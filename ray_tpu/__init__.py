@@ -1,0 +1,96 @@
+"""ray_tpu — a TPU-native distributed computing framework.
+
+Capabilities modeled on the reference Ray (tasks, actors, objects,
+placement groups, Data/Train/Tune/Serve/RLlib) with TPU-idiomatic
+internals: JAX/XLA for compute, GSPMD + shard_map over device meshes for
+parallelism, pallas kernels for hot ops.
+
+Core API (reference: python/ray/_private/worker.py):
+
+    import ray_tpu
+
+    ray_tpu.init()
+
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    ray_tpu.get(f.remote(2))  # -> 4
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, overload
+
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.worker import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    shutdown,
+    timeline,
+    wait,
+)
+from ray_tpu.actor import ActorClass, ActorHandle, exit_actor, method
+from ray_tpu.remote_function import RemoteFunction
+from ray_tpu.runtime_context import get_runtime_context
+from ray_tpu import exceptions
+
+__version__ = "0.1.0"
+
+
+def remote(*args, **kwargs):
+    """Turn a function into a task factory or a class into an actor factory.
+
+    Reference: ray.remote (python/ray/_private/worker.py:3137-3236).
+    Supports both bare ``@remote`` and parameterized
+    ``@remote(num_cpus=2, ...)`` forms.
+    """
+    if len(args) == 1 and not kwargs and callable(args[0]):
+        target = args[0]
+        if isinstance(target, type):
+            return ActorClass(target)
+        return RemoteFunction(target)
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. "
+                        "@remote(num_cpus=2)")
+
+    def decorator(target):
+        if isinstance(target, type):
+            return ActorClass(target, kwargs)
+        return RemoteFunction(target, kwargs)
+
+    return decorator
+
+
+__all__ = [
+    "ObjectRef",
+    "ActorClass",
+    "ActorHandle",
+    "RemoteFunction",
+    "available_resources",
+    "cancel",
+    "cluster_resources",
+    "exceptions",
+    "exit_actor",
+    "get",
+    "get_actor",
+    "get_runtime_context",
+    "init",
+    "is_initialized",
+    "kill",
+    "method",
+    "nodes",
+    "put",
+    "remote",
+    "shutdown",
+    "timeline",
+    "wait",
+]

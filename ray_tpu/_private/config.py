@@ -1,0 +1,105 @@
+"""Framework configuration flag system.
+
+TPU-native analogue of the reference's RAY_CONFIG macro table
+(reference: src/ray/common/ray_config_def.h — 217 entries, overridable via
+RAY_* env vars and the driver's _system_config). Here flags are declared
+once in ``_DEFAULTS``; every flag is overridable via ``RAY_TPU_<NAME>``
+environment variables and via ``init(system_config={...})``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any
+
+_DEFAULTS: dict[str, Any] = {
+    # Scheduling.
+    "num_cpus": os.cpu_count() or 1,
+    "scheduler_spread_threshold": 0.5,
+    "max_pending_lease_requests_per_scheduling_category": 10,
+    "worker_lease_timeout_ms": 500,
+    # Object store.
+    "object_store_memory_mb": 2048,
+    "object_store_full_delay_ms": 100,
+    "inline_object_max_size_bytes": 100 * 1024,
+    "object_spilling_threshold": 0.8,
+    "object_spilling_dir": "/tmp/ray_tpu_spill",
+    # Tasks.
+    "max_task_retries": 0,
+    "task_retry_delay_ms": 0,
+    # Actors.
+    "actor_max_restarts": 0,
+    "actor_graceful_shutdown_timeout_s": 5.0,
+    # Health checking.
+    "health_check_period_ms": 1000,
+    "health_check_failure_threshold": 5,
+    # Metrics.
+    "metrics_report_interval_ms": 2000,
+    # Logging.
+    "log_level": "INFO",
+    # Multiprocess worker pool.
+    "worker_pool_size": 0,  # 0 => defer to num_cpus
+    "worker_startup_timeout_s": 30.0,
+    # Placement groups.
+    "placement_group_commit_timeout_s": 30.0,
+}
+
+
+class Config:
+    """Process-wide flag table with env-var and runtime overrides."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._values = dict(_DEFAULTS)
+        self._apply_env_overrides()
+
+    def _apply_env_overrides(self):
+        for key, default in _DEFAULTS.items():
+            env_key = "RAY_TPU_" + key.upper()
+            raw = os.environ.get(env_key)
+            if raw is None:
+                continue
+            self._values[key] = _coerce(raw, type(default))
+
+    def update(self, overrides: dict[str, Any] | str | None):
+        if not overrides:
+            return
+        if isinstance(overrides, str):
+            overrides = json.loads(overrides)
+        with self._lock:
+            for key, value in overrides.items():
+                if key not in _DEFAULTS:
+                    raise KeyError(f"Unknown system config key: {key!r}")
+                self._values[key] = value
+
+    def get(self, key: str) -> Any:
+        with self._lock:
+            return self._values[key]
+
+    def __getattr__(self, key: str) -> Any:
+        if key.startswith("_"):
+            raise AttributeError(key)
+        try:
+            return self.get(key)
+        except KeyError:
+            raise AttributeError(key) from None
+
+    def reset(self):
+        with self._lock:
+            self._values = dict(_DEFAULTS)
+            self._apply_env_overrides()
+
+
+def _coerce(raw: str, typ: type) -> Any:
+    if typ is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    if typ is int:
+        return int(raw)
+    if typ is float:
+        return float(raw)
+    return raw
+
+
+GLOBAL_CONFIG = Config()

@@ -1,0 +1,264 @@
+"""Global Control Service — the head-node control plane.
+
+TPU-native analogue of the reference GCS (reference:
+src/ray/gcs/gcs_server/gcs_server.h:78 and its managers): internal KV
+(gcs_kv_manager.h), named-actor registry (gcs_actor_manager.h), node table
+(gcs_node_manager.h), job table, task-event store for observability
+(gcs_task_manager.h), and a pubsub hub (src/ray/pubsub/publisher.h:307).
+
+Single-node slice: tables are in-process and thread-safe; the pubsub hub
+delivers callbacks synchronously on publish. The storage interface is kept
+behind ``KVStore`` so a redis/file-backed implementation can slot in for
+fault tolerance (reference: store_client/redis_store_client.h:33).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ray_tpu._private.ids import ActorID, JobID, NodeID, TaskID
+
+
+class KVStore:
+    """Namespaced key-value store (reference: gcs_kv_manager.h)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._data: dict[str, dict[bytes, bytes]] = defaultdict(dict)
+
+    def put(self, key: bytes, value: bytes, namespace: str = "default",
+            overwrite: bool = True) -> bool:
+        with self._lock:
+            ns = self._data[namespace]
+            if not overwrite and key in ns:
+                return False
+            ns[key] = value
+            return True
+
+    def get(self, key: bytes, namespace: str = "default") -> bytes | None:
+        with self._lock:
+            return self._data[namespace].get(key)
+
+    def delete(self, key: bytes, namespace: str = "default") -> bool:
+        with self._lock:
+            return self._data[namespace].pop(key, None) is not None
+
+    def exists(self, key: bytes, namespace: str = "default") -> bool:
+        with self._lock:
+            return key in self._data[namespace]
+
+    def keys(self, prefix: bytes = b"", namespace: str = "default") -> list[bytes]:
+        with self._lock:
+            return [k for k in self._data[namespace] if k.startswith(prefix)]
+
+
+class PubSub:
+    """In-process pub/sub hub (reference: src/ray/pubsub/publisher.h:307)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._subs: dict[str, list[Callable[[Any], None]]] = defaultdict(list)
+
+    def subscribe(self, channel: str, callback: Callable[[Any], None]) -> Callable[[], None]:
+        with self._lock:
+            self._subs[channel].append(callback)
+
+        def unsubscribe():
+            with self._lock:
+                try:
+                    self._subs[channel].remove(callback)
+                except ValueError:
+                    pass
+
+        return unsubscribe
+
+    def publish(self, channel: str, message: Any) -> None:
+        with self._lock:
+            callbacks = list(self._subs.get(channel, ()))
+        for cb in callbacks:
+            try:
+                cb(message)
+            except Exception:
+                pass
+
+
+@dataclass
+class ActorRecord:
+    actor_id: ActorID
+    name: str | None
+    namespace: str
+    class_name: str
+    state: str = "PENDING"  # PENDING / ALIVE / RESTARTING / DEAD
+    max_restarts: int = 0
+    num_restarts: int = 0
+    death_cause: str | None = None
+    handle: Any = None  # the live LocalActor executor (single-node slice)
+    placement_hint: Any = None
+    # Per-method defaults declared via @ray_tpu.method (e.g. num_returns).
+    method_meta: dict = field(default_factory=dict)
+
+
+@dataclass
+class NodeRecord:
+    node_id: NodeID
+    address: str
+    resources: dict[str, float]
+    labels: dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+    last_heartbeat: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class JobRecord:
+    job_id: JobID
+    start_time: float = field(default_factory=time.time)
+    end_time: float | None = None
+    status: str = "RUNNING"
+
+
+@dataclass
+class TaskEvent:
+    """Observability record (reference: gcs_task_manager.h task events)."""
+
+    task_id: TaskID
+    name: str
+    state: str  # PENDING / RUNNING / FINISHED / FAILED
+    start_time: float = 0.0
+    end_time: float = 0.0
+    node_id: str = ""
+    error: str | None = None
+    actor_id: str | None = None
+
+
+class GlobalControlService:
+    """All control-plane tables in one place."""
+
+    def __init__(self):
+        self.kv = KVStore()
+        self.pubsub = PubSub()
+        self._lock = threading.Lock()
+        self._actors: dict[ActorID, ActorRecord] = {}
+        self._named_actors: dict[tuple[str, str], ActorID] = {}
+        self._nodes: dict[NodeID, NodeRecord] = {}
+        self._jobs: dict[JobID, JobRecord] = {}
+        self._task_events: dict[TaskID, TaskEvent] = {}
+        self._task_event_limit = 100_000
+
+    # ---------------------------------------------------------------- actors
+
+    def register_actor(self, record: ActorRecord) -> None:
+        with self._lock:
+            if record.name is not None:
+                key = (record.namespace, record.name)
+                existing_id = self._named_actors.get(key)
+                if existing_id is not None:
+                    existing = self._actors.get(existing_id)
+                    if existing is not None and existing.state != "DEAD":
+                        raise ValueError(
+                            f"Actor with name {record.name!r} already exists "
+                            f"in namespace {record.namespace!r}")
+                self._named_actors[key] = record.actor_id
+            self._actors[record.actor_id] = record
+        self.pubsub.publish("actors", ("REGISTERED", record.actor_id))
+
+    def update_actor_state(self, actor_id: ActorID, state: str,
+                           death_cause: str | None = None) -> None:
+        with self._lock:
+            record = self._actors.get(actor_id)
+            if record is None:
+                return
+            record.state = state
+            if death_cause is not None:
+                record.death_cause = death_cause
+        self.pubsub.publish("actors", (state, actor_id))
+
+    def get_actor(self, actor_id: ActorID) -> ActorRecord | None:
+        with self._lock:
+            return self._actors.get(actor_id)
+
+    def get_named_actor(self, name: str, namespace: str = "default") -> ActorRecord | None:
+        with self._lock:
+            actor_id = self._named_actors.get((namespace, name))
+            if actor_id is None:
+                return None
+            record = self._actors.get(actor_id)
+            if record is None or record.state == "DEAD":
+                return None
+            return record
+
+    def remove_actor(self, actor_id: ActorID, reason: str = "killed") -> None:
+        with self._lock:
+            record = self._actors.get(actor_id)
+            if record is None:
+                return
+            record.state = "DEAD"
+            record.death_cause = reason
+            if record.name is not None:
+                self._named_actors.pop((record.namespace, record.name), None)
+        self.pubsub.publish("actors", ("DEAD", actor_id))
+
+    def list_actors(self) -> list[ActorRecord]:
+        with self._lock:
+            return list(self._actors.values())
+
+    # ----------------------------------------------------------------- nodes
+
+    def register_node(self, record: NodeRecord) -> None:
+        with self._lock:
+            self._nodes[record.node_id] = record
+        self.pubsub.publish("nodes", ("ALIVE", record.node_id))
+
+    def mark_node_dead(self, node_id: NodeID) -> None:
+        with self._lock:
+            record = self._nodes.get(node_id)
+            if record is not None:
+                record.alive = False
+        self.pubsub.publish("nodes", ("DEAD", node_id))
+
+    def heartbeat(self, node_id: NodeID) -> None:
+        with self._lock:
+            record = self._nodes.get(node_id)
+            if record is not None:
+                record.last_heartbeat = time.monotonic()
+
+    def list_nodes(self) -> list[NodeRecord]:
+        with self._lock:
+            return list(self._nodes.values())
+
+    # ------------------------------------------------------------------ jobs
+
+    def register_job(self, record: JobRecord) -> None:
+        with self._lock:
+            self._jobs[record.job_id] = record
+
+    def finish_job(self, job_id: JobID, status: str = "SUCCEEDED") -> None:
+        with self._lock:
+            record = self._jobs.get(job_id)
+            if record is not None:
+                record.status = status
+                record.end_time = time.time()
+
+    def list_jobs(self) -> list[JobRecord]:
+        with self._lock:
+            return list(self._jobs.values())
+
+    # ----------------------------------------------------------- task events
+
+    def record_task_event(self, event: TaskEvent) -> None:
+        with self._lock:
+            if len(self._task_events) >= self._task_event_limit \
+                    and event.task_id not in self._task_events:
+                return
+            self._task_events[event.task_id] = event
+
+    def get_task_event(self, task_id: TaskID) -> TaskEvent | None:
+        with self._lock:
+            return self._task_events.get(task_id)
+
+    def list_task_events(self) -> list[TaskEvent]:
+        with self._lock:
+            return list(self._task_events.values())

@@ -1,0 +1,98 @@
+"""Unique identifiers for framework entities.
+
+TPU-native analogue of the reference's binary ID system
+(reference: src/ray/common/id.h, design_docs/id_specification.md): every
+task, object, actor, node, job and placement group gets a globally unique
+id. We use 16 random bytes (hex-printed) rather than the reference's
+composed task-id+index scheme; object provenance is tracked explicitly by
+the ownership table instead.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+class BaseID:
+    """A 16-byte random identifier with a stable hex representation."""
+
+    __slots__ = ("_bytes",)
+    _NIL: bytes = b"\x00" * 16
+
+    def __init__(self, id_bytes: bytes | None = None):
+        if id_bytes is None:
+            id_bytes = os.urandom(16)
+        if len(id_bytes) != 16:
+            raise ValueError(f"{type(self).__name__} requires 16 bytes, got {len(id_bytes)}")
+        self._bytes = id_bytes
+
+    @classmethod
+    def nil(cls):
+        return cls(cls._NIL)
+
+    @classmethod
+    def from_hex(cls, hex_str: str):
+        return cls(bytes.fromhex(hex_str))
+
+    def is_nil(self) -> bool:
+        return self._bytes == self._NIL
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __hash__(self):
+        return hash((type(self).__name__, self._bytes))
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.hex()})"
+
+    def __reduce__(self):
+        return (type(self), (self._bytes,))
+
+
+class ObjectID(BaseID):
+    pass
+
+
+class TaskID(BaseID):
+    pass
+
+
+class ActorID(BaseID):
+    pass
+
+
+class JobID(BaseID):
+    pass
+
+
+class NodeID(BaseID):
+    pass
+
+
+class WorkerID(BaseID):
+    pass
+
+
+class PlacementGroupID(BaseID):
+    pass
+
+
+class _Counter:
+    """Thread-safe monotonically increasing counter (for sequence numbers)."""
+
+    def __init__(self):
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def next(self) -> int:
+        with self._lock:
+            self._value += 1
+            return self._value

@@ -1,0 +1,106 @@
+"""ObjectRef — a handle to a (possibly pending) object.
+
+Reference: python/ray/includes/object_ref.pxi + ownership semantics from
+src/ray/core_worker/reference_count.h. A live ObjectRef contributes one
+reference; deserializing a ref (e.g. inside task args) re-registers it so
+borrower lifetimes are tracked.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+from typing import Any
+
+from ray_tpu._private.ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("_id", "_owner", "_registered", "__weakref__")
+
+    def __init__(self, object_id: ObjectID, owner: str = "", _register: bool = True):
+        self._id = object_id
+        self._owner = owner
+        self._registered = False
+        if _register:
+            runtime = _try_runtime()
+            if runtime is not None:
+                runtime.reference_counter.add_ref(object_id)
+                self._registered = True
+
+    def id(self) -> ObjectID:
+        return self._id
+
+    def hex(self) -> str:
+        return self._id.hex()
+
+    def binary(self) -> bytes:
+        return self._id.binary()
+
+    @property
+    def owner_address(self) -> str:
+        return self._owner
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def __del__(self):
+        if getattr(self, "_registered", False):
+            try:
+                runtime = _try_runtime()
+                if runtime is not None:
+                    runtime.reference_counter.remove_ref(self._id)
+            except BaseException:
+                pass
+
+    def __reduce__(self):
+        # Deserializing creates a borrower reference on the receiving side.
+        return (ObjectRef, (self._id, self._owner))
+
+    # -- convenience --------------------------------------------------------
+
+    def future(self) -> concurrent.futures.Future:
+        """Return a concurrent.futures.Future resolving to the value."""
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        runtime = _try_runtime()
+        if runtime is None:
+            fut.set_exception(RuntimeError("ray_tpu is not initialized"))
+            return fut
+        runtime.attach_future(self, fut)
+        return fut
+
+    def __await__(self):
+        import asyncio
+
+        return asyncio.wrap_future(self.future()).__await__()
+
+    def __hash__(self):
+        return hash(self._id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._id == self._id
+
+    def __repr__(self):
+        return f"ObjectRef({self._id.hex()})"
+
+
+def _try_runtime():
+    from ray_tpu._private import worker
+
+    return worker.global_runtime()
+
+
+def resolve_args(args: tuple, kwargs: dict, get_fn) -> tuple[tuple, dict, list[Any]]:
+    """Replace top-level ObjectRef args with their values.
+
+    Matches the reference's dependency-resolution semantics
+    (src/ray/core_worker/transport/dependency_resolver.h): only top-level
+    refs are resolved; refs nested inside containers are passed through
+    (the callee must call get() itself).
+    """
+    resolved_args = tuple(get_fn(a) if isinstance(a, ObjectRef) else a for a in args)
+    resolved_kwargs = {
+        k: get_fn(v) if isinstance(v, ObjectRef) else v for k, v in kwargs.items()
+    }
+    deps = [a for a in args if isinstance(a, ObjectRef)] + [
+        v for v in kwargs.values() if isinstance(v, ObjectRef)
+    ]
+    return resolved_args, resolved_kwargs, deps

@@ -1,0 +1,368 @@
+"""In-memory object store with spilling and reference counting.
+
+TPU-native analogue of the reference's two-tier store: the in-process
+memory store for small objects/futures (reference:
+src/ray/core_worker/store_provider/memory_store/memory_store.h) plus the
+plasma shared-memory store with LRU eviction and disk spilling (reference:
+src/ray/object_manager/plasma/object_store.h,
+src/ray/raylet/local_object_manager.h:110 SpillObjects).
+
+Objects here are held as live Python objects (zero-copy within the node —
+host numpy/jax arrays are shared by reference, the moral equivalent of
+plasma's mmap zero-copy reads). When the store exceeds its memory budget,
+sealed objects with no pinned readers are spilled to disk (pickled) and
+restored transparently on access.
+
+Reference counting follows the ownership model (reference:
+src/ray/core_worker/reference_count.h:61): the driver/worker that created
+an object owns it; local ObjectRef lifetimes drive the count and an object
+with zero references becomes evictable.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ray_tpu._private.ids import ObjectID
+from ray_tpu.exceptions import (
+    GetTimeoutError,
+    ObjectFreedError,
+    ObjectLostError,
+)
+
+
+def _sizeof(value: Any) -> int:
+    """Best-effort deep size estimate without serializing."""
+    try:
+        import numpy as np
+
+        if isinstance(value, np.ndarray):
+            return int(value.nbytes)
+    except Exception:
+        pass
+    try:
+        import jax
+
+        if isinstance(value, jax.Array):
+            return int(value.size * value.dtype.itemsize)
+    except Exception:
+        pass
+    if isinstance(value, (bytes, bytearray, memoryview)):
+        return len(value)
+    if isinstance(value, str):
+        return len(value)
+    if isinstance(value, (list, tuple, set)) and len(value) < 1024:
+        return 64 + sum(_sizeof(v) for v in value)
+    if isinstance(value, dict) and len(value) < 1024:
+        return 64 + sum(_sizeof(k) + _sizeof(v) for k, v in value.items())
+    return 64
+
+
+@dataclass
+class ObjectEntry:
+    object_id: ObjectID
+    value: Any = None
+    error: BaseException | None = None
+    sealed: bool = False
+    size_bytes: int = 0
+    spilled_path: str | None = None
+    freed: bool = False
+    created_at: float = field(default_factory=time.monotonic)
+    # Pinned while a get() is materializing it; pinned entries never spill.
+    pin_count: int = 0
+
+
+class ObjectStore:
+    """Node-local object store: seal/get/wait/free with spill-to-disk."""
+
+    def __init__(self, memory_limit_bytes: int, spill_dir: str):
+        self._lock = threading.Condition(threading.Lock())
+        self._entries: dict[ObjectID, ObjectEntry] = {}
+        self._memory_limit = memory_limit_bytes
+        self._memory_used = 0
+        self._spill_dir = spill_dir
+        self._spilled_bytes_total = 0
+        self._restored_bytes_total = 0
+        # Callbacks fired (outside the lock) when an object is sealed.
+        self._seal_listeners: list[Callable[[ObjectID], None]] = []
+
+    # ------------------------------------------------------------------ put
+
+    def create_pending(self, object_id: ObjectID) -> None:
+        """Register an object whose value will arrive later (a future)."""
+        with self._lock:
+            if object_id not in self._entries:
+                self._entries[object_id] = ObjectEntry(object_id)
+
+    def put(self, object_id: ObjectID, value: Any) -> None:
+        self._seal(object_id, value=value, error=None)
+
+    def put_error(self, object_id: ObjectID, error: BaseException) -> None:
+        self._seal(object_id, value=None, error=error)
+
+    def _seal(self, object_id: ObjectID, value: Any, error: BaseException | None):
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                entry = ObjectEntry(object_id)
+                self._entries[object_id] = entry
+            if entry.sealed and not entry.freed:
+                # Idempotent reseal (e.g. task retry recomputed the value).
+                if entry.spilled_path is not None:
+                    # Spilled copies already gave their bytes back; just drop
+                    # the stale file.
+                    try:
+                        os.unlink(entry.spilled_path)
+                    except OSError:
+                        pass
+                else:
+                    self._memory_used -= entry.size_bytes
+            entry.value = value
+            entry.error = error
+            entry.sealed = True
+            entry.freed = False
+            entry.spilled_path = None
+            entry.size_bytes = _sizeof(value) if error is None else 256
+            self._memory_used += entry.size_bytes
+            self._lock.notify_all()
+            listeners = list(self._seal_listeners)
+        for cb in listeners:
+            cb(object_id)
+        self._maybe_spill()
+
+    def add_seal_listener(self, cb: Callable[[ObjectID], None]) -> None:
+        with self._lock:
+            self._seal_listeners.append(cb)
+
+    # ------------------------------------------------------------------ get
+
+    def get(self, object_id: ObjectID, timeout: float | None = None) -> Any:
+        """Block until the object is sealed; raise stored errors."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                entry = self._entries.get(object_id)
+                if entry is not None and entry.freed:
+                    raise ObjectFreedError(object_id, f"object {object_id.hex()} was freed")
+                if entry is not None and entry.sealed:
+                    break
+                if entry is None:
+                    # Unknown id: wait for it to appear (it may be in flight).
+                    pass
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise GetTimeoutError(
+                        f"get() timed out waiting for object {object_id.hex()}")
+                self._lock.wait(timeout=remaining if remaining is None else min(remaining, 1.0))
+            entry.pin_count += 1
+        try:
+            value, error = self._materialize(entry)
+        finally:
+            with self._lock:
+                entry.pin_count -= 1
+        if error is not None:
+            raise error
+        return value
+
+    def _materialize(self, entry: ObjectEntry):
+        """Load a (possibly spilled) sealed entry. Called outside hot lock.
+
+        Concurrent restores of the same object race benignly: each reader
+        snapshots the path under the lock, and only the thread whose
+        snapshot still matches performs the restore/unlink.
+        """
+        while True:
+            with self._lock:
+                path = entry.spilled_path
+            if path is None:
+                return entry.value, entry.error
+            try:
+                with open(path, "rb") as f:
+                    value = pickle.load(f)
+            except FileNotFoundError:
+                continue  # another reader restored it; re-check
+            with self._lock:
+                if entry.spilled_path == path:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                    entry.spilled_path = None
+                    entry.value = value
+                    self._memory_used += entry.size_bytes
+                    self._restored_bytes_total += entry.size_bytes
+            self._maybe_spill()
+            return entry.value, entry.error
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            entry = self._entries.get(object_id)
+            return entry is not None and entry.sealed and not entry.freed
+
+    def is_pending(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            entry = self._entries.get(object_id)
+            return entry is not None and not entry.sealed
+
+    def wait(
+        self,
+        object_ids: list[ObjectID],
+        num_returns: int,
+        timeout: float | None,
+    ) -> tuple[list[ObjectID], list[ObjectID]]:
+        """Reference: CoreWorker::Wait (src/ray/core_worker/core_worker.cc:1627)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while True:
+                ready = [
+                    oid for oid in object_ids
+                    if (e := self._entries.get(oid)) is not None and e.sealed and not e.freed
+                ]
+                if len(ready) >= num_returns:
+                    ready_set = set(ready[:num_returns])
+                    # preserve input order
+                    ready_ordered = [o for o in object_ids if o in ready_set]
+                    not_ready = [o for o in object_ids if o not in ready_set]
+                    return ready_ordered, not_ready
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    ready_set = set(ready)
+                    return ([o for o in object_ids if o in ready_set],
+                            [o for o in object_ids if o not in ready_set])
+                self._lock.wait(timeout=remaining if remaining is None else min(remaining, 1.0))
+
+    # ----------------------------------------------------------------- free
+
+    def free(self, object_ids: list[ObjectID]) -> None:
+        with self._lock:
+            for oid in object_ids:
+                entry = self._entries.get(oid)
+                if entry is None:
+                    continue
+                if entry.sealed and entry.spilled_path is None:
+                    self._memory_used -= entry.size_bytes
+                if entry.spilled_path is not None:
+                    try:
+                        os.unlink(entry.spilled_path)
+                    except OSError:
+                        pass
+                entry.value = None
+                entry.error = None
+                entry.freed = True
+                entry.sealed = True
+                entry.spilled_path = None
+            self._lock.notify_all()
+
+    def evict(self, object_id: ObjectID) -> None:
+        """Drop an object entirely (refcount reached zero)."""
+        with self._lock:
+            entry = self._entries.pop(object_id, None)
+            if entry is not None and entry.sealed and not entry.freed \
+                    and entry.spilled_path is None:
+                self._memory_used -= entry.size_bytes
+            if entry is not None and entry.spilled_path is not None:
+                try:
+                    os.unlink(entry.spilled_path)
+                except OSError:
+                    pass
+
+    # ----------------------------------------------------------------- spill
+
+    def _maybe_spill(self) -> None:
+        """Spill least-recently-created unpinned objects above the budget.
+
+        Reference: LocalObjectManager::SpillObjects
+        (src/ray/raylet/local_object_manager.h:110).
+        """
+        to_spill: list[ObjectEntry] = []
+        with self._lock:
+            if self._memory_used <= self._memory_limit:
+                return
+            candidates = sorted(
+                (e for e in self._entries.values()
+                 if e.sealed and not e.freed and e.error is None
+                 and e.spilled_path is None and e.pin_count == 0
+                 and e.size_bytes > 4096),
+                key=lambda e: e.created_at,
+            )
+            need = self._memory_used - int(self._memory_limit * 0.7)
+            for entry in candidates:
+                if need <= 0:
+                    break
+                to_spill.append(entry)
+                need -= entry.size_bytes
+        if not to_spill:
+            return
+        os.makedirs(self._spill_dir, exist_ok=True)
+        for entry in to_spill:
+            path = os.path.join(self._spill_dir, entry.object_id.hex())
+            try:
+                with open(path, "wb") as f:
+                    pickle.dump(entry.value, f, protocol=pickle.HIGHEST_PROTOCOL)
+            except Exception:
+                continue  # unpicklable objects just stay in memory
+            with self._lock:
+                if entry.pin_count == 0 and entry.spilled_path is None and entry.sealed:
+                    entry.spilled_path = path
+                    entry.value = None
+                    self._memory_used -= entry.size_bytes
+                    self._spilled_bytes_total += entry.size_bytes
+                else:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+
+    # ----------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "num_objects": len(self._entries),
+                "num_sealed": sum(1 for e in self._entries.values() if e.sealed),
+                "memory_used_bytes": self._memory_used,
+                "memory_limit_bytes": self._memory_limit,
+                "spilled_bytes_total": self._spilled_bytes_total,
+                "restored_bytes_total": self._restored_bytes_total,
+            }
+
+
+class ReferenceCounter:
+    """Ownership-based distributed reference counting (single-node slice).
+
+    Reference: src/ray/core_worker/reference_count.h:61 — the owner tracks
+    local refs plus borrower counts; here all refs are node-local so the
+    count is the number of live ObjectRef handles plus task-argument pins.
+    """
+
+    def __init__(self, store: ObjectStore):
+        self._lock = threading.Lock()
+        self._counts: dict[ObjectID, int] = {}
+        self._store = store
+
+    def add_ref(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._counts[object_id] = self._counts.get(object_id, 0) + 1
+
+    def remove_ref(self, object_id: ObjectID) -> None:
+        evict = False
+        with self._lock:
+            count = self._counts.get(object_id)
+            if count is None:
+                return
+            if count <= 1:
+                del self._counts[object_id]
+                evict = True
+            else:
+                self._counts[object_id] = count - 1
+        if evict:
+            self._store.evict(object_id)
+
+    def count(self, object_id: ObjectID) -> int:
+        with self._lock:
+            return self._counts.get(object_id, 0)

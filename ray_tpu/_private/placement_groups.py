@@ -1,0 +1,209 @@
+"""Placement groups — gang resource reservation.
+
+Reference: GCS-side GcsPlacementGroupManager/Scheduler (2-phase prepare/
+commit of bundles, src/ray/gcs/gcs_server/gcs_placement_group_manager.h)
+plus raylet-side PlacementGroupResourceManager
+(src/ray/raylet/placement_group_resource_manager.h) and bundle policies
+PACK/SPREAD/STRICT_PACK/STRICT_SPREAD
+(src/ray/raylet/scheduling/policy/bundle_scheduling_policy.cc).
+
+TPU-native addition: STRICT_PACK is the natural strategy for a TPU pod
+slice — the ``tpu_slice_bundle`` helper reserves every chip of a slice on
+one host group, mirroring the reference's TPU-{type}-head gang resource
+(python/ray/_private/accelerators/tpu.py:382).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from ray_tpu._private.ids import NodeID, ObjectID, PlacementGroupID
+from ray_tpu.exceptions import PlacementGroupError
+
+VALID_STRATEGIES = ("PACK", "SPREAD", "STRICT_PACK", "STRICT_SPREAD")
+
+
+@dataclass
+class BundleReservation:
+    bundle_index: int
+    resources: dict[str, float]
+    node_id: NodeID | None = None
+    committed: bool = False
+    # Resources currently loaned out to tasks/actors scheduled in the bundle.
+    in_use: dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class PlacementGroupRecord:
+    pg_id: PlacementGroupID
+    bundles: list[BundleReservation]
+    strategy: str
+    name: str
+    state: str = "PENDING"  # PENDING / CREATED / REMOVED
+    ready_object_id: ObjectID | None = None
+
+
+class PlacementGroupManager:
+    """Two-phase (prepare/commit) bundle reservation over ClusterState."""
+
+    def __init__(self, cluster, store):
+        self._cluster = cluster
+        self._store = store
+        self._lock = threading.Lock()
+        self._groups: dict[PlacementGroupID, PlacementGroupRecord] = {}
+
+    def create(self, bundles: list[dict[str, float]], strategy: str,
+               name: str = "") -> PlacementGroupRecord:
+        if strategy not in VALID_STRATEGIES:
+            raise ValueError(
+                f"Invalid strategy {strategy!r}; must be one of {VALID_STRATEGIES}")
+        if not bundles:
+            raise ValueError("Placement group requires at least one bundle")
+        for bundle in bundles:
+            if not bundle or all(v == 0 for v in bundle.values()):
+                raise ValueError(f"Invalid empty bundle: {bundle}")
+        record = PlacementGroupRecord(
+            pg_id=PlacementGroupID(),
+            bundles=[BundleReservation(i, dict(b)) for i, b in enumerate(bundles)],
+            strategy=strategy,
+            name=name,
+            ready_object_id=ObjectID(),
+        )
+        with self._lock:
+            self._groups[record.pg_id] = record
+        self._store.create_pending(record.ready_object_id)
+        # Reservation runs in the background; ready_object seals on commit.
+        threading.Thread(
+            target=self._reserve_loop, args=(record,), daemon=True,
+            name=f"ray_tpu-pg-{record.pg_id.hex()[:8]}").start()
+        return record
+
+    # ------------------------------------------------------------- placement
+
+    def _reserve_loop(self, record: PlacementGroupRecord) -> None:
+        import time
+
+        while True:
+            with self._lock:
+                if record.state == "REMOVED":
+                    return
+            if self._try_reserve(record):
+                with self._lock:
+                    if record.state == "REMOVED":
+                        self._rollback(record)
+                        return
+                    record.state = "CREATED"
+                self._store.put(record.ready_object_id, None)
+                return
+            time.sleep(0.05)
+
+    def _try_reserve(self, record: PlacementGroupRecord) -> bool:
+        """Phase 1 prepare: acquire all bundles or roll back (all-or-nothing)."""
+        placed: list[BundleReservation] = []
+        used_nodes: set[NodeID] = set()
+        ok = True
+        for bundle in record.bundles:
+            node = self._pick_bundle_node(record, bundle, used_nodes, placed)
+            if node is None or not self._cluster.try_acquire(node.node_id, bundle.resources):
+                ok = False
+                break
+            bundle.node_id = node.node_id
+            placed.append(bundle)
+            used_nodes.add(node.node_id)
+        if not ok:
+            for bundle in placed:
+                self._cluster.release(bundle.node_id, bundle.resources)
+                bundle.node_id = None
+            return False
+        # Phase 2 commit.
+        for bundle in record.bundles:
+            bundle.committed = True
+        return True
+
+    def _pick_bundle_node(self, record, bundle, used_nodes, placed):
+        strategy = record.strategy
+        if strategy == "STRICT_PACK":
+            if placed:
+                node = self._cluster.get_node(placed[0].node_id)
+                return node if (node and node.fits(bundle.resources)) else None
+            return self._cluster.pick_node(bundle.resources, None)
+        if strategy == "STRICT_SPREAD":
+            return self._cluster.pick_node(bundle.resources, None, exclude=used_nodes)
+        if strategy == "SPREAD":
+            node = self._cluster.pick_node(bundle.resources, None, exclude=used_nodes)
+            if node is None:
+                node = self._cluster.pick_node(bundle.resources, None)
+            return node
+        # PACK: prefer the node already used by earlier bundles.
+        if placed:
+            node = self._cluster.get_node(placed[0].node_id)
+            if node is not None and node.fits(bundle.resources):
+                return node
+        return self._cluster.pick_node(bundle.resources, None)
+
+    # ------------------------------------------------------------ bundle use
+
+    def acquire_from_bundle(self, pg_id: PlacementGroupID, bundle_index: int,
+                            demand: dict[str, float]) -> NodeID:
+        """Loan resources from a committed bundle to a task/actor."""
+        with self._lock:
+            record = self._groups.get(pg_id)
+            if record is None or record.state != "CREATED":
+                raise PlacementGroupError(
+                    f"Placement group {pg_id.hex()} is not ready")
+            candidates = (record.bundles if bundle_index < 0
+                          else [record.bundles[bundle_index]])
+            for bundle in candidates:
+                free = {
+                    k: bundle.resources.get(k, 0.0) - bundle.in_use.get(k, 0.0)
+                    for k in set(bundle.resources) | set(demand)
+                }
+                if all(free.get(k, 0.0) + 1e-9 >= v for k, v in demand.items()):
+                    for k, v in demand.items():
+                        bundle.in_use[k] = bundle.in_use.get(k, 0.0) + v
+                    return bundle.node_id
+            raise PlacementGroupError(
+                f"No capacity in placement group {pg_id.hex()} bundle "
+                f"{bundle_index} for {demand}")
+
+    def release_to_bundle(self, pg_id: PlacementGroupID, bundle_index: int,
+                          demand: dict[str, float]) -> None:
+        with self._lock:
+            record = self._groups.get(pg_id)
+            if record is None:
+                return
+            bundles = (record.bundles if bundle_index < 0
+                       else [record.bundles[bundle_index]])
+            for bundle in bundles:
+                if all(bundle.in_use.get(k, 0.0) + 1e-9 >= v for k, v in demand.items()):
+                    for k, v in demand.items():
+                        bundle.in_use[k] = bundle.in_use.get(k, 0.0) - v
+                    return
+
+    # ---------------------------------------------------------------- remove
+
+    def remove(self, pg_id: PlacementGroupID) -> None:
+        with self._lock:
+            record = self._groups.get(pg_id)
+            if record is None:
+                return
+            was_created = record.state == "CREATED"
+            record.state = "REMOVED"
+        if was_created:
+            self._rollback(record)
+
+    def _rollback(self, record: PlacementGroupRecord) -> None:
+        for bundle in record.bundles:
+            if bundle.node_id is not None and bundle.committed:
+                self._cluster.release(bundle.node_id, bundle.resources)
+                bundle.committed = False
+                bundle.node_id = None
+
+    def get(self, pg_id: PlacementGroupID) -> PlacementGroupRecord | None:
+        with self._lock:
+            return self._groups.get(pg_id)
+
+    def list(self) -> list[PlacementGroupRecord]:
+        with self._lock:
+            return list(self._groups.values())

@@ -1,0 +1,399 @@
+"""Cluster resource scheduling and task dispatch.
+
+TPU-native analogue of the reference's two-level scheduler:
+
+- ``ClusterState`` mirrors ClusterResourceManager + ClusterResourceScheduler
+  (reference: src/ray/raylet/scheduling/cluster_resource_scheduler.h:44):
+  a view of every node's total/available resources plus policy-based node
+  selection (hybrid pack-then-spread, spread, node-affinity — reference:
+  src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.cc).
+- ``NodeExecutor`` mirrors the raylet's LocalTaskManager + WorkerPool
+  (reference: src/ray/raylet/local_task_manager.h:58, worker_pool.h):
+  per-node dispatch queue with resource admission; a Python thread plays
+  the role of a leased worker (true multiprocess workers are layered on in
+  ray_tpu/_private/worker_pool.py).
+
+Nodes are in-process "virtual nodes" so multi-node scheduling logic is
+fully exercised on one machine — the same strategy as the reference's
+cluster_utils.Cluster test fixture (python/ray/cluster_utils.py:108).
+
+Deadlock note: a task blocked in ``get()`` releases its CPU admission and
+reacquires on wake (reference behavior: workers blocked in ray.get return
+their CPU to the raylet), so nested task graphs cannot starve.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ray_tpu._private.ids import NodeID, _Counter
+from ray_tpu._private.task import TaskSpec
+
+_DISPATCH_ORDER = _Counter()
+
+
+@dataclass
+class NodeState:
+    """One node's resource ledger."""
+
+    node_id: NodeID
+    total: dict[str, float]
+    available: dict[str, float]
+    labels: dict[str, str] = field(default_factory=dict)
+    alive: bool = True
+
+    def fits(self, demand: dict[str, float]) -> bool:
+        return all(self.available.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
+
+    def feasible(self, demand: dict[str, float]) -> bool:
+        return all(self.total.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
+
+    def acquire(self, demand: dict[str, float]) -> None:
+        for key, value in demand.items():
+            self.available[key] = self.available.get(key, 0.0) - value
+
+    def release(self, demand: dict[str, float]) -> None:
+        for key, value in demand.items():
+            self.available[key] = self.available.get(key, 0.0) + value
+
+    def utilization(self) -> float:
+        best = 0.0
+        for key, total in self.total.items():
+            if total > 0:
+                used = total - self.available.get(key, 0.0)
+                best = max(best, used / total)
+        return best
+
+
+class ClusterState:
+    """Cluster-wide resource view + node selection policies."""
+
+    def __init__(self, spread_threshold: float = 0.5):
+        self._lock = threading.Condition(threading.Lock())
+        self._nodes: dict[NodeID, NodeState] = {}
+        self._spread_threshold = spread_threshold
+        self._rr_counter = 0
+
+    # ----------------------------------------------------------- membership
+
+    def add_node(self, node: NodeState) -> None:
+        with self._lock:
+            self._nodes[node.node_id] = node
+            self._lock.notify_all()
+
+    def remove_node(self, node_id: NodeID) -> None:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is not None:
+                node.alive = False
+
+    def nodes(self) -> list[NodeState]:
+        with self._lock:
+            return [n for n in self._nodes.values() if n.alive]
+
+    def get_node(self, node_id: NodeID) -> NodeState | None:
+        with self._lock:
+            return self._nodes.get(node_id)
+
+    def total_resources(self) -> dict[str, float]:
+        with self._lock:
+            out: dict[str, float] = {}
+            for node in self._nodes.values():
+                if not node.alive:
+                    continue
+                for k, v in node.total.items():
+                    out[k] = out.get(k, 0.0) + v
+            return out
+
+    def available_resources(self) -> dict[str, float]:
+        with self._lock:
+            out: dict[str, float] = {}
+            for node in self._nodes.values():
+                if not node.alive:
+                    continue
+                for k, v in node.available.items():
+                    out[k] = out.get(k, 0.0) + v
+            return out
+
+    # ------------------------------------------------------------ selection
+
+    def pick_node(self, demand: dict[str, float], strategy,
+                  exclude: set[NodeID] | None = None) -> NodeState | None:
+        """Select a feasible node per policy; None if nothing fits *now*.
+
+        Hybrid policy (reference: hybrid_scheduling_policy.cc): prefer
+        packing onto low-index nodes until utilization crosses the spread
+        threshold, then prefer the least-utilized node.
+        """
+        with self._lock:
+            candidates = [
+                n for n in self._nodes.values()
+                if n.alive and (exclude is None or n.node_id not in exclude)
+            ]
+            if strategy is not None and strategy.kind == "NODE_AFFINITY":
+                target = [n for n in candidates if n.node_id.hex() == strategy.node_id]
+                if not target:
+                    return None
+                node = target[0]
+                return node if node.fits(demand) else None
+            fitting = [n for n in candidates if n.fits(demand)]
+            if not fitting:
+                return None
+            if strategy is not None and strategy.kind == "SPREAD":
+                # Round-robin across fitting nodes (reference: spread policy).
+                self._rr_counter += 1
+                return fitting[self._rr_counter % len(fitting)]
+            under = [n for n in fitting if n.utilization() < self._spread_threshold]
+            pool = under if under else fitting
+            return min(pool, key=lambda n: (n.utilization(), n.node_id.hex()))
+
+    def is_feasible(self, demand: dict[str, float]) -> bool:
+        with self._lock:
+            return any(n.feasible(demand) for n in self._nodes.values() if n.alive)
+
+    # ------------------------------------------------------- acquire/release
+
+    def try_acquire(self, node_id: NodeID, demand: dict[str, float]) -> bool:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or not node.alive or not node.fits(demand):
+                return False
+            node.acquire(demand)
+            return True
+
+    def release(self, node_id: NodeID, demand: dict[str, float]) -> None:
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is not None:
+                node.release(demand)
+            self._lock.notify_all()
+
+    def wait_for_change(self, timeout: float) -> None:
+        with self._lock:
+            self._lock.wait(timeout)
+
+    def notify(self) -> None:
+        with self._lock:
+            self._lock.notify_all()
+
+
+@dataclass
+class _QueuedTask:
+    spec: TaskSpec
+    run: Callable[[TaskSpec, NodeState], None]
+    order: int = field(default_factory=_DISPATCH_ORDER.next)
+    unresolved_deps: int = 0
+
+
+class Dispatcher:
+    """Dependency-aware, resource-admitting task dispatcher.
+
+    Reference roles combined: DependencyManager
+    (src/ray/raylet/dependency_manager.h) gating on args, ClusterTaskManager
+    (scheduling/cluster_task_manager.h:42) queue + node pick, WorkerPool
+    lease grant (one thread per admitted task).
+    """
+
+    def __init__(self, cluster: ClusterState, store, on_task_state=None):
+        self._cluster = cluster
+        self._store = store
+        self._lock = threading.Condition(threading.Lock())
+        self._waiting: list[_QueuedTask] = []  # deps not ready
+        self._ready: list[_QueuedTask] = []  # deps ready, awaiting resources
+        self._shutdown = False
+        self._infeasible_warned: set[str] = set()
+        self._on_task_state = on_task_state
+        self._num_running = 0
+        self._dispatch_thread = threading.Thread(
+            target=self._dispatch_loop, name="ray_tpu-dispatcher", daemon=True)
+        self._dispatch_thread.start()
+        store.add_seal_listener(self._on_object_sealed)
+
+    # ------------------------------------------------------------ submission
+
+    def submit(self, spec: TaskSpec, run: Callable[[TaskSpec, NodeState], None],
+               deps: list) -> None:
+        task = _QueuedTask(spec=spec, run=run)
+        # The contains() checks must happen under self._lock: _on_object_sealed
+        # also takes it, so a dep sealing concurrently either shows up in
+        # contains() here or finds the task already appended to _waiting.
+        with self._lock:
+            pending_deps = [d for d in deps if not self._store.contains(d.id())]
+            task.unresolved_deps = len(pending_deps)
+            if task.unresolved_deps == 0:
+                self._ready.append(task)
+            else:
+                task._dep_ids = {d.id() for d in pending_deps}
+                self._waiting.append(task)
+            self._lock.notify_all()
+
+    def _on_object_sealed(self, object_id) -> None:
+        with self._lock:
+            still_waiting = []
+            for task in self._waiting:
+                dep_ids = getattr(task, "_dep_ids", set())
+                if object_id in dep_ids:
+                    dep_ids.discard(object_id)
+                    task.unresolved_deps = len(dep_ids)
+                if task.unresolved_deps == 0:
+                    self._ready.append(task)
+                else:
+                    still_waiting.append(task)
+            self._waiting = still_waiting
+            self._lock.notify_all()
+
+    # -------------------------------------------------------------- dispatch
+
+    def _dispatch_loop(self) -> None:
+        while True:
+            with self._lock:
+                while not self._ready and not self._shutdown:
+                    self._lock.wait(timeout=0.2)
+                if self._shutdown:
+                    return
+                # FIFO within the queue; stable by submission order.
+                self._ready.sort(key=lambda t: t.order)
+                pending = list(self._ready)
+            launched_any = False
+            for task in pending:
+                node = self._try_admit(task)
+                if node is not None:
+                    with self._lock:
+                        try:
+                            self._ready.remove(task)
+                        except ValueError:
+                            continue
+                        self._num_running += 1
+                    self._launch(task, node)
+                    launched_any = True
+            if not launched_any:
+                # Nothing admitted: wait for resources to free up.
+                self._cluster.wait_for_change(0.05)
+
+    def _try_admit(self, task: _QueuedTask) -> NodeState | None:
+        spec = task.spec
+        node = self._cluster.pick_node(spec.resources, spec.scheduling_strategy)
+        if node is None:
+            if not self._cluster.is_feasible(spec.resources) \
+                    and spec.name not in self._infeasible_warned:
+                self._infeasible_warned.add(spec.name)
+                import logging
+
+                logging.getLogger("ray_tpu").warning(
+                    "Task %s demands %s which no node can ever satisfy; "
+                    "it will hang until matching nodes join.",
+                    spec.name, spec.resources)
+            return None
+        if not self._cluster.try_acquire(node.node_id, spec.resources):
+            return None
+        return node
+
+    def _launch(self, task: _QueuedTask, node: NodeState) -> None:
+        def runner():
+            try:
+                task.run(task.spec, node)
+            finally:
+                self._cluster.release(node.node_id, task.spec.resources)
+                with self._lock:
+                    self._num_running -= 1
+                    self._lock.notify_all()
+
+        thread = threading.Thread(
+            target=runner, name=f"ray_tpu-task-{task.spec.name}", daemon=True)
+        thread.start()
+
+    # --------------------------------------------------------------- control
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._waiting) + len(self._ready) + self._num_running
+
+    def wait_idle(self, timeout: float | None = None) -> bool:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            while len(self._waiting) + len(self._ready) + self._num_running > 0:
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._lock.wait(timeout=0.1 if remaining is None else min(remaining, 0.1))
+            return True
+
+    def cancel_pending(self, task_id) -> bool:
+        with self._lock:
+            for queue in (self._waiting, self._ready):
+                for task in queue:
+                    if task.spec.task_id == task_id:
+                        queue.remove(task)
+                        return True
+        return False
+
+    def cancel_by_return_id(self, object_id) -> "TaskSpec | None":
+        """Remove the not-yet-dispatched task producing ``object_id``.
+
+        Returns the removed spec, or None if the task already started
+        (cancellation of running threads is not possible — matches the
+        best-effort semantics of the reference's non-force cancel).
+        """
+        with self._lock:
+            for queue in (self._waiting, self._ready):
+                for task in queue:
+                    if any(rid == object_id for rid in task.spec.return_ids):
+                        queue.remove(task)
+                        return task.spec
+        return None
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            self._lock.notify_all()
+
+
+class BlockedResourceContext:
+    """Release this task's CPU admission while blocked in get().
+
+    Reference behavior: a worker blocked in ray.get notifies the raylet,
+    which returns its CPU to the pool and re-admits on wake.
+    """
+
+    _tls = threading.local()
+
+    @classmethod
+    def current(cls):
+        return getattr(cls._tls, "ctx", None)
+
+    def __init__(self, cluster: ClusterState, node_id: NodeID,
+                 resources: dict[str, float]):
+        self._cluster = cluster
+        self._node_id = node_id
+        # Only CPU is returned while blocked; accelerators stay held.
+        self._cpu_only = {k: v for k, v in resources.items() if k == "CPU"}
+        self._depth = 0
+
+    def __enter__(self):
+        self._tls.ctx = self
+        return self
+
+    def __exit__(self, *exc):
+        self._tls.ctx = None
+        return False
+
+    def block(self):
+        if self._depth == 0 and self._cpu_only:
+            self._cluster.release(self._node_id, self._cpu_only)
+        self._depth += 1
+
+    def unblock(self):
+        self._depth -= 1
+        if self._depth == 0 and self._cpu_only:
+            # Reacquire; spin-wait is acceptable because release is imminent
+            # by construction (we only woke because our object sealed).
+            while not self._cluster.try_acquire(self._node_id, self._cpu_only):
+                time.sleep(0.001)
+
+
+def format_traceback(exc: BaseException) -> str:
+    return "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))

@@ -1,0 +1,70 @@
+"""Task specification.
+
+Reference: src/ray/common/task/task_spec.h:247 (TaskSpecification over
+common.proto TaskSpec) — function descriptor, args, resource demand,
+num_returns, retry policy, scheduling strategy.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ray_tpu._private.ids import ActorID, ObjectID, TaskID
+
+
+def normalize_resources(
+    num_cpus: float | None,
+    num_tpus: float | None,
+    resources: dict[str, float] | None,
+    default_cpus: float = 1.0,
+) -> dict[str, float]:
+    """Build the resource demand map. TPU is a first-class resource here
+    (the reference bolts it on via python/ray/_private/accelerators/tpu.py)."""
+    demand: dict[str, float] = {}
+    demand["CPU"] = float(num_cpus) if num_cpus is not None else default_cpus
+    if num_tpus:
+        demand["TPU"] = float(num_tpus)
+    if resources:
+        for key, value in resources.items():
+            if key in ("CPU", "TPU"):
+                demand[key] = float(value)
+            else:
+                demand[key] = float(value)
+    return {k: v for k, v in demand.items() if v > 0}
+
+
+@dataclass
+class SchedulingStrategy:
+    """Reference: python/ray/util/scheduling_strategies.py."""
+
+    kind: str = "DEFAULT"  # DEFAULT | SPREAD | PLACEMENT_GROUP | NODE_AFFINITY
+    placement_group: Any = None
+    placement_group_bundle_index: int = -1
+    node_id: str | None = None
+    soft: bool = False
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    name: str
+    func: Callable | None
+    args: tuple
+    kwargs: dict
+    num_returns: int = 1
+    resources: dict[str, float] = field(default_factory=dict)
+    max_retries: int = 0
+    retry_exceptions: bool | list[type] = False
+    scheduling_strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    return_ids: list[ObjectID] = field(default_factory=list)
+    # Actor tasks.
+    actor_id: ActorID | None = None
+    is_actor_creation: bool = False
+    runtime_env: dict | None = None
+    # Internal bookkeeping.
+    attempt: int = 0
+
+    @property
+    def is_actor_task(self) -> bool:
+        return self.actor_id is not None and not self.is_actor_creation

@@ -1,0 +1,729 @@
+"""The driver/worker runtime and the core public API implementation.
+
+TPU-native analogue of the reference's CoreWorker + worker.py pair:
+- ``Runtime`` plays the role of CoreWorker (reference:
+  src/ray/core_worker/core_worker.h:291 — SubmitTask/CreateActor/
+  SubmitActorTask/Get/Put/Wait) plus the per-process singleton
+  (core_worker_process.h).
+- Module functions (``init``/``get``/``put``/``wait``/…) mirror
+  python/ray/_private/worker.py:1219+ (ray.init), :2547 (get), :2679
+  (put), :2744 (wait), :2890 (get_actor).
+
+Single-node, thread-worker slice: every "node" is a virtual node in one
+process (see scheduler.py docstring); a true multiprocess pool is layered
+in via ``ray_tpu._private.worker_pool`` for CPU-parallel workloads.
+"""
+
+from __future__ import annotations
+
+import atexit
+import concurrent.futures
+import logging
+import threading
+import time
+from typing import Any, Iterable, Sequence
+
+from ray_tpu._private import accelerators
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.gcs import (
+    ActorRecord,
+    GlobalControlService,
+    JobRecord,
+    NodeRecord,
+    TaskEvent,
+)
+from ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID, TaskID
+from ray_tpu._private.object_ref import ObjectRef, resolve_args
+from ray_tpu._private.object_store import ObjectStore, ReferenceCounter
+from ray_tpu._private.placement_groups import PlacementGroupManager
+from ray_tpu._private.scheduler import (
+    BlockedResourceContext,
+    ClusterState,
+    Dispatcher,
+    NodeState,
+    format_traceback,
+)
+from ray_tpu._private.task import SchedulingStrategy, TaskSpec
+from ray_tpu._private.actor_runtime import LocalActor, _ActorCall
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    GetTimeoutError,
+    TaskCancelledError,
+    TaskError,
+)
+
+logger = logging.getLogger("ray_tpu")
+
+_runtime_lock = threading.Lock()
+_runtime: "Runtime | None" = None
+
+
+class RuntimeContext:
+    """Per-task/actor execution context (reference:
+    python/ray/runtime_context.py)."""
+
+    _tls = threading.local()
+
+    @classmethod
+    def current(cls) -> dict:
+        return getattr(cls._tls, "ctx", None) or {}
+
+    @classmethod
+    def set(cls, **kwargs):
+        cls._tls.ctx = kwargs
+
+    @classmethod
+    def clear(cls):
+        cls._tls.ctx = None
+
+
+class Runtime:
+    """Everything a node/driver needs: store, control plane, scheduler."""
+
+    def __init__(
+        self,
+        num_cpus: float | None = None,
+        num_tpus: float | None = None,
+        resources: dict[str, float] | None = None,
+        object_store_memory: int | None = None,
+        namespace: str = "default",
+    ):
+        cfg = GLOBAL_CONFIG
+        self.namespace = namespace
+        self.job_id = JobID()
+        self.gcs = GlobalControlService()
+        self.store = ObjectStore(
+            memory_limit_bytes=(object_store_memory
+                                or cfg.object_store_memory_mb * 1024 * 1024),
+            spill_dir=cfg.object_spilling_dir,
+        )
+        self.reference_counter = ReferenceCounter(self.store)
+        self.cluster = ClusterState(spread_threshold=cfg.scheduler_spread_threshold)
+        self.dispatcher = Dispatcher(self.cluster, self.store)
+        self.placement_groups = PlacementGroupManager(self.cluster, self.store)
+        self._actors: dict[ActorID, LocalActor] = {}
+        self._actor_queues: dict[ActorID, Any] = {}
+        self._actor_leases: dict[ActorID, tuple[NodeID, dict, Any]] = {}
+        self._futures_lock = threading.Lock()
+        self._futures: dict[ObjectID, list[concurrent.futures.Future]] = {}
+        self.store.add_seal_listener(self._resolve_futures)
+        self._task_counter = 0
+
+        # Head node: autodetect CPU and TPU resources.
+        detected = accelerators.detect_resources()
+        head_resources = {"CPU": float(num_cpus if num_cpus is not None else cfg.num_cpus)}
+        if num_tpus is not None:
+            head_resources["TPU"] = float(num_tpus)
+        elif detected.get("TPU"):
+            head_resources["TPU"] = detected["TPU"]
+        head_resources.update(
+            {k: v for k, v in detected.items() if k not in head_resources})
+        if resources:
+            head_resources.update({k: float(v) for k, v in resources.items()})
+        self.head_node_id = self.add_node(head_resources, labels={"node_type": "head"})
+        self.gcs.register_job(JobRecord(self.job_id))
+
+    # -------------------------------------------------------------- cluster
+
+    def add_node(self, resources: dict[str, float],
+                 labels: dict[str, str] | None = None) -> NodeID:
+        """Add a virtual node (reference: cluster_utils.Cluster.add_node)."""
+        node_id = NodeID()
+        state = NodeState(
+            node_id=node_id,
+            total=dict(resources),
+            available=dict(resources),
+            labels=labels or {},
+        )
+        self.cluster.add_node(state)
+        self.gcs.register_node(NodeRecord(
+            node_id=node_id, address=f"local://{node_id.hex()[:8]}",
+            resources=dict(resources), labels=labels or {}))
+        return node_id
+
+    def remove_node(self, node_id: NodeID) -> None:
+        self.cluster.remove_node(node_id)
+        self.gcs.mark_node_dead(node_id)
+
+    # ----------------------------------------------------------------- tasks
+
+    def submit_task(
+        self,
+        func,
+        args: tuple,
+        kwargs: dict,
+        *,
+        name: str,
+        num_returns: int = 1,
+        resources: dict[str, float],
+        max_retries: int = 0,
+        retry_exceptions: bool | list = False,
+        scheduling_strategy: SchedulingStrategy | None = None,
+        runtime_env: dict | None = None,
+    ) -> list[ObjectRef]:
+        """Reference: CoreWorker::SubmitTask (core_worker.cc:1998)."""
+        task_id = TaskID()
+        return_ids = [ObjectID() for _ in range(num_returns)]
+        strategy = scheduling_strategy or SchedulingStrategy()
+        spec = TaskSpec(
+            task_id=task_id, name=name, func=func, args=args, kwargs=kwargs,
+            num_returns=num_returns, resources=resources,
+            max_retries=max_retries, retry_exceptions=retry_exceptions,
+            scheduling_strategy=strategy, return_ids=return_ids,
+            runtime_env=runtime_env,
+        )
+        for rid in return_ids:
+            self.store.create_pending(rid)
+        refs = [ObjectRef(rid) for rid in return_ids]
+        self.gcs.record_task_event(TaskEvent(task_id, name, "PENDING"))
+        deps = [a for a in args if isinstance(a, ObjectRef)] + [
+            v for v in kwargs.values() if isinstance(v, ObjectRef)]
+
+        if strategy.kind == "PLACEMENT_GROUP" and strategy.placement_group is not None:
+            self._submit_pg_task(spec, deps, strategy)
+        else:
+            self.dispatcher.submit(spec, self._execute_task, deps)
+        return refs
+
+    def _submit_pg_task(self, spec: TaskSpec, deps, strategy) -> None:
+        """Route through the bundle ledger once the PG is committed."""
+        pg = strategy.placement_group
+
+        def run_when_ready():
+            try:
+                self.store.get(pg.ready_ref.id())  # wait for commit
+                node_id = self.placement_groups.acquire_from_bundle(
+                    pg.id, strategy.placement_group_bundle_index, spec.resources)
+            except BaseException as exc:  # noqa: BLE001
+                for rid in spec.return_ids:
+                    self.store.put_error(rid, exc)
+                return
+            node = self.cluster.get_node(node_id)
+            try:
+                self._execute_task(spec, node, acquired=False)
+            finally:
+                self.placement_groups.release_to_bundle(
+                    pg.id, strategy.placement_group_bundle_index, spec.resources)
+
+        # PG tasks bypass cluster admission (resources come from the bundle),
+        # but still respect dependency gating via the dispatcher.
+        pg_spec = TaskSpec(
+            task_id=spec.task_id, name=spec.name, func=spec.func, args=spec.args,
+            kwargs=spec.kwargs, num_returns=spec.num_returns, resources={},
+            return_ids=spec.return_ids, scheduling_strategy=SchedulingStrategy())
+        pg_spec._original = spec
+        self.dispatcher.submit(pg_spec, lambda s, n: run_when_ready(), deps)
+
+    def _execute_task(self, spec: TaskSpec, node: NodeState, acquired: bool = True) -> None:
+        """Reference: CoreWorker::ExecuteTask (core_worker.cc:2717)."""
+        start = time.time()
+        self.gcs.record_task_event(TaskEvent(
+            spec.task_id, spec.name, "RUNNING", start_time=start,
+            node_id=node.node_id.hex() if node else ""))
+        RuntimeContext.set(
+            task_id=spec.task_id, task_name=spec.name, job_id=self.job_id,
+            node_id=node.node_id if node else None, actor_id=None)
+        block_ctx = BlockedResourceContext(
+            self.cluster, node.node_id, spec.resources) if (node and acquired) else None
+        try:
+            resolved_args, resolved_kwargs, _ = resolve_args(
+                spec.args, spec.kwargs, lambda ref: self.get([ref])[0])
+            if block_ctx is not None:
+                block_ctx.__enter__()
+            try:
+                result = spec.func(*resolved_args, **resolved_kwargs)
+            finally:
+                if block_ctx is not None:
+                    block_ctx.__exit__(None, None, None)
+            self._store_task_result(spec, result)
+            self.gcs.record_task_event(TaskEvent(
+                spec.task_id, spec.name, "FINISHED", start_time=start,
+                end_time=time.time(),
+                node_id=node.node_id.hex() if node else ""))
+        except BaseException as exc:  # noqa: BLE001 — becomes a TaskError ref
+            if self._maybe_retry(spec, exc):
+                return
+            error = exc if isinstance(exc, (TaskError, TaskCancelledError)) else \
+                TaskError(exc, format_traceback(exc), spec.name)
+            for rid in spec.return_ids:
+                self.store.put_error(rid, error)
+            self.gcs.record_task_event(TaskEvent(
+                spec.task_id, spec.name, "FAILED", start_time=start,
+                end_time=time.time(), error=repr(exc)))
+        finally:
+            RuntimeContext.clear()
+
+    def _maybe_retry(self, spec: TaskSpec, exc: BaseException) -> bool:
+        """Owner-driven retry (reference: task_manager.h:195, max_task_retries
+        common.proto:645). Application errors retry only if retry_exceptions
+        allows them."""
+        if spec.attempt >= spec.max_retries:
+            return False
+        retry_ok = False
+        if isinstance(exc, (ActorDiedError,)):
+            retry_ok = True
+        elif spec.retry_exceptions is True:
+            retry_ok = True
+        elif isinstance(spec.retry_exceptions, (list, tuple)):
+            retry_ok = any(isinstance(exc, t) for t in spec.retry_exceptions)
+        if not retry_ok:
+            return False
+        spec.attempt += 1
+        logger.info("Retrying task %s (attempt %d/%d) after %r",
+                    spec.name, spec.attempt, spec.max_retries, exc)
+        deps = [a for a in spec.args if isinstance(a, ObjectRef)] + [
+            v for v in spec.kwargs.values() if isinstance(v, ObjectRef)]
+        self.dispatcher.submit(spec, self._execute_task, deps)
+        return True
+
+    def _store_task_result(self, spec: TaskSpec, result: Any) -> None:
+        if spec.num_returns == 1:
+            self.store.put(spec.return_ids[0], result)
+        elif spec.num_returns == 0:
+            pass
+        else:
+            if not isinstance(result, (tuple, list)) or len(result) != spec.num_returns:
+                raise ValueError(
+                    f"Task {spec.name} declared num_returns={spec.num_returns} but "
+                    f"returned {type(result).__name__} of length "
+                    f"{len(result) if isinstance(result, (tuple, list)) else 'n/a'}")
+            for rid, value in zip(spec.return_ids, result):
+                self.store.put(rid, value)
+
+    # ---------------------------------------------------------------- actors
+
+    def create_actor(
+        self,
+        cls: type,
+        args: tuple,
+        kwargs: dict,
+        *,
+        name: str | None = None,
+        namespace: str | None = None,
+        resources: dict[str, float],
+        max_concurrency: int = 1,
+        max_restarts: int = 0,
+        max_pending_calls: int = -1,
+        lifetime: str | None = None,
+        scheduling_strategy: SchedulingStrategy | None = None,
+        get_if_exists: bool = False,
+    ) -> tuple[ActorID, ObjectRef]:
+        """Reference: CoreWorker::CreateActor (core_worker.cc:2069) +
+        GcsActorManager registration."""
+        ns = namespace or self.namespace
+        if name is not None and get_if_exists:
+            existing = self.gcs.get_named_actor(name, ns)
+            if existing is not None:
+                ready = ObjectRef(ObjectID())
+                self.store.put(ready.id(), None)
+                return existing.actor_id, ready
+        actor_id = ActorID()
+        creation_rid = ObjectID()
+        self.store.create_pending(creation_rid)
+        creation_ref = ObjectRef(creation_rid)
+        method_meta = {}
+        for attr_name in dir(cls):
+            attr = getattr(cls, attr_name, None)
+            if callable(attr) and hasattr(attr, "__ray_tpu_num_returns__"):
+                method_meta[attr_name] = {
+                    "num_returns": attr.__ray_tpu_num_returns__}
+        record = ActorRecord(
+            actor_id=actor_id, name=name, namespace=ns,
+            class_name=cls.__name__, max_restarts=max_restarts,
+            method_meta=method_meta)
+        self.gcs.register_actor(record)
+
+        strategy = scheduling_strategy or SchedulingStrategy()
+
+        def start_actor():
+            # Lease actor resources for its lifetime.
+            node_id = None
+            pg_info = None
+            try:
+                if strategy.kind == "PLACEMENT_GROUP" and strategy.placement_group is not None:
+                    pg = strategy.placement_group
+                    self.store.get(pg.ready_ref.id())
+                    node_id = self.placement_groups.acquire_from_bundle(
+                        pg.id, strategy.placement_group_bundle_index, resources)
+                    pg_info = (pg.id, strategy.placement_group_bundle_index)
+                else:
+                    deadline = time.monotonic() + 300.0
+                    while node_id is None:
+                        node = self.cluster.pick_node(resources, strategy)
+                        if node is not None and self.cluster.try_acquire(
+                                node.node_id, resources):
+                            node_id = node.node_id
+                            break
+                        if time.monotonic() > deadline:
+                            raise TimeoutError(
+                                f"Could not lease resources {resources} for actor "
+                                f"{cls.__name__} within 300s")
+                        self.cluster.wait_for_change(0.05)
+            except BaseException as exc:  # noqa: BLE001
+                self.store.put_error(creation_rid, exc)
+                self.gcs.update_actor_state(actor_id, "DEAD", repr(exc))
+                return
+
+            def on_death(aid, reason):
+                self.gcs.update_actor_state(aid, "DEAD", reason)
+                lease = self._actor_leases.pop(aid, None)
+                if lease is not None:
+                    lease_node, lease_resources, lease_pg = lease
+                    if lease_pg is not None:
+                        self.placement_groups.release_to_bundle(
+                            lease_pg[0], lease_pg[1], lease_resources)
+                    else:
+                        self.cluster.release(lease_node, lease_resources)
+
+            def on_restart(aid):
+                self.gcs.update_actor_state(aid, "ALIVE")
+
+            actor = LocalActor(
+                actor_id, cls, args, kwargs, self,
+                max_concurrency=max_concurrency, max_restarts=max_restarts,
+                max_pending_calls=max_pending_calls,
+                creation_return_id=creation_rid, on_death=on_death,
+                on_restart=on_restart)
+            self._actors[actor_id] = actor
+            self._actor_leases[actor_id] = (node_id, resources, pg_info)
+            record.handle = actor
+            self.gcs.update_actor_state(actor_id, "ALIVE")
+
+        threading.Thread(target=start_actor, daemon=True,
+                         name=f"ray_tpu-actor-create-{cls.__name__}").start()
+        return actor_id, creation_ref
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str,
+                          args: tuple, kwargs: dict,
+                          num_returns: int = 1) -> list[ObjectRef]:
+        """Reference: CoreWorker::SubmitActorTask (core_worker.cc:2304).
+
+        All calls for one actor flow through a per-actor ordered submission
+        queue so per-caller call order is preserved even across actor
+        startup and ObjectRef-argument resolution (reference:
+        transport/sequential_actor_submit_queue.h).
+        """
+        return_ids = [ObjectID() for _ in range(max(1, num_returns))]
+        for rid in return_ids:
+            self.store.create_pending(rid)
+        refs = [ObjectRef(rid) for rid in return_ids]
+        call = _ActorCall(method_name, args, kwargs, return_ids)
+
+        record = self.gcs.get_actor(actor_id)
+        if record is None or (record.state == "DEAD" and actor_id not in self._actors):
+            err = ActorDiedError(actor_id, (record.death_cause if record else None)
+                                 or "actor not found")
+            for rid in return_ids:
+                self.store.put_error(rid, err)
+            return refs
+        self._actor_submit_queue(actor_id).put(call)
+        return refs
+
+    def _actor_submit_queue(self, actor_id: ActorID):
+        """Lazily start the per-actor ordered submission worker."""
+        import queue as queue_mod
+
+        with self._futures_lock:
+            entry = self._actor_queues.get(actor_id)
+            if entry is not None:
+                return entry
+            submit_queue: queue_mod.Queue = queue_mod.Queue()
+            self._actor_queues[actor_id] = submit_queue
+
+        def drain():
+            while True:
+                call = submit_queue.get()
+                # Wait for the actor to come alive (or die trying).
+                actor = self._actors.get(actor_id)
+                deadline = time.monotonic() + 300.0
+                while actor is None and time.monotonic() < deadline:
+                    rec = self.gcs.get_actor(actor_id)
+                    if rec is None or rec.state == "DEAD":
+                        break
+                    time.sleep(0.002)
+                    actor = self._actors.get(actor_id)
+                if actor is None:
+                    err = ActorDiedError(actor_id, "actor failed to start")
+                    for rid in call.return_ids:
+                        self.store.put_error(rid, err)
+                    continue
+                # Resolve ObjectRef args in queue order (blocking keeps order).
+                try:
+                    call.args, call.kwargs, _ = resolve_args(
+                        call.args, call.kwargs, lambda ref: self.get([ref])[0])
+                except BaseException as exc:  # noqa: BLE001
+                    for rid in call.return_ids:
+                        self.store.put_error(rid, exc)
+                    continue
+                actor.submit(call)
+
+        threading.Thread(target=drain, daemon=True,
+                         name=f"ray_tpu-actor-submit-{actor_id.hex()[:8]}").start()
+        return submit_queue
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        actor = self._actors.get(actor_id)
+        if actor is not None:
+            actor.kill("killed via kill()", no_restart=no_restart)
+        else:
+            self.gcs.remove_actor(actor_id)
+
+    def get_actor_handle(self, name: str, namespace: str | None = None):
+        from ray_tpu.actor import ActorHandle
+
+        record = self.gcs.get_named_actor(name, namespace or self.namespace)
+        if record is None:
+            raise ValueError(f"Failed to look up actor with name {name!r}")
+        return ActorHandle(record.actor_id, record.class_name)
+
+    # ------------------------------------------------------------ get/put/…
+
+    def put(self, value: Any) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("Calling put() on an ObjectRef is not allowed")
+        object_id = ObjectID()
+        self.store.put(object_id, value)
+        return ObjectRef(object_id)
+
+    def get(self, refs: Sequence[ObjectRef], timeout: float | None = None) -> list[Any]:
+        block_ctx = BlockedResourceContext.current()
+        results = []
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for ref in refs:
+            if not isinstance(ref, ObjectRef):
+                raise TypeError(
+                    f"get() expects ObjectRef (or list of them), got {type(ref)}")
+            remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+            if self.store.contains(ref.id()):
+                results.append(self.store.get(ref.id()))
+                continue
+            if block_ctx is not None:
+                block_ctx.block()
+            try:
+                results.append(self.store.get(ref.id(), timeout=remaining))
+            finally:
+                if block_ctx is not None:
+                    block_ctx.unblock()
+        return results
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: float | None = None) -> tuple[list[ObjectRef], list[ObjectRef]]:
+        if num_returns > len(refs):
+            raise ValueError(
+                f"num_returns={num_returns} exceeds the number of refs ({len(refs)})")
+        by_id = {ref.id(): ref for ref in refs}
+        block_ctx = BlockedResourceContext.current()
+        if block_ctx is not None:
+            block_ctx.block()
+        try:
+            ready_ids, not_ready_ids = self.store.wait(
+                [r.id() for r in refs], num_returns, timeout)
+        finally:
+            if block_ctx is not None:
+                block_ctx.unblock()
+        return ([by_id[i] for i in ready_ids], [by_id[i] for i in not_ready_ids])
+
+    def cancel(self, ref: ObjectRef) -> None:
+        # Best-effort: only not-yet-dispatched tasks can be cancelled in the
+        # thread-worker slice (threads are not preemptible). A task that is
+        # already running completes normally — matching non-force cancel in
+        # the reference.
+        spec = self.dispatcher.cancel_by_return_id(ref.id())
+        if spec is not None:
+            err = TaskCancelledError(spec.task_id)
+            for rid in spec.return_ids:
+                self.store.put_error(rid, err)
+            self.gcs.record_task_event(TaskEvent(
+                spec.task_id, spec.name, "FAILED", error="cancelled"))
+
+    def free(self, refs: Sequence[ObjectRef]) -> None:
+        self.store.free([r.id() for r in refs])
+
+    # -------------------------------------------------------------- futures
+
+    def attach_future(self, ref: ObjectRef, fut: concurrent.futures.Future) -> None:
+        with self._futures_lock:
+            if not self.store.contains(ref.id()) and self.store.is_pending(ref.id()):
+                self._futures.setdefault(ref.id(), []).append(fut)
+                return
+        # Already sealed (or unknown): resolve immediately.
+        self._resolve_one_future(ref.id(), fut)
+
+    def _resolve_futures(self, object_id: ObjectID) -> None:
+        with self._futures_lock:
+            futs = self._futures.pop(object_id, [])
+        for fut in futs:
+            self._resolve_one_future(object_id, fut)
+
+    def _resolve_one_future(self, object_id: ObjectID, fut) -> None:
+        try:
+            value = self.store.get(object_id, timeout=0)
+            fut.set_result(value)
+        except BaseException as exc:  # noqa: BLE001
+            try:
+                fut.set_exception(exc)
+            except Exception:
+                pass
+
+    # --------------------------------------------------------------- status
+
+    def cluster_resources(self) -> dict[str, float]:
+        return self.cluster.total_resources()
+
+    def available_resources(self) -> dict[str, float]:
+        return self.cluster.available_resources()
+
+    def shutdown(self) -> None:
+        for actor in list(self._actors.values()):
+            actor.kill("runtime shutdown", no_restart=True)
+        self.dispatcher.shutdown()
+        self.gcs.finish_job(self.job_id)
+
+
+# --------------------------------------------------------------------------
+# Module-level singleton API
+# --------------------------------------------------------------------------
+
+
+def global_runtime() -> Runtime | None:
+    return _runtime
+
+
+def init(
+    *,
+    num_cpus: float | None = None,
+    num_tpus: float | None = None,
+    resources: dict[str, float] | None = None,
+    object_store_memory: int | None = None,
+    namespace: str = "default",
+    ignore_reinit_error: bool = False,
+    system_config: dict | None = None,
+    logging_level: str | None = None,
+    **_ignored,
+) -> Runtime:
+    """Initialize the runtime (reference: ray.init, worker.py:1219)."""
+    global _runtime
+    with _runtime_lock:
+        if _runtime is not None:
+            if ignore_reinit_error:
+                return _runtime
+            raise RuntimeError(
+                "ray_tpu.init() has already been called; pass "
+                "ignore_reinit_error=True to ignore")
+        if system_config:
+            GLOBAL_CONFIG.update(system_config)
+        if logging_level:
+            logging.getLogger("ray_tpu").setLevel(logging_level)
+        _runtime = Runtime(
+            num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
+            object_store_memory=object_store_memory, namespace=namespace)
+        atexit.register(_atexit_shutdown)
+        return _runtime
+
+
+def _atexit_shutdown():
+    global _runtime
+    with _runtime_lock:
+        if _runtime is not None:
+            try:
+                _runtime.shutdown()
+            except Exception:
+                pass
+            _runtime = None
+
+
+def shutdown() -> None:
+    global _runtime
+    with _runtime_lock:
+        if _runtime is not None:
+            _runtime.shutdown()
+            _runtime = None
+
+
+def is_initialized() -> bool:
+    return _runtime is not None
+
+
+def _require_runtime() -> Runtime:
+    if _runtime is None:
+        init()
+    return _runtime  # type: ignore[return-value]
+
+
+def auto_init() -> Runtime:
+    return _require_runtime()
+
+
+def put(value: Any) -> ObjectRef:
+    return _require_runtime().put(value)
+
+
+def get(refs, timeout: float | None = None):
+    runtime = _require_runtime()
+    if isinstance(refs, ObjectRef):
+        return runtime.get([refs], timeout=timeout)[0]
+    if isinstance(refs, (list, tuple)):
+        return runtime.get(list(refs), timeout=timeout)
+    raise TypeError(f"get() expects an ObjectRef or list of ObjectRefs, got {type(refs)}")
+
+
+def wait(refs, *, num_returns: int = 1, timeout: float | None = None,
+         fetch_local: bool = True):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    return _require_runtime().wait(list(refs), num_returns=num_returns, timeout=timeout)
+
+
+def kill(actor_handle, *, no_restart: bool = True) -> None:
+    from ray_tpu.actor import ActorHandle
+
+    if not isinstance(actor_handle, ActorHandle):
+        raise TypeError("kill() expects an ActorHandle")
+    _require_runtime().kill_actor(actor_handle._actor_id, no_restart=no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True) -> None:
+    _require_runtime().cancel(ref)
+
+
+def get_actor(name: str, namespace: str | None = None):
+    return _require_runtime().get_actor_handle(name, namespace)
+
+
+def cluster_resources() -> dict[str, float]:
+    return _require_runtime().cluster_resources()
+
+
+def available_resources() -> dict[str, float]:
+    return _require_runtime().available_resources()
+
+
+def nodes() -> list[dict]:
+    runtime = _require_runtime()
+    return [
+        {
+            "NodeID": r.node_id.hex(),
+            "Alive": r.alive,
+            "Resources": dict(r.resources),
+            "Labels": dict(r.labels),
+            "NodeManagerAddress": r.address,
+        }
+        for r in runtime.gcs.list_nodes()
+    ]
+
+
+def timeline() -> list[dict]:
+    """Chrome-trace-style task events (reference: `ray timeline`)."""
+    runtime = _require_runtime()
+    out = []
+    for ev in runtime.gcs.list_task_events():
+        out.append({
+            "name": ev.name,
+            "cat": "task",
+            "ph": "X",
+            "ts": ev.start_time * 1e6,
+            "dur": max(0.0, (ev.end_time - ev.start_time)) * 1e6,
+            "pid": ev.node_id or "driver",
+            "args": {"state": ev.state, "task_id": ev.task_id.hex()},
+        })
+    return out

@@ -1,0 +1,150 @@
+"""Actor frontend: ActorClass / ActorHandle / method calls.
+
+Reference: python/ray/actor.py — ActorClass (:544), its _remote (:830),
+ActorHandle (:1193), ActorMethod wrappers.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.actor_runtime import exit_actor  # re-export  # noqa: F401
+from ray_tpu._private.ids import ActorID
+from ray_tpu._private.task import normalize_resources
+from ray_tpu.remote_function import _VALID_OPTIONS, _build_strategy
+
+_ACTOR_OPTIONS = _VALID_OPTIONS | {
+    "max_concurrency", "max_restarts", "max_task_retries", "max_pending_calls",
+    "lifetime", "namespace", "get_if_exists",
+}
+
+
+class ActorMethod:
+    """Bound remote method: ``handle.method.remote(...)``."""
+
+    def __init__(self, actor_id: ActorID, method_name: str, num_returns: int = 1):
+        self._actor_id = actor_id
+        self._method_name = method_name
+        self._num_returns = num_returns
+
+    def options(self, **opts) -> "ActorMethod":
+        method = ActorMethod(self._actor_id, self._method_name,
+                             opts.get("num_returns", self._num_returns))
+        return method
+
+    def remote(self, *args, **kwargs):
+        runtime = worker_mod.auto_init()
+        refs = runtime.submit_actor_task(
+            self._actor_id, self._method_name, args, kwargs,
+            num_returns=self._num_returns)
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method '{self._method_name}' cannot be called directly; "
+            "use '.remote()'.")
+
+
+class ActorHandle:
+    """A serializable handle to a live actor (reference: actor.py:1193)."""
+
+    def __init__(self, actor_id: ActorID, class_name: str = "Actor"):
+        self._actor_id = actor_id
+        self._class_name = class_name
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        num_returns = 1
+        runtime = worker_mod.global_runtime()
+        if runtime is not None:
+            record = runtime.gcs.get_actor(self._actor_id)
+            if record is not None:
+                num_returns = record.method_meta.get(name, {}).get("num_returns", 1)
+        return ActorMethod(self._actor_id, name, num_returns)
+
+    def _actor_record(self):
+        runtime = worker_mod.auto_init()
+        return runtime.gcs.get_actor(self._actor_id)
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._class_name))
+
+    def __hash__(self):
+        return hash(self._actor_id)
+
+    def __eq__(self, other):
+        return isinstance(other, ActorHandle) and other._actor_id == self._actor_id
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
+
+
+class ActorClass:
+    """A class turned into an actor factory via ``@ray_tpu.remote``."""
+
+    def __init__(self, cls: type, default_options: dict | None = None):
+        self._cls = cls
+        self._default_options = dict(default_options or {})
+        bad = set(self._default_options) - _ACTOR_OPTIONS
+        if bad:
+            raise ValueError(f"Invalid actor options: {sorted(bad)}")
+        functools.update_wrapper(self, cls, updated=[])
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class '{self._cls.__name__}' cannot be instantiated "
+            "directly. Use '.remote()' to create an actor, or access the "
+            "underlying class via '.cls'.")
+
+    @property
+    def cls(self) -> type:
+        return self._cls
+
+    def options(self, **options) -> "ActorClass":
+        bad = set(options) - _ACTOR_OPTIONS
+        if bad:
+            raise ValueError(f"Invalid options: {sorted(bad)}")
+        return ActorClass(self._cls, {**self._default_options, **options})
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        runtime = worker_mod.auto_init()
+        opts = self._default_options
+        resources = normalize_resources(
+            opts.get("num_cpus"),
+            opts.get("num_tpus") or opts.get("num_gpus"),
+            opts.get("resources"),
+            default_cpus=0.0,  # actors default to 0 CPU like the reference
+        )
+        actor_id, creation_ref = runtime.create_actor(
+            self._cls, args, kwargs,
+            name=opts.get("name"),
+            namespace=opts.get("namespace"),
+            resources=resources,
+            max_concurrency=opts.get("max_concurrency", 1),
+            max_restarts=opts.get("max_restarts", 0),
+            max_pending_calls=opts.get("max_pending_calls", -1),
+            lifetime=opts.get("lifetime"),
+            scheduling_strategy=_build_strategy(opts),
+            get_if_exists=opts.get("get_if_exists", False),
+        )
+        handle = ActorHandle(actor_id, self._cls.__name__)
+        handle._creation_ref = creation_ref  # keeps creation error observable
+        return handle
+
+    def __repr__(self):
+        return f"ActorClass({self._cls.__name__})"
+
+
+def method(num_returns: int = 1):
+    """Decorator carrying per-method defaults (reference: ray.method)."""
+
+    def decorator(fn):
+        fn.__ray_tpu_num_returns__ = num_returns
+        return fn
+
+    return decorator

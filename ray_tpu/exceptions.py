@@ -1,0 +1,91 @@
+"""User-visible exceptions.
+
+Mirrors the reference's exception taxonomy (reference:
+python/ray/exceptions.py): task errors wrap the user exception with the
+remote traceback, actor errors/actor-death, object loss, and timeouts.
+"""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayTpuError):
+    """A remote task raised an exception.
+
+    The original exception is available as ``.cause``; re-raising through
+    ``get()`` chains the remote traceback text so users see where the
+    failure happened (reference: python/ray/exceptions.py RayTaskError).
+    """
+
+    def __init__(self, cause: BaseException, remote_traceback: str = "",
+                 task_name: str = ""):
+        self.cause = cause
+        self.remote_traceback = remote_traceback
+        self.task_name = task_name
+        super().__init__(str(cause))
+
+    def __str__(self):
+        base = f"Task '{self.task_name}' failed: {type(self.cause).__name__}: {self.cause}"
+        if self.remote_traceback:
+            base += "\n\nRemote traceback:\n" + self.remote_traceback
+        return base
+
+
+class ActorError(TaskError):
+    """An actor method raised an exception."""
+
+
+class ActorDiedError(RayTpuError):
+    """The actor was dead when a method call was attempted."""
+
+    def __init__(self, actor_id=None, reason: str = "actor has died"):
+        self.actor_id = actor_id
+        self.reason = reason
+        super().__init__(reason)
+
+
+class ActorUnavailableError(RayTpuError):
+    """The actor is temporarily unreachable (e.g. restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """An object could not be found in any store and had no lineage."""
+
+    def __init__(self, object_ref=None, reason: str = "object lost"):
+        self.object_ref = object_ref
+        super().__init__(reason)
+
+
+class ObjectFreedError(ObjectLostError):
+    """The object was explicitly freed."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """``get()`` did not complete within the requested timeout."""
+
+
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled before or during execution."""
+
+    def __init__(self, task_id=None):
+        self.task_id = task_id
+        super().__init__("task was cancelled")
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    """Actor's pending call queue exceeded max_pending_calls."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Failed to set up the runtime environment for a task/actor."""
+
+
+class OutOfMemoryError(RayTpuError):
+    """The object store or worker heap exceeded its memory budget."""
+
+
+class PlacementGroupError(RayTpuError):
+    """Placement group creation/scheduling failed."""

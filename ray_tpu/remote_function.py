@@ -1,0 +1,105 @@
+"""@remote function frontend.
+
+Reference: python/ray/remote_function.py:40 (RemoteFunction, _remote at
+:268) and option handling in python/ray/_private/ray_option_utils.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.task import SchedulingStrategy, normalize_resources
+
+_VALID_OPTIONS = {
+    "num_cpus", "num_tpus", "num_gpus", "resources", "num_returns",
+    "max_retries", "retry_exceptions", "name", "scheduling_strategy",
+    "placement_group", "placement_group_bundle_index", "runtime_env",
+    "memory", "max_calls", "_metadata",
+}
+
+
+def _build_strategy(options: dict) -> SchedulingStrategy:
+    strategy = options.get("scheduling_strategy")
+    if isinstance(strategy, SchedulingStrategy):
+        return strategy
+    if strategy == "SPREAD":
+        return SchedulingStrategy(kind="SPREAD")
+    if strategy == "DEFAULT" or strategy is None:
+        pg = options.get("placement_group")
+        if pg is not None:
+            return SchedulingStrategy(
+                kind="PLACEMENT_GROUP", placement_group=pg,
+                placement_group_bundle_index=options.get(
+                    "placement_group_bundle_index", -1))
+        return SchedulingStrategy()
+    # Library scheduling-strategy dataclasses.
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+        PlacementGroupSchedulingStrategy,
+    )
+
+    if isinstance(strategy, PlacementGroupSchedulingStrategy):
+        return SchedulingStrategy(
+            kind="PLACEMENT_GROUP", placement_group=strategy.placement_group,
+            placement_group_bundle_index=strategy.placement_group_bundle_index)
+    if isinstance(strategy, NodeAffinitySchedulingStrategy):
+        return SchedulingStrategy(
+            kind="NODE_AFFINITY", node_id=strategy.node_id, soft=strategy.soft)
+    raise ValueError(f"Unsupported scheduling_strategy: {strategy!r}")
+
+
+class RemoteFunction:
+    """A function turned into a task factory via ``@ray_tpu.remote``."""
+
+    def __init__(self, func: Callable, default_options: dict | None = None):
+        self._function = func
+        self._default_options = dict(default_options or {})
+        bad = set(self._default_options) - _VALID_OPTIONS
+        if bad:
+            raise ValueError(f"Invalid @remote options: {sorted(bad)}")
+        functools.update_wrapper(self, func)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{self._function.__name__}' cannot be called "
+            "directly. Use '.remote()' to submit it as a task, or access the "
+            "underlying function via '.func'.")
+
+    @property
+    def func(self) -> Callable:
+        return self._function
+
+    def options(self, **options) -> "RemoteFunction":
+        bad = set(options) - _VALID_OPTIONS
+        if bad:
+            raise ValueError(f"Invalid options: {sorted(bad)}")
+        merged = {**self._default_options, **options}
+        return RemoteFunction(self._function, merged)
+
+    def remote(self, *args, **kwargs):
+        runtime = worker_mod.auto_init()
+        opts = self._default_options
+        resources = normalize_resources(
+            opts.get("num_cpus"),
+            opts.get("num_tpus") or opts.get("num_gpus"),
+            opts.get("resources"),
+        )
+        num_returns = opts.get("num_returns", 1)
+        refs = runtime.submit_task(
+            self._function, args, kwargs,
+            name=opts.get("name") or self._function.__qualname__,
+            num_returns=num_returns,
+            resources=resources,
+            max_retries=opts.get("max_retries", 0),
+            retry_exceptions=opts.get("retry_exceptions", False),
+            scheduling_strategy=_build_strategy(opts),
+            runtime_env=opts.get("runtime_env"),
+        )
+        if num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __repr__(self):
+        return f"RemoteFunction({self._function.__qualname__})"

@@ -1,0 +1,54 @@
+"""Runtime context — introspection from inside tasks/actors.
+
+Reference: python/ray/runtime_context.py (get_runtime_context()).
+"""
+
+from __future__ import annotations
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.worker import RuntimeContext as _Ctx
+
+
+class RuntimeContextAPI:
+    @property
+    def job_id(self):
+        runtime = worker_mod.auto_init()
+        return _Ctx.current().get("job_id", runtime.job_id)
+
+    def get_job_id(self) -> str:
+        return self.job_id.hex()
+
+    @property
+    def task_id(self):
+        return _Ctx.current().get("task_id")
+
+    def get_task_id(self) -> str | None:
+        task_id = self.task_id
+        return task_id.hex() if task_id is not None else None
+
+    @property
+    def actor_id(self):
+        return _Ctx.current().get("actor_id")
+
+    def get_actor_id(self) -> str | None:
+        actor_id = self.actor_id
+        return actor_id.hex() if actor_id is not None else None
+
+    @property
+    def node_id(self):
+        runtime = worker_mod.auto_init()
+        return _Ctx.current().get("node_id", runtime.head_node_id)
+
+    def get_node_id(self) -> str:
+        return self.node_id.hex()
+
+    @property
+    def namespace(self) -> str:
+        return worker_mod.auto_init().namespace
+
+    def get_assigned_resources(self) -> dict:
+        return _Ctx.current().get("resources", {})
+
+
+def get_runtime_context() -> RuntimeContextAPI:
+    return RuntimeContextAPI()

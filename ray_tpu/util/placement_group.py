@@ -1,0 +1,91 @@
+"""Placement group user API.
+
+Reference: python/ray/util/placement_group.py:146 (placement_group(...)),
+plus the TPU pod-slice gang pattern from
+python/ray/_private/accelerators/tpu.py:363-382.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.ids import PlacementGroupID
+from ray_tpu._private.object_ref import ObjectRef
+
+
+class PlacementGroup:
+    """Handle to a placement group."""
+
+    def __init__(self, pg_id: PlacementGroupID, ready_ref: ObjectRef,
+                 bundles: list[dict], strategy: str):
+        self.id = pg_id
+        self.ready_ref = ready_ref
+        self.bundle_specs = bundles
+        self.strategy = strategy
+
+    def ready(self) -> ObjectRef:
+        """ObjectRef sealed once all bundles are committed."""
+        return self.ready_ref
+
+    def wait(self, timeout_seconds: float | None = None) -> bool:
+        from ray_tpu.exceptions import GetTimeoutError
+
+        runtime = worker_mod.auto_init()
+        try:
+            runtime.get([self.ready_ref], timeout=timeout_seconds)
+            return True
+        except GetTimeoutError:
+            return False
+
+    @property
+    def bundle_count(self) -> int:
+        return len(self.bundle_specs)
+
+    def __reduce__(self):
+        return (PlacementGroup,
+                (self.id, self.ready_ref, self.bundle_specs, self.strategy))
+
+
+def placement_group(bundles: list[dict], strategy: str = "PACK",
+                    name: str = "", lifetime: str | None = None) -> PlacementGroup:
+    runtime = worker_mod.auto_init()
+    record = runtime.placement_groups.create(bundles, strategy, name=name)
+    ready_ref = ObjectRef(record.ready_object_id)
+    return PlacementGroup(record.pg_id, ready_ref, bundles, strategy)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    runtime = worker_mod.auto_init()
+    runtime.placement_groups.remove(pg.id)
+
+
+def placement_group_table() -> dict:
+    runtime = worker_mod.auto_init()
+    out = {}
+    for record in runtime.placement_groups.list():
+        out[record.pg_id.hex()] = {
+            "placement_group_id": record.pg_id.hex(),
+            "name": record.name,
+            "strategy": record.strategy,
+            "state": record.state,
+            "bundles": {i: dict(b.resources) for i, b in enumerate(record.bundles)},
+        }
+    return out
+
+
+def tpu_slice_bundle(num_chips: int, cpus_per_host: float = 8.0,
+                     chips_per_host: int = 4) -> list[dict]:
+    """Bundles reserving a whole TPU slice with STRICT_PACK semantics.
+
+    TPU-native equivalent of claiming the TPU-{pod_type}-head gang
+    resource (reference: tpu.py:382): one bundle per host, each holding
+    that host's chips, so a slice is acquired all-or-nothing.
+    """
+    bundles = []
+    remaining = num_chips
+    while remaining > 0:
+        chips = min(chips_per_host, remaining)
+        bundles.append({"TPU": float(chips), "CPU": cpus_per_host})
+        remaining -= chips
+    return bundles

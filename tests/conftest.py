@@ -1,0 +1,52 @@
+"""Test fixtures.
+
+JAX is forced onto a virtual 8-device CPU platform so multi-chip sharding
+logic (pjit/shard_map over a Mesh) is exercised without TPU hardware —
+the same strategy as the reference's "many nodes on one box" fixtures
+(reference: python/ray/cluster_utils.py:108).
+"""
+
+import os
+
+# Must run before jax is imported anywhere.
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("RAY_TPU_SKIP_TPU_DETECTION", "1")
+
+import pytest
+
+
+@pytest.fixture
+def ray_start_regular():
+    """A fresh single-node runtime per test (reference: conftest.py
+    ray_start_regular)."""
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    runtime = ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_cluster():
+    """A runtime plus the ability to add virtual nodes."""
+    import ray_tpu
+    from ray_tpu._private import worker as worker_mod
+
+    ray_tpu.shutdown()
+    runtime = ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def cpu_mesh8():
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = np.array(jax.devices("cpu")[:8]).reshape(2, 4)
+    with Mesh(devices, ("dp", "tp")) as mesh:
+        yield mesh

@@ -1,0 +1,299 @@
+"""Core task API tests (modeled on reference python/ray/tests/test_basic*.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError, TaskError
+
+
+def test_put_get(ray_start_regular):
+    ref = ray_tpu.put(42)
+    assert ray_tpu.get(ref) == 42
+
+
+def test_put_get_list(ray_start_regular):
+    refs = [ray_tpu.put(i) for i in range(10)]
+    assert ray_tpu.get(refs) == list(range(10))
+
+
+def test_simple_task(ray_start_regular):
+    @ray_tpu.remote
+    def f(x):
+        return x * 2
+
+    assert ray_tpu.get(f.remote(21)) == 42
+
+
+def test_task_with_kwargs(ray_start_regular):
+    @ray_tpu.remote
+    def f(a, b=10, *, c=100):
+        return a + b + c
+
+    assert ray_tpu.get(f.remote(1, b=2, c=3)) == 6
+
+
+def test_task_dependency_chain(ray_start_regular):
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = ray_tpu.put(0)
+    for _ in range(10):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref) == 10
+
+
+def test_task_fan_out_fan_in(ray_start_regular):
+    @ray_tpu.remote
+    def square(x):
+        return x * x
+
+    @ray_tpu.remote
+    def total(*xs):
+        return sum(xs)
+
+    refs = [square.remote(i) for i in range(10)]
+    assert ray_tpu.get(total.remote(*refs)) == sum(i * i for i in range(10))
+
+
+def test_nested_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def child(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def parent(x):
+        return ray_tpu.get(child.remote(x)) + 1
+
+    assert ray_tpu.get(parent.remote(0)) == 2
+
+
+def test_deeply_nested_tasks_no_deadlock(ray_start_regular):
+    @ray_tpu.remote
+    def recurse(depth):
+        if depth == 0:
+            return 0
+        return ray_tpu.get(recurse.remote(depth - 1)) + 1
+
+    # Depth exceeds num_cpus=8: passes only if blocked tasks release CPU.
+    assert ray_tpu.get(recurse.remote(20)) == 20
+
+
+def test_num_returns(ray_start_regular):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray_tpu.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagation(ray_start_regular):
+    @ray_tpu.remote
+    def fail():
+        raise ValueError("boom")
+
+    with pytest.raises(TaskError) as exc_info:
+        ray_tpu.get(fail.remote())
+    assert "boom" in str(exc_info.value)
+    assert isinstance(exc_info.value.cause, ValueError)
+
+
+def test_error_propagates_through_dependency(ray_start_regular):
+    @ray_tpu.remote
+    def fail():
+        raise ValueError("boom")
+
+    @ray_tpu.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(TaskError):
+        ray_tpu.get(consume.remote(fail.remote()))
+
+
+def test_get_timeout(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(slow.remote(), timeout=0.2)
+
+
+def test_wait(ray_start_regular):
+    @ray_tpu.remote
+    def fast():
+        return "fast"
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    fast_ref, slow_ref = fast.remote(), slow.remote()
+    ready, not_ready = ray_tpu.wait([fast_ref, slow_ref], num_returns=1,
+                                    timeout=2.0)
+    assert ready == [fast_ref]
+    assert not_ready == [slow_ref]
+
+
+def test_wait_timeout_returns_partial(ray_start_regular):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+
+    ready, not_ready = ray_tpu.wait([slow.remote()], num_returns=1, timeout=0.1)
+    assert ready == []
+    assert len(not_ready) == 1
+
+
+def test_options_override(ray_start_regular):
+    @ray_tpu.remote(num_cpus=1)
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.options(num_cpus=2, name="custom").remote()) == 1
+
+
+def test_retries(ray_start_regular):
+    import threading
+
+    attempts = []
+    lock = threading.Lock()
+
+    @ray_tpu.remote(max_retries=3, retry_exceptions=True)
+    def flaky():
+        with lock:
+            attempts.append(1)
+            if len(attempts) < 3:
+                raise RuntimeError("transient")
+        return "ok"
+
+    assert ray_tpu.get(flaky.remote()) == "ok"
+    assert len(attempts) == 3
+
+
+def test_calling_remote_function_directly_raises(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError):
+        f()
+
+
+def test_parallelism(ray_start_regular):
+    @ray_tpu.remote
+    def sleep_task():
+        time.sleep(0.3)
+        return 1
+
+    start = time.monotonic()
+    refs = [sleep_task.remote() for _ in range(8)]
+    assert sum(ray_tpu.get(refs)) == 8
+    elapsed = time.monotonic() - start
+    # 8 tasks x 0.3s on 8 CPUs should take ~0.3s, far below serial 2.4s.
+    assert elapsed < 1.5
+
+
+def test_resource_limit_enforced(ray_start_regular):
+    import threading
+
+    running = []
+    peak = []
+    lock = threading.Lock()
+
+    @ray_tpu.remote(num_cpus=4)
+    def heavy(idx):
+        with lock:
+            running.append(idx)
+            peak.append(len(running))
+        time.sleep(0.2)
+        with lock:
+            running.remove(idx)
+        return idx
+
+    refs = [heavy.remote(i) for i in range(4)]
+    ray_tpu.get(refs)
+    # 8 CPUs / 4 per task => at most 2 concurrent.
+    assert max(peak) <= 2
+
+
+def test_object_ref_in_container_not_resolved(ray_start_regular):
+    @ray_tpu.remote
+    def f(container):
+        (ref,) = container
+        return ray_tpu.get(ref) + 1
+
+    inner = ray_tpu.put(1)
+    assert ray_tpu.get(f.remote([inner])) == 2
+
+
+def test_cluster_resources(ray_start_regular):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 8.0
+
+
+def test_nodes_listing(ray_start_regular):
+    node_list = ray_tpu.nodes()
+    assert len(node_list) == 1
+    assert node_list[0]["Alive"]
+
+
+def test_timeline_records_tasks(ray_start_regular):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    ray_tpu.get(f.remote())
+    events = ray_tpu.timeline()
+    assert any(e["name"].endswith("f") for e in events)
+
+
+def test_runtime_context_inside_task(ray_start_regular):
+    @ray_tpu.remote
+    def whoami():
+        ctx = ray_tpu.get_runtime_context()
+        return ctx.get_task_id()
+
+    task_id = ray_tpu.get(whoami.remote())
+    assert task_id is not None and len(task_id) == 32
+
+
+def test_cancel_pending_task(ray_start_regular):
+    import threading
+    release = threading.Event()
+
+    @ray_tpu.remote(num_cpus=8)
+    def blocker():
+        release.wait(10)
+        return "done"
+
+    @ray_tpu.remote(num_cpus=8)
+    def queued():
+        return "ran"
+
+    blocker_ref = blocker.remote()
+    time.sleep(0.1)
+    queued_ref = queued.remote()  # stuck behind blocker (8/8 CPUs)
+    ray_tpu.cancel(queued_ref)
+    release.set()
+    assert ray_tpu.get(blocker_ref) == "done"
+    from ray_tpu.exceptions import TaskCancelledError
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(queued_ref, timeout=5)
+
+
+def test_cancel_running_task_is_noop(ray_start_regular):
+    @ray_tpu.remote
+    def running():
+        time.sleep(0.3)
+        return "finished"
+
+    ref = running.remote()
+    time.sleep(0.1)
+    ray_tpu.cancel(ref)  # already running: best-effort no-op
+    assert ray_tpu.get(ref) == "finished"

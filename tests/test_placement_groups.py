@@ -1,0 +1,102 @@
+"""Placement group tests (modeled on reference
+python/ray/tests/test_placement_group*.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util.placement_group import (
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+    tpu_slice_bundle,
+)
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+def test_pg_create_and_ready(ray_start_regular):
+    pg = placement_group([{"CPU": 2}, {"CPU": 2}], strategy="PACK")
+    assert pg.wait(timeout_seconds=5)
+    table = placement_group_table()
+    assert any(v["state"] == "CREATED" for v in table.values())
+
+
+def test_pg_reserves_resources(ray_start_regular):
+    pg = placement_group([{"CPU": 4}], strategy="PACK")
+    assert pg.wait(timeout_seconds=5)
+    assert ray_tpu.available_resources().get("CPU", 0) == 4
+    remove_placement_group(pg)
+    time.sleep(0.2)
+    assert ray_tpu.available_resources().get("CPU", 0) == 8
+
+
+def test_pg_task_scheduling(ray_start_regular):
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+
+    @ray_tpu.remote(num_cpus=2)
+    def inside():
+        return "in-bundle"
+
+    strategy = PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0)
+    ref = inside.options(scheduling_strategy=strategy).remote()
+    assert ray_tpu.get(ref, timeout=10) == "in-bundle"
+
+
+def test_pg_actor_scheduling(ray_start_regular):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+
+    @ray_tpu.remote(num_cpus=1)
+    class Worker:
+        def ping(self):
+            return "pong"
+
+    strategy = PlacementGroupSchedulingStrategy(placement_group=pg)
+    worker = Worker.options(scheduling_strategy=strategy).remote()
+    assert ray_tpu.get(worker.ping.remote(), timeout=10) == "pong"
+    ray_tpu.kill(worker)
+
+
+def test_pg_pending_until_capacity(ray_start_regular):
+    # 8 CPUs total: a 6-CPU PG fits, a second one must stay pending.
+    pg1 = placement_group([{"CPU": 6}], strategy="PACK")
+    assert pg1.wait(timeout_seconds=5)
+    pg2 = placement_group([{"CPU": 6}], strategy="PACK")
+    assert not pg2.wait(timeout_seconds=0.3)
+    remove_placement_group(pg1)
+    assert pg2.wait(timeout_seconds=5)
+
+
+def test_pg_strict_spread_needs_multiple_nodes(ray_start_cluster):
+    runtime = ray_start_cluster
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    # Only one node: cannot commit yet.
+    assert not pg.wait(timeout_seconds=0.3)
+    runtime.add_node({"CPU": 4})
+    assert pg.wait(timeout_seconds=5)
+
+
+def test_pg_invalid_strategy(ray_start_regular):
+    with pytest.raises(ValueError):
+        placement_group([{"CPU": 1}], strategy="BOGUS")
+
+
+def test_pg_invalid_bundle(ray_start_regular):
+    with pytest.raises(ValueError):
+        placement_group([{}], strategy="PACK")
+
+
+def test_tpu_slice_bundle_shape():
+    bundles = tpu_slice_bundle(num_chips=8, cpus_per_host=4, chips_per_host=4)
+    assert bundles == [{"TPU": 4.0, "CPU": 4.0}, {"TPU": 4.0, "CPU": 4.0}]
+
+
+def test_tpu_pg_on_virtual_tpu_nodes(ray_start_cluster):
+    runtime = ray_start_cluster
+    runtime.add_node({"CPU": 4, "TPU": 4})
+    runtime.add_node({"CPU": 4, "TPU": 4})
+    pg = placement_group(
+        tpu_slice_bundle(num_chips=8, cpus_per_host=2), strategy="STRICT_SPREAD")
+    assert pg.wait(timeout_seconds=5)
+    assert ray_tpu.available_resources().get("TPU", 0) == 0
