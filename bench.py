@@ -1,0 +1,120 @@
+"""Benchmark: Llama training-step MFU on the local accelerator.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+North-star (BASELINE.md): Llama-2-7B SFT at >=35% MFU on v5e-64. This
+single-chip bench runs the same training-step code path (GSPMD jit, bf16,
+remat, AdamW) on a ~350M Llama sized for one chip's HBM and reports MFU
+against the 35% target.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+PEAK_FLOPS = {
+    # bf16 peak per chip.
+    "tpu v5 lite": 197e12,
+    "tpu v5e": 197e12,
+    "tpu v5": 459e12,
+    "tpu v4": 275e12,
+    "cpu": 1e12,  # nominal, so the bench still runs off-TPU
+}
+
+
+def peak_flops(device) -> float:
+    kind = device.device_kind.lower()
+    for name, flops in PEAK_FLOPS.items():
+        if name in kind:
+            return flops
+    return PEAK_FLOPS["cpu"]
+
+
+def bench_config():
+    from ray_tpu.models.llama import LlamaConfig
+
+    # ~350M params: fits params+AdamW(f32)+activations in 16GB HBM.
+    return dataclasses.replace(
+        LlamaConfig(),
+        vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+        num_layers=24, num_heads=16, num_kv_heads=8, head_dim=64,
+        max_seq_len=2048)
+
+
+def main() -> None:
+    from ray_tpu.models import llama
+    from ray_tpu.parallel.mesh import MeshConfig, build_mesh
+    from ray_tpu.parallel.train_step import (
+        build_train_step,
+        create_train_state,
+        default_optimizer,
+        shard_batch,
+    )
+
+    device = jax.devices()[0]
+    on_tpu = device.platform == "tpu"
+    config = bench_config()
+    batch_size, seq_len = (8, 2048) if on_tpu else (2, 256)
+
+    mesh = build_mesh(MeshConfig(dp=1), devices=[device])
+    with jax.set_mesh(mesh):
+        params = llama.init_params(config, jax.random.PRNGKey(0))
+        optimizer = default_optimizer(learning_rate=3e-4, warmup_steps=10,
+                                      total_steps=1000)
+        state = create_train_state(
+            params, optimizer, mesh, llama.param_logical_axes(config))
+        del params
+
+        def loss(params, batch):
+            return llama.loss_fn(params, batch["tokens"], batch["targets"],
+                                 config)
+
+        step = build_train_step(loss, optimizer)
+        tokens = jax.random.randint(
+            jax.random.PRNGKey(1), (batch_size, seq_len + 1), 0,
+            config.vocab_size)
+        batch = shard_batch(
+            {"tokens": tokens[:, :-1], "targets": tokens[:, 1:]}, mesh)
+
+        # Warmup/compile.
+        state, metrics = step(state, batch)
+        jax.block_until_ready(metrics["loss"])
+
+        n_steps = 10 if on_tpu else 2
+        start = time.perf_counter()
+        for _ in range(n_steps):
+            state, metrics = step(state, batch)
+        jax.block_until_ready(metrics["loss"])
+        elapsed = time.perf_counter() - start
+
+    tokens_per_step = batch_size * seq_len
+    step_time = elapsed / n_steps
+    tokens_per_sec = tokens_per_step / step_time
+    achieved = tokens_per_sec * llama.flops_per_token(config, seq_len)
+    mfu = achieved / peak_flops(device)
+
+    print(json.dumps({
+        "metric": "llama_350m_train_mfu",
+        "value": round(mfu, 4),
+        "unit": "mfu_fraction",
+        "vs_baseline": round(mfu / 0.35, 4),
+        "detail": {
+            "device": device.device_kind,
+            "tokens_per_sec": round(tokens_per_sec, 1),
+            "step_time_s": round(step_time, 4),
+            "params": config.num_params,
+            "batch": [batch_size, seq_len],
+            "loss": float(metrics["loss"]),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,252 @@
+"""Llama-family decoder-only transformer, TPU-first.
+
+Flagship model for the Train/Serve/bench paths (the reference has no
+model zoo of its own — it launches torch models; BASELINE.json's
+north-star configs are Llama-2-7B SFT + serving, so the model family
+lives here as a first-class framework component).
+
+Design choices for TPU:
+- pure-JAX functional (params = pytree), bf16 activations / f32 params
+  and optimizer, f32 logits for the loss;
+- every param carries a *logical* sharding axis tuple
+  (``param_logical_axes``) consumed by ray_tpu.parallel.sharding rules →
+  GSPMD: tp shards heads/mlp/vocab, fsdp shards embed, sp shards the
+  sequence via ring attention, dp replicates;
+- layers run under ``lax.scan`` with ``jax.checkpoint`` (remat) so the
+  whole stack compiles to one fused loop and activation memory stays
+  O(1) in depth — the XLA-idiomatic equivalent of activation
+  checkpointing wrappers;
+- GQA (num_kv_heads < num_heads), RoPE, RMSNorm, SwiGLU — the Llama-2/3
+  architecture family.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ray_tpu.parallel.ring_attention import (
+    plain_attention,
+    ring_attention,
+    ring_attention_gspmd,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: int = 128
+    max_seq_len: int = 4096
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    # "plain" (full attention) or "ring" (context parallel over sp axis —
+    # requires running inside shard_map with an "sp" axis).
+    attention: str = "plain"
+
+    @staticmethod
+    def llama2_7b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128,
+            max_seq_len=8192, rope_theta=500000.0)
+
+    @staticmethod
+    def small_1b() -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_layers=22, num_heads=32, num_kv_heads=4, head_dim=64)
+
+    @staticmethod
+    def tiny(vocab_size: int = 256) -> "LlamaConfig":
+        """Test-size config; every sharded dim is divisible by 2 and 4."""
+        return LlamaConfig(
+            vocab_size=vocab_size, hidden_size=64, intermediate_size=128,
+            num_layers=2, num_heads=4, num_kv_heads=4, head_dim=16,
+            max_seq_len=128, remat=False)
+
+    @property
+    def num_params(self) -> int:
+        e, m, v = self.hidden_size, self.intermediate_size, self.vocab_size
+        h, kv, d = self.num_heads, self.num_kv_heads, self.head_dim
+        per_layer = (e * h * d + 2 * e * kv * d + h * d * e  # attention
+                     + 3 * e * m  # swiglu
+                     + 2 * e)  # norms
+        return v * e + self.num_layers * per_layer + e + e * v
+
+
+# ---------------------------------------------------------------------- init
+
+
+def init_params(config: LlamaConfig, key: jax.Array) -> dict:
+    """Initialize a param pytree. Per-layer params are stacked on a
+    leading ``num_layers`` dim (consumed by lax.scan)."""
+    e, m, v = config.hidden_size, config.intermediate_size, config.vocab_size
+    h, kv, d = config.num_heads, config.num_kv_heads, config.head_dim
+    n = config.num_layers
+    keys = jax.random.split(key, 9)
+
+    def norm_init(*shape):
+        return jnp.ones(shape, dtype=jnp.float32)
+
+    def dense_init(key, fan_in, *shape):
+        return jax.random.normal(key, shape, dtype=jnp.float32) * fan_in ** -0.5
+
+    return {
+        "embed": {"tokens": dense_init(keys[0], e, v, e)},
+        "layers": {
+            "attn_norm": norm_init(n, e),
+            "wq": dense_init(keys[1], e, n, e, h, d),
+            "wk": dense_init(keys[2], e, n, e, kv, d),
+            "wv": dense_init(keys[3], e, n, e, kv, d),
+            "wo": dense_init(keys[4], h * d, n, h, d, e),
+            "mlp_norm": norm_init(n, e),
+            "w_gate": dense_init(keys[5], e, n, e, m),
+            "w_up": dense_init(keys[6], e, n, e, m),
+            "w_down": dense_init(keys[7], m, n, m, e),
+        },
+        "final_norm": norm_init(e),
+        "lm_head": dense_init(keys[8], e, e, v),
+    }
+
+
+def param_logical_axes(config: LlamaConfig | None = None) -> dict:
+    """Logical sharding axes per param (leading scan dim = None).
+
+    tp → heads/mlp/vocab; fsdp → embed; norms replicated.
+    """
+    return {
+        "embed": {"tokens": ("vocab", "embed")},
+        "layers": {
+            "attn_norm": (None, "norm"),
+            "wq": (None, "embed", "heads", None),
+            "wk": (None, "embed", "kv_heads", None),
+            "wv": (None, "embed", "kv_heads", None),
+            "wo": (None, "heads", None, "embed"),
+            "mlp_norm": (None, "norm"),
+            "w_gate": (None, "embed", "mlp"),
+            "w_up": (None, "embed", "mlp"),
+            "w_down": (None, "mlp", "embed"),
+        },
+        "final_norm": ("norm",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+# ------------------------------------------------------------------- forward
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float) -> jax.Array:
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    out = x32 * lax.rsqrt(var + eps)
+    return (out * scale).astype(x.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x: [B, L, H, D], positions: [B, L]."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d // 2, dtype=jnp.float32) / (d // 2))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, L, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attention_block(layer: dict, x: jax.Array, positions: jax.Array,
+                     config: LlamaConfig) -> jax.Array:
+    dtype = config.dtype
+    h, kv, d = config.num_heads, config.num_kv_heads, config.head_dim
+    normed = rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
+    q = jnp.einsum("ble,ehd->blhd", normed, layer["wq"].astype(dtype))
+    k = jnp.einsum("ble,ekd->blkd", normed, layer["wk"].astype(dtype))
+    v = jnp.einsum("ble,ekd->blkd", normed, layer["wv"].astype(dtype))
+    q = rope(q, positions, config.rope_theta)
+    k = rope(k, positions, config.rope_theta)
+    if kv != h:
+        reps = h // kv
+        k = jnp.repeat(k, reps, axis=2)
+        v = jnp.repeat(v, reps, axis=2)
+    if config.attention == "ring":
+        # Context-parallel path: shard_map ring over the ambient mesh's
+        # sp axis (requires jax.set_mesh).
+        out = ring_attention_gspmd(q, k, v, causal=True)
+    elif config.attention == "ring_local":
+        # Already inside a shard_map with an "sp" axis.
+        out = ring_attention(q, k, v, axis_name="sp", causal=True)
+    else:
+        out = plain_attention(q, k, v, causal=True)
+    return x + jnp.einsum("blhd,hde->ble", out, layer["wo"].astype(dtype))
+
+
+def _mlp_block(layer: dict, x: jax.Array, config: LlamaConfig) -> jax.Array:
+    dtype = config.dtype
+    normed = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
+    gate = jnp.einsum("ble,em->blm", normed, layer["w_gate"].astype(dtype))
+    up = jnp.einsum("ble,em->blm", normed, layer["w_up"].astype(dtype))
+    hidden = jax.nn.silu(gate) * up
+    return x + jnp.einsum("blm,me->ble", hidden, layer["w_down"].astype(dtype))
+
+
+def forward(params: dict, tokens: jax.Array, config: LlamaConfig,
+            positions: jax.Array | None = None) -> jax.Array:
+    """tokens [B, L] (local shard if under sp) -> logits [B, L, V] f32.
+
+    When ``positions`` is provided they are the *global* token positions
+    (needed for RoPE + causal masking under sequence parallelism).
+    """
+    if positions is None:
+        b, l = tokens.shape
+        positions = jnp.broadcast_to(jnp.arange(l), (b, l))
+    x = params["embed"]["tokens"].astype(config.dtype)[tokens]
+
+    def layer_step(x, layer):
+        x = _attention_block(layer, x, positions, config)
+        x = _mlp_block(layer, x, config)
+        return x, None
+
+    step = layer_step
+    if config.remat:
+        step = jax.checkpoint(layer_step, prevent_cse=False)
+    x, _ = lax.scan(step, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
+    logits = jnp.einsum("ble,ev->blv", x.astype(jnp.float32),
+                        params["lm_head"].astype(jnp.float32))
+    return logits
+
+
+def loss_fn(params: dict, tokens: jax.Array, targets: jax.Array,
+            config: LlamaConfig, positions: jax.Array | None = None,
+            mask: jax.Array | None = None) -> jax.Array:
+    """Mean next-token cross-entropy (targets already shifted)."""
+    logits = forward(params, tokens, config, positions)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if mask is not None:
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)
+
+
+def flops_per_token(config: LlamaConfig, seq_len: int | None = None) -> float:
+    """6 * params (fwd+bwd) + attention term — standard MFU accounting."""
+    seq = seq_len if seq_len is not None else config.max_seq_len
+    attn_flops = (12 * config.num_layers * config.num_heads
+                  * config.head_dim * seq)
+    return 6.0 * config.num_params + attn_flops

@@ -1,0 +1,60 @@
+"""MLP classifier — the MNIST end-to-end model (BASELINE config 2:
+"ray.train MNIST MLP DataParallelTrainer (4-worker DDP → pmap)").
+
+Pure-JAX functional; data parallel via GSPMD batch sharding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    input_dim: int = 784
+    hidden_dims: tuple[int, ...] = (128, 128)
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+
+def init_params(config: MLPConfig, key: jax.Array) -> list[dict]:
+    dims = (config.input_dim, *config.hidden_dims, config.num_classes)
+    params = []
+    for i, (d_in, d_out) in enumerate(zip(dims[:-1], dims[1:])):
+        key, sub = jax.random.split(key)
+        params.append({
+            "w": jax.random.normal(sub, (d_in, d_out)) * (2.0 / d_in) ** 0.5,
+            "b": jnp.zeros((d_out,)),
+        })
+    return params
+
+
+def param_logical_axes(config: MLPConfig | None = None,
+                       num_layers: int | None = None) -> list[dict]:
+    n = (num_layers if num_layers is not None
+         else (len(config.hidden_dims) + 1 if config else 3))
+    return [{"w": ("embed", "mlp"), "b": (None,)} for _ in range(n)]
+
+
+def forward(params: list[dict], x: jax.Array) -> jax.Array:
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jax.nn.relu(x)
+    return x
+
+
+def loss_fn(params: list[dict], batch: dict) -> jax.Array:
+    logits = forward(params, batch["x"])
+    logp = jax.nn.log_softmax(logits)
+    nll = -jnp.take_along_axis(logp, batch["y"][:, None], axis=-1)[:, 0]
+    return jnp.mean(nll)
+
+
+def accuracy(params: list[dict], batch: dict) -> jax.Array:
+    logits = forward(params, batch["x"])
+    return jnp.mean((jnp.argmax(logits, -1) == batch["y"]).astype(jnp.float32))

@@ -1,0 +1,127 @@
+"""Logical-axis sharding rules → GSPMD shardings.
+
+The reference delegates sharding to torch wrappers (DDP/FSDP via
+train/torch/train_loop_utils.py:158); here sharding is a core framework
+concept: params and activations carry *logical* axis names which a rule
+table maps onto mesh axes, then XLA inserts the collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Default rule table for transformer models. Each logical axis maps to a
+# mesh axis (or tuple of axes, or None = replicated).
+DEFAULT_RULES: tuple[tuple[str, Any], ...] = (
+    ("batch", ("dp", "fsdp")),
+    ("sequence", "sp"),
+    ("embed", "fsdp"),          # ZeRO-3 style parameter sharding
+    ("heads", "tp"),
+    ("kv_heads", "tp"),
+    ("head_dim", None),
+    ("mlp", "tp"),
+    ("vocab", "tp"),
+    ("expert", "ep"),
+    ("stage", "pp"),
+    ("norm", None),
+)
+
+
+def rules_dict(rules: Sequence[tuple[str, Any]] | None = None) -> dict[str, Any]:
+    return dict(DEFAULT_RULES if rules is None else rules)
+
+
+def logical_to_spec(logical_axes: Sequence[str | None],
+                    rules: Sequence[tuple[str, Any]] | None = None) -> P:
+    """Map logical axis names to a PartitionSpec via the rule table."""
+    table = rules_dict(rules)
+    spec = []
+    used: set[str] = set()
+    for name in logical_axes:
+        if name is None:
+            spec.append(None)
+            continue
+        mesh_axes = table.get(name)
+        if mesh_axes is None:
+            spec.append(None)
+            continue
+        if isinstance(mesh_axes, str):
+            mesh_axes = (mesh_axes,)
+        free = tuple(a for a in mesh_axes if a not in used)
+        used.update(free)
+        if not free:
+            spec.append(None)
+        elif len(free) == 1:
+            spec.append(free[0])
+        else:
+            spec.append(free)
+    return P(*spec)
+
+
+def named_sharding(mesh: Mesh, *logical_axes: str | None,
+                   rules: Sequence[tuple[str, Any]] | None = None) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_spec(logical_axes, rules))
+
+
+def constrain(x: jax.Array, mesh: Mesh, *logical_axes: str | None,
+              rules: Sequence[tuple[str, Any]] | None = None) -> jax.Array:
+    """with_sharding_constraint by logical axis names (inside jit)."""
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(mesh, logical_to_spec(logical_axes, rules)))
+
+
+def tree_shardings(mesh: Mesh, logical_tree: Any,
+                   rules: Sequence[tuple[str, Any]] | None = None) -> Any:
+    """Map a pytree of logical-axis tuples to a pytree of NamedShardings."""
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, logical_to_spec(axes, rules)),
+        logical_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x),
+    )
+
+
+def infer_param_logical_axes(params: Any) -> Any:
+    """Heuristic logical axes for a param pytree, keyed by path + rank.
+
+    Used when a model doesn't carry explicit partitioning metadata:
+    - rank-1 arrays (biases, norm scales) → replicated
+    - rank-2 arrays → ("embed", "mlp"-or-"vocab"-or-"heads" by name)
+    - rank-3 arrays (attention qkv) → ("embed", "heads", None)
+    """
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def classify(path, leaf):
+        name = jax.tree_util.keystr(path).lower()
+        if leaf.ndim <= 1:
+            return tuple([None] * leaf.ndim)
+        if leaf.ndim == 2:
+            if "embed" in name and "token" in name or "vocab" in name:
+                return ("vocab", "embed")
+            if any(k in name for k in ("out_proj", "o_proj", "down")):
+                return ("mlp", "embed")
+            return ("embed", "mlp")
+        if leaf.ndim == 3:
+            return ("embed", "heads", None)
+        if leaf.ndim == 4:
+            return (None, None, None, None)
+        return tuple([None] * leaf.ndim)
+
+    leaves = {path: classify(path, leaf) for path, leaf in flat}
+
+    def rebuild(path, leaf):
+        return leaves[path]
+
+    return jax.tree_util.tree_map_with_path(rebuild, params)
+
+
+def shard_params(params: Any, mesh: Mesh, logical_axes: Any | None = None,
+                 rules: Sequence[tuple[str, Any]] | None = None) -> Any:
+    """Place a parameter pytree onto the mesh per the rules."""
+    if logical_axes is None:
+        logical_axes = infer_param_logical_axes(params)
+    shardings = tree_shardings(mesh, logical_axes, rules)
+    return jax.device_put(params, shardings)

@@ -8,11 +8,15 @@ the same strategy as the reference's "many nodes on one box" fixtures
 
 import os
 
-# Must run before jax is imported anywhere.
-os.environ.setdefault("XLA_FLAGS",
-                      "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-os.environ.setdefault("RAY_TPU_SKIP_TPU_DETECTION", "1")
+# Must run before jax is imported anywhere. Force (not setdefault): the
+# ambient environment may pin JAX_PLATFORMS to a TPU plugin, but tests
+# always run on the virtual CPU mesh.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["RAY_TPU_SKIP_TPU_DETECTION"] = "1"
 
 import pytest
 
