@@ -1,0 +1,39 @@
+"""ray_tpu.train — distributed training orchestration (reference:
+python/ray/train)."""
+
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train.session import (
+    get_checkpoint,
+    get_context,
+    get_mesh,
+    report,
+)
+from ray_tpu.train.trainer import (
+    BaseTrainer,
+    DataParallelTrainer,
+    JaxTrainer,
+    Result,
+)
+
+__all__ = [
+    "BaseTrainer",
+    "Checkpoint",
+    "CheckpointConfig",
+    "CheckpointManager",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "get_checkpoint",
+    "get_context",
+    "get_mesh",
+    "report",
+]

@@ -1,0 +1,126 @@
+"""Checkpoints: directory-based with orbax for sharded arrays.
+
+Reference: python/ray/train/_checkpoint.py (Checkpoint = directory +
+fsspec upload) and _internal/checkpoint_manager.py (top-K retention).
+TPU-native: array state goes through orbax (async-capable, handles
+jax.Array shardings) — SURVEY §5 "checkpoint/resume" TPU note.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+import time
+from typing import Any
+
+
+class Checkpoint:
+    """A directory of checkpoint data."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(os.path.abspath(path))
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "Checkpoint":
+        tmp = tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        with open(os.path.join(tmp, "data.pkl"), "wb") as f:
+            pickle.dump(data, f)
+        return cls(tmp)
+
+    def to_dict(self) -> dict:
+        with open(os.path.join(self.path, "data.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def as_directory(self) -> str:
+        return self.path
+
+    # ---- jax pytree state (orbax when available, pickle fallback) ----
+
+    @classmethod
+    def from_state(cls, state: Any, path: str | None = None) -> "Checkpoint":
+        """Save a pytree of (possibly sharded) jax arrays."""
+        target = path or tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        os.makedirs(target, exist_ok=True)
+        try:
+            import orbax.checkpoint as ocp
+
+            ckptr = ocp.StandardCheckpointer()
+            ckptr.save(os.path.join(target, "state"), state, force=True)
+            ckptr.wait_until_finished()
+            meta = {"format": "orbax"}
+        except Exception:
+            import jax
+
+            host_state = jax.tree.map(
+                lambda x: __import__("numpy").asarray(x)
+                if hasattr(x, "dtype") else x, state)
+            with open(os.path.join(target, "state.pkl"), "wb") as f:
+                pickle.dump(host_state, f)
+            meta = {"format": "pickle"}
+        with open(os.path.join(target, "meta.json"), "w") as f:
+            json.dump(meta, f)
+        return cls(target)
+
+    def to_state(self, template: Any | None = None) -> Any:
+        with open(os.path.join(self.path, "meta.json")) as f:
+            meta = json.load(f)
+        if meta["format"] == "orbax":
+            import orbax.checkpoint as ocp
+
+            ckptr = ocp.StandardCheckpointer()
+            return ckptr.restore(os.path.join(self.path, "state"), template)
+        with open(os.path.join(self.path, "state.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+class CheckpointManager:
+    """Top-K checkpoint retention (reference:
+    train/_internal/checkpoint_manager.py)."""
+
+    def __init__(self, storage_path: str, num_to_keep: int | None = None,
+                 metric: str | None = None, mode: str = "max"):
+        self.storage_path = storage_path
+        self.num_to_keep = num_to_keep
+        self.metric = metric
+        self.mode = mode
+        self._entries: list[tuple[float, str, dict]] = []
+        os.makedirs(storage_path, exist_ok=True)
+
+    def register(self, checkpoint: Checkpoint, metrics: dict) -> str:
+        """Move a checkpoint into managed storage; evict beyond top-K."""
+        name = f"checkpoint_{int(time.time() * 1000):x}_{len(self._entries)}"
+        dest = os.path.join(self.storage_path, name)
+        if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
+            shutil.move(checkpoint.path, dest)
+        score = metrics.get(self.metric, 0.0) if self.metric else float(
+            len(self._entries))
+        if self.mode == "min":
+            score = -score
+        self._entries.append((score, dest, dict(metrics)))
+        self._entries.sort(key=lambda e: e[0], reverse=True)
+        if self.num_to_keep is not None:
+            while len(self._entries) > self.num_to_keep:
+                _, evict_path, _ = self._entries.pop()
+                shutil.rmtree(evict_path, ignore_errors=True)
+        return dest
+
+    def best_checkpoint(self) -> Checkpoint | None:
+        if not self._entries:
+            return None
+        return Checkpoint(self._entries[0][1])
+
+    def latest_checkpoint(self) -> Checkpoint | None:
+        if not self._entries:
+            return None
+        latest = max(self._entries, key=lambda e: e[1])
+        return Checkpoint(latest[1])

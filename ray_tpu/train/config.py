@@ -1,0 +1,70 @@
+"""Shared training config dataclasses.
+
+Reference: python/ray/air/config.py — ScalingConfig (:101), FailureConfig
+(:377), CheckpointConfig (:427), RunConfig (:576). TPU-native twist:
+ScalingConfig speaks chips/hosts and placement is slice-aware
+(STRICT_PACK over a pod slice), since a TPU slice fails and is acquired
+as a unit (SURVEY §7 hard parts).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class ScalingConfig:
+    """How many workers and what each worker holds.
+
+    num_workers: actor count in the worker group (1 per TPU host in a
+    real slice; threads in the single-node slice).
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: dict[str, float] = field(default_factory=dict)
+    placement_strategy: str = "PACK"
+    # TPU topology hints.
+    chips_per_worker: int = 0
+
+    def worker_resources(self) -> dict[str, float]:
+        res = dict(self.resources_per_worker)
+        if self.use_tpu and "TPU" not in res:
+            res["TPU"] = float(self.chips_per_worker or 1)
+        if "CPU" not in res:
+            res["CPU"] = 1.0
+        return res
+
+
+@dataclass
+class FailureConfig:
+    """Reference: air/config.py:377. max_failures: group-level restarts;
+    a TPU slice fails as a unit, so recovery re-forms the whole group."""
+
+    max_failures: int = 0
+
+
+@dataclass
+class CheckpointConfig:
+    """Reference: air/config.py:427."""
+
+    num_to_keep: int | None = None
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: bool = False
+
+
+@dataclass
+class RunConfig:
+    """Reference: air/config.py:576."""
+
+    name: str | None = None
+    storage_path: str | None = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    stop: dict[str, Any] | None = None
+    verbose: int = 0
+    # Max seconds between worker reports before the run is declared hung.
+    # Large default: the first report waits on the full XLA compile of the
+    # sharded train step, which for 7B-class models takes many minutes.
+    report_timeout_s: float = 3600.0

@@ -1,0 +1,106 @@
+"""WorkerGroup — the gang of training actors.
+
+Reference: python/ray/train/_internal/worker_group.py:102 (WorkerGroup of
+actors placed per ScalingConfig) and backend_executor.py:65/:124/:438
+(start, start_training). Placement uses a placement group so the gang is
+scheduled all-or-nothing (slice semantics for TPU).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import ScalingConfig
+from ray_tpu.train.session import TrainContext, _SessionState
+from ray_tpu.util.placement_group import placement_group, remove_placement_group
+from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
+
+
+@ray_tpu.remote
+class TrainWorker:
+    """One member of the gang; runs the user loop in its actor thread."""
+
+    def __init__(self, rank: int, world_size: int):
+        self.rank = rank
+        self.world_size = world_size
+
+    def run(self, fn: Callable, config: dict, results_queue,
+            stop_event, resume_checkpoint) -> Any:
+        from ray_tpu.train.session import run_with_session
+
+        state = _SessionState(
+            context=TrainContext(world_size=self.world_size,
+                                 world_rank=self.rank,
+                                 local_rank=self.rank),
+            results_queue=results_queue,
+            resume_checkpoint=resume_checkpoint,
+            stop_event=stop_event,
+        )
+
+        def emit(msg: dict):
+            results_queue.put({"rank": self.rank, **msg})
+
+        try:
+            return run_with_session(fn, config, state, emit)
+        except BaseException:  # noqa: BLE001 — already emitted; fail the ref
+            raise
+
+    def ping(self) -> str:
+        return "ok"
+
+
+class WorkerGroup:
+    """Creates, supervises and tears down the gang."""
+
+    def __init__(self, scaling: ScalingConfig):
+        self.scaling = scaling
+        self.workers: list = []
+        self.pg = None
+        self._start()
+
+    def _start(self):
+        n = self.scaling.num_workers
+        resources = self.scaling.worker_resources()
+        bundles = [dict(resources) for _ in range(n)]
+        self.pg = placement_group(bundles, strategy=self.scaling.placement_strategy)
+        if not self.pg.wait(timeout_seconds=60):
+            remove_placement_group(self.pg)
+            raise TimeoutError(
+                f"Could not reserve {n} x {resources} for the worker group")
+        strategy = PlacementGroupSchedulingStrategy(placement_group=self.pg)
+        try:
+            self.workers = [
+                TrainWorker.options(
+                    resources={k: v for k, v in resources.items()},
+                    num_cpus=0,
+                    scheduling_strategy=strategy,
+                ).remote(rank, n)
+                for rank in range(n)
+            ]
+            ray_tpu.get([w.ping.remote() for w in self.workers], timeout=60)
+        except BaseException:
+            # Don't leak the committed bundles or half-started gang.
+            self.shutdown()
+            raise
+
+    def run(self, fn: Callable, config: dict, results_queue,
+            stop_event, resume_checkpoint) -> list:
+        """Kick off the loop on every worker; returns refs."""
+        return [
+            w.run.remote(fn, config, results_queue, stop_event, resume_checkpoint)
+            for w in self.workers
+        ]
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        if self.pg is not None:
+            remove_placement_group(self.pg)
+        self.workers = []

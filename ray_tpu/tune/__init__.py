@@ -1,0 +1,72 @@
+"""ray_tpu.tune — hyperparameter optimization (reference: python/ray/tune)."""
+
+from ray_tpu.train.session import get_checkpoint
+from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.search import (
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.tuner import (
+    ResultGrid,
+    TrialResult,
+    TuneConfig,
+    Tuner,
+    run,
+)
+
+
+def report(metrics: dict, checkpoint=None) -> None:
+    """In-trial reporting (reference: ray.tune.report / session.report)."""
+    from ray_tpu.train.session import report as _report
+
+    _report(metrics, checkpoint)
+
+
+class Trainable:
+    """Class trainable protocol (reference: tune/trainable/trainable.py:61).
+
+    Subclasses override setup(config), step() -> dict, and optionally
+    save_checkpoint/load_checkpoint/cleanup.
+    """
+
+    def __init__(self, config: dict | None = None):
+        self.config = config or {}
+
+    def setup(self, config: dict) -> None:
+        pass
+
+    def step(self) -> dict:
+        raise NotImplementedError
+
+    def save_checkpoint(self, checkpoint_dir: str):
+        return None
+
+    def load_checkpoint(self, checkpoint) -> None:
+        pass
+
+    def cleanup(self) -> None:
+        pass
+
+
+__all__ = [
+    "ASHAScheduler",
+    "FIFOScheduler",
+    "ResultGrid",
+    "Trainable",
+    "TrialResult",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "grid_search",
+    "loguniform",
+    "randint",
+    "report",
+    "run",
+    "sample_from",
+    "uniform",
+]

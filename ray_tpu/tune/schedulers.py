@@ -1,0 +1,70 @@
+"""Trial schedulers: FIFO and ASHA.
+
+Reference: python/ray/tune/schedulers/async_hyperband.py (ASHA) — rungs
+at grace_period * reduction_factor^k; a trial reaching a rung must be in
+the top 1/reduction_factor of results seen at that rung or it stops.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    def on_result(self, trial_id: str, metrics: dict) -> str:
+        return CONTINUE
+
+
+@dataclass
+class ASHAScheduler:
+    metric: str = "loss"
+    mode: str = "min"  # "min" or "max"
+    grace_period: int = 1
+    reduction_factor: int = 4
+    max_t: int = 100
+    time_attr: str = "training_iteration"
+    _rungs: dict[int, list[float]] = field(default_factory=lambda: defaultdict(list))
+    _recorded: dict[str, set] = field(default_factory=lambda: defaultdict(set))
+
+    def __post_init__(self):
+        if self.mode not in ("min", "max"):
+            raise ValueError(f"mode must be min|max, got {self.mode}")
+
+    def _rung_levels(self) -> list[int]:
+        levels = []
+        t = self.grace_period
+        while t < self.max_t:
+            levels.append(t)
+            t *= self.reduction_factor
+        return levels
+
+    def on_result(self, trial_id: str, metrics: dict) -> str:
+        t = metrics.get(self.time_attr)
+        value = metrics.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        score = -float(value) if self.mode == "min" else float(value)
+        decision = CONTINUE
+        # Milestone semantics (>=): trials reporting on a stride that skips
+        # an exact rung value still get evaluated at the first report at or
+        # past each rung, once per trial per rung.
+        seen = self._recorded[trial_id]
+        for level in self._rung_levels():
+            if t >= level and level not in seen:
+                seen.add(level)
+                rung = self._rungs[level]
+                rung.append(score)
+                if len(rung) >= self.reduction_factor:
+                    rung_sorted = sorted(rung, reverse=True)
+                    cutoff = rung_sorted[
+                        max(0, len(rung) // self.reduction_factor - 1)]
+                    if score < cutoff:
+                        decision = STOP
+        if t >= self.max_t:
+            decision = STOP
+        return decision

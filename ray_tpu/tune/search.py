@@ -1,0 +1,110 @@
+"""Search spaces and variant generation.
+
+Reference: python/ray/tune/search/ — basic_variant (grid/random),
+sample.py domains (choice/uniform/loguniform/randint), and
+ConcurrencyLimiter semantics (max_concurrent in TuneConfig).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from dataclasses import dataclass
+from typing import Any, Callable
+
+
+@dataclass
+class GridSearch:
+    values: list
+
+
+@dataclass
+class Choice:
+    values: list
+
+    def sample(self, rng: random.Random):
+        return rng.choice(self.values)
+
+
+@dataclass
+class Uniform:
+    low: float
+    high: float
+
+    def sample(self, rng: random.Random):
+        return rng.uniform(self.low, self.high)
+
+
+@dataclass
+class LogUniform:
+    low: float
+    high: float
+
+    def sample(self, rng: random.Random):
+        import math
+
+        return math.exp(rng.uniform(math.log(self.low), math.log(self.high)))
+
+
+@dataclass
+class RandInt:
+    low: int
+    high: int
+
+    def sample(self, rng: random.Random):
+        return rng.randrange(self.low, self.high)
+
+
+@dataclass
+class Func:
+    fn: Callable[[dict], Any]
+
+    def sample(self, rng: random.Random):
+        return self.fn(None)
+
+
+def grid_search(values: list) -> GridSearch:
+    return GridSearch(list(values))
+
+
+def choice(values: list) -> Choice:
+    return Choice(list(values))
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float) -> LogUniform:
+    return LogUniform(low, high)
+
+
+def randint(low: int, high: int) -> RandInt:
+    return RandInt(low, high)
+
+
+def sample_from(fn: Callable) -> Func:
+    return Func(fn)
+
+
+def generate_variants(param_space: dict, num_samples: int = 1,
+                      seed: int | None = None) -> list[dict]:
+    """Grid axes are expanded exhaustively; stochastic domains are sampled
+    ``num_samples`` times per grid point (reference: basic_variant.py)."""
+    rng = random.Random(seed)
+    grid_keys = [k for k, v in param_space.items() if isinstance(v, GridSearch)]
+    grid_values = [param_space[k].values for k in grid_keys]
+    variants = []
+    grid_points = list(itertools.product(*grid_values)) if grid_keys else [()]
+    for point in grid_points:
+        for _ in range(num_samples):
+            config = {}
+            for key, value in param_space.items():
+                if isinstance(value, GridSearch):
+                    config[key] = point[grid_keys.index(key)]
+                elif hasattr(value, "sample"):
+                    config[key] = value.sample(rng)
+                else:
+                    config[key] = value
+            variants.append(config)
+    return variants

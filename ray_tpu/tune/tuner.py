@@ -1,0 +1,319 @@
+"""Tuner + trial controller.
+
+Reference: python/ray/tune/tuner.py:54/:354 (Tuner.fit) and
+execution/tune_controller.py:72/:718 (TuneController event loop managing
+trials as actors). Trials here run as tasks on the ray_tpu runtime;
+reports stream through a shared queue; the scheduler (ASHA) can stop
+trials at rung boundaries via per-trial stop events.
+
+Trainable forms supported (reference: tune/trainable/trainable.py):
+- function trainables ``fn(config)`` using ``ray_tpu.tune.report``;
+- class Trainables with setup/step/save/restore;
+- ray_tpu.train trainers via ``TunableTrainer`` (BaseTrainer.fit wraps a
+  trainer in a 1-trial tune run in the reference — here the layering is
+  inverted but equivalent: a trainer is just another trainable).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import ray_tpu
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.session import (
+    StopTraining,
+    TrainContext,
+    _SessionState,
+    _TrainSession,
+)
+from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
+from ray_tpu.tune.search import generate_variants
+
+
+@dataclass
+class TuneConfig:
+    metric: str = "loss"
+    mode: str = "min"
+    num_samples: int = 1
+    max_concurrent_trials: int = 0  # 0 = unlimited
+    scheduler: Any = None
+    seed: int | None = None
+    max_iterations: int = 0  # 0 = until trainable returns
+    # Wall-clock budget for the whole run; None = unlimited. On expiry,
+    # running trials are stopped and marked with a TimeoutError.
+    time_budget_s: float | None = None
+
+
+@dataclass
+class TrialResult:
+    trial_id: str
+    config: dict
+    metrics: dict = field(default_factory=dict)
+    history: list = field(default_factory=list)
+    error: BaseException | None = None
+    checkpoint: Checkpoint | None = None
+
+    @property
+    def last_result(self) -> dict:
+        return self.metrics
+
+
+class ResultGrid:
+    """Reference: ray.tune.ResultGrid."""
+
+    def __init__(self, results: list[TrialResult], metric: str, mode: str):
+        self._results = results
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __getitem__(self, idx):
+        return self._results[idx]
+
+    @property
+    def errors(self) -> list[BaseException]:
+        return [r.error for r in self._results if r.error is not None]
+
+    def get_best_result(self, metric: str | None = None,
+                        mode: str | None = None) -> TrialResult:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        valid = [r for r in self._results
+                 if r.error is None and metric in r.metrics]
+        if not valid:
+            raise ValueError("No successful trial reported metric "
+                             f"{metric!r}")
+        key = lambda r: r.metrics[metric]  # noqa: E731
+        return min(valid, key=key) if mode == "min" else max(valid, key=key)
+
+    def get_dataframe(self):
+        rows = [{"trial_id": r.trial_id, **r.config, **r.metrics}
+                for r in self._results]
+        try:
+            import pandas as pd
+
+            return pd.DataFrame(rows)
+        except Exception:
+            return rows
+
+
+def _run_trial_fn(trainable: Callable, config: dict, trial_id: str,
+                  results_queue, stop_event) -> Any:
+    """Execute one trial inside a task; session routes tune.report."""
+    from ray_tpu.train.session import run_with_session
+
+    state = _SessionState(
+        context=TrainContext(trial_name=trial_id),
+        results_queue=_TaggedQueue(results_queue, trial_id),
+        stop_event=stop_event,
+    )
+
+    def emit(msg: dict):
+        if msg.get("error") is None and isinstance(msg.get("result"), dict):
+            # A trainable may return its final metrics instead of reporting.
+            results_queue.put({"trial_id": trial_id, "done": False,
+                               "metrics": msg["result"], "checkpoint": None,
+                               "iteration": state.iteration + 1})
+        results_queue.put({"trial_id": trial_id, "done": True,
+                           "error": msg.get("error")})
+
+    try:
+        return run_with_session(trainable, config, state, emit)
+    except BaseException:  # noqa: BLE001 — recorded via emit; don't fail task
+        return None
+
+
+class _TaggedQueue:
+    """Adapts the train-session queue protocol to tagged tune messages.
+
+    Each report blocks until the controller has applied the scheduler
+    decision, so early-stopping (ASHA) takes effect on the very next
+    report rather than racing the trial loop.
+    """
+
+    def __init__(self, inner, trial_id: str):
+        self._inner = inner
+        self._trial_id = trial_id
+
+    def put(self, msg: dict):
+        ack = threading.Event()
+        self._inner.put({
+            "trial_id": self._trial_id,
+            "done": msg.get("done", False),
+            "metrics": msg.get("metrics", {}),
+            "checkpoint": msg.get("checkpoint"),
+            "iteration": msg.get("iteration", 0),
+            "error": msg.get("error"),
+            "ack": ack,
+        })
+        ack.wait(timeout=60.0)
+
+
+def _class_trainable_loop(cls: type, max_iterations: int) -> Callable:
+    """Adapt a class Trainable to the function protocol."""
+
+    def fn(config: dict):
+        from ray_tpu.tune import report
+
+        instance = cls(config) if _takes_config(cls) else cls()
+        if hasattr(instance, "setup"):
+            instance.setup(config)
+        i = 0
+        try:
+            while True:
+                i += 1
+                metrics = instance.step()
+                metrics.setdefault("training_iteration", i)
+                report(metrics)
+                if metrics.get("done") or (max_iterations and i >= max_iterations):
+                    break
+        finally:
+            if hasattr(instance, "cleanup"):
+                instance.cleanup()
+
+    return fn
+
+
+def _takes_config(cls: type) -> bool:
+    import inspect
+
+    try:
+        sig = inspect.signature(cls.__init__)
+        return len(sig.parameters) > 1
+    except (TypeError, ValueError):
+        return False
+
+
+class Tuner:
+    """Reference: ray.tune.Tuner (tuner.py:54)."""
+
+    def __init__(self, trainable: Callable | type, *,
+                 param_space: dict | None = None,
+                 tune_config: TuneConfig | None = None,
+                 run_config=None):
+        self.trainable = trainable
+        self.param_space = param_space or {}
+        self.tune_config = tune_config or TuneConfig()
+        self.run_config = run_config
+
+    def fit(self) -> ResultGrid:
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        tc = self.tune_config
+        scheduler = tc.scheduler or FIFOScheduler()
+        variants = generate_variants(self.param_space, tc.num_samples, tc.seed)
+        if not variants:
+            variants = [{}]
+
+        trainable = self.trainable
+        if isinstance(trainable, type):
+            trainable = _class_trainable_loop(trainable, tc.max_iterations)
+
+        results_queue: queue.Queue = queue.Queue()
+        trials: dict[str, TrialResult] = {}
+        stop_events: dict[str, threading.Event] = {}
+        pending = []
+        for i, config in enumerate(variants):
+            trial_id = f"trial_{i:05d}_{uuid.uuid4().hex[:6]}"
+            trials[trial_id] = TrialResult(trial_id=trial_id, config=config)
+            stop_events[trial_id] = threading.Event()
+            pending.append((trial_id, config))
+
+        max_concurrent = tc.max_concurrent_trials or len(pending)
+        running: set[str] = set()
+        done: set[str] = set()
+
+        run_trial = ray_tpu.remote(_run_trial_fn)
+
+        def launch_next():
+            while pending and len(running) < max_concurrent:
+                trial_id, config = pending.pop(0)
+                running.add(trial_id)
+                run_trial.options(name=trial_id).remote(
+                    trainable, config, trial_id, results_queue,
+                    stop_events[trial_id])
+
+        launch_next()
+        run_cfg = self.run_config
+        manager = None
+        if run_cfg is not None and getattr(run_cfg, "storage_path", None):
+            from ray_tpu.train.checkpoint import CheckpointManager
+
+            name = run_cfg.name or f"tune_{int(time.time())}"
+            keep = run_cfg.checkpoint_config.num_to_keep
+            manager = CheckpointManager(
+                f"{run_cfg.storage_path}/{name}", num_to_keep=keep,
+                metric=tc.metric, mode=tc.mode)
+        stop_criteria = (run_cfg.stop if run_cfg is not None else None) or {}
+        deadline = (time.monotonic() + tc.time_budget_s
+                    if tc.time_budget_s else None)
+        timed_out = False
+        while len(done) < len(trials):
+            if deadline is not None and time.monotonic() > deadline:
+                timed_out = True
+                break
+            try:
+                msg = results_queue.get(timeout=1.0)
+            except queue.Empty:
+                continue
+            trial = trials[msg["trial_id"]]
+            if msg.get("done"):
+                if msg.get("error") is not None:
+                    trial.error = msg["error"]
+                done.add(trial.trial_id)
+                running.discard(trial.trial_id)
+                launch_next()
+                continue
+            metrics = dict(msg.get("metrics") or {})
+            metrics.setdefault("training_iteration", msg.get("iteration", 0))
+            trial.metrics = metrics
+            trial.history.append(metrics)
+            if msg.get("checkpoint") is not None:
+                trial.checkpoint = msg["checkpoint"]
+            if msg.get("checkpoint") is not None and manager is not None:
+                path = manager.register(msg["checkpoint"], metrics)
+                trial.checkpoint = Checkpoint(path)
+            if scheduler.on_result(trial.trial_id, metrics) == STOP:
+                stop_events[trial.trial_id].set()
+            for key, threshold in stop_criteria.items():
+                if key in metrics and metrics[key] >= threshold:
+                    stop_events[trial.trial_id].set()
+            if msg.get("ack") is not None:
+                msg["ack"].set()
+        if timed_out:
+            budget_error = TimeoutError(
+                f"tune run exceeded time_budget_s={tc.time_budget_s}")
+            for trial_id in set(trials) - done:
+                stop_events[trial_id].set()
+                trials[trial_id].error = budget_error
+            # Unblock any trial waiting on a report ack.
+            try:
+                while True:
+                    msg = results_queue.get_nowait()
+                    if msg.get("ack") is not None:
+                        msg["ack"].set()
+            except queue.Empty:
+                pass
+        return ResultGrid(list(trials.values()), tc.metric, tc.mode)
+
+
+def run(trainable, *, config: dict | None = None, num_samples: int = 1,
+        metric: str = "loss", mode: str = "min", scheduler=None,
+        max_concurrent_trials: int = 0) -> ResultGrid:
+    """Legacy entry point (reference: tune.run, tune.py:277)."""
+    tuner = Tuner(
+        trainable, param_space=config,
+        tune_config=TuneConfig(metric=metric, mode=mode,
+                               num_samples=num_samples, scheduler=scheduler,
+                               max_concurrent_trials=max_concurrent_trials))
+    return tuner.fit()

@@ -1,0 +1,166 @@
+"""Tune tests (reference: python/ray/tune/tests)."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.tune import ASHAScheduler, TuneConfig, Tuner
+
+
+@pytest.fixture
+def fresh_runtime():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_grid_search(fresh_runtime):
+    def objective(config):
+        tune.report({"score": config["x"] ** 2})
+
+    tuner = Tuner(objective,
+                  param_space={"x": tune.grid_search([1, 2, 3, 4])},
+                  tune_config=TuneConfig(metric="score", mode="min"))
+    results = tuner.fit()
+    assert len(results) == 4
+    best = results.get_best_result()
+    assert best.config["x"] == 1
+    assert best.metrics["score"] == 1
+
+
+def test_random_search_num_samples(fresh_runtime):
+    def objective(config):
+        tune.report({"score": config["lr"]})
+
+    tuner = Tuner(objective,
+                  param_space={"lr": tune.loguniform(1e-5, 1e-1)},
+                  tune_config=TuneConfig(metric="score", mode="max",
+                                         num_samples=8, seed=0))
+    results = tuner.fit()
+    assert len(results) == 8
+    for r in results:
+        assert 1e-5 <= r.config["lr"] <= 1e-1
+
+
+def test_function_returning_dict(fresh_runtime):
+    def objective(config):
+        return {"score": config["x"] + 1}
+
+    results = Tuner(objective, param_space={"x": tune.grid_search([0, 5])},
+                    tune_config=TuneConfig(metric="score", mode="max")).fit()
+    assert results.get_best_result().metrics["score"] == 6
+
+
+def test_trial_error_isolated(fresh_runtime):
+    def objective(config):
+        if config["x"] == 2:
+            raise RuntimeError("bad trial")
+        tune.report({"score": config["x"]})
+
+    results = Tuner(objective, param_space={"x": tune.grid_search([1, 2, 3])},
+                    tune_config=TuneConfig(metric="score", mode="max")).fit()
+    assert len(results.errors) == 1
+    assert results.get_best_result().config["x"] == 3
+
+
+def test_class_trainable(fresh_runtime):
+    class MyTrainable(tune.Trainable):
+        def setup(self, config):
+            self.x = config["x"]
+            self.i = 0
+
+        def step(self):
+            self.i += 1
+            return {"score": self.x * self.i, "done": self.i >= 3}
+
+    results = Tuner(MyTrainable, param_space={"x": tune.grid_search([1, 2])},
+                    tune_config=TuneConfig(metric="score", mode="max",
+                                           max_iterations=5)).fit()
+    best = results.get_best_result()
+    assert best.config["x"] == 2
+    assert best.metrics["score"] == 6
+
+
+def test_asha_early_stopping(fresh_runtime):
+    """Bad trials are stopped before completing all iterations."""
+    iterations_run = {}
+
+    def objective(config):
+        for i in range(1, 21):
+            # quality differs by config; ASHA should cut the weak ones.
+            tune.report({"loss": config["q"] + 1.0 / i,
+                         "training_iteration": i})
+            iterations_run[config["q"]] = i
+
+    scheduler = ASHAScheduler(metric="loss", mode="min", grace_period=2,
+                              reduction_factor=2, max_t=20)
+    results = Tuner(
+        objective,
+        param_space={"q": tune.grid_search([0.0, 1.0, 2.0, 3.0, 4.0, 5.0])},
+        tune_config=TuneConfig(metric="loss", mode="min", scheduler=scheduler,
+                               max_concurrent_trials=1),
+    ).fit()
+    assert results.get_best_result().config["q"] == 0.0
+    # The worst configs must have been early-stopped.
+    assert iterations_run[5.0] < 20
+
+
+def test_max_concurrent(fresh_runtime):
+    import threading
+    import time
+
+    lock = threading.Lock()
+    running = [0]
+    peak = [0]
+
+    def objective(config):
+        with lock:
+            running[0] += 1
+            peak[0] = max(peak[0], running[0])
+        time.sleep(0.2)
+        with lock:
+            running[0] -= 1
+        tune.report({"score": 1})
+
+    Tuner(objective, param_space={"x": tune.grid_search(list(range(6)))},
+          tune_config=TuneConfig(metric="score", mode="max",
+                                 max_concurrent_trials=2)).fit()
+    assert peak[0] <= 2
+
+
+def test_tune_run_legacy_api(fresh_runtime):
+    def objective(config):
+        tune.report({"loss": abs(config["x"] - 3)})
+
+    results = tune.run(objective, config={"x": tune.grid_search([1, 3, 5])},
+                       metric="loss", mode="min")
+    assert results.get_best_result().config["x"] == 3
+
+
+def test_tuner_over_trainer(fresh_runtime, tmp_path):
+    """HPO over a JaxTrainer (trainer-in-tune layering)."""
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    def make_objective(storage):
+        def objective(config):
+            def loop(cfg):
+                from ray_tpu import train
+
+                train.report({"loss": cfg["lr"] * 10})
+
+            trainer = JaxTrainer(
+                loop, train_loop_config={"lr": config["lr"]},
+                scaling_config=ScalingConfig(num_workers=1),
+                run_config=RunConfig(storage_path=storage))
+            result = trainer.fit()
+            tune.report(result.metrics)
+
+        return objective
+
+    results = Tuner(
+        make_objective(str(tmp_path)),
+        param_space={"lr": tune.grid_search([0.1, 0.01])},
+        tune_config=TuneConfig(metric="loss", mode="min"),
+    ).fit()
+    assert results.get_best_result().config["lr"] == 0.01
