@@ -50,17 +50,22 @@ class Checkpoint:
         os.makedirs(target, exist_ok=True)
         try:
             import orbax.checkpoint as ocp
-
+        except ImportError:
+            ocp = None
+        if ocp is not None:
+            # Real save failures (disk full, bad pytree leaf) must surface,
+            # not silently change the on-disk format — only an unavailable
+            # orbax triggers the pickle fallback.
             ckptr = ocp.StandardCheckpointer()
             ckptr.save(os.path.join(target, "state"), state, force=True)
             ckptr.wait_until_finished()
             meta = {"format": "orbax"}
-        except Exception:
+        else:
             import jax
+            import numpy as np
 
             host_state = jax.tree.map(
-                lambda x: __import__("numpy").asarray(x)
-                if hasattr(x, "dtype") else x, state)
+                lambda x: np.asarray(x) if hasattr(x, "dtype") else x, state)
             with open(os.path.join(target, "state.pkl"), "wb") as f:
                 pickle.dump(host_state, f)
             meta = {"format": "pickle"}
@@ -93,34 +98,39 @@ class CheckpointManager:
         self.num_to_keep = num_to_keep
         self.metric = metric
         self.mode = mode
-        self._entries: list[tuple[float, str, dict]] = []
+        # (score, seq, path, metrics); seq is a monotonic counter so names
+        # never collide (timestamps alone can repeat within a millisecond)
+        # and "latest" is insertion order, not lexicographic path order.
+        self._entries: list[tuple[float, int, str, dict]] = []
+        self._seq = 0
         os.makedirs(storage_path, exist_ok=True)
 
     def register(self, checkpoint: Checkpoint, metrics: dict) -> str:
         """Move a checkpoint into managed storage; evict beyond top-K."""
-        name = f"checkpoint_{int(time.time() * 1000):x}_{len(self._entries)}"
+        seq = self._seq
+        self._seq += 1
+        name = f"checkpoint_{int(time.time() * 1000):x}_{seq:08d}"
         dest = os.path.join(self.storage_path, name)
         if os.path.abspath(checkpoint.path) != os.path.abspath(dest):
             shutil.move(checkpoint.path, dest)
-        score = metrics.get(self.metric, 0.0) if self.metric else float(
-            len(self._entries))
+        score = metrics.get(self.metric, 0.0) if self.metric else float(seq)
         if self.mode == "min":
             score = -score
-        self._entries.append((score, dest, dict(metrics)))
-        self._entries.sort(key=lambda e: e[0], reverse=True)
+        self._entries.append((score, seq, dest, dict(metrics)))
+        self._entries.sort(key=lambda e: (e[0], e[1]), reverse=True)
         if self.num_to_keep is not None:
             while len(self._entries) > self.num_to_keep:
-                _, evict_path, _ = self._entries.pop()
+                _, _, evict_path, _ = self._entries.pop()
                 shutil.rmtree(evict_path, ignore_errors=True)
         return dest
 
     def best_checkpoint(self) -> Checkpoint | None:
         if not self._entries:
             return None
-        return Checkpoint(self._entries[0][1])
+        return Checkpoint(self._entries[0][2])
 
     def latest_checkpoint(self) -> Checkpoint | None:
         if not self._entries:
             return None
         latest = max(self._entries, key=lambda e: e[1])
-        return Checkpoint(latest[1])
+        return Checkpoint(latest[2])

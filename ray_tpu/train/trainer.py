@@ -194,20 +194,37 @@ class JaxTrainer(DataParallelTrainer):
 
     The backend hook's job in the reference is dist.init_process_group
     (torch/config.py:47-91); the JAX analogue is jax.distributed.initialize
-    on multi-host — a no-op in the single-process slice. Workers then use
-    session.get_mesh() and the parallel.train_step utilities.
+    on multi-host. Pass ``jax_distributed_config`` (kwargs for
+    ``jax.distributed.initialize``: coordinator_address, num_processes,
+    process_id) to form the multi-host world on every worker; omit it for
+    the single-process slice. Workers then use session.get_mesh() and the
+    parallel.train_step utilities.
     """
 
-    def __init__(self, train_loop_per_worker: Callable, **kwargs):
-        super().__init__(self._jax_backend_wrap(train_loop_per_worker), **kwargs)
+    def __init__(self, train_loop_per_worker: Callable,
+                 jax_distributed_config: dict | None = None, **kwargs):
+        self.jax_distributed_config = jax_distributed_config
+        super().__init__(
+            self._jax_backend_wrap(train_loop_per_worker,
+                                   jax_distributed_config), **kwargs)
 
     @staticmethod
-    def _jax_backend_wrap(loop: Callable) -> Callable:
+    def _jax_backend_wrap(loop: Callable,
+                          dist_config: dict | None) -> Callable:
         def wrapped(config):
+            import os
+
             import jax
 
-            if jax.process_count() > 1:
-                pass  # already initialized by the launcher
+            if dist_config is not None:
+                jax.distributed.initialize(**dist_config)
+            elif os.environ.get("JAX_COORDINATOR_ADDRESS"):
+                # Multi-host launch configured via env (the analogue of
+                # torchrun env:// rendezvous); idempotent per process.
+                try:
+                    jax.distributed.initialize()
+                except RuntimeError:
+                    pass  # already initialized by the launcher
             return loop(config)
 
         return wrapped

@@ -114,7 +114,7 @@ def _run_trial_fn(trainable: Callable, config: dict, trial_id: str,
 
     state = _SessionState(
         context=TrainContext(trial_name=trial_id),
-        results_queue=_TaggedQueue(results_queue, trial_id),
+        results_queue=_TaggedQueue(results_queue, trial_id, stop_event),
         stop_event=stop_event,
     )
 
@@ -141,9 +141,10 @@ class _TaggedQueue:
     report rather than racing the trial loop.
     """
 
-    def __init__(self, inner, trial_id: str):
+    def __init__(self, inner, trial_id: str, stop_event=None):
         self._inner = inner
         self._trial_id = trial_id
+        self._stop_event = stop_event
 
     def put(self, msg: dict):
         ack = threading.Event()
@@ -156,7 +157,14 @@ class _TaggedQueue:
             "error": msg.get("error"),
             "ack": ack,
         })
-        ack.wait(timeout=60.0)
+        # Wake promptly on stop: after time_budget_s expiry the controller
+        # stops reading the queue, so a report racing the final drain would
+        # otherwise block here for the full timeout.
+        deadline = time.monotonic() + 60.0
+        while not ack.is_set() and time.monotonic() < deadline:
+            if self._stop_event is not None and self._stop_event.is_set():
+                break
+            ack.wait(timeout=0.1)
 
 
 def _class_trainable_loop(cls: type, max_iterations: int) -> Callable:

@@ -18,6 +18,21 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["RAY_TPU_SKIP_TPU_DETECTION"] = "1"
 
+# The sandbox sitecustomize may have already initialized JAX on a real
+# accelerator platform before this conftest ran. Force a clean re-init on
+# the virtual 8-device CPU platform.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+if jax.devices()[0].platform != "cpu" or len(jax.devices()) < 8:
+    try:
+        import jax.extend.backend as _jeb
+
+        _jeb.clear_backends()
+    except Exception:
+        jax.clear_backends()
+assert jax.devices()[0].platform == "cpu" and len(jax.devices()) >= 8
+
 import pytest
 
 

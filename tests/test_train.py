@@ -144,6 +144,31 @@ def test_checkpoint_top_k(tmp_path):
     assert best.to_dict()["score"] == 5.0
 
 
+def test_checkpoint_rapid_register_no_collision(tmp_path):
+    # Regression: same-millisecond registrations used to reuse names after
+    # eviction, nesting one checkpoint dir inside another and destroying it.
+    from ray_tpu.train import CheckpointManager
+
+    manager = CheckpointManager(str(tmp_path / "ckpts"), num_to_keep=2,
+                                metric="score")
+    for score in (1.0, 5.0, 3.0, 4.0):
+        manager.register(Checkpoint.from_dict({"score": score}),
+                         {"score": score})
+    assert manager.latest_checkpoint().to_dict()["score"] == 4.0
+    assert manager.best_checkpoint().to_dict()["score"] == 5.0
+
+
+def test_checkpoint_latest_is_insertion_order(tmp_path):
+    # Regression: "latest" was lexicographic on path, which mis-ordered
+    # index 9 vs 10 within one millisecond.
+    from ray_tpu.train import CheckpointManager
+
+    manager = CheckpointManager(str(tmp_path / "ckpts"))
+    for i in range(12):
+        manager.register(Checkpoint.from_dict({"step": i}), {"step": i})
+    assert manager.latest_checkpoint().to_dict()["step"] == 11
+
+
 def test_scaling_config_resources():
     sc = ScalingConfig(num_workers=2, use_tpu=True, chips_per_worker=4)
     assert sc.worker_resources() == {"TPU": 4.0, "CPU": 1.0}
