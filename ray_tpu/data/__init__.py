@@ -1,0 +1,40 @@
+"""ray_tpu.data — lazy, streaming datasets over the task runtime.
+
+Reference: python/ray/data/ (Dataset at dataset.py:142, read_api.py,
+streaming executor at _internal/execution/streaming_executor.py:55).
+"""
+
+from ray_tpu.data.block import Block, BlockAccessor
+from ray_tpu.data.dataset import Dataset
+from ray_tpu.data.grouped import GroupedData
+from ray_tpu.data.read_api import (
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,  # noqa: A004 — mirrors ray.data.range
+    read_binary_files,
+    read_csv,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)
+
+__all__ = [
+    "Block",
+    "BlockAccessor",
+    "Dataset",
+    "GroupedData",
+    "from_arrow",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "read_binary_files",
+    "read_csv",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+    "read_text",
+]

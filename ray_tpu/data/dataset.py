@@ -1,0 +1,459 @@
+"""Dataset: the lazy, streaming dataset API.
+
+Reference: python/ray/data/dataset.py:142 (Dataset). Transforms append
+logical ops; nothing executes until consumption (iter_batches / take /
+materialize / write_*). Execution streams block tasks through the
+ray_tpu runtime (executor.py) with operator fusion and bounded in-flight
+work.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator
+
+import numpy as np
+import pyarrow as pa
+
+import ray_tpu
+from ray_tpu.data.block import (
+    Block,
+    BlockAccessor,
+    concat_blocks,
+    split_block,
+)
+from ray_tpu.data.executor import (
+    ExecutionContext,
+    default_reduce,
+    iter_block_refs,
+    run_exchange,
+)
+from ray_tpu.data.plan import (
+    AllToAll,
+    InputData,
+    Limit,
+    LogicalOp,
+    MapBlocks,
+)
+
+
+class Dataset:
+    """A lazy distributed dataset of Arrow blocks."""
+
+    def __init__(self, ops: list[LogicalOp], name: str = "dataset"):
+        self._ops = ops
+        self._name = name
+
+    # ------------------------------------------------------------ transforms
+
+    def _with(self, op: LogicalOp, name: str) -> "Dataset":
+        return Dataset(self._ops + [op], name=name)
+
+    def map(self, fn: Callable[[dict], dict]) -> "Dataset":
+        """Row transform (reference: dataset.map)."""
+
+        def map_block(block: Block) -> Block:
+            rows = [fn(row) for row in BlockAccessor(block).iter_rows()]
+            return BlockAccessor.rows_to_block(rows)
+
+        return self._with(MapBlocks(map_block, name="Map"), "map")
+
+    def map_batches(self, fn: Callable, *, batch_size: int | None = None,
+                    batch_format: str = "numpy",
+                    fn_kwargs: dict | None = None) -> "Dataset":
+        """Batch transform (reference: dataset.map_batches) — the TPU-hot
+        path: numpy batches in, numpy batches out, vectorized."""
+        fn_kwargs = fn_kwargs or {}
+
+        def map_block(block: Block) -> Block:
+            acc = BlockAccessor(block)
+            out_blocks = []
+            n = acc.num_rows()
+            step = batch_size or max(n, 1)
+            for start in range(0, max(n, 1), step):
+                sub = BlockAccessor(acc.slice(start, min(start + step, n)))
+                result = fn(sub.to_batch(batch_format), **fn_kwargs)
+                out_blocks.append(BlockAccessor.batch_to_block(result))
+            return concat_blocks(out_blocks) if out_blocks else block
+
+        return self._with(MapBlocks(map_block, name="MapBatches"),
+                          "map_batches")
+
+    def flat_map(self, fn: Callable[[dict], Iterable[dict]]) -> "Dataset":
+        def map_block(block: Block) -> Block:
+            rows: list[dict] = []
+            for row in BlockAccessor(block).iter_rows():
+                rows.extend(fn(row))
+            return BlockAccessor.rows_to_block(rows)
+
+        return self._with(MapBlocks(map_block, name="FlatMap"), "flat_map")
+
+    def filter(self, fn: Callable[[dict], bool]) -> "Dataset":
+        def map_block(block: Block) -> Block:
+            mask = [fn(row) for row in BlockAccessor(block).iter_rows()]
+            return block.filter(pa.array(mask, type=pa.bool_()))
+
+        return self._with(MapBlocks(map_block, name="Filter"), "filter")
+
+    def add_column(self, name: str, fn: Callable[[dict], Any]) -> "Dataset":
+        def map_block(block: Block) -> Block:
+            values = [fn(row) for row in BlockAccessor(block).iter_rows()]
+            return block.append_column(name, pa.array(values))
+
+        return self._with(MapBlocks(map_block, name="AddColumn"), "add_column")
+
+    def drop_columns(self, cols: list[str]) -> "Dataset":
+        return self._with(
+            MapBlocks(lambda b: b.drop_columns(cols), name="DropColumns"),
+            "drop_columns")
+
+    def select_columns(self, cols: list[str]) -> "Dataset":
+        return self._with(
+            MapBlocks(lambda b: b.select(cols), name="SelectColumns"),
+            "select_columns")
+
+    def rename_columns(self, mapping: dict[str, str]) -> "Dataset":
+        def map_block(block: Block) -> Block:
+            return block.rename_columns(
+                [mapping.get(c, c) for c in block.column_names])
+
+        return self._with(MapBlocks(map_block, name="Rename"), "rename")
+
+    def limit(self, n: int) -> "Dataset":
+        return self._with(Limit(limit=n), f"limit({n})")
+
+    # ----------------------------------------------------------- all-to-all
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        """Reference: dataset.repartition (exchange-based)."""
+
+        def do(block_refs: list, ctx) -> list:
+            return run_exchange(
+                block_refs,
+                partition_fn=lambda b, n, _i: split_block(b, n),
+                reduce_fn=default_reduce,
+                num_partitions=num_blocks)
+
+        return self._with(AllToAll(do, name="Repartition"), "repartition")
+
+    def random_shuffle(self, *, seed: int | None = None,
+                       num_blocks: int | None = None) -> "Dataset":
+        """Reference: dataset.random_shuffle → push-based shuffle exchange."""
+
+        def do(block_refs: list, ctx) -> list:
+            nparts = num_blocks or max(1, len(block_refs))
+            # Unseeded shuffles draw fresh OS entropy per execution so each
+            # epoch reshuffles; seeded shuffles are deterministic.
+            rng_seed = (seed if seed is not None
+                        else np.random.SeedSequence().entropy % (2 ** 31))
+
+            def partition(block: Block, n: int, idx: int) -> list[Block]:
+                rng = np.random.default_rng((rng_seed, idx))
+                perm = rng.permutation(block.num_rows)
+                shuffled = BlockAccessor(block).take_rows(perm)
+                return split_block(shuffled, n)
+
+            def reduce(parts: list[Block]) -> Block:
+                merged = concat_blocks(parts)
+                rng = np.random.default_rng((rng_seed, merged.num_rows, 1))
+                return BlockAccessor(merged).take_rows(
+                    rng.permutation(merged.num_rows))
+
+            return run_exchange(block_refs, partition, reduce, nparts)
+
+        return self._with(AllToAll(do, name="RandomShuffle"),
+                          "random_shuffle")
+
+    def sort(self, key: str, descending: bool = False) -> "Dataset":
+        """Sample-partition-merge sort (reference: planner/exchange/
+        sort_task_spec.py)."""
+
+        def do(block_refs: list, ctx) -> list:
+            nparts = max(1, len(block_refs))
+            if not block_refs:
+                return []
+            # Sample boundaries from the first block.
+            sample = ray_tpu.get(block_refs[0])
+            col = BlockAccessor(sample).to_numpy().get(key)
+            if col is None or len(col) == 0:
+                boundaries = np.array([])
+            else:
+                qs = np.linspace(0, 100, nparts + 1)[1:-1]
+                boundaries = np.percentile(col, qs) if len(qs) else np.array([])
+
+            def partition(block: Block, n: int, _bi: int) -> list[Block]:
+                vals = BlockAccessor(block).to_numpy()[key]
+                idx = np.searchsorted(boundaries, vals) if len(boundaries) \
+                    else np.zeros(len(vals), dtype=int)
+                return [BlockAccessor(block).take_rows(
+                    np.nonzero(idx == i)[0]) for i in range(n)]
+
+            def reduce(parts: list[Block]) -> Block:
+                merged = concat_blocks(parts)
+                vals = BlockAccessor(merged).to_numpy()[key]
+                order = np.argsort(vals, kind="stable")
+                if descending:
+                    order = order[::-1]
+                return BlockAccessor(merged).take_rows(order)
+
+            parts = run_exchange(block_refs, partition, reduce, nparts)
+            return parts if not descending else list(reversed(parts))
+
+        return self._with(AllToAll(do, name="Sort"), f"sort({key})")
+
+    def groupby(self, key: str) -> "GroupedData":
+        from ray_tpu.data.grouped import GroupedData
+
+        return GroupedData(self, key)
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        def do(block_refs: list, ctx) -> list:
+            out = list(block_refs)
+            for other in others:
+                out.extend(other._block_refs())
+            return out
+
+        return self._with(AllToAll(do, name="Union"), "union")
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        def do(block_refs: list, ctx) -> list:
+            left = concat_blocks([ray_tpu.get(r) for r in block_refs])
+            right = concat_blocks([ray_tpu.get(r) for r in other._block_refs()])
+            if left.num_rows != right.num_rows:
+                raise ValueError(
+                    f"zip requires equal row counts: {left.num_rows} vs "
+                    f"{right.num_rows}")
+            for name in right.column_names:
+                out_name = name if name not in left.column_names else name + "_1"
+                left = left.append_column(out_name, right.column(name))
+            return [ray_tpu.put(left)]
+
+        return self._with(AllToAll(do, name="Zip"), "zip")
+
+    def random_sample(self, fraction: float, *, seed: int | None = None) -> "Dataset":
+        def map_block(block: Block) -> Block:
+            rng = np.random.default_rng(seed)
+            mask = rng.random(block.num_rows) < fraction
+            return block.filter(pa.array(mask))
+
+        return self._with(MapBlocks(map_block, name="RandomSample"),
+                          "random_sample")
+
+    # ----------------------------------------------------------- consumption
+
+    def _block_ref_iter(self) -> Iterator[Any]:
+        return iter_block_refs(self._ops)
+
+    def _block_refs(self) -> list[Any]:
+        return list(self._block_ref_iter())
+
+    def materialize(self) -> "Dataset":
+        """Execute now; result holds block refs (reference:
+        dataset.materialize → MaterializedDataset)."""
+        refs = self._block_refs()
+        return Dataset([InputData(block_refs=refs)],
+                       name=f"{self._name}(materialized)")
+
+    def count(self) -> int:
+        return sum(ray_tpu.get(r).num_rows for r in self._block_ref_iter())
+
+    def schema(self) -> pa.Schema | None:
+        for ref in self._block_ref_iter():
+            return ray_tpu.get(ref).schema
+        return None
+
+    def columns(self) -> list[str]:
+        s = self.schema()
+        return list(s.names) if s is not None else []
+
+    def num_blocks(self) -> int:
+        return len(self._block_refs())
+
+    def size_bytes(self) -> int:
+        return sum(ray_tpu.get(r).nbytes for r in self._block_ref_iter())
+
+    def take(self, limit: int = 20) -> list[dict]:
+        rows: list[dict] = []
+        for ref in self._block_ref_iter():
+            for row in BlockAccessor(ray_tpu.get(ref)).iter_rows():
+                rows.append(row)
+                if len(rows) >= limit:
+                    return rows
+        return rows
+
+    def take_all(self) -> list[dict]:
+        rows: list[dict] = []
+        for ref in self._block_ref_iter():
+            rows.extend(BlockAccessor(ray_tpu.get(ref)).iter_rows())
+        return rows
+
+    def take_batch(self, batch_size: int = 20,
+                   batch_format: str = "numpy"):
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format=batch_format):
+            return batch
+        return {}
+
+    def show(self, limit: int = 20) -> None:
+        for row in self.take(limit):
+            print(row)
+
+    def iter_rows(self) -> Iterator[dict]:
+        for ref in self._block_ref_iter():
+            yield from BlockAccessor(ray_tpu.get(ref)).iter_rows()
+
+    def iter_batches(self, *, batch_size: int | None = 256,
+                     batch_format: str = "numpy", drop_last: bool = False,
+                     prefetch_batches: int = 1) -> Iterator[Any]:
+        from ray_tpu.data.iterator import iter_batches_over_refs
+
+        return iter_batches_over_refs(
+            self._block_ref_iter(), batch_size=batch_size,
+            batch_format=batch_format, drop_last=drop_last,
+            prefetch_batches=prefetch_batches)
+
+    def iter_jax_batches(self, *, batch_size: int = 256,
+                         drop_last: bool = True, sharding=None,
+                         dtypes: dict | None = None) -> Iterator[dict]:
+        """Device-fed batches with double buffering (TPU-native analogue of
+        iter_torch_batches; see iterator.py)."""
+        from ray_tpu.data.iterator import iter_jax_batches_over_refs
+
+        return iter_jax_batches_over_refs(
+            self._block_ref_iter(), batch_size=batch_size,
+            drop_last=drop_last, sharding=sharding, dtypes=dtypes)
+
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           drop_last: bool = False) -> Iterator[dict]:
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       drop_last=drop_last):
+            yield {k: torch.as_tensor(v) for k, v in batch.items()}
+
+    # ------------------------------------------------------------- reshaping
+
+    def split(self, n: int, *, equal: bool = False) -> list["Dataset"]:
+        """Split into n datasets by block (reference: dataset.split)."""
+        refs = self._block_refs()
+        if equal or len(refs) < n:
+            block = concat_blocks([ray_tpu.get(r) for r in refs])
+            parts = split_block(block, n)
+            return [Dataset([InputData(block_refs=[ray_tpu.put(p)])],
+                            name=f"{self._name}.split[{i}]")
+                    for i, p in enumerate(parts)]
+        out: list[list] = [[] for _ in range(n)]
+        for i, ref in enumerate(refs):
+            out[i % n].append(ref)
+        return [Dataset([InputData(block_refs=part)],
+                        name=f"{self._name}.split[{i}]")
+                for i, part in enumerate(out)]
+
+    def shard(self, num_shards: int, index: int) -> "Dataset":
+        """Deterministic shard for per-worker ingestion (reference:
+        dataset.split + train data_config)."""
+        return self.split(num_shards)[index]
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False,
+                         seed: int | None = None):
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        rows = ds.take_all()
+        cut = int(len(rows) * (1 - test_size))
+        from ray_tpu.data.read_api import from_items
+
+        return from_items(rows[:cut]), from_items(rows[cut:])
+
+    # ---------------------------------------------------------------- output
+
+    def write_parquet(self, path: str) -> None:
+        import pyarrow.parquet as pq
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._block_ref_iter()):
+            pq.write_table(ray_tpu.get(ref), f"{path}/part-{i:05d}.parquet")
+
+    def write_csv(self, path: str) -> None:
+        from pyarrow import csv as pacsv
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._block_ref_iter()):
+            pacsv.write_csv(ray_tpu.get(ref), f"{path}/part-{i:05d}.csv")
+
+    def write_json(self, path: str) -> None:
+        import json
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._block_ref_iter()):
+            rows = BlockAccessor(ray_tpu.get(ref)).iter_rows()
+            with open(f"{path}/part-{i:05d}.json", "w") as f:
+                for row in rows:
+                    f.write(json.dumps(_json_safe(row)) + "\n")
+
+    def to_pandas(self):
+        return concat_blocks(
+            [ray_tpu.get(r) for r in self._block_ref_iter()]).to_pandas()
+
+    def to_arrow(self) -> pa.Table:
+        return concat_blocks([ray_tpu.get(r) for r in self._block_ref_iter()])
+
+    # ----------------------------------------------------------------- stats
+
+    def stats(self) -> str:
+        return (f"Dataset(name={self._name!r}, "
+                f"stages={[op.name for op in self._ops]})")
+
+    def __repr__(self):
+        return f"Dataset({self._name})"
+
+    # ------------------------------------------------------------ aggregates
+
+    def sum(self, on: str) -> float:
+        return self._agg_column(on, np.sum)
+
+    def min(self, on: str) -> float:
+        return self._agg_column(on, np.min)
+
+    def max(self, on: str) -> float:
+        return self._agg_column(on, np.max)
+
+    def mean(self, on: str) -> float:
+        total, count = 0.0, 0
+        for ref in self._block_ref_iter():
+            col = BlockAccessor(ray_tpu.get(ref)).to_numpy()[on]
+            total += float(np.sum(col))
+            count += len(col)
+        return total / max(count, 1)
+
+    def std(self, on: str) -> float:
+        vals = np.concatenate([
+            BlockAccessor(ray_tpu.get(r)).to_numpy()[on]
+            for r in self._block_ref_iter()])
+        return float(np.std(vals, ddof=1))
+
+    def unique(self, on: str) -> list:
+        seen: set = set()
+        for ref in self._block_ref_iter():
+            seen.update(BlockAccessor(ray_tpu.get(ref)).to_numpy()[on].tolist())
+        return sorted(seen)
+
+    def _agg_column(self, on: str, fn) -> float:
+        partials = [
+            fn(BlockAccessor(ray_tpu.get(r)).to_numpy()[on])
+            for r in self._block_ref_iter()]
+        return float(fn(np.asarray(partials)))
+
+
+def _json_safe(row: dict) -> dict:
+    out = {}
+    for k, v in row.items():
+        if isinstance(v, (np.integer,)):
+            out[k] = int(v)
+        elif isinstance(v, (np.floating,)):
+            out[k] = float(v)
+        elif isinstance(v, np.ndarray):
+            out[k] = v.tolist()
+        else:
+            out[k] = v
+    return out

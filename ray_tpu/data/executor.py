@@ -1,0 +1,179 @@
+"""Streaming execution of a data plan over the ray_tpu task runtime.
+
+Reference: python/ray/data/_internal/execution/streaming_executor.py:55 —
+the reference runs operators as a streaming topology with bounded
+in-flight work (backpressure_policy/). This executor keeps the same two
+properties with much less machinery:
+
+- **streaming**: block refs are yielded as tasks finish; a consumer
+  iterating batches overlaps with upstream reads/maps still running.
+- **bounded in-flight window**: at most ``max_in_flight`` block tasks are
+  outstanding per stage, so a huge dataset never floods the scheduler or
+  the object store (the backpressure role of resource_manager.py).
+
+All-to-all ops (shuffle/sort/repartition/groupby) are barriers executed
+via a split/merge exchange (reference: _internal/planner/exchange/).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Iterator
+
+import ray_tpu
+from ray_tpu.data.block import Block, concat_blocks
+from ray_tpu.data.plan import (
+    AllToAll,
+    InputData,
+    Limit,
+    LogicalOp,
+    MapBlocks,
+    ReadTask,
+    fuse_stages,
+)
+
+
+class ExecutionContext:
+    """Knobs shared by stages; carried into AllToAll fns."""
+
+    def __init__(self, max_in_flight: int = 16):
+        self.max_in_flight = max_in_flight
+
+
+@ray_tpu.remote
+def _run_read(read_fn) -> Block:
+    return read_fn()
+
+
+@ray_tpu.remote
+def _run_chain(block: Block, fn) -> Block:
+    return fn(block)
+
+
+@ray_tpu.remote
+def _run_read_chain(read_fn, fn) -> Block:
+    return fn(read_fn())
+
+
+def iter_block_refs(ops: list[LogicalOp],
+                    ctx: ExecutionContext | None = None) -> Iterator[Any]:
+    """Stream block refs through the fused plan, preserving block order."""
+    ctx = ctx or ExecutionContext()
+    ops = fuse_stages(ops)
+    assert ops and isinstance(ops[0], InputData), "plan must start with Input"
+    source: InputData = ops[0]
+    stages = ops[1:]
+
+    # A leading MapBlocks fuses into the read task itself (read fusion).
+    read_fused = None
+    if stages and isinstance(stages[0], MapBlocks) and source.read_tasks:
+        read_fused = stages[0].fn
+        stages = stages[1:]
+
+    def input_stream() -> Iterator[Any]:
+        if source.read_tasks is not None:
+            in_flight: collections.deque = collections.deque()
+            for task in source.read_tasks:
+                if read_fused is not None:
+                    ref = _run_read_chain.remote(task.fn, read_fused)
+                else:
+                    ref = _run_read.remote(task.fn)
+                in_flight.append(ref)
+                if len(in_flight) >= ctx.max_in_flight:
+                    yield in_flight.popleft()
+            while in_flight:
+                yield in_flight.popleft()
+        else:
+            yield from (source.block_refs or [])
+
+    stream: Iterator[Any] = input_stream()
+    for op in stages:
+        if isinstance(op, MapBlocks):
+            stream = _map_stage(stream, op, ctx)
+        elif isinstance(op, AllToAll):
+            stream = iter(op.fn(list(stream), ctx))
+        elif isinstance(op, Limit):
+            stream = _limit_stage(stream, op.limit)
+        else:
+            raise TypeError(f"Unknown op {op!r}")
+    return stream
+
+
+def _map_stage(upstream: Iterator[Any], op: MapBlocks,
+               ctx: ExecutionContext) -> Iterator[Any]:
+    in_flight: collections.deque = collections.deque()
+    for ref in upstream:
+        in_flight.append(_run_chain.remote(ref, op.fn))
+        if len(in_flight) >= ctx.max_in_flight:
+            yield in_flight.popleft()
+    while in_flight:
+        yield in_flight.popleft()
+
+
+def _limit_stage(upstream: Iterator[Any], limit: int) -> Iterator[Any]:
+    remaining = limit
+    for ref in upstream:
+        if remaining <= 0:
+            return
+        block: Block = ray_tpu.get(ref)
+        if block.num_rows <= remaining:
+            remaining -= block.num_rows
+            yield ref
+        else:
+            yield ray_tpu.put(block.slice(0, remaining))
+            remaining = 0
+            return
+
+
+def materialize_refs(ops: list[LogicalOp],
+                     ctx: ExecutionContext | None = None) -> list[Any]:
+    return list(iter_block_refs(ops, ctx))
+
+
+# ------------------------------------------------------------------ exchange
+
+
+@ray_tpu.remote
+def _partition_block(block: Block, partition_fn, num_partitions: int,
+                     block_index: int):
+    """Map side of an exchange: split one block into N partition blocks."""
+    parts = partition_fn(block, num_partitions, block_index)
+    assert len(parts) == num_partitions
+    return tuple(parts) if num_partitions > 1 else parts[0]
+
+
+@ray_tpu.remote
+def _merge_partition(reduce_fn, *parts: Block) -> Block:
+    return reduce_fn(list(parts))
+
+
+def run_exchange(block_refs: list[Any], partition_fn, reduce_fn,
+                 num_partitions: int) -> list[Any]:
+    """Split/merge exchange (reference: planner/exchange/
+    shuffle_task_scheduler.py): every input block is partitioned, then
+    partition i across all inputs is merged by one reduce task.
+
+    ``partition_fn(block, num_partitions, block_index)`` — the index lets
+    per-block randomness differ even for identically-sized blocks.
+    """
+    if not block_refs:
+        return []
+    split_refs = [
+        _partition_block.options(num_returns=num_partitions).remote(
+            ref, partition_fn, num_partitions, idx)
+        for idx, ref in enumerate(block_refs)
+    ]
+    if num_partitions == 1:
+        split_cols = [[r] if not isinstance(r, list) else r
+                      for r in split_refs]
+        return [_merge_partition.remote(reduce_fn,
+                                        *[c[0] for c in split_cols])]
+    out = []
+    for i in range(num_partitions):
+        parts_i = [splits[i] for splits in split_refs]
+        out.append(_merge_partition.remote(reduce_fn, *parts_i))
+    return out
+
+
+def default_reduce(parts: list[Block]) -> Block:
+    return concat_blocks(parts)

@@ -1,0 +1,99 @@
+"""Batch iteration, including the TPU device-feed path.
+
+Reference: python/ray/data/iterator.py (iter_batches / iter_torch_batches).
+The TPU-native analogue is ``iter_jax_batches``: host batches are staged
+to device with ``jax.device_put`` **one batch ahead** (double buffering),
+so host→HBM transfer of batch N+1 overlaps the step computing batch N —
+the role the reference delegates to torch DataLoader pin_memory/prefetch.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Iterator
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data.block import BlockAccessor, concat_blocks
+
+
+def iter_batches_over_refs(ref_iter: Iterator[Any], *,
+                           batch_size: int | None, batch_format: str,
+                           drop_last: bool,
+                           prefetch_batches: int = 1) -> Iterator[Any]:
+    """Slice a stream of block refs into fixed-size batches, carrying
+    remainders across block boundaries."""
+    carry = None
+    # Resolve a window of refs ahead so upstream tasks overlap consumption.
+    window: collections.deque = collections.deque()
+
+    def fill(it):
+        while len(window) < 1 + max(0, prefetch_batches):
+            try:
+                window.append(next(it))
+            except StopIteration:
+                return False
+        return True
+
+    it = iter(ref_iter)
+    while True:
+        fill(it)
+        if not window:
+            break
+        block = ray_tpu.get(window.popleft())
+        if block.num_rows == 0:
+            continue
+        if carry is not None:
+            block = concat_blocks([carry, block])
+            carry = None
+        if batch_size is None:
+            yield BlockAccessor(block).to_batch(batch_format)
+            continue
+        n = block.num_rows
+        start = 0
+        while n - start >= batch_size:
+            yield BlockAccessor(
+                block.slice(start, batch_size)).to_batch(batch_format)
+            start += batch_size
+        if start < n:
+            carry = block.slice(start, n - start)
+    if carry is not None and carry.num_rows and not drop_last:
+        yield BlockAccessor(carry).to_batch(batch_format)
+
+
+def iter_jax_batches_over_refs(ref_iter: Iterator[Any], *, batch_size: int,
+                               drop_last: bool, sharding=None,
+                               dtypes: dict | None = None) -> Iterator[dict]:
+    """Double-buffered device feed.
+
+    Each yielded batch is a dict of ``jax.Array``s already on device (and
+    sharded per ``sharding`` — e.g. batch-dim sharding over a dp mesh
+    axis). The *next* batch's transfer is issued before the current one
+    is yielded; jax transfers are async, so the copy rides alongside the
+    consumer's compute.
+    """
+    import jax
+
+    def to_device(host_batch: dict) -> dict:
+        out = {}
+        for k, v in host_batch.items():
+            arr = np.asarray(v)
+            if dtypes and k in dtypes:
+                arr = arr.astype(dtypes[k])
+            out[k] = (jax.device_put(arr, sharding) if sharding is not None
+                      else jax.device_put(arr))
+        return out
+
+    host_iter = iter_batches_over_refs(
+        ref_iter, batch_size=batch_size, batch_format="numpy",
+        drop_last=drop_last, prefetch_batches=2)
+
+    staged = None
+    for host_batch in host_iter:
+        nxt = to_device(host_batch)  # async transfer starts now
+        if staged is not None:
+            yield staged
+        staged = nxt
+    if staged is not None:
+        yield staged

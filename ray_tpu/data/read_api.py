@@ -1,0 +1,154 @@
+"""Creation/read APIs for ray_tpu.data.
+
+Reference: python/ray/data/read_api.py + datasource/ connectors. Each
+reader emits ``ReadTask``s (deferred, one block each) so reads execute
+lazily inside the streaming plan, in parallel, with backpressure.
+"""
+
+from __future__ import annotations
+
+import glob as glob_mod
+import os
+from typing import Any, Callable, Iterable
+
+import numpy as np
+import pyarrow as pa
+
+from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data.plan import InputData, ReadTask
+
+
+def _dataset(input_data, name: str):
+    from ray_tpu.data.dataset import Dataset
+
+    return Dataset([input_data], name=name)
+
+
+def range(n: int, *, override_num_blocks: int | None = None):  # noqa: A001
+    """Dataset of {"id": 0..n-1} (reference: read_api.range)."""
+    import builtins
+
+    num_blocks = override_num_blocks or min(n, 200) or 1
+    bounds = np.linspace(0, n, num_blocks + 1).astype(int)
+    tasks = []
+    for i in builtins.range(num_blocks):
+        lo, hi = int(bounds[i]), int(bounds[i + 1])
+
+        def read(lo=lo, hi=hi) -> pa.Table:
+            return pa.table({"id": np.arange(lo, hi, dtype=np.int64)})
+
+        tasks.append(ReadTask(read, {"num_rows": hi - lo}))
+    return _dataset(InputData(read_tasks=tasks), f"range({n})")
+
+
+def from_items(items: list, *, override_num_blocks: int | None = None):
+    """Dataset from a list of dicts or scalars (reference:
+    read_api.from_items)."""
+    items = list(items)
+    num_blocks = max(1, min(override_num_blocks or min(len(items), 200), max(len(items), 1)))
+    bounds = np.linspace(0, len(items), num_blocks + 1).astype(int)
+    tasks = []
+    import builtins
+
+    for i in builtins.range(num_blocks):
+        chunk = items[int(bounds[i]):int(bounds[i + 1])]
+
+        def read(chunk=chunk) -> pa.Table:
+            return BlockAccessor.rows_to_block(
+                [c if isinstance(c, dict) else {"item": c} for c in chunk])
+
+        tasks.append(ReadTask(read, {"num_rows": len(chunk)}))
+    return _dataset(InputData(read_tasks=tasks), "from_items")
+
+
+def from_numpy(arrays: np.ndarray | dict[str, np.ndarray]):
+    if isinstance(arrays, np.ndarray):
+        arrays = {"data": arrays}
+
+    def read() -> pa.Table:
+        return BlockAccessor.batch_to_block(arrays)
+
+    return _dataset(InputData(read_tasks=[ReadTask(read)]), "from_numpy")
+
+
+def from_pandas(df) -> Any:
+    def read() -> pa.Table:
+        return pa.Table.from_pandas(df, preserve_index=False)
+
+    return _dataset(InputData(read_tasks=[ReadTask(read)]), "from_pandas")
+
+
+def from_arrow(table: pa.Table):
+    return _dataset(InputData(read_tasks=[ReadTask(lambda: table)]),
+                    "from_arrow")
+
+
+def _expand_paths(paths: str | list[str], suffix: str | None) -> list[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: list[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            pattern = os.path.join(p, f"**/*{suffix or ''}")
+            out.extend(sorted(glob_mod.glob(pattern, recursive=True)))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(glob_mod.glob(p)))
+        else:
+            out.append(p)
+    files = [p for p in out if os.path.isfile(p)]
+    if not files:
+        raise FileNotFoundError(f"No input files found for {paths!r}")
+    return files
+
+
+def _file_reader(paths, suffix, parse: Callable[[str], pa.Table], name: str):
+    files = _expand_paths(paths, suffix)
+    tasks = [ReadTask((lambda f=f: parse(f)), {"path": f}) for f in files]
+    return _dataset(InputData(read_tasks=tasks), name)
+
+
+def read_parquet(paths: str | list[str], *, columns: list[str] | None = None):
+    """Reference: read_api.read_parquet / datasource/parquet_datasource.py."""
+    import pyarrow.parquet as pq
+
+    return _file_reader(paths, ".parquet",
+                        lambda f: pq.read_table(f, columns=columns),
+                        "read_parquet")
+
+
+def read_csv(paths: str | list[str], **csv_kwargs):
+    from pyarrow import csv as pacsv
+
+    return _file_reader(paths, ".csv", lambda f: pacsv.read_csv(f),
+                        "read_csv")
+
+
+def read_json(paths: str | list[str]):
+    """Newline-delimited JSON (reference: datasource/json_datasource.py)."""
+    from pyarrow import json as pajson
+
+    return _file_reader(paths, ".json", lambda f: pajson.read_json(f),
+                        "read_json")
+
+
+def read_numpy(paths: str | list[str]):
+    def parse(f: str) -> pa.Table:
+        return BlockAccessor.batch_to_block({"data": np.load(f)})
+
+    return _file_reader(paths, ".npy", parse, "read_numpy")
+
+
+def read_binary_files(paths: str | list[str]):
+    def parse(f: str) -> pa.Table:
+        with open(f, "rb") as fh:
+            return pa.table({"path": [f], "bytes": [fh.read()]})
+
+    return _file_reader(paths, None, parse, "read_binary_files")
+
+
+def read_text(paths: str | list[str]):
+    def parse(f: str) -> pa.Table:
+        with open(f) as fh:
+            return pa.table({"text": [ln.rstrip("\n") for ln in fh]})
+
+    return _file_reader(paths, None, parse, "read_text")

@@ -217,7 +217,18 @@ class JaxTrainer(DataParallelTrainer):
             import jax
 
             if dist_config is not None:
-                jax.distributed.initialize(**dist_config)
+                from ray_tpu.train.session import get_context
+
+                cfg = dict(dist_config)
+                # process_id is per-worker: derive from the gang rank unless
+                # the caller pinned it explicitly.
+                cfg.setdefault("process_id", get_context().get_world_rank())
+                try:
+                    jax.distributed.initialize(**cfg)
+                except RuntimeError:
+                    # Already initialized (e.g. workers sharing a process in
+                    # the local runtime, or a restart within one process).
+                    pass
             elif os.environ.get("JAX_COORDINATOR_ADDRESS"):
                 # Multi-host launch configured via env (the analogue of
                 # torchrun env:// rendezvous); idempotent per process.

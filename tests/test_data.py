@@ -1,0 +1,246 @@
+"""Tests for ray_tpu.data (reference test model: python/ray/data/tests/)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import ray_tpu
+from ray_tpu import data
+
+
+@pytest.fixture
+def rt():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+    yield
+    ray_tpu.shutdown()
+
+
+def test_range_count_take(rt):
+    ds = data.range(100, override_num_blocks=4)
+    assert ds.count() == 100
+    assert ds.take(3) == [{"id": 0}, {"id": 1}, {"id": 2}]
+    assert ds.num_blocks() == 4
+
+
+def test_from_items_and_schema(rt):
+    ds = data.from_items([{"x": i, "y": str(i)} for i in range(10)])
+    assert ds.count() == 10
+    assert set(ds.columns()) == {"x", "y"}
+
+
+def test_map_and_filter(rt):
+    ds = data.range(20).map(lambda row: {"id": row["id"] * 2})
+    assert ds.take(3) == [{"id": 0}, {"id": 2}, {"id": 4}]
+    even = data.range(20).filter(lambda row: row["id"] % 2 == 0)
+    assert even.count() == 10
+
+
+def test_map_batches_numpy(rt):
+    ds = data.range(100, override_num_blocks=5).map_batches(
+        lambda b: {"id": b["id"] + 1})
+    assert ds.take(2) == [{"id": 1}, {"id": 2}]
+    assert ds.count() == 100
+
+
+def test_flat_map(rt):
+    ds = data.from_items([{"n": 2}, {"n": 3}]).flat_map(
+        lambda row: [{"v": row["n"]}] * row["n"])
+    assert ds.count() == 5
+
+
+def test_limit_streams_early(rt):
+    ds = data.range(1000, override_num_blocks=50).limit(5)
+    assert ds.take_all() == [{"id": i} for i in range(5)]
+
+
+def test_repartition(rt):
+    ds = data.range(100, override_num_blocks=10).repartition(3)
+    assert ds.num_blocks() == 3
+    assert ds.count() == 100
+
+
+def test_random_shuffle_preserves_rows(rt):
+    ds = data.range(50, override_num_blocks=5).random_shuffle(seed=7)
+    ids = sorted(r["id"] for r in ds.take_all())
+    assert ids == list(range(50))
+
+
+def test_sort(rt):
+    rng = np.random.default_rng(0)
+    vals = rng.permutation(60)
+    ds = data.from_items([{"v": int(v)} for v in vals]).sort("v")
+    out = [r["v"] for r in ds.take_all()]
+    assert out == sorted(out)
+    desc = data.from_items([{"v": int(v)} for v in vals]).sort(
+        "v", descending=True)
+    out = [r["v"] for r in desc.take_all()]
+    assert out == sorted(out, reverse=True)
+
+
+def test_groupby_aggregates(rt):
+    ds = data.from_items([{"k": i % 3, "v": i} for i in range(12)])
+    out = {r["k"]: r["sum(v)"] for r in ds.groupby("k").sum("v").take_all()}
+    assert out == {0: 0 + 3 + 6 + 9, 1: 1 + 4 + 7 + 10, 2: 2 + 5 + 8 + 11}
+    counts = {r["k"]: r["count()"]
+              for r in ds.groupby("k").count().take_all()}
+    assert counts == {0: 4, 1: 4, 2: 4}
+
+
+def test_groupby_map_groups(rt):
+    ds = data.from_items([{"k": i % 2, "v": float(i)} for i in range(10)])
+    normed = ds.groupby("k").map_groups(
+        lambda g: {"k": g["k"], "v": g["v"] - g["v"].mean()})
+    for row in normed.take_all():
+        assert abs(row["v"]) < 10
+
+
+def test_iter_batches_batch_size(rt):
+    ds = data.range(103, override_num_blocks=7)
+    sizes = [len(b["id"]) for b in ds.iter_batches(batch_size=25)]
+    assert sum(sizes) == 103
+    assert all(s == 25 for s in sizes[:-1])
+
+    sizes = [len(b["id"]) for b in
+             ds.iter_batches(batch_size=25, drop_last=True)]
+    assert all(s == 25 for s in sizes)
+
+
+def test_iter_batches_formats(rt):
+    ds = data.range(10)
+    b = next(iter(ds.iter_batches(batch_size=4, batch_format="pandas")))
+    assert list(b["id"]) == [0, 1, 2, 3]
+    b = next(iter(ds.iter_batches(batch_size=4, batch_format="pyarrow")))
+    assert isinstance(b, pa.Table)
+
+
+def test_iter_jax_batches_device(rt):
+    import jax
+
+    ds = data.range(64).map_batches(
+        lambda b: {"x": b["id"].astype(np.float32)})
+    batches = list(ds.iter_jax_batches(batch_size=16))
+    assert len(batches) == 4
+    assert isinstance(batches[0]["x"], jax.Array)
+    assert float(batches[0]["x"].sum()) == sum(range(16))
+
+
+def test_split_and_shard(rt):
+    ds = data.range(100, override_num_blocks=10)
+    shards = ds.split(4)
+    assert sum(s.count() for s in shards) == 100
+    assert ds.shard(4, 0).count() == shards[0].count()
+
+
+def test_union_zip(rt):
+    a = data.range(5)
+    b = data.range(5)
+    assert a.union(b).count() == 10
+    z = a.zip(data.range(5).map(lambda r: {"other": r["id"] * 10}))
+    rows = z.take_all()
+    assert rows[2] == {"id": 2, "other": 20}
+
+
+def test_aggregates(rt):
+    ds = data.range(10)
+    assert ds.sum("id") == 45
+    assert ds.min("id") == 0
+    assert ds.max("id") == 9
+    assert ds.mean("id") == 4.5
+    assert ds.unique("id") == list(range(10))
+
+
+def test_read_write_parquet_roundtrip(rt, tmp_path):
+    ds = data.range(30, override_num_blocks=3)
+    ds.write_parquet(str(tmp_path / "out"))
+    back = data.read_parquet(str(tmp_path / "out"))
+    assert back.count() == 30
+    assert sorted(r["id"] for r in back.take_all()) == list(range(30))
+
+
+def test_read_write_csv_json(rt, tmp_path):
+    ds = data.from_items([{"a": i, "b": float(i)} for i in range(5)])
+    ds.write_csv(str(tmp_path / "csv"))
+    assert data.read_csv(str(tmp_path / "csv")).count() == 5
+    ds.write_json(str(tmp_path / "json"))
+    assert data.read_json(str(tmp_path / "json")).count() == 5
+
+
+def test_tensor_columns_roundtrip(rt):
+    arr = np.arange(24, dtype=np.float32).reshape(6, 4)
+    ds = data.from_numpy({"x": arr})
+    batch = ds.take_batch(6)
+    np.testing.assert_array_equal(batch["x"], arr)
+
+
+def test_ndim_tensor_columns_keep_shape(rt):
+    # Regression: (B, H, W) tensors used to flatten to (B, H*W).
+    arr = np.arange(4 * 3 * 5, dtype=np.float32).reshape(4, 3, 5)
+    ds = data.from_numpy({"img": arr})
+    batch = ds.take_batch(4)
+    assert batch["img"].shape == (4, 3, 5)
+    np.testing.assert_array_equal(batch["img"], arr)
+
+
+def test_heterogeneous_row_keys_union(rt):
+    # Regression: keys introduced after row 0 used to be dropped.
+    ds = data.from_items([{"a": 1}]).flat_map(
+        lambda r: [{"a": 1}, {"a": 2, "b": 3}])
+    rows = ds.take_all()
+    assert rows[1]["b"] == 3
+    assert rows[0].get("b") is None
+
+
+def test_unseeded_shuffle_differs_across_runs(rt):
+    ds = data.range(100, override_num_blocks=2)
+    a = [r["id"] for r in ds.random_shuffle().take_all()]
+    b = [r["id"] for r in ds.random_shuffle().take_all()]
+    assert a != b  # ~1/100! collision chance
+    s1 = [r["id"] for r in ds.random_shuffle(seed=3).take_all()]
+    s2 = [r["id"] for r in ds.random_shuffle(seed=3).take_all()]
+    assert s1 == s2
+
+
+def test_select_drop_rename(rt):
+    ds = data.from_items([{"a": 1, "b": 2, "c": 3}])
+    assert ds.select_columns(["a"]).columns() == ["a"]
+    assert set(ds.drop_columns(["a"]).columns()) == {"b", "c"}
+    assert "z" in ds.rename_columns({"a": "z"}).columns()
+
+
+def test_streaming_executor_is_lazy(rt):
+    # A transform on a huge dataset must not execute at definition time.
+    calls = {"n": 0}
+
+    def spy(batch):
+        calls["n"] += 1
+        return batch
+
+    ds = data.range(1000, override_num_blocks=100).map_batches(spy)
+    assert calls["n"] == 0
+    ds.take(1)
+    # Streaming: taking 1 row must not run all 100 blocks.
+    assert calls["n"] < 100
+
+
+def test_train_integration_datasets(rt):
+    from ray_tpu import train
+    from ray_tpu.train import DataParallelTrainer, ScalingConfig
+
+    ds = data.range(64).map_batches(
+        lambda b: {"x": b["id"].astype(np.float32)})
+
+    def loop(config):
+        total = 0.0
+        n = 0
+        for batch in config["datasets"]["train"].iter_batches(batch_size=8):
+            total += float(batch["x"].sum())
+            n += len(batch["x"])
+        train.report({"total": total, "rows": n})
+
+    trainer = DataParallelTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2),
+        datasets={"train": ds})
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["rows"] == 32  # each worker sees its shard
