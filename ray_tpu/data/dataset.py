@@ -9,6 +9,7 @@ work.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Callable, Iterable, Iterator
 
 import numpy as np
@@ -42,6 +43,8 @@ class Dataset:
     def __init__(self, ops: list[LogicalOp], name: str = "dataset"):
         self._ops = ops
         self._name = name
+        self._shard_lock = threading.Lock()
+        self._shard_refs_cache: list | None = None
 
     # ------------------------------------------------------------ transforms
 
@@ -230,13 +233,19 @@ class Dataset:
         return self._with(AllToAll(do, name="Zip"), "zip")
 
     def random_sample(self, fraction: float, *, seed: int | None = None) -> "Dataset":
-        def map_block(block: Block) -> Block:
-            rng = np.random.default_rng(seed)
+        # Salt the seed per block so blocks draw independent Bernoulli
+        # streams (same pattern as random_shuffle's per-partition rng).
+        base = (seed if seed is not None
+                else np.random.SeedSequence().entropy % (2 ** 31))
+
+        def map_block(block: Block, idx: int) -> Block:
+            rng = np.random.default_rng((base, idx))
             mask = rng.random(block.num_rows) < fraction
             return block.filter(pa.array(mask))
 
-        return self._with(MapBlocks(map_block, name="RandomSample"),
-                          "random_sample")
+        return self._with(
+            MapBlocks(map_block, name="RandomSample", needs_index=True),
+            "random_sample")
 
     # ----------------------------------------------------------- consumption
 
@@ -350,8 +359,31 @@ class Dataset:
 
     def shard(self, num_shards: int, index: int) -> "Dataset":
         """Deterministic shard for per-worker ingestion (reference:
-        dataset.split + train data_config)."""
-        return self.split(num_shards)[index]
+        dataset.split + train data_config).
+
+        The pipeline executes ONCE per Dataset object (block refs are
+        cached under a lock), so N workers sharding the same dataset do
+        not re-run reads N times; each shard holds only its own block
+        refs — the full dataset is never concatenated.
+        """
+        if not 0 <= index < num_shards:
+            raise ValueError(f"shard index {index} out of [0, {num_shards})")
+        with self._shard_lock:
+            if self._shard_refs_cache is None:
+                self._shard_refs_cache = self._block_refs()
+        refs = self._shard_refs_cache
+        if len(refs) >= num_shards:
+            mine = refs[index::num_shards]
+        else:
+            # Fewer blocks than shards: row-split each block and take the
+            # index-th slice of each, keeping per-worker memory at 1/N.
+            mine = []
+            for ref in refs:
+                part = split_block(ray_tpu.get(ref), num_shards)[index]
+                if part.num_rows:
+                    mine.append(ray_tpu.put(part))
+        return Dataset([InputData(block_refs=mine)],
+                       name=f"{self._name}.shard[{index}/{num_shards}]")
 
     def train_test_split(self, test_size: float, *, shuffle: bool = False,
                          seed: int | None = None):

@@ -51,8 +51,18 @@ def _run_chain(block: Block, fn) -> Block:
 
 
 @ray_tpu.remote
+def _run_chain_idx(block: Block, fn, idx: int) -> Block:
+    return fn(block, idx)
+
+
+@ray_tpu.remote
 def _run_read_chain(read_fn, fn) -> Block:
     return fn(read_fn())
+
+
+@ray_tpu.remote
+def _run_read_chain_idx(read_fn, fn, idx: int) -> Block:
+    return fn(read_fn(), idx)
 
 
 def iter_block_refs(ops: list[LogicalOp],
@@ -66,15 +76,20 @@ def iter_block_refs(ops: list[LogicalOp],
 
     # A leading MapBlocks fuses into the read task itself (read fusion).
     read_fused = None
+    read_fused_needs_index = False
     if stages and isinstance(stages[0], MapBlocks) and source.read_tasks:
         read_fused = stages[0].fn
+        read_fused_needs_index = stages[0].needs_index
         stages = stages[1:]
 
     def input_stream() -> Iterator[Any]:
         if source.read_tasks is not None:
             in_flight: collections.deque = collections.deque()
-            for task in source.read_tasks:
-                if read_fused is not None:
+            for task_idx, task in enumerate(source.read_tasks):
+                if read_fused is not None and read_fused_needs_index:
+                    ref = _run_read_chain_idx.remote(
+                        task.fn, read_fused, task_idx)
+                elif read_fused is not None:
                     ref = _run_read_chain.remote(task.fn, read_fused)
                 else:
                     ref = _run_read.remote(task.fn)
@@ -102,8 +117,11 @@ def iter_block_refs(ops: list[LogicalOp],
 def _map_stage(upstream: Iterator[Any], op: MapBlocks,
                ctx: ExecutionContext) -> Iterator[Any]:
     in_flight: collections.deque = collections.deque()
-    for ref in upstream:
-        in_flight.append(_run_chain.remote(ref, op.fn))
+    for idx, ref in enumerate(upstream):
+        if op.needs_index:
+            in_flight.append(_run_chain_idx.remote(ref, op.fn, idx))
+        else:
+            in_flight.append(_run_chain.remote(ref, op.fn))
         if len(in_flight) >= ctx.max_in_flight:
             yield in_flight.popleft()
     while in_flight:

@@ -44,10 +44,16 @@ class InputData(LogicalOp):
 
 @dataclass
 class MapBlocks(LogicalOp):
-    """One-to-one block transform; fusable with neighbors."""
+    """One-to-one block transform; fusable with neighbors.
+
+    ``needs_index=True`` ops receive ``fn(block, block_index)`` — used by
+    seeded per-block randomness (random_sample) so every block draws an
+    independent stream from the same user seed.
+    """
 
     fn: Callable[[Block], Block]
     name: str = "Map"
+    needs_index: bool = False
 
 
 @dataclass
@@ -77,10 +83,14 @@ def fuse_stages(ops: list[LogicalOp]) -> list[LogicalOp]:
                 and isinstance(fused[-1], MapBlocks)):
             prev = fused.pop()
 
-            def chained(block: Block, _a=prev.fn, _b=op.fn) -> Block:
-                return _b(_a(block))
+            def chained(block: Block, idx: int = 0, _a=prev.fn, _b=op.fn,
+                        _ai=prev.needs_index, _bi=op.needs_index) -> Block:
+                block = _a(block, idx) if _ai else _a(block)
+                return _b(block, idx) if _bi else _b(block)
 
-            fused.append(MapBlocks(chained, name=f"{prev.name}->{op.name}"))
+            fused.append(MapBlocks(
+                chained, name=f"{prev.name}->{op.name}",
+                needs_index=prev.needs_index or op.needs_index))
         else:
             fused.append(op)
     return fused

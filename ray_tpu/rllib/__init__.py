@@ -1,0 +1,61 @@
+"""ray_tpu.rllib — reinforcement learning on the TPU-native runtime.
+
+Reference: rllib/ (new API stack only — RLModule / Learner /
+LearnerGroup / EnvRunner / Algorithm; see SURVEY.md §2.3). The compute
+path is pure JAX: jitted policy steps on env runners, jitted
+loss+update on learners (GAE and V-trace as `lax.scan`), GSPMD meshes
+instead of DDP wrappers for multi-device learners.
+"""
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.core.learner import JaxLearner, Learner, compute_gae
+from ray_tpu.rllib.core.learner_group import LearnerGroup
+from ray_tpu.rllib.core.rl_module import (
+    DefaultActorCriticModule,
+    RLModule,
+    RLModuleSpec,
+)
+from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.env.vector_env import (
+    CartPoleVectorEnv,
+    VectorEnv,
+    make_vector_env,
+    register_env,
+)
+from ray_tpu.rllib.utils.actor_manager import FaultTolerantActorManager
+from ray_tpu.rllib.utils.replay_buffers import (
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
+from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "CartPoleVectorEnv",
+    "Columns",
+    "DQN",
+    "DQNConfig",
+    "DefaultActorCriticModule",
+    "FaultTolerantActorManager",
+    "IMPALA",
+    "IMPALAConfig",
+    "JaxLearner",
+    "Learner",
+    "LearnerGroup",
+    "PPO",
+    "PPOConfig",
+    "PrioritizedReplayBuffer",
+    "RLModule",
+    "RLModuleSpec",
+    "ReplayBuffer",
+    "SampleBatch",
+    "SingleAgentEnvRunner",
+    "VectorEnv",
+    "compute_gae",
+    "make_vector_env",
+    "register_env",
+]

@@ -1,0 +1,297 @@
+"""Algorithm + AlgorithmConfig — the RLlib-equivalent driver layer.
+
+Reference: rllib/algorithms/algorithm.py:195 (Algorithm extends Tune's
+Trainable; step :807, training_step :1597) and algorithm_config.py
+(fluent builder). An Algorithm owns:
+
+- a FaultTolerantActorManager of SingleAgentEnvRunner actors (CPU), and
+- a LearnerGroup (TPU) holding the jitted update,
+
+and its ``training_step`` moves sample fragments from the first to the
+second through the object store, then broadcasts weights back.
+"""
+
+from __future__ import annotations
+
+import copy
+import pickle
+import os
+import time
+from typing import Any
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.core.learner_group import LearnerGroup
+from ray_tpu.rllib.core.rl_module import (
+    DefaultActorCriticModule,
+    RLModuleSpec,
+)
+from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.env.vector_env import make_vector_env
+from ray_tpu.rllib.utils.actor_manager import FaultTolerantActorManager
+from ray_tpu.rllib.utils.sample_batch import SampleBatch
+from ray_tpu.tune import Trainable
+
+
+class AlgorithmConfig:
+    """Fluent config builder (reference: algorithm_config.py).
+
+    Usage::
+
+        config = (PPOConfig()
+                  .environment("CartPole-v1")
+                  .env_runners(num_env_runners=2, num_envs_per_env_runner=8)
+                  .training(lr=3e-4, gamma=0.99))
+        algo = config.build()
+    """
+
+    algo_class: type | None = None
+
+    def __init__(self):
+        # environment()
+        self.env = "CartPole-v1"
+        # env_runners()
+        self.num_env_runners = 0  # 0 = sample in the driver process
+        self.num_envs_per_env_runner = 8
+        self.rollout_fragment_length = 64
+        self.explore = True
+        # training()
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.grad_clip = None
+        self.train_batch_size = 512
+        self.minibatch_size = 128
+        self.num_epochs = 1
+        # learners()
+        self.num_learners = 0  # 0 = single local learner
+        # Devices for the local learner's data mesh: 1 = single device,
+        # -1 = all local devices (GSPMD shards the batch; XLA inserts the
+        # gradient all-reduce over ICI).
+        self.num_devices_per_learner = 1
+        # rl_module()
+        self.model_config: dict = {"hidden": (64, 64)}
+        self.module_class: type | None = None
+        # debugging()
+        self.seed = 0
+
+    # -- fluent setters (each returns self) ---------------------------
+    def environment(self, env: str) -> "AlgorithmConfig":
+        self.env = env
+        return self
+
+    def env_runners(self, *, num_env_runners: int | None = None,
+                    num_envs_per_env_runner: int | None = None,
+                    rollout_fragment_length: int | None = None,
+                    explore: bool | None = None) -> "AlgorithmConfig":
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        if explore is not None:
+            self.explore = explore
+        return self
+
+    def training(self, **kwargs) -> "AlgorithmConfig":
+        for k, v in kwargs.items():
+            if not hasattr(self, k):
+                raise ValueError(f"Unknown training option: {k}")
+            setattr(self, k, v)
+        return self
+
+    def learners(self, *, num_learners: int | None = None,
+                 num_devices_per_learner: int | None = None,
+                 ) -> "AlgorithmConfig":
+        if num_learners is not None:
+            self.num_learners = num_learners
+        if num_devices_per_learner is not None:
+            self.num_devices_per_learner = num_devices_per_learner
+        return self
+
+    def rl_module(self, *, model_config: dict | None = None,
+                  module_class: type | None = None) -> "AlgorithmConfig":
+        if model_config is not None:
+            self.model_config = model_config
+        if module_class is not None:
+            self.module_class = module_class
+        return self
+
+    def debugging(self, *, seed: int | None = None) -> "AlgorithmConfig":
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    # -- build ---------------------------------------------------------
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def learner_class(self) -> type:
+        raise NotImplementedError
+
+    def module_spec(self) -> RLModuleSpec:
+        probe = make_vector_env(self.env, 1)
+        return RLModuleSpec(
+            module_class=self.module_class or DefaultActorCriticModule,
+            observation_size=probe.observation_size,
+            num_actions=probe.num_actions,
+            model_config=dict(self.model_config))
+
+    def build(self) -> "Algorithm":
+        assert self.algo_class is not None
+        return self.algo_class(config=self)
+
+    def to_dict(self) -> dict:
+        return {k: v for k, v in vars(self).items()
+                if not k.startswith("_")}
+
+
+class Algorithm(Trainable):
+    """Reference: rllib/algorithms/algorithm.py:195.
+
+    ``train()`` (Trainable protocol) -> ``step()`` -> ``training_step()``
+    which subclasses implement. Also usable under ray_tpu.tune.
+    """
+
+    config_class: type = AlgorithmConfig
+
+    def __init__(self, config: "AlgorithmConfig | dict | None" = None):
+        if isinstance(config, dict) or config is None:
+            cfg = self.config_class()
+            for k, v in (config or {}).items():
+                setattr(cfg, k, v)
+            config = cfg
+        super().__init__(config.to_dict())
+        self.algo_config = config
+        self.iteration = 0
+        self._timesteps_total = 0
+        self._weights_version = 0
+        self.setup(self.config)
+
+    # -- lifecycle ----------------------------------------------------
+    def setup(self, config: dict) -> None:
+        cfg = self.algo_config
+        self.module_spec = cfg.module_spec()
+        self.learner_group = LearnerGroup(
+            learner_class=cfg.learner_class(),
+            module_spec=self.module_spec, config=cfg)
+        self.env_runner_group = self._build_env_runners(cfg)
+        self._sync_weights()
+
+    def _build_env_runners(self, cfg) -> "FaultTolerantActorManager | None":
+        if cfg.num_env_runners <= 0:
+            self.local_env_runner = SingleAgentEnvRunner(
+                env_id=cfg.env, module_spec=self.module_spec,
+                num_envs=cfg.num_envs_per_env_runner,
+                rollout_fragment_length=cfg.rollout_fragment_length,
+                seed=cfg.seed, worker_index=0, explore=cfg.explore)
+            return None
+        RemoteRunner = ray_tpu.remote(SingleAgentEnvRunner)
+
+        def factory(idx: int):
+            return RemoteRunner.remote(
+                env_id=cfg.env, module_spec=self.module_spec,
+                num_envs=cfg.num_envs_per_env_runner,
+                rollout_fragment_length=cfg.rollout_fragment_length,
+                seed=cfg.seed, worker_index=idx + 1, explore=cfg.explore)
+
+        actors = [factory(i) for i in range(cfg.num_env_runners)]
+        self.local_env_runner = None
+        return FaultTolerantActorManager(actors, actor_factory=factory)
+
+    def _sync_weights(self) -> None:
+        """Broadcast learner weights to all env runners (reference:
+        Algorithm's weight sync after each training_step)."""
+        weights = self.learner_group.get_weights()
+        self._weights_version += 1
+        if self.env_runner_group is None:
+            self.local_env_runner.set_weights(weights, self._weights_version)
+        else:
+            # Put once; every runner resolves the same object (the object
+            # store is the broadcast plane, reference impala.py:676+).
+            ref = ray_tpu.put(weights)
+            self.env_runner_group.foreach_actor(
+                "set_weights", ref, self._weights_version)
+
+    # -- Trainable protocol -------------------------------------------
+    def step(self) -> dict:
+        t0 = time.time()
+        results = self.training_step()
+        self.iteration += 1
+        results.setdefault("training_iteration", self.iteration)
+        results.setdefault("num_env_steps_sampled_lifetime",
+                           self._timesteps_total)
+        results["time_this_iter_s"] = time.time() - t0
+        return results
+
+    def train(self) -> dict:
+        return self.step()
+
+    def training_step(self) -> dict:
+        raise NotImplementedError
+
+    # -- sampling helper ----------------------------------------------
+    def _sample_fragments(self) -> list[SampleBatch]:
+        """One synchronous sampling round across all env runners."""
+        if self.env_runner_group is None:
+            batches = [self.local_env_runner.sample()]
+        else:
+            batches = self.env_runner_group.foreach_actor("sample")
+        for b in batches:
+            T, B = np.shape(b["obs"])[:2]
+            self._timesteps_total += T * B
+        return batches
+
+    def _runner_metrics(self) -> dict:
+        if self.env_runner_group is None:
+            metrics = [self.local_env_runner.get_metrics()]
+        else:
+            metrics = self.env_runner_group.foreach_actor("get_metrics")
+        merged: dict = {"num_episodes": 0}
+        returns = []
+        for m in metrics:
+            merged["num_episodes"] += m.get("num_episodes", 0)
+            if "episode_return_mean" in m:
+                returns.append(m["episode_return_mean"])
+        if returns:
+            merged["episode_return_mean"] = float(np.mean(returns))
+        return merged
+
+    # -- checkpointing (Trainable protocol) ---------------------------
+    def save_checkpoint(self, checkpoint_dir: str):
+        state = {
+            "learner": self.learner_group.get_state(),
+            "iteration": self.iteration,
+            "timesteps": self._timesteps_total,
+            "config": self.algo_config.to_dict(),
+        }
+        path = os.path.join(checkpoint_dir, "algorithm_state.pkl")
+        with open(path, "wb") as f:
+            pickle.dump(state, f)
+        return checkpoint_dir
+
+    def load_checkpoint(self, checkpoint) -> None:
+        path = checkpoint if isinstance(checkpoint, str) else checkpoint
+        state_file = os.path.join(path, "algorithm_state.pkl")
+        with open(state_file, "rb") as f:
+            state = pickle.load(f)
+        self.learner_group.set_state(state["learner"])
+        self.iteration = state["iteration"]
+        self._timesteps_total = state["timesteps"]
+        self._sync_weights()
+
+    save = save_checkpoint
+    restore = load_checkpoint
+
+    def stop(self) -> None:
+        self.cleanup()
+
+    def cleanup(self) -> None:
+        if self.env_runner_group is not None:
+            for i in self.env_runner_group.healthy_actor_ids():
+                try:
+                    ray_tpu.kill(self.env_runner_group.actor(i))
+                except Exception:
+                    pass
+        self.learner_group.shutdown()

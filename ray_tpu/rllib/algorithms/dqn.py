@@ -1,0 +1,234 @@
+"""DQN — off-policy Q-learning with target network and replay.
+
+Reference: rllib/algorithms/dqn/ (new-stack DQN/Rainbow-lite:
+double-Q + target net + optional prioritized replay). The TD-error and
+update are one jitted function; the target network is a second params
+pytree swapped by `optax.periodic_update`-style copying.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec, _mlp_apply, _mlp_init
+from ray_tpu.rllib.utils.replay_buffers import (
+    PrioritizedReplayBuffer,
+    ReplayBuffer,
+)
+from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
+
+
+class QNetworkModule(RLModule):
+    """MLP Q-network; exploration is epsilon-greedy with a linear decay
+    schedule computed INSIDE the jitted forward from the runner's step
+    counter (batch["t"]), so epsilon changes every step without ever
+    retracing."""
+
+    def __init__(self, observation_size: int, num_actions: int,
+                 hidden: tuple = (64, 64), epsilon_start: float = 1.0,
+                 epsilon_end: float = 0.05,
+                 epsilon_decay_steps: int = 10_000, **_):
+        self.observation_size = observation_size
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+        self.epsilon_start = epsilon_start
+        self.epsilon_end = epsilon_end
+        self.epsilon_decay_steps = epsilon_decay_steps
+
+    def init(self, rng):
+        sizes = (self.observation_size,) + self.hidden + (self.num_actions,)
+        return {"q": _mlp_init(rng, sizes)}
+
+    def q_values(self, params, obs):
+        return _mlp_apply(params["q"], obs)
+
+    def forward_inference(self, params, batch, rng=None):
+        q = self.q_values(params, batch["obs"])
+        return {"action_logits": q, "actions": jnp.argmax(q, axis=-1)}
+
+    def forward_exploration(self, params, batch, rng=None):
+        q = self.q_values(params, batch["obs"])
+        greedy = jnp.argmax(q, axis=-1)
+        t = batch.get("t", self.epsilon_decay_steps)
+        frac = jnp.clip(t / self.epsilon_decay_steps, 0.0, 1.0)
+        eps = self.epsilon_start + frac * (
+            self.epsilon_end - self.epsilon_start)
+        explore_rng, action_rng = jax.random.split(rng)
+        random_actions = jax.random.randint(
+            action_rng, greedy.shape, 0, self.num_actions)
+        take_random = jax.random.uniform(
+            explore_rng, greedy.shape) < eps
+        actions = jnp.where(take_random, random_actions, greedy)
+        return {"action_logits": q, "actions": actions,
+                "action_logp": jnp.zeros_like(q[..., 0]),
+                "vf_preds": jnp.max(q, axis=-1)}
+
+    def forward_train(self, params, batch, rng=None):
+        return {"action_logits": self.q_values(params, batch["obs"])}
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.module_class = QNetworkModule
+        self.lr = 5e-4
+        self.buffer_capacity = 50_000
+        self.prioritized_replay = False
+        self.train_batch_size = 64
+        self.target_update_freq = 200     # learner steps
+        self.num_steps_sampled_before_learning = 1000
+        self.epsilon_start = 1.0
+        self.epsilon_end = 0.05
+        self.epsilon_decay_steps = 10_000
+        self.double_q = True
+        self.updates_per_iteration = 32
+
+    def learner_class(self):
+        return DQNLearner
+
+    def module_spec(self):
+        spec = super().module_spec()
+        spec.model_config.setdefault("epsilon_start", self.epsilon_start)
+        spec.model_config.setdefault("epsilon_end", self.epsilon_end)
+        spec.model_config.setdefault("epsilon_decay_steps",
+                                     self.epsilon_decay_steps)
+        return spec
+
+
+class DQNLearner(Learner):
+    def __init__(self, module_spec: RLModuleSpec, config=None, mesh=None):
+        super().__init__(module_spec, config, mesh)
+        self.target_params = jax.tree_util.tree_map(
+            jnp.copy, self.params)
+
+    def compute_loss(self, params, batch, rng):
+        cfg = self.config
+        q = self.module.q_values(params, batch[Columns.OBS])
+        q_taken = jnp.take_along_axis(
+            q, batch[Columns.ACTIONS][..., None].astype(jnp.int32),
+            axis=-1)[..., 0]
+
+        # Target params ride inside the batch so the jitted loss stays a
+        # pure function of its inputs (a closed-over pytree would be
+        # baked in as a compile-time constant and never update).
+        q_next_target = self.module.q_values(
+            batch["target_params"], batch[Columns.NEXT_OBS])
+        if getattr(cfg, "double_q", True):
+            q_next_online = self.module.q_values(
+                params, batch[Columns.NEXT_OBS])
+            next_actions = jnp.argmax(q_next_online, axis=-1)
+            q_next = jnp.take_along_axis(
+                q_next_target, next_actions[..., None], axis=-1)[..., 0]
+        else:
+            q_next = jnp.max(q_next_target, axis=-1)
+
+        not_done = 1.0 - batch[Columns.TERMINATEDS].astype(jnp.float32)
+        targets = batch[Columns.REWARDS] + cfg.gamma * not_done * q_next
+        td_error = q_taken - jax.lax.stop_gradient(targets)
+        weights = batch.get("weights", jnp.ones_like(td_error))
+        loss = jnp.mean(weights * jnp.square(td_error))
+        return loss, {"td_error_mean": jnp.mean(jnp.abs(td_error)),
+                      "q_mean": jnp.mean(q_taken)}
+
+    def update_from_batch(self, batch: SampleBatch) -> dict:
+        batch = SampleBatch(batch)
+        batch["target_params"] = self.target_params
+        metrics = super().update_from_batch(batch)
+        if self._steps % getattr(self.config, "target_update_freq", 200) == 0:
+            self.target_params = jax.tree_util.tree_map(
+                jnp.copy, self.params)
+        return metrics
+
+    def compute_td_errors(self, batch: SampleBatch) -> np.ndarray:
+        """Per-row |TD error| for priority updates (post-update params)."""
+        if not hasattr(self, "_td_fn"):
+            def td_fn(params, batch):
+                cfg = self.config
+                q = self.module.q_values(params, batch[Columns.OBS])
+                q_taken = jnp.take_along_axis(
+                    q, batch[Columns.ACTIONS][..., None].astype(jnp.int32),
+                    axis=-1)[..., 0]
+                q_next_target = self.module.q_values(
+                    batch["target_params"], batch[Columns.NEXT_OBS])
+                q_next = jnp.max(q_next_target, axis=-1)
+                not_done = 1.0 - batch[Columns.TERMINATEDS].astype(
+                    jnp.float32)
+                targets = (batch[Columns.REWARDS]
+                           + cfg.gamma * not_done * q_next)
+                return jnp.abs(q_taken - targets)
+            self._td_fn = jax.jit(td_fn)
+        b = SampleBatch(batch)
+        b["target_params"] = self.target_params
+        return np.asarray(self._td_fn(self.params, self._device_batch(b)))
+
+
+class DQN(Algorithm):
+    config_class = DQNConfig
+
+    def setup(self, config: dict) -> None:
+        super().setup(config)
+        cfg = self.algo_config
+        buf_cls = (PrioritizedReplayBuffer if cfg.prioritized_replay
+                   else ReplayBuffer)
+        self.replay = buf_cls(cfg.buffer_capacity, seed=cfg.seed)
+        self._learner_steps = 0
+
+    def _fragment_to_transitions(self, frag: SampleBatch) -> SampleBatch:
+        """[T, B] fragment -> flat (s, a, r, s', done) rows.
+
+        Drops (a) the last step of each lane (no stored successor) and
+        (b) TRUNCATED steps: the vector env auto-resets on done, so the
+        next stored obs belongs to a fresh episode — bootstrapping
+        r + gamma*Q(reset_obs) would poison the target. Terminated steps
+        are kept (their target ignores next_obs).
+        """
+        obs = np.asarray(frag[Columns.OBS])          # [T, B, obs]
+        next_obs = obs[1:]
+        keep = ~np.asarray(frag[Columns.TRUNCATEDS])[:-1].reshape(-1)
+        flat = SampleBatch({
+            Columns.OBS: obs[:-1].reshape((-1,) + obs.shape[2:])[keep],
+            Columns.NEXT_OBS: next_obs.reshape(
+                (-1,) + obs.shape[2:])[keep],
+            Columns.ACTIONS: np.asarray(
+                frag[Columns.ACTIONS])[:-1].reshape(-1)[keep],
+            Columns.REWARDS: np.asarray(
+                frag[Columns.REWARDS])[:-1].reshape(-1)[keep],
+            Columns.TERMINATEDS: np.asarray(
+                frag[Columns.TERMINATEDS])[:-1].reshape(-1)[keep],
+        })
+        return flat
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        fragments = self._sample_fragments()
+        for frag in fragments:
+            self.replay.add(self._fragment_to_transitions(frag))
+
+        metrics: dict = {}
+        if len(self.replay) >= cfg.num_steps_sampled_before_learning:
+            for _ in range(cfg.updates_per_iteration):
+                batch = self.replay.sample(cfg.train_batch_size)
+                metrics = self.learner_group.update_from_batch(batch)
+                self._learner_steps += 1
+                if cfg.prioritized_replay and "batch_indexes" in batch:
+                    td = self.learner_group.call(
+                        "compute_td_errors",
+                        SampleBatch({k: v for k, v in batch.items()
+                                     if k not in ("weights",
+                                                  "batch_indexes")}))
+                    self.replay.update_priorities(
+                        batch["batch_indexes"], td)
+            self._sync_weights()
+
+        results = self._runner_metrics()
+        results.update(metrics)
+        results["replay_buffer_size"] = len(self.replay)
+        results["num_learner_steps"] = self._learner_steps
+        return results
+
+
+DQNConfig.algo_class = DQN

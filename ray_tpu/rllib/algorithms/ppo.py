@@ -1,0 +1,140 @@
+"""PPO — Proximal Policy Optimization (new-API-stack shape).
+
+Reference: rllib/algorithms/ppo/ppo.py:379/:405/:414 (training_step:
+parallel EnvRunner.sample -> learner_group.update) and
+ppo/torch/ppo_torch_learner.py (clipped-surrogate loss). The loss,
+GAE, and minibatch epochs here are pure JAX: GAE is a reverse
+`lax.scan` (core/learner.py:compute_gae) and each SGD minibatch is one
+jitted update on static shapes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner, compute_gae
+from ray_tpu.rllib.core.rl_module import (
+    categorical_entropy,
+    categorical_kl,
+    categorical_logp,
+)
+from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
+
+import jax.numpy as jnp
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lambda_ = 0.95
+        self.clip_param = 0.3
+        self.vf_clip_param = 10.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.0
+        self.kl_coeff = 0.2
+        self.kl_target = 0.01
+        self.num_epochs = 4
+        self.minibatch_size = 128
+
+    def learner_class(self):
+        return PPOLearner
+
+
+class PPOLearner(Learner):
+    """Clipped-surrogate loss (reference: ppo_torch_learner.py
+    compute_loss_for_module)."""
+
+    def compute_loss(self, params, batch, rng):
+        cfg = self.config
+        out = self.module.forward_train(params, batch, rng)
+        logits = out["action_logits"]
+        values = out["vf_preds"]
+
+        logp = categorical_logp(logits, batch[Columns.ACTIONS])
+        ratio = jnp.exp(logp - batch[Columns.ACTION_LOGP])
+        advantages = batch[Columns.ADVANTAGES]
+
+        surrogate = jnp.minimum(
+            advantages * ratio,
+            advantages * jnp.clip(ratio, 1 - cfg.clip_param,
+                                  1 + cfg.clip_param))
+
+        vf_targets = batch[Columns.VALUE_TARGETS]
+        vf_err = jnp.square(values - vf_targets)
+        vf_loss = jnp.clip(vf_err, 0, cfg.vf_clip_param)
+
+        entropy = categorical_entropy(logits)
+        kl = categorical_kl(batch[Columns.ACTION_LOGITS], logits)
+
+        total = jnp.mean(
+            -surrogate
+            + cfg.vf_loss_coeff * vf_loss
+            - cfg.entropy_coeff * entropy
+            + cfg.kl_coeff * kl)
+        metrics = {
+            "policy_loss": -jnp.mean(surrogate),
+            "vf_loss": jnp.mean(vf_loss),
+            "entropy": jnp.mean(entropy),
+            "mean_kl": jnp.mean(kl),
+        }
+        return total, metrics
+
+
+def postprocess_fragment(batch: SampleBatch, gamma: float,
+                         lam: float) -> SampleBatch:
+    """GAE over a time-major [T, B] fragment, then flatten to [T*B].
+
+    Runs as one jitted scan on device; the flattened batch is what the
+    minibatch SGD loop consumes.
+    """
+    advantages, value_targets = compute_gae(
+        jnp.asarray(batch[Columns.REWARDS]),
+        jnp.asarray(batch[Columns.VF_PREDS]),
+        jnp.asarray(batch["bootstrap_value"]),
+        jnp.asarray(batch[Columns.TERMINATEDS]),
+        jnp.asarray(batch[Columns.TRUNCATEDS]),
+        gamma, lam)
+    adv = np.asarray(advantages)
+    flat = SampleBatch()
+    for key in (Columns.OBS, Columns.ACTIONS, Columns.ACTION_LOGP,
+                Columns.ACTION_LOGITS, Columns.VF_PREDS):
+        v = np.asarray(batch[key])
+        flat[key] = v.reshape((-1,) + v.shape[2:])
+    flat[Columns.ADVANTAGES] = adv.reshape(-1)
+    flat[Columns.VALUE_TARGETS] = np.asarray(value_targets).reshape(-1)
+    # Advantage normalization (standard PPO practice; reference does this
+    # per-minibatch in the learner connector).
+    a = flat[Columns.ADVANTAGES]
+    flat[Columns.ADVANTAGES] = (a - a.mean()) / (a.std() + 1e-8)
+    return flat
+
+
+class PPO(Algorithm):
+    config_class = PPOConfig
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        fragments = self._sample_fragments()
+        train_batch = SampleBatch.concat(
+            [postprocess_fragment(f, cfg.gamma, cfg.lambda_)
+             for f in fragments])
+
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        metrics: dict = {}
+        num_updates = 0
+        mb = min(cfg.minibatch_size, len(train_batch))
+        for _ in range(cfg.num_epochs):
+            for minibatch in train_batch.minibatches(mb, rng):
+                metrics = self.learner_group.update_from_batch(minibatch)
+                num_updates += 1
+        self._sync_weights()
+
+        results = self._runner_metrics()
+        results.update(metrics)
+        results["num_sgd_updates"] = num_updates
+        results["num_env_steps_trained"] = len(train_batch)
+        return results
+
+
+PPOConfig.algo_class = PPO

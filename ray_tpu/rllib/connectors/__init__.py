@@ -1,0 +1,81 @@
+"""ConnectorV2-lite — composable batch transforms.
+
+Reference: rllib/connectors/ (ConnectorV2 pipelines between env, module
+and learner). Here a connector is any callable ``(batch) -> batch``;
+``ConnectorPipeline`` composes them. Kept deliberately functional: a
+pipeline of pure transforms can be fused into the jitted update when
+every piece is jax-traceable.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from ray_tpu.rllib.utils.sample_batch import SampleBatch
+
+
+class ConnectorPipeline:
+    """Ordered list of batch transforms (reference: ConnectorPipelineV2)."""
+
+    def __init__(self, connectors: "list[Callable] | None" = None):
+        self.connectors = list(connectors or [])
+
+    def append(self, connector: Callable) -> "ConnectorPipeline":
+        self.connectors.append(connector)
+        return self
+
+    def prepend(self, connector: Callable) -> "ConnectorPipeline":
+        self.connectors.insert(0, connector)
+        return self
+
+    def __call__(self, batch: SampleBatch) -> SampleBatch:
+        for c in self.connectors:
+            batch = c(batch)
+        return batch
+
+
+class NormalizeObservations:
+    """Running mean/std observation filter (reference:
+    rllib MeanStdFilter connector)."""
+
+    def __init__(self, epsilon: float = 1e-8):
+        self.mean = None
+        self.var = None
+        self.count = epsilon
+        self.eps = epsilon
+
+    def __call__(self, batch: SampleBatch) -> SampleBatch:
+        obs = np.asarray(batch["obs"], dtype=np.float64)
+        flat = obs.reshape(-1, obs.shape[-1])
+        if self.mean is None:
+            self.mean = np.zeros(obs.shape[-1])
+            self.var = np.ones(obs.shape[-1])
+        batch_mean = flat.mean(axis=0)
+        batch_var = flat.var(axis=0)
+        n = flat.shape[0]
+        delta = batch_mean - self.mean
+        total = self.count + n
+        self.mean = self.mean + delta * n / total
+        self.var = (self.var * self.count + batch_var * n
+                    + delta**2 * self.count * n / total) / total
+        self.count = total
+        out = SampleBatch(batch)
+        out["obs"] = ((obs - self.mean)
+                      / np.sqrt(self.var + self.eps)).astype(np.float32)
+        return out
+
+
+class ClipRewards:
+    def __init__(self, limit: float = 1.0):
+        self.limit = limit
+
+    def __call__(self, batch: SampleBatch) -> SampleBatch:
+        out = SampleBatch(batch)
+        out["rewards"] = np.clip(
+            np.asarray(batch["rewards"]), -self.limit, self.limit)
+        return out
+
+
+__all__ = ["ConnectorPipeline", "NormalizeObservations", "ClipRewards"]

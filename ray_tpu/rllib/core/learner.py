@@ -1,0 +1,224 @@
+"""JaxLearner — gradient updates on TPU.
+
+Reference: rllib/core/learner/learner.py:106 (Learner; compute_loss
+:871, _update :1247) and torch_learner.py:52. The reference
+data-parallelizes by wrapping modules in DDP
+(torch_learner.py:265,384-386); here the whole update is ONE jitted
+pure function — running it under a `jax.sharding.Mesh` with batch-
+sharded inputs makes XLA insert the gradient all-reduce over ICI
+(GSPMD), so a "multi-learner" setup is just the same function on a
+bigger mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
+from ray_tpu.rllib.utils.sample_batch import SampleBatch
+
+
+class Learner:
+    """Base learner: owns module params, optimizer state, jitted update.
+
+    Subclasses implement ``compute_loss(params, batch, rng) ->
+    (loss, metrics_dict)`` as a pure function (reference: Learner.
+    compute_loss learner.py:871).
+
+    With a ``mesh``, batches are device_put batch-sharded over it and
+    params replicated; GSPMD inserts the gradient all-reduce over ICI
+    (the reference needs DDP for this, torch_learner.py:384-386).
+    ``batch_axis`` names which input axis is the data axis (IMPALA's
+    time-major [T, B] batches set it to 1).
+    """
+
+    batch_axis: int = 0
+
+    def __init__(self, module_spec: RLModuleSpec, config=None,
+                 mesh=None):
+        self.config = config
+        self.module: RLModule = module_spec.build()
+        self._mesh = mesh
+        self._rng = jax.random.PRNGKey(
+            getattr(config, "seed", 0) if config is not None else 0)
+        self._rng, init_rng = jax.random.split(self._rng)
+        self.params = self.module.init(init_rng)
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            self._replicated = NamedSharding(self._mesh, P())
+            self.params = jax.device_put(self.params, self._replicated)
+        self.optimizer = self.configure_optimizer()
+        self.opt_state = self.optimizer.init(self.params)
+        self._update_fn = None  # lazily jitted
+        self._steps = 0
+
+    # -- to override -------------------------------------------------
+    def configure_optimizer(self) -> optax.GradientTransformation:
+        lr = getattr(self.config, "lr", 3e-4) if self.config else 3e-4
+        grad_clip = getattr(self.config, "grad_clip", None) \
+            if self.config else None
+        tx = optax.adam(lr)
+        if grad_clip:
+            tx = optax.chain(optax.clip_by_global_norm(grad_clip), tx)
+        return tx
+
+    def compute_loss(self, params, batch: dict, rng) -> tuple:
+        raise NotImplementedError
+
+    # -- update path -------------------------------------------------
+    def _build_update(self) -> Callable:
+        def update(params, opt_state, batch, rng):
+            (loss, metrics), grads = jax.value_and_grad(
+                self.compute_loss, has_aux=True)(params, batch, rng)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+            metrics = dict(metrics)
+            metrics["total_loss"] = loss
+            metrics["grad_norm"] = optax.global_norm(grads)
+            return params, opt_state, metrics
+
+        # Under a mesh the batch arrives device_put with a batch-sharded
+        # NamedSharding (see _device_batch); jit + GSPMD then derives the
+        # gradient all-reduce automatically — no explicit in_shardings
+        # needed, and the same compiled fn serves 1..N devices.
+        return jax.jit(update)
+
+    def _device_batch(self, batch: SampleBatch) -> dict:
+        # tree_map so columns may themselves be pytrees (e.g. DQN ships
+        # its target-net params inside the batch to keep the update pure).
+        arrays = jax.tree_util.tree_map(jnp.asarray, dict(batch))
+        if self._mesh is None:
+            return arrays
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        n = self._mesh.size
+        axis = self.batch_axis
+        out = {}
+        for k, v in arrays.items():
+            if (isinstance(v, jax.Array) and v.ndim > axis
+                    and v.shape[axis] % n == 0):
+                spec = [None] * v.ndim
+                spec[axis] = self._mesh.axis_names[0]
+                out[k] = jax.device_put(
+                    v, NamedSharding(self._mesh, P(*spec)))
+            else:
+                # Pytree columns (e.g. target params) and non-divisible
+                # arrays (e.g. [B] bootstrap values in time-major batches)
+                # are replicated.
+                out[k] = jax.device_put(v, self._replicated)
+        return out
+
+    def update_from_batch(self, batch: SampleBatch) -> dict:
+        """One gradient step on one (already minibatched) batch.
+
+        Reference: Learner._update (learner.py:1247)."""
+        if self._update_fn is None:
+            self._update_fn = self._build_update()
+        self._rng, step_rng = jax.random.split(self._rng)
+        dev_batch = self._device_batch(batch)
+        self.params, self.opt_state, metrics = self._update_fn(
+            self.params, self.opt_state, dev_batch, step_rng)
+        self._steps += 1
+        return {k: float(v) for k, v in metrics.items()}
+
+    # -- gradient fan-in path (actor-based LearnerGroup) --------------
+    def compute_gradients(self, batch: SampleBatch) -> tuple:
+        """(grads, metrics) on this learner's shard — used when learners
+        are separate actors/hosts and the group averages gradients
+        (reference: DDP allreduce in torch_learner.py:384-386; here the
+        reduction is done by the group, see learner_group.py)."""
+        if not hasattr(self, "_grad_fn"):
+            def grad_fn(params, batch, rng):
+                (loss, metrics), grads = jax.value_and_grad(
+                    self.compute_loss, has_aux=True)(params, batch, rng)
+                metrics = dict(metrics)
+                metrics["total_loss"] = loss
+                return grads, metrics
+            self._grad_fn = jax.jit(grad_fn)
+        self._rng, step_rng = jax.random.split(self._rng)
+        grads, metrics = self._grad_fn(
+            self.params, self._device_batch(batch), step_rng)
+        return (jax.device_get(grads),
+                {k: float(v) for k, v in metrics.items()})
+
+    def apply_gradients(self, grads) -> None:
+        if not hasattr(self, "_apply_fn"):
+            def apply_fn(params, opt_state, grads):
+                updates, opt_state = self.optimizer.update(
+                    grads, opt_state, params)
+                return optax.apply_updates(params, updates), opt_state
+            self._apply_fn = jax.jit(apply_fn)
+        grads = jax.tree_util.tree_map(jnp.asarray, grads)
+        self.params, self.opt_state = self._apply_fn(
+            self.params, self.opt_state, grads)
+        self._steps += 1
+
+    # -- state -------------------------------------------------------
+    def get_weights(self):
+        return jax.device_get(self.params)
+
+    def set_weights(self, weights) -> None:
+        self.params = jax.tree_util.tree_map(jnp.asarray, weights)
+        if self._mesh is not None:
+            self.params = jax.device_put(self.params, self._replicated)
+
+    def get_state(self) -> dict:
+        return {
+            "params": jax.device_get(self.params),
+            "opt_state": jax.device_get(self.opt_state),
+            "steps": self._steps,
+        }
+
+    def set_state(self, state: dict) -> None:
+        self.params = jax.tree_util.tree_map(jnp.asarray, state["params"])
+        self.opt_state = jax.tree_util.tree_map(
+            jnp.asarray, state["opt_state"])
+        self._steps = state.get("steps", 0)
+
+
+JaxLearner = Learner  # the only framework here is JAX
+
+
+def compute_gae(rewards: jax.Array, values: jax.Array,
+                bootstrap_value: jax.Array, terminateds: jax.Array,
+                truncateds: jax.Array, gamma: float,
+                lam: float) -> tuple:
+    """Generalized advantage estimation over a [T, B] rollout.
+
+    Reference behavior: rllib/evaluation/postprocessing (GAE); computed
+    here as a reverse `lax.scan` inside jit — the whole advantage pass
+    stays on device, no per-episode host loop.
+
+    truncated steps bootstrap from the value function; terminated steps
+    cut the return to the immediate reward.
+    """
+    not_term = 1.0 - terminateds.astype(jnp.float32)
+    # Value of the state after step t: v_{t+1}, bootstrapped at the end.
+    next_values = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0)
+    # At a boundary (terminated OR truncated) the next row of `values`
+    # belongs to a different episode; for truncation we have no stored
+    # v(s_{t+1}) for the pre-reset state, so we approximate it with the
+    # stored value (standard rollout-fragment practice).
+    boundary = jnp.logical_or(terminateds, truncateds).astype(jnp.float32)
+    next_values = jnp.where(truncateds, values, next_values)
+
+    deltas = rewards + gamma * not_term * next_values - values
+
+    def scan_fn(carry, xs):
+        delta, cont = xs
+        adv = delta + gamma * lam * cont * carry
+        return adv, adv
+
+    # GAE accumulation stops at any episode boundary.
+    cont = 1.0 - boundary
+    _, advantages = jax.lax.scan(
+        scan_fn, jnp.zeros_like(bootstrap_value),
+        (deltas, cont), reverse=True)
+    value_targets = advantages + values
+    return advantages, value_targets

@@ -1,0 +1,148 @@
+"""RLModule — the neural-network abstraction of the new API stack.
+
+Reference: rllib/core/rl_module/rl_module.py:237 with the three forward
+passes at :601/:624/:649 (forward_inference / forward_exploration /
+forward_train).
+
+TPU-first departure: the reference RLModule is a stateful
+torch.nn.Module; here an RLModule is a *functional* spec — parameters
+are an explicit pytree created by ``init`` and threaded through pure
+``forward_*`` functions. That makes every pass jittable/shardable with
+no wrapper (the reference needs TorchDDPRLModule,
+core/learner/torch/torch_learner.py:265, to data-parallelize; under
+GSPMD the same function runs on any mesh unchanged).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class RLModule:
+    """Functional policy/value network bundle.
+
+    Subclasses implement ``init`` and the ``forward_*`` methods as pure
+    functions of (params, batch, rng). ``output`` dicts use the column
+    names in utils/sample_batch.py (ACTION_LOGITS, VF_PREDS, ...).
+    """
+
+    def init(self, rng: jax.Array) -> Any:
+        raise NotImplementedError
+
+    def forward_inference(self, params, batch: dict, rng=None) -> dict:
+        """Greedy/deterministic pass (reference rl_module.py:601)."""
+        raise NotImplementedError
+
+    def forward_exploration(self, params, batch: dict, rng=None) -> dict:
+        """Sampling pass used by env runners (reference :624)."""
+        raise NotImplementedError
+
+    def forward_train(self, params, batch: dict, rng=None) -> dict:
+        """Train-time pass used inside the learner loss (reference :649)."""
+        raise NotImplementedError
+
+
+@dataclass
+class RLModuleSpec:
+    """Serializable module constructor (reference:
+    rl_module.py RLModuleSpec). Shipped to env-runner and learner actors;
+    ``build()`` runs on the receiving side."""
+
+    module_class: type | None = None
+    observation_size: int = 0
+    num_actions: int = 0
+    model_config: dict = field(default_factory=dict)
+
+    def build(self) -> "RLModule":
+        cls = self.module_class or DefaultActorCriticModule
+        return cls(self.observation_size, self.num_actions,
+                   **self.model_config)
+
+
+def _mlp_init(rng, sizes):
+    params = []
+    for i, (n_in, n_out) in enumerate(zip(sizes[:-1], sizes[1:])):
+        rng, key = jax.random.split(rng)
+        scale = jnp.sqrt(2.0 / n_in)
+        params.append({
+            "w": jax.random.normal(key, (n_in, n_out)) * scale,
+            "b": jnp.zeros(n_out),
+        })
+    return params
+
+
+def _mlp_apply(params, x, final_activation=None):
+    for i, layer in enumerate(params):
+        x = x @ layer["w"] + layer["b"]
+        if i < len(params) - 1:
+            x = jnp.tanh(x)
+        elif final_activation is not None:
+            x = final_activation(x)
+    return x
+
+
+class DefaultActorCriticModule(RLModule):
+    """Shared-nothing MLP actor-critic for discrete actions.
+
+    Reference analogue: rllib's default PPO catalog model (separate pi and
+    vf MLP towers, tanh activations).
+    """
+
+    def __init__(self, observation_size: int, num_actions: int,
+                 hidden: tuple = (64, 64), **_):
+        self.observation_size = observation_size
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+
+    def init(self, rng):
+        pi_rng, vf_rng = jax.random.split(rng)
+        sizes = (self.observation_size,) + self.hidden
+        return {
+            "pi": _mlp_init(pi_rng, sizes + (self.num_actions,)),
+            "vf": _mlp_init(vf_rng, sizes + (1,)),
+        }
+
+    def _logits_and_value(self, params, obs):
+        logits = _mlp_apply(params["pi"], obs)
+        value = _mlp_apply(params["vf"], obs)[..., 0]
+        return logits, value
+
+    def forward_inference(self, params, batch, rng=None):
+        logits, value = self._logits_and_value(params, batch["obs"])
+        return {"action_logits": logits, "vf_preds": value,
+                "actions": jnp.argmax(logits, axis=-1)}
+
+    def forward_exploration(self, params, batch, rng=None):
+        logits, value = self._logits_and_value(params, batch["obs"])
+        actions = jax.random.categorical(rng, logits)
+        logp = jax.nn.log_softmax(logits)
+        action_logp = jnp.take_along_axis(
+            logp, actions[..., None], axis=-1)[..., 0]
+        return {"action_logits": logits, "vf_preds": value,
+                "actions": actions, "action_logp": action_logp}
+
+    def forward_train(self, params, batch, rng=None):
+        logits, value = self._logits_and_value(params, batch["obs"])
+        return {"action_logits": logits, "vf_preds": value}
+
+
+def categorical_logp(logits: jax.Array, actions: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits)
+    return jnp.take_along_axis(logp, actions[..., None].astype(jnp.int32),
+                               axis=-1)[..., 0]
+
+
+def categorical_entropy(logits: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+def categorical_kl(logits_p: jax.Array, logits_q: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits_p)
+    logq = jax.nn.log_softmax(logits_q)
+    return jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1)

@@ -1,0 +1,167 @@
+"""EnvRunner — rollout collection actors.
+
+Reference: rllib/env/env_runner.py (EnvRunner API) and
+single_agent_env_runner.py:27/:125 (SingleAgentEnvRunner.sample — the
+rollout hot loop). Design differences for TPU:
+
+- envs are stepped as a batched vector env (numpy), so the policy
+  forward is ONE jitted call over [B, obs] per env step — the classic
+  per-env Python loop never appears;
+- the runner keeps module params as a host-local pytree; inference runs
+  on whatever backend jit picks (CPU for rollout actors, so the TPU
+  stays dedicated to the learner);
+- output is a time-major SampleBatch fragment [T, B], which is exactly
+  the layout GAE/V-trace scans want — no transpose on the learner.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.env.vector_env import make_vector_env
+from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
+
+
+class SingleAgentEnvRunner:
+    """Collects fixed-length rollout fragments from a vector env."""
+
+    def __init__(self, *, env_id: str, module_spec: RLModuleSpec,
+                 num_envs: int = 8, rollout_fragment_length: int = 64,
+                 seed: int = 0, worker_index: int = 0,
+                 explore: bool = True, inference_backend: str = "cpu"):
+        self.worker_index = worker_index
+        # Rollout inference defaults to the CPU backend: per-step policy
+        # calls are tiny and latency-bound, and pinning them to CPU keeps
+        # the TPU dedicated to the learner (the reference gets this for
+        # free because env runners are plain CPU actors).
+        try:
+            self._device = jax.local_devices(backend=inference_backend)[0]
+        except RuntimeError:
+            self._device = None
+        self.env = make_vector_env(env_id, num_envs)
+        self.module = module_spec.build()
+        self.rollout_fragment_length = rollout_fragment_length
+        self.explore = explore
+        # The PRNG key is derived *inside* the jitted step from a host
+        # integer, so no device-committed key ever leaks across backends
+        # (host ints are uncommitted; execution stays on the rollout
+        # device).
+        self._seed_base = np.uint32((seed * 100003 + worker_index * 7919)
+                                    & 0x7FFFFFFF)
+        self._step_counter = 0
+        self._weights = None
+        self._weights_version = -1
+        self._obs = self.env.reset(seed=seed * 7919 + worker_index)
+        # Per-env episode-return accounting for metrics.
+        self._ep_return = np.zeros(self.env.num_envs, dtype=np.float64)
+        self._ep_len = np.zeros(self.env.num_envs, dtype=np.int64)
+        self._completed_returns: list[float] = []
+        self._completed_lengths: list[int] = []
+
+        fwd = (self.module.forward_exploration if explore
+               else self.module.forward_inference)
+
+        def policy_step(params, obs, seed):
+            rng = jax.random.fold_in(
+                jax.random.PRNGKey(self._seed_base), seed)
+            # "t" doubles as the exploration-schedule clock (e.g. DQN's
+            # epsilon decay); traced, so no retrace as it changes.
+            return fwd(params, {"obs": obs, "t": seed}, rng)
+
+        jitted = jax.jit(policy_step)
+        if self._device is not None:
+            device = self._device
+
+            def policy_on_device(params, obs, rng):
+                with jax.default_device(device):
+                    return jitted(params, obs, rng)
+
+            self._policy_step = policy_on_device
+        else:
+            self._policy_step = jitted
+
+    # -- weights sync ------------------------------------------------
+    def set_weights(self, weights, version: int = 0) -> None:
+        self._weights = weights
+        self._weights_version = version
+
+    def get_weights_version(self) -> int:
+        return self._weights_version
+
+    # -- sampling ----------------------------------------------------
+    def sample(self, num_steps: int | None = None) -> SampleBatch:
+        """Collect a [T, B] fragment. Hot loop: one vectorized env step +
+        one jitted policy call per T."""
+        assert self._weights is not None, "set_weights() before sample()"
+        T = num_steps or self.rollout_fragment_length
+        B = self.env.num_envs
+        cols: dict[str, list] = {k: [] for k in (
+            Columns.OBS, Columns.ACTIONS, Columns.REWARDS,
+            Columns.TERMINATEDS, Columns.TRUNCATEDS, Columns.ACTION_LOGP,
+            Columns.VF_PREDS, Columns.ACTION_LOGITS)}
+
+        obs = self._obs
+        for _ in range(T):
+            self._step_counter += 1
+            out = self._policy_step(self._weights, obs,
+                                    self._step_counter)
+            actions = np.asarray(out["actions"])
+            next_obs, rewards, term, trunc = self.env.step(actions)
+
+            cols[Columns.OBS].append(obs)
+            cols[Columns.ACTIONS].append(actions)
+            cols[Columns.REWARDS].append(rewards)
+            cols[Columns.TERMINATEDS].append(term)
+            cols[Columns.TRUNCATEDS].append(trunc)
+            cols[Columns.ACTION_LOGP].append(
+                np.asarray(out.get("action_logp", np.zeros(B))))
+            cols[Columns.VF_PREDS].append(
+                np.asarray(out.get("vf_preds", np.zeros(B))))
+            cols[Columns.ACTION_LOGITS].append(
+                np.asarray(out["action_logits"]))
+
+            self._ep_return += rewards
+            self._ep_len += 1
+            done = term | trunc
+            if done.any():
+                for i in np.flatnonzero(done):
+                    self._completed_returns.append(float(self._ep_return[i]))
+                    self._completed_lengths.append(int(self._ep_len[i]))
+                self._ep_return[done] = 0.0
+                self._ep_len[done] = 0
+            obs = next_obs
+
+        self._obs = obs
+        batch = SampleBatch(
+            {k: np.stack(v, axis=0) for k, v in cols.items()})
+        # Bootstrap values for the final obs of each env lane: one more
+        # policy call on the current obs.
+        self._step_counter += 1
+        out = self._policy_step(self._weights, obs, self._step_counter)
+        batch["bootstrap_value"] = np.asarray(out.get(
+            "vf_preds", np.zeros(B)))
+        batch["weights_version"] = np.full(
+            (batch[Columns.OBS].shape[0],), self._weights_version,
+            dtype=np.int64)
+        return batch
+
+    def get_metrics(self) -> dict:
+        """Drain episode metrics (reference: env runner metrics logger)."""
+        rets, lens = self._completed_returns, self._completed_lengths
+        self._completed_returns, self._completed_lengths = [], []
+        if not rets:
+            return {"num_episodes": 0}
+        return {
+            "num_episodes": len(rets),
+            "episode_return_mean": float(np.mean(rets)),
+            "episode_return_max": float(np.max(rets)),
+            "episode_return_min": float(np.min(rets)),
+            "episode_len_mean": float(np.mean(lens)),
+        }
+
+    def ping(self) -> str:
+        return "pong"

@@ -1,0 +1,133 @@
+"""Vectorized environments for rollout actors.
+
+Reference: rllib's EnvRunner wraps gymnasium vector envs
+(rllib/env/single_agent_env_runner.py:27). Here the built-in envs are
+pure-numpy batched implementations — the rollout hot loop steps B envs
+in one vectorized call with no per-env Python loop, which is what feeds
+a jitted batched policy efficiently. gymnasium envs are supported via
+``gym_vector_env`` when a non-builtin id is requested.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class VectorEnv:
+    """B independent env copies stepped in lockstep (auto-reset on done)."""
+
+    num_envs: int
+    observation_size: int
+    num_actions: int
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, actions: np.ndarray):
+        """-> (obs, rewards, terminateds, truncateds). Auto-resets done
+        envs; the returned obs for a done env is the fresh reset obs."""
+        raise NotImplementedError
+
+
+class CartPoleVectorEnv(VectorEnv):
+    """Batched CartPole-v1 (classic control; standard physics constants).
+
+    Matches gymnasium's CartPole-v1 dynamics and termination thresholds
+    so learning curves are comparable; 500-step truncation.
+    """
+
+    GRAVITY = 9.8
+    MASSCART = 1.0
+    MASSPOLE = 0.1
+    LENGTH = 0.5  # half pole length
+    FORCE_MAG = 10.0
+    TAU = 0.02
+    THETA_LIMIT = 12 * 2 * np.pi / 360
+    X_LIMIT = 2.4
+    MAX_STEPS = 500
+
+    observation_size = 4
+    num_actions = 2
+
+    def __init__(self, num_envs: int = 8, max_steps: int | None = None):
+        self.num_envs = num_envs
+        self.max_steps = max_steps or self.MAX_STEPS
+        self._state = np.zeros((num_envs, 4), dtype=np.float64)
+        self._t = np.zeros(num_envs, dtype=np.int64)
+        self._rng = np.random.default_rng(0)
+
+    def _sample_state(self, n: int) -> np.ndarray:
+        return self._rng.uniform(-0.05, 0.05, size=(n, 4))
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._state = self._sample_state(self.num_envs)
+        self._t[:] = 0
+        return self._state.astype(np.float32)
+
+    def step(self, actions: np.ndarray):
+        x, x_dot, theta, theta_dot = self._state.T
+        force = np.where(actions == 1, self.FORCE_MAG, -self.FORCE_MAG)
+        costheta, sintheta = np.cos(theta), np.sin(theta)
+        total_mass = self.MASSCART + self.MASSPOLE
+        polemass_length = self.MASSPOLE * self.LENGTH
+        temp = (force + polemass_length * theta_dot**2 * sintheta) / total_mass
+        thetaacc = (self.GRAVITY * sintheta - costheta * temp) / (
+            self.LENGTH * (4.0 / 3.0 - self.MASSPOLE * costheta**2 / total_mass))
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+        x = x + self.TAU * x_dot
+        x_dot = x_dot + self.TAU * xacc
+        theta = theta + self.TAU * theta_dot
+        theta_dot = theta_dot + self.TAU * thetaacc
+        self._state = np.stack([x, x_dot, theta, theta_dot], axis=1)
+        self._t += 1
+
+        terminated = ((np.abs(x) > self.X_LIMIT)
+                      | (np.abs(theta) > self.THETA_LIMIT))
+        truncated = (~terminated) & (self._t >= self.max_steps)
+        rewards = np.ones(self.num_envs, dtype=np.float32)
+
+        done = terminated | truncated
+        if done.any():
+            self._state[done] = self._sample_state(int(done.sum()))
+            self._t[done] = 0
+        return (self._state.astype(np.float32), rewards,
+                terminated, truncated)
+
+
+class GymVectorEnv(VectorEnv):
+    """Adapter over gymnasium.vector.SyncVectorEnv for non-builtin ids."""
+
+    def __init__(self, env_id: str, num_envs: int = 8):
+        import gymnasium as gym
+
+        self.num_envs = num_envs
+        self._env = gym.vector.SyncVectorEnv(
+            [lambda: gym.make(env_id) for _ in range(num_envs)])
+        space = self._env.single_observation_space
+        self.observation_size = int(np.prod(space.shape))
+        self.num_actions = int(self._env.single_action_space.n)
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        obs, _ = self._env.reset(seed=seed)
+        return obs.reshape(self.num_envs, -1).astype(np.float32)
+
+    def step(self, actions: np.ndarray):
+        obs, rewards, term, trunc, _ = self._env.step(np.asarray(actions))
+        return (obs.reshape(self.num_envs, -1).astype(np.float32),
+                rewards.astype(np.float32), term, trunc)
+
+
+_BUILTIN = {"CartPole-v1": CartPoleVectorEnv}
+
+
+def make_vector_env(env_id: str, num_envs: int) -> VectorEnv:
+    if env_id in _BUILTIN:
+        return _BUILTIN[env_id](num_envs)
+    return GymVectorEnv(env_id, num_envs)
+
+
+def register_env(env_id: str, factory) -> None:
+    """Register a VectorEnv factory (reference: ray.tune.register_env)."""
+    _BUILTIN[env_id] = factory

@@ -1,0 +1,118 @@
+"""Fault-tolerant actor fan-out.
+
+Reference: rllib/utils/actor_manager.py (FaultTolerantActorManager) —
+tolerates env-runner actor failures: broken actors are marked unhealthy,
+calls skip them, and ``probe_unhealthy_actors`` restarts replacements.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError, ActorError, TaskError
+
+
+class FaultTolerantActorManager:
+    """Manages a homogeneous set of actor handles with health tracking."""
+
+    def __init__(self, actors: list, *, actor_factory: Callable | None = None,
+                 max_remote_requests_in_flight_per_actor: int = 2):
+        self._actors: dict[int, Any] = dict(enumerate(actors))
+        self._healthy: dict[int, bool] = {i: True for i in self._actors}
+        self._factory = actor_factory
+        self._max_in_flight = max_remote_requests_in_flight_per_actor
+        self._in_flight: dict[int, list] = {i: [] for i in self._actors}
+
+    # -- introspection ----------------------------------------------
+    def num_actors(self) -> int:
+        return len(self._actors)
+
+    def num_healthy_actors(self) -> int:
+        return sum(self._healthy.values())
+
+    def healthy_actor_ids(self) -> list[int]:
+        return [i for i, ok in self._healthy.items() if ok]
+
+    def actor(self, actor_id: int):
+        return self._actors[actor_id]
+
+    # -- sync fan-out -------------------------------------------------
+    def foreach_actor(self, fn_name: str, *args,
+                      timeout: float | None = 60.0,
+                      **kwargs) -> list:
+        """Call ``fn_name(*args)`` on every healthy actor; returns results
+        in actor-id order, skipping (and marking) failed actors."""
+        refs = {}
+        for i in self.healthy_actor_ids():
+            method = getattr(self._actors[i], fn_name)
+            refs[i] = method.remote(*args, **kwargs)
+        results = []
+        for i, ref in refs.items():
+            try:
+                results.append(ray_tpu.get(ref, timeout=timeout))
+            except (ActorError, ActorDiedError, TaskError, TimeoutError):
+                self._healthy[i] = False
+        return results
+
+    # -- async fan-out ------------------------------------------------
+    def submit(self, fn_name: str, *args, actor_id: int | None = None,
+               **kwargs):
+        """Fire a call without waiting; bounded in-flight per actor.
+        Returns (actor_id, ref) or None if saturated/unhealthy."""
+        candidates = ([actor_id] if actor_id is not None
+                      else self.healthy_actor_ids())
+        for i in candidates:
+            if not self._healthy.get(i):
+                continue
+            pending = self._in_flight[i]
+            if pending:
+                _, pending = ray_tpu.wait(
+                    pending, num_returns=len(pending), timeout=0)
+            self._in_flight[i] = pending
+            if len(self._in_flight[i]) >= self._max_in_flight:
+                continue
+            ref = getattr(self._actors[i], fn_name).remote(*args, **kwargs)
+            self._in_flight[i].append(ref)
+            return i, ref
+        return None
+
+    def fetch_ready(self, refs: list, timeout: float = 0.01) -> tuple:
+        """(ready_results, remaining_refs); failures mark actors sick."""
+        if not refs:
+            return [], []
+        ready, _ = ray_tpu.wait(
+            [r for _, r in refs], num_returns=len(refs), timeout=timeout)
+        ready_set = {id(r) for r in ready}
+        results, remaining = [], []
+        for actor_id, ref in refs:
+            if id(ref) in ready_set:
+                try:
+                    results.append((actor_id, ray_tpu.get(ref)))
+                except (ActorError, ActorDiedError, TaskError):
+                    self._healthy[actor_id] = False
+            else:
+                remaining.append((actor_id, ref))
+        return results, remaining
+
+    # -- recovery -----------------------------------------------------
+    def probe_unhealthy_actors(self) -> list[int]:
+        """Try to replace dead actors via the factory (reference:
+        FaultTolerantActorManager.probe_unhealthy_actors)."""
+        restored = []
+        for i, ok in list(self._healthy.items()):
+            if ok:
+                continue
+            try:
+                ray_tpu.get(self._actors[i].ping.remote(), timeout=1.0)
+                self._healthy[i] = True
+                restored.append(i)
+                continue
+            except Exception:
+                pass
+            if self._factory is not None:
+                self._actors[i] = self._factory(i)
+                self._in_flight[i] = []
+                self._healthy[i] = True
+                restored.append(i)
+        return restored

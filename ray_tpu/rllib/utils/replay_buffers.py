@@ -1,0 +1,87 @@
+"""Replay buffers for off-policy algorithms.
+
+Reference: rllib/utils/replay_buffers/ (EpisodeReplayBuffer
+episode_replay_buffer.py:14, prioritized variant). Stored as
+preallocated numpy ring buffers over flat transitions — sampling
+produces fixed-shape batches, so the learner's jitted update never
+recompiles.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
+
+
+class ReplayBuffer:
+    """Uniform FIFO transition buffer."""
+
+    def __init__(self, capacity: int = 100_000, seed: int = 0):
+        self.capacity = capacity
+        self._storage: dict[str, np.ndarray] = {}
+        self._idx = 0
+        self._size = 0
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add(self, batch: SampleBatch) -> None:
+        """Append flat [N, ...] transitions."""
+        n = len(batch)
+        if not self._storage:
+            for k, v in batch.items():
+                v = np.asarray(v)
+                self._storage[k] = np.zeros(
+                    (self.capacity,) + v.shape[1:], dtype=v.dtype)
+        for k, v in batch.items():
+            v = np.asarray(v)
+            idx = (self._idx + np.arange(n)) % self.capacity
+            self._storage[k][idx] = v
+        self._idx = (self._idx + n) % self.capacity
+        self._size = min(self._size + n, self.capacity)
+
+    def sample(self, batch_size: int) -> SampleBatch:
+        idx = self._rng.integers(0, self._size, size=batch_size)
+        return SampleBatch({k: v[idx] for k, v in self._storage.items()})
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional prioritized replay (Schaul et al. 2016).
+
+    Reference: rllib/utils/replay_buffers/prioritized_episode_buffer.
+    Priorities kept in a flat array; sampling is O(N) numpy (fine for
+    host-side buffers — the TPU never sees this path).
+    """
+
+    def __init__(self, capacity: int = 100_000, alpha: float = 0.6,
+                 beta: float = 0.4, seed: int = 0):
+        super().__init__(capacity, seed)
+        self.alpha = alpha
+        self.beta = beta
+        self._priorities = np.zeros(capacity, dtype=np.float64)
+        self._max_priority = 1.0
+
+    def add(self, batch: SampleBatch) -> None:
+        n = len(batch)
+        idx = (self._idx + np.arange(n)) % self.capacity
+        super().add(batch)
+        self._priorities[idx] = self._max_priority
+
+    def sample(self, batch_size: int) -> SampleBatch:
+        prios = self._priorities[:self._size] ** self.alpha
+        probs = prios / prios.sum()
+        idx = self._rng.choice(self._size, size=batch_size, p=probs)
+        weights = (self._size * probs[idx]) ** (-self.beta)
+        weights /= weights.max()
+        out = SampleBatch({k: v[idx] for k, v in self._storage.items()})
+        out["batch_indexes"] = idx
+        out["weights"] = weights.astype(np.float32)
+        return out
+
+    def update_priorities(self, idx: np.ndarray,
+                          td_errors: np.ndarray) -> None:
+        prios = np.abs(td_errors) + 1e-6
+        self._priorities[idx] = prios
+        self._max_priority = max(self._max_priority, float(prios.max()))

@@ -1,0 +1,112 @@
+"""Columnar sample batches.
+
+Reference: rllib/policy/sample_batch.py:99 (SampleBatch) — a dict of
+equal-length columns with concat/slice/minibatch utilities. Here columns
+are numpy or jax arrays; batches are the unit shipped from env runners
+to learners through the object store.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Mapping
+
+import numpy as np
+
+
+class Columns:
+    """Canonical column names (reference: rllib/core/columns.py)."""
+
+    OBS = "obs"
+    NEXT_OBS = "next_obs"
+    ACTIONS = "actions"
+    REWARDS = "rewards"
+    TERMINATEDS = "terminateds"
+    TRUNCATEDS = "truncateds"
+    ACTION_LOGP = "action_logp"
+    ACTION_LOGITS = "action_logits"
+    VF_PREDS = "vf_preds"
+    ADVANTAGES = "advantages"
+    VALUE_TARGETS = "value_targets"
+    EPS_ID = "eps_id"
+    T = "t"
+
+
+class SampleBatch(dict):
+    """A dict of columns, all with the same leading dimension.
+
+    Reference: rllib/policy/sample_batch.py:99. Unlike the reference this
+    is a plain dict subclass holding numpy/jax arrays; no compression or
+    lazy views — the object store handles zero-copy.
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+
+    def __len__(self) -> int:
+        for v in self.values():
+            return int(np.shape(v)[0])
+        return 0
+
+    @property
+    def count(self) -> int:
+        return len(self)
+
+    def slice(self, start: int, end: int) -> "SampleBatch":
+        return SampleBatch({k: v[start:end] for k, v in self.items()})
+
+    def shuffle(self, rng: np.random.Generator | None = None) -> "SampleBatch":
+        rng = rng or np.random.default_rng()
+        perm = rng.permutation(len(self))
+        return SampleBatch({k: np.asarray(v)[perm] for k, v in self.items()})
+
+    def minibatches(self, size: int,
+                    rng: np.random.Generator | None = None,
+                    shuffle: bool = True) -> Iterator["SampleBatch"]:
+        """Equal-size minibatches; the tail remainder is dropped so every
+        jitted update sees a static shape (XLA recompiles per shape)."""
+        batch = self.shuffle(rng) if shuffle else self
+        n = len(batch)
+        for start in range(0, n - size + 1, size):
+            yield batch.slice(start, start + size)
+
+    @staticmethod
+    def concat(batches: "list[SampleBatch]") -> "SampleBatch":
+        if not batches:
+            return SampleBatch()
+        keys = batches[0].keys()
+        return SampleBatch({
+            k: np.concatenate([np.asarray(b[k]) for b in batches], axis=0)
+            for k in keys
+        })
+
+    def to_numpy(self) -> "SampleBatch":
+        return SampleBatch({k: np.asarray(v) for k, v in self.items()})
+
+    def split_n(self, n: int) -> "list[SampleBatch]":
+        """Split into n near-equal shards (for data-parallel learners)."""
+        size = len(self) // n
+        return [self.slice(i * size, (i + 1) * size) for i in range(n)]
+
+
+def pad_to_multiple(batch: SampleBatch, multiple: int,
+                    pad_value: float = 0.0) -> tuple[SampleBatch, np.ndarray]:
+    """Pad all columns to a multiple of ``multiple`` along axis 0.
+
+    Returns (padded_batch, mask) where mask is 1.0 for real rows. Keeps
+    shapes static-friendly for XLA: a handful of bucket sizes instead of
+    arbitrary lengths.
+    """
+    n = len(batch)
+    target = ((n + multiple - 1) // multiple) * multiple
+    pad = target - n
+    mask = np.ones(target, dtype=np.float32)
+    if pad:
+        mask[n:] = 0.0
+        batch = SampleBatch({
+            k: np.concatenate(
+                [np.asarray(v),
+                 np.full((pad,) + np.shape(v)[1:], pad_value,
+                         dtype=np.asarray(v).dtype)], axis=0)
+            for k, v in batch.items()
+        })
+    return batch, mask

@@ -225,17 +225,22 @@ class JaxTrainer(DataParallelTrainer):
                 cfg.setdefault("process_id", get_context().get_world_rank())
                 try:
                     jax.distributed.initialize(**cfg)
-                except RuntimeError:
-                    # Already initialized (e.g. workers sharing a process in
+                except RuntimeError as e:
+                    # Tolerate ONLY double-init (workers sharing a process in
                     # the local runtime, or a restart within one process).
-                    pass
+                    # Anything else (coordinator unreachable, deadline
+                    # exceeded) must fail loudly or the gang silently
+                    # trains with the wrong world size.
+                    if "already initialized" not in str(e).lower():
+                        raise
             elif os.environ.get("JAX_COORDINATOR_ADDRESS"):
                 # Multi-host launch configured via env (the analogue of
                 # torchrun env:// rendezvous); idempotent per process.
                 try:
                     jax.distributed.initialize()
-                except RuntimeError:
-                    pass  # already initialized by the launcher
+                except RuntimeError as e:
+                    if "already initialized" not in str(e).lower():
+                        raise
             return loop(config)
 
         return wrapped
