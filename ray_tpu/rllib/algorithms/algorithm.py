@@ -272,7 +272,7 @@ class Algorithm(Trainable):
         return checkpoint_dir
 
     def load_checkpoint(self, checkpoint) -> None:
-        path = checkpoint if isinstance(checkpoint, str) else checkpoint
+        path = checkpoint if isinstance(checkpoint, str) else checkpoint.path
         state_file = os.path.join(path, "algorithm_state.pkl")
         with open(state_file, "rb") as f:
             state = pickle.load(f)

@@ -143,6 +143,20 @@ class DQNLearner(Learner):
                 jnp.copy, self.params)
         return metrics
 
+    def compute_gradients(self, batch: SampleBatch) -> tuple:
+        # The actor-based LearnerGroup sharded path calls this directly
+        # (bypassing update_from_batch), so target params must ride in
+        # here too.
+        batch = SampleBatch(batch)
+        batch["target_params"] = self.target_params
+        return super().compute_gradients(batch)
+
+    def apply_gradients(self, grads) -> None:
+        super().apply_gradients(grads)
+        if self._steps % getattr(self.config, "target_update_freq", 200) == 0:
+            self.target_params = jax.tree_util.tree_map(
+                jnp.copy, self.params)
+
     def compute_td_errors(self, batch: SampleBatch) -> np.ndarray:
         """Per-row |TD error| for priority updates (post-update params)."""
         if not hasattr(self, "_td_fn"):

@@ -31,32 +31,42 @@ from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
 
 
 def vtrace(behavior_logp, target_logp, rewards, values, bootstrap_value,
-           terminateds, gamma, clip_rho_threshold=1.0,
+           terminateds, truncateds, gamma, clip_rho_threshold=1.0,
            clip_c_threshold=1.0):
     """V-trace targets (Espeholt et al. 2018) over a [T, B] fragment.
 
     Pure-JAX reverse scan; everything stays on device inside the jitted
     learner update.
+
+    Episode boundaries inside the fragment: termination cuts the return
+    to the immediate reward; truncation bootstraps from the value
+    function, approximating v(s_true_next) with the stored v(s_t) (the
+    auto-reset next row belongs to a NEW episode — same convention as
+    compute_gae in core/learner.py).
     """
     rhos = jnp.exp(target_logp - behavior_logp)
     clipped_rhos = jnp.minimum(clip_rho_threshold, rhos)
     cs = jnp.minimum(clip_c_threshold, rhos)
     not_term = 1.0 - terminateds.astype(jnp.float32)
+    boundary = jnp.logical_or(terminateds, truncateds)
+    cont = 1.0 - boundary.astype(jnp.float32)
 
     next_values = jnp.concatenate([values[1:], bootstrap_value[None]], axis=0)
+    next_values = jnp.where(truncateds, values, next_values)
     deltas = clipped_rhos * (
         rewards + gamma * not_term * next_values - values)
 
     def scan_fn(acc, xs):
-        delta, c, nt = xs
-        acc = delta + gamma * nt * c * acc
+        delta, c, ct = xs
+        acc = delta + gamma * ct * c * acc
         return acc, acc
 
     _, vs_minus_v = jax.lax.scan(
         scan_fn, jnp.zeros_like(bootstrap_value),
-        (deltas, cs, not_term), reverse=True)
+        (deltas, cs, cont), reverse=True)
     vs = vs_minus_v + values
     next_vs = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    next_vs = jnp.where(truncateds, values, next_vs)
     pg_advantages = clipped_rhos * (
         rewards + gamma * not_term * next_vs - values)
     return jax.lax.stop_gradient(vs), jax.lax.stop_gradient(pg_advantages)
@@ -100,8 +110,8 @@ class IMPALALearner(Learner):
         vs, pg_adv = vtrace(
             batch[Columns.ACTION_LOGP], target_logp,
             batch[Columns.REWARDS], values, batch["bootstrap_value"],
-            batch[Columns.TERMINATEDS], cfg.gamma,
-            cfg.clip_rho_threshold, cfg.clip_c_threshold)
+            batch[Columns.TERMINATEDS], batch[Columns.TRUNCATEDS],
+            cfg.gamma, cfg.clip_rho_threshold, cfg.clip_c_threshold)
 
         pg_loss = -jnp.mean(target_logp * pg_adv)
         vf_loss = 0.5 * jnp.mean(jnp.square(values - vs))
@@ -164,7 +174,8 @@ class IMPALA(Algorithm):
                 sb = SampleBatch({
                     k: batch[k] for k in (
                         Columns.OBS, Columns.ACTIONS, Columns.REWARDS,
-                        Columns.TERMINATEDS, Columns.ACTION_LOGP)})
+                        Columns.TERMINATEDS, Columns.TRUNCATEDS,
+                        Columns.ACTION_LOGP)})
                 sb["bootstrap_value"] = batch["bootstrap_value"]
                 metrics = self.learner_group.update_from_batch(
                     sb, shard=False)

@@ -1,0 +1,174 @@
+"""Public Serve API: start / run / shutdown / handles / status.
+
+Reference: python/ray/serve/api.py — serve.start (:61), serve.run
+(:439), plus handle accessors. The controller is a detached named actor;
+``run`` walks the bound application graph, deploys dependencies first
+(their init-arg positions become DeploymentHandles inside the consuming
+replica), then waits for the ingress deployment to be ready.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any
+
+import ray_tpu
+from ray_tpu.serve.config import HTTPOptions
+from ray_tpu.serve.controller import ServeController
+from ray_tpu.serve.deployment import Application, Deployment
+from ray_tpu.serve.router import DeploymentHandle, clear_routers
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+_lock = threading.Lock()
+_controller = None
+_proxy = None
+_apps: dict[str, Application] = {}
+
+
+@dataclasses.dataclass
+class _HandleMarker:
+    """Placeholder for a bound sub-deployment in init args; the replica
+    swaps it for a live DeploymentHandle at construction time."""
+
+    app_name: str
+    deployment_name: str
+
+
+def _get_controller():
+    global _controller
+    with _lock:
+        if _controller is not None:
+            return _controller
+        ray_tpu.init(ignore_reinit_error=True)
+        try:
+            _controller = ray_tpu.get_actor(CONTROLLER_NAME)
+        except Exception:  # noqa: BLE001 — not running yet
+            _controller = ray_tpu.remote(ServeController).options(
+                name=CONTROLLER_NAME, max_concurrency=32).remote()
+        return _controller
+
+
+def start(http_options: HTTPOptions | dict | None = None, **kwargs):
+    """Start Serve (controller + optional HTTP proxy). Reference:
+    serve/api.py:61."""
+    global _proxy
+    controller = _get_controller()
+    if http_options is not None:
+        if isinstance(http_options, dict):
+            http_options = HTTPOptions(**http_options)
+        with _lock:
+            if _proxy is None:
+                from ray_tpu.serve.proxy import HTTPProxy
+
+                _proxy = HTTPProxy(controller, http_options)
+                _proxy.start()
+    return controller
+
+
+def _deploy_graph(app: Application, app_name: str, controller) -> None:
+    """Depth-first deploy of bound dependencies, then the node itself."""
+
+    def convert(value):
+        if isinstance(value, Application):
+            _deploy_graph(value, app_name, controller)
+            return _HandleMarker(app_name, value.deployment.name)
+        return value
+
+    init_args = tuple(convert(a) for a in app.init_args)
+    init_kwargs = {k: convert(v) for k, v in app.init_kwargs.items()}
+    dep: Deployment = app.deployment
+    replica_config = dep.build_replica_config()
+    replica_config.init_args = init_args
+    replica_config.init_kwargs = init_kwargs
+    ray_tpu.get(controller.deploy.remote(
+        app_name, dep.name, dep.deployment_config, replica_config))
+
+
+def run(target: Application, *, name: str = "default",
+        route_prefix: str | None = "/", blocking: bool = False,
+        _wait_s: float = 30.0) -> DeploymentHandle:
+    """Deploy an application and return a handle to its ingress
+    deployment (reference: serve/api.py:439)."""
+    if isinstance(target, Deployment):
+        target = target.bind()
+    if not isinstance(target, Application):
+        raise TypeError(f"serve.run expects a bound Application, "
+                        f"got {type(target)}")
+    controller = _get_controller()
+    _deploy_graph(target, name, controller)
+    with _lock:
+        _apps[name] = target
+        target.deployment.route_prefix = (
+            target.deployment.route_prefix or route_prefix)
+    handle = DeploymentHandle(
+        target._ingress_name(), name, controller)
+    # Wait for the ingress deployment to reach its replica target (falls
+    # through at the deadline; the router also waits for membership).
+    deadline = time.monotonic() + _wait_s
+    key = f"{name}::{target._ingress_name()}"
+    while time.monotonic() < deadline:
+        status = ray_tpu.get(controller.get_status.remote())
+        info = status.get(key)
+        if info and info["running_replicas"] >= info["target_replicas"]:
+            break
+        time.sleep(0.05)
+    if blocking:
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+    return handle
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    controller = _get_controller()
+    with _lock:
+        app = _apps.get(name)
+    if app is not None:
+        return DeploymentHandle(app._ingress_name(), name, controller)
+    # Fall back to controller state (handle from another process).
+    for app_name, dep_name in ray_tpu.get(
+            controller.list_deployments.remote()):
+        if app_name == name:
+            return DeploymentHandle(dep_name, name, controller)
+    raise KeyError(f"no Serve application named {name!r}")
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, app_name, _get_controller())
+
+
+def status() -> dict:
+    controller = _get_controller()
+    return ray_tpu.get(controller.get_status.remote())
+
+
+def delete(name: str) -> None:
+    controller = _get_controller()
+    ray_tpu.get(controller.delete_app.remote(name))
+    with _lock:
+        _apps.pop(name, None)
+
+
+def shutdown() -> None:
+    """Tear down proxy, routers, controller, and all replicas."""
+    global _controller, _proxy
+    with _lock:
+        proxy, _proxy = _proxy, None
+        controller, _controller = _controller, None
+        _apps.clear()
+    if proxy is not None:
+        proxy.stop()
+    clear_routers()
+    if controller is not None:
+        try:
+            ray_tpu.get(controller.shutdown.remote(), timeout=10)
+            time.sleep(0.2)  # let the reconcile loop drain replicas
+            ray_tpu.kill(controller, no_restart=True)
+        except Exception:  # noqa: BLE001 — already down
+            pass

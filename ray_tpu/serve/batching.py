@@ -1,0 +1,118 @@
+"""Dynamic request batching: @serve.batch.
+
+Reference: python/ray/serve/batching.py (:436 ``batch`` decorator) — calls
+to the wrapped method are queued; a batcher drains up to
+``max_batch_size`` items (waiting at most ``batch_wait_timeout_s`` for the
+batch to fill), invokes the underlying function ONCE with the list of
+inputs, and scatters the list of outputs back to the callers.
+
+TPU note: this is the key to feeding the MXU from many small requests —
+the wrapped function sees a batch and can run one jitted forward pass.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import functools
+import threading
+import time
+from typing import Any, Callable
+
+
+class _Batcher:
+    def __init__(self, fn: Callable, max_batch_size: int,
+                 batch_wait_timeout_s: float):
+        self._fn = fn
+        self._max_batch_size = max_batch_size
+        self._wait_s = batch_wait_timeout_s
+        self._lock = threading.Condition()
+        self._queue: list[tuple[Any, concurrent.futures.Future]] = []
+        self._thread: threading.Thread | None = None
+
+    def submit(self, instance, item: Any) -> concurrent.futures.Future:
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        with self._lock:
+            self._queue.append((item, fut))
+            # The loop only exits under this lock with an empty queue
+            # (clearing self._thread), so a live self._thread is
+            # guaranteed to see this item — no lost-wakeup race.
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, args=(instance,),
+                    name="serve-batcher", daemon=True)
+                self._thread.start()
+            self._lock.notify_all()
+        return fut
+
+    def _take_batch(self) -> list[tuple[Any, concurrent.futures.Future]]:
+        deadline = time.monotonic() + self._wait_s
+        with self._lock:
+            while True:
+                if len(self._queue) >= self._max_batch_size:
+                    batch = self._queue[:self._max_batch_size]
+                    del self._queue[:self._max_batch_size]
+                    return batch
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or (self._queue and not self._wait_s):
+                    batch, self._queue = self._queue, []
+                    return batch
+                self._lock.wait(min(remaining, 0.05))
+
+    def _loop(self, instance) -> None:
+        idle_since = time.monotonic()
+        while True:
+            batch = self._take_batch()
+            if not batch:
+                if time.monotonic() - idle_since > 5.0:
+                    with self._lock:
+                        if self._queue:
+                            continue  # raced with a submit: keep going
+                        self._thread = None  # next submit starts a new loop
+                        return
+                continue
+            idle_since = time.monotonic()
+            items = [item for item, _ in batch]
+            try:
+                if instance is not None:
+                    results = self._fn(instance, items)
+                else:
+                    results = self._fn(items)
+                if not isinstance(results, (list, tuple)) or \
+                        len(results) != len(items):
+                    raise TypeError(
+                        f"@serve.batch function must return a list of "
+                        f"{len(items)} results, got {type(results)}")
+                for (_, fut), result in zip(batch, results):
+                    fut.set_result(result)
+            except Exception as exc:  # noqa: BLE001 — fan the error out
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(exc)
+
+
+def batch(_fn: Callable | None = None, *, max_batch_size: int = 10,
+          batch_wait_timeout_s: float = 0.01):
+    """Decorator: the wrapped fn receives a LIST of requests and must
+    return a list of responses of the same length. Callers still call it
+    with a single request and get a single response.
+    """
+
+    def decorator(fn: Callable):
+        batcher = _Batcher(fn, max_batch_size, batch_wait_timeout_s)
+
+        @functools.wraps(fn)
+        def wrapper(*args):
+            if len(args) == 2:  # bound method: (self, item)
+                instance, item = args
+            elif len(args) == 1:
+                instance, item = None, args[0]
+            else:
+                raise TypeError("@serve.batch functions take one request arg")
+            return batcher.submit(instance, item).result()
+
+        wrapper._serve_batcher = batcher
+        return wrapper
+
+    if _fn is not None:
+        return decorator(_fn)
+    return decorator

@@ -1,0 +1,86 @@
+"""Serve configuration dataclasses.
+
+Reference shapes: python/ray/serve/config.py (AutoscalingConfig,
+DeploymentConfig, HTTPOptions) and python/ray/serve/schema.py. Kept as
+plain dataclasses (no pydantic in this image).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    """Queue-depth-driven replica autoscaling.
+
+    Reference: python/ray/serve/config.py AutoscalingConfig +
+    python/ray/serve/autoscaling_policy.py (desired = total ongoing
+    requests / target_ongoing_requests, smoothed and clamped).
+    """
+
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_ongoing_requests: float = 2.0
+    metrics_interval_s: float = 0.5
+    upscale_delay_s: float = 0.5
+    downscale_delay_s: float = 2.0
+    upscale_smoothing_factor: float = 1.0
+    downscale_smoothing_factor: float = 1.0
+    initial_replicas: int | None = None
+
+    def desired_replicas(self, total_ongoing: float, current: int) -> int:
+        if current == 0:
+            return max(self.min_replicas, 1)
+        error = total_ongoing / self.target_ongoing_requests
+        if error > current:
+            desired = current + (error - current) * self.upscale_smoothing_factor
+            desired = math.ceil(desired)
+        else:
+            desired = current - (current - error) * self.downscale_smoothing_factor
+            desired = math.floor(desired) if desired >= self.min_replicas else current
+        return max(self.min_replicas, min(self.max_replicas, int(desired)))
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    """Per-deployment behavior knobs (reference: serve/config.py
+    DeploymentConfig)."""
+
+    num_replicas: int = 1
+    max_ongoing_requests: int = 100
+    autoscaling_config: AutoscalingConfig | None = None
+    user_config: Any = None
+    health_check_period_s: float = 2.0
+    health_check_timeout_s: float = 30.0
+    graceful_shutdown_timeout_s: float = 5.0
+
+    @property
+    def target_num_replicas(self) -> int:
+        if self.autoscaling_config is not None:
+            init = self.autoscaling_config.initial_replicas
+            if init is not None:
+                return init
+            return self.autoscaling_config.min_replicas
+        return self.num_replicas
+
+
+@dataclasses.dataclass
+class ReplicaConfig:
+    """What to run in each replica: the user class/function + init args +
+    per-replica resources (reference: serve/config.py ReplicaConfig)."""
+
+    deployment_def: Any = None
+    init_args: tuple = ()
+    init_kwargs: dict = dataclasses.field(default_factory=dict)
+    ray_actor_options: dict = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class HTTPOptions:
+    """Proxy options (reference: serve/config.py HTTPOptions)."""
+
+    host: str = "127.0.0.1"
+    port: int = 8000

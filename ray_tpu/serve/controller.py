@@ -1,0 +1,255 @@
+"""The Serve controller actor: reconciles deployment target state.
+
+Reference: python/ray/serve/_private/controller.py (ServeController :91)
++ deployment_state.py (DeploymentStateManager :2366, DeploymentState
+:1221): the controller holds the *target* state (deployments × replica
+counts), a reconcile loop starts/stops replica actors toward it, health
+checks demote failed replicas, and the autoscaler adjusts targets from
+replica queue metrics. Membership changes fan out to routers via
+long-poll (long_poll.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from ray_tpu.serve.config import DeploymentConfig, ReplicaConfig
+from ray_tpu.serve.long_poll import LongPollHost
+
+RECONCILE_PERIOD_S = 0.05
+
+
+@dataclass
+class _ReplicaState:
+    tag: str
+    handle: Any
+    healthy: bool = True
+    last_ongoing: float = 0.0
+
+
+@dataclass
+class _DeploymentState:
+    app_name: str
+    name: str
+    deployment_config: DeploymentConfig
+    replica_config: ReplicaConfig
+    target_replicas: int = 1
+    replicas: list[_ReplicaState] = field(default_factory=list)
+    handle_args: dict = field(default_factory=dict)
+    last_scale_change: float = 0.0
+    deleting: bool = False
+
+
+class ServeController:
+    """Runs as a named actor; methods are the control-plane API."""
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._deployments: dict[tuple[str, str], _DeploymentState] = {}
+        self._long_poll = LongPollHost()
+        self._replica_counter = itertools.count()
+        self._shutdown = threading.Event()
+        self._loop_thread = threading.Thread(
+            target=self._reconcile_loop, name="serve-controller", daemon=True)
+        self._loop_thread.start()
+
+    # -------------------------------------------------------------- deploy
+
+    def deploy(self, app_name: str, name: str,
+               deployment_config: DeploymentConfig,
+               replica_config: ReplicaConfig,
+               handle_args: dict | None = None) -> None:
+        with self._lock:
+            key = (app_name, name)
+            state = self._deployments.get(key)
+            if state is None:
+                state = _DeploymentState(
+                    app_name=app_name, name=name,
+                    deployment_config=deployment_config,
+                    replica_config=replica_config,
+                    handle_args=handle_args or {})
+                self._deployments[key] = state
+            else:
+                state.deployment_config = deployment_config
+                state.replica_config = replica_config
+                state.handle_args = handle_args or {}
+                state.deleting = False
+                # In-place reconfigure of live replicas on user_config
+                # change (reference: DeploymentState autoscaling +
+                # reconfigure broadcast).
+                if deployment_config.user_config is not None:
+                    for replica in state.replicas:
+                        replica.handle.reconfigure.remote(
+                            deployment_config.user_config)
+            state.target_replicas = deployment_config.target_num_replicas
+
+    def delete_app(self, app_name: str) -> None:
+        with self._lock:
+            for key, state in self._deployments.items():
+                if key[0] == app_name:
+                    state.deleting = True
+                    state.target_replicas = 0
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for state in self._deployments.values():
+                state.deleting = True
+                state.target_replicas = 0
+        self._shutdown.set()
+
+    # -------------------------------------------------------------- queries
+
+    def listen_for_change(self, keys_to_versions: dict):
+        return self._long_poll.listen_for_change(keys_to_versions)
+
+    def get_status(self) -> dict:
+        with self._lock:
+            return {
+                f"{app}::{name}": {
+                    "target_replicas": st.target_replicas,
+                    "running_replicas": len(st.replicas),
+                    "replica_tags": [r.tag for r in st.replicas],
+                }
+                for (app, name), st in self._deployments.items()
+                if not st.deleting
+            }
+
+    def list_deployments(self) -> list[tuple[str, str]]:
+        with self._lock:
+            return [key for key, st in self._deployments.items()
+                    if not st.deleting]
+
+    # ------------------------------------------------------------ reconcile
+
+    def _start_replica(self, state: _DeploymentState) -> None:
+        import ray_tpu
+        from ray_tpu.serve.replica import Replica
+
+        tag = f"{state.name}#{next(self._replica_counter)}"
+        opts = dict(state.replica_config.ray_actor_options or {})
+        opts.setdefault("max_concurrency", 16)
+        cfg = state.deployment_config
+        handle = ray_tpu.remote(Replica).options(**opts).remote(
+            state.name, tag,
+            state.replica_config.deployment_def,
+            state.replica_config.init_args,
+            state.replica_config.init_kwargs,
+            user_config=cfg.user_config,
+            max_ongoing_requests=cfg.max_ongoing_requests,
+            handle_args=state.handle_args,
+        )
+        state.replicas.append(_ReplicaState(tag=tag, handle=handle))
+
+    def _stop_replica(self, replica: _ReplicaState) -> None:
+        import ray_tpu
+
+        try:
+            replica.handle.prepare_for_shutdown.remote()
+            ray_tpu.kill(replica.handle, no_restart=True)
+        except Exception:  # noqa: BLE001 — already dead is fine
+            pass
+
+    def _broadcast(self, state: _DeploymentState) -> None:
+        key = f"replicas::{state.app_name}::{state.name}"
+        self._long_poll.notify_changed(
+            key, [r.handle for r in state.replicas if r.healthy])
+
+    def _reconcile_once(self) -> None:
+        import ray_tpu
+
+        with self._lock:
+            states = list(self._deployments.items())
+        for key, state in states:
+            with self._lock:
+                changed = False
+                while len(state.replicas) < state.target_replicas:
+                    self._start_replica(state)
+                    changed = True
+                while len(state.replicas) > state.target_replicas:
+                    self._stop_replica(state.replicas.pop())
+                    changed = True
+                if changed:
+                    state.last_scale_change = time.monotonic()
+                    self._broadcast(state)
+                if state.deleting and not state.replicas:
+                    del self._deployments[key]
+
+    def _autoscale_once(self) -> None:
+        import ray_tpu
+
+        with self._lock:
+            states = [st for st in self._deployments.values()
+                      if st.deployment_config.autoscaling_config is not None
+                      and not st.deleting]
+        for state in states:
+            cfg = state.deployment_config.autoscaling_config
+            refs = []
+            for replica in state.replicas:
+                try:
+                    refs.append(replica.handle.get_metrics.remote())
+                except Exception:  # noqa: BLE001
+                    pass
+            total_ongoing = 0.0
+            for ref in refs:
+                try:
+                    total_ongoing += ray_tpu.get(ref, timeout=1.0)[
+                        "num_ongoing_requests"]
+                except Exception:  # noqa: BLE001 — dead replica
+                    pass
+            current = len(state.replicas)
+            desired = cfg.desired_replicas(total_ongoing, current)
+            now = time.monotonic()
+            delay = (cfg.upscale_delay_s if desired > current
+                     else cfg.downscale_delay_s)
+            if desired != current and \
+                    now - state.last_scale_change >= delay:
+                with self._lock:
+                    state.target_replicas = desired
+
+    def _health_check_once(self) -> None:
+        import ray_tpu
+
+        with self._lock:
+            states = list(self._deployments.values())
+        for state in states:
+            dead = []
+            for replica in state.replicas:
+                try:
+                    ray_tpu.get(replica.handle.check_health.remote(),
+                                timeout=state.deployment_config
+                                .health_check_timeout_s)
+                except Exception:  # noqa: BLE001 — failed health check
+                    dead.append(replica)
+            if dead:
+                with self._lock:
+                    for replica in dead:
+                        if replica in state.replicas:
+                            state.replicas.remove(replica)
+                            self._stop_replica(replica)
+                    self._broadcast(state)  # replacements come next tick
+
+    def _reconcile_loop(self) -> None:
+        last_autoscale = 0.0
+        last_health = 0.0
+        while not self._shutdown.is_set():
+            try:
+                self._reconcile_once()
+                now = time.monotonic()
+                if now - last_autoscale > 0.25:
+                    self._autoscale_once()
+                    last_autoscale = now
+                if now - last_health > 2.0:
+                    self._health_check_once()
+                    last_health = now
+            except Exception:  # noqa: BLE001 — keep the loop alive
+                pass
+            time.sleep(RECONCILE_PERIOD_S)
+        # Drain on shutdown.
+        try:
+            self._reconcile_once()
+        except Exception:  # noqa: BLE001
+            pass

@@ -1,0 +1,239 @@
+"""LLM serving: a deployment class running continuous-batching decode.
+
+The reference has no native LLM engine (Serve replicas host arbitrary
+torch code); BASELINE config 5 ("Serve pjit TP=8") makes this a
+first-class component here. TPU-first design:
+
+- one fixed-shape jitted decode step for the WHOLE active batch
+  ([max_batch, 1] tokens against a [layers, max_batch, max_len] KV
+  cache) — every HTTP request shares one MXU-friendly matmul batch;
+- continuous batching: requests claim free cache slots on arrival
+  (prefill into the slot's rows), finished rows free their slot between
+  decode steps — no stop-the-world batch boundaries;
+- prefill lengths are bucketed to powers of two so XLA compiles a
+  handful of prefill programs, then every step hits the jit cache;
+- donate_argnums on the cache: decode updates in place in HBM;
+- under a TP mesh, wrap with ``with jax.set_mesh(...)`` and shard params
+  via ray_tpu.parallel.sharding — the same jitted fns become pjit.
+
+Works headless (token-in/token-out) so no tokenizer dependency.
+"""
+
+from __future__ import annotations
+
+import functools
+import queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models import llama
+
+
+def _bucket(n: int, lo: int = 16) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class _Request:
+    tokens: list[int]
+    max_new_tokens: int
+    temperature: float
+    done: threading.Event = field(default_factory=threading.Event)
+    output: list[int] = field(default_factory=list)
+    error: Exception | None = None
+
+
+@dataclass
+class _Slot:
+    request: _Request | None = None
+    position: int = 0          # next position to write
+    remaining: int = 0
+    last_token: int = 0
+
+
+class LLMServer:
+    """Deployment class: ``serve.run(LLMServer.bind(config, params))``.
+
+    Request: ``{"tokens": [int], "max_new_tokens": int,
+    "temperature": float}`` → ``{"tokens": [int]}``.
+    """
+
+    def __init__(self, config: llama.LlamaConfig | None = None,
+                 params: dict | None = None, *, max_batch_size: int = 8,
+                 max_seq_len: int | None = None, seed: int = 0):
+        self.config = config or llama.LlamaConfig.tiny()
+        self.params = params if params is not None else llama.init_params(
+            self.config, jax.random.PRNGKey(seed))
+        self.max_batch = max_batch_size
+        self.max_len = max_seq_len or self.config.max_seq_len
+        self.cache = llama.init_kv_cache(
+            self.config, self.max_batch, self.max_len)
+        self.slots = [_Slot() for _ in range(self.max_batch)]
+        self._queue: queue.Queue[_Request] = queue.Queue()
+        self._key = jax.random.PRNGKey(seed + 1)
+        self._shutdown = threading.Event()
+        self._loop_thread = threading.Thread(
+            target=self._engine_loop, name="llm-engine", daemon=True)
+        self._loop_thread.start()
+
+    # ----------------------------------------------------------- jitted fns
+
+    @functools.cached_property
+    def _decode_step(self):
+        config = self.config
+
+        @jax.jit
+        def step(params, cache, tokens, positions, key, temperature):
+            # tokens [B, 1]; positions [B, 1]; returns next token per row.
+            logits, cache = llama.forward_with_cache(
+                params, tokens, cache, positions, config)
+            last = logits[:, -1, :]  # [B, V]
+            greedy = jnp.argmax(last, axis=-1)
+            sampled = jax.random.categorical(
+                key, last / jnp.maximum(temperature, 1e-4)[:, None], axis=-1)
+            nxt = jnp.where(temperature > 0, sampled, greedy)
+            return nxt.astype(jnp.int32), cache
+
+        return step
+
+    @functools.cached_property
+    def _prefill(self):
+        config = self.config
+
+        @jax.jit
+        def prefill(params, cache, tokens, positions, last_idx, slot):
+            # tokens [1, T] into cache rows [slot]; ``last_idx`` is the
+            # index of the last REAL prompt token (T includes bucket
+            # padding). Returns that token's logits row. ``slot`` is a
+            # traced index (dynamic_slice) so XLA compiles ONE program
+            # per prompt bucket, not one per (bucket, slot) pair.
+            row = {
+                "k": jax.lax.dynamic_slice_in_dim(cache["k"], slot, 1, 1),
+                "v": jax.lax.dynamic_slice_in_dim(cache["v"], slot, 1, 1),
+            }
+            logits, row = llama.forward_with_cache(
+                params, tokens, row, positions, config)
+            cache = {
+                "k": jax.lax.dynamic_update_slice_in_dim(
+                    cache["k"], row["k"], slot, 1),
+                "v": jax.lax.dynamic_update_slice_in_dim(
+                    cache["v"], row["v"], slot, 1),
+            }
+            return logits[0, last_idx, :], cache
+
+        return prefill
+
+    # -------------------------------------------------------------- engine
+
+    def _admit(self) -> None:
+        """Move queued requests into free slots (prefill)."""
+        for slot_idx, slot in enumerate(self.slots):
+            if slot.request is not None:
+                continue
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            req.max_new_tokens = max(1, min(req.max_new_tokens,
+                                            self.max_len - 2))
+            prompt = req.tokens or [0]
+            keep = max(1, self.max_len - req.max_new_tokens - 1)
+            prompt = prompt[-keep:]
+            bucket = min(_bucket(len(prompt)), self.max_len)
+            padded = np.zeros((1, bucket), dtype=np.int32)
+            padded[0, :len(prompt)] = prompt
+            # Padded tokens scatter their k/v into the max_len-1 scratch
+            # slot: invisible to every real query (mask allows s <= p
+            # only) and overwritten by the real token if the row ever
+            # reaches that position.
+            pos = np.arange(bucket)
+            pos[len(prompt):] = self.max_len - 1
+            pos = pos[None, :]
+            try:
+                last_logits, self.cache = self._prefill(
+                    self.params, self.cache, jnp.asarray(padded),
+                    jnp.asarray(pos), len(prompt) - 1, slot_idx)
+                first = int(jnp.argmax(last_logits))
+            except Exception as exc:  # noqa: BLE001 — surface to caller
+                req.error = exc
+                req.done.set()
+                continue
+            # position = next unwritten cache slot; the first generated
+            # token (prefill's prediction) is written there by the first
+            # decode step.
+            slot.request = req
+            slot.position = len(prompt)
+            slot.remaining = req.max_new_tokens
+            slot.last_token = first
+            req.output.append(first)
+            slot.remaining -= 1
+            if slot.remaining <= 0 or slot.position >= self.max_len:
+                self._finish(slot)
+
+    def _finish(self, slot: _Slot) -> None:
+        if slot.request is not None:
+            slot.request.done.set()
+        slot.request = None
+        slot.remaining = 0
+
+    def _engine_loop(self) -> None:
+        while not self._shutdown.is_set():
+            self._admit()
+            active = [i for i, s in enumerate(self.slots)
+                      if s.request is not None]
+            if not active:
+                self._shutdown.wait(0.002)
+                continue
+            tokens = np.zeros((self.max_batch, 1), dtype=np.int32)
+            positions = np.zeros((self.max_batch, 1), dtype=np.int32)
+            temps = np.zeros((self.max_batch,), dtype=np.float32)
+            for i in active:
+                slot = self.slots[i]
+                tokens[i, 0] = slot.last_token
+                # last_token sits at position-1's prediction; it is
+                # written at the slot's current position.
+                positions[i, 0] = slot.position
+                temps[i] = slot.request.temperature
+            self._key, sub = jax.random.split(self._key)
+            nxt, self.cache = self._decode_step(
+                self.params, self.cache, jnp.asarray(tokens),
+                jnp.asarray(positions), sub, jnp.asarray(temps))
+            nxt = np.asarray(nxt)
+            for i in active:
+                slot = self.slots[i]
+                slot.request.output.append(int(nxt[i]))
+                slot.last_token = int(nxt[i])
+                slot.position += 1
+                slot.remaining -= 1
+                if slot.remaining <= 0 or slot.position >= self.max_len:
+                    self._finish(slot)
+
+    # ----------------------------------------------------------- public API
+
+    def __call__(self, request: dict) -> dict:
+        req = _Request(
+            tokens=list(request.get("tokens") or []),
+            max_new_tokens=int(request.get("max_new_tokens", 16)),
+            temperature=float(request.get("temperature", 0.0)),
+        )
+        self._queue.put(req)
+        if not req.done.wait(timeout=120.0):
+            raise TimeoutError("generation timed out")
+        if req.error is not None:
+            raise req.error
+        return {"tokens": req.output}
+
+    def check_health(self):
+        if not self._loop_thread.is_alive():
+            raise RuntimeError("LLM engine loop died")
+
+    def __del__(self):
+        self._shutdown.set()

@@ -1,0 +1,94 @@
+"""Versioned long-poll pub/sub for controller → router config fan-out.
+
+Reference: python/ray/serve/_private/long_poll.py — LongPollHost (:175)
+holds (key → (version, value)); LongPollClient (:66) blocks on
+``listen_for_change({key: last_seen_version})`` and gets back only keys
+whose version advanced. Routers learn replica membership this way instead
+of polling, so scale-up/down propagates in one RTT.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable
+
+
+LISTEN_TIMEOUT_S = 5.0
+
+
+class LongPollHost:
+    """Hosted inside the controller actor."""
+
+    def __init__(self):
+        self._lock = threading.Condition()
+        self._store: dict[str, tuple[int, Any]] = {}
+
+    def notify_changed(self, key: str, value: Any) -> None:
+        with self._lock:
+            version = self._store.get(key, (0, None))[0] + 1
+            self._store[key] = (version, value)
+            self._lock.notify_all()
+
+    def listen_for_change(
+            self, keys_to_versions: dict[str, int],
+            timeout_s: float = LISTEN_TIMEOUT_S) -> dict[str, tuple[int, Any]]:
+        """Block until any key advances past the caller's version; return
+        the advanced {key: (version, value)} subset ({} on timeout)."""
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            while True:
+                updates = {
+                    key: self._store[key]
+                    for key, seen in keys_to_versions.items()
+                    if key in self._store and self._store[key][0] > seen
+                }
+                if updates:
+                    return updates
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {}
+                self._lock.wait(remaining)
+
+    def snapshot(self, key: str) -> tuple[int, Any]:
+        with self._lock:
+            return self._store.get(key, (0, None))
+
+
+class LongPollClient:
+    """Background thread repeatedly long-polling the controller actor.
+
+    ``callbacks``: {key: fn(value)} invoked on each update.
+    """
+
+    def __init__(self, controller_handle, callbacks: dict[str, Callable]):
+        self._controller = controller_handle
+        self._callbacks = callbacks
+        self._versions = {key: 0 for key in callbacks}
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-long-poll", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopped.set()
+
+    def _loop(self) -> None:
+        import ray_tpu
+
+        while not self._stopped.is_set():
+            try:
+                ref = self._controller.listen_for_change.remote(
+                    dict(self._versions))
+                updates = ray_tpu.get(ref, timeout=LISTEN_TIMEOUT_S * 4)
+            except Exception:
+                if self._stopped.is_set():
+                    return
+                time.sleep(0.1)
+                continue
+            for key, (version, value) in (updates or {}).items():
+                self._versions[key] = version
+                try:
+                    self._callbacks[key](value)
+                except Exception:  # noqa: BLE001 — user callback
+                    pass

@@ -1,0 +1,105 @@
+"""HTTP ingress proxy over the stdlib http.server.
+
+Reference: python/ray/serve/_private/proxy.py — per-node uvicorn/
+starlette proxy routing by route_prefix to deployment handles. This image
+has no starlette/uvicorn, so the proxy is a ThreadingHTTPServer; the data
+path (proxy → router pow-2 → replica actor) matches the reference.
+
+Request mapping: ``POST/GET <route_prefix>`` → ingress ``__call__`` with
+the JSON-decoded body (or raw bytes) as the single argument. JSON-encodes
+the response (raw str/bytes pass through).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import ray_tpu
+from ray_tpu.serve.config import HTTPOptions
+
+
+class HTTPProxy:
+    def __init__(self, controller_handle, options: HTTPOptions):
+        self._controller = controller_handle
+        self._options = options
+        self._server: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    # Route table: longest matching route_prefix wins.
+    def _resolve_route(self, path: str):
+        from ray_tpu.serve import api as serve_api
+
+        with serve_api._lock:
+            apps = dict(serve_api._apps)
+        best = None
+        for app_name, app in apps.items():
+            prefix = app.deployment.route_prefix or "/"
+            if path == prefix or path.startswith(
+                    prefix.rstrip("/") + "/") or prefix == "/":
+                if best is None or len(prefix) > len(best[0]):
+                    best = (prefix, app_name, app)
+        if best is None:
+            return None
+        _, app_name, app = best
+        return serve_api.get_app_handle(app_name)
+
+    def start(self) -> None:
+        proxy = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # silence request logging
+                pass
+
+            def _handle(self):
+                handle = proxy._resolve_route(self.path)
+                if handle is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    self.wfile.write(b"no app bound to this route")
+                    return
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                try:
+                    arg = json.loads(body) if body else None
+                except json.JSONDecodeError:
+                    arg = body
+                try:
+                    result = handle.remote(arg).result(timeout_s=60.0)
+                except Exception as exc:  # noqa: BLE001 — 500 + message
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(str(exc).encode())
+                    return
+                if isinstance(result, bytes):
+                    payload, ctype = result, "application/octet-stream"
+                elif isinstance(result, str):
+                    payload, ctype = result.encode(), "text/plain"
+                else:
+                    payload = json.dumps(result).encode()
+                    ctype = "application/json"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            do_GET = do_POST = do_PUT = _handle
+
+        self._server = ThreadingHTTPServer(
+            (self._options.host, self._options.port), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="serve-proxy",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1] if self._server else -1
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None

@@ -1,0 +1,122 @@
+"""The replica actor: hosts one copy of the user's deployment callable.
+
+Reference: python/ray/serve/_private/replica.py — ReplicaActor (:233),
+handle_request (:391). Each replica tracks its ongoing-request count
+(the router's pow-2 signal and the autoscaler's input) and enforces
+``max_ongoing_requests`` backpressure.
+"""
+
+from __future__ import annotations
+
+import inspect
+import threading
+import time
+from typing import Any
+
+from ray_tpu.exceptions import TaskError
+
+
+class BackPressureError(Exception):
+    """Replica at max_ongoing_requests (reference: replica raises when
+    over capacity so the router retries elsewhere)."""
+
+
+class Replica:
+    """Runs as a ray_tpu actor (one per replica, max_concurrency > 1 so
+    requests overlap like the reference's asyncio replicas)."""
+
+    def __init__(self, deployment_name: str, replica_tag: str,
+                 deployment_def: Any, init_args: tuple, init_kwargs: dict,
+                 user_config: Any = None, max_ongoing_requests: int = 100,
+                 handle_args: dict | None = None):
+        self._deployment_name = deployment_name
+        self._replica_tag = replica_tag
+        self._max_ongoing = max_ongoing_requests
+        self._lock = threading.Lock()
+        self._num_ongoing = 0
+        self._num_total = 0
+        self._healthy = True
+
+        # Bound sub-deployments arrive as _HandleMarker placeholders and
+        # become live DeploymentHandles here inside the replica
+        # (reference: deployment_graph_build.py — graph edges become
+        # handles).
+        def resolve(value):
+            from ray_tpu.serve.api import _HandleMarker, get_deployment_handle
+
+            if isinstance(value, _HandleMarker):
+                return get_deployment_handle(
+                    value.deployment_name, value.app_name)
+            return value
+
+        init_args = tuple(resolve(a) for a in init_args)
+        init_kwargs = {k: resolve(v) for k, v in init_kwargs.items()}
+
+        if inspect.isclass(deployment_def):
+            self._callable = deployment_def(*init_args, **init_kwargs)
+        else:
+            self._callable = deployment_def
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    # ------------------------------------------------------------- data path
+
+    def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+        with self._lock:
+            if self._num_ongoing >= self._max_ongoing:
+                raise BackPressureError(
+                    f"{self._replica_tag} at max_ongoing_requests="
+                    f"{self._max_ongoing}")
+            self._num_ongoing += 1
+            self._num_total += 1
+        try:
+            if method_name == "__call__":
+                target = self._callable
+                if not callable(target):
+                    raise TypeError(
+                        f"Deployment {self._deployment_name} is not callable;"
+                        f" specify a method name")
+            else:
+                target = getattr(self._callable, method_name)
+            result = target(*args, **kwargs)
+            if inspect.isgenerator(result):
+                # Streaming responses materialize to a chunk list (the
+                # in-process analogue of reference replica.py:471 — the
+                # handle re-streams them to the caller).
+                result = list(result)
+            return result
+        finally:
+            with self._lock:
+                self._num_ongoing -= 1
+
+    # ---------------------------------------------------------- control path
+
+    def reconfigure(self, user_config: Any) -> None:
+        hook = getattr(self._callable, "reconfigure", None)
+        if hook is not None:
+            hook(user_config)
+
+    def check_health(self) -> bool:
+        hook = getattr(self._callable, "check_health", None)
+        if hook is not None:
+            hook()
+        return True
+
+    def get_metrics(self) -> dict:
+        with self._lock:
+            return {
+                "replica_tag": self._replica_tag,
+                "num_ongoing_requests": self._num_ongoing,
+                "num_total_requests": self._num_total,
+                "timestamp": time.time(),
+            }
+
+    def prepare_for_shutdown(self) -> None:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with self._lock:
+                if self._num_ongoing == 0:
+                    break
+            time.sleep(0.02)
+        hook = getattr(self._callable, "__del__", None)
+        del hook

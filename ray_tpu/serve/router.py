@@ -1,0 +1,197 @@
+"""Request routing: DeploymentHandle → pow-2-choices replica selection.
+
+Reference: python/ray/serve/_private/router.py (Router :38,
+assign_request :325) and replica_scheduler/pow_2_scheduler.py
+(PowerOfTwoChoicesReplicaScheduler :44): pick two random replicas, send
+to the one with the smaller queue. Queue depth here is the router's local
+in-flight count per replica (the reference also starts from local counts
+and only probes replicas when over capacity).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Any
+
+from ray_tpu.serve.long_poll import LongPollClient
+from ray_tpu.serve.replica import BackPressureError
+
+
+class DeploymentResponse:
+    """Future-like result of handle.remote() (reference:
+    python/ray/serve/handle.py DeploymentResponse).
+
+    A replica that rejects with BackPressureError is retried on another
+    replica transparently (the reference pow-2 scheduler requeues
+    rejected requests the same way).
+    """
+
+    def __init__(self, object_ref, router=None, replica_idx=None,
+                 request=None):
+        self._ref = object_ref
+        self._router = router
+        self._replica_idx = replica_idx
+        self._request = request  # (method_name, args, kwargs)
+
+    def _release(self):
+        if self._router is not None and self._replica_idx is not None:
+            self._router._release(self._replica_idx)
+            self._replica_idx = None
+
+    def result(self, timeout_s: float | None = None):
+        import ray_tpu
+
+        deadline = (time.monotonic() + timeout_s
+                    if timeout_s is not None else None)
+        while True:
+            try:
+                value = ray_tpu.get(self._ref, timeout=timeout_s)
+                self._release()
+                return value
+            except Exception as exc:  # noqa: BLE001 — inspect for backpressure
+                self._release()
+                cause = getattr(exc, "cause", exc)
+                retriable = (isinstance(cause, BackPressureError)
+                             and self._router is not None
+                             and self._request is not None)
+                if not retriable or (deadline is not None
+                                     and time.monotonic() > deadline):
+                    raise
+                time.sleep(0.01)
+                idx, handle = self._router._pick()
+                self._replica_idx = idx
+                self._ref = handle.handle_request.remote(*self._request)
+                if deadline is not None:
+                    timeout_s = max(0.0, deadline - time.monotonic())
+
+    def _to_object_ref(self):
+        return self._ref
+
+
+class Router:
+    """One per (process, deployment): tracks replica membership via
+    long-poll and assigns requests."""
+
+    def __init__(self, controller_handle, app_name: str,
+                 deployment_name: str):
+        self._controller = controller_handle
+        self._key = f"replicas::{app_name}::{deployment_name}"
+        self._deployment_name = deployment_name
+        self._lock = threading.Lock()
+        self._replicas: list[Any] = []          # ActorHandles
+        self._inflight: dict[int, int] = {}     # replica idx -> count
+        self._have_replicas = threading.Event()
+        self._long_poll = LongPollClient(
+            controller_handle, {self._key: self._update_replicas})
+
+    def _update_replicas(self, handles: list) -> None:
+        with self._lock:
+            self._replicas = list(handles or [])
+            self._inflight = {i: 0 for i in range(len(self._replicas))}
+        if handles:
+            self._have_replicas.set()
+        else:
+            self._have_replicas.clear()
+
+    def _pick(self) -> tuple[int, Any]:
+        """Power of two choices on local in-flight counts."""
+        with self._lock:
+            n = len(self._replicas)
+            if n == 0:
+                raise RuntimeError("no replicas")
+            if n == 1:
+                idx = 0
+            else:
+                a, b = random.sample(range(n), 2)
+                idx = a if self._inflight.get(a, 0) <= \
+                    self._inflight.get(b, 0) else b
+            self._inflight[idx] = self._inflight.get(idx, 0) + 1
+            return idx, self._replicas[idx]
+
+    def _release(self, idx: int) -> None:
+        with self._lock:
+            if idx in self._inflight and self._inflight[idx] > 0:
+                self._inflight[idx] -= 1
+
+    def assign_request(self, method_name: str, args: tuple, kwargs: dict,
+                       timeout_s: float = 30.0) -> DeploymentResponse:
+        if not self._have_replicas.wait(timeout_s):
+            raise TimeoutError(
+                f"Deployment {self._deployment_name}: no replicas came up "
+                f"within {timeout_s}s")
+        idx, handle = self._pick()
+        ref = handle.handle_request.remote(method_name, args, kwargs)
+        # Backpressure rejections are retried on another replica inside
+        # DeploymentResponse.result() (reference: pow-2 scheduler
+        # requeues on replica rejection).
+        return DeploymentResponse(
+            ref, router=self, replica_idx=idx,
+            request=(method_name, args, kwargs))
+
+    def shutdown(self) -> None:
+        self._long_poll.stop()
+
+
+_routers_lock = threading.Lock()
+_routers: dict[tuple[str, str], Router] = {}
+
+
+def get_or_create_router(controller_handle, app_name: str,
+                         deployment_name: str) -> Router:
+    with _routers_lock:
+        key = (app_name, deployment_name)
+        router = _routers.get(key)
+        if router is None:
+            router = Router(controller_handle, app_name, deployment_name)
+            _routers[key] = router
+        return router
+
+
+def clear_routers() -> None:
+    with _routers_lock:
+        for router in _routers.values():
+            router.shutdown()
+        _routers.clear()
+
+
+class DeploymentHandle:
+    """User-facing handle (reference: python/ray/serve/handle.py
+    DeploymentHandle): ``handle.remote(...)``, ``handle.method.remote``,
+    ``handle.options(method_name=...)``."""
+
+    def __init__(self, deployment_name: str, app_name: str,
+                 controller_handle, method_name: str = "__call__"):
+        self._deployment_name = deployment_name
+        self._app_name = app_name
+        self._controller = controller_handle
+        self._method_name = method_name
+
+    def options(self, method_name: str | None = None) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self._deployment_name, self._app_name, self._controller,
+            method_name or self._method_name)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(
+            self._deployment_name, self._app_name, self._controller, name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        router = get_or_create_router(
+            self._controller, self._app_name, self._deployment_name)
+        return router.assign_request(self._method_name, args, kwargs)
+
+    def __reduce__(self):
+        # Rebuild from names inside another process/replica.
+        return (_rebuild_handle,
+                (self._deployment_name, self._app_name, self._method_name))
+
+
+def _rebuild_handle(deployment_name, app_name, method_name):
+    from ray_tpu.serve.api import _get_controller
+
+    return DeploymentHandle(
+        deployment_name, app_name, _get_controller(), method_name)

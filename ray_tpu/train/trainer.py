@@ -231,7 +231,9 @@ class JaxTrainer(DataParallelTrainer):
                     # Anything else (coordinator unreachable, deadline
                     # exceeded) must fail loudly or the gang silently
                     # trains with the wrong world size.
-                    if "already initialized" not in str(e).lower():
+                    msg = str(e).lower()
+                    if ("already initialized" not in msg
+                            and "only be called once" not in msg):
                         raise
             elif os.environ.get("JAX_COORDINATOR_ADDRESS"):
                 # Multi-host launch configured via env (the analogue of
@@ -239,7 +241,9 @@ class JaxTrainer(DataParallelTrainer):
                 try:
                     jax.distributed.initialize()
                 except RuntimeError as e:
-                    if "already initialized" not in str(e).lower():
+                    msg = str(e).lower()
+                    if ("already initialized" not in msg
+                            and "only be called once" not in msg):
                         raise
             return loop(config)
 
