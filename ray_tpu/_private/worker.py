@@ -331,7 +331,20 @@ class Runtime:
             actor_id=actor_id, name=name, namespace=ns,
             class_name=cls.__name__, max_restarts=max_restarts,
             method_meta=method_meta)
-        self.gcs.register_actor(record)
+        try:
+            self.gcs.register_actor(record)
+        except ValueError:
+            # Named-actor registration race: two concurrent get_if_exists
+            # creators both passed the existence check; the loser joins
+            # the winner's actor (reference: GcsActorManager resolves
+            # RegisterActor name collisions the same way). Seal the
+            # already-created pending ref so nothing waits on it forever.
+            if name is not None and get_if_exists:
+                existing = self.gcs.get_named_actor(name, ns)
+                if existing is not None:
+                    self.store.put(creation_rid, None)
+                    return existing.actor_id, creation_ref
+            raise
 
         strategy = scheduling_strategy or SchedulingStrategy()
 

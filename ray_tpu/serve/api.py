@@ -99,6 +99,8 @@ def run(target: Application, *, name: str = "default",
                         f"got {type(target)}")
     controller = _get_controller()
     _deploy_graph(target, name, controller)
+    ray_tpu.get(controller.set_ingress.remote(
+        name, target._ingress_name()))
     with _lock:
         _apps[name] = target
         target.deployment.route_prefix = (
@@ -130,11 +132,11 @@ def get_app_handle(name: str = "default") -> DeploymentHandle:
         app = _apps.get(name)
     if app is not None:
         return DeploymentHandle(app._ingress_name(), name, controller)
-    # Fall back to controller state (handle from another process).
-    for app_name, dep_name in ray_tpu.get(
-            controller.list_deployments.remote()):
-        if app_name == name:
-            return DeploymentHandle(dep_name, name, controller)
+    # Fall back to controller state (handle from another process): the
+    # controller records each app's ingress at run() time.
+    ingress = ray_tpu.get(controller.get_ingress.remote(name))
+    if ingress is not None:
+        return DeploymentHandle(ingress, name, controller)
     raise KeyError(f"no Serve application named {name!r}")
 
 

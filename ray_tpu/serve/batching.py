@@ -98,7 +98,22 @@ def batch(_fn: Callable | None = None, *, max_batch_size: int = 10,
     """
 
     def decorator(fn: Callable):
-        batcher = _Batcher(fn, max_batch_size, batch_wait_timeout_s)
+        # One batcher per bound instance (replicas must not share queues
+        # or execute against each other's self); plain functions share
+        # the module-level batcher.
+        free_batcher = _Batcher(fn, max_batch_size, batch_wait_timeout_s)
+        per_instance: dict[int, _Batcher] = {}
+        creation_lock = threading.Lock()
+
+        def batcher_for(instance):
+            if instance is None:
+                return free_batcher
+            with creation_lock:
+                b = per_instance.get(id(instance))
+                if b is None:
+                    b = _Batcher(fn, max_batch_size, batch_wait_timeout_s)
+                    per_instance[id(instance)] = b
+                return b
 
         @functools.wraps(fn)
         def wrapper(*args):
@@ -108,9 +123,9 @@ def batch(_fn: Callable | None = None, *, max_batch_size: int = 10,
                 instance, item = None, args[0]
             else:
                 raise TypeError("@serve.batch functions take one request arg")
-            return batcher.submit(instance, item).result()
+            return batcher_for(instance).submit(instance, item).result()
 
-        wrapper._serve_batcher = batcher
+        wrapper._serve_batcher = free_batcher
         return wrapper
 
     if _fn is not None:

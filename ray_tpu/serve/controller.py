@@ -50,6 +50,7 @@ class ServeController:
     def __init__(self):
         self._lock = threading.RLock()
         self._deployments: dict[tuple[str, str], _DeploymentState] = {}
+        self._ingress: dict[str, str] = {}
         self._long_poll = LongPollHost()
         self._replica_counter = itertools.count()
         self._shutdown = threading.Event()
@@ -86,6 +87,14 @@ class ServeController:
                         replica.handle.reconfigure.remote(
                             deployment_config.user_config)
             state.target_replicas = deployment_config.target_num_replicas
+
+    def set_ingress(self, app_name: str, deployment_name: str) -> None:
+        with self._lock:
+            self._ingress[app_name] = deployment_name
+
+    def get_ingress(self, app_name: str) -> str | None:
+        with self._lock:
+            return self._ingress.get(app_name)
 
     def delete_app(self, app_name: str) -> None:
         with self._lock:
@@ -144,14 +153,25 @@ class ServeController:
         )
         state.replicas.append(_ReplicaState(tag=tag, handle=handle))
 
-    def _stop_replica(self, replica: _ReplicaState) -> None:
+    def _stop_replica(self, replica: _ReplicaState,
+                      graceful_timeout_s: float = 5.0) -> None:
         import ray_tpu
 
-        try:
-            replica.handle.prepare_for_shutdown.remote()
-            ray_tpu.kill(replica.handle, no_restart=True)
-        except Exception:  # noqa: BLE001 — already dead is fine
-            pass
+        def drain_then_kill():
+            try:
+                ref = replica.handle.prepare_for_shutdown.remote()
+                ray_tpu.get(ref, timeout=graceful_timeout_s)
+            except Exception:  # noqa: BLE001 — drain is best-effort
+                pass
+            try:
+                ray_tpu.kill(replica.handle, no_restart=True)
+            except Exception:  # noqa: BLE001 — already dead is fine
+                pass
+
+        # Off the reconcile thread: the graceful drain must not stall
+        # reconciliation of other deployments.
+        threading.Thread(target=drain_then_kill, daemon=True,
+                         name=f"stop-{replica.tag}").start()
 
     def _broadcast(self, state: _DeploymentState) -> None:
         key = f"replicas::{state.app_name}::{state.name}"
@@ -170,7 +190,9 @@ class ServeController:
                     self._start_replica(state)
                     changed = True
                 while len(state.replicas) > state.target_replicas:
-                    self._stop_replica(state.replicas.pop())
+                    self._stop_replica(
+                        state.replicas.pop(),
+                        state.deployment_config.graceful_shutdown_timeout_s)
                     changed = True
                 if changed:
                     state.last_scale_change = time.monotonic()
@@ -215,22 +237,48 @@ class ServeController:
 
         with self._lock:
             states = list(self._deployments.values())
+        # Fire all probes in parallel; one bounded wait for the whole
+        # fleet so a slow replica can't serially stall reconciliation.
+        probes = []  # (state, replica, ref)
         for state in states:
-            dead = []
             for replica in state.replicas:
                 try:
-                    ray_tpu.get(replica.handle.check_health.remote(),
-                                timeout=state.deployment_config
-                                .health_check_timeout_s)
-                except Exception:  # noqa: BLE001 — failed health check
-                    dead.append(replica)
-            if dead:
-                with self._lock:
-                    for replica in dead:
-                        if replica in state.replicas:
-                            state.replicas.remove(replica)
-                            self._stop_replica(replica)
-                    self._broadcast(state)  # replacements come next tick
+                    probes.append(
+                        (state, replica,
+                         replica.handle.check_health.remote()))
+                except Exception:  # noqa: BLE001 — clearly dead
+                    probes.append((state, replica, None))
+        if not probes:
+            return
+        timeout = max(s.deployment_config.health_check_timeout_s
+                      for s in states) if states else 30.0
+        live_refs = [ref for _, _, ref in probes if ref is not None]
+        if live_refs:
+            ray_tpu.wait(live_refs, num_returns=len(live_refs),
+                         timeout=timeout)
+        by_state: dict[int, list] = {}
+        for state, replica, ref in probes:
+            failed = ref is None
+            if ref is not None:
+                try:
+                    ready, _ = ray_tpu.wait([ref], timeout=0)
+                    if ready:
+                        ray_tpu.get(ref, timeout=1.0)
+                    # Not ready ≠ dead: the replica may still be
+                    # initializing (long __init__) or busy — leave it.
+                except Exception:  # noqa: BLE001 — probe raised: unhealthy
+                    failed = True
+            if failed:
+                by_state.setdefault(id(state), [state, []])[1].append(replica)
+        for state, dead in by_state.values():
+            with self._lock:
+                for replica in dead:
+                    if replica in state.replicas:
+                        state.replicas.remove(replica)
+                        self._stop_replica(
+                            replica, state.deployment_config
+                            .graceful_shutdown_timeout_s)
+                self._broadcast(state)  # replacements come next tick
 
     def _reconcile_loop(self) -> None:
         last_autoscale = 0.0
@@ -242,7 +290,12 @@ class ServeController:
                 if now - last_autoscale > 0.25:
                     self._autoscale_once()
                     last_autoscale = now
-                if now - last_health > 2.0:
+                with self._lock:
+                    period = min(
+                        (st.deployment_config.health_check_period_s
+                         for st in self._deployments.values()),
+                        default=2.0)
+                if now - last_health > period:
                     self._health_check_once()
                     last_health = now
             except Exception:  # noqa: BLE001 — keep the loop alive

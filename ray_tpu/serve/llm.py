@@ -161,7 +161,12 @@ class LLMServer:
                 last_logits, self.cache = self._prefill(
                     self.params, self.cache, jnp.asarray(padded),
                     jnp.asarray(pos), len(prompt) - 1, slot_idx)
-                first = int(jnp.argmax(last_logits))
+                if req.temperature > 0:
+                    self._key, sub = jax.random.split(self._key)
+                    first = int(jax.random.categorical(
+                        sub, last_logits / max(req.temperature, 1e-4)))
+                else:
+                    first = int(jnp.argmax(last_logits))
             except Exception as exc:  # noqa: BLE001 — surface to caller
                 req.error = exc
                 req.done.set()

@@ -119,4 +119,8 @@ class Replica:
                     break
             time.sleep(0.02)
         hook = getattr(self._callable, "__del__", None)
-        del hook
+        if hook is not None:
+            try:
+                hook()  # e.g. LLMServer.__del__ stops its engine thread
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass

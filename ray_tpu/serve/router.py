@@ -81,39 +81,51 @@ class Router:
         self._deployment_name = deployment_name
         self._lock = threading.Lock()
         self._replicas: list[Any] = []          # ActorHandles
-        self._inflight: dict[int, int] = {}     # replica idx -> count
+        # In-flight counts keyed by replica IDENTITY (actor id), so
+        # membership changes neither zero live load nor cross-release a
+        # different replica that inherited a list index.
+        self._inflight: dict[Any, int] = {}
         self._have_replicas = threading.Event()
         self._long_poll = LongPollClient(
             controller_handle, {self._key: self._update_replicas})
 
+    @staticmethod
+    def _rkey(handle) -> Any:
+        return getattr(handle, "_actor_id", None) or id(handle)
+
     def _update_replicas(self, handles: list) -> None:
         with self._lock:
             self._replicas = list(handles or [])
-            self._inflight = {i: 0 for i in range(len(self._replicas))}
+            keep = {self._rkey(h) for h in self._replicas}
+            self._inflight = {k: v for k, v in self._inflight.items()
+                              if k in keep}
         if handles:
             self._have_replicas.set()
         else:
             self._have_replicas.clear()
 
-    def _pick(self) -> tuple[int, Any]:
-        """Power of two choices on local in-flight counts."""
+    def _pick(self) -> tuple[Any, Any]:
+        """Power of two choices on local in-flight counts. Returns
+        (replica_key, handle)."""
         with self._lock:
             n = len(self._replicas)
             if n == 0:
                 raise RuntimeError("no replicas")
             if n == 1:
-                idx = 0
+                handle = self._replicas[0]
             else:
                 a, b = random.sample(range(n), 2)
-                idx = a if self._inflight.get(a, 0) <= \
-                    self._inflight.get(b, 0) else b
-            self._inflight[idx] = self._inflight.get(idx, 0) + 1
-            return idx, self._replicas[idx]
+                ha, hb = self._replicas[a], self._replicas[b]
+                handle = ha if self._inflight.get(self._rkey(ha), 0) <= \
+                    self._inflight.get(self._rkey(hb), 0) else hb
+            key = self._rkey(handle)
+            self._inflight[key] = self._inflight.get(key, 0) + 1
+            return key, handle
 
-    def _release(self, idx: int) -> None:
+    def _release(self, key: Any) -> None:
         with self._lock:
-            if idx in self._inflight and self._inflight[idx] > 0:
-                self._inflight[idx] -= 1
+            if self._inflight.get(key, 0) > 0:
+                self._inflight[key] -= 1
 
     def assign_request(self, method_name: str, args: tuple, kwargs: dict,
                        timeout_s: float = 30.0) -> DeploymentResponse:

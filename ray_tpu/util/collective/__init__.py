@@ -1,0 +1,38 @@
+"""Explicit collective communication between actors.
+
+Reference: python/ray/util/collective/collective.py (:120
+init_collective_group, :258 allreduce, :298 barrier, :373 broadcast,
+:423 allgather, :472 reducescatter, :531/:594 send/recv) with NCCL/Gloo
+backends (collective_group/nccl_collective_group.py, 821 LoC).
+
+TPU-native split (SURVEY §7 step 4):
+- ``backend="store"`` — the Gloo-equivalent host-side backend: a named
+  rendezvous actor carries contributions over the object store. Used by
+  CPU rollout actors and control-plane gangs.
+- ``ray_tpu.util.collective.xla`` — the NCCL-equivalent device plane:
+  XLA collectives (psum/all_gather/ppermute/...) over a
+  jax.sharding.Mesh via shard_map, riding ICI. Use inside SPMD
+  programs; the host API here is for actor-to-actor exchange.
+"""
+
+from ray_tpu.util.collective.collective import (
+    ReduceOp,
+    allgather,
+    allreduce,
+    barrier,
+    broadcast,
+    destroy_collective_group,
+    get_rank,
+    get_world_size,
+    init_collective_group,
+    recv,
+    reducescatter,
+    send,
+)
+from ray_tpu.util.collective import xla
+
+__all__ = [
+    "ReduceOp", "allgather", "allreduce", "barrier", "broadcast",
+    "destroy_collective_group", "get_rank", "get_world_size",
+    "init_collective_group", "recv", "reducescatter", "send", "xla",
+]

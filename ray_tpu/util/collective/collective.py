@@ -1,0 +1,183 @@
+"""Host-side collective API over the rendezvous store.
+
+Reference: python/ray/util/collective/collective.py — the module-level
+functions keep a per-process (here per-actor-thread) group table
+(GroupManager :49) and every op goes through the group's backend. Ops
+and signatures mirror collective.py:258-615.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+import ray_tpu
+
+
+class ReduceOp(enum.Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda arrs: np.sum(arrs, axis=0),
+    ReduceOp.PRODUCT: lambda arrs: np.prod(arrs, axis=0),
+    ReduceOp.MIN: lambda arrs: np.min(arrs, axis=0),
+    ReduceOp.MAX: lambda arrs: np.max(arrs, axis=0),
+}
+
+
+@dataclass
+class _Group:
+    name: str
+    rank: int
+    world_size: int
+    store: Any
+    seq: int = 0
+
+    def next_key(self, op: str) -> str:
+        self.seq += 1
+        return f"{op}:{self.seq}"
+
+
+class _GroupTable(threading.local):
+    """Thread-local: each actor (its own thread) has its own ranks."""
+
+    def __init__(self):
+        self.groups: dict[str, _Group] = {}
+
+
+_table = _GroupTable()
+
+
+def init_collective_group(world_size: int, rank: int,
+                          backend: str = "store",
+                          group_name: str = "default") -> None:
+    """Join ``group_name`` as ``rank`` (reference: collective.py:120).
+
+    Every participating actor/driver must call this; the named store
+    actor is the rendezvous point (created once, get-if-exists).
+    """
+    if backend not in ("store", "gloo", "cpu"):
+        raise ValueError(
+            f"backend={backend!r}: host-side groups use the store backend"
+            f" (device collectives live in ray_tpu.util.collective.xla)")
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} outside [0, {world_size})")
+    from ray_tpu.util.collective.store import CollectiveStore
+
+    store = ray_tpu.remote(CollectiveStore).options(
+        name=f"collective::{group_name}", get_if_exists=True,
+        max_concurrency=max(64, world_size * 4)).remote(world_size)
+    actual = ray_tpu.get(store.world_size.remote())
+    if actual != world_size:
+        raise ValueError(
+            f"group {group_name!r} exists with world_size={actual}, "
+            f"asked for {world_size}")
+    _table.groups[group_name] = _Group(
+        name=group_name, rank=rank, world_size=world_size, store=store)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    group = _table.groups.pop(group_name, None)
+    if group is not None and group.rank == 0:
+        try:
+            ray_tpu.kill(group.store)
+        except Exception:  # noqa: BLE001 — another rank already killed it
+            pass
+
+
+def _group(group_name: str) -> _Group:
+    try:
+        return _table.groups[group_name]
+    except KeyError:
+        raise RuntimeError(
+            f"collective group {group_name!r} not initialized in this "
+            f"actor — call init_collective_group() first") from None
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _group(group_name).rank
+
+
+def get_world_size(group_name: str = "default") -> int:
+    return _group(group_name).world_size
+
+
+# ------------------------------------------------------------------ ops
+
+
+def _exchange(group: _Group, op: str, payload) -> dict[int, Any]:
+    key = group.next_key(op)
+    return ray_tpu.get(
+        group.store.exchange.remote(key, group.rank, payload),
+        timeout=120.0)
+
+
+def allreduce(tensor, group_name: str = "default",
+              op: ReduceOp = ReduceOp.SUM):
+    """Reference: collective.py:258. Returns the reduced array."""
+    group = _group(group_name)
+    contributions = _exchange(group, "allreduce", np.asarray(tensor))
+    arrs = [contributions[r] for r in range(group.world_size)]
+    return _REDUCERS[op](np.stack(arrs))
+
+
+def barrier(group_name: str = "default") -> None:
+    """Reference: collective.py:298."""
+    _exchange(_group(group_name), "barrier", None)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    """Reference: collective.py:373. Returns src's tensor on every rank."""
+    group = _group(group_name)
+    payload = np.asarray(tensor) if group.rank == src_rank else None
+    contributions = _exchange(group, "broadcast", payload)
+    if contributions.get(src_rank) is None:
+        raise RuntimeError(f"broadcast: src_rank {src_rank} sent nothing")
+    return contributions[src_rank]
+
+
+def allgather(tensor, group_name: str = "default") -> list:
+    """Reference: collective.py:423. Returns [rank0_tensor, ...]."""
+    group = _group(group_name)
+    contributions = _exchange(group, "allgather", np.asarray(tensor))
+    return [contributions[r] for r in range(group.world_size)]
+
+
+def reducescatter(tensor, group_name: str = "default",
+                  op: ReduceOp = ReduceOp.SUM):
+    """Reference: collective.py:472. Each rank gets its 1/world_size
+    chunk (along axis 0) of the reduction."""
+    group = _group(group_name)
+    arr = np.asarray(tensor)
+    if arr.shape[0] % group.world_size:
+        raise ValueError(
+            f"reducescatter: leading dim {arr.shape[0]} not divisible by "
+            f"world_size {group.world_size}")
+    contributions = _exchange(group, "reducescatter", arr)
+    reduced = _REDUCERS[op](
+        np.stack([contributions[r] for r in range(group.world_size)]))
+    chunks = np.split(reduced, group.world_size, axis=0)
+    return chunks[group.rank]
+
+
+def send(tensor, dst_rank: int, group_name: str = "default",
+         tag: int = 0) -> None:
+    """Reference: collective.py:531."""
+    group = _group(group_name)
+    ray_tpu.get(group.store.p2p_put.remote(
+        (group.rank, dst_rank, tag), np.asarray(tensor)))
+
+
+def recv(src_rank: int, group_name: str = "default", tag: int = 0):
+    """Reference: collective.py:594. Blocks for a matching send."""
+    group = _group(group_name)
+    return ray_tpu.get(group.store.p2p_take.remote(
+        (src_rank, group.rank, tag)), timeout=120.0)

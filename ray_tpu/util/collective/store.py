@@ -1,0 +1,77 @@
+"""Rendezvous actor backing the host-side collective backend.
+
+Reference: the NCCL group's bootstrap in
+python/ray/util/collective/collective_group/nccl_collective_group.py
+rendezvouses through a named store actor (Rendezvous/NCCLUniqueIDStore);
+here the store carries the *data itself* (Gloo-equivalent CPU plane):
+every rank contributes a payload for (op sequence number), the store
+releases the full set once world_size contributions arrived.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+
+class CollectiveStore:
+    """Runs as a named actor, one per collective group."""
+
+    def __init__(self, world_size: int):
+        self._world = world_size
+        self._lock = threading.Condition()
+        # op_key -> {rank: payload}
+        self._pending: dict[str, dict[int, Any]] = {}
+        # op_key -> number of ranks that already collected (for cleanup)
+        self._collected: dict[str, int] = {}
+        # (src, dst, tag) point-to-point mailbox
+        self._mailbox: dict[tuple, Any] = {}
+
+    def world_size(self) -> int:
+        return self._world
+
+    def exchange(self, op_key: str, rank: int, payload: Any,
+                 timeout_s: float = 60.0) -> dict[int, Any]:
+        """Contribute and block until every rank contributed; returns
+        {rank: payload} for the whole group."""
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            slot = self._pending.setdefault(op_key, {})
+            if rank in slot:
+                raise RuntimeError(
+                    f"rank {rank} contributed twice to {op_key} — "
+                    f"collective calls out of order?")
+            slot[rank] = payload
+            self._lock.notify_all()
+            while len(slot) < self._world:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(
+                        f"collective {op_key}: only {len(slot)}/"
+                        f"{self._world} ranks arrived within {timeout_s}s")
+                self._lock.wait(remaining)
+            result = dict(slot)
+            self._collected[op_key] = self._collected.get(op_key, 0) + 1
+            if self._collected[op_key] >= self._world:
+                del self._pending[op_key]
+                del self._collected[op_key]
+            return result
+
+    # ------------------------------------------------------ point-to-point
+
+    def p2p_put(self, key: tuple, payload: Any) -> None:
+        with self._lock:
+            self._mailbox[key] = payload
+            self._lock.notify_all()
+
+    def p2p_take(self, key: tuple, timeout_s: float = 60.0) -> Any:
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            while key not in self._mailbox:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TimeoutError(f"recv {key}: no matching send "
+                                       f"within {timeout_s}s")
+                self._lock.wait(remaining)
+            return self._mailbox.pop(key)

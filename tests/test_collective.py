@@ -1,0 +1,149 @@
+"""Collective API tests.
+
+Reference surface: python/ray/util/collective/tests (allreduce/
+broadcast/allgather/reducescatter/sendrecv across actor groups) plus the
+device-plane (XLA over the virtual 8-device mesh).
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util import collective as col
+
+
+@ray_tpu.remote
+class Worker:
+    def __init__(self, rank, world):
+        self.rank = rank
+        self.world = world
+        col.init_collective_group(world, rank, group_name="g")
+
+    def do_allreduce(self):
+        return col.allreduce(np.full((4,), self.rank + 1.0),
+                             group_name="g")
+
+    def do_allgather(self):
+        return col.allgather(np.array([self.rank]), group_name="g")
+
+    def do_broadcast(self):
+        t = (np.arange(3) * 7 if self.rank == 1
+             else np.zeros(3, dtype=np.int64))
+        return col.broadcast(t, src_rank=1, group_name="g")
+
+    def do_reducescatter(self):
+        return col.reducescatter(
+            np.arange(8, dtype=np.float64) + self.rank, group_name="g")
+
+    def do_sendrecv(self):
+        if self.rank == 0:
+            col.send(np.array([42.0]), dst_rank=1, group_name="g")
+        elif self.rank == 1:
+            return col.recv(src_rank=0, group_name="g")
+        return None
+
+    def do_barrier(self):
+        col.barrier(group_name="g")
+        return self.rank
+
+    def stats(self):
+        return (col.get_rank("g"), col.get_world_size("g"))
+
+
+@pytest.fixture
+def group(ray_start_regular):
+    workers = [Worker.remote(r, 4) for r in range(4)]
+    # Ensure constructors (and group init) finished.
+    ray_tpu.get([w.stats.remote() for w in workers])
+    yield workers
+    for w in workers:
+        ray_tpu.kill(w)
+
+
+def _run_all(workers, method):
+    return ray_tpu.get([getattr(w, method).remote() for w in workers])
+
+
+def test_allreduce(group):
+    results = _run_all(group, "do_allreduce")
+    expected = np.full((4,), 1.0 + 2 + 3 + 4)
+    for r in results:
+        np.testing.assert_allclose(r, expected)
+
+
+def test_allgather(group):
+    for r in _run_all(group, "do_allgather"):
+        assert [int(x[0]) for x in r] == [0, 1, 2, 3]
+
+
+def test_broadcast(group):
+    for r in _run_all(group, "do_broadcast"):
+        np.testing.assert_array_equal(r, np.arange(3) * 7)
+
+
+def test_reducescatter(group):
+    results = _run_all(group, "do_reducescatter")
+    # sum over ranks of (arange(8) + rank) = 4*arange(8) + 6
+    full = 4 * np.arange(8, dtype=np.float64) + 6
+    for rank, r in enumerate(results):
+        np.testing.assert_allclose(r, full[rank * 2:(rank + 1) * 2])
+
+
+def test_sendrecv(group):
+    results = _run_all(group, "do_sendrecv")
+    assert results[0] is None
+    np.testing.assert_allclose(results[1], [42.0])
+
+
+def test_barrier_and_rank(group):
+    assert sorted(_run_all(group, "do_barrier")) == [0, 1, 2, 3]
+    stats = _run_all(group, "stats")
+    assert stats == [(r, 4) for r in range(4)]
+
+
+def test_uninitialized_group_raises(ray_start_regular):
+    with pytest.raises(RuntimeError, match="not initialized"):
+        col.allreduce(np.ones(2), group_name="nope")
+
+
+def test_world_size_mismatch_raises(ray_start_regular):
+    @ray_tpu.remote
+    class W:
+        def go(self, world, rank):
+            col.init_collective_group(world, rank, group_name="mm")
+            return True
+
+    a = W.remote()
+    assert ray_tpu.get(a.go.remote(2, 0))
+    b = W.remote()
+    with pytest.raises(Exception, match="world_size"):
+        ray_tpu.get(b.go.remote(3, 0))
+
+
+# ------------------------------------------------------------ device plane
+
+
+def test_xla_device_allreduce():
+    x = np.stack([np.full((3,), float(i)) for i in range(8)])
+    out = col.xla.device_allreduce(x)
+    np.testing.assert_allclose(out, np.full((3,), sum(range(8))))
+
+
+def test_xla_device_allgather():
+    x = np.arange(8, dtype=np.float32)[:, None]
+    out = col.xla.device_allgather(x)
+    np.testing.assert_allclose(out, x)
+
+
+def test_xla_device_reducescatter():
+    x = np.stack([np.arange(8, dtype=np.float32) + i for i in range(8)])
+    out = col.xla.device_reducescatter(x)
+    full = 8 * np.arange(8, dtype=np.float32) + sum(range(8))
+    np.testing.assert_allclose(out.reshape(-1), full)
+
+
+def test_xla_ring_shift():
+    x = np.arange(8, dtype=np.float32)[:, None]
+    out = col.xla.device_ring_shift(x, shift=1)
+    np.testing.assert_allclose(out.reshape(-1),
+                               np.roll(np.arange(8, dtype=np.float32), 1))
