@@ -83,19 +83,26 @@ def main() -> None:
         batch = shard_batch(
             {"tokens": tokens[:, :-1], "targets": tokens[:, 1:]}, mesh)
 
-        # Warmup/compile.
+        # Warmup/compile. NOTE: the measurement fences every step with a
+        # host fetch of the loss — on the tunneled TPU platform
+        # block_until_ready returns before execution finishes, so an
+        # unfenced loop under-reports step time by >100x; the per-step
+        # fetch also keeps the tunnel's work queue shallow (deep queues
+        # abort with INVALID_ARGUMENT).
         state, metrics = step(state, batch)
-        jax.block_until_ready(metrics["loss"])
+        float(metrics["loss"])
 
-        n_steps = 10 if on_tpu else 2
-        start = time.perf_counter()
+        n_steps = 8 if on_tpu else 2
+        times = []
         for _ in range(n_steps):
+            start = time.perf_counter()
             state, metrics = step(state, batch)
-        jax.block_until_ready(metrics["loss"])
-        elapsed = time.perf_counter() - start
+            float(metrics["loss"])  # host fetch = real fence
+            times.append(time.perf_counter() - start)
+        times.sort()
+        step_time = times[len(times) // 2]  # median
 
     tokens_per_step = batch_size * seq_len
-    step_time = elapsed / n_steps
     tokens_per_sec = tokens_per_step / step_time
     achieved = tokens_per_sec * llama.flops_per_token(config, seq_len)
     mfu = achieved / peak_flops(device)

@@ -51,8 +51,13 @@ class LlamaConfig:
     rms_norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
-    # "plain" (full attention) or "ring" (context parallel over sp axis —
-    # requires running inside shard_map with an "sp" axis).
+    # Remat policy: "full" recomputes everything (min memory);
+    # "dots" saves matmul outputs and recomputes elementwise only —
+    # much less recompute FLOPs for ~2x the activation memory.
+    remat_policy: str = "full"
+    # "plain" (full attention), "flash" (pallas blockwise kernel), or
+    # "ring" (context parallel over sp axis — requires running inside
+    # shard_map with an "sp" axis; "ring_local" when already inside).
     attention: str = "plain"
 
     @staticmethod
@@ -191,6 +196,11 @@ def _attention_block(layer: dict, x: jax.Array, positions: jax.Array,
     elif config.attention == "ring_local":
         # Already inside a shard_map with an "sp" axis.
         out = ring_attention(q, k, v, axis_name="sp", causal=True)
+    elif config.attention == "flash":
+        # Pallas blockwise kernel (ray_tpu.ops.flash_attention).
+        from ray_tpu.ops import flash_attention
+
+        out = flash_attention(q, k, v, causal=True)
     else:
         out = plain_attention(q, k, v, causal=True)
     return x + jnp.einsum("blhd,hde->ble", out, layer["wo"].astype(dtype))
@@ -224,7 +234,17 @@ def forward(params: dict, tokens: jax.Array, config: LlamaConfig,
 
     step = layer_step
     if config.remat:
-        step = jax.checkpoint(layer_step, prevent_cse=False)
+        policy = None
+        if config.remat_policy == "dots":
+            # Saves weight-activation matmul outputs, recomputes
+            # elementwise AND the [L, L] attention scores (those are the
+            # batched dots — saving them would be O(B·H·L²)).
+            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        elif config.remat_policy != "full":
+            raise ValueError(
+                f"remat_policy={config.remat_policy!r}: expected 'full' "
+                f"or 'dots'")
+        step = jax.checkpoint(layer_step, prevent_cse=False, policy=policy)
     x, _ = lax.scan(step, x, params["layers"])
     x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
     logits = jnp.einsum("ble,ev->blv", x.astype(jnp.float32),

@@ -1,0 +1,13 @@
+"""ray_tpu.ops — pallas TPU kernels for the hot ops.
+
+The reference delegates all device kernels to torch/CUDA; here they are
+first-class: blockwise flash attention (flash_attention.py) and fused
+elementwise kernels (fused.py). Every op is differentiable (custom
+vjp) and falls back to pallas interpret mode off-TPU so the same code
+path runs in CPU tests.
+"""
+
+from ray_tpu.ops.flash_attention import flash_attention
+from ray_tpu.ops.fused import rms_norm
+
+__all__ = ["flash_attention", "rms_norm"]

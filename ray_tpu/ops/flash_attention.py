@@ -1,0 +1,202 @@
+"""Blockwise (flash) causal attention as a pallas TPU kernel.
+
+The reference framework has no attention kernels at all (SURVEY §5
+long-context: absent — it launches torch models); this is a native
+capability of the TPU build. Design per the pallas guide
+(/opt/skills/guides/pallas_guide.md):
+
+- grid = (batch*heads, L/block_q); each program owns one q tile in VMEM
+  and streams k/v tiles from the per-(b,h) VMEM block with online
+  softmax (running max/denominator) — O(block) VMEM, no [L, L] scores
+  materialized in HBM;
+- causal programs stop their k loop at the diagonal (work ∝ L²/2);
+- matmuls hit the MXU via jnp.dot with preferred_element_type=f32,
+  softmax statistics stay f32 while tiles stay input-dtype;
+- backward: custom_vjp whose bwd differentiates a checkpointed
+  blockwise lax.scan reference (recompute instead of storing scores —
+  activation memory O(L·D), the flash-backward tradeoff) so the op is
+  trainable today; a fused bwd kernel can replace it transparently.
+
+On CPU (tests / virtual mesh) the kernel runs in interpret mode
+automatically.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # TPU backend only; tests run interpret mode on CPU.
+    from jax.experimental.pallas import tpu as pltpu
+
+    _MEMSPACE = pltpu.VMEM
+except Exception:  # pragma: no cover - pallas TPU backend unavailable
+    pltpu = None
+    _MEMSPACE = None
+
+NEG_INF = -1e30
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
+                 scale: float, causal: bool, seq_len: int):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
+    d = q.shape[-1]
+
+    q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    if causal:
+        # Only k blocks at or left of the diagonal.
+        num_k_blocks = lax.div(qi * block_q, block_k) + pl.cdiv(
+            block_q, block_k)
+        num_k_blocks = jnp.minimum(num_k_blocks, seq_len // block_k)
+    else:
+        num_k_blocks = seq_len // block_k
+
+    def body(j, carry):
+        m_prev, l_prev, acc = carry
+        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        if causal:
+            k_pos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(p, v,
+                                    preferred_element_type=jnp.float32)
+        return m_new, l_new, acc
+
+    m0 = jnp.full((block_q, 1), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((block_q, 1), dtype=jnp.float32)
+    acc0 = jnp.zeros((block_q, d), dtype=jnp.float32)
+    _, l_fin, acc = lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l_fin, 1e-30)).astype(o_ref.dtype)
+
+
+def _fit_block(requested: int, seq_len: int) -> int:
+    """Largest divisor of seq_len ≤ requested — the grid and k-loop use
+    exact tiling, so a non-dividing block would silently drop tail rows/
+    keys. Correctness over tile-shape preference."""
+    b = min(requested, seq_len)
+    while seq_len % b:
+        b -= 1
+    return b
+
+
+def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
+               interpret: bool):
+    """q/k/v: [BH, L, D] → o [BH, L, D]."""
+    bh, seq_len, d = q.shape
+    block_q = _fit_block(block_q, seq_len)
+    block_k = _fit_block(block_k, seq_len)
+    scale = d ** -0.5
+    kernel = functools.partial(
+        _attn_kernel, block_q=block_q, block_k=block_k, scale=scale,
+        causal=causal, seq_len=seq_len)
+    spec_kwargs = {}
+    if _MEMSPACE is not None and not interpret:
+        spec_kwargs["memory_space"] = _MEMSPACE
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, seq_len // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+                         **spec_kwargs),
+            pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0),
+                         **spec_kwargs),
+            pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0),
+                         **spec_kwargs),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+                               **spec_kwargs),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _blockwise_reference(q, k, v, causal: bool, block_k: int):
+    """Pure-JAX blockwise attention (same online-softmax math); its
+    checkpointed vjp is the flash backward."""
+    bh, seq_len, d = q.shape
+    block_k = _fit_block(block_k, seq_len)
+    scale = d ** -0.5
+    qf = q.astype(jnp.float32) * scale
+    q_pos = jnp.arange(seq_len)[:, None]
+    n_blocks = seq_len // block_k
+    kb = k.astype(jnp.float32).reshape(bh, n_blocks, block_k, d)
+    vb = v.astype(jnp.float32).reshape(bh, n_blocks, block_k, d)
+
+    def step(carry, blk):
+        m_prev, l_prev, acc = carry
+        kj, vj, j = blk
+        s = jnp.einsum("bqd,bkd->bqk", qf, kj)
+        if causal:
+            k_pos = j * block_k + jnp.arange(block_k)[None, :]
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.einsum("bqk,bkd->bqd", p, vj)
+        return (m_new, l_new, acc), None
+
+    m0 = jnp.full((bh, seq_len, 1), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((bh, seq_len, 1), dtype=jnp.float32)
+    acc0 = jnp.zeros((bh, seq_len, d), dtype=jnp.float32)
+    (_, l_fin, acc), _ = lax.scan(
+        jax.checkpoint(step, prevent_cse=False),
+        (m0, l0, acc0),
+        (kb.swapaxes(0, 1), vb.swapaxes(0, 1), jnp.arange(n_blocks)))
+    return (acc / jnp.maximum(l_fin, 1e-30)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _core_fwd(q, k, v, causal, block_q, block_k, interpret):
+    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+
+
+def _core_bwd(causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: _blockwise_reference(q, k, v, causal, block_k),
+        q, k, v)
+    return vjp(g)
+
+
+_flash_core.defvjp(_core_fwd, _core_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128, interpret: bool | None = None):
+    """Flash attention over [B, L, H, D] (layout used by models/llama).
+
+    GQA (fewer kv heads than q heads) is handled by repeating kv heads.
+    Differentiable (custom vjp). ``interpret=None`` auto-selects
+    interpret mode off-TPU.
+    """
+    b, l, h, d = q.shape
+    kvh = k.shape[2]
+    if kvh != h:
+        reps = h // kvh
+        k = jnp.repeat(k, reps, axis=2)
+        v = jnp.repeat(v, reps, axis=2)
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu",)
+    # [B, L, H, D] -> [B*H, L, D]
+    qt = q.transpose(0, 2, 1, 3).reshape(b * h, l, d)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * h, l, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * h, l, d)
+    out = _flash_core(qt, kt, vt, causal, block_q, block_k, interpret)
+    return out.reshape(b, h, l, d).transpose(0, 2, 1, 3)

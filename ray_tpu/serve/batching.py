@@ -16,6 +16,7 @@ import concurrent.futures
 import functools
 import threading
 import time
+import weakref
 from typing import Any, Callable
 
 
@@ -100,20 +101,33 @@ def batch(_fn: Callable | None = None, *, max_batch_size: int = 10,
     def decorator(fn: Callable):
         # One batcher per bound instance (replicas must not share queues
         # or execute against each other's self); plain functions share
-        # the module-level batcher.
+        # the module-level batcher. Weak keys: a dead replica's batcher
+        # is collected with it — no leak, and no id()-reuse handing a
+        # new instance a stale batcher bound to the old self.
         free_batcher = _Batcher(fn, max_batch_size, batch_wait_timeout_s)
-        per_instance: dict[int, _Batcher] = {}
+        per_instance: "weakref.WeakKeyDictionary" = (
+            weakref.WeakKeyDictionary())
+        id_fallback: dict[int, _Batcher] = {}  # non-weakrefable classes
         creation_lock = threading.Lock()
 
         def batcher_for(instance):
             if instance is None:
                 return free_batcher
             with creation_lock:
-                b = per_instance.get(id(instance))
-                if b is None:
-                    b = _Batcher(fn, max_batch_size, batch_wait_timeout_s)
-                    per_instance[id(instance)] = b
-                return b
+                try:
+                    b = per_instance.get(instance)
+                    if b is None:
+                        b = _Batcher(fn, max_batch_size,
+                                     batch_wait_timeout_s)
+                        per_instance[instance] = b
+                    return b
+                except TypeError:  # no __weakref__ slot
+                    b = id_fallback.get(id(instance))
+                    if b is None:
+                        b = _Batcher(fn, max_batch_size,
+                                     batch_wait_timeout_s)
+                        id_fallback[id(instance)] = b
+                    return b
 
         @functools.wraps(fn)
         def wrapper(*args):

@@ -29,6 +29,9 @@ class _ReplicaState:
     handle: Any
     healthy: bool = True
     last_ongoing: float = 0.0
+    # In-flight health probe: (ref, sent_at monotonic). A probe
+    # unanswered past health_check_timeout_s marks the replica dead.
+    probe: tuple | None = None
 
 
 @dataclass
@@ -98,6 +101,7 @@ class ServeController:
 
     def delete_app(self, app_name: str) -> None:
         with self._lock:
+            self._ingress.pop(app_name, None)
             for key, state in self._deployments.items():
                 if key[0] == app_name:
                     state.deleting = True
@@ -105,6 +109,7 @@ class ServeController:
 
     def shutdown(self) -> None:
         with self._lock:
+            self._ingress.clear()
             for state in self._deployments.values():
                 state.deleting = True
                 state.target_replicas = 0
@@ -233,52 +238,50 @@ class ServeController:
                     state.target_replicas = desired
 
     def _health_check_once(self) -> None:
+        """Fully non-blocking probe cycle: each replica carries at most
+        one outstanding check_health ref; a probe that raises → dead, a
+        probe unanswered past health_check_timeout_s → dead (hung
+        replica), otherwise keep waiting. A slow replica never stalls
+        the reconcile thread, and a replica with a long __init__ only
+        fails once the timeout genuinely elapses."""
         import ray_tpu
 
         with self._lock:
             states = list(self._deployments.values())
-        # Fire all probes in parallel; one bounded wait for the whole
-        # fleet so a slow replica can't serially stall reconciliation.
-        probes = []  # (state, replica, ref)
+        now = time.monotonic()
         for state in states:
+            timeout_s = state.deployment_config.health_check_timeout_s
+            dead = []
             for replica in state.replicas:
-                try:
-                    probes.append(
-                        (state, replica,
-                         replica.handle.check_health.remote()))
-                except Exception:  # noqa: BLE001 — clearly dead
-                    probes.append((state, replica, None))
-        if not probes:
-            return
-        timeout = max(s.deployment_config.health_check_timeout_s
-                      for s in states) if states else 30.0
-        live_refs = [ref for _, _, ref in probes if ref is not None]
-        if live_refs:
-            ray_tpu.wait(live_refs, num_returns=len(live_refs),
-                         timeout=timeout)
-        by_state: dict[int, list] = {}
-        for state, replica, ref in probes:
-            failed = ref is None
-            if ref is not None:
+                if replica.probe is None:
+                    try:
+                        replica.probe = (
+                            replica.handle.check_health.remote(), now)
+                    except Exception:  # noqa: BLE001 — clearly dead
+                        dead.append(replica)
+                    continue
+                ref, sent_at = replica.probe
                 try:
                     ready, _ = ray_tpu.wait([ref], timeout=0)
-                    if ready:
+                except Exception:  # noqa: BLE001
+                    ready = [ref]
+                if ready:
+                    try:
                         ray_tpu.get(ref, timeout=1.0)
-                    # Not ready ≠ dead: the replica may still be
-                    # initializing (long __init__) or busy — leave it.
-                except Exception:  # noqa: BLE001 — probe raised: unhealthy
-                    failed = True
-            if failed:
-                by_state.setdefault(id(state), [state, []])[1].append(replica)
-        for state, dead in by_state.values():
-            with self._lock:
-                for replica in dead:
-                    if replica in state.replicas:
-                        state.replicas.remove(replica)
-                        self._stop_replica(
-                            replica, state.deployment_config
-                            .graceful_shutdown_timeout_s)
-                self._broadcast(state)  # replacements come next tick
+                        replica.probe = None  # healthy; next tick re-probes
+                    except Exception:  # noqa: BLE001 — probe raised
+                        dead.append(replica)
+                elif now - sent_at > timeout_s:
+                    dead.append(replica)  # hung past the deadline
+            if dead:
+                with self._lock:
+                    for replica in dead:
+                        if replica in state.replicas:
+                            state.replicas.remove(replica)
+                            self._stop_replica(
+                                replica, state.deployment_config
+                                .graceful_shutdown_timeout_s)
+                    self._broadcast(state)  # replacements come next tick
 
     def _reconcile_loop(self) -> None:
         last_autoscale = 0.0

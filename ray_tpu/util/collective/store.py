@@ -25,8 +25,9 @@ class CollectiveStore:
         self._pending: dict[str, dict[int, Any]] = {}
         # op_key -> number of ranks that already collected (for cleanup)
         self._collected: dict[str, int] = {}
-        # (src, dst, tag) point-to-point mailbox
-        self._mailbox: dict[tuple, Any] = {}
+        # (src, dst, tag) point-to-point mailboxes — FIFO queues, so
+        # back-to-back sends before the first recv are not lost.
+        self._mailbox: dict[tuple, list] = {}
 
     def world_size(self) -> int:
         return self._world
@@ -62,16 +63,20 @@ class CollectiveStore:
 
     def p2p_put(self, key: tuple, payload: Any) -> None:
         with self._lock:
-            self._mailbox[key] = payload
+            self._mailbox.setdefault(key, []).append(payload)
             self._lock.notify_all()
 
     def p2p_take(self, key: tuple, timeout_s: float = 60.0) -> Any:
         deadline = time.monotonic() + timeout_s
         with self._lock:
-            while key not in self._mailbox:
+            while not self._mailbox.get(key):
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise TimeoutError(f"recv {key}: no matching send "
                                        f"within {timeout_s}s")
                 self._lock.wait(remaining)
-            return self._mailbox.pop(key)
+            queue = self._mailbox[key]
+            payload = queue.pop(0)
+            if not queue:
+                del self._mailbox[key]
+            return payload

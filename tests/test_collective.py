@@ -147,3 +147,25 @@ def test_xla_ring_shift():
     out = col.xla.device_ring_shift(x, shift=1)
     np.testing.assert_allclose(out.reshape(-1),
                                np.roll(np.arange(8, dtype=np.float32), 1))
+
+
+def test_sendrecv_queue_preserves_order(ray_start_regular):
+    """Back-to-back sends before any recv must all arrive, in order."""
+    @ray_tpu.remote
+    class P:
+        def __init__(self, rank):
+            col.init_collective_group(2, rank, group_name="q")
+            self.rank = rank
+
+        def producer(self):
+            for i in range(5):
+                col.send(np.array([float(i)]), dst_rank=1, group_name="q")
+            return True
+
+        def consumer(self):
+            return [float(col.recv(src_rank=0, group_name="q")[0])
+                    for _ in range(5)]
+
+    a, b = P.remote(0), P.remote(1)
+    assert ray_tpu.get(a.producer.remote())
+    assert ray_tpu.get(b.consumer.remote()) == [0.0, 1.0, 2.0, 3.0, 4.0]
