@@ -41,11 +41,13 @@ def bench_config():
     from ray_tpu.models.llama import LlamaConfig
 
     # ~350M params: fits params+AdamW(f32)+activations in 16GB HBM.
+    # flash (pallas kernels, fwd + fused bwd) + "dots" remat measured
+    # 40.7% MFU on v5e vs 25.9% for plain attention + full remat.
     return dataclasses.replace(
         LlamaConfig(),
         vocab_size=32000, hidden_size=1024, intermediate_size=2816,
         num_layers=24, num_heads=16, num_kv_heads=8, head_dim=64,
-        max_seq_len=2048)
+        max_seq_len=2048, attention="flash", remat_policy="dots")
 
 
 def main() -> None:

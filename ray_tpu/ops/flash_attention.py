@@ -1,24 +1,33 @@
-"""Blockwise (flash) causal attention as a pallas TPU kernel.
+"""Blockwise (flash) causal attention as pallas TPU kernels, fwd + bwd.
 
 The reference framework has no attention kernels at all (SURVEY §5
 long-context: absent — it launches torch models); this is a native
 capability of the TPU build. Design per the pallas guide
 (/opt/skills/guides/pallas_guide.md):
 
-- grid = (batch*heads, L/block_q); each program owns one q tile in VMEM
-  and streams k/v tiles from the per-(b,h) VMEM block with online
-  softmax (running max/denominator) — O(block) VMEM, no [L, L] scores
-  materialized in HBM;
-- causal programs stop their k loop at the diagonal (work ∝ L²/2);
-- matmuls hit the MXU via jnp.dot with preferred_element_type=f32,
-  softmax statistics stay f32 while tiles stay input-dtype;
-- backward: custom_vjp whose bwd differentiates a checkpointed
-  blockwise lax.scan reference (recompute instead of storing scores —
-  activation memory O(L·D), the flash-backward tradeoff) so the op is
-  trainable today; a fused bwd kernel can replace it transparently.
+- forward: grid = (batch*heads, L/block_q); each program owns one q tile
+  in VMEM and streams k/v tiles from the per-(b,h) VMEM block with
+  online softmax (running max/denominator) — O(block) VMEM, no [L, L]
+  scores materialized in HBM. Also emits the per-row logsumexp (LSE)
+  residual for the backward.
+- backward: two fused kernels using the saved LSE (no online softmax
+  needed — probabilities are recomputed exactly as exp(s - lse)):
+  * dq kernel, grid (batch*heads, L/block_q): for one q tile, loop over
+    k tiles at-or-left-of the diagonal accumulating
+    dq += (p ∘ (dO·Vᵀ - D)) · K.
+  * dk/dv kernel, grid (batch*heads, L/block_k): for one k tile, loop
+    over q tiles at-or-below the diagonal accumulating
+    dv += pᵀ·dO and dk += (p ∘ (dO·Vᵀ - D))ᵀ · Q.
+  D = rowsum(dO ∘ O) is recomputed per q tile from the O residual —
+  cheaper than a third pass or an HBM round-trip.
+- matmul operands stay bf16 (MXU native) with
+  preferred_element_type=f32 accumulation; softmax statistics are f32.
+- causal programs stop their k loop at the diagonal (work ∝ L²/2), and
+  the dk/dv kernel starts its q loop there.
 
-On CPU (tests / virtual mesh) the kernel runs in interpret mode
-automatically.
+On CPU (tests / virtual mesh) the kernels run in interpret mode
+automatically. ``_blockwise_reference`` remains as the correctness
+oracle for tests.
 """
 
 from __future__ import annotations
@@ -41,10 +50,13 @@ except Exception:  # pragma: no cover - pallas TPU backend unavailable
 NEG_INF = -1e30
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
-                 scale: float, causal: bool, seq_len: int):
+# ------------------------------------------------------------------ forward
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, block_q: int,
+                block_k: int, scale: float, causal: bool, seq_len: int):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale          # [bq, D]
+    q = q_ref[0]                                      # [bq, D] bf16
     d = q.shape[-1]
 
     q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
@@ -59,9 +71,11 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
 
     def body(j, carry):
         m_prev, l_prev, acc = carry
-        k = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)  # [bq, bk]
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]   # bf16
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        # bf16 × bf16 on the MXU, f32 accumulation; scale applied to the
+        # f32 result (not the bf16 operand) to keep softmax numerics.
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
             k_pos = j * block_k + lax.broadcasted_iota(
                 jnp.int32, (1, block_k), 1)
@@ -70,15 +84,17 @@ def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, block_q: int, block_k: int,
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.dot(p, v,
+        acc = acc * alpha + jnp.dot(p.astype(v.dtype), v,
                                     preferred_element_type=jnp.float32)
         return m_new, l_new, acc
 
     m0 = jnp.full((block_q, 1), NEG_INF, dtype=jnp.float32)
     l0 = jnp.zeros((block_q, 1), dtype=jnp.float32)
     acc0 = jnp.zeros((block_q, d), dtype=jnp.float32)
-    _, l_fin, acc = lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l_fin, 1e-30)).astype(o_ref.dtype)
+    m_fin, l_fin, acc = lax.fori_loop(0, num_k_blocks, body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l_fin, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0, :, 0] = (m_fin + jnp.log(l_safe))[:, 0]
 
 
 def _fit_block(requested: int, seq_len: int) -> int:
@@ -91,40 +107,182 @@ def _fit_block(requested: int, seq_len: int) -> int:
     return b
 
 
+def _specs(shapes_and_maps, interpret):
+    kwargs = {}
+    if _MEMSPACE is not None and not interpret:
+        kwargs["memory_space"] = _MEMSPACE
+    return [pl.BlockSpec(shape, index_map, **kwargs)
+            for shape, index_map in shapes_and_maps]
+
+
 def _flash_fwd(q, k, v, causal: bool, block_q: int, block_k: int,
                interpret: bool):
-    """q/k/v: [BH, L, D] → o [BH, L, D]."""
+    """q/k/v: [BH, L, D] → (o [BH, L, D], lse [BH, L, 1] f32)."""
     bh, seq_len, d = q.shape
     block_q = _fit_block(block_q, seq_len)
     block_k = _fit_block(block_k, seq_len)
     scale = d ** -0.5
     kernel = functools.partial(
-        _attn_kernel, block_q=block_q, block_k=block_k, scale=scale,
+        _fwd_kernel, block_q=block_q, block_k=block_k, scale=scale,
         causal=causal, seq_len=seq_len)
-    spec_kwargs = {}
-    if _MEMSPACE is not None and not interpret:
-        spec_kwargs["memory_space"] = _MEMSPACE
+    in_specs = _specs([
+        ((1, block_q, d), lambda b, i: (b, i, 0)),
+        ((1, seq_len, d), lambda b, i: (b, 0, 0)),
+        ((1, seq_len, d), lambda b, i: (b, 0, 0)),
+    ], interpret)
+    out_specs = _specs([
+        ((1, block_q, d), lambda b, i: (b, i, 0)),
+        ((1, block_q, 1), lambda b, i: (b, i, 0)),
+    ], interpret)
     return pl.pallas_call(
         kernel,
         grid=(bh, seq_len // block_q),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
-                         **spec_kwargs),
-            pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0),
-                         **spec_kwargs),
-            pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0),
-                         **spec_kwargs),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_len, 1), jnp.float32),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
-                               **spec_kwargs),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=interpret,
     )(q, k, v)
 
 
+# ----------------------------------------------------------------- backward
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dq_ref, *,
+                   block_q: int, block_k: int, scale: float, causal: bool,
+                   seq_len: int):
+    qi = pl.program_id(1)
+    q = q_ref[0]                                       # [bq, D] bf16
+    do = do_ref[0]                                     # [bq, D] bf16
+    o = o_ref[0]
+    lse = lse_ref[0]                                   # [bq, 1] f32
+    d = q.shape[-1]
+
+    # D_i = rowsum(dO ∘ O), f32.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)            # [bq, 1]
+
+    q_pos = qi * block_q + lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    if causal:
+        num_k_blocks = lax.div(qi * block_q, block_k) + pl.cdiv(
+            block_q, block_k)
+        num_k_blocks = jnp.minimum(num_k_blocks, seq_len // block_k)
+    else:
+        num_k_blocks = seq_len // block_k
+
+    def body(j, dq_acc):
+        k = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            k_pos = j * block_k + lax.broadcasted_iota(
+                jnp.int32, (1, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                           # [bq, bk] f32
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)                          # [bq, bk] f32
+        return dq_acc + jnp.dot(ds.astype(k.dtype), k,
+                                preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros((block_q, d), dtype=jnp.float32)
+    dq = lax.fori_loop(0, num_k_blocks, body, dq0)
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, do_ref, dk_ref,
+                    dv_ref, *, block_q: int, block_k: int, scale: float,
+                    causal: bool, seq_len: int):
+    ki = pl.program_id(1)
+    k = k_ref[0]                                       # [bk, D] bf16
+    v = v_ref[0]
+    d = k.shape[-1]
+
+    k_pos = ki * block_k + lax.broadcasted_iota(jnp.int32, (1, block_k), 1)
+
+    num_q_blocks = seq_len // block_q
+    if causal:
+        # q blocks strictly left of this k tile never attend to it.
+        first_q_block = lax.div(ki * block_k, block_q)
+    else:
+        first_q_block = 0
+
+    def body(i, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, pl.ds(i * block_q, block_q), :]
+        o = o_ref[0, pl.ds(i * block_q, block_q), :]
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), :]   # [bq, 1] f32
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = i * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, 1), 0)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)                           # [bq, bk] f32
+        pt = p.astype(do.dtype).T                      # [bk, bq]
+        dv_acc = dv_acc + jnp.dot(pt, do,
+                                  preferred_element_type=jnp.float32)
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1, keepdims=True)        # [bq, 1]
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta)).astype(q.dtype)        # [bq, bk]
+        dk_acc = dk_acc + jnp.dot(ds.T, q,
+                                  preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    dk0 = jnp.zeros((block_k, d), dtype=jnp.float32)
+    dv0 = jnp.zeros((block_k, d), dtype=jnp.float32)
+    dk, dv = lax.fori_loop(first_q_block, num_q_blocks, body, (dk0, dv0))
+    dk_ref[0] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, causal: bool, block_q: int,
+               block_k: int, interpret: bool):
+    bh, seq_len, d = q.shape
+    block_q = _fit_block(block_q, seq_len)
+    block_k = _fit_block(block_k, seq_len)
+    scale = d ** -0.5
+    kw = dict(block_q=block_q, block_k=block_k, scale=scale, causal=causal,
+              seq_len=seq_len)
+
+    full = ((1, seq_len, d), lambda b, i: (b, 0, 0))
+    full_lse = ((1, seq_len, 1), lambda b, i: (b, 0, 0))
+    q_tile = ((1, block_q, d), lambda b, i: (b, i, 0))
+    q_lse = ((1, block_q, 1), lambda b, i: (b, i, 0))
+    k_tile = ((1, block_k, d), lambda b, i: (b, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **kw),
+        grid=(bh, seq_len // block_q),
+        in_specs=_specs([q_tile, full, full, q_tile, q_lse, q_tile],
+                        interpret),
+        out_specs=_specs([q_tile], interpret)[0],
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=interpret,
+    )(q, k, v, o, lse, do)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **kw),
+        grid=(bh, seq_len // block_k),
+        in_specs=_specs([full, k_tile, k_tile, full, full_lse, full],
+                        interpret),
+        out_specs=_specs([k_tile, k_tile], interpret),
+        out_shape=[jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)],
+        interpret=interpret,
+    )(q, k, v, o, lse, do)
+    return dq, dk, dv
+
+
+# ------------------------------------------------- reference (test oracle)
+
+
 def _blockwise_reference(q, k, v, causal: bool, block_k: int):
-    """Pure-JAX blockwise attention (same online-softmax math); its
-    checkpointed vjp is the flash backward."""
+    """Pure-JAX blockwise attention (same online-softmax math); the
+    correctness oracle for the kernels in tests."""
     bh, seq_len, d = q.shape
     block_k = _fit_block(block_k, seq_len)
     scale = d ** -0.5
@@ -158,33 +316,36 @@ def _blockwise_reference(q, k, v, causal: bool, block_k: int):
     return (acc / jnp.maximum(l_fin, 1e-30)).astype(q.dtype)
 
 
+# ------------------------------------------------------------- public entry
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_core(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    o, _ = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return o
 
 
 def _core_fwd(q, k, v, causal, block_q, block_k, interpret):
-    return _flash_fwd(q, k, v, causal, block_q, block_k, interpret), (q, k, v)
+    o, lse = _flash_fwd(q, k, v, causal, block_q, block_k, interpret)
+    return o, (q, k, v, o, lse)
 
 
 def _core_bwd(causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: _blockwise_reference(q, k, v, causal, block_k),
-        q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    return _flash_bwd(q, k, v, o, lse, g.astype(q.dtype), causal,
+                      block_q, block_k, interpret)
 
 
 _flash_core.defvjp(_core_fwd, _core_bwd)
 
 
-def flash_attention(q, k, v, causal: bool = True, block_q: int = 128,
-                    block_k: int = 128, interpret: bool | None = None):
+def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
+                    block_k: int = 512, interpret: bool | None = None):
     """Flash attention over [B, L, H, D] (layout used by models/llama).
 
     GQA (fewer kv heads than q heads) is handled by repeating kv heads.
-    Differentiable (custom vjp). ``interpret=None`` auto-selects
-    interpret mode off-TPU.
+    Differentiable via fused pallas backward kernels. ``interpret=None``
+    auto-selects interpret mode off-TPU.
     """
     b, l, h, d = q.shape
     kvh = k.shape[2]

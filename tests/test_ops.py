@@ -69,6 +69,40 @@ def test_flash_attention_grads_match():
         np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
 
 
+def test_flash_attention_grads_match_noncausal():
+    q, k, v = _qkv(l=64)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=False,
+                                       block_q=32, block_k=32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(plain_attention(q, k, v, causal=False) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+
+def test_flash_attention_grads_uneven_blocks():
+    # Gradient path with non-dividing requested blocks (clamped) and GQA.
+    q, k, v = _qkv(l=96, h=8, kvh=2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, block_q=96, block_k=32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(plain_attention(
+            q, jnp.repeat(k, 4, axis=2), jnp.repeat(v, 4, axis=2),
+            causal=True) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(a, b, atol=1e-4, rtol=1e-4)
+
+
 def test_flash_attention_jit_compatible():
     q, k, v = _qkv(l=64)
     f = jax.jit(lambda q, k, v: flash_attention(q, k, v))
