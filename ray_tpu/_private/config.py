@@ -40,7 +40,7 @@ _DEFAULTS: dict[str, Any] = {
     # Logging.
     "log_level": "INFO",
     # Multiprocess worker pool.
-    "worker_pool_size": 0,  # 0 => defer to num_cpus
+    "worker_pool_size": 0,  # 0 => disabled (thread workers); N>0 => N processes
     "worker_startup_timeout_s": 30.0,
     # Placement groups.
     "placement_group_commit_timeout_s": 30.0,

@@ -1,0 +1,109 @@
+"""Serialization boundary for the multiprocess runtime.
+
+TPU-native analogue of the reference's serialization layer
+(python/ray/_private/serialization.py + the cloudpickle fork in
+python/ray/cloudpickle/): cloudpickle for code/closures, pickle
+protocol 5 out-of-band buffers for zero-copy numpy, and a framed
+single-buffer layout so a whole object drops into one shared-memory
+segment that workers map directly.
+
+Layout of a framed object (all lengths little-endian uint64):
+
+    [header_len][header bytes][n_buffers]
+    [buf_0 len][buf_0 bytes] ... [buf_{n-1} len][buf_{n-1} bytes]
+
+``deserialize_from_buffer`` reconstructs buffers as memoryviews into the
+source buffer — numpy arrays come back zero-copy, viewing shared memory
+directly (the moral equivalent of plasma's mmap reads,
+src/ray/object_manager/plasma/client.h).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import Any
+
+import cloudpickle
+
+_U64 = struct.Struct("<Q")
+
+
+def serialize(value: Any) -> tuple[bytes, list[pickle.PickleBuffer]]:
+    """Serialize with out-of-band buffers (zero-copy for numpy)."""
+    buffers: list[pickle.PickleBuffer] = []
+    header = cloudpickle.dumps(value, protocol=5,
+                               buffer_callback=buffers.append)
+    return header, buffers
+
+
+def deserialize(header: bytes, buffers: list) -> Any:
+    return pickle.loads(header, buffers=buffers)
+
+
+def framed_size(header: bytes, buffers: list[pickle.PickleBuffer]) -> int:
+    total = _U64.size * 2 + len(header)
+    for buf in buffers:
+        total += _U64.size + memoryview(buf).nbytes
+    return total
+
+
+def write_framed(target: memoryview, header: bytes,
+                 buffers: list[pickle.PickleBuffer]) -> int:
+    """Write the framed layout into ``target``; returns bytes written."""
+    off = 0
+
+    def put(b) -> None:
+        nonlocal off
+        m = memoryview(b)
+        if m.ndim != 1 or m.format != "B":
+            m = m.cast("B")
+        target[off:off + m.nbytes] = m
+        off += m.nbytes
+
+    put(_U64.pack(len(header)))
+    put(header)
+    put(_U64.pack(len(buffers)))
+    for buf in buffers:
+        m = memoryview(buf)
+        put(_U64.pack(m.nbytes))
+        put(m)
+    return off
+
+
+def serialize_framed(value: Any) -> bytes:
+    header, buffers = serialize(value)
+    out = bytearray(framed_size(header, buffers))
+    write_framed(memoryview(out), header, buffers)
+    return bytes(out)
+
+
+def deserialize_from_buffer(source: memoryview) -> Any:
+    """Read the framed layout; buffers are zero-copy views of ``source``."""
+    off = 0
+
+    def take(n: int) -> memoryview:
+        nonlocal off
+        view = source[off:off + n]
+        off += n
+        return view
+
+    (header_len,) = _U64.unpack(bytes(take(_U64.size)))
+    header = bytes(take(header_len))
+    (n_buffers,) = _U64.unpack(bytes(take(_U64.size)))
+    buffers = []
+    for _ in range(n_buffers):
+        (buf_len,) = _U64.unpack(bytes(take(_U64.size)))
+        buffers.append(take(buf_len))
+    return pickle.loads(header, buffers=buffers)
+
+
+def dumps_function(func: Any) -> bytes:
+    """Pickle code (functions, classes, closures) by value when needed —
+    the function-manager boundary (reference:
+    python/ray/_private/function_manager.py exports to GCS KV)."""
+    return cloudpickle.dumps(func, protocol=5)
+
+
+def loads_function(blob: bytes) -> Any:
+    return pickle.loads(blob)

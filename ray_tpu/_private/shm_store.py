@@ -1,0 +1,212 @@
+"""Shared-memory object transport between the driver and pool workers.
+
+TPU-native analogue of the plasma store (reference:
+src/ray/object_manager/plasma/store_runner.h, object_store.h,
+client.h fd-passing): objects are serialized once into a POSIX
+shared-memory segment (multiprocessing.shared_memory) and mapped
+read-only by any process that needs them — worker-to-worker argument
+passing never copies through the driver.
+
+The driver owns the directory (object_id -> segment descriptor), which
+plays the role of the ownership-based object directory
+(src/ray/object_manager/ownership_based_object_directory.h). Workers
+hold an open-segment cache so repeated gets of the same object reuse
+the mapping.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from multiprocessing import shared_memory
+from typing import Any
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ObjectID
+
+
+@dataclass(frozen=True)
+class ShmDescriptor:
+    """Where an object lives: segment name + payload size."""
+
+    name: str
+    size: int
+
+
+def untrack(seg: shared_memory.SharedMemory) -> None:
+    """Remove a segment from this process's resource tracker.
+
+    Python's tracker auto-unlinks registered segments at process exit —
+    a worker exiting would delete objects the driver still serves. So
+    workers untrack segments they create (the driver adopts them), and
+    the driver `track`s adopted ones, keeping exactly one registration
+    alive until the driver's unlink (which unregisters internally).
+    """
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(seg._name, "shared_memory")
+    except Exception:
+        pass
+
+
+def _defuse(seg: shared_memory.SharedMemory) -> None:
+    """Make a segment's close()/__del__ a no-op after a BufferError.
+
+    Live user views still reference the mapping, so it cannot be closed;
+    the mapping is deliberately leaked until process exit (the kernel
+    reclaims it) instead of raising "Exception ignored in __del__" noise
+    at interpreter shutdown. Touches CPython internals knowingly.
+    """
+    try:
+        seg._buf = None
+        seg._mmap = None
+    except Exception:
+        pass
+
+
+def track(seg: shared_memory.SharedMemory) -> None:
+    """Register an adopted segment with this process's tracker, making
+    the later ``unlink()`` (which unregisters) symmetric and giving
+    crash-cleanup for adopted segments."""
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.register(seg._name, "shared_memory")
+    except Exception:
+        pass
+
+
+class ShmObjectWriter:
+    """Create-then-seal protocol (plasma's Create/Seal)."""
+
+    @staticmethod
+    def put(value: Any) -> tuple[ShmDescriptor, shared_memory.SharedMemory]:
+        header, buffers = serialization.serialize(value)
+        size = serialization.framed_size(header, buffers)
+        seg = shared_memory.SharedMemory(create=True, size=max(size, 1))
+        serialization.write_framed(seg.buf, header, buffers)
+        return ShmDescriptor(seg.name, size), seg
+
+
+class ShmClient:
+    """Per-process reader with an open-segment cache.
+
+    Deserialized values view the mapping zero-copy, so a segment stays
+    open (referenced here) for the life of the process once read.
+    ``close_segment`` drops the mapping when the driver frees an object.
+    """
+
+    def __init__(self, untrack_on_attach: bool = False):
+        self._lock = threading.Lock()
+        self._segments: dict[str, shared_memory.SharedMemory] = {}
+        # Python 3.12 registers segments with the resource tracker on
+        # ATTACH as well as create. Worker clients never unlink, so they
+        # untrack attaches (else their tracker warns/unlinks at exit).
+        # The driver's client shares its process with ShmDirectory —
+        # whose unlink() unregisters — so it must NOT untrack, or the
+        # register/unregister pairing breaks (tracker KeyError noise).
+        self._untrack_on_attach = untrack_on_attach
+        # Segments whose mappings still have live views at close time;
+        # referenced here so __del__ never runs on them.
+        self._leaked: list[shared_memory.SharedMemory] = []
+
+    def get(self, desc: ShmDescriptor) -> Any:
+        with self._lock:
+            seg = self._segments.get(desc.name)
+            if seg is None:
+                seg = shared_memory.SharedMemory(name=desc.name)
+                if self._untrack_on_attach:
+                    untrack(seg)
+                self._segments[desc.name] = seg
+        return serialization.deserialize_from_buffer(seg.buf[:desc.size])
+
+    def close_segment(self, name: str) -> None:
+        with self._lock:
+            seg = self._segments.pop(name, None)
+        if seg is not None:
+            try:
+                seg.close()
+            except BufferError:
+                # A live numpy view still references the mapping; keep it
+                # open rather than invalidating user data.
+                with self._lock:
+                    self._segments[name] = seg
+
+    def close_all(self) -> None:
+        with self._lock:
+            segments = list(self._segments.items())
+            self._segments.clear()
+        for _, seg in segments:
+            try:
+                seg.close()
+            except BufferError:
+                # Live views remain: leak the mapping until process exit.
+                _defuse(seg)
+                with self._lock:
+                    self._leaked.append(seg)
+
+
+class ShmDirectory:
+    """Driver-side registry of shm-resident objects (owner directory).
+
+    Tracks which segments exist so they can be unlinked exactly once at
+    free/shutdown (POSIX shm persists until unlinked — leaking segments
+    outlives the process).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._by_object: dict[ObjectID, ShmDescriptor] = {}
+        self._owned: dict[str, shared_memory.SharedMemory] = {}
+        self._leaked: list[shared_memory.SharedMemory] = []
+
+    def register(self, object_id: ObjectID, desc: ShmDescriptor,
+                 segment: shared_memory.SharedMemory | None = None) -> None:
+        with self._lock:
+            self._by_object[object_id] = desc
+            if segment is not None:
+                self._owned[desc.name] = segment
+
+    def adopt(self, object_id: ObjectID, desc: ShmDescriptor) -> None:
+        """Record a worker-created segment; the driver takes ownership of
+        unlinking it (the worker process may exit first)."""
+        try:
+            seg = shared_memory.SharedMemory(name=desc.name)
+        except FileNotFoundError:
+            return
+        track(seg)  # the creating worker untracked; ownership moves here
+        with self._lock:
+            self._by_object[object_id] = desc
+            self._owned[desc.name] = seg
+
+    def lookup(self, object_id: ObjectID) -> ShmDescriptor | None:
+        with self._lock:
+            return self._by_object.get(object_id)
+
+    def free(self, object_id: ObjectID) -> None:
+        with self._lock:
+            desc = self._by_object.pop(object_id, None)
+            seg = self._owned.pop(desc.name, None) if desc else None
+        if seg is not None:
+            self._close_and_unlink(seg)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            owned = list(self._owned.values())
+            self._owned.clear()
+            self._by_object.clear()
+        for seg in owned:
+            self._close_and_unlink(seg)
+
+    def _close_and_unlink(self, seg: shared_memory.SharedMemory) -> None:
+        try:
+            seg.unlink()
+        except FileNotFoundError:
+            pass
+        try:
+            seg.close()
+        except BufferError:
+            _defuse(seg)
+            with self._lock:
+                self._leaked.append(seg)

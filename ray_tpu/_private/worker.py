@@ -9,9 +9,13 @@ TPU-native analogue of the reference's CoreWorker + worker.py pair:
   python/ray/_private/worker.py:1219+ (ray.init), :2547 (get), :2679
   (put), :2744 (wait), :2890 (get_actor).
 
-Single-node, thread-worker slice: every "node" is a virtual node in one
-process (see scheduler.py docstring); a true multiprocess pool is layered
-in via ``ray_tpu._private.worker_pool`` for CPU-parallel workloads.
+Execution modes: by default tasks run on dispatcher threads (lowest
+latency, shared address space). With ``init(process_workers=N)`` tasks
+run on a pool of N OS worker processes behind a cloudpickle
+serialization boundary with shared-memory object transport
+(ray_tpu._private.worker_pool + shm_store) — real CPU parallelism for
+fan-out workloads. Actors opt into a dedicated worker process with
+``@remote(process=True)``.
 """
 
 from __future__ import annotations
@@ -87,6 +91,7 @@ class Runtime:
         resources: dict[str, float] | None = None,
         object_store_memory: int | None = None,
         namespace: str = "default",
+        process_workers: int | None = None,
     ):
         cfg = GLOBAL_CONFIG
         self.namespace = namespace
@@ -108,6 +113,25 @@ class Runtime:
         self._futures: dict[ObjectID, list[concurrent.futures.Future]] = {}
         self.store.add_seal_listener(self._resolve_futures)
         self._task_counter = 0
+
+        # Multiprocess worker pool (opt-in): serialization boundary +
+        # shared-memory transport; see worker_pool.py.
+        from ray_tpu._private.shm_store import ShmClient, ShmDirectory
+
+        import weakref
+
+        self.shm_directory = ShmDirectory()
+        self.shm_client = ShmClient()
+        self.worker_pool = None
+        self._func_blobs: "weakref.WeakKeyDictionary" = \
+            weakref.WeakKeyDictionary()
+        pool_size = (process_workers if process_workers is not None
+                     else cfg.worker_pool_size)
+        if pool_size and pool_size > 0:
+            from ray_tpu._private.worker_pool import WorkerPool
+
+            self.worker_pool = WorkerPool(
+                int(pool_size), self.shm_directory, self.shm_client)
 
         # Head node: autodetect CPU and TPU resources.
         detected = accelerators.detect_resources()
@@ -226,16 +250,21 @@ class Runtime:
         block_ctx = BlockedResourceContext(
             self.cluster, node.node_id, spec.resources) if (node and acquired) else None
         try:
-            resolved_args, resolved_kwargs, _ = resolve_args(
-                spec.args, spec.kwargs, lambda ref: self.get([ref])[0])
-            if block_ctx is not None:
-                block_ctx.__enter__()
-            try:
-                result = spec.func(*resolved_args, **resolved_kwargs)
-            finally:
+            if self.worker_pool is not None:
+                ran_on_pool = self._try_execute_on_pool(spec)
+            else:
+                ran_on_pool = False
+            if not ran_on_pool:
+                resolved_args, resolved_kwargs, _ = resolve_args(
+                    spec.args, spec.kwargs, lambda ref: self.get([ref])[0])
                 if block_ctx is not None:
-                    block_ctx.__exit__(None, None, None)
-            self._store_task_result(spec, result)
+                    block_ctx.__enter__()
+                try:
+                    result = spec.func(*resolved_args, **resolved_kwargs)
+                finally:
+                    if block_ctx is not None:
+                        block_ctx.__exit__(None, None, None)
+                self._store_task_result(spec, result)
             self.gcs.record_task_event(TaskEvent(
                 spec.task_id, spec.name, "FINISHED", start_time=start,
                 end_time=time.time(),
@@ -244,7 +273,9 @@ class Runtime:
             if self._maybe_retry(spec, exc):
                 return
             error = exc if isinstance(exc, (TaskError, TaskCancelledError)) else \
-                TaskError(exc, format_traceback(exc), spec.name)
+                TaskError(exc,
+                          getattr(exc, "__ray_tpu_remote_tb__", None)
+                          or format_traceback(exc), spec.name)
             for rid in spec.return_ids:
                 self.store.put_error(rid, error)
             self.gcs.record_task_event(TaskEvent(
@@ -253,14 +284,80 @@ class Runtime:
         finally:
             RuntimeContext.clear()
 
+    def _try_execute_on_pool(self, spec: TaskSpec) -> bool:
+        """Run the task on a pool worker process behind the serialization
+        boundary. Returns False (caller falls back to in-thread execution)
+        when the function/args cannot cross it (unpicklable closures) or
+        the task needs accelerator resources (pool workers are CPU
+        processes; the driver's process owns the TPU-backed JAX).
+        """
+        from ray_tpu._private.worker_pool import _RemoteTaskError
+
+        if any(k.startswith("TPU") for k in spec.resources):
+            return False
+        try:
+            args_blob = self.worker_pool.marshal_args(
+                spec.args, spec.kwargs, self._promote_to_shm)
+            digest, func_blob = self._function_blob(spec.func)
+        except Exception:  # noqa: BLE001 — not serializable: run in-thread
+            return False
+        try:
+            results = self.worker_pool.run_task_blobs(
+                digest, func_blob, args_blob, spec.num_returns,
+                spec.return_ids)
+        except _RemoteTaskError as rte:
+            rte.cause.__ray_tpu_remote_tb__ = rte.remote_tb
+            raise rte.cause from None
+        for rid, value in results:
+            self.store.put(rid, value)
+        return True
+
+    def _function_blob(self, func) -> tuple[str, bytes]:
+        """Serialize a task function once per identity (reference:
+        function_manager.py exports each function to the GCS KV once).
+        Like the reference, closures are captured at first export."""
+        import hashlib
+
+        from ray_tpu._private import serialization
+
+        try:
+            cached = self._func_blobs.get(func)
+        except TypeError:  # unhashable callable
+            cached = None
+        if cached is not None:
+            return cached
+        blob = serialization.dumps_function(func)
+        entry = (hashlib.sha1(blob).hexdigest(), blob)
+        try:
+            self._func_blobs[func] = entry
+        except TypeError:
+            pass
+        return entry
+
+    def _promote_to_shm(self, ref: ObjectRef):
+        """Object directory lookup-or-promote: make a driver-held object
+        reachable by worker processes via a shared-memory segment."""
+        from ray_tpu._private.shm_store import ShmObjectWriter
+
+        desc = self.shm_directory.lookup(ref.id())
+        if desc is not None:
+            return desc
+        value = self.store.get(ref.id())  # deps already sealed at dispatch
+        desc, seg = ShmObjectWriter.put(value)
+        self.shm_directory.register(ref.id(), desc, seg)
+        return desc
+
     def _maybe_retry(self, spec: TaskSpec, exc: BaseException) -> bool:
         """Owner-driven retry (reference: task_manager.h:195, max_task_retries
-        common.proto:645). Application errors retry only if retry_exceptions
+        common.proto:645). System failures (worker death) retry whenever
+        retries remain; application errors only if retry_exceptions
         allows them."""
+        from ray_tpu.exceptions import WorkerCrashedError
+
         if spec.attempt >= spec.max_retries:
             return False
         retry_ok = False
-        if isinstance(exc, (ActorDiedError,)):
+        if isinstance(exc, (ActorDiedError, WorkerCrashedError)):
             retry_ok = True
         elif spec.retry_exceptions is True:
             retry_ok = True
@@ -307,6 +404,7 @@ class Runtime:
         lifetime: str | None = None,
         scheduling_strategy: SchedulingStrategy | None = None,
         get_if_exists: bool = False,
+        process: bool = False,
     ) -> tuple[ActorID, ObjectRef]:
         """Reference: CoreWorker::CreateActor (core_worker.cc:2069) +
         GcsActorManager registration."""
@@ -391,12 +489,22 @@ class Runtime:
             def on_restart(aid):
                 self.gcs.update_actor_state(aid, "ALIVE")
 
-            actor = LocalActor(
-                actor_id, cls, args, kwargs, self,
-                max_concurrency=max_concurrency, max_restarts=max_restarts,
-                max_pending_calls=max_pending_calls,
-                creation_return_id=creation_rid, on_death=on_death,
-                on_restart=on_restart)
+            if process:
+                from ray_tpu._private.worker_pool import ProcessActor
+
+                actor = ProcessActor(
+                    actor_id, cls, args, kwargs, self,
+                    max_restarts=max_restarts,
+                    max_pending_calls=max_pending_calls,
+                    creation_return_id=creation_rid, on_death=on_death,
+                    on_restart=on_restart)
+            else:
+                actor = LocalActor(
+                    actor_id, cls, args, kwargs, self,
+                    max_concurrency=max_concurrency, max_restarts=max_restarts,
+                    max_pending_calls=max_pending_calls,
+                    creation_return_id=creation_rid, on_death=on_death,
+                    on_restart=on_restart)
             self._actors[actor_id] = actor
             self._actor_leases[actor_id] = (node_id, resources, pg_info)
             record.handle = actor
@@ -551,6 +659,11 @@ class Runtime:
 
     def free(self, refs: Sequence[ObjectRef]) -> None:
         self.store.free([r.id() for r in refs])
+        for r in refs:
+            desc = self.shm_directory.lookup(r.id())
+            if desc is not None:
+                self.shm_client.close_segment(desc.name)
+                self.shm_directory.free(r.id())
 
     # -------------------------------------------------------------- futures
 
@@ -590,6 +703,10 @@ class Runtime:
         for actor in list(self._actors.values()):
             actor.kill("runtime shutdown", no_restart=True)
         self.dispatcher.shutdown()
+        if self.worker_pool is not None:
+            self.worker_pool.shutdown()
+        self.shm_client.close_all()
+        self.shm_directory.shutdown()
         self.gcs.finish_job(self.job_id)
 
 
@@ -612,9 +729,16 @@ def init(
     ignore_reinit_error: bool = False,
     system_config: dict | None = None,
     logging_level: str | None = None,
+    process_workers: int | None = None,
     **_ignored,
 ) -> Runtime:
     """Initialize the runtime (reference: ray.init, worker.py:1219)."""
+    import os as _os
+
+    if _os.environ.get("RAY_TPU_IN_POOL_WORKER"):
+        raise RuntimeError(
+            "ray_tpu.init() is not available inside pool worker processes: "
+            "pool tasks cannot submit nested tasks (v1 limitation)")
     global _runtime
     with _runtime_lock:
         if _runtime is not None:
@@ -629,7 +753,8 @@ def init(
             logging.getLogger("ray_tpu").setLevel(logging_level)
         _runtime = Runtime(
             num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
-            object_store_memory=object_store_memory, namespace=namespace)
+            object_store_memory=object_store_memory, namespace=namespace,
+            process_workers=process_workers)
         atexit.register(_atexit_shutdown)
         return _runtime
 

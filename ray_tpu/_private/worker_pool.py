@@ -1,0 +1,688 @@
+"""Multiprocess worker pool: OS-process task execution + process actors.
+
+TPU-native analogue of the reference's worker pool + direct task
+transport (src/ray/raylet/worker_pool.h forks language workers;
+src/ray/core_worker/transport/direct_task_transport.h:75 pushes tasks to
+leased workers): the driver spawns N Python worker processes, pushes
+tasks over a duplex pipe with a cloudpickle serialization boundary, and
+moves data through named shared-memory segments (shm_store.py) so
+worker-to-worker arguments never copy through the driver.
+
+Why processes: the thread-worker slice shares one GIL — CPU-bound
+fan-out (RLlib rollouts, data preprocessing) cannot exceed one core.
+Pool workers are real processes; a crashed worker is detected by pipe
+EOF, the task fails with WorkerCrashedError (retryable as a system
+failure, like the reference's worker-death retries), and the pool
+respawns the worker.
+
+Process actors (``ProcessActor``) give an actor a dedicated worker
+process: constructor and method calls execute there in submission
+order; max_restarts respawns the process and re-runs the constructor.
+
+v1 limitations (documented, not hidden): code running inside a pool
+worker cannot call back into the driver's runtime (no nested task
+submission), and process actors execute calls sequentially
+(max_concurrency applies to thread-mode actors).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ActorID, ObjectID
+from ray_tpu._private.shm_store import (
+    ShmClient,
+    ShmDescriptor,
+    ShmDirectory,
+    ShmObjectWriter,
+    untrack,
+)
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    ActorError,
+    TaskError,
+    WorkerCrashedError,
+)
+
+# Results smaller than this ship inline through the pipe; larger ones go
+# through a shared-memory segment the driver adopts.
+INLINE_RESULT_BYTES = 64 * 1024
+
+
+@dataclass
+class _ShmRef:
+    """Placeholder for an ObjectRef argument: resolved worker-side by
+    mapping the segment (zero-copy)."""
+
+    desc: ShmDescriptor
+
+
+# --------------------------------------------------------------------------
+# Worker process side
+# --------------------------------------------------------------------------
+
+
+def _exception_blob(exc: BaseException) -> bytes:
+    tb = "".join(traceback.format_exception(type(exc), exc, exc.__traceback__))
+    try:
+        return serialization.serialize_framed((exc, tb))
+    except Exception:
+        return serialization.serialize_framed(
+            (RuntimeError(f"{type(exc).__name__}: {exc}"), tb))
+
+
+def _resolve_shm_args(args, kwargs, client: ShmClient):
+    args = tuple(client.get(a.desc) if isinstance(a, _ShmRef) else a
+                 for a in args)
+    kwargs = {k: client.get(v.desc) if isinstance(v, _ShmRef) else v
+              for k, v in kwargs.items()}
+    return args, kwargs
+
+
+def _pack_results(values: list) -> list:
+    """Each value -> ("inline", bytes) | ("shm", name, size) | ("err", blob)."""
+    from multiprocessing import shared_memory
+
+    out = []
+    for value in values:
+        try:
+            header, buffers = serialization.serialize(value)
+        except Exception as exc:  # noqa: BLE001 — unpicklable result
+            out.append(("err", _exception_blob(exc)))
+            continue
+        size = serialization.framed_size(header, buffers)
+        if size <= INLINE_RESULT_BYTES:
+            blob = bytearray(size)
+            serialization.write_framed(memoryview(blob), header, buffers)
+            out.append(("inline", bytes(blob)))
+        else:
+            seg = shared_memory.SharedMemory(create=True, size=size)
+            untrack(seg)  # unlink belongs to the driver directory
+            serialization.write_framed(seg.buf, header, buffers)
+            name = seg.name
+            seg.close()  # driver adopts + unlinks; worker drops its handle
+            out.append(("shm", name, size))
+    return out
+
+
+def worker_main(conn) -> None:
+    """Worker process entry: serve task/actor requests until exit.
+
+    The first message is ("hello", parent_sys_path): workers adopt the
+    parent's sys.path so functions pickled by reference (importable
+    modules, incl. test modules) resolve.
+    """
+    kind, parent_sys_path = conn.recv()
+    assert kind == "hello", kind
+    sys.path[:0] = [p for p in parent_sys_path if p not in sys.path]
+    os.environ["RAY_TPU_IN_POOL_WORKER"] = "1"  # init() guard
+    client = ShmClient(untrack_on_attach=True)
+    try:
+        _serve(conn, client)
+    finally:
+        client.close_all()
+
+
+def _serve(conn, client: ShmClient) -> None:
+    actor_instance = None
+    func_cache: dict[str, Any] = {}
+    while True:
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError):
+            return
+        kind = msg[0]
+        try:
+            if kind == "exit":
+                return
+            elif kind == "ping":
+                conn.send(("pong", os.getpid()))
+            elif kind == "task":
+                _, digest, func_blob, args_blob, n_returns = msg
+                if func_blob is not None:
+                    func = serialization.loads_function(func_blob)
+                    func_cache[digest] = func
+                else:
+                    func = func_cache[digest]
+                args, kwargs = serialization.deserialize_from_buffer(
+                    memoryview(args_blob))
+                args, kwargs = _resolve_shm_args(args, kwargs, client)
+                result = func(*args, **kwargs)
+                if n_returns == 0:
+                    values = []
+                elif n_returns == 1:
+                    values = [result]
+                else:
+                    if (not isinstance(result, (tuple, list))
+                            or len(result) != n_returns):
+                        raise ValueError(
+                            f"task declared num_returns={n_returns} but "
+                            f"returned {type(result).__name__}")
+                    values = list(result)
+                conn.send(("ok", _pack_results(values)))
+            elif kind == "actor_new":
+                _, cls_blob, args_blob = msg
+                cls = serialization.loads_function(cls_blob)
+                args, kwargs = serialization.deserialize_from_buffer(
+                    memoryview(args_blob))
+                args, kwargs = _resolve_shm_args(args, kwargs, client)
+                actor_instance = cls(*args, **kwargs)
+                conn.send(("ok", None))
+            elif kind == "actor_call":
+                _, method_name, args_blob, n_returns = msg
+                if actor_instance is None:
+                    raise RuntimeError("actor_call before actor_new")
+                args, kwargs = serialization.deserialize_from_buffer(
+                    memoryview(args_blob))
+                args, kwargs = _resolve_shm_args(args, kwargs, client)
+                method = getattr(actor_instance, method_name)
+                result = method(*args, **kwargs)
+                values = [result] if n_returns == 1 else \
+                    (list(result) if isinstance(result, (tuple, list))
+                     else [None] * n_returns)
+                conn.send(("ok", _pack_results(values)))
+            else:
+                raise RuntimeError(f"unknown message kind {kind!r}")
+        except BaseException as exc:  # noqa: BLE001 — shipped to the driver
+            try:
+                conn.send(("err", _exception_blob(exc)))
+            except (OSError, BrokenPipeError):
+                return
+
+
+# --------------------------------------------------------------------------
+# Driver side
+# --------------------------------------------------------------------------
+
+
+def _spawn_worker(name: str):
+    """Start a worker as a fresh interpreter that connects back over a
+    Unix socket (reference: worker_pool.h spawns language workers that
+    connect to the raylet socket).
+
+    subprocess + connect-back (rather than multiprocessing's spawn) so
+    the child never re-imports the user's ``__main__`` — unguarded user
+    scripts must keep working. The child env drops accelerator plugin
+    registration and pins JAX to CPU: pool workers are CPU processes.
+    """
+    import secrets
+    import subprocess
+    import tempfile
+    from multiprocessing.connection import Listener
+
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    # Random suffix: concurrent spawns (e.g. several process actors
+    # created back-to-back) must never race on one socket path.
+    addr = os.path.join(
+        tempfile.gettempdir(),
+        f"ray_tpu_{os.getpid()}_{name}_{secrets.token_hex(4)}.sock")
+    try:
+        os.unlink(addr)
+    except FileNotFoundError:
+        pass
+    authkey = secrets.token_bytes(16)
+    listener = Listener(addr, family="AF_UNIX", authkey=authkey)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # skip TPU plugin registration
+    env["RAY_TPU_SKIP_TPU_DETECTION"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RAY_TPU_WORKER_AUTHKEY"] = authkey.hex()
+    # The parent may have extended sys.path at runtime (e.g. a script
+    # that inserted the framework's location); the child's `-m` import
+    # must resolve ray_tpu before the hello handshake can deliver it.
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in sys.path if p] +
+        [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p])
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.worker_pool", addr],
+        env=env, cwd=os.getcwd())
+    try:
+        # Listener.accept has no timeout arg; guard with a thread join.
+        conn_box: list = []
+
+        def accept():
+            try:
+                conn_box.append(listener.accept())
+            except Exception as exc:  # noqa: BLE001
+                conn_box.append(exc)
+
+        t = threading.Thread(target=accept, daemon=True)
+        t.start()
+        t.join(timeout=float(GLOBAL_CONFIG.worker_startup_timeout_s))
+        if not conn_box or isinstance(conn_box[0], Exception):
+            proc.kill()
+            raise WorkerCrashedError(
+                f"worker {name} failed to connect: "
+                f"{conn_box[0] if conn_box else 'timeout'}")
+        conn = conn_box[0]
+    finally:
+        listener.close()
+        try:
+            os.unlink(addr)
+        except FileNotFoundError:
+            pass
+    conn.send(("hello", list(sys.path)))
+    return proc, conn
+
+
+class PoolWorker:
+    """One worker process + its pipe. One in-flight request at a time."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self._lock = threading.Lock()
+        # Function-blob digests this worker has already received (the
+        # function-manager pattern: ship each function once per worker).
+        self.known_digests: set[str] = set()
+        self.proc, self.conn = _spawn_worker(f"w{index}")
+
+    def request(self, msg: tuple) -> tuple:
+        """Send one request and wait for its reply.
+
+        Raises _WorkerUnavailable if the send itself fails (the request
+        never reached the worker — safe to retry elsewhere), or
+        WorkerCrashedError if the process dies after accepting it (the
+        task may have started executing).
+        """
+        with self._lock:
+            try:
+                self.conn.send(msg)
+            except (OSError, BrokenPipeError) as exc:
+                raise _WorkerUnavailable(
+                    f"worker {self.index} (pid {self.proc.pid}) "
+                    f"unreachable: {exc!r}") from exc
+            try:
+                return self.conn.recv()
+            except (EOFError, OSError) as exc:
+                raise WorkerCrashedError(
+                    f"worker {self.index} (pid "
+                    f"{self.proc.pid}) died: {exc!r}") from exc
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def stop(self) -> None:
+        import subprocess
+
+        try:
+            with self._lock:
+                self.conn.send(("exit",))
+        except (OSError, BrokenPipeError):
+            pass
+        try:
+            self.proc.wait(timeout=1.0)
+        except subprocess.TimeoutExpired:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=1.0)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        self.conn.close()
+
+
+class WorkerPool:
+    """Fixed-size pool of task workers (reference: worker_pool.h pops a
+    worker per lease, returns it after; prestart keeps latency low)."""
+
+    def __init__(self, size: int, directory: ShmDirectory,
+                 driver_client: ShmClient):
+        self.size = size
+        self.directory = directory
+        self.driver_client = driver_client
+        self._lock = threading.Condition(threading.Lock())
+        self._index_lock = threading.Lock()
+        self._idle: list[PoolWorker] = []
+        self._next_index = 0
+        self._shutdown = False
+        # Spawn in parallel: each worker blocks on interpreter boot +
+        # socket handshake, so serial startup would be O(N).
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=min(size, 8)) as tpe:
+            self._idle.extend(tpe.map(lambda _: self._new_worker(),
+                                      range(size)))
+
+    def _new_worker(self) -> PoolWorker:
+        with self._index_lock:
+            index = self._next_index
+            self._next_index += 1
+        return PoolWorker(index)
+
+    def _acquire(self) -> PoolWorker:
+        with self._lock:
+            while not self._idle and not self._shutdown:
+                self._lock.wait(timeout=0.5)
+            if self._shutdown:
+                raise RuntimeError("worker pool is shut down")
+            return self._idle.pop()
+
+    def _release(self, worker: PoolWorker) -> None:
+        # Spawn any replacement outside the pool lock (spawn is slow and
+        # _new_worker must not nest under the condition lock).
+        replacement = None
+        if not worker.alive():
+            replacement = self._new_worker()
+        with self._lock:
+            if self._shutdown:
+                worker.stop()
+                if replacement is not None:
+                    replacement.stop()
+                return
+            self._idle.append(replacement if replacement is not None else worker)
+            self._lock.notify()
+
+    # ------------------------------------------------------------- task path
+
+    def marshal_args(self, args: tuple, kwargs: dict,
+                     promote: Callable[[Any], ShmDescriptor]) -> bytes:
+        """Replace top-level ObjectRef args with _ShmRef descriptors
+        (promoting driver-held values into shm) and frame the rest."""
+        from ray_tpu._private.object_ref import ObjectRef
+
+        def convert(a):
+            if isinstance(a, ObjectRef):
+                return _ShmRef(promote(a))
+            return a
+
+        conv_args = tuple(convert(a) for a in args)
+        conv_kwargs = {k: convert(v) for k, v in kwargs.items()}
+        return serialization.serialize_framed((conv_args, conv_kwargs))
+
+    def run_task_blobs(self, digest: str, func_blob: bytes, args_blob: bytes,
+                       n_returns: int,
+                       return_ids: list[ObjectID]) -> list[tuple[ObjectID, Any]]:
+        """Execute on a pool worker; returns [(return_id, value)] pairs.
+
+        The function blob only crosses the pipe the first time a given
+        worker sees its digest (function-manager pattern); afterwards
+        the worker's cache is addressed by digest alone.
+
+        Raises WorkerCrashedError (system failure) or _RemoteTaskError
+        (application failure, carrying the remote traceback). A worker
+        that proves unreachable before accepting the request is replaced
+        and the request retried on another — no work was started, so
+        this is invisible to the caller.
+        """
+        while True:
+            worker = self._acquire()
+            send_blob = None if digest in worker.known_digests else func_blob
+            try:
+                reply = worker.request(
+                    ("task", digest, send_blob, args_blob, n_returns))
+            except _WorkerUnavailable:
+                continue  # _release (in finally) already spawns a live one
+            finally:
+                self._release(worker)
+            worker.known_digests.add(digest)
+            return self._unpack_reply(reply, return_ids)
+
+    def _unpack_reply(self, reply: tuple,
+                      return_ids: list[ObjectID]) -> list[tuple[ObjectID, Any]]:
+        if reply[0] == "err":
+            exc, tb = serialization.deserialize_from_buffer(
+                memoryview(reply[1]))
+            raise _RemoteTaskError(exc, tb)
+        results = []
+        for rid, packed in zip(return_ids, reply[1]):
+            if packed[0] == "inline":
+                value = serialization.deserialize_from_buffer(
+                    memoryview(packed[1]))
+            elif packed[0] == "shm":
+                desc = ShmDescriptor(packed[1], packed[2])
+                self.directory.adopt(rid, desc)
+                value = self.driver_client.get(desc)
+            else:  # ("err", blob) — this return value failed to pickle
+                exc, tb = serialization.deserialize_from_buffer(
+                    memoryview(packed[1]))
+                raise _RemoteTaskError(exc, tb)
+            results.append((rid, value))
+        return results
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._shutdown = True
+            workers = list(self._idle)
+            self._idle.clear()
+            self._lock.notify_all()
+        for w in workers:
+            w.stop()
+
+
+class _RemoteTaskError(Exception):
+    """Carries a worker-side exception + its remote traceback string."""
+
+    def __init__(self, cause: BaseException, remote_tb: str):
+        super().__init__(str(cause))
+        self.cause = cause
+        self.remote_tb = remote_tb
+
+
+class _WorkerUnavailable(Exception):
+    """The request could not be delivered (worker already dead)."""
+
+
+# --------------------------------------------------------------------------
+# Process actors
+# --------------------------------------------------------------------------
+
+
+class ProcessActor:
+    """An actor bound to a dedicated worker process.
+
+    Mirrors LocalActor's interface (submit/kill/is_dead) so the Runtime
+    treats both uniformly; calls execute in submission order in the
+    worker process (reference: a Ray actor IS a worker process with an
+    ordered scheduling queue, transport/actor_scheduling_queue.h).
+    """
+
+    def __init__(self, actor_id: ActorID, cls: type, init_args: tuple,
+                 init_kwargs: dict, runtime, *, max_restarts: int = 0,
+                 max_pending_calls: int = -1,
+                 creation_return_id: ObjectID | None = None,
+                 on_death: Callable[[ActorID, str], None] | None = None,
+                 on_restart: Callable[[ActorID], None] | None = None):
+        import queue as queue_mod
+
+        self.actor_id = actor_id
+        self._cls = cls
+        self._init_args = init_args
+        self._init_kwargs = init_kwargs
+        self._runtime = runtime
+        self._max_restarts = max_restarts
+        self._max_pending_calls = max_pending_calls
+        self._on_death = on_death
+        self._on_restart = on_restart
+        self._num_restarts = 0
+        self._queue: queue_mod.Queue = queue_mod.Queue()
+        self._pending = 0
+        self._lock = threading.Lock()
+        self._dead = False
+        self._death_reason: str | None = None
+        self._creation_return_id = creation_return_id
+        self._worker: PoolWorker | None = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"ray_tpu-pactor-{cls.__name__}")
+        self._thread.start()
+
+    # Interface shared with LocalActor ------------------------------------
+
+    def submit(self, call) -> None:
+        from ray_tpu.exceptions import PendingCallsLimitExceeded
+
+        with self._lock:
+            if self._dead:
+                self._fail_call(call, ActorDiedError(
+                    self.actor_id, self._death_reason or "actor has died"))
+                return
+            if 0 <= self._max_pending_calls <= self._pending:
+                self._fail_call(call, PendingCallsLimitExceeded(
+                    f"actor {self._cls.__name__} has {self._pending} "
+                    f"pending calls"))
+                return
+            self._pending += 1
+            self._queue.put(call)
+
+    def kill(self, reason: str = "killed via kill()",
+             no_restart: bool = True) -> None:
+        restartable = (not no_restart) and self._num_restarts < self._max_restarts
+        # Terminate the process FIRST: an in-flight request holds the
+        # PoolWorker lock until its recv fails, and _mark_dead's
+        # worker.stop() needs that lock — killing after would deadlock.
+        worker = self._worker
+        if worker is not None and worker.alive():
+            worker.proc.terminate()
+        self._mark_dead(reason, notify=not restartable)
+        self._queue.put(None)
+        if restartable:
+            self._restart()
+
+    def is_dead(self) -> bool:
+        with self._lock:
+            return self._dead
+
+    def wait_started(self, timeout: float | None = None) -> bool:
+        return self._started.wait(timeout)
+
+    # Internals ------------------------------------------------------------
+
+    def _fail_call(self, call, error: BaseException) -> None:
+        for rid in call.return_ids:
+            self._runtime.store.put_error(rid, error)
+
+    def _marshal(self, args: tuple, kwargs: dict) -> bytes:
+        return serialization.serialize_framed((args, kwargs))
+
+    def _run(self) -> None:
+        try:
+            self._worker = PoolWorker(-1)
+            cls_blob = serialization.dumps_function(self._cls)
+            args_blob = self._marshal(self._init_args, self._init_kwargs)
+            reply = self._worker.request(("actor_new", cls_blob, args_blob))
+            if reply[0] == "err":
+                exc, tb = serialization.deserialize_from_buffer(
+                    memoryview(reply[1]))
+                raise ActorError(exc, tb, f"{self._cls.__name__}.__init__")
+        except BaseException as exc:  # noqa: BLE001
+            self._mark_dead(f"constructor failed: {exc!r}")
+            if self._creation_return_id is not None:
+                self._runtime.store.put_error(self._creation_return_id, exc)
+            return
+        if self._creation_return_id is not None:
+            self._runtime.store.put(self._creation_return_id, None)
+        self._started.set()
+        while True:
+            call = self._queue.get()
+            if call is None:
+                return
+            with self._lock:
+                self._pending -= 1
+                if self._dead:
+                    self._fail_call(call, ActorDiedError(
+                        self.actor_id, self._death_reason or "actor died"))
+                    continue
+            try:
+                try:
+                    args_blob = self._marshal(call.args, call.kwargs)
+                except Exception as exc:  # noqa: BLE001 — unpicklable args
+                    self._fail_call(call, ActorError(
+                        exc, "", f"{self._cls.__name__}.{call.method_name} "
+                        f"(argument serialization)"))
+                    continue
+                reply = self._worker.request(
+                    ("actor_call", call.method_name, args_blob,
+                     len(call.return_ids)))
+                if reply[0] == "err":
+                    exc, tb = serialization.deserialize_from_buffer(
+                        memoryview(reply[1]))
+                    self._fail_call(call, ActorError(
+                        exc, tb, f"{self._cls.__name__}.{call.method_name}"))
+                    continue
+                for rid, packed in zip(call.return_ids, reply[1]):
+                    if packed[0] == "inline":
+                        value = serialization.deserialize_from_buffer(
+                            memoryview(packed[1]))
+                    else:
+                        desc = ShmDescriptor(packed[1], packed[2])
+                        self._runtime.shm_directory.adopt(rid, desc)
+                        value = self._runtime.shm_client.get(desc)
+                    self._runtime.store.put(rid, value)
+            except (WorkerCrashedError, _WorkerUnavailable):
+                self._handle_crash(call)
+                return
+            except BaseException as exc:  # noqa: BLE001 — never kill the
+                # executor thread silently: fail the call and keep serving.
+                self._fail_call(call, exc)
+
+    def _handle_crash(self, call) -> None:
+        reason = f"actor process died executing {call.method_name}()"
+        restartable = self._num_restarts < self._max_restarts
+        self._fail_call(call, ActorDiedError(self.actor_id, reason))
+        self._mark_dead(reason, notify=not restartable)
+        if restartable:
+            self._restart()
+
+    def _mark_dead(self, reason: str, notify: bool = True) -> None:
+        import queue as queue_mod
+
+        with self._lock:
+            if self._dead:
+                return
+            self._dead = True
+            self._death_reason = reason
+            drained = []
+            try:
+                while True:
+                    item = self._queue.get_nowait()
+                    if item is not None:
+                        drained.append(item)
+            except queue_mod.Empty:
+                pass
+            self._pending = 0
+        for call in drained:
+            self._fail_call(call, ActorDiedError(self.actor_id, reason))
+        worker = self._worker
+        if worker is not None:
+            worker.stop()
+        if notify and self._on_death is not None:
+            self._on_death(self.actor_id, reason)
+
+    def _restart(self) -> None:
+        with self._lock:
+            self._num_restarts += 1
+            self._dead = False
+            self._death_reason = None
+        self._started.clear()
+        self._creation_return_id = None
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"ray_tpu-pactor-{self._cls.__name__}-r{self._num_restarts}")
+        self._thread.start()
+        if self._on_restart is not None:
+            self._on_restart(self.actor_id)
+
+
+# --------------------------------------------------------------------------
+# Worker executable entry: python -m ray_tpu._private.worker_pool <socket>
+# --------------------------------------------------------------------------
+
+if __name__ == "__main__":
+    from multiprocessing.connection import Client
+
+    # Serve from the canonically-imported module, not this __main__
+    # alias: unpickled _ShmRef instances come from the import-path copy
+    # and must be the same class the serving loop isinstance-checks.
+    from ray_tpu._private.worker_pool import worker_main as _worker_main
+
+    _addr = sys.argv[1]
+    _authkey = bytes.fromhex(os.environ.pop("RAY_TPU_WORKER_AUTHKEY"))
+    _conn = Client(_addr, family="AF_UNIX", authkey=_authkey)
+    _worker_main(_conn)

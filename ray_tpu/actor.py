@@ -17,7 +17,7 @@ from ray_tpu.remote_function import _VALID_OPTIONS, _build_strategy
 
 _ACTOR_OPTIONS = _VALID_OPTIONS | {
     "max_concurrency", "max_restarts", "max_task_retries", "max_pending_calls",
-    "lifetime", "namespace", "get_if_exists",
+    "lifetime", "namespace", "get_if_exists", "process",
 }
 
 
@@ -131,6 +131,7 @@ class ActorClass:
             lifetime=opts.get("lifetime"),
             scheduling_strategy=_build_strategy(opts),
             get_if_exists=opts.get("get_if_exists", False),
+            process=opts.get("process", False),
         )
         handle = ActorHandle(actor_id, self._cls.__name__)
         handle._creation_ref = creation_ref  # keeps creation error observable

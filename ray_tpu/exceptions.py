@@ -79,6 +79,12 @@ class PendingCallsLimitExceeded(RayTpuError):
     """Actor's pending call queue exceeded max_pending_calls."""
 
 
+class WorkerCrashedError(RayTpuError):
+    """A worker process died while executing a task (system failure —
+    retried when retries remain, reference: python/ray/exceptions.py
+    WorkerCrashedError)."""
+
+
 class RuntimeEnvSetupError(RayTpuError):
     """Failed to set up the runtime environment for a task/actor."""
 
