@@ -35,6 +35,8 @@ _DEFAULTS: dict[str, Any] = {
     # Health checking.
     "health_check_period_ms": 1000,
     "health_check_failure_threshold": 5,
+    # Lineage reconstruction.
+    "lineage_table_max_entries": 10_000,
     # Metrics.
     "metrics_report_interval_ms": 2000,
     # Logging.

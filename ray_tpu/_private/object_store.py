@@ -72,6 +72,9 @@ class ObjectEntry:
     size_bytes: int = 0
     spilled_path: str | None = None
     freed: bool = False
+    # Lost: was sealed, then its node died. Getters block until lineage
+    # recovery reseals it (or an ObjectLostError is sealed in).
+    lost: bool = False
     created_at: float = field(default_factory=time.monotonic)
     # Pinned while a get() is materializing it; pinned entries never spill.
     pin_count: int = 0
@@ -126,6 +129,7 @@ class ObjectStore:
             entry.error = error
             entry.sealed = True
             entry.freed = False
+            entry.lost = False
             entry.spilled_path = None
             entry.size_bytes = _sizeof(value) if error is None else 256
             self._memory_used += entry.size_bytes
@@ -198,6 +202,38 @@ class ObjectStore:
                     self._restored_bytes_total += entry.size_bytes
             self._maybe_spill()
             return entry.value, entry.error
+
+    def mark_lost(self, object_id: ObjectID) -> bool:
+        """Transition a sealed object back to pending because its node
+        died (reference: plasma objects vanish with the raylet; the owner
+        notices via the object directory). Returns True if it was sealed.
+        """
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None or not entry.sealed or entry.freed:
+                return False
+            if entry.pin_count > 0:
+                # A get() is reading the value right now (same rule as
+                # spilling): the driver-held copy survives the node.
+                return False
+            if entry.spilled_path is not None:
+                try:
+                    os.unlink(entry.spilled_path)
+                except OSError:
+                    pass
+                entry.spilled_path = None
+            else:
+                self._memory_used -= entry.size_bytes
+            entry.value = None
+            entry.error = None
+            entry.sealed = False
+            entry.lost = True
+            return True
+
+    def is_lost(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            entry = self._entries.get(object_id)
+            return entry is not None and entry.lost and not entry.sealed
 
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
@@ -344,6 +380,9 @@ class ReferenceCounter:
         self._lock = threading.Lock()
         self._counts: dict[ObjectID, int] = {}
         self._store = store
+        # Optional hook fired after refcount-zero eviction (the runtime
+        # drops its directory/lineage entries there).
+        self.on_evict: Callable[[ObjectID], None] | None = None
 
     def add_ref(self, object_id: ObjectID) -> None:
         with self._lock:
@@ -362,6 +401,8 @@ class ReferenceCounter:
                 self._counts[object_id] = count - 1
         if evict:
             self._store.evict(object_id)
+            if self.on_evict is not None:
+                self.on_evict(object_id)
 
     def count(self, object_id: ObjectID) -> int:
         with self._lock:

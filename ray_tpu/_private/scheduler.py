@@ -99,6 +99,13 @@ class ClusterState:
         with self._lock:
             return self._nodes.get(node_id)
 
+    def get_node_by_hex(self, node_id_hex: str) -> NodeState | None:
+        with self._lock:
+            for node in self._nodes.values():
+                if node.node_id.hex() == node_id_hex:
+                    return node
+            return None
+
     def total_resources(self) -> dict[str, float]:
         with self._lock:
             out: dict[str, float] = {}
@@ -136,10 +143,14 @@ class ClusterState:
             ]
             if strategy is not None and strategy.kind == "NODE_AFFINITY":
                 target = [n for n in candidates if n.node_id.hex() == strategy.node_id]
-                if not target:
+                if target and target[0].fits(demand):
+                    return target[0]
+                # Soft affinity falls back to the default policy when the
+                # preferred node is gone/full (reference:
+                # scheduling_strategies.py NodeAffinitySchedulingStrategy
+                # soft=True); hard affinity cannot schedule elsewhere.
+                if not getattr(strategy, "soft", False):
                     return None
-                node = target[0]
-                return node if node.fits(demand) else None
             fitting = [n for n in candidates if n.fits(demand)]
             if not fitting:
                 return None

@@ -133,6 +133,28 @@ class Runtime:
             self.worker_pool = WorkerPool(
                 int(pool_size), self.shm_directory, self.shm_client)
 
+        # Lineage + recovery + node health (reference:
+        # object_recovery_manager.h:41, gcs_health_check_manager.h:39).
+        from ray_tpu._private.recovery import (
+            LineageTable,
+            NodeHealthMonitor,
+            ObjectRecoveryManager,
+        )
+
+        self.lineage = LineageTable(cfg.lineage_table_max_entries)
+        self.recovery = ObjectRecoveryManager(self)
+        self._object_locations: dict[ObjectID, NodeID] = {}
+        # RLock: _forget_object can re-enter from ObjectRef.__del__ (GC
+        # may fire while _record_location holds this lock).
+        self._locations_lock = threading.RLock()
+        # Refcount-zero eviction must also drop directory + lineage
+        # entries, or they leak for the runtime's lifetime.
+        self.reference_counter.on_evict = self._forget_object
+        self.health_monitor = NodeHealthMonitor(
+            self.gcs, period_s=cfg.health_check_period_ms / 1000.0,
+            failure_threshold=cfg.health_check_failure_threshold,
+            on_node_dead=self._on_node_dead)
+
         # Head node: autodetect CPU and TPU resources.
         detected = accelerators.detect_resources()
         head_resources = {"CPU": float(num_cpus if num_cpus is not None else cfg.num_cpus)}
@@ -169,6 +191,37 @@ class Runtime:
         self.cluster.remove_node(node_id)
         self.gcs.mark_node_dead(node_id)
 
+    def kill_node(self, node_id: NodeID) -> None:
+        """Chaos: simulate a node crash (reference:
+        test_utils.NodeKillerActor, :1498). The health monitor stops
+        heartbeating it; staleness then drives the normal death path
+        (_on_node_dead) — detection, not fiat.
+        """
+        self.health_monitor.suppress(node_id)
+
+    def _on_node_dead(self, node_id: NodeID) -> None:
+        """Node death: remove from scheduling, lose its objects, rebuild
+        what lineage allows (reference: GcsNodeManager node-dead
+        broadcast + ObjectRecoveryManager re-execution)."""
+        from ray_tpu.exceptions import ObjectLostError
+
+        logger.warning("Node %s died; reconstructing its objects",
+                       node_id.hex()[:8])
+        self.remove_node(node_id)
+        with self._locations_lock:
+            lost = [oid for oid, nid in self._object_locations.items()
+                    if nid == node_id]
+            for oid in lost:
+                del self._object_locations[oid]
+        for oid in lost:
+            if not self.store.mark_lost(oid):
+                continue  # not sealed (already pending/freed): nothing to do
+            if not self.recovery.recover(oid):
+                self.store.put_error(oid, ObjectLostError(
+                    ObjectRef(oid),
+                    f"object {oid.hex()} was on dead node "
+                    f"{node_id.hex()[:8]} and has no lineage"))
+
     # ----------------------------------------------------------------- tasks
 
     def submit_task(
@@ -199,6 +252,7 @@ class Runtime:
         for rid in return_ids:
             self.store.create_pending(rid)
         refs = [ObjectRef(rid) for rid in return_ids]
+        self.lineage.record(spec)
         self.gcs.record_task_event(TaskEvent(task_id, name, "PENDING"))
         deps = [a for a in args if isinstance(a, ObjectRef)] + [
             v for v in kwargs.values() if isinstance(v, ObjectRef)]
@@ -251,7 +305,7 @@ class Runtime:
             self.cluster, node.node_id, spec.resources) if (node and acquired) else None
         try:
             if self.worker_pool is not None:
-                ran_on_pool = self._try_execute_on_pool(spec)
+                ran_on_pool = self._try_execute_on_pool(spec, node)
             else:
                 ran_on_pool = False
             if not ran_on_pool:
@@ -264,7 +318,7 @@ class Runtime:
                 finally:
                     if block_ctx is not None:
                         block_ctx.__exit__(None, None, None)
-                self._store_task_result(spec, result)
+                self._store_task_result(spec, result, node)
             self.gcs.record_task_event(TaskEvent(
                 spec.task_id, spec.name, "FINISHED", start_time=start,
                 end_time=time.time(),
@@ -284,7 +338,7 @@ class Runtime:
         finally:
             RuntimeContext.clear()
 
-    def _try_execute_on_pool(self, spec: TaskSpec) -> bool:
+    def _try_execute_on_pool(self, spec: TaskSpec, node=None) -> bool:
         """Run the task on a pool worker process behind the serialization
         boundary. Returns False (caller falls back to in-thread execution)
         when the function/args cannot cross it (unpicklable closures) or
@@ -310,7 +364,21 @@ class Runtime:
             raise rte.cause from None
         for rid, value in results:
             self.store.put(rid, value)
+            if node is not None:
+                self._record_location(rid, node.node_id)
         return True
+
+    def _record_location(self, object_id: ObjectID, node_id: NodeID) -> None:
+        """Owner-side object directory (reference:
+        ownership_based_object_directory.h): which node holds the primary
+        copy — the set of objects that die with that node."""
+        with self._locations_lock:
+            self._object_locations[object_id] = node_id
+
+    def _forget_object(self, object_id: ObjectID) -> None:
+        with self._locations_lock:
+            self._object_locations.pop(object_id, None)
+        self.lineage.forget([object_id])
 
     def _function_blob(self, func) -> tuple[str, bytes]:
         """Serialize a task function once per identity (reference:
@@ -373,7 +441,8 @@ class Runtime:
         self.dispatcher.submit(spec, self._execute_task, deps)
         return True
 
-    def _store_task_result(self, spec: TaskSpec, result: Any) -> None:
+    def _store_task_result(self, spec: TaskSpec, result: Any,
+                           node: NodeState | None = None) -> None:
         if spec.num_returns == 1:
             self.store.put(spec.return_ids[0], result)
         elif spec.num_returns == 0:
@@ -386,6 +455,9 @@ class Runtime:
                     f"{len(result) if isinstance(result, (tuple, list)) else 'n/a'}")
             for rid, value in zip(spec.return_ids, result):
                 self.store.put(rid, value)
+        if node is not None:
+            for rid in spec.return_ids:
+                self._record_location(rid, node.node_id)
 
     # ---------------------------------------------------------------- actors
 
@@ -659,6 +731,10 @@ class Runtime:
 
     def free(self, refs: Sequence[ObjectRef]) -> None:
         self.store.free([r.id() for r in refs])
+        self.lineage.forget([r.id() for r in refs])
+        with self._locations_lock:
+            for r in refs:
+                self._object_locations.pop(r.id(), None)
         for r in refs:
             desc = self.shm_directory.lookup(r.id())
             if desc is not None:
@@ -700,6 +776,7 @@ class Runtime:
         return self.cluster.available_resources()
 
     def shutdown(self) -> None:
+        self.health_monitor.shutdown()
         for actor in list(self._actors.values()):
             actor.kill("runtime shutdown", no_restart=True)
         self.dispatcher.shutdown()
