@@ -1,0 +1,124 @@
+"""Core-runtime microbenchmarks.
+
+Mirrors the metric set of the reference's `ray microbenchmark`
+(reference: python/ray/_private/ray_perf.py:120-189): tasks/sec sync and
+async, actor calls/sec, put/get throughput, large puts, wait over many
+refs, and a get through an object containing many refs. Prints one JSON
+line per metric so regressions are visible round-over-round.
+
+Run: python bench_core.py  (CPU-only; does not touch the TPU)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+os.environ.setdefault("RAY_TPU_SKIP_TPU_DETECTION", "1")
+
+import numpy as np
+
+import ray_tpu
+
+
+def timeit(name: str, fn, multiplier: float = 1.0,
+           warmup: int = 1, repeat: int = 3, unit: str = "ops/s") -> dict:
+    for _ in range(warmup):
+        fn()
+    rates = []
+    for _ in range(repeat):
+        start = time.perf_counter()
+        fn()
+        elapsed = time.perf_counter() - start
+        rates.append(multiplier / elapsed)
+    result = {"metric": name, "value": round(max(rates), 1), "unit": unit}
+    print(json.dumps(result), flush=True)
+    return result
+
+
+def main() -> None:
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=max(4, os.cpu_count() or 4))
+
+    @ray_tpu.remote
+    def small_value():
+        return b"ok"
+
+    @ray_tpu.remote
+    class Actor:
+        def small_value(self):
+            return b"ok"
+
+        def small_value_batch(self, n):
+            ray_tpu.get([small_value.remote() for _ in range(n)])
+
+    results = []
+
+    # --- object store -----------------------------------------------------
+    small = b"x" * 100
+    ref_small = ray_tpu.put(small)
+    results.append(timeit(
+        "single_client_get_calls",
+        lambda: [ray_tpu.get(ref_small) for _ in range(1000)], 1000))
+    results.append(timeit(
+        "single_client_put_calls",
+        lambda: [ray_tpu.put(small) for _ in range(1000)], 1000))
+
+    # NOTE: the in-process store holds host arrays by reference (the
+    # moral equivalent of plasma's zero-copy), so this measures put-path
+    # overhead, not a memcpy rate.
+    arr = np.zeros(100 * 1024 * 1024, dtype=np.int64)  # 0.8 GB
+    results.append(timeit(
+        "single_client_put_gigabytes",
+        lambda: ray_tpu.put(arr), 8 * 0.1, unit="GB/s"))
+
+    # --- tasks ------------------------------------------------------------
+    results.append(timeit(
+        "single_client_tasks_sync",
+        lambda: [ray_tpu.get(small_value.remote()) for _ in range(100)], 100))
+    results.append(timeit(
+        "single_client_tasks_async",
+        lambda: ray_tpu.get([small_value.remote() for _ in range(1000)]),
+        1000))
+
+    # --- wait -------------------------------------------------------------
+    def wait_many():
+        not_ready = [small_value.remote() for _ in range(1000)]
+        while not_ready:
+            _, not_ready = ray_tpu.wait(not_ready, num_returns=1)
+
+    results.append(timeit("single_client_wait_1k_refs", wait_many, 1000))
+
+    # --- ref-containing object -------------------------------------------
+    refs_obj = [ray_tpu.put(i) for i in range(10_000)]
+    big_ref = ray_tpu.put(refs_obj)
+    results.append(timeit(
+        "single_client_get_object_containing_10k_refs",
+        lambda: ray_tpu.get(big_ref), 1.0))
+
+    # --- actors -----------------------------------------------------------
+    actor = Actor.remote()
+    results.append(timeit(
+        "single_client_actor_calls_sync",
+        lambda: [ray_tpu.get(actor.small_value.remote()) for _ in range(100)],
+        100))
+    results.append(timeit(
+        "single_client_actor_calls_async",
+        lambda: ray_tpu.get(
+            [actor.small_value.remote() for _ in range(1000)]), 1000))
+
+    actors = [Actor.remote() for _ in range(4)]
+    n = 1000
+    results.append(timeit(
+        "multi_client_tasks_async",
+        lambda: ray_tpu.get(
+            [a.small_value_batch.remote(n) for a in actors]), n * 4))
+
+    ray_tpu.shutdown()
+    print(json.dumps({"metric": "core_microbenchmark_suite",
+                      "value": len(results), "unit": "metrics"}))
+
+
+if __name__ == "__main__":
+    main()

@@ -25,7 +25,7 @@ import time
 from collections import OrderedDict
 from typing import Callable
 
-from ray_tpu._private.ids import NodeID, ObjectID
+from ray_tpu._private.ids import NodeID, ObjectID  # noqa: F401 (NodeID: from_hex)
 from ray_tpu._private.object_ref import ObjectRef
 from ray_tpu._private.task import TaskSpec
 
@@ -88,7 +88,8 @@ class ObjectRecoveryManager:
                 and not strategy.soft):
             # Hard affinity to a dead node can never reschedule; fail
             # fast instead of queueing a task that hangs forever.
-            node = self._runtime.cluster.get_node_by_hex(strategy.node_id)
+            node = self._runtime.cluster.get_node(
+                NodeID.from_hex(strategy.node_id))
             if node is None or not node.alive:
                 return False
         with self._lock:
@@ -100,6 +101,7 @@ class ObjectRecoveryManager:
 
         store = self._runtime.store
         deps = []
+        unrecoverable_dep = None
         for arg in list(spec.args) + list(spec.kwargs.values()):
             if isinstance(arg, ObjectRef):
                 deps.append(arg)
@@ -107,9 +109,21 @@ class ObjectRecoveryManager:
                     if not self.recover(arg.id()):
                         from ray_tpu.exceptions import ObjectLostError
 
-                        store.put_error(arg.id(), ObjectLostError(
-                            arg, f"object {arg.id().hex()} lost with no "
-                            f"lineage to rebuild it"))
+                        dep_err = ObjectLostError(
+                            ObjectRef(arg.id(), _register=False),
+                            f"object {arg.id().hex()} lost with no "
+                            f"lineage to rebuild it")
+                        store.put_error(arg.id(), dep_err)
+                        unrecoverable_dep = dep_err
+        if unrecoverable_dep is not None:
+            # The parent can never produce a correct value; surface the
+            # dependency's ObjectLostError instead of resubmitting a task
+            # doomed to fail (and burn retries) on argument resolution.
+            for rid in spec.return_ids:
+                store.put_error(rid, unrecoverable_dep)
+            with self._lock:
+                self._in_flight.difference_update(spec.return_ids)
+            return True
         for rid in spec.return_ids:
             store.create_pending(rid)
 

@@ -99,13 +99,6 @@ class ClusterState:
         with self._lock:
             return self._nodes.get(node_id)
 
-    def get_node_by_hex(self, node_id_hex: str) -> NodeState | None:
-        with self._lock:
-            for node in self._nodes.values():
-                if node.node_id.hex() == node_id_hex:
-                    return node
-            return None
-
     def total_resources(self) -> dict[str, float]:
         with self._lock:
             out: dict[str, float] = {}

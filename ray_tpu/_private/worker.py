@@ -213,14 +213,23 @@ class Runtime:
                     if nid == node_id]
             for oid in lost:
                 del self._object_locations[oid]
-        for oid in lost:
-            if not self.store.mark_lost(oid):
-                continue  # not sealed (already pending/freed): nothing to do
-            if not self.recovery.recover(oid):
-                self.store.put_error(oid, ObjectLostError(
-                    ObjectRef(oid),
-                    f"object {oid.hex()} was on dead node "
-                    f"{node_id.hex()[:8]} and has no lineage"))
+        # Mark everything lost BEFORE recovering anything: recovery checks
+        # is_lost() on dependencies, so a partially-marked set would let a
+        # parent resubmit against a dep about to vanish.
+        marked = [oid for oid in lost if self.store.mark_lost(oid)]
+        for oid in marked:
+            try:
+                if not self.recovery.recover(oid):
+                    # _register=False: the error lives inside the entry it
+                    # describes — a registered ref would pin the refcount
+                    # above zero forever.
+                    self.store.put_error(oid, ObjectLostError(
+                        ObjectRef(oid, _register=False),
+                        f"object {oid.hex()} was on dead node "
+                        f"{node_id.hex()[:8]} and has no lineage"))
+            except Exception:  # noqa: BLE001 — one object must not strand
+                logger.exception("failed to handle loss of object %s",
+                                 oid.hex())
 
     # ----------------------------------------------------------------- tasks
 
@@ -326,7 +335,13 @@ class Runtime:
         except BaseException as exc:  # noqa: BLE001 — becomes a TaskError ref
             if self._maybe_retry(spec, exc):
                 return
-            error = exc if isinstance(exc, (TaskError, TaskCancelledError)) else \
+            from ray_tpu.exceptions import ObjectLostError
+
+            # ObjectLostError passes through unwrapped: a task that failed
+            # because its input is unrecoverable should surface the loss,
+            # not a generic TaskError around it.
+            error = exc if isinstance(
+                exc, (TaskError, TaskCancelledError, ObjectLostError)) else \
                 TaskError(exc,
                           getattr(exc, "__ray_tpu_remote_tb__", None)
                           or format_traceback(exc), spec.name)
@@ -372,6 +387,12 @@ class Runtime:
         """Owner-side object directory (reference:
         ownership_based_object_directory.h): which node holds the primary
         copy — the set of objects that die with that node."""
+        node = self.cluster.get_node(node_id)
+        if node is None or not node.alive:
+            # A task that finished after its node was declared dead keeps
+            # its driver-held result; recording the dead node would leave
+            # a permanently stale entry.
+            return
         with self._locations_lock:
             self._object_locations[object_id] = node_id
 

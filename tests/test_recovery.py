@@ -123,6 +123,25 @@ def test_tasks_reschedule_off_dead_node(chaos_runtime):
     assert runtime.get(later, timeout=10) == [2, 4, 6, 8]
 
 
+def test_unrecoverable_dep_surfaces_object_lost(chaos_runtime):
+    """A task whose lost dependency has no lineage fails with
+    ObjectLostError (not a retry loop ending in TaskError)."""
+    runtime = chaos_runtime
+    node_b = runtime.add_node({"CPU": 2.0})
+    payload = runtime.put([1, 2, 3])
+    runtime._record_location(payload.id(), node_b)
+
+    child = runtime.submit_task(
+        lambda x: sum(x), (payload,), {}, name="child",
+        resources={"CPU": 1.0}, scheduling_strategy=_affinity(node_b))
+    assert runtime.get(child)[0] == 6
+
+    runtime.kill_node(node_b)
+    _wait_node_dead(runtime, node_b)
+    with pytest.raises(ObjectLostError):
+        runtime.get(child, timeout=10)
+
+
 def test_lineage_table_is_bounded():
     from ray_tpu._private.recovery import LineageTable
     from ray_tpu._private.ids import ObjectID, TaskID
