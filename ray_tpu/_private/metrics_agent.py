@@ -1,0 +1,107 @@
+"""Prometheus metrics agent.
+
+Reference: python/ray/_private/metrics_agent.py + prometheus_exporter.py
+(OpenCensus → Prometheus bridge per node). Here: the process-wide metric
+registry (ray_tpu.util.metrics.REGISTRY) plus built-in runtime
+collectors, served in Prometheus text exposition format over HTTP at
+``/metrics``.
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ray_tpu.util.metrics import REGISTRY, _escape_label
+
+
+def install_runtime_collectors(runtime) -> None:
+    """Register scrape-time collectors over the runtime's live tables
+    (tasks by state, actors by state, store bytes, nodes alive) —
+    the metric set mirrors stats/metric_defs.cc core metrics."""
+
+    def collect() -> list[str]:
+        lines = []
+        by_state: dict[str, int] = {}
+        for ev in runtime.gcs.list_task_events():
+            by_state[ev.state] = by_state.get(ev.state, 0) + 1
+        lines.append("# TYPE ray_tpu_tasks gauge")
+        for state, n in sorted(by_state.items()):
+            lines.append(f'ray_tpu_tasks{{state="{state}"}} {n}')
+
+        actor_states: dict[str, int] = {}
+        for rec in runtime.gcs.list_actors():
+            actor_states[rec.state] = actor_states.get(rec.state, 0) + 1
+        lines.append("# TYPE ray_tpu_actors gauge")
+        for state, n in sorted(actor_states.items()):
+            lines.append(f'ray_tpu_actors{{state="{state}"}} {n}')
+
+        stats = runtime.store.stats()
+        lines.append("# TYPE ray_tpu_object_store_memory_bytes gauge")
+        lines.append(
+            f"ray_tpu_object_store_memory_bytes {stats['memory_used_bytes']}")
+        lines.append("# TYPE ray_tpu_object_store_num_objects gauge")
+        lines.append(
+            f"ray_tpu_object_store_num_objects {stats['num_objects']}")
+        lines.append("# TYPE ray_tpu_spilled_bytes_total counter")
+        lines.append(
+            f"ray_tpu_spilled_bytes_total {stats['spilled_bytes_total']}")
+
+        alive = sum(1 for n in runtime.gcs.list_nodes() if n.alive)
+        lines.append("# TYPE ray_tpu_nodes_alive gauge")
+        lines.append(f"ray_tpu_nodes_alive {alive}")
+
+        lines.append("# TYPE ray_tpu_resource_available gauge")
+        for key, value in runtime.cluster.available_resources().items():
+            # Label VALUES take any UTF-8 (escaped); only metric names
+            # need sanitizing — keep the real resource name joinable.
+            lines.append(
+                f'ray_tpu_resource_available'
+                f'{{resource="{_escape_label(key)}"}} {value}')
+        return lines
+
+    return REGISTRY.add_collector(collect)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 (http.server API)
+        if self.path.rstrip("/") not in ("", "/metrics"):
+            self.send_error(404)
+            return
+        body = REGISTRY.scrape().encode()
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # silence per-request stderr noise
+        pass
+
+
+class MetricsAgent:
+    """HTTP /metrics endpoint on a background thread."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 remove_collector=None):
+        self._server = ThreadingHTTPServer((host, port), _Handler)
+        self.port = self._server.server_address[1]
+        self._remove_collector = remove_collector
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="ray_tpu-metrics",
+            daemon=True)
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        # Deregister the runtime collector: a later init() would otherwise
+        # scrape a second (dead) runtime and emit duplicate series.
+        if self._remove_collector is not None:
+            self._remove_collector()
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def start_metrics_agent(runtime, port: int = 0) -> MetricsAgent:
+    remove = install_runtime_collectors(runtime)
+    return MetricsAgent(port=port, remove_collector=remove)

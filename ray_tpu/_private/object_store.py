@@ -356,6 +356,30 @@ class ObjectStore:
 
     # ----------------------------------------------------------------- stats
 
+    def snapshot(self) -> list[dict]:
+        """Per-object state listing for the state API."""
+        with self._lock:
+            out = []
+            for entry in self._entries.values():
+                if entry.freed:
+                    state = "FREED"
+                elif entry.lost and not entry.sealed:
+                    state = "LOST"
+                elif entry.sealed and entry.error is not None:
+                    state = "ERRORED"
+                elif entry.sealed:
+                    state = "SEALED"
+                else:
+                    state = "PENDING"
+                holds_bytes = entry.sealed and not entry.freed
+                out.append({
+                    "object_id": entry.object_id.hex(),
+                    "state": state,
+                    "size_bytes": entry.size_bytes if holds_bytes else 0,
+                    "spilled": entry.spilled_path is not None,
+                })
+            return out
+
     def stats(self) -> dict:
         with self._lock:
             return {
@@ -407,3 +431,7 @@ class ReferenceCounter:
     def count(self, object_id: ObjectID) -> int:
         with self._lock:
             return self._counts.get(object_id, 0)
+
+    def count_hex(self, object_id_hex: str) -> int:
+        with self._lock:
+            return self._counts.get(ObjectID.from_hex(object_id_hex), 0)

@@ -53,6 +53,29 @@ class PlacementGroupManager:
         self._lock = threading.Lock()
         self._groups: dict[PlacementGroupID, PlacementGroupRecord] = {}
 
+    def snapshot(self) -> list[dict]:
+        """State-API listing of all placement groups."""
+        with self._lock:
+            records = list(self._groups.values())
+        return [
+            {
+                "pg_id": rec.pg_id.hex(),
+                "state": rec.state,
+                "strategy": rec.strategy,
+                "bundles": [
+                    {
+                        "bundle_index": b.bundle_index,
+                        "resources": dict(b.resources),
+                        "node_id": b.node_id.hex() if b.node_id else None,
+                        "committed": b.committed,
+                    }
+                    for b in rec.bundles
+                ],
+            }
+            for rec in records
+        ]
+
+
     def create(self, bundles: list[dict[str, float]], strategy: str,
                name: str = "") -> PlacementGroupRecord:
         if strategy not in VALID_STRATEGIES:

@@ -92,6 +92,7 @@ class Runtime:
         object_store_memory: int | None = None,
         namespace: str = "default",
         process_workers: int | None = None,
+        metrics_port: int | None = None,
     ):
         cfg = GLOBAL_CONFIG
         self.namespace = namespace
@@ -154,6 +155,14 @@ class Runtime:
             self.gcs, period_s=cfg.health_check_period_ms / 1000.0,
             failure_threshold=cfg.health_check_failure_threshold,
             on_node_dead=self._on_node_dead)
+
+        # Prometheus /metrics endpoint (opt-in via metrics_port; 0 picks
+        # a free port — reference: _private/metrics_agent.py per node).
+        self.metrics_agent = None
+        if metrics_port is not None:
+            from ray_tpu._private.metrics_agent import start_metrics_agent
+
+            self.metrics_agent = start_metrics_agent(self, port=metrics_port)
 
         # Head node: autodetect CPU and TPU resources.
         detected = accelerators.detect_resources()
@@ -797,6 +806,8 @@ class Runtime:
         return self.cluster.available_resources()
 
     def shutdown(self) -> None:
+        if self.metrics_agent is not None:
+            self.metrics_agent.shutdown()
         self.health_monitor.shutdown()
         for actor in list(self._actors.values()):
             actor.kill("runtime shutdown", no_restart=True)
@@ -828,6 +839,7 @@ def init(
     system_config: dict | None = None,
     logging_level: str | None = None,
     process_workers: int | None = None,
+    metrics_port: int | None = None,
     **_ignored,
 ) -> Runtime:
     """Initialize the runtime (reference: ray.init, worker.py:1219)."""
@@ -852,7 +864,7 @@ def init(
         _runtime = Runtime(
             num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
             object_store_memory=object_store_memory, namespace=namespace,
-            process_workers=process_workers)
+            process_workers=process_workers, metrics_port=metrics_port)
         atexit.register(_atexit_shutdown)
         return _runtime
 

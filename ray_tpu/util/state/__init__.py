@@ -1,0 +1,26 @@
+"""State API: list/get/summarize cluster state.
+
+Reference: python/ray/util/state/api.py (list_tasks :1014, list_actors
+:782, list_objects :1060, list_nodes :876, list_placement_groups :831,
+list_jobs :922, summarize_* :1376-1444). Backed directly by the GCS
+tables, the object store, and the placement-group ledger.
+
+Also runnable as a CLI, mirroring `ray list ...`:
+    python -m ray_tpu.util.state list tasks
+    python -m ray_tpu.util.state summary tasks
+"""
+
+from ray_tpu.util.state.api import (  # noqa: F401
+    get_actor,
+    get_node,
+    get_task,
+    list_actors,
+    list_jobs,
+    list_nodes,
+    list_objects,
+    list_placement_groups,
+    list_tasks,
+    summarize_actors,
+    summarize_objects,
+    summarize_tasks,
+)

@@ -1,0 +1,5 @@
+import sys
+
+from ray_tpu.util.state.api import _cli
+
+sys.exit(_cli(sys.argv[1:]))

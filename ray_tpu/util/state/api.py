@@ -1,0 +1,215 @@
+"""State API implementation (reference: python/ray/util/state/api.py).
+
+Every listing returns plain dicts, newest-first where a time exists,
+with reference-style filters: ``filters=[("state", "=", "FAILED")]``
+supports ``=``/``!=``, and ``limit`` caps the result size.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ray_tpu._private import worker as worker_mod
+
+
+def _runtime():
+    runtime = worker_mod.global_runtime()
+    if runtime is None:
+        raise RuntimeError("ray_tpu is not initialized")
+    return runtime
+
+
+def _apply_filters(rows: list[dict], filters, limit: int) -> list[dict]:
+    for key, op, value in (filters or []):
+        if op == "=":
+            rows = [r for r in rows if str(r.get(key)) == str(value)]
+        elif op == "!=":
+            rows = [r for r in rows if str(r.get(key)) != str(value)]
+        else:
+            raise ValueError(f"Unsupported filter op {op!r}; use '=' or '!='")
+    return rows[:limit]
+
+
+# ------------------------------------------------------------------- tasks
+
+
+def list_tasks(filters=None, limit: int = 100) -> list[dict]:
+    """Reference: `ray list tasks` (api.py:1014)."""
+    events = _runtime().gcs.list_task_events()
+    rows = [
+        {
+            "task_id": ev.task_id.hex(),
+            "name": ev.name,
+            "state": ev.state,
+            "node_id": ev.node_id,
+            "start_time": ev.start_time,
+            "end_time": ev.end_time,
+            "error": ev.error,
+        }
+        for ev in events
+    ]
+    rows.sort(key=lambda r: r["start_time"], reverse=True)
+    return _apply_filters(rows, filters, limit)
+
+
+def get_task(task_id: str) -> dict | None:
+    for row in list_tasks(limit=10**9):
+        if row["task_id"] == task_id:
+            return row
+    return None
+
+
+def summarize_tasks() -> dict:
+    """Counts by (name, state) (reference: summarize_tasks api.py:1376)."""
+    summary: dict[str, dict[str, int]] = {}
+    for row in list_tasks(limit=10**9):
+        per_name = summary.setdefault(row["name"], {})
+        per_name[row["state"]] = per_name.get(row["state"], 0) + 1
+    return {"node_count": len(list_nodes(limit=10**9)), "summary": summary}
+
+
+# ------------------------------------------------------------------ actors
+
+
+def list_actors(filters=None, limit: int = 100) -> list[dict]:
+    """Reference: `ray list actors` (api.py:782)."""
+    rows = [
+        {
+            "actor_id": rec.actor_id.hex(),
+            "class_name": rec.class_name,
+            "state": rec.state,
+            "name": rec.name,
+            "namespace": rec.namespace,
+            "num_restarts": rec.num_restarts,
+            "death_cause": rec.death_cause,
+        }
+        for rec in _runtime().gcs.list_actors()
+    ]
+    return _apply_filters(rows, filters, limit)
+
+
+def get_actor(actor_id: str) -> dict | None:
+    for row in list_actors(limit=10**9):
+        if row["actor_id"] == actor_id:
+            return row
+    return None
+
+
+def summarize_actors() -> dict:
+    summary: dict[str, dict[str, int]] = {}
+    for row in list_actors(limit=10**9):
+        per_class = summary.setdefault(row["class_name"], {})
+        per_class[row["state"]] = per_class.get(row["state"], 0) + 1
+    return {"summary": summary}
+
+
+# ----------------------------------------------------------------- objects
+
+
+def list_objects(filters=None, limit: int = 100) -> list[dict]:
+    """Reference: `ray list objects` (api.py:1060)."""
+    runtime = _runtime()
+    rows = []
+    for entry in runtime.store.snapshot():
+        rows.append({
+            "object_id": entry["object_id"],
+            "state": entry["state"],
+            "size_bytes": entry["size_bytes"],
+            "reference_count": runtime.reference_counter.count_hex(
+                entry["object_id"]),
+            "spilled": entry["spilled"],
+        })
+    return _apply_filters(rows, filters, limit)
+
+
+def summarize_objects() -> dict:
+    total = 0
+    bytes_total = 0
+    by_state: dict[str, int] = {}
+    for row in list_objects(limit=10**9):
+        total += 1
+        bytes_total += row["size_bytes"]
+        by_state[row["state"]] = by_state.get(row["state"], 0) + 1
+    return {"total_objects": total, "total_size_bytes": bytes_total,
+            "by_state": by_state}
+
+
+# ------------------------------------------------------------------- nodes
+
+
+def list_nodes(filters=None, limit: int = 100) -> list[dict]:
+    rows = [
+        {
+            "node_id": rec.node_id.hex(),
+            "state": "ALIVE" if rec.alive else "DEAD",
+            "address": rec.address,
+            "resources": dict(rec.resources),
+            "labels": dict(rec.labels),
+        }
+        for rec in _runtime().gcs.list_nodes()
+    ]
+    return _apply_filters(rows, filters, limit)
+
+
+def get_node(node_id: str) -> dict | None:
+    for row in list_nodes(limit=10**9):
+        if row["node_id"] == node_id:
+            return row
+    return None
+
+
+# --------------------------------------------------------- placement groups
+
+
+def list_placement_groups(filters=None, limit: int = 100) -> list[dict]:
+    rows = [
+        {
+            "placement_group_id": rec["pg_id"],
+            "state": rec["state"],
+            "strategy": rec["strategy"],
+            "bundles": rec["bundles"],
+        }
+        for rec in _runtime().placement_groups.snapshot()
+    ]
+    return _apply_filters(rows, filters, limit)
+
+
+# -------------------------------------------------------------------- jobs
+
+
+def list_jobs(filters=None, limit: int = 100) -> list[dict]:
+    rows = [
+        {
+            "job_id": rec.job_id.hex(),
+            "status": rec.status,
+            "start_time": rec.start_time,
+            "end_time": rec.end_time,
+        }
+        for rec in _runtime().gcs.list_jobs()
+    ]
+    return _apply_filters(rows, filters, limit)
+
+
+# --------------------------------------------------------------------- CLI
+
+
+def _cli(argv: list[str]) -> int:
+    import json
+
+    listings = {
+        "tasks": list_tasks, "actors": list_actors, "objects": list_objects,
+        "nodes": list_nodes, "placement-groups": list_placement_groups,
+        "jobs": list_jobs,
+    }
+    summaries = {"tasks": summarize_tasks, "actors": summarize_actors,
+                 "objects": summarize_objects}
+    if len(argv) < 2:
+        print("usage: python -m ray_tpu.util.state {list|summary} <resource>")
+        return 2
+    verb, resource = argv[0], argv[1]
+    table = listings if verb == "list" else summaries if verb == "summary" else None
+    if table is None or resource not in table:
+        print(f"unknown: {verb} {resource}; resources: {sorted(table or listings)}")
+        return 2
+    print(json.dumps(table[resource](), indent=2, default=str))
+    return 0

@@ -1,0 +1,131 @@
+"""State API + Prometheus metrics (reference: python/ray/util/state/
+api.py listings and python/ray/util/metrics.py user metrics)."""
+
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state
+from ray_tpu.util.metrics import REGISTRY, Counter, Gauge, Histogram
+
+
+@pytest.fixture
+def metrics_runtime():
+    ray_tpu.shutdown()
+    REGISTRY.clear()
+    runtime = ray_tpu.init(num_cpus=4, metrics_port=0)
+    yield runtime
+    ray_tpu.shutdown()
+    REGISTRY.clear()
+
+
+def test_list_tasks_and_filters(ray_start_regular):
+    @ray_tpu.remote
+    def ok():
+        return 1
+
+    @ray_tpu.remote
+    def bad():
+        raise ValueError("nope")
+
+    ray_tpu.get([ok.remote() for _ in range(3)])
+    with pytest.raises(Exception):
+        ray_tpu.get(bad.remote())
+
+    rows = state.list_tasks()
+    names = {r["name"] for r in rows}
+    assert any("ok" in n for n in names)
+    failed = state.list_tasks(filters=[("state", "=", "FAILED")])
+    assert len(failed) == 1 and "bad" in failed[0]["name"]
+    finished = state.list_tasks(filters=[("state", "!=", "FAILED")])
+    assert all(r["state"] != "FAILED" for r in finished)
+    assert state.get_task(rows[0]["task_id"]) is not None
+
+    summary = state.summarize_tasks()
+    assert summary["node_count"] >= 1
+    bad_name = failed[0]["name"]
+    assert summary["summary"][bad_name]["FAILED"] == 1
+
+
+def test_list_actors_and_nodes(ray_start_regular):
+    @ray_tpu.remote
+    class Thing:
+        def ping(self):
+            return "pong"
+
+    t = Thing.remote()
+    assert ray_tpu.get(t.ping.remote()) == "pong"
+    actors = state.list_actors(filters=[("class_name", "=", "Thing")])
+    assert len(actors) == 1 and actors[0]["state"] == "ALIVE"
+    ray_tpu.kill(t)
+
+    nodes = state.list_nodes()
+    assert len(nodes) >= 1 and nodes[0]["state"] == "ALIVE"
+    assert state.get_node(nodes[0]["node_id"]) is not None
+
+
+def test_list_objects_and_summary(ray_start_regular):
+    refs = [ray_tpu.put(b"x" * 1000) for _ in range(5)]
+    rows = state.list_objects(filters=[("state", "=", "SEALED")])
+    assert len(rows) >= 5
+    summary = state.summarize_objects()
+    assert summary["total_objects"] >= 5
+    assert summary["by_state"].get("SEALED", 0) >= 5
+    del refs
+
+
+def test_list_placement_groups(ray_start_regular):
+    from ray_tpu.util.placement_group import placement_group
+
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    ray_tpu.get(pg.ready())
+    rows = state.list_placement_groups()
+    assert len(rows) == 1
+    assert rows[0]["state"] == "CREATED"
+    assert len(rows[0]["bundles"]) == 2
+
+
+def test_user_metrics_exposition():
+    REGISTRY.clear()
+    c = Counter("test_requests_total", "requests", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    g = Gauge("test_queue_depth", "depth")
+    g.set(7)
+    h = Histogram("test_latency_s", "latency", boundaries=[0.1, 1.0])
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    text = REGISTRY.scrape()
+    assert 'test_requests_total{route="/a"} 3.0' in text
+    assert "test_queue_depth 7.0" in text
+    assert 'test_latency_s_bucket{le="0.1"} 1' in text
+    assert 'test_latency_s_bucket{le="1.0"} 2' in text
+    assert 'test_latency_s_bucket{le="+Inf"} 3' in text
+    assert "test_latency_s_count 3" in text
+    REGISTRY.clear()
+
+
+def test_metric_tag_validation():
+    REGISTRY.clear()
+    c = Counter("test_tagged", tag_keys=("a",))
+    with pytest.raises(ValueError):
+        c.inc(tags={"b": "x"})
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    REGISTRY.clear()
+
+
+def test_metrics_http_endpoint(metrics_runtime):
+    @ray_tpu.remote
+    def work():
+        return 1
+
+    ray_tpu.get([work.remote() for _ in range(3)])
+    port = metrics_runtime.metrics_agent.port
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+    assert 'ray_tpu_tasks{state="FINISHED"} 3' in body
+    assert "ray_tpu_nodes_alive 1" in body
+    assert "ray_tpu_object_store_num_objects" in body
