@@ -15,10 +15,13 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from ray_tpu.util.metrics import REGISTRY, _escape_label
 
 
-def install_runtime_collectors(runtime) -> None:
+def install_runtime_collectors(runtime):
     """Register scrape-time collectors over the runtime's live tables
     (tasks by state, actors by state, store bytes, nodes alive) —
-    the metric set mirrors stats/metric_defs.cc core metrics."""
+    the metric set mirrors stats/metric_defs.cc core metrics.
+
+    Returns the deregistration callable (MetricsAgent.shutdown uses it
+    so a re-init cannot scrape a dead runtime's tables)."""
 
     def collect() -> list[str]:
         lines = []
@@ -65,7 +68,8 @@ def install_runtime_collectors(runtime) -> None:
 
 class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 (http.server API)
-        if self.path.rstrip("/") not in ("", "/metrics"):
+        path = self.path.split("?", 1)[0].rstrip("/")
+        if path not in ("", "/metrics"):
             self.send_error(404)
             return
         body = REGISTRY.scrape().encode()

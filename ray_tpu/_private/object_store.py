@@ -432,6 +432,3 @@ class ReferenceCounter:
         with self._lock:
             return self._counts.get(object_id, 0)
 
-    def count_hex(self, object_id_hex: str) -> int:
-        with self._lock:
-            return self._counts.get(ObjectID.from_hex(object_id_hex), 0)

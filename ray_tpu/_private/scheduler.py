@@ -316,6 +316,14 @@ class Dispatcher:
         with self._lock:
             return len(self._waiting) + len(self._ready) + self._num_running
 
+    def pending_demands(self) -> list[dict[str, float]]:
+        """Resource demands of queued-not-running tasks — the autoscaler's
+        input (reference: scheduler_resource_reporter.cc reports demand
+        to the GCS for the autoscaler)."""
+        with self._lock:
+            return [dict(t.spec.resources)
+                    for t in self._ready + self._waiting if t.spec.resources]
+
     def wait_idle(self, timeout: float | None = None) -> bool:
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:

@@ -1,0 +1,17 @@
+"""Autoscaler: resource-demand-driven cluster sizing.
+
+Reference: python/ray/autoscaler/_private/autoscaler.py
+(StandardAutoscaler), resource_demand_scheduler.py (bin-packing demand
+onto node types), node_provider.py (provider abstraction), and the
+fake_multi_node test provider the reference uses to exercise scaling
+logic without a cloud.
+"""
+
+from ray_tpu.autoscaler.autoscaler import (  # noqa: F401
+    NodeTypeConfig,
+    StandardAutoscaler,
+)
+from ray_tpu.autoscaler.node_provider import (  # noqa: F401
+    NodeProvider,
+    VirtualNodeProvider,
+)

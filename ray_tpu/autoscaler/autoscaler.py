@@ -1,0 +1,238 @@
+"""StandardAutoscaler: demand ledger -> bin-pack -> launch/terminate.
+
+Reference: python/ray/autoscaler/_private/autoscaler.py
+(StandardAutoscaler.update), load_metrics.py (demand collection), and
+resource_demand_scheduler.py get_nodes_to_launch (bin-packing pending
+demands onto hypothetical nodes of each configured type).
+
+The update loop:
+1. Collect pending demands: queued task resources + uncommitted
+   placement-group bundles.
+2. Simulate packing them onto the *current* free capacity; whatever
+   doesn't fit is unfulfilled demand.
+3. Bin-pack unfulfilled demand onto hypothetical new nodes per node
+   type (respecting max_workers) and launch them.
+4. Terminate autoscaler-launched nodes that have been fully idle longer
+   than idle_timeout_s (respecting min_workers).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ray_tpu._private.ids import NodeID
+from ray_tpu.autoscaler.node_provider import NodeProvider, VirtualNodeProvider
+
+logger = logging.getLogger("ray_tpu")
+
+
+@dataclass
+class NodeTypeConfig:
+    """One launchable node shape (reference: available_node_types in the
+    cluster YAML schema)."""
+
+    name: str
+    resources: dict[str, float]
+    min_workers: int = 0
+    max_workers: int = 10
+
+
+@dataclass
+class _TrackedNode:
+    node_id: NodeID
+    node_type: str
+    idle_since: float | None = field(default=None)
+
+
+def _fits(avail: dict[str, float], demand: dict[str, float]) -> bool:
+    return all(avail.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
+
+
+def _consume(avail: dict[str, float], demand: dict[str, float]) -> None:
+    for k, v in demand.items():
+        avail[k] = avail.get(k, 0.0) - v
+
+
+class StandardAutoscaler:
+    """Scales the virtual cluster to pending resource demand."""
+
+    def __init__(self, runtime, node_types: list[NodeTypeConfig],
+                 idle_timeout_s: float = 10.0, update_interval_s: float = 0.5,
+                 provider: NodeProvider | None = None,
+                 max_launch_batch: int = 5):
+        self._runtime = runtime
+        self._node_types = {nt.name: nt for nt in node_types}
+        self._idle_timeout = idle_timeout_s
+        self._interval = update_interval_s
+        self._provider = provider or VirtualNodeProvider(runtime)
+        self._max_launch_batch = max_launch_batch
+        self._lock = threading.Lock()
+        self._tracked: dict[NodeID, _TrackedNode] = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        # Satisfy min_workers immediately.
+        for nt in node_types:
+            for _ in range(nt.min_workers):
+                self._launch(nt)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> "StandardAutoscaler":
+        self._thread = threading.Thread(
+            target=self._run, name="ray_tpu-autoscaler", daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.update()
+            except Exception:
+                logger.exception("autoscaler update failed")
+
+    # --------------------------------------------------------------- update
+
+    def update(self) -> None:
+        """One reconcile step (reference: StandardAutoscaler.update)."""
+        demands = self._collect_demands()
+        unfulfilled = self._simulate_packing(demands)
+        if unfulfilled:
+            self._scale_up(unfulfilled)
+        self._enforce_min_workers()
+        self._scale_down()
+
+    def _enforce_min_workers(self) -> None:
+        """Re-satisfy the floor every update (a launched node may have
+        died since __init__ — reference: StandardAutoscaler re-enforces
+        min_workers each reconcile)."""
+        for nt in self._node_types.values():
+            while self._count(nt.name) < nt.min_workers:
+                self._launch(nt)
+
+    def _collect_demands(self) -> list[dict[str, float]]:
+        demands = list(self._runtime.dispatcher.pending_demands())
+        for pg in self._runtime.placement_groups.snapshot():
+            if pg["state"] == "PENDING":
+                demands.extend(dict(b["resources"]) for b in pg["bundles"])
+        return demands
+
+    def _simulate_packing(self, demands) -> list[dict[str, float]]:
+        """Pack demands onto current free capacity; return the leftovers."""
+        frees = [dict(n.available) for n in self._runtime.cluster.nodes()]
+        unfulfilled = []
+        for demand in sorted(demands, key=lambda d: -sum(d.values())):
+            for free in frees:
+                if _fits(free, demand):
+                    _consume(free, demand)
+                    break
+            else:
+                unfulfilled.append(demand)
+        return unfulfilled
+
+    def _scale_up(self, unfulfilled: list[dict[str, float]]) -> None:
+        """Bin-pack leftovers onto hypothetical new nodes and launch them
+        (reference: resource_demand_scheduler.get_nodes_to_launch)."""
+        launches: list[NodeTypeConfig] = []
+        pending_capacity: list[dict[str, float]] = []
+        for demand in unfulfilled:
+            placed = False
+            for cap in pending_capacity:
+                if _fits(cap, demand):
+                    _consume(cap, demand)
+                    placed = True
+                    break
+            if placed:
+                continue
+            nt = self._pick_node_type(
+                demand, extra={n.name: launches.count(n) for n in launches})
+            if nt is None:
+                continue  # no configured type can ever hold this demand
+            if len(launches) >= self._max_launch_batch:
+                break
+            launches.append(nt)
+            cap = dict(nt.resources)
+            _consume(cap, demand)
+            pending_capacity.append(cap)
+        for nt in launches:
+            self._launch(nt)
+
+    def _pick_node_type(self, demand,
+                        extra: dict[str, int] | None = None
+                        ) -> NodeTypeConfig | None:
+        candidates = []
+        for nt in self._node_types.values():
+            if not _fits(dict(nt.resources), demand):
+                continue
+            # Count this update's not-yet-launched picks too, or one
+            # burst can blow past max_workers.
+            pending = (extra or {}).get(nt.name, 0)
+            if self._count(nt.name) + pending >= nt.max_workers:
+                continue
+            candidates.append(nt)
+        if not candidates:
+            return None
+        # Smallest node that fits (cheapest-first, like the reference's
+        # utilization scorer preferring tight fits).
+        return min(candidates, key=lambda nt: sum(nt.resources.values()))
+
+    def _count(self, node_type: str) -> int:
+        with self._lock:
+            return sum(1 for t in self._tracked.values()
+                       if t.node_type == node_type)
+
+    def _launch(self, nt: NodeTypeConfig) -> None:
+        node_id = self._provider.create_node(nt.name, nt.resources)
+        with self._lock:
+            self._tracked[node_id] = _TrackedNode(node_id, nt.name)
+        logger.info("autoscaler launched %s node %s", nt.name,
+                    node_id.hex()[:8])
+
+    def _scale_down(self) -> None:
+        now = time.monotonic()
+        to_terminate = []
+        with self._lock:
+            tracked = list(self._tracked.values())
+        for t in tracked:
+            node = self._runtime.cluster.get_node(t.node_id)
+            if node is None or not node.alive:
+                with self._lock:
+                    self._tracked.pop(t.node_id, None)
+                continue
+            busy = any(node.available.get(k, 0.0) + 1e-9 < v
+                       for k, v in node.total.items())
+            if busy:
+                t.idle_since = None
+                continue
+            if t.idle_since is None:
+                t.idle_since = now
+                continue
+            nt = self._node_types[t.node_type]
+            # Count terminations already picked this pass, or one sweep
+            # of simultaneously-idle nodes drops below min_workers.
+            terminating = sum(1 for x in to_terminate
+                              if x.node_type == t.node_type)
+            if (now - t.idle_since > self._idle_timeout
+                    and self._count(t.node_type) - terminating
+                    > nt.min_workers):
+                to_terminate.append(t)
+        for t in to_terminate:
+            with self._lock:
+                self._tracked.pop(t.node_id, None)
+            self._provider.terminate_node(t.node_id)
+            logger.info("autoscaler terminated idle %s node %s",
+                        t.node_type, t.node_id.hex()[:8])
+
+    # ---------------------------------------------------------------- state
+
+    def num_nodes(self, node_type: str | None = None) -> int:
+        with self._lock:
+            if node_type is None:
+                return len(self._tracked)
+            return sum(1 for t in self._tracked.values()
+                       if t.node_type == node_type)

@@ -1,0 +1,59 @@
+"""Node provider abstraction.
+
+Reference: python/ray/autoscaler/node_provider.py — the pluggable
+create/terminate/list surface each cloud implements; the virtual
+provider plays the role of autoscaler/_private/fake_multi_node (real
+scheduling behavior, no cloud).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from ray_tpu._private.ids import NodeID
+
+
+class NodeProvider:
+    """Minimal provider surface (create/terminate/list)."""
+
+    def create_node(self, node_type: str,
+                    resources: dict[str, float]) -> NodeID:
+        raise NotImplementedError
+
+    def terminate_node(self, node_id: NodeID) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> list[NodeID]:
+        raise NotImplementedError
+
+
+class VirtualNodeProvider(NodeProvider):
+    """Adds/removes virtual nodes on the live runtime."""
+
+    def __init__(self, runtime: Any):
+        self._runtime = runtime
+        self._lock = threading.Lock()
+        self._launched: dict[NodeID, str] = {}
+
+    def create_node(self, node_type: str,
+                    resources: dict[str, float]) -> NodeID:
+        node_id = self._runtime.add_node(
+            dict(resources),
+            labels={"node_type": node_type, "autoscaler": "1"})
+        with self._lock:
+            self._launched[node_id] = node_type
+        return node_id
+
+    def terminate_node(self, node_id: NodeID) -> None:
+        with self._lock:
+            self._launched.pop(node_id, None)
+        self._runtime.remove_node(node_id)
+
+    def non_terminated_nodes(self) -> list[NodeID]:
+        with self._lock:
+            return list(self._launched)
+
+    def node_type(self, node_id: NodeID) -> str | None:
+        with self._lock:
+            return self._launched.get(node_id)

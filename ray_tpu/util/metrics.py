@@ -56,7 +56,10 @@ class _Registry:
             try:
                 lines.extend(fn())
             except Exception:
-                pass
+                import logging
+
+                logging.getLogger("ray_tpu").exception(
+                    "metrics collector %r failed during scrape", fn)
         return "\n".join(lines) + "\n"
 
     def clear(self) -> None:

@@ -108,6 +108,8 @@ def summarize_actors() -> dict:
 
 def list_objects(filters=None, limit: int = 100) -> list[dict]:
     """Reference: `ray list objects` (api.py:1060)."""
+    from ray_tpu._private.ids import ObjectID
+
     runtime = _runtime()
     rows = []
     for entry in runtime.store.snapshot():
@@ -115,8 +117,8 @@ def list_objects(filters=None, limit: int = 100) -> list[dict]:
             "object_id": entry["object_id"],
             "state": entry["state"],
             "size_bytes": entry["size_bytes"],
-            "reference_count": runtime.reference_counter.count_hex(
-                entry["object_id"]),
+            "reference_count": runtime.reference_counter.count(
+                ObjectID.from_hex(entry["object_id"])),
             "spilled": entry["spilled"],
         })
     return _apply_filters(rows, filters, limit)
