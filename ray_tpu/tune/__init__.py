@@ -1,7 +1,11 @@
 """ray_tpu.tune — hyperparameter optimization (reference: python/ray/tune)."""
 
 from ray_tpu.train.session import get_checkpoint
-from ray_tpu.tune.schedulers import ASHAScheduler, FIFOScheduler
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+)
 from ray_tpu.tune.search import (
     choice,
     grid_search,
@@ -66,6 +70,7 @@ __all__ = [
     "loguniform",
     "randint",
     "report",
+    "PopulationBasedTraining",
     "run",
     "sample_from",
     "uniform",

@@ -1,18 +1,26 @@
-"""Trial schedulers: FIFO and ASHA.
+"""Trial schedulers: FIFO, ASHA, and Population Based Training.
 
 Reference: python/ray/tune/schedulers/async_hyperband.py (ASHA) — rungs
 at grace_period * reduction_factor^k; a trial reaching a rung must be in
 the top 1/reduction_factor of results seen at that rung or it stops.
+python/ray/tune/schedulers/pbt.py (PBT) — at each perturbation interval,
+bottom-quantile trials *exploit* a top-quantile trial (copy its config +
+checkpoint) and *explore* (mutate hyperparameters), continuing training
+from the copied checkpoint.
 """
 
 from __future__ import annotations
 
+import random
 from collections import defaultdict
 from dataclasses import dataclass, field
 
 
 CONTINUE = "CONTINUE"
 STOP = "STOP"
+# PBT: stop this trial and relaunch it with (new_config, checkpoint)
+# from Scheduler.exploit(trial_id).
+EXPLOIT = "EXPLOIT"
 
 
 class FIFOScheduler:
@@ -68,3 +76,115 @@ class ASHAScheduler:
         if t >= self.max_t:
             decision = STOP
         return decision
+
+
+class PopulationBasedTraining:
+    """PBT (reference: python/ray/tune/schedulers/pbt.py).
+
+    The controller feeds trial state via ``on_trial_state(trial_id,
+    config, checkpoint)`` on every checkpointed report. ``on_result``
+    returns EXPLOIT for a bottom-quantile trial at a perturbation
+    boundary; the controller then calls ``exploit(trial_id)`` for the
+    (mutated_config, source_checkpoint) to relaunch it with.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: dict | None = None,
+                 quantile_fraction: float = 0.25,
+                 perturbation_factors: tuple = (0.8, 1.2),
+                 resample_probability: float = 0.25,
+                 time_attr: str = "training_iteration",
+                 seed: int | None = None):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be min|max, got {mode}")
+        if not hyperparam_mutations:
+            raise ValueError("PBT requires hyperparam_mutations")
+        self.metric = metric
+        self.mode = mode
+        self.perturbation_interval = perturbation_interval
+        self.hyperparam_mutations = hyperparam_mutations
+        self.quantile_fraction = quantile_fraction
+        self.perturbation_factors = perturbation_factors
+        self.resample_probability = resample_probability
+        self.time_attr = time_attr
+        self._rng = random.Random(seed)
+        self._scores: dict[str, float] = {}
+        self._configs: dict[str, dict] = {}
+        self._checkpoints: dict[str, object] = {}
+        self._last_perturb: dict[str, int] = {}
+        self._exploit_sources: dict[str, str] = {}
+        self.num_perturbations = 0
+
+    # ---------------------------------------------------------- state feed
+
+    def on_trial_state(self, trial_id: str, config: dict,
+                       checkpoint) -> None:
+        self._configs[trial_id] = dict(config)
+        if checkpoint is not None:
+            self._checkpoints[trial_id] = checkpoint
+
+    # -------------------------------------------------------------- decide
+
+    def _score(self, value: float) -> float:
+        return -value if self.mode == "min" else value
+
+    def on_result(self, trial_id: str, metrics: dict) -> str:
+        t = metrics.get(self.time_attr)
+        value = metrics.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        self._scores[trial_id] = self._score(float(value))
+        last = self._last_perturb.get(trial_id, 0)
+        if t - last < self.perturbation_interval:
+            return CONTINUE
+        self._last_perturb[trial_id] = t
+        ranked = sorted(self._scores, key=self._scores.get)  # worst first
+        if len(ranked) < 2:
+            return CONTINUE
+        n_quantile = max(1, int(len(ranked) * self.quantile_fraction))
+        bottom = set(ranked[:n_quantile])
+        top = [tid for tid in ranked[-n_quantile:]
+               if tid in self._checkpoints and tid != trial_id]
+        if trial_id in bottom and top:
+            self._exploit_sources[trial_id] = self._rng.choice(top)
+            return EXPLOIT
+        return CONTINUE
+
+    # ------------------------------------------------------------- exploit
+
+    def exploit(self, trial_id: str):
+        """(mutated_config, source_checkpoint) for the stopped trial."""
+        source = self._exploit_sources.pop(trial_id, None)
+        if source is None:
+            raise ValueError(
+                f"exploit({trial_id!r}) without a preceding EXPLOIT "
+                f"decision for that trial")
+        new_config = self._explore(dict(self._configs.get(source, {})))
+        self._configs[trial_id] = new_config
+        self.num_perturbations += 1
+        return new_config, self._checkpoints.get(source)
+
+    def _explore(self, config: dict) -> dict:
+        """Mutate each listed hyperparameter (reference: pbt.py explore)."""
+        for key, space in self.hyperparam_mutations.items():
+            resample = self._rng.random() < self.resample_probability
+            current = config.get(key)
+            if callable(space):
+                config[key] = space()
+            elif isinstance(space, (list, tuple)):
+                # Stay INSIDE the listed space: shift to an adjacent
+                # index (reference pbt.py explore), never multiply —
+                # 64 * 0.8 = 51.2 is not a legal batch size.
+                values = list(space)
+                if resample or current not in values:
+                    config[key] = self._rng.choice(values)
+                else:
+                    idx = values.index(current)
+                    shift = self._rng.choice((-1, 1))
+                    config[key] = values[min(len(values) - 1,
+                                             max(0, idx + shift))]
+            elif isinstance(current, (int, float)):
+                config[key] = current * self._rng.choice(
+                    self.perturbation_factors)
+        return config

@@ -31,7 +31,7 @@ from ray_tpu.train.session import (
     _SessionState,
     _TrainSession,
 )
-from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler
+from ray_tpu.tune.schedulers import CONTINUE, EXPLOIT, STOP, FIFOScheduler
 from ray_tpu.tune.search import generate_variants
 
 
@@ -108,7 +108,8 @@ class ResultGrid:
 
 
 def _run_trial_fn(trainable: Callable, config: dict, trial_id: str,
-                  results_queue, stop_event) -> Any:
+                  results_queue, stop_event,
+                  resume_checkpoint: Checkpoint | None = None) -> Any:
     """Execute one trial inside a task; session routes tune.report."""
     from ray_tpu.train.session import run_with_session
 
@@ -116,6 +117,7 @@ def _run_trial_fn(trainable: Callable, config: dict, trial_id: str,
         context=TrainContext(trial_name=trial_id),
         results_queue=_TaggedQueue(results_queue, trial_id, stop_event),
         stop_event=stop_event,
+        resume_checkpoint=resume_checkpoint,
     )
 
     def emit(msg: dict):
@@ -203,25 +205,89 @@ def _takes_config(cls: type) -> bool:
 
 
 class Tuner:
-    """Reference: ray.tune.Tuner (tuner.py:54)."""
+    """Reference: ray.tune.Tuner (tuner.py:54). ``Tuner.restore`` resumes
+    a previous run from its persisted experiment state (reference:
+    Tuner.restore + tune/execution experiment checkpointing)."""
 
     def __init__(self, trainable: Callable | type, *,
                  param_space: dict | None = None,
                  tune_config: TuneConfig | None = None,
-                 run_config=None):
+                 run_config=None,
+                 _restored_trials: list | None = None):
         self.trainable = trainable
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config
+        self._restored_trials = _restored_trials
+
+    # ------------------------------------------------------ experiment dir
+
+    def _experiment_dir(self) -> str | None:
+        run_cfg = self.run_config
+        if run_cfg is None or not getattr(run_cfg, "storage_path", None):
+            return None
+        # Never mutate the caller's RunConfig: a shared config across two
+        # Tuners must not make them share (and clobber) one directory.
+        if getattr(self, "_exp_name", None) is None:
+            self._exp_name = run_cfg.name or \
+                f"tune_{int(time.time())}_{uuid.uuid4().hex[:6]}"
+        return f"{run_cfg.storage_path}/{self._exp_name}"
+
+    @staticmethod
+    def _save_state(exp_dir: str, trials: dict, done: set) -> None:
+        """Persist resumable state (reference: the tuner.pkl +
+        experiment-state files under the experiment dir)."""
+        import os
+        import pickle
+
+        state = [
+            {
+                "trial_id": t.trial_id,
+                "config": t.config,
+                "status": ("DONE" if t.trial_id in done and t.error is None
+                           else "ERROR" if t.trial_id in done else "PENDING"),
+                "metrics": t.metrics,
+                "history": t.history,
+                "checkpoint_path": (t.checkpoint.path
+                                    if t.checkpoint is not None else None),
+            }
+            for t in trials.values()
+        ]
+        os.makedirs(exp_dir, exist_ok=True)
+        tmp = f"{exp_dir}/experiment_state.pkl.tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(state, f)
+        os.replace(tmp, f"{exp_dir}/experiment_state.pkl")
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable | type, *,
+                tune_config: TuneConfig | None = None,
+                run_config=None) -> "Tuner":
+        """Resume a run from ``{storage_path}/{name}``: finished trials
+        keep their results; unfinished ones re-run from their last
+        checkpoint."""
+        import os
+        import pickle
+
+        state_file = os.path.join(path, "experiment_state.pkl")
+        with open(state_file, "rb") as f:
+            state = pickle.load(f)
+        if run_config is None:
+            from ray_tpu.train.config import RunConfig
+
+            run_config = RunConfig(
+                storage_path=os.path.dirname(path.rstrip("/")),
+                name=os.path.basename(path.rstrip("/")))
+        return cls(trainable, tune_config=tune_config,
+                   run_config=run_config, _restored_trials=state)
+
+    # ----------------------------------------------------------------- fit
 
     def fit(self) -> ResultGrid:
         if not ray_tpu.is_initialized():
             ray_tpu.init()
         tc = self.tune_config
         scheduler = tc.scheduler or FIFOScheduler()
-        variants = generate_variants(self.param_space, tc.num_samples, tc.seed)
-        if not variants:
-            variants = [{}]
 
         trainable = self.trainable
         if isinstance(trainable, type):
@@ -230,38 +296,79 @@ class Tuner:
         results_queue: queue.Queue = queue.Queue()
         trials: dict[str, TrialResult] = {}
         stop_events: dict[str, threading.Event] = {}
-        pending = []
-        for i, config in enumerate(variants):
-            trial_id = f"trial_{i:05d}_{uuid.uuid4().hex[:6]}"
-            trials[trial_id] = TrialResult(trial_id=trial_id, config=config)
-            stop_events[trial_id] = threading.Event()
-            pending.append((trial_id, config))
-
-        max_concurrent = tc.max_concurrent_trials or len(pending)
-        running: set[str] = set()
+        resume_ckpts: dict[str, Checkpoint | None] = {}
+        pending: list[tuple[str, dict]] = []
         done: set[str] = set()
 
+        if self._restored_trials is not None:
+            for rec in self._restored_trials:
+                trial_id = rec["trial_id"]
+                trial = TrialResult(trial_id=trial_id, config=rec["config"],
+                                    metrics=rec["metrics"],
+                                    history=rec["history"])
+                if rec["checkpoint_path"]:
+                    trial.checkpoint = Checkpoint(rec["checkpoint_path"])
+                trials[trial_id] = trial
+                stop_events[trial_id] = threading.Event()
+                if rec["status"] == "DONE":
+                    done.add(trial_id)
+                else:
+                    resume_ckpts[trial_id] = trial.checkpoint
+                    pending.append((trial_id, rec["config"]))
+        else:
+            variants = generate_variants(self.param_space, tc.num_samples,
+                                         tc.seed)
+            if not variants:
+                variants = [{}]
+            for i, config in enumerate(variants):
+                trial_id = f"trial_{i:05d}_{uuid.uuid4().hex[:6]}"
+                trials[trial_id] = TrialResult(trial_id=trial_id,
+                                               config=config)
+                stop_events[trial_id] = threading.Event()
+                pending.append((trial_id, config))
+
+        max_concurrent = tc.max_concurrent_trials or max(len(pending), 1)
+        running: set[str] = set()
+        # Trials stopped by an EXPLOIT decision, awaiting relaunch with
+        # (new_config, source_checkpoint).
+        exploiting: dict[str, tuple[dict, Checkpoint | None]] = {}
+
         run_trial = ray_tpu.remote(_run_trial_fn)
+
+        def launch(trial_id: str, config: dict,
+                   ckpt: Checkpoint | None) -> None:
+            running.add(trial_id)
+            stop_events[trial_id] = threading.Event()
+            run_trial.options(name=trial_id).remote(
+                trainable, config, trial_id, results_queue,
+                stop_events[trial_id], ckpt)
 
         def launch_next():
             while pending and len(running) < max_concurrent:
                 trial_id, config = pending.pop(0)
-                running.add(trial_id)
-                run_trial.options(name=trial_id).remote(
-                    trainable, config, trial_id, results_queue,
-                    stop_events[trial_id])
+                launch(trial_id, config, resume_ckpts.get(trial_id))
 
         launch_next()
         run_cfg = self.run_config
-        manager = None
-        if run_cfg is not None and getattr(run_cfg, "storage_path", None):
-            from ray_tpu.train.checkpoint import CheckpointManager
+        exp_dir = self._experiment_dir()
+        # Per-TRIAL checkpoint managers (reference: each trial owns its
+        # directory): a shared top-K across trials would evict the very
+        # checkpoints PBT exploit and restore() rely on.
+        managers: dict[str, Any] = {}
 
-            name = run_cfg.name or f"tune_{int(time.time())}"
-            keep = run_cfg.checkpoint_config.num_to_keep
-            manager = CheckpointManager(
-                f"{run_cfg.storage_path}/{name}", num_to_keep=keep,
-                metric=tc.metric, mode=tc.mode)
+        def trial_manager(trial_id: str):
+            if exp_dir is None:
+                return None
+            if trial_id not in managers:
+                from ray_tpu.train.checkpoint import CheckpointManager
+
+                managers[trial_id] = CheckpointManager(
+                    f"{exp_dir}/{trial_id}",
+                    num_to_keep=run_cfg.checkpoint_config.num_to_keep,
+                    metric=tc.metric, mode=tc.mode)
+            return managers[trial_id]
+
+        last_state_save = 0.0
         stop_criteria = (run_cfg.stop if run_cfg is not None else None) or {}
         deadline = (time.monotonic() + tc.time_budget_s
                     if tc.time_budget_s else None)
@@ -276,10 +383,19 @@ class Tuner:
                 continue
             trial = trials[msg["trial_id"]]
             if msg.get("done"):
+                if trial.trial_id in exploiting:
+                    # PBT relaunch: same trial, mutated config, source ckpt.
+                    new_config, ckpt = exploiting.pop(trial.trial_id)
+                    trial.config = new_config
+                    running.discard(trial.trial_id)
+                    launch(trial.trial_id, new_config, ckpt)
+                    continue
                 if msg.get("error") is not None:
                     trial.error = msg["error"]
                 done.add(trial.trial_id)
                 running.discard(trial.trial_id)
+                if exp_dir is not None:
+                    self._save_state(exp_dir, trials, done)
                 launch_next()
                 continue
             metrics = dict(msg.get("metrics") or {})
@@ -288,10 +404,24 @@ class Tuner:
             trial.history.append(metrics)
             if msg.get("checkpoint") is not None:
                 trial.checkpoint = msg["checkpoint"]
-            if msg.get("checkpoint") is not None and manager is not None:
-                path = manager.register(msg["checkpoint"], metrics)
-                trial.checkpoint = Checkpoint(path)
-            if scheduler.on_result(trial.trial_id, metrics) == STOP:
+                manager = trial_manager(trial.trial_id)
+                if manager is not None:
+                    path = manager.register(msg["checkpoint"], metrics)
+                    trial.checkpoint = Checkpoint(path)
+                # Throttled (the done-path saves unconditionally): a full
+                # state rewrite per report would be O(iterations^2) I/O.
+                if exp_dir is not None and \
+                        time.monotonic() - last_state_save > 1.0:
+                    last_state_save = time.monotonic()
+                    self._save_state(exp_dir, trials, done)
+            if hasattr(scheduler, "on_trial_state"):
+                scheduler.on_trial_state(trial.trial_id, trial.config,
+                                         trial.checkpoint)
+            decision = scheduler.on_result(trial.trial_id, metrics)
+            if decision == STOP:
+                stop_events[trial.trial_id].set()
+            elif decision == EXPLOIT:
+                exploiting[trial.trial_id] = scheduler.exploit(trial.trial_id)
                 stop_events[trial.trial_id].set()
             for key, threshold in stop_criteria.items():
                 if key in metrics and metrics[key] >= threshold:
@@ -312,6 +442,12 @@ class Tuner:
                         msg["ack"].set()
             except queue.Empty:
                 pass
+            if exp_dir is not None:
+                # Interrupted trials persist as PENDING so restore()
+                # re-runs them from their last checkpoint.
+                self._save_state(exp_dir, trials, done)
+        elif exp_dir is not None:
+            self._save_state(exp_dir, trials, done)
         return ResultGrid(list(trials.values()), tc.metric, tc.mode)
 
 

@@ -1,0 +1,111 @@
+"""PBT scheduler + experiment resume (reference:
+python/ray/tune/schedulers/pbt.py, tune/execution experiment state)."""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import RunConfig
+from ray_tpu.tune import PopulationBasedTraining, TuneConfig, Tuner
+from ray_tpu.tune.schedulers import CONTINUE, EXPLOIT
+
+
+def test_pbt_scheduler_decisions_and_explore():
+    pbt = PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=2,
+        hyperparam_mutations={"lr": [0.001, 0.01, 0.1]}, seed=0,
+        quantile_fraction=0.5)
+    # Two trials; t1 is much better and has a checkpoint.
+    ckpt = Checkpoint.from_dict({"w": 1})
+    pbt.on_trial_state("t1", {"lr": 0.1}, ckpt)
+    pbt.on_trial_state("t2", {"lr": 0.001}, None)
+    assert pbt.on_result("t1", {"training_iteration": 2, "score": 10}) \
+        == CONTINUE
+    assert pbt.on_result("t2", {"training_iteration": 2, "score": 1}) \
+        == EXPLOIT
+    new_config, source_ckpt = pbt.exploit("t2")
+    assert source_ckpt is ckpt
+    # Mutated from the TOP trial's config (0.1), not t2's own.
+    assert new_config["lr"] in (0.001, 0.01, 0.1, 0.08, 0.12) or \
+        new_config["lr"] == pytest.approx(0.1 * 0.8) or \
+        new_config["lr"] == pytest.approx(0.1 * 1.2)
+    assert pbt.num_perturbations == 1
+
+
+def test_pbt_end_to_end_improves_bad_trials(ray_start_regular):
+    """Bad-lr trials exploit the good one and continue from its state."""
+
+    def trainable(config):
+        ckpt = tune.get_checkpoint()
+        step = ckpt.to_dict()["step"] if ckpt is not None else 0
+        lr = config["lr"]
+        for i in range(step + 1, step + 21):
+            # score grows with iterations only for good lr.
+            score = i * (1.0 if lr >= 0.05 else 0.01)
+            tune.report({"score": score, "training_iteration": i},
+                        checkpoint=Checkpoint.from_dict({"step": i}))
+            if i >= 20:
+                return
+
+    pbt = PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=5,
+        hyperparam_mutations={"lr": [0.1, 0.2]}, seed=1,
+        quantile_fraction=0.5, resample_probability=1.0)
+    results = Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.001, 0.1])},
+        tune_config=TuneConfig(metric="score", mode="max", scheduler=pbt),
+    ).fit()
+    assert pbt.num_perturbations >= 1
+    assert not results.errors
+    # After exploitation the bad trial's config was mutated to a good lr.
+    configs = [r.config["lr"] for r in results]
+    assert all(lr >= 0.05 for lr in configs), configs
+    # And every trial finished with a high score.
+    for r in results:
+        assert r.metrics["score"] >= 15
+
+
+def test_experiment_state_saved_and_restored(ray_start_regular, tmp_path):
+    marker_dir = tmp_path / "markers"
+    marker_dir.mkdir()
+
+    def trainable(config):
+        ckpt = tune.get_checkpoint()
+        start = ckpt.to_dict()["i"] if ckpt is not None else 0
+        # Record where each run started, per trial.
+        with open(marker_dir / f"{config['x']}_starts", "a") as f:
+            f.write(f"{start},")
+        for i in range(start + 1, 6):
+            tune.report({"loss": 1.0 / i, "training_iteration": i},
+                        checkpoint=Checkpoint.from_dict({"i": i}))
+            if config["x"] == "slow" and i == 2 and start == 0:
+                raise RuntimeError("simulated crash")
+
+    run_cfg = RunConfig(name="exp1", storage_path=str(tmp_path))
+    results = Tuner(
+        trainable,
+        param_space={"x": tune.grid_search(["fast", "slow"])},
+        tune_config=TuneConfig(metric="loss", mode="min"),
+        run_config=run_cfg,
+    ).fit()
+    assert len(results.errors) == 1  # slow crashed
+    assert os.path.exists(tmp_path / "exp1" / "experiment_state.pkl")
+
+    # Restore: finished trial is kept, crashed trial re-runs from ckpt.
+    restored = Tuner.restore(
+        str(tmp_path / "exp1"), trainable,
+        tune_config=TuneConfig(metric="loss", mode="min"))
+    results2 = restored.fit()
+    assert not results2.errors
+    for r in results2:
+        assert r.metrics["training_iteration"] == 5
+    # The crashed trial resumed from its iteration-2 checkpoint (start=2),
+    # not from scratch; the finished trial never re-ran.
+    slow_starts = (marker_dir / "slow_starts").read_text()
+    assert slow_starts == "0,2,"
+    fast_starts = (marker_dir / "fast_starts").read_text()
+    assert fast_starts == "0,"
