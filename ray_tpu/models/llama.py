@@ -59,6 +59,11 @@ class LlamaConfig:
     # "ring" (context parallel over sp axis — requires running inside
     # shard_map with an "sp" axis; "ring_local" when already inside).
     attention: str = "plain"
+    # Mixture-of-Experts: >0 replaces the dense SwiGLU MLP with a top-1
+    # routed expert layer (experts sharded over the ep mesh axis).
+    num_experts: int = 0
+    expert_capacity_factor: float = 1.25
+    moe_aux_loss_coef: float = 0.01
 
     @staticmethod
     def llama2_7b() -> "LlamaConfig":
@@ -85,14 +90,28 @@ class LlamaConfig:
             num_layers=2, num_heads=4, num_kv_heads=4, head_dim=16,
             max_seq_len=128, remat=False)
 
-    @property
-    def num_params(self) -> int:
+    def _param_count(self, experts_counted: int) -> int:
         e, m, v = self.hidden_size, self.intermediate_size, self.vocab_size
         h, kv, d = self.num_heads, self.num_kv_heads, self.head_dim
+        if self.num_experts > 0:
+            mlp = e * self.num_experts + 3 * e * m * experts_counted
+        else:
+            mlp = 3 * e * m  # dense swiglu
         per_layer = (e * h * d + 2 * e * kv * d + h * d * e  # attention
-                     + 3 * e * m  # swiglu
+                     + mlp
                      + 2 * e)  # norms
         return v * e + self.num_layers * per_layer + e + e * v
+
+    @property
+    def num_params(self) -> int:
+        return self._param_count(max(self.num_experts, 1))
+
+    @property
+    def num_active_params(self) -> int:
+        """Params touched per token: top-1 routing activates ONE expert,
+        so MoE compute cost is dense-equivalent — MFU accounting must use
+        this, not total params."""
+        return self._param_count(1)
 
 
 # ---------------------------------------------------------------------- init
@@ -112,19 +131,27 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
     def dense_init(key, fan_in, *shape):
         return jax.random.normal(key, shape, dtype=jnp.float32) * fan_in ** -0.5
 
-    return {
-        "embed": {"tokens": dense_init(keys[0], e, v, e)},
-        "layers": {
-            "attn_norm": norm_init(n, e),
-            "wq": dense_init(keys[1], e, n, e, h, d),
-            "wk": dense_init(keys[2], e, n, e, kv, d),
-            "wv": dense_init(keys[3], e, n, e, kv, d),
-            "wo": dense_init(keys[4], h * d, n, h, d, e),
-            "mlp_norm": norm_init(n, e),
+    layers = {
+        "attn_norm": norm_init(n, e),
+        "wq": dense_init(keys[1], e, n, e, h, d),
+        "wk": dense_init(keys[2], e, n, e, kv, d),
+        "wv": dense_init(keys[3], e, n, e, kv, d),
+        "wo": dense_init(keys[4], h * d, n, h, d, e),
+        "mlp_norm": norm_init(n, e),
+    }
+    if config.num_experts > 0:
+        from ray_tpu.models.moe import init_moe_params
+
+        layers.update(init_moe_params(keys[5], e, m, config.num_experts, n))
+    else:
+        layers.update({
             "w_gate": dense_init(keys[5], e, n, e, m),
             "w_up": dense_init(keys[6], e, n, e, m),
             "w_down": dense_init(keys[7], m, n, m, e),
-        },
+        })
+    return {
+        "embed": {"tokens": dense_init(keys[0], e, v, e)},
+        "layers": layers,
         "final_norm": norm_init(e),
         "lm_head": dense_init(keys[8], e, e, v),
     }
@@ -133,21 +160,29 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
 def param_logical_axes(config: LlamaConfig | None = None) -> dict:
     """Logical sharding axes per param (leading scan dim = None).
 
-    tp → heads/mlp/vocab; fsdp → embed; norms replicated.
+    tp → heads/mlp/vocab; fsdp → embed; ep → experts; norms replicated.
     """
-    return {
-        "embed": {"tokens": ("vocab", "embed")},
-        "layers": {
-            "attn_norm": (None, "norm"),
-            "wq": (None, "embed", "heads", None),
-            "wk": (None, "embed", "kv_heads", None),
-            "wv": (None, "embed", "kv_heads", None),
-            "wo": (None, "heads", None, "embed"),
-            "mlp_norm": (None, "norm"),
+    layers = {
+        "attn_norm": (None, "norm"),
+        "wq": (None, "embed", "heads", None),
+        "wk": (None, "embed", "kv_heads", None),
+        "wv": (None, "embed", "kv_heads", None),
+        "wo": (None, "heads", None, "embed"),
+        "mlp_norm": (None, "norm"),
+    }
+    if config is not None and config.num_experts > 0:
+        from ray_tpu.models.moe import moe_logical_axes
+
+        layers.update(moe_logical_axes())
+    else:
+        layers.update({
             "w_gate": (None, "embed", "mlp"),
             "w_up": (None, "embed", "mlp"),
             "w_down": (None, "mlp", "embed"),
-        },
+        })
+    return {
+        "embed": {"tokens": ("vocab", "embed")},
+        "layers": layers,
         "final_norm": ("norm",),
         "lm_head": ("embed", "vocab"),
     }
@@ -215,22 +250,42 @@ def _mlp_block(layer: dict, x: jax.Array, config: LlamaConfig) -> jax.Array:
     return x + jnp.einsum("blm,me->ble", hidden, layer["w_down"].astype(dtype))
 
 
+def _moe_block(layer: dict, x: jax.Array,
+               config: LlamaConfig) -> tuple[jax.Array, jax.Array]:
+    from ray_tpu.models.moe import moe_mlp
+
+    normed = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
+    out, aux = moe_mlp(
+        layer, normed, capacity_factor=config.expert_capacity_factor,
+        dtype=config.dtype)
+    return x + out, aux
+
+
 def forward(params: dict, tokens: jax.Array, config: LlamaConfig,
-            positions: jax.Array | None = None) -> jax.Array:
+            positions: jax.Array | None = None,
+            with_aux: bool = False):
     """tokens [B, L] (local shard if under sp) -> logits [B, L, V] f32.
 
     When ``positions`` is provided they are the *global* token positions
     (needed for RoPE + causal masking under sequence parallelism).
+    ``with_aux=True`` additionally returns the summed MoE load-balancing
+    loss (0.0 for dense configs).
     """
     if positions is None:
         b, l = tokens.shape
         positions = jnp.broadcast_to(jnp.arange(l), (b, l))
     x = params["embed"]["tokens"].astype(config.dtype)[tokens]
+    moe = config.num_experts > 0
 
-    def layer_step(x, layer):
+    def layer_step(carry, layer):
+        x, aux_sum = carry
         x = _attention_block(layer, x, positions, config)
-        x = _mlp_block(layer, x, config)
-        return x, None
+        if moe:
+            x, aux = _moe_block(layer, x, config)
+            aux_sum = aux_sum + aux
+        else:
+            x = _mlp_block(layer, x, config)
+        return (x, aux_sum), None
 
     step = layer_step
     if config.remat:
@@ -245,7 +300,8 @@ def forward(params: dict, tokens: jax.Array, config: LlamaConfig,
                 f"remat_policy={config.remat_policy!r}: expected 'full' "
                 f"or 'dots'")
         step = jax.checkpoint(layer_step, prevent_cse=False, policy=policy)
-    x, _ = lax.scan(step, x, params["layers"])
+    (x, aux_sum), _ = lax.scan(
+        step, (x, jnp.zeros((), dtype=jnp.float32)), params["layers"])
     x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
     # bf16 operands on the MXU with f32 accumulation: same numerics as
     # mixed-precision matmul everywhere else in the stack, ~2x the
@@ -253,6 +309,8 @@ def forward(params: dict, tokens: jax.Array, config: LlamaConfig,
     logits = jnp.einsum("ble,ev->blv", x,
                         params["lm_head"].astype(config.dtype),
                         preferred_element_type=jnp.float32)
+    if with_aux:
+        return logits, aux_sum
     return logits
 
 
@@ -265,14 +323,15 @@ def loss_fn(params: dict, tokens: jax.Array, targets: jax.Array,
     reduction instead of materializing a second [B, L, V] log-softmax
     array in HBM (the [B, L, V] f32 logits alone are ~2 GiB at the bench
     shape — HBM bandwidth, not FLOPs, dominates this tail).
+
+    MoE configs add the router load-balancing loss scaled by
+    ``moe_aux_loss_coef``.
     """
-    logits = forward(params, tokens, config, positions)
-    lse = jax.nn.logsumexp(logits, axis=-1)
-    picked = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    nll = lse - picked
-    if mask is not None:
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-    return jnp.mean(nll)
+    logits, aux = forward(params, tokens, config, positions, with_aux=True)
+    ce = cross_entropy(logits, targets, mask)
+    if config.num_experts > 0:
+        return ce + config.moe_aux_loss_coef * aux
+    return ce
 
 
 # ------------------------------------------------------- KV-cache inference
@@ -344,6 +403,9 @@ def forward_with_cache(params: dict, tokens: jax.Array, cache: dict,
     decode step, T=prompt_len for prefill). Returns (logits [B, T, V] f32,
     updated cache). Same-shape calls hit the jit cache.
     """
+    if config.num_experts > 0:
+        raise NotImplementedError(
+            "KV-cache decoding for MoE configs is not implemented yet")
     x = params["embed"]["tokens"].astype(config.dtype)[tokens]
 
     def layer_step(x, layer_and_cache):
@@ -363,8 +425,22 @@ def forward_with_cache(params: dict, tokens: jax.Array, cache: dict,
 
 
 def flops_per_token(config: LlamaConfig, seq_len: int | None = None) -> float:
-    """6 * params (fwd+bwd) + attention term — standard MFU accounting."""
+    """6 * active params (fwd+bwd) + attention term — standard MFU
+    accounting. Uses num_active_params so top-1 MoE doesn't count the
+    experts a token never touches."""
     seq = seq_len if seq_len is not None else config.max_seq_len
     attn_flops = (12 * config.num_layers * config.num_heads
                   * config.head_dim * seq)
-    return 6.0 * config.num_params + attn_flops
+    return 6.0 * config.num_active_params + attn_flops
+
+
+def cross_entropy(logits: jax.Array, targets: jax.Array,
+                  mask: jax.Array | None = None) -> jax.Array:
+    """Fused mean next-token CE: logsumexp(logits) - logits[target]
+    (no second [B, L, V] log-softmax materialized — see loss_fn)."""
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = lse - picked
+    if mask is not None:
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.mean(nll)

@@ -1,0 +1,96 @@
+"""Mixture-of-Experts SwiGLU layer with expert parallelism.
+
+No reference implementation exists (SURVEY §2.4: EP absent from Ray) —
+built natively for the ``ep`` mesh axis. Design (Mesh-TensorFlow-style
+einsum dispatch, the canonical GSPMD MoE formulation):
+
+- top-1 router with capacity ``C = capacity_factor * T / E``; tokens
+  over capacity are dropped (residual connection carries them through);
+- dispatch/combine tensors [B, T, E, C] turn routing into einsums, so
+  with experts sharded over ``ep`` (logical axis "expert") and batch
+  over dp, XLA lowers token movement to all-to-alls over ICI;
+- load-balancing auxiliary loss (mean fraction x mean router prob per
+  expert, scaled by E) keeps the router from collapsing.
+
+Params per MoE layer (leading E = expert dim, logical "expert" -> ep):
+  w_router [H, E]; w_gate/w_up [E, H, M]; w_down [E, M, H].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def init_moe_params(key: jax.Array, hidden: int, mlp: int,
+                    num_experts: int, num_layers: int) -> dict:
+    keys = jax.random.split(key, 4)
+
+    def dense(k, fan_in, *shape):
+        return jax.random.normal(k, shape, dtype=jnp.float32) * fan_in ** -0.5
+
+    return {
+        "w_router": dense(keys[0], hidden, num_layers, hidden, num_experts),
+        "w_gate": dense(keys[1], hidden, num_layers, num_experts, hidden, mlp),
+        "w_up": dense(keys[2], hidden, num_layers, num_experts, hidden, mlp),
+        "w_down": dense(keys[3], mlp, num_layers, num_experts, mlp, hidden),
+    }
+
+
+def moe_logical_axes() -> dict:
+    """Leading scan (layer) dim = None; expert dim -> ep via rules."""
+    return {
+        "w_router": (None, "embed", None),
+        "w_gate": (None, "expert", "embed", "mlp"),
+        "w_up": (None, "expert", "embed", "mlp"),
+        "w_down": (None, "expert", "mlp", "embed"),
+    }
+
+
+def moe_mlp(layer: dict, x: jax.Array, *, capacity_factor: float = 1.25,
+            dtype=jnp.bfloat16) -> tuple[jax.Array, jax.Array]:
+    """Top-1 MoE SwiGLU: x [B, T, H] -> (out [B, T, H], aux_loss scalar).
+
+    ``layer`` holds one layer's slice: w_router [H, E],
+    w_gate/w_up [E, H, M], w_down [E, M, H].
+    """
+    b, t, h = x.shape
+    num_experts = layer["w_router"].shape[-1]
+    capacity = max(1, int(capacity_factor * t / num_experts))
+
+    # Router (f32 for a stable softmax).
+    logits = jnp.einsum("bth,he->bte", x.astype(jnp.float32),
+                        layer["w_router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)            # [B, T, E]
+    gate = jnp.max(probs, axis=-1)                     # [B, T]
+    expert_idx = jnp.argmax(probs, axis=-1)            # [B, T]
+    onehot = jax.nn.one_hot(expert_idx, num_experts, dtype=jnp.float32)
+
+    # Load-balancing aux loss (Switch Transformer eq. 4).
+    fraction = jnp.mean(onehot, axis=1)                # [B, E]
+    mean_prob = jnp.mean(probs, axis=1)                # [B, E]
+    aux_loss = num_experts * jnp.mean(
+        jnp.sum(fraction * mean_prob, axis=-1))
+
+    # Position of each token within its expert (per batch row); tokens
+    # past the capacity are dropped (the residual stream carries them).
+    position = jnp.cumsum(onehot, axis=1) * onehot     # [B, T, E], 1-based
+    keep = (position > 0) & (position <= capacity)
+    pos_onehot = jax.nn.one_hot((position - 1).astype(jnp.int32), capacity,
+                                dtype=jnp.float32)     # [B, T, E, C]
+    dispatch = pos_onehot * keep.astype(jnp.float32)[..., None]
+    combine = dispatch * gate[..., None, None]
+
+    # Dispatch: [B,T,E,C] x [B,T,H] -> [E, B, C, H] (all-to-all under ep).
+    expert_in = jnp.einsum("btec,bth->ebch", dispatch.astype(dtype),
+                           x.astype(dtype))
+    gate_h = jnp.einsum("ebch,ehm->ebcm", expert_in,
+                        layer["w_gate"].astype(dtype))
+    up_h = jnp.einsum("ebch,ehm->ebcm", expert_in,
+                      layer["w_up"].astype(dtype))
+    hidden = jax.nn.silu(gate_h) * up_h
+    expert_out = jnp.einsum("ebcm,emh->ebch", hidden,
+                            layer["w_down"].astype(dtype))
+    # Combine back: weighted un-dispatch (second all-to-all).
+    out = jnp.einsum("btec,ebch->bth", combine.astype(dtype), expert_out)
+    return out.astype(x.dtype), aux_loss

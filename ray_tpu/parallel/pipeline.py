@@ -1,0 +1,172 @@
+"""Pipeline parallelism: GPipe-style microbatched stage schedule.
+
+No reference implementation exists (SURVEY §2.4: Ray delegates PP to
+frameworks) — built natively, like ring attention. Design:
+
+- Stage parameters carry a leading ``[num_stages, ...]`` dim sharded
+  over the mesh's ``pp`` axis (logical axis "stage" in the rule table).
+- ``pipeline_apply`` drops into shard_map over ``pp`` (+ the batch axes)
+  inside the surrounding GSPMD jit. Each device runs ONE stage; the
+  local batch splits into microbatches; at every tick each stage
+  processes one microbatch and hands its activation to the next stage
+  over ICI via ``lax.ppermute`` — the classic GPipe fill/steady/drain
+  schedule with ``num_microbatches + num_stages - 1`` ticks.
+- The tick loop is a ``lax.scan`` (compiler-friendly: one compiled tick
+  body, no Python unrolling) and each stage application is
+  ``jax.checkpoint``-ed so activation memory stays O(microbatch).
+
+Composability: pp composes with dp/fsdp (batch axes in the shard_map
+specs). Run tensor parallelism inside a stage by keeping tp out of the
+shard_map and using a nested mesh — not wired here yet.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def split_stages(stacked: Any, num_stages: int) -> Any:
+    """[L, ...] layer-stacked params -> [S, L/S, ...] stage-stacked."""
+
+    def reshape(x):
+        n = x.shape[0]
+        if n % num_stages:
+            raise ValueError(
+                f"{n} layers not divisible into {num_stages} stages")
+        return x.reshape(num_stages, n // num_stages, *x.shape[1:])
+
+    return jax.tree.map(reshape, stacked)
+
+
+def merge_stages(staged: Any) -> Any:
+    """Inverse of split_stages."""
+    return jax.tree.map(
+        lambda x: x.reshape(x.shape[0] * x.shape[1], *x.shape[2:]), staged)
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   stage_params: Any, x: jax.Array, *,
+                   num_microbatches: int, axis_name: str = "pp",
+                   batch_axes: tuple = ("dp", "fsdp")) -> jax.Array:
+    """Run ``x`` through all pipeline stages; call inside a GSPMD jit
+    with an ambient mesh (jax.set_mesh).
+
+    stage_params: pytree with leading [S, ...] dim (one slice per
+    stage). x: [B, ...] activations; B must divide by num_microbatches
+    on each data shard. Returns activations after the last stage,
+    replicated over pp.
+    """
+    params_spec = jax.tree.map(lambda _: P(axis_name), stage_params)
+    x_spec = P(batch_axes)
+
+    @functools.partial(jax.shard_map,
+                       in_specs=(params_spec, x_spec),
+                       out_specs=x_spec, check_vma=False)
+    def run(local_params, x_local):
+        # Each device must hold exactly ONE stage; if num_stages exceeds
+        # the pp axis size, shard_map would hand every device multiple
+        # stage slices and the squeeze below would silently drop layers.
+        leading = {p.shape[0] for p in jax.tree.leaves(local_params)}
+        if leading != {1}:
+            raise ValueError(
+                f"stage count must equal the {axis_name!r} mesh axis size "
+                f"(got local stage dims {sorted(leading)})")
+        local_params = jax.tree.map(lambda p: p[0], local_params)
+        num_stages = lax.psum(1, axis_name)
+        stage_idx = lax.axis_index(axis_name)
+        batch = x_local.shape[0]
+        if batch % num_microbatches:
+            raise ValueError(
+                f"local batch {batch} not divisible by "
+                f"{num_microbatches} microbatches")
+        mb = batch // num_microbatches
+        xm = x_local.reshape(num_microbatches, mb, *x_local.shape[1:])
+        ticks = num_microbatches + num_stages - 1
+
+        checked_stage = jax.checkpoint(stage_fn, prevent_cse=False)
+        shift_perm = [(i, i + 1) for i in range(num_stages - 1)]
+
+        def tick(carry, t):
+            state, out = carry
+            # Stage 0 ingests microbatch t during the fill/steady phase;
+            # later stages consume what the previous stage shifted in.
+            feed = lax.dynamic_index_in_dim(
+                xm, jnp.minimum(t, num_microbatches - 1), keepdims=False)
+            inp = jnp.where(stage_idx == 0, feed, state)
+            y = checked_stage(local_params, inp)
+            # The last stage completes microbatch j = t - (S - 1).
+            j = t - (num_stages - 1)
+            collected = lax.dynamic_update_index_in_dim(
+                out, y, jnp.maximum(j, 0), axis=0)
+            is_last = stage_idx == num_stages - 1
+            out = jnp.where(jnp.logical_and(is_last, j >= 0), collected, out)
+            # Hand activations down the ring (stage i -> i+1).
+            state = lax.ppermute(y, axis_name, shift_perm)
+            return (state, out), None
+
+        state0 = jnp.zeros_like(xm[0])
+        out0 = jnp.zeros_like(xm)
+        (_, out), _ = lax.scan(tick, (state0, out0), jnp.arange(ticks))
+        # Only the last stage holds real outputs (zeros elsewhere): psum
+        # replicates the result across the pp ring.
+        out = lax.psum(out, axis_name)
+        return out.reshape(batch, *x_local.shape[1:])
+
+    return run(stage_params, x)
+
+
+def llama_pipeline_forward(params: dict, tokens: jax.Array, config,
+                           num_stages: int, num_microbatches: int,
+                           positions: jax.Array | None = None) -> jax.Array:
+    """Llama forward with the layer stack pipelined over ``pp``.
+
+    Embedding and the LM head run outside the pipeline (replicated over
+    pp, sharded per the usual rules); the transformer stack is split
+    into ``num_stages`` stages of consecutive layers.
+
+    Reference capability: none (Ray has no model execution); the
+    architecture mirrors scan-over-layers Llama (models/llama.py) with
+    the scan split per stage.
+    """
+    import dataclasses
+
+    from ray_tpu.models import llama as llama_mod
+
+    if positions is not None:
+        raise NotImplementedError(
+            "pipelined forward assumes contiguous positions (computed "
+            "inside each stage — shard_map bodies must not close over "
+            "traced arrays)")
+    if config.num_experts > 0:
+        raise NotImplementedError(
+            "pipelined forward does not support MoE configs yet (the "
+            "stage body applies the dense MLP and cannot surface the "
+            "router aux loss)")
+    cfg = dataclasses.replace(config, remat=False)  # remat per stage here
+    x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]
+    staged = split_stages(params["layers"], num_stages)
+
+    def stage_fn(stage_layers, h):
+        mb, l = h.shape[0], h.shape[1]
+        pos = jnp.broadcast_to(jnp.arange(l), (mb, l))
+
+        def layer_step(h, layer):
+            h = llama_mod._attention_block(layer, h, pos, cfg)
+            h = llama_mod._mlp_block(layer, h, cfg)
+            return h, None
+
+        h, _ = lax.scan(layer_step, h, stage_layers)
+        return h
+
+    x = pipeline_apply(stage_fn, staged, x,
+                       num_microbatches=num_microbatches)
+    x = llama_mod.rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return jnp.einsum("ble,ev->blv", x,
+                      params["lm_head"].astype(cfg.dtype),
+                      preferred_element_type=jnp.float32)
