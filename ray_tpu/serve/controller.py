@@ -215,7 +215,9 @@ class ServeController:
         for state in states:
             cfg = state.deployment_config.autoscaling_config
             refs = []
-            for replica in state.replicas:
+            with self._lock:
+                replicas = list(state.replicas)
+            for replica in replicas:
                 try:
                     refs.append(replica.handle.get_metrics.remote())
                 except Exception:  # noqa: BLE001
@@ -227,7 +229,7 @@ class ServeController:
                         "num_ongoing_requests"]
                 except Exception:  # noqa: BLE001 — dead replica
                     pass
-            current = len(state.replicas)
+            current = len(replicas)
             desired = cfg.desired_replicas(total_ongoing, current)
             now = time.monotonic()
             delay = (cfg.upscale_delay_s if desired > current
@@ -252,7 +254,9 @@ class ServeController:
         for state in states:
             timeout_s = state.deployment_config.health_check_timeout_s
             dead = []
-            for replica in state.replicas:
+            with self._lock:
+                replicas = list(state.replicas)
+            for replica in replicas:
                 if replica.probe is None:
                     try:
                         replica.probe = (

@@ -90,7 +90,7 @@ class LLMServer:
     def _decode_step(self):
         config = self.config
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=(1,))
         def step(params, cache, tokens, positions, key, temperature):
             # tokens [B, 1]; positions [B, 1]; returns next token per row.
             logits, cache = llama.forward_with_cache(
@@ -108,7 +108,7 @@ class LLMServer:
     def _prefill(self):
         config = self.config
 
-        @jax.jit
+        @functools.partial(jax.jit, donate_argnums=(1,))
         def prefill(params, cache, tokens, positions, last_idx, slot):
             # tokens [1, T] into cache rows [slot]; ``last_idx`` is the
             # index of the last REAL prompt token (T includes bucket
@@ -170,7 +170,10 @@ class LLMServer:
             except Exception as exc:  # noqa: BLE001 — surface to caller
                 req.error = exc
                 req.done.set()
-                continue
+                # The cache buffer was donated to the failed call and may
+                # be invalid — drop every in-flight request and rebuild.
+                self._reset_after_failure(exc)
+                break
             # position = next unwritten cache slot; the first generated
             # token (prefill's prediction) is written there by the first
             # decode step.
@@ -188,6 +191,21 @@ class LLMServer:
             slot.request.done.set()
         slot.request = None
         slot.remaining = 0
+
+    def _reset_after_failure(self, exc: Exception) -> None:
+        """Fail all in-flight requests and rebuild the KV cache.
+
+        Decode/prefill donate the cache buffer (donate_argnums), so after
+        a failed call the old cache is gone along with every active
+        slot's KV state — surface the error to the affected callers and
+        start fresh rather than killing the engine thread (ADVICE r1).
+        """
+        for slot in self.slots:
+            if slot.request is not None:
+                slot.request.error = exc
+            self._finish(slot)
+        self.cache = llama.init_kv_cache(
+            self.config, self.max_batch, self.max_len)
 
     def _engine_loop(self) -> None:
         while not self._shutdown.is_set():
@@ -208,10 +226,14 @@ class LLMServer:
                 positions[i, 0] = slot.position
                 temps[i] = slot.request.temperature
             self._key, sub = jax.random.split(self._key)
-            nxt, self.cache = self._decode_step(
-                self.params, self.cache, jnp.asarray(tokens),
-                jnp.asarray(positions), sub, jnp.asarray(temps))
-            nxt = np.asarray(nxt)
+            try:
+                nxt, self.cache = self._decode_step(
+                    self.params, self.cache, jnp.asarray(tokens),
+                    jnp.asarray(positions), sub, jnp.asarray(temps))
+                nxt = np.asarray(nxt)
+            except Exception as exc:  # noqa: BLE001 — keep engine alive
+                self._reset_after_failure(exc)
+                continue
             for i in active:
                 slot = self.slots[i]
                 slot.request.output.append(int(nxt[i]))

@@ -45,6 +45,11 @@ class DeploymentResponse:
 
         deadline = (time.monotonic() + timeout_s
                     if timeout_s is not None else None)
+        # Without a deadline, bound the backpressure retries so a
+        # permanently saturated deployment surfaces BackPressureError
+        # instead of livelocking the caller (ADVICE r1).
+        retries_left = 100 if deadline is None else None
+        backoff_s = 0.01
         while True:
             try:
                 value = ray_tpu.get(self._ref, timeout=timeout_s)
@@ -59,7 +64,16 @@ class DeploymentResponse:
                 if not retriable or (deadline is not None
                                      and time.monotonic() > deadline):
                     raise
-                time.sleep(0.01)
+                if retries_left is not None:
+                    retries_left -= 1
+                    if retries_left <= 0:
+                        raise
+                sleep_s = backoff_s
+                if deadline is not None:
+                    sleep_s = min(sleep_s, max(0.0,
+                                               deadline - time.monotonic()))
+                time.sleep(sleep_s)
+                backoff_s = min(backoff_s * 2, 1.0)
                 idx, handle = self._router._pick()
                 self._replica_idx = idx
                 self._ref = handle.handle_request.remote(*self._request)

@@ -281,3 +281,38 @@ def test_llm_decode_matches_full_forward():
     # to agree almost always.
     agree = sum(a == b for a, b in zip(out, expected))
     assert agree >= 5, f"cache {out} vs full {expected}"
+
+
+def test_llm_engine_survives_decode_failure():
+    """A transient decode error fails in-flight requests with the error
+    but leaves the engine alive for subsequent requests (ADVICE r1)."""
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm import LLMServer
+
+    server = LLMServer(llama.LlamaConfig.tiny(), max_batch_size=2,
+                       max_seq_len=64)
+    # Warm path works.
+    out = server({"tokens": [1, 2, 3], "max_new_tokens": 2})["tokens"]
+    assert len(out) == 2
+
+    # Inject a one-shot failure into the jitted decode step.
+    real_step = server._decode_step
+    calls = {"n": 0}
+
+    def flaky_step(*args, **kwargs):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("transient XLA failure")
+        return real_step(*args, **kwargs)
+
+    server.__dict__["_decode_step"] = flaky_step
+    try:
+        server({"tokens": [4, 5], "max_new_tokens": 4})
+        raise AssertionError("expected the injected failure to surface")
+    except RuntimeError as exc:
+        assert "transient" in str(exc)
+
+    # Engine thread is still alive and serves new requests.
+    assert server._loop_thread.is_alive()
+    out = server({"tokens": [6, 7, 8], "max_new_tokens": 3})["tokens"]
+    assert len(out) == 3
