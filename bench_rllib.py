@@ -1,0 +1,118 @@
+"""RLlib throughput benchmarks.
+
+Measures env-steps/sec against BASELINE.md's 1M env-steps/sec north
+star (reference: rllib's IMPALA throughput on CPU rollout fleets):
+
+1. raw sampling throughput — N process-isolated env-runner actors
+   (``.options(process=True)``: real OS processes, so the fleet scales
+   past one GIL) each stepping a vectorized CartPole;
+2. IMPALA end-to-end — async sample + V-trace learner updates + weight
+   broadcast, measured as env-steps consumed by the learner per second.
+
+Run: python bench_rllib.py [num_runners]  (CPU-only)
+Prints one JSON line per metric (same format as bench_core.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("RAY_TPU_SKIP_TPU_DETECTION", "1")
+
+import numpy as np
+
+import ray_tpu
+
+
+def bench_raw_sampling(num_runners: int, num_envs: int = 64,
+                       fragment: int = 200, rounds: int = 5) -> dict:
+    from ray_tpu.rllib import RLModuleSpec, SingleAgentEnvRunner
+
+    spec = RLModuleSpec(observation_size=4, num_actions=2,
+                        model_config={"hidden": (64, 64)})
+    module = spec.build()
+    import jax
+
+    weights = module.init(jax.random.PRNGKey(0))
+
+    RemoteRunner = ray_tpu.remote(SingleAgentEnvRunner).options(
+        process=True)
+    runners = [
+        RemoteRunner.remote(
+            env_id="CartPole-v1", module_spec=spec, num_envs=num_envs,
+            rollout_fragment_length=fragment, seed=i, worker_index=i)
+        for i in range(num_runners)]
+    ref = ray_tpu.put(weights)
+    ray_tpu.get([r.set_weights.remote(ref, 0) for r in runners])
+    # Warmup (jit compile in each worker process).
+    ray_tpu.get([r.sample.remote(8) for r in runners])
+
+    start = time.perf_counter()
+    total_steps = 0
+    for _ in range(rounds):
+        batches = ray_tpu.get([r.sample.remote() for r in runners])
+        for b in batches:
+            T, B = np.shape(b["rewards"])
+            total_steps += T * B
+    elapsed = time.perf_counter() - start
+    for r in runners:
+        ray_tpu.kill(r)
+    return {"metric": "rllib_sampling_env_steps_per_s",
+            "value": round(total_steps / elapsed, 1),
+            "unit": "steps/s",
+            "detail": {"num_runners": num_runners, "num_envs": num_envs,
+                       "fragment": fragment}}
+
+
+def bench_impala_e2e(num_runners: int, num_envs: int = 64,
+                     fragment: int = 50, iters: int = 8) -> dict:
+    from ray_tpu.rllib import IMPALAConfig
+
+    config = (IMPALAConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=num_runners,
+                           num_envs_per_env_runner=num_envs,
+                           rollout_fragment_length=fragment)
+              .training(num_batches_per_step=4))
+    algo = config.build()
+    algo.train()  # warmup: compile policy + learner
+    start = time.perf_counter()
+    trained = 0
+    for _ in range(iters):
+        result = algo.train()
+        trained += result["num_env_steps_trained"]
+    elapsed = time.perf_counter() - start
+    algo.cleanup()
+    return {"metric": "rllib_impala_env_steps_per_s",
+            "value": round(trained / elapsed, 1),
+            "unit": "steps/s",
+            "detail": {"num_runners": num_runners, "num_envs": num_envs,
+                       "fragment": fragment}}
+
+
+def main() -> None:
+    num_runners = int(sys.argv[1]) if len(sys.argv) > 1 else min(
+        8, max(2, (os.cpu_count() or 4) - 2))
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=max(num_runners + 2, os.cpu_count() or 4))
+
+    results = [
+        bench_raw_sampling(num_runners),
+        bench_impala_e2e(num_runners),
+    ]
+    for r in results:
+        r["detail"]["host_cpus"] = os.cpu_count()
+        print(json.dumps(r), flush=True)
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_RLLIB.json"), "w") as f:
+        for r in results:
+            f.write(json.dumps(r) + "\n")
+
+    ray_tpu.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -8,9 +8,15 @@ instead of DDP wrappers for multi-device learners.
 """
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rllib.algorithms.multi_agent_ppo import (
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.core.learner import JaxLearner, Learner, compute_gae
 from ray_tpu.rllib.core.learner_group import LearnerGroup
 from ray_tpu.rllib.core.rl_module import (
@@ -18,9 +24,21 @@ from ray_tpu.rllib.core.rl_module import (
     RLModule,
     RLModuleSpec,
 )
+from ray_tpu.rllib.core.multi_rl_module import (
+    MultiRLModule,
+    MultiRLModuleSpec,
+)
 from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.env.multi_agent_env import (
+    IndependentMultiAgentEnv,
+    MultiAgentVectorEnv,
+    make_multi_agent,
+    register_multi_agent_env,
+)
+from ray_tpu.rllib.env.multi_agent_env_runner import MultiAgentEnvRunner
 from ray_tpu.rllib.env.vector_env import (
     CartPoleVectorEnv,
+    PendulumVectorEnv,
     VectorEnv,
     make_vector_env,
     register_env,
@@ -33,6 +51,8 @@ from ray_tpu.rllib.utils.replay_buffers import (
 from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
 
 __all__ = [
+    "APPO",
+    "APPOConfig",
     "Algorithm",
     "AlgorithmConfig",
     "CartPoleVectorEnv",
@@ -43,19 +63,31 @@ __all__ = [
     "FaultTolerantActorManager",
     "IMPALA",
     "IMPALAConfig",
+    "IndependentMultiAgentEnv",
     "JaxLearner",
     "Learner",
     "LearnerGroup",
+    "MultiAgentEnvRunner",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
+    "MultiAgentVectorEnv",
+    "MultiRLModule",
+    "MultiRLModuleSpec",
     "PPO",
     "PPOConfig",
+    "PendulumVectorEnv",
     "PrioritizedReplayBuffer",
     "RLModule",
     "RLModuleSpec",
     "ReplayBuffer",
+    "SAC",
+    "SACConfig",
     "SampleBatch",
     "SingleAgentEnvRunner",
     "VectorEnv",
     "compute_gae",
+    "make_multi_agent",
     "make_vector_env",
     "register_env",
+    "register_multi_agent_env",
 ]

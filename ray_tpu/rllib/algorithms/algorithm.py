@@ -132,11 +132,16 @@ class AlgorithmConfig:
 
     def module_spec(self) -> RLModuleSpec:
         probe = make_vector_env(self.env, 1)
+        model_config = dict(self.model_config)
+        if getattr(probe, "action_size", 0):
+            model_config.setdefault(
+                "action_scale", getattr(probe, "action_scale", 1.0))
         return RLModuleSpec(
             module_class=self.module_class or DefaultActorCriticModule,
             observation_size=probe.observation_size,
             num_actions=probe.num_actions,
-            model_config=dict(self.model_config))
+            action_size=getattr(probe, "action_size", 0),
+            model_config=model_config)
 
     def build(self) -> "Algorithm":
         assert self.algo_class is not None

@@ -1,0 +1,139 @@
+"""APPO — Asynchronous PPO (IMPALA architecture + clipped surrogate).
+
+Reference: rllib/algorithms/appo/appo.py (APPO extends IMPALA; config
+adds use_kl_loss/clip_param/target-network) and
+appo/torch/appo_torch_learner.py (loss: V-trace advantages fed into the
+PPO clip objective, plus a KL term against the TARGET policy — the
+slow-moving network that generated... is periodically snapshotted from
+the online one).
+
+TPU shape: inherits IMPALA's async sampling/queue loop unchanged; the
+loss swap and the target-params snapshot are the only deltas. Target
+params ride inside the batch (same trick as DQN) so the jitted update
+stays pure.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.algorithms.impala import (
+    IMPALA,
+    IMPALAConfig,
+    IMPALALearner,
+    vtrace,
+)
+from ray_tpu.rllib.core.rl_module import (
+    categorical_entropy,
+    categorical_kl,
+    categorical_logp,
+)
+from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.clip_param = 0.4
+        self.use_kl_loss = True
+        self.kl_coeff = 1.0
+        self.kl_target = 0.01
+        # Learner steps between target-network snapshots (reference:
+        # appo.py target_network_update_freq, counted in env steps there).
+        self.target_update_frequency = 4
+
+    def learner_class(self):
+        return APPOLearner
+
+
+class APPOLearner(IMPALALearner):
+    """Clipped-surrogate + V-trace loss with target-policy KL."""
+
+    def __init__(self, module_spec, config=None, mesh=None):
+        super().__init__(module_spec, config, mesh)
+        self.target_params = jax.tree_util.tree_map(
+            jnp.copy, self.params)
+        # Adaptive KL coefficient (host-side state, like the reference's
+        # kl_coeff update in appo_torch_learner.py).
+        self.kl_coeff = float(getattr(config, "kl_coeff", 1.0))
+
+    def compute_loss(self, params, batch, rng):
+        cfg = self.config
+        T, B = batch[Columns.REWARDS].shape
+        flat = {"obs": batch[Columns.OBS].reshape(
+            (T * B,) + batch[Columns.OBS].shape[2:])}
+        out = self.module.forward_train(params, flat, rng)
+        logits = out["action_logits"].reshape(T, B, -1)
+        values = out["vf_preds"].reshape(T, B)
+
+        target_out = self.module.forward_train(
+            batch["target_params"], flat, rng)
+        target_logits = jax.lax.stop_gradient(
+            target_out["action_logits"].reshape(T, B, -1))
+
+        target_logp = categorical_logp(logits, batch[Columns.ACTIONS])
+        behavior_logp = batch[Columns.ACTION_LOGP]
+        vs, pg_adv = vtrace(
+            behavior_logp, jax.lax.stop_gradient(target_logp),
+            batch[Columns.REWARDS], jax.lax.stop_gradient(values),
+            batch["bootstrap_value"], batch[Columns.TERMINATEDS],
+            batch[Columns.TRUNCATEDS], cfg.gamma,
+            cfg.clip_rho_threshold, cfg.clip_c_threshold)
+
+        ratio = jnp.exp(target_logp - behavior_logp)
+        surrogate = jnp.minimum(
+            pg_adv * ratio,
+            pg_adv * jnp.clip(ratio, 1 - cfg.clip_param,
+                              1 + cfg.clip_param))
+        pg_loss = -jnp.mean(surrogate)
+        vf_loss = 0.5 * jnp.mean(jnp.square(values - vs))
+        entropy = jnp.mean(categorical_entropy(logits))
+        kl = jnp.mean(categorical_kl(target_logits, logits))
+
+        total = (pg_loss + cfg.vf_loss_coeff * vf_loss
+                 - cfg.entropy_coeff * entropy)
+        if getattr(cfg, "use_kl_loss", True):
+            total = total + batch["kl_coeff"] * kl
+        return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy, "kl": kl}
+
+    def update_from_batch(self, batch: SampleBatch) -> dict:
+        batch = SampleBatch(batch)
+        batch["target_params"] = self.target_params
+        batch["kl_coeff"] = jnp.asarray(self.kl_coeff, dtype=jnp.float32)
+        metrics = super().update_from_batch(batch)
+        # Adaptive KL coefficient (reference: appo_torch_learner.py
+        # after_gradient_based_update).
+        cfg = self.config
+        kl = metrics.get("kl", 0.0)
+        if kl > 2.0 * cfg.kl_target:
+            self.kl_coeff *= 1.5
+        elif kl < 0.5 * cfg.kl_target:
+            self.kl_coeff *= 0.5
+        metrics["kl_coeff"] = self.kl_coeff
+        # Periodic target snapshot.
+        if self._steps % getattr(cfg, "target_update_frequency", 4) == 0:
+            self.target_params = jax.tree_util.tree_map(
+                jnp.copy, self.params)
+        return metrics
+
+    def get_state(self) -> dict:
+        state = super().get_state()
+        state["target_params"] = jax.device_get(self.target_params)
+        state["kl_coeff"] = self.kl_coeff
+        return state
+
+    def set_state(self, state: dict) -> None:
+        super().set_state(state)
+        if "target_params" in state:
+            self.target_params = jax.tree_util.tree_map(
+                jnp.asarray, state["target_params"])
+        self.kl_coeff = state.get("kl_coeff", self.kl_coeff)
+
+
+class APPO(IMPALA):
+    config_class = APPOConfig
+
+
+APPOConfig.algo_class = APPO

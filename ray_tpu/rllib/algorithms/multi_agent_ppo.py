@@ -1,0 +1,224 @@
+"""Multi-agent PPO — independent-learner PPO over a MultiAgentEnv.
+
+Reference: rllib's multi-agent support lives in the config
+(`config.multi_agent(policies=..., policy_mapping_fn=...)`,
+algorithm_config.py) + MultiAgentEnvRunner + MultiRLModule; PPO itself
+is agent-count agnostic. Same factoring here: one PPOLearner per
+policy, fragments arrive pre-grouped per policy from the runner
+(multi_agent_env_runner.py), and each policy runs the standard PPO
+minibatch loop on its own [T*K*B] batch.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.ppo import (
+    PPOConfig,
+    PPOLearner,
+    postprocess_fragment,
+)
+from ray_tpu.rllib.core.multi_rl_module import MultiRLModuleSpec
+from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.env.multi_agent_env import make_multi_agent_env
+from ray_tpu.rllib.env.multi_agent_env_runner import MultiAgentEnvRunner
+from ray_tpu.rllib.utils.actor_manager import FaultTolerantActorManager
+from ray_tpu.rllib.utils.sample_batch import SampleBatch
+
+
+class MultiAgentPPOConfig(PPOConfig):
+    def __init__(self):
+        super().__init__()
+        self.num_agents = 2
+        self.policies: tuple = ("shared",)
+        self.policy_mapping_fn: Callable[[str], str] = (
+            lambda aid: "shared")
+        self.policy_model_configs: dict = {}
+
+    def multi_agent(self, *, num_agents: int | None = None,
+                    policies: tuple | list | None = None,
+                    policy_mapping_fn: Callable | None = None,
+                    policy_model_configs: dict | None = None,
+                    ) -> "MultiAgentPPOConfig":
+        """Reference: AlgorithmConfig.multi_agent (algorithm_config.py)."""
+        if num_agents is not None:
+            self.num_agents = num_agents
+        if policies is not None:
+            self.policies = tuple(policies)
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
+        if policy_model_configs is not None:
+            self.policy_model_configs = dict(policy_model_configs)
+        return self
+
+    def learner_class(self):
+        return PPOLearner
+
+    def marl_spec(self) -> MultiRLModuleSpec:
+        probe = make_multi_agent_env(self.env, self.num_agents, 1)
+        specs = {}
+        for aid in probe.agent_ids:
+            pid = self.policy_mapping_fn(aid)
+            if pid in specs:
+                continue
+            specs[pid] = RLModuleSpec(
+                module_class=self.module_class,
+                observation_size=probe.observation_size(aid),
+                num_actions=probe.num_actions(aid),
+                action_size=probe.action_size(aid),
+                model_config=dict(self.policy_model_configs.get(
+                    pid, self.model_config)))
+        # Policies declared but mapped to no agent still get modules
+        # (reference allows training them via custom mapping later).
+        for pid in self.policies:
+            if pid not in specs and probe.agent_ids:
+                aid = probe.agent_ids[0]
+                specs[pid] = RLModuleSpec(
+                    module_class=self.module_class,
+                    observation_size=probe.observation_size(aid),
+                    num_actions=probe.num_actions(aid),
+                    action_size=probe.action_size(aid),
+                    model_config=dict(self.policy_model_configs.get(
+                        pid, self.model_config)))
+        return MultiRLModuleSpec(module_specs=specs)
+
+
+class MultiAgentPPO(Algorithm):
+    config_class = MultiAgentPPOConfig
+
+    def setup(self, config: dict) -> None:
+        from ray_tpu.rllib.core.learner_group import LearnerGroup
+
+        cfg = self.algo_config
+        if cfg.num_learners > 0:
+            raise ValueError(
+                "MultiAgentPPO runs one local learner per policy; "
+                "num_learners > 0 is not supported. Scale the update "
+                "over devices with num_devices_per_learner instead "
+                "(GSPMD shards each policy's batch over the mesh).")
+        self.marl_spec = cfg.marl_spec()
+        learner_cls = cfg.learner_class()
+        mesh = LearnerGroup._build_local_mesh(cfg.num_devices_per_learner)
+        self.learners = {
+            pid: learner_cls(spec, config=cfg, mesh=mesh)
+            for pid, spec in self.marl_spec.module_specs.items()}
+        self.env_runner_group = self._build_env_runners(cfg)
+        self._sync_weights()
+
+    def _build_env_runners(self, cfg):
+        kwargs = dict(
+            env_id=cfg.env, marl_spec=self.marl_spec,
+            policy_mapping_fn=cfg.policy_mapping_fn,
+            num_agents=cfg.num_agents,
+            num_envs=cfg.num_envs_per_env_runner,
+            rollout_fragment_length=cfg.rollout_fragment_length,
+            seed=cfg.seed, explore=cfg.explore)
+        if cfg.num_env_runners <= 0:
+            self.local_env_runner = MultiAgentEnvRunner(
+                worker_index=0, **kwargs)
+            return None
+        RemoteRunner = ray_tpu.remote(MultiAgentEnvRunner)
+
+        def factory(idx: int):
+            return RemoteRunner.remote(worker_index=idx + 1, **kwargs)
+
+        actors = [factory(i) for i in range(cfg.num_env_runners)]
+        self.local_env_runner = None
+        return FaultTolerantActorManager(actors, actor_factory=factory)
+
+    def _sync_weights(self) -> None:
+        weights = {pid: lrn.get_weights()
+                   for pid, lrn in self.learners.items()}
+        self._weights_version += 1
+        if self.env_runner_group is None:
+            self.local_env_runner.set_weights(
+                weights, self._weights_version)
+        else:
+            ref = ray_tpu.put(weights)
+            self.env_runner_group.foreach_actor(
+                "set_weights", ref, self._weights_version)
+
+    def _sample_fragments(self) -> list[dict]:
+        if self.env_runner_group is None:
+            frags = [self.local_env_runner.sample()]
+        else:
+            frags = self.env_runner_group.foreach_actor("sample")
+        for frag in frags:
+            for batch in frag.values():
+                T, B = np.shape(batch["rewards"])[:2]
+                self._timesteps_total += T * B
+        return frags
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        fragments = self._sample_fragments()
+
+        results: dict = {}
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        for pid, learner in self.learners.items():
+            per_policy = [frag[pid] for frag in fragments if pid in frag]
+            if not per_policy:
+                continue
+            train_batch = SampleBatch.concat(
+                [postprocess_fragment(f, cfg.gamma, cfg.lambda_)
+                 for f in per_policy])
+            mb = min(cfg.minibatch_size, len(train_batch))
+            metrics: dict = {}
+            for _ in range(cfg.num_epochs):
+                for minibatch in train_batch.minibatches(mb, rng):
+                    metrics = learner.update_from_batch(minibatch)
+            results[pid] = metrics
+        self._sync_weights()
+
+        results.update(self._runner_metrics())
+        return results
+
+    # -- checkpointing ------------------------------------------------
+    def save_checkpoint(self, checkpoint_dir: str):
+        import os
+        import pickle
+
+        state = {
+            "learners": {pid: lrn.get_state()
+                         for pid, lrn in self.learners.items()},
+            "iteration": self.iteration,
+            "timesteps": self._timesteps_total,
+        }
+        with open(os.path.join(checkpoint_dir,
+                               "algorithm_state.pkl"), "wb") as f:
+            pickle.dump(state, f)
+        return checkpoint_dir
+
+    def load_checkpoint(self, checkpoint) -> None:
+        import os
+        import pickle
+
+        path = checkpoint if isinstance(checkpoint, str) else checkpoint.path
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        for pid, lrn_state in state["learners"].items():
+            self.learners[pid].set_state(lrn_state)
+        self.iteration = state["iteration"]
+        self._timesteps_total = state["timesteps"]
+        self._sync_weights()
+
+    # Rebind the Trainable aliases to the multi-agent implementations
+    # (the base class binds `save = Algorithm.save_checkpoint`, which
+    # references self.learner_group — never created here).
+    save = save_checkpoint
+    restore = load_checkpoint
+
+    def cleanup(self) -> None:
+        if self.env_runner_group is not None:
+            for i in self.env_runner_group.healthy_actor_ids():
+                try:
+                    ray_tpu.kill(self.env_runner_group.actor(i))
+                except Exception:  # noqa: BLE001
+                    pass
+
+
+MultiAgentPPOConfig.algo_class = MultiAgentPPO

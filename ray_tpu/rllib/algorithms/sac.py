@@ -1,0 +1,343 @@
+"""SAC — Soft Actor-Critic for continuous control.
+
+Reference: rllib/algorithms/sac/ (sac.py config surface; torch learner
+sac_torch_learner.py computes the three losses — critic, actor,
+alpha — as separate optimizer steps). TPU shape here: ONE jitted
+update computes all three losses and applies all three optimizers plus
+the polyak target update in a single XLA program — no Python between
+them, so the whole SGD step is one device launch.
+
+Components:
+- squashed-Gaussian actor: a = tanh(mu + sigma * eps), with the
+  tanh-Jacobian log-prob correction;
+- twin Q critics (clipped double-Q targets);
+- learnable entropy temperature alpha with target entropy
+  -|action_size| (the "auto" setting of the reference).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import (
+    RLModule,
+    RLModuleSpec,
+    _mlp_apply,
+    _mlp_init,
+)
+from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
+
+LOG_STD_MIN = -20.0
+LOG_STD_MAX = 2.0
+
+
+class SACModule(RLModule):
+    """Squashed-Gaussian policy + twin Q networks.
+
+    Actions are squashed to the env's symmetric box
+    [-action_scale, action_scale]^d (reference: rllib's SquashedGaussian
+    distribution scales tanh output to the action-space bounds).
+    """
+
+    def __init__(self, observation_size: int, num_actions: int = 0,
+                 action_size: int = 1, hidden: tuple = (256, 256),
+                 action_scale: float = 1.0, **_):
+        assert num_actions == 0, "SAC is continuous-control only"
+        self.observation_size = observation_size
+        self.action_size = action_size
+        self.hidden = tuple(hidden)
+        self.action_scale = float(action_scale)
+
+    def init(self, rng):
+        pi_rng, q1_rng, q2_rng = jax.random.split(rng, 3)
+        obs, act, h = self.observation_size, self.action_size, self.hidden
+        return {
+            # Actor trunk emits [mu, log_std] stacked.
+            "pi": _mlp_init(pi_rng, (obs,) + h + (2 * act,)),
+            "q1": _mlp_init(q1_rng, (obs + act,) + h + (1,)),
+            "q2": _mlp_init(q2_rng, (obs + act,) + h + (1,)),
+        }
+
+    # -- policy ------------------------------------------------------
+    def _mu_logstd(self, params, obs):
+        out = _mlp_apply(params["pi"], obs)
+        mu, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+        return mu, log_std
+
+    def sample_action(self, params, obs, rng):
+        """-> (action in [-s, s]^d, log-prob) with tanh correction.
+
+        Actions are squashed to the env's symmetric box (s =
+        ``action_scale``, reference: SquashedGaussian scaling to the
+        action-space bounds).
+        """
+        mu, log_std = self._mu_logstd(params, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(rng, mu.shape)
+        pre_tanh = mu + std * eps
+        action = jnp.tanh(pre_tanh) * self.action_scale
+        # N(mu, std) logp minus log|d (s*tanh)/dx|, the numerically
+        # stable form: log(1 - tanh(x)^2) = 2*(log2 - x - softplus(-2x)).
+        gauss_logp = jnp.sum(
+            -0.5 * jnp.square(eps) - log_std
+            - 0.5 * jnp.log(2 * jnp.pi), axis=-1)
+        correction = jnp.sum(
+            2.0 * (jnp.log(2.0) - pre_tanh
+                   - jax.nn.softplus(-2.0 * pre_tanh))
+            + jnp.log(self.action_scale), axis=-1)
+        return action, gauss_logp - correction
+
+    def q_values(self, params, obs, actions):
+        x = jnp.concatenate([obs, actions], axis=-1)
+        return (_mlp_apply(params["q1"], x)[..., 0],
+                _mlp_apply(params["q2"], x)[..., 0])
+
+    # -- RLModule passes ----------------------------------------------
+    def forward_inference(self, params, batch, rng=None):
+        mu, _ = self._mu_logstd(params, batch["obs"])
+        return {"actions": jnp.tanh(mu) * self.action_scale,
+                "action_logits": mu,
+                "action_logp": jnp.zeros(mu.shape[:-1])}
+
+    def forward_exploration(self, params, batch, rng=None):
+        action, logp = self.sample_action(params, batch["obs"], rng)
+        return {"actions": action, "action_logp": logp,
+                "action_logits": action,
+                "vf_preds": jnp.zeros(action.shape[:-1])}
+
+    def forward_train(self, params, batch, rng=None):
+        return {}
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.env = "Pendulum-v1"
+        self.module_class = SACModule
+        self.model_config = {"hidden": (256, 256)}
+        self.lr = 3e-4
+        self.actor_lr = 3e-4
+        self.critic_lr = 3e-4
+        self.alpha_lr = 3e-4
+        self.tau = 0.005                     # polyak coefficient
+        self.initial_alpha = 1.0
+        self.target_entropy = None           # None => -action_size
+        self.buffer_capacity = 100_000
+        self.train_batch_size = 256
+        self.num_steps_sampled_before_learning = 1500
+        self.updates_per_iteration = 64
+
+    def learner_class(self):
+        return SACLearner
+
+
+class SACLearner(Learner):
+    """All-in-one jitted SAC update (reference splits this into three
+    torch optimizer steps in sac_torch_learner.py; here XLA fuses the
+    critic/actor/alpha updates and the polyak into one program)."""
+
+    def __init__(self, module_spec: RLModuleSpec, config=None, mesh=None):
+        super().__init__(module_spec, config, mesh)
+        cfg = self.config
+        self.target_params = jax.tree_util.tree_map(
+            jnp.copy, {"q1": self.params["q1"], "q2": self.params["q2"]})
+        self.log_alpha = jnp.asarray(
+            np.log(getattr(cfg, "initial_alpha", 1.0)), dtype=jnp.float32)
+        self.target_entropy = (
+            cfg.target_entropy if getattr(cfg, "target_entropy", None)
+            is not None else -float(self.module.action_size))
+        self._alpha_opt = optax.adam(getattr(cfg, "alpha_lr", 3e-4))
+        self._alpha_opt_state = self._alpha_opt.init(self.log_alpha)
+        self._sac_update = None
+
+    def configure_optimizer(self):
+        # One optimizer over {pi, q1, q2}: per-leaf learning rates via
+        # masks give actor/critic their own lr like the reference's
+        # separate optimizers.
+        cfg = self.config
+        actor_lr = getattr(cfg, "actor_lr", 3e-4)
+        critic_lr = getattr(cfg, "critic_lr", 3e-4)
+
+        def label_fn(params):
+            return {k: ("actor" if k == "pi" else "critic")
+                    for k in params}
+
+        return optax.multi_transform(
+            {"actor": optax.adam(actor_lr),
+             "critic": optax.adam(critic_lr)}, label_fn)
+
+    def _build_sac_update(self):
+        cfg = self.config
+        gamma = cfg.gamma
+        tau = getattr(cfg, "tau", 0.005)
+        target_entropy = self.target_entropy
+        module = self.module
+
+        def update(params, opt_state, target_params, log_alpha,
+                   alpha_opt_state, batch, rng):
+            next_rng, pi_rng = jax.random.split(rng)
+            alpha = jnp.exp(log_alpha)
+
+            # --- critic loss: clipped double-Q soft target ----------
+            next_a, next_logp = module.sample_action(
+                params, batch[Columns.NEXT_OBS], next_rng)
+            tq1, tq2 = module.q_values(
+                {**params, **target_params},
+                batch[Columns.NEXT_OBS], next_a)
+            q_next = jnp.minimum(tq1, tq2) - alpha * next_logp
+            not_done = 1.0 - batch[Columns.TERMINATEDS].astype(jnp.float32)
+            targets = jax.lax.stop_gradient(
+                batch[Columns.REWARDS] + gamma * not_done * q_next)
+
+            def critic_loss_fn(p):
+                q1, q2 = module.q_values(
+                    p, batch[Columns.OBS], batch[Columns.ACTIONS])
+                return 0.5 * (jnp.mean(jnp.square(q1 - targets))
+                              + jnp.mean(jnp.square(q2 - targets))), (q1,)
+
+            # --- actor loss -----------------------------------------
+            def actor_loss_fn(p):
+                a, logp = module.sample_action(
+                    p, batch[Columns.OBS], pi_rng)
+                q1, q2 = module.q_values(p, batch[Columns.OBS], a)
+                q = jnp.minimum(q1, q2)
+                return jnp.mean(alpha * logp - q), (logp,)
+
+            (critic_loss, (q1_vals,)), critic_grads = jax.value_and_grad(
+                critic_loss_fn, has_aux=True)(params)
+            (actor_loss, (logp,)), actor_grads = jax.value_and_grad(
+                actor_loss_fn, has_aux=True)(params)
+            # Actor gradients flow only into pi; critic grads only into
+            # q1/q2 (actor loss's q-grads must NOT update the critics —
+            # mask them out, mirroring the reference's separate steps).
+            grads = {
+                "pi": actor_grads["pi"],
+                "q1": critic_grads["q1"],
+                "q2": critic_grads["q2"],
+            }
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+
+            # --- alpha loss -----------------------------------------
+            def alpha_loss_fn(la):
+                return -jnp.mean(jnp.exp(la) * jax.lax.stop_gradient(
+                    logp + target_entropy))
+
+            alpha_loss, alpha_grad = jax.value_and_grad(alpha_loss_fn)(
+                log_alpha)
+            alpha_updates, alpha_opt_state = self._alpha_opt.update(
+                alpha_grad, alpha_opt_state, log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, alpha_updates)
+
+            # --- polyak target update -------------------------------
+            target_params = jax.tree_util.tree_map(
+                lambda t, o: (1 - tau) * t + tau * o,
+                target_params, {"q1": params["q1"], "q2": params["q2"]})
+
+            metrics = {
+                "critic_loss": critic_loss,
+                "actor_loss": actor_loss,
+                "alpha_loss": alpha_loss,
+                "alpha": alpha,
+                "q_mean": jnp.mean(q1_vals),
+                "entropy": -jnp.mean(logp),
+            }
+            return (params, opt_state, target_params, log_alpha,
+                    alpha_opt_state, metrics)
+
+        return jax.jit(update)
+
+    def update_from_batch(self, batch: SampleBatch) -> dict:
+        if self._sac_update is None:
+            self._sac_update = self._build_sac_update()
+        self._rng, rng = jax.random.split(self._rng)
+        arrays = self._device_batch(batch)
+        (self.params, self.opt_state, self.target_params, self.log_alpha,
+         self._alpha_opt_state, metrics) = self._sac_update(
+            self.params, self.opt_state, self.target_params,
+            self.log_alpha, self._alpha_opt_state, arrays, rng)
+        self._steps += 1
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_state(self) -> dict:
+        state = super().get_state()
+        state["target_params"] = jax.device_get(self.target_params)
+        state["log_alpha"] = jax.device_get(self.log_alpha)
+        state["alpha_opt_state"] = jax.device_get(self._alpha_opt_state)
+        return state
+
+    def set_state(self, state: dict) -> None:
+        super().set_state(state)
+        if "target_params" in state:
+            self.target_params = state["target_params"]
+        if "log_alpha" in state:
+            self.log_alpha = state["log_alpha"]
+        if "alpha_opt_state" in state:
+            self._alpha_opt_state = state["alpha_opt_state"]
+
+
+class SAC(Algorithm):
+    """Off-policy loop: replay buffer of flat transitions, N jitted
+    updates per iteration (reference: sac.py training_step via the
+    shared DQN-style off-policy skeleton)."""
+
+    config_class = SACConfig
+
+    def setup(self, config: dict) -> None:
+        cfg = self.algo_config
+        if cfg.num_learners > 0:
+            raise ValueError(
+                "SAC's update (twin-Q + actor + alpha + polyak in one "
+                "jitted program) runs on a local learner; num_learners "
+                "> 0 is not supported. Scale over devices with "
+                "num_devices_per_learner (GSPMD shards the batch).")
+        super().setup(config)
+        self.replay = ReplayBuffer(cfg.buffer_capacity, seed=cfg.seed)
+        self._learner_steps = 0
+
+    def _fragment_to_transitions(self, frag: SampleBatch) -> SampleBatch:
+        obs = np.asarray(frag[Columns.OBS])          # [T, B, obs]
+        actions = np.asarray(frag[Columns.ACTIONS])  # [T, B, act]
+        next_obs = obs[1:]
+        keep = ~np.asarray(frag[Columns.TRUNCATEDS])[:-1].reshape(-1)
+        return SampleBatch({
+            Columns.OBS: obs[:-1].reshape((-1,) + obs.shape[2:])[keep],
+            Columns.NEXT_OBS: next_obs.reshape(
+                (-1,) + obs.shape[2:])[keep],
+            Columns.ACTIONS: actions[:-1].reshape(
+                (-1,) + actions.shape[2:])[keep],
+            Columns.REWARDS: np.asarray(
+                frag[Columns.REWARDS])[:-1].reshape(-1)[keep],
+            Columns.TERMINATEDS: np.asarray(
+                frag[Columns.TERMINATEDS])[:-1].reshape(-1)[keep],
+        })
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        for frag in self._sample_fragments():
+            self.replay.add(self._fragment_to_transitions(frag))
+
+        metrics: dict = {}
+        if len(self.replay) >= cfg.num_steps_sampled_before_learning:
+            for _ in range(cfg.updates_per_iteration):
+                batch = self.replay.sample(cfg.train_batch_size)
+                metrics = self.learner_group.update_from_batch(batch)
+                self._learner_steps += 1
+            self._sync_weights()
+
+        results = self._runner_metrics()
+        results.update(metrics)
+        results["replay_buffer_size"] = len(self.replay)
+        results["num_learner_steps"] = self._learner_steps
+        return results
+
+
+SACConfig.algo_class = SAC

@@ -1,0 +1,48 @@
+"""MultiRLModule — a dict of RLModules keyed by policy (module) id.
+
+Reference: rllib/core/rl_module/multi_rl_module.py (MultiRLModule holds
+ModuleID -> RLModule; MultiRLModuleSpec builds it). Parameters here are
+a dict-of-pytrees {policy_id: params}, so the whole multi-policy state
+remains one pytree — checkpointable/shippable like any other.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+
+from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec
+
+
+@dataclass
+class MultiRLModuleSpec:
+    """{policy_id: RLModuleSpec}; build() -> {policy_id: RLModule}."""
+
+    module_specs: dict = field(default_factory=dict)
+
+    def build(self) -> "MultiRLModule":
+        return MultiRLModule(
+            {pid: spec.build() for pid, spec in self.module_specs.items()})
+
+
+class MultiRLModule:
+    def __init__(self, modules: dict):
+        self._modules = modules
+
+    def __getitem__(self, policy_id: str) -> RLModule:
+        return self._modules[policy_id]
+
+    def __contains__(self, policy_id: str) -> bool:
+        return policy_id in self._modules
+
+    def keys(self):
+        return self._modules.keys()
+
+    def items(self):
+        return self._modules.items()
+
+    def init(self, rng: jax.Array) -> dict:
+        keys = jax.random.split(rng, len(self._modules))
+        return {pid: mod.init(k)
+                for (pid, mod), k in zip(self._modules.items(), keys)}

@@ -55,13 +55,16 @@ class RLModuleSpec:
 
     module_class: type | None = None
     observation_size: int = 0
-    num_actions: int = 0
+    num_actions: int = 0      # discrete action count (0 if continuous)
+    action_size: int = 0      # continuous action dim (0 if discrete)
     model_config: dict = field(default_factory=dict)
 
     def build(self) -> "RLModule":
         cls = self.module_class or DefaultActorCriticModule
-        return cls(self.observation_size, self.num_actions,
-                   **self.model_config)
+        kwargs = dict(self.model_config)
+        if self.action_size:
+            kwargs.setdefault("action_size", self.action_size)
+        return cls(self.observation_size, self.num_actions, **kwargs)
 
 
 def _mlp_init(rng, sizes):

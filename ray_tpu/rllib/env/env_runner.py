@@ -22,6 +22,12 @@ import jax
 import numpy as np
 
 from ray_tpu.rllib.core.rl_module import RLModuleSpec
+from ray_tpu.rllib.env.runner_common import (
+    EpisodeStats,
+    make_policy_step,
+    rollout_device,
+    worker_seed_base,
+)
 from ray_tpu.rllib.env.vector_env import make_vector_env
 from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
 
@@ -38,51 +44,22 @@ class SingleAgentEnvRunner:
         # calls are tiny and latency-bound, and pinning them to CPU keeps
         # the TPU dedicated to the learner (the reference gets this for
         # free because env runners are plain CPU actors).
-        try:
-            self._device = jax.local_devices(backend=inference_backend)[0]
-        except RuntimeError:
-            self._device = None
+        self._device = rollout_device(inference_backend)
         self.env = make_vector_env(env_id, num_envs)
         self.module = module_spec.build()
         self.rollout_fragment_length = rollout_fragment_length
         self.explore = explore
-        # The PRNG key is derived *inside* the jitted step from a host
-        # integer, so no device-committed key ever leaks across backends
-        # (host ints are uncommitted; execution stays on the rollout
-        # device).
-        self._seed_base = np.uint32((seed * 100003 + worker_index * 7919)
-                                    & 0x7FFFFFFF)
+        self._seed_base = worker_seed_base(seed, worker_index)
         self._step_counter = 0
         self._weights = None
         self._weights_version = -1
         self._obs = self.env.reset(seed=seed * 7919 + worker_index)
-        # Per-env episode-return accounting for metrics.
-        self._ep_return = np.zeros(self.env.num_envs, dtype=np.float64)
-        self._ep_len = np.zeros(self.env.num_envs, dtype=np.int64)
-        self._completed_returns: list[float] = []
-        self._completed_lengths: list[int] = []
+        self._stats = EpisodeStats(self.env.num_envs)
 
         fwd = (self.module.forward_exploration if explore
                else self.module.forward_inference)
-
-        def policy_step(params, obs, seed):
-            rng = jax.random.fold_in(
-                jax.random.PRNGKey(self._seed_base), seed)
-            # "t" doubles as the exploration-schedule clock (e.g. DQN's
-            # epsilon decay); traced, so no retrace as it changes.
-            return fwd(params, {"obs": obs, "t": seed}, rng)
-
-        jitted = jax.jit(policy_step)
-        if self._device is not None:
-            device = self._device
-
-            def policy_on_device(params, obs, rng):
-                with jax.default_device(device):
-                    return jitted(params, obs, rng)
-
-            self._policy_step = policy_on_device
-        else:
-            self._policy_step = jitted
+        self._policy_step = make_policy_step(
+            fwd, self._seed_base, self._device)
 
     # -- weights sync ------------------------------------------------
     def set_weights(self, weights, version: int = 0) -> None:
@@ -124,15 +101,7 @@ class SingleAgentEnvRunner:
             cols[Columns.ACTION_LOGITS].append(
                 np.asarray(out["action_logits"]))
 
-            self._ep_return += rewards
-            self._ep_len += 1
-            done = term | trunc
-            if done.any():
-                for i in np.flatnonzero(done):
-                    self._completed_returns.append(float(self._ep_return[i]))
-                    self._completed_lengths.append(int(self._ep_len[i]))
-                self._ep_return[done] = 0.0
-                self._ep_len[done] = 0
+            self._stats.record(rewards, term, trunc)
             obs = next_obs
 
         self._obs = obs
@@ -151,17 +120,7 @@ class SingleAgentEnvRunner:
 
     def get_metrics(self) -> dict:
         """Drain episode metrics (reference: env runner metrics logger)."""
-        rets, lens = self._completed_returns, self._completed_lengths
-        self._completed_returns, self._completed_lengths = [], []
-        if not rets:
-            return {"num_episodes": 0}
-        return {
-            "num_episodes": len(rets),
-            "episode_return_mean": float(np.mean(rets)),
-            "episode_return_max": float(np.max(rets)),
-            "episode_return_min": float(np.min(rets)),
-            "episode_len_mean": float(np.mean(lens)),
-        }
+        return self._stats.drain()
 
     def ping(self) -> str:
         return "pong"

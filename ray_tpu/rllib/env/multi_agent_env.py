@@ -1,0 +1,108 @@
+"""Multi-agent environments.
+
+Reference: rllib/env/multi_agent_env.py (MultiAgentEnv: dict-keyed
+obs/rewards/dones per agent; `make_multi_agent`:378 turns any
+single-agent env into an N-agent copy env). TPU-first shape: the
+multi-agent env is *vectorized* like everything else — each agent
+contributes a [B, obs] block per step, so a policy serving K agents
+runs ONE jitted forward over [K*B, obs] instead of K per-agent calls.
+
+Simplification vs the reference: agents are fixed for the env's
+lifetime and all act every step (lockstep); per-agent episode
+boundaries are still independent (each agent's lanes auto-reset on its
+own done). Turn-based games can encode "not my turn" as a no-op action.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.env.vector_env import VectorEnv, make_vector_env
+
+
+class MultiAgentVectorEnv:
+    """B lockstep copies of an N-agent environment.
+
+    Dict-keyed API (reference MultiAgentEnv):
+      reset(seed)            -> {agent_id: [B, obs]}
+      step({agent_id: [B]})  -> (obs, rewards, terminateds, truncateds)
+                                 each {agent_id: [B]-shaped arrays}
+    """
+
+    num_envs: int
+    agent_ids: tuple
+
+    def observation_size(self, agent_id: str) -> int:
+        raise NotImplementedError
+
+    def num_actions(self, agent_id: str) -> int:
+        raise NotImplementedError
+
+    def action_size(self, agent_id: str) -> int:
+        return 0
+
+    def reset(self, seed: int | None = None) -> dict:
+        raise NotImplementedError
+
+    def step(self, actions: dict):
+        raise NotImplementedError
+
+
+class IndependentMultiAgentEnv(MultiAgentVectorEnv):
+    """N agents each driving an independent copy of a single-agent env
+    (reference: make_multi_agent, multi_agent_env.py:378 — the standard
+    multi-agent CartPole used across rllib's test suite)."""
+
+    def __init__(self, env_id: str, num_agents: int = 2,
+                 num_envs: int = 8):
+        self.num_envs = num_envs
+        self.agent_ids = tuple(f"agent_{i}" for i in range(num_agents))
+        self._envs = {aid: make_vector_env(env_id, num_envs)
+                      for aid in self.agent_ids}
+
+    def observation_size(self, agent_id: str) -> int:
+        return self._envs[agent_id].observation_size
+
+    def num_actions(self, agent_id: str) -> int:
+        return self._envs[agent_id].num_actions
+
+    def action_size(self, agent_id: str) -> int:
+        return getattr(self._envs[agent_id], "action_size", 0)
+
+    def reset(self, seed: int | None = None) -> dict:
+        return {aid: env.reset(None if seed is None else seed + i)
+                for i, (aid, env) in enumerate(self._envs.items())}
+
+    def step(self, actions: dict):
+        obs, rew, term, trunc = {}, {}, {}, {}
+        for aid, env in self._envs.items():
+            obs[aid], rew[aid], term[aid], trunc[aid] = env.step(
+                actions[aid])
+        return obs, rew, term, trunc
+
+
+def make_multi_agent(env_id: str):
+    """Factory-of-factories (reference multi_agent_env.py:378):
+    ``MultiCartPole = make_multi_agent("CartPole-v1")``,
+    ``env = MultiCartPole(num_agents=4, num_envs=8)``."""
+
+    def factory(num_agents: int = 2, num_envs: int = 8):
+        return IndependentMultiAgentEnv(env_id, num_agents, num_envs)
+
+    return factory
+
+
+_MULTI_BUILTIN: dict = {}
+
+
+def register_multi_agent_env(env_id: str, factory) -> None:
+    _MULTI_BUILTIN[env_id] = factory
+
+
+def make_multi_agent_env(env_id: str, num_agents: int,
+                         num_envs: int) -> MultiAgentVectorEnv:
+    if env_id in _MULTI_BUILTIN:
+        return _MULTI_BUILTIN[env_id](num_agents=num_agents,
+                                      num_envs=num_envs)
+    # Fall back to N independent copies of a (builtin or gym) env.
+    return IndependentMultiAgentEnv(env_id, num_agents, num_envs)

@@ -1,0 +1,173 @@
+"""MultiAgentEnvRunner — rollout collection over multi-agent envs.
+
+Reference: rllib/env/multi_agent_env_runner.py (MultiAgentEnvRunner:
+steps a MultiAgentEnv, routes per-agent obs through policy_mapping_fn
+to modules, emits MultiAgentEpisodes). TPU shape: per step there is ONE
+jitted policy call per *policy* (not per agent) — agents mapped to the
+same policy have their [B, obs] blocks concatenated into a single
+[K*B, obs] forward, then actions are split back per agent. Fragments
+come out as {policy_id: SampleBatch[T, K*B]} — already merged along the
+batch axis, so the learner consumes them with zero reshuffling.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import numpy as np
+
+from ray_tpu.rllib.core.multi_rl_module import MultiRLModuleSpec
+from ray_tpu.rllib.env.multi_agent_env import make_multi_agent_env
+from ray_tpu.rllib.env.runner_common import (
+    EpisodeStats,
+    make_policy_step,
+    rollout_device,
+    worker_seed_base,
+)
+from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
+
+
+class MultiAgentEnvRunner:
+    """Collects {policy_id: [T, K*B]} fragments."""
+
+    def __init__(self, *, env_id: str, marl_spec: MultiRLModuleSpec,
+                 policy_mapping_fn: Callable[[str], str],
+                 num_agents: int = 2, num_envs: int = 8,
+                 rollout_fragment_length: int = 64, seed: int = 0,
+                 worker_index: int = 0, explore: bool = True,
+                 inference_backend: str = "cpu"):
+        self.worker_index = worker_index
+        self._device = rollout_device(inference_backend)
+        self.env = make_multi_agent_env(env_id, num_agents, num_envs)
+        self.marl_module = marl_spec.build()
+        self.policy_mapping_fn = policy_mapping_fn
+        self.rollout_fragment_length = rollout_fragment_length
+        self.explore = explore
+        # policy_id -> ordered agent list (order fixes the concat layout).
+        self.policy_agents: dict[str, list[str]] = {}
+        for aid in self.env.agent_ids:
+            pid = policy_mapping_fn(aid)
+            if pid not in self.marl_module:
+                raise KeyError(
+                    f"policy_mapping_fn({aid!r}) = {pid!r} which is not "
+                    f"in the MultiRLModuleSpec ({list(self.marl_module.keys())})")
+            self.policy_agents.setdefault(pid, []).append(aid)
+
+        self._seed_base = worker_seed_base(seed, worker_index)
+        self._step_counter = 0
+        self._weights: dict | None = None
+        self._weights_version = -1
+        self._obs = self.env.reset(seed=seed * 7919 + worker_index)
+        B = self.env.num_envs
+        self._stats = {aid: EpisodeStats(B) for aid in self.env.agent_ids}
+
+        # One jitted policy step per policy.
+        self._policy_steps = {}
+        for pid in self.policy_agents:
+            module = self.marl_module[pid]
+            fwd = (module.forward_exploration if explore
+                   else module.forward_inference)
+            self._policy_steps[pid] = make_policy_step(
+                fwd, self._seed_base, self._device)
+
+    # -- weights sync ------------------------------------------------
+    def set_weights(self, weights: dict, version: int = 0) -> None:
+        """weights: {policy_id: params pytree}."""
+        self._weights = weights
+        self._weights_version = version
+
+    def get_weights_version(self) -> int:
+        return self._weights_version
+
+    # -- sampling ----------------------------------------------------
+    def sample(self, num_steps: int | None = None) -> dict:
+        """-> {policy_id: SampleBatch [T, K*B]} (+ bootstrap_value)."""
+        assert self._weights is not None, "set_weights() before sample()"
+        T = num_steps or self.rollout_fragment_length
+        B = self.env.num_envs
+        keys = (Columns.OBS, Columns.ACTIONS, Columns.REWARDS,
+                Columns.TERMINATEDS, Columns.TRUNCATEDS,
+                Columns.ACTION_LOGP, Columns.VF_PREDS,
+                Columns.ACTION_LOGITS)
+        cols = {pid: {k: [] for k in keys} for pid in self.policy_agents}
+
+        obs = self._obs
+        for _ in range(T):
+            self._step_counter += 1
+            actions, per_policy_out = self._act(obs)
+            next_obs, rewards, term, trunc = self.env.step(actions)
+
+            for pid, agents in self.policy_agents.items():
+                out = per_policy_out[pid]
+                c = cols[pid]
+                c[Columns.OBS].append(
+                    np.concatenate([obs[a] for a in agents], axis=0))
+                c[Columns.ACTIONS].append(np.asarray(out["actions"]))
+                c[Columns.REWARDS].append(
+                    np.concatenate([rewards[a] for a in agents], axis=0))
+                c[Columns.TERMINATEDS].append(
+                    np.concatenate([term[a] for a in agents], axis=0))
+                c[Columns.TRUNCATEDS].append(
+                    np.concatenate([trunc[a] for a in agents], axis=0))
+                n = len(agents) * B
+                c[Columns.ACTION_LOGP].append(np.asarray(
+                    out.get("action_logp", np.zeros(n))))
+                c[Columns.VF_PREDS].append(np.asarray(
+                    out.get("vf_preds", np.zeros(n))))
+                c[Columns.ACTION_LOGITS].append(
+                    np.asarray(out["action_logits"]))
+
+            for aid in self.env.agent_ids:
+                self._stats[aid].record(rewards[aid], term[aid], trunc[aid])
+            obs = next_obs
+
+        self._obs = obs
+        fragments = {}
+        self._step_counter += 1
+        _, bootstrap_out = self._act(obs)
+        for pid in self.policy_agents:
+            batch = SampleBatch(
+                {k: np.stack(v, axis=0) for k, v in cols[pid].items()})
+            n = len(self.policy_agents[pid]) * B
+            batch["bootstrap_value"] = np.asarray(
+                bootstrap_out[pid].get("vf_preds", np.zeros(n)))
+            batch["weights_version"] = np.full(
+                (T,), self._weights_version, dtype=np.int64)
+            fragments[pid] = batch
+        return fragments
+
+    def _act(self, obs: dict):
+        """One jitted forward per policy over concatenated agent blocks;
+        returns (per-agent action dict, per-policy raw outputs)."""
+        B = self.env.num_envs
+        actions: dict = {}
+        per_policy_out: dict = {}
+        for pid, agents in self.policy_agents.items():
+            stacked = np.concatenate([obs[a] for a in agents], axis=0)
+            out = self._policy_steps[pid](
+                self._weights[pid], stacked, self._step_counter)
+            per_policy_out[pid] = out
+            acts = np.asarray(out["actions"])
+            for j, aid in enumerate(agents):
+                actions[aid] = acts[j * B:(j + 1) * B]
+        return actions, per_policy_out
+
+    def get_metrics(self) -> dict:
+        """Drain per-agent episode metrics, merged across agents."""
+        drains = [s.drain() for s in self._stats.values()]
+        n = sum(d["num_episodes"] for d in drains)
+        if n == 0:
+            return {"num_episodes": 0}
+        means = [d["episode_return_mean"] for d in drains
+                 if "episode_return_mean" in d]
+        lens = [d["episode_len_mean"] for d in drains
+                if "episode_len_mean" in d]
+        return {
+            "num_episodes": n,
+            "episode_return_mean": float(np.mean(means)),
+            "episode_len_mean": float(np.mean(lens)),
+        }
+
+    def ping(self) -> str:
+        return "pong"

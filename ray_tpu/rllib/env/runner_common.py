@@ -1,0 +1,83 @@
+"""Shared env-runner machinery (single- and multi-agent).
+
+Reference: rllib/env/env_runner.py base-class utilities. Both runners
+need the same three pieces: a deterministic per-worker seed scheme, a
+jitted policy step pinned to the rollout device (CPU by default, so the
+TPU stays dedicated to the learner), and per-lane episode accounting.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def worker_seed_base(seed: int, worker_index: int) -> np.uint32:
+    """Deterministic per-worker PRNG base (decorrelates workers)."""
+    return np.uint32((seed * 100003 + worker_index * 7919) & 0x7FFFFFFF)
+
+
+def rollout_device(inference_backend: str):
+    """First device of the requested backend, or None if unavailable."""
+    try:
+        return jax.local_devices(backend=inference_backend)[0]
+    except RuntimeError:
+        return None
+
+
+def make_policy_step(fwd, seed_base: np.uint32, device):
+    """Jit ``fwd(params, {"obs", "t"}, rng)`` with the PRNG key derived
+    INSIDE the jitted fn from a host integer (no device-committed key
+    leaks across backends), optionally pinned to ``device``."""
+
+    def policy_step(params, obs, seed):
+        rng = jax.random.fold_in(jax.random.PRNGKey(seed_base), seed)
+        # "t" doubles as the exploration-schedule clock (e.g. DQN's
+        # epsilon decay); traced, so no retrace as it changes.
+        return fwd(params, {"obs": obs, "t": seed}, rng)
+
+    jitted = jax.jit(policy_step)
+    if device is None:
+        return jitted
+
+    def on_device(params, obs, seed):
+        with jax.default_device(device):
+            return jitted(params, obs, seed)
+
+    return on_device
+
+
+class EpisodeStats:
+    """Per-lane episode return/length accounting with drain semantics
+    (reference: env-runner metrics logger)."""
+
+    def __init__(self, num_lanes: int):
+        self._ep_return = np.zeros(num_lanes, dtype=np.float64)
+        self._ep_len = np.zeros(num_lanes, dtype=np.int64)
+        self._completed_returns: list[float] = []
+        self._completed_lengths: list[int] = []
+
+    def record(self, rewards: np.ndarray, term: np.ndarray,
+               trunc: np.ndarray) -> None:
+        self._ep_return += rewards
+        self._ep_len += 1
+        done = term | trunc
+        if done.any():
+            for i in np.flatnonzero(done):
+                self._completed_returns.append(float(self._ep_return[i]))
+                self._completed_lengths.append(int(self._ep_len[i]))
+            self._ep_return[done] = 0.0
+            self._ep_len[done] = 0
+
+    def drain(self) -> dict:
+        rets, lens = self._completed_returns, self._completed_lengths
+        self._completed_returns, self._completed_lengths = [], []
+        if not rets:
+            return {"num_episodes": 0}
+        return {
+            "num_episodes": len(rets),
+            "episode_return_mean": float(np.mean(rets)),
+            "episode_return_max": float(np.max(rets)),
+            "episode_return_min": float(np.min(rets)),
+            "episode_len_mean": float(np.mean(lens)),
+        }

@@ -18,7 +18,10 @@ class VectorEnv:
 
     num_envs: int
     observation_size: int
-    num_actions: int
+    num_actions: int      # discrete envs; 0 for continuous
+    action_size: int = 0  # continuous envs; 0 for discrete
+    # Continuous action bounds (symmetric box, one scalar for all dims).
+    action_scale: float = 1.0
 
     def reset(self, seed: int | None = None) -> np.ndarray:
         raise NotImplementedError
@@ -96,6 +99,77 @@ class CartPoleVectorEnv(VectorEnv):
                 terminated, truncated)
 
 
+class PendulumVectorEnv(VectorEnv):
+    """Batched Pendulum-v1 (continuous torque control).
+
+    Matches gymnasium's Pendulum-v1 dynamics (g=10, m=1, l=1, dt=0.05,
+    torque clip ±2, speed clip ±8) so SAC learning curves are
+    comparable; 200-step truncation, never terminates.
+    """
+
+    G = 10.0
+    M = 1.0
+    L = 1.0
+    DT = 0.05
+    MAX_TORQUE = 2.0
+    MAX_SPEED = 8.0
+    MAX_STEPS = 200
+
+    observation_size = 3
+    num_actions = 0
+    action_size = 1
+    action_scale = 2.0  # torque range ±2
+
+    def __init__(self, num_envs: int = 8, max_steps: int | None = None):
+        self.num_envs = num_envs
+        self.max_steps = max_steps or self.MAX_STEPS
+        self._theta = np.zeros(num_envs)
+        self._thetadot = np.zeros(num_envs)
+        self._t = np.zeros(num_envs, dtype=np.int64)
+        self._rng = np.random.default_rng(0)
+
+    def _obs(self) -> np.ndarray:
+        return np.stack([np.cos(self._theta), np.sin(self._theta),
+                         self._thetadot], axis=1).astype(np.float32)
+
+    def _sample_state(self, n: int):
+        return (self._rng.uniform(-np.pi, np.pi, size=n),
+                self._rng.uniform(-1.0, 1.0, size=n))
+
+    def reset(self, seed: int | None = None) -> np.ndarray:
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._theta, self._thetadot = self._sample_state(self.num_envs)
+        self._t[:] = 0
+        return self._obs()
+
+    def step(self, actions: np.ndarray):
+        u = np.clip(np.asarray(actions, dtype=np.float64).reshape(-1),
+                    -self.MAX_TORQUE, self.MAX_TORQUE)
+        theta, thetadot = self._theta, self._thetadot
+        angle_norm = ((theta + np.pi) % (2 * np.pi)) - np.pi
+        costs = angle_norm**2 + 0.1 * thetadot**2 + 0.001 * u**2
+
+        thetadot = thetadot + self.DT * (
+            3 * self.G / (2 * self.L) * np.sin(theta)
+            + 3.0 / (self.M * self.L**2) * u)
+        thetadot = np.clip(thetadot, -self.MAX_SPEED, self.MAX_SPEED)
+        theta = theta + self.DT * thetadot
+        self._theta, self._thetadot = theta, thetadot
+        self._t += 1
+
+        terminated = np.zeros(self.num_envs, dtype=bool)
+        truncated = self._t >= self.max_steps
+        if truncated.any():
+            n = int(truncated.sum())
+            new_theta, new_thetadot = self._sample_state(n)
+            self._theta[truncated] = new_theta
+            self._thetadot[truncated] = new_thetadot
+            self._t[truncated] = 0
+        return (self._obs(), (-costs).astype(np.float32),
+                terminated, truncated)
+
+
 class GymVectorEnv(VectorEnv):
     """Adapter over gymnasium.vector.SyncVectorEnv for non-builtin ids."""
 
@@ -107,7 +181,14 @@ class GymVectorEnv(VectorEnv):
             [lambda: gym.make(env_id) for _ in range(num_envs)])
         space = self._env.single_observation_space
         self.observation_size = int(np.prod(space.shape))
-        self.num_actions = int(self._env.single_action_space.n)
+        act_space = self._env.single_action_space
+        if hasattr(act_space, "n"):           # Discrete
+            self.num_actions = int(act_space.n)
+            self.action_size = 0
+        else:                                  # Box (continuous)
+            self.num_actions = 0
+            self.action_size = int(np.prod(act_space.shape))
+            self.action_scale = float(np.max(np.abs(act_space.high)))
 
     def reset(self, seed: int | None = None) -> np.ndarray:
         obs, _ = self._env.reset(seed=seed)
@@ -119,7 +200,8 @@ class GymVectorEnv(VectorEnv):
                 rewards.astype(np.float32), term, trunc)
 
 
-_BUILTIN = {"CartPole-v1": CartPoleVectorEnv}
+_BUILTIN = {"CartPole-v1": CartPoleVectorEnv,
+            "Pendulum-v1": PendulumVectorEnv}
 
 
 def make_vector_env(env_id: str, num_envs: int) -> VectorEnv:
