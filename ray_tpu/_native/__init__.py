@@ -1,0 +1,85 @@
+"""Native (C++) components, compiled on demand.
+
+The reference ships ~166K LoC of C++ under src/ray/ prebuilt by Bazel;
+here the native layer is small enough to build lazily with the system
+toolchain the first time it is needed, cached next to the source. If no
+toolchain is available the callers fall back to pure-Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "plasma_store.cpp")
+_LIB = os.path.join(_DIR, "libray_tpu_native.so")
+
+_lock = threading.Lock()
+_lib: "ctypes.CDLL | None | bool" = None  # False = tried and failed
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O2", "-shared", "-fPIC", _SRC, "-o", _LIB,
+           "-lpthread", "-lrt"]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    return proc.returncode == 0 and os.path.exists(_LIB)
+
+
+def load() -> "ctypes.CDLL | None":
+    """Compile (if stale/missing) and dlopen the native library.
+
+    Returns None when the toolchain or build is unavailable; callers
+    must degrade gracefully.
+    """
+    global _lib
+    with _lock:
+        if _lib is not None:
+            return _lib or None
+        try:
+            if (not os.path.exists(_LIB)
+                    or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+                if not _build():
+                    _lib = False
+                    return None
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            _lib = False
+            return None
+
+        u64, u32, p = ctypes.c_uint64, ctypes.c_uint32, ctypes.c_void_p
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.rt_store_create.restype = p
+        lib.rt_store_create.argtypes = [ctypes.c_char_p, u64, u32]
+        lib.rt_store_attach.restype = p
+        lib.rt_store_attach.argtypes = [ctypes.c_char_p]
+        lib.rt_store_detach.restype = None
+        lib.rt_store_detach.argtypes = [p]
+        lib.rt_store_destroy.restype = ctypes.c_int
+        lib.rt_store_destroy.argtypes = [p, ctypes.c_char_p]
+        lib.rt_store_base.restype = u8p
+        lib.rt_store_base.argtypes = [p]
+        lib.rt_store_create_object.restype = u64
+        lib.rt_store_create_object.argtypes = [p, ctypes.c_char_p, u64]
+        lib.rt_store_seal.restype = ctypes.c_int
+        lib.rt_store_seal.argtypes = [p, ctypes.c_char_p]
+        lib.rt_store_seal_pinned.restype = ctypes.c_int
+        lib.rt_store_seal_pinned.argtypes = [p, ctypes.c_char_p]
+        lib.rt_store_get.restype = u64
+        lib.rt_store_get.argtypes = [p, ctypes.c_char_p,
+                                     ctypes.POINTER(u64)]
+        lib.rt_store_release.restype = ctypes.c_int
+        lib.rt_store_release.argtypes = [p, ctypes.c_char_p]
+        lib.rt_store_delete.restype = ctypes.c_int
+        lib.rt_store_delete.argtypes = [p, ctypes.c_char_p]
+        lib.rt_store_contains.restype = ctypes.c_int
+        lib.rt_store_contains.argtypes = [p, ctypes.c_char_p]
+        lib.rt_store_stats.restype = None
+        lib.rt_store_stats.argtypes = [p] + [ctypes.POINTER(u64)] * 5
+        _lib = lib
+        return lib

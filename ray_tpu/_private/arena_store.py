@@ -1,0 +1,161 @@
+"""ArenaStore — Python wrapper over the native shared-memory arena.
+
+Reference: src/ray/object_manager/plasma/client.h (PlasmaClient:
+Create/Seal/Get/Release/Delete against the store's shared arena). The
+native side (ray_tpu/_native/plasma_store.cpp) keeps the allocator,
+object table, and robust lock in shared memory; this wrapper adds the
+Python-facing buffer protocol.
+
+Ownership model: the driver process creates the arena; pool workers
+attach by name (RAY_TPU_ARENA_NAME in their environment). Objects are
+keyed by 16-byte ids (ObjectID.binary() or os.urandom for transport
+blobs).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Any
+
+from ray_tpu._native import load as _load_native
+
+
+class ArenaFullError(Exception):
+    """Arena could not satisfy the allocation even after eviction."""
+
+
+class ArenaStore:
+    """One mapped shared-memory arena (create or attach)."""
+
+    def __init__(self, handle, name: str, owner: bool):
+        self._lib = _load_native()
+        self._handle = handle
+        self.name = name
+        self.owner = owner
+        self._base = self._lib.rt_store_base(handle)
+
+    # -- lifecycle ----------------------------------------------------
+    @classmethod
+    def create(cls, name: str, capacity_bytes: int,
+               table_capacity: int = 4096) -> "ArenaStore | None":
+        lib = _load_native()
+        if lib is None:
+            return None
+        handle = lib.rt_store_create(
+            name.encode(), capacity_bytes, table_capacity)
+        if not handle:
+            return None
+        return cls(handle, name, owner=True)
+
+    @classmethod
+    def attach(cls, name: str) -> "ArenaStore | None":
+        lib = _load_native()
+        if lib is None:
+            return None
+        handle = lib.rt_store_attach(name.encode())
+        if not handle:
+            return None
+        return cls(handle, name, owner=False)
+
+    def close(self) -> None:
+        if self._handle is None:
+            return
+        if self.owner:
+            self._lib.rt_store_destroy(self._handle, self.name.encode())
+        else:
+            self._lib.rt_store_detach(self._handle)
+        self._handle = None
+
+    # -- objects ------------------------------------------------------
+    def _view(self, offset: int, size: int) -> memoryview:
+        addr = ctypes.addressof(self._base.contents) + offset
+        return memoryview(
+            (ctypes.c_uint8 * size).from_address(addr)).cast("B")
+
+    def put_bytes(self, object_id: bytes, payloads) -> bool:
+        """Write ``payloads`` (an iterable of buffers) as one object.
+
+        Returns False when the arena cannot hold it (caller falls back
+        to a dedicated segment).
+        """
+        total = sum(len(p) for p in payloads)
+        if total == 0:
+            total = 1  # zero-size objects still need a table entry
+        offset = self._lib.rt_store_create_object(
+            self._handle, object_id, total)
+        if not offset:
+            return False
+        view = self._view(offset, total)
+        pos = 0
+        for p in payloads:
+            n = len(p)
+            view[pos:pos + n] = bytes(p) if not isinstance(
+                p, (bytes, bytearray, memoryview)) else p
+            pos += n
+        self._lib.rt_store_seal(self._handle, object_id)
+        return True
+
+    def create_for_write(self, object_id: bytes,
+                         size: int) -> memoryview | None:
+        """Allocate an unsealed object and return a writable view into
+        the arena (plasma's Create). Caller writes then ``seal``s.
+        Returns None when the arena cannot hold it."""
+        offset = self._lib.rt_store_create_object(
+            self._handle, object_id, max(size, 1))
+        if not offset:
+            return None
+        return self._view(offset, max(size, 1))
+
+    def seal(self, object_id: bytes) -> None:
+        self._lib.rt_store_seal(self._handle, object_id)
+
+    def seal_pinned(self, object_id: bytes) -> None:
+        """Seal + take a reference atomically: the object is never in
+        the evictable (sealed, refcount-0) state, so it survives until
+        ``unpin`` even under arena pressure. Used for ownership handoff
+        (worker result -> driver directory)."""
+        self._lib.rt_store_seal_pinned(self._handle, object_id)
+
+    def unpin(self, object_id: bytes) -> None:
+        """Drop a reference taken by seal_pinned (or pin)."""
+        self._lib.rt_store_release(self._handle, object_id)
+
+    def get_bytes(self, object_id: bytes) -> bytes | None:
+        """Copy an object's payload out of the arena.
+
+        Copies deliberately: a zero-copy view could be invalidated by
+        eviction/reuse after release. Large objects (where zero-copy
+        matters) use dedicated segments, not the arena — see
+        shm_store.py's size policy.
+        """
+        size = ctypes.c_uint64()
+        offset = self._lib.rt_store_get(
+            self._handle, object_id, ctypes.byref(size))
+        if not offset:
+            return None
+        try:
+            return bytes(self._view(offset, size.value))
+        finally:
+            self._lib.rt_store_release(self._handle, object_id)
+
+    def delete(self, object_id: bytes) -> None:
+        self._lib.rt_store_delete(self._handle, object_id)
+
+    def contains(self, object_id: bytes) -> bool:
+        return bool(self._lib.rt_store_contains(self._handle, object_id))
+
+    def stats(self) -> dict:
+        u = [ctypes.c_uint64() for _ in range(5)]
+        self._lib.rt_store_stats(self._handle, *[ctypes.byref(x) for x in u])
+        return {
+            "used_bytes": u[0].value,
+            "capacity_bytes": u[1].value,
+            "num_objects": u[2].value,
+            "num_evictions": u[3].value,
+            "alloc_failures": u[4].value,
+        }
+
+
+def default_arena_name() -> str:
+    return f"/ray_tpu_arena_{os.getpid()}"

@@ -44,6 +44,9 @@ _DEFAULTS: dict[str, Any] = {
     # Multiprocess worker pool.
     "worker_pool_size": 0,  # 0 => disabled (thread workers); N>0 => N processes
     "worker_startup_timeout_s": 30.0,
+    # Native shared-memory arena (plasma-lite, _native/plasma_store.cpp).
+    "object_arena_bytes": 64 * 1024 * 1024,  # 0 => segment-per-object only
+    "object_arena_max_object_bytes": 1024 * 1024,
     # Placement groups.
     "placement_group_commit_timeout_s": 30.0,
 }

@@ -33,6 +33,17 @@ class ShmDescriptor:
     size: int
 
 
+@dataclass(frozen=True)
+class ArenaDescriptor:
+    """An object resident in the shared arena (plasma-lite,
+    _native/plasma_store.cpp): 16-byte key + payload size. ``name`` is a
+    sentinel so segment-oriented call sites (close_segment) no-op."""
+
+    key: bytes
+    size: int
+    name: str = "<arena>"
+
+
 def untrack(seg: shared_memory.SharedMemory) -> None:
     """Remove a segment from this process's resource tracker.
 
@@ -81,12 +92,52 @@ class ShmObjectWriter:
     """Create-then-seal protocol (plasma's Create/Seal)."""
 
     @staticmethod
-    def put(value: Any) -> tuple[ShmDescriptor, shared_memory.SharedMemory]:
-        header, buffers = serialization.serialize(value)
-        size = serialization.framed_size(header, buffers)
+    def put_serialized(header, buffers,
+                       size: int) -> tuple[ShmDescriptor,
+                                           shared_memory.SharedMemory]:
         seg = shared_memory.SharedMemory(create=True, size=max(size, 1))
         serialization.write_framed(seg.buf, header, buffers)
         return ShmDescriptor(seg.name, size), seg
+
+    @staticmethod
+    def put(value: Any) -> tuple[ShmDescriptor, shared_memory.SharedMemory]:
+        header, buffers = serialization.serialize(value)
+        size = serialization.framed_size(header, buffers)
+        return ShmObjectWriter.put_serialized(header, buffers, size)
+
+    @staticmethod
+    def put_arena_serialized(arena, key: bytes, header, buffers,
+                             size: int) -> "ArenaDescriptor | None":
+        """Write pre-serialized framed data into the arena under ``key``,
+        sealed PINNED (one reference owned by the registering directory;
+        ShmDirectory.free unpins). Returns None when the arena is absent
+        or full — the caller falls back to a dedicated segment."""
+        if arena is None:
+            return None
+        view = arena.create_for_write(key, size)
+        if view is None:
+            return None
+        serialization.write_framed(view, header, buffers)
+        arena.seal_pinned(key)
+        return ArenaDescriptor(key, size)
+
+    @staticmethod
+    def put_arena(value: Any, arena, key: bytes,
+                  max_bytes: int) -> "ArenaDescriptor | None":
+        """Serialize ``value`` directly into the arena under ``key``.
+
+        Returns None (caller falls back to a dedicated segment) when the
+        value exceeds the small-object cutoff or the arena is full —
+        large objects keep the segment path's true zero-copy reads.
+        """
+        if arena is None:
+            return None
+        header, buffers = serialization.serialize(value)
+        size = serialization.framed_size(header, buffers)
+        if size > max_bytes:
+            return None
+        return ShmObjectWriter.put_arena_serialized(
+            arena, key, header, buffers, size)
 
 
 class ShmClient:
@@ -100,6 +151,7 @@ class ShmClient:
     def __init__(self, untrack_on_attach: bool = False):
         self._lock = threading.Lock()
         self._segments: dict[str, shared_memory.SharedMemory] = {}
+        self._arena = None
         # Python 3.12 registers segments with the resource tracker on
         # ATTACH as well as create. Worker clients never unlink, so they
         # untrack attaches (else their tracker warns/unlinks at exit).
@@ -111,7 +163,20 @@ class ShmClient:
         # referenced here so __del__ never runs on them.
         self._leaked: list[shared_memory.SharedMemory] = []
 
-    def get(self, desc: ShmDescriptor) -> Any:
+    def set_arena(self, arena) -> None:
+        self._arena = arena
+
+    def get(self, desc: "ShmDescriptor | ArenaDescriptor") -> Any:
+        if isinstance(desc, ArenaDescriptor):
+            if self._arena is None:
+                raise RuntimeError("arena object but no arena attached")
+            blob = self._arena.get_bytes(desc.key)
+            if blob is None:
+                raise KeyError(
+                    f"arena object {desc.key.hex()} evicted or deleted")
+            # The copy (get_bytes) owns the memory, so zero-copy views
+            # from deserialization stay valid after arena eviction.
+            return serialization.deserialize_from_buffer(memoryview(blob))
         with self._lock:
             seg = self._segments.get(desc.name)
             if seg is None:
@@ -157,9 +222,24 @@ class ShmDirectory:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._by_object: dict[ObjectID, ShmDescriptor] = {}
+        self._by_object: dict[ObjectID, "ShmDescriptor | ArenaDescriptor"] = {}
         self._owned: dict[str, shared_memory.SharedMemory] = {}
         self._leaked: list[shared_memory.SharedMemory] = []
+        self._arena = None
+
+    def set_arena(self, arena) -> None:
+        self._arena = arena
+
+    def register_arena(self, object_id: ObjectID,
+                       desc: ArenaDescriptor) -> None:
+        """Record an arena-resident object.
+
+        The object arrives sealed PINNED (seal_pinned: refcount 1 from
+        creation, so it was never evictable in transit); the directory
+        takes over that reference and drops it in ``free``.
+        """
+        with self._lock:
+            self._by_object[object_id] = desc
 
     def register(self, object_id: ObjectID, desc: ShmDescriptor,
                  segment: shared_memory.SharedMemory | None = None) -> None:
@@ -188,6 +268,10 @@ class ShmDirectory:
         with self._lock:
             desc = self._by_object.pop(object_id, None)
             seg = self._owned.pop(desc.name, None) if desc else None
+        if isinstance(desc, ArenaDescriptor) and self._arena is not None:
+            self._arena.unpin(desc.key)   # drop the seal_pinned ref
+            self._arena.delete(desc.key)
+            return
         if seg is not None:
             self._close_and_unlink(seg)
 

@@ -23,6 +23,7 @@ from __future__ import annotations
 import atexit
 import concurrent.futures
 import logging
+import os
 import threading
 import time
 from typing import Any, Iterable, Sequence
@@ -124,6 +125,25 @@ class Runtime:
         self.shm_directory = ShmDirectory()
         self.shm_client = ShmClient()
         self.worker_pool = None
+        # Native shared arena (plasma-lite, _native/plasma_store.cpp):
+        # the driver owns it; pool workers attach via RAY_TPU_ARENA_NAME.
+        # Best-effort — without a C++ toolchain everything stays on the
+        # segment-per-object path.
+        self.arena = None
+        arena_bytes = int(cfg.object_arena_bytes or 0)
+        if arena_bytes > 0:
+            from ray_tpu._private.arena_store import (
+                ArenaStore,
+                default_arena_name,
+            )
+
+            self.arena = ArenaStore.create(default_arena_name(), arena_bytes)
+            if self.arena is not None:
+                os.environ["RAY_TPU_ARENA_NAME"] = self.arena.name
+                os.environ["RAY_TPU_ARENA_MAX"] = str(
+                    int(cfg.object_arena_max_object_bytes))
+                self.shm_client.set_arena(self.arena)
+                self.shm_directory.set_arena(self.arena)
         self._func_blobs: "weakref.WeakKeyDictionary" = \
             weakref.WeakKeyDictionary()
         pool_size = (process_workers if process_workers is not None
@@ -437,11 +457,24 @@ class Runtime:
         reachable by worker processes via a shared-memory segment."""
         from ray_tpu._private.shm_store import ShmObjectWriter
 
+        from ray_tpu._private import serialization
+
         desc = self.shm_directory.lookup(ref.id())
         if desc is not None:
             return desc
         value = self.store.get(ref.id())  # deps already sealed at dispatch
-        desc, seg = ShmObjectWriter.put(value)
+        header, buffers = serialization.serialize(value)
+        size = serialization.framed_size(header, buffers)
+        if (self.arena is not None
+                and size <= int(GLOBAL_CONFIG.object_arena_max_object_bytes)):
+            # Arena-first: keyed by the object id, so repeated promotes
+            # of the same object are one table hit, not a new segment.
+            adesc = ShmObjectWriter.put_arena_serialized(
+                self.arena, ref.id().binary(), header, buffers, size)
+            if adesc is not None:
+                self.shm_directory.register_arena(ref.id(), adesc)
+                return adesc
+        desc, seg = ShmObjectWriter.put_serialized(header, buffers, size)
         self.shm_directory.register(ref.id(), desc, seg)
         return desc
 
@@ -816,6 +849,10 @@ class Runtime:
             self.worker_pool.shutdown()
         self.shm_client.close_all()
         self.shm_directory.shutdown()
+        if self.arena is not None:
+            self.arena.close()  # owner: destroys the shared arena
+            os.environ.pop("RAY_TPU_ARENA_NAME", None)
+            self.arena = None
         self.gcs.finish_job(self.job_id)
 
 

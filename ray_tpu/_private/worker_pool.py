@@ -37,6 +37,7 @@ from typing import Any, Callable
 from ray_tpu._private import serialization
 from ray_tpu._private.ids import ActorID, ObjectID
 from ray_tpu._private.shm_store import (
+    ArenaDescriptor,
     ShmClient,
     ShmDescriptor,
     ShmDirectory,
@@ -50,8 +51,11 @@ from ray_tpu.exceptions import (
     WorkerCrashedError,
 )
 
-# Results smaller than this ship inline through the pipe; larger ones go
-# through a shared-memory segment the driver adopts.
+# Results smaller than this ship inline through the pipe; mid-size ones
+# go through the native shared arena (one lock round-trip, no syscalls);
+# larger ones get a dedicated shared-memory segment the driver adopts
+# (true zero-copy reads). The arena cutoff comes from config
+# (object_arena_max_object_bytes) via the RAY_TPU_ARENA_MAX env var.
 INLINE_RESULT_BYTES = 64 * 1024
 
 
@@ -85,8 +89,9 @@ def _resolve_shm_args(args, kwargs, client: ShmClient):
     return args, kwargs
 
 
-def _pack_results(values: list) -> list:
-    """Each value -> ("inline", bytes) | ("shm", name, size) | ("err", blob)."""
+def _pack_results(values: list, arena=None, arena_max: int = 0) -> list:
+    """Each value -> ("inline", bytes) | ("arena", key, size)
+    | ("shm", name, size) | ("err", blob)."""
     from multiprocessing import shared_memory
 
     out = []
@@ -101,13 +106,27 @@ def _pack_results(values: list) -> list:
             blob = bytearray(size)
             serialization.write_framed(memoryview(blob), header, buffers)
             out.append(("inline", bytes(blob)))
-        else:
-            seg = shared_memory.SharedMemory(create=True, size=size)
-            untrack(seg)  # unlink belongs to the driver directory
-            serialization.write_framed(seg.buf, header, buffers)
-            name = seg.name
-            seg.close()  # driver adopts + unlinks; worker drops its handle
-            out.append(("shm", name, size))
+            continue
+        if arena is not None and size <= arena_max:
+            key = os.urandom(16)
+            view = arena.create_for_write(key, size)
+            if view is not None:
+                serialization.write_framed(view, header, buffers)
+                # Pinned: the driver's directory inherits the reference
+                # at register_arena, so the result cannot be evicted in
+                # transit. (A worker crash between here and the driver
+                # receiving the reply leaks this one pin — bounded by
+                # crash count, and the arena dies with the driver.)
+                arena.seal_pinned(key)
+                out.append(("arena", key, size))
+                continue
+            # Arena full even after eviction: dedicated segment below.
+        seg = shared_memory.SharedMemory(create=True, size=size)
+        untrack(seg)  # unlink belongs to the driver directory
+        serialization.write_framed(seg.buf, header, buffers)
+        name = seg.name
+        seg.close()  # driver adopts + unlinks; worker drops its handle
+        out.append(("shm", name, size))
     return out
 
 
@@ -123,13 +142,25 @@ def worker_main(conn) -> None:
     sys.path[:0] = [p for p in parent_sys_path if p not in sys.path]
     os.environ["RAY_TPU_IN_POOL_WORKER"] = "1"  # init() guard
     client = ShmClient(untrack_on_attach=True)
+    # Attach the driver's shared arena (plasma-lite) when one exists.
+    arena = None
+    arena_name = os.environ.get("RAY_TPU_ARENA_NAME")
+    if arena_name:
+        from ray_tpu._private.arena_store import ArenaStore
+
+        arena = ArenaStore.attach(arena_name)
+        client.set_arena(arena)
+    arena_max = int(os.environ.get("RAY_TPU_ARENA_MAX", 1024 * 1024))
     try:
-        _serve(conn, client)
+        _serve(conn, client, arena, arena_max)
     finally:
         client.close_all()
+        if arena is not None:
+            arena.close()
 
 
-def _serve(conn, client: ShmClient) -> None:
+def _serve(conn, client: ShmClient, arena=None,
+           arena_max: int = 0) -> None:
     actor_instance = None
     func_cache: dict[str, Any] = {}
     while True:
@@ -165,7 +196,7 @@ def _serve(conn, client: ShmClient) -> None:
                             f"task declared num_returns={n_returns} but "
                             f"returned {type(result).__name__}")
                     values = list(result)
-                conn.send(("ok", _pack_results(values)))
+                conn.send(("ok", _pack_results(values, arena, arena_max)))
             elif kind == "actor_new":
                 _, cls_blob, args_blob = msg
                 cls = serialization.loads_function(cls_blob)
@@ -186,7 +217,7 @@ def _serve(conn, client: ShmClient) -> None:
                 values = [result] if n_returns == 1 else \
                     (list(result) if isinstance(result, (tuple, list))
                      else [None] * n_returns)
-                conn.send(("ok", _pack_results(values)))
+                conn.send(("ok", _pack_results(values, arena, arena_max)))
             else:
                 raise RuntimeError(f"unknown message kind {kind!r}")
         except BaseException as exc:  # noqa: BLE001 — shipped to the driver
@@ -434,6 +465,10 @@ class WorkerPool:
             if packed[0] == "inline":
                 value = serialization.deserialize_from_buffer(
                     memoryview(packed[1]))
+            elif packed[0] == "arena":
+                desc = ArenaDescriptor(packed[1], packed[2])
+                self.directory.register_arena(rid, desc)
+                value = self.driver_client.get(desc)
             elif packed[0] == "shm":
                 desc = ShmDescriptor(packed[1], packed[2])
                 self.directory.adopt(rid, desc)
@@ -610,6 +645,10 @@ class ProcessActor:
                     if packed[0] == "inline":
                         value = serialization.deserialize_from_buffer(
                             memoryview(packed[1]))
+                    elif packed[0] == "arena":
+                        desc = ArenaDescriptor(packed[1], packed[2])
+                        self._runtime.shm_directory.register_arena(rid, desc)
+                        value = self._runtime.shm_client.get(desc)
                     else:
                         desc = ShmDescriptor(packed[1], packed[2])
                         self._runtime.shm_directory.adopt(rid, desc)
