@@ -233,13 +233,32 @@ void heap_free(Handle* h, uint64_t off, uint64_t payload) {
   }
 }
 
+// Remove an entry: tombstone it, then — if no probe chain continues
+// past this slot (next slot empty) — convert it and any contiguous
+// preceding tombstones back to kEmpty so lookup misses stay O(chain)
+// instead of degrading to O(table) as tombstones accumulate.
+void remove_entry(Handle* h, ObjectEntry* e) {
+  Header* hd = header(h);
+  ObjectEntry* tab = table(h);
+  uint32_t mask = hd->table_capacity - 1;
+  e->state = kTombstone;
+  uint32_t idx = static_cast<uint32_t>(e - tab);
+  if (tab[(idx + 1) & mask].state != kEmpty) return;
+  uint32_t i = idx;
+  do {
+    if (tab[i].state != kTombstone) return;
+    tab[i].state = kEmpty;
+    i = (i - 1) & mask;
+  } while (i != idx);
+}
+
 void evict_one(Handle* h, ObjectEntry* victim) {
   Header* hd = header(h);
   heap_free(h, victim->offset, victim->size);
   hd->used_bytes -= victim->size;
   hd->num_objects--;
   hd->num_evictions++;
-  victim->state = kTombstone;
+  remove_entry(h, victim);
 }
 
 // Rebuild the free list from the object table (EOWNERDEAD recovery: the
@@ -559,7 +578,7 @@ int rt_store_delete(void* hv, const uint8_t* id) {
     heap_free(h, e->offset, e->size);
     hd->used_bytes -= e->size;
     hd->num_objects--;
-    e->state = kTombstone;
+    remove_entry(h, e);
   } else {
     // Sealed-with-refs: make it eviction-eligible the moment refs
     // drain by aging it to the oldest possible tick.

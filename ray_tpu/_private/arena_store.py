@@ -80,8 +80,6 @@ class ArenaStore:
         to a dedicated segment).
         """
         total = sum(len(p) for p in payloads)
-        if total == 0:
-            total = 1  # zero-size objects still need a table entry
         offset = self._lib.rt_store_create_object(
             self._handle, object_id, total)
         if not offset:
@@ -102,10 +100,10 @@ class ArenaStore:
         the arena (plasma's Create). Caller writes then ``seal``s.
         Returns None when the arena cannot hold it."""
         offset = self._lib.rt_store_create_object(
-            self._handle, object_id, max(size, 1))
+            self._handle, object_id, size)
         if not offset:
             return None
-        return self._view(offset, max(size, 1))
+        return self._view(offset, size)
 
     def seal(self, object_id: bytes) -> None:
         self._lib.rt_store_seal(self._handle, object_id)

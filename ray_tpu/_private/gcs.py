@@ -118,6 +118,9 @@ class JobRecord:
     start_time: float = field(default_factory=time.time)
     end_time: float | None = None
     status: str = "RUNNING"
+    entrypoint: str = ""       # submitted jobs: the shell command
+    message: str = ""          # human-readable status detail
+    submission_id: str = ""    # user-facing id (job submission API)
 
 
 @dataclass

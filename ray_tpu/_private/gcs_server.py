@@ -1,0 +1,251 @@
+"""GCS server — the head node's control plane, served over RPC.
+
+Reference: src/ray/gcs/gcs_server/gcs_server.h (GcsServer hosts the
+node/actor/job/KV services over gRPC; python/ray/_private/gcs_utils.py
+is the client side). Here one RpcServer exposes a
+GlobalControlService's tables plus the job manager to every node,
+driver, and CLI in the cluster.
+
+Heartbeat failure detection matches the reference's
+gcs_health_check_manager.h: nodes that miss heartbeats past the
+threshold are marked dead and published on the node channel.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+import time
+from typing import Any
+
+from ray_tpu._private.gcs import (
+    GlobalControlService,
+    JobRecord,
+    NodeRecord,
+)
+from ray_tpu._private.ids import JobID, NodeID
+from ray_tpu._private.rpc import RpcServer
+
+
+class JobManager:
+    """Head-side job submission (reference:
+    dashboard/modules/job/job_manager.py — entrypoint subprocesses with
+    captured logs and terminal-state tracking)."""
+
+    def __init__(self, gcs: GlobalControlService, log_dir: str):
+        self.gcs = gcs
+        self.log_dir = log_dir
+        os.makedirs(log_dir, exist_ok=True)
+        self._procs: dict[str, subprocess.Popen] = {}
+        self._lock = threading.Lock()
+
+    def submit(self, entrypoint: str, *, submission_id: str | None = None,
+               env: dict | None = None, cwd: str | None = None) -> str:
+        job_id = JobID()
+        sub_id = submission_id or f"raysubmit_{job_id.hex()[:12]}"
+        log_path = os.path.join(self.log_dir, f"{sub_id}.log")
+        full_env = dict(os.environ)
+        # A submitted driver connects back to THIS head by default.
+        full_env["RAY_TPU_JOB_SUBMISSION_ID"] = sub_id
+        # Entrypoints must resolve the same ray_tpu installation as the
+        # head (reference: job drivers inherit the cluster's ray).
+        import ray_tpu
+
+        pkg_file = getattr(ray_tpu, "__file__", None)
+        if pkg_file:
+            pkg_root = os.path.dirname(os.path.dirname(
+                os.path.abspath(pkg_file)))
+            prior = full_env.get("PYTHONPATH", "")
+            if pkg_root not in prior.split(os.pathsep):
+                full_env["PYTHONPATH"] = (
+                    pkg_root + (os.pathsep + prior if prior else ""))
+        full_env.update(env or {})
+        try:
+            log_file = open(log_path, "wb")
+            proc = subprocess.Popen(
+                entrypoint, shell=True, stdout=log_file,
+                stderr=subprocess.STDOUT, cwd=cwd, env=full_env,
+                start_new_session=True)
+        except OSError as exc:
+            self.gcs.register_job(JobRecord(
+                job_id=job_id, status="FAILED", entrypoint=entrypoint,
+                submission_id=sub_id, message=str(exc)))
+            return sub_id
+        self.gcs.register_job(JobRecord(
+            job_id=job_id, status="RUNNING", entrypoint=entrypoint,
+            submission_id=sub_id))
+        with self._lock:
+            self._procs[sub_id] = proc
+        threading.Thread(target=self._wait, args=(sub_id, job_id, proc),
+                         daemon=True, name=f"job-wait-{sub_id}").start()
+        return sub_id
+
+    def _wait(self, sub_id: str, job_id: JobID,
+              proc: subprocess.Popen) -> None:
+        rc = proc.wait()
+        status = "SUCCEEDED" if rc == 0 else "FAILED"
+        self.gcs.finish_job(job_id, status=status)
+        record = self._record(sub_id)
+        if record is not None:
+            record.message = f"exit code {rc}"
+        with self._lock:
+            self._procs.pop(sub_id, None)
+
+    def _record(self, sub_id: str) -> JobRecord | None:
+        for record in self.gcs.list_jobs():
+            if record.submission_id == sub_id:
+                return record
+        return None
+
+    def status(self, sub_id: str) -> dict | None:
+        record = self._record(sub_id)
+        if record is None:
+            return None
+        return {
+            "submission_id": record.submission_id,
+            "status": record.status,
+            "entrypoint": record.entrypoint,
+            "message": record.message,
+            "start_time": record.start_time,
+            "end_time": record.end_time,
+        }
+
+    def logs(self, sub_id: str, tail_bytes: int = 1 << 20) -> bytes:
+        path = os.path.join(self.log_dir, f"{sub_id}.log")
+        try:
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - tail_bytes))
+                return f.read()
+        except FileNotFoundError:
+            return b""
+
+    def stop(self, sub_id: str) -> bool:
+        with self._lock:
+            proc = self._procs.get(sub_id)
+        if proc is None:
+            return False
+        import signal
+
+        try:  # the whole session: entrypoints may spawn children
+            os.killpg(proc.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            proc.terminate()
+        record = self._record(sub_id)
+        if record is not None:
+            record.status = "STOPPED"
+            record.end_time = time.time()
+        return True
+
+    def list(self) -> list[dict]:
+        return [self.status(r.submission_id)
+                for r in self.gcs.list_jobs() if r.submission_id]
+
+    def shutdown(self) -> None:
+        with self._lock:
+            procs = list(self._procs.values())
+        for proc in procs:
+            try:
+                proc.terminate()
+            except OSError:
+                pass
+
+
+class GcsServer:
+    """RPC facade over GlobalControlService + JobManager + cluster KV."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 log_dir: str = "/tmp/ray_tpu/session",
+                 heartbeat_timeout_s: float = 10.0):
+        self.gcs = GlobalControlService()
+        self.jobs = JobManager(self.gcs, os.path.join(log_dir, "jobs"))
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self._server = RpcServer(host, port)
+        self._shutdown = threading.Event()
+        self._register_methods()
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, daemon=True, name="gcs-monitor")
+
+    @property
+    def address(self) -> str:
+        return self._server.address
+
+    def _register_methods(self) -> None:
+        s = self._server
+        s.register("ping", lambda: "pong")
+        # KV (reference: gcs InternalKV service).
+        s.register("kv_put", self.gcs.kv.put)
+        s.register("kv_get", self.gcs.kv.get)
+        s.register("kv_del", self.gcs.kv.delete)
+        s.register("kv_exists", self.gcs.kv.exists)
+        s.register("kv_keys", self.gcs.kv.keys)
+        # Nodes.
+        s.register("register_node", self._register_node)
+        s.register("heartbeat", self._heartbeat)
+        s.register("list_nodes", self._list_nodes)
+        s.register("drain_node", self._drain_node)
+        # Jobs.
+        s.register("submit_job", self.jobs.submit)
+        s.register("job_status", self.jobs.status)
+        s.register("job_logs", self.jobs.logs)
+        s.register("stop_job", self.jobs.stop)
+        s.register("list_jobs", self.jobs.list)
+        # Cluster-wide info.
+        s.register("cluster_resources", self._cluster_resources)
+
+    # -- node service -------------------------------------------------
+    def _register_node(self, address: str, resources: dict,
+                       labels: dict | None = None) -> bytes:
+        node_id = NodeID()
+        self.gcs.register_node(NodeRecord(
+            node_id=node_id, address=address, resources=dict(resources),
+            labels=dict(labels or {})))
+        return node_id.binary()
+
+    def _heartbeat(self, node_id_bytes: bytes) -> bool:
+        self.gcs.heartbeat(NodeID(node_id_bytes))
+        return True
+
+    def _list_nodes(self) -> list[dict]:
+        return [{
+            "node_id": r.node_id.hex(),
+            "address": r.address,
+            "resources": dict(r.resources),
+            "labels": dict(r.labels),
+            "alive": r.alive,
+        } for r in self.gcs.list_nodes()]
+
+    def _drain_node(self, node_id_bytes: bytes) -> bool:
+        self.gcs.mark_node_dead(NodeID(node_id_bytes))
+        return True
+
+    def _cluster_resources(self) -> dict:
+        total: dict[str, float] = {}
+        for r in self.gcs.list_nodes():
+            if not r.alive:
+                continue
+            for k, v in r.resources.items():
+                total[k] = total.get(k, 0.0) + v
+        return total
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self) -> None:
+        self._server.start()
+        self._monitor.start()
+
+    def _monitor_loop(self) -> None:
+        """Mark nodes dead when heartbeats go stale (reference:
+        gcs_health_check_manager.h:39)."""
+        while not self._shutdown.wait(1.0):
+            now = time.monotonic()
+            for record in self.gcs.list_nodes():
+                if record.alive and (now - record.last_heartbeat
+                                     > self.heartbeat_timeout_s):
+                    self.gcs.mark_node_dead(record.node_id)
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        self.jobs.shutdown()
+        self._server.stop()

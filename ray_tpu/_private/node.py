@@ -1,0 +1,144 @@
+"""Node agents + head/worker daemon entrypoints.
+
+Reference: python/ray/_private/node.py (Node starts/owns the per-node
+services) and src/ray/raylet (the node agent registering with the GCS
+and heartbeating). A ray_tpu cluster is:
+
+- ONE head daemon: GcsServer (RPC control plane) + its own node record;
+- N worker daemons: NodeAgent registering resources + heartbeating.
+
+Daemons are started by the CLI (``python -m ray_tpu start``) as
+detached subprocesses with pidfiles under /tmp/ray_tpu (reference:
+``ray start`` spawning raylet/gcs_server with session dirs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+
+from ray_tpu._private.rpc import RpcClient, RpcError
+
+SESSION_DIR = os.environ.get("RAY_TPU_SESSION_DIR", "/tmp/ray_tpu")
+
+
+def _own_address() -> str:
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return "127.0.0.1"
+
+
+class NodeAgent:
+    """Registers this node with the head GCS and heartbeats.
+
+    Reference: the raylet's NodeManager registration +
+    ReportHeartbeat loop."""
+
+    def __init__(self, gcs_address: str, resources: dict,
+                 labels: dict | None = None,
+                 heartbeat_period_s: float = 1.0):
+        self.client = RpcClient(gcs_address)
+        self.resources = dict(resources)
+        self.labels = dict(labels or {})
+        self.heartbeat_period_s = heartbeat_period_s
+        self.node_id: bytes = self.client.call(
+            "register_node", f"{_own_address()}:{os.getpid()}",
+            self.resources, self.labels)
+        self._shutdown = threading.Event()
+        self._thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True, name="node-heartbeat")
+        self._thread.start()
+
+    def _heartbeat_loop(self) -> None:
+        while not self._shutdown.wait(self.heartbeat_period_s):
+            try:
+                self.client.call("heartbeat", self.node_id)
+            except RpcError:
+                pass  # head unreachable; keep trying (it may restart)
+
+    def stop(self, drain: bool = True) -> None:
+        self._shutdown.set()
+        if drain:
+            try:
+                self.client.call("drain_node", self.node_id)
+            except RpcError:
+                pass
+        self.client.close()
+
+
+def default_resources() -> dict:
+    resources = {"CPU": float(os.cpu_count() or 1)}
+    try:
+        from ray_tpu._private import accelerators
+
+        resources.update(accelerators.detect_resources())
+    except Exception:  # noqa: BLE001 — detection is best-effort
+        pass
+    return resources
+
+
+def run_head(port: int, resources: dict | None = None) -> None:
+    """Head daemon: GCS server + own node registration. Blocks."""
+    from ray_tpu._private.gcs_server import GcsServer
+
+    os.makedirs(SESSION_DIR, exist_ok=True)
+    server = GcsServer(port=port, log_dir=SESSION_DIR)
+    server.start()
+    with open(os.path.join(SESSION_DIR, "head_address"), "w") as f:
+        f.write(f"{_own_address()}:{server._server.port}")
+    agent = NodeAgent(f"127.0.0.1:{server._server.port}",
+                      resources or default_resources(),
+                      labels={"node_role": "head"})
+
+    stop_event = threading.Event()
+
+    def on_term(signum, frame):
+        stop_event.set()
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+    try:
+        while not stop_event.wait(0.5):
+            pass
+    finally:
+        agent.stop()
+        server.stop()
+
+
+def run_worker(gcs_address: str, resources: dict | None = None) -> None:
+    """Worker-node daemon: register + heartbeat. Blocks."""
+    agent = NodeAgent(gcs_address, resources or default_resources(),
+                      labels={"node_role": "worker"})
+    stop_event = threading.Event()
+
+    def on_term(signum, frame):
+        stop_event.set()
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
+    try:
+        while not stop_event.wait(0.5):
+            pass
+    finally:
+        agent.stop()
+
+
+def main(argv: list[str]) -> None:
+    role = argv[0]
+    kwargs = json.loads(argv[1]) if len(argv) > 1 else {}
+    if role == "head":
+        run_head(**kwargs)
+    elif role == "worker":
+        run_worker(**kwargs)
+    else:
+        raise SystemExit(f"unknown node role: {role}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,240 @@
+"""RPC layer — length-prefixed pickle messages over TCP.
+
+TPU-native analogue of the reference's gRPC plumbing
+(src/ray/rpc/grpc_server.h, grpc_client.h): every cross-process control
+message in the reference is protobuf-over-gRPC; here it is
+pickle-over-TCP with an 8-byte length prefix. Pickle is acceptable for
+the same reason the reference ships cloudpickle blobs inside its
+protobufs: cluster links are trusted (same security model).
+
+Server: thread-per-connection, sequential dispatch per connection (the
+reference's gRPC servers are also ordered per stream). Client: one
+socket, calls serialized under a lock, transparent reconnect on a dead
+socket (e.g. head restarted).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import threading
+import traceback
+from typing import Any, Callable
+
+_LEN = struct.Struct(">Q")
+MAX_FRAME = 1 << 31  # 2GB sanity bound
+
+
+class RpcError(ConnectionError):
+    """Transport-level failure (peer unreachable / connection lost)."""
+
+
+class RpcMethodError(Exception):
+    """The remote method raised; carries the remote traceback."""
+
+    def __init__(self, cause: BaseException, remote_tb: str):
+        super().__init__(f"{type(cause).__name__}: {cause}")
+        self.cause = cause
+        self.remote_tb = remote_tb
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n > 0:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise RpcError("connection closed by peer")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if length > MAX_FRAME:
+        raise RpcError(f"frame too large: {length}")
+    return _recv_exact(sock, length)
+
+
+class RpcServer:
+    """Serves registered callables; ``register_object`` exposes every
+    public method of an object (the gRPC service pattern)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.host, self.port = self._sock.getsockname()[:2]
+        self._methods: dict[str, Callable] = {}
+        self._shutdown = threading.Event()
+        self._accept_thread: threading.Thread | None = None
+        self._conn_threads: list[threading.Thread] = []
+        self._conns: list[socket.socket] = []
+        self._conns_lock = threading.Lock()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def register(self, name: str, fn: Callable) -> None:
+        self._methods[name] = fn
+
+    def register_object(self, obj: Any, prefix: str = "") -> None:
+        for name in dir(obj):
+            if name.startswith("_"):
+                continue
+            fn = getattr(obj, name)
+            if callable(fn):
+                self._methods[prefix + name] = fn
+
+    def start(self) -> None:
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="rpc-accept")
+        self._accept_thread.start()
+
+    def _accept_loop(self) -> None:
+        self._sock.settimeout(0.5)
+        while not self._shutdown.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._conns_lock:
+                self._conns.append(conn)
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True, name="rpc-conn")
+            t.start()
+            self._conn_threads.append(t)
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._shutdown.is_set():
+                try:
+                    frame = _recv_frame(conn)
+                except RpcError:
+                    return
+                seq, method, args, kwargs = pickle.loads(frame)
+                try:
+                    fn = self._methods[method]
+                except KeyError:
+                    reply = (seq, "err", (KeyError(f"no method {method}"),
+                                          ""))
+                else:
+                    try:
+                        reply = (seq, "ok", fn(*args, **kwargs))
+                    except BaseException as exc:  # noqa: BLE001
+                        tb = traceback.format_exc()
+                        try:
+                            pickle.dumps(exc)
+                        except Exception:
+                            exc = RuntimeError(
+                                f"{type(exc).__name__}: {exc}")
+                        reply = (seq, "err", (exc, tb))
+                try:
+                    _send_frame(conn, pickle.dumps(reply))
+                except OSError:
+                    return
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            with self._conns_lock:
+                try:
+                    self._conns.remove(conn)
+                except ValueError:
+                    pass
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            conns, self._conns = self._conns, []
+        for conn in conns:
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+
+class RpcClient:
+    """One connection; calls are serialized (seq-matched replies)."""
+
+    def __init__(self, address: str, timeout_s: float = 30.0):
+        host, _, port = address.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self.address = f"{self._addr[0]}:{self._addr[1]}"
+        self._timeout = timeout_s
+        self._lock = threading.Lock()
+        self._sock: socket.socket | None = None
+        self._seq = 0
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection(self._addr, timeout=self._timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def call(self, method: str, *args, **kwargs) -> Any:
+        with self._lock:
+            self._seq += 1
+            seq = self._seq
+            request = pickle.dumps((seq, method, args, kwargs))
+            last_exc: Exception | None = None
+            for attempt in range(2):  # one transparent reconnect
+                try:
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    _send_frame(self._sock, request)
+                    rseq, status, payload = pickle.loads(
+                        _recv_frame(self._sock))
+                    if rseq != seq:
+                        raise RpcError(
+                            f"out-of-order reply: {rseq} != {seq}")
+                    break
+                except (OSError, RpcError, EOFError) as exc:
+                    last_exc = exc
+                    if self._sock is not None:
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
+            else:
+                raise RpcError(
+                    f"rpc to {self.address} failed: {last_exc}") \
+                    from last_exc
+        if status == "err":
+            exc, tb = payload
+            raise RpcMethodError(exc, tb)
+        return payload
+
+    def ping(self) -> bool:
+        try:
+            return self.call("ping") == "pong"
+        except (RpcError, RpcMethodError):
+            return False
+
+    def close(self) -> None:
+        with self._lock:
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                self._sock = None

@@ -121,23 +121,6 @@ class ShmObjectWriter:
         arena.seal_pinned(key)
         return ArenaDescriptor(key, size)
 
-    @staticmethod
-    def put_arena(value: Any, arena, key: bytes,
-                  max_bytes: int) -> "ArenaDescriptor | None":
-        """Serialize ``value`` directly into the arena under ``key``.
-
-        Returns None (caller falls back to a dedicated segment) when the
-        value exceeds the small-object cutoff or the arena is full —
-        large objects keep the segment path's true zero-copy reads.
-        """
-        if arena is None:
-            return None
-        header, buffers = serialization.serialize(value)
-        size = serialization.framed_size(header, buffers)
-        if size > max_bytes:
-            return None
-        return ShmObjectWriter.put_arena_serialized(
-            arena, key, header, buffers, size)
 
 
 class ShmClient:

@@ -94,10 +94,34 @@ class Runtime:
         namespace: str = "default",
         process_workers: int | None = None,
         metrics_port: int | None = None,
+        address: str | None = None,
     ):
         cfg = GLOBAL_CONFIG
         self.namespace = namespace
         self.job_id = JobID()
+        # Connected-cluster mode: register this driver with an external
+        # head GCS (python -m ray_tpu start --head) and mirror its node
+        # table into nodes()/state listings. Task execution stays local
+        # to this driver's runtime; the control plane is cluster-wide.
+        self.gcs_client = None
+        self._node_agent = None
+        if address:
+            from ray_tpu._private.node import NodeAgent
+            from ray_tpu._private.rpc import RpcClient, RpcError
+
+            self.gcs_client = RpcClient(address)
+            try:
+                self._node_agent = NodeAgent(
+                    address,
+                    {"CPU": float(num_cpus if num_cpus is not None
+                                  else cfg.num_cpus)},
+                    labels={"node_role": "driver"})
+            except (RpcError, OSError) as exc:
+                self.gcs_client.close()
+                self.gcs_client = None
+                raise ConnectionError(
+                    f"cannot connect to ray_tpu head at {address}: "
+                    f"{exc}") from exc
         self.gcs = GlobalControlService()
         self.store = ObjectStore(
             memory_limit_bytes=(object_store_memory
@@ -125,6 +149,7 @@ class Runtime:
         self.shm_directory = ShmDirectory()
         self.shm_client = ShmClient()
         self.worker_pool = None
+        self._promote_lock = threading.Lock()
         # Native shared arena (plasma-lite, _native/plasma_store.cpp):
         # the driver owns it; pool workers attach via RAY_TPU_ARENA_NAME.
         # Best-effort — without a C++ toolchain everything stays on the
@@ -454,29 +479,37 @@ class Runtime:
 
     def _promote_to_shm(self, ref: ObjectRef):
         """Object directory lookup-or-promote: make a driver-held object
-        reachable by worker processes via a shared-memory segment."""
+        reachable by worker processes via a shared-memory segment.
+
+        Serialized under a lock: two dispatcher threads promoting the
+        same ref concurrently would otherwise race the arena's
+        duplicate-id check and leak a pinned arena entry.
+        """
         from ray_tpu._private.shm_store import ShmObjectWriter
 
         from ray_tpu._private import serialization
 
-        desc = self.shm_directory.lookup(ref.id())
-        if desc is not None:
+        with self._promote_lock:
+            desc = self.shm_directory.lookup(ref.id())
+            if desc is not None:
+                return desc
+            value = self.store.get(ref.id())  # deps sealed at dispatch
+            header, buffers = serialization.serialize(value)
+            size = serialization.framed_size(header, buffers)
+            if (self.arena is not None and size <= int(
+                    GLOBAL_CONFIG.object_arena_max_object_bytes)):
+                # Arena-first: keyed by the object id, so repeated
+                # promotes of the same object are one table hit, not a
+                # new segment.
+                adesc = ShmObjectWriter.put_arena_serialized(
+                    self.arena, ref.id().binary(), header, buffers, size)
+                if adesc is not None:
+                    self.shm_directory.register_arena(ref.id(), adesc)
+                    return adesc
+            desc, seg = ShmObjectWriter.put_serialized(
+                header, buffers, size)
+            self.shm_directory.register(ref.id(), desc, seg)
             return desc
-        value = self.store.get(ref.id())  # deps already sealed at dispatch
-        header, buffers = serialization.serialize(value)
-        size = serialization.framed_size(header, buffers)
-        if (self.arena is not None
-                and size <= int(GLOBAL_CONFIG.object_arena_max_object_bytes)):
-            # Arena-first: keyed by the object id, so repeated promotes
-            # of the same object are one table hit, not a new segment.
-            adesc = ShmObjectWriter.put_arena_serialized(
-                self.arena, ref.id().binary(), header, buffers, size)
-            if adesc is not None:
-                self.shm_directory.register_arena(ref.id(), adesc)
-                return adesc
-        desc, seg = ShmObjectWriter.put_serialized(header, buffers, size)
-        self.shm_directory.register(ref.id(), desc, seg)
-        return desc
 
     def _maybe_retry(self, spec: TaskSpec, exc: BaseException) -> bool:
         """Owner-driven retry (reference: task_manager.h:195, max_task_retries
@@ -839,6 +872,12 @@ class Runtime:
         return self.cluster.available_resources()
 
     def shutdown(self) -> None:
+        if self._node_agent is not None:
+            self._node_agent.stop()
+            self._node_agent = None
+        if self.gcs_client is not None:
+            self.gcs_client.close()
+            self.gcs_client = None
         if self.metrics_agent is not None:
             self.metrics_agent.shutdown()
         self.health_monitor.shutdown()
@@ -877,9 +916,15 @@ def init(
     logging_level: str | None = None,
     process_workers: int | None = None,
     metrics_port: int | None = None,
+    address: str | None = None,
     **_ignored,
 ) -> Runtime:
-    """Initialize the runtime (reference: ray.init, worker.py:1219)."""
+    """Initialize the runtime (reference: ray.init, worker.py:1219).
+
+    ``address="host:port"`` connects to a running head's GCS
+    (``python -m ray_tpu start --head``); ``address="auto"`` resolves it
+    from RAY_TPU_ADDRESS or the local head's session file.
+    """
     import os as _os
 
     if _os.environ.get("RAY_TPU_IN_POOL_WORKER"):
@@ -898,10 +943,20 @@ def init(
             GLOBAL_CONFIG.update(system_config)
         if logging_level:
             logging.getLogger("ray_tpu").setLevel(logging_level)
+        if address == "auto":
+            from ray_tpu.scripts import resolve_address
+
+            try:
+                address = resolve_address(None)
+            except SystemExit as exc:
+                # resolve_address is CLI-oriented; surface a catchable
+                # library error here instead of exiting the process.
+                raise ConnectionError(str(exc)) from None
         _runtime = Runtime(
             num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
             object_store_memory=object_store_memory, namespace=namespace,
-            process_workers=process_workers, metrics_port=metrics_port)
+            process_workers=process_workers, metrics_port=metrics_port,
+            address=address)
         atexit.register(_atexit_shutdown)
         return _runtime
 
@@ -985,7 +1040,7 @@ def available_resources() -> dict[str, float]:
 
 def nodes() -> list[dict]:
     runtime = _require_runtime()
-    return [
+    out = [
         {
             "NodeID": r.node_id.hex(),
             "Alive": r.alive,
@@ -995,6 +1050,21 @@ def nodes() -> list[dict]:
         }
         for r in runtime.gcs.list_nodes()
     ]
+    if runtime.gcs_client is not None:
+        from ray_tpu._private.rpc import RpcError
+
+        try:
+            for n in runtime.gcs_client.call("list_nodes"):
+                out.append({
+                    "NodeID": n["node_id"],
+                    "Alive": n["alive"],
+                    "Resources": n["resources"],
+                    "Labels": n["labels"],
+                    "NodeManagerAddress": n["address"],
+                })
+        except RpcError:
+            pass  # head unreachable; local view only
+    return out
 
 
 def timeline() -> list[dict]:

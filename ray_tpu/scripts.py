@@ -1,0 +1,254 @@
+"""ray_tpu CLI — cluster lifecycle + job submission.
+
+Reference: python/ray/scripts/scripts.py (``ray start``:566, ``ray
+stop``:1042, ``ray status``, ``ray job ...`` via
+dashboard/modules/job/cli.py). Usage::
+
+    python -m ray_tpu start --head [--port 6379]
+    python -m ray_tpu start --address HOST:PORT        # join as worker
+    python -m ray_tpu status [--address HOST:PORT]
+    python -m ray_tpu stop
+    python -m ray_tpu job submit [--address A] -- python script.py
+    python -m ray_tpu job {status,logs,stop} SUBMISSION_ID
+    python -m ray_tpu job list
+    python -m ray_tpu list {tasks,actors,objects,nodes,...}  # state CLI
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+SESSION_DIR = os.environ.get("RAY_TPU_SESSION_DIR", "/tmp/ray_tpu")
+
+
+def _pidfile(role: str) -> str:
+    return os.path.join(SESSION_DIR, f"{role}.pid")
+
+
+def _head_address_file() -> str:
+    return os.path.join(SESSION_DIR, "head_address")
+
+
+def resolve_address(address: str | None) -> str:
+    """CLI --address, RAY_TPU_ADDRESS env, or the local head's file."""
+    if address:
+        return address
+    env = os.environ.get("RAY_TPU_ADDRESS")
+    if env:
+        return env
+    try:
+        with open(_head_address_file()) as f:
+            return f.read().strip()
+    except FileNotFoundError:
+        raise SystemExit(
+            "no cluster address: pass --address, set RAY_TPU_ADDRESS, or "
+            "start a head on this machine (python -m ray_tpu start --head)")
+
+
+def _spawn_daemon(role: str, kwargs: dict) -> int:
+    os.makedirs(SESSION_DIR, exist_ok=True)
+    log = open(os.path.join(SESSION_DIR, f"{role}.log"), "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.node", role,
+         json.dumps(kwargs)],
+        stdout=log, stderr=subprocess.STDOUT, start_new_session=True)
+    with open(_pidfile(role), "w") as f:
+        f.write(str(proc.pid))
+    return proc.pid
+
+
+def cmd_start(args) -> int:
+    from ray_tpu._private.rpc import RpcClient
+
+    if args.head:
+        pid = _spawn_daemon("head", {"port": args.port})
+        # Wait for the head to publish its address.
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                with open(_head_address_file()) as f:
+                    address = f.read().strip()
+                if address and RpcClient(address, timeout_s=2).ping():
+                    print(f"ray_tpu head started (pid {pid}) at {address}")
+                    print(f"  connect workers:  python -m ray_tpu start "
+                          f"--address {address}")
+                    print(f"  submit jobs:      python -m ray_tpu job "
+                          f"submit --address {address} -- <cmd>")
+                    return 0
+            except (FileNotFoundError, OSError):
+                pass
+            time.sleep(0.2)
+        print("head failed to start; see "
+              f"{os.path.join(SESSION_DIR, 'head.log')}", file=sys.stderr)
+        return 1
+    if not args.address:
+        print("start requires --head or --address", file=sys.stderr)
+        return 1
+    resources = {}
+    if args.num_cpus is not None:
+        resources["CPU"] = float(args.num_cpus)
+    pid = _spawn_daemon("worker", {
+        "gcs_address": args.address,
+        "resources": resources or None})
+    print(f"ray_tpu worker started (pid {pid}), joining {args.address}")
+    return 0
+
+
+def cmd_stop(args) -> int:
+    stopped = 0
+    for role in ("worker", "head"):
+        path = _pidfile(role)
+        try:
+            with open(path) as f:
+                pid = int(f.read().strip())
+        except (FileNotFoundError, ValueError):
+            continue
+        try:
+            os.kill(pid, signal.SIGTERM)
+            stopped += 1
+            print(f"stopped {role} (pid {pid})")
+        except ProcessLookupError:
+            pass
+        os.remove(path)
+    if stopped == 0:
+        print("no ray_tpu daemons found")
+    return 0
+
+
+def cmd_status(args) -> int:
+    from ray_tpu._private.rpc import RpcClient, RpcError
+
+    address = resolve_address(args.address)
+    client = RpcClient(address, timeout_s=5)
+    try:
+        nodes = client.call("list_nodes")
+        resources = client.call("cluster_resources")
+        jobs = client.call("list_jobs")
+    except RpcError as exc:
+        print(f"cannot reach GCS at {address}: {exc}", file=sys.stderr)
+        return 1
+    alive = [n for n in nodes if n["alive"]]
+    print(f"cluster at {address}: {len(alive)} alive node(s), "
+          f"{len(nodes) - len(alive)} dead")
+    for n in nodes:
+        state = "ALIVE" if n["alive"] else "DEAD"
+        role = n["labels"].get("node_role", "?")
+        res = " ".join(f"{k}={v:g}" for k, v in sorted(
+            n["resources"].items()))
+        print(f"  {state:<5} {role:<6} {n['node_id'][:12]}  {res}")
+    print("total resources: " + " ".join(
+        f"{k}={v:g}" for k, v in sorted(resources.items())))
+    running = [j for j in jobs if j and j["status"] == "RUNNING"]
+    if running:
+        print(f"jobs running: {len(running)}")
+    return 0
+
+
+def cmd_job(args) -> int:
+    from ray_tpu._private.rpc import RpcClient, RpcError
+
+    address = resolve_address(args.address)
+    client = RpcClient(address, timeout_s=10)
+    try:
+        if args.job_cmd == "submit":
+            import shlex
+
+            # shlex.join preserves each token through the head's shell.
+            entrypoint = shlex.join(args.entrypoint)
+            if not entrypoint:
+                print("job submit requires an entrypoint after --",
+                      file=sys.stderr)
+                return 1
+            env = {}
+            env["RAY_TPU_ADDRESS"] = address
+            if args.working_dir:
+                sub_id = client.call(
+                    "submit_job", entrypoint, env=env,
+                    cwd=os.path.abspath(args.working_dir))
+            else:
+                sub_id = client.call("submit_job", entrypoint, env=env)
+            print(sub_id)
+            return 0
+        if args.job_cmd == "status":
+            status = client.call("job_status", args.submission_id)
+            if status is None:
+                print(f"no such job: {args.submission_id}",
+                      file=sys.stderr)
+                return 1
+            print(json.dumps(status, indent=2))
+            return 0
+        if args.job_cmd == "logs":
+            sys.stdout.buffer.write(
+                client.call("job_logs", args.submission_id))
+            return 0
+        if args.job_cmd == "stop":
+            ok = client.call("stop_job", args.submission_id)
+            print("stopped" if ok else "not running")
+            return 0
+        if args.job_cmd == "list":
+            for status in client.call("list_jobs"):
+                if status:
+                    print(f"{status['submission_id']:<26} "
+                          f"{status['status']:<10} {status['entrypoint']}")
+            return 0
+    except RpcError as exc:
+        print(f"cannot reach GCS at {address}: {exc}", file=sys.stderr)
+        return 1
+    return 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # `list ...` routes to the state CLI (ray_tpu/util/state).
+    if argv and argv[0] in ("list", "summary", "timeline"):
+        from ray_tpu.util.state.api import _cli
+
+        return _cli(argv)
+
+    parser = argparse.ArgumentParser(prog="ray_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p_start = sub.add_parser("start", help="start a head or worker daemon")
+    p_start.add_argument("--head", action="store_true")
+    p_start.add_argument("--port", type=int, default=6379)
+    p_start.add_argument("--address", help="head GCS address (worker mode)")
+    p_start.add_argument("--num-cpus", type=float, default=None)
+    p_start.set_defaults(fn=cmd_start)
+
+    p_stop = sub.add_parser("stop", help="stop local daemons")
+    p_stop.set_defaults(fn=cmd_stop)
+
+    p_status = sub.add_parser("status", help="show cluster nodes/resources")
+    p_status.add_argument("--address", default=None)
+    p_status.set_defaults(fn=cmd_status)
+
+    p_job = sub.add_parser("job", help="job submission API")
+    jsub = p_job.add_subparsers(dest="job_cmd", required=True)
+    p_submit = jsub.add_parser("submit")
+    p_submit.add_argument("--address", default=None)
+    p_submit.add_argument("--working-dir", default=None)
+    p_submit.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    for name in ("status", "logs", "stop"):
+        p = jsub.add_parser(name)
+        p.add_argument("submission_id")
+        p.add_argument("--address", default=None)
+    p_list = jsub.add_parser("list")
+    p_list.add_argument("--address", default=None)
+    p_job.set_defaults(fn=cmd_job)
+
+    args = parser.parse_args(argv)
+    # Strip the leading "--" separator from a REMAINDER entrypoint.
+    entry = getattr(args, "entrypoint", None)
+    if entry and entry[0] == "--":
+        args.entrypoint = entry[1:]
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

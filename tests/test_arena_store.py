@@ -204,3 +204,26 @@ v = a.create_for_write({leak_key!r}, 400_000)
     assert not arena.contains(leak_key)
     # The leaked 400KB was reclaimed (now reused by live objects).
     assert stats["num_evictions"] >= 1
+
+
+def test_empty_object_roundtrip(arena):
+    oid = os.urandom(16)
+    assert arena.put_bytes(oid, [])
+    assert arena.contains(oid)
+    assert arena.get_bytes(oid) == b""
+
+
+def test_tombstone_cleanup_keeps_lookups_fast(arena):
+    """Churn far more objects than table slots; misses must stay fast
+    (tombstones are cleared back to empty when chains allow)."""
+    import time as _time
+
+    for _ in range(3000):  # 256-slot table, ~12x churn
+        oid = os.urandom(16)
+        assert arena.put_bytes(oid, [b"t"])
+        arena.delete(oid)
+    t0 = _time.perf_counter()
+    for _ in range(1000):
+        arena.contains(os.urandom(16))  # guaranteed misses
+    per_miss = (_time.perf_counter() - t0) / 1000
+    assert per_miss < 200e-6, f"lookup miss degraded to {per_miss*1e6:.0f}us"

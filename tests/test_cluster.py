@@ -1,0 +1,237 @@
+"""Cluster lifecycle tests: RPC layer, GCS server, node agents, job
+submission, CLI.
+
+Reference test intent: python/ray/tests/test_cli.py (ray start/stop/
+status), test_job_manager.py (submit/status/logs/stop), and the gcs
+heartbeat tests (gcs_health_check_manager).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ray_tpu._private.gcs_server import GcsServer
+from ray_tpu._private.node import NodeAgent
+from ray_tpu._private.rpc import (
+    RpcClient,
+    RpcError,
+    RpcMethodError,
+    RpcServer,
+)
+
+
+# ---------------------------------------------------------------- rpc
+def test_rpc_roundtrip_and_errors():
+    server = RpcServer(host="127.0.0.1")
+    server.register("add", lambda a, b: a + b)
+    server.register("boom", lambda: 1 / 0)
+    server.register("ping", lambda: "pong")
+    server.start()
+    try:
+        client = RpcClient(server.address)
+        assert client.call("add", 2, 3) == 5
+        assert client.call("add", a=10, b=20) == 30
+        assert client.ping()
+        with pytest.raises(RpcMethodError) as exc_info:
+            client.call("boom")
+        assert isinstance(exc_info.value.cause, ZeroDivisionError)
+        assert "ZeroDivisionError" in exc_info.value.remote_tb
+        with pytest.raises(RpcMethodError):
+            client.call("no_such_method")
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_rpc_client_reconnects():
+    server = RpcServer(host="127.0.0.1")
+    server.register("echo", lambda x: x)
+    server.start()
+    client = RpcClient(server.address)
+    assert client.call("echo", "a") == "a"
+    # Kill the client's socket out from under it; the next call must
+    # transparently reconnect.
+    client._sock.close()
+    assert client.call("echo", "b") == "b"
+    server.stop()
+    with pytest.raises(RpcError):
+        client.call("echo", "c")
+
+
+def test_rpc_large_payload():
+    server = RpcServer(host="127.0.0.1")
+    server.register("length", lambda blob: len(blob))
+    server.start()
+    try:
+        client = RpcClient(server.address)
+        assert client.call("length", b"x" * (5 << 20)) == 5 << 20
+    finally:
+        server.stop()
+
+
+# --------------------------------------------------------- gcs server
+@pytest.fixture
+def gcs(tmp_path):
+    server = GcsServer(host="127.0.0.1", log_dir=str(tmp_path),
+                       heartbeat_timeout_s=1.0)
+    server.start()
+    yield server
+    server.stop()
+
+
+def test_node_register_heartbeat_death(gcs):
+    client = RpcClient(gcs.address)
+    agent = NodeAgent(gcs.address, {"CPU": 4.0},
+                      labels={"node_role": "worker"},
+                      heartbeat_period_s=0.2)
+    nodes = client.call("list_nodes")
+    assert len(nodes) == 1 and nodes[0]["alive"]
+    assert nodes[0]["resources"] == {"CPU": 4.0}
+    assert client.call("cluster_resources") == {"CPU": 4.0}
+
+    # Stop heartbeating (no drain): the monitor must mark it dead.
+    agent._shutdown.set()
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        nodes = client.call("list_nodes")
+        if not nodes[0]["alive"]:
+            break
+        time.sleep(0.2)
+    assert not nodes[0]["alive"], "stale node never marked dead"
+    assert client.call("cluster_resources") == {}
+    agent.client.close()
+
+
+def test_node_drain_on_stop(gcs):
+    client = RpcClient(gcs.address)
+    agent = NodeAgent(gcs.address, {"CPU": 2.0}, heartbeat_period_s=0.2)
+    agent.stop(drain=True)
+    nodes = client.call("list_nodes")
+    assert len(nodes) == 1 and not nodes[0]["alive"]
+
+
+def test_gcs_kv(gcs):
+    client = RpcClient(gcs.address)
+    client.call("kv_put", b"k1", b"v1")
+    assert client.call("kv_get", b"k1") == b"v1"
+    assert client.call("kv_exists", b"k1")
+    assert client.call("kv_keys", b"k") == [b"k1"]
+    client.call("kv_del", b"k1")
+    assert client.call("kv_get", b"k1") is None
+
+
+# ---------------------------------------------------------------- jobs
+def test_job_submit_success_and_logs(gcs):
+    client = RpcClient(gcs.address)
+    sub_id = client.call(
+        "submit_job", f"{sys.executable} -c 'print(6*7)'")
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        status = client.call("job_status", sub_id)
+        if status["status"] in ("SUCCEEDED", "FAILED"):
+            break
+        time.sleep(0.2)
+    assert status["status"] == "SUCCEEDED", status
+    assert b"42" in client.call("job_logs", sub_id)
+    assert any(j["submission_id"] == sub_id
+               for j in client.call("list_jobs"))
+
+
+def test_job_failure_reported(gcs):
+    client = RpcClient(gcs.address)
+    sub_id = client.call(
+        "submit_job", f"{sys.executable} -c 'raise SystemExit(3)'")
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        status = client.call("job_status", sub_id)
+        if status["status"] in ("SUCCEEDED", "FAILED"):
+            break
+        time.sleep(0.2)
+    assert status["status"] == "FAILED"
+    assert "exit code 3" in status["message"]
+
+
+def test_job_stop(gcs):
+    client = RpcClient(gcs.address)
+    sub_id = client.call(
+        "submit_job", f"{sys.executable} -c 'import time; time.sleep(60)'")
+    time.sleep(0.5)
+    assert client.call("stop_job", sub_id)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        status = client.call("job_status", sub_id)
+        if status["status"] in ("STOPPED", "FAILED"):
+            break
+        time.sleep(0.2)
+    assert status["status"] in ("STOPPED", "FAILED")
+    assert client.call("job_status", "raysubmit_nonexistent") is None
+
+
+# -------------------------------------------------------- driver mode
+def test_init_address_registers_driver(gcs):
+    import ray_tpu
+
+    ray_tpu.shutdown()
+    runtime = ray_tpu.init(num_cpus=2, address=gcs.address)
+    try:
+        client = RpcClient(gcs.address)
+        roles = [n["labels"].get("node_role")
+                 for n in client.call("list_nodes")]
+        assert "driver" in roles
+        # nodes() merges local virtual nodes with the cluster view.
+        merged_roles = [n["Labels"].get("node_role", "")
+                        for n in ray_tpu.nodes()]
+        assert "driver" in merged_roles
+    finally:
+        ray_tpu.shutdown()
+    # Shutdown drains the driver node.
+    nodes = RpcClient(gcs.address).call("list_nodes")
+    driver_nodes = [n for n in nodes
+                    if n["labels"].get("node_role") == "driver"]
+    assert driver_nodes and not driver_nodes[0]["alive"]
+
+
+# ----------------------------------------------------------------- cli
+def test_cli_start_status_job_stop(tmp_path):
+    """Full daemonized lifecycle through the real CLI."""
+    env = dict(os.environ)
+    env["RAY_TPU_SESSION_DIR"] = str(tmp_path)
+    env["RAY_TPU_SKIP_TPU_DETECTION"] = "1"
+
+    def cli(*args, timeout=60):
+        return subprocess.run(
+            [sys.executable, "-m", "ray_tpu", *args],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd="/")  # cwd outside the repo: PYTHONPATH must carry
+
+    env["PYTHONPATH"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    try:
+        out = cli("start", "--head", "--port", "0")
+        assert out.returncode == 0, out.stderr + out.stdout
+        address = open(tmp_path / "head_address").read().strip()
+
+        out = cli("status", "--address", address)
+        assert out.returncode == 0
+        assert "1 alive node" in out.stdout
+
+        out = cli("job", "submit", "--address", address, "--",
+                  sys.executable, "-c", "print('cli-job-ok')")
+        assert out.returncode == 0, out.stderr
+        sub_id = out.stdout.strip()
+        deadline = time.time() + 30
+        status = ""
+        while time.time() < deadline:
+            out = cli("job", "status", sub_id, "--address", address)
+            if '"SUCCEEDED"' in out.stdout or '"FAILED"' in out.stdout:
+                status = out.stdout
+                break
+            time.sleep(0.3)
+        assert '"SUCCEEDED"' in status, status
+        out = cli("job", "logs", sub_id, "--address", address)
+        assert "cli-job-ok" in out.stdout
+    finally:
+        cli("stop")
