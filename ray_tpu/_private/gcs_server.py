@@ -44,6 +44,10 @@ class JobManager:
                env: dict | None = None, cwd: str | None = None) -> str:
         job_id = JobID()
         sub_id = submission_id or f"raysubmit_{job_id.hex()[:12]}"
+        # Idempotent on submission_id: a client retrying a dropped RPC
+        # (rpc.py reconnect) must not launch the entrypoint twice.
+        if submission_id is not None and self._record(sub_id) is not None:
+            return sub_id
         log_path = os.path.join(self.log_dir, f"{sub_id}.log")
         full_env = dict(os.environ)
         # A submitted driver connects back to THIS head by default.
@@ -84,11 +88,15 @@ class JobManager:
     def _wait(self, sub_id: str, job_id: JobID,
               proc: subprocess.Popen) -> None:
         rc = proc.wait()
-        status = "SUCCEEDED" if rc == 0 else "FAILED"
-        self.gcs.finish_job(job_id, status=status)
         record = self._record(sub_id)
-        if record is not None:
-            record.message = f"exit code {rc}"
+        if record is not None and record.status == "STOPPED":
+            # User-stopped (SIGTERM): keep STOPPED, don't report FAILED.
+            self.gcs.finish_job(job_id, status="STOPPED")
+        else:
+            self.gcs.finish_job(
+                job_id, status="SUCCEEDED" if rc == 0 else "FAILED")
+            if record is not None:
+                record.message = f"exit code {rc}"
         with self._lock:
             self._procs.pop(sub_id, None)
 

@@ -74,7 +74,6 @@ class RpcServer:
         self._methods: dict[str, Callable] = {}
         self._shutdown = threading.Event()
         self._accept_thread: threading.Thread | None = None
-        self._conn_threads: list[threading.Thread] = []
         self._conns: list[socket.socket] = []
         self._conns_lock = threading.Lock()
 
@@ -110,10 +109,8 @@ class RpcServer:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             with self._conns_lock:
                 self._conns.append(conn)
-            t = threading.Thread(target=self._serve_conn, args=(conn,),
-                                 daemon=True, name="rpc-conn")
-            t.start()
-            self._conn_threads.append(t)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="rpc-conn").start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
         try:

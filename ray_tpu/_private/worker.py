@@ -59,6 +59,22 @@ from ray_tpu.exceptions import (
 
 logger = logging.getLogger("ray_tpu")
 
+_runtime_env_warned = False
+
+
+def _warn_runtime_env_ignored(context: str) -> None:
+    """runtime_env only takes effect across a process boundary (pool
+    workers / process actors); warn once when it is silently dropped."""
+    global _runtime_env_warned
+    if _runtime_env_warned:
+        return
+    _runtime_env_warned = True
+    logger.warning(
+        "runtime_env is ignored for thread-mode execution (%s): "
+        "env_vars/working_dir need a process boundary — enable the "
+        "worker pool (init(process_workers=N)) or use process=True "
+        "actors", context)
+
 _runtime_lock = threading.Lock()
 _runtime: "Runtime | None" = None
 
@@ -372,6 +388,9 @@ class Runtime:
             else:
                 ran_on_pool = False
             if not ran_on_pool:
+                if spec.runtime_env:
+                    _warn_runtime_env_ignored(
+                        f"task {spec.name!r} runs in-thread")
                 resolved_args, resolved_kwargs, _ = resolve_args(
                     spec.args, spec.kwargs, lambda ref: self.get([ref])[0])
                 if block_ctx is not None:
@@ -427,7 +446,7 @@ class Runtime:
         try:
             results = self.worker_pool.run_task_blobs(
                 digest, func_blob, args_blob, spec.num_returns,
-                spec.return_ids)
+                spec.return_ids, runtime_env=spec.runtime_env)
         except _RemoteTaskError as rte:
             rte.cause.__ray_tpu_remote_tb__ = rte.remote_tb
             raise rte.cause from None
@@ -573,6 +592,7 @@ class Runtime:
         scheduling_strategy: SchedulingStrategy | None = None,
         get_if_exists: bool = False,
         process: bool = False,
+        runtime_env: dict | None = None,
     ) -> tuple[ActorID, ObjectRef]:
         """Reference: CoreWorker::CreateActor (core_worker.cc:2069) +
         GcsActorManager registration."""
@@ -665,8 +685,12 @@ class Runtime:
                     max_restarts=max_restarts,
                     max_pending_calls=max_pending_calls,
                     creation_return_id=creation_rid, on_death=on_death,
-                    on_restart=on_restart)
+                    on_restart=on_restart, runtime_env=runtime_env)
             else:
+                if runtime_env:
+                    _warn_runtime_env_ignored(
+                        f"actor {cls.__name__} runs in-process "
+                        "(pass process=True)")
                 actor = LocalActor(
                     actor_id, cls, args, kwargs, self,
                     max_concurrency=max_concurrency, max_restarts=max_restarts,

@@ -81,6 +81,50 @@ def _exception_blob(exc: BaseException) -> bytes:
             (RuntimeError(f"{type(exc).__name__}: {exc}"), tb))
 
 
+class _runtime_env_ctx:
+    """Apply a runtime_env around one task execution in the worker
+    process (reference: python/ray/_private/runtime_env/ — per-worker
+    env_vars and working_dir; our pool workers are shared, so the env
+    is applied per-task and restored after)."""
+
+    def __init__(self, runtime_env: dict | None):
+        self.env = runtime_env or {}
+        self._saved_vars: dict[str, str | None] = {}
+        self._saved_cwd: str | None = None
+        self._added_sys_path: str | None = None
+
+    def __enter__(self):
+        for k, v in (self.env.get("env_vars") or {}).items():
+            self._saved_vars[k] = os.environ.get(k)
+            os.environ[k] = str(v)
+        working_dir = self.env.get("working_dir")
+        if working_dir:
+            self._saved_cwd = os.getcwd()
+            os.chdir(working_dir)
+            if working_dir not in sys.path:
+                sys.path.insert(0, working_dir)
+                self._added_sys_path = working_dir
+        return self
+
+    def __exit__(self, *exc):
+        if self._saved_cwd is not None:
+            try:
+                os.chdir(self._saved_cwd)
+            except OSError:
+                pass
+        if self._added_sys_path is not None:
+            try:
+                sys.path.remove(self._added_sys_path)
+            except ValueError:
+                pass
+        for k, old in self._saved_vars.items():
+            if old is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = old
+        return None
+
+
 def _resolve_shm_args(args, kwargs, client: ShmClient):
     args = tuple(client.get(a.desc) if isinstance(a, _ShmRef) else a
                  for a in args)
@@ -175,7 +219,7 @@ def _serve(conn, client: ShmClient, arena=None,
             elif kind == "ping":
                 conn.send(("pong", os.getpid()))
             elif kind == "task":
-                _, digest, func_blob, args_blob, n_returns = msg
+                _, digest, func_blob, args_blob, n_returns, renv = msg
                 if func_blob is not None:
                     func = serialization.loads_function(func_blob)
                     func_cache[digest] = func
@@ -184,7 +228,8 @@ def _serve(conn, client: ShmClient, arena=None,
                 args, kwargs = serialization.deserialize_from_buffer(
                     memoryview(args_blob))
                 args, kwargs = _resolve_shm_args(args, kwargs, client)
-                result = func(*args, **kwargs)
+                with _runtime_env_ctx(renv):
+                    result = func(*args, **kwargs)
                 if n_returns == 0:
                     values = []
                 elif n_returns == 1:
@@ -198,11 +243,14 @@ def _serve(conn, client: ShmClient, arena=None,
                     values = list(result)
                 conn.send(("ok", _pack_results(values, arena, arena_max)))
             elif kind == "actor_new":
-                _, cls_blob, args_blob = msg
+                _, cls_blob, args_blob, renv = msg
                 cls = serialization.loads_function(cls_blob)
                 args, kwargs = serialization.deserialize_from_buffer(
                     memoryview(args_blob))
                 args, kwargs = _resolve_shm_args(args, kwargs, client)
+                # Actor runtime_env applies for the actor's whole life:
+                # this worker process is dedicated to it.
+                _runtime_env_ctx(renv).__enter__()
                 actor_instance = cls(*args, **kwargs)
                 conn.send(("ok", None))
             elif kind == "actor_call":
@@ -427,8 +475,9 @@ class WorkerPool:
         return serialization.serialize_framed((conv_args, conv_kwargs))
 
     def run_task_blobs(self, digest: str, func_blob: bytes, args_blob: bytes,
-                       n_returns: int,
-                       return_ids: list[ObjectID]) -> list[tuple[ObjectID, Any]]:
+                       n_returns: int, return_ids: list[ObjectID],
+                       runtime_env: dict | None = None,
+                       ) -> list[tuple[ObjectID, Any]]:
         """Execute on a pool worker; returns [(return_id, value)] pairs.
 
         The function blob only crosses the pipe the first time a given
@@ -446,7 +495,8 @@ class WorkerPool:
             send_blob = None if digest in worker.known_digests else func_blob
             try:
                 reply = worker.request(
-                    ("task", digest, send_blob, args_blob, n_returns))
+                    ("task", digest, send_blob, args_blob, n_returns,
+                     runtime_env))
             except _WorkerUnavailable:
                 continue  # _release (in finally) already spawns a live one
             finally:
@@ -522,11 +572,13 @@ class ProcessActor:
                  max_pending_calls: int = -1,
                  creation_return_id: ObjectID | None = None,
                  on_death: Callable[[ActorID, str], None] | None = None,
-                 on_restart: Callable[[ActorID], None] | None = None):
+                 on_restart: Callable[[ActorID], None] | None = None,
+                 runtime_env: dict | None = None):
         import queue as queue_mod
 
         self.actor_id = actor_id
         self._cls = cls
+        self._runtime_env = runtime_env
         self._init_args = init_args
         self._init_kwargs = init_kwargs
         self._runtime = runtime
@@ -601,7 +653,8 @@ class ProcessActor:
             self._worker = PoolWorker(-1)
             cls_blob = serialization.dumps_function(self._cls)
             args_blob = self._marshal(self._init_args, self._init_kwargs)
-            reply = self._worker.request(("actor_new", cls_blob, args_blob))
+            reply = self._worker.request(
+                ("actor_new", cls_blob, args_blob, self._runtime_env))
             if reply[0] == "err":
                 exc, tb = serialization.deserialize_from_buffer(
                     memoryview(reply[1]))

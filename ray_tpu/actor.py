@@ -43,6 +43,13 @@ class ActorMethod:
             return refs[0]
         return refs
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node over a live actor (reference: ray.dag
+        ClassMethodNode)."""
+        from ray_tpu.dag import ClassMethodNode
+
+        return ClassMethodNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"Actor method '{self._method_name}' cannot be called directly; "
@@ -132,6 +139,7 @@ class ActorClass:
             scheduling_strategy=_build_strategy(opts),
             get_if_exists=opts.get("get_if_exists", False),
             process=opts.get("process", False),
+            runtime_env=opts.get("runtime_env"),
         )
         handle = ActorHandle(actor_id, self._cls.__name__)
         handle._creation_ref = creation_ref  # keeps creation error observable

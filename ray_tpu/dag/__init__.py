@@ -1,0 +1,240 @@
+"""ray_tpu.dag — lazy task graphs with ``.bind()`` and compiled DAGs.
+
+Reference: python/ray/dag/ (DAGNode, FunctionNode, InputNode;
+``dag_node.execute()``) and compiled_dag_node.py (accelerated DAG:
+compile a static graph once, then execute repeatedly with pre-wired
+channels instead of per-call task submission).
+
+TPU-first shape of the compiled path: the graph is resolved to a
+topological schedule ONCE, and execute() walks that schedule calling
+bound functions/actor methods DIRECTLY (no per-call scheduler/lease
+round trip) passing values in memory — the same latency motivation as
+the reference's channel-based compiled DAG, adapted to the
+single-process driver runtime.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+
+class DAGNode:
+    """Base: a lazy computation; ``execute()`` materializes the graph."""
+
+    def execute(self, *input_args, **input_kwargs):
+        """Run the graph through the normal task path (ObjectRefs +
+        scheduler), returning this node's result (reference:
+        dag_node.py execute -> ObjectRef; we return the value for
+        ergonomic parity with compiled execute)."""
+        import ray_tpu
+
+        ref_or_val = _submit(self, input_args, input_kwargs, {})
+        from ray_tpu._private.object_ref import ObjectRef
+
+        if isinstance(ref_or_val, ObjectRef):
+            return ray_tpu.get(ref_or_val)
+        return ref_or_val
+
+    def experimental_compile(self) -> "CompiledDAG":
+        return CompiledDAG(self)
+
+    # -- traversal ----------------------------------------------------
+    def _children(self) -> list["DAGNode"]:
+        out = []
+        for a in getattr(self, "args", ()):  # type: ignore[attr-defined]
+            if isinstance(a, DAGNode):
+                out.append(a)
+        for v in getattr(self, "kwargs", {}).values():  # type: ignore
+            if isinstance(v, DAGNode):
+                out.append(v)
+        return out
+
+
+class InputNode(DAGNode):
+    """Placeholder for execute()-time input (reference: input_node.py).
+
+    Supports ``with InputNode() as inp:`` for parity with reference
+    examples; subscripting (``inp[0]``/``inp["key"]``) selects one
+    positional/keyword input.
+    """
+
+    def __init__(self):
+        self.args = ()
+        self.kwargs = {}
+
+    def __enter__(self) -> "InputNode":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+    def __getitem__(self, key) -> "InputAttributeNode":
+        return InputAttributeNode(self, key)
+
+
+class InputAttributeNode(DAGNode):
+    def __init__(self, parent: InputNode, key):
+        self.parent = parent
+        self.key = key
+        self.args = ()
+        self.kwargs = {}
+
+
+class FunctionNode(DAGNode):
+    """``remote_fn.bind(*args)`` (reference: function_node.py)."""
+
+    def __init__(self, remote_function, args: tuple, kwargs: dict):
+        self.remote_function = remote_function
+        self.args = args
+        self.kwargs = kwargs
+
+
+class ClassMethodNode(DAGNode):
+    """``actor_handle.method.bind(*args)`` (reference:
+    class_node.py ClassMethodNode on a live actor)."""
+
+    def __init__(self, actor_method, args: tuple, kwargs: dict):
+        self.actor_method = actor_method
+        self.args = args
+        self.kwargs = kwargs
+
+
+class MultiOutputNode(DAGNode):
+    """Bundle several leaves into one execute() (reference:
+    output_node.py)."""
+
+    def __init__(self, nodes: list):
+        self.args = tuple(nodes)
+        self.kwargs = {}
+
+
+def _resolve_input(node, input_args, input_kwargs):
+    if isinstance(node, InputNode):
+        if input_kwargs or len(input_args) != 1:
+            raise TypeError(
+                "bare InputNode expects exactly one positional "
+                "execute() argument; use inp[i]/inp['key'] for multiple")
+        return input_args[0]
+    # InputAttributeNode
+    key = node.key
+    if isinstance(key, int):
+        return input_args[key]
+    return input_kwargs[key]
+
+
+def _submit(node: DAGNode, input_args, input_kwargs, memo: dict):
+    """Post-order walk: submit tasks for function nodes (returns
+    ObjectRef), call actor methods (ObjectRef), resolve inputs."""
+    import ray_tpu
+
+    if id(node) in memo:
+        return memo[id(node)]
+    if isinstance(node, (InputNode, InputAttributeNode)):
+        value = _resolve_input(node, input_args, input_kwargs)
+        memo[id(node)] = value
+        return value
+
+    def resolve(v):
+        if isinstance(v, DAGNode):
+            return _submit(v, input_args, input_kwargs, memo)
+        return v
+
+    args = tuple(resolve(a) for a in node.args)
+    kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
+    if isinstance(node, FunctionNode):
+        result = node.remote_function.remote(*args, **kwargs)
+    elif isinstance(node, ClassMethodNode):
+        result = node.actor_method.remote(*args, **kwargs)
+    elif isinstance(node, MultiOutputNode):
+        result = [ray_tpu.get(a) if _is_ref(a) else a for a in args]
+    else:
+        raise TypeError(f"cannot execute {type(node).__name__}")
+    memo[id(node)] = result
+    return result
+
+
+def _is_ref(v) -> bool:
+    from ray_tpu._private.object_ref import ObjectRef
+
+    return isinstance(v, ObjectRef)
+
+
+class CompiledDAG:
+    """Static schedule compiled from a DAG (reference:
+    compiled_dag_node.py).
+
+    Compilation walks the graph once into a topological schedule;
+    ``execute`` replays the schedule with direct calls — function nodes
+    run inline in the caller (no scheduler round trip) and actor-method
+    nodes go straight to the actor's submission queue. Repeated
+    executions pay zero graph-walking or task-bookkeeping overhead,
+    which is the reference's accelerated-DAG motivation (its gRPC/
+    channel setup maps to our direct call paths).
+    """
+
+    def __init__(self, root: DAGNode):
+        self.root = root
+        self._schedule: list[DAGNode] = []
+        self._lock = threading.Lock()
+        seen: set[int] = set()
+
+        def visit(node: DAGNode):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for child in node._children():
+                visit(child)
+            self._schedule.append(node)
+
+        visit(root)
+
+    def execute(self, *input_args, **input_kwargs) -> Any:
+        import ray_tpu
+
+        with self._lock:  # schedules share per-node memo per execution
+            memo: dict[int, Any] = {}
+            for node in self._schedule:
+                if isinstance(node, (InputNode, InputAttributeNode)):
+                    memo[id(node)] = _resolve_input(
+                        node, input_args, input_kwargs)
+                    continue
+
+                def resolve(v):
+                    if isinstance(v, DAGNode):
+                        value = memo[id(v)]
+                        return ray_tpu.get(value) if _is_ref(value) \
+                            else value
+                    return v
+
+                args = tuple(resolve(a) for a in node.args)
+                kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
+                if isinstance(node, FunctionNode):
+                    # Direct inline call: the compiled path trades
+                    # scheduler features (retries, resources) for
+                    # latency, exactly like the reference's compiled DAG
+                    # restrictions.
+                    memo[id(node)] = node.remote_function._function(
+                        *args, **kwargs)
+                elif isinstance(node, ClassMethodNode):
+                    memo[id(node)] = ray_tpu.get(
+                        node.actor_method.remote(*args, **kwargs))
+                elif isinstance(node, MultiOutputNode):
+                    memo[id(node)] = list(args)
+                else:
+                    raise TypeError(type(node).__name__)
+            result = memo[id(self.root)]
+            return ray_tpu.get(result) if _is_ref(result) else result
+
+    def teardown(self) -> None:
+        self._schedule.clear()
+
+
+__all__ = [
+    "CompiledDAG",
+    "ClassMethodNode",
+    "DAGNode",
+    "FunctionNode",
+    "InputNode",
+    "MultiOutputNode",
+]

@@ -101,5 +101,11 @@ class RemoteFunction:
             return refs[0]
         return refs
 
+    def bind(self, *args, **kwargs):
+        """Lazy DAG node (reference: ray.dag — fn.bind(...).execute())."""
+        from ray_tpu.dag import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def __repr__(self):
         return f"RemoteFunction({self._function.__qualname__})"

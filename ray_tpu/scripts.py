@@ -167,12 +167,17 @@ def cmd_job(args) -> int:
                 return 1
             env = {}
             env["RAY_TPU_ADDRESS"] = address
+            # Client-generated id makes the RPC idempotent under the
+            # client's transparent reconnect/resend.
+            sub_id_req = f"raysubmit_{os.urandom(6).hex()}"
             if args.working_dir:
                 sub_id = client.call(
                     "submit_job", entrypoint, env=env,
+                    submission_id=sub_id_req,
                     cwd=os.path.abspath(args.working_dir))
             else:
-                sub_id = client.call("submit_job", entrypoint, env=env)
+                sub_id = client.call("submit_job", entrypoint, env=env,
+                                     submission_id=sub_id_req)
             print(sub_id)
             return 0
         if args.job_cmd == "status":

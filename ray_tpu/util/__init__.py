@@ -1,0 +1,19 @@
+"""ray_tpu.util — user-facing utilities.
+
+Reference: python/ray/util/ (ActorPool, Queue, collective,
+placement_group helpers, scheduling strategies, metrics, state API).
+"""
+
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
+from ray_tpu.util.queue import Empty, Full, Queue
+
+__all__ = [
+    "ActorPool",
+    "Counter",
+    "Empty",
+    "Full",
+    "Gauge",
+    "Histogram",
+    "Queue",
+]

@@ -1,0 +1,111 @@
+"""ActorPool — load-balance tasks over a fixed set of actors.
+
+Reference: python/ray/util/actor_pool.py (same API surface: map,
+map_unordered, submit/get_next/get_next_unordered, has_next,
+push/pop_idle).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: Iterable[Any]):
+        self._idle = list(actors)
+        self._future_to_actor: dict = {}          # future -> (idx, actor)
+        self._index_to_future: dict[int, Any] = {}
+        self._returned: dict[int, Any] = {}       # completed, unconsumed
+        self._consumed: set[int] = set()          # taken unordered
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: list[tuple[Callable, Any]] = []
+
+    # -- bulk API -----------------------------------------------------
+    def map(self, fn: Callable, values: Iterable) -> Iterator:
+        """Ordered results; ``fn(actor, value) -> ObjectRef``."""
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable) -> Iterator:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    # -- incremental API ----------------------------------------------
+    def submit(self, fn: Callable, value: Any) -> None:
+        if self._idle:
+            actor = self._idle.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = (self._next_task_index, actor)
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            # No idle actor: queue; dispatched when one frees up.
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor or self._pending_submits
+                    or self._returned)
+
+    def _return_actor(self, actor: Any) -> None:
+        self._idle.append(actor)
+        if self._pending_submits:
+            self.submit(*self._pending_submits.pop(0))
+
+    def _fetch_one(self, timeout: float | None) -> int:
+        """Wait for any in-flight future; buffer its value. -> index."""
+        ready, _ = ray_tpu.wait(
+            list(self._future_to_actor), num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("ActorPool result wait timed out")
+        future = ready[0]
+        index, actor = self._future_to_actor.pop(future)
+        self._index_to_future.pop(index, None)
+        self._return_actor(actor)
+        self._returned[index] = ray_tpu.get(future)
+        return index
+
+    def _skip_consumed(self) -> None:
+        while self._next_return_index in self._consumed:
+            self._consumed.discard(self._next_return_index)
+            self._next_return_index += 1
+
+    def get_next(self, timeout: float | None = None) -> Any:
+        """Next result in SUBMISSION order (skipping results already
+        taken via get_next_unordered)."""
+        self._skip_consumed()
+        if not self.has_next():
+            raise StopIteration("no more results")
+        index = self._next_return_index
+        while index not in self._returned:
+            self._fetch_one(timeout)
+        self._next_return_index += 1
+        self._skip_consumed()
+        return self._returned.pop(index)
+
+    def get_next_unordered(self, timeout: float | None = None) -> Any:
+        """Next result in COMPLETION order."""
+        if not self.has_next():
+            raise StopIteration("no more results")
+        if not self._returned:
+            self._fetch_one(timeout)
+        index = min(self._returned)
+        self._consumed.add(index)
+        return self._returned.pop(index)
+
+    # -- membership ---------------------------------------------------
+    def push(self, actor: Any) -> None:
+        """Add an (idle) actor to the pool."""
+        self._return_actor(actor)
+
+    def pop_idle(self) -> Any | None:
+        return self._idle.pop() if self._idle else None
+
+    def has_free(self) -> bool:
+        return bool(self._idle)

@@ -1,0 +1,247 @@
+"""ray_tpu.workflow — durable DAG execution with resume.
+
+Reference: python/ray/workflow/ (api.py run/resume/list_all; workflow
+storage checkpoints each step's output so a crashed workflow resumes
+from the last completed step instead of recomputing).
+
+Execution model: a workflow is a ray_tpu.dag graph. Each DAG node is a
+*step*; when a step completes, its result is checkpointed (pickle) to
+``<storage>/<workflow_id>/steps/<step_key>``. ``run`` with the same
+workflow_id (or ``resume``) skips checkpointed steps — after a process
+crash the graph re-executes only the unfinished suffix.
+
+Step keys are content-derived (function qualname + structural position)
+so a resumed run maps steps to prior checkpoints without relying on
+Python object identity across processes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import shutil
+import time
+from typing import Any
+
+from ray_tpu.dag import (
+    DAGNode,
+    FunctionNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+_DEFAULT_STORAGE = os.environ.get(
+    "RAY_TPU_WORKFLOW_STORAGE", "/tmp/ray_tpu/workflows")
+_storage_dir = _DEFAULT_STORAGE
+
+
+def init(storage: str | None = None) -> None:
+    """Set the checkpoint root (reference: workflow.init(storage=...))."""
+    global _storage_dir
+    if storage:
+        _storage_dir = storage
+    os.makedirs(_storage_dir, exist_ok=True)
+
+
+def _wf_dir(workflow_id: str) -> str:
+    return os.path.join(_storage_dir, workflow_id)
+
+
+def _step_key(node: DAGNode, memo: dict) -> str:
+    """Stable key: function identity + keys of argument steps."""
+    if id(node) in memo:
+        return memo[id(node)]
+    parts: list[str] = [type(node).__name__]
+    if isinstance(node, FunctionNode):
+        fn = node.remote_function._function
+        parts.append(f"{fn.__module__}.{fn.__qualname__}")
+    labeled = [(f"arg{i}", a) for i, a in enumerate(node.args)]
+    labeled += [(f"kw:{k}", v) for k, v in sorted(node.kwargs.items())]
+    for label, value in labeled:
+        parts.append(label)
+        if isinstance(value, DAGNode):
+            parts.append(_step_key(value, memo))
+        else:
+            try:
+                parts.append(hashlib.sha1(
+                    pickle.dumps(value)).hexdigest()[:12])
+            except Exception:  # noqa: BLE001 — unpicklable constant
+                parts.append(repr(value))
+    key = hashlib.sha1("|".join(parts).encode()).hexdigest()[:20]
+    memo[id(node)] = key
+    return key
+
+
+class _StepRunner:
+    def __init__(self, workflow_id: str):
+        self.dir = _wf_dir(workflow_id)
+        self.steps_dir = os.path.join(self.dir, "steps")
+        os.makedirs(self.steps_dir, exist_ok=True)
+        self.key_memo: dict[int, str] = {}
+
+    def _ckpt_path(self, key: str) -> str:
+        return os.path.join(self.steps_dir, key)
+
+    def load(self, key: str):
+        path = self._ckpt_path(key)
+        if not os.path.exists(path):
+            return None, False
+        with open(path, "rb") as f:
+            return pickle.load(f), True
+
+    def save(self, key: str, value: Any) -> None:
+        path = self._ckpt_path(key)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(value, f)
+        os.replace(tmp, path)  # atomic: a crash never half-writes
+
+    def run_node(self, node: DAGNode, input_args, input_kwargs) -> Any:
+        import ray_tpu
+
+        if isinstance(node, InputNode):
+            if input_kwargs or len(input_args) != 1:
+                raise TypeError("bare InputNode expects one argument")
+            return input_args[0]
+        if isinstance(node, InputAttributeNode):
+            key = node.key
+            return (input_args[key] if isinstance(key, int)
+                    else input_kwargs[key])
+
+        step_key = _step_key(node, self.key_memo)
+        cached, hit = self.load(step_key)
+        if hit:
+            return cached
+
+        def resolve(v):
+            if isinstance(v, DAGNode):
+                return self.run_node(v, input_args, input_kwargs)
+            return v
+
+        args = tuple(resolve(a) for a in node.args)
+        kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
+        if isinstance(node, FunctionNode):
+            value = ray_tpu.get(
+                node.remote_function.remote(*args, **kwargs))
+        elif isinstance(node, MultiOutputNode):
+            value = list(args)
+        else:
+            raise TypeError(
+                f"workflows support function/multi-output nodes, "
+                f"got {type(node).__name__}")
+        self.save(step_key, value)
+        return value
+
+
+def run(dag: DAGNode, *args, workflow_id: str | None = None,
+        **kwargs) -> Any:
+    """Execute durably; completed steps are skipped on re-run
+    (reference: workflow/api.py run)."""
+    init()
+    workflow_id = workflow_id or f"workflow_{int(time.time() * 1000):x}"
+    wf_dir = _wf_dir(workflow_id)
+    os.makedirs(wf_dir, exist_ok=True)
+    meta_path = os.path.join(wf_dir, "meta.pkl")
+    if not os.path.exists(meta_path):
+        with open(meta_path, "wb") as f:
+            pickle.dump({
+                "workflow_id": workflow_id,
+                "status": "RUNNING",
+                "created_at": time.time(),
+                "dag": _try_pickle(dag),
+                "args": _try_pickle((args, kwargs)),
+            }, f)
+    runner = _StepRunner(workflow_id)
+    try:
+        result = runner.run_node(dag, args, kwargs)
+    except BaseException:
+        _set_status(workflow_id, "FAILED")
+        raise
+    # Result first, THEN status: a crash in between leaves RUNNING (so
+    # resume re-checks), never SUCCEEDED-without-result.
+    runner.save("__result__", result)
+    _set_status(workflow_id, "SUCCEEDED")
+    return result
+
+
+def _try_pickle(obj) -> bytes | None:
+    # cloudpickle: DAGs close over RemoteFunction instances and driver
+    # locals that plain pickle cannot serialize by reference.
+    try:
+        import cloudpickle
+
+        return cloudpickle.dumps(obj)
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _set_status(workflow_id: str, status: str) -> None:
+    meta_path = os.path.join(_wf_dir(workflow_id), "meta.pkl")
+    try:
+        with open(meta_path, "rb") as f:
+            meta = pickle.load(f)
+        meta["status"] = status
+        with open(meta_path + ".tmp", "wb") as f:
+            pickle.dump(meta, f)
+        os.replace(meta_path + ".tmp", meta_path)
+    except FileNotFoundError:
+        pass
+
+
+def resume(workflow_id: str) -> Any:
+    """Re-run a stored workflow; checkpointed steps are skipped
+    (reference: workflow/api.py resume)."""
+    init()
+    meta_path = os.path.join(_wf_dir(workflow_id), "meta.pkl")
+    with open(meta_path, "rb") as f:
+        meta = pickle.load(f)
+    if meta.get("dag") is None:
+        raise ValueError(
+            f"workflow {workflow_id} stored no DAG (unpicklable); "
+            "re-invoke run() with the original graph and workflow_id")
+    dag = pickle.loads(meta["dag"])
+    args, kwargs = pickle.loads(meta["args"]) if meta.get("args") \
+        else ((), {})
+    return run(dag, *args, workflow_id=workflow_id, **kwargs)
+
+
+def get_status(workflow_id: str) -> str | None:
+    try:
+        with open(os.path.join(_wf_dir(workflow_id), "meta.pkl"),
+                  "rb") as f:
+            return pickle.load(f)["status"]
+    except FileNotFoundError:
+        return None
+
+
+def get_output(workflow_id: str) -> Any:
+    runner = _StepRunner(workflow_id)
+    value, hit = runner.load("__result__")
+    if not hit:
+        raise ValueError(f"workflow {workflow_id} has no stored result")
+    return value
+
+
+def list_all() -> list[tuple[str, str]]:
+    """[(workflow_id, status)] (reference: workflow/api.py list_all)."""
+    init()
+    out = []
+    try:
+        entries = sorted(os.listdir(_storage_dir))
+    except FileNotFoundError:
+        return []
+    for wf_id in entries:
+        status = get_status(wf_id)
+        if status is not None:
+            out.append((wf_id, status))
+    return out
+
+
+def delete(workflow_id: str) -> None:
+    shutil.rmtree(_wf_dir(workflow_id), ignore_errors=True)
+
+
+__all__ = ["delete", "get_output", "get_status", "init", "list_all",
+           "resume", "run"]

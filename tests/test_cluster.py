@@ -160,14 +160,31 @@ def test_job_stop(gcs):
         "submit_job", f"{sys.executable} -c 'import time; time.sleep(60)'")
     time.sleep(0.5)
     assert client.call("stop_job", sub_id)
+    # The exit-watcher must preserve STOPPED (not overwrite with FAILED
+    # when the SIGTERM'd process exits nonzero).
     deadline = time.time() + 10
     while time.time() < deadline:
         status = client.call("job_status", sub_id)
-        if status["status"] in ("STOPPED", "FAILED"):
+        if status["status"] != "RUNNING":
             break
         time.sleep(0.2)
-    assert status["status"] in ("STOPPED", "FAILED")
+    time.sleep(0.5)  # let the exit-watcher run after the kill
+    status = client.call("job_status", sub_id)
+    assert status["status"] == "STOPPED"
     assert client.call("job_status", "raysubmit_nonexistent") is None
+
+
+def test_job_submit_idempotent_on_submission_id(gcs):
+    client = RpcClient(gcs.address)
+    sub = client.call("submit_job", f"{sys.executable} -c 'print(1)'",
+                      submission_id="raysubmit_fixed")
+    sub2 = client.call("submit_job", f"{sys.executable} -c 'print(1)'",
+                       submission_id="raysubmit_fixed")
+    assert sub == sub2 == "raysubmit_fixed"
+    # Only ONE job record exists for the id.
+    records = [j for j in client.call("list_jobs")
+               if j and j["submission_id"] == "raysubmit_fixed"]
+    assert len(records) == 1
 
 
 # -------------------------------------------------------- driver mode

@@ -1,0 +1,173 @@
+"""ActorPool, Queue, and runtime_env tests.
+
+Reference intent: python/ray/tests/test_actor_pool.py,
+test_queue.py, and the runtime_env env_vars/working_dir tests.
+"""
+
+import os
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool, Empty, Full, Queue
+
+
+@pytest.fixture
+def ray_start():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+@ray_tpu.remote
+class _PoolWorker:
+    def double(self, x):
+        return 2 * x
+
+    def slow_double(self, x):
+        import time
+
+        time.sleep(0.05 if x % 2 else 0.0)
+        return 2 * x
+
+
+def test_actor_pool_map_ordered(ray_start):
+    pool = ActorPool([_PoolWorker.remote() for _ in range(3)])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(10)))
+    assert out == [2 * i for i in range(10)]
+
+
+def test_actor_pool_map_unordered_complete_set(ray_start):
+    pool = ActorPool([_PoolWorker.remote() for _ in range(3)])
+    out = list(pool.map_unordered(
+        lambda a, v: a.slow_double.remote(v), range(8)))
+    assert sorted(out) == [2 * i for i in range(8)]
+
+
+def test_actor_pool_submit_get_next(ray_start):
+    pool = ActorPool([_PoolWorker.remote() for _ in range(2)])
+    for i in range(5):  # more submits than actors: queueing kicks in
+        pool.submit(lambda a, v: a.double.remote(v), i)
+    assert [pool.get_next() for _ in range(5)] == [0, 2, 4, 6, 8]
+    assert not pool.has_next()
+    with pytest.raises(StopIteration):
+        pool.get_next()
+
+
+def test_actor_pool_push_pop_idle(ray_start):
+    pool = ActorPool([_PoolWorker.remote()])
+    actor = pool.pop_idle()
+    assert actor is not None
+    assert not pool.has_free()
+    pool.push(actor)
+    assert pool.has_free()
+
+
+def test_queue_fifo_and_batches(ray_start):
+    q = Queue()
+    for i in range(5):
+        q.put(i)
+    assert q.qsize() == 5 and not q.empty()
+    assert [q.get() for _ in range(5)] == [0, 1, 2, 3, 4]
+    assert q.empty()
+    q.put_nowait_batch([10, 11, 12])
+    assert q.get_nowait_batch(3) == [10, 11, 12]
+    with pytest.raises(Empty):
+        q.get_nowait()
+    with pytest.raises(Empty):
+        q.get(timeout=0.05)
+
+
+def test_queue_maxsize_full(ray_start):
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    assert q.full()
+    with pytest.raises(Full):
+        q.put_nowait(3)
+    with pytest.raises(Full):
+        q.put(3, timeout=0.05)
+    q.get()
+    q.put(3)  # space freed
+
+
+def test_queue_shared_across_tasks(ray_start):
+    q = Queue()
+
+    @ray_tpu.remote
+    def producer(queue, n):
+        for i in range(n):
+            queue.put(i)
+        return n
+
+    assert ray_tpu.get(producer.remote(q, 4)) == 4
+    assert sorted(q.get() for _ in range(4)) == [0, 1, 2, 3]
+
+
+# ---------------------------------------------------------- runtime_env
+def test_runtime_env_env_vars_in_pool_tasks():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, process_workers=2)
+    try:
+        @ray_tpu.remote
+        def read_env():
+            return os.environ.get("RT_TEST_VAR")
+
+        assert ray_tpu.get(read_env.options(
+            runtime_env={"env_vars": {"RT_TEST_VAR": "42"}}).remote()) \
+            == "42"
+        # And it does NOT leak into the next task on the same worker.
+        assert ray_tpu.get(read_env.remote()) is None
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_runtime_env_working_dir_in_pool_tasks(tmp_path):
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, process_workers=2)
+    try:
+        marker = tmp_path / "marker.txt"
+        marker.write_text("found-me")
+
+        @ray_tpu.remote
+        def read_marker():
+            with open("marker.txt") as f:
+                return f.read()
+
+        out = ray_tpu.get(read_marker.options(
+            runtime_env={"working_dir": str(tmp_path)}).remote())
+        assert out == "found-me"
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_runtime_env_process_actor():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    try:
+        @ray_tpu.remote
+        class EnvActor:
+            def read(self):
+                return os.environ.get("RT_ACTOR_VAR")
+
+        actor = EnvActor.options(
+            process=True,
+            runtime_env={"env_vars": {"RT_ACTOR_VAR": "actor-env"}},
+        ).remote()
+        assert ray_tpu.get(actor.read.remote()) == "actor-env"
+        ray_tpu.kill(actor)
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_actor_pool_mixed_ordered_unordered(ray_start):
+    """get_next after get_next_unordered must skip consumed indices
+    instead of waiting forever (regression)."""
+    pool = ActorPool([_PoolWorker.remote() for _ in range(3)])
+    for i in range(3):
+        pool.submit(lambda a, v: a.double.remote(v), i)
+    first = pool.get_next_unordered()      # some index, consumed
+    remaining = sorted([pool.get_next(), pool.get_next()])
+    assert sorted([first] + remaining) == [0, 2, 4]
+    assert not pool.has_next()
