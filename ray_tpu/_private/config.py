@@ -47,6 +47,11 @@ _DEFAULTS: dict[str, Any] = {
     # Native shared-memory arena (plasma-lite, _native/plasma_store.cpp).
     "object_arena_bytes": 64 * 1024 * 1024,  # 0 => segment-per-object only
     "object_arena_max_object_bytes": 1024 * 1024,
+    # Memory monitor (reference: memory_monitor.h kill-on-pressure).
+    "memory_usage_threshold": 0.95,
+    "memory_monitor_refresh_ms": 1000,  # 0 => disabled
+    # Worker log capture + driver-side echo (reference: log_monitor.py).
+    "log_to_driver": True,
     # Placement groups.
     "placement_group_commit_timeout_s": 30.0,
 }

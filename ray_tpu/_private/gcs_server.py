@@ -45,9 +45,16 @@ class JobManager:
         job_id = JobID()
         sub_id = submission_id or f"raysubmit_{job_id.hex()[:12]}"
         # Idempotent on submission_id: a client retrying a dropped RPC
-        # (rpc.py reconnect) must not launch the entrypoint twice.
-        if submission_id is not None and self._record(sub_id) is not None:
-            return sub_id
+        # (rpc.py reconnect) must not launch the entrypoint twice. The
+        # check-and-register is atomic under the lock (two server
+        # threads can carry the same retried request concurrently).
+        with self._lock:
+            if submission_id is not None \
+                    and self._record(sub_id) is not None:
+                return sub_id
+            self.gcs.register_job(JobRecord(
+                job_id=job_id, status="RUNNING", entrypoint=entrypoint,
+                submission_id=sub_id))
         log_path = os.path.join(self.log_dir, f"{sub_id}.log")
         full_env = dict(os.environ)
         # A submitted driver connects back to THIS head by default.
@@ -72,13 +79,11 @@ class JobManager:
                 stderr=subprocess.STDOUT, cwd=cwd, env=full_env,
                 start_new_session=True)
         except OSError as exc:
-            self.gcs.register_job(JobRecord(
-                job_id=job_id, status="FAILED", entrypoint=entrypoint,
-                submission_id=sub_id, message=str(exc)))
+            self.gcs.finish_job(job_id, status="FAILED")
+            record = self._record(sub_id)
+            if record is not None:
+                record.message = str(exc)
             return sub_id
-        self.gcs.register_job(JobRecord(
-            job_id=job_id, status="RUNNING", entrypoint=entrypoint,
-            submission_id=sub_id))
         with self._lock:
             self._procs[sub_id] = proc
         threading.Thread(target=self._wait, args=(sub_id, job_id, proc),
@@ -137,14 +142,16 @@ class JobManager:
             return False
         import signal
 
-        try:  # the whole session: entrypoints may spawn children
-            os.killpg(proc.pid, signal.SIGTERM)
-        except (ProcessLookupError, PermissionError):
-            proc.terminate()
+        # STOPPED first: the exit-watcher reads this the moment the
+        # SIGTERM'd process exits, and must not report FAILED.
         record = self._record(sub_id)
         if record is not None:
             record.status = "STOPPED"
             record.end_time = time.time()
+        try:  # the whole session: entrypoints may spawn children
+            os.killpg(proc.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            proc.terminate()
         return True
 
     def list(self) -> list[dict]:

@@ -1,0 +1,79 @@
+"""Log monitor — stream worker-process logs back to the driver.
+
+Reference: python/ray/_private/log_monitor.py (tails per-worker log
+files under the session dir and republishes lines to drivers with a
+``(pid=...)`` prefix). Pool workers write stdout/stderr to files under
+the session log dir; this monitor tails them and echoes new lines to
+the driver's stdout.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+
+class LogMonitor:
+    def __init__(self, log_dir: str, period_s: float = 0.2,
+                 out=None):
+        self.log_dir = log_dir
+        self.period_s = period_s
+        self._out = out or sys.stdout
+        self._offsets: dict[str, int] = {}
+        self._shutdown = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="log-monitor")
+
+    def start(self) -> "LogMonitor":
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._shutdown.wait(self.period_s):
+            self.poll_once()
+        self.poll_once()  # final drain
+
+    def poll_once(self) -> int:
+        """Tail every log file once; returns lines emitted."""
+        emitted = 0
+        try:
+            names = sorted(os.listdir(self.log_dir))
+        except FileNotFoundError:
+            return 0
+        for name in names:
+            if not name.endswith(".log"):
+                continue
+            path = os.path.join(self.log_dir, name)
+            offset = self._offsets.get(name, 0)
+            try:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    chunk = f.read()
+            except OSError:
+                continue
+            if not chunk:
+                continue
+            # Only complete lines; partial tail re-read next poll.
+            last_nl = chunk.rfind(b"\n")
+            if last_nl < 0:
+                continue
+            self._offsets[name] = offset + last_nl + 1
+            prefix = f"({name[:-len('.log')]}) "
+            for line in chunk[:last_nl].decode(
+                    "utf-8", errors="replace").splitlines():
+                try:
+                    self._out.write(prefix + line + "\n")
+                    emitted += 1
+                except Exception:  # noqa: BLE001 — closed stream
+                    return emitted
+        if emitted:
+            try:
+                self._out.flush()
+            except Exception:  # noqa: BLE001
+                pass
+        return emitted
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        self._thread.join(timeout=2.0)

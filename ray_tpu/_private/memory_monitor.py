@@ -1,0 +1,109 @@
+"""Memory monitor — detect host memory pressure, kill the fattest
+pool worker before the OS OOM-killer takes the whole node.
+
+Reference: python/ray/_private/memory_monitor.py +
+src/ray/common/memory_monitor.h (kill a task's worker when node memory
+exceeds the threshold; the task fails with OutOfMemoryError and is
+retryable as a system failure).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+logger = logging.getLogger("ray_tpu")
+
+
+def host_memory_usage_fraction() -> float:
+    """used/total from /proc/meminfo (MemAvailable-based, like the
+    reference's psutil path). Returns 0.0 when unreadable."""
+    try:
+        info: dict[str, int] = {}
+        with open("/proc/meminfo") as f:
+            for line in f:
+                key, _, rest = line.partition(":")
+                info[key] = int(rest.strip().split()[0])  # kB
+        total = info.get("MemTotal", 0)
+        avail = info.get("MemAvailable", 0)
+        if total <= 0:
+            return 0.0
+        return 1.0 - avail / total
+    except OSError:
+        return 0.0
+
+
+def process_rss_bytes(pid: int) -> int:
+    try:
+        with open(f"/proc/{pid}/statm") as f:
+            pages = int(f.read().split()[1])
+        import resource
+
+        return pages * (resource.getpagesize())
+    except (OSError, IndexError, ValueError):
+        return 0
+
+
+class MemoryMonitor:
+    """Polls host memory; above the threshold, kills the pool worker
+    with the largest RSS (its in-flight task fails as a system failure
+    and is retryable, matching the reference's OOM-kill policy)."""
+
+    def __init__(self, runtime, threshold: float = 0.95,
+                 period_s: float = 1.0):
+        self.runtime = runtime
+        self.threshold = threshold
+        self.period_s = period_s
+        self.num_kills = 0
+        self._shutdown = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="memory-monitor")
+
+    def start(self) -> "MemoryMonitor":
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._shutdown.wait(self.period_s):
+            self.check_once()
+
+    def check_once(self) -> int | None:
+        """One pressure check; returns the killed pid (or None)."""
+        usage = host_memory_usage_fraction()
+        if usage <= self.threshold:
+            return None
+        pool = getattr(self.runtime, "worker_pool", None)
+        if pool is None:
+            logger.warning(
+                "memory pressure: host at %.0f%% (threshold %.0f%%) — "
+                "no worker pool to reclaim from", usage * 100,
+                self.threshold * 100)
+            return None
+        victim = self._largest_worker(pool)
+        if victim is None:
+            return None
+        pid = victim.proc.pid
+        logger.warning(
+            "memory pressure: host at %.0f%% — killing pool worker "
+            "pid=%s rss=%.0fMB (its task fails with a retryable "
+            "system error)", usage * 100, pid,
+            process_rss_bytes(pid) / 1e6)
+        try:
+            victim.proc.kill()
+        except OSError:
+            return None
+        self.num_kills += 1
+        return pid
+
+    @staticmethod
+    def _largest_worker(pool):
+        # Idle AND busy workers are candidates: killing a busy worker
+        # fails its task with a retryable system error, which the
+        # reference prefers over the OS OOM-killer taking the node.
+        alive = pool.live_workers()
+        if not alive:
+            return None
+        return max(alive, key=lambda w: process_rss_bytes(w.proc.pid))
+
+    def stop(self) -> None:
+        self._shutdown.set()

@@ -83,15 +83,27 @@ def default_resources() -> dict:
     return resources
 
 
-def run_head(port: int, resources: dict | None = None) -> None:
-    """Head daemon: GCS server + own node registration. Blocks."""
+def run_head(port: int, resources: dict | None = None,
+             dashboard_port: int | None = 0) -> None:
+    """Head daemon: GCS server + dashboard + own node registration.
+    Blocks."""
     from ray_tpu._private.gcs_server import GcsServer
+    from ray_tpu.dashboard import Dashboard, gcs_provider
 
     os.makedirs(SESSION_DIR, exist_ok=True)
     server = GcsServer(port=port, log_dir=SESSION_DIR)
     server.start()
     with open(os.path.join(SESSION_DIR, "head_address"), "w") as f:
         f.write(f"{_own_address()}:{server._server.port}")
+    dashboard = None
+    if dashboard_port is not None:
+        # Bind all interfaces: the advertised address file carries the
+        # external IP, which must actually be reachable.
+        dashboard = Dashboard(gcs_provider(server), host="0.0.0.0",
+                              port=dashboard_port).start()
+        with open(os.path.join(SESSION_DIR, "dashboard_address"),
+                  "w") as f:
+            f.write(f"{_own_address()}:{dashboard.port}")
     agent = NodeAgent(f"127.0.0.1:{server._server.port}",
                       resources or default_resources(),
                       labels={"node_role": "head"})
@@ -108,6 +120,8 @@ def run_head(port: int, resources: dict | None = None) -> None:
             pass
     finally:
         agent.stop()
+        if dashboard is not None:
+            dashboard.stop()
         server.stop()
 
 

@@ -110,6 +110,7 @@ class Runtime:
         namespace: str = "default",
         process_workers: int | None = None,
         metrics_port: int | None = None,
+        dashboard_port: int | None = None,
         address: str | None = None,
     ):
         cfg = GLOBAL_CONFIG
@@ -189,11 +190,33 @@ class Runtime:
             weakref.WeakKeyDictionary()
         pool_size = (process_workers if process_workers is not None
                      else cfg.worker_pool_size)
+        self.log_monitor = None
+        self.memory_monitor = None
         if pool_size and pool_size > 0:
             from ray_tpu._private.worker_pool import WorkerPool
 
+            # Worker stdout/stderr -> per-worker files; the log monitor
+            # tails them back to the driver console (reference:
+            # log_monitor.py).
+            if cfg.log_to_driver:
+                import tempfile
+
+                log_dir = os.path.join(
+                    tempfile.gettempdir(),
+                    f"ray_tpu_session_{os.getpid()}", "logs")
+                os.environ["RAY_TPU_WORKER_LOG_DIR"] = log_dir
+                from ray_tpu._private.log_monitor import LogMonitor
+
+                self.log_monitor = LogMonitor(log_dir).start()
             self.worker_pool = WorkerPool(
                 int(pool_size), self.shm_directory, self.shm_client)
+            refresh_ms = int(cfg.memory_monitor_refresh_ms or 0)
+            if refresh_ms > 0:
+                from ray_tpu._private.memory_monitor import MemoryMonitor
+
+                self.memory_monitor = MemoryMonitor(
+                    self, threshold=float(cfg.memory_usage_threshold),
+                    period_s=refresh_ms / 1000.0).start()
 
         # Lineage + recovery + node health (reference:
         # object_recovery_manager.h:41, gcs_health_check_manager.h:39).
@@ -224,6 +247,15 @@ class Runtime:
             from ray_tpu._private.metrics_agent import start_metrics_agent
 
             self.metrics_agent = start_metrics_agent(self, port=metrics_port)
+
+        # HTTP dashboard (opt-in via dashboard_port; 0 picks a free
+        # port — reference: dashboard/head.py).
+        self.dashboard = None
+        if dashboard_port is not None:
+            from ray_tpu.dashboard import Dashboard, runtime_provider
+
+            self.dashboard = Dashboard(
+                runtime_provider(self), port=dashboard_port).start()
 
         # Head node: autodetect CPU and TPU resources.
         detected = accelerators.detect_resources()
@@ -902,14 +934,22 @@ class Runtime:
         if self.gcs_client is not None:
             self.gcs_client.close()
             self.gcs_client = None
+        if self.dashboard is not None:
+            self.dashboard.stop()
+            self.dashboard = None
         if self.metrics_agent is not None:
             self.metrics_agent.shutdown()
         self.health_monitor.shutdown()
         for actor in list(self._actors.values()):
             actor.kill("runtime shutdown", no_restart=True)
         self.dispatcher.shutdown()
+        if self.memory_monitor is not None:
+            self.memory_monitor.stop()
         if self.worker_pool is not None:
             self.worker_pool.shutdown()
+        if self.log_monitor is not None:
+            self.log_monitor.stop()
+            os.environ.pop("RAY_TPU_WORKER_LOG_DIR", None)
         self.shm_client.close_all()
         self.shm_directory.shutdown()
         if self.arena is not None:
@@ -940,6 +980,7 @@ def init(
     logging_level: str | None = None,
     process_workers: int | None = None,
     metrics_port: int | None = None,
+    dashboard_port: int | None = None,
     address: str | None = None,
     **_ignored,
 ) -> Runtime:
@@ -980,7 +1021,7 @@ def init(
             num_cpus=num_cpus, num_tpus=num_tpus, resources=resources,
             object_store_memory=object_store_memory, namespace=namespace,
             process_workers=process_workers, metrics_port=metrics_port,
-            address=address)
+            dashboard_port=dashboard_port, address=address)
         atexit.register(_atexit_shutdown)
         return _runtime
 

@@ -319,9 +319,20 @@ def _spawn_worker(name: str):
     env["PYTHONPATH"] = os.pathsep.join(
         [p for p in sys.path if p] +
         [p for p in env.get("PYTHONPATH", "").split(os.pathsep) if p])
+    # Per-worker log files under the session dir (reference: worker
+    # stdout/stderr files tailed by the log monitor); without a log dir
+    # workers inherit the driver's console directly.
+    log_dir = env.get("RAY_TPU_WORKER_LOG_DIR")
+    log_file = None
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+        log_file = open(os.path.join(log_dir, f"worker-{name}.log"), "ab")
     proc = subprocess.Popen(
         [sys.executable, "-m", "ray_tpu._private.worker_pool", addr],
-        env=env, cwd=os.getcwd())
+        env=env, cwd=os.getcwd(),
+        stdout=log_file, stderr=log_file)
+    if log_file is not None:
+        log_file.close()  # the child holds the fd now
     try:
         # Listener.accept has no timeout arg; guard with a thread join.
         conn_box: list = []
@@ -418,6 +429,7 @@ class WorkerPool:
         self._lock = threading.Condition(threading.Lock())
         self._index_lock = threading.Lock()
         self._idle: list[PoolWorker] = []
+        self._all_workers: set[PoolWorker] = set()
         self._next_index = 0
         self._shutdown = False
         # Spawn in parallel: each worker blocks on interpreter boot +
@@ -432,15 +444,32 @@ class WorkerPool:
         with self._index_lock:
             index = self._next_index
             self._next_index += 1
-        return PoolWorker(index)
+        worker = PoolWorker(index)
+        with self._index_lock:
+            self._all_workers.add(worker)
+            self._all_workers = {w for w in self._all_workers
+                                 if w.alive()}
+        return worker
+
+    def live_workers(self) -> list[PoolWorker]:
+        """All live workers, idle or busy (memory-monitor view)."""
+        with self._index_lock:
+            return [w for w in self._all_workers if w.alive()]
 
     def _acquire(self) -> PoolWorker:
-        with self._lock:
-            while not self._idle and not self._shutdown:
-                self._lock.wait(timeout=0.5)
-            if self._shutdown:
-                raise RuntimeError("worker pool is shut down")
-            return self._idle.pop()
+        while True:
+            with self._lock:
+                while not self._idle and not self._shutdown:
+                    self._lock.wait(timeout=0.5)
+                if self._shutdown:
+                    raise RuntimeError("worker pool is shut down")
+                worker = self._idle.pop()
+            if worker.alive():
+                return worker
+            # Died while idle (crash, memory-monitor kill): replace it
+            # (spawn happens outside the condition lock — it is slow).
+            worker.stop()
+            return self._new_worker()
 
     def _release(self, worker: PoolWorker) -> None:
         # Spawn any replacement outside the pool lock (spawn is slow and

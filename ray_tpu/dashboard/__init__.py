@@ -1,0 +1,172 @@
+"""ray_tpu.dashboard — HTTP dashboard over the state API.
+
+Reference: dashboard/head.py (DashboardHead serving the web UI +
+/api endpoints backed by the GCS). Here one stdlib ThreadingHTTPServer
+serves:
+
+- ``/``               minimal auto-refreshing HTML overview
+- ``/api/cluster``    resources + node summary
+- ``/api/nodes|actors|tasks|objects|placement_groups|jobs``
+                      the state-API listings as JSON
+
+Two hosts embed it: a driver runtime (``init(dashboard_port=...)``)
+and the head daemon (jobs come from the head's JobManager).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<meta http-equiv="refresh" content="5">
+<style>
+ body {{ font-family: monospace; margin: 2em; }}
+ table {{ border-collapse: collapse; margin-bottom: 2em; }}
+ td, th {{ border: 1px solid #999; padding: 4px 10px; text-align: left; }}
+ h2 {{ margin-bottom: 0.3em; }}
+</style></head><body>
+<h1>ray_tpu dashboard</h1>
+{sections}
+</body></html>"""
+
+
+def _table(title: str, rows: list[dict], cols: list[str]) -> str:
+    import html
+
+    head = "".join(f"<th>{html.escape(c)}</th>" for c in cols)
+    body = "".join(
+        "<tr>" + "".join(
+            f"<td>{html.escape(str(row.get(c, '')))}</td>"
+            for c in cols) + "</tr>"
+        for row in rows)
+    return (f"<h2>{html.escape(title)} ({len(rows)})</h2>"
+            f"<table><tr>{head}</tr>{body}</table>")
+
+
+class Dashboard:
+    """Serves snapshots produced by a provider callable so the same
+    server works over a live Runtime or a head GcsServer."""
+
+    def __init__(self, provider: Callable[[str], list | dict | None],
+                 host: str = "127.0.0.1", port: int = 0):
+        dashboard = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                try:
+                    if self.path in ("/", "/index.html"):
+                        payload = dashboard._render_html().encode()
+                        ctype = "text/html"
+                    elif self.path.startswith("/api/"):
+                        section = self.path[len("/api/"):].strip("/")
+                        data = provider(section)
+                        if data is None:
+                            self.send_error(404, f"unknown: {section}")
+                            return
+                        payload = json.dumps(data, default=str).encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as exc:  # noqa: BLE001
+                    self.send_error(500, str(exc))
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        self._provider = provider
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.port = self._server.server_port
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="dashboard")
+
+    def start(self) -> "Dashboard":
+        self._thread.start()
+        return self
+
+    def _render_html(self) -> str:
+        import html
+
+        sections = []
+        cluster = self._provider("cluster") or {}
+        sections.append(
+            "<h2>cluster</h2><table>" + "".join(
+                f"<tr><th>{html.escape(str(k))}</th>"
+                f"<td>{html.escape(str(v))}</td></tr>"
+                for k, v in cluster.items()) + "</table>")
+        for name, cols in (
+                ("nodes", ["node_id", "alive", "resources", "labels"]),
+                ("actors", ["actor_id", "class_name", "state", "name"]),
+                ("jobs", ["job_id", "status", "entrypoint",
+                          "submission_id"]),
+                ("tasks", ["task_id", "name", "state"]),
+        ):
+            rows = self._provider(name)
+            if rows:
+                sections.append(_table(name, rows[:100], cols))
+        return _PAGE.format(sections="".join(sections))
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def runtime_provider(runtime) -> Callable:
+    """Sections backed by a live driver Runtime via the state API."""
+
+    def provide(section: str):
+        from ray_tpu.util import state
+
+        if section == "cluster":
+            return {
+                "total_resources": runtime.cluster.total_resources(),
+                "available_resources":
+                    runtime.cluster.available_resources(),
+                "alive_nodes": sum(
+                    1 for n in runtime.gcs.list_nodes() if n.alive),
+            }
+        fn = {
+            "nodes": state.list_nodes,
+            "actors": state.list_actors,
+            "tasks": state.list_tasks,
+            "objects": state.list_objects,
+            "placement_groups": state.list_placement_groups,
+            "jobs": state.list_jobs,
+        }.get(section)
+        return fn(limit=1000) if fn else None
+
+    return provide
+
+
+def gcs_provider(gcs_server) -> Callable:
+    """Sections backed by a head daemon's GcsServer."""
+
+    def provide(section: str):
+        if section == "cluster":
+            return {
+                "total_resources": gcs_server._cluster_resources(),
+                "alive_nodes": sum(
+                    1 for n in gcs_server.gcs.list_nodes() if n.alive),
+            }
+        if section == "nodes":
+            return gcs_server._list_nodes()
+        if section == "jobs":
+            return [dict(j, job_id=j.get("submission_id", ""))
+                    for j in gcs_server.jobs.list() if j]
+        if section in ("actors", "tasks", "objects",
+                       "placement_groups"):
+            return []  # driver-local tables; not mirrored to the head
+        return None
+
+    return provide

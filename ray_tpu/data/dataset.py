@@ -45,6 +45,7 @@ class Dataset:
         self._name = name
         self._shard_lock = threading.Lock()
         self._shard_refs_cache: list | None = None
+        self._last_exec_ctx = None  # stats of the most recent execution
 
     # ------------------------------------------------------------ transforms
 
@@ -129,10 +130,20 @@ class Dataset:
     def repartition(self, num_blocks: int) -> "Dataset":
         """Reference: dataset.repartition (exchange-based)."""
 
+        def partition(b: Block, n: int, idx: int) -> list[Block]:
+            # Rotate the split->partition assignment by the block index:
+            # split_block floor-biases remainder rows toward the tail,
+            # and without rotation every small block sends its rows to
+            # the SAME partition (e.g. 100 one-row blocks -> one
+            # 100-row partition + n-1 empties).
+            parts = split_block(b, n)
+            k = idx % n
+            return parts[n - k:] + parts[:n - k]
+
         def do(block_refs: list, ctx) -> list:
             return run_exchange(
                 block_refs,
-                partition_fn=lambda b, n, _i: split_block(b, n),
+                partition_fn=partition,
                 reduce_fn=default_reduce,
                 num_partitions=num_blocks)
 
@@ -250,7 +261,11 @@ class Dataset:
     # ----------------------------------------------------------- consumption
 
     def _block_ref_iter(self) -> Iterator[Any]:
-        return iter_block_refs(self._ops)
+        from ray_tpu.data.executor import ExecutionContext
+
+        ctx = ExecutionContext()
+        self._last_exec_ctx = ctx
+        return iter_block_refs(self._ops, ctx)
 
     def _block_refs(self) -> list[Any]:
         return list(self._block_ref_iter())
@@ -357,6 +372,23 @@ class Dataset:
                         name=f"{self._name}.split[{i}]")
                 for i, part in enumerate(out)]
 
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        max_queued_blocks: int = 4) -> list:
+        """n DataIterators over ONE shared streaming execution
+        (reference: dataset.streaming_split — the per-worker ingestion
+        path of distributed trainers).
+
+        Unlike ``split`` (materializes, then partitions), the upstream
+        pipeline runs once, streaming; bounded per-consumer queues
+        backpressure it when any consumer lags. ``equal=True`` balances
+        by rows (greedy least-loaded) instead of round-robin.
+        """
+        from ray_tpu.data.iterator import streaming_split_iterators
+
+        return streaming_split_iterators(
+            self._block_ref_iter(), n, equal=equal,
+            max_queued_blocks=max_queued_blocks, name=self._name)
+
     def shard(self, num_shards: int, index: int) -> "Dataset":
         """Deterministic shard for per-worker ingestion (reference:
         dataset.split + train data_config).
@@ -433,8 +465,13 @@ class Dataset:
     # ----------------------------------------------------------------- stats
 
     def stats(self) -> str:
-        return (f"Dataset(name={self._name!r}, "
-                f"stages={[op.name for op in self._ops]})")
+        """Execution stats of the most recent run (reference:
+        Dataset.stats / _internal/stats.py)."""
+        header = (f"Dataset(name={self._name!r}, "
+                  f"stages={[op.name for op in self._ops]})")
+        if self._last_exec_ctx is None:
+            return header + "\n  (not executed yet)"
+        return header + "\n" + self._last_exec_ctx.stats.summary()
 
     def __repr__(self):
         return f"Dataset({self._name})"

@@ -33,11 +33,66 @@ from ray_tpu.data.plan import (
 )
 
 
+class StageStats:
+    """Per-operator execution accounting (reference:
+    _internal/stats.py DatasetStats)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.num_blocks = 0
+        self.wall_s = 0.0
+        self.backpressure_waits = 0
+
+
+class ExecutionStats:
+    def __init__(self):
+        self.stages: list[StageStats] = []
+        self.started_at: float | None = None
+        self.finished_at: float | None = None
+
+    def stage(self, name: str) -> StageStats:
+        st = StageStats(name)
+        self.stages.append(st)
+        return st
+
+    def summary(self) -> str:
+        lines = ["Execution stats:"]
+        for st in self.stages:
+            line = (f"  {st.name}: {st.num_blocks} blocks, "
+                    f"{st.wall_s:.3f}s wall")
+            if st.backpressure_waits:
+                line += f", {st.backpressure_waits} backpressure waits"
+            lines.append(line)
+        if self.started_at is not None and self.finished_at is not None:
+            lines.append(
+                f"  total: {self.finished_at - self.started_at:.3f}s")
+        return "\n".join(lines)
+
+
+def _store_under_pressure() -> bool:
+    """Object-store backpressure signal (reference:
+    backpressure_policy/ resource_manager.py): above the spill
+    threshold, stages stop growing their in-flight window."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu._private.worker import global_runtime
+
+    runtime = global_runtime()
+    if runtime is None:
+        return False
+    stats = runtime.store.stats()
+    limit = stats.get("memory_limit_bytes") or 0
+    if limit <= 0:
+        return False
+    threshold = float(GLOBAL_CONFIG.object_spilling_threshold)
+    return stats.get("memory_used_bytes", 0) > threshold * limit
+
+
 class ExecutionContext:
-    """Knobs shared by stages; carried into AllToAll fns."""
+    """Knobs + stats shared by stages; carried into AllToAll fns."""
 
     def __init__(self, max_in_flight: int = 16):
         self.max_in_flight = max_in_flight
+        self.stats = ExecutionStats()
 
 
 @ray_tpu.remote
@@ -82,10 +137,25 @@ def iter_block_refs(ops: list[LogicalOp],
         read_fused_needs_index = stages[0].needs_index
         stages = stages[1:]
 
+    read_name = "read" + (f"+{read_fused.__name__}" if read_fused
+                          and hasattr(read_fused, "__name__") else "")
+
     def input_stream() -> Iterator[Any]:
+        import time as _time
+
+        st = ctx.stats.stage(read_name if source.read_tasks else "input")
+        if ctx.stats.started_at is None:
+            ctx.stats.started_at = _time.perf_counter()
+        t0 = _time.perf_counter()
         if source.read_tasks is not None:
             in_flight: collections.deque = collections.deque()
             for task_idx, task in enumerate(source.read_tasks):
+                # Backpressure: drain before submitting when the object
+                # store is above the spill threshold.
+                while in_flight and _store_under_pressure():
+                    st.backpressure_waits += 1
+                    st.num_blocks += 1
+                    yield in_flight.popleft()
                 if read_fused is not None and read_fused_needs_index:
                     ref = _run_read_chain_idx.remote(
                         task.fn, read_fused, task_idx)
@@ -95,11 +165,17 @@ def iter_block_refs(ops: list[LogicalOp],
                     ref = _run_read.remote(task.fn)
                 in_flight.append(ref)
                 if len(in_flight) >= ctx.max_in_flight:
+                    st.num_blocks += 1
                     yield in_flight.popleft()
             while in_flight:
+                st.num_blocks += 1
                 yield in_flight.popleft()
         else:
-            yield from (source.block_refs or [])
+            for ref in (source.block_refs or []):
+                st.num_blocks += 1
+                yield ref
+        st.wall_s = _time.perf_counter() - t0
+        ctx.stats.finished_at = _time.perf_counter()
 
     stream: Iterator[Any] = input_stream()
     for op in stages:
@@ -116,16 +192,28 @@ def iter_block_refs(ops: list[LogicalOp],
 
 def _map_stage(upstream: Iterator[Any], op: MapBlocks,
                ctx: ExecutionContext) -> Iterator[Any]:
+    import time as _time
+
+    st = ctx.stats.stage(op.name)
+    t0 = _time.perf_counter()
     in_flight: collections.deque = collections.deque()
     for idx, ref in enumerate(upstream):
+        while in_flight and _store_under_pressure():
+            st.backpressure_waits += 1
+            st.num_blocks += 1
+            yield in_flight.popleft()
         if op.needs_index:
             in_flight.append(_run_chain_idx.remote(ref, op.fn, idx))
         else:
             in_flight.append(_run_chain.remote(ref, op.fn))
         if len(in_flight) >= ctx.max_in_flight:
+            st.num_blocks += 1
             yield in_flight.popleft()
     while in_flight:
+        st.num_blocks += 1
         yield in_flight.popleft()
+    st.wall_s = _time.perf_counter() - t0
+    ctx.stats.finished_at = _time.perf_counter()
 
 
 def _limit_stage(upstream: Iterator[Any], limit: int) -> Iterator[Any]:

@@ -97,3 +97,155 @@ def iter_jax_batches_over_refs(ref_iter: Iterator[Any], *, batch_size: int,
         staged = nxt
     if staged is not None:
         yield staged
+
+
+class _SplitLane:
+    """One consumer's bounded queue + abandonment flag."""
+
+    def __init__(self, maxsize: int):
+        import queue as queue_mod
+        import threading
+
+        self.queue: "queue_mod.Queue" = queue_mod.Queue(maxsize=maxsize)
+        self.abandoned = threading.Event()
+
+    def drain(self) -> None:
+        import queue as queue_mod
+
+        try:
+            while True:
+                self.queue.get_nowait()
+        except queue_mod.Empty:
+            pass
+
+
+class DataIterator:
+    """One consumer's view of a shared streaming execution.
+
+    Reference: python/ray/data/iterator.py DataIterator, as returned by
+    Dataset.streaming_split — N training workers iterate concurrently
+    while ONE upstream execution produces blocks.
+
+    A consumer that stops early (break / exception) closes its lane
+    (generator finally), so the shared distributor reroutes its share
+    instead of blocking the other consumers forever.
+    """
+
+    def __init__(self, lane: _SplitLane, name: str):
+        self._lane = lane
+        self._name = name
+
+    def close(self) -> None:
+        """Abandon this split: remaining blocks go to other consumers."""
+        self._lane.abandoned.set()
+        self._lane.drain()
+
+    def _ref_iter(self) -> Iterator[Any]:
+        try:
+            while True:
+                ref = self._lane.queue.get()
+                if ref is None:
+                    return
+                yield ref
+        finally:
+            # Early exit (consumer broke out) or normal end: either way
+            # the distributor must not keep feeding this lane.
+            self.close()
+
+    def iter_batches(self, *, batch_size: int | None = 256,
+                     batch_format: str = "numpy",
+                     drop_last: bool = False,
+                     prefetch_batches: int = 1) -> Iterator[Any]:
+        return iter_batches_over_refs(
+            self._ref_iter(), batch_size=batch_size,
+            batch_format=batch_format, drop_last=drop_last,
+            prefetch_batches=prefetch_batches)
+
+    def iter_rows(self) -> Iterator[dict]:
+        for batch in self.iter_batches(batch_size=None,
+                                       batch_format="pyarrow"):
+            yield from batch.to_pylist()
+
+    def iter_jax_batches(self, *, batch_size: int = 256,
+                         drop_last: bool = True, sharding=None,
+                         dtypes: dict | None = None) -> Iterator[Any]:
+        return iter_jax_batches_over_refs(
+            self._ref_iter(), batch_size=batch_size, drop_last=drop_last,
+            sharding=sharding, dtypes=dtypes)
+
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           drop_last: bool = False) -> Iterator[Any]:
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       drop_last=drop_last):
+            yield {k: torch.as_tensor(v) for k, v in batch.items()}
+
+    def __repr__(self):
+        return f"DataIterator({self._name})"
+
+
+def streaming_split_iterators(ref_iter: Iterator[Any], n: int, *,
+                              equal: bool = False,
+                              max_queued_blocks: int = 4,
+                              name: str = "split") -> list[DataIterator]:
+    """Fan a stream of block refs out to n DataIterators.
+
+    A distributor thread assigns each block to the consumer with the
+    fewest assigned rows so far (``equal=True``: reads each block's
+    row count via the in-process store — a dict lookup here, not a
+    transfer) or round-robin. Bounded per-consumer queues backpressure
+    the shared execution when any consumer lags; abandoned lanes
+    (consumer stopped early) are rerouted, not waited on.
+    """
+    import queue as queue_mod
+    import threading
+
+    lanes = [_SplitLane(max_queued_blocks) for _ in range(n)]
+    assigned_rows = [0] * n
+
+    def offer(target: int, ref) -> bool:
+        """Put to a lane; False if it is (or becomes) abandoned."""
+        while not lanes[target].abandoned.is_set():
+            try:
+                lanes[target].queue.put(ref, timeout=0.2)
+                return True
+            except queue_mod.Full:
+                continue
+        return False
+
+    def distribute():
+        try:
+            rr = 0
+            for ref in ref_iter:
+                placed = False
+                while not placed:
+                    live = [j for j in range(n)
+                            if not lanes[j].abandoned.is_set()]
+                    if not live:
+                        return  # every consumer gone: stop executing
+                    if equal:
+                        target = min(live,
+                                     key=lambda j: assigned_rows[j])
+                        rows = ray_tpu.get(ref).num_rows
+                    else:
+                        target = live[rr % len(live)]
+                        rr += 1
+                        rows = 0
+                    placed = offer(target, ref)
+                    if placed:
+                        assigned_rows[target] += rows
+        finally:
+            for lane in lanes:
+                while not lane.abandoned.is_set():
+                    try:
+                        lane.queue.put(None, timeout=0.2)
+                        break
+                    except queue_mod.Full:
+                        continue
+
+    threading.Thread(target=distribute, daemon=True,
+                     name="data-split-distributor").start()
+    return [DataIterator(lane, f"{name}[{i}/{n}]")
+            for i, lane in enumerate(lanes)]

@@ -53,6 +53,15 @@ def _step_key(node: DAGNode, memo: dict) -> str:
     """Stable key: function identity + keys of argument steps."""
     if id(node) in memo:
         return memo[id(node)]
+    if isinstance(node, InputAttributeNode):
+        # Which input slot matters: square(inp[0]) and square(inp[1])
+        # must NOT share a checkpoint key.
+        key = f"input[{node.key!r}]"
+        memo[id(node)] = key
+        return key
+    if isinstance(node, InputNode):
+        memo[id(node)] = "input"
+        return "input"
     parts: list[str] = [type(node).__name__]
     if isinstance(node, FunctionNode):
         fn = node.remote_function._function

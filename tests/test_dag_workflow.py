@@ -166,3 +166,19 @@ def test_workflow_resume_api_from_storage(ray_start, tmp_path):
     dag = _add.bind(20, 22)
     assert workflow.run(dag, workflow_id="wf3") == 42
     assert workflow.resume("wf3") == 42
+
+
+def test_workflow_distinct_input_slots_not_conflated(ray_start, tmp_path):
+    """Regression: square(inp[0]) and square(inp[1]) must have distinct
+    checkpoint keys."""
+    from ray_tpu import workflow
+
+    workflow.init(storage=str(tmp_path))
+
+    @ray_tpu.remote
+    def square(x):
+        return x * x
+
+    with InputNode() as inp:
+        dag = _add.bind(square.bind(inp[0]), square.bind(inp[1]))
+    assert workflow.run(dag, 2, 3, workflow_id="wf_slots") == 13

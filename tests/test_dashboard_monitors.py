@@ -1,0 +1,178 @@
+"""Dashboard + log monitor + memory monitor tests.
+
+Reference intent: dashboard API tests, log_monitor tests
+(worker prints echoed to the driver with a prefix), memory_monitor
+kill-on-pressure tests.
+"""
+
+import io
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+
+
+def _http_get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return resp.status, resp.read()
+
+
+def test_dashboard_serves_state(capsys):
+    ray_tpu.shutdown()
+    runtime = ray_tpu.init(num_cpus=4, dashboard_port=0)
+    try:
+        @ray_tpu.remote
+        class Sleeper:
+            def ping(self):
+                return "ok"
+
+        actor = Sleeper.remote()
+        assert ray_tpu.get(actor.ping.remote()) == "ok"
+        port = runtime.dashboard.port
+
+        status, body = _http_get(port, "/")
+        assert status == 200
+        assert b"ray_tpu dashboard" in body
+
+        status, body = _http_get(port, "/api/cluster")
+        cluster = json.loads(body)
+        assert cluster["alive_nodes"] >= 1
+        assert "CPU" in cluster["total_resources"]
+
+        status, body = _http_get(port, "/api/actors")
+        actors = json.loads(body)
+        assert any(a["class_name"] == "Sleeper" for a in actors)
+
+        status, body = _http_get(port, "/api/nodes")
+        assert json.loads(body)
+
+        with pytest.raises(urllib.error.HTTPError):
+            _http_get(port, "/api/nonsense")
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_head_daemon_dashboard(tmp_path):
+    """The head daemon serves its own dashboard with cluster + jobs."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["RAY_TPU_SESSION_DIR"] = str(tmp_path)
+    env["RAY_TPU_SKIP_TPU_DETECTION"] = "1"
+    env["PYTHONPATH"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    try:
+        out = subprocess.run(
+            [sys.executable, "-m", "ray_tpu", "start", "--head",
+             "--port", "0"],
+            capture_output=True, text=True, timeout=60, env=env, cwd="/")
+        assert out.returncode == 0, out.stderr + out.stdout
+        deadline = time.time() + 15
+        dash_addr = None
+        while time.time() < deadline and dash_addr is None:
+            try:
+                dash_addr = (tmp_path / "dashboard_address"). \
+                    read_text().strip()
+            except FileNotFoundError:
+                time.sleep(0.2)
+        assert dash_addr
+        port = int(dash_addr.rsplit(":", 1)[1])
+        status, body = _http_get(port, "/api/cluster")
+        assert json.loads(body)["alive_nodes"] >= 1
+        status, body = _http_get(port, "/")
+        assert b"dashboard" in body
+    finally:
+        subprocess.run([sys.executable, "-m", "ray_tpu", "stop"],
+                       capture_output=True, timeout=30, env=env, cwd="/")
+
+
+# ----------------------------------------------------------- log monitor
+def test_worker_prints_echoed_to_driver():
+    from ray_tpu._private.log_monitor import LogMonitor
+
+    ray_tpu.shutdown()
+    runtime = ray_tpu.init(num_cpus=4, process_workers=2)
+    try:
+        assert runtime.log_monitor is not None
+
+        @ray_tpu.remote
+        def chatty(i):
+            print(f"hello-from-worker-{i}")
+            return i
+
+        assert ray_tpu.get([chatty.remote(i) for i in range(3)]) \
+            == [0, 1, 2]
+        # Drain into a buffer we control (the background thread also
+        # polls; poll into our own sink for a deterministic check).
+        sink = io.StringIO()
+        monitor = LogMonitor(runtime.log_monitor.log_dir, out=sink)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            monitor.poll_once()
+            text = sink.getvalue()
+            if all(f"hello-from-worker-{i}" in text for i in range(3)):
+                break
+            time.sleep(0.1)
+        text = sink.getvalue()
+        for i in range(3):
+            assert f"hello-from-worker-{i}" in text
+        # Lines carry the per-worker prefix.
+        assert "(worker-" in text
+    finally:
+        ray_tpu.shutdown()
+
+
+# -------------------------------------------------------- memory monitor
+def test_memory_monitor_kills_fattest_worker():
+    from ray_tpu._private.memory_monitor import (
+        MemoryMonitor,
+        host_memory_usage_fraction,
+        process_rss_bytes,
+    )
+
+    assert 0.0 < host_memory_usage_fraction() < 1.0
+
+    ray_tpu.shutdown()
+    runtime = ray_tpu.init(
+        num_cpus=4, process_workers=2,
+        system_config={"memory_monitor_refresh_ms": 0})  # manual control
+    try:
+        workers = runtime.worker_pool.live_workers()
+        assert len(workers) == 2
+        assert all(process_rss_bytes(w.proc.pid) > 0 for w in workers)
+
+        # Threshold 0 => always over pressure; one kill per check.
+        monitor = MemoryMonitor(runtime, threshold=0.0)
+        killed_pid = monitor.check_once()
+        assert killed_pid in {w.proc.pid for w in workers}
+        assert monitor.num_kills == 1
+
+        # The pool replaces the dead worker; tasks still run.
+        @ray_tpu.remote
+        def ok():
+            return os.getpid()
+
+        assert ray_tpu.get(ok.remote()) > 0
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_memory_monitor_noop_below_threshold():
+    from ray_tpu._private.memory_monitor import MemoryMonitor
+
+    ray_tpu.shutdown()
+    runtime = ray_tpu.init(
+        num_cpus=2, process_workers=1,
+        system_config={"memory_monitor_refresh_ms": 0})
+    try:
+        monitor = MemoryMonitor(runtime, threshold=1.0)  # never over
+        assert monitor.check_once() is None
+        assert monitor.num_kills == 0
+    finally:
+        ray_tpu.shutdown()

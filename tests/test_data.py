@@ -244,3 +244,102 @@ def test_train_integration_datasets(rt):
     result = trainer.fit()
     assert result.error is None
     assert result.metrics["rows"] == 32  # each worker sees its shard
+
+
+# ------------------------------------------------- streaming_split/stats
+def test_streaming_split_covers_all_rows(ray_start_regular):
+    import threading
+
+    import ray_tpu.data as rdata
+
+    ds = rdata.range(1000, override_num_blocks=10).map(
+        lambda row: {"id": row["id"], "sq": row["id"] ** 2})
+    iterators = ds.streaming_split(3)
+    assert len(iterators) == 3
+
+    seen: list[list[int]] = [[] for _ in range(3)]
+
+    def consume(i):
+        for batch in iterators[i].iter_batches(batch_size=64):
+            seen[i].extend(int(x) for x in batch["id"])
+
+    threads = [threading.Thread(target=consume, args=(i,))
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    all_ids = sorted(x for part in seen for x in part)
+    assert all_ids == list(range(1000))
+    # Every consumer got a nonempty share.
+    assert all(part for part in seen)
+
+
+def test_streaming_split_equal_balances_rows(ray_start_regular):
+    import ray_tpu.data as rdata
+
+    # Skewed blocks: without equal=True round-robin would be lopsided.
+    ds = rdata.from_items([{"v": i} for i in range(100)]).repartition(5)
+    iterators = ds.streaming_split(2, equal=True)
+    counts = []
+    for it in iterators:
+        counts.append(sum(1 for _ in it.iter_rows()))
+    assert sum(counts) == 100
+    assert abs(counts[0] - counts[1]) <= 40  # roughly balanced
+
+
+def test_dataset_stats_reports_stages(ray_start_regular):
+    import ray_tpu.data as rdata
+
+    ds = rdata.range(100, override_num_blocks=4).map(lambda r: {"x": r["id"]})
+    assert "(not executed yet)" in ds.stats()
+    _ = ds.take_all()
+    report = ds.stats()
+    assert "Execution stats:" in report
+    assert "blocks" in report and "wall" in report
+
+
+def test_repartition_balances_many_small_blocks(ray_start_regular):
+    """Regression: 100 one-row blocks repartitioned to 5 must spread
+    rows across partitions, not pile them into one."""
+    import ray_tpu.data as rdata
+
+    ds = rdata.from_items([{"v": i} for i in range(100)]).repartition(5)
+    rows_per_block = [ray_tpu.get(r).num_rows for r in ds._block_refs()]
+    assert sum(rows_per_block) == 100
+    assert max(rows_per_block) <= 40
+    assert min(rows_per_block) >= 5
+    # All rows survive intact.
+    assert sorted(r["v"] for r in ds.take_all()) == list(range(100))
+
+
+def test_streaming_split_survives_abandoned_consumer(ray_start_regular):
+    """Regression: a consumer stopping early must not starve the rest."""
+    import threading
+
+    import ray_tpu.data as rdata
+
+    ds = rdata.range(600, override_num_blocks=12).map(
+        lambda r: {"id": r["id"]})
+    its = ds.streaming_split(2, max_queued_blocks=1)
+
+    # Consumer 0 quits after the first batch.
+    got_first = []
+    for batch in its[0].iter_batches(batch_size=10):
+        got_first.extend(int(x) for x in batch["id"])
+        break  # abandon
+
+    # Consumer 1 must still receive the rest (within a timeout).
+    rest: list[int] = []
+
+    def consume():
+        for batch in its[1].iter_batches(batch_size=50):
+            rest.extend(int(x) for x in batch["id"])
+
+    t = threading.Thread(target=consume)
+    t.start()
+    t.join(timeout=30)
+    assert not t.is_alive(), "surviving consumer hung"
+    # Everything except what consumer 0 took (plus blocks lost in its
+    # abandoned queue) flowed to consumer 1.
+    assert len(rest) >= 400
