@@ -50,6 +50,7 @@ _DEFAULTS: dict[str, Any] = {
     # Memory monitor (reference: memory_monitor.h kill-on-pressure).
     "memory_usage_threshold": 0.95,
     "memory_monitor_refresh_ms": 1000,  # 0 => disabled
+    "task_oom_retries": 3,  # retry budget for monitor-killed tasks
     # Worker log capture + driver-side echo (reference: log_monitor.py).
     "log_to_driver": True,
     # Placement groups.

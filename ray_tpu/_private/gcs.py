@@ -110,6 +110,9 @@ class NodeRecord:
     labels: dict[str, str] = field(default_factory=dict)
     alive: bool = True
     last_heartbeat: float = field(default_factory=time.monotonic)
+    # Live usage piggybacked on heartbeats (reference: ray_syncer's
+    # resource-usage broadcast; here the heartbeat IS the sync channel).
+    available: dict[str, float] = field(default_factory=dict)
 
 
 @dataclass
@@ -222,11 +225,14 @@ class GlobalControlService:
                 record.alive = False
         self.pubsub.publish("nodes", ("DEAD", node_id))
 
-    def heartbeat(self, node_id: NodeID) -> None:
+    def heartbeat(self, node_id: NodeID,
+                  available: dict | None = None) -> None:
         with self._lock:
             record = self._nodes.get(node_id)
             if record is not None:
                 record.last_heartbeat = time.monotonic()
+                if available is not None:
+                    record.available = dict(available)
 
     def list_nodes(self) -> list[NodeRecord]:
         with self._lock:

@@ -219,8 +219,9 @@ class GcsServer:
             labels=dict(labels or {})))
         return node_id.binary()
 
-    def _heartbeat(self, node_id_bytes: bytes) -> bool:
-        self.gcs.heartbeat(NodeID(node_id_bytes))
+    def _heartbeat(self, node_id_bytes: bytes,
+                   available: dict | None = None) -> bool:
+        self.gcs.heartbeat(NodeID(node_id_bytes), available)
         return True
 
     def _list_nodes(self) -> list[dict]:
@@ -228,6 +229,7 @@ class GcsServer:
             "node_id": r.node_id.hex(),
             "address": r.address,
             "resources": dict(r.resources),
+            "available": dict(r.available),
             "labels": dict(r.labels),
             "alive": r.alive,
         } for r in self.gcs.list_nodes()]

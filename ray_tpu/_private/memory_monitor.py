@@ -55,6 +55,10 @@ class MemoryMonitor:
         self.threshold = threshold
         self.period_s = period_s
         self.num_kills = 0
+        # Pids this monitor killed: their WorkerCrashedErrors are
+        # OOM failures, retried beyond the task's own max_retries
+        # (reference: OOM kills get their own retry budget).
+        self.killed_pids: set[int] = set()
         self._shutdown = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="memory-monitor")
@@ -88,6 +92,7 @@ class MemoryMonitor:
             "pid=%s rss=%.0fMB (its task fails with a retryable "
             "system error)", usage * 100, pid,
             process_rss_bytes(pid) / 1e6)
+        self.killed_pids.add(pid)
         try:
             victim.proc.kill()
         except OSError:

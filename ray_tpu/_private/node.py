@@ -42,11 +42,15 @@ class NodeAgent:
 
     def __init__(self, gcs_address: str, resources: dict,
                  labels: dict | None = None,
-                 heartbeat_period_s: float = 1.0):
+                 heartbeat_period_s: float = 1.0,
+                 usage_fn=None):
         self.client = RpcClient(gcs_address)
         self.resources = dict(resources)
         self.labels = dict(labels or {})
         self.heartbeat_period_s = heartbeat_period_s
+        # Optional live-usage callable: () -> {resource: available}
+        # piggybacked on heartbeats (ray_syncer-lite).
+        self.usage_fn = usage_fn
         self.node_id: bytes = self.client.call(
             "register_node", f"{_own_address()}:{os.getpid()}",
             self.resources, self.labels)
@@ -57,8 +61,14 @@ class NodeAgent:
 
     def _heartbeat_loop(self) -> None:
         while not self._shutdown.wait(self.heartbeat_period_s):
+            available = None
+            if self.usage_fn is not None:
+                try:
+                    available = self.usage_fn()
+                except Exception:  # noqa: BLE001 — usage is best-effort
+                    available = None
             try:
-                self.client.call("heartbeat", self.node_id)
+                self.client.call("heartbeat", self.node_id, available)
             except RpcError:
                 pass  # head unreachable; keep trying (it may restart)
 
@@ -104,6 +114,16 @@ def run_head(port: int, resources: dict | None = None,
         with open(os.path.join(SESSION_DIR, "dashboard_address"),
                   "w") as f:
             f.write(f"{_own_address()}:{dashboard.port}")
+
+    # Client server: remote drivers run tasks/actors against the head's
+    # runtime (reference: ray client server inside `ray start --head`).
+    import ray_tpu
+    from ray_tpu.util.client import ClientServer
+
+    ray_tpu.init(ignore_reinit_error=True)
+    client_server = ClientServer(host="0.0.0.0", port=0).start()
+    with open(os.path.join(SESSION_DIR, "client_address"), "w") as f:
+        f.write(f"{_own_address()}:{client_server.port}")
     agent = NodeAgent(f"127.0.0.1:{server._server.port}",
                       resources or default_resources(),
                       labels={"node_role": "head"})
@@ -120,6 +140,7 @@ def run_head(port: int, resources: dict | None = None,
             pass
     finally:
         agent.stop()
+        client_server.stop()
         if dashboard is not None:
             dashboard.stop()
         server.stop()

@@ -132,7 +132,8 @@ class Runtime:
                     address,
                     {"CPU": float(num_cpus if num_cpus is not None
                                   else cfg.num_cpus)},
-                    labels={"node_role": "driver"})
+                    labels={"node_role": "driver"},
+                    usage_fn=self.available_resources)
             except (RpcError, OSError) as exc:
                 self.gcs_client.close()
                 self.gcs_client = None
@@ -200,10 +201,15 @@ class Runtime:
             # log_monitor.py).
             if cfg.log_to_driver:
                 import tempfile
+                import uuid
 
+                # Unique per SESSION (not just pid): an init/shutdown
+                # cycle in one process must not replay or append to the
+                # previous session's worker logs.
                 log_dir = os.path.join(
                     tempfile.gettempdir(),
-                    f"ray_tpu_session_{os.getpid()}", "logs")
+                    f"ray_tpu_session_{os.getpid()}_"
+                    f"{uuid.uuid4().hex[:6]}", "logs")
                 os.environ["RAY_TPU_WORKER_LOG_DIR"] = log_dir
                 from ray_tpu._private.log_monitor import LogMonitor
 
@@ -569,7 +575,17 @@ class Runtime:
         allows them."""
         from ray_tpu.exceptions import WorkerCrashedError
 
-        if spec.attempt >= spec.max_retries:
+        # OOM kills by the memory monitor carry their own retry budget
+        # (reference: OOM failures retry independently of
+        # max_task_retries — the task did nothing wrong).
+        oom_kill = (isinstance(exc, WorkerCrashedError)
+                    and self.memory_monitor is not None
+                    and getattr(exc, "worker_pid", None)
+                    in self.memory_monitor.killed_pids)
+        retry_budget = max(spec.max_retries,
+                           int(GLOBAL_CONFIG.task_oom_retries)
+                           if oom_kill else spec.max_retries)
+        if spec.attempt >= retry_budget:
             return False
         retry_ok = False
         if isinstance(exc, (ActorDiedError, WorkerCrashedError)):
@@ -950,6 +966,11 @@ class Runtime:
         if self.log_monitor is not None:
             self.log_monitor.stop()
             os.environ.pop("RAY_TPU_WORKER_LOG_DIR", None)
+            import shutil
+
+            shutil.rmtree(os.path.dirname(self.log_monitor.log_dir),
+                          ignore_errors=True)
+            self.log_monitor = None
         self.shm_client.close_all()
         self.shm_directory.shutdown()
         if self.arena is not None:

@@ -391,9 +391,11 @@ class PoolWorker:
             try:
                 return self.conn.recv()
             except (EOFError, OSError) as exc:
-                raise WorkerCrashedError(
+                err = WorkerCrashedError(
                     f"worker {self.index} (pid "
-                    f"{self.proc.pid}) died: {exc!r}") from exc
+                    f"{self.proc.pid}) died: {exc!r}")
+                err.worker_pid = self.proc.pid  # OOM-kill attribution
+                raise err from exc
 
     def alive(self) -> bool:
         return self.proc.poll() is None
@@ -457,19 +459,18 @@ class WorkerPool:
             return [w for w in self._all_workers if w.alive()]
 
     def _acquire(self) -> PoolWorker:
-        while True:
-            with self._lock:
-                while not self._idle and not self._shutdown:
-                    self._lock.wait(timeout=0.5)
-                if self._shutdown:
-                    raise RuntimeError("worker pool is shut down")
-                worker = self._idle.pop()
-            if worker.alive():
-                return worker
-            # Died while idle (crash, memory-monitor kill): replace it
-            # (spawn happens outside the condition lock — it is slow).
-            worker.stop()
-            return self._new_worker()
+        with self._lock:
+            while not self._idle and not self._shutdown:
+                self._lock.wait(timeout=0.5)
+            if self._shutdown:
+                raise RuntimeError("worker pool is shut down")
+            worker = self._idle.pop()
+        if worker.alive():
+            return worker
+        # Died while idle (crash, memory-monitor kill): replace it
+        # (spawn happens outside the condition lock — it is slow).
+        worker.stop()
+        return self._new_worker()
 
     def _release(self, worker: PoolWorker) -> None:
         # Spawn any replacement outside the pool lock (spawn is slow and

@@ -147,35 +147,39 @@ def iter_block_refs(ops: list[LogicalOp],
         if ctx.stats.started_at is None:
             ctx.stats.started_at = _time.perf_counter()
         t0 = _time.perf_counter()
-        if source.read_tasks is not None:
-            in_flight: collections.deque = collections.deque()
-            for task_idx, task in enumerate(source.read_tasks):
-                # Backpressure: drain before submitting when the object
-                # store is above the spill threshold.
-                while in_flight and _store_under_pressure():
-                    st.backpressure_waits += 1
+        try:
+            if source.read_tasks is not None:
+                in_flight: collections.deque = collections.deque()
+                for task_idx, task in enumerate(source.read_tasks):
+                    # Backpressure: drain before submitting when the
+                    # object store is above the spill threshold.
+                    while in_flight and _store_under_pressure():
+                        st.backpressure_waits += 1
+                        st.num_blocks += 1
+                        yield in_flight.popleft()
+                    if read_fused is not None and read_fused_needs_index:
+                        ref = _run_read_chain_idx.remote(
+                            task.fn, read_fused, task_idx)
+                    elif read_fused is not None:
+                        ref = _run_read_chain.remote(task.fn, read_fused)
+                    else:
+                        ref = _run_read.remote(task.fn)
+                    in_flight.append(ref)
+                    if len(in_flight) >= ctx.max_in_flight:
+                        st.num_blocks += 1
+                        yield in_flight.popleft()
+                while in_flight:
                     st.num_blocks += 1
                     yield in_flight.popleft()
-                if read_fused is not None and read_fused_needs_index:
-                    ref = _run_read_chain_idx.remote(
-                        task.fn, read_fused, task_idx)
-                elif read_fused is not None:
-                    ref = _run_read_chain.remote(task.fn, read_fused)
-                else:
-                    ref = _run_read.remote(task.fn)
-                in_flight.append(ref)
-                if len(in_flight) >= ctx.max_in_flight:
+            else:
+                for ref in (source.block_refs or []):
                     st.num_blocks += 1
-                    yield in_flight.popleft()
-            while in_flight:
-                st.num_blocks += 1
-                yield in_flight.popleft()
-        else:
-            for ref in (source.block_refs or []):
-                st.num_blocks += 1
-                yield ref
-        st.wall_s = _time.perf_counter() - t0
-        ctx.stats.finished_at = _time.perf_counter()
+                    yield ref
+        finally:
+            # finally: early-terminated consumption (limit/take) must
+            # still record real wall time, not 0.
+            st.wall_s = _time.perf_counter() - t0
+            ctx.stats.finished_at = _time.perf_counter()
 
     stream: Iterator[Any] = input_stream()
     for op in stages:
@@ -196,24 +200,26 @@ def _map_stage(upstream: Iterator[Any], op: MapBlocks,
 
     st = ctx.stats.stage(op.name)
     t0 = _time.perf_counter()
-    in_flight: collections.deque = collections.deque()
-    for idx, ref in enumerate(upstream):
-        while in_flight and _store_under_pressure():
-            st.backpressure_waits += 1
+    try:
+        in_flight: collections.deque = collections.deque()
+        for idx, ref in enumerate(upstream):
+            while in_flight and _store_under_pressure():
+                st.backpressure_waits += 1
+                st.num_blocks += 1
+                yield in_flight.popleft()
+            if op.needs_index:
+                in_flight.append(_run_chain_idx.remote(ref, op.fn, idx))
+            else:
+                in_flight.append(_run_chain.remote(ref, op.fn))
+            if len(in_flight) >= ctx.max_in_flight:
+                st.num_blocks += 1
+                yield in_flight.popleft()
+        while in_flight:
             st.num_blocks += 1
             yield in_flight.popleft()
-        if op.needs_index:
-            in_flight.append(_run_chain_idx.remote(ref, op.fn, idx))
-        else:
-            in_flight.append(_run_chain.remote(ref, op.fn))
-        if len(in_flight) >= ctx.max_in_flight:
-            st.num_blocks += 1
-            yield in_flight.popleft()
-    while in_flight:
-        st.num_blocks += 1
-        yield in_flight.popleft()
-    st.wall_s = _time.perf_counter() - t0
-    ctx.stats.finished_at = _time.perf_counter()
+    finally:
+        st.wall_s = _time.perf_counter() - t0
+        ctx.stats.finished_at = _time.perf_counter()
 
 
 def _limit_stage(upstream: Iterator[Any], limit: int) -> Iterator[Any]:

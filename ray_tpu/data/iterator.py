@@ -143,10 +143,13 @@ class DataIterator:
     def _ref_iter(self) -> Iterator[Any]:
         try:
             while True:
-                ref = self._lane.queue.get()
-                if ref is None:
+                item = self._lane.queue.get()
+                if item is None:
                     return
-                yield ref
+                if isinstance(item, tuple) and len(item) == 2 \
+                        and item[0] == "__split_error__":
+                    raise item[1]
+                yield item
         finally:
             # Early exit (consumer broke out) or normal end: either way
             # the distributor must not keep feeding this lane.
@@ -216,6 +219,10 @@ def streaming_split_iterators(ref_iter: Iterator[Any], n: int, *,
         return False
 
     def distribute():
+        # On an upstream task failure the error must reach every
+        # consumer — a clean end-of-stream would silently truncate the
+        # data (training on a partial dataset with no error).
+        tail_item: list = [None]
         try:
             rr = 0
             for ref in ref_iter:
@@ -236,11 +243,14 @@ def streaming_split_iterators(ref_iter: Iterator[Any], n: int, *,
                     placed = offer(target, ref)
                     if placed:
                         assigned_rows[target] += rows
+        except BaseException as exc:  # noqa: BLE001 — fan the error out
+            tail_item[0] = ("__split_error__", exc)
+            raise
         finally:
             for lane in lanes:
                 while not lane.abandoned.is_set():
                     try:
-                        lane.queue.put(None, timeout=0.2)
+                        lane.queue.put(tail_item[0], timeout=0.2)
                         break
                     except queue_mod.Full:
                         continue

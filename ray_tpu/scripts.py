@@ -139,8 +139,10 @@ def cmd_status(args) -> int:
     for n in nodes:
         state = "ALIVE" if n["alive"] else "DEAD"
         role = n["labels"].get("node_role", "?")
-        res = " ".join(f"{k}={v:g}" for k, v in sorted(
-            n["resources"].items()))
+        avail = n.get("available") or {}
+        res = " ".join(
+            f"{k}={avail[k]:g}/{v:g}" if k in avail else f"{k}={v:g}"
+            for k, v in sorted(n["resources"].items()))
         print(f"  {state:<5} {role:<6} {n['node_id'][:12]}  {res}")
     print("total resources: " + " ".join(
         f"{k}={v:g}" for k, v in sorted(resources.items())))

@@ -1,0 +1,36 @@
+"""ray_tpu.util.client — remote drivers over RPC (Ray Client).
+
+Reference: python/ray/util/client/ (server/server.py:RayletServicer +
+client worker: a thin proxy where remote()/get()/put() run against a
+cluster-hosted runtime instead of a local one).
+
+Usage::
+
+    from ray_tpu.util import client
+
+    api = client.connect("HEAD_HOST:CLIENT_PORT")
+    square = api.remote(lambda x: x * x)      # or a def
+    assert api.get(square.remote(7)) == 49
+    api.disconnect()
+
+The head daemon hosts the server (``python -m ray_tpu start --head``
+advertises ``client_address`` in the session dir); any machine that can
+reach it runs tasks/actors ON the cluster runtime with no local
+ray_tpu.init().
+"""
+
+from ray_tpu.util.client.api import (
+    ClientAPI,
+    ClientActorHandle,
+    ClientObjectRef,
+    connect,
+)
+from ray_tpu.util.client.server import ClientServer
+
+__all__ = [
+    "ClientAPI",
+    "ClientActorHandle",
+    "ClientObjectRef",
+    "ClientServer",
+    "connect",
+]

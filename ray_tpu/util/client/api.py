@@ -1,0 +1,155 @@
+"""Client-side API — remote() / get() / put() proxied over RPC.
+
+Reference: python/ray/util/client/__init__.py + worker.py (the client
+worker that ships functions to the cluster and holds ClientObjectRefs).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ray_tpu._private import serialization
+from ray_tpu._private.rpc import RpcClient
+
+
+class ClientObjectRef:
+    def __init__(self, api: "ClientAPI", key: str):
+        self._api = api
+        self._key = key
+
+    def __repr__(self):
+        return f"ClientObjectRef({self._key[:12]})"
+
+
+class ClientRemoteFunction:
+    def __init__(self, api: "ClientAPI", func, options: dict | None = None):
+        self._api = api
+        self._func = func
+        self._func_blob = serialization.dumps_function(func)
+        self._options = dict(options or {})
+
+    def options(self, **opts) -> "ClientRemoteFunction":
+        return ClientRemoteFunction(
+            self._api, self._func, {**self._options, **opts})
+
+    def remote(self, *args, **kwargs):
+        keys = self._api._rpc.call(
+            "client_task", self._func_blob,
+            self._api._marshal(args, kwargs), self._options)
+        refs = [ClientObjectRef(self._api, k) for k in keys]
+        return refs[0] if len(refs) == 1 else refs
+
+
+class _ClientActorMethod:
+    def __init__(self, api: "ClientAPI", actor_key: str, name: str):
+        self._api = api
+        self._actor_key = actor_key
+        self._name = name
+        self._num_returns = 1
+
+    def options(self, *, num_returns: int = 1) -> "_ClientActorMethod":
+        method = _ClientActorMethod(self._api, self._actor_key, self._name)
+        method._num_returns = num_returns
+        return method
+
+    def remote(self, *args, **kwargs):
+        keys = self._api._rpc.call(
+            "client_actor_call", self._actor_key, self._name,
+            self._api._marshal(args, kwargs), self._num_returns)
+        refs = [ClientObjectRef(self._api, k) for k in keys]
+        return refs[0] if len(refs) == 1 else refs
+
+
+class ClientActorHandle:
+    def __init__(self, api: "ClientAPI", actor_key: str):
+        self._api = api
+        self._actor_key = actor_key
+
+    def __getattr__(self, name: str) -> _ClientActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _ClientActorMethod(self._api, self._actor_key, name)
+
+
+class ClientRemoteClass:
+    def __init__(self, api: "ClientAPI", cls, options: dict | None = None):
+        self._api = api
+        self._cls = cls
+        self._cls_blob = serialization.dumps_function(cls)
+        self._options = dict(options or {})
+
+    def options(self, **opts) -> "ClientRemoteClass":
+        return ClientRemoteClass(
+            self._api, self._cls, {**self._options, **opts})
+
+    def remote(self, *args, **kwargs) -> ClientActorHandle:
+        key = self._api._rpc.call(
+            "client_create_actor", self._cls_blob,
+            self._api._marshal(args, kwargs), self._options)
+        return ClientActorHandle(self._api, key)
+
+
+class ClientAPI:
+    """The remote() / get() / put() / wait() surface of a connected
+    client (reference: ray.util.client ClientAPI)."""
+
+    def __init__(self, address: str, timeout_s: float = 60.0):
+        self._rpc = RpcClient(address, timeout_s=timeout_s)
+        if not self._rpc.ping():
+            raise ConnectionError(
+                f"no ray_tpu client server at {address}")
+
+    # -- marshalling --------------------------------------------------
+    def _marshal(self, args: tuple, kwargs: dict) -> bytes:
+        def convert(v):
+            if isinstance(v, ClientObjectRef):
+                return ("__ref__", v._key)
+            if isinstance(v, ClientActorHandle):
+                return ("__actor__", v._actor_key)
+            return v
+
+        return serialization.serialize_framed(
+            (tuple(convert(a) for a in args),
+             {k: convert(v) for k, v in kwargs.items()}))
+
+    # -- API ----------------------------------------------------------
+    def remote(self, func_or_class, **options):
+        if isinstance(func_or_class, type):
+            return ClientRemoteClass(self, func_or_class, options)
+        return ClientRemoteFunction(self, func_or_class, options)
+
+    def put(self, value: Any) -> ClientObjectRef:
+        key = self._rpc.call(
+            "client_put", serialization.serialize_framed(value))
+        return ClientObjectRef(self, key)
+
+    def get(self, refs, timeout: float | None = None):
+        single = isinstance(refs, ClientObjectRef)
+        if single:
+            refs = [refs]
+        blob = self._rpc.call(
+            "client_get", [r._key for r in refs], timeout)
+        values = serialization.deserialize_from_buffer(memoryview(blob))
+        return values[0] if single else list(values)
+
+    def wait(self, refs, *, num_returns: int = 1,
+             timeout: float | None = None):
+        by_key = {r._key: r for r in refs}
+        ready, pending = self._rpc.call(
+            "client_wait", [r._key for r in refs], num_returns, timeout)
+        return ([by_key[k] for k in ready], [by_key[k] for k in pending])
+
+    def kill(self, actor: ClientActorHandle) -> bool:
+        return self._rpc.call("client_kill_actor", actor._actor_key)
+
+    def release(self, refs) -> int:
+        return self._rpc.call("client_release", [r._key for r in refs])
+
+    def disconnect(self) -> None:
+        self._rpc.close()
+
+
+def connect(address: str, timeout_s: float = 60.0) -> ClientAPI:
+    """Connect to a cluster's client server (reference:
+    ray.init("ray://...") / ray.util.connect)."""
+    return ClientAPI(address, timeout_s=timeout_s)

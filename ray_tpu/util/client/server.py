@@ -1,0 +1,168 @@
+"""Client server — hosts remote drivers against the local runtime.
+
+Reference: python/ray/util/client/server/server.py (RayletServicer:
+gRPC endpoints Schedule/GetObject/PutObject/WaitObject/Terminate
+backed by the server-side ray worker). Here the endpoints ride the
+framework RPC layer (rpc.py) and execute against this process's
+Runtime (the head's, when embedded in the head daemon).
+
+Object lifetime: every ref returned to a client is pinned in
+``self._refs`` until the client disconnects or releases it, so the
+runtime cannot GC results the client still names.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any
+
+from ray_tpu._private import serialization
+from ray_tpu._private.rpc import RpcServer
+
+
+class ClientServer:
+    def __init__(self, host: str = "0.0.0.0", port: int = 0):
+        self._server = RpcServer(host, port)
+        self._refs: dict[str, Any] = {}       # ref hex -> ObjectRef
+        self._actors: dict[str, Any] = {}     # actor hex -> ActorHandle
+        self._lock = threading.Lock()
+        s = self._server
+        s.register("ping", lambda: "pong")
+        s.register("client_put", self.put)
+        s.register("client_get", self.get)
+        s.register("client_wait", self.wait)
+        s.register("client_task", self.task)
+        s.register("client_create_actor", self.create_actor)
+        s.register("client_actor_call", self.actor_call)
+        s.register("client_kill_actor", self.kill_actor)
+        s.register("client_release", self.release)
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    @property
+    def address(self) -> str:
+        return self._server.address
+
+    def start(self) -> "ClientServer":
+        self._server.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.stop()
+
+    # -- helpers ------------------------------------------------------
+    def _track(self, ref) -> str:
+        key = ref.id().hex()
+        with self._lock:
+            self._refs[key] = ref
+        return key
+
+    def _resolve(self, key: str):
+        with self._lock:
+            try:
+                return self._refs[key]
+            except KeyError:
+                raise KeyError(f"unknown/released client ref {key}") \
+                    from None
+
+    def _deserialize_args(self, args_blob: bytes):
+        args, kwargs = serialization.deserialize_from_buffer(
+            memoryview(args_blob))
+
+        def convert(v):
+            if isinstance(v, tuple) and len(v) == 2 and v[0] == "__ref__":
+                return self._resolve(v[1])
+            if isinstance(v, tuple) and len(v) == 2 \
+                    and v[0] == "__actor__":
+                with self._lock:
+                    return self._actors[v[1]]
+            return v
+
+        return (tuple(convert(a) for a in args),
+                {k: convert(v) for k, v in kwargs.items()})
+
+    # -- endpoints ----------------------------------------------------
+    def put(self, value_blob: bytes) -> str:
+        import ray_tpu
+
+        value = serialization.deserialize_from_buffer(
+            memoryview(value_blob))
+        return self._track(ray_tpu.put(value))
+
+    def get(self, keys: list[str], timeout: float | None = None) -> bytes:
+        import ray_tpu
+
+        refs = [self._resolve(k) for k in keys]
+        values = ray_tpu.get(refs, timeout=timeout)
+        return serialization.serialize_framed(values)
+
+    def wait(self, keys: list[str], num_returns: int,
+             timeout: float | None) -> tuple[list[str], list[str]]:
+        import ray_tpu
+
+        refs = [self._resolve(k) for k in keys]
+        ready, pending = ray_tpu.wait(
+            refs, num_returns=num_returns, timeout=timeout)
+        by_ref = {id(r): k for r, k in zip(refs, keys)}
+        return ([by_ref[id(r)] for r in ready],
+                [by_ref[id(r)] for r in pending])
+
+    def task(self, func_blob: bytes, args_blob: bytes,
+             options: dict) -> list[str]:
+        import ray_tpu
+
+        func = serialization.loads_function(func_blob)
+        args, kwargs = self._deserialize_args(args_blob)
+        remote_fn = ray_tpu.remote(func)
+        if options:
+            remote_fn = remote_fn.options(**options)
+        out = remote_fn.remote(*args, **kwargs)
+        refs = out if isinstance(out, (list, tuple)) else [out]
+        return [self._track(r) for r in refs]
+
+    def create_actor(self, cls_blob: bytes, args_blob: bytes,
+                     options: dict) -> str:
+        import ray_tpu
+
+        cls = serialization.loads_function(cls_blob)
+        args, kwargs = self._deserialize_args(args_blob)
+        actor_cls = ray_tpu.remote(cls)
+        if options:
+            actor_cls = actor_cls.options(**options)
+        handle = actor_cls.remote(*args, **kwargs)
+        key = handle._actor_id.hex()
+        with self._lock:
+            self._actors[key] = handle
+        return key
+
+    def actor_call(self, actor_key: str, method: str,
+                   args_blob: bytes, num_returns: int = 1) -> list[str]:
+        with self._lock:
+            handle = self._actors[actor_key]
+        args, kwargs = self._deserialize_args(args_blob)
+        bound = getattr(handle, method)
+        if num_returns != 1:
+            bound = bound.options(num_returns=num_returns)
+        out = bound.remote(*args, **kwargs)
+        refs = out if isinstance(out, (list, tuple)) else [out]
+        return [self._track(r) for r in refs]
+
+    def kill_actor(self, actor_key: str) -> bool:
+        import ray_tpu
+
+        with self._lock:
+            handle = self._actors.pop(actor_key, None)
+        if handle is None:
+            return False
+        ray_tpu.kill(handle)
+        return True
+
+    def release(self, keys: list[str]) -> int:
+        with self._lock:
+            n = 0
+            for k in keys:
+                if self._refs.pop(k, None) is not None:
+                    n += 1
+        return n

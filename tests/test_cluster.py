@@ -252,3 +252,31 @@ def test_cli_start_status_job_stop(tmp_path):
         assert "cli-job-ok" in out.stdout
     finally:
         cli("stop")
+
+
+def test_heartbeat_carries_resource_usage(gcs):
+    """ray_syncer-lite: live availability rides heartbeats."""
+    usage = {"value": {"CPU": 3.0}}
+    agent = NodeAgent(gcs.address, {"CPU": 4.0},
+                      heartbeat_period_s=0.1,
+                      usage_fn=lambda: usage["value"])
+    client = RpcClient(gcs.address)
+    deadline = time.time() + 10
+    seen = {}
+    while time.time() < deadline:
+        nodes = client.call("list_nodes")
+        seen = nodes[0].get("available", {})
+        if seen == {"CPU": 3.0}:
+            break
+        time.sleep(0.1)
+    assert seen == {"CPU": 3.0}
+    # Usage updates as the node's availability changes.
+    usage["value"] = {"CPU": 1.0}
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        nodes = client.call("list_nodes")
+        if nodes[0].get("available") == {"CPU": 1.0}:
+            break
+        time.sleep(0.1)
+    assert nodes[0]["available"] == {"CPU": 1.0}
+    agent.stop()

@@ -149,6 +149,9 @@ def test_memory_monitor_kills_fattest_worker():
 
         # Threshold 0 => always over pressure; one kill per check.
         monitor = MemoryMonitor(runtime, threshold=0.0)
+        # Wire it in like init() does: a dispatch racing the async kill
+        # then retries on the OOM budget instead of failing the task.
+        runtime.memory_monitor = monitor
         killed_pid = monitor.check_once()
         assert killed_pid in {w.proc.pid for w in workers}
         assert monitor.num_kills == 1
@@ -174,5 +177,51 @@ def test_memory_monitor_noop_below_threshold():
         monitor = MemoryMonitor(runtime, threshold=1.0)  # never over
         assert monitor.check_once() is None
         assert monitor.num_kills == 0
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_oom_killed_task_is_retried(tmp_path):
+    """A task whose worker the memory monitor kills retries on its OOM
+    budget even with max_retries=0 (reference OOM policy)."""
+    import threading
+
+    from ray_tpu._private.memory_monitor import MemoryMonitor
+
+    ray_tpu.shutdown()
+    runtime = ray_tpu.init(
+        num_cpus=2, process_workers=1,
+        system_config={"memory_monitor_refresh_ms": 0})
+    try:
+        marker = tmp_path / "attempted"
+
+        @ray_tpu.remote
+        def first_slow_then_fast(path):
+            import os as _os
+            import time as _time
+
+            if not _os.path.exists(path):
+                with open(path, "w") as f:
+                    f.write("1")
+                _time.sleep(30)  # first attempt: long enough to be shot
+                return "slow-path"
+            return "retried-ok"
+
+        monitor = MemoryMonitor(runtime, threshold=0.0)
+        runtime.memory_monitor = monitor  # retry logic consults this
+        ref = first_slow_then_fast.remote(str(marker))
+
+        def shoot():
+            deadline = time.time() + 15
+            while time.time() < deadline and not marker.exists():
+                time.sleep(0.05)
+            time.sleep(0.2)  # the task is inside its sleep now
+            monitor.check_once()
+
+        t = threading.Thread(target=shoot)
+        t.start()
+        assert ray_tpu.get(ref, timeout=60) == "retried-ok"
+        t.join(timeout=10)
+        assert monitor.num_kills == 1
     finally:
         ray_tpu.shutdown()

@@ -343,3 +343,39 @@ def test_streaming_split_survives_abandoned_consumer(ray_start_regular):
     # Everything except what consumer 0 took (plus blocks lost in its
     # abandoned queue) flowed to consumer 1.
     assert len(rest) >= 400
+
+
+def test_streaming_split_propagates_upstream_error(ray_start_regular):
+    """Regression (equal mode): an upstream task failure must raise in
+    consumers, not end the stream cleanly with truncated data."""
+    import ray_tpu.data as rdata
+
+    def poison(row):
+        if row["id"] == 37:
+            raise RuntimeError("poisoned row")
+        return row
+
+    ds = rdata.range(100, override_num_blocks=10).map(poison)
+    for equal in (True, False):
+        its = ds.streaming_split(2, equal=equal)
+
+        def drain(it):
+            for _ in it.iter_batches(batch_size=10):
+                pass
+
+        errors = []
+        import threading
+
+        def run(it):
+            try:
+                drain(it)
+            except Exception as exc:  # noqa: BLE001
+                errors.append(exc)
+
+        threads = [threading.Thread(target=run, args=(it,))
+                   for it in its]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert errors, f"equal={equal}: no consumer saw the failure"
