@@ -57,8 +57,11 @@ class MemoryMonitor:
         self.num_kills = 0
         # Pids this monitor killed: their WorkerCrashedErrors are
         # OOM failures, retried beyond the task's own max_retries
-        # (reference: OOM kills get their own retry budget).
+        # (reference: OOM kills get their own retry budget). Bounded +
+        # consumed on attribution so an OS-recycled pid cannot
+        # misclassify an unrelated crash hours later.
         self.killed_pids: set[int] = set()
+        self._kill_order: list[int] = []
         self._shutdown = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="memory-monitor")
@@ -93,6 +96,9 @@ class MemoryMonitor:
             "system error)", usage * 100, pid,
             process_rss_bytes(pid) / 1e6)
         self.killed_pids.add(pid)
+        self._kill_order.append(pid)
+        while len(self._kill_order) > 64:
+            self.killed_pids.discard(self._kill_order.pop(0))
         try:
             victim.proc.kill()
         except OSError:

@@ -124,9 +124,18 @@ def run_head(port: int, resources: dict | None = None,
     client_server = ClientServer(host="0.0.0.0", port=0).start()
     with open(os.path.join(SESSION_DIR, "client_address"), "w") as f:
         f.write(f"{_own_address()}:{client_server.port}")
+    # The head executes client-submitted work, so its heartbeats carry
+    # the live availability of its own runtime.
+    from ray_tpu._private.worker import global_runtime
+
+    def head_usage():
+        runtime = global_runtime()
+        return runtime.available_resources() if runtime else None
+
     agent = NodeAgent(f"127.0.0.1:{server._server.port}",
                       resources or default_resources(),
-                      labels={"node_role": "head"})
+                      labels={"node_role": "head"},
+                      usage_fn=head_usage)
 
     stop_event = threading.Event()
 

@@ -582,6 +582,11 @@ class Runtime:
                     and self.memory_monitor is not None
                     and getattr(exc, "worker_pid", None)
                     in self.memory_monitor.killed_pids)
+        if oom_kill and spec.attempt + 1 >= int(
+                GLOBAL_CONFIG.task_oom_retries):
+            # Final OOM attempt: consume the attribution so a recycled
+            # pid cannot reclassify a future unrelated crash.
+            self.memory_monitor.killed_pids.discard(exc.worker_pid)
         retry_budget = max(spec.max_retries,
                            int(GLOBAL_CONFIG.task_oom_retries)
                            if oom_kill else spec.max_retries)

@@ -36,7 +36,7 @@ class ClientRemoteFunction:
         keys = self._api._rpc.call(
             "client_task", self._func_blob,
             self._api._marshal(args, kwargs), self._options)
-        refs = [ClientObjectRef(self._api, k) for k in keys]
+        refs = [self._api._new_ref(k) for k in keys]
         return refs[0] if len(refs) == 1 else refs
 
 
@@ -56,7 +56,7 @@ class _ClientActorMethod:
         keys = self._api._rpc.call(
             "client_actor_call", self._actor_key, self._name,
             self._api._marshal(args, kwargs), self._num_returns)
-        refs = [ClientObjectRef(self._api, k) for k in keys]
+        refs = [self._api._new_ref(k) for k in keys]
         return refs[0] if len(refs) == 1 else refs
 
 
@@ -86,6 +86,7 @@ class ClientRemoteClass:
         key = self._api._rpc.call(
             "client_create_actor", self._cls_blob,
             self._api._marshal(args, kwargs), self._options)
+        self._api._live_actors.add(key)
         return ClientActorHandle(self._api, key)
 
 
@@ -93,19 +94,35 @@ class ClientAPI:
     """The remote() / get() / put() / wait() surface of a connected
     client (reference: ray.util.client ClientAPI)."""
 
+    # Server-side poll window per RPC; must stay well under the socket
+    # timeout so long gets never trip the transport's reconnect/resend.
+    _POLL_S = 10.0
+
     def __init__(self, address: str, timeout_s: float = 60.0):
         self._rpc = RpcClient(address, timeout_s=timeout_s)
         if not self._rpc.ping():
             raise ConnectionError(
                 f"no ray_tpu client server at {address}")
+        # Session-owned server state, cleaned up on disconnect().
+        self._live_refs: set[str] = set()
+        self._live_actors: set[str] = set()
 
     # -- marshalling --------------------------------------------------
     def _marshal(self, args: tuple, kwargs: dict) -> bytes:
         def convert(v):
+            # Recursive: refs inside lists/tuples/dicts must become
+            # placeholders too (a raw ClientObjectRef drags its RpcClient
+            # — socket + lock — into pickle and fails).
             if isinstance(v, ClientObjectRef):
                 return ("__ref__", v._key)
             if isinstance(v, ClientActorHandle):
                 return ("__actor__", v._actor_key)
+            if isinstance(v, list):
+                return [convert(x) for x in v]
+            if isinstance(v, tuple):
+                return tuple(convert(x) for x in v)
+            if isinstance(v, dict):
+                return {k: convert(x) for k, x in v.items()}
             return v
 
         return serialization.serialize_framed(
@@ -118,34 +135,77 @@ class ClientAPI:
             return ClientRemoteClass(self, func_or_class, options)
         return ClientRemoteFunction(self, func_or_class, options)
 
+    def _new_ref(self, key: str) -> ClientObjectRef:
+        self._live_refs.add(key)
+        return ClientObjectRef(self, key)
+
     def put(self, value: Any) -> ClientObjectRef:
         key = self._rpc.call(
             "client_put", serialization.serialize_framed(value))
-        return ClientObjectRef(self, key)
+        return self._new_ref(key)
 
     def get(self, refs, timeout: float | None = None):
+        """Chunked long-poll: each RPC blocks server-side at most
+        _POLL_S, so tasks longer than the socket timeout still resolve
+        (and the transport's resend can't duplicate a blocking get)."""
+        import time as _time
+
         single = isinstance(refs, ClientObjectRef)
         if single:
             refs = [refs]
-        blob = self._rpc.call(
-            "client_get", [r._key for r in refs], timeout)
-        values = serialization.deserialize_from_buffer(memoryview(blob))
-        return values[0] if single else list(values)
+        keys = [r._key for r in refs]
+        deadline = None if timeout is None \
+            else _time.monotonic() + timeout
+        while True:
+            status, blob = self._rpc.call(
+                "client_get", keys, self._POLL_S)
+            if status == "ok":
+                values = serialization.deserialize_from_buffer(
+                    memoryview(blob))
+                return values[0] if single else list(values)
+            if deadline is not None and _time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"client get timed out after {timeout}s")
 
     def wait(self, refs, *, num_returns: int = 1,
              timeout: float | None = None):
+        import time as _time
+
         by_key = {r._key: r for r in refs}
-        ready, pending = self._rpc.call(
-            "client_wait", [r._key for r in refs], num_returns, timeout)
-        return ([by_key[k] for k in ready], [by_key[k] for k in pending])
+        deadline = None if timeout is None \
+            else _time.monotonic() + timeout
+        while True:
+            remaining = None if deadline is None \
+                else max(0.0, deadline - _time.monotonic())
+            ready, pending = self._rpc.call(
+                "client_wait", [r._key for r in refs], num_returns,
+                remaining, self._POLL_S)
+            if len(ready) >= num_returns or (
+                    remaining is not None and remaining <= 0):
+                return ([by_key[k] for k in ready],
+                        [by_key[k] for k in pending])
 
     def kill(self, actor: ClientActorHandle) -> bool:
+        self._live_actors.discard(actor._actor_key)
         return self._rpc.call("client_kill_actor", actor._actor_key)
 
     def release(self, refs) -> int:
-        return self._rpc.call("client_release", [r._key for r in refs])
+        keys = [r._key for r in refs]
+        self._live_refs.difference_update(keys)
+        return self._rpc.call("client_release", keys)
 
     def disconnect(self) -> None:
+        """Release this session's server-side refs and actors, then
+        close. (A client that crashes without disconnecting leaves its
+        refs pinned — same caveat as the reference client.)"""
+        try:
+            self._rpc.call("client_disconnect",
+                           sorted(self._live_refs),
+                           sorted(self._live_actors))
+        except Exception:  # noqa: BLE001 — best-effort cleanup
+            pass
+        self._live_refs.clear()
+        self._live_actors.clear()
         self._rpc.close()
 
 

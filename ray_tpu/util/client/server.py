@@ -36,6 +36,7 @@ class ClientServer:
         s.register("client_actor_call", self.actor_call)
         s.register("client_kill_actor", self.kill_actor)
         s.register("client_release", self.release)
+        s.register("client_disconnect", self.disconnect_cleanup)
 
     @property
     def port(self) -> int:
@@ -72,12 +73,20 @@ class ClientServer:
             memoryview(args_blob))
 
         def convert(v):
+            # Symmetric with ClientAPI._marshal: placeholders may sit
+            # inside lists/tuples/dicts, not just at the top level.
             if isinstance(v, tuple) and len(v) == 2 and v[0] == "__ref__":
                 return self._resolve(v[1])
             if isinstance(v, tuple) and len(v) == 2 \
                     and v[0] == "__actor__":
                 with self._lock:
                     return self._actors[v[1]]
+            if isinstance(v, list):
+                return [convert(x) for x in v]
+            if isinstance(v, tuple):
+                return tuple(convert(x) for x in v)
+            if isinstance(v, dict):
+                return {k: convert(x) for k, x in v.items()}
             return v
 
         return (tuple(convert(a) for a in args),
@@ -91,23 +100,48 @@ class ClientServer:
             memoryview(value_blob))
         return self._track(ray_tpu.put(value))
 
-    def get(self, keys: list[str], timeout: float | None = None) -> bytes:
-        import ray_tpu
-
-        refs = [self._resolve(k) for k in keys]
-        values = ray_tpu.get(refs, timeout=timeout)
-        return serialization.serialize_framed(values)
-
-    def wait(self, keys: list[str], num_returns: int,
-             timeout: float | None) -> tuple[list[str], list[str]]:
+    def get(self, keys: list[str],
+            poll_s: float = 10.0) -> tuple[str, bytes | None]:
+        """Bounded server-side block: ("ok", values_blob) when every
+        ref is ready within poll_s, else ("pending", None). The client
+        loops — an RPC never outlives the socket timeout, so the
+        transport's reconnect/resend cannot fire mid-long-get.
+        """
         import ray_tpu
 
         refs = [self._resolve(k) for k in keys]
         ready, pending = ray_tpu.wait(
-            refs, num_returns=num_returns, timeout=timeout)
+            refs, num_returns=len(refs), timeout=poll_s)
+        if pending:
+            return ("pending", None)
+        values = ray_tpu.get(refs)
+        return ("ok", serialization.serialize_framed(values))
+
+    def wait(self, keys: list[str], num_returns: int,
+             timeout: float | None,
+             poll_s: float = 10.0) -> tuple[list[str], list[str]]:
+        """Server-side block capped at poll_s; the client loops."""
+        import ray_tpu
+
+        capped = poll_s if timeout is None else min(timeout, poll_s)
+        refs = [self._resolve(k) for k in keys]
+        ready, pending = ray_tpu.wait(
+            refs, num_returns=num_returns, timeout=capped)
         by_ref = {id(r): k for r, k in zip(refs, keys)}
         return ([by_ref[id(r)] for r in ready],
                 [by_ref[id(r)] for r in pending])
+
+    def disconnect_cleanup(self, ref_keys: list[str],
+                           actor_keys: list[str]) -> int:
+        """Release a disconnecting client's refs and kill its actors
+        (reference: client session cleanup on connection close)."""
+        n = self.release(ref_keys)
+        for key in actor_keys:
+            try:
+                self.kill_actor(key)
+            except Exception:  # noqa: BLE001 — already dead
+                pass
+        return n
 
     def task(self, func_blob: bytes, args_blob: bytes,
              options: dict) -> list[str]:

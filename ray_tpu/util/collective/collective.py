@@ -25,12 +25,6 @@ class ReduceOp(enum.Enum):
     MAX = "max"
 
 
-_REDUCERS = {
-    ReduceOp.SUM: lambda arrs: np.sum(arrs, axis=0),
-    ReduceOp.PRODUCT: lambda arrs: np.prod(arrs, axis=0),
-    ReduceOp.MIN: lambda arrs: np.min(arrs, axis=0),
-    ReduceOp.MAX: lambda arrs: np.max(arrs, axis=0),
-}
 
 
 @dataclass
@@ -122,11 +116,18 @@ def _exchange(group: _Group, op: str, payload) -> dict[int, Any]:
 
 def allreduce(tensor, group_name: str = "default",
               op: ReduceOp = ReduceOp.SUM):
-    """Reference: collective.py:258. Returns the reduced array."""
+    """Reference: collective.py:258. Returns the reduced array.
+
+    The store reduces incrementally as contributions arrive, so each
+    rank ships one array and receives one array — O(world) traffic
+    (the round-1 fan-out of the full contribution set was O(world^2)).
+    """
     group = _group(group_name)
-    contributions = _exchange(group, "allreduce", np.asarray(tensor))
-    arrs = [contributions[r] for r in range(group.world_size)]
-    return _REDUCERS[op](np.stack(arrs))
+    key = group.next_key("allreduce")
+    return ray_tpu.get(
+        group.store.reduce_exchange.remote(
+            key, group.rank, np.asarray(tensor), op.value),
+        timeout=120.0)
 
 
 def barrier(group_name: str = "default") -> None:
@@ -135,13 +136,18 @@ def barrier(group_name: str = "default") -> None:
 
 
 def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
-    """Reference: collective.py:373. Returns src's tensor on every rank."""
+    """Reference: collective.py:373. Returns src's tensor on every rank.
+
+    Only the source ships a payload; receivers block for the value
+    (no receiver-receiver barrier, matching NCCL broadcast).
+    """
     group = _group(group_name)
+    key = group.next_key("broadcast")
     payload = np.asarray(tensor) if group.rank == src_rank else None
-    contributions = _exchange(group, "broadcast", payload)
-    if contributions.get(src_rank) is None:
-        raise RuntimeError(f"broadcast: src_rank {src_rank} sent nothing")
-    return contributions[src_rank]
+    return ray_tpu.get(
+        group.store.broadcast_value.remote(
+            key, group.rank, payload, src_rank),
+        timeout=120.0)
 
 
 def allgather(tensor, group_name: str = "default") -> list:
@@ -161,11 +167,12 @@ def reducescatter(tensor, group_name: str = "default",
         raise ValueError(
             f"reducescatter: leading dim {arr.shape[0]} not divisible by "
             f"world_size {group.world_size}")
-    contributions = _exchange(group, "reducescatter", arr)
-    reduced = _REDUCERS[op](
-        np.stack([contributions[r] for r in range(group.world_size)]))
-    chunks = np.split(reduced, group.world_size, axis=0)
-    return chunks[group.rank]
+    key = group.next_key("reducescatter")
+    # Store-side reduce; each rank receives only its shard.
+    return ray_tpu.get(
+        group.store.reduce_scatter.remote(
+            key, group.rank, arr, op.value),
+        timeout=120.0)
 
 
 def send(tensor, dst_rank: int, group_name: str = "default",

@@ -99,3 +99,86 @@ def test_client_options_num_returns(client_server):
 
     refs = api.remote(pair).options(num_returns=2).remote()
     assert [api.get(r) for r in refs] == [1, 2]
+
+
+def test_client_nested_refs_in_containers(client_server):
+    """Regression: refs nested in lists/dicts must be rebuilt as real
+    server-side ObjectRefs (not pickled raw with their RpcClient).
+    Reference semantics: nested refs arrive as refs — the task gets
+    them itself."""
+    api, _ = client_server
+    refs = [api.put(i) for i in range(3)]
+
+    def total(items, named):
+        import ray_tpu
+
+        return sum(ray_tpu.get(list(items))) + ray_tpu.get(named["x"])
+
+    out = api.remote(total).remote(refs, {"x": api.put(100)})
+    assert api.get(out) == 0 + 1 + 2 + 100
+
+
+def test_client_long_task_exceeds_poll_window(client_server):
+    """A task longer than the per-RPC poll window still resolves
+    (chunked long-poll; no transport resend duplication)."""
+    api, _ = client_server
+    api._POLL_S = 0.2  # shrink the window so the test is fast
+
+    def slowish():
+        import time as _t
+
+        _t.sleep(1.0)
+        return "done-after-poll-windows"
+
+    assert api.get(api.remote(slowish).remote()) == \
+        "done-after-poll-windows"
+    with pytest.raises(TimeoutError):
+        api.get(api.remote(slowish).remote(), timeout=0.3)
+
+
+def test_client_disconnect_releases_session_state():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4)
+    server = rclient.ClientServer(host="127.0.0.1").start()
+    try:
+        api = rclient.connect(f"127.0.0.1:{server.port}")
+        refs = [api.put(i) for i in range(5)]
+        _ = api.get(refs)
+        assert len(server._refs) == 5
+        api.disconnect()
+        assert len(server._refs) == 0
+    finally:
+        server.stop()
+        ray_tpu.shutdown()
+
+
+def test_collective_allreduce_results_not_aliased():
+    """Regression: each rank's allreduce result must be independently
+    mutable (the store must not hand out one shared accumulator)."""
+    import numpy as np
+
+    from ray_tpu.util import collective
+
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    try:
+        @ray_tpu.remote
+        class Rank:
+            def __init__(self, rank, world):
+                collective.init_collective_group(
+                    world, rank, group_name="alias")
+                self.rank = rank
+
+            def run(self):
+                out = collective.allreduce(
+                    np.ones(4), group_name="alias")
+                # Simulate MEAN: divide in place. Must not affect peers.
+                out /= 2.0
+                return out
+
+        actors = [Rank.remote(r, 3) for r in range(3)]
+        results = ray_tpu.get([a.run.remote() for a in actors])
+        for r in results:
+            np.testing.assert_allclose(r, np.full(4, 1.5))
+    finally:
+        ray_tpu.shutdown()
