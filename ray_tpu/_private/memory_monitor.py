@@ -74,6 +74,15 @@ class MemoryMonitor:
         while not self._shutdown.wait(self.period_s):
             self.check_once()
 
+    def consume_attribution(self, pid: int) -> None:
+        """Forget a kill after its final retry attempt (keeps a
+        recycled pid from reclassifying a future unrelated crash)."""
+        self.killed_pids.discard(pid)
+        try:
+            self._kill_order.remove(pid)
+        except ValueError:
+            pass
+
     def check_once(self) -> int | None:
         """One pressure check; returns the killed pid (or None)."""
         usage = host_memory_usage_fraction()

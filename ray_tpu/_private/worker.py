@@ -586,7 +586,7 @@ class Runtime:
                 GLOBAL_CONFIG.task_oom_retries):
             # Final OOM attempt: consume the attribution so a recycled
             # pid cannot reclassify a future unrelated crash.
-            self.memory_monitor.killed_pids.discard(exc.worker_pid)
+            self.memory_monitor.consume_attribution(exc.worker_pid)
         retry_budget = max(spec.max_retries,
                            int(GLOBAL_CONFIG.task_oom_retries)
                            if oom_kill else spec.max_retries)

@@ -91,7 +91,7 @@ class _runtime_env_ctx:
         self.env = runtime_env or {}
         self._saved_vars: dict[str, str | None] = {}
         self._saved_cwd: str | None = None
-        self._added_sys_path: str | None = None
+        self._added_sys_paths: list[str] = []
 
     def __enter__(self):
         for k, v in (self.env.get("env_vars") or {}).items():
@@ -103,7 +103,15 @@ class _runtime_env_ctx:
             os.chdir(working_dir)
             if working_dir not in sys.path:
                 sys.path.insert(0, working_dir)
-                self._added_sys_path = working_dir
+                self._added_sys_paths.append(working_dir)
+        # py_modules: local module dirs importable task-side
+        # (reference: runtime_env/py_modules.py; local paths only —
+        # no URI packaging without a cluster-wide store).
+        for path in (self.env.get("py_modules") or []):
+            parent = os.path.dirname(os.path.abspath(path))
+            if parent not in sys.path:
+                sys.path.insert(0, parent)
+                self._added_sys_paths.append(parent)
         return self
 
     def __exit__(self, *exc):
@@ -112,9 +120,19 @@ class _runtime_env_ctx:
                 os.chdir(self._saved_cwd)
             except OSError:
                 pass
-        if self._added_sys_path is not None:
+        if self._added_sys_paths:
+            # Unload modules imported from the env's paths: pool
+            # workers are shared across tasks, and a module cached in
+            # sys.modules would leak into tasks without this env
+            # (reference isolates via dedicated worker processes).
+            prefixes = tuple(p + os.sep for p in self._added_sys_paths)
+            for name, mod in list(sys.modules.items()):
+                mod_file = getattr(mod, "__file__", None)
+                if mod_file and mod_file.startswith(prefixes):
+                    sys.modules.pop(name, None)
+        for added in self._added_sys_paths:
             try:
-                sys.path.remove(self._added_sys_path)
+                sys.path.remove(added)
             except ValueError:
                 pass
         for k, old in self._saved_vars.items():

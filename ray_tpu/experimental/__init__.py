@@ -1,0 +1,21 @@
+"""ray_tpu.experimental — internal KV and channels.
+
+Reference: python/ray/experimental/ (internal_kv.py — driver/library
+access to the GCS KV; channel.py — compiled-DAG channels).
+"""
+
+from ray_tpu.experimental.internal_kv import (
+    internal_kv_del,
+    internal_kv_exists,
+    internal_kv_get,
+    internal_kv_list,
+    internal_kv_put,
+)
+
+__all__ = [
+    "internal_kv_del",
+    "internal_kv_exists",
+    "internal_kv_get",
+    "internal_kv_list",
+    "internal_kv_put",
+]

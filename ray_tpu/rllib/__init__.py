@@ -9,6 +9,12 @@ instead of DDP wrappers for multi-device learners.
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
+from ray_tpu.rllib.algorithms.bc import (
+    BC,
+    BCConfig,
+    MARWIL,
+    MARWILConfig,
+)
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.multi_agent_ppo import (
@@ -53,6 +59,10 @@ from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
 __all__ = [
     "APPO",
     "APPOConfig",
+    "BC",
+    "BCConfig",
+    "MARWIL",
+    "MARWILConfig",
     "Algorithm",
     "AlgorithmConfig",
     "CartPoleVectorEnv",

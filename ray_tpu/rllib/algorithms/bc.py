@@ -1,0 +1,207 @@
+"""BC / MARWIL — offline RL from logged experience.
+
+Reference: rllib/algorithms/bc/ (behavior cloning: supervised
+log-likelihood on logged actions) and rllib/algorithms/marwil/
+(monotonic advantage re-weighted imitation learning — BC weighted by
+exp(beta * advantage), so better-than-average logged actions are
+imitated harder; BC is MARWIL with beta=0). Offline IO
+(rllib/offline/) reads logged episodes; here the input is a
+ray_tpu.data Dataset (or a list of dicts), so offline training rides
+the same streaming data plane as everything else.
+
+The loss is one jitted update on [B] batches of (obs, action,
+advantage-ish weight); no environment interaction happens (env
+metrics come from optional evaluation rollouts).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import (
+    categorical_entropy,
+    categorical_logp,
+)
+from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
+
+
+class MARWILConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.beta = 1.0              # 0 => pure BC
+        self.vf_coeff = 1.0          # value branch for the advantage
+        self.bc_logstd_coeff = 0.0
+        self.entropy_coeff = 0.0
+        self.train_batch_size = 256
+        self.updates_per_iteration = 32
+        # offline_data(): dataset of rows with at least
+        # {"obs": [obs_dim], "actions": int} (+ optional "rewards").
+        self.input_ = None
+        # Optional evaluation rollouts (greedy) per iteration.
+        self.evaluation_num_episodes = 0
+
+    def offline_data(self, input_) -> "MARWILConfig":
+        """Reference: AlgorithmConfig.offline_data(input_=...)."""
+        self.input_ = input_
+        return self
+
+    def evaluation(self, *, evaluation_num_episodes: int | None = None,
+                   ) -> "MARWILConfig":
+        if evaluation_num_episodes is not None:
+            self.evaluation_num_episodes = evaluation_num_episodes
+        return self
+
+    def learner_class(self):
+        return MARWILLearner
+
+
+class BCConfig(MARWILConfig):
+    """BC = MARWIL with beta=0 (reference: bc/bc.py subclasses
+    MARWIL the same way)."""
+
+    def __init__(self):
+        super().__init__()
+        self.beta = 0.0
+
+
+class MARWILLearner(Learner):
+    """exp(beta * A) - weighted log-likelihood loss (reference:
+    marwil/torch/marwil_torch_learner.py)."""
+
+    def compute_loss(self, params, batch, rng):
+        cfg = self.config
+        out = self.module.forward_train(
+            params, {"obs": batch[Columns.OBS]}, rng)
+        logits = out["action_logits"]
+        logp = categorical_logp(logits, batch[Columns.ACTIONS])
+
+        beta = getattr(cfg, "beta", 1.0)
+        if beta > 0:
+            values = out["vf_preds"]
+            # Monte-Carlo return as the value target; advantage = G - V.
+            returns = batch["returns"]
+            advantages = jax.lax.stop_gradient(returns - values)
+            weights = jnp.exp(jnp.clip(beta * advantages, -10.0, 10.0))
+            vf_loss = jnp.mean(jnp.square(values - returns))
+        else:
+            weights = jnp.ones_like(logp)
+            vf_loss = jnp.zeros(())
+
+        bc_loss = -jnp.mean(jax.lax.stop_gradient(weights) * logp)
+        entropy = jnp.mean(categorical_entropy(logits))
+        total = (bc_loss + getattr(cfg, "vf_coeff", 1.0) * vf_loss
+                 - getattr(cfg, "entropy_coeff", 0.0) * entropy)
+        return total, {"bc_loss": bc_loss, "vf_loss": vf_loss,
+                       "entropy": entropy,
+                       "mean_weight": jnp.mean(weights)}
+
+
+def _rows_to_batch(rows: list[dict], gamma: float) -> SampleBatch:
+    """Flatten logged rows into a train batch with MC returns.
+
+    Rows are episode-ordered with "terminateds"/"truncateds" flags (or
+    independent transitions when absent — returns default to rewards).
+    """
+    obs = np.asarray([r["obs"] for r in rows], dtype=np.float32)
+    actions = np.asarray([r["actions"] for r in rows])
+    rewards = np.asarray([float(r.get("rewards", 0.0)) for r in rows],
+                         dtype=np.float32)
+    dones = np.asarray([bool(r.get("terminateds", False)
+                             or r.get("truncateds", False))
+                        for r in rows])
+    returns = np.zeros_like(rewards)
+    acc = 0.0
+    for i in range(len(rows) - 1, -1, -1):
+        if dones[i]:
+            acc = 0.0
+        acc = rewards[i] + gamma * acc
+        returns[i] = acc
+    return SampleBatch({
+        Columns.OBS: obs,
+        Columns.ACTIONS: actions,
+        "returns": returns,
+    })
+
+
+class MARWIL(Algorithm):
+    config_class = MARWILConfig
+
+    def setup(self, config: dict) -> None:
+        super().setup(config)
+        cfg = self.algo_config
+        if cfg.input_ is None:
+            raise ValueError(
+                "offline algorithms need config.offline_data(input_=...): "
+                "a ray_tpu.data Dataset or a list of row dicts")
+        rows = (cfg.input_.take_all()
+                if hasattr(cfg.input_, "take_all") else list(cfg.input_))
+        if not rows:
+            raise ValueError("offline input is empty")
+        self._train_batch = _rows_to_batch(rows, cfg.gamma)
+        self._rng = np.random.default_rng(cfg.seed)
+        self._learner_steps = 0
+
+    def _build_env_runners(self, cfg):
+        # Offline: env runners exist only for optional evaluation.
+        if cfg.evaluation_num_episodes <= 0:
+            self.local_env_runner = None
+            return None
+        return super()._build_env_runners(cfg)
+
+    def _sync_weights(self) -> None:
+        if getattr(self, "local_env_runner", None) is None \
+                and self.env_runner_group is None:
+            self._weights_version += 1
+            return
+        super()._sync_weights()
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        n = len(self._train_batch)
+        metrics: dict = {}
+        for _ in range(cfg.updates_per_iteration):
+            idx = self._rng.integers(
+                0, n, size=min(cfg.train_batch_size, n))
+            minibatch = SampleBatch(
+                {k: np.asarray(v)[idx]
+                 for k, v in self._train_batch.items()})
+            metrics = self.learner_group.update_from_batch(minibatch)
+            self._learner_steps += 1
+        results = dict(metrics)
+        results["num_learner_steps"] = self._learner_steps
+
+        if cfg.evaluation_num_episodes > 0:
+            results.update(self._evaluate(cfg))
+        return results
+
+    def _evaluate(self, cfg) -> dict:
+        """Greedy rollouts with the current policy on the LOCAL runner
+        (reference: evaluation_config with explore=False; offline
+        evaluation keeps num_env_runners=0)."""
+        self._sync_weights()
+        runner = self.local_env_runner
+        if runner is None:
+            return {}
+        episodes = 0
+        rounds = 0
+        while episodes < cfg.evaluation_num_episodes and rounds < 50:
+            runner.sample()
+            rounds += 1
+            m = runner.get_metrics()
+            episodes += m.get("num_episodes", 0)
+            if "episode_return_mean" in m:
+                return {"evaluation_return_mean":
+                        m["episode_return_mean"]}
+        return {}
+
+
+class BC(MARWIL):
+    config_class = BCConfig
+
+
+MARWILConfig.algo_class = MARWIL
+BCConfig.algo_class = BC

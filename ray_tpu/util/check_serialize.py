@@ -1,0 +1,78 @@
+"""inspect_serializability — find WHY an object will not pickle.
+
+Reference: python/ray/util/check_serialize.py (walks closures and
+attributes of a failing object, printing a tree of the unserializable
+leaves).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any
+
+from ray_tpu._private import serialization
+
+
+class FailureTuple:
+    """One unserializable leaf: the object, its name, and its parent."""
+
+    def __init__(self, obj: Any, name: str, parent: Any):
+        self.obj = obj
+        self.name = name
+        self.parent = parent
+
+    def __repr__(self):
+        return f"FailureTuple(obj={self.obj!r}, name={self.name!r})"
+
+
+def _serializable(obj: Any) -> bool:
+    try:
+        serialization.dumps_function(obj)
+        return True
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _find_failures(obj: Any, name: str, parent: Any, found: list,
+                   seen: set, depth: int = 0) -> None:
+    if id(obj) in seen or depth > 4:
+        return
+    seen.add(id(obj))
+    if _serializable(obj):
+        return
+    children: list[tuple[str, Any]] = []
+    if inspect.isfunction(obj):
+        # Closure cells + globals the function references.
+        if obj.__closure__:
+            for var, cell in zip(obj.__code__.co_freevars,
+                                 obj.__closure__):
+                try:
+                    children.append((var, cell.cell_contents))
+                except ValueError:
+                    pass
+        for gname in obj.__code__.co_names:
+            if gname in obj.__globals__:
+                children.append((gname, obj.__globals__[gname]))
+    elif hasattr(obj, "__dict__") and isinstance(obj.__dict__, dict):
+        children.extend(obj.__dict__.items())
+
+    child_failures_before = len(found)
+    for cname, child in children:
+        if not _serializable(child):
+            _find_failures(child, cname, obj, found, seen, depth + 1)
+    if len(found) == child_failures_before:
+        # No deeper culprit: this object itself is the leaf.
+        found.append(FailureTuple(obj, name, parent))
+
+
+def inspect_serializability(
+        obj: Any, name: str | None = None
+) -> tuple[bool, list[FailureTuple]]:
+    """-> (is_serializable, failure_leaves). Reference:
+    check_serialize.inspect_serializability."""
+    name = name or getattr(obj, "__name__", repr(obj)[:40])
+    if _serializable(obj):
+        return True, []
+    found: list[FailureTuple] = []
+    _find_failures(obj, name, None, found, set())
+    return False, found

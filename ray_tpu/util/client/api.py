@@ -117,11 +117,14 @@ class ClientAPI:
                 return ("__ref__", v._key)
             if isinstance(v, ClientActorHandle):
                 return ("__actor__", v._actor_key)
-            if isinstance(v, list):
+            # EXACT container types only: tuple/dict subclasses
+            # (namedtuples, OrderedDicts) pass through untouched —
+            # rebuilding them as plain containers would mangle them.
+            if type(v) is list:
                 return [convert(x) for x in v]
-            if isinstance(v, tuple):
+            if type(v) is tuple:
                 return tuple(convert(x) for x in v)
-            if isinstance(v, dict):
+            if type(v) is dict:
                 return {k: convert(x) for k, x in v.items()}
             return v
 
@@ -157,8 +160,12 @@ class ClientAPI:
         deadline = None if timeout is None \
             else _time.monotonic() + timeout
         while True:
-            status, blob = self._rpc.call(
-                "client_get", keys, self._POLL_S)
+            # Poll window never exceeds the caller's remaining budget,
+            # so get(timeout=0.5) returns in ~0.5s, not a full window.
+            poll = self._POLL_S
+            if deadline is not None:
+                poll = min(poll, max(0.0, deadline - _time.monotonic()))
+            status, blob = self._rpc.call("client_get", keys, poll)
             if status == "ok":
                 values = serialization.deserialize_from_buffer(
                     memoryview(blob))

@@ -81,11 +81,14 @@ class ClientServer:
                     and v[0] == "__actor__":
                 with self._lock:
                     return self._actors[v[1]]
-            if isinstance(v, list):
+            # EXACT container types only: tuple/dict subclasses
+            # (namedtuples, OrderedDicts) pass through untouched —
+            # rebuilding them as plain containers would mangle them.
+            if type(v) is list:
                 return [convert(x) for x in v]
-            if isinstance(v, tuple):
+            if type(v) is tuple:
                 return tuple(convert(x) for x in v)
-            if isinstance(v, dict):
+            if type(v) is dict:
                 return {k: convert(x) for k, x in v.items()}
             return v
 

@@ -142,6 +142,10 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     (no receiver-receiver barrier, matching NCCL broadcast).
     """
     group = _group(group_name)
+    if not 0 <= src_rank < group.world_size:
+        raise ValueError(
+            f"broadcast: src_rank {src_rank} outside "
+            f"[0, {group.world_size}) — no rank would ever send")
     key = group.next_key("broadcast")
     payload = np.asarray(tensor) if group.rank == src_rank else None
     return ray_tpu.get(

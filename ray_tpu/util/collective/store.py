@@ -84,16 +84,24 @@ class CollectiveStore:
             arr = np.asarray(payload)
             if slot["acc"] is None:
                 slot["acc"] = arr.copy()
-            elif reduce_op in ("sum", "mean"):
-                slot["acc"] += arr
-            elif reduce_op == "product":
-                slot["acc"] *= arr
-            elif reduce_op == "min":
-                np.minimum(slot["acc"], arr, out=slot["acc"])
-            elif reduce_op == "max":
-                np.maximum(slot["acc"], arr, out=slot["acc"])
             else:
-                raise ValueError(f"unknown reduce op {reduce_op!r}")
+                # Deterministic dtype promotion regardless of arrival
+                # order (the in-place op alone would pin the dtype to
+                # whichever rank arrived first).
+                common = np.result_type(slot["acc"].dtype, arr.dtype)
+                if slot["acc"].dtype != common:
+                    slot["acc"] = slot["acc"].astype(common)
+                if reduce_op in ("sum", "mean"):
+                    slot["acc"] = slot["acc"] + arr
+                elif reduce_op == "product":
+                    slot["acc"] = slot["acc"] * arr
+                elif reduce_op == "min":
+                    slot["acc"] = np.minimum(slot["acc"], arr)
+                elif reduce_op == "max":
+                    slot["acc"] = np.maximum(slot["acc"], arr)
+                else:
+                    raise ValueError(
+                        f"unknown reduce op {reduce_op!r}")
             slot["count"] += 1
             self._lock.notify_all()
             while slot["count"] < self._world:

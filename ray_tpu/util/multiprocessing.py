@@ -1,0 +1,134 @@
+"""multiprocessing.Pool shim over the task runtime.
+
+Reference: python/ray/util/multiprocessing/ (Pool running on actors so
+existing multiprocessing code ports by changing an import). Methods:
+apply/apply_async, map/map_async, imap/imap_unordered, starmap.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+import ray_tpu
+
+
+class AsyncResult:
+    """Reference: multiprocessing.pool.AsyncResult protocol."""
+
+    def __init__(self, refs, single: bool):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: float | None = None):
+        values = ray_tpu.get(self._refs, timeout=timeout)
+        return values[0] if self._single else values
+
+    def wait(self, timeout: float | None = None) -> None:
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(self._refs,
+                                num_returns=len(self._refs), timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        if not self.ready():
+            raise ValueError("result is not ready")
+        try:
+            ray_tpu.get(self._refs, timeout=0)
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+
+class Pool:
+    """Task-backed process pool (each call is a ray_tpu task, so with
+    ``init(process_workers=N)`` work runs on real OS processes)."""
+
+    def __init__(self, processes: int | None = None,
+                 initializer: Callable | None = None,
+                 initargs: tuple = ()):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self._processes = processes or 4
+        self._closed = False
+        # The initializer contract is per-worker-process; our tasks
+        # share pool workers, so run it lazily inside each task chunk.
+        self._initializer = initializer
+        self._initargs = initargs
+
+    def _wrap(self, func: Callable) -> Callable:
+        init, initargs = self._initializer, self._initargs
+        if init is None:
+            return func
+
+        def wrapped(*a, **kw):
+            init(*initargs)
+            return func(*a, **kw)
+
+        return wrapped
+
+    def _check_open(self) -> None:
+        if self._closed:
+            raise ValueError("Pool is closed")
+
+    # -- apply --------------------------------------------------------
+    def apply(self, func: Callable, args: tuple = (),
+              kwds: dict | None = None):
+        return self.apply_async(func, args, kwds).get()
+
+    def apply_async(self, func: Callable, args: tuple = (),
+                    kwds: dict | None = None) -> AsyncResult:
+        self._check_open()
+        remote_fn = ray_tpu.remote(self._wrap(func))
+        return AsyncResult([remote_fn.remote(*args, **(kwds or {}))],
+                           single=True)
+
+    # -- map ----------------------------------------------------------
+    def map(self, func: Callable, iterable: Iterable) -> list:
+        return self.map_async(func, iterable).get()
+
+    def map_async(self, func: Callable, iterable: Iterable) -> AsyncResult:
+        self._check_open()
+        remote_fn = ray_tpu.remote(self._wrap(func))
+        return AsyncResult([remote_fn.remote(x) for x in iterable],
+                           single=False)
+
+    def starmap(self, func: Callable, iterable: Iterable) -> list:
+        self._check_open()
+        remote_fn = ray_tpu.remote(self._wrap(func))
+        return ray_tpu.get(
+            [remote_fn.remote(*args) for args in iterable])
+
+    def imap(self, func: Callable, iterable: Iterable):
+        self._check_open()
+        remote_fn = ray_tpu.remote(self._wrap(func))
+        refs = [remote_fn.remote(x) for x in iterable]
+        for ref in refs:  # submission order
+            yield ray_tpu.get(ref)
+
+    def imap_unordered(self, func: Callable, iterable: Iterable):
+        self._check_open()
+        remote_fn = ray_tpu.remote(self._wrap(func))
+        pending = [remote_fn.remote(x) for x in iterable]
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1)
+            yield ray_tpu.get(ready[0])
+
+    # -- lifecycle ----------------------------------------------------
+    def close(self) -> None:
+        self._closed = True
+
+    def terminate(self) -> None:
+        self._closed = True
+
+    def join(self) -> None:
+        if not self._closed:
+            raise ValueError("Pool is still open")
+
+    def __enter__(self) -> "Pool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.terminate()

@@ -169,3 +169,51 @@ def test_sendrecv_queue_preserves_order(ray_start_regular):
     a, b = P.remote(0), P.remote(1)
     assert ray_tpu.get(a.producer.remote())
     assert ray_tpu.get(b.consumer.remote()) == [0.0, 1.0, 2.0, 3.0, 4.0]
+
+
+def test_broadcast_invalid_src_rank_fails_fast(ray_start_regular):
+    import numpy as np
+
+    from ray_tpu.util import collective
+
+    @ray_tpu.remote
+    class Solo:
+        def __init__(self):
+            collective.init_collective_group(1, 0, group_name="solo")
+
+        def bad(self):
+            try:
+                collective.broadcast(np.ones(2), src_rank=5,
+                                     group_name="solo")
+                return "no-error"
+            except ValueError as exc:
+                return str(exc)
+
+    msg = ray_tpu.get(Solo.remote().bad.remote())
+    assert "src_rank 5" in msg
+
+
+def test_allreduce_mixed_dtype_promotes_deterministically(
+        ray_start_regular):
+    import numpy as np
+
+    from ray_tpu.util import collective
+
+    @ray_tpu.remote
+    class Rank:
+        def __init__(self, rank):
+            collective.init_collective_group(2, rank, group_name="dt")
+            self.rank = rank
+
+        def run(self):
+            # rank 0 ships f64, rank 1 ships f32 — result must be f64
+            # regardless of arrival order.
+            arr = (np.full(3, 0.1, dtype=np.float64) if self.rank == 0
+                   else np.full(3, 0.2, dtype=np.float32))
+            return collective.allreduce(arr, group_name="dt")
+
+    results = ray_tpu.get([Rank.remote(r).run.remote() for r in range(2)])
+    for out in results:
+        assert out.dtype == np.float64
+        np.testing.assert_allclose(
+            out, np.float64(0.1) + np.float32(0.2), rtol=1e-9)

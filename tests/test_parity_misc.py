@@ -1,0 +1,235 @@
+"""Offline RL (BC/MARWIL), data preprocessors, multiprocessing Pool,
+check_serialize, experimental KV, py_modules runtime_env.
+
+Reference test intent: rllib/algorithms/tests/test_bc.py /
+test_marwil.py, data/tests/preprocessors/, tests/test_multiprocessing,
+tests/test_serialization (inspect), tests/test_runtime_env.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def ray_start():
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=8)
+    yield
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------- offline RL
+def _expert_cartpole_rows(n_episodes: int = 40) -> list[dict]:
+    """Logged experience from a decent hand-written CartPole policy
+    (push toward the falling side)."""
+    from ray_tpu.rllib import CartPoleVectorEnv
+
+    env = CartPoleVectorEnv(num_envs=1)
+    rows = []
+    obs = env.reset(seed=0)
+    for _ in range(n_episodes * 120):
+        # Angle + angular-velocity heuristic: a strong CartPole expert.
+        action = int(obs[0, 2] + 0.5 * obs[0, 3] > 0)
+        next_obs, rew, term, trunc = env.step(np.array([action]))
+        rows.append({
+            "obs": obs[0].tolist(), "actions": action,
+            "rewards": float(rew[0]),
+            "terminateds": bool(term[0]), "truncateds": bool(trunc[0]),
+        })
+        obs = next_obs
+    return rows
+
+
+def test_bc_learns_from_expert_data(ray_start):
+    from ray_tpu.rllib import BCConfig
+
+    rows = _expert_cartpole_rows()
+    config = (BCConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=0, num_envs_per_env_runner=8,
+                           explore=False)
+              .training(train_batch_size=256, updates_per_iteration=100,
+                        lr=1e-3)
+              .debugging(seed=0))
+    config.offline_data(rows).evaluation(evaluation_num_episodes=8)
+    algo = config.build()
+    last_eval = None
+    for _ in range(6):
+        result = algo.train()
+        last_eval = result.get("evaluation_return_mean", last_eval)
+    algo.cleanup()
+    # Random CartPole ~20; the cloned expert policy must be far better.
+    assert last_eval is not None and last_eval > 100, last_eval
+
+
+def test_marwil_beta_weights_advantages(ray_start):
+    from ray_tpu.rllib import MARWILConfig
+
+    rows = _expert_cartpole_rows(10)
+    config = (MARWILConfig()
+              .environment("CartPole-v1")
+              .training(train_batch_size=128, updates_per_iteration=10,
+                        beta=1.0))
+    config.offline_data(rows)
+    algo = config.build()
+    result = algo.train()
+    assert "bc_loss" in result and "vf_loss" in result
+    assert result["mean_weight"] > 0  # exp-advantage weights active
+    # BC (beta=0) reports zero value loss.
+    from ray_tpu.rllib import BCConfig
+
+    bc = BCConfig().environment("CartPole-v1")
+    bc.offline_data(rows)
+    bc_algo = bc.build()
+    bc_result = bc_algo.train()
+    assert bc_result["vf_loss"] == 0.0
+    algo.cleanup()
+    bc_algo.cleanup()
+
+
+def test_offline_input_from_dataset(ray_start):
+    """Offline input can be a ray_tpu.data Dataset (offline IO path)."""
+    import ray_tpu.data as rdata
+    from ray_tpu.rllib import BCConfig
+
+    ds = rdata.from_items(_expert_cartpole_rows(5))
+    config = BCConfig().environment("CartPole-v1").training(
+        updates_per_iteration=2)
+    config.offline_data(ds)
+    algo = config.build()
+    result = algo.train()
+    assert "bc_loss" in result
+    algo.cleanup()
+
+
+# ------------------------------------------------------- preprocessors
+def test_standard_and_minmax_scalers(ray_start):
+    import ray_tpu.data as rdata
+    from ray_tpu.data.preprocessors import MinMaxScaler, StandardScaler
+
+    ds = rdata.from_items(
+        [{"a": float(i), "b": float(2 * i)} for i in range(100)])
+    scaler = StandardScaler(["a", "b"]).fit(ds)
+    out = scaler.transform(ds).take_all()
+    a = np.array([r["a"] for r in out])
+    assert abs(a.mean()) < 1e-6 and abs(a.std() - 1.0) < 1e-6
+
+    mm = MinMaxScaler(["a"]).fit(ds)
+    out = mm.transform(ds).take_all()
+    a = np.array([r["a"] for r in out])
+    assert a.min() == 0.0 and a.max() == 1.0
+
+
+def test_label_onehot_concat_chain(ray_start):
+    import ray_tpu.data as rdata
+    from ray_tpu.data.preprocessors import (
+        Chain,
+        Concatenator,
+        LabelEncoder,
+        OneHotEncoder,
+    )
+
+    ds = rdata.from_items([
+        {"color": c, "x": float(i)}
+        for i, c in enumerate(["red", "green", "blue", "green"] * 5)])
+    le = LabelEncoder("color").fit(ds)
+    out = le.transform(ds).take_all()
+    assert le.classes_ == ["blue", "green", "red"]
+    assert all(isinstance(r["color"], (int, np.integer)) for r in out)
+
+    oh = OneHotEncoder(["color"]).fit(ds)
+    out = oh.transform(ds).take_all()
+    assert np.asarray(out[0]["color"]).shape == (3,)
+    assert np.asarray(out[0]["color"]).sum() == 1.0
+
+    chain = Chain(OneHotEncoder(["color"]),
+                  Concatenator(["color", "x"], "features")).fit(ds)
+    out = chain.transform(ds).take_all()
+    assert np.asarray(out[0]["features"]).shape == (4,)
+
+
+# ------------------------------------------------- multiprocessing Pool
+def test_pool_map_apply_imap(ray_start):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(4) as pool:
+        assert pool.map(lambda x: x * x, range(8)) == \
+            [x * x for x in range(8)]
+        assert pool.apply(lambda a, b: a + b, (3, 4)) == 7
+        res = pool.apply_async(lambda: 42)
+        assert res.get(timeout=30) == 42 and res.successful()
+        assert list(pool.imap(lambda x: -x, range(4))) == [0, -1, -2, -3]
+        assert sorted(pool.imap_unordered(lambda x: x + 1, range(4))) \
+            == [1, 2, 3, 4]
+        assert pool.starmap(lambda a, b: a * b, [(2, 3), (4, 5)]) \
+            == [6, 20]
+    with pytest.raises(ValueError):
+        pool.map(lambda x: x, [1])  # closed
+
+
+# ----------------------------------------------------- check_serialize
+def test_inspect_serializability(ray_start):
+    from ray_tpu.util.check_serialize import inspect_serializability
+
+    ok, failures = inspect_serializability(lambda x: x + 1)
+    assert ok and failures == []
+
+    lock = threading.Lock()
+
+    def closes_over_lock():
+        return lock
+
+    ok, failures = inspect_serializability(closes_over_lock)
+    assert not ok
+    assert any(f.obj is lock or f.name == "lock" for f in failures)
+
+
+# -------------------------------------------------------- internal KV
+def test_experimental_internal_kv(ray_start):
+    from ray_tpu import experimental
+
+    experimental.internal_kv_put(b"cfg", b"v1")
+    assert experimental.internal_kv_get(b"cfg") == b"v1"
+    assert experimental.internal_kv_exists(b"cfg")
+    assert b"cfg" in experimental.internal_kv_list(b"c")
+    assert experimental.internal_kv_del(b"cfg")
+    assert experimental.internal_kv_get(b"cfg") is None
+
+
+# ------------------------------------------------ py_modules runtime env
+def test_runtime_env_py_modules(tmp_path):
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=4, process_workers=2)
+    try:
+        pkg = tmp_path / "my_extra_mod"
+        pkg.mkdir()
+        (pkg / "__init__.py").write_text("MAGIC = 1234\n")
+
+        @ray_tpu.remote
+        def use_module():
+            import my_extra_mod
+
+            return my_extra_mod.MAGIC
+
+        out = ray_tpu.get(use_module.options(
+            runtime_env={"py_modules": [str(pkg)]}).remote())
+        assert out == 1234
+
+        # Without the runtime_env the module must NOT be importable.
+        @ray_tpu.remote
+        def try_import():
+            try:
+                import my_extra_mod  # noqa: F401
+
+                return True
+            except ImportError:
+                return False
+
+        assert ray_tpu.get(try_import.remote()) is False
+    finally:
+        ray_tpu.shutdown()
