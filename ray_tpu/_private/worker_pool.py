@@ -92,6 +92,7 @@ class _runtime_env_ctx:
         self._saved_vars: dict[str, str | None] = {}
         self._saved_cwd: str | None = None
         self._added_sys_paths: list[str] = []
+        self._unload_prefixes: list[str] = []
 
     def __enter__(self):
         for k, v in (self.env.get("env_vars") or {}).items():
@@ -104,14 +105,20 @@ class _runtime_env_ctx:
             if working_dir not in sys.path:
                 sys.path.insert(0, working_dir)
                 self._added_sys_paths.append(working_dir)
+            self._unload_prefixes.append(os.path.abspath(working_dir))
         # py_modules: local module dirs importable task-side
         # (reference: runtime_env/py_modules.py; local paths only —
         # no URI packaging without a cluster-wide store).
         for path in (self.env.get("py_modules") or []):
-            parent = os.path.dirname(os.path.abspath(path))
+            abspath = os.path.abspath(path)
+            parent = os.path.dirname(abspath)
             if parent not in sys.path:
                 sys.path.insert(0, parent)
                 self._added_sys_paths.append(parent)
+            # Unload only the MODULE itself on exit, never the whole
+            # parent directory (siblings may be imported legitimately
+            # through other sys.path entries).
+            self._unload_prefixes.append(abspath)
         return self
 
     def __exit__(self, *exc):
@@ -120,15 +127,18 @@ class _runtime_env_ctx:
                 os.chdir(self._saved_cwd)
             except OSError:
                 pass
-        if self._added_sys_paths:
+        if self._unload_prefixes:
             # Unload modules imported from the env's paths: pool
             # workers are shared across tasks, and a module cached in
             # sys.modules would leak into tasks without this env
             # (reference isolates via dedicated worker processes).
-            prefixes = tuple(p + os.sep for p in self._added_sys_paths)
+            dir_prefixes = tuple(p + os.sep for p in
+                                 self._unload_prefixes)
+            exact_files = set(self._unload_prefixes)
             for name, mod in list(sys.modules.items()):
                 mod_file = getattr(mod, "__file__", None)
-                if mod_file and mod_file.startswith(prefixes):
+                if mod_file and (mod_file.startswith(dir_prefixes)
+                                 or mod_file in exact_files):
                     sys.modules.pop(name, None)
         for added in self._added_sys_paths:
             try:

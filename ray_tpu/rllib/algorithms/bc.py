@@ -113,13 +113,19 @@ def _rows_to_batch(rows: list[dict], gamma: float) -> SampleBatch:
     dones = np.asarray([bool(r.get("terminateds", False)
                              or r.get("truncateds", False))
                         for r in rows])
-    returns = np.zeros_like(rewards)
-    acc = 0.0
-    for i in range(len(rows) - 1, -1, -1):
-        if dones[i]:
-            acc = 0.0
-        acc = rewards[i] + gamma * acc
-        returns[i] = acc
+    if not dones.any():
+        # No episode boundaries at all: treat rows as independent
+        # transitions (returns = per-row rewards) rather than chaining
+        # one never-resetting discounted sum across unrelated rows.
+        returns = rewards.copy()
+    else:
+        returns = np.zeros_like(rewards)
+        acc = 0.0
+        for i in range(len(rows) - 1, -1, -1):
+            if dones[i]:
+                acc = 0.0
+            acc = rewards[i] + gamma * acc
+            returns[i] = acc
     return SampleBatch({
         Columns.OBS: obs,
         Columns.ACTIONS: actions,
@@ -186,17 +192,24 @@ class MARWIL(Algorithm):
         runner = self.local_env_runner
         if runner is None:
             return {}
+        # Accumulate across rounds until the episode target is met
+        # (get_metrics drains, so each round's mean is weighted by its
+        # episode count).
         episodes = 0
+        weighted_return = 0.0
         rounds = 0
         while episodes < cfg.evaluation_num_episodes and rounds < 50:
             runner.sample()
             rounds += 1
             m = runner.get_metrics()
-            episodes += m.get("num_episodes", 0)
-            if "episode_return_mean" in m:
-                return {"evaluation_return_mean":
-                        m["episode_return_mean"]}
-        return {}
+            n = m.get("num_episodes", 0)
+            if n:
+                episodes += n
+                weighted_return += m["episode_return_mean"] * n
+        if episodes == 0:
+            return {}
+        return {"evaluation_return_mean": weighted_return / episodes,
+                "evaluation_num_episodes": episodes}
 
 
 class BC(MARWIL):

@@ -20,9 +20,11 @@ from ray_tpu.train.trainer import (
     JaxTrainer,
     Result,
 )
+from ray_tpu.train.torch import TorchTrainer
 
 __all__ = [
     "BaseTrainer",
+    "TorchTrainer",
     "Checkpoint",
     "CheckpointConfig",
     "CheckpointManager",

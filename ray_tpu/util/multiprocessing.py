@@ -2,25 +2,48 @@
 
 Reference: python/ray/util/multiprocessing/ (Pool running on actors so
 existing multiprocessing code ports by changing an import). Methods:
-apply/apply_async, map/map_async, imap/imap_unordered, starmap.
+apply/apply_async, map/map_async, imap/imap_unordered, starmap —
+including ``chunksize`` and the ``processes`` concurrency bound, and
+stdlib ``multiprocessing.TimeoutError`` on timeouts, so except clauses
+in ported code keep working.
 """
 
 from __future__ import annotations
 
+from multiprocessing import TimeoutError as MpTimeoutError
 from typing import Any, Callable, Iterable
 
 import ray_tpu
 
 
-class AsyncResult:
-    """Reference: multiprocessing.pool.AsyncResult protocol."""
+def _chunks(iterable: Iterable, chunksize: int) -> list[list]:
+    items = list(iterable)
+    chunksize = max(1, chunksize)
+    return [items[i:i + chunksize]
+            for i in range(0, len(items), chunksize)]
 
-    def __init__(self, refs, single: bool):
+
+class AsyncResult:
+    """Reference: multiprocessing.pool.AsyncResult protocol.
+
+    ``refs`` are chunk tasks; ``get`` flattens chunk outputs back to
+    per-item results.
+    """
+
+    def __init__(self, refs, single: bool, chunked: bool = False):
         self._refs = refs
         self._single = single
+        self._chunked = chunked
 
     def get(self, timeout: float | None = None):
-        values = ray_tpu.get(self._refs, timeout=timeout)
+        try:
+            values = ray_tpu.get(self._refs, timeout=timeout)
+        except Exception as exc:  # noqa: BLE001 — translate timeouts
+            if isinstance(exc, TimeoutError):
+                raise MpTimeoutError(str(exc)) from exc
+            raise
+        if self._chunked:
+            values = [v for chunk in values for v in chunk]
         return values[0] if self._single else values
 
     def wait(self, timeout: float | None = None) -> None:
@@ -43,35 +66,50 @@ class AsyncResult:
 
 
 class Pool:
-    """Task-backed process pool (each call is a ray_tpu task, so with
-    ``init(process_workers=N)`` work runs on real OS processes)."""
+    """Task-backed pool. ``processes`` bounds in-flight task chunks
+    (Pool(1) serializes work like the stdlib); with
+    ``init(process_workers=N)`` chunks run on real OS processes."""
 
     def __init__(self, processes: int | None = None,
                  initializer: Callable | None = None,
                  initargs: tuple = ()):
         if not ray_tpu.is_initialized():
             ray_tpu.init()
-        self._processes = processes or 4
+        self._processes = max(1, processes or 4)
         self._closed = False
         # The initializer contract is per-worker-process; our tasks
         # share pool workers, so run it lazily inside each task chunk.
         self._initializer = initializer
         self._initargs = initargs
 
-    def _wrap(self, func: Callable) -> Callable:
+    def _chunk_fn(self, func: Callable, star: bool = False) -> Callable:
         init, initargs = self._initializer, self._initargs
-        if init is None:
-            return func
 
-        def wrapped(*a, **kw):
-            init(*initargs)
-            return func(*a, **kw)
+        def run_chunk(items: list) -> list:
+            if init is not None:
+                init(*initargs)
+            if star:
+                return [func(*args) for args in items]
+            return [func(x) for x in items]
 
-        return wrapped
+        return run_chunk
 
     def _check_open(self) -> None:
         if self._closed:
             raise ValueError("Pool is closed")
+
+    def _submit_bounded(self, remote_fn, chunks: list) -> list:
+        """Submit respecting the `processes` in-flight bound; returns
+        refs in submission order."""
+        refs: list = []
+        in_flight: list = []
+        for chunk in chunks:
+            while len(in_flight) >= self._processes:
+                _, in_flight = ray_tpu.wait(in_flight, num_returns=1)
+            ref = remote_fn.remote(chunk)
+            refs.append(ref)
+            in_flight.append(ref)
+        return refs
 
     # -- apply --------------------------------------------------------
     def apply(self, func: Callable, args: tuple = (),
@@ -81,40 +119,68 @@ class Pool:
     def apply_async(self, func: Callable, args: tuple = (),
                     kwds: dict | None = None) -> AsyncResult:
         self._check_open()
-        remote_fn = ray_tpu.remote(self._wrap(func))
-        return AsyncResult([remote_fn.remote(*args, **(kwds or {}))],
-                           single=True)
+        init, initargs = self._initializer, self._initargs
+
+        def call():
+            if init is not None:
+                init(*initargs)
+            return func(*args, **(kwds or {}))
+
+        return AsyncResult([ray_tpu.remote(call).remote()], single=True)
 
     # -- map ----------------------------------------------------------
-    def map(self, func: Callable, iterable: Iterable) -> list:
-        return self.map_async(func, iterable).get()
+    def map(self, func: Callable, iterable: Iterable,
+            chunksize: int = 1) -> list:
+        return self.map_async(func, iterable, chunksize).get()
 
-    def map_async(self, func: Callable, iterable: Iterable) -> AsyncResult:
+    def map_async(self, func: Callable, iterable: Iterable,
+                  chunksize: int = 1) -> AsyncResult:
         self._check_open()
-        remote_fn = ray_tpu.remote(self._wrap(func))
-        return AsyncResult([remote_fn.remote(x) for x in iterable],
-                           single=False)
+        remote_fn = ray_tpu.remote(self._chunk_fn(func))
+        refs = self._submit_bounded(remote_fn,
+                                    _chunks(iterable, chunksize))
+        return AsyncResult(refs, single=False, chunked=True)
 
-    def starmap(self, func: Callable, iterable: Iterable) -> list:
+    def starmap(self, func: Callable, iterable: Iterable,
+                chunksize: int = 1) -> list:
         self._check_open()
-        remote_fn = ray_tpu.remote(self._wrap(func))
-        return ray_tpu.get(
-            [remote_fn.remote(*args) for args in iterable])
+        remote_fn = ray_tpu.remote(self._chunk_fn(func, star=True))
+        refs = self._submit_bounded(remote_fn,
+                                    _chunks(iterable, chunksize))
+        return [v for chunk in ray_tpu.get(refs) for v in chunk]
 
-    def imap(self, func: Callable, iterable: Iterable):
+    def imap(self, func: Callable, iterable: Iterable,
+             chunksize: int = 1):
         self._check_open()
-        remote_fn = ray_tpu.remote(self._wrap(func))
-        refs = [remote_fn.remote(x) for x in iterable]
-        for ref in refs:  # submission order
-            yield ray_tpu.get(ref)
+        remote_fn = ray_tpu.remote(self._chunk_fn(func))
+        chunks = _chunks(iterable, chunksize)
+        in_flight: list = []
+        pending = list(chunks)
+        submitted: list = []
+        # Keep `processes` chunks in flight; yield in submission order.
+        while pending or submitted:
+            while pending and len(in_flight) < self._processes:
+                ref = remote_fn.remote(pending.pop(0))
+                submitted.append(ref)
+                in_flight.append(ref)
+            ref = submitted.pop(0)
+            for value in ray_tpu.get(ref):
+                yield value
+            in_flight = [r for r in in_flight if r is not ref]
 
-    def imap_unordered(self, func: Callable, iterable: Iterable):
+    def imap_unordered(self, func: Callable, iterable: Iterable,
+                       chunksize: int = 1):
         self._check_open()
-        remote_fn = ray_tpu.remote(self._wrap(func))
-        pending = [remote_fn.remote(x) for x in iterable]
-        while pending:
-            ready, pending = ray_tpu.wait(pending, num_returns=1)
-            yield ray_tpu.get(ready[0])
+        remote_fn = ray_tpu.remote(self._chunk_fn(func))
+        pending_chunks = _chunks(iterable, chunksize)
+        in_flight: list = []
+        while pending_chunks or in_flight:
+            while pending_chunks and len(in_flight) < self._processes:
+                in_flight.append(
+                    remote_fn.remote(pending_chunks.pop(0)))
+            ready, in_flight = ray_tpu.wait(in_flight, num_returns=1)
+            for value in ray_tpu.get(ready[0]):
+                yield value
 
     # -- lifecycle ----------------------------------------------------
     def close(self) -> None:

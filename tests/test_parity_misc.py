@@ -233,3 +233,35 @@ def test_runtime_env_py_modules(tmp_path):
         assert ray_tpu.get(try_import.remote()) is False
     finally:
         ray_tpu.shutdown()
+
+
+def test_pool_processes_bound_and_chunksize(ray_start):
+    """Pool(1) serializes execution; chunksize groups items per task."""
+    import time as _time
+
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(1) as pool:
+        # Serialized: overlapping sleeps would finish in ~0.1s; Pool(1)
+        # must take >= 4 * 0.05.
+        t0 = _time.monotonic()
+        out = pool.map(lambda x: (_time.sleep(0.05), x)[1], range(4))
+        assert out == [0, 1, 2, 3]
+        assert _time.monotonic() - t0 >= 0.18
+
+    with Pool(4) as pool:
+        assert pool.map(lambda x: x * 2, range(10), chunksize=3) == \
+            [2 * i for i in range(10)]
+        assert list(pool.imap(lambda x: x + 1, range(7), chunksize=2)) \
+            == [1, 2, 3, 4, 5, 6, 7]
+
+
+def test_pool_timeout_is_stdlib_timeout(ray_start):
+    import multiprocessing
+
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool(2) as pool:
+        res = pool.apply_async(lambda: __import__("time").sleep(10))
+        with pytest.raises(multiprocessing.TimeoutError):
+            res.get(timeout=0.1)

@@ -172,3 +172,95 @@ def test_checkpoint_latest_is_insertion_order(tmp_path):
 def test_scaling_config_resources():
     sc = ScalingConfig(num_workers=2, use_tpu=True, chips_per_worker=4)
     assert sc.worker_resources() == {"TPU": 4.0, "CPU": 1.0}
+
+
+# --------------------------------------------------------- TorchTrainer
+def test_torch_trainer_ddp_semantics(ray_start_regular):
+    """prepare_model broadcasts rank-0 params and averages gradients
+    across ranks on backward (reference TorchTrainer + DDP behavior,
+    riding the framework collective)."""
+    import torch
+
+    from ray_tpu import train
+    from ray_tpu.train.torch import prepare_model
+
+    def loop(config):
+        torch.manual_seed(100 + train.get_context().get_world_rank())
+        model = torch.nn.Linear(4, 1)  # different init per rank
+        model = prepare_model(model)
+        # After prepare_model all ranks hold rank 0's weights.
+        w0 = model.weight.detach().numpy().copy()
+
+        opt = torch.optim.SGD(model.parameters(), lr=0.05)
+        rank = train.get_context().get_world_rank()
+        torch.manual_seed(rank)  # DIFFERENT data per rank
+        x = torch.randn(64, 4)
+        y = (x.sum(dim=1, keepdim=True) > 0).float()
+        last = None
+        for _ in range(10):
+            opt.zero_grad()
+            loss = torch.nn.functional.mse_loss(model(x), y)
+            loss.backward()  # grads allreduced by the hooks
+            opt.step()
+            last = float(loss)
+        train.report({
+            "loss": last,
+            "w_init_sum": float(w0.sum()),
+            "w_final_sum": float(model.weight.detach().sum()),
+        })
+
+    trainer = train.TorchTrainer(
+        loop,
+        scaling_config=train.ScalingConfig(num_workers=2,
+                                           resources_per_worker={"CPU": 1}),
+        run_config=train.RunConfig(name="torch_ddp_test"),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["loss"] < 0.5
+
+
+def test_torch_trainer_ranks_stay_synchronized(ray_start_regular):
+    """With different per-rank data, averaged gradients must keep the
+    replicas bit-identical — the DDP invariant."""
+    import torch
+
+    from ray_tpu import train
+    from ray_tpu.train.torch import prepare_model
+
+    def loop(config):
+        import numpy as np
+
+        from ray_tpu.train.torch import _group_name
+        from ray_tpu.util import collective
+
+        rank = train.get_context().get_world_rank()
+        model = prepare_model(torch.nn.Linear(3, 2))
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        # Per-rank generator: torch's GLOBAL seed is process-wide and
+        # thread workers share a process, so only a private Generator
+        # gives each rank independent data.
+        gen = torch.Generator().manual_seed(1000 + rank)
+        for _ in range(5):
+            x = torch.randn(16, 3, generator=gen)
+            opt.zero_grad()
+            model(x).pow(2).mean().backward()
+            opt.step()
+        # The DDP invariant, checked directly: after synced training on
+        # DIFFERENT data, every rank holds identical weights.
+        wsum = float(model.weight.detach().double().sum())
+        all_sums = collective.allgather(
+            np.array([wsum]), group_name=_group_name())
+        spread = max(float(s[0]) for s in all_sums) - min(
+            float(s[0]) for s in all_sums)
+        train.report({"spread": spread, "wsum": wsum})
+
+    trainer = train.TorchTrainer(
+        loop,
+        scaling_config=train.ScalingConfig(num_workers=2,
+                                           resources_per_worker={"CPU": 1}),
+        run_config=train.RunConfig(name="torch_sync_test"),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["spread"] < 1e-12, result.metrics
