@@ -118,21 +118,34 @@ class Router:
         else:
             self._have_replicas.clear()
 
-    def _pick(self) -> tuple[Any, Any]:
-        """Power of two choices on local in-flight counts. Returns
-        (replica_key, handle)."""
+    def _pick(self, model_id: str | None = None) -> tuple[Any, Any]:
+        """Power of two choices on local in-flight counts; multiplexed
+        requests stick to the replica that last served their model id
+        (reference: the pow-2 scheduler's multiplex locality
+        preference). Returns (replica_key, handle)."""
         with self._lock:
             n = len(self._replicas)
             if n == 0:
                 raise RuntimeError("no replicas")
-            if n == 1:
-                handle = self._replicas[0]
-            else:
-                a, b = random.sample(range(n), 2)
-                ha, hb = self._replicas[a], self._replicas[b]
-                handle = ha if self._inflight.get(self._rkey(ha), 0) <= \
-                    self._inflight.get(self._rkey(hb), 0) else hb
+            handle = None
+            if model_id is not None:
+                affine_key = self._model_affinity.get(model_id)
+                if affine_key is not None:
+                    for replica in self._replicas:
+                        if self._rkey(replica) == affine_key:
+                            handle = replica
+                            break
+            if handle is None:
+                if n == 1:
+                    handle = self._replicas[0]
+                else:
+                    a, b = random.sample(range(n), 2)
+                    ha, hb = self._replicas[a], self._replicas[b]
+                    handle = ha if self._inflight.get(self._rkey(ha), 0) \
+                        <= self._inflight.get(self._rkey(hb), 0) else hb
             key = self._rkey(handle)
+            if model_id is not None:
+                self._model_affinity[model_id] = key
             self._inflight[key] = self._inflight.get(key, 0) + 1
             return key, handle
 
@@ -142,12 +155,13 @@ class Router:
                 self._inflight[key] -= 1
 
     def assign_request(self, method_name: str, args: tuple, kwargs: dict,
-                       timeout_s: float = 30.0) -> DeploymentResponse:
+                       timeout_s: float = 30.0,
+                       model_id: str | None = None) -> DeploymentResponse:
         if not self._have_replicas.wait(timeout_s):
             raise TimeoutError(
                 f"Deployment {self._deployment_name}: no replicas came up "
                 f"within {timeout_s}s")
-        idx, handle = self._pick()
+        idx, handle = self._pick(model_id=model_id)
         ref = handle.handle_request.remote(method_name, args, kwargs)
         # Backpressure rejections are retried on another replica inside
         # DeploymentResponse.result() (reference: pow-2 scheduler
@@ -194,21 +208,35 @@ class DeploymentHandle:
         self._controller = controller_handle
         self._method_name = method_name
 
-    def options(self, method_name: str | None = None) -> "DeploymentHandle":
-        return DeploymentHandle(
+    def options(self, method_name: str | None = None,
+                multiplexed_model_id: str | None = None,
+                ) -> "DeploymentHandle":
+        handle = DeploymentHandle(
             self._deployment_name, self._app_name, self._controller,
             method_name or self._method_name)
+        handle._model_id = (multiplexed_model_id
+                            if multiplexed_model_id is not None
+                            else getattr(self, "_model_id", None))
+        return handle
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
             raise AttributeError(name)
-        return DeploymentHandle(
+        handle = DeploymentHandle(
             self._deployment_name, self._app_name, self._controller, name)
+        handle._model_id = getattr(self, "_model_id", None)
+        return handle
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
+        from ray_tpu.serve.multiplex import MODEL_ID_KWARG
+
         router = get_or_create_router(
             self._controller, self._app_name, self._deployment_name)
-        return router.assign_request(self._method_name, args, kwargs)
+        model_id = getattr(self, "_model_id", None)
+        if model_id is not None:
+            kwargs = {**kwargs, MODEL_ID_KWARG: model_id}
+        return router.assign_request(self._method_name, args, kwargs,
+                                     model_id=model_id)
 
     def __reduce__(self):
         # Rebuild from names inside another process/replica.
