@@ -460,9 +460,16 @@ uint64_t rt_store_create_object(void* hv, const uint8_t* id, uint64_t size) {
   if (lock_arena(h) != 0) return 0;
   ObjectEntry* e = insert_slot(h, id);
   if (!e) {
-    hd->alloc_failures++;
-    unlock_arena(hd);
-    return 0;
+    // insert_slot fails for BOTH a full table and a duplicate id; a
+    // duplicate must fail cleanly (never evict the live same-id object
+    // or unrelated entries). Only a genuinely full table earns an
+    // eviction pass: tombstoned entries free slots, then retry.
+    if (find_entry(h, id) != nullptr || !evict_lru(h, size) ||
+        !(e = insert_slot(h, id))) {
+      hd->alloc_failures++;
+      unlock_arena(hd);
+      return 0;
+    }
   }
   uint64_t off = heap_alloc(h, size);
   if (!off) {

@@ -226,13 +226,19 @@ class GlobalControlService:
         self.pubsub.publish("nodes", ("DEAD", node_id))
 
     def heartbeat(self, node_id: NodeID,
-                  available: dict | None = None) -> None:
+                  available: dict | None = None) -> bool:
+        """Refresh a node's liveness. Returns False when the node is
+        unknown or already marked dead — the agent must re-register
+        (reference: raylets re-register after GCS restart; a dead node
+        is never resurrected in place, it gets a new node id)."""
         with self._lock:
             record = self._nodes.get(node_id)
-            if record is not None:
-                record.last_heartbeat = time.monotonic()
-                if available is not None:
-                    record.available = dict(available)
+            if record is None or not record.alive:
+                return False
+            record.last_heartbeat = time.monotonic()
+            if available is not None:
+                record.available = dict(available)
+            return True
 
     def list_nodes(self) -> list[NodeRecord]:
         with self._lock:

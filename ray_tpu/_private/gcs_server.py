@@ -221,8 +221,8 @@ class GcsServer:
 
     def _heartbeat(self, node_id_bytes: bytes,
                    available: dict | None = None) -> bool:
-        self.gcs.heartbeat(NodeID(node_id_bytes), available)
-        return True
+        # False tells the agent it is unknown/dead and must re-register.
+        return self.gcs.heartbeat(NodeID(node_id_bytes), available)
 
     def _list_nodes(self) -> list[dict]:
         return [{

@@ -51,13 +51,16 @@ class NodeAgent:
         # Optional live-usage callable: () -> {resource: available}
         # piggybacked on heartbeats (ray_syncer-lite).
         self.usage_fn = usage_fn
-        self.node_id: bytes = self.client.call(
-            "register_node", f"{_own_address()}:{os.getpid()}",
-            self.resources, self.labels)
+        self._address = f"{_own_address()}:{os.getpid()}"
+        self.node_id: bytes = self._register()
         self._shutdown = threading.Event()
         self._thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True, name="node-heartbeat")
         self._thread.start()
+
+    def _register(self) -> bytes:
+        return self.client.call(
+            "register_node", self._address, self.resources, self.labels)
 
     def _heartbeat_loop(self) -> None:
         while not self._shutdown.wait(self.heartbeat_period_s):
@@ -68,7 +71,13 @@ class NodeAgent:
                 except Exception:  # noqa: BLE001 — usage is best-effort
                     available = None
             try:
-                self.client.call("heartbeat", self.node_id, available)
+                accepted = self.client.call(
+                    "heartbeat", self.node_id, available)
+                if not accepted:
+                    # Unknown/dead at the head (stall past the timeout or
+                    # a head restart): re-register under a fresh node id
+                    # (reference: raylet re-registration flow).
+                    self.node_id = self._register()
             except RpcError:
                 pass  # head unreachable; keep trying (it may restart)
 

@@ -16,6 +16,7 @@ socket (e.g. head restarted).
 from __future__ import annotations
 
 import pickle
+import select
 import socket
 import struct
 import threading
@@ -187,6 +188,18 @@ class RpcClient:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return sock
 
+    @staticmethod
+    def _stale(sock: socket.socket) -> bool:
+        """A pooled idle socket with a pending EOF/RST shows readable
+        (no reply is outstanding, so ANY readability means the peer
+        closed). Detecting this before send keeps the common
+        server-restart case retriable without double-execution risk."""
+        try:
+            readable, _, _ = select.select([sock], [], [], 0)
+            return bool(readable)
+        except (OSError, ValueError):
+            return True
+
     def call(self, method: str, *args, **kwargs) -> Any:
         with self._lock:
             self._seq += 1
@@ -194,10 +207,23 @@ class RpcClient:
             request = pickle.dumps((seq, method, args, kwargs))
             last_exc: Exception | None = None
             for attempt in range(2):  # one transparent reconnect
+                # Retry is safe ONLY while the server cannot have executed
+                # the request: before the full frame was handed to the
+                # kernel. Once sendall returns, a lost reply may mean the
+                # method ran — surface RpcError instead of re-sending
+                # (non-idempotent methods would double-execute).
+                sent = False
                 try:
+                    if self._sock is not None and self._stale(self._sock):
+                        try:
+                            self._sock.close()
+                        except OSError:
+                            pass
+                        self._sock = None
                     if self._sock is None:
                         self._sock = self._connect()
                     _send_frame(self._sock, request)
+                    sent = True
                     rseq, status, payload = pickle.loads(
                         _recv_frame(self._sock))
                     if rseq != seq:
@@ -212,6 +238,10 @@ class RpcClient:
                         except OSError:
                             pass
                         self._sock = None
+                    if sent:
+                        raise RpcError(
+                            f"rpc {method} to {self.address} failed after "
+                            f"send (may have executed): {exc}") from exc
             else:
                 raise RpcError(
                     f"rpc to {self.address} failed: {last_exc}") \

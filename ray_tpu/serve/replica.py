@@ -62,6 +62,17 @@ class Replica:
     # ------------------------------------------------------------- data path
 
     def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+        from ray_tpu.serve.multiplex import MODEL_ID_KWARG, _request_model_id
+
+        # The router injects the multiplexed model id as a reserved kwarg;
+        # it must never reach the user callable. Surface it via the
+        # contextvar instead (reference: serve.get_multiplexed_model_id).
+        # Thread actors share the caller's kwargs dict object — strip via
+        # a copy so a backpressure retry still carries the model id.
+        model_id = kwargs.get(MODEL_ID_KWARG)
+        if model_id is not None:
+            kwargs = {k: v for k, v in kwargs.items()
+                      if k != MODEL_ID_KWARG}
         with self._lock:
             if self._num_ongoing >= self._max_ongoing:
                 raise BackPressureError(
@@ -69,6 +80,8 @@ class Replica:
                     f"{self._max_ongoing}")
             self._num_ongoing += 1
             self._num_total += 1
+        token = (_request_model_id.set(model_id)
+                 if model_id is not None else None)
         try:
             if method_name == "__call__":
                 target = self._callable
@@ -86,6 +99,8 @@ class Replica:
                 result = list(result)
             return result
         finally:
+            if token is not None:
+                _request_model_id.reset(token)
             with self._lock:
                 self._num_ongoing -= 1
 

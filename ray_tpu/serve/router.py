@@ -29,11 +29,12 @@ class DeploymentResponse:
     """
 
     def __init__(self, object_ref, router=None, replica_idx=None,
-                 request=None):
+                 request=None, model_id=None):
         self._ref = object_ref
         self._router = router
         self._replica_idx = replica_idx
         self._request = request  # (method_name, args, kwargs)
+        self._model_id = model_id  # multiplex affinity on retries
 
     def _release(self):
         if self._router is not None and self._replica_idx is not None:
@@ -74,7 +75,7 @@ class DeploymentResponse:
                                                deadline - time.monotonic()))
                 time.sleep(sleep_s)
                 backoff_s = min(backoff_s * 2, 1.0)
-                idx, handle = self._router._pick()
+                idx, handle = self._router._pick(model_id=self._model_id)
                 self._replica_idx = idx
                 self._ref = handle.handle_request.remote(*self._request)
                 if deadline is not None:
@@ -99,6 +100,8 @@ class Router:
         # membership changes neither zero live load nor cross-release a
         # different replica that inherited a list index.
         self._inflight: dict[Any, int] = {}
+        # model_id → replica key that last served it (multiplex affinity).
+        self._model_affinity: dict[str, Any] = {}
         self._have_replicas = threading.Event()
         self._long_poll = LongPollClient(
             controller_handle, {self._key: self._update_replicas})
@@ -113,6 +116,9 @@ class Router:
             keep = {self._rkey(h) for h in self._replicas}
             self._inflight = {k: v for k, v in self._inflight.items()
                               if k in keep}
+            self._model_affinity = {m: k for m, k
+                                    in self._model_affinity.items()
+                                    if k in keep}
         if handles:
             self._have_replicas.set()
         else:
@@ -168,7 +174,7 @@ class Router:
         # requeues on replica rejection).
         return DeploymentResponse(
             ref, router=self, replica_idx=idx,
-            request=(method_name, args, kwargs))
+            request=(method_name, args, kwargs), model_id=model_id)
 
     def shutdown(self) -> None:
         self._long_poll.stop()
@@ -241,11 +247,15 @@ class DeploymentHandle:
     def __reduce__(self):
         # Rebuild from names inside another process/replica.
         return (_rebuild_handle,
-                (self._deployment_name, self._app_name, self._method_name))
+                (self._deployment_name, self._app_name, self._method_name,
+                 getattr(self, "_model_id", None)))
 
 
-def _rebuild_handle(deployment_name, app_name, method_name):
+def _rebuild_handle(deployment_name, app_name, method_name, model_id=None):
     from ray_tpu.serve.api import _get_controller
 
-    return DeploymentHandle(
+    handle = DeploymentHandle(
         deployment_name, app_name, _get_controller(), method_name)
+    if model_id is not None:
+        handle._model_id = model_id
+    return handle

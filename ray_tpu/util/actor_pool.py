@@ -12,6 +12,13 @@ from typing import Any, Callable, Iterable, Iterator
 import ray_tpu
 
 
+class _TaskError:
+    """Buffered failure: re-raised when its slot is consumed."""
+
+    def __init__(self, exc: Exception):
+        self.exc = exc
+
+
 class ActorPool:
     def __init__(self, actors: Iterable[Any]):
         self._idle = list(actors)
@@ -68,7 +75,13 @@ class ActorPool:
         index, actor = self._future_to_actor.pop(future)
         self._index_to_future.pop(index, None)
         self._return_actor(actor)
-        self._returned[index] = ray_tpu.get(future)
+        # A failed task must still populate _returned, otherwise
+        # get_next() re-enters _fetch_one with no future left for this
+        # index and hangs; store the error and raise it at consumption.
+        try:
+            self._returned[index] = ray_tpu.get(future)
+        except Exception as exc:  # noqa: BLE001 — surfaced in get_next*
+            self._returned[index] = _TaskError(exc)
         return index
 
     def _skip_consumed(self) -> None:
@@ -87,7 +100,10 @@ class ActorPool:
             self._fetch_one(timeout)
         self._next_return_index += 1
         self._skip_consumed()
-        return self._returned.pop(index)
+        value = self._returned.pop(index)
+        if isinstance(value, _TaskError):
+            raise value.exc
+        return value
 
     def get_next_unordered(self, timeout: float | None = None) -> Any:
         """Next result in COMPLETION order."""
@@ -97,7 +113,10 @@ class ActorPool:
             self._fetch_one(timeout)
         index = min(self._returned)
         self._consumed.add(index)
-        return self._returned.pop(index)
+        value = self._returned.pop(index)
+        if isinstance(value, _TaskError):
+            raise value.exc
+        return value
 
     # -- membership ---------------------------------------------------
     def push(self, actor: Any) -> None:

@@ -280,3 +280,27 @@ def test_heartbeat_carries_resource_usage(gcs):
         time.sleep(0.1)
     assert nodes[0]["available"] == {"CPU": 1.0}
     agent.stop()
+
+
+def test_heartbeat_rejects_dead_node_and_agent_reregisters(gcs):
+    """A node marked dead (stale heartbeat / head restart) gets
+    heartbeat()->False and the agent re-registers under a new id
+    (ADVICE r2: dead nodes must not heartbeat forever into a void)."""
+    client = RpcClient(gcs.address)
+    agent = NodeAgent(gcs.address, {"CPU": 3.0}, heartbeat_period_s=0.2)
+    old_id = agent.node_id
+    # Mark it dead behind the agent's back (as the stale-heartbeat
+    # monitor would).
+    client.call("drain_node", old_id)
+    assert client.call("heartbeat", old_id, None) is False
+    # The agent's loop must notice and re-register with a fresh id.
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if agent.node_id != old_id:
+            break
+        time.sleep(0.1)
+    assert agent.node_id != old_id, "agent never re-registered"
+    nodes = {n["node_id"]: n for n in client.call("list_nodes")}
+    alive = [n for n in nodes.values() if n["alive"]]
+    assert len(alive) == 1 and alive[0]["resources"] == {"CPU": 3.0}
+    agent.stop()

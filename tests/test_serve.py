@@ -316,3 +316,42 @@ def test_llm_engine_survives_decode_failure():
     assert server._loop_thread.is_alive()
     out = server({"tokens": [6, 7, 8], "max_new_tokens": 3})["tokens"]
     assert len(out) == 3
+
+
+def test_multiplexed_model_serving(serve_instance):
+    """End-to-end multiplex: the router sticks a model id to a replica,
+    the replica surfaces it via serve.get_multiplexed_model_id(), and
+    the loader LRU keeps at most max_num_models_per_replica models."""
+    loads = []
+
+    @serve.deployment(num_replicas=2)
+    class ModelServer:
+        @serve.multiplexed(max_num_models_per_replica=2)
+        def get_model(self, model_id: str):
+            loads.append(model_id)
+            return lambda x: f"{model_id}:{x}"
+
+        def __call__(self, x):
+            model_id = serve.get_multiplexed_model_id()
+            assert model_id, "contextvar not set inside replica"
+            model = self.get_model()
+            return model(x)
+
+    handle = serve.run(ModelServer.bind(), name="mux_app")
+    for _ in range(3):
+        assert handle.options(multiplexed_model_id="m1").remote(
+            "a").result(timeout_s=10) == "m1:a"
+    assert handle.options(multiplexed_model_id="m2").remote(
+        "b").result(timeout_s=10) == "m2:b"
+    # Affinity: repeated m1 requests hit the replica that loaded it, so
+    # m1 loads exactly once despite 3 requests (thread actors share the
+    # driver process, so the list is visible here).
+    assert loads.count("m1") == 1
+    # Requests without a model id still work and see an empty id.
+
+    @serve.deployment
+    def plain(x):
+        return serve.get_multiplexed_model_id()
+
+    handle2 = serve.run(plain.bind(), name="plain_app")
+    assert handle2.remote("x").result(timeout_s=10) == ""

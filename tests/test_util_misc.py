@@ -171,3 +171,26 @@ def test_actor_pool_mixed_ordered_unordered(ray_start):
     remaining = sorted([pool.get_next(), pool.get_next()])
     assert sorted([first] + remaining) == [0, 2, 4]
     assert not pool.has_next()
+
+
+def test_actor_pool_task_error_surfaces_and_advances(ray_start):
+    """A failed task raises from get_next once, then the pool keeps
+    working (ADVICE r2: errors used to hang get_next forever)."""
+
+    @ray_tpu.remote
+    class Flaky:
+        def run(self, v):
+            if v == 1:
+                raise ValueError("boom-1")
+            return v * 10
+
+    pool = ActorPool([Flaky.remote() for _ in range(2)])
+    for i in range(4):
+        pool.submit(lambda a, v: a.run.remote(v), i)
+    assert pool.get_next(timeout=10) == 0
+    with pytest.raises(Exception) as exc_info:
+        pool.get_next(timeout=10)
+    assert "boom-1" in str(exc_info.value)
+    assert pool.get_next(timeout=10) == 20
+    assert pool.get_next(timeout=10) == 30
+    assert not pool.has_next()
