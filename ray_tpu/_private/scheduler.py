@@ -176,6 +176,16 @@ class ClusterState:
                 node.release(demand)
             self._lock.notify_all()
 
+    def force_acquire(self, node_id: NodeID, demand: dict[str, float]) -> None:
+        """Unconditional acquire (availability may go transiently
+        negative). Used when a blocked task resumes: stalling the
+        resume until capacity frees can deadlock the executor, and
+        pick_node's fits() check keeps negative nodes unschedulable."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is not None:
+                node.acquire(demand)
+
     def wait_for_change(self, timeout: float) -> None:
         with self._lock:
             self._lock.wait(timeout)
@@ -384,6 +394,8 @@ class BlockedResourceContext:
         # Only CPU is returned while blocked; accelerators stay held.
         self._cpu_only = {k: v for k, v in resources.items() if k == "CPU"}
         self._depth = 0
+        # Cross-process nested gets block/unblock from RPC threads.
+        self._depth_lock = threading.Lock()
 
     def __enter__(self):
         self._tls.ctx = self
@@ -394,17 +406,42 @@ class BlockedResourceContext:
         return False
 
     def block(self):
-        if self._depth == 0 and self._cpu_only:
+        with self._depth_lock:
+            release = self._depth == 0 and bool(self._cpu_only)
+            self._depth += 1
+        if release:
             self._cluster.release(self._node_id, self._cpu_only)
-        self._depth += 1
 
-    def unblock(self):
-        self._depth -= 1
-        if self._depth == 0 and self._cpu_only:
-            # Reacquire; spin-wait is acceptable because release is imminent
-            # by construction (we only woke because our object sealed).
-            while not self._cluster.try_acquire(self._node_id, self._cpu_only):
-                time.sleep(0.001)
+    def unblock(self, force: bool = False):
+        with self._depth_lock:
+            if self._depth <= 0:
+                return  # tolerate protocol-imbalanced extra unblocks
+            self._depth -= 1
+            reacquire = self._depth == 0 and bool(self._cpu_only)
+        if not reacquire:
+            return
+        if force:
+            # Cross-process unblock (nested pool gets): stalling the
+            # RPC reply on reacquisition would time out the worker's
+            # socket; transient overcommit is the lesser evil (pick_node
+            # keeps negative-availability nodes unschedulable).
+            self._cluster.force_acquire(self._node_id, self._cpu_only)
+            return
+        # Reacquire; spin-wait is acceptable because release is imminent
+        # by construction (we only woke because our object sealed).
+        while not self._cluster.try_acquire(self._node_id, self._cpu_only):
+            time.sleep(0.001)
+
+    def drain(self):
+        """Restore admission balance at task end: if the worker died (or
+        timed out) while blocked, the pending release must be undone
+        before the dispatcher's own release fires, else availability is
+        double-counted."""
+        while True:
+            with self._depth_lock:
+                if self._depth <= 0:
+                    return
+            self.unblock(force=True)
 
 
 def format_traceback(exc: BaseException) -> str:

@@ -193,6 +193,13 @@ class Runtime:
                      else cfg.worker_pool_size)
         self.log_monitor = None
         self.memory_monitor = None
+        # Nested-submission plumbing: pool workers call the public API
+        # back through this client server (reference: every Ray worker is
+        # a full CoreWorker, core_worker.h:291); blocked nested gets ship
+        # a task token so the owning task's CPU is released while waiting.
+        self.worker_client_server = None
+        self._inflight_blocks: dict[str, BlockedResourceContext] = {}
+        self._inflight_blocks_lock = threading.Lock()
         if pool_size and pool_size > 0:
             from ray_tpu._private.worker_pool import WorkerPool
 
@@ -214,6 +221,13 @@ class Runtime:
                 from ray_tpu._private.log_monitor import LogMonitor
 
                 self.log_monitor = LogMonitor(log_dir).start()
+            from ray_tpu.util.client import ClientServer
+
+            self.worker_client_server = ClientServer(
+                host="127.0.0.1", port=0).start()
+            # Spawned workers inherit this via os.environ.
+            os.environ["RAY_TPU_DRIVER_CLIENT_ADDR"] = \
+                f"127.0.0.1:{self.worker_client_server.port}"
             self.worker_pool = WorkerPool(
                 int(pool_size), self.shm_directory, self.shm_client)
             refresh_ms = int(cfg.memory_monitor_refresh_ms or 0)
@@ -481,18 +495,40 @@ class Runtime:
             digest, func_blob = self._function_blob(spec.func)
         except Exception:  # noqa: BLE001 — not serializable: run in-thread
             return False
+        # Registered for the task's lifetime: a nested get() from the
+        # worker carries this token and releases the task's CPU here.
+        token = spec.task_id.hex()
+        if node is not None:
+            with self._inflight_blocks_lock:
+                self._inflight_blocks[token] = BlockedResourceContext(
+                    self.cluster, node.node_id, spec.resources)
         try:
             results = self.worker_pool.run_task_blobs(
                 digest, func_blob, args_blob, spec.num_returns,
-                spec.return_ids, runtime_env=spec.runtime_env)
+                spec.return_ids, runtime_env=spec.runtime_env,
+                task_token=token)
         except _RemoteTaskError as rte:
             rte.cause.__ray_tpu_remote_tb__ = rte.remote_tb
             raise rte.cause from None
+        finally:
+            with self._inflight_blocks_lock:
+                ctx = self._inflight_blocks.pop(token, None)
+            if ctx is not None:
+                # If the worker died/timed out mid-blocked-get, the CPU
+                # release is still outstanding; undo it before the
+                # dispatcher's own release double-counts availability.
+                ctx.drain()
         for rid, value in results:
             self.store.put(rid, value)
             if node is not None:
                 self._record_location(rid, node.node_id)
         return True
+
+    def lookup_block_context(self, token: str):
+        """Block context of an in-flight pool task (client server calls
+        this when a nested get carries the task's token)."""
+        with self._inflight_blocks_lock:
+            return self._inflight_blocks.get(token)
 
     def _record_location(self, object_id: ObjectID, node_id: NodeID) -> None:
         """Owner-side object directory (reference:
@@ -968,6 +1004,10 @@ class Runtime:
             self.memory_monitor.stop()
         if self.worker_pool is not None:
             self.worker_pool.shutdown()
+        if self.worker_client_server is not None:
+            self.worker_client_server.stop()
+            os.environ.pop("RAY_TPU_DRIVER_CLIENT_ADDR", None)
+            self.worker_client_server = None
         if self.log_monitor is not None:
             self.log_monitor.stop()
             os.environ.pop("RAY_TPU_WORKER_LOG_DIR", None)
@@ -990,8 +1030,14 @@ class Runtime:
 # --------------------------------------------------------------------------
 
 
-def global_runtime() -> Runtime | None:
-    return _runtime
+def global_runtime():
+    if _runtime is not None:
+        return _runtime
+    if os.environ.get("RAY_TPU_IN_POOL_WORKER"):
+        from ray_tpu._private import worker_client
+
+        return worker_client.active_worker_runtime()
+    return None
 
 
 def init(
@@ -1019,9 +1065,16 @@ def init(
     import os as _os
 
     if _os.environ.get("RAY_TPU_IN_POOL_WORKER"):
+        # Inside a pool worker the public API proxies back to the driver
+        # (reference: workers are full CoreWorkers and may submit tasks);
+        # init() is a no-op returning the proxy runtime.
+        if _os.environ.get("RAY_TPU_DRIVER_CLIENT_ADDR"):
+            from ray_tpu._private import worker_client
+
+            return worker_client.get_worker_runtime()
         raise RuntimeError(
-            "ray_tpu.init() is not available inside pool worker processes: "
-            "pool tasks cannot submit nested tasks (v1 limitation)")
+            "ray_tpu.init() inside a pool worker requires the driver's "
+            "client server (driver predates nested submission)")
     global _runtime
     with _runtime_lock:
         if _runtime is not None:
@@ -1075,8 +1128,10 @@ def is_initialized() -> bool:
     return _runtime is not None
 
 
-def _require_runtime() -> Runtime:
+def _require_runtime():
     if _runtime is None:
+        if os.environ.get("RAY_TPU_IN_POOL_WORKER"):
+            return init()  # worker-mode proxy runtime
         init()
     return _runtime  # type: ignore[return-value]
 

@@ -1,0 +1,342 @@
+"""Worker-mode runtime: nested task submission from pool workers.
+
+Reference: in Ray every worker process hosts a full CoreWorker
+(src/ray/core_worker/core_worker.h:291), so code running inside a task
+or actor can itself call ``ray.remote``/``ray.get``. Here pool workers
+are thin executors; instead of embedding the whole runtime, a worker
+gets a proxy runtime that routes the public API back to the driver's
+client server (ray_tpu/util/client/server.py) over RPC — the same
+endpoint remote drivers use. ObjectRefs created in a worker are inert
+id handles whose hex keys name driver-pinned objects, so refs flow
+freely between nested calls, task returns, and the driver.
+
+Deadlock safety: a worker blocked in ``get()`` ships its task token
+with the RPC; the driver releases that task's CPU admission while the
+wait is in flight (the cross-process analogue of
+BlockedResourceContext — reference: workers blocked in ray.get return
+their CPU to the raylet).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Sequence
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ActorID, ObjectID
+from ray_tpu._private.object_ref import ObjectRef
+from ray_tpu._private.rpc import RpcClient
+
+# Set by the pool worker's serve loop around each task execution; rides
+# along on blocking get/wait RPCs for driver-side CPU release.
+_current_task_token: str | None = None
+
+_active_lock = threading.Lock()
+_active: "WorkerModeRuntime | None" = None
+
+
+def current_task_token() -> str | None:
+    return _current_task_token
+
+
+def set_task_token(token: str | None) -> None:
+    global _current_task_token
+    _current_task_token = token
+
+
+def active_worker_runtime() -> "WorkerModeRuntime | None":
+    return _active
+
+
+def get_worker_runtime() -> "WorkerModeRuntime":
+    """Per-process singleton, created on first API use in a worker."""
+    global _active
+    with _active_lock:
+        if _active is None:
+            address = os.environ.get("RAY_TPU_DRIVER_CLIENT_ADDR")
+            if not address:
+                raise RuntimeError(
+                    "nested ray_tpu API use inside a pool worker requires "
+                    "the driver's client server (driver too old, or the "
+                    "worker was spawned without RAY_TPU_DRIVER_CLIENT_ADDR)")
+            _active = WorkerModeRuntime(address)
+        return _active
+
+
+class _ProxyReferenceCounter:
+    """Ref lifetimes in the worker release the driver-side pin on zero
+    (the borrower half of the ownership protocol)."""
+
+    def __init__(self, runtime: "WorkerModeRuntime"):
+        self._runtime = runtime
+        self._lock = threading.Lock()
+        self._counts: dict[ObjectID, int] = {}
+
+    def add_ref(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._counts[object_id] = self._counts.get(object_id, 0) + 1
+
+    def remove_ref(self, object_id: ObjectID) -> None:
+        with self._lock:
+            count = self._counts.get(object_id)
+            if count is None:
+                return
+            if count <= 1:
+                del self._counts[object_id]
+                release = True
+            else:
+                self._counts[object_id] = count - 1
+                release = False
+        if release:
+            try:
+                self._runtime._rpc.call("client_release", [object_id.hex()])
+            except Exception:  # noqa: BLE001 — interpreter teardown etc.
+                pass
+
+    def count(self, object_id: ObjectID) -> int:
+        with self._lock:
+            return self._counts.get(object_id, 0)
+
+
+class _NullGcs:
+    """ActorHandle.__getattr__ probes gcs.get_actor for method metadata;
+    in a worker that metadata lives driver-side — default it."""
+
+    def get_actor(self, actor_id):
+        return None
+
+
+class WorkerModeRuntime:
+    """The subset of Runtime the public API touches, proxied over RPC."""
+
+    _POLL_S = 10.0
+
+    def __init__(self, address: str):
+        self._rpc = RpcClient(address, timeout_s=60.0)
+        self.reference_counter = _ProxyReferenceCounter(self)
+        self.gcs = _NullGcs()
+        self.namespace = "default"
+
+    # -- marshalling ----------------------------------------------------
+    @staticmethod
+    def _marshal(args: tuple, kwargs: dict) -> bytes:
+        """ObjectRefs/ActorHandles become key placeholders the driver's
+        client server resolves (same wire shape as ClientAPI._marshal)."""
+        from ray_tpu.actor import ActorHandle
+
+        def convert(v):
+            if isinstance(v, ObjectRef):
+                return ("__ref__", v.hex())
+            if isinstance(v, ActorHandle):
+                return ("__actor__", v._actor_id.hex())
+            if type(v) is list:
+                return [convert(x) for x in v]
+            if type(v) is tuple:
+                return tuple(convert(x) for x in v)
+            if type(v) is dict:
+                return {k: convert(x) for k, x in v.items()}
+            return v
+
+        return serialization.serialize_framed(
+            (tuple(convert(a) for a in args),
+             {k: convert(v) for k, v in kwargs.items()}))
+
+    @staticmethod
+    def _resource_options(resources: dict[str, float]) -> dict:
+        opts: dict[str, Any] = {}
+        if resources:
+            rest = {k: v for k, v in resources.items()
+                    if k not in ("CPU", "TPU")}
+            if "CPU" in resources:
+                opts["num_cpus"] = resources["CPU"]
+            if "TPU" in resources:
+                opts["num_tpus"] = resources["TPU"]
+            if rest:
+                opts["resources"] = rest
+        return opts
+
+    def _new_refs(self, keys: list[str]) -> list[ObjectRef]:
+        return [ObjectRef(ObjectID(bytes.fromhex(k))) for k in keys]
+
+    @staticmethod
+    def _strategy_options(strategy) -> dict:
+        """Translate a SchedulingStrategy into driver-side options;
+        hard constraints must carry over or raise, never silently drop."""
+        kind = getattr(strategy, "kind", "DEFAULT") if strategy else "DEFAULT"
+        if kind == "DEFAULT":
+            return {}
+        if kind == "SPREAD":
+            return {"scheduling_strategy": "SPREAD"}
+        if kind == "NODE_AFFINITY":
+            from ray_tpu.util.scheduling_strategies import (
+                NodeAffinitySchedulingStrategy,
+            )
+
+            return {"scheduling_strategy": NodeAffinitySchedulingStrategy(
+                node_id=strategy.node_id, soft=strategy.soft)}
+        raise ValueError(
+            f"{kind} scheduling is not supported for work submitted "
+            "from inside pool workers")
+
+    # -- tasks ----------------------------------------------------------
+    def submit_task(self, func, args: tuple, kwargs: dict, *, name: str,
+                    num_returns: int = 1, resources: dict[str, float],
+                    max_retries: int = 0, retry_exceptions=False,
+                    scheduling_strategy=None,
+                    runtime_env: dict | None = None) -> list[ObjectRef]:
+        options = self._resource_options(resources)
+        options.update(name=name, num_returns=num_returns,
+                       max_retries=max_retries,
+                       retry_exceptions=retry_exceptions)
+        if runtime_env:
+            options["runtime_env"] = runtime_env
+        options.update(self._strategy_options(scheduling_strategy))
+        func_blob = serialization.dumps_function(func)
+        keys = self._rpc.call("client_task", func_blob,
+                              self._marshal(args, kwargs), options)
+        return self._new_refs(keys)
+
+    # -- objects --------------------------------------------------------
+    def put(self, value: Any) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("Calling put() on an ObjectRef is not allowed")
+        key = self._rpc.call("client_put",
+                             serialization.serialize_framed(value))
+        return self._new_refs([key])[0]
+
+    def _abandon_block(self, token: str | None, blocked: bool) -> None:
+        if token is not None and blocked:
+            try:
+                self._rpc.call("client_unblock", token)
+            except Exception:  # noqa: BLE001 — best-effort restore
+                pass
+
+    def get(self, refs: Sequence[ObjectRef],
+            timeout: float | None = None) -> list[Any]:
+        keys = [r.hex() for r in refs]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        token = current_task_token()
+        blocked = False  # a "pending" round left our CPU released
+        try:
+            while True:
+                poll = self._POLL_S
+                if deadline is not None:
+                    poll = min(poll, max(0.0, deadline - time.monotonic()))
+                status, blob = self._rpc.call(
+                    "client_get", keys, poll, token, blocked)
+                if status == "ok":
+                    blocked = False
+                    return list(serialization.deserialize_from_buffer(
+                        memoryview(blob)))
+                blocked = token is not None
+                if deadline is not None and time.monotonic() >= deadline:
+                    from ray_tpu.exceptions import GetTimeoutError
+
+                    raise GetTimeoutError(
+                        f"get() timed out after {timeout}s (nested)")
+        finally:
+            self._abandon_block(token, blocked)
+
+    def wait(self, refs: Sequence[ObjectRef], num_returns: int = 1,
+             timeout: float | None = None):
+        by_key = {r.hex(): r for r in refs}
+        deadline = None if timeout is None else time.monotonic() + timeout
+        token = current_task_token()
+        blocked = False
+        try:
+            while True:
+                remaining = None if deadline is None \
+                    else max(0.0, deadline - time.monotonic())
+                ready, pending = self._rpc.call(
+                    "client_wait", [r.hex() for r in refs], num_returns,
+                    remaining, self._POLL_S, token, blocked)
+                if len(ready) >= num_returns or (
+                        remaining is not None and remaining <= 0):
+                    blocked = False
+                    return ([by_key[k] for k in ready],
+                            [by_key[k] for k in pending])
+                blocked = token is not None
+        finally:
+            self._abandon_block(token, blocked)
+
+    def cancel(self, ref: ObjectRef) -> None:
+        self._rpc.call("client_cancel", ref.hex())
+
+    def free(self, refs: Sequence[ObjectRef]) -> None:
+        self._rpc.call("client_release", [r.hex() for r in refs])
+
+    # -- actors ---------------------------------------------------------
+    def create_actor(self, cls: type, args: tuple, kwargs: dict, *,
+                     name: str | None = None, namespace: str | None = None,
+                     resources: dict[str, float], max_concurrency: int = 1,
+                     max_restarts: int = 0, max_pending_calls: int = -1,
+                     lifetime: str | None = None, scheduling_strategy=None,
+                     get_if_exists: bool = False, process: bool = False,
+                     runtime_env: dict | None = None):
+        options = self._resource_options(resources)
+        options.update(max_concurrency=max_concurrency,
+                       max_restarts=max_restarts,
+                       max_pending_calls=max_pending_calls)
+        options.update(self._strategy_options(scheduling_strategy))
+        if name is not None:
+            options["name"] = name
+        if namespace is not None:
+            options["namespace"] = namespace
+        if get_if_exists:
+            options["get_if_exists"] = True
+        if process:
+            options["process"] = True
+        if runtime_env:
+            options["runtime_env"] = runtime_env
+        cls_blob = serialization.dumps_function(cls)
+        key = self._rpc.call("client_create_actor", cls_blob,
+                             self._marshal(args, kwargs), options)
+        return ActorID(bytes.fromhex(key)), None
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str,
+                          args: tuple, kwargs: dict,
+                          num_returns: int = 1) -> list[ObjectRef]:
+        keys = self._rpc.call(
+            "client_actor_call", actor_id.hex(), method_name,
+            self._marshal(args, kwargs), num_returns)
+        return self._new_refs(keys)
+
+    def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
+        self._rpc.call("client_kill_actor", actor_id.hex())
+
+    def get_actor_handle(self, name: str, namespace: str | None = None):
+        from ray_tpu.actor import ActorHandle
+
+        key, class_name = self._rpc.call(
+            "client_get_actor", name, namespace)
+        return ActorHandle(ActorID(bytes.fromhex(key)), class_name)
+
+    # -- misc surface ----------------------------------------------------
+    def cluster_resources(self) -> dict[str, float]:
+        return self._rpc.call("client_cluster_resources", False)
+
+    def available_resources(self) -> dict[str, float]:
+        return self._rpc.call("client_cluster_resources", True)
+
+    def attach_future(self, ref, fut) -> None:
+        import concurrent.futures  # noqa: F401
+
+        def resolve():
+            try:
+                fut.set_result(self.get([ref])[0])
+            except BaseException as exc:  # noqa: BLE001
+                try:
+                    fut.set_exception(exc)
+                except Exception:
+                    pass
+
+        threading.Thread(target=resolve, daemon=True).start()
+
+    def shutdown(self) -> None:
+        global _active
+        self._rpc.close()
+        with _active_lock:
+            if _active is self:
+                _active = None

@@ -19,10 +19,19 @@ Process actors (``ProcessActor``) give an actor a dedicated worker
 process: constructor and method calls execute there in submission
 order; max_restarts respawns the process and re-runs the constructor.
 
-v1 limitations (documented, not hidden): code running inside a pool
-worker cannot call back into the driver's runtime (no nested task
-submission), and process actors execute calls sequentially
-(max_concurrency applies to thread-mode actors).
+Nested submission: code inside a pool worker (tasks and process actors)
+can call the full public API — remote()/get()/put()/wait()/actors — via
+a proxy runtime that routes to the driver's client server
+(worker_client.py). Blocked nested gets from TASKS release the owning
+task's CPU admission through a task token, and the pool grows on demand
+(up to max_size) so an outer task waiting on an inner one never starves
+it. Process-actor calls carry no token — actors hold their resources
+for their lifetime (and default to 0 CPU, like the reference), so
+blocked actor gets keep their lease.
+
+Remaining v1 limitation (documented, not hidden): process actors
+execute calls sequentially (max_concurrency applies to thread-mode
+actors).
 """
 
 from __future__ import annotations
@@ -247,7 +256,7 @@ def _serve(conn, client: ShmClient, arena=None,
             elif kind == "ping":
                 conn.send(("pong", os.getpid()))
             elif kind == "task":
-                _, digest, func_blob, args_blob, n_returns, renv = msg
+                _, digest, func_blob, args_blob, n_returns, renv, token = msg
                 if func_blob is not None:
                     func = serialization.loads_function(func_blob)
                     func_cache[digest] = func
@@ -256,8 +265,16 @@ def _serve(conn, client: ShmClient, arena=None,
                 args, kwargs = serialization.deserialize_from_buffer(
                     memoryview(args_blob))
                 args, kwargs = _resolve_shm_args(args, kwargs, client)
-                with _runtime_env_ctx(renv):
-                    result = func(*args, **kwargs)
+                # Token rides along on nested get()/wait() RPCs so the
+                # driver can release this task's CPU while it blocks.
+                from ray_tpu._private import worker_client
+
+                worker_client.set_task_token(token)
+                try:
+                    with _runtime_env_ctx(renv):
+                        result = func(*args, **kwargs)
+                finally:
+                    worker_client.set_task_token(None)
                 if n_returns == 0:
                     values = []
                 elif n_returns == 1:
@@ -452,8 +469,13 @@ class WorkerPool:
     worker per lease, returns it after; prestart keeps latency low)."""
 
     def __init__(self, size: int, directory: ShmDirectory,
-                 driver_client: ShmClient):
+                 driver_client: ShmClient, max_size: int | None = None):
         self.size = size
+        # Growth headroom for nested submission: an outer task blocked in
+        # get() occupies its worker while the nested task needs another
+        # (reference: the raylet starts workers on demand; CPU admission,
+        # not pool size, bounds running tasks).
+        self.max_size = max_size if max_size is not None else size * 4 + 8
         self.directory = directory
         self.driver_client = driver_client
         self._lock = threading.Condition(threading.Lock())
@@ -461,6 +483,7 @@ class WorkerPool:
         self._idle: list[PoolWorker] = []
         self._all_workers: set[PoolWorker] = set()
         self._next_index = 0
+        self._num_leased = 0
         self._shutdown = False
         # Spawn in parallel: each worker blocks on interpreter boot +
         # socket handshake, so serial startup would be O(N).
@@ -487,32 +510,71 @@ class WorkerPool:
             return [w for w in self._all_workers if w.alive()]
 
     def _acquire(self) -> PoolWorker:
+        grow = False
         with self._lock:
             while not self._idle and not self._shutdown:
+                # Grow past `size` (up to max_size) instead of waiting:
+                # every leased worker may be an outer task blocked on a
+                # nested one that needs a worker of its own.
+                if self._num_leased < self.max_size:
+                    self._num_leased += 1
+                    grow = True
+                    break
                 self._lock.wait(timeout=0.5)
             if self._shutdown:
                 raise RuntimeError("worker pool is shut down")
-            worker = self._idle.pop()
+            if not grow:
+                worker = self._idle.pop()
+                self._num_leased += 1
+        if grow:
+            try:
+                return self._new_worker()
+            except BaseException:
+                # Give the lease slot back, or a failed spawn (e.g.
+                # fork under memory pressure) pins the pool at max_size.
+                with self._lock:
+                    self._num_leased -= 1
+                    self._lock.notify()
+                raise
         if worker.alive():
             return worker
         # Died while idle (crash, memory-monitor kill): replace it
         # (spawn happens outside the condition lock — it is slow).
         worker.stop()
-        return self._new_worker()
+        try:
+            return self._new_worker()
+        except BaseException:
+            with self._lock:
+                self._num_leased -= 1
+                self._lock.notify()
+            raise
 
     def _release(self, worker: PoolWorker) -> None:
         # Spawn any replacement outside the pool lock (spawn is slow and
         # _new_worker must not nest under the condition lock).
         replacement = None
         if not worker.alive():
-            replacement = self._new_worker()
+            if self._num_leased <= self.size:
+                replacement = self._new_worker()
+            else:
+                worker.stop()  # shrink back toward the target size
         with self._lock:
+            self._num_leased -= 1
             if self._shutdown:
                 worker.stop()
                 if replacement is not None:
                     replacement.stop()
                 return
-            self._idle.append(replacement if replacement is not None else worker)
+            if replacement is not None:
+                self._idle.append(replacement)
+            elif worker.alive():
+                if len(self._idle) < self.size:
+                    self._idle.append(worker)
+                else:
+                    # Surplus growth worker: retire it now that the
+                    # burst is over (stop() can block; do it off-lock).
+                    threading.Thread(target=worker.stop,
+                                     daemon=True).start()
             self._lock.notify()
 
     # ------------------------------------------------------------- task path
@@ -535,6 +597,7 @@ class WorkerPool:
     def run_task_blobs(self, digest: str, func_blob: bytes, args_blob: bytes,
                        n_returns: int, return_ids: list[ObjectID],
                        runtime_env: dict | None = None,
+                       task_token: str | None = None,
                        ) -> list[tuple[ObjectID, Any]]:
         """Execute on a pool worker; returns [(return_id, value)] pairs.
 
@@ -554,7 +617,7 @@ class WorkerPool:
             try:
                 reply = worker.request(
                     ("task", digest, send_blob, args_blob, n_returns,
-                     runtime_env))
+                     runtime_env, task_token))
             except _WorkerUnavailable:
                 continue  # _release (in finally) already spawns a live one
             finally:

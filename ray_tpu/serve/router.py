@@ -75,7 +75,8 @@ class DeploymentResponse:
                                                deadline - time.monotonic()))
                 time.sleep(sleep_s)
                 backoff_s = min(backoff_s * 2, 1.0)
-                idx, handle = self._router._pick(model_id=self._model_id)
+                idx, handle = self._router._pick(
+                    model_id=self._model_id, skip_affinity=True)
                 self._replica_idx = idx
                 self._ref = handle.handle_request.remote(*self._request)
                 if deadline is not None:
@@ -124,17 +125,21 @@ class Router:
         else:
             self._have_replicas.clear()
 
-    def _pick(self, model_id: str | None = None) -> tuple[Any, Any]:
+    def _pick(self, model_id: str | None = None,
+              skip_affinity: bool = False) -> tuple[Any, Any]:
         """Power of two choices on local in-flight counts; multiplexed
         requests stick to the replica that last served their model id
         (reference: the pow-2 scheduler's multiplex locality
-        preference). Returns (replica_key, handle)."""
+        preference). Backpressure retries pass skip_affinity so an
+        overloaded affine replica doesn't pin the request while other
+        replicas sit idle (affinity re-points to the new replica).
+        Returns (replica_key, handle)."""
         with self._lock:
             n = len(self._replicas)
             if n == 0:
                 raise RuntimeError("no replicas")
             handle = None
-            if model_id is not None:
+            if model_id is not None and not skip_affinity:
                 affine_key = self._model_affinity.get(model_id)
                 if affine_key is not None:
                     for replica in self._replicas:

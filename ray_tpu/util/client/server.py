@@ -37,6 +37,10 @@ class ClientServer:
         s.register("client_kill_actor", self.kill_actor)
         s.register("client_release", self.release)
         s.register("client_disconnect", self.disconnect_cleanup)
+        s.register("client_cancel", self.cancel)
+        s.register("client_unblock", self.unblock)
+        s.register("client_get_actor", self.get_actor)
+        s.register("client_cluster_resources", self.cluster_resources)
 
     @property
     def port(self) -> int:
@@ -62,11 +66,43 @@ class ClientServer:
 
     def _resolve(self, key: str):
         with self._lock:
-            try:
-                return self._refs[key]
-            except KeyError:
-                raise KeyError(f"unknown/released client ref {key}") \
-                    from None
+            ref = self._refs.get(key)
+        if ref is not None:
+            return ref
+        # Not server-tracked: possibly a driver-created ref that reached
+        # the client (passed into a pool task's args and echoed back by
+        # nested code). This process is the owner, so a bare id the
+        # local store knows resolves directly; anything else is a
+        # released/bogus key and must fail, not block forever.
+        from ray_tpu._private.ids import ObjectID
+        from ray_tpu._private.object_ref import ObjectRef
+        from ray_tpu._private.worker import global_runtime
+
+        runtime = global_runtime()
+        oid = ObjectID(bytes.fromhex(key))
+        if runtime is not None and (runtime.store.contains(oid)
+                                    or runtime.store.is_pending(oid)):
+            return ObjectRef(oid)
+        raise KeyError(f"unknown/released client ref {key}")
+
+    def _resolve_actor(self, key: str):
+        with self._lock:
+            handle = self._actors.get(key)
+        if handle is not None:
+            return handle
+        # Driver-created ActorHandle passed into a pool task: rebuild
+        # from the id against the local runtime's actor table.
+        from ray_tpu._private.ids import ActorID
+        from ray_tpu._private.worker import global_runtime
+        from ray_tpu.actor import ActorHandle
+
+        actor_id = ActorID(bytes.fromhex(key))
+        runtime = global_runtime()
+        record = (runtime.gcs.get_actor(actor_id)
+                  if runtime is not None else None)
+        if record is None:
+            raise KeyError(f"unknown client actor {key}")
+        return ActorHandle(actor_id, record.class_name)
 
     def _deserialize_args(self, args_blob: bytes):
         args, kwargs = serialization.deserialize_from_buffer(
@@ -79,8 +115,7 @@ class ClientServer:
                 return self._resolve(v[1])
             if isinstance(v, tuple) and len(v) == 2 \
                     and v[0] == "__actor__":
-                with self._lock:
-                    return self._actors[v[1]]
+                return self._resolve_actor(v[1])
             # EXACT container types only: tuple/dict subclasses
             # (namedtuples, OrderedDicts) pass through untouched —
             # rebuilding them as plain containers would mangle them.
@@ -103,36 +138,86 @@ class ClientServer:
             memoryview(value_blob))
         return self._track(ray_tpu.put(value))
 
-    def get(self, keys: list[str],
-            poll_s: float = 10.0) -> tuple[str, bytes | None]:
+    @staticmethod
+    def _block_ctx(block_token: str | None):
+        """The caller is a pool worker blocked inside one of OUR tasks:
+        release that task's CPU admission while it waits (cross-process
+        BlockedResourceContext — reference: workers blocked in ray.get
+        return their CPU to the raylet)."""
+        if block_token is None:
+            return None
+        from ray_tpu._private.worker import global_runtime
+
+        runtime = global_runtime()
+        if runtime is None:
+            return None
+        return runtime.lookup_block_context(block_token)
+
+    def get(self, keys: list[str], poll_s: float = 10.0,
+            block_token: str | None = None,
+            blocked_already: bool = False) -> tuple[str, bytes | None]:
         """Bounded server-side block: ("ok", values_blob) when every
         ref is ready within poll_s, else ("pending", None). The client
         loops — an RPC never outlives the socket timeout, so the
         transport's reconnect/resend cannot fire mid-long-get.
+
+        CPU admission of the calling task is released on the FIRST poll
+        and held released across "pending" rounds (blocked_already tells
+        us a prior round blocked); it is restored only on a terminal
+        outcome, so a long nested wait never thrashes acquire/release.
         """
         import ray_tpu
 
         refs = [self._resolve(k) for k in keys]
-        ready, pending = ray_tpu.wait(
-            refs, num_returns=len(refs), timeout=poll_s)
-        if pending:
-            return ("pending", None)
-        values = ray_tpu.get(refs)
-        return ("ok", serialization.serialize_framed(values))
+        ctx = self._block_ctx(block_token)
+        if ctx is not None and not blocked_already:
+            ctx.block()
+        terminal = True
+        try:
+            ready, pending = ray_tpu.wait(
+                refs, num_returns=len(refs), timeout=poll_s)
+            if pending:
+                terminal = False
+                return ("pending", None)
+            values = ray_tpu.get(refs)
+            return ("ok", serialization.serialize_framed(values))
+        finally:
+            if ctx is not None and terminal:
+                ctx.unblock(force=True)
 
     def wait(self, keys: list[str], num_returns: int,
-             timeout: float | None,
-             poll_s: float = 10.0) -> tuple[list[str], list[str]]:
+             timeout: float | None, poll_s: float = 10.0,
+             block_token: str | None = None,
+             blocked_already: bool = False) -> tuple[list[str], list[str]]:
         """Server-side block capped at poll_s; the client loops."""
         import ray_tpu
 
         capped = poll_s if timeout is None else min(timeout, poll_s)
         refs = [self._resolve(k) for k in keys]
-        ready, pending = ray_tpu.wait(
-            refs, num_returns=num_returns, timeout=capped)
-        by_ref = {id(r): k for r, k in zip(refs, keys)}
-        return ([by_ref[id(r)] for r in ready],
-                [by_ref[id(r)] for r in pending])
+        ctx = self._block_ctx(block_token)
+        if ctx is not None and not blocked_already:
+            ctx.block()
+        terminal = True
+        try:
+            ready, pending = ray_tpu.wait(
+                refs, num_returns=num_returns, timeout=capped)
+            terminal = (len(ready) >= num_returns
+                        or (timeout is not None and timeout <= capped))
+            by_ref = {id(r): k for r, k in zip(refs, keys)}
+            return ([by_ref[id(r)] for r in ready],
+                    [by_ref[id(r)] for r in pending])
+        finally:
+            if ctx is not None and terminal:
+                ctx.unblock(force=True)
+
+    def unblock(self, block_token: str) -> bool:
+        """Client-side abandonment (timeout raised while a server-side
+        block was held): restore the task's admission."""
+        ctx = self._block_ctx(block_token)
+        if ctx is None:
+            return False
+        ctx.drain()
+        return True
 
     def disconnect_cleanup(self, ref_keys: list[str],
                            actor_keys: list[str]) -> int:
@@ -176,8 +261,7 @@ class ClientServer:
 
     def actor_call(self, actor_key: str, method: str,
                    args_blob: bytes, num_returns: int = 1) -> list[str]:
-        with self._lock:
-            handle = self._actors[actor_key]
+        handle = self._resolve_actor(actor_key)
         args, kwargs = self._deserialize_args(args_blob)
         bound = getattr(handle, method)
         if num_returns != 1:
@@ -192,7 +276,10 @@ class ClientServer:
         with self._lock:
             handle = self._actors.pop(actor_key, None)
         if handle is None:
-            return False
+            try:
+                handle = self._resolve_actor(actor_key)
+            except KeyError:
+                return False
         ray_tpu.kill(handle)
         return True
 
@@ -203,3 +290,28 @@ class ClientServer:
                 if self._refs.pop(k, None) is not None:
                     n += 1
         return n
+
+    def cancel(self, key: str) -> bool:
+        import ray_tpu
+
+        try:
+            ray_tpu.cancel(self._resolve(key))
+            return True
+        except KeyError:
+            return False
+
+    def get_actor(self, name: str,
+                  namespace: str | None = None) -> tuple[str, str]:
+        import ray_tpu
+
+        handle = ray_tpu.get_actor(name, namespace)
+        key = handle._actor_id.hex()
+        with self._lock:
+            self._actors[key] = handle
+        return key, handle._class_name
+
+    def cluster_resources(self, available: bool = False) -> dict:
+        import ray_tpu
+
+        return (ray_tpu.available_resources() if available
+                else ray_tpu.cluster_resources())
