@@ -108,6 +108,9 @@ class NodeRecord:
     address: str
     resources: dict[str, float]
     labels: dict[str, str] = field(default_factory=dict)
+    # RPC address of the node's executor service (empty for nodes that
+    # cannot run tasks, e.g. pure drivers).
+    executor_address: str = ""
     alive: bool = True
     last_heartbeat: float = field(default_factory=time.monotonic)
     # Live usage piggybacked on heartbeats (reference: ray_syncer's

@@ -212,11 +212,13 @@ class GcsServer:
 
     # -- node service -------------------------------------------------
     def _register_node(self, address: str, resources: dict,
-                       labels: dict | None = None) -> bytes:
+                       labels: dict | None = None,
+                       executor_address: str = "") -> bytes:
         node_id = NodeID()
         self.gcs.register_node(NodeRecord(
             node_id=node_id, address=address, resources=dict(resources),
-            labels=dict(labels or {})))
+            labels=dict(labels or {}),
+            executor_address=executor_address))
         return node_id.binary()
 
     def _heartbeat(self, node_id_bytes: bytes,
@@ -231,6 +233,7 @@ class GcsServer:
             "resources": dict(r.resources),
             "available": dict(r.available),
             "labels": dict(r.labels),
+            "executor_address": r.executor_address,
             "alive": r.alive,
         } for r in self.gcs.list_nodes()]
 

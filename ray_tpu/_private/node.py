@@ -43,7 +43,7 @@ class NodeAgent:
     def __init__(self, gcs_address: str, resources: dict,
                  labels: dict | None = None,
                  heartbeat_period_s: float = 1.0,
-                 usage_fn=None):
+                 usage_fn=None, executor_address: str = ""):
         self.client = RpcClient(gcs_address)
         self.resources = dict(resources)
         self.labels = dict(labels or {})
@@ -51,6 +51,7 @@ class NodeAgent:
         # Optional live-usage callable: () -> {resource: available}
         # piggybacked on heartbeats (ray_syncer-lite).
         self.usage_fn = usage_fn
+        self.executor_address = executor_address
         self._address = f"{_own_address()}:{os.getpid()}"
         self.node_id: bytes = self._register()
         self._shutdown = threading.Event()
@@ -60,7 +61,8 @@ class NodeAgent:
 
     def _register(self) -> bytes:
         return self.client.call(
-            "register_node", self._address, self.resources, self.labels)
+            "register_node", self._address, self.resources, self.labels,
+            self.executor_address)
 
     def _heartbeat_loop(self) -> None:
         while not self._shutdown.wait(self.heartbeat_period_s):
@@ -164,10 +166,23 @@ def run_head(port: int, resources: dict | None = None,
         server.stop()
 
 
-def run_worker(gcs_address: str, resources: dict | None = None) -> None:
-    """Worker-node daemon: register + heartbeat. Blocks."""
-    agent = NodeAgent(gcs_address, resources or default_resources(),
-                      labels={"node_role": "worker"})
+def run_worker(gcs_address: str, resources: dict | None = None,
+               pool_size: int | None = None) -> None:
+    """Worker-node daemon: executor service + register + heartbeat.
+    Blocks. (Reference: the raylet — lease-based dispatch onto this
+    node's worker pool, node_manager.cc:1714.)"""
+    from ray_tpu._private.node_executor import NodeExecutorService
+
+    resources = resources or default_resources()
+    # Unique per-daemon tag, inherited by this node's pool workers (set
+    # BEFORE the pool spawns) — tasks can read it to learn where they ran.
+    os.environ["RAY_TPU_NODE_TAG"] = os.urandom(6).hex()
+    executor = NodeExecutorService(
+        pool_size=pool_size, resources=resources).start()
+    agent = NodeAgent(gcs_address, resources,
+                      labels={"node_role": "worker"},
+                      usage_fn=executor.available_resources,
+                      executor_address=executor.address_for(_own_address()))
     stop_event = threading.Event()
 
     def on_term(signum, frame):
@@ -180,6 +195,7 @@ def run_worker(gcs_address: str, resources: dict | None = None) -> None:
             pass
     finally:
         agent.stop()
+        executor.stop()
 
 
 def main(argv: list[str]) -> None:

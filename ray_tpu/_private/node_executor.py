@@ -1,0 +1,473 @@
+"""Node executor service — the cluster's distributed execution plane.
+
+TPU-native analogue of the raylet's lease-and-dispatch loop plus the
+object manager's node-to-node transfer:
+
+- ``NodeExecutorService`` runs inside every worker-node daemon and
+  serves ``execute_task`` over RPC (reference: the raylet grants a
+  worker lease and the task is pushed to that node's worker pool —
+  src/ray/raylet/node_manager.cc:1714 HandleRequestWorkerLease,
+  local_task_manager.h:58). CPU tasks run on the node's own
+  multiprocess worker pool; TPU tasks run in the daemon process (which
+  owns the node's JAX/TPU runtime).
+- ``NodeObjectStore`` holds serialized task results and pulled objects;
+  peers and the driver read them with chunked ``fetch_object`` RPCs
+  (reference: src/ray/object_manager/object_manager.h:106-130 —
+  chunked Push/Pull between nodes).
+- ``RemoteNodeHandle`` is the driver side: it leases the task to the
+  node, ships the function once per node by digest (function-manager
+  pattern), passes remote-located args as ``FetchRef`` location hints
+  so the consuming node pulls them peer-to-peer — the driver never
+  relays the bytes (reference: ownership_based_object_directory.h, the
+  owner hands out locations, data flows node-to-node).
+
+Results above the inline threshold stay on the producing node; the
+driver's store holds a ``RemoteBlob`` placeholder that materializes by
+chunked pull only when the value is actually read locally.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ObjectID
+from ray_tpu._private.rpc import RpcClient, RpcError, RpcServer
+
+# Results at or below this ship inline in the execute_task reply;
+# larger ones stay in the producing node's store (driver pulls lazily).
+INLINE_REPLY_BYTES = 256 * 1024
+FETCH_CHUNK_BYTES = 4 * 1024 * 1024
+
+
+@dataclass
+class FetchRef:
+    """Arg placeholder: the value lives in a node's object store —
+    resolve by local lookup or a chunked pull from ``addr``."""
+
+    id_bytes: bytes
+    addr: str
+
+
+@dataclass
+class RemoteBlob:
+    """Driver-store placeholder for a result held on a remote node."""
+
+    node_hex: str
+    addr: str
+    size: int
+
+
+class NodeObjectStore:
+    """Serialized-blob store of a node daemon: task results (until the
+    owner frees them) + pulled peer objects (evictable cache)."""
+
+    def __init__(self, cache_limit_bytes: int = 512 * 1024 * 1024):
+        self._lock = threading.Lock()
+        self._blobs: dict[bytes, bytes] = {}
+        self._cached: dict[bytes, None] = {}  # pulled copies, FIFO evict
+        self._cache_limit = cache_limit_bytes
+        self._cache_bytes = 0
+        self.fetches_served = 0
+
+    def put(self, id_bytes: bytes, blob: bytes, cached: bool = False) -> None:
+        with self._lock:
+            old = self._blobs.get(id_bytes)
+            if old is not None and id_bytes in self._cached:
+                self._cache_bytes -= len(old)
+                del self._cached[id_bytes]
+            self._blobs[id_bytes] = blob
+            if cached:
+                self._cached[id_bytes] = None
+                self._cache_bytes += len(blob)
+                while self._cache_bytes > self._cache_limit and self._cached:
+                    victim = next(iter(self._cached))
+                    del self._cached[victim]
+                    dropped = self._blobs.pop(victim, None)
+                    if dropped is not None:
+                        self._cache_bytes -= len(dropped)
+
+    def get(self, id_bytes: bytes) -> bytes | None:
+        with self._lock:
+            return self._blobs.get(id_bytes)
+
+    def free(self, ids: list[bytes]) -> int:
+        with self._lock:
+            n = 0
+            for id_bytes in ids:
+                blob = self._blobs.pop(id_bytes, None)
+                if blob is not None:
+                    n += 1
+                    if id_bytes in self._cached:
+                        del self._cached[id_bytes]
+                        self._cache_bytes -= len(blob)
+            return n
+
+    def read_chunk(self, id_bytes: bytes, offset: int,
+                   length: int) -> tuple[int, bytes] | None:
+        with self._lock:
+            blob = self._blobs.get(id_bytes)
+            if blob is None:
+                return None
+            self.fetches_served += 1
+            return len(blob), blob[offset:offset + length]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "num_blobs": len(self._blobs),
+                "bytes": sum(len(b) for b in self._blobs.values()),
+                "fetches_served": self.fetches_served,
+            }
+
+
+class _PeerClients:
+    """One pooled RPC client per peer address (daemon-side pulls)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._clients: dict[str, RpcClient] = {}
+
+    def get(self, addr: str) -> RpcClient:
+        with self._lock:
+            client = self._clients.get(addr)
+            if client is None:
+                client = RpcClient(addr)
+                self._clients[addr] = client
+            return client
+
+    def close(self) -> None:
+        with self._lock:
+            for client in self._clients.values():
+                client.close()
+            self._clients.clear()
+
+
+def fetch_blob(client: RpcClient, id_bytes: bytes) -> bytes:
+    """Chunked pull of one object (reference: object_manager.h chunked
+    Push — here pull-oriented, sized by FETCH_CHUNK_BYTES)."""
+    out = bytearray()
+    offset = 0
+    while True:
+        reply = client.call("fetch_object", id_bytes, offset,
+                            FETCH_CHUNK_BYTES)
+        if reply is None:
+            raise KeyError(
+                f"object {id_bytes.hex()} not present on {client.address}")
+        total, chunk = reply
+        out.extend(chunk)
+        offset += len(chunk)
+        if offset >= total:
+            return bytes(out)
+
+
+class NodeExecutorService:
+    """The daemon-side execution plane: worker pool + object store +
+    the RPC surface (execute_task / fetch_object / free_objects)."""
+
+    def __init__(self, host: str = "0.0.0.0", port: int = 0,
+                 pool_size: int | None = None,
+                 resources: dict[str, float] | None = None):
+        from ray_tpu._private.shm_store import ShmClient, ShmDirectory
+
+        self._server = RpcServer(host, port)
+        self.store = NodeObjectStore()
+        self._peers = _PeerClients()
+        self._resources = dict(resources or {})
+        self._running_lock = threading.Lock()
+        self._running: dict[str, dict[str, float]] = {}
+        self._func_cache: dict[str, Callable] = {}
+        self._func_lock = threading.Lock()
+        self.tasks_executed = 0
+
+        if pool_size is None:
+            pool_size = max(1, min(int(self._resources.get(
+                "CPU", os.cpu_count() or 1)), 16))
+        from ray_tpu._private.worker_pool import WorkerPool
+
+        self._shm_directory = ShmDirectory()
+        self._shm_client = ShmClient()
+        self.pool = WorkerPool(pool_size, self._shm_directory,
+                               self._shm_client)
+
+        s = self._server
+        s.register("ping", lambda: "pong")
+        s.register("exec_ping", lambda: os.getpid())
+        s.register("execute_task", self.execute_task)
+        s.register("fetch_object", self.fetch_object)
+        s.register("free_objects", self.free_objects)
+        s.register("executor_stats", self.executor_stats)
+
+    @property
+    def port(self) -> int:
+        return self._server.port
+
+    def address_for(self, host: str) -> str:
+        return f"{host}:{self._server.port}"
+
+    def start(self) -> "NodeExecutorService":
+        self._server.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.stop()
+        self.pool.shutdown()
+        self._peers.close()
+        self._shm_client.close_all()
+        self._shm_directory.shutdown()
+
+    # ------------------------------------------------------------- endpoints
+
+    def execute_task(self, digest: str, func_blob: bytes | None,
+                     args_blob: bytes, n_returns: int,
+                     return_keys: list[bytes],
+                     runtime_env: dict | None = None,
+                     resources: dict | None = None) -> tuple:
+        """Run one task; reply ("ok", [result descriptors]) where each
+        descriptor is ("inline", blob) or ("stored", size), or
+        ("need_func",) when the digest is unknown here, or
+        ("err", exc_blob)."""
+        with self._func_lock:
+            func = self._func_cache.get(digest)
+        if func is None:
+            if func_blob is None:
+                return ("need_func",)
+            # Deserialize OUTSIDE the lock: loading can import heavy
+            # modules and must not stall other tasks' cache lookups.
+            try:
+                func = serialization.loads_function(func_blob)
+            except BaseException as exc:  # noqa: BLE001
+                return ("err", _exc_blob(exc))
+            with self._func_lock:
+                self._func_cache[digest] = func
+
+        token = f"exec-{digest[:8]}-{os.urandom(4).hex()}"
+        with self._running_lock:
+            self._running[token] = dict(resources or {})
+        try:
+            args, kwargs = serialization.deserialize_from_buffer(
+                memoryview(args_blob))
+            args, kwargs = self._resolve_fetch_args(args, kwargs)
+            values = self._run(func, digest, func_blob, args, kwargs,
+                               n_returns, runtime_env,
+                               resources or {})
+        except BaseException as exc:  # noqa: BLE001 — shipped to driver
+            return ("err", _exc_blob(exc))
+        finally:
+            with self._running_lock:
+                self._running.pop(token, None)
+        self.tasks_executed += 1
+
+        out = []
+        for id_bytes, value in zip(return_keys, values):
+            try:
+                blob = serialization.serialize_framed(value)
+            except BaseException as exc:  # noqa: BLE001
+                out.append(("err", _exc_blob(exc)))
+                continue
+            if len(blob) <= INLINE_REPLY_BYTES:
+                out.append(("inline", blob))
+            else:
+                self.store.put(id_bytes, blob)
+                out.append(("stored", len(blob)))
+        return ("ok", out)
+
+    def fetch_object(self, id_bytes: bytes, offset: int,
+                     length: int):
+        return self.store.read_chunk(id_bytes, offset, length)
+
+    def free_objects(self, ids: list[bytes]) -> int:
+        return self.store.free(ids)
+
+    def executor_stats(self) -> dict:
+        with self._running_lock:
+            running = len(self._running)
+        return {"tasks_executed": self.tasks_executed,
+                "running": running, "store": self.store.stats(),
+                "pid": os.getpid()}
+
+    def available_resources(self) -> dict[str, float]:
+        """Heartbeat piggyback: total minus the demands of running
+        tasks (ray_syncer-lite view for dashboards/autoscaler)."""
+        avail = dict(self._resources)
+        with self._running_lock:
+            for demand in self._running.values():
+                for key, value in demand.items():
+                    avail[key] = avail.get(key, 0.0) - value
+        return avail
+
+    # ------------------------------------------------------------- internals
+
+    def _resolve_fetch_args(self, args: tuple, kwargs: dict):
+        def convert(a):
+            if isinstance(a, FetchRef):
+                return self._load_object(a)
+            return a
+
+        return (tuple(convert(a) for a in args),
+                {k: convert(v) for k, v in kwargs.items()})
+
+    def _load_object(self, ref: FetchRef) -> Any:
+        blob = self.store.get(ref.id_bytes)
+        if blob is None:
+            # Peer pull (node-to-node; the driver is never in the path).
+            client = self._peers.get(ref.addr)
+            blob = fetch_blob(client, ref.id_bytes)
+            self.store.put(ref.id_bytes, blob, cached=True)
+        return serialization.deserialize_from_buffer(memoryview(blob))
+
+    def _run(self, func, digest, func_blob, args, kwargs, n_returns,
+             runtime_env, resources) -> list:
+        if any(k.startswith("TPU") for k in resources):
+            # TPU tasks run in the daemon process: it owns this node's
+            # JAX/TPU runtime (pool workers are pinned to CPU).
+            result = func(*args, **kwargs)
+        else:
+            from ray_tpu._private.worker_pool import _RemoteTaskError
+
+            args_blob = serialization.serialize_framed((args, kwargs))
+            if func_blob is None:
+                func_blob = serialization.dumps_function(func)
+            return_ids = [ObjectID() for _ in range(max(1, n_returns))]
+            try:
+                pairs = self.pool.run_task_blobs(
+                    digest, func_blob, args_blob, n_returns, return_ids,
+                    runtime_env=runtime_env)
+            except _RemoteTaskError as rte:
+                rte.cause.__ray_tpu_remote_tb__ = rte.remote_tb
+                raise rte.cause from None
+            return [value for _, value in pairs]
+        if n_returns == 0:
+            return []
+        if n_returns == 1:
+            return [result]
+        if not isinstance(result, (tuple, list)) or len(result) != n_returns:
+            raise ValueError(
+                f"task declared num_returns={n_returns} but returned "
+                f"{type(result).__name__}")
+        return list(result)
+
+
+def _exc_blob(exc: BaseException) -> bytes:
+    import traceback
+
+    tb = "".join(traceback.format_exception(type(exc), exc,
+                                            exc.__traceback__))
+    try:
+        return serialization.serialize_framed((exc, tb))
+    except Exception:  # noqa: BLE001 — unpicklable exception
+        return serialization.serialize_framed(
+            (RuntimeError(f"{type(exc).__name__}: {exc}"), tb))
+
+
+# --------------------------------------------------------------------------
+# Driver side
+# --------------------------------------------------------------------------
+
+
+class _RpcClientPool:
+    """Connection pool to one node: execute_task blocks for the task's
+    duration, so concurrent in-flight tasks need parallel sockets (the
+    single-socket RpcClient would head-of-line block them)."""
+
+    def __init__(self, address: str, timeout_s: float = 24 * 3600.0):
+        self.address = address
+        self._timeout = timeout_s
+        self._lock = threading.Lock()
+        self._idle: list[RpcClient] = []
+
+    def acquire(self) -> RpcClient:
+        with self._lock:
+            if self._idle:
+                return self._idle.pop()
+        return RpcClient(self.address, timeout_s=self._timeout)
+
+    def release(self, client: RpcClient) -> None:
+        with self._lock:
+            if len(self._idle) < 16:
+                self._idle.append(client)
+                return
+        client.close()
+
+    def call(self, method: str, *args) -> Any:
+        client = self.acquire()
+        try:
+            result = client.call(method, *args)
+        except BaseException:
+            client.close()
+            raise
+        self.release(client)
+        return result
+
+    def close(self) -> None:
+        with self._lock:
+            for client in self._idle:
+                client.close()
+            self._idle.clear()
+
+
+class RemoteNodeHandle:
+    """Driver-side handle to one worker-node executor."""
+
+    def __init__(self, node_id, address: str):
+        self.node_id = node_id
+        self.address = address
+        self.pool = _RpcClientPool(address)
+        # Short-timeout client for watcher-thread control calls: a ping
+        # to an unreachable address must fail fast, never stall the
+        # watcher behind the pool's task-length timeouts.
+        self._control = RpcClient(address, timeout_s=5.0,
+                                  connect_timeout_s=2.0)
+        self._digest_lock = threading.Lock()
+        self.known_digests: set[str] = set()
+
+    def ping(self) -> bool:
+        try:
+            return self._control.call("ping") == "pong"
+        except (RpcError, OSError):
+            return False
+
+    def execute(self, digest: str, func_blob: bytes, args_blob: bytes,
+                n_returns: int, return_keys: list[bytes],
+                runtime_env: dict | None,
+                resources: dict[str, float]) -> list:
+        """Lease + push + reply. Ships the function blob only the first
+        time this node sees its digest."""
+        with self._digest_lock:
+            known = digest in self.known_digests
+        reply = self.pool.call(
+            "execute_task", digest, None if known else func_blob,
+            args_blob, n_returns, return_keys, runtime_env, resources)
+        if reply[0] == "need_func":
+            # Node restarted / cache miss despite our bookkeeping.
+            reply = self.pool.call(
+                "execute_task", digest, func_blob, args_blob, n_returns,
+                return_keys, runtime_env, resources)
+        with self._digest_lock:
+            self.known_digests.add(digest)
+        if reply[0] == "err":
+            exc, tb = serialization.deserialize_from_buffer(
+                memoryview(reply[1]))
+            exc.__ray_tpu_remote_tb__ = tb
+            raise exc
+        return reply[1]
+
+    def fetch(self, id_bytes: bytes) -> bytes:
+        client = self.pool.acquire()
+        try:
+            blob = fetch_blob(client, id_bytes)
+        except BaseException:
+            client.close()
+            raise
+        self.pool.release(client)
+        return blob
+
+    def free(self, ids: list[bytes]) -> None:
+        self._control.call("free_objects", ids)
+
+    def close(self) -> None:
+        self._control.close()
+        self.pool.close()

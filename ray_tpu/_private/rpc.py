@@ -174,17 +174,25 @@ class RpcServer:
 class RpcClient:
     """One connection; calls are serialized (seq-matched replies)."""
 
-    def __init__(self, address: str, timeout_s: float = 30.0):
+    def __init__(self, address: str, timeout_s: float = 30.0,
+                 connect_timeout_s: float | None = None):
         host, _, port = address.rpartition(":")
         self._addr = (host or "127.0.0.1", int(port))
         self.address = f"{self._addr[0]}:{self._addr[1]}"
         self._timeout = timeout_s
+        # Long read timeouts (blocking task executions) must not make
+        # CONNECTING to a dead host block equally long.
+        self._connect_timeout = (connect_timeout_s
+                                 if connect_timeout_s is not None
+                                 else min(timeout_s, 10.0))
         self._lock = threading.Lock()
         self._sock: socket.socket | None = None
         self._seq = 0
 
     def _connect(self) -> socket.socket:
-        sock = socket.create_connection(self._addr, timeout=self._timeout)
+        sock = socket.create_connection(
+            self._addr, timeout=self._connect_timeout)
+        sock.settimeout(self._timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return sock
 

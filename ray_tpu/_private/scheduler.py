@@ -91,6 +91,19 @@ class ClusterState:
             if node is not None:
                 node.alive = False
 
+    def revive_node(self, node_id: NodeID) -> bool:
+        """Bring a transiently-removed node back WITHOUT resetting its
+        ledger: in-flight tasks still hold acquired resources, and a
+        fresh NodeState would let their releases oversubscribe the node.
+        Returns False when the node was never known (add it instead)."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                return False
+            node.alive = True
+            self._lock.notify_all()
+            return True
+
     def nodes(self) -> list[NodeState]:
         with self._lock:
             return [n for n in self._nodes.values() if n.alive]

@@ -252,6 +252,18 @@ class Runtime:
         # RLock: _forget_object can re-enter from ObjectRef.__del__ (GC
         # may fire while _record_location holds this lock).
         self._locations_lock = threading.RLock()
+        # Remote execution plane state (threads start at the end of
+        # __init__, but callbacks may touch these during construction).
+        self._remote_nodes: dict[NodeID, Any] = {}
+        self._remote_nodes_lock = threading.Lock()
+        self._remote_ever: set[NodeID] = set()
+        self._remote_free_queue: list[tuple[NodeID, bytes]] = []
+        self._remote_free_lock = threading.Lock()
+        self._watcher_stop = threading.Event()
+        self._node_watcher = None
+        self._export_store = None
+        self._obj_server = None
+        self._export_addr = ""
         # Refcount-zero eviction must also drop directory + lineage
         # entries, or they leak for the runtime's lifetime.
         self.reference_counter.on_evict = self._forget_object
@@ -290,6 +302,187 @@ class Runtime:
             head_resources.update({k: float(v) for k, v in resources.items()})
         self.head_node_id = self.add_node(head_resources, labels={"node_type": "head"})
         self.gcs.register_job(JobRecord(self.job_id))
+
+        # Connected-cluster execution plane: mirror the GCS node table
+        # into ClusterState so pick_node can choose worker daemons, and
+        # dispatch to them over RPC (reference: the two-level scheduler —
+        # cluster view + remote raylet lease, cluster_task_manager.h:42).
+        if self.gcs_client is not None:
+            # Driver-side object export server: driver-held task args
+            # above the inline threshold are served from here so each
+            # node pulls (and caches) them ONCE instead of the driver
+            # re-shipping the bytes with every task (reference: plasma +
+            # object manager — args are objects nodes fetch, not
+            # payloads inlined per task).
+            from ray_tpu._private.node import _own_address
+            from ray_tpu._private.node_executor import NodeObjectStore
+            from ray_tpu._private.rpc import RpcServer
+
+            self._export_store = NodeObjectStore()
+            self._obj_server = RpcServer(host="0.0.0.0", port=0)
+            self._obj_server.register("ping", lambda: "pong")
+            self._obj_server.register(
+                "fetch_object", self._export_store.read_chunk)
+            self._obj_server.start()
+            self._export_addr = \
+                f"{_own_address()}:{self._obj_server.port}"
+            self._node_watcher = threading.Thread(
+                target=self._watch_remote_nodes, daemon=True,
+                name="ray_tpu-node-watcher")
+            self._node_watcher.start()
+
+    # ------------------------------------------------------ remote exec plane
+
+    def _watch_remote_nodes(self) -> None:
+        """Poll the head GCS node table; add/remove remote executor
+        nodes in ClusterState and flush queued object frees."""
+        from ray_tpu._private.rpc import RpcError
+
+        while not self._watcher_stop.wait(0.5):
+            try:
+                nodes = self.gcs_client.call("list_nodes")
+            except (RpcError, OSError, AttributeError):
+                continue
+            try:
+                self._sync_remote_nodes(nodes)
+                self._flush_remote_frees()
+            except Exception:  # noqa: BLE001 — watcher must survive
+                logger.exception("remote node sync failed")
+
+    def _sync_remote_nodes(self, nodes: list[dict]) -> None:
+        from ray_tpu._private.node_executor import RemoteNodeHandle
+
+        listed: dict[NodeID, dict] = {}
+        for info in nodes:
+            if info.get("executor_address"):
+                listed[NodeID(bytes.fromhex(info["node_id"]))] = info
+
+        # Reconcile disappearances: a node gone from the table entirely
+        # (head restart pruned it) or now dead must be dropped, and a
+        # daemon that re-registered under a fresh id must not leave its
+        # old id double-counting capacity (same executor_address).
+        with self._remote_nodes_lock:
+            known = dict(self._remote_nodes)
+        alive_addrs = {info["executor_address"] for nid, info
+                       in listed.items() if info["alive"]}
+        for node_id, handle in known.items():
+            info = listed.get(node_id)
+            stale = (info is None or not info["alive"]
+                     or info["executor_address"] != handle.address)
+            superseded = (info is None
+                          and handle.address in alive_addrs)
+            if stale or superseded:
+                self._drop_remote_node(node_id)
+
+        for node_id, info in listed.items():
+            if not info["alive"]:
+                continue
+            with self._remote_nodes_lock:
+                if node_id in self._remote_nodes:
+                    continue
+            handle = RemoteNodeHandle(node_id, info["executor_address"])
+            if not handle.ping():
+                handle.close()
+                continue
+            with self._remote_nodes_lock:
+                self._remote_nodes[node_id] = handle
+                self._remote_ever.add(node_id)
+            # Re-join after a transient drop keeps the old ledger (in-
+            # flight task releases must balance); only genuinely new
+            # nodes get a fresh NodeState.
+            if not self.cluster.revive_node(node_id):
+                self.cluster.add_node(NodeState(
+                    node_id=node_id,
+                    total=dict(info["resources"]),
+                    available=dict(info["resources"]),
+                    labels={**info.get("labels", {}), "remote": "1"},
+                ))
+            logger.info("remote node %s (%s) joined with %s",
+                        info["node_id"][:8], info["executor_address"],
+                        info["resources"])
+
+    def _drop_remote_node(self, node_id: NodeID) -> None:
+        with self._remote_nodes_lock:
+            handle = self._remote_nodes.pop(node_id, None)
+        if handle is None:
+            return
+        handle.close()
+        self._on_node_dead(node_id)
+
+    def _flush_remote_frees(self) -> None:
+        with self._remote_free_lock:
+            queued, self._remote_free_queue = self._remote_free_queue, []
+        if not queued:
+            return
+        by_node: dict[NodeID, list[bytes]] = {}
+        for node_id, id_bytes in queued:
+            by_node.setdefault(node_id, []).append(id_bytes)
+        retained: list[tuple[NodeID, bytes]] = []
+        for node_id, ids in by_node.items():
+            with self._remote_nodes_lock:
+                handle = self._remote_nodes.get(node_id)
+            if handle is None:
+                # Node transiently absent: keep the frees for its
+                # return (its store only drops results on owner free).
+                retained.extend((node_id, i) for i in ids)
+                continue
+            try:
+                handle.free(ids)
+            except Exception:  # noqa: BLE001 — best-effort, retry later
+                retained.extend((node_id, i) for i in ids)
+        if retained:
+            with self._remote_free_lock:
+                self._remote_free_queue.extend(retained)
+                # Bounded: drop the oldest if a node never comes back.
+                if len(self._remote_free_queue) > 100_000:
+                    del self._remote_free_queue[:-50_000]
+
+    def _materialize_value(self, object_id: ObjectID, value: Any) -> Any:
+        """Resolve a RemoteBlob placeholder by chunked pull from the
+        holding node; on failure fall back to lineage reconstruction
+        (reference: pull via object directory, recovery via
+        object_recovery_manager.h:41)."""
+        from ray_tpu._private.node_executor import RemoteBlob, fetch_blob
+        from ray_tpu._private import serialization
+
+        if not isinstance(value, RemoteBlob):
+            return value
+        node_id = NodeID(bytes.fromhex(value.node_hex))
+        with self._remote_nodes_lock:
+            handle = self._remote_nodes.get(node_id)
+        try:
+            if handle is not None:
+                blob = handle.fetch(object_id.binary())
+            else:
+                from ray_tpu._private.rpc import RpcClient
+
+                client = RpcClient(value.addr)
+                try:
+                    blob = fetch_blob(client, object_id.binary())
+                finally:
+                    client.close()
+            real = serialization.deserialize_from_buffer(memoryview(blob))
+        except Exception as exc:  # noqa: BLE001 — node gone: try lineage
+            from ray_tpu.exceptions import ObjectLostError
+
+            if not self.store.mark_lost(object_id):
+                raise
+            recovered = False
+            try:
+                recovered = self.recovery.recover(object_id)
+            except Exception:  # noqa: BLE001
+                pass
+            if recovered:
+                return self._materialize_value(
+                    object_id, self.store.get(object_id))
+            err = ObjectLostError(
+                ObjectRef(object_id, _register=False),
+                f"object {object_id.hex()} was on unreachable node "
+                f"{value.node_hex[:8]} and has no lineage: {exc}")
+            self.store.put_error(object_id, err)
+            raise err from exc
+        self.store.put(object_id, real)  # reseal with the local copy
+        return real
 
     # -------------------------------------------------------------- cluster
 
@@ -434,8 +627,15 @@ class Runtime:
             node_id=node.node_id if node else None, actor_id=None)
         block_ctx = BlockedResourceContext(
             self.cluster, node.node_id, spec.resources) if (node and acquired) else None
+        remote_handle = None
+        if node is not None:
+            with self._remote_nodes_lock:
+                remote_handle = self._remote_nodes.get(node.node_id)
         try:
-            if self.worker_pool is not None:
+            if remote_handle is not None:
+                ran_on_pool = self._try_execute_remote(
+                    spec, node, remote_handle)
+            elif self.worker_pool is not None:
                 ran_on_pool = self._try_execute_on_pool(spec, node)
             else:
                 ran_on_pool = False
@@ -524,6 +724,80 @@ class Runtime:
                 self._record_location(rid, node.node_id)
         return True
 
+    def _try_execute_remote(self, spec: TaskSpec, node: NodeState,
+                            handle) -> bool:
+        """Dispatch to a worker-node daemon's executor (reference: lease
+        request to a remote raylet + push to its worker pool,
+        node_manager.cc:1714). Args already held on remote nodes ship as
+        FetchRef location hints — the consuming node pulls peer-to-peer
+        and the driver never relays the bytes. Returns False when the
+        function/args can't cross a process boundary (caller runs the
+        task locally in-thread)."""
+        from ray_tpu._private import serialization
+        from ray_tpu._private.node_executor import FetchRef, RemoteBlob
+        from ray_tpu._private.rpc import RpcError
+        from ray_tpu.exceptions import WorkerCrashedError
+
+        from ray_tpu._private.node_executor import INLINE_REPLY_BYTES
+        from ray_tpu._private.object_store import _sizeof
+
+        def convert(a):
+            if not isinstance(a, ObjectRef):
+                return a
+            id_bytes = a.id().binary()
+            if self._export_store is not None \
+                    and self._export_store.get(id_bytes) is not None:
+                return FetchRef(id_bytes, self._export_addr)
+            value = self.store.get(a.id())  # deps sealed at dispatch
+            if isinstance(value, RemoteBlob):
+                return FetchRef(id_bytes, value.addr)
+            if self._export_store is not None \
+                    and _sizeof(value) > INLINE_REPLY_BYTES:
+                # Export once; every node pulls + caches it by id
+                # instead of the driver re-shipping per task.
+                blob = serialization.serialize_framed(value)
+                self._export_store.put(id_bytes, blob)
+                return FetchRef(id_bytes, self._export_addr)
+            return value
+
+        try:
+            digest, func_blob = self._function_blob(spec.func)
+            args = tuple(convert(a) for a in spec.args)
+            kwargs = {k: convert(v) for k, v in spec.kwargs.items()}
+            args_blob = serialization.serialize_framed((args, kwargs))
+        except Exception:  # noqa: BLE001 — unpicklable: run locally
+            return False
+        return_keys = [rid.binary() for rid in spec.return_ids]
+        try:
+            results = handle.execute(
+                digest, func_blob, args_blob, spec.num_returns,
+                return_keys, spec.runtime_env, spec.resources)
+        except (RpcError, OSError) as exc:
+            # Distinguish a dead node from a transient call failure: a
+            # drop marks every object on the node lost and fires
+            # lineage recovery — far too heavy for one reset socket.
+            if not handle.ping():
+                self._drop_remote_node(node.node_id)
+            err = WorkerCrashedError(
+                f"node {node.node_id.hex()[:8]} unreachable during "
+                f"task {spec.name}: {exc}")
+            raise err from exc
+        for rid, packed in zip(spec.return_ids, results):
+            if packed[0] == "inline":
+                self.store.put(rid, serialization.deserialize_from_buffer(
+                    memoryview(packed[1])))
+            elif packed[0] == "stored":
+                # Result stays on the producing node; pull lazily.
+                self.store.put(rid, RemoteBlob(
+                    node.node_id.hex(), handle.address, packed[1]))
+                self._record_location(rid, node.node_id)
+            else:  # ("err", blob): this return value failed to pickle
+                exc, tb = serialization.deserialize_from_buffer(
+                    memoryview(packed[1]))
+                exc.__ray_tpu_remote_tb__ = tb
+                raise exc
+        return True
+
     def lookup_block_context(self, token: str):
         """Block context of an in-flight pool task (client server calls
         this when a nested get carries the task's token)."""
@@ -545,7 +819,20 @@ class Runtime:
 
     def _forget_object(self, object_id: ObjectID) -> None:
         with self._locations_lock:
-            self._object_locations.pop(object_id, None)
+            node_id = self._object_locations.pop(object_id, None)
+        if self._export_store is not None:
+            self._export_store.free([object_id.binary()])
+        if node_id is not None:
+            # Remote primary copy: tell the holder to drop it (owner-
+            # driven GC — batched by the node watcher). Queue even when
+            # the handle is transiently gone: the flush retains entries
+            # until the node returns, else the blob leaks in its store.
+            with self._remote_nodes_lock:
+                ever_remote = node_id in self._remote_ever
+            if ever_remote:
+                with self._remote_free_lock:
+                    self._remote_free_queue.append(
+                        (node_id, object_id.binary()))
         self.lineage.forget([object_id])
 
     def _function_blob(self, func) -> tuple[str, bytes]:
@@ -586,7 +873,8 @@ class Runtime:
             desc = self.shm_directory.lookup(ref.id())
             if desc is not None:
                 return desc
-            value = self.store.get(ref.id())  # deps sealed at dispatch
+            value = self._materialize_value(
+                ref.id(), self.store.get(ref.id()))  # deps sealed at dispatch
             header, buffers = serialization.serialize(value)
             size = serialization.framed_size(header, buffers)
             if (self.arena is not None and size <= int(
@@ -897,12 +1185,14 @@ class Runtime:
                     f"get() expects ObjectRef (or list of them), got {type(ref)}")
             remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
             if self.store.contains(ref.id()):
-                results.append(self.store.get(ref.id()))
+                results.append(self._materialize_value(
+                    ref.id(), self.store.get(ref.id())))
                 continue
             if block_ctx is not None:
                 block_ctx.block()
             try:
-                results.append(self.store.get(ref.id(), timeout=remaining))
+                results.append(self._materialize_value(
+                    ref.id(), self.store.get(ref.id(), timeout=remaining)))
             finally:
                 if block_ctx is not None:
                     block_ctx.unblock()
@@ -968,7 +1258,8 @@ class Runtime:
 
     def _resolve_one_future(self, object_id: ObjectID, fut) -> None:
         try:
-            value = self.store.get(object_id, timeout=0)
+            value = self._materialize_value(
+                object_id, self.store.get(object_id, timeout=0))
             fut.set_result(value)
         except BaseException as exc:  # noqa: BLE001
             try:
@@ -985,6 +1276,15 @@ class Runtime:
         return self.cluster.available_resources()
 
     def shutdown(self) -> None:
+        self._watcher_stop.set()
+        with self._remote_nodes_lock:
+            handles = list(self._remote_nodes.values())
+            self._remote_nodes.clear()
+        for handle in handles:
+            handle.close()
+        if self._obj_server is not None:
+            self._obj_server.stop()
+            self._obj_server = None
         if self._node_agent is not None:
             self._node_agent.stop()
             self._node_agent = None

@@ -1,0 +1,196 @@
+"""Distributed execution plane: worker daemons execute tasks, objects
+move node-to-node without the driver relaying bytes.
+
+Reference test intent: python/ray/tests with ray_start_cluster — real
+multi-daemon scheduling on one box (cluster_utils.Cluster pattern), plus
+object-manager transfer tests (test_object_manager.py).
+"""
+
+import json
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private.gcs_server import GcsServer
+from ray_tpu._private.rpc import RpcClient
+
+
+def _spawn_worker_daemon(gcs_address: str, cpus: float):
+    return subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.node", "worker",
+         json.dumps({"gcs_address": gcs_address,
+                     "resources": {"CPU": cpus},
+                     "pool_size": 2})],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+
+@pytest.fixture
+def two_node_cluster():
+    """Head GCS in-process + 2 worker daemons as real OS processes +
+    a connected driver with zero local CPU (all CPU work must go
+    remote)."""
+    ray_tpu.shutdown()
+    gcs = GcsServer(host="127.0.0.1", port=0,
+                    log_dir="/tmp/ray_tpu_test_dist")
+    gcs.start()
+    daemons = [_spawn_worker_daemon(gcs.address, 2.0) for _ in range(2)]
+    try:
+        # Wait for both daemons to register with executor addresses.
+        client = RpcClient(gcs.address)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            nodes = [n for n in client.call("list_nodes")
+                     if n["alive"] and n["executor_address"]]
+            if len(nodes) >= 2:
+                break
+            time.sleep(0.2)
+        assert len(nodes) >= 2, "worker daemons never registered"
+        client.close()
+
+        runtime = ray_tpu.init(num_cpus=0, address=gcs.address)
+        # Wait for the driver's watcher to mirror the remote nodes.
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if ray_tpu.cluster_resources().get("CPU", 0) >= 4:
+                break
+            time.sleep(0.2)
+        assert ray_tpu.cluster_resources().get("CPU", 0) >= 4, \
+            "remote nodes never joined the driver's cluster view"
+        yield runtime
+    finally:
+        ray_tpu.shutdown()
+        for proc in daemons:
+            proc.terminate()
+        for proc in daemons:
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        gcs.stop()
+
+
+def _remote_node_ids(runtime):
+    with runtime._remote_nodes_lock:
+        return list(runtime._remote_nodes)
+
+
+def test_fanout_executes_on_multiple_daemons(two_node_cluster):
+    """VERDICT r2 #1 acceptance: a 50-task fan-out runs on >=2 distinct
+    daemon processes (the driver has 0 CPU, so nothing runs locally)."""
+
+    @ray_tpu.remote(scheduling_strategy="SPREAD")
+    def where():
+        import os
+
+        return os.environ.get("RAY_TPU_NODE_TAG"), os.getpid()
+
+    results = ray_tpu.get([where.remote() for _ in range(50)], timeout=120)
+    tags = {tag for tag, _ in results}
+    pids = {pid for _, pid in results}
+    assert None not in tags, "a task ran outside a worker daemon"
+    assert len(tags) >= 2, f"tasks only reached daemons {tags}"
+    assert len(pids) >= 2
+
+
+def test_task_chain_across_nodes_driver_never_relays(two_node_cluster):
+    """VERDICT r2 #2 acceptance: f.remote(g.remote()) where g runs on
+    node A and f on node B — B pulls g's (large) result from A directly
+    and the driver's copy stays an unmaterialized placeholder."""
+    from ray_tpu._private.node_executor import RemoteBlob
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    runtime = two_node_cluster
+    node_a, node_b = _remote_node_ids(runtime)[:2]
+
+    @ray_tpu.remote
+    def produce():
+        return np.arange(500_000, dtype=np.float64)  # ~4MB >> inline max
+
+    @ray_tpu.remote
+    def consume(arr):
+        return float(arr.sum())
+
+    g_ref = produce.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=node_a.hex(), soft=False)).remote()
+    f_ref = consume.options(
+        scheduling_strategy=NodeAffinitySchedulingStrategy(
+            node_id=node_b.hex(), soft=False)).remote(g_ref)
+    expected = float(np.arange(500_000, dtype=np.float64).sum())
+    assert ray_tpu.get(f_ref, timeout=120) == expected
+
+    # The intermediate stayed remote: the driver's store still holds
+    # the placeholder, proving it never relayed/materialized the bytes.
+    entry_value = runtime.store._entries[g_ref.id()].value
+    assert isinstance(entry_value, RemoteBlob), entry_value
+
+    # Sanity: the driver CAN materialize it on demand.
+    arr = ray_tpu.get(g_ref)
+    assert float(arr.sum()) == expected
+
+
+def test_remote_task_error_propagates(two_node_cluster):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("remote-boom")
+
+    from ray_tpu.exceptions import TaskError
+
+    with pytest.raises(TaskError) as exc_info:
+        ray_tpu.get(boom.remote(), timeout=60)
+    assert "remote-boom" in str(exc_info.value)
+
+
+def test_daemon_death_retries_on_survivor(two_node_cluster):
+    """Kill one daemon mid-workload: tasks with retries land on the
+    survivor (system-failure retry, reference: worker-death retries)."""
+    runtime = two_node_cluster
+
+    @ray_tpu.remote(max_retries=3, scheduling_strategy="SPREAD")
+    def slowish(i):
+        import os
+        import time as _t
+
+        _t.sleep(0.3)
+        return i, os.environ.get("RAY_TPU_NODE_TAG")
+
+    refs = [slowish.remote(i) for i in range(12)]
+    time.sleep(0.4)
+    # Kill one daemon process abruptly (find it via the runtime table).
+    node_id = _remote_node_ids(runtime)[0]
+    with runtime._remote_nodes_lock:
+        handle = runtime._remote_nodes[node_id]
+    victim_pid = handle.pool.call("exec_ping")
+    import os as _os
+    import signal as _signal
+
+    _os.kill(victim_pid, _signal.SIGKILL)
+    results = ray_tpu.get(refs, timeout=120)
+    assert sorted(i for i, _ in results) == list(range(12))
+
+
+def test_large_driver_arg_exported_and_cached(two_node_cluster):
+    """A large driver-held arg ships to each node ONCE via the driver's
+    export server (FetchRef), then is served from the node's cache —
+    not re-inlined into every task's payload."""
+    runtime = two_node_cluster
+    big = ray_tpu.put(np.arange(300_000, dtype=np.float64))  # ~2.4MB
+
+    @ray_tpu.remote(scheduling_strategy="SPREAD")
+    def use(arr, i):
+        return float(arr[i])
+
+    out = ray_tpu.get([use.remote(big, i) for i in range(10)], timeout=120)
+    assert out == [float(i) for i in range(10)]
+    # The driver exported the blob exactly once...
+    stats = runtime._export_store.stats()
+    assert stats["num_blobs"] == 1
+    # ...and served at most one pull per node (chunked pulls may take a
+    # few fetch RPCs each, but far fewer than 10 tasks' worth).
+    assert stats["fetches_served"] <= 2 * 2  # 2 nodes x <=2 chunks
