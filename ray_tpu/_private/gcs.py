@@ -29,6 +29,18 @@ class KVStore:
     def __init__(self):
         self._lock = threading.Lock()
         self._data: dict[str, dict[bytes, bytes]] = defaultdict(dict)
+        # Monotonic change counter: persistence snapshots only when dirty.
+        self.version = 0
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {ns: dict(kv) for ns, kv in self._data.items()}
+
+    def restore(self, data: dict) -> None:
+        with self._lock:
+            for ns, kv in data.items():
+                self._data[ns].update(kv)
+            self.version += 1
 
     def put(self, key: bytes, value: bytes, namespace: str = "default",
             overwrite: bool = True) -> bool:
@@ -37,6 +49,7 @@ class KVStore:
             if not overwrite and key in ns:
                 return False
             ns[key] = value
+            self.version += 1
             return True
 
     def get(self, key: bytes, namespace: str = "default") -> bytes | None:
@@ -45,7 +58,10 @@ class KVStore:
 
     def delete(self, key: bytes, namespace: str = "default") -> bool:
         with self._lock:
-            return self._data[namespace].pop(key, None) is not None
+            existed = self._data[namespace].pop(key, None) is not None
+            if existed:
+                self.version += 1
+            return existed
 
     def exists(self, key: bytes, namespace: str = "default") -> bool:
         with self._lock:

@@ -173,10 +173,19 @@ class GcsServer:
 
     def __init__(self, host: str = "0.0.0.0", port: int = 0,
                  log_dir: str = "/tmp/ray_tpu/session",
-                 heartbeat_timeout_s: float = 10.0):
+                 heartbeat_timeout_s: float = 10.0,
+                 persist_path: str | None = None):
         self.gcs = GlobalControlService()
         self.jobs = JobManager(self.gcs, os.path.join(log_dir, "jobs"))
         self.heartbeat_timeout_s = heartbeat_timeout_s
+        # Fault tolerance: KV (incl. the cluster actor directory) + job
+        # table snapshot to disk, restored on restart (reference:
+        # store_client/redis_store_client.h:33 — redis-backed GCS FT;
+        # here a file-backed snapshot, same recovery semantics).
+        self._persist_path = persist_path
+        self._persisted_version = -1
+        if persist_path:
+            self._restore_snapshot()
         self._server = RpcServer(host, port)
         self._shutdown = threading.Event()
         self._register_methods()
@@ -257,15 +266,68 @@ class GcsServer:
 
     def _monitor_loop(self) -> None:
         """Mark nodes dead when heartbeats go stale (reference:
-        gcs_health_check_manager.h:39)."""
+        gcs_health_check_manager.h:39); snapshot persistent state when
+        dirty."""
         while not self._shutdown.wait(1.0):
             now = time.monotonic()
             for record in self.gcs.list_nodes():
                 if record.alive and (now - record.last_heartbeat
                                      > self.heartbeat_timeout_s):
                     self.gcs.mark_node_dead(record.node_id)
+            if self._persist_path:
+                self._save_snapshot()
+
+    # -- persistence --------------------------------------------------
+    def _save_snapshot(self) -> None:
+        import pickle
+
+        version = (self.gcs.kv.version,
+                   tuple(sorted((r.submission_id, r.status)
+                                for r in self.gcs.list_jobs())))
+        if version == self._persisted_version:
+            return
+        state = {
+            "kv": self.gcs.kv.snapshot(),
+            "jobs": [{
+                "job_id": r.job_id.binary(), "status": r.status,
+                "entrypoint": r.entrypoint, "message": r.message,
+                "submission_id": r.submission_id,
+                "start_time": r.start_time, "end_time": r.end_time,
+            } for r in self.gcs.list_jobs()],
+        }
+        tmp = self._persist_path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                pickle.dump(state, f, protocol=pickle.HIGHEST_PROTOCOL)
+            os.replace(tmp, self._persist_path)  # atomic swap
+            self._persisted_version = version
+        except OSError:
+            pass  # disk hiccup: retry next tick
+
+    def _restore_snapshot(self) -> None:
+        import pickle
+
+        try:
+            with open(self._persist_path, "rb") as f:
+                state = pickle.load(f)
+        except (OSError, EOFError, pickle.UnpicklingError):
+            return
+        self.gcs.kv.restore(state.get("kv", {}))
+        for j in state.get("jobs", []):
+            record = JobRecord(
+                job_id=JobID(j["job_id"]), entrypoint=j["entrypoint"],
+                message=j["message"], submission_id=j["submission_id"],
+                start_time=j["start_time"], end_time=j["end_time"],
+                # Entrypoint processes did not survive the head restart.
+                status="FAILED" if j["status"] == "RUNNING"
+                else j["status"])
+            self.gcs.register_job(record)
 
     def stop(self) -> None:
         self._shutdown.set()
         self.jobs.shutdown()
+        if self._persist_path:
+            # Final snapshot: mutations from the last monitor tick must
+            # survive a clean shutdown.
+            self._save_snapshot()
         self._server.stop()

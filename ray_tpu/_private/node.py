@@ -112,7 +112,9 @@ def run_head(port: int, resources: dict | None = None,
     from ray_tpu.dashboard import Dashboard, gcs_provider
 
     os.makedirs(SESSION_DIR, exist_ok=True)
-    server = GcsServer(port=port, log_dir=SESSION_DIR)
+    snapshot_path = os.path.join(SESSION_DIR, "gcs_snapshot.pkl")
+    server = GcsServer(port=port, log_dir=SESSION_DIR,
+                       persist_path=snapshot_path)
     server.start()
     with open(os.path.join(SESSION_DIR, "head_address"), "w") as f:
         f.write(f"{_own_address()}:{server._server.port}")
@@ -164,6 +166,13 @@ def run_head(port: int, resources: dict | None = None,
         if dashboard is not None:
             dashboard.stop()
         server.stop()
+        # Clean stop = session over: the snapshot exists for CRASH
+        # recovery only. Leaving it would resurrect stale jobs/actors
+        # into the NEXT, unrelated cluster on this machine.
+        try:
+            os.unlink(snapshot_path)
+        except OSError:
+            pass
 
 
 def run_worker(gcs_address: str, resources: dict | None = None,

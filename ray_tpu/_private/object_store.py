@@ -84,7 +84,12 @@ class ObjectStore:
     """Node-local object store: seal/get/wait/free with spill-to-disk."""
 
     def __init__(self, memory_limit_bytes: int, spill_dir: str):
-        self._lock = threading.Condition(threading.Lock())
+        # REENTRANT: any allocation inside a locked section can trigger
+        # GC, which can run ObjectRef.__del__ → remove_ref → evict() on
+        # THIS store from the same thread. A plain lock deadlocks there
+        # (observed: _seal's _sizeof iterating a container whose temp
+        # refs die mid-iteration).
+        self._lock = threading.Condition(threading.RLock())
         self._entries: dict[ObjectID, ObjectEntry] = {}
         self._memory_limit = memory_limit_bytes
         self._memory_used = 0
@@ -109,6 +114,9 @@ class ObjectStore:
         self._seal(object_id, value=None, error=error)
 
     def _seal(self, object_id: ObjectID, value: Any, error: BaseException | None):
+        # Size OUTSIDE the lock: _sizeof walks user containers, which
+        # can run arbitrary __del__s via GC.
+        size_bytes = _sizeof(value) if error is None else 256
         with self._lock:
             entry = self._entries.get(object_id)
             if entry is None:
@@ -131,7 +139,7 @@ class ObjectStore:
             entry.freed = False
             entry.lost = False
             entry.spilled_path = None
-            entry.size_bytes = _sizeof(value) if error is None else 256
+            entry.size_bytes = size_bytes
             self._memory_used += entry.size_bytes
             self._lock.notify_all()
             listeners = list(self._seal_listeners)

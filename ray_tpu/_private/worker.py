@@ -152,6 +152,7 @@ class Runtime:
         self.placement_groups = PlacementGroupManager(self.cluster, self.store)
         self._actors: dict[ActorID, LocalActor] = {}
         self._actor_queues: dict[ActorID, Any] = {}
+        self._foreign_proxies: dict[tuple[str, str], Any] = {}
         self._actor_leases: dict[ActorID, tuple[NodeID, dict, Any]] = {}
         self._futures_lock = threading.Lock()
         self._futures: dict[ObjectID, list[concurrent.futures.Future]] = {}
@@ -200,6 +201,16 @@ class Runtime:
         self.worker_client_server = None
         self._inflight_blocks: dict[str, BlockedResourceContext] = {}
         self._inflight_blocks_lock = threading.Lock()
+        # The client server also fronts this driver's actors for OTHER
+        # drivers in a connected cluster (cluster-wide named actors), so
+        # it exists whenever a pool or a cluster connection does.
+        if (pool_size and pool_size > 0) or self.gcs_client is not None:
+            from ray_tpu.util.client import ClientServer
+
+            host = "0.0.0.0" if self.gcs_client is not None \
+                else "127.0.0.1"
+            self.worker_client_server = ClientServer(
+                host=host, port=0).start()
         if pool_size and pool_size > 0:
             from ray_tpu._private.worker_pool import WorkerPool
 
@@ -221,10 +232,6 @@ class Runtime:
                 from ray_tpu._private.log_monitor import LogMonitor
 
                 self.log_monitor = LogMonitor(log_dir).start()
-            from ray_tpu.util.client import ClientServer
-
-            self.worker_client_server = ClientServer(
-                host="127.0.0.1", port=0).start()
             # Spawned workers inherit this via os.environ.
             os.environ["RAY_TPU_DRIVER_CLIENT_ADDR"] = \
                 f"127.0.0.1:{self.worker_client_server.port}"
@@ -996,6 +1003,12 @@ class Runtime:
             method_meta=method_meta)
         try:
             self.gcs.register_actor(record)
+            # Publish synchronously at registration so an actor is
+            # resolvable from other drivers the moment .remote()
+            # returns (calls queue until it is alive; every failure
+            # path below unpublishes).
+            if name is not None:
+                self._publish_named_actor(record)
         except ValueError:
             # Named-actor registration race: two concurrent get_if_exists
             # creators both passed the existence check; the loser joins
@@ -1038,10 +1051,14 @@ class Runtime:
             except BaseException as exc:  # noqa: BLE001
                 self.store.put_error(creation_rid, exc)
                 self.gcs.update_actor_state(actor_id, "DEAD", repr(exc))
+                if name is not None:
+                    self._unpublish_named_actor(ns, name)
                 return
 
             def on_death(aid, reason):
                 self.gcs.update_actor_state(aid, "DEAD", reason)
+                if name is not None:
+                    self._unpublish_named_actor(ns, name)
                 lease = self._actor_leases.pop(aid, None)
                 if lease is not None:
                     lease_node, lease_resources, lease_pg = lease
@@ -1159,12 +1176,103 @@ class Runtime:
             self.gcs.remove_actor(actor_id)
 
     def get_actor_handle(self, name: str, namespace: str | None = None):
-        from ray_tpu.actor import ActorHandle
+        from ray_tpu.actor import ActorHandle, ForeignActorHandle
 
-        record = self.gcs.get_named_actor(name, namespace or self.namespace)
-        if record is None:
-            raise ValueError(f"Failed to look up actor with name {name!r}")
-        return ActorHandle(record.actor_id, record.class_name)
+        ns = namespace or self.namespace
+        record = self.gcs.get_named_actor(name, ns)
+        if record is not None:
+            return ActorHandle(record.actor_id, record.class_name)
+        # Cluster actor directory: the actor may live in ANOTHER
+        # driver's runtime (reference: named actors resolve through the
+        # GCS actor table, gcs_actor_manager.h).
+        if self.gcs_client is not None:
+            import pickle
+
+            try:
+                blob = self.gcs_client.call(
+                    "kv_get", f"{ns}/{name}".encode(), "named_actors")
+            except Exception:  # noqa: BLE001 — head unreachable
+                blob = None
+            if blob is not None:
+                info = pickle.loads(blob)
+                if info["owner_addr"] == self._client_server_addr():
+                    # Our own published actor (registered under this
+                    # driver): serve it locally.
+                    return ActorHandle(
+                        ActorID(bytes.fromhex(info["actor_key"])),
+                        info["class_name"])
+                return ForeignActorHandle(
+                    info["owner_addr"], info["actor_key"],
+                    info["class_name"],
+                    method_meta=info.get("method_meta", {}))
+        raise ValueError(f"Failed to look up actor with name {name!r}")
+
+    def _client_server_addr(self) -> str:
+        if self.worker_client_server is None:
+            return ""
+        from ray_tpu._private.node import _own_address
+
+        return f"{_own_address()}:{self.worker_client_server.port}"
+
+    def _publish_named_actor(self, record) -> None:
+        """Advertise a named actor in the cluster directory (GCS KV)."""
+        if self.gcs_client is None or self.worker_client_server is None:
+            return
+        import pickle
+
+        entry = pickle.dumps({
+            "actor_key": record.actor_id.hex(),
+            "class_name": record.class_name,
+            "owner_addr": self._client_server_addr(),
+            # Per-method defaults (num_returns) so foreign callers match
+            # local ActorHandle semantics.
+            "method_meta": dict(record.method_meta),
+        })
+        try:
+            self.gcs_client.call(
+                "kv_put", f"{record.namespace}/{record.name}".encode(),
+                entry, "named_actors")
+        except Exception:  # noqa: BLE001 — best-effort advertisement
+            logger.warning("failed to publish named actor %s",
+                           record.name)
+
+    def _unpublish_named_actor(self, namespace: str, name: str) -> None:
+        if self.gcs_client is None:
+            return
+        try:
+            self.gcs_client.call(
+                "kv_del", f"{namespace}/{name}".encode(), "named_actors")
+        except Exception:  # noqa: BLE001 — best-effort cleanup
+            pass
+
+    def submit_foreign_actor_task(self, owner_addr: str, actor_key: str,
+                                  method_name: str, args: tuple,
+                                  kwargs: dict,
+                                  num_returns: int = 1) -> list[ObjectRef]:
+        """Call an actor owned by another driver: ordered per-handle
+        proxy thread drives the owner's client server and seals the
+        results into OUR store as they arrive."""
+        return_ids = [ObjectID() for _ in range(max(1, num_returns))]
+        for rid in return_ids:
+            self.store.create_pending(rid)
+        refs = [ObjectRef(rid) for rid in return_ids]
+        key = (owner_addr, actor_key)
+        with self._futures_lock:
+            proxy = self._foreign_proxies.get(key)
+            if proxy is None:
+                proxy = _ForeignActorProxy(self, owner_addr, actor_key)
+                self._foreign_proxies[key] = proxy
+        proxy.submit(method_name, args, kwargs, return_ids)
+        return refs
+
+    def kill_foreign_actor(self, owner_addr: str, actor_key: str) -> None:
+        from ray_tpu._private.rpc import RpcClient
+
+        client = RpcClient(owner_addr, timeout_s=30.0)
+        try:
+            client.call("client_kill_actor", actor_key)
+        finally:
+            client.close()
 
     # ------------------------------------------------------------ get/put/…
 
@@ -1285,6 +1393,14 @@ class Runtime:
         if self._obj_server is not None:
             self._obj_server.stop()
             self._obj_server = None
+        for proxy in list(self._foreign_proxies.values()):
+            proxy.close()
+        self._foreign_proxies.clear()
+        # Kill actors while the GCS connection is still open: their
+        # on_death hooks unpublish cluster named-actor entries, which
+        # would otherwise go stale forever.
+        for actor in list(self._actors.values()):
+            actor.kill("runtime shutdown", no_restart=True)
         if self._node_agent is not None:
             self._node_agent.stop()
             self._node_agent = None
@@ -1297,8 +1413,6 @@ class Runtime:
         if self.metrics_agent is not None:
             self.metrics_agent.shutdown()
         self.health_monitor.shutdown()
-        for actor in list(self._actors.values()):
-            actor.kill("runtime shutdown", no_restart=True)
         self.dispatcher.shutdown()
         if self.memory_monitor is not None:
             self.memory_monitor.stop()
@@ -1323,6 +1437,94 @@ class Runtime:
             os.environ.pop("RAY_TPU_ARENA_NAME", None)
             self.arena = None
         self.gcs.finish_job(self.job_id)
+
+
+class _ForeignActorProxy:
+    """Ordered call pipe to one foreign actor: a drain thread issues
+    client_actor_call + long-poll gets against the owning driver's
+    client server and seals results into the local store (the foreign
+    analogue of the per-actor submit queue,
+    transport/sequential_actor_submit_queue.h)."""
+
+    def __init__(self, runtime: "Runtime", owner_addr: str,
+                 actor_key: str):
+        import queue as queue_mod
+
+        from ray_tpu._private.rpc import RpcClient
+
+        self._runtime = runtime
+        self._actor_key = actor_key
+        self._owner_addr = owner_addr
+        self._rpc = RpcClient(owner_addr, timeout_s=60.0)
+        self._queue: queue_mod.Queue = queue_mod.Queue()
+        self._thread = threading.Thread(
+            target=self._drain, daemon=True,
+            name=f"ray_tpu-foreign-actor-{actor_key[:8]}")
+        self._thread.start()
+
+    def submit(self, method_name: str, args: tuple, kwargs: dict,
+               return_ids: list[ObjectID]) -> None:
+        self._queue.put((method_name, args, kwargs, return_ids))
+
+    def close(self) -> None:
+        self._queue.put(None)
+        self._rpc.close()
+
+    def _fail(self, return_ids, exc) -> None:
+        for rid in return_ids:
+            self._runtime.store.put_error(rid, exc)
+
+    def _drain(self) -> None:
+        from ray_tpu._private import serialization
+        from ray_tpu._private.rpc import RpcError, RpcMethodError
+
+        while True:
+            item = self._queue.get()
+            if item is None:
+                return
+            method_name, args, kwargs, return_ids = item
+            sealed: set = set()
+            try:
+                # Resolve refs to values locally: the owner cannot
+                # dereference OUR object ids.
+                args, kwargs, _ = resolve_args(
+                    args, kwargs, lambda r: self._runtime.get([r])[0])
+                blob = serialization.serialize_framed((args, kwargs))
+                keys = self._rpc.call(
+                    "client_actor_call", self._actor_key, method_name,
+                    blob, len(return_ids))
+                if len(keys) != len(return_ids):
+                    raise ValueError(
+                        f"{method_name} returned {len(keys)} values but "
+                        f"the handle expected {len(return_ids)} (declare "
+                        f"num_returns via .options or @method)")
+                for key, rid in zip(keys, return_ids):
+                    while True:
+                        status, vblob = self._rpc.call(
+                            "client_get", [key], 10.0)
+                        if status == "ok":
+                            value = serialization.deserialize_from_buffer(
+                                memoryview(vblob))[0]
+                            self._runtime.store.put(rid, value)
+                            sealed.add(rid)
+                            break
+                try:
+                    self._rpc.call("client_release", keys)
+                except (RpcError, RpcMethodError):
+                    pass
+            except RpcMethodError as exc:
+                self._fail([r for r in return_ids if r not in sealed],
+                           exc.cause)
+            except (RpcError, OSError) as exc:
+                # Never clobber results already delivered: only the
+                # still-pending returns become errors.
+                self._fail([r for r in return_ids if r not in sealed],
+                           ActorDiedError(
+                               None, f"owner driver at {self._owner_addr} "
+                               f"unreachable: {exc}"))
+            except BaseException as exc:  # noqa: BLE001
+                self._fail([r for r in return_ids if r not in sealed],
+                           exc)
 
 
 # --------------------------------------------------------------------------
@@ -1461,8 +1663,12 @@ def wait(refs, *, num_returns: int = 1, timeout: float | None = None,
 
 
 def kill(actor_handle, *, no_restart: bool = True) -> None:
-    from ray_tpu.actor import ActorHandle
+    from ray_tpu.actor import ActorHandle, ForeignActorHandle
 
+    if isinstance(actor_handle, ForeignActorHandle):
+        _require_runtime().kill_foreign_actor(
+            actor_handle._owner_addr, actor_handle._actor_key)
+        return
     if not isinstance(actor_handle, ActorHandle):
         raise TypeError("kill() expects an ActorHandle")
     _require_runtime().kill_actor(actor_handle._actor_id, no_restart=no_restart)

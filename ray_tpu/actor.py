@@ -91,6 +91,75 @@ class ActorHandle:
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:12]})"
 
 
+class _ForeignActorMethod:
+    """Bound method of an actor owned by ANOTHER driver; calls route to
+    the owner's client server (reference: cross-driver named actors via
+    the GCS actor table, gcs_actor_manager.h)."""
+
+    def __init__(self, handle: "ForeignActorHandle", method_name: str):
+        self._handle = handle
+        self._method_name = method_name
+        self._num_returns = 1
+
+    def options(self, *, num_returns: int = 1) -> "_ForeignActorMethod":
+        method = _ForeignActorMethod(self._handle, self._method_name)
+        method._num_returns = num_returns
+        return method
+
+    def remote(self, *args, **kwargs):
+        runtime = worker_mod.auto_init()
+        refs = runtime.submit_foreign_actor_task(
+            self._handle._owner_addr, self._handle._actor_key,
+            self._method_name, args, kwargs,
+            num_returns=self._num_returns)
+        if self._num_returns == 1:
+            return refs[0]
+        return refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method '{self._method_name}' cannot be called "
+            "directly; use '.remote()'.")
+
+
+class ForeignActorHandle:
+    """Handle to a named actor living in another driver's runtime,
+    resolved through the cluster actor directory (GCS KV)."""
+
+    def __init__(self, owner_addr: str, actor_key: str,
+                 class_name: str = "Actor",
+                 method_meta: dict | None = None):
+        self._owner_addr = owner_addr
+        self._actor_key = actor_key
+        self._class_name = class_name
+        self._method_meta = dict(method_meta or {})
+
+    def __getattr__(self, name: str) -> _ForeignActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        method = _ForeignActorMethod(self, name)
+        method._num_returns = self._method_meta.get(name, {}).get(
+            "num_returns", 1)
+        return method
+
+    def __reduce__(self):
+        return (ForeignActorHandle,
+                (self._owner_addr, self._actor_key, self._class_name,
+                 self._method_meta))
+
+    def __hash__(self):
+        return hash((self._owner_addr, self._actor_key))
+
+    def __eq__(self, other):
+        return (isinstance(other, ForeignActorHandle)
+                and other._owner_addr == self._owner_addr
+                and other._actor_key == self._actor_key)
+
+    def __repr__(self):
+        return (f"ForeignActorHandle({self._class_name}, "
+                f"{self._actor_key[:12]}@{self._owner_addr})")
+
+
 class ActorClass:
     """A class turned into an actor factory via ``@ray_tpu.remote``."""
 

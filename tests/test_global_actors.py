@@ -1,0 +1,200 @@
+"""Cluster-wide named actors + GCS persistence.
+
+Reference intent: gcs_actor_manager.h (named actors resolve across
+drivers through the GCS actor table) and redis_store_client.h:33
+(GCS state survives a head restart via persistence).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.gcs_server import GcsServer
+from ray_tpu._private.rpc import RpcClient
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+OWNER_SCRIPT = textwrap.dedent("""
+    import sys, time
+    import ray_tpu
+
+    ray_tpu.init(address=sys.argv[1], num_cpus=1)
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def add(self, x):
+            self.n += x
+            return self.n
+
+        def owner_pid(self):
+            import os
+
+            return os.getpid()
+
+    c = Counter.options(name="global_counter").remote()
+    assert ray_tpu.get(c.add.remote(0)) == 0
+    print("READY", flush=True)
+    time.sleep(300)
+""")
+
+
+@pytest.fixture
+def gcs_head():
+    ray_tpu.shutdown()
+    gcs = GcsServer(host="127.0.0.1", port=0,
+                    log_dir="/tmp/ray_tpu_test_gactors")
+    gcs.start()
+    yield gcs
+    ray_tpu.shutdown()
+    gcs.stop()
+
+
+def test_named_actor_visible_across_drivers(gcs_head):
+    """Driver A (separate process) creates a named actor; driver B
+    (this process) resolves it via the GCS directory and calls it —
+    state lives in A."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["RAY_TPU_SKIP_TPU_DETECTION"] = "1"
+    owner = subprocess.Popen(
+        [sys.executable, "-c", OWNER_SCRIPT, gcs_head.address],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True)
+    try:
+        line = owner.stdout.readline()
+        deadline = time.time() + 60
+        while "READY" not in line and time.time() < deadline:
+            assert owner.poll() is None, \
+                f"owner died: {line + owner.stdout.read()}"
+            line = owner.stdout.readline()
+        assert "READY" in line
+
+        ray_tpu.init(address=gcs_head.address, num_cpus=1)
+        handle = ray_tpu.get_actor("global_counter")
+        # Calls execute in driver A's process, so state accumulates
+        # there and the pid proves the locality.
+        assert ray_tpu.get(handle.add.remote(5)) == 5
+        assert ray_tpu.get(handle.add.remote(3)) == 8
+        assert ray_tpu.get(handle.owner_pid.remote()) == owner.pid
+        # The handle survives pickling (passes between processes).
+        import pickle
+
+        handle2 = pickle.loads(pickle.dumps(handle))
+        assert ray_tpu.get(handle2.add.remote(2)) == 10
+    finally:
+        owner.terminate()
+        try:
+            owner.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            owner.kill()
+
+
+def test_unknown_named_actor_raises(gcs_head):
+    ray_tpu.init(address=gcs_head.address, num_cpus=1)
+    with pytest.raises(ValueError):
+        ray_tpu.get_actor("does_not_exist_anywhere")
+
+
+def test_gcs_persistence_survives_restart(tmp_path):
+    """KV (incl. the actor directory) and terminal job records survive
+    a head restart; running jobs are marked FAILED (their processes
+    died with the head)."""
+    snap = str(tmp_path / "gcs_snapshot.pkl")
+    gcs = GcsServer(host="127.0.0.1", port=0,
+                    log_dir=str(tmp_path / "s1"), persist_path=snap)
+    gcs.start()
+    client = RpcClient(gcs.address)
+    client.call("kv_put", b"mykey", b"myvalue", "default")
+    client.call("kv_put", b"ns1/actorA", b"entry", "named_actors")
+    sub_id = client.call("submit_job", "true", submission_id="job-echo")
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        status = client.call("job_status", sub_id)
+        if status and status["status"] in ("SUCCEEDED", "FAILED"):
+            break
+        time.sleep(0.1)
+    assert status["status"] == "SUCCEEDED"
+    client.close()
+    gcs.stop()  # takes the final snapshot
+
+    gcs2 = GcsServer(host="127.0.0.1", port=0,
+                     log_dir=str(tmp_path / "s2"), persist_path=snap)
+    gcs2.start()
+    client2 = RpcClient(gcs2.address)
+    try:
+        assert client2.call("kv_get", b"mykey", "default") == b"myvalue"
+        assert client2.call(
+            "kv_get", b"ns1/actorA", "named_actors") == b"entry"
+        status = client2.call("job_status", sub_id)
+        assert status is not None and status["status"] == "SUCCEEDED"
+    finally:
+        client2.close()
+        gcs2.stop()
+
+
+def test_foreign_actor_multi_return_and_stale_cleanup(gcs_head):
+    """@method(num_returns=2) carries over to foreign handles via the
+    directory's method metadata; owner shutdown unpublishes the entry
+    so late resolvers get ValueError, not a dead handle."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["RAY_TPU_SKIP_TPU_DETECTION"] = "1"
+    script = textwrap.dedent("""
+        import sys, time
+        import ray_tpu
+
+        ray_tpu.init(address=sys.argv[1], num_cpus=1)
+
+        @ray_tpu.remote
+        class Pair:
+            @ray_tpu.method(num_returns=2)
+            def split(self, a, b):
+                return a, b
+
+        p = Pair.options(name="pair_actor").remote()
+        ray_tpu.get(p.split.remote(0, 0))
+        print("READY", flush=True)
+        sys.stdin.readline()  # clean shutdown on EOF/newline
+        ray_tpu.shutdown()
+        print("DONE", flush=True)
+    """)
+    owner = subprocess.Popen(
+        [sys.executable, "-c", script, gcs_head.address], env=env,
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        line = owner.stdout.readline()
+        assert "READY" in line, line
+        ray_tpu.init(address=gcs_head.address, num_cpus=1)
+        handle = ray_tpu.get_actor("pair_actor")
+        r1, r2 = handle.split.remote("x", "y")
+        assert ray_tpu.get([r1, r2]) == ["x", "y"]
+        # Clean owner shutdown must unpublish the directory entry.
+        owner.stdin.write("\n")
+        owner.stdin.close()
+        deadline = time.time() + 30
+        while "DONE" not in owner.stdout.readline():
+            assert time.time() < deadline
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                ray_tpu.get_actor("pair_actor2_missing")
+            except ValueError:
+                pass
+            try:
+                ray_tpu.get_actor("pair_actor")
+            except ValueError:
+                break
+            time.sleep(0.2)
+        with pytest.raises(ValueError):
+            ray_tpu.get_actor("pair_actor")
+    finally:
+        owner.terminate()
