@@ -21,13 +21,21 @@ import sys
 import time
 
 os.environ.setdefault("RAY_TPU_SKIP_TPU_DETECTION", "1")
+# CPU-only benchmark by contract (docstring above): without this, the
+# learner jit lands on whatever accelerator jax finds — including a
+# network-tunneled TPU, whose per-update round-trip latency would be
+# measured instead of the framework. The axon plugin registers itself
+# regardless of JAX_PLATFORMS, so drop its trigger too (same as the
+# worker-pool spawner does for rollout processes).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import numpy as np
 
 import ray_tpu
 
 
-def bench_raw_sampling(num_runners: int, num_envs: int = 64,
+def bench_raw_sampling(num_runners: int, num_envs: int = 256,
                        fragment: int = 200, rounds: int = 5) -> dict:
     from ray_tpu.rllib import RLModuleSpec, SingleAgentEnvRunner
 
@@ -67,8 +75,8 @@ def bench_raw_sampling(num_runners: int, num_envs: int = 64,
                        "fragment": fragment}}
 
 
-def bench_impala_e2e(num_runners: int, num_envs: int = 64,
-                     fragment: int = 50, iters: int = 8) -> dict:
+def bench_impala_e2e(num_runners: int, num_envs: int = 256,
+                     fragment: int = 64, iters: int = 8) -> dict:
     from ray_tpu.rllib import IMPALAConfig
 
     config = (IMPALAConfig()

@@ -43,11 +43,15 @@ class ObjectRef:
     # -- lifecycle ----------------------------------------------------------
 
     def __del__(self):
+        # Runs at arbitrary GC points, possibly while this thread holds
+        # runtime locks: the counter defers the real work to a reaper
+        # thread (deque append is lock-free), so __del__ can never
+        # deadlock against the lock its own thread already holds.
         if getattr(self, "_registered", False):
             try:
                 runtime = _try_runtime()
                 if runtime is not None:
-                    runtime.reference_counter.remove_ref(self._id)
+                    runtime.reference_counter.defer_remove(self._id)
             except BaseException:
                 pass
 

@@ -406,15 +406,45 @@ class ReferenceCounter:
     Reference: src/ray/core_worker/reference_count.h:61 — the owner tracks
     local refs plus borrower counts; here all refs are node-local so the
     count is the number of live ObjectRef handles plus task-argument pins.
+
+    GC safety: ObjectRef.__del__ runs at ARBITRARY points — including
+    while this thread already holds one of the runtime's locks — so the
+    destructor path must be lock-free. ``defer_remove`` appends to a
+    deque (GIL-atomic, no lock) and a reaper thread performs the actual
+    remove_ref/evict work.
     """
 
     def __init__(self, store: ObjectStore):
+        import collections
+
         self._lock = threading.Lock()
         self._counts: dict[ObjectID, int] = {}
         self._store = store
         # Optional hook fired after refcount-zero eviction (the runtime
         # drops its directory/lineage entries there).
         self.on_evict: Callable[[ObjectID], None] | None = None
+        self._deferred: "collections.deque[ObjectID]" = collections.deque()
+        self._reaper = threading.Thread(
+            target=self._reap_loop, daemon=True, name="ray_tpu-ref-reaper")
+        self._reaper.start()
+
+    def defer_remove(self, object_id: ObjectID) -> None:
+        """Destructor entry point: ONLY a deque append (GIL-atomic).
+        Even Event.set() takes a lock and could deadlock a nested GC
+        __del__ — the reaper polls instead of being signalled."""
+        self._deferred.append(object_id)
+
+    def _reap_loop(self) -> None:
+        while True:
+            try:
+                object_id = self._deferred.popleft()
+            except IndexError:
+                time.sleep(0.02)
+                continue
+            try:
+                self.remove_ref(object_id)
+            except Exception:  # noqa: BLE001 — reaper must survive
+                pass
 
     def add_ref(self, object_id: ObjectID) -> None:
         with self._lock:

@@ -67,16 +67,42 @@ def get_worker_runtime() -> "WorkerModeRuntime":
 
 class _ProxyReferenceCounter:
     """Ref lifetimes in the worker release the driver-side pin on zero
-    (the borrower half of the ownership protocol)."""
+    (the borrower half of the ownership protocol).
+
+    __del__ safety: destructor entry (defer_remove) is a lock-free deque
+    append; a reaper thread does the counting and the release RPC (an
+    RPC inside GC could deadlock on the rpc client's own lock)."""
 
     def __init__(self, runtime: "WorkerModeRuntime"):
+        import collections
+
         self._runtime = runtime
         self._lock = threading.Lock()
         self._counts: dict[ObjectID, int] = {}
+        self._deferred: "collections.deque[ObjectID]" = collections.deque()
+        threading.Thread(target=self._reap_loop, daemon=True,
+                         name="ray_tpu-proxy-ref-reaper").start()
 
     def add_ref(self, object_id: ObjectID) -> None:
         with self._lock:
             self._counts[object_id] = self._counts.get(object_id, 0) + 1
+
+    def defer_remove(self, object_id: ObjectID) -> None:
+        # ONLY an append: even Event.set() takes a lock, which a nested
+        # GC __del__ on the same thread could deadlock against.
+        self._deferred.append(object_id)
+
+    def _reap_loop(self) -> None:
+        while True:
+            try:
+                object_id = self._deferred.popleft()
+            except IndexError:
+                time.sleep(0.02)
+                continue
+            try:
+                self.remove_ref(object_id)
+            except Exception:  # noqa: BLE001
+                pass
 
     def remove_ref(self, object_id: ObjectID) -> None:
         with self._lock:

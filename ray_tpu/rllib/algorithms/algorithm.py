@@ -56,6 +56,11 @@ class AlgorithmConfig:
         self.num_envs_per_env_runner = 8
         self.rollout_fragment_length = 64
         self.explore = True
+        # Remote runners default to dedicated OS processes: a thread
+        # fleet shares one GIL and caps rollout throughput at a single
+        # core (reference: env runners are separate worker processes by
+        # construction).
+        self.use_process_runners = True
         # training()
         self.lr = 3e-4
         self.gamma = 0.99
@@ -83,7 +88,9 @@ class AlgorithmConfig:
     def env_runners(self, *, num_env_runners: int | None = None,
                     num_envs_per_env_runner: int | None = None,
                     rollout_fragment_length: int | None = None,
-                    explore: bool | None = None) -> "AlgorithmConfig":
+                    explore: bool | None = None,
+                    use_process_runners: bool | None = None,
+                    ) -> "AlgorithmConfig":
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
         if num_envs_per_env_runner is not None:
@@ -92,6 +99,8 @@ class AlgorithmConfig:
             self.rollout_fragment_length = rollout_fragment_length
         if explore is not None:
             self.explore = explore
+        if use_process_runners is not None:
+            self.use_process_runners = use_process_runners
         return self
 
     def training(self, **kwargs) -> "AlgorithmConfig":
@@ -193,6 +202,8 @@ class Algorithm(Trainable):
                 seed=cfg.seed, worker_index=0, explore=cfg.explore)
             return None
         RemoteRunner = ray_tpu.remote(SingleAgentEnvRunner)
+        if getattr(cfg, "use_process_runners", False):
+            RemoteRunner = RemoteRunner.options(process=True)
 
         def factory(idx: int):
             return RemoteRunner.remote(
@@ -215,9 +226,12 @@ class Algorithm(Trainable):
         else:
             # Put once; every runner resolves the same object (the object
             # store is the broadcast plane, reference impala.py:676+).
+            # Async + backpressured: at most one in-flight push per
+            # runner, resolved pushes consumed (errors mark unhealthy).
             ref = ray_tpu.put(weights)
-            self.env_runner_group.foreach_actor(
-                "set_weights", ref, self._weights_version)
+            self._weight_push_refs = self.env_runner_group.broadcast_async(
+                "set_weights", ref, self._weights_version,
+                pending=getattr(self, "_weight_push_refs", None))
 
     # -- Trainable protocol -------------------------------------------
     def step(self) -> dict:

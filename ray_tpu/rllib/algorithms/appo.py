@@ -98,7 +98,10 @@ class APPOLearner(IMPALALearner):
         return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
                        "entropy": entropy, "kl": kl}
 
-    def update_from_batch(self, batch: SampleBatch) -> dict:
+    def update_from_batch(self, batch: SampleBatch,
+                          sync_metrics: bool = True) -> dict:
+        # The adaptive-KL controller below reads metrics["kl"] on host,
+        # so APPO always syncs regardless of the caller's preference.
         batch = SampleBatch(batch)
         batch["target_params"] = self.target_params
         batch["kl_coeff"] = jnp.asarray(self.kl_coeff, dtype=jnp.float32)

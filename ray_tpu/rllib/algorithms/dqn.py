@@ -134,10 +134,12 @@ class DQNLearner(Learner):
         return loss, {"td_error_mean": jnp.mean(jnp.abs(td_error)),
                       "q_mean": jnp.mean(q_taken)}
 
-    def update_from_batch(self, batch: SampleBatch) -> dict:
+    def update_from_batch(self, batch: SampleBatch,
+                          sync_metrics: bool = True) -> dict:
         batch = SampleBatch(batch)
         batch["target_params"] = self.target_params
-        metrics = super().update_from_batch(batch)
+        metrics = super().update_from_batch(batch,
+                                            sync_metrics=sync_metrics)
         if self._steps % getattr(self.config, "target_update_freq", 200) == 0:
             self.target_params = jax.tree_util.tree_map(
                 jnp.copy, self.params)

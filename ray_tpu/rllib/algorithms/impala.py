@@ -177,8 +177,9 @@ class IMPALA(Algorithm):
                         Columns.TERMINATEDS, Columns.TRUNCATEDS,
                         Columns.ACTION_LOGP)})
                 sb["bootstrap_value"] = batch["bootstrap_value"]
+                # Lazy metrics: no device sync inside the hot loop.
                 metrics = self.learner_group.update_from_batch(
-                    sb, shard=False)
+                    sb, shard=False, sync_metrics=False)
                 trained += T * B
                 self._learner_steps += 1
                 batches_this_step += 1
@@ -186,7 +187,10 @@ class IMPALA(Algorithm):
                     self._sync_weights()
 
         results = self._runner_metrics()
-        results.update(metrics)
+        if metrics:
+            # One device->host sync per training_step, not per update.
+            host = jax.device_get(metrics)
+            results.update({k: float(v) for k, v in host.items()})
         results["num_env_steps_trained"] = trained
         results["num_learner_steps"] = self._learner_steps
         return results

@@ -255,7 +255,8 @@ class SACLearner(Learner):
 
         return jax.jit(update)
 
-    def update_from_batch(self, batch: SampleBatch) -> dict:
+    def update_from_batch(self, batch: SampleBatch,
+                          sync_metrics: bool = True) -> dict:
         if self._sac_update is None:
             self._sac_update = self._build_sac_update()
         self._rng, rng = jax.random.split(self._rng)

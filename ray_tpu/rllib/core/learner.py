@@ -90,11 +90,14 @@ class Learner:
         return jax.jit(update)
 
     def _device_batch(self, batch: SampleBatch) -> dict:
+        if self._mesh is None:
+            # ONE device_put for the whole pytree: per-column transfers
+            # each pay a dispatch (and, on remote devices, a round
+            # trip); a single call batches them.
+            return jax.device_put(dict(batch))
         # tree_map so columns may themselves be pytrees (e.g. DQN ships
         # its target-net params inside the batch to keep the update pure).
         arrays = jax.tree_util.tree_map(jnp.asarray, dict(batch))
-        if self._mesh is None:
-            return arrays
         from jax.sharding import NamedSharding, PartitionSpec as P
         n = self._mesh.size
         axis = self.batch_axis
@@ -113,10 +116,16 @@ class Learner:
                 out[k] = jax.device_put(v, self._replicated)
         return out
 
-    def update_from_batch(self, batch: SampleBatch) -> dict:
+    def update_from_batch(self, batch: SampleBatch,
+                          sync_metrics: bool = True) -> dict:
         """One gradient step on one (already minibatched) batch.
 
-        Reference: Learner._update (learner.py:1247)."""
+        Reference: Learner._update (learner.py:1247).
+
+        ``sync_metrics=False`` returns the metrics as device arrays
+        WITHOUT blocking — high-rate loops (IMPALA) convert once per
+        reporting interval instead of paying a device→host sync per
+        update (per-scalar float() is one round trip each)."""
         if self._update_fn is None:
             self._update_fn = self._build_update()
         self._rng, step_rng = jax.random.split(self._rng)
@@ -124,7 +133,10 @@ class Learner:
         self.params, self.opt_state, metrics = self._update_fn(
             self.params, self.opt_state, dev_batch, step_rng)
         self._steps += 1
-        return {k: float(v) for k, v in metrics.items()}
+        if not sync_metrics:
+            return metrics
+        host = jax.device_get(metrics)  # one transfer for all scalars
+        return {k: float(v) for k, v in host.items()}
 
     # -- gradient fan-in path (actor-based LearnerGroup) --------------
     def compute_gradients(self, batch: SampleBatch) -> tuple:

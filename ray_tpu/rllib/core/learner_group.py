@@ -68,7 +68,8 @@ class LearnerGroup:
 
     # -- update -------------------------------------------------------
     def update_from_batch(self, batch: SampleBatch,
-                          shard: bool = True) -> dict:
+                          shard: bool = True,
+                          sync_metrics: bool = True) -> dict:
         """One gradient step over the full group (reference:
         learner_group.py:210).
 
@@ -76,7 +77,8 @@ class LearnerGroup:
         (IMPALA's async pattern: time-major batches can't be row-split
         without breaking the V-trace scan)."""
         if self._local is not None:
-            return self._local.update_from_batch(batch)
+            return self._local.update_from_batch(
+                batch, sync_metrics=sync_metrics)
         if not shard:
             self._rr = getattr(self, "_rr", -1) + 1
             actor = self._actors[self._rr % self._num_learners]

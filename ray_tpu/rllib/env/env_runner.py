@@ -63,6 +63,15 @@ class SingleAgentEnvRunner:
 
     # -- weights sync ------------------------------------------------
     def set_weights(self, weights, version: int = 0) -> None:
+        # Commit once to the rollout device: host-numpy params would be
+        # re-uploaded on EVERY jitted policy call (T transfers per
+        # fragment instead of one per sync).
+        try:
+            weights = (jax.device_put(weights, self._device)
+                       if self._device is not None
+                       else jax.device_put(weights))
+        except Exception:  # noqa: BLE001 — keep host copy on odd backends
+            pass
         self._weights = weights
         self._weights_version = version
 

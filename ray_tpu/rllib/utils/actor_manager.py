@@ -55,6 +55,34 @@ class FaultTolerantActorManager:
                 self._healthy[i] = False
         return results
 
+    def broadcast_async(self, fn_name: str, *args,
+                        pending: dict | None = None, **kwargs) -> dict:
+        """Backpressured async fan-out (weight broadcasts must not stall
+        the learner; reference: IMPALA pushes weights asynchronously).
+
+        At most ONE in-flight push per actor: an actor whose previous
+        push hasn't resolved is skipped this round (its pending ref is
+        carried over), so a slow runner never accumulates queued pushes
+        each pinning a weights object. Resolved pushes are consumed so
+        failures mark the actor unhealthy. Returns {actor_id: ref}."""
+        pending = dict(pending or {})
+        out: dict[int, Any] = {}
+        for i in self.healthy_actor_ids():
+            prev = pending.get(i)
+            if prev is not None:
+                ready, _ = ray_tpu.wait([prev], num_returns=1, timeout=0)
+                if not ready:
+                    out[i] = prev  # still in flight; skip this round
+                    continue
+                try:
+                    ray_tpu.get(prev)
+                except (ActorError, ActorDiedError, TaskError):
+                    self._healthy[i] = False
+                    continue
+            method = getattr(self._actors[i], fn_name)
+            out[i] = method.remote(*args, **kwargs)
+        return out
+
     # -- async fan-out ------------------------------------------------
     def submit(self, fn_name: str, *args, actor_id: int | None = None,
                **kwargs):

@@ -15,6 +15,7 @@ torn down, re-formed, and restarted from the latest reported checkpoint.
 from __future__ import annotations
 
 import logging
+import os
 import queue
 import threading
 import time
@@ -83,7 +84,12 @@ class DataParallelTrainer(BaseTrainer):
             ray_tpu.init()
         max_failures = self.run_config.failure_config.max_failures
         storage = self.run_config.storage_path or "/tmp/ray_tpu_train"
-        name = self.run_config.name or f"train_{int(time.time())}"
+        # Unique default name: a second-granularity timestamp collides
+        # when two fits start within the same second (their checkpoint
+        # managers then evict each other's checkpoints mid-run).
+        name = self.run_config.name or (
+            f"train_{int(time.time())}_{os.getpid()}_"
+            f"{os.urandom(3).hex()}")
         ckpt_cfg = self.run_config.checkpoint_config
         manager = CheckpointManager(
             f"{storage}/{name}", num_to_keep=ckpt_cfg.num_to_keep)

@@ -24,6 +24,9 @@ class ClientServer:
     def __init__(self, host: str = "0.0.0.0", port: int = 0):
         self._server = RpcServer(host, port)
         self._refs: dict[str, Any] = {}       # ref hex -> ObjectRef
+        # Explicitly released keys: _resolve must reject them even while
+        # the (deferred) refcount reaper hasn't evicted the object yet.
+        self._released: set[str] = set()
         self._actors: dict[str, Any] = {}     # actor hex -> ActorHandle
         self._lock = threading.Lock()
         s = self._server
@@ -62,10 +65,13 @@ class ClientServer:
         key = ref.id().hex()
         with self._lock:
             self._refs[key] = ref
+            self._released.discard(key)
         return key
 
     def _resolve(self, key: str):
         with self._lock:
+            if key in self._released:
+                raise KeyError(f"released client ref {key}")
             ref = self._refs.get(key)
         if ref is not None:
             return ref
@@ -289,6 +295,10 @@ class ClientServer:
             for k in keys:
                 if self._refs.pop(k, None) is not None:
                     n += 1
+                    self._released.add(k)
+            # Tombstones bound memory: keep only the most recent ones.
+            if len(self._released) > 100_000:
+                self._released = set(list(self._released)[-50_000:])
         return n
 
     def cancel(self, key: str) -> bool:
