@@ -201,17 +201,16 @@ class Runtime:
         self.worker_client_server = None
         self._inflight_blocks: dict[str, BlockedResourceContext] = {}
         self._inflight_blocks_lock = threading.Lock()
-        # The client server also fronts this driver's actors for OTHER
-        # drivers in a connected cluster (cluster-wide named actors), so
-        # it exists whenever a pool or a cluster connection does.
-        if (pool_size and pool_size > 0) or self.gcs_client is not None:
-            from ray_tpu.util.client import ClientServer
-
-            host = "0.0.0.0" if self.gcs_client is not None \
-                else "127.0.0.1"
-            self.worker_client_server = ClientServer(
-                host=host, port=0).start()
+        # The client server backs nested submission from worker
+        # processes (pool workers and process actors) and fronts this
+        # driver's actors for other drivers in a connected cluster. It
+        # starts eagerly in connected mode (named-actor publication
+        # needs its address); otherwise lazily at the first process
+        # spawn, so thread-only runtimes pay nothing.
+        if self.gcs_client is not None:
+            self.ensure_client_server()
         if pool_size and pool_size > 0:
+            self.ensure_client_server()
             from ray_tpu._private.worker_pool import WorkerPool
 
             # Worker stdout/stderr -> per-worker files; the log monitor
@@ -232,9 +231,6 @@ class Runtime:
                 from ray_tpu._private.log_monitor import LogMonitor
 
                 self.log_monitor = LogMonitor(log_dir).start()
-            # Spawned workers inherit this via os.environ.
-            os.environ["RAY_TPU_DRIVER_CLIENT_ADDR"] = \
-                f"127.0.0.1:{self.worker_client_server.port}"
             self.worker_pool = WorkerPool(
                 int(pool_size), self.shm_directory, self.shm_client)
             refresh_ms = int(cfg.memory_monitor_refresh_ms or 0)
@@ -805,6 +801,18 @@ class Runtime:
                 raise exc
         return True
 
+    def ensure_client_server(self) -> None:
+        """Start the client server on first need (idempotent)."""
+        if self.worker_client_server is not None:
+            return
+        from ray_tpu.util.client import ClientServer
+
+        host = "0.0.0.0" if self.gcs_client is not None else "127.0.0.1"
+        self.worker_client_server = ClientServer(host=host, port=0).start()
+        # Worker processes spawned after this inherit it via os.environ.
+        os.environ["RAY_TPU_DRIVER_CLIENT_ADDR"] = \
+            f"127.0.0.1:{self.worker_client_server.port}"
+
     def lookup_block_context(self, token: str):
         """Block context of an in-flight pool task (client server calls
         this when a nested get carries the task's token)."""
@@ -1074,6 +1082,9 @@ class Runtime:
             if process:
                 from ray_tpu._private.worker_pool import ProcessActor
 
+                # The actor's process needs the nested-API endpoint in
+                # its inherited env BEFORE it spawns.
+                self.ensure_client_server()
                 actor = ProcessActor(
                     actor_id, cls, args, kwargs, self,
                     max_restarts=max_restarts,

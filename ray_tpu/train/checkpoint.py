@@ -13,8 +13,16 @@ import os
 import pickle
 import shutil
 import tempfile
+import threading
 import time
 from typing import Any
+
+# Orbax's tmp-directory/OCDBT machinery is not safe for CONCURRENT
+# saves from multiple threads of one process (observed: rmtree races in
+# atomicity._create_tmp_directory when two thread-mode gang workers
+# checkpoint simultaneously). Serialize in-process saves; separate
+# processes (real multi-host) are unaffected.
+_ORBAX_SAVE_LOCK = threading.Lock()
 
 
 class Checkpoint:
@@ -56,9 +64,11 @@ class Checkpoint:
             # Real save failures (disk full, bad pytree leaf) must surface,
             # not silently change the on-disk format — only an unavailable
             # orbax triggers the pickle fallback.
-            ckptr = ocp.StandardCheckpointer()
-            ckptr.save(os.path.join(target, "state"), state, force=True)
-            ckptr.wait_until_finished()
+            with _ORBAX_SAVE_LOCK:
+                ckptr = ocp.StandardCheckpointer()
+                ckptr.save(os.path.join(target, "state"), state,
+                           force=True)
+                ckptr.wait_until_finished()
             meta = {"format": "orbax"}
         else:
             import jax

@@ -27,6 +27,13 @@ class ScalingConfig:
     placement_strategy: str = "PACK"
     # TPU topology hints.
     chips_per_worker: int = 0
+    # Each gang member gets a dedicated OS process (one JAX process per
+    # worker — required for a jax.distributed multi-process SPMD mesh;
+    # thread workers share one JAX runtime and cannot form one).
+    use_process_workers: bool = False
+    # Extra env for process workers (e.g. XLA_FLAGS for virtual-device
+    # meshes in tests), applied before the worker's first JAX use.
+    worker_env: dict[str, str] = field(default_factory=dict)
 
     def worker_resources(self) -> dict[str, float]:
         res = dict(self.resources_per_worker)

@@ -112,6 +112,15 @@ def run_with_session(fn, config, state: _SessionState, emit) -> Any:
         emit({"done": True, "result": None, "error": None})
         return None
     except BaseException as exc:  # noqa: BLE001 — surfaced to the driver
+        import traceback
+
+        # The driver only sees the exception object; keep the worker
+        # traceback attached or failures are undebuggable.
+        try:
+            exc.__ray_tpu_remote_tb__ = "".join(traceback.format_exception(
+                type(exc), exc, exc.__traceback__))
+        except Exception:
+            pass
         emit({"done": True, "result": None, "error": exc})
         raise
     finally:

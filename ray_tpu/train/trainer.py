@@ -99,6 +99,7 @@ class DataParallelTrainer(BaseTrainer):
         last_error: BaseException | None = None
         all_history: list = []
         while attempt <= max(0, max_failures):
+            self._before_attempt()
             try:
                 result = self._run_attempt(manager, resume)
             except BaseException as exc:  # noqa: BLE001 — group formation
@@ -117,6 +118,9 @@ class DataParallelTrainer(BaseTrainer):
         final = Result(error=last_error)
         final.checkpoint = manager.latest_checkpoint()
         return final
+
+    def _before_attempt(self) -> None:
+        """Hook run before each (re)start of the worker group."""
 
     def _run_attempt(self, manager: CheckpointManager,
                      resume: Checkpoint | None) -> Result:
@@ -149,13 +153,34 @@ class DataParallelTrainer(BaseTrainer):
         error: BaseException | None = None
         stop_criteria = self.run_config.stop or {}
         timeout_s = self.run_config.report_timeout_s
+        pending_refs = list(refs)
+        deadline = time.monotonic() + timeout_s
         while len(done_ranks) < n and error is None:
             try:
-                msg = results_queue.get(timeout=timeout_s)
+                msg = results_queue.get(timeout=1.0)
             except queue.Empty:
-                error = TimeoutError(
-                    f"no training report within report_timeout_s={timeout_s}")
-                break
+                # Hard worker death (process gangs) surfaces on the run
+                # refs immediately — don't sit out the report timeout
+                # masking the real cause.
+                if pending_refs:
+                    finished, pending_refs = ray_tpu.wait(
+                        pending_refs, num_returns=len(pending_refs),
+                        timeout=0)
+                    for ref in finished:
+                        try:
+                            ray_tpu.get(ref)
+                        except BaseException as exc:  # noqa: BLE001
+                            error = exc
+                            break
+                if error is not None:
+                    break
+                if time.monotonic() > deadline:
+                    error = TimeoutError(
+                        f"no training report within "
+                        f"report_timeout_s={timeout_s}")
+                    break
+                continue
+            deadline = time.monotonic() + timeout_s
             if msg.get("done"):
                 done_ranks.add(msg["rank"])
                 if msg.get("error") is not None:
@@ -208,11 +233,52 @@ class JaxTrainer(DataParallelTrainer):
     """
 
     def __init__(self, train_loop_per_worker: Callable,
-                 jax_distributed_config: dict | None = None, **kwargs):
+                 jax_distributed_config: "dict | str | None" = None,
+                 **kwargs):
+        self._auto_spmd = jax_distributed_config == "auto"
+        if self._auto_spmd:
+            # Multi-process SPMD gang: this driver picks the rendezvous
+            # point; every worker derives process_id from its gang rank
+            # (the analogue of TorchTrainer's automatic
+            # init_process_group rendezvous, torch/config.py:47-91).
+            from ray_tpu.train.config import ScalingConfig as _SC
+
+            scaling = kwargs.get("scaling_config") or _SC()
+            if scaling.num_workers > 1 and not scaling.use_process_workers:
+                raise ValueError(
+                    "jax_distributed_config='auto' with num_workers>1 "
+                    "requires ScalingConfig(use_process_workers=True): "
+                    "thread workers share one process and can never "
+                    "form a multi-process jax.distributed world")
+            jax_distributed_config = {
+                "num_processes": scaling.num_workers,
+            }
+            self._refresh_coordinator(jax_distributed_config)
         self.jax_distributed_config = jax_distributed_config
         super().__init__(
             self._jax_backend_wrap(train_loop_per_worker,
                                    jax_distributed_config), **kwargs)
+
+    @staticmethod
+    def _refresh_coordinator(config: dict) -> None:
+        import socket
+
+        from ray_tpu._private.node import _own_address
+
+        sock = socket.socket()
+        sock.bind(("", 0))
+        port = sock.getsockname()[1]
+        sock.close()
+        config["coordinator_address"] = f"{_own_address()}:{port}"
+
+    def _before_attempt(self) -> None:
+        # Fresh coordinator port per (re)start: the previous gang's
+        # rank-0 process may still be exiting and holding the old port
+        # (shutdown SIGTERMs without waiting), and EADDRINUSE would
+        # burn the retry budget on an infra conflict. The loop wrapper
+        # closes over this dict, so mutating it reaches the workers.
+        if self._auto_spmd:
+            self._refresh_coordinator(self.jax_distributed_config)
 
     @staticmethod
     def _jax_backend_wrap(loop: Callable,

@@ -21,8 +21,54 @@ from ray_tpu.util.scheduling_strategies import PlacementGroupSchedulingStrategy
 
 
 @ray_tpu.remote
+class _TrainChannel:
+    """Driver-side report/stop channel for PROCESS worker gangs: queue
+    objects cannot cross the process boundary, so workers report through
+    this actor (via the nested-submission path) and learn the stop flag
+    from each report's reply (reference: session reports stream back to
+    the driver and carry stop decisions)."""
+
+    def __init__(self):
+        self._msgs: list = []
+        self._stop = False
+
+    def report(self, msg: dict) -> bool:
+        self._msgs.append(msg)
+        return self._stop
+
+    def drain(self) -> list:
+        out, self._msgs = self._msgs, []
+        return out
+
+    def set_stop(self) -> None:
+        self._stop = True
+
+
+class _ChannelReporter:
+    """Worker-side queue/stop shim over the channel actor."""
+
+    class _Flag:
+        def __init__(self):
+            self._set = False
+
+        def is_set(self) -> bool:
+            return self._set
+
+    def __init__(self, channel_handle):
+        self._channel = channel_handle
+        self.stop_flag = self._Flag()
+
+    def put(self, msg: dict) -> None:
+        import ray_tpu
+
+        if ray_tpu.get(self._channel.report.remote(msg)):
+            self.stop_flag._set = True
+
+
+@ray_tpu.remote
 class TrainWorker:
-    """One member of the gang; runs the user loop in its actor thread."""
+    """One member of the gang; runs the user loop in its actor thread
+    (or its own process when the gang is a multi-process SPMD world)."""
 
     def __init__(self, rank: int, world_size: int):
         self.rank = rank
@@ -32,6 +78,12 @@ class TrainWorker:
             stop_event, resume_checkpoint) -> Any:
         from ray_tpu.train.session import run_with_session
 
+        if stop_event is None:
+            # Process-worker gang: results_queue is a channel actor
+            # handle; replies double as the stop signal.
+            reporter = _ChannelReporter(results_queue)
+            results_queue = reporter
+            stop_event = reporter.stop_flag
         state = _SessionState(
             context=TrainContext(world_size=self.world_size,
                                  world_rank=self.rank,
@@ -60,6 +112,8 @@ class WorkerGroup:
         self.scaling = scaling
         self.workers: list = []
         self.pg = None
+        self.channel = None
+        self._pump_stop = threading.Event()
         self._start()
 
     def _start(self):
@@ -72,15 +126,20 @@ class WorkerGroup:
             raise TimeoutError(
                 f"Could not reserve {n} x {resources} for the worker group")
         strategy = PlacementGroupSchedulingStrategy(placement_group=self.pg)
+        worker_cls = TrainWorker.options(
+            resources={k: v for k, v in resources.items()},
+            num_cpus=0,
+            scheduling_strategy=strategy,
+        )
+        if self.scaling.use_process_workers:
+            options: dict = {"process": True}
+            if self.scaling.worker_env:
+                options["runtime_env"] = {
+                    "env_vars": dict(self.scaling.worker_env)}
+            worker_cls = worker_cls.options(**options)
+            self.channel = _TrainChannel.remote()
         try:
-            self.workers = [
-                TrainWorker.options(
-                    resources={k: v for k, v in resources.items()},
-                    num_cpus=0,
-                    scheduling_strategy=strategy,
-                ).remote(rank, n)
-                for rank in range(n)
-            ]
+            self.workers = [worker_cls.remote(rank, n) for rank in range(n)]
             ray_tpu.get([w.ping.remote() for w in self.workers], timeout=60)
         except BaseException:
             # Don't leak the committed bundles or half-started gang.
@@ -90,17 +149,54 @@ class WorkerGroup:
     def run(self, fn: Callable, config: dict, results_queue,
             stop_event, resume_checkpoint) -> list:
         """Kick off the loop on every worker; returns refs."""
+        if self.channel is not None:
+            # Process gang: workers report through the channel actor; a
+            # driver-side pump forwards into the local results queue and
+            # relays the local stop event to the channel.
+            self._start_pump(results_queue, stop_event)
+            return [
+                w.run.remote(fn, config, self.channel, None,
+                             resume_checkpoint)
+                for w in self.workers
+            ]
         return [
             w.run.remote(fn, config, results_queue, stop_event, resume_checkpoint)
             for w in self.workers
         ]
 
+    def _start_pump(self, results_queue, stop_event) -> None:
+        def pump():
+            stop_sent = False
+            while not self._pump_stop.is_set():
+                try:
+                    for msg in ray_tpu.get(self.channel.drain.remote()):
+                        results_queue.put(msg)
+                except Exception:  # noqa: BLE001 — channel dying = done
+                    return
+                if stop_event.is_set() and not stop_sent:
+                    stop_sent = True
+                    try:
+                        self.channel.set_stop.remote()
+                    except Exception:  # noqa: BLE001
+                        pass
+                self._pump_stop.wait(0.05)
+
+        threading.Thread(target=pump, daemon=True,
+                         name="train-channel-pump").start()
+
     def shutdown(self):
+        self._pump_stop.set()
         for w in self.workers:
             try:
                 ray_tpu.kill(w)
             except Exception:
                 pass
+        if self.channel is not None:
+            try:
+                ray_tpu.kill(self.channel)
+            except Exception:
+                pass
+            self.channel = None
         if self.pg is not None:
             remove_placement_group(self.pg)
         self.workers = []

@@ -1,0 +1,102 @@
+"""Arena store stress: N processes hammering one shared arena while
+writers are SIGKILLed at random.
+
+VERDICT r2 #6: validate the robust-mutex + free-list-rebuild story
+under real contention (reference: plasma has unit suites plus release
+stress tests). Correctness bar: no deadlock, no corruption — after the
+chaos the arena still serves create/seal/get and its accounting is
+internally consistent.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ray_tpu._private.arena_store import ArenaStore
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import os, random, sys, time
+sys.path.insert(0, %(repo)r)
+from ray_tpu._private.arena_store import ArenaStore
+
+arena = ArenaStore.attach(%(name)r)
+assert arena is not None
+rng = random.Random(os.getpid())
+deadline = time.time() + %(seconds)f
+wrote = 0
+while time.time() < deadline:
+    oid = os.urandom(20)
+    size = rng.randrange(64, 64 * 1024)
+    view = arena.create_for_write(oid, size)
+    if view is not None:
+        view[:8] = oid[:8]  # self-describing payload for validation
+        arena.seal(oid)
+        wrote += 1
+        if rng.random() < 0.3:
+            blob = arena.get_bytes(oid)
+            assert blob is not None and bytes(blob[:8]) == oid[:8], \
+                "corrupted read-back"
+        if rng.random() < 0.2:
+            arena.delete(oid)
+    # occasionally read whatever happens to be around via stats
+    if rng.random() < 0.05:
+        arena.stats()
+print(wrote, flush=True)
+"""
+
+
+@pytest.mark.parametrize("kill_rounds", [2])
+def test_arena_survives_concurrent_writers_and_sigkill(tmp_path,
+                                                       kill_rounds):
+    probe = ArenaStore.create(f"probe_stress_{os.getpid()}", 1 << 20)
+    if probe is None:
+        pytest.skip("no native arena (toolchain unavailable)")
+    probe.close()
+    name = f"stress_{os.getpid()}"
+    arena = ArenaStore.create(name, 32 * 1024 * 1024)
+    assert arena is not None
+    try:
+        def spawn(seconds):
+            return subprocess.Popen(
+                [sys.executable, "-c",
+                 WORKER % {"repo": REPO, "name": name,
+                           "seconds": seconds}],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True)
+
+        procs = [spawn(6.0) for _ in range(4)]
+        # Kill a random writer mid-flight each round; replace it so
+        # pressure stays up (the robust mutex must recover if the
+        # victim died holding it; dead-writer entries must be
+        # reclaimed by eviction).
+        for _ in range(kill_rounds):
+            time.sleep(1.0)
+            victim = procs.pop(0)
+            os.kill(victim.pid, signal.SIGKILL)
+            victim.wait()
+            procs.append(spawn(3.0))
+        survivors_wrote = 0
+        for proc in procs:
+            out, _ = proc.communicate(timeout=60)
+            assert proc.returncode == 0, f"writer failed:\n{out}"
+            survivors_wrote += int(out.strip().splitlines()[-1])
+        assert survivors_wrote > 100, "writers made no progress"
+
+        # The arena must still be fully functional from the owner.
+        oid = b"final-check-object--"
+        view = arena.create_for_write(oid, 1024)
+        assert view is not None, "arena wedged after chaos"
+        view[:4] = b"DONE"
+        arena.seal(oid)
+        blob = arena.get_bytes(oid)
+        assert bytes(blob[:4]) == b"DONE"
+        stats = arena.stats()
+        assert stats["used_bytes"] <= 32 * 1024 * 1024
+    finally:
+        arena.close()

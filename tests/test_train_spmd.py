@@ -1,0 +1,108 @@
+"""Multi-process SPMD worker group through JaxTrainer.
+
+VERDICT r2 #5 acceptance: gang-schedule a worker group where each
+member is its own OS process running jax.distributed.initialize, and
+train a step over a device mesh SPANNING both processes (the CPU
+virtual-device trick stands in for two TPU hosts).
+
+Reference shape: python/ray/train/torch/config.py:47-91 — the backend
+hook forms the collective world; here it is jax.distributed +
+GSPMD over the global mesh instead of torch.distributed NCCL.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+
+@pytest.fixture
+def fresh_runtime():
+    ray_tpu.shutdown()
+    runtime = ray_tpu.init(num_cpus=4)
+    yield runtime
+    ray_tpu.shutdown()
+
+
+def _spmd_loop(config):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ray_tpu import train
+
+    # The gang formed one jax.distributed world of 2 processes x 4
+    # virtual CPU devices = one 8-device global mesh.
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 8, jax.devices()
+    mesh = Mesh(np.array(jax.devices()), ("dp",))
+
+    # Build a global [8, 4] batch sharded over dp: each process
+    # contributes its addressable shards.
+    sharding = NamedSharding(mesh, P("dp"))
+    rank = train.get_context().get_world_rank()
+
+    def shard_value(index):
+        # index is the global slice this shard covers; derive the data
+        # from it so both processes agree on the global array.
+        start = index[0].start or 0
+        return np.arange(start, start + 1, dtype=np.float32)[
+            :, None] * np.ones((1, 4), np.float32)
+
+    batch = jax.make_array_from_callback((8, 4), sharding, shard_value)
+
+    # One DP "train step": per-shard square + global mean — XLA inserts
+    # the cross-process collective for the mean.
+    @jax.jit
+    def step(x):
+        return jnp.mean(x * x)
+
+    loss = float(step(batch))
+    expected = float(np.mean(np.arange(8, dtype=np.float32)[:, None] ** 2
+                             * np.ones((1, 4))))
+    assert abs(loss - expected) < 1e-5, (loss, expected)
+    train.report({"loss": loss, "world": jax.process_count(),
+                  "devices": len(jax.devices()), "rank": rank})
+
+
+def test_jax_trainer_two_process_spmd_mesh(fresh_runtime):
+    scaling = ScalingConfig(
+        num_workers=2,
+        use_process_workers=True,
+        worker_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=4"},
+    )
+    trainer = JaxTrainer(
+        _spmd_loop,
+        jax_distributed_config="auto",
+        scaling_config=scaling,
+        run_config=RunConfig(report_timeout_s=120.0),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["world"] == 2
+    assert result.metrics["devices"] == 8
+    expected = float(np.mean(np.arange(8, dtype=np.float32)[:, None] ** 2
+                             * np.ones((1, 4))))
+    assert abs(result.metrics["loss"] - expected) < 1e-5
+
+
+def test_process_worker_gang_reports_and_stops(fresh_runtime):
+    """Channel-actor reporting: process workers stream reports and obey
+    the stop criteria (no jax.distributed involved)."""
+
+    def loop(config):
+        from ray_tpu import train
+
+        for i in range(50):
+            train.report({"score": i})
+
+    scaling = ScalingConfig(num_workers=2, use_process_workers=True)
+    trainer = JaxTrainer(
+        loop, scaling_config=scaling,
+        run_config=RunConfig(stop={"score": 5}, report_timeout_s=60.0))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    # Stopped early: far fewer than 50 reports from rank 0.
+    assert 5 <= result.metrics["score"] < 50
