@@ -41,8 +41,10 @@ def bench_config():
     from ray_tpu.models.llama import LlamaConfig
 
     # ~350M params: fits params+AdamW(f32)+activations in 16GB HBM.
-    # flash (pallas kernels, fwd + fused bwd) + "dots" remat: 38.6% MFU
-    # on v5e (BENCH_r02.json) vs 25.9% for plain attention + full remat.
+    # flash (pallas kernels, fwd + fused bwd, GQA-native via a
+    # rep-axis vmap into the launch grid — no repeated-kv tensor) +
+    # "dots" remat: 40.5% MFU on v5e vs 25.9% for plain attention +
+    # full remat (38.6% with kv materialized by repeat, BENCH_r02.json).
     return dataclasses.replace(
         LlamaConfig(),
         vocab_size=32000, hidden_size=1024, intermediate_size=2816,

@@ -211,16 +211,26 @@ def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 
 def _attention_block(layer: dict, x: jax.Array, positions: jax.Array,
-                     config: LlamaConfig) -> jax.Array:
+                     config: LlamaConfig,
+                     tp_axis: str | None = None) -> jax.Array:
+    """``tp_axis``: Megatron-style manual tensor parallelism for use
+    INSIDE a shard_map body (the pipelined path; GSPMD handles tp
+    automatically elsewhere): q/k/v/o arrive head-sharded over the axis
+    and the output projection psums the partial sums."""
     dtype = config.dtype
     h, kv, d = config.num_heads, config.num_kv_heads, config.head_dim
+    if tp_axis is not None:
+        tp = jax.lax.psum(1, tp_axis)
+        h, kv = h // tp, kv // tp
     normed = rms_norm(x, layer["attn_norm"], config.rms_norm_eps)
     q = jnp.einsum("ble,ehd->blhd", normed, layer["wq"].astype(dtype))
     k = jnp.einsum("ble,ekd->blkd", normed, layer["wk"].astype(dtype))
     v = jnp.einsum("ble,ekd->blkd", normed, layer["wv"].astype(dtype))
     q = rope(q, positions, config.rope_theta)
     k = rope(k, positions, config.rope_theta)
-    if kv != h:
+    if kv != h and config.attention != "flash":
+        # flash_attention is GQA-native (kernels index head groups);
+        # the other paths want materialized full-head kv.
         reps = h // kv
         k = jnp.repeat(k, reps, axis=2)
         v = jnp.repeat(v, reps, axis=2)
@@ -238,16 +248,23 @@ def _attention_block(layer: dict, x: jax.Array, positions: jax.Array,
         out = flash_attention(q, k, v, causal=True)
     else:
         out = plain_attention(q, k, v, causal=True)
-    return x + jnp.einsum("blhd,hde->ble", out, layer["wo"].astype(dtype))
+    proj = jnp.einsum("blhd,hde->ble", out, layer["wo"].astype(dtype))
+    if tp_axis is not None:
+        proj = jax.lax.psum(proj, tp_axis)  # partial sums over head shards
+    return x + proj
 
 
-def _mlp_block(layer: dict, x: jax.Array, config: LlamaConfig) -> jax.Array:
+def _mlp_block(layer: dict, x: jax.Array, config: LlamaConfig,
+               tp_axis: str | None = None) -> jax.Array:
     dtype = config.dtype
     normed = rms_norm(x, layer["mlp_norm"], config.rms_norm_eps)
     gate = jnp.einsum("ble,em->blm", normed, layer["w_gate"].astype(dtype))
     up = jnp.einsum("ble,em->blm", normed, layer["w_up"].astype(dtype))
     hidden = jax.nn.silu(gate) * up
-    return x + jnp.einsum("blm,me->ble", hidden, layer["w_down"].astype(dtype))
+    proj = jnp.einsum("blm,me->ble", hidden, layer["w_down"].astype(dtype))
+    if tp_axis is not None:
+        proj = jax.lax.psum(proj, tp_axis)  # partial sums over mlp shards
+    return x + proj
 
 
 def _moe_block(layer: dict, x: jax.Array,

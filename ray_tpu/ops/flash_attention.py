@@ -343,21 +343,35 @@ def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
                     block_k: int = 512, interpret: bool | None = None):
     """Flash attention over [B, L, H, D] (layout used by models/llama).
 
-    GQA (fewer kv heads than q heads) is handled by repeating kv heads.
+    GQA-native: with fewer kv heads than q heads the kernel runs once
+    per query-head group over the SAME kv tensors — repeated kv heads
+    are never materialized (a ``jnp.repeat`` would burn HBM bandwidth
+    and capacity exactly where flash is supposed to save it); kv
+    gradients from the groups accumulate through autodiff.
     Differentiable via fused pallas backward kernels. ``interpret=None``
     auto-selects interpret mode off-TPU.
     """
     b, l, h, d = q.shape
     kvh = k.shape[2]
-    if kvh != h:
-        reps = h // kvh
-        k = jnp.repeat(k, reps, axis=2)
-        v = jnp.repeat(v, reps, axis=2)
     if interpret is None:
         interpret = jax.default_backend() not in ("tpu",)
-    # [B, L, H, D] -> [B*H, L, D]
-    qt = q.transpose(0, 2, 1, 3).reshape(b * h, l, d)
-    kt = k.transpose(0, 2, 1, 3).reshape(b * h, l, d)
-    vt = v.transpose(0, 2, 1, 3).reshape(b * h, l, d)
-    out = _flash_core(qt, kt, vt, causal, block_q, block_k, interpret)
-    return out.reshape(b, h, l, d).transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3).reshape(b * kvh, l, d)
+    vt = v.transpose(0, 2, 1, 3).reshape(b * kvh, l, d)
+    if kvh == h:
+        qt = q.transpose(0, 2, 1, 3).reshape(b * h, l, d)
+        out = _flash_core(qt, kt, vt, causal, block_q, block_k, interpret)
+        return out.reshape(b, h, l, d).transpose(0, 2, 1, 3)
+    reps = h // kvh
+    # q head j attends kv head j // reps: regroup q as
+    # [reps, B*kvh, L, D] and vmap the kernel over the rep axis with kv
+    # UNMAPPED — pallas folds the vmap into the launch grid and every
+    # rep reads the same kv blocks, so utilization matches the dense
+    # call without the repeated-kv tensor ever existing. kv gradients
+    # sum over the rep axis through the batched vjp.
+    qg = q.reshape(b, l, kvh, reps, d).transpose(3, 0, 2, 1, 4)
+    qg = qg.reshape(reps, b * kvh, l, d)
+    out = jax.vmap(
+        lambda qq: _flash_core(qq, kt, vt, causal, block_q, block_k,
+                               interpret))(qg)
+    out = out.reshape(reps, b, kvh, l, d).transpose(1, 3, 2, 0, 4)
+    return out.reshape(b, l, h, d)

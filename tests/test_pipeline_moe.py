@@ -207,3 +207,91 @@ def test_moe_train_step_learns():
         for _ in range(10):
             state, m = step(state, batch)
         assert float(m["loss"]) < float(m0["loss"])
+
+
+def test_llama_pipeline_tp_inside_stage_matches_sequential():
+    """pp x tp composition (VERDICT r2 #8): Megatron-style tensor
+    parallelism inside each pipeline stage must reproduce the plain
+    sequential forward."""
+    cfg = _tiny()
+    mesh = build_mesh(MeshConfig(pp=2, dp=2, tp=2))
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0,
+                                cfg.vocab_size)
+    expected = llama.forward(params, tokens, cfg)
+    with jax.set_mesh(mesh):
+        logits = jax.jit(lambda p, t: llama_pipeline_forward(
+            p, t, cfg, num_stages=2, num_microbatches=2,
+            tp_axis="tp"))(params, tokens)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(expected),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_llama_pipeline_tp_gqa_matches_sequential():
+    """GQA under tp (kv heads sharded too): the per-shard head-group
+    repeat must keep q/kv pairing intact."""
+    cfg = dataclasses.replace(_tiny(), num_kv_heads=2)
+    mesh = build_mesh(MeshConfig(pp=2, dp=2, tp=2))
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0,
+                                cfg.vocab_size)
+    expected = llama.forward(params, tokens, cfg)
+    with jax.set_mesh(mesh):
+        logits = jax.jit(lambda p, t: llama_pipeline_forward(
+            p, t, cfg, num_stages=2, num_microbatches=2,
+            tp_axis="tp"))(params, tokens)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(expected),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_llama_pipeline_tp_differentiable():
+    cfg = _tiny()
+    mesh = build_mesh(MeshConfig(pp=2, dp=2, tp=2))
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 17), 0,
+                                cfg.vocab_size)
+
+    def loss(p):
+        logits = llama_pipeline_forward(
+            p, tokens[:, :-1], cfg, num_stages=2, num_microbatches=2,
+            tp_axis="tp")
+        return llama.cross_entropy(logits, tokens[:, 1:])
+
+    with jax.set_mesh(mesh):
+        val, grads = jax.jit(jax.value_and_grad(loss))(params)
+    assert np.isfinite(float(val))
+    gnorm = float(jnp.sqrt(sum(
+        jnp.sum(g ** 2) for g in jax.tree.leaves(grads))))
+    assert gnorm > 0 and np.isfinite(gnorm)
+
+
+def test_llama_pipeline_moe_matches_sequential_with_aux():
+    """MoE inside the pipeline (VERDICT r2 #8): logits AND the router
+    aux loss (threaded through the scan carry) must match the
+    unpipelined forward."""
+    cfg = _tiny(num_experts=4)
+    mesh = build_mesh(MeshConfig(pp=2, dp=4))
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0,
+                                cfg.vocab_size)
+    expected_logits, expected_aux = llama.forward(
+        params, tokens, cfg, with_aux=True)
+    with jax.set_mesh(mesh):
+        logits, aux = jax.jit(lambda p, t: llama_pipeline_forward(
+            p, t, cfg, num_stages=2, num_microbatches=2,
+            with_aux=True))(params, tokens)
+    np.testing.assert_allclose(np.asarray(logits),
+                               np.asarray(expected_logits),
+                               atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(float(aux), float(expected_aux),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_llama_pipeline_moe_rejects_tp():
+    cfg = _tiny(num_experts=4)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jnp.zeros((4, 16), jnp.int32)
+    with pytest.raises(NotImplementedError):
+        llama_pipeline_forward(params, tokens, cfg, num_stages=2,
+                               num_microbatches=2, tp_axis="tp",
+                               with_aux=True)
