@@ -1089,6 +1089,7 @@ class Runtime:
                     actor_id, cls, args, kwargs, self,
                     max_restarts=max_restarts,
                     max_pending_calls=max_pending_calls,
+                    max_concurrency=max_concurrency,
                     creation_return_id=creation_rid, on_death=on_death,
                     on_restart=on_restart, runtime_env=runtime_env)
             else:

@@ -29,9 +29,11 @@ it. Process-actor calls carry no token — actors hold their resources
 for their lifetime (and default to 0 CPU, like the reference), so
 blocked actor gets keep their lease.
 
-Remaining v1 limitation (documented, not hidden): process actors
-execute calls sequentially (max_concurrency applies to thread-mode
-actors).
+Process actors honor ``max_concurrency``: above 1 the pipe switches to
+a multiplexed protocol (calls tagged with ids, a worker-side thread
+pool, interleaved replies), so e.g. serve replicas on process actors
+overlap requests AND scale past one GIL (reference: actor concurrency
+groups, transport/concurrency_group_manager.h).
 """
 
 from __future__ import annotations
@@ -288,7 +290,7 @@ def _serve(conn, client: ShmClient, arena=None,
                     values = list(result)
                 conn.send(("ok", _pack_results(values, arena, arena_max)))
             elif kind == "actor_new":
-                _, cls_blob, args_blob, renv = msg
+                _, cls_blob, args_blob, renv, max_concurrency = msg
                 cls = serialization.loads_function(cls_blob)
                 args, kwargs = serialization.deserialize_from_buffer(
                     memoryview(args_blob))
@@ -298,19 +300,27 @@ def _serve(conn, client: ShmClient, arena=None,
                 _runtime_env_ctx(renv).__enter__()
                 actor_instance = cls(*args, **kwargs)
                 conn.send(("ok", None))
+                if max_concurrency and max_concurrency > 1:
+                    # Switch to the multiplexed protocol: calls carry
+                    # ids, execute on a thread pool, and replies
+                    # interleave — the serve-replica concurrency story
+                    # (reference: actor concurrency groups,
+                    # transport/concurrency_group_manager.h).
+                    _serve_actor_concurrent(
+                        conn, actor_instance, client, arena, arena_max,
+                        max_concurrency)
+                    return
             elif kind == "actor_call":
                 _, method_name, args_blob, n_returns = msg
                 if actor_instance is None:
                     raise RuntimeError("actor_call before actor_new")
-                args, kwargs = serialization.deserialize_from_buffer(
-                    memoryview(args_blob))
-                args, kwargs = _resolve_shm_args(args, kwargs, client)
-                method = getattr(actor_instance, method_name)
-                result = method(*args, **kwargs)
-                values = [result] if n_returns == 1 else \
-                    (list(result) if isinstance(result, (tuple, list))
-                     else [None] * n_returns)
-                conn.send(("ok", _pack_results(values, arena, arena_max)))
+                status, payload = _invoke_actor_method(
+                    actor_instance, client, arena, arena_max,
+                    method_name, args_blob, n_returns)
+                if status == "err":
+                    conn.send(("err", payload))
+                else:
+                    conn.send(("ok", payload))
             else:
                 raise RuntimeError(f"unknown message kind {kind!r}")
         except BaseException as exc:  # noqa: BLE001 — shipped to the driver
@@ -318,6 +328,71 @@ def _serve(conn, client: ShmClient, arena=None,
                 conn.send(("err", _exception_blob(exc)))
             except (OSError, BrokenPipeError):
                 return
+
+
+def _invoke_actor_method(instance, client: ShmClient, arena,
+                         arena_max: int, method_name: str,
+                         args_blob: bytes, n_returns: int) -> tuple:
+    """Deserialize-resolve-invoke-pack, shared by the sequential and
+    multiplexed serving loops. -> ("ok", packed) | ("err", blob)."""
+    try:
+        args, kwargs = serialization.deserialize_from_buffer(
+            memoryview(args_blob))
+        args, kwargs = _resolve_shm_args(args, kwargs, client)
+        method = getattr(instance, method_name)
+        result = method(*args, **kwargs)
+        values = [result] if n_returns == 1 else \
+            (list(result) if isinstance(result, (tuple, list))
+             else [None] * n_returns)
+        return ("ok", _pack_results(values, arena, arena_max))
+    except BaseException as exc:  # noqa: BLE001 — shipped to driver
+        return ("err", _exception_blob(exc))
+
+
+def _serve_actor_concurrent(conn, instance, client: ShmClient, arena,
+                            arena_max: int, max_concurrency: int) -> None:
+    """Multiplexed actor serving: up to ``max_concurrency`` calls run
+    simultaneously on a thread pool; replies are tagged with call ids
+    and interleave on the pipe (send-locked)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    send_lock = threading.Lock()
+    pool = ThreadPoolExecutor(max_workers=max_concurrency,
+                              thread_name_prefix="actor-call")
+
+    def run_one(call_id, method_name, args_blob, n_returns):
+        status, payload = _invoke_actor_method(
+            instance, client, arena, arena_max, method_name, args_blob,
+            n_returns)
+        try:
+            with send_lock:
+                conn.send(("reply", call_id, status, payload))
+        except (OSError, BrokenPipeError):
+            pass  # driver gone; the process is about to exit anyway
+
+    while True:
+        try:
+            msg = conn.recv()
+        except (EOFError, OSError):
+            return
+        kind = msg[0]
+        if kind == "exit":
+            pool.shutdown(wait=False, cancel_futures=True)
+            return
+        if kind == "ping":
+            with send_lock:
+                conn.send(("pong", os.getpid()))
+            continue
+        if kind == "actor_call_async":
+            _, call_id, method_name, args_blob, n_returns = msg
+            pool.submit(run_one, call_id, method_name, args_blob,
+                        n_returns)
+        else:
+            with send_lock:
+                conn.send(("reply", msg[1] if len(msg) > 1 else -1, "err",
+                           _exception_blob(RuntimeError(
+                               f"unknown concurrent-actor message "
+                               f"{kind!r}"))))
 
 
 # --------------------------------------------------------------------------
@@ -691,6 +766,7 @@ class ProcessActor:
     def __init__(self, actor_id: ActorID, cls: type, init_args: tuple,
                  init_kwargs: dict, runtime, *, max_restarts: int = 0,
                  max_pending_calls: int = -1,
+                 max_concurrency: int = 1,
                  creation_return_id: ObjectID | None = None,
                  on_death: Callable[[ActorID, str], None] | None = None,
                  on_restart: Callable[[ActorID], None] | None = None,
@@ -699,6 +775,7 @@ class ProcessActor:
 
         self.actor_id = actor_id
         self._cls = cls
+        self._max_concurrency = max(1, int(max_concurrency))
         self._runtime_env = runtime_env
         self._init_args = init_args
         self._init_kwargs = init_kwargs
@@ -775,7 +852,8 @@ class ProcessActor:
             cls_blob = serialization.dumps_function(self._cls)
             args_blob = self._marshal(self._init_args, self._init_kwargs)
             reply = self._worker.request(
-                ("actor_new", cls_blob, args_blob, self._runtime_env))
+                ("actor_new", cls_blob, args_blob, self._runtime_env,
+                 self._max_concurrency))
             if reply[0] == "err":
                 exc, tb = serialization.deserialize_from_buffer(
                     memoryview(reply[1]))
@@ -788,6 +866,9 @@ class ProcessActor:
         if self._creation_return_id is not None:
             self._runtime.store.put(self._creation_return_id, None)
         self._started.set()
+        if self._max_concurrency > 1:
+            self._run_concurrent()
+            return
         while True:
             call = self._queue.get()
             if call is None:
@@ -815,25 +896,146 @@ class ProcessActor:
                     self._fail_call(call, ActorError(
                         exc, tb, f"{self._cls.__name__}.{call.method_name}"))
                     continue
-                for rid, packed in zip(call.return_ids, reply[1]):
-                    if packed[0] == "inline":
-                        value = serialization.deserialize_from_buffer(
-                            memoryview(packed[1]))
-                    elif packed[0] == "arena":
-                        desc = ArenaDescriptor(packed[1], packed[2])
-                        self._runtime.shm_directory.register_arena(rid, desc)
-                        value = self._runtime.shm_client.get(desc)
-                    else:
-                        desc = ShmDescriptor(packed[1], packed[2])
-                        self._runtime.shm_directory.adopt(rid, desc)
-                        value = self._runtime.shm_client.get(desc)
-                    self._runtime.store.put(rid, value)
+                self._store_call_results(call, reply[1])
             except (WorkerCrashedError, _WorkerUnavailable):
                 self._handle_crash(call)
                 return
             except BaseException as exc:  # noqa: BLE001 — never kill the
                 # executor thread silently: fail the call and keep serving.
                 self._fail_call(call, exc)
+
+    def _store_call_results(self, call, packed_list) -> None:
+        for rid, packed in zip(call.return_ids, packed_list):
+            if packed[0] == "inline":
+                value = serialization.deserialize_from_buffer(
+                    memoryview(packed[1]))
+            elif packed[0] == "arena":
+                desc = ArenaDescriptor(packed[1], packed[2])
+                self._runtime.shm_directory.register_arena(rid, desc)
+                value = self._runtime.shm_client.get(desc)
+            elif packed[0] == "shm":
+                desc = ShmDescriptor(packed[1], packed[2])
+                self._runtime.shm_directory.adopt(rid, desc)
+                value = self._runtime.shm_client.get(desc)
+            else:  # ("err", blob): this return value failed to pickle
+                exc, tb = serialization.deserialize_from_buffer(
+                    memoryview(packed[1]))
+                self._fail_call(call, ActorError(
+                    exc, tb, f"{self._cls.__name__}.{call.method_name}"))
+                return
+            self._runtime.store.put(rid, value)
+
+    def _run_concurrent(self) -> None:
+        """Multiplexed mode (max_concurrency > 1): submissions stream to
+        the worker tagged with call ids, a reader thread matches
+        interleaved replies, and up to max_concurrency calls execute
+        simultaneously worker-side. Per-caller ordering is NOT
+        guaranteed — the same trade the reference makes for
+        max_concurrency > 1 actors."""
+        worker = self._worker  # generation guard for the crash path
+        conn = worker.conn
+        send_lock = threading.Lock()
+        pending: dict[int, Any] = {}
+        pending_lock = threading.Lock()
+        next_id = [0]
+
+        def reader():
+            while True:
+                try:
+                    msg = conn.recv()
+                except (EOFError, OSError):
+                    break
+                if msg[0] != "reply":
+                    continue
+                _, call_id, status, payload = msg
+                with pending_lock:
+                    call = pending.pop(call_id, None)
+                if call is None:
+                    continue
+                with self._lock:
+                    # _pending counts queued + in-flight here, so
+                    # max_pending_calls bounds the true outstanding work
+                    # (decrement only once the reply landed).
+                    self._pending = max(0, self._pending - 1)
+                if status == "err":
+                    exc, tb = serialization.deserialize_from_buffer(
+                        memoryview(payload))
+                    self._fail_call(call, ActorError(
+                        exc, tb,
+                        f"{self._cls.__name__}.{call.method_name}"))
+                else:
+                    self._store_call_results(call, payload)
+            # Pipe closed: fail everything still in flight. The reader
+            # is the single authority for crash handling in concurrent
+            # mode (the sender defers to it); skip if this worker
+            # generation was already replaced or cleanly killed.
+            with pending_lock:
+                stranded = list(pending.values())
+                pending.clear()
+            for call in stranded:
+                self._fail_call(call, ActorDiedError(
+                    self.actor_id, "actor process died with calls "
+                    "in flight"))
+            if self._worker is worker and not self.is_dead():
+                restartable = self._num_restarts < self._max_restarts
+                self._mark_dead("actor process died",
+                                notify=not restartable)
+                if restartable:
+                    self._restart()
+
+        reader_thread = threading.Thread(
+            target=reader, daemon=True,
+            name=f"ray_tpu-pactor-read-{self._cls.__name__}")
+        reader_thread.start()
+
+        while True:
+            call = self._queue.get()
+            if call is None:
+                return
+            if self._worker is not worker:
+                # A crash-restart replaced this generation while we were
+                # blocked on the queue: hand the call to the NEW
+                # sender and exit (stale senders must not steal work).
+                self._queue.put(call)
+                return
+            with self._lock:
+                # NOTE: _pending is NOT decremented here — it keeps
+                # counting until the reply arrives (reader thread), so
+                # max_pending_calls bounds queued + in-flight.
+                if self._dead:
+                    self._pending = max(0, self._pending - 1)
+                    self._fail_call(call, ActorDiedError(
+                        self.actor_id, self._death_reason or "actor died"))
+                    continue
+            try:
+                args_blob = self._marshal(call.args, call.kwargs)
+            except Exception as exc:  # noqa: BLE001 — unpicklable args
+                with self._lock:
+                    self._pending = max(0, self._pending - 1)
+                self._fail_call(call, ActorError(
+                    exc, "", f"{self._cls.__name__}.{call.method_name} "
+                    f"(argument serialization)"))
+                continue
+            call_id = next_id[0]
+            next_id[0] += 1
+            with pending_lock:
+                pending[call_id] = call
+            try:
+                with send_lock:
+                    conn.send(("actor_call_async", call_id,
+                               call.method_name, args_blob,
+                               len(call.return_ids)))
+            except (OSError, BrokenPipeError):
+                with pending_lock:
+                    pending.pop(call_id, None)
+                with self._lock:
+                    self._pending = max(0, self._pending - 1)
+                # Fail this call; death/restart is the READER's job
+                # (single authority — two restart paths would race).
+                self._fail_call(call, ActorDiedError(
+                    self.actor_id,
+                    f"actor process died sending {call.method_name}()"))
+                return
 
     def _handle_crash(self, call) -> None:
         reason = f"actor process died executing {call.method_name}()"

@@ -396,3 +396,80 @@ def test_driver_created_ref_and_actor_usable_in_nested_code(pool_runtime):
     assert ray_tpu.get(consume.remote(data_refs, acc)) == 6
     assert ray_tpu.get(acc.add.remote(1)) == 7
     ray_tpu.kill(acc)
+
+
+def test_process_actor_concurrent_calls_overlap(pool_runtime):
+    """max_concurrency > 1 on a process actor: blocked calls overlap
+    worker-side (multiplexed pipe protocol), so N sleeps take ~1 sleep
+    of wall time, not N."""
+
+    @ray_tpu.remote(max_concurrency=4, process=True)
+    class Sleeper:
+        def nap(self, seconds):
+            import threading
+            import time as _t
+
+            _t.sleep(seconds)
+            return threading.get_ident()
+
+    actor = Sleeper.remote()
+    start = time.monotonic()
+    refs = [actor.nap.remote(0.5) for _ in range(4)]
+    idents = ray_tpu.get(refs, timeout=30)
+    elapsed = time.monotonic() - start
+    assert elapsed < 1.5, f"calls serialized: {elapsed:.2f}s for 4x0.5s"
+    assert len(set(idents)) > 1, "all calls ran on one worker thread"
+    ray_tpu.kill(actor)
+
+
+def test_process_actor_concurrent_errors_and_state(pool_runtime):
+    @ray_tpu.remote(max_concurrency=4, process=True)
+    class Counter:
+        def __init__(self):
+            import threading
+
+            self.lock = threading.Lock()
+            self.n = 0
+
+        def add(self, x):
+            with self.lock:
+                self.n += x
+                return self.n
+
+        def boom(self):
+            raise ValueError("concurrent-boom")
+
+    actor = Counter.remote()
+    refs = [actor.add.remote(1) for _ in range(20)]
+    results = ray_tpu.get(refs, timeout=30)
+    assert sorted(results) == list(range(1, 21))
+    with pytest.raises(ActorError) as exc_info:
+        ray_tpu.get(actor.boom.remote(), timeout=30)
+    assert "concurrent-boom" in str(exc_info.value)
+    # Still serving after an error.
+    assert ray_tpu.get(actor.add.remote(5), timeout=30) == 25
+    ray_tpu.kill(actor)
+
+
+def test_process_actor_concurrent_crash_fails_inflight(pool_runtime):
+    @ray_tpu.remote(max_concurrency=4, process=True)
+    class Crashy:
+        def nap(self, seconds):
+            import time as _t
+
+            _t.sleep(seconds)
+            return "done"
+
+        def die(self):
+            import os as _os
+
+            _os._exit(1)
+
+    actor = Crashy.remote()
+    refs = [actor.nap.remote(5.0) for _ in range(3)]
+    time.sleep(0.3)
+    actor.die.remote()
+    for ref in refs:
+        with pytest.raises(ActorDiedError):
+            ray_tpu.get(ref, timeout=30)
+    ray_tpu.kill(actor)
