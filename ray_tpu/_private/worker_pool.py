@@ -932,7 +932,13 @@ class ProcessActor:
         simultaneously worker-side. Per-caller ordering is NOT
         guaranteed — the same trade the reference makes for
         max_concurrency > 1 actors."""
-        worker = self._worker  # generation guard for the crash path
+        worker = self._worker
+        # Generation guard: _restart bumps _num_restarts BEFORE spawning
+        # the replacement thread, so comparing it is race-free (checking
+        # self._worker is not — it's replaced only after the slow
+        # process spawn completes, leaving a window where a stale sender
+        # could steal a post-restart call).
+        my_gen = self._num_restarts
         conn = worker.conn
         send_lock = threading.Lock()
         pending: dict[int, Any] = {}
@@ -957,14 +963,21 @@ class ProcessActor:
                     # max_pending_calls bounds the true outstanding work
                     # (decrement only once the reply landed).
                     self._pending = max(0, self._pending - 1)
-                if status == "err":
-                    exc, tb = serialization.deserialize_from_buffer(
-                        memoryview(payload))
-                    self._fail_call(call, ActorError(
-                        exc, tb,
-                        f"{self._cls.__name__}.{call.method_name}"))
-                else:
-                    self._store_call_results(call, payload)
+                # The reader must never die silently: one bad reply
+                # (shm attach failure, undeserializable payload) fails
+                # ITS call and the loop keeps serving — otherwise every
+                # in-flight call hangs forever with the pipe still open.
+                try:
+                    if status == "err":
+                        exc, tb = serialization.deserialize_from_buffer(
+                            memoryview(payload))
+                        self._fail_call(call, ActorError(
+                            exc, tb,
+                            f"{self._cls.__name__}.{call.method_name}"))
+                    else:
+                        self._store_call_results(call, payload)
+                except BaseException as exc:  # noqa: BLE001
+                    self._fail_call(call, exc)
             # Pipe closed: fail everything still in flight. The reader
             # is the single authority for crash handling in concurrent
             # mode (the sender defers to it); skip if this worker
@@ -976,7 +989,7 @@ class ProcessActor:
                 self._fail_call(call, ActorDiedError(
                     self.actor_id, "actor process died with calls "
                     "in flight"))
-            if self._worker is worker and not self.is_dead():
+            if self._num_restarts == my_gen and not self.is_dead():
                 restartable = self._num_restarts < self._max_restarts
                 self._mark_dead("actor process died",
                                 notify=not restartable)
@@ -992,7 +1005,7 @@ class ProcessActor:
             call = self._queue.get()
             if call is None:
                 return
-            if self._worker is not worker:
+            if self._num_restarts != my_gen:
                 # A crash-restart replaced this generation while we were
                 # blocked on the queue: hand the call to the NEW
                 # sender and exit (stale senders must not steal work).

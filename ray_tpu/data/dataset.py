@@ -46,11 +46,31 @@ class Dataset:
         self._shard_lock = threading.Lock()
         self._shard_refs_cache: list | None = None
         self._last_exec_ctx = None  # stats of the most recent execution
+        self._exec_options: dict = {}
 
     # ------------------------------------------------------------ transforms
 
     def _with(self, op: LogicalOp, name: str) -> "Dataset":
-        return Dataset(self._ops + [op], name=name)
+        out = Dataset(self._ops + [op], name=name)
+        out._exec_options = dict(self._exec_options)
+        return out
+
+    def execution_options(self, *, max_in_flight: int | None = None,
+                          per_op_caps: dict[str, int] | None = None,
+                          policies: list | None = None) -> "Dataset":
+        """Per-dataset execution knobs (reference: per-operator resource
+        limits + backpressure_policy/): ``per_op_caps`` bounds how many
+        block tasks a named operator keeps in flight, ``policies`` adds
+        custom BackpressurePolicy objects."""
+        out = Dataset(self._ops, name=self._name)
+        out._exec_options = dict(self._exec_options)
+        if max_in_flight is not None:
+            out._exec_options["max_in_flight"] = max_in_flight
+        if per_op_caps is not None:
+            out._exec_options["per_op_caps"] = dict(per_op_caps)
+        if policies is not None:
+            out._exec_options["policies"] = list(policies)
+        return out
 
     def map(self, fn: Callable[[dict], dict]) -> "Dataset":
         """Row transform (reference: dataset.map)."""
@@ -263,7 +283,7 @@ class Dataset:
     def _block_ref_iter(self) -> Iterator[Any]:
         from ray_tpu.data.executor import ExecutionContext
 
-        ctx = ExecutionContext()
+        ctx = ExecutionContext(**self._exec_options)
         self._last_exec_ctx = ctx
         return iter_block_refs(self._ops, ctx)
 

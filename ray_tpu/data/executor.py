@@ -69,30 +69,33 @@ class ExecutionStats:
         return "\n".join(lines)
 
 
-def _store_under_pressure() -> bool:
-    """Object-store backpressure signal (reference:
-    backpressure_policy/ resource_manager.py): above the spill
-    threshold, stages stop growing their in-flight window."""
-    from ray_tpu._private.config import GLOBAL_CONFIG
-    from ray_tpu._private.worker import global_runtime
-
-    runtime = global_runtime()
-    if runtime is None:
-        return False
-    stats = runtime.store.stats()
-    limit = stats.get("memory_limit_bytes") or 0
-    if limit <= 0:
-        return False
-    threshold = float(GLOBAL_CONFIG.object_spilling_threshold)
-    return stats.get("memory_used_bytes", 0) > threshold * limit
-
-
 class ExecutionContext:
-    """Knobs + stats shared by stages; carried into AllToAll fns."""
+    """Knobs + stats shared by stages; carried into AllToAll fns.
 
-    def __init__(self, max_in_flight: int = 16):
+    ``policies`` are BackpressurePolicy objects consulted before an
+    operator grows its in-flight window; ``per_op_caps`` is sugar for a
+    ConcurrencyCapBackpressurePolicy (reference: per-operator resource
+    limits + backpressure_policy/)."""
+
+    def __init__(self, max_in_flight: int = 16,
+                 policies: list | None = None,
+                 per_op_caps: dict[str, int] | None = None):
+        from ray_tpu.data.backpressure import (
+            ConcurrencyCapBackpressurePolicy,
+            default_policies,
+        )
+
         self.max_in_flight = max_in_flight
+        self.policies = (list(policies) if policies is not None
+                         else default_policies())
+        if per_op_caps:
+            self.policies.append(
+                ConcurrencyCapBackpressurePolicy(per_op_caps))
         self.stats = ExecutionStats()
+
+    def can_add_input(self, op_name: str, in_flight: int) -> bool:
+        return all(p.can_add_input(op_name, in_flight)
+                   for p in self.policies)
 
 
 @ray_tpu.remote
@@ -151,9 +154,10 @@ def iter_block_refs(ops: list[LogicalOp],
             if source.read_tasks is not None:
                 in_flight: collections.deque = collections.deque()
                 for task_idx, task in enumerate(source.read_tasks):
-                    # Backpressure: drain before submitting when the
-                    # object store is above the spill threshold.
-                    while in_flight and _store_under_pressure():
+                    # Backpressure: drain before submitting when any
+                    # policy (store memory, per-op caps) says stop.
+                    while in_flight and not ctx.can_add_input(
+                            "read", len(in_flight)):
                         st.backpressure_waits += 1
                         st.num_blocks += 1
                         yield in_flight.popleft()
@@ -203,7 +207,8 @@ def _map_stage(upstream: Iterator[Any], op: MapBlocks,
     try:
         in_flight: collections.deque = collections.deque()
         for idx, ref in enumerate(upstream):
-            while in_flight and _store_under_pressure():
+            while in_flight and not ctx.can_add_input(
+                    op.name, len(in_flight)):
                 st.backpressure_waits += 1
                 st.num_blocks += 1
                 yield in_flight.popleft()

@@ -7,6 +7,9 @@ from ray_tpu.tune.schedulers import (
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (
+    BasicVariantSearcher,
+    Searcher,
+    TPESearcher,
     choice,
     grid_search,
     loguniform,
@@ -66,6 +69,9 @@ __all__ = [
     "Tuner",
     "choice",
     "get_checkpoint",
+    "BasicVariantSearcher",
+    "Searcher",
+    "TPESearcher",
     "grid_search",
     "loguniform",
     "randint",

@@ -108,3 +108,189 @@ def generate_variants(param_space: dict, num_samples: int = 1,
                     config[key] = value
             variants.append(config)
     return variants
+
+
+# ----------------------------------------------------------- searcher plugin
+
+
+class Searcher:
+    """Pluggable search algorithm (reference: tune/search/searcher.py
+    Searcher: suggest / on_trial_result / on_trial_complete). Set via
+    ``TuneConfig(search_alg=...)``; the Tuner then asks the searcher for
+    each trial's config instead of pre-generating variants."""
+
+    def __init__(self, metric: str | None = None, mode: str | None = None):
+        self.metric = metric
+        self.mode = mode
+
+    def set_search_properties(self, metric: str, mode: str,
+                              param_space: dict) -> None:
+        """Called once by the Tuner before the first suggest."""
+        self.metric = self.metric or metric
+        self.mode = self.mode or mode
+
+    def suggest(self, trial_id: str) -> dict | None:
+        """Next config to evaluate; None = nothing more to suggest."""
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        """Intermediate result (optional hook)."""
+
+    def on_trial_complete(self, trial_id: str, result: dict | None,
+                          error: bool = False) -> None:
+        """Terminal result for a trial this searcher suggested."""
+
+
+class BasicVariantSearcher(Searcher):
+    """generate_variants wrapped in the Searcher interface — what the
+    Tuner uses when no search_alg is given."""
+
+    def __init__(self, param_space: dict, num_samples: int = 1,
+                 seed: int | None = None):
+        super().__init__()
+        self._variants = generate_variants(param_space, num_samples, seed)
+        self._next = 0
+
+    def suggest(self, trial_id: str) -> dict | None:
+        if self._next >= len(self._variants):
+            return None
+        config = self._variants[self._next]
+        self._next += 1
+        return config
+
+
+class TPESearcher(Searcher):
+    """Native Tree-structured Parzen Estimator (Bergstra et al. 2011) —
+    the built-in analogue of the reference's hyperopt integration
+    (tune/search/hyperopt/). Supports Choice / Uniform / LogUniform /
+    RandInt domains; GridSearch axes are rejected (grids belong to the
+    basic variant generator).
+
+    Per dimension, observed configs split into the top ``gamma``
+    fraction (good) and the rest (bad); candidates are drawn from a
+    kernel density over the good values and scored by the density ratio
+    l_good / l_bad — the classic TPE acquisition.
+    """
+
+    def __init__(self, metric: str | None = None, mode: str | None = None,
+                 n_initial_points: int = 8, gamma: float = 0.25,
+                 n_candidates: int = 24, seed: int | None = None):
+        super().__init__(metric=metric, mode=mode)
+        self.n_initial = n_initial_points
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._rng = random.Random(seed)
+        self._space: dict = {}
+        self._suggested: dict[str, dict] = {}
+        self._observed: list[tuple[dict, float]] = []
+
+    def set_search_properties(self, metric: str, mode: str,
+                              param_space: dict) -> None:
+        super().set_search_properties(metric, mode, param_space)
+        for key, dom in param_space.items():
+            if isinstance(dom, GridSearch):
+                raise ValueError(
+                    "TPESearcher does not accept grid_search axes; use "
+                    "choice() or the default variant generator")
+        self._space = dict(param_space)
+
+    # -- observation --------------------------------------------------
+    def on_trial_complete(self, trial_id: str, result: dict | None,
+                          error: bool = False) -> None:
+        config = self._suggested.pop(trial_id, None)
+        if config is None or error or not result:
+            return
+        value = result.get(self.metric)
+        if value is None:
+            return
+        score = float(value) if self.mode == "min" else -float(value)
+        self._observed.append((config, score))
+
+    # -- suggestion ---------------------------------------------------
+    def _random_config(self) -> dict:
+        config = {}
+        for key, dom in self._space.items():
+            config[key] = dom.sample(self._rng) if hasattr(dom, "sample") \
+                else dom
+        return config
+
+    @staticmethod
+    def _kde_logpdf(values: list[float], x: float, bandwidth: float) -> float:
+        import math
+
+        if not values:
+            return 0.0
+        total = 0.0
+        for v in values:
+            total += math.exp(-0.5 * ((x - v) / bandwidth) ** 2)
+        return math.log(max(total / (len(values) * bandwidth), 1e-12))
+
+    def _dim_score(self, dom, good: list, bad: list, x) -> float:
+        import math
+
+        if not isinstance(dom, (Choice, Uniform, LogUniform, RandInt)):
+            return 0.0  # Func/sample_from etc: no density model
+        if isinstance(dom, Choice):
+            smoothing = 1.0
+            n_opts = max(len(dom.values), 1)
+            pg = (good.count(x) + smoothing) / (len(good) + smoothing * n_opts)
+            pb = (bad.count(x) + smoothing) / (len(bad) + smoothing * n_opts)
+            return math.log(pg) - math.log(pb)
+        to_float = math.log if isinstance(dom, LogUniform) else float
+        lo = to_float(dom.low)
+        hi = to_float(dom.high)
+        bandwidth = max((hi - lo) / 5.0, 1e-9)
+        xg = [to_float(v) for v in good]
+        xb = [to_float(v) for v in bad]
+        xv = to_float(x)
+        return (self._kde_logpdf(xg, xv, bandwidth)
+                - self._kde_logpdf(xb, xv, bandwidth))
+
+    def suggest(self, trial_id: str) -> dict | None:
+        if len(self._observed) < self.n_initial:
+            config = self._random_config()
+        else:
+            ranked = sorted(self._observed, key=lambda cv: cv[1])
+            n_good = max(1, int(self.gamma * len(ranked)))
+            good_cfgs = [c for c, _ in ranked[:n_good]]
+            bad_cfgs = [c for c, _ in ranked[n_good:]] or good_cfgs
+            best, best_score = None, -float("inf")
+            for _ in range(self.n_candidates):
+                cand = {}
+                for key, dom in self._space.items():
+                    if not hasattr(dom, "sample"):
+                        cand[key] = dom
+                        continue
+                    # Sample near a good observation (jittered), falling
+                    # back to the prior.
+                    if isinstance(dom, Choice) or self._rng.random() < 0.25:
+                        cand[key] = dom.sample(self._rng)
+                    else:
+                        base = self._rng.choice(good_cfgs)[key]
+                        cand[key] = self._jitter(dom, base)
+                score = sum(
+                    self._dim_score(dom, [g[k] for g in good_cfgs],
+                                    [b[k] for b in bad_cfgs], cand[k])
+                    for k, dom in self._space.items()
+                    if hasattr(dom, "sample"))
+                if score > best_score:
+                    best, best_score = cand, score
+            config = best or self._random_config()
+        self._suggested[trial_id] = config
+        return config
+
+    def _jitter(self, dom, base):
+        import math
+
+        if isinstance(dom, LogUniform):
+            lo, hi = math.log(dom.low), math.log(dom.high)
+            x = math.log(base) + self._rng.gauss(0, (hi - lo) / 5.0)
+            return math.exp(min(max(x, lo), hi))
+        if isinstance(dom, Uniform):
+            x = base + self._rng.gauss(0, (dom.high - dom.low) / 5.0)
+            return min(max(x, dom.low), dom.high)
+        if isinstance(dom, RandInt):
+            x = base + int(round(self._rng.gauss(0, max(
+                (dom.high - dom.low) / 5.0, 1.0))))
+            return min(max(x, dom.low), dom.high - 1)
+        return dom.sample(self._rng)

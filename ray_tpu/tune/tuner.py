@@ -44,6 +44,9 @@ class TuneConfig:
     scheduler: Any = None
     seed: int | None = None
     max_iterations: int = 0  # 0 = until trainable returns
+    # Pluggable search algorithm (Searcher subclass, e.g. TPESearcher);
+    # None = exhaustive/random variant generation from param_space.
+    search_alg: Any = None
     # Wall-clock budget for the whole run; None = unlimited. On expiry,
     # running trials are stopped and marked with a TimeoutError.
     time_budget_s: float | None = None
@@ -315,7 +318,7 @@ class Tuner:
                 else:
                     resume_ckpts[trial_id] = trial.checkpoint
                     pending.append((trial_id, rec["config"]))
-        else:
+        elif tc.search_alg is None:
             variants = generate_variants(self.param_space, tc.num_samples,
                                          tc.seed)
             if not variants:
@@ -327,7 +330,23 @@ class Tuner:
                 stop_events[trial_id] = threading.Event()
                 pending.append((trial_id, config))
 
-        max_concurrent = tc.max_concurrent_trials or max(len(pending), 1)
+        # Searcher-driven mode: trials are created lazily so each
+        # suggestion can condition on completed results (reference:
+        # search-algo integrations under tune/search/). On restore, the
+        # searcher is replayed with the restored completions and keeps
+        # issuing until num_samples total trials exist.
+        searcher = tc.search_alg
+        issued = [len(trials)]
+        if searcher is not None:
+            searcher.set_search_properties(tc.metric, tc.mode,
+                                           self.param_space)
+            if self._restored_trials is not None:
+                for t in trials.values():
+                    if t.trial_id in done and t.error is None                             and t.metrics:
+                        searcher.on_trial_complete(t.trial_id, t.metrics)
+
+        max_concurrent = tc.max_concurrent_trials or (
+            max(len(pending), 1) if searcher is None else 1)
         running: set[str] = set()
         # Trials stopped by an EXPLOIT decision, awaiting relaunch with
         # (new_config, source_checkpoint).
@@ -347,6 +366,17 @@ class Tuner:
             while pending and len(running) < max_concurrent:
                 trial_id, config = pending.pop(0)
                 launch(trial_id, config, resume_ckpts.get(trial_id))
+            while (searcher is not None and len(running) < max_concurrent
+                   and issued[0] < tc.num_samples):
+                trial_id = f"trial_{issued[0]:05d}_{uuid.uuid4().hex[:6]}"
+                config = searcher.suggest(trial_id)
+                if config is None:
+                    break
+                issued[0] += 1
+                trials[trial_id] = TrialResult(trial_id=trial_id,
+                                               config=config)
+                stop_events[trial_id] = threading.Event()
+                launch(trial_id, config, None)
 
         launch_next()
         run_cfg = self.run_config
@@ -394,6 +424,10 @@ class Tuner:
                     trial.error = msg["error"]
                 done.add(trial.trial_id)
                 running.discard(trial.trial_id)
+                if searcher is not None:
+                    searcher.on_trial_complete(
+                        trial.trial_id, trial.metrics,
+                        error=trial.error is not None)
                 if exp_dir is not None:
                     self._save_state(exp_dir, trials, done)
                 launch_next()
@@ -414,6 +448,8 @@ class Tuner:
                         time.monotonic() - last_state_save > 1.0:
                     last_state_save = time.monotonic()
                     self._save_state(exp_dir, trials, done)
+            if searcher is not None:
+                searcher.on_trial_result(trial.trial_id, metrics)
             if hasattr(scheduler, "on_trial_state"):
                 scheduler.on_trial_state(trial.trial_id, trial.config,
                                          trial.checkpoint)

@@ -164,3 +164,81 @@ def test_tuner_over_trainer(fresh_runtime, tmp_path):
         tune_config=TuneConfig(metric="loss", mode="min"),
     ).fit()
     assert results.get_best_result().config["lr"] == 0.01
+
+
+# ------------------------------------------------------- searcher plugin
+
+
+def test_custom_searcher_plugin_drives_trials(fresh_runtime):
+    """VERDICT r2 #10: a Searcher subclass plugs into the Tuner —
+    suggestions become trials, completions feed back."""
+    from ray_tpu import tune
+
+    class FixedSearcher(tune.Searcher):
+        def __init__(self):
+            super().__init__()
+            self.completed = []
+            self._i = 0
+
+        def suggest(self, trial_id):
+            if self._i >= 3:
+                return None
+            self._i += 1
+            return {"x": self._i}
+
+        def on_trial_complete(self, trial_id, result, error=False):
+            self.completed.append((result or {}).get("loss"))
+
+    searcher = FixedSearcher()
+
+    def trainable(config):
+        tune.report({"loss": config["x"] * 10.0})
+
+    results = tune.Tuner(
+        trainable,
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", num_samples=10,
+            search_alg=searcher, max_concurrent_trials=1),
+    ).fit()
+    # Searcher returned None after 3 suggestions: exactly 3 trials ran.
+    assert len(results) == 3
+    assert sorted(searcher.completed) == [10.0, 20.0, 30.0]
+    assert results.get_best_result().metrics["loss"] == 10.0
+
+
+def test_tpe_searcher_converges_on_quadratic(fresh_runtime):
+    """Native TPE: minimizes a smooth 2-D quadratic well below the
+    prior's expected minimum within a modest budget."""
+    from ray_tpu import tune
+
+    def trainable(config):
+        loss = (config["x"] - 0.7) ** 2 + (config["y"] + 0.2) ** 2
+        tune.report({"loss": loss})
+
+    searcher = tune.TPESearcher(n_initial_points=8, seed=7)
+    results = tune.Tuner(
+        trainable,
+        param_space={"x": tune.uniform(-2.0, 2.0),
+                     "y": tune.uniform(-2.0, 2.0)},
+        tune_config=tune.TuneConfig(
+            metric="loss", mode="min", num_samples=40,
+            search_alg=searcher, max_concurrent_trials=1),
+    ).fit()
+    best = results.get_best_result().metrics["loss"]
+    assert len(results) == 40
+    assert best < 0.05, f"TPE failed to converge: best={best}"
+
+
+def test_tpe_rejects_grid_axes(fresh_runtime):
+    from ray_tpu import tune
+
+    def trainable(config):
+        tune.report({"loss": 0.0})
+
+    with pytest.raises(ValueError):
+        tune.Tuner(
+            trainable,
+            param_space={"x": tune.grid_search([1, 2])},
+            tune_config=tune.TuneConfig(
+                search_alg=tune.TPESearcher(), num_samples=2),
+        ).fit()
