@@ -61,6 +61,11 @@ class RemoteBlob:
     size: int
 
 
+class NodeBusyError(Exception):
+    """The node rejected the lease at admission (another driver's work
+    saturates it); the submitter should spill to a different node."""
+
+
 class NodeObjectStore:
     """Serialized-blob store of a node daemon: task results (until the
     owner frees them) + pulled peer objects (evictable cache)."""
@@ -230,24 +235,41 @@ class NodeExecutorService:
         descriptor is ("inline", blob) or ("stored", size), or
         ("need_func",) when the digest is unknown here, or
         ("err", exc_blob)."""
-        with self._func_lock:
-            func = self._func_cache.get(digest)
-        if func is None:
-            if func_blob is None:
-                return ("need_func",)
-            # Deserialize OUTSIDE the lock: loading can import heavy
-            # modules and must not stall other tasks' cache lookups.
-            try:
-                func = serialization.loads_function(func_blob)
-            except BaseException as exc:  # noqa: BLE001
-                return ("err", _exc_blob(exc))
-            with self._func_lock:
-                self._func_cache[digest] = func
-
+        # Admission: with several drivers sharing this node, each one
+        # accounts only its own leases — reject work beyond capacity and
+        # let the submitter spill to another node (reference: raylet
+        # spillback, cluster_task_manager.h:42 / HandleRequestWorkerLease
+        # redirecting the lease).
+        # NOTE: the reservation spans the whole execution, including any
+        # time the task spends blocked — daemon-side tasks cannot make
+        # nested submissions today (no driver endpoint in daemon pools),
+        # so blocked-in-get CPU release does not apply here yet.
+        demand = dict(resources or {})
+        demand.setdefault("CPU", 1.0)
         token = f"exec-{digest[:8]}-{os.urandom(4).hex()}"
         with self._running_lock:
-            self._running[token] = dict(resources or {})
+            for key, cap in self._resources.items():
+                used = sum(float(d.get(key, 0.0))
+                           for d in self._running.values())
+                if used + float(demand.get(key, 0.0)) > float(cap) + 1e-9:
+                    return ("busy",)
+            # Reserve atomically with the check (two concurrent calls
+            # must not both pass a half-full node).
+            self._running[token] = demand
         try:
+            with self._func_lock:
+                func = self._func_cache.get(digest)
+            if func is None:
+                if func_blob is None:
+                    return ("need_func",)
+                # Deserialize OUTSIDE the lock: loading can import heavy
+                # modules and must not stall other tasks' cache lookups.
+                try:
+                    func = serialization.loads_function(func_blob)
+                except BaseException as exc:  # noqa: BLE001
+                    return ("err", _exc_blob(exc))
+                with self._func_lock:
+                    self._func_cache[digest] = func
             args, kwargs = serialization.deserialize_from_buffer(
                 memoryview(args_blob))
             args, kwargs = self._resolve_fetch_args(args, kwargs)
@@ -446,6 +468,8 @@ class RemoteNodeHandle:
             reply = self.pool.call(
                 "execute_task", digest, func_blob, args_blob, n_returns,
                 return_keys, runtime_env, resources)
+        if reply[0] == "busy":
+            raise NodeBusyError(self.address)
         with self._digest_lock:
             self.known_digests.add(digest)
         if reply[0] == "err":

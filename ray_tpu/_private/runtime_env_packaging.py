@@ -1,0 +1,141 @@
+"""Runtime-env directory packaging: ship working_dir / py_modules to
+every node that runs the task.
+
+Reference: python/ray/_private/runtime_env/packaging.py — local dirs
+become content-hashed zip packages (gcs://_ray_pkg_<hash>.zip) uploaded
+once, downloaded + extracted once per node, cached by hash. Here the
+driver's object export server is the distribution plane (the same
+chunked fetch_object path task arguments ride), so packages flow
+driver → node exactly once regardless of task count.
+
+Wire format: a runtime_env entry that named a local directory becomes
+``{"__pkg__": [hash_hex, export_addr]}``; worker-side resolution
+downloads (or reuses the cache) and substitutes the extracted path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import os
+import zipfile
+
+_CACHE_ROOT = os.environ.get("RAY_TPU_PKG_CACHE",
+                             "/tmp/ray_tpu_pkg_cache")
+_EXCLUDE_DIRS = {"__pycache__", ".git"}
+_MAX_PACKAGE_BYTES = 512 * 1024 * 1024
+
+
+def hash_directory(path: str) -> str:
+    """Content hash of a directory (same walk/ordering as
+    package_directory, no zipping) — cheap enough to run per submit so
+    edited sources re-ship instead of serving a stale cache."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise ValueError(f"runtime_env path {path!r} is not a directory")
+    hasher = hashlib.sha1()
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+        for name in sorted(files):
+            if name.endswith(".pyc"):
+                continue
+            full = os.path.join(root, name)
+            hasher.update(os.path.relpath(full, path).encode())
+            with open(full, "rb") as f:
+                hasher.update(f.read())
+    return hasher.hexdigest()
+
+
+def package_directory(path: str) -> tuple[str, bytes]:
+    """Deterministic zip of a directory -> (content_hash_hex, bytes).
+
+    Deterministic (sorted entries, fixed timestamps) so the hash is
+    stable across runs and caches hit."""
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        raise ValueError(f"runtime_env path {path!r} is not a directory")
+    entries = []
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs if d not in _EXCLUDE_DIRS)
+        for name in sorted(files):
+            if name.endswith(".pyc"):
+                continue
+            full = os.path.join(root, name)
+            entries.append((os.path.relpath(full, path), full))
+    buf = io.BytesIO()
+    hasher = hashlib.sha1()
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for rel, full in entries:
+            with open(full, "rb") as f:
+                data = f.read()
+            hasher.update(rel.encode())
+            hasher.update(data)
+            info = zipfile.ZipInfo(rel, date_time=(1980, 1, 1, 0, 0, 0))
+            info.external_attr = (os.stat(full).st_mode & 0xFFFF) << 16
+            zf.writestr(info, data)
+    blob = buf.getvalue()
+    if len(blob) > _MAX_PACKAGE_BYTES:
+        raise ValueError(
+            f"runtime_env package for {path!r} is {len(blob)} bytes "
+            f"(limit {_MAX_PACKAGE_BYTES}); exclude build artifacts")
+    return hasher.hexdigest(), blob
+
+
+def ensure_package_local(hash_hex: str, export_addr: str,
+                         member: str | None = None) -> str:
+    """Extracted package directory for ``hash_hex``, downloading from
+    the owner's export server on first use (per-node cache).
+
+    ``member``: for py_modules the importable directory must keep its
+    NAME, so contents extract under ``<cache>/<hash>/<member>/`` and
+    that inner path is returned; working_dir packages extract flat."""
+    # Cache key includes the member name: the same content extracts to
+    # different layouts for working_dir vs py_modules use.
+    target = os.path.join(
+        _CACHE_ROOT, hash_hex + (f"-{member}" if member else ""))
+    inner = os.path.join(target, member) if member else target
+    marker = os.path.join(target, ".complete")
+    if os.path.exists(marker):
+        return inner
+    from ray_tpu._private.node_executor import fetch_blob
+    from ray_tpu._private.rpc import RpcClient
+
+    client = RpcClient(export_addr, timeout_s=120.0)
+    try:
+        blob = fetch_blob(client, bytes.fromhex(hash_hex))
+    finally:
+        client.close()
+    tmp = target + f".tmp.{os.getpid()}"
+    extract_to = os.path.join(tmp, member) if member else tmp
+    os.makedirs(extract_to, exist_ok=True)
+    with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+        zf.extractall(extract_to)
+    open(os.path.join(tmp, ".complete"), "w").close()
+    try:
+        os.rename(tmp, target)
+    except OSError:
+        # Concurrent extraction won the rename; use the winner.
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return inner
+
+
+def resolve_runtime_env(renv: dict | None) -> dict | None:
+    """Worker-side: replace ``{"__pkg__": [hash, addr, member]}``
+    markers with locally extracted directories."""
+    if not renv:
+        return renv
+
+    def resolve(value):
+        if isinstance(value, dict) and "__pkg__" in value:
+            hash_hex, addr, member = value["__pkg__"]
+            return ensure_package_local(hash_hex, addr, member)
+        return value
+
+    out = dict(renv)
+    if "working_dir" in out:
+        out["working_dir"] = resolve(out["working_dir"])
+    if out.get("py_modules"):
+        out["py_modules"] = [resolve(m) for m in out["py_modules"]]
+    return out

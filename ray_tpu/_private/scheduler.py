@@ -303,7 +303,9 @@ class Dispatcher:
 
     def _try_admit(self, task: _QueuedTask) -> NodeState | None:
         spec = task.spec
-        node = self._cluster.pick_node(spec.resources, spec.scheduling_strategy)
+        node = self._cluster.pick_node(
+            spec.resources, spec.scheduling_strategy,
+            exclude=getattr(spec, "_avoid_nodes", None) or None)
         if node is None:
             if not self._cluster.is_feasible(spec.resources) \
                     and spec.name not in self._infeasible_warned:

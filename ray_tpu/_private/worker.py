@@ -267,6 +267,7 @@ class Runtime:
         self._export_store = None
         self._obj_server = None
         self._export_addr = ""
+        self._pkg_hashes: dict[str, str] = {}
         # Refcount-zero eviction must also drop directory + lineage
         # entries, or they leak for the runtime's lifetime.
         self.reference_counter.on_evict = self._forget_object
@@ -574,7 +575,7 @@ class Runtime:
             num_returns=num_returns, resources=resources,
             max_retries=max_retries, retry_exceptions=retry_exceptions,
             scheduling_strategy=strategy, return_ids=return_ids,
-            runtime_env=runtime_env,
+            runtime_env=self._package_runtime_env(runtime_env),
         )
         for rid in return_ids:
             self.store.create_pending(rid)
@@ -636,8 +637,44 @@ class Runtime:
                 remote_handle = self._remote_nodes.get(node.node_id)
         try:
             if remote_handle is not None:
-                ran_on_pool = self._try_execute_remote(
-                    spec, node, remote_handle)
+                from ray_tpu._private.node_executor import NodeBusyError
+
+                try:
+                    ran_on_pool = self._try_execute_remote(
+                        spec, node, remote_handle)
+                except NodeBusyError:
+                    # Spillback (reference: the raylet redirects the
+                    # lease): requeue avoiding this node; once every
+                    # remote node has rejected, the avoid set resets so
+                    # the task keeps probing as capacity frees up —
+                    # after a growing delay, so saturated clusters are
+                    # polled, not hammered with submit/RPC hot spins.
+                    avoid = getattr(spec, "_avoid_nodes", set())
+                    avoid.add(node.node_id)
+                    delay = 0.0
+                    with self._remote_nodes_lock:
+                        if avoid >= set(self._remote_nodes):
+                            avoid = set()
+                            spills = getattr(spec, "_spill_rounds", 0) + 1
+                            spec._spill_rounds = spills
+                            delay = min(0.05 * (2 ** min(spills, 6)), 2.0)
+                    spec._avoid_nodes = avoid
+                    deps = [a for a in spec.args
+                            if isinstance(a, ObjectRef)] + [
+                        v for v in spec.kwargs.values()
+                        if isinstance(v, ObjectRef)]
+
+                    def requeue():
+                        self.dispatcher.submit(
+                            spec, self._execute_task, deps)
+
+                    if delay > 0:
+                        timer = threading.Timer(delay, requeue)
+                        timer.daemon = True
+                        timer.start()
+                    else:
+                        requeue()
+                    return
             elif self.worker_pool is not None:
                 ran_on_pool = self._try_execute_on_pool(spec, node)
             else:
@@ -812,6 +849,45 @@ class Runtime:
         # Worker processes spawned after this inherit it via os.environ.
         os.environ["RAY_TPU_DRIVER_CLIENT_ADDR"] = \
             f"127.0.0.1:{self.worker_client_server.port}"
+
+    def _package_runtime_env(self, renv: dict | None) -> dict | None:
+        """Turn local working_dir / py_modules directories into content-
+        hashed packages served from the export store, so remote nodes
+        can fetch + cache them (reference:
+        _private/runtime_env/packaging.py). Local-only runtimes (no
+        export server) keep raw paths — every worker shares the
+        filesystem there."""
+        if not renv or self._obj_server is None:
+            return renv
+        from ray_tpu._private.runtime_env_packaging import (
+            hash_directory,
+            package_directory,
+        )
+
+        def pack(path, keep_name):
+            if not (isinstance(path, str) and os.path.isdir(path)):
+                return path
+            key = os.path.abspath(path)
+            # Re-hash per submit (cheap): edits to the directory must
+            # ship fresh content, never a stale cached package.
+            hash_hex = hash_directory(key)
+            if self._pkg_hashes.get(key) != hash_hex:
+                zipped_hash, blob = package_directory(key)
+                self._export_store.put(bytes.fromhex(zipped_hash), blob)
+                self._pkg_hashes[key] = zipped_hash
+                hash_hex = zipped_hash
+            member = os.path.basename(key.rstrip("/")) if keep_name \
+                else None
+            return {"__pkg__": [hash_hex, self._export_addr, member]}
+
+        out = dict(renv)
+        if "working_dir" in out:
+            out["working_dir"] = pack(out["working_dir"], keep_name=False)
+        if out.get("py_modules"):
+            # py_modules stay importable by their directory NAME.
+            out["py_modules"] = [pack(m, keep_name=True)
+                                 for m in out["py_modules"]]
+        return out
 
     def lookup_block_context(self, token: str):
         """Block context of an in-flight pool task (client server calls
@@ -1091,7 +1167,8 @@ class Runtime:
                     max_pending_calls=max_pending_calls,
                     max_concurrency=max_concurrency,
                     creation_return_id=creation_rid, on_death=on_death,
-                    on_restart=on_restart, runtime_env=runtime_env)
+                    on_restart=on_restart,
+                    runtime_env=self._package_runtime_env(runtime_env))
             else:
                 if runtime_env:
                     _warn_runtime_env_ignored(

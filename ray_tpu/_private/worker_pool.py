@@ -99,7 +99,13 @@ class _runtime_env_ctx:
     is applied per-task and restored after)."""
 
     def __init__(self, runtime_env: dict | None):
-        self.env = runtime_env or {}
+        from ray_tpu._private.runtime_env_packaging import (
+            resolve_runtime_env,
+        )
+
+        # Package markers ({"__pkg__": [hash, addr]}) become locally
+        # extracted directories here (downloaded once per node, cached).
+        self.env = resolve_runtime_env(runtime_env) or {}
         self._saved_vars: dict[str, str | None] = {}
         self._saved_cwd: str | None = None
         self._added_sys_paths: list[str] = []

@@ -6,52 +6,29 @@ multi-daemon scheduling on one box (cluster_utils.Cluster pattern), plus
 object-manager transfer tests (test_object_manager.py).
 """
 
-import json
-import subprocess
-import sys
 import time
 
 import numpy as np
 import pytest
 
 import ray_tpu
-from ray_tpu._private.gcs_server import GcsServer
-from ray_tpu._private.rpc import RpcClient
-
-
-def _spawn_worker_daemon(gcs_address: str, cpus: float):
-    return subprocess.Popen(
-        [sys.executable, "-m", "ray_tpu._private.node", "worker",
-         json.dumps({"gcs_address": gcs_address,
-                     "resources": {"CPU": cpus},
-                     "pool_size": 2})],
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+from ray_tpu.cluster_utils import Cluster
 
 
 @pytest.fixture
 def two_node_cluster():
     """Head GCS in-process + 2 worker daemons as real OS processes +
     a connected driver with zero local CPU (all CPU work must go
-    remote)."""
+    remote). Uses the public cluster_utils.Cluster fixture (reference:
+    cluster_utils.py:108)."""
     ray_tpu.shutdown()
-    gcs = GcsServer(host="127.0.0.1", port=0,
-                    log_dir="/tmp/ray_tpu_test_dist")
-    gcs.start()
-    daemons = [_spawn_worker_daemon(gcs.address, 2.0) for _ in range(2)]
+    cluster = Cluster(log_dir="/tmp/ray_tpu_test_dist")
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
     try:
-        # Wait for both daemons to register with executor addresses.
-        client = RpcClient(gcs.address)
-        deadline = time.time() + 30
-        while time.time() < deadline:
-            nodes = [n for n in client.call("list_nodes")
-                     if n["alive"] and n["executor_address"]]
-            if len(nodes) >= 2:
-                break
-            time.sleep(0.2)
-        assert len(nodes) >= 2, "worker daemons never registered"
-        client.close()
-
-        runtime = ray_tpu.init(num_cpus=0, address=gcs.address)
+        assert cluster.wait_for_nodes(2, timeout=30), \
+            "worker daemons never registered"
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
         # Wait for the driver's watcher to mirror the remote nodes.
         deadline = time.time() + 30
         while time.time() < deadline:
@@ -63,14 +40,7 @@ def two_node_cluster():
         yield runtime
     finally:
         ray_tpu.shutdown()
-        for proc in daemons:
-            proc.terminate()
-        for proc in daemons:
-            try:
-                proc.wait(timeout=5)
-            except subprocess.TimeoutExpired:
-                proc.kill()
-        gcs.stop()
+        cluster.shutdown()
 
 
 def _remote_node_ids(runtime):
@@ -194,3 +164,128 @@ def test_large_driver_arg_exported_and_cached(two_node_cluster):
     # ...and served at most one pull per node (chunked pulls may take a
     # few fetch RPCs each, but far fewer than 10 tasks' worth).
     assert stats["fetches_served"] <= 2 * 2  # 2 nodes x <=2 chunks
+
+
+def test_executor_admission_rejects_over_capacity():
+    """Node-side admission: a saturated executor replies busy instead of
+    queueing unbounded foreign work (reference: raylet spillback)."""
+    import threading
+
+    from ray_tpu._private import serialization
+    from ray_tpu._private.node_executor import NodeExecutorService
+    from ray_tpu._private.rpc import RpcClient
+
+    service = NodeExecutorService(
+        host="127.0.0.1", resources={"CPU": 1.0}, pool_size=1).start()
+    try:
+        def make_args(seconds):
+            return serialization.serialize_framed(((seconds,), {}))
+
+        import time as _t
+
+        blob = serialization.dumps_function(
+            lambda s: (_t.sleep(s), "done")[1])
+        slow_client = RpcClient(f"127.0.0.1:{service.port}")
+        result_box = {}
+
+        def run_slow():
+            result_box["slow"] = slow_client.call(
+                "execute_task", "digest-slow", blob, make_args(2.0), 1,
+                [b"r" * 20], None, {"CPU": 1.0})
+
+        t = threading.Thread(target=run_slow)
+        t.start()
+        deadline = time.time() + 5
+        while time.time() < deadline and not service._running:
+            time.sleep(0.02)
+        probe = RpcClient(f"127.0.0.1:{service.port}")
+        reply = probe.call("execute_task", "digest-probe", blob,
+                           make_args(0.0), 1, [b"p" * 20], None,
+                           {"CPU": 1.0})
+        assert reply[0] == "busy", reply
+        t.join(timeout=20)
+        assert result_box["slow"][0] == "ok"
+        probe.close()
+        slow_client.close()
+    finally:
+        service.stop()
+
+
+def test_driver_spills_to_other_node_on_busy(two_node_cluster):
+    """Busy replies requeue the task avoiding that node; once every
+    node rejected, the avoid set resets and the task lands when
+    capacity frees (multi-driver contention shape)."""
+    from ray_tpu._private.node_executor import NodeBusyError
+
+    runtime = two_node_cluster
+    busy_counts = {}
+    with runtime._remote_nodes_lock:
+        handles = list(runtime._remote_nodes.values())
+    for handle in handles:
+        orig = handle.execute
+        busy_counts[handle.address] = 0
+
+        def flaky(*args, _orig=orig, _addr=handle.address, **kwargs):
+            if busy_counts[_addr] < 1:
+                busy_counts[_addr] += 1
+                raise NodeBusyError(_addr)
+            return _orig(*args, **kwargs)
+
+        handle.execute = flaky
+
+    @ray_tpu.remote
+    def plus(x):
+        return x + 1
+
+    assert ray_tpu.get([plus.remote(i) for i in range(6)],
+                       timeout=60) == [1, 2, 3, 4, 5, 6]
+    assert sum(busy_counts.values()) >= 1, "busy path never exercised"
+
+
+def test_runtime_env_py_modules_ship_to_remote_nodes(two_node_cluster,
+                                                     tmp_path):
+    """A local py_modules directory is packaged (content-hashed zip),
+    served from the driver's export store, and extracted+cached on the
+    worker daemons — code reaches nodes that share no filesystem path
+    with the driver's sources (reference: runtime_env packaging.py)."""
+    mod_dir = tmp_path / "shipped_mod"
+    mod_dir.mkdir()
+    (mod_dir / "__init__.py").write_text("MAGIC = 'shipped-okay'\n")
+    (mod_dir / "helper.py").write_text(
+        "def triple(x):\n    return x * 3\n")
+
+    @ray_tpu.remote(runtime_env={"py_modules": [str(mod_dir)]},
+                    scheduling_strategy="SPREAD")
+    def use_module(x):
+        import os
+
+        import shipped_mod
+        from shipped_mod.helper import triple
+
+        # Prove we're on a daemon AND imported from the package cache.
+        assert os.environ.get("RAY_TPU_NODE_TAG"), "ran outside a daemon"
+        assert "ray_tpu_pkg_cache" in shipped_mod.__file__, \
+            shipped_mod.__file__
+        return shipped_mod.MAGIC, triple(x)
+
+    results = ray_tpu.get([use_module.remote(i) for i in range(6)],
+                          timeout=120)
+    assert all(m == "shipped-okay" for m, _ in results)
+    assert [t for _, t in results] == [0, 3, 6, 9, 12, 15]
+
+
+def test_runtime_env_working_dir_ships_to_remote_nodes(two_node_cluster,
+                                                       tmp_path):
+    work = tmp_path / "workdir"
+    work.mkdir()
+    (work / "data.txt").write_text("hello-from-driver")
+
+    @ray_tpu.remote(runtime_env={"working_dir": str(work)})
+    def read_file():
+        import os
+
+        with open("data.txt") as f:
+            return os.environ.get("RAY_TPU_NODE_TAG") is not None, f.read()
+
+    on_daemon, content = ray_tpu.get(read_file.remote(), timeout=60)
+    assert on_daemon and content == "hello-from-driver"
