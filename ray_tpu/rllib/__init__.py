@@ -15,7 +15,9 @@ from ray_tpu.rllib.algorithms.bc import (
     MARWIL,
     MARWILConfig,
 )
+from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.es import ES, ESConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.multi_agent_ppo import (
     MultiAgentPPO,
@@ -71,6 +73,10 @@ __all__ = [
     "DQNConfig",
     "DefaultActorCriticModule",
     "FaultTolerantActorManager",
+    "CQL",
+    "CQLConfig",
+    "ES",
+    "ESConfig",
     "IMPALA",
     "IMPALAConfig",
     "IndependentMultiAgentEnv",

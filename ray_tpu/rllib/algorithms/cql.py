@@ -1,0 +1,109 @@
+"""CQL — Conservative Q-Learning for offline continuous control.
+
+Reference: rllib/algorithms/cql/ (CQL builds on SAC: the torch learner
+adds the conservative penalty to the critic loss and trains purely
+from logged data). Here the penalty lives in the shared SAC update
+(sac.py: cql_alpha gates it inside the same single jitted program) and
+the offline input rides ray_tpu.data — no environment interaction.
+
+Input rows need {"obs": [D], "actions": [A], "rewards": float,
+"new_obs"/"next_obs": [D], "terminateds"/"dones": bool}.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.sac import SACConfig
+from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
+
+
+class CQLConfig(SACConfig):
+    def __init__(self):
+        super().__init__()
+        self.cql_alpha = 1.0
+        self.cql_num_sampled_actions = 10
+        self.updates_per_iteration = 64
+        # offline_data(): a ray_tpu.data Dataset or a list of row dicts.
+        self.input_ = None
+
+    def offline_data(self, input_) -> "CQLConfig":
+        """Reference: AlgorithmConfig.offline_data(input_=...)."""
+        self.input_ = input_
+        return self
+
+
+def _rows_to_transitions(rows: list[dict]) -> SampleBatch:
+    def col(*names, default=None):
+        out = []
+        for row in rows:
+            for name in names:
+                if name in row:
+                    out.append(row[name])
+                    break
+            else:
+                if default is None:
+                    raise KeyError(
+                        f"offline row missing one of {names}: "
+                        f"{sorted(row)}")
+                out.append(default)
+        return np.asarray(out)
+
+    return SampleBatch({
+        Columns.OBS: col("obs").astype(np.float32),
+        Columns.ACTIONS: col("actions").astype(np.float32),
+        Columns.REWARDS: col("rewards").astype(np.float32),
+        Columns.NEXT_OBS: col("new_obs", "next_obs").astype(np.float32),
+        Columns.TERMINATEDS: col("terminateds", "dones",
+                                 default=False).astype(bool),
+    })
+
+
+class CQL(Algorithm):
+    """Offline training loop: dataset -> replay buffer -> N conservative
+    SAC updates per iteration (no env runners)."""
+
+    config_class = CQLConfig
+
+    def setup(self, config: dict) -> None:
+        cfg = self.algo_config
+        if cfg.input_ is None:
+            raise ValueError("CQL needs config.offline_data(input_=...)")
+        if cfg.num_learners > 0:
+            raise ValueError("CQL runs on a local learner (like SAC)")
+        super().setup(config)
+        rows = (list(cfg.input_.take_all())
+                if hasattr(cfg.input_, "take_all") else list(cfg.input_))
+        if not rows:
+            raise ValueError("CQL offline input is empty")
+        batch = _rows_to_transitions(rows)
+        self.replay = ReplayBuffer(max(len(rows), 1), seed=cfg.seed)
+        self.replay.add(batch)
+        self._learner_steps = 0
+
+    def _build_env_runners(self, cfg):
+        self.local_env_runner = None  # purely offline
+        return None
+
+    def _sync_weights(self) -> None:
+        pass  # no runners to sync
+
+    def _runner_metrics(self) -> dict:
+        return {}
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        metrics: dict = {}
+        for _ in range(cfg.updates_per_iteration):
+            batch = self.replay.sample(
+                min(cfg.train_batch_size, len(self.replay)))
+            metrics = self.learner_group.update_from_batch(batch)
+            self._learner_steps += 1
+        metrics["num_learner_steps"] = self._learner_steps
+        metrics["dataset_size"] = len(self.replay)
+        return metrics
+
+
+CQLConfig.algo_class = CQL

@@ -1,0 +1,194 @@
+"""ES — OpenAI-style Evolution Strategies.
+
+Reference: rllib/algorithms/es/ (Salimans et al. 2017: a population of
+parameter perturbations evaluated in parallel; the update is the
+rank-weighted sum of the noise directions). The compute shape fits the
+task runtime perfectly: each antithetic pair is one stateless remote
+task, so evaluation fans out over every core/node the cluster has.
+
+Shared-noise trick (reference: es/utils.py noise table): tasks receive
+only (base params ref, seed, sigma) and regenerate their perturbation
+from the seed; the driver regenerates the same noise to apply the
+update — full parameter vectors never travel per perturbation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+
+
+class ESConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.population_size = 32        # antithetic pairs = pop / 2
+        self.sigma = 0.05                # perturbation stddev
+        self.lr = 0.02
+        self.episodes_per_perturbation = 2
+        self.max_episode_steps = 500
+        self.report_eval_episodes = 4    # greedy eval of the mean policy
+
+    def learner_class(self):  # pragma: no cover - ES has no learner
+        return None
+
+
+def _policy_step(module):
+    """Jitted greedy step, built ONCE per module (jit caches key on
+    function identity — a fresh lambda per rollout would recompile
+    every call)."""
+    import jax
+
+    return jax.jit(
+        lambda p, o: module.forward_inference(p, {"obs": o}))
+
+
+def _rollout_return(step, params, env, max_steps: int) -> tuple:
+    """(mean undiscounted episode return over the env's lanes,
+    actual env steps taken)."""
+    obs = env.reset(seed=0)
+    total = np.zeros(env.num_envs)
+    alive = np.ones(env.num_envs, dtype=bool)
+    steps = 0
+    for _ in range(max_steps):
+        out = step(params, obs)
+        actions = np.asarray(out["actions"])
+        obs, rewards, term, trunc = env.step(actions)
+        total += rewards * alive
+        steps += int(alive.sum())
+        alive &= ~(term | trunc)
+        if not alive.any():
+            break
+    return float(np.mean(total)), steps
+
+
+def _evaluate_pair(spec, flat_params, seed: int, sigma: float,
+                   env_id: str, episodes: int, max_steps: int):
+    """One antithetic pair: returns (R(theta + sigma*eps),
+    R(theta - sigma*eps)) with eps ~ N(0, I) regenerated from seed."""
+    import jax
+
+    from ray_tpu.rllib.env.vector_env import make_vector_env
+
+    module = spec.build()
+    template = module.init(jax.random.PRNGKey(0))
+    from jax.flatten_util import ravel_pytree
+
+    _, unravel = ravel_pytree(template)
+    eps = np.random.default_rng(seed).standard_normal(
+        flat_params.shape[0]).astype(np.float32)
+    env = make_vector_env(env_id, episodes)
+    step = _policy_step(module)
+    r_plus, n_plus = _rollout_return(
+        step, unravel(flat_params + sigma * eps), env, max_steps)
+    r_minus, n_minus = _rollout_return(
+        step, unravel(flat_params - sigma * eps), env, max_steps)
+    return seed, r_plus, r_minus, n_plus + n_minus
+
+
+def _centered_ranks(values: np.ndarray) -> np.ndarray:
+    """Fitness shaping: ranks in [-0.5, 0.5] (reference: es utils)."""
+    ranks = np.empty(len(values), dtype=np.float32)
+    ranks[values.argsort()] = np.arange(len(values), dtype=np.float32)
+    return ranks / max(len(values) - 1, 1) - 0.5
+
+
+class ES(Algorithm):
+    config_class = ESConfig
+
+    def setup(self, config: dict) -> None:
+        import jax
+        from jax.flatten_util import ravel_pytree
+
+        cfg = self.algo_config
+        self.module_spec = cfg.module_spec()
+        module = self.module_spec.build()
+        params = module.init(jax.random.PRNGKey(cfg.seed))
+        flat, self._unravel = ravel_pytree(params)
+        self._theta = np.asarray(flat, dtype=np.float32)
+        self._module = module
+        self._policy_step = _policy_step(module)
+        self._eval_task = ray_tpu.remote(_evaluate_pair)
+        self._rng = np.random.default_rng(cfg.seed)
+        self._timesteps_total = 0
+        self.iteration = 0
+        self.learner_group = None
+        self.env_runner_group = None
+        self.local_env_runner = None
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        pairs = max(1, cfg.population_size // 2)
+        seeds = [int(s) for s in
+                 self._rng.integers(0, 2 ** 31 - 1, size=pairs)]
+        theta_ref = ray_tpu.put(self._theta)
+        refs = [self._eval_task.remote(self.module_spec, theta_ref, seed,
+                                 cfg.sigma, cfg.env,
+                                 cfg.episodes_per_perturbation,
+                                 cfg.max_episode_steps)
+                for seed in seeds]
+        results = ray_tpu.get(refs, timeout=600)
+
+        rewards = np.array([[rp, rm] for _, rp, rm, _ in results])
+        ranks = _centered_ranks(rewards.reshape(-1)).reshape(rewards.shape)
+        grad = np.zeros_like(self._theta)
+        for (seed, _, _, _), (rank_p, rank_m) in zip(results, ranks):
+            eps = np.random.default_rng(seed).standard_normal(
+                self._theta.shape[0]).astype(np.float32)
+            grad += (rank_p - rank_m) * eps
+        grad /= 2 * pairs * cfg.sigma
+        self._theta = self._theta + cfg.lr * grad
+
+        # Greedy eval of the (unperturbed) mean policy for reporting.
+        from ray_tpu.rllib.env.vector_env import make_vector_env
+
+        eval_return, eval_steps = _rollout_return(
+            self._policy_step, self._unravel(self._theta),
+            make_vector_env(cfg.env, cfg.report_eval_episodes),
+            cfg.max_episode_steps)
+        # Real env steps from the evaluations, not the worst-case cap.
+        self._timesteps_total += (
+            sum(n for _, _, _, n in results) + eval_steps)
+        return {
+            "episode_return_mean": eval_return,
+            "population_reward_mean": float(rewards.mean()),
+            "population_reward_max": float(rewards.max()),
+            "num_perturbations": 2 * pairs,
+        }
+
+    def get_policy_params(self):
+        return self._unravel(self._theta)
+
+    # -- Trainable protocol (no learner group to checkpoint) ----------
+    def save_checkpoint(self, checkpoint_dir: str):
+        import os
+        import pickle
+
+        with open(os.path.join(checkpoint_dir,
+                               "algorithm_state.pkl"), "wb") as f:
+            pickle.dump({"theta": self._theta,
+                         "iteration": self.iteration,
+                         "timesteps": self._timesteps_total}, f)
+        return checkpoint_dir
+
+    def load_checkpoint(self, checkpoint) -> None:
+        import os
+        import pickle
+
+        path = (checkpoint if isinstance(checkpoint, str)
+                else checkpoint.path)
+        with open(os.path.join(path, "algorithm_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self._theta = state["theta"]
+        self.iteration = state["iteration"]
+        self._timesteps_total = state["timesteps"]
+
+    save = save_checkpoint
+    restore = load_checkpoint
+
+    def cleanup(self) -> None:
+        pass
+
+
+ESConfig.algo_class = ES

@@ -180,9 +180,18 @@ class SACLearner(Learner):
         target_entropy = self.target_entropy
         module = self.module
 
+        # CQL hook (reference: rllib/algorithms/cql/ builds on SAC):
+        # a conservative penalty alpha_cql * (logsumexp_a Q(s, a) -
+        # Q(s, a_data)) keeps offline Q estimates from exploding on
+        # out-of-distribution actions. Zero (the default) is plain SAC.
+        cql_alpha = float(getattr(cfg, "cql_alpha", 0.0))
+        cql_n = int(getattr(cfg, "cql_num_sampled_actions", 10))
+        action_scale = float(getattr(module, "action_scale", 1.0))
+        action_size = int(getattr(module, "action_size", 1))
+
         def update(params, opt_state, target_params, log_alpha,
                    alpha_opt_state, batch, rng):
-            next_rng, pi_rng = jax.random.split(rng)
+            next_rng, pi_rng, cql_rng = jax.random.split(rng, 3)
             alpha = jnp.exp(log_alpha)
 
             # --- critic loss: clipped double-Q soft target ----------
@@ -199,8 +208,25 @@ class SACLearner(Learner):
             def critic_loss_fn(p):
                 q1, q2 = module.q_values(
                     p, batch[Columns.OBS], batch[Columns.ACTIONS])
-                return 0.5 * (jnp.mean(jnp.square(q1 - targets))
-                              + jnp.mean(jnp.square(q2 - targets))), (q1,)
+                loss = 0.5 * (jnp.mean(jnp.square(q1 - targets))
+                              + jnp.mean(jnp.square(q2 - targets)))
+                penalty = jnp.zeros(())
+                if cql_alpha > 0.0:
+                    # CQL(H) with uniform proposals: push down
+                    # logsumexp_a Q(s, a), push up Q on data actions.
+                    b = batch[Columns.OBS].shape[0]
+                    rand_a = jax.random.uniform(
+                        cql_rng, (cql_n, b, action_size),
+                        minval=-action_scale, maxval=action_scale)
+                    rq1, rq2 = jax.vmap(
+                        lambda a: module.q_values(
+                            p, batch[Columns.OBS], a))(rand_a)
+                    lse1 = jax.scipy.special.logsumexp(rq1, axis=0)
+                    lse2 = jax.scipy.special.logsumexp(rq2, axis=0)
+                    penalty = (jnp.mean(lse1 - q1)
+                               + jnp.mean(lse2 - q2))
+                    loss = loss + cql_alpha * penalty
+                return loss, (q1, penalty)
 
             # --- actor loss -----------------------------------------
             def actor_loss_fn(p):
@@ -210,8 +236,8 @@ class SACLearner(Learner):
                 q = jnp.minimum(q1, q2)
                 return jnp.mean(alpha * logp - q), (logp,)
 
-            (critic_loss, (q1_vals,)), critic_grads = jax.value_and_grad(
-                critic_loss_fn, has_aux=True)(params)
+            (critic_loss, (q1_vals, cql_penalty)), critic_grads = \
+                jax.value_and_grad(critic_loss_fn, has_aux=True)(params)
             (actor_loss, (logp,)), actor_grads = jax.value_and_grad(
                 actor_loss_fn, has_aux=True)(params)
             # Actor gradients flow only into pi; critic grads only into
@@ -249,6 +275,7 @@ class SACLearner(Learner):
                 "alpha": alpha,
                 "q_mean": jnp.mean(q1_vals),
                 "entropy": -jnp.mean(logp),
+                "cql_penalty": cql_penalty,
             }
             return (params, opt_state, target_params, log_alpha,
                     alpha_opt_state, metrics)
