@@ -116,8 +116,6 @@ def run_head(port: int, resources: dict | None = None,
     server = GcsServer(port=port, log_dir=SESSION_DIR,
                        persist_path=snapshot_path)
     server.start()
-    with open(os.path.join(SESSION_DIR, "head_address"), "w") as f:
-        f.write(f"{_own_address()}:{server._server.port}")
     dashboard = None
     if dashboard_port is not None:
         # Bind all interfaces: the advertised address file carries the
@@ -137,18 +135,43 @@ def run_head(port: int, resources: dict | None = None,
     client_server = ClientServer(host="0.0.0.0", port=0).start()
     with open(os.path.join(SESSION_DIR, "client_address"), "w") as f:
         f.write(f"{_own_address()}:{client_server.port}")
-    # The head executes client-submitted work, so its heartbeats carry
-    # the live availability of its own runtime.
+    # The head's heartbeat availability reflects BOTH consumers of its
+    # cores: leased executor tasks and client-server work on the
+    # in-process runtime (reporting only one would double-book the
+    # node in status/list_nodes).
     from ray_tpu._private.worker import global_runtime
 
     def head_usage():
+        avail = dict(executor.available_resources())
         runtime = global_runtime()
-        return runtime.available_resources() if runtime else None
+        if runtime is not None:
+            rt_avail = runtime.available_resources()
+            for key, total in runtime.cluster_resources().items():
+                used = total - rt_avail.get(key, 0.0)
+                if used > 0:
+                    avail[key] = avail.get(key, 0.0) - used
+        return avail
+
+    # The head is ALSO an executor node: connected drivers can lease
+    # tasks onto it like any worker daemon (reference: `ray start
+    # --head` contributes its own raylet + worker pool).
+    from ray_tpu._private.node_executor import NodeExecutorService
+
+    head_resources = resources or default_resources()
+    os.environ.setdefault("RAY_TPU_NODE_TAG", f"head-{os.urandom(4).hex()}")
+    executor = NodeExecutorService(resources=head_resources).start()
 
     agent = NodeAgent(f"127.0.0.1:{server._server.port}",
-                      resources or default_resources(),
+                      head_resources,
                       labels={"node_role": "head"},
-                      usage_fn=head_usage)
+                      usage_fn=head_usage,
+                      executor_address=executor.address_for(_own_address()))
+
+    # Written LAST: `start` blocks on this file, so by the time the CLI
+    # returns, the head's own node (executor included) is registered
+    # and `status` immediately shows 1 alive node.
+    with open(os.path.join(SESSION_DIR, "head_address"), "w") as f:
+        f.write(f"{_own_address()}:{server._server.port}")
 
     stop_event = threading.Event()
 
@@ -162,6 +185,7 @@ def run_head(port: int, resources: dict | None = None,
             pass
     finally:
         agent.stop()
+        executor.stop()
         client_server.stop()
         if dashboard is not None:
             dashboard.stop()

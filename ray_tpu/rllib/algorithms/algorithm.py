@@ -161,6 +161,21 @@ class AlgorithmConfig:
                 if not k.startswith("_")}
 
 
+def load_offline_rows(input_) -> list:
+    """Offline-input unwrap shared by BC/MARWIL/CQL: a ray_tpu.data
+    Dataset (take_all) or any iterable of row dicts; None/empty are
+    clear errors instead of shape crashes deep in the learner."""
+    if input_ is None:
+        raise ValueError(
+            "offline algorithms need config.offline_data(input_=...): "
+            "a ray_tpu.data Dataset or a list of row dicts")
+    rows = (list(input_.take_all())
+            if hasattr(input_, "take_all") else list(input_))
+    if not rows:
+        raise ValueError("offline input is empty")
+    return rows
+
+
 class Algorithm(Trainable):
     """Reference: rllib/algorithms/algorithm.py:195.
 

@@ -139,15 +139,10 @@ class MARWIL(Algorithm):
     def setup(self, config: dict) -> None:
         super().setup(config)
         cfg = self.algo_config
-        if cfg.input_ is None:
-            raise ValueError(
-                "offline algorithms need config.offline_data(input_=...): "
-                "a ray_tpu.data Dataset or a list of row dicts")
-        rows = (cfg.input_.take_all()
-                if hasattr(cfg.input_, "take_all") else list(cfg.input_))
-        if not rows:
-            raise ValueError("offline input is empty")
-        self._train_batch = _rows_to_batch(rows, cfg.gamma)
+        from ray_tpu.rllib.algorithms.algorithm import load_offline_rows
+
+        self._train_batch = _rows_to_batch(
+            load_offline_rows(cfg.input_), cfg.gamma)
         self._rng = np.random.default_rng(cfg.seed)
         self._learner_steps = 0
 

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import numpy as np
 
-from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, load_offline_rows
 from ray_tpu.rllib.algorithms.sac import SACConfig
 from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
 from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
@@ -69,15 +69,10 @@ class CQL(Algorithm):
 
     def setup(self, config: dict) -> None:
         cfg = self.algo_config
-        if cfg.input_ is None:
-            raise ValueError("CQL needs config.offline_data(input_=...)")
+        rows = load_offline_rows(cfg.input_)
         if cfg.num_learners > 0:
             raise ValueError("CQL runs on a local learner (like SAC)")
         super().setup(config)
-        rows = (list(cfg.input_.take_all())
-                if hasattr(cfg.input_, "take_all") else list(cfg.input_))
-        if not rows:
-            raise ValueError("CQL offline input is empty")
         batch = _rows_to_transitions(rows)
         self.replay = ReplayBuffer(max(len(rows), 1), seed=cfg.seed)
         self.replay.add(batch)

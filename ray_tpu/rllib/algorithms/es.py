@@ -63,23 +63,39 @@ def _rollout_return(step, params, env, max_steps: int) -> tuple:
     return float(np.mean(total)), steps
 
 
+# Per-process cache: pool workers persist across tasks, and a fresh
+# module + jitted lambda per task would pay a full XLA recompile per
+# perturbation evaluation.
+_EVAL_CACHE: dict = {}
+
+
+def _cached_policy(spec):
+    key = repr((spec.module_class, spec.observation_size,
+                spec.num_actions, getattr(spec, "action_size", 0),
+                sorted(spec.model_config.items(), key=repr)))
+    entry = _EVAL_CACHE.get(key)
+    if entry is None:
+        import jax
+        from jax.flatten_util import ravel_pytree
+
+        module = spec.build()
+        template = module.init(jax.random.PRNGKey(0))
+        _, unravel = ravel_pytree(template)
+        entry = (unravel, _policy_step(module))
+        _EVAL_CACHE[key] = entry
+    return entry
+
+
 def _evaluate_pair(spec, flat_params, seed: int, sigma: float,
                    env_id: str, episodes: int, max_steps: int):
     """One antithetic pair: returns (R(theta + sigma*eps),
     R(theta - sigma*eps)) with eps ~ N(0, I) regenerated from seed."""
-    import jax
-
     from ray_tpu.rllib.env.vector_env import make_vector_env
 
-    module = spec.build()
-    template = module.init(jax.random.PRNGKey(0))
-    from jax.flatten_util import ravel_pytree
-
-    _, unravel = ravel_pytree(template)
+    unravel, step = _cached_policy(spec)
     eps = np.random.default_rng(seed).standard_normal(
         flat_params.shape[0]).astype(np.float32)
     env = make_vector_env(env_id, episodes)
-    step = _policy_step(module)
     r_plus, n_plus = _rollout_return(
         step, unravel(flat_params + sigma * eps), env, max_steps)
     r_minus, n_minus = _rollout_return(

@@ -304,3 +304,53 @@ def test_heartbeat_rejects_dead_node_and_agent_reregisters(gcs):
     alive = [n for n in nodes.values() if n["alive"]]
     assert len(alive) == 1 and alive[0]["resources"] == {"CPU": 3.0}
     agent.stop()
+
+
+def test_head_daemon_executes_driver_tasks(tmp_path):
+    """`start --head` contributes an executor node: a connected driver
+    with zero local CPU runs its tasks ON the head daemon (reference:
+    `ray start --head` includes a raylet + worker pool)."""
+    env = dict(os.environ)
+    env["RAY_TPU_SESSION_DIR"] = str(tmp_path)
+    env["RAY_TPU_SKIP_TPU_DETECTION"] = "1"
+    env["PYTHONPATH"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+
+    def cli(*args, timeout=60):
+        return subprocess.run(
+            [sys.executable, "-m", "ray_tpu", *args],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd="/")
+
+    driver_script = """
+import time
+import ray_tpu
+
+ray_tpu.init(num_cpus=0, address=%(addr)r)
+deadline = time.time() + 30
+while time.time() < deadline and \
+        ray_tpu.cluster_resources().get("CPU", 0) < 1:
+    time.sleep(0.2)
+
+@ray_tpu.remote
+def where():
+    import os
+
+    return os.environ.get("RAY_TPU_NODE_TAG", "")
+
+tag = ray_tpu.get(where.remote(), timeout=60)
+assert tag.startswith("head-"), tag
+print("RAN-ON-HEAD", tag)
+"""
+    try:
+        out = cli("start", "--head", "--port", "0")
+        assert out.returncode == 0, out.stderr + out.stdout
+        address = open(tmp_path / "head_address").read().strip()
+        result = subprocess.run(
+            [sys.executable, "-c", driver_script % {"addr": address}],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd="/")
+        assert result.returncode == 0, result.stderr + result.stdout
+        assert "RAN-ON-HEAD head-" in result.stdout
+    finally:
+        cli("stop")
