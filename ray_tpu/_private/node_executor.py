@@ -169,9 +169,146 @@ def fetch_blob(client: RpcClient, id_bytes: bytes) -> bytes:
             return bytes(out)
 
 
+class _ActorNewError(Exception):
+    """Daemon-actor constructor failed; carries the serialized
+    (exception, traceback) blob from the worker."""
+
+    def __init__(self, blob: bytes):
+        super().__init__("actor constructor failed")
+        self.blob = blob
+
+
+class _MuxPipe:
+    """Multiplexed driver for an actor worker pipe in concurrent mode
+    (max_concurrency > 1): calls are tagged with ids, a reader thread
+    matches interleaved replies, and up to max_concurrency calls run
+    worker-side simultaneously (reference: actor concurrency groups,
+    transport/concurrency_group_manager.h)."""
+
+    def __init__(self, conn):
+        import queue as queue_mod
+
+        self._queue_mod = queue_mod
+        self._conn = conn
+        self._send_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self._pending: dict[int, Any] = {}
+        self._next_id = 0
+        self._closed = False
+        threading.Thread(target=self._reader, daemon=True,
+                         name="daemon-actor-mux-reader").start()
+
+    def call(self, method: str, args_blob: bytes,
+             n_returns: int) -> tuple:
+        from ray_tpu.exceptions import WorkerCrashedError
+
+        slot = self._queue_mod.SimpleQueue()
+        with self._lock:
+            if self._closed:
+                raise WorkerCrashedError("actor process died")
+            self._next_id += 1
+            call_id = self._next_id
+            self._pending[call_id] = slot
+        try:
+            with self._send_lock:
+                self._conn.send(("actor_call_async", call_id, method,
+                                 args_blob, n_returns))
+        except (OSError, BrokenPipeError) as exc:
+            with self._lock:
+                self._pending.pop(call_id, None)
+            raise WorkerCrashedError(
+                f"actor pipe broken: {exc!r}") from exc
+        result = slot.get()
+        if result is None:
+            raise WorkerCrashedError(
+                "actor process died with the call in flight")
+        return result
+
+    def _reader(self) -> None:
+        while True:
+            try:
+                msg = self._conn.recv()
+            except (EOFError, OSError):
+                break
+            if msg[0] != "reply":
+                continue
+            _, call_id, status, payload = msg
+            with self._lock:
+                slot = self._pending.pop(call_id, None)
+            if slot is not None:
+                slot.put((status, payload))
+        with self._lock:
+            self._closed = True
+            stranded = list(self._pending.values())
+            self._pending.clear()
+        for slot in stranded:
+            slot.put(None)
+
+
+class _DaemonActor:
+    """A daemon-hosted actor: a dedicated worker process driven over
+    its pipe (reference: a Ray actor IS a worker process with an
+    ordered scheduling queue — core_worker.cc:2069 CreateActor lands
+    the constructor in a leased worker; transport/actor_scheduling_
+    queue.h orders the calls)."""
+
+    def __init__(self, cls_blob: bytes, args_blob: bytes,
+                 runtime_env: dict | None, max_concurrency: int,
+                 extra_env: dict | None, allow_tpu: bool,
+                 sys_path: list | None):
+        from ray_tpu._private.worker_pool import PoolWorker
+
+        self.max_concurrency = max(1, int(max_concurrency or 1))
+        self._worker = PoolWorker(-1, extra_env=extra_env,
+                                  allow_tpu=allow_tpu)
+        self._mux = None
+        reply = self._worker.request(
+            ("actor_new", cls_blob, args_blob, runtime_env,
+             self.max_concurrency, sys_path))
+        if reply[0] == "err":
+            self._worker.stop()
+            raise _ActorNewError(reply[1])
+        if self.max_concurrency > 1:
+            self._mux = _MuxPipe(self._worker.conn)
+
+    @property
+    def pid(self) -> int:
+        return self._worker.proc.pid
+
+    def alive(self) -> bool:
+        return self._worker.alive()
+
+    def call(self, method: str, args_blob: bytes, n_returns: int) -> tuple:
+        """-> ("ok", packed_list) | ("err", blob); raises
+        WorkerCrashedError/_WorkerUnavailable on process death."""
+        if self._mux is not None:
+            return self._mux.call(method, args_blob, n_returns)
+        return self._worker.request(
+            ("actor_call", method, args_blob, n_returns))
+
+    def kill(self) -> None:
+        try:
+            if self._worker.alive():
+                self._worker.proc.terminate()
+            # Always wait: an already-dead child must be reaped or it
+            # stays a zombie for the daemon's lifetime.
+            self._worker.proc.wait(timeout=2.0)
+        except Exception:  # noqa: BLE001 — escalate
+            self._worker.proc.kill()
+            try:
+                self._worker.proc.wait(timeout=2.0)
+            except Exception:  # noqa: BLE001
+                pass
+        try:
+            self._worker.conn.close()
+        except OSError:
+            pass
+
+
 class NodeExecutorService:
     """The daemon-side execution plane: worker pool + object store +
-    the RPC surface (execute_task / fetch_object / free_objects)."""
+    the RPC surface (execute_task / actor plane / fetch_object /
+    free_objects)."""
 
     def __init__(self, host: str = "0.0.0.0", port: int = 0,
                  pool_size: int | None = None,
@@ -184,9 +321,19 @@ class NodeExecutorService:
         self._resources = dict(resources or {})
         self._running_lock = threading.Lock()
         self._running: dict[str, dict[str, float]] = {}
+        # token -> CPU share temporarily returned by a blocked task.
+        self._blocked_cpu: dict[str, float] = {}
         self._func_cache: dict[str, Callable] = {}
         self._func_lock = threading.Lock()
+        # need_func retries fetch their stashed args by nonce (bounded).
+        self._stashed_args: dict[str, bytes] = {}
+        # Driver import paths adopted via adopt_sys_path; forwarded to
+        # pool workers with each task so by-reference pickles resolve.
+        self._driver_sys_path: list[str] = []
         self.tasks_executed = 0
+        # Actor plane: actor key (bytes) -> _DaemonActor.
+        self._actors: dict[bytes, _DaemonActor] = {}
+        self._actors_lock = threading.Lock()
 
         if pool_size is None:
             pool_size = max(1, min(int(self._resources.get(
@@ -205,6 +352,12 @@ class NodeExecutorService:
         s.register("fetch_object", self.fetch_object)
         s.register("free_objects", self.free_objects)
         s.register("executor_stats", self.executor_stats)
+        s.register("task_block", self.task_block)
+        s.register("task_unblock", self.task_unblock)
+        s.register("adopt_sys_path", self.adopt_sys_path)
+        s.register("create_actor", self.create_actor)
+        s.register("actor_call", self.actor_call)
+        s.register("actor_kill", self.actor_kill)
 
     @property
     def port(self) -> int:
@@ -219,6 +372,11 @@ class NodeExecutorService:
 
     def stop(self) -> None:
         self._server.stop()
+        with self._actors_lock:
+            actors = list(self._actors.values())
+            self._actors.clear()
+        for actor in actors:
+            actor.kill()
         self.pool.shutdown()
         self._peers.close()
         self._shm_client.close_all()
@@ -230,38 +388,55 @@ class NodeExecutorService:
                      args_blob: bytes, n_returns: int,
                      return_keys: list[bytes],
                      runtime_env: dict | None = None,
-                     resources: dict | None = None) -> tuple:
+                     resources: dict | None = None,
+                     task_token: str | None = None,
+                     client_addr: str | None = None,
+                     args_ref: str | None = None) -> tuple:
         """Run one task; reply ("ok", [result descriptors]) where each
         descriptor is ("inline", blob) or ("stored", size), or
-        ("need_func",) when the digest is unknown here, or
-        ("err", exc_blob)."""
+        ("need_func", nonce) when the digest is unknown here (args are
+        stashed under the nonce so the retry ships the function alone),
+        or ("err", exc_blob)."""
         # Admission: with several drivers sharing this node, each one
         # accounts only its own leases — reject work beyond capacity and
         # let the submitter spill to another node (reference: raylet
         # spillback, cluster_task_manager.h:42 / HandleRequestWorkerLease
         # redirecting the lease).
-        # NOTE: the reservation spans the whole execution, including any
-        # time the task spends blocked — daemon-side tasks cannot make
-        # nested submissions today (no driver endpoint in daemon pools),
-        # so blocked-in-get CPU release does not apply here yet.
+        # The reservation is keyed by the driver's task token so a task
+        # blocked in a nested get() can return its CPU (task_block /
+        # task_unblock, driven by the owning driver's block context —
+        # reference: workers blocked in ray.get return their CPU to the
+        # raylet).
         demand = dict(resources or {})
         demand.setdefault("CPU", 1.0)
-        token = f"exec-{digest[:8]}-{os.urandom(4).hex()}"
-        with self._running_lock:
-            for key, cap in self._resources.items():
-                used = sum(float(d.get(key, 0.0))
-                           for d in self._running.values())
-                if used + float(demand.get(key, 0.0)) > float(cap) + 1e-9:
-                    return ("busy",)
-            # Reserve atomically with the check (two concurrent calls
-            # must not both pass a half-full node).
-            self._running[token] = demand
+        token = task_token or f"exec-{digest[:8]}-{os.urandom(4).hex()}"
+        if args_blob is None and args_ref is not None:
+            with self._func_lock:
+                args_blob = self._stashed_args.pop(args_ref, None)
+            if args_blob is None:
+                return ("stale_args",)
+        if not self._try_reserve(token, demand):
+            return ("busy",)
         try:
             with self._func_lock:
                 func = self._func_cache.get(digest)
             if func is None:
                 if func_blob is None:
-                    return ("need_func",)
+                    # Stash the args so the retry ships the function
+                    # alone (never re-sends possibly-large args). Bounded
+                    # by entries AND bytes: a driver that dies between
+                    # the two calls must not pin blobs here forever.
+                    nonce = os.urandom(8).hex()
+                    with self._func_lock:
+                        self._stashed_args[nonce] = args_blob
+                        total = sum(len(b) for b in
+                                    self._stashed_args.values())
+                        while self._stashed_args and (
+                                len(self._stashed_args) > 256
+                                or total > 256 * 1024 * 1024):
+                            victim = next(iter(self._stashed_args))
+                            total -= len(self._stashed_args.pop(victim))
+                    return ("need_func", nonce)
                 # Deserialize OUTSIDE the lock: loading can import heavy
                 # modules and must not stall other tasks' cache lookups.
                 try:
@@ -275,12 +450,14 @@ class NodeExecutorService:
             args, kwargs = self._resolve_fetch_args(args, kwargs)
             values = self._run(func, digest, func_blob, args, kwargs,
                                n_returns, runtime_env,
-                               resources or {})
+                               resources or {}, task_token=token,
+                               client_addr=client_addr)
         except BaseException as exc:  # noqa: BLE001 — shipped to driver
             return ("err", _exc_blob(exc))
         finally:
             with self._running_lock:
                 self._running.pop(token, None)
+                self._blocked_cpu.pop(token, None)
         self.tasks_executed += 1
 
         out = []
@@ -297,6 +474,20 @@ class NodeExecutorService:
                 out.append(("stored", len(blob)))
         return ("ok", out)
 
+    def _try_reserve(self, token: str, demand: dict) -> bool:
+        """Admission: reserve ``demand`` under ``token`` atomically with
+        the capacity check (two concurrent calls must not both pass a
+        half-full node) — shared by tasks and actors (reference: raylet
+        admission before the lease grant, cluster_task_manager.h:42)."""
+        with self._running_lock:
+            for key, cap in self._resources.items():
+                used = sum(float(d.get(key, 0.0))
+                           for d in self._running.values())
+                if used + float(demand.get(key, 0.0)) > float(cap) + 1e-9:
+                    return False
+            self._running[token] = demand
+            return True
+
     def fetch_object(self, id_bytes: bytes, offset: int,
                      length: int):
         return self.store.read_chunk(id_bytes, offset, length)
@@ -307,9 +498,208 @@ class NodeExecutorService:
     def executor_stats(self) -> dict:
         with self._running_lock:
             running = len(self._running)
+        with self._actors_lock:
+            num_actors = len(self._actors)
         return {"tasks_executed": self.tasks_executed,
                 "running": running, "store": self.store.stats(),
-                "pid": os.getpid()}
+                "num_actors": num_actors, "pid": os.getpid()}
+
+    def adopt_sys_path(self, paths: list) -> int:
+        """Adopt a driver's import paths (existing directories only) so
+        functions/classes pickled BY REFERENCE from the driver's modules
+        resolve here and in this node's workers. One-machine clusters
+        share the filesystem, so the paths are valid; on real multi-host
+        the nonexistent ones are skipped and runtime_env py_modules is
+        the supported route (reference: the function manager assumes
+        importable modules; runtime_env ships the rest)."""
+        import sys
+
+        added = 0
+        for path in paths:
+            if path and path not in sys.path and os.path.isdir(path):
+                sys.path.append(path)
+                added += 1
+        with self._func_lock:
+            merged = list(self._driver_sys_path)
+            merged += [p for p in paths
+                       if p and p not in merged and os.path.isdir(p)]
+            self._driver_sys_path = merged
+        return added
+
+    def task_block(self, token: str) -> bool:
+        """A task on this node blocked in a nested get(): return its CPU
+        to the admission ledger so dependent work can land here
+        (otherwise a parent waiting on a child scheduled to this node
+        deadlocks — reference: blocked workers release their CPU to the
+        raylet)."""
+        with self._running_lock:
+            demand = self._running.get(token)
+            if demand is None or token in self._blocked_cpu:
+                return False
+            cpu = float(demand.get("CPU", 0.0))
+            if cpu <= 0:
+                return False
+            self._blocked_cpu[token] = cpu
+            reduced = dict(demand)
+            reduced["CPU"] = 0.0
+            self._running[token] = reduced
+        return True
+
+    def task_unblock(self, token: str) -> bool:
+        """The blocked task resumed: re-reserve its CPU (may transiently
+        overcommit; admission of NEW work still checks the full ledger)."""
+        with self._running_lock:
+            cpu = self._blocked_cpu.pop(token, None)
+            demand = self._running.get(token)
+            if cpu is None or demand is None:
+                return False
+            restored = dict(demand)
+            restored["CPU"] = restored.get("CPU", 0.0) + cpu
+            self._running[token] = restored
+        return True
+
+    # --------------------------------------------------------- actor plane
+
+    def create_actor(self, actor_key: bytes, cls_blob: bytes,
+                     args_blob: bytes, runtime_env: dict | None = None,
+                     max_concurrency: int = 1,
+                     resources: dict | None = None,
+                     client_addr: str | None = None,
+                     sys_path: list | None = None) -> tuple:
+        """Host an actor on this node: admission-reserve its resources
+        for its lifetime, spawn a dedicated worker process, run the
+        constructor there. -> ("ok", pid) | ("busy",) | ("err", blob).
+        (Reference: GcsActorScheduler leases a worker on the chosen node
+        and pushes the creation task — gcs_actor_scheduler.h.)"""
+        with self._actors_lock:
+            existing = self._actors.get(actor_key)
+        if existing is not None:
+            if existing.alive():
+                # Driver retry after a lost reply: already up.
+                return ("ok", existing.pid)
+            # Dead copy: reap it (wait the process, close the pipe,
+            # release its reservation) before re-creating.
+            self._reap_actor(actor_key)
+        demand = dict(resources or {})  # actors default to 0 CPU
+        token = "actor-" + actor_key.hex()
+        if not self._try_reserve(token, demand):
+            return ("busy",)
+        try:
+            args, kwargs = serialization.deserialize_from_buffer(
+                memoryview(args_blob))
+            args, kwargs = self._resolve_fetch_args(args, kwargs)
+            init_blob = serialization.serialize_framed((args, kwargs))
+            extra_env = {}
+            if client_addr:
+                extra_env["RAY_TPU_DRIVER_CLIENT_ADDR"] = client_addr
+            # TPU actors own the accelerator from their process. Whole-
+            # chip demands are safe: admission then rejects TPU tasks on
+            # this node (the daemon process would contend for the same
+            # runtime). Fractional TPU sharing across processes is the
+            # user's risk — same caveat as the reference's fractional
+            # GPUs (reference: TPU_VISIBLE_CHIPS isolation, tpu.py:30).
+            allow_tpu = any(k.startswith("TPU") for k in demand)
+            actor = _DaemonActor(cls_blob, init_blob, runtime_env,
+                                 max_concurrency, extra_env, allow_tpu,
+                                 sys_path)
+        except _ActorNewError as exc:
+            with self._running_lock:
+                self._running.pop(token, None)
+            return ("err", exc.blob)
+        except BaseException as exc:  # noqa: BLE001 — shipped to driver
+            with self._running_lock:
+                self._running.pop(token, None)
+            return ("err", _exc_blob(exc))
+        with self._actors_lock:
+            self._actors[actor_key] = actor
+        return ("ok", actor.pid)
+
+    def actor_call(self, actor_key: bytes, method: str,
+                   args_blob: bytes, n_returns: int,
+                   return_keys: list[bytes]) -> tuple:
+        """Invoke a method on a hosted actor. -> ("ok", descriptors)
+        with the execute_task result shape (inline/stored per return),
+        ("err", blob) for application errors, ("dead", blob) when the
+        actor process died, ("gone",) when this daemon does not host the
+        actor (e.g. it restarted)."""
+        from ray_tpu._private.worker_pool import (
+            _WorkerUnavailable,
+        )
+        from ray_tpu.exceptions import WorkerCrashedError
+
+        with self._actors_lock:
+            actor = self._actors.get(actor_key)
+        if actor is None:
+            return ("gone",)
+        try:
+            args, kwargs = serialization.deserialize_from_buffer(
+                memoryview(args_blob))
+            args, kwargs = self._resolve_fetch_args(args, kwargs)
+            call_blob = serialization.serialize_framed((args, kwargs))
+            status, payload = actor.call(method, call_blob,
+                                         max(1, n_returns))
+        except (WorkerCrashedError, _WorkerUnavailable) as exc:
+            self._reap_actor(actor_key)
+            return ("dead", _exc_blob(exc))
+        except BaseException as exc:  # noqa: BLE001 — shipped to driver
+            return ("err", _exc_blob(exc))
+        if status == "err":
+            return ("err", payload)
+        out = []
+        for id_bytes, packed in zip(return_keys, payload):
+            blob = self._packed_to_blob(id_bytes, packed)
+            if blob is None:
+                out.append(packed)  # ("err", blob) passthrough
+                continue
+            if len(blob) <= INLINE_REPLY_BYTES:
+                out.append(("inline", blob))
+            else:
+                self.store.put(id_bytes, blob)
+                out.append(("stored", len(blob)))
+        return ("ok", out)
+
+    def actor_kill(self, actor_key: bytes) -> bool:
+        return self._reap_actor(actor_key)
+
+    def _reap_actor(self, actor_key: bytes) -> bool:
+        with self._actors_lock:
+            actor = self._actors.pop(actor_key, None)
+        with self._running_lock:
+            self._running.pop("actor-" + actor_key.hex(), None)
+        if actor is None:
+            return False
+        actor.kill()
+        return True
+
+    def _packed_to_blob(self, id_bytes: bytes, packed: tuple):
+        """Worker-pipe result descriptor -> framed blob (None for error
+        descriptors, which pass through to the driver)."""
+        from ray_tpu._private.ids import ObjectID as _OID
+        from ray_tpu._private.shm_store import (
+            ArenaDescriptor,
+            ShmDescriptor,
+        )
+
+        kind = packed[0]
+        if kind == "inline":
+            return packed[1]  # already framed bytes
+        if kind == "arena":
+            desc = ArenaDescriptor(packed[1], packed[2])
+            self._shm_directory.register_arena(_OID(id_bytes), desc)
+            value = self._shm_client.get(desc)
+            blob = serialization.serialize_framed(value)
+            self._shm_directory.free(_OID(id_bytes))
+            return blob
+        if kind == "shm":
+            desc = ShmDescriptor(packed[1], packed[2])
+            rid = _OID(id_bytes)
+            self._shm_directory.adopt(rid, desc)
+            value = self._shm_client.get(desc)
+            blob = serialization.serialize_framed(value)
+            self._shm_client.close_segment(desc.name)
+            self._shm_directory.free(rid)
+            return blob
+        return None  # ("err", blob)
 
     def available_resources(self) -> dict[str, float]:
         """Heartbeat piggyback: total minus the demands of running
@@ -342,7 +732,8 @@ class NodeExecutorService:
         return serialization.deserialize_from_buffer(memoryview(blob))
 
     def _run(self, func, digest, func_blob, args, kwargs, n_returns,
-             runtime_env, resources) -> list:
+             runtime_env, resources, task_token=None,
+             client_addr=None) -> list:
         if any(k.startswith("TPU") for k in resources):
             # TPU tasks run in the daemon process: it owns this node's
             # JAX/TPU runtime (pool workers are pinned to CPU).
@@ -354,10 +745,13 @@ class NodeExecutorService:
             if func_blob is None:
                 func_blob = serialization.dumps_function(func)
             return_ids = [ObjectID() for _ in range(max(1, n_returns))]
+            with self._func_lock:
+                sys_path = self._driver_sys_path or None
             try:
                 pairs = self.pool.run_task_blobs(
                     digest, func_blob, args_blob, n_returns, return_ids,
-                    runtime_env=runtime_env)
+                    runtime_env=runtime_env, task_token=task_token,
+                    client_addr=client_addr, sys_path=sys_path)
             except _RemoteTaskError as rte:
                 rte.cause.__ray_tpu_remote_tb__ = rte.remote_tb
                 raise rte.cause from None
@@ -445,6 +839,7 @@ class RemoteNodeHandle:
                                   connect_timeout_s=2.0)
         self._digest_lock = threading.Lock()
         self.known_digests: set[str] = set()
+        self._sys_path_sent = False
 
     def ping(self) -> bool:
         try:
@@ -452,22 +847,54 @@ class RemoteNodeHandle:
         except (RpcError, OSError):
             return False
 
+    def ensure_sys_path(self) -> None:
+        """One-shot: hand the node this driver's import paths so
+        by-reference pickles (module-level functions/classes) resolve
+        there (one-machine clusters share the filesystem)."""
+        if self._sys_path_sent:
+            return
+        import sys
+
+        from ray_tpu._private.rpc import RpcMethodError
+
+        try:
+            self._control.call("adopt_sys_path",
+                               [p for p in sys.path if p])
+            self._sys_path_sent = True
+        except (RpcError, RpcMethodError, OSError):
+            pass  # best-effort; retried on the next execute
+
     def execute(self, digest: str, func_blob: bytes, args_blob: bytes,
                 n_returns: int, return_keys: list[bytes],
                 runtime_env: dict | None,
-                resources: dict[str, float]) -> list:
+                resources: dict[str, float],
+                task_token: str | None = None,
+                client_addr: str | None = None) -> list:
         """Lease + push + reply. Ships the function blob only the first
         time this node sees its digest."""
+        self.ensure_sys_path()
         with self._digest_lock:
             known = digest in self.known_digests
         reply = self.pool.call(
             "execute_task", digest, None if known else func_blob,
-            args_blob, n_returns, return_keys, runtime_env, resources)
+            args_blob, n_returns, return_keys, runtime_env, resources,
+            task_token, client_addr)
         if reply[0] == "need_func":
-            # Node restarted / cache miss despite our bookkeeping.
+            # Node restarted / cache miss despite our bookkeeping: send
+            # the function ALONE — the node stashed the args from the
+            # first attempt under a nonce, so they are not re-shipped.
+            nonce = reply[1] if len(reply) > 1 else None
             reply = self.pool.call(
-                "execute_task", digest, func_blob, args_blob, n_returns,
-                return_keys, runtime_env, resources)
+                "execute_task", digest, func_blob,
+                None if nonce else args_blob, n_returns,
+                return_keys, runtime_env, resources, task_token,
+                client_addr, nonce)
+            if reply[0] == "stale_args":
+                # The stash was evicted between the two calls: full resend.
+                reply = self.pool.call(
+                    "execute_task", digest, func_blob, args_blob,
+                    n_returns, return_keys, runtime_env, resources,
+                    task_token, client_addr)
         if reply[0] == "busy":
             raise NodeBusyError(self.address)
         with self._digest_lock:

@@ -1,0 +1,437 @@
+"""Driver-side handle runtime for actors hosted on worker-node daemons.
+
+TPU-native analogue of the reference's remote-actor machinery: the GCS
+actor scheduler picks a node and pushes the creation task to a leased
+worker there (reference: src/ray/gcs/gcs_server/gcs_actor_scheduler.h,
+src/ray/core_worker/core_worker.cc:2069 CreateActor); method calls are
+pushed directly to that worker with per-caller ordering (reference:
+transport/direct_actor_task_submitter.h, sequential_actor_submit_
+queue.h); on node death the GCS reschedules the actor onto a survivor
+while restarts remain (reference: gcs_actor_manager.h max_restarts).
+
+``RemoteActor`` mirrors LocalActor/ProcessActor's interface
+(submit/kill/is_dead/wait_started) so the Runtime treats all three
+uniformly. The actor's process is spawned by the daemon
+(node_executor.create_actor) and lives in the daemon's process tree;
+this class owns placement, restarts, and result sealing.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from ray_tpu._private import serialization
+from ray_tpu._private.ids import ActorID, ObjectID
+from ray_tpu._private.scheduler import format_traceback
+from ray_tpu.exceptions import (
+    ActorDiedError,
+    ActorError,
+    PendingCallsLimitExceeded,
+    TaskCancelledError,
+)
+
+
+class RemoteActor:
+    """An actor executing in a dedicated process on a worker daemon."""
+
+    # The Runtime's submit queue leaves ObjectRef args in place (waiting
+    # only for them to seal); this class converts them to FetchRef
+    # location hints so argument bytes flow node-to-node.
+    resolves_refs = True
+
+    def __init__(
+        self,
+        actor_id: ActorID,
+        cls: type,
+        init_args: tuple,
+        init_kwargs: dict,
+        runtime,
+        *,
+        node_id,
+        handle,
+        resources: dict[str, float],
+        max_concurrency: int = 1,
+        max_restarts: int = 0,
+        max_pending_calls: int = -1,
+        creation_return_id: ObjectID | None = None,
+        on_death: Callable[[ActorID, str], None] | None = None,
+        on_restart: Callable[[ActorID], None] | None = None,
+        runtime_env: dict | None = None,
+    ):
+        import queue as queue_mod
+
+        self.actor_id = actor_id
+        self.node_id = node_id
+        self._key = actor_id.binary()
+        self._cls = cls
+        self._init_args = init_args
+        self._init_kwargs = init_kwargs
+        self._runtime = runtime
+        self._handle = handle
+        self._resources = dict(resources)
+        self._max_concurrency = max(1, int(max_concurrency))
+        self._max_restarts = max_restarts
+        self._max_pending_calls = max_pending_calls
+        self._runtime_env = runtime_env
+        self._on_death = on_death
+        self._on_restart = on_restart
+        self._creation_return_id = creation_return_id
+        self._queue: queue_mod.Queue = queue_mod.Queue()
+        self._lock = threading.Lock()
+        self._pending = 0
+        self._dead = False
+        self._death_reason: str | None = None
+        self._num_restarts = 0
+        self._gen = 0  # bumps on every crash-handling pass (single-flight)
+        self.pid: int | None = None
+        self._started = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"ray_tpu-ractor-{cls.__name__}")
+        self._thread.start()
+
+    # Interface shared with LocalActor/ProcessActor ------------------------
+
+    def submit(self, call) -> None:
+        with self._lock:
+            if self._dead:
+                self._fail_call(call, ActorDiedError(
+                    self.actor_id, self._death_reason or "actor has died"))
+                return
+            if 0 <= self._max_pending_calls <= self._pending:
+                self._fail_call(call, PendingCallsLimitExceeded(
+                    f"actor {self._cls.__name__} has {self._pending} "
+                    f"pending calls"))
+                return
+            self._pending += 1
+            self._queue.put(call)
+
+    def kill(self, reason: str = "killed via kill()",
+             no_restart: bool = True) -> None:
+        with self._lock:
+            if self._dead:
+                return
+            gen = self._gen
+            handle = self._handle
+        try:
+            handle._control.call("actor_kill", self._key)
+        except Exception:  # noqa: BLE001 — daemon gone; process dies with it
+            pass
+        if not no_restart:
+            # Consumes a restart (or dies); off-thread — relocation can
+            # block and kill() must return promptly.
+            threading.Thread(
+                target=self._handle_crash, args=(gen, reason),
+                daemon=True).start()
+        else:
+            self._mark_dead(reason)
+
+    def is_dead(self) -> bool:
+        with self._lock:
+            return self._dead
+
+    def wait_started(self, timeout: float | None = None) -> bool:
+        return self._started.wait(timeout)
+
+    def notify_node_death(self, node_id) -> None:
+        """The daemon hosting this actor died: restart on a survivor (or
+        die permanently) even with no call in flight. Runs off-thread —
+        the caller is the health monitor and relocation can block."""
+        with self._lock:
+            if self._dead or node_id != self.node_id:
+                return
+            gen = self._gen
+        threading.Thread(
+            target=self._handle_crash,
+            args=(gen, f"node {node_id.hex()[:8]} died"),
+            daemon=True,
+            name=f"ray_tpu-ractor-restart-{self._cls.__name__}").start()
+
+    # Internals ------------------------------------------------------------
+
+    def _fail_call(self, call, error: BaseException) -> None:
+        for rid in call.return_ids:
+            self._runtime.store.put_error(rid, error)
+
+    def _run(self) -> None:
+        try:
+            # Cache hit: create_actor's serializability probe already
+            # exported this class through _function_blob.
+            self._cls_blob = self._runtime._function_blob(self._cls)[1]
+            init_blob = self._runtime._convert_remote_args(
+                self._init_args, self._init_kwargs)
+        except BaseException as exc:  # noqa: BLE001 — not remotable
+            self._mark_dead(f"constructor args not serializable: {exc!r}")
+            if self._creation_return_id is not None:
+                self._runtime.store.put_error(
+                    self._creation_return_id,
+                    ActorError(exc, format_traceback(exc),
+                               f"{self._cls.__name__}.__init__"))
+            return
+        err = self._create_on_cluster(init_blob)
+        if err == "dead":
+            # kill() raced creation; _mark_dead already ran there.
+            if self._creation_return_id is not None:
+                self._runtime.store.put_error(
+                    self._creation_return_id, ActorDiedError(
+                        self.actor_id,
+                        self._death_reason or "killed during creation"))
+            return
+        if err is not None:
+            self._mark_dead(f"constructor failed: {err!r}")
+            if self._creation_return_id is not None:
+                self._runtime.store.put_error(self._creation_return_id, err)
+            return
+        if self._creation_return_id is not None:
+            self._runtime.store.put(self._creation_return_id, None)
+        self._started.set()
+        if self._max_concurrency > 1:
+            self._run_concurrent()
+        else:
+            self._run_sequential()
+
+    def _create_on_cluster(self, init_blob: bytes,
+                           timeout: float = 300.0):
+        """Create the instance on the currently-leased node, relocating
+        on busy/unreachable daemons. Returns None on success or the
+        creation error."""
+        import os
+        import sys
+
+        from ray_tpu._private.rpc import RpcError, RpcMethodError
+
+        deadline = time.monotonic() + timeout
+        client_addr = self._runtime._client_server_addr() or None
+        while True:
+            with self._lock:
+                if self._dead:
+                    # kill() raced the creation; stop without touching
+                    # ledgers twice (the abort path below cleans up).
+                    return "dead"
+                handle = self._handle
+                node_id = self.node_id
+            node_dead = False
+            handle.ensure_sys_path()
+            try:
+                reply = handle.pool.call(
+                    "create_actor", self._key, self._cls_blob, init_blob,
+                    self._runtime_env, self._max_concurrency,
+                    self._resources, client_addr,
+                    [p for p in sys.path if p and os.path.isdir(p)])
+            except RpcMethodError as exc:
+                return ActorError(exc.cause, exc.remote_tb,
+                                  f"{self._cls.__name__}.__init__")
+            except (RpcError, OSError):
+                if not handle.ping():
+                    self._runtime._drop_remote_node(node_id)
+                    node_dead = True
+                else:
+                    # Reply lost after send: the daemon may have created
+                    # (or still be constructing) a copy. Reap it before
+                    # relocating, or the copy is orphaned holding its
+                    # admission reservation (and a stateful actor would
+                    # split brain).
+                    try:
+                        handle._control.call("actor_kill", self._key)
+                    except Exception:  # noqa: BLE001 — best-effort
+                        pass
+                reply = ("busy",)
+            if reply[0] == "ok":
+                self.pid = reply[1]
+                with self._lock:
+                    raced_kill = self._dead
+                if raced_kill:
+                    # kill() landed between the RPC and here: reap the
+                    # fresh copy and give back the re-acquired lease.
+                    try:
+                        handle._control.call("actor_kill", self._key)
+                    except Exception:  # noqa: BLE001
+                        pass
+                    self._runtime._release_actor_lease(self.actor_id)
+                    return "dead"
+                return None
+            if reply[0] == "err":
+                exc, tb = serialization.deserialize_from_buffer(
+                    memoryview(reply[1]))
+                return ActorError(exc, tb,
+                                  f"{self._cls.__name__}.__init__")
+            # busy (or unreachable): move the lease — possibly back to
+            # the same node once its capacity frees. Never attempt a
+            # create without holding a lease (the ledger must reflect
+            # where the actor actually runs).
+            placed = None
+            while placed is None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return TimeoutError(
+                        f"could not place actor {self._cls.__name__} "
+                        f"({self._resources}) on any worker daemon "
+                        f"within {timeout:.0f}s")
+                placed = self._runtime._relocate_actor_lease(
+                    self.actor_id, self._resources,
+                    exclude={node_id} if node_dead else None,
+                    timeout=min(remaining, 30.0))
+            with self._lock:
+                self.node_id, self._handle = placed
+            time.sleep(0.05)  # saturated cluster: poll, don't hammer
+
+    def _run_sequential(self) -> None:
+        while True:
+            call = self._queue.get()
+            if call is None:
+                return
+            self._dispatch_call(call)
+
+    def _run_concurrent(self) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(
+                max_workers=self._max_concurrency,
+                thread_name_prefix=f"ractor-{self._cls.__name__}") as pool:
+            while True:
+                call = self._queue.get()
+                if call is None:
+                    return
+                pool.submit(self._dispatch_call, call)
+
+    def _dispatch_call(self, call) -> None:
+        from ray_tpu._private.rpc import RpcError, RpcMethodError
+
+        with self._lock:
+            self._pending = max(0, self._pending - 1)
+            if self._dead:
+                self._fail_call(call, ActorDiedError(
+                    self.actor_id, self._death_reason or "actor died"))
+                return
+            gen = self._gen
+            handle = self._handle
+            node_id = self.node_id
+        if getattr(call, "cancelled", False):
+            self._fail_call(call, TaskCancelledError())
+            return
+        site = f"{self._cls.__name__}.{call.method_name}"
+        try:
+            args_blob = self._runtime._convert_remote_args(
+                call.args, call.kwargs)
+        except BaseException as exc:  # noqa: BLE001 — unpicklable args
+            self._fail_call(call, ActorError(
+                exc, "", f"{site} (argument serialization)"))
+            return
+        try:
+            reply = handle.pool.call(
+                "actor_call", self._key, call.method_name, args_blob,
+                len(call.return_ids),
+                [r.binary() for r in call.return_ids])
+        except RpcMethodError as exc:
+            self._fail_call(call, ActorError(exc.cause, exc.remote_tb, site))
+            return
+        except (RpcError, OSError) as exc:
+            if handle.ping():
+                # One reset socket on a healthy daemon must not destroy
+                # the actor (mirror of the task path's dead-vs-transient
+                # distinction): fail only this call — it may or may not
+                # have executed, which the caller must treat like any
+                # in-flight loss.
+                self._fail_call(call, ActorError(
+                    exc, "", f"{site} (transport failure; actor alive)"))
+                return
+            self._fail_call(call, ActorDiedError(
+                self.actor_id,
+                f"node {node_id.hex()[:8]} unreachable: {exc}"))
+            self._handle_crash(gen, f"node unreachable: {exc}")
+            return
+        if reply[0] == "ok":
+            try:
+                self._runtime._seal_remote_results(
+                    call.return_ids, reply[1], node_id, handle.address)
+            except BaseException as exc:  # noqa: BLE001 — result unpicklable
+                self._fail_call(call, ActorError(
+                    exc, getattr(exc, "__ray_tpu_remote_tb__", "") or "",
+                    site))
+        elif reply[0] == "err":
+            exc, tb = serialization.deserialize_from_buffer(
+                memoryview(reply[1]))
+            self._fail_call(call, ActorError(exc, tb, site))
+        else:  # ("dead", blob) | ("gone",)
+            reason = "actor process died"
+            if reply[0] == "gone":
+                reason = "hosting daemon lost the actor (restarted?)"
+            self._fail_call(call, ActorDiedError(self.actor_id, reason))
+            self._handle_crash(gen, reason)
+
+    def _handle_crash(self, gen: int, reason: str) -> None:
+        """Single-flight restart-or-die (reference: GcsActorManager
+        restart path — the owner reschedules while max_restarts
+        allows)."""
+        with self._lock:
+            if self._dead or gen != self._gen:
+                return  # another thread already handled this failure
+            self._gen += 1
+            restartable = self._num_restarts < self._max_restarts
+            if restartable:
+                self._num_restarts += 1
+            handle = self._handle
+            node_id = self.node_id
+        if not restartable:
+            self._mark_dead(reason)
+            return
+        exclude = None
+        if not handle.ping():
+            self._runtime._drop_remote_node(node_id)
+            exclude = {node_id}
+        else:
+            # The old daemon is alive: kill its copy of the actor before
+            # recreating elsewhere, or the process is orphaned, its
+            # admission reservation leaks, and a stateful actor splits
+            # brain.
+            try:
+                handle._control.call("actor_kill", self._key)
+            except Exception:  # noqa: BLE001 — best-effort
+                pass
+        placed = self._runtime._relocate_actor_lease(
+            self.actor_id, self._resources, exclude=exclude, timeout=120.0)
+        if placed is None:
+            self._mark_dead(
+                f"no surviving worker daemon to restart on ({reason})")
+            return
+        with self._lock:
+            self.node_id, self._handle = placed
+        try:
+            init_blob = self._runtime._convert_remote_args(
+                self._init_args, self._init_kwargs)
+            err = self._create_on_cluster(init_blob, timeout=120.0)
+        except BaseException as exc:  # noqa: BLE001
+            err = exc
+        if err == "dead":
+            return  # kill() raced the restart; already cleaned up
+        if err is not None:
+            self._mark_dead(f"restart failed: {err!r}")
+            return
+        if self._on_restart is not None:
+            self._on_restart(self.actor_id)
+
+    def _mark_dead(self, reason: str, notify: bool = True) -> None:
+        import queue as queue_mod
+
+        with self._lock:
+            if self._dead:
+                return
+            self._dead = True
+            self._death_reason = reason
+            drained = []
+            try:
+                while True:
+                    item = self._queue.get_nowait()
+                    if item is not None:
+                        drained.append(item)
+            except queue_mod.Empty:
+                pass
+            self._pending = 0
+        self._queue.put(None)  # wake the drain loop
+        for call in drained:
+            self._fail_call(call, ActorDiedError(self.actor_id, reason))
+        self._started.set()  # never leave waiters hanging
+        if notify and self._on_death is not None:
+            self._on_death(self.actor_id, reason)

@@ -426,6 +426,7 @@ class BlockedResourceContext:
             self._depth += 1
         if release:
             self._cluster.release(self._node_id, self._cpu_only)
+            self._on_release()
 
     def unblock(self, force: bool = False):
         with self._depth_lock:
@@ -441,11 +442,21 @@ class BlockedResourceContext:
             # socket; transient overcommit is the lesser evil (pick_node
             # keeps negative-availability nodes unschedulable).
             self._cluster.force_acquire(self._node_id, self._cpu_only)
+            self._on_reacquire()
             return
         # Reacquire; spin-wait is acceptable because release is imminent
         # by construction (we only woke because our object sealed).
         while not self._cluster.try_acquire(self._node_id, self._cpu_only):
             time.sleep(0.001)
+        self._on_reacquire()
+
+    def _on_release(self):
+        """Hook for subclasses: the blocked task's CPU was just given
+        back (remote tasks also release the executing daemon's
+        admission here)."""
+
+    def _on_reacquire(self):
+        """Hook for subclasses: the task resumed and re-holds its CPU."""
 
     def drain(self):
         """Restore admission balance at task end: if the worker died (or

@@ -527,6 +527,12 @@ class Runtime:
         logger.warning("Node %s died; reconstructing its objects",
                        node_id.hex()[:8])
         self.remove_node(node_id)
+        # Actors hosted on the dead node restart on a survivor (or die
+        # permanently) — even parked ones with no call in flight
+        # (reference: GcsActorManager restarts actors on node death).
+        for actor in list(self._actors.values()):
+            if getattr(actor, "node_id", None) == node_id:
+                actor.notify_node_death(node_id)
         with self._locations_lock:
             lost = [oid for oid, nid in self._object_locations.items()
                     if nid == node_id]
@@ -764,21 +770,19 @@ class Runtime:
                 self._record_location(rid, node.node_id)
         return True
 
-    def _try_execute_remote(self, spec: TaskSpec, node: NodeState,
-                            handle) -> bool:
-        """Dispatch to a worker-node daemon's executor (reference: lease
-        request to a remote raylet + push to its worker pool,
-        node_manager.cc:1714). Args already held on remote nodes ship as
-        FetchRef location hints — the consuming node pulls peer-to-peer
-        and the driver never relays the bytes. Returns False when the
-        function/args can't cross a process boundary (caller runs the
-        task locally in-thread)."""
+    def _convert_remote_args(self, args: tuple, kwargs: dict) -> bytes:
+        """ObjectRef args become FetchRef location hints (the consuming
+        node pulls peer-to-peer; the driver never relays the bytes) or
+        inline values; everything else ships by value. Returns the
+        framed args blob; raises when the args cannot cross a process
+        boundary (reference: args are objects nodes fetch via the
+        ownership directory, not payloads inlined per task)."""
         from ray_tpu._private import serialization
-        from ray_tpu._private.node_executor import FetchRef, RemoteBlob
-        from ray_tpu._private.rpc import RpcError
-        from ray_tpu.exceptions import WorkerCrashedError
-
-        from ray_tpu._private.node_executor import INLINE_REPLY_BYTES
+        from ray_tpu._private.node_executor import (
+            INLINE_REPLY_BYTES,
+            FetchRef,
+            RemoteBlob,
+        )
         from ray_tpu._private.object_store import _sizeof
 
         def convert(a):
@@ -800,18 +804,63 @@ class Runtime:
                 return FetchRef(id_bytes, self._export_addr)
             return value
 
+        conv_args = tuple(convert(a) for a in args)
+        conv_kwargs = {k: convert(v) for k, v in kwargs.items()}
+        return serialization.serialize_framed((conv_args, conv_kwargs))
+
+    def _seal_remote_results(self, return_ids, results, node_id,
+                             address) -> None:
+        """Seal an execute/actor-call reply: inline values locally,
+        larger results as lazy RemoteBlob placeholders with a recorded
+        location."""
+        from ray_tpu._private import serialization
+        from ray_tpu._private.node_executor import RemoteBlob
+
+        for rid, packed in zip(return_ids, results):
+            if packed[0] == "inline":
+                self.store.put(rid, serialization.deserialize_from_buffer(
+                    memoryview(packed[1])))
+            elif packed[0] == "stored":
+                # Result stays on the producing node; pull lazily.
+                self.store.put(rid, RemoteBlob(
+                    node_id.hex(), address, packed[1]))
+                self._record_location(rid, node_id)
+            else:  # ("err", blob): this return value failed to pickle
+                exc, tb = serialization.deserialize_from_buffer(
+                    memoryview(packed[1]))
+                exc.__ray_tpu_remote_tb__ = tb
+                raise exc
+
+    def _try_execute_remote(self, spec: TaskSpec, node: NodeState,
+                            handle) -> bool:
+        """Dispatch to a worker-node daemon's executor (reference: lease
+        request to a remote raylet + push to its worker pool,
+        node_manager.cc:1714). Returns False when the function/args
+        can't cross a process boundary (caller runs the task locally
+        in-thread)."""
+        from ray_tpu._private.rpc import RpcError
+        from ray_tpu.exceptions import WorkerCrashedError
+
         try:
             digest, func_blob = self._function_blob(spec.func)
-            args = tuple(convert(a) for a in spec.args)
-            kwargs = {k: convert(v) for k, v in spec.kwargs.items()}
-            args_blob = serialization.serialize_framed((args, kwargs))
+            args_blob = self._convert_remote_args(spec.args, spec.kwargs)
         except Exception:  # noqa: BLE001 — unpicklable: run locally
             return False
         return_keys = [rid.binary() for rid in spec.return_ids]
+        # The task token keys the daemon's admission entry AND this
+        # driver's block context: a nested get() from the daemon's pool
+        # worker releases the task's CPU on BOTH ledgers while blocked.
+        token = spec.task_id.hex()
+        ctx = _RemoteBlockContext(self.cluster, node.node_id,
+                                  spec.resources, handle, token)
+        with self._inflight_blocks_lock:
+            self._inflight_blocks[token] = ctx
         try:
             results = handle.execute(
                 digest, func_blob, args_blob, spec.num_returns,
-                return_keys, spec.runtime_env, spec.resources)
+                return_keys, spec.runtime_env, spec.resources,
+                task_token=token,
+                client_addr=self._client_server_addr() or None)
         except (RpcError, OSError) as exc:
             # Distinguish a dead node from a transient call failure: a
             # drop marks every object on the node lost and fires
@@ -822,20 +871,13 @@ class Runtime:
                 f"node {node.node_id.hex()[:8]} unreachable during "
                 f"task {spec.name}: {exc}")
             raise err from exc
-        for rid, packed in zip(spec.return_ids, results):
-            if packed[0] == "inline":
-                self.store.put(rid, serialization.deserialize_from_buffer(
-                    memoryview(packed[1])))
-            elif packed[0] == "stored":
-                # Result stays on the producing node; pull lazily.
-                self.store.put(rid, RemoteBlob(
-                    node.node_id.hex(), handle.address, packed[1]))
-                self._record_location(rid, node.node_id)
-            else:  # ("err", blob): this return value failed to pickle
-                exc, tb = serialization.deserialize_from_buffer(
-                    memoryview(packed[1]))
-                exc.__ray_tpu_remote_tb__ = tb
-                raise exc
+        finally:
+            with self._inflight_blocks_lock:
+                popped = self._inflight_blocks.pop(token, None)
+            if popped is not None:
+                popped.drain()
+        self._seal_remote_results(spec.return_ids, results,
+                                  node.node_id, handle.address)
         return True
 
     def ensure_client_server(self) -> None:
@@ -1108,6 +1150,43 @@ class Runtime:
 
         strategy = scheduling_strategy or SchedulingStrategy()
 
+        # Remote placement probe: an actor can only execute on a worker
+        # daemon when its class and init args cross a process boundary.
+        # Unserializable actors (closures over driver state) stay on the
+        # driver host, as do zero-resource default-strategy actors
+        # (cheap; keeping them local preserves thread-actor semantics).
+        serializable = True
+        with self._remote_nodes_lock:
+            any_remote = bool(self._remote_nodes)
+        if any_remote:
+            from ray_tpu._private import serialization as _ser
+
+            try:
+                # _function_blob caches by identity, so RemoteActor's own
+                # dumps_function of the same class is a cache hit.
+                self._function_blob(cls)
+                if args or kwargs:  # skip the probe for no-arg actors
+                    probe_args = tuple(
+                        None if isinstance(a, ObjectRef) else a
+                        for a in args)
+                    probe_kwargs = {
+                        k: None if isinstance(v, ObjectRef) else v
+                        for k, v in kwargs.items()}
+                    _ser.serialize_framed((probe_args, probe_kwargs))
+            except Exception:  # noqa: BLE001 — not remotable
+                serializable = False
+
+        def remote_exclude() -> set | None:
+            """Nodes an actor must avoid: remote daemons when the actor
+            cannot leave the driver process."""
+            keep_local = (not serializable or (
+                strategy.kind == "DEFAULT"
+                and not any(resources.values())))
+            if not keep_local:
+                return None
+            with self._remote_nodes_lock:
+                return set(self._remote_nodes) or None
+
         def start_actor():
             # Lease actor resources for its lifetime.
             node_id = None
@@ -1122,7 +1201,8 @@ class Runtime:
                 else:
                     deadline = time.monotonic() + 300.0
                     while node_id is None:
-                        node = self.cluster.pick_node(resources, strategy)
+                        node = self.cluster.pick_node(
+                            resources, strategy, exclude=remote_exclude())
                         if node is not None and self.cluster.try_acquire(
                                 node.node_id, resources):
                             node_id = node.node_id
@@ -1155,7 +1235,34 @@ class Runtime:
             def on_restart(aid):
                 self.gcs.update_actor_state(aid, "ALIVE")
 
-            if process:
+            # Record the lease BEFORE constructing the actor: a remote
+            # actor's creation thread may relocate (busy daemon) and
+            # must find the current lease to release it.
+            self._actor_leases[actor_id] = (node_id, resources, pg_info)
+            remote_handle = None
+            if node_id is not None and serializable:
+                with self._remote_nodes_lock:
+                    remote_handle = self._remote_nodes.get(node_id)
+            if remote_handle is not None:
+                from ray_tpu._private.remote_actor import RemoteActor
+
+                # The actor executes ON the leased daemon node — its
+                # process lives in that daemon's tree, so the lease and
+                # the execution site agree (reference: the GCS actor
+                # scheduler creates the actor on the node whose
+                # resources it claimed, gcs_actor_scheduler.h).
+                self.ensure_client_server()
+                actor = RemoteActor(
+                    actor_id, cls, args, kwargs, self,
+                    node_id=node_id, handle=remote_handle,
+                    resources=resources,
+                    max_restarts=max_restarts,
+                    max_pending_calls=max_pending_calls,
+                    max_concurrency=max_concurrency,
+                    creation_return_id=creation_rid, on_death=on_death,
+                    on_restart=on_restart,
+                    runtime_env=self._package_runtime_env(runtime_env))
+            elif process:
                 from ray_tpu._private.worker_pool import ProcessActor
 
                 # The actor's process needs the nested-API endpoint in
@@ -1181,7 +1288,6 @@ class Runtime:
                     creation_return_id=creation_rid, on_death=on_death,
                     on_restart=on_restart)
             self._actors[actor_id] = actor
-            self._actor_leases[actor_id] = (node_id, resources, pg_info)
             record.handle = actor
             self.gcs.update_actor_state(actor_id, "ALIVE")
 
@@ -1245,8 +1351,20 @@ class Runtime:
                     continue
                 # Resolve ObjectRef args in queue order (blocking keeps order).
                 try:
-                    call.args, call.kwargs, _ = resolve_args(
-                        call.args, call.kwargs, lambda ref: self.get([ref])[0])
+                    if getattr(actor, "resolves_refs", False):
+                        # Remote actors convert refs to FetchRef
+                        # location hints themselves (node-to-node
+                        # pulls); here just wait for the deps to seal
+                        # WITHOUT materializing remote blobs locally.
+                        for dep in [a for a in call.args
+                                    if isinstance(a, ObjectRef)] + [
+                                v for v in call.kwargs.values()
+                                if isinstance(v, ObjectRef)]:
+                            self.store.get(dep.id())
+                    else:
+                        call.args, call.kwargs, _ = resolve_args(
+                            call.args, call.kwargs,
+                            lambda ref: self.get([ref])[0])
                 except BaseException as exc:  # noqa: BLE001
                     for rid in call.return_ids:
                         self.store.put_error(rid, exc)
@@ -1256,6 +1374,81 @@ class Runtime:
         threading.Thread(target=drain, daemon=True,
                          name=f"ray_tpu-actor-submit-{actor_id.hex()[:8]}").start()
         return submit_queue
+
+    def _release_actor_lease(self, actor_id: ActorID) -> None:
+        """Give back an actor's resource lease (idempotent)."""
+        lease = self._actor_leases.pop(actor_id, None)
+        if lease is None:
+            return
+        node_id, resources, pg_info = lease
+        if pg_info is not None:
+            self.placement_groups.release_to_bundle(
+                pg_info[0], pg_info[1], resources)
+        else:
+            self.cluster.release(node_id, resources)
+
+    def _relocate_actor_lease(self, actor_id: ActorID,
+                              resources: dict[str, float],
+                              exclude: set | None = None,
+                              timeout: float = 300.0):
+        """Move a remote actor's resource lease to a (different) worker
+        daemon: release the current lease, acquire on another remote
+        node. Returns (node_id, handle) or None when no remote node can
+        host it within the timeout (reference: GcsActorScheduler re-
+        schedules restarting actors onto surviving nodes)."""
+        lease = self._actor_leases.pop(actor_id, None)
+        if lease is not None:
+            old_node, old_resources, old_pg = lease
+            if old_pg is not None:
+                # A placement-group actor is pinned to its bundle: it
+                # may only be recreated where the bundle lives, never
+                # silently relocated outside the gang (STRICT_* co-
+                # location contracts). If the bundle's node is gone the
+                # actor dies and group-level recovery (FailureConfig)
+                # re-forms the whole gang — slice semantics.
+                self.placement_groups.release_to_bundle(
+                    old_pg[0], old_pg[1], old_resources)
+                try:
+                    node_id = self.placement_groups.acquire_from_bundle(
+                        old_pg[0], old_pg[1], resources)
+                except Exception:  # noqa: BLE001 — bundle gone
+                    return None
+                node_state = self.cluster.get_node(node_id)
+                with self._remote_nodes_lock:
+                    handle = self._remote_nodes.get(node_id)
+                if (handle is None or node_state is None
+                        or not node_state.alive
+                        or (exclude and node_id in exclude)):
+                    self.placement_groups.release_to_bundle(
+                        old_pg[0], old_pg[1], resources)
+                    return None
+                self._actor_leases[actor_id] = (node_id, resources, old_pg)
+                return node_id, handle
+            self.cluster.release(old_node, old_resources)
+        deadline = time.monotonic() + timeout
+        exclude = set(exclude or ())
+        while True:
+            with self._remote_nodes_lock:
+                remote_ids = set(self._remote_nodes)
+            # Only worker daemons can host a RemoteActor.
+            local_ids = {n.node_id for n in self.cluster.nodes()
+                         if n.node_id not in remote_ids}
+            node = self.cluster.pick_node(
+                resources, SchedulingStrategy(),
+                exclude=local_ids | exclude)
+            if node is not None and self.cluster.try_acquire(
+                    node.node_id, resources):
+                with self._remote_nodes_lock:
+                    handle = self._remote_nodes.get(node.node_id)
+                if handle is None:  # dropped between pick and acquire
+                    self.cluster.release(node.node_id, resources)
+                else:
+                    self._actor_leases[actor_id] = (
+                        node.node_id, resources, None)
+                    return node.node_id, handle
+            if time.monotonic() > deadline:
+                return None
+            self.cluster.wait_for_change(0.1)
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:
         actor = self._actors.get(actor_id)
@@ -1526,6 +1719,31 @@ class Runtime:
             os.environ.pop("RAY_TPU_ARENA_NAME", None)
             self.arena = None
         self.gcs.finish_job(self.job_id)
+
+
+class _RemoteBlockContext(BlockedResourceContext):
+    """Block context for a task executing on a worker-node daemon: a
+    nested blocked get() releases the task's CPU on the driver's
+    cluster ledger (base class) AND on the daemon's admission ledger
+    (task_block/task_unblock RPCs), so dependent work can be admitted
+    to the same daemon while the parent waits."""
+
+    def __init__(self, cluster, node_id, resources, handle, token):
+        super().__init__(cluster, node_id, resources)
+        self._handle = handle
+        self._token = token
+
+    def _on_release(self):
+        try:
+            self._handle._control.call("task_block", self._token)
+        except Exception:  # noqa: BLE001 — daemon gone; best-effort
+            pass
+
+    def _on_reacquire(self):
+        try:
+            self._handle._control.call("task_unblock", self._token)
+        except Exception:  # noqa: BLE001 — daemon gone; best-effort
+            pass
 
 
 class _ForeignActorProxy:

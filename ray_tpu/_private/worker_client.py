@@ -50,6 +50,20 @@ def active_worker_runtime() -> "WorkerModeRuntime | None":
     return _active
 
 
+def set_driver_addr(address: str) -> None:
+    """Point the nested-API proxy at a (possibly different) owning
+    driver. Daemon pool workers execute tasks from many drivers; each
+    task carries its owner's client-server address, and the proxy
+    singleton is rebuilt when the owner changes."""
+    global _active
+    with _active_lock:
+        prior = os.environ.get("RAY_TPU_DRIVER_CLIENT_ADDR")
+        os.environ["RAY_TPU_DRIVER_CLIENT_ADDR"] = address
+        if prior != address and _active is not None:
+            _active._rpc.close()
+            _active = None
+
+
 def get_worker_runtime() -> "WorkerModeRuntime":
     """Per-process singleton, created on first API use in a worker."""
     global _active

@@ -264,7 +264,17 @@ def _serve(conn, client: ShmClient, arena=None,
             elif kind == "ping":
                 conn.send(("pong", os.getpid()))
             elif kind == "task":
-                _, digest, func_blob, args_blob, n_returns, renv, token = msg
+                (_, digest, func_blob, args_blob, n_returns, renv,
+                 token) = msg[:7]
+                # Daemon pools serve many drivers: the owning driver's
+                # client-server address rides with each task so nested
+                # API calls reach the right owner (reference: every
+                # worker knows its owner's CoreWorker address).
+                client_addr = msg[7] if len(msg) > 7 else None
+                if len(msg) > 8 and msg[8]:
+                    # Driver import paths for by-reference pickles.
+                    sys.path.extend(p for p in msg[8]
+                                    if p not in sys.path)
                 if func_blob is not None:
                     func = serialization.loads_function(func_blob)
                     func_cache[digest] = func
@@ -277,6 +287,8 @@ def _serve(conn, client: ShmClient, arena=None,
                 # driver can release this task's CPU while it blocks.
                 from ray_tpu._private import worker_client
 
+                if client_addr:
+                    worker_client.set_driver_addr(client_addr)
                 worker_client.set_task_token(token)
                 try:
                     with _runtime_env_ctx(renv):
@@ -296,7 +308,14 @@ def _serve(conn, client: ShmClient, arena=None,
                     values = list(result)
                 conn.send(("ok", _pack_results(values, arena, arena_max)))
             elif kind == "actor_new":
-                _, cls_blob, args_blob, renv, max_concurrency = msg
+                _, cls_blob, args_blob, renv, max_concurrency = msg[:5]
+                # Remote actors: the creating driver's sys.path entries
+                # (classes pickled by reference must resolve on a daemon
+                # that never saw the driver's import paths; one-machine
+                # clusters share the filesystem, so the paths are valid).
+                if len(msg) > 5 and msg[5]:
+                    sys.path.extend(p for p in msg[5]
+                                    if p not in sys.path)
                 cls = serialization.loads_function(cls_blob)
                 args, kwargs = serialization.deserialize_from_buffer(
                     memoryview(args_blob))
@@ -406,7 +425,8 @@ def _serve_actor_concurrent(conn, instance, client: ShmClient, arena,
 # --------------------------------------------------------------------------
 
 
-def _spawn_worker(name: str):
+def _spawn_worker(name: str, extra_env: dict | None = None,
+                  allow_tpu: bool = False):
     """Start a worker as a fresh interpreter that connects back over a
     Unix socket (reference: worker_pool.h spawns language workers that
     connect to the raylet socket).
@@ -435,10 +455,13 @@ def _spawn_worker(name: str):
     authkey = secrets.token_bytes(16)
     listener = Listener(addr, family="AF_UNIX", authkey=authkey)
     env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)  # skip TPU plugin registration
-    env["RAY_TPU_SKIP_TPU_DETECTION"] = "1"
-    env["JAX_PLATFORMS"] = "cpu"
+    if not allow_tpu:
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # skip TPU plugin registration
+        env["RAY_TPU_SKIP_TPU_DETECTION"] = "1"
+        env["JAX_PLATFORMS"] = "cpu"
     env["RAY_TPU_WORKER_AUTHKEY"] = authkey.hex()
+    if extra_env:
+        env.update({k: str(v) for k, v in extra_env.items()})
     # The parent may have extended sys.path at runtime (e.g. a script
     # that inserted the framework's location); the child's `-m` import
     # must resolve ray_tpu before the hello handshake can deliver it.
@@ -491,13 +514,15 @@ def _spawn_worker(name: str):
 class PoolWorker:
     """One worker process + its pipe. One in-flight request at a time."""
 
-    def __init__(self, index: int):
+    def __init__(self, index: int, extra_env: dict | None = None,
+                 allow_tpu: bool = False):
         self.index = index
         self._lock = threading.Lock()
         # Function-blob digests this worker has already received (the
         # function-manager pattern: ship each function once per worker).
         self.known_digests: set[str] = set()
-        self.proc, self.conn = _spawn_worker(f"w{index}")
+        self.proc, self.conn = _spawn_worker(
+            f"w{index}", extra_env=extra_env, allow_tpu=allow_tpu)
 
     def request(self, msg: tuple) -> tuple:
         """Send one request and wait for its reply.
@@ -679,6 +704,8 @@ class WorkerPool:
                        n_returns: int, return_ids: list[ObjectID],
                        runtime_env: dict | None = None,
                        task_token: str | None = None,
+                       client_addr: str | None = None,
+                       sys_path: list | None = None,
                        ) -> list[tuple[ObjectID, Any]]:
         """Execute on a pool worker; returns [(return_id, value)] pairs.
 
@@ -698,7 +725,8 @@ class WorkerPool:
             try:
                 reply = worker.request(
                     ("task", digest, send_blob, args_blob, n_returns,
-                     runtime_env, task_token))
+                     runtime_env, task_token, client_addr,
+                     sys_path if send_blob is not None else None))
             except _WorkerUnavailable:
                 continue  # _release (in finally) already spawns a live one
             finally:

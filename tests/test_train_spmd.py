@@ -88,6 +88,88 @@ def test_jax_trainer_two_process_spmd_mesh(fresh_runtime):
     assert abs(result.metrics["loss"] - expected) < 1e-5
 
 
+def _multinode_loop(config):
+    """Each gang member proves its placement: allgather (pid, a node-tag
+    hash) across the jax.distributed world so rank 0 can report every
+    member's location."""
+    import os
+
+    import jax
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from ray_tpu import train
+
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 4, jax.devices()
+
+    def parent_pid() -> int:
+        with open(f"/proc/{os.getpid()}/status") as f:
+            for line in f:
+                if line.startswith("PPid:"):
+                    return int(line.split()[1])
+        return -1
+
+    mine = np.array([os.getpid(), parent_pid()], dtype=np.int64)
+    gathered = multihost_utils.process_allgather(mine)
+    train.report({
+        "world": jax.process_count(),
+        "pids": [int(x) for x in gathered[:, 0]],
+        "ppids": [int(x) for x in gathered[:, 1]],
+    })
+
+
+def test_jax_trainer_gang_spans_two_daemon_nodes():
+    """VERDICT r3 #2 acceptance: a STRICT_SPREAD worker group lands on
+    two *worker daemons* (real OS processes), forms one
+    jax.distributed world (jax.process_count()==2), and the two member
+    processes are children of two DIFFERENT daemon PIDs."""
+    import time
+
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir="/tmp/ray_tpu_test_train_gang")
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    try:
+        assert cluster.wait_for_nodes(2, timeout=30)
+        ray_tpu.init(num_cpus=0, address=cluster.address)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if ray_tpu.cluster_resources().get("CPU", 0) >= 4:
+                break
+            time.sleep(0.2)
+        assert ray_tpu.cluster_resources().get("CPU", 0) >= 4
+
+        scaling = ScalingConfig(
+            num_workers=2,
+            use_process_workers=True,
+            placement_strategy="STRICT_SPREAD",
+            worker_env={
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=2"},
+        )
+        trainer = JaxTrainer(
+            _multinode_loop,
+            jax_distributed_config="auto",
+            scaling_config=scaling,
+            run_config=RunConfig(report_timeout_s=180.0),
+        )
+        result = trainer.fit()
+        assert result.error is None, result.error
+        assert result.metrics["world"] == 2
+        daemon_pids = {n.pid for n in cluster.worker_nodes}
+        ppids = result.metrics["ppids"]
+        assert set(ppids) <= daemon_pids, (
+            f"gang processes {result.metrics['pids']} (parents {ppids}) "
+            f"are not children of the daemons {daemon_pids}")
+        assert len(set(ppids)) == 2, (
+            f"gang did not span two daemons: parents {ppids}")
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
 def test_process_worker_gang_reports_and_stops(fresh_runtime):
     """Channel-actor reporting: process workers stream reports and obey
     the stop criteria (no jax.distributed involved)."""
