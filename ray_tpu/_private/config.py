@@ -55,6 +55,17 @@ _DEFAULTS: dict[str, Any] = {
     "log_to_driver": True,
     # Placement groups.
     "placement_group_commit_timeout_s": 30.0,
+    # Worker-node daemon object store (primary copies of task/actor
+    # results). Over the cap, the oldest primaries spill to disk and
+    # restore on fetch (reference: local_object_manager.h:110 spilling).
+    "node_store_primary_limit_mb": 4096,
+    "node_store_spill_dir": "/tmp/ray_tpu_node_spill",
+    # Owner-death GC on daemons: blobs/actors of a driver whose client
+    # endpoint stays unreachable past the grace period are swept
+    # (reference: owner-death cleanup in the ownership protocol,
+    # reference_count.h:61). 0 disables the sweeper.
+    "owner_sweep_period_ms": 5000,
+    "owner_dead_grace_s": 15.0,
 }
 
 

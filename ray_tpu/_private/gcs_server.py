@@ -188,6 +188,11 @@ class GcsServer:
             self._restore_snapshot()
         self._server = RpcServer(host, port)
         self._shutdown = threading.Event()
+        # owner addr -> {object hex -> node hex}; pruned when the owner
+        # stops refreshing (its driver exited).
+        self._object_locations: dict[str, dict[str, str]] = {}
+        self._obj_loc_seen: dict[str, float] = {}
+        self._obj_loc_lock = threading.Lock()
         self._register_methods()
         self._monitor = threading.Thread(
             target=self._monitor_loop, daemon=True, name="gcs-monitor")
@@ -218,6 +223,12 @@ class GcsServer:
         s.register("list_jobs", self.jobs.list)
         # Cluster-wide info.
         s.register("cluster_resources", self._cluster_resources)
+        # Object-location table (reference:
+        # ownership_based_object_directory.h — owner -> holding nodes;
+        # here owners batch-publish their primary-copy locations).
+        s.register("object_locations_update",
+                   self._object_locations_update)
+        s.register("list_object_locations", self._list_object_locations)
 
     # -- node service -------------------------------------------------
     def _register_node(self, address: str, resources: dict,
@@ -250,6 +261,35 @@ class GcsServer:
         self.gcs.mark_node_dead(NodeID(node_id_bytes))
         return True
 
+    def _object_locations_update(self, owner: str, adds: list,
+                                 removes: list) -> int:
+        """Batched owner-published location deltas; an empty update is a
+        keepalive that refreshes the owner's lease on its entries."""
+        with self._obj_loc_lock:
+            table = self._object_locations.setdefault(owner, {})
+            for obj_hex, node_hex in adds:
+                table[obj_hex] = node_hex
+            for obj_hex in removes:
+                table.pop(obj_hex, None)
+            self._obj_loc_seen[owner] = time.monotonic()
+            if not table:
+                self._object_locations.pop(owner, None)
+            return len(table)
+
+    def _list_object_locations(self, owner: str | None = None) -> dict:
+        with self._obj_loc_lock:
+            if owner is not None:
+                return dict(self._object_locations.get(owner, {}))
+            return {o: dict(t) for o, t in self._object_locations.items()}
+
+    def _prune_object_locations(self, ttl_s: float = 60.0) -> None:
+        now = time.monotonic()
+        with self._obj_loc_lock:
+            for owner in [o for o, seen in self._obj_loc_seen.items()
+                          if now - seen > ttl_s]:
+                self._obj_loc_seen.pop(owner, None)
+                self._object_locations.pop(owner, None)
+
     def _cluster_resources(self) -> dict:
         total: dict[str, float] = {}
         for r in self.gcs.list_nodes():
@@ -274,6 +314,7 @@ class GcsServer:
                 if record.alive and (now - record.last_heartbeat
                                      > self.heartbeat_timeout_s):
                     self.gcs.mark_node_dead(record.node_id)
+            self._prune_object_locations()
             if self._persist_path:
                 self._save_snapshot()
 

@@ -67,24 +67,53 @@ class NodeBusyError(Exception):
 
 
 class NodeObjectStore:
-    """Serialized-blob store of a node daemon: task results (until the
-    owner frees them) + pulled peer objects (evictable cache)."""
+    """Serialized-blob store of a node daemon: task/actor results
+    (primary copies, owner-tagged, spillable to disk past the cap) +
+    pulled peer objects (evictable cache).
 
-    def __init__(self, cache_limit_bytes: int = 512 * 1024 * 1024):
+    Reference: the raylet's LocalObjectManager — primary copies live
+    until the owner frees them or dies (local_object_manager.h:110
+    SpillObjects / owner-death cleanup)."""
+
+    def __init__(self, cache_limit_bytes: int = 512 * 1024 * 1024,
+                 primary_limit_bytes: int | None = None,
+                 spill_dir: str | None = None):
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
         self._lock = threading.Lock()
-        self._blobs: dict[bytes, bytes] = {}
+        self._blobs: dict[bytes, bytes] = {}  # insertion-ordered
         self._cached: dict[bytes, None] = {}  # pulled copies, FIFO evict
         self._cache_limit = cache_limit_bytes
         self._cache_bytes = 0
+        self._primary_limit = (
+            primary_limit_bytes if primary_limit_bytes is not None
+            else int(GLOBAL_CONFIG.node_store_primary_limit_mb) * 1024 * 1024)
+        self._spill_dir = (spill_dir or GLOBAL_CONFIG.node_store_spill_dir)
+        self._primary_bytes = 0
+        # id -> (path, size): primaries moved to disk; restored on fetch.
+        self._spilled: dict[bytes, tuple[str, int]] = {}
+        # Ownership: id -> owner key; owner -> ids (owner-death sweep).
+        self._owner_of: dict[bytes, str] = {}
+        self._owned_ids: dict[str, set[bytes]] = {}
         self.fetches_served = 0
+        self.spills = 0
+        self.restores = 0
 
-    def put(self, id_bytes: bytes, blob: bytes, cached: bool = False) -> None:
+    def put(self, id_bytes: bytes, blob: bytes, cached: bool = False,
+            owner: str | None = None) -> None:
+        spill_victims: list[tuple[bytes, bytes]] = []
         with self._lock:
             old = self._blobs.get(id_bytes)
             if old is not None and id_bytes in self._cached:
                 self._cache_bytes -= len(old)
                 del self._cached[id_bytes]
+            elif old is not None:
+                self._primary_bytes -= len(old)
+            self._drop_spilled(id_bytes)
             self._blobs[id_bytes] = blob
+            if owner is not None and not cached:
+                self._owner_of[id_bytes] = owner
+                self._owned_ids.setdefault(owner, set()).add(id_bytes)
             if cached:
                 self._cached[id_bytes] = None
                 self._cache_bytes += len(blob)
@@ -94,31 +123,143 @@ class NodeObjectStore:
                     dropped = self._blobs.pop(victim, None)
                     if dropped is not None:
                         self._cache_bytes -= len(dropped)
+            else:
+                self._primary_bytes += len(blob)
+                # Over the cap: spill the OLDEST primaries to disk (the
+                # newest blob is the one most likely to be fetched next).
+                # Victims are only SELECTED here — they stay readable in
+                # _blobs until the disk write lands (_spill_one), so a
+                # concurrent fetch/free never sees the object in neither
+                # map.
+                projected = self._primary_bytes
+                for victim in list(self._blobs):
+                    if projected <= self._primary_limit:
+                        break
+                    if victim in self._cached or victim == id_bytes:
+                        continue
+                    vblob = self._blobs[victim]
+                    projected -= len(vblob)
+                    spill_victims.append((victim, vblob))
+        for victim, vblob in spill_victims:
+            self._spill_one(victim, vblob)
+
+    def _spill_one(self, id_bytes: bytes, blob: bytes) -> None:
+        os.makedirs(self._spill_dir, exist_ok=True)
+        # Unique per attempt: two concurrent put()s may both pick this
+        # victim; each must own its file so the loser's cleanup cannot
+        # unlink the winner's registered copy.
+        path = os.path.join(
+            self._spill_dir,
+            f"{os.getpid()}-{id_bytes.hex()}-{os.urandom(4).hex()}.blob")
+        try:
+            with open(path, "wb") as f:
+                f.write(blob)
+        except OSError:
+            return  # disk full/unwritable: blob simply stays in memory
+        with self._lock:
+            # The blob stayed visible during the write; only now swap it
+            # to the disk copy — unless a concurrent free() removed it
+            # or a reseal replaced it, in which case the file is stale.
+            if self._blobs.get(id_bytes) is not blob:
+                stale = True
+            else:
+                del self._blobs[id_bytes]
+                self._primary_bytes -= len(blob)
+                self._spilled[id_bytes] = (path, len(blob))
+                self.spills += 1
+                stale = False
+        if stale:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def _drop_spilled(self, id_bytes: bytes) -> None:
+        # Caller holds self._lock.
+        entry = self._spilled.pop(id_bytes, None)
+        if entry is not None:
+            try:
+                os.unlink(entry[0])
+            except OSError:
+                pass
 
     def get(self, id_bytes: bytes) -> bytes | None:
         with self._lock:
-            return self._blobs.get(id_bytes)
+            blob = self._blobs.get(id_bytes)
+            spilled = self._spilled.get(id_bytes)
+        if blob is not None:
+            return blob
+        if spilled is not None:
+            try:
+                with open(spilled[0], "rb") as f:
+                    data = f.read()
+            except OSError:
+                return None
+            with self._lock:
+                self.restores += 1
+            return data
+        return None
+
+    def _forget(self, id_bytes: bytes) -> bool:
+        # Caller holds self._lock. Returns True if the id existed.
+        existed = False
+        blob = self._blobs.pop(id_bytes, None)
+        if blob is not None:
+            existed = True
+            if id_bytes in self._cached:
+                del self._cached[id_bytes]
+                self._cache_bytes -= len(blob)
+            else:
+                self._primary_bytes -= len(blob)
+        if id_bytes in self._spilled:
+            existed = True
+            self._drop_spilled(id_bytes)
+        owner = self._owner_of.pop(id_bytes, None)
+        if owner is not None:
+            ids = self._owned_ids.get(owner)
+            if ids is not None:
+                ids.discard(id_bytes)
+                if not ids:
+                    del self._owned_ids[owner]
+        return existed
 
     def free(self, ids: list[bytes]) -> int:
         with self._lock:
-            n = 0
-            for id_bytes in ids:
-                blob = self._blobs.pop(id_bytes, None)
-                if blob is not None:
-                    n += 1
-                    if id_bytes in self._cached:
-                        del self._cached[id_bytes]
-                        self._cache_bytes -= len(blob)
-            return n
+            return sum(1 for id_bytes in ids if self._forget(id_bytes))
+
+    def free_owner(self, owner: str) -> int:
+        """Owner-death sweep: drop every primary the owner left here."""
+        with self._lock:
+            ids = list(self._owned_ids.get(owner, ()))
+            return sum(1 for id_bytes in ids if self._forget(id_bytes))
+
+    def owners(self) -> list[str]:
+        with self._lock:
+            return list(self._owned_ids)
 
     def read_chunk(self, id_bytes: bytes, offset: int,
                    length: int) -> tuple[int, bytes] | None:
         with self._lock:
             blob = self._blobs.get(id_bytes)
-            if blob is None:
-                return None
+            spilled = self._spilled.get(id_bytes)
+            if blob is not None:
+                self.fetches_served += 1
+                return len(blob), blob[offset:offset + length]
+        if spilled is None:
+            return None
+        # Spilled primary: stream the chunk straight from disk (restore
+        # on fetch — reference: spilled_object_reader.h).
+        path, size = spilled
+        try:
+            with open(path, "rb") as f:
+                f.seek(offset)
+                chunk = f.read(length)
+        except OSError:
+            return None
+        with self._lock:
             self.fetches_served += 1
-            return len(blob), blob[offset:offset + length]
+            self.restores += 1
+        return size, chunk
 
     def stats(self) -> dict:
         with self._lock:
@@ -126,6 +267,11 @@ class NodeObjectStore:
                 "num_blobs": len(self._blobs),
                 "bytes": sum(len(b) for b in self._blobs.values()),
                 "fetches_served": self.fetches_served,
+                "spilled_blobs": len(self._spilled),
+                "spilled_bytes": sum(s for _, s in self._spilled.values()),
+                "spills": self.spills,
+                "restores": self.restores,
+                "owners": len(self._owned_ids),
             }
 
 
@@ -259,6 +405,7 @@ class _DaemonActor:
         from ray_tpu._private.worker_pool import PoolWorker
 
         self.max_concurrency = max(1, int(max_concurrency or 1))
+        self.owner: str | None = None  # creating driver's client addr
         self._worker = PoolWorker(-1, extra_env=extra_env,
                                   allow_tpu=allow_tpu)
         self._mux = None
@@ -334,6 +481,8 @@ class NodeExecutorService:
         # Actor plane: actor key (bytes) -> _DaemonActor.
         self._actors: dict[bytes, _DaemonActor] = {}
         self._actors_lock = threading.Lock()
+        self._stop_event = threading.Event()
+        self._sweep_thread: threading.Thread | None = None
 
         if pool_size is None:
             pool_size = max(1, min(int(self._resources.get(
@@ -368,9 +517,73 @@ class NodeExecutorService:
 
     def start(self) -> "NodeExecutorService":
         self._server.start()
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        period_ms = int(GLOBAL_CONFIG.owner_sweep_period_ms or 0)
+        if period_ms > 0:
+            self._sweep_thread = threading.Thread(
+                target=self._owner_sweep_loop,
+                args=(period_ms / 1000.0,
+                      float(GLOBAL_CONFIG.owner_dead_grace_s)),
+                daemon=True, name="node-owner-sweep")
+            self._sweep_thread.start()
         return self
 
+    def _owner_sweep_loop(self, period_s: float, grace_s: float) -> None:
+        """Owner-death GC: a driver whose client endpoint stays
+        unreachable past the grace period has crashed — drop its primary
+        blobs and kill its actors, or a dead driver's results pin daemon
+        memory forever (reference: owner-death cleanup in the ownership
+        protocol, reference_count.h:61; actor owners dying kill their
+        actors, gcs_actor_manager.h)."""
+        import time as _time
+
+        last_ok: dict[str, float] = {}
+        while not self._stop_event.wait(period_s):
+            with self._actors_lock:
+                actor_owners = {a.owner: None for a in
+                                self._actors.values()
+                                if getattr(a, "owner", None)}
+            owners = set(self.store.owners()) | set(actor_owners)
+            now = _time.monotonic()
+            for owner in owners:
+                alive = False
+                try:
+                    probe = RpcClient(owner, timeout_s=3.0,
+                                      connect_timeout_s=2.0)
+                    try:
+                        alive = probe.call("ping") == "pong"
+                    finally:
+                        probe.close()
+                except Exception:  # noqa: BLE001 — unreachable
+                    alive = False
+                if alive:
+                    last_ok[owner] = now
+                    continue
+                first_seen = last_ok.setdefault(owner, now)
+                if now - first_seen <= grace_s:
+                    continue
+                freed = self.store.free_owner(owner)
+                with self._actors_lock:
+                    dead_keys = [k for k, a in self._actors.items()
+                                 if getattr(a, "owner", None) == owner]
+                for key in dead_keys:
+                    self._reap_actor(key)
+                last_ok.pop(owner, None)
+                if freed or dead_keys:
+                    import logging
+
+                    logging.getLogger("ray_tpu").warning(
+                        "owner %s unreachable for %.0fs: swept %d blobs,"
+                        " %d actors", owner, grace_s, freed,
+                        len(dead_keys))
+            # Prune owners that no longer hold anything here.
+            for owner in list(last_ok):
+                if owner not in owners:
+                    del last_ok[owner]
+
     def stop(self) -> None:
+        self._stop_event.set()
         self._server.stop()
         with self._actors_lock:
             actors = list(self._actors.values())
@@ -470,7 +683,7 @@ class NodeExecutorService:
             if len(blob) <= INLINE_REPLY_BYTES:
                 out.append(("inline", blob))
             else:
-                self.store.put(id_bytes, blob)
+                self.store.put(id_bytes, blob, owner=client_addr)
                 out.append(("stored", len(blob)))
         return ("ok", out)
 
@@ -610,6 +823,7 @@ class NodeExecutorService:
             with self._running_lock:
                 self._running.pop(token, None)
             return ("err", _exc_blob(exc))
+        actor.owner = client_addr  # owner-death sweep kills orphans
         with self._actors_lock:
             self._actors[actor_key] = actor
         return ("ok", actor.pid)
@@ -654,7 +868,8 @@ class NodeExecutorService:
             if len(blob) <= INLINE_REPLY_BYTES:
                 out.append(("inline", blob))
             else:
-                self.store.put(id_bytes, blob)
+                self.store.put(id_bytes, blob,
+                               owner=getattr(actor, "owner", None))
                 out.append(("stored", len(blob)))
         return ("ok", out)
 

@@ -115,10 +115,7 @@ class RemoteActor:
                 return
             gen = self._gen
             handle = self._handle
-        try:
-            handle._control.call("actor_kill", self._key)
-        except Exception:  # noqa: BLE001 — daemon gone; process dies with it
-            pass
+        self._kill_remote_copy(handle)
         if not no_restart:
             # Consumes a restart (or dies); off-thread — relocation can
             # block and kill() must return promptly.
@@ -154,6 +151,14 @@ class RemoteActor:
     def _fail_call(self, call, error: BaseException) -> None:
         for rid in call.return_ids:
             self._runtime.store.put_error(rid, error)
+
+    def _kill_remote_copy(self, handle) -> None:
+        """Best-effort reap of this actor's process on ``handle``'s
+        daemon (idempotent; the daemon may not host it)."""
+        try:
+            handle._control.call("actor_kill", self._key)
+        except Exception:  # noqa: BLE001 — daemon gone
+            pass
 
     def _run(self) -> None:
         try:
@@ -233,10 +238,7 @@ class RemoteActor:
                     # relocating, or the copy is orphaned holding its
                     # admission reservation (and a stateful actor would
                     # split brain).
-                    try:
-                        handle._control.call("actor_kill", self._key)
-                    except Exception:  # noqa: BLE001 — best-effort
-                        pass
+                    self._kill_remote_copy(handle)
                 reply = ("busy",)
             if reply[0] == "ok":
                 self.pid = reply[1]
@@ -245,10 +247,7 @@ class RemoteActor:
                 if raced_kill:
                     # kill() landed between the RPC and here: reap the
                     # fresh copy and give back the re-acquired lease.
-                    try:
-                        handle._control.call("actor_kill", self._key)
-                    except Exception:  # noqa: BLE001
-                        pass
+                    self._kill_remote_copy(handle)
                     self._runtime._release_actor_lease(self.actor_id)
                     return "dead"
                 return None
@@ -273,6 +272,11 @@ class RemoteActor:
                     self.actor_id, self._resources,
                     exclude={node_id} if node_dead else None,
                     timeout=min(remaining, 30.0))
+            if placed == "pg_dead":
+                return ActorDiedError(
+                    self.actor_id,
+                    "placement-group bundle no longer available; the "
+                    "gang must be re-formed")
             with self._lock:
                 self.node_id, self._handle = placed
             time.sleep(0.05)  # saturated cluster: poll, don't hammer
@@ -386,13 +390,10 @@ class RemoteActor:
             # recreating elsewhere, or the process is orphaned, its
             # admission reservation leaks, and a stateful actor splits
             # brain.
-            try:
-                handle._control.call("actor_kill", self._key)
-            except Exception:  # noqa: BLE001 — best-effort
-                pass
+            self._kill_remote_copy(handle)
         placed = self._runtime._relocate_actor_lease(
             self.actor_id, self._resources, exclude=exclude, timeout=120.0)
-        if placed is None:
+        if placed is None or placed == "pg_dead":
             self._mark_dead(
                 f"no surviving worker daemon to restart on ({reason})")
             return

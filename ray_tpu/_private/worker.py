@@ -255,6 +255,12 @@ class Runtime:
         # RLock: _forget_object can re-enter from ObjectRef.__del__ (GC
         # may fire while _record_location holds this lock).
         self._locations_lock = threading.RLock()
+        # Location deltas pending publication to the head's object-
+        # location table (reference: ownership_based_object_directory.h;
+        # flushed in batches by the node watcher).
+        self._loc_dirty_adds: dict[str, str] = {}
+        self._loc_dirty_removes: set[str] = set()
+        self._loc_keepalive = 0.0
         # Remote execution plane state (threads start at the end of
         # __init__, but callbacks may touch these during construction).
         self._remote_nodes: dict[NodeID, Any] = {}
@@ -350,6 +356,7 @@ class Runtime:
             try:
                 self._sync_remote_nodes(nodes)
                 self._flush_remote_frees()
+                self._flush_object_locations()
             except Exception:  # noqa: BLE001 — watcher must survive
                 logger.exception("remote node sync failed")
 
@@ -949,10 +956,47 @@ class Runtime:
             return
         with self._locations_lock:
             self._object_locations[object_id] = node_id
+            self._loc_dirty_adds[object_id.hex()] = node_id.hex()
+            self._loc_dirty_removes.discard(object_id.hex())
+
+    def _flush_object_locations(self) -> None:
+        """Batched publish of location deltas to the head's object-
+        location table; an empty update every 10s keeps the owner's
+        entries leased while it lives."""
+        if self.gcs_client is None or not self._export_addr:
+            return
+        with self._locations_lock:
+            adds = list(self._loc_dirty_adds.items())
+            removes = list(self._loc_dirty_removes)
+            self._loc_dirty_adds.clear()
+            self._loc_dirty_removes.clear()
+            have_entries = bool(self._object_locations)
+        now = time.monotonic()
+        if not adds and not removes:
+            if not have_entries or now - self._loc_keepalive < 10.0:
+                return
+            # Keepalive doubles as a FULL re-publish: a restarted head
+            # (in-memory table) or a >TTL driver stall must not lose
+            # the surviving entries forever.
+            with self._locations_lock:
+                adds = [(oid.hex(), nid.hex()) for oid, nid
+                        in self._object_locations.items()]
+        try:
+            self.gcs_client.call("object_locations_update",
+                                 self._export_addr, adds, removes)
+            self._loc_keepalive = now
+        except Exception:  # noqa: BLE001 — head unreachable: requeue
+            with self._locations_lock:
+                for obj_hex, node_hex in adds:
+                    self._loc_dirty_adds.setdefault(obj_hex, node_hex)
+                self._loc_dirty_removes.update(removes)
 
     def _forget_object(self, object_id: ObjectID) -> None:
         with self._locations_lock:
             node_id = self._object_locations.pop(object_id, None)
+            if node_id is not None:
+                self._loc_dirty_removes.add(object_id.hex())
+                self._loc_dirty_adds.pop(object_id.hex(), None)
         if self._export_store is not None:
             self._export_store.free([object_id.binary()])
         if node_id is not None:
@@ -1223,14 +1267,7 @@ class Runtime:
                 self.gcs.update_actor_state(aid, "DEAD", reason)
                 if name is not None:
                     self._unpublish_named_actor(ns, name)
-                lease = self._actor_leases.pop(aid, None)
-                if lease is not None:
-                    lease_node, lease_resources, lease_pg = lease
-                    if lease_pg is not None:
-                        self.placement_groups.release_to_bundle(
-                            lease_pg[0], lease_pg[1], lease_resources)
-                    else:
-                        self.cluster.release(lease_node, lease_resources)
+                self._release_actor_lease(aid)
 
             def on_restart(aid):
                 self.gcs.update_actor_state(aid, "ALIVE")
@@ -1404,15 +1441,18 @@ class Runtime:
                 # may only be recreated where the bundle lives, never
                 # silently relocated outside the gang (STRICT_* co-
                 # location contracts). If the bundle's node is gone the
-                # actor dies and group-level recovery (FailureConfig)
-                # re-forms the whole gang — slice semantics.
+                # TERMINAL sentinel makes the actor die — group-level
+                # recovery (FailureConfig) re-forms the whole gang,
+                # slice semantics. (Plain None would send the caller's
+                # retry loop through the generic path and silently
+                # un-pin the actor.)
                 self.placement_groups.release_to_bundle(
                     old_pg[0], old_pg[1], old_resources)
                 try:
                     node_id = self.placement_groups.acquire_from_bundle(
                         old_pg[0], old_pg[1], resources)
                 except Exception:  # noqa: BLE001 — bundle gone
-                    return None
+                    return "pg_dead"
                 node_state = self.cluster.get_node(node_id)
                 with self._remote_nodes_lock:
                     handle = self._remote_nodes.get(node_id)
@@ -1421,7 +1461,7 @@ class Runtime:
                         or (exclude and node_id in exclude)):
                     self.placement_groups.release_to_bundle(
                         old_pg[0], old_pg[1], resources)
-                    return None
+                    return "pg_dead"
                 self._actor_leases[actor_id] = (node_id, resources, old_pg)
                 return node_id, handle
             self.cluster.release(old_node, old_resources)

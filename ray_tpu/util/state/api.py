@@ -111,6 +111,9 @@ def list_objects(filters=None, limit: int = 100) -> list[dict]:
     from ray_tpu._private.ids import ObjectID
 
     runtime = _runtime()
+    with runtime._locations_lock:
+        locations = {oid.hex(): nid.hex()
+                     for oid, nid in runtime._object_locations.items()}
     rows = []
     for entry in runtime.store.snapshot():
         rows.append({
@@ -120,6 +123,7 @@ def list_objects(filters=None, limit: int = 100) -> list[dict]:
             "reference_count": runtime.reference_counter.count(
                 ObjectID.from_hex(entry["object_id"])),
             "spilled": entry["spilled"],
+            "node_id": locations.get(entry["object_id"], ""),
         })
     return _apply_filters(rows, filters, limit)
 

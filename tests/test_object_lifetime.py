@@ -1,0 +1,212 @@
+"""Cluster object lifetime: owner-death sweep of node stores, primary-
+copy spill + restore, and the GCS object-location table (VERDICT r3 #4).
+
+Reference test intent: python/ray/tests/test_object_spilling*.py and the
+owner-death cleanup of the ownership protocol
+(src/ray/core_worker/reference_count.h:61,
+src/ray/raylet/local_object_manager.h:110).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+
+def test_node_store_spills_primaries_and_restores(tmp_path):
+    """Over the primary cap the oldest blobs move to disk; fetches read
+    them back chunk by chunk (restore-on-fetch)."""
+    from ray_tpu._private.node_executor import NodeObjectStore
+
+    store = NodeObjectStore(primary_limit_bytes=3 * 1024 * 1024,
+                            spill_dir=str(tmp_path / "spill"))
+    blobs = {}
+    for i in range(8):  # 8 x 1MB >> 3MB cap
+        key = bytes([i]) * 16
+        blob = bytes([i]) * (1024 * 1024)
+        blobs[key] = blob
+        store.put(key, blob, owner="owner-a")
+    stats = store.stats()
+    assert stats["spilled_blobs"] >= 5, stats
+    assert stats["bytes"] <= 3 * 1024 * 1024 + 1024, stats
+    # Every blob — memory-resident or spilled — reads back intact.
+    for key, blob in blobs.items():
+        assert store.get(key) == blob
+        total, chunk = store.read_chunk(key, 512 * 1024, 1024)
+        assert total == len(blob)
+        assert chunk == blob[512 * 1024:512 * 1024 + 1024]
+    assert store.stats()["restores"] > 0
+    # free() also deletes the spill files.
+    store.free(list(blobs))
+    assert store.stats()["num_blobs"] == 0
+    assert store.stats()["spilled_blobs"] == 0
+    leftover = list((tmp_path / "spill").glob("*.blob")) \
+        if (tmp_path / "spill").exists() else []
+    assert leftover == []
+
+
+def test_owner_free_drops_only_that_owners_blobs(tmp_path):
+    from ray_tpu._private.node_executor import NodeObjectStore
+
+    store = NodeObjectStore(spill_dir=str(tmp_path / "spill"))
+    store.put(b"a" * 16, b"x" * 100, owner="owner-a")
+    store.put(b"b" * 16, b"y" * 100, owner="owner-b")
+    store.put(b"c" * 16, b"z" * 100, owner="owner-a")
+    assert store.free_owner("owner-a") == 2
+    assert store.get(b"b" * 16) == b"y" * 100
+    assert store.get(b"a" * 16) is None
+    assert store.owners() == ["owner-b"]
+
+
+_CRASHING_DRIVER = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, {repo!r})
+    os.environ.setdefault("RAY_TPU_SKIP_TPU_DETECTION", "1")
+    import numpy as np
+    import ray_tpu
+
+    rt = ray_tpu.init(num_cpus=0, address={address!r})
+    deadline = time.time() + 30
+    while time.time() < deadline and \\
+            ray_tpu.cluster_resources().get("CPU", 0) < 2:
+        time.sleep(0.2)
+
+    @ray_tpu.remote
+    def big():
+        return np.zeros(400_000)  # ~3.2MB -> stored on the daemon
+
+    @ray_tpu.remote(num_cpus=1)
+    class Held:
+        def ping(self):
+            return "up"
+
+    refs = [big.remote() for _ in range(3)]
+    actor = Held.remote()
+    assert ray_tpu.get(actor.ping.remote(), timeout=60) == "up"
+    ray_tpu.wait(refs, num_returns=3, timeout=60)
+    print("DRIVER-READY", flush=True)
+    time.sleep(120)  # killed from outside; never exits cleanly
+""")
+
+
+def test_driver_crash_sweeps_daemon_blobs_and_actors():
+    """SIGKILL a connected driver: after the owner grace period the
+    daemon drops its stored results AND its hosted actor — zero
+    orphans (VERDICT r3 #4 acceptance)."""
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir="/tmp/ray_tpu_test_ownersweep")
+    cluster.add_node(num_cpus=2, env={
+        "RAY_TPU_OWNER_SWEEP_PERIOD_MS": "1000",
+        "RAY_TPU_OWNER_DEAD_GRACE_S": "4",
+    })
+    driver = None
+    try:
+        assert cluster.wait_for_nodes(1, timeout=30)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = _CRASHING_DRIVER.format(repo=repo,
+                                         address=cluster.address)
+        driver = subprocess.Popen(
+            [sys.executable, "-c", script], stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT)
+        # Wait for the driver to park with live blobs + actor.
+        ready = False
+        deadline = time.time() + 90
+        while time.time() < deadline:
+            line = driver.stdout.readline()
+            if b"DRIVER-READY" in line:
+                ready = True
+                break
+            if driver.poll() is not None:
+                break
+        assert ready, driver.stdout.read().decode(errors="replace")
+
+        # Observe the daemon holding the driver's state.
+        from ray_tpu._private.rpc import RpcClient
+
+        gcs = RpcClient(cluster.address)
+        exec_addr = next(
+            n["executor_address"] for n in gcs.call("list_nodes")
+            if n["alive"] and n["executor_address"])
+        probe = RpcClient(exec_addr)
+        stats = probe.call("executor_stats")
+        assert stats["store"]["num_blobs"] >= 3, stats
+        assert stats["num_actors"] == 1, stats
+
+        driver.kill()  # crash: no cleanup, no frees
+        driver.wait(timeout=10)
+
+        deadline = time.time() + 40
+        swept = None
+        while time.time() < deadline:
+            swept = probe.call("executor_stats")
+            if (swept["store"]["num_blobs"] == 0
+                    and swept["num_actors"] == 0):
+                break
+            time.sleep(0.5)
+        assert swept["store"]["num_blobs"] == 0, swept
+        assert swept["num_actors"] == 0, swept
+        probe.close()
+        gcs.close()
+    finally:
+        if driver is not None and driver.poll() is None:
+            driver.kill()
+        cluster.shutdown()
+
+
+def test_gcs_object_location_table_tracks_primaries():
+    """The driver publishes primary-copy locations to the head; frees
+    retract them (reference: ownership_based_object_directory.h)."""
+    import numpy as np
+
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir="/tmp/ray_tpu_test_loctable")
+    cluster.add_node(num_cpus=2)
+    try:
+        assert cluster.wait_for_nodes(1, timeout=30)
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        deadline = time.time() + 30
+        while time.time() < deadline and \
+                ray_tpu.cluster_resources().get("CPU", 0) < 2:
+            time.sleep(0.2)
+
+        @ray_tpu.remote
+        def big():
+            return np.zeros(400_000)
+
+        refs = [big.remote() for _ in range(3)]
+        ray_tpu.wait(refs, num_returns=3, timeout=60)
+
+        table = {}
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            table = runtime.gcs_client.call(
+                "list_object_locations", runtime._export_addr)
+            if len(table) >= 3:
+                break
+            time.sleep(0.3)
+        assert len(table) >= 3, table
+        held = {r.id().hex() for r in refs}
+        assert held <= set(table), (held, table)
+
+        # Dropping the refs retracts the entries.
+        del refs
+        import gc
+
+        gc.collect()
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            table = runtime.gcs_client.call(
+                "list_object_locations", runtime._export_addr)
+            if not (held & set(table)):
+                break
+            time.sleep(0.3)
+        assert not (held & set(table)), table
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
