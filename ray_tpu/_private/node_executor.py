@@ -98,6 +98,31 @@ class NodeObjectStore:
         self.fetches_served = 0
         self.spills = 0
         self.restores = 0
+        self._purge_stale_spills()
+
+    def _purge_stale_spills(self) -> None:
+        """Delete spill files left by crashed prior daemons (filenames
+        are pid-prefixed; a dead pid's files have no owner and would
+        otherwise accumulate across crash cycles until the disk fills)."""
+        try:
+            names = os.listdir(self._spill_dir)
+        except OSError:
+            return
+        for name in names:
+            if not name.endswith(".blob"):
+                continue
+            pid_part = name.split("-", 1)[0]
+            if not pid_part.isdigit() or int(pid_part) == os.getpid():
+                continue
+            try:
+                os.kill(int(pid_part), 0)
+            except ProcessLookupError:
+                try:
+                    os.unlink(os.path.join(self._spill_dir, name))
+                except OSError:
+                    pass
+            except OSError:
+                pass  # alive but not ours (EPERM): leave it
 
     def put(self, id_bytes: bytes, blob: bytes, cached: bool = False,
             owner: str | None = None) -> None:
@@ -276,17 +301,21 @@ class NodeObjectStore:
 
 
 class _PeerClients:
-    """One pooled RPC client per peer address (daemon-side pulls)."""
+    """One multiplexed RPC client per peer address (daemon-side pulls:
+    concurrent chunk fetches interleave on a single socket per pair)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
-        self._clients: dict[str, RpcClient] = {}
+        from ray_tpu._private.rpc import MuxRpcClient
 
-    def get(self, addr: str) -> RpcClient:
+        self._mux_cls = MuxRpcClient
+        self._lock = threading.Lock()
+        self._clients: dict[str, Any] = {}
+
+    def get(self, addr: str):
         with self._lock:
             client = self._clients.get(addr)
             if client is None:
-                client = RpcClient(addr)
+                client = self._mux_cls(addr, timeout_s=600.0)
                 self._clients[addr] = client
             return client
 
@@ -497,15 +526,18 @@ class NodeExecutorService:
         s = self._server
         s.register("ping", lambda: "pong")
         s.register("exec_ping", lambda: os.getpid())
-        s.register("execute_task", self.execute_task)
-        s.register("fetch_object", self.fetch_object)
+        # Long-running methods dispatch concurrently so ONE multiplexed
+        # connection carries all of a driver's in-flight work (reference:
+        # async completion queues, client_call.h — not a socket per task).
+        s.register("execute_task", self.execute_task, concurrent=True)
+        s.register("fetch_object", self.fetch_object, concurrent=True)
         s.register("free_objects", self.free_objects)
         s.register("executor_stats", self.executor_stats)
         s.register("task_block", self.task_block)
         s.register("task_unblock", self.task_unblock)
         s.register("adopt_sys_path", self.adopt_sys_path)
-        s.register("create_actor", self.create_actor)
-        s.register("actor_call", self.actor_call)
+        s.register("create_actor", self.create_actor, concurrent=True)
+        s.register("actor_call", self.actor_call, concurrent=True)
         s.register("actor_kill", self.actor_kill)
 
     @property
@@ -537,31 +569,45 @@ class NodeExecutorService:
         protocol, reference_count.h:61; actor owners dying kill their
         actors, gcs_actor_manager.h)."""
         import time as _time
+        from concurrent.futures import ThreadPoolExecutor
 
-        last_ok: dict[str, float] = {}
+        # Sweep requires SUSTAINED unreachability: fail_since records the
+        # first of an unbroken run of failed probes; one transient miss
+        # (dropped SYN, a slow driver tick) never frees a live owner's
+        # state. Probes run concurrently so many dead owners cannot
+        # stretch the sweep period and starve probes of live ones.
+        fail_since: dict[str, float] = {}
         while not self._stop_event.wait(period_s):
             with self._actors_lock:
                 actor_owners = {a.owner: None for a in
                                 self._actors.values()
                                 if getattr(a, "owner", None)}
             owners = set(self.store.owners()) | set(actor_owners)
-            now = _time.monotonic()
-            for owner in owners:
-                alive = False
+            if not owners:
+                fail_since.clear()
+                continue
+
+            def probe_one(owner: str) -> bool:
                 try:
                     probe = RpcClient(owner, timeout_s=3.0,
                                       connect_timeout_s=2.0)
                     try:
-                        alive = probe.call("ping") == "pong"
+                        return probe.call("ping") == "pong"
                     finally:
                         probe.close()
                 except Exception:  # noqa: BLE001 — unreachable
-                    alive = False
+                    return False
+
+            with ThreadPoolExecutor(max_workers=min(8, len(owners))) \
+                    as pool:
+                results = dict(zip(owners, pool.map(probe_one, owners)))
+            now = _time.monotonic()
+            for owner, alive in results.items():
                 if alive:
-                    last_ok[owner] = now
+                    fail_since.pop(owner, None)
                     continue
-                first_seen = last_ok.setdefault(owner, now)
-                if now - first_seen <= grace_s:
+                first_fail = fail_since.setdefault(owner, now)
+                if now - first_fail <= grace_s:
                     continue
                 freed = self.store.free_owner(owner)
                 with self._actors_lock:
@@ -569,7 +615,7 @@ class NodeExecutorService:
                                  if getattr(a, "owner", None) == owner]
                 for key in dead_keys:
                     self._reap_actor(key)
-                last_ok.pop(owner, None)
+                fail_since.pop(owner, None)
                 if freed or dead_keys:
                     import logging
 
@@ -577,10 +623,9 @@ class NodeExecutorService:
                         "owner %s unreachable for %.0fs: swept %d blobs,"
                         " %d actors", owner, grace_s, freed,
                         len(dead_keys))
-            # Prune owners that no longer hold anything here.
-            for owner in list(last_ok):
+            for owner in list(fail_since):
                 if owner not in owners:
-                    del last_ok[owner]
+                    del fail_since[owner]
 
     def stop(self) -> None:
         self._stop_event.set()
@@ -715,7 +760,8 @@ class NodeExecutorService:
             num_actors = len(self._actors)
         return {"tasks_executed": self.tasks_executed,
                 "running": running, "store": self.store.stats(),
-                "num_actors": num_actors, "pid": os.getpid()}
+                "num_actors": num_actors, "pid": os.getpid(),
+                "threads": threading.active_count()}
 
     def adopt_sys_path(self, paths: list) -> int:
         """Adopt a driver's import paths (existing directories only) so
@@ -999,54 +1045,21 @@ def _exc_blob(exc: BaseException) -> bytes:
 # --------------------------------------------------------------------------
 
 
-class _RpcClientPool:
-    """Connection pool to one node: execute_task blocks for the task's
-    duration, so concurrent in-flight tasks need parallel sockets (the
-    single-socket RpcClient would head-of-line block them)."""
-
-    def __init__(self, address: str, timeout_s: float = 24 * 3600.0):
-        self.address = address
-        self._timeout = timeout_s
-        self._lock = threading.Lock()
-        self._idle: list[RpcClient] = []
-
-    def acquire(self) -> RpcClient:
-        with self._lock:
-            if self._idle:
-                return self._idle.pop()
-        return RpcClient(self.address, timeout_s=self._timeout)
-
-    def release(self, client: RpcClient) -> None:
-        with self._lock:
-            if len(self._idle) < 16:
-                self._idle.append(client)
-                return
-        client.close()
-
-    def call(self, method: str, *args) -> Any:
-        client = self.acquire()
-        try:
-            result = client.call(method, *args)
-        except BaseException:
-            client.close()
-            raise
-        self.release(client)
-        return result
-
-    def close(self) -> None:
-        with self._lock:
-            for client in self._idle:
-                client.close()
-            self._idle.clear()
-
-
 class RemoteNodeHandle:
-    """Driver-side handle to one worker-node executor."""
+    """Driver-side handle to one worker-node executor.
+
+    All task/actor traffic multiplexes on ONE socket (``self.pool``):
+    N in-flight calls are seq-tagged and interleaved, not N sockets
+    (reference: async completion queues, src/ray/rpc/client_call.h)."""
 
     def __init__(self, node_id, address: str):
+        from ray_tpu._private.rpc import MuxRpcClient
+
         self.node_id = node_id
         self.address = address
-        self.pool = _RpcClientPool(address)
+        # "pool" kept for call-site compatibility: it is one multiplexed
+        # connection that behaves like an unbounded pool.
+        self.pool = MuxRpcClient(address)
         # Short-timeout client for watcher-thread control calls: a ping
         # to an unreachable address must fail fast, never stall the
         # watcher behind the pool's task-length timeouts.
@@ -1122,14 +1135,7 @@ class RemoteNodeHandle:
         return reply[1]
 
     def fetch(self, id_bytes: bytes) -> bytes:
-        client = self.pool.acquire()
-        try:
-            blob = fetch_blob(client, id_bytes)
-        except BaseException:
-            client.close()
-            raise
-        self.pool.release(client)
-        return blob
+        return fetch_blob(self.pool, id_bytes)
 
     def free(self, ids: list[bytes]) -> None:
         self._control.call("free_objects", ids)

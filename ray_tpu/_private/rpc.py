@@ -73,6 +73,11 @@ class RpcServer:
         self._sock.listen(128)
         self.host, self.port = self._sock.getsockname()[:2]
         self._methods: dict[str, Callable] = {}
+        # Methods that may run long (task execution): dispatched on
+        # their own thread with out-of-order replies, so one connection
+        # can carry many interleaved in-flight calls (the gRPC async
+        # completion-queue shape — reference: src/ray/rpc/client_call.h).
+        self._concurrent: set[str] = set()
         self._shutdown = threading.Event()
         self._accept_thread: threading.Thread | None = None
         self._conns: list[socket.socket] = []
@@ -82,8 +87,11 @@ class RpcServer:
     def address(self) -> str:
         return f"{self.host}:{self.port}"
 
-    def register(self, name: str, fn: Callable) -> None:
+    def register(self, name: str, fn: Callable,
+                 concurrent: bool = False) -> None:
         self._methods[name] = fn
+        if concurrent:
+            self._concurrent.add(name)
 
     def register_object(self, obj: Any, prefix: str = "") -> None:
         for name in dir(obj):
@@ -114,6 +122,7 @@ class RpcServer:
                              daemon=True, name="rpc-conn").start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        send_lock = threading.Lock()  # interleaved replies share the pipe
         try:
             while not self._shutdown.is_set():
                 try:
@@ -121,25 +130,14 @@ class RpcServer:
                 except RpcError:
                     return
                 seq, method, args, kwargs = pickle.loads(frame)
-                try:
-                    fn = self._methods[method]
-                except KeyError:
-                    reply = (seq, "err", (KeyError(f"no method {method}"),
-                                          ""))
-                else:
-                    try:
-                        reply = (seq, "ok", fn(*args, **kwargs))
-                    except BaseException as exc:  # noqa: BLE001
-                        tb = traceback.format_exc()
-                        try:
-                            pickle.dumps(exc)
-                        except Exception:
-                            exc = RuntimeError(
-                                f"{type(exc).__name__}: {exc}")
-                        reply = (seq, "err", (exc, tb))
-                try:
-                    _send_frame(conn, pickle.dumps(reply))
-                except OSError:
+                if method in self._concurrent:
+                    threading.Thread(
+                        target=self._handle_one,
+                        args=(conn, send_lock, seq, method, args, kwargs),
+                        daemon=True, name=f"rpc-{method}").start()
+                    continue
+                if not self._handle_one(conn, send_lock, seq, method,
+                                        args, kwargs):
                     return
         finally:
             try:
@@ -151,6 +149,50 @@ class RpcServer:
                     self._conns.remove(conn)
                 except ValueError:
                     pass
+
+    def _handle_one(self, conn, send_lock, seq, method, args,
+                    kwargs) -> bool:
+        try:
+            fn = self._methods[method]
+        except KeyError:
+            reply = (seq, "err", (KeyError(f"no method {method}"), ""))
+        else:
+            try:
+                reply = (seq, "ok", fn(*args, **kwargs))
+            except BaseException as exc:  # noqa: BLE001
+                tb = traceback.format_exc()
+                try:
+                    pickle.dumps(exc)
+                except Exception:
+                    exc = RuntimeError(f"{type(exc).__name__}: {exc}")
+                reply = (seq, "err", (exc, tb))
+        try:
+            blob = pickle.dumps(reply)
+        except BaseException as exc:  # noqa: BLE001 — reply unpicklable
+            # The client MUST get a reply or its mux slot hangs for the
+            # full call timeout; degrade to an error reply.
+            reply = (seq, "err", (RuntimeError(
+                f"reply serialization failed: {exc!r}"), ""))
+            try:
+                blob = pickle.dumps(reply)
+            except BaseException:  # noqa: BLE001 — give up: kill the conn
+                try:
+                    conn.close()  # wakes every mux slot with RpcError
+                except OSError:
+                    pass
+                return False
+        try:
+            with send_lock:
+                _send_frame(conn, blob)
+            return True
+        except OSError:
+            # A concurrent dispatch thread cannot signal the serve loop;
+            # closing the socket fails the connection for everyone fast.
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return False
 
     def stop(self) -> None:
         self._shutdown.set()
@@ -169,6 +211,159 @@ class RpcServer:
                 conn.close()
             except OSError:
                 pass
+
+
+class _MuxSlot:
+    __slots__ = ("event", "reply", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.reply = None
+        self.error: BaseException | None = None
+
+
+class MuxRpcClient:
+    """One connection, MANY concurrent in-flight calls: requests are
+    seq-tagged, a reader thread matches interleaved replies. This is the
+    client half of the async completion-queue model (reference:
+    src/ray/rpc/client_call.h) — N in-flight tasks to a node cost one
+    socket, not N.
+
+    The server must dispatch the called methods concurrently
+    (RpcServer.register(..., concurrent=True)), or a long call would
+    head-of-line block every other call on the connection.
+
+    No transparent retry: once a request is written, a lost connection
+    fails ALL in-flight calls with RpcError (the method may have
+    executed — the caller owns the retry policy, as with RpcClient's
+    after-send failures)."""
+
+    def __init__(self, address: str, timeout_s: float = 24 * 3600.0,
+                 connect_timeout_s: float = 10.0):
+        host, _, port = address.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self.address = f"{self._addr[0]}:{self._addr[1]}"
+        self._timeout = timeout_s
+        self._connect_timeout = connect_timeout_s
+        self._lock = threading.Lock()       # conn state + seq + pending
+        self._send_lock = threading.Lock()  # frame writes
+        self._sock: socket.socket | None = None
+        self._seq = 0
+        self._pending: dict[int, _MuxSlot] = {}
+        self._closed = False
+
+    def _ensure_conn(self) -> socket.socket:
+        # Caller holds self._lock.
+        if self._sock is None:
+            sock = socket.create_connection(
+                self._addr, timeout=self._connect_timeout)
+            sock.settimeout(None)  # reader blocks; call timeouts are
+            sock.setsockopt(socket.IPPROTO_TCP,  # enforced on the slots
+                            socket.TCP_NODELAY, 1)
+            self._sock = sock
+            threading.Thread(target=self._reader_loop, args=(sock,),
+                             daemon=True, name="mux-rpc-reader").start()
+        return self._sock
+
+    def call(self, method: str, *args, timeout_s: float | None = None,
+             **kwargs) -> Any:
+        slot = _MuxSlot()
+        with self._lock:
+            if self._closed:
+                raise RpcError(f"client to {self.address} is closed")
+            try:
+                sock = self._ensure_conn()
+            except OSError as exc:
+                raise RpcError(
+                    f"cannot connect to {self.address}: {exc}") from exc
+            self._seq += 1
+            seq = self._seq
+        # Pickle BEFORE registering the slot: an unpicklable argument
+        # must raise cleanly, not leak a pending entry per attempt.
+        request = pickle.dumps((seq, method, args, kwargs))
+        with self._lock:
+            if self._closed:
+                raise RpcError(f"client to {self.address} is closed")
+            self._pending[seq] = slot
+        try:
+            with self._send_lock:
+                _send_frame(sock, request)
+        except OSError as exc:
+            self._fail_conn(sock, exc)
+            raise RpcError(
+                f"rpc {method} to {self.address} failed: {exc}") from exc
+        if not slot.event.wait(timeout_s if timeout_s is not None
+                               else self._timeout):
+            with self._lock:
+                self._pending.pop(seq, None)
+            raise RpcError(
+                f"rpc {method} to {self.address} timed out")
+        if slot.error is not None:
+            raise RpcError(
+                f"rpc {method} to {self.address} failed "
+                f"(may have executed): {slot.error}") from slot.error
+        status, payload = slot.reply
+        if status == "err":
+            exc, tb = payload
+            raise RpcMethodError(exc, tb)
+        return payload
+
+    def _reader_loop(self, sock: socket.socket) -> None:
+        while True:
+            try:
+                frame = _recv_frame(sock)
+            except (RpcError, OSError) as exc:
+                self._fail_conn(sock, exc)
+                return
+            try:
+                seq, status, payload = pickle.loads(frame)
+            except Exception as exc:  # noqa: BLE001 — corrupt stream
+                self._fail_conn(sock, exc)
+                return
+            with self._lock:
+                slot = self._pending.pop(seq, None)
+            if slot is not None:
+                slot.reply = (status, payload)
+                slot.event.set()
+
+    def _fail_conn(self, sock: socket.socket, exc: BaseException) -> None:
+        with self._lock:
+            if self._sock is sock:
+                self._sock = None  # next call reconnects fresh
+            pending = list(self._pending.values())
+            self._pending.clear()
+        try:
+            sock.close()
+        except OSError:
+            pass
+        for slot in pending:
+            slot.error = exc
+            slot.event.set()
+
+    def ping(self) -> bool:
+        try:
+            return self.call("ping", timeout_s=5.0) == "pong"
+        except (RpcError, RpcMethodError):
+            return False
+
+    def num_connections(self) -> int:
+        with self._lock:
+            return 1 if self._sock is not None else 0
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            sock, self._sock = self._sock, None
+            pending = list(self._pending.values())
+            self._pending.clear()
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        for slot in pending:
+            slot.error = RpcError("client closed")
+            slot.event.set()
 
 
 class RpcClient:

@@ -289,3 +289,32 @@ def test_runtime_env_working_dir_ships_to_remote_nodes(two_node_cluster,
 
     on_daemon, content = ray_tpu.get(read_file.remote(), timeout=60)
     assert on_daemon and content == "hello-from-driver"
+
+
+def test_mux_rpc_5k_tasks_few_sockets(two_node_cluster):
+    """VERDICT r3 #6 acceptance: thousands of concurrent small tasks
+    ride a few multiplexed connections per node pair (one task socket +
+    one control socket per handle), not a socket per in-flight task."""
+    runtime = two_node_cluster
+
+    @ray_tpu.remote(scheduling_strategy="SPREAD")
+    def tiny(i):
+        return i + 1
+
+    n = 5000
+    refs = [tiny.remote(i) for i in range(n)]
+    results = ray_tpu.get(refs, timeout=600)
+    assert results == [i + 1 for i in range(n)]
+
+    # Driver side: exactly one multiplexed task connection per node.
+    with runtime._remote_nodes_lock:
+        handles = list(runtime._remote_nodes.values())
+    assert len(handles) >= 2
+    for handle in handles:
+        assert handle.pool.num_connections() <= 1
+
+    # Daemon side: thread count stays bounded by admitted concurrency,
+    # nowhere near the task count.
+    for handle in handles:
+        stats = handle.pool.call("executor_stats")
+        assert stats["threads"] < 64, stats
