@@ -43,8 +43,10 @@ def bench_config():
     # ~350M params: fits params+AdamW(f32)+activations in 16GB HBM.
     # flash (pallas kernels, fwd + fused bwd, GQA-native via a
     # rep-axis vmap into the launch grid — no repeated-kv tensor) +
-    # "dots" remat: 38.6-40.5% MFU on v5e across runs (remote-device
-    # link variance; 25.9% for plain attention + full remat).
+    # "dots" remat. Measured MFU lives in BENCH_r{N}.json (the driver
+    # records each round; numbers vary run-to-run with the remote-
+    # device link) — this comment intentionally cites the artifact
+    # instead of hardcoding a range that goes stale.
     return dataclasses.replace(
         LlamaConfig(),
         vocab_size=32000, hidden_size=1024, intermediate_size=2816,

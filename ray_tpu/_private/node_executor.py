@@ -35,7 +35,12 @@ from typing import Any, Callable
 
 from ray_tpu._private import serialization
 from ray_tpu._private.ids import ObjectID
-from ray_tpu._private.rpc import RpcClient, RpcError, RpcServer
+from ray_tpu._private.rpc import (
+    MuxRpcClient,
+    RpcClient,
+    RpcError,
+    RpcServer,
+)
 
 # Results at or below this ship inline in the execute_task reply;
 # larger ones stay in the producing node's store (driver pulls lazily).
@@ -305,17 +310,14 @@ class _PeerClients:
     concurrent chunk fetches interleave on a single socket per pair)."""
 
     def __init__(self):
-        from ray_tpu._private.rpc import MuxRpcClient
-
-        self._mux_cls = MuxRpcClient
         self._lock = threading.Lock()
-        self._clients: dict[str, Any] = {}
+        self._clients: dict[str, MuxRpcClient] = {}
 
-    def get(self, addr: str):
+    def get(self, addr: str) -> MuxRpcClient:
         with self._lock:
             client = self._clients.get(addr)
             if client is None:
-                client = self._mux_cls(addr, timeout_s=600.0)
+                client = MuxRpcClient(addr, timeout_s=600.0)
                 self._clients[addr] = client
             return client
 
@@ -530,7 +532,8 @@ class NodeExecutorService:
         # connection carries all of a driver's in-flight work (reference:
         # async completion queues, client_call.h — not a socket per task).
         s.register("execute_task", self.execute_task, concurrent=True)
-        s.register("fetch_object", self.fetch_object, concurrent=True)
+        s.register("fetch_object", self.fetch_object,
+                   concurrent="pooled")
         s.register("free_objects", self.free_objects)
         s.register("executor_stats", self.executor_stats)
         s.register("task_block", self.task_block)
@@ -1053,8 +1056,6 @@ class RemoteNodeHandle:
     (reference: async completion queues, src/ray/rpc/client_call.h)."""
 
     def __init__(self, node_id, address: str):
-        from ray_tpu._private.rpc import MuxRpcClient
-
         self.node_id = node_id
         self.address = address
         # "pool" kept for call-site compatibility: it is one multiplexed

@@ -73,11 +73,16 @@ class RpcServer:
         self._sock.listen(128)
         self.host, self.port = self._sock.getsockname()[:2]
         self._methods: dict[str, Callable] = {}
-        # Methods that may run long (task execution): dispatched on
-        # their own thread with out-of-order replies, so one connection
+        # Methods that may run long (task execution): dispatched off the
+        # connection loop with out-of-order replies, so one connection
         # can carry many interleaved in-flight calls (the gRPC async
         # completion-queue shape — reference: src/ray/rpc/client_call.h).
-        self._concurrent: set[str] = set()
+        # "thread" = a thread per request (long blocking calls; bounded
+        # upstream by admission); "pooled" = a small shared executor
+        # (short calls like chunk fetches — no thread churn per chunk).
+        self._concurrent: dict[str, str] = {}
+        self._io_pool = None
+        self._io_pool_lock = threading.Lock()
         self._shutdown = threading.Event()
         self._accept_thread: threading.Thread | None = None
         self._conns: list[socket.socket] = []
@@ -88,10 +93,20 @@ class RpcServer:
         return f"{self.host}:{self.port}"
 
     def register(self, name: str, fn: Callable,
-                 concurrent: bool = False) -> None:
+                 concurrent: "bool | str" = False) -> None:
         self._methods[name] = fn
         if concurrent:
-            self._concurrent.add(name)
+            self._concurrent[name] = (
+                concurrent if isinstance(concurrent, str) else "thread")
+
+    def _get_io_pool(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._io_pool_lock:
+            if self._io_pool is None:
+                self._io_pool = ThreadPoolExecutor(
+                    max_workers=16, thread_name_prefix="rpc-io")
+            return self._io_pool
 
     def register_object(self, obj: Any, prefix: str = "") -> None:
         for name in dir(obj):
@@ -130,7 +145,13 @@ class RpcServer:
                 except RpcError:
                     return
                 seq, method, args, kwargs = pickle.loads(frame)
-                if method in self._concurrent:
+                mode = self._concurrent.get(method)
+                if mode == "pooled":
+                    self._get_io_pool().submit(
+                        self._handle_one, conn, send_lock, seq, method,
+                        args, kwargs)
+                    continue
+                if mode is not None:
                     threading.Thread(
                         target=self._handle_one,
                         args=(conn, send_lock, seq, method, args, kwargs),
@@ -196,6 +217,10 @@ class RpcServer:
 
     def stop(self) -> None:
         self._shutdown.set()
+        with self._io_pool_lock:
+            if self._io_pool is not None:
+                self._io_pool.shutdown(wait=False)
+                self._io_pool = None
         try:
             self._sock.close()
         except OSError:
@@ -222,6 +247,18 @@ class _MuxSlot:
         self.error: BaseException | None = None
 
 
+class _MuxConn:
+    """One live connection + the in-flight slots bound to IT. Slots are
+    per-connection so a stale socket's failure can never wipe calls
+    already riding a fresh reconnect."""
+
+    __slots__ = ("sock", "pending")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.pending: dict[int, _MuxSlot] = {}
+
+
 class MuxRpcClient:
     """One connection, MANY concurrent in-flight calls: requests are
     seq-tagged, a reader thread matches interleaved replies. This is the
@@ -245,25 +282,25 @@ class MuxRpcClient:
         self.address = f"{self._addr[0]}:{self._addr[1]}"
         self._timeout = timeout_s
         self._connect_timeout = connect_timeout_s
-        self._lock = threading.Lock()       # conn state + seq + pending
+        self._lock = threading.Lock()       # conn state + seq
         self._send_lock = threading.Lock()  # frame writes
-        self._sock: socket.socket | None = None
+        self._conn: _MuxConn | None = None
         self._seq = 0
-        self._pending: dict[int, _MuxSlot] = {}
         self._closed = False
 
-    def _ensure_conn(self) -> socket.socket:
+    def _ensure_conn(self) -> _MuxConn:
         # Caller holds self._lock.
-        if self._sock is None:
+        if self._conn is None:
             sock = socket.create_connection(
                 self._addr, timeout=self._connect_timeout)
             sock.settimeout(None)  # reader blocks; call timeouts are
             sock.setsockopt(socket.IPPROTO_TCP,  # enforced on the slots
                             socket.TCP_NODELAY, 1)
-            self._sock = sock
-            threading.Thread(target=self._reader_loop, args=(sock,),
-                             daemon=True, name="mux-rpc-reader").start()
-        return self._sock
+            self._conn = _MuxConn(sock)
+            threading.Thread(target=self._reader_loop,
+                             args=(self._conn,), daemon=True,
+                             name="mux-rpc-reader").start()
+        return self._conn
 
     def call(self, method: str, *args, timeout_s: float | None = None,
              **kwargs) -> Any:
@@ -272,7 +309,7 @@ class MuxRpcClient:
             if self._closed:
                 raise RpcError(f"client to {self.address} is closed")
             try:
-                sock = self._ensure_conn()
+                conn = self._ensure_conn()
             except OSError as exc:
                 raise RpcError(
                     f"cannot connect to {self.address}: {exc}") from exc
@@ -284,18 +321,18 @@ class MuxRpcClient:
         with self._lock:
             if self._closed:
                 raise RpcError(f"client to {self.address} is closed")
-            self._pending[seq] = slot
+            conn.pending[seq] = slot
         try:
             with self._send_lock:
-                _send_frame(sock, request)
+                _send_frame(conn.sock, request)
         except OSError as exc:
-            self._fail_conn(sock, exc)
+            self._fail_conn(conn, exc)
             raise RpcError(
                 f"rpc {method} to {self.address} failed: {exc}") from exc
         if not slot.event.wait(timeout_s if timeout_s is not None
                                else self._timeout):
             with self._lock:
-                self._pending.pop(seq, None)
+                conn.pending.pop(seq, None)
             raise RpcError(
                 f"rpc {method} to {self.address} timed out")
         if slot.error is not None:
@@ -308,32 +345,34 @@ class MuxRpcClient:
             raise RpcMethodError(exc, tb)
         return payload
 
-    def _reader_loop(self, sock: socket.socket) -> None:
+    def _reader_loop(self, conn: _MuxConn) -> None:
         while True:
             try:
-                frame = _recv_frame(sock)
+                frame = _recv_frame(conn.sock)
             except (RpcError, OSError) as exc:
-                self._fail_conn(sock, exc)
+                self._fail_conn(conn, exc)
                 return
             try:
                 seq, status, payload = pickle.loads(frame)
             except Exception as exc:  # noqa: BLE001 — corrupt stream
-                self._fail_conn(sock, exc)
+                self._fail_conn(conn, exc)
                 return
             with self._lock:
-                slot = self._pending.pop(seq, None)
+                slot = conn.pending.pop(seq, None)
             if slot is not None:
                 slot.reply = (status, payload)
                 slot.event.set()
 
-    def _fail_conn(self, sock: socket.socket, exc: BaseException) -> None:
+    def _fail_conn(self, conn: _MuxConn, exc: BaseException) -> None:
+        """Fail exactly the calls riding THIS connection; calls on a
+        reconnected successor are untouched."""
         with self._lock:
-            if self._sock is sock:
-                self._sock = None  # next call reconnects fresh
-            pending = list(self._pending.values())
-            self._pending.clear()
+            if self._conn is conn:
+                self._conn = None  # next call reconnects fresh
+            pending = list(conn.pending.values())
+            conn.pending.clear()
         try:
-            sock.close()
+            conn.sock.close()
         except OSError:
             pass
         for slot in pending:
@@ -348,17 +387,18 @@ class MuxRpcClient:
 
     def num_connections(self) -> int:
         with self._lock:
-            return 1 if self._sock is not None else 0
+            return 1 if self._conn is not None else 0
 
     def close(self) -> None:
         with self._lock:
             self._closed = True
-            sock, self._sock = self._sock, None
-            pending = list(self._pending.values())
-            self._pending.clear()
-        if sock is not None:
+            conn, self._conn = self._conn, None
+            pending = list(conn.pending.values()) if conn else []
+            if conn:
+                conn.pending.clear()
+        if conn is not None:
             try:
-                sock.close()
+                conn.sock.close()
             except OSError:
                 pass
         for slot in pending:

@@ -209,23 +209,32 @@ class Algorithm(Trainable):
         self._sync_weights()
 
     def _build_env_runners(self, cfg) -> "FaultTolerantActorManager | None":
+        # Algorithms that recompute values/logits learner-side declare a
+        # minimal column set; the runners then skip shipping the rest.
+        emit = getattr(cfg, "runner_emit_columns", None)
         if cfg.num_env_runners <= 0:
             self.local_env_runner = SingleAgentEnvRunner(
                 env_id=cfg.env, module_spec=self.module_spec,
                 num_envs=cfg.num_envs_per_env_runner,
                 rollout_fragment_length=cfg.rollout_fragment_length,
-                seed=cfg.seed, worker_index=0, explore=cfg.explore)
+                seed=cfg.seed, worker_index=0, explore=cfg.explore,
+                emit_columns=emit)
             return None
         RemoteRunner = ray_tpu.remote(SingleAgentEnvRunner)
         if getattr(cfg, "use_process_runners", False):
             RemoteRunner = RemoteRunner.options(process=True)
+        runner_options = dict(getattr(cfg, "runner_actor_options", None)
+                              or {})
+        if runner_options:
+            RemoteRunner = RemoteRunner.options(**runner_options)
 
         def factory(idx: int):
             return RemoteRunner.remote(
                 env_id=cfg.env, module_spec=self.module_spec,
                 num_envs=cfg.num_envs_per_env_runner,
                 rollout_fragment_length=cfg.rollout_fragment_length,
-                seed=cfg.seed, worker_index=idx + 1, explore=cfg.explore)
+                seed=cfg.seed, worker_index=idx + 1, explore=cfg.explore,
+                emit_columns=emit)
 
         actors = [factory(i) for i in range(cfg.num_env_runners)]
         self.local_env_runner = None

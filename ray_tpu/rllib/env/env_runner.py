@@ -38,14 +38,17 @@ class SingleAgentEnvRunner:
     def __init__(self, *, env_id: str, module_spec: RLModuleSpec,
                  num_envs: int = 8, rollout_fragment_length: int = 64,
                  seed: int = 0, worker_index: int = 0,
-                 explore: bool = True, inference_backend: str = "cpu"):
+                 explore: bool = True, inference_backend: str = "cpu",
+                 fused_rollouts: bool | None = None,
+                 emit_columns: tuple | None = None):
+        from ray_tpu.rllib.env.jax_env import get_jax_env
+
         self.worker_index = worker_index
         # Rollout inference defaults to the CPU backend: per-step policy
         # calls are tiny and latency-bound, and pinning them to CPU keeps
         # the TPU dedicated to the learner (the reference gets this for
         # free because env runners are plain CPU actors).
         self._device = rollout_device(inference_backend)
-        self.env = make_vector_env(env_id, num_envs)
         self.module = module_spec.build()
         self.rollout_fragment_length = rollout_fragment_length
         self.explore = explore
@@ -53,11 +56,39 @@ class SingleAgentEnvRunner:
         self._step_counter = 0
         self._weights = None
         self._weights_version = -1
-        self._obs = self.env.reset(seed=seed * 7919 + worker_index)
+        # Consumers that don't need every column skip its transport
+        # (IMPALA recomputes values/logits in the learner; shipping them
+        # wastes a third of the batch bytes).
+        self._emit_columns = (set(emit_columns)
+                              if emit_columns is not None else None)
+
+        # Device-resident rollouts: when the env has a pure-JAX
+        # implementation, the whole fragment (policy + physics +
+        # auto-reset) is ONE jitted lax.scan — no per-step dispatch
+        # (jax_env.py; no reference equivalent, rllib steps envs from
+        # Python per step). Default: on for accelerator rollout devices
+        # (dispatch-bound, the scan wins); off on CPU, where XLA's
+        # while-loop overhead per tiny step loses to the vectorized
+        # numpy loop — measured, not assumed.
+        if fused_rollouts is None:
+            fused_rollouts = (self._device is not None
+                              and self._device.platform != "cpu")
+        self._jax_env = get_jax_env(env_id, num_envs) \
+            if fused_rollouts else None
+        if self._jax_env is not None:
+            self.env = self._jax_env  # exposes num_envs/spaces
+            reset_rng = jax.random.PRNGKey(
+                np.uint32(seed * 7919 + worker_index))
+            self._env_state, self._obs = self._jax_env.reset(reset_rng)
+            self._fused_fns: dict[int, Any] = {}
+        else:
+            self.env = make_vector_env(env_id, num_envs)
+            self._obs = self.env.reset(seed=seed * 7919 + worker_index)
         self._stats = EpisodeStats(self.env.num_envs)
 
         fwd = (self.module.forward_exploration if explore
                else self.module.forward_inference)
+        self._fwd = fwd
         self._policy_step = make_policy_step(
             fwd, self._seed_base, self._device)
 
@@ -79,11 +110,95 @@ class SingleAgentEnvRunner:
         return self._weights_version
 
     # -- sampling ----------------------------------------------------
+    def _fused_rollout_fn(self, T: int):
+        """One jitted fn per fragment length: lax.scan over T of
+        (policy forward -> env.step), bootstrap value included."""
+        cached = self._fused_fns.get(T)
+        if cached is not None:
+            return cached
+        env = self._jax_env
+        fwd = self._fwd
+        seed_base = self._seed_base
+        emit = self._emit_columns
+        import jax.numpy as jnp
+
+        def rollout(weights, env_state, obs, start_t):
+            base = jax.random.PRNGKey(seed_base)
+
+            def body(carry, i):
+                env_state, obs = carry
+                rng = jax.random.fold_in(base, start_t + i)
+                out = fwd(weights, {"obs": obs, "t": start_t + i}, rng)
+                actions = out["actions"]
+                env_state, next_obs, rew, term, trunc = env.step(
+                    env_state, actions)
+                ys = {Columns.OBS: obs, Columns.ACTIONS: actions,
+                      Columns.REWARDS: rew, Columns.TERMINATEDS: term,
+                      Columns.TRUNCATEDS: trunc}
+                # Filtered columns never enter the scan's stacked
+                # outputs, so their device->host transfer is never paid.
+                for key, value in (
+                        (Columns.ACTION_LOGP,
+                         out.get("action_logp", jnp.zeros_like(rew))),
+                        (Columns.VF_PREDS,
+                         out.get("vf_preds", jnp.zeros_like(rew))),
+                        (Columns.ACTION_LOGITS, out["action_logits"])):
+                    if emit is None or key in emit:
+                        ys[key] = value
+                return (env_state, next_obs), ys
+
+            (env_state, obs), ys = jax.lax.scan(
+                body, (env_state, obs), jnp.arange(T))
+            brng = jax.random.fold_in(base, start_t + T)
+            bout = fwd(weights, {"obs": obs, "t": start_t + T}, brng)
+            bootstrap = bout.get("vf_preds", jnp.zeros(obs.shape[0]))
+            return env_state, obs, ys, bootstrap
+
+        jitted = jax.jit(rollout)
+        if self._device is not None:
+            def on_device(*args, _jitted=jitted):
+                with jax.default_device(self._device):
+                    return _jitted(*args)
+            fn = on_device
+        else:
+            fn = jitted
+        self._fused_fns[T] = fn
+        return fn
+
+    def _sample_fused(self, T: int) -> SampleBatch:
+        fn = self._fused_rollout_fn(T)
+        self._env_state, self._obs, ys, bootstrap = fn(
+            self._weights, self._env_state, self._obs,
+            self._step_counter)
+        self._step_counter += T + 1
+        batch = SampleBatch(jax.device_get(ys))
+        batch["bootstrap_value"] = np.asarray(bootstrap)
+        batch["weights_version"] = np.full(
+            (T,), self._weights_version, dtype=np.int64)
+        self._stats.record_fragment(
+            batch[Columns.REWARDS], batch[Columns.TERMINATEDS],
+            batch[Columns.TRUNCATEDS])
+        return batch
+
+    _OPTIONAL_COLUMNS = (Columns.ACTION_LOGP, Columns.VF_PREDS,
+                         Columns.ACTION_LOGITS)
+
+    def _filter_columns(self, batch: SampleBatch) -> SampleBatch:
+        if self._emit_columns is None:
+            return batch
+        for key in self._OPTIONAL_COLUMNS:
+            if key not in self._emit_columns:
+                batch.pop(key, None)
+        return batch
+
     def sample(self, num_steps: int | None = None) -> SampleBatch:
-        """Collect a [T, B] fragment. Hot loop: one vectorized env step +
-        one jitted policy call per T."""
+        """Collect a [T, B] fragment. Fused path: ONE jitted scan for
+        the whole fragment; fallback: one vectorized env step + one
+        jitted policy call per T."""
         assert self._weights is not None, "set_weights() before sample()"
         T = num_steps or self.rollout_fragment_length
+        if self._jax_env is not None:
+            return self._sample_fused(T)
         B = self.env.num_envs
         cols: dict[str, list] = {k: [] for k in (
             Columns.OBS, Columns.ACTIONS, Columns.REWARDS,
@@ -125,7 +240,7 @@ class SingleAgentEnvRunner:
         batch["weights_version"] = np.full(
             (batch[Columns.OBS].shape[0],), self._weights_version,
             dtype=np.int64)
-        return batch
+        return self._filter_columns(batch)
 
     def get_metrics(self) -> dict:
         """Drain episode metrics (reference: env runner metrics logger)."""

@@ -1,0 +1,187 @@
+"""Pure-JAX vector environments — device-resident rollout dynamics.
+
+No reference equivalent: rllib steps gymnasium envs from Python
+(rllib/env/single_agent_env_runner.py:125, one Python iteration per env
+step). Here the built-in control environments are pure functions of
+(state, action), so the WHOLE rollout fragment — policy forward + env
+physics + auto-reset — fuses into one jitted ``lax.scan``
+(env_runner.py), turning T jit dispatches + T numpy steps per fragment
+into a single device call. On TPU this keeps sampling on the MXU-fed
+compute path; on CPU it removes the per-step dispatch overhead that
+bounds IMPALA throughput.
+
+Functional protocol: ``reset(rng) -> (state, obs)``;
+``step(state, actions) -> (state, obs, reward, term, trunc)`` — state
+carries the PRNG so auto-resets stay inside the scan.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class JaxVectorEnv:
+    """B lockstep env copies as pure jittable functions."""
+
+    num_envs: int
+    observation_size: int
+    num_actions: int
+    action_size: int = 0
+    action_scale: float = 1.0
+
+    def reset(self, rng: jax.Array):
+        raise NotImplementedError
+
+    def step(self, state, actions):
+        raise NotImplementedError
+
+
+class JaxCartPole(JaxVectorEnv):
+    """CartPole-v1 dynamics as a pure function (same constants and
+    termination thresholds as vector_env.CartPoleVectorEnv / gymnasium,
+    so learning curves are comparable)."""
+
+    GRAVITY = 9.8
+    MASSCART = 1.0
+    MASSPOLE = 0.1
+    LENGTH = 0.5
+    FORCE_MAG = 10.0
+    TAU = 0.02
+    THETA_LIMIT = 12 * 2 * jnp.pi / 360
+    X_LIMIT = 2.4
+    MAX_STEPS = 500
+
+    observation_size = 4
+    num_actions = 2
+
+    def __init__(self, num_envs: int = 8, max_steps: int | None = None):
+        self.num_envs = num_envs
+        self.max_steps = max_steps or self.MAX_STEPS
+
+    def _fresh(self, rng):
+        return jax.random.uniform(
+            rng, (self.num_envs, 4), minval=-0.05, maxval=0.05,
+            dtype=jnp.float32)
+
+    def reset(self, rng: jax.Array):
+        rng, sub = jax.random.split(rng)
+        s = self._fresh(sub)
+        state = {"s": s, "t": jnp.zeros(self.num_envs, jnp.int32),
+                 "rng": rng}
+        return state, s
+
+    def step(self, state, actions):
+        x, x_dot, theta, theta_dot = (state["s"][:, 0], state["s"][:, 1],
+                                      state["s"][:, 2], state["s"][:, 3])
+        force = jnp.where(actions == 1, self.FORCE_MAG, -self.FORCE_MAG)
+        costheta, sintheta = jnp.cos(theta), jnp.sin(theta)
+        total_mass = self.MASSCART + self.MASSPOLE
+        polemass_length = self.MASSPOLE * self.LENGTH
+        temp = (force + polemass_length * theta_dot**2 * sintheta) \
+            / total_mass
+        thetaacc = (self.GRAVITY * sintheta - costheta * temp) / (
+            self.LENGTH * (4.0 / 3.0
+                           - self.MASSPOLE * costheta**2 / total_mass))
+        xacc = temp - polemass_length * thetaacc * costheta / total_mass
+        x = x + self.TAU * x_dot
+        x_dot = x_dot + self.TAU * xacc
+        theta = theta + self.TAU * theta_dot
+        theta_dot = theta_dot + self.TAU * thetaacc
+        s2 = jnp.stack([x, x_dot, theta, theta_dot], axis=1)
+        t2 = state["t"] + 1
+
+        terminated = ((jnp.abs(x) > self.X_LIMIT)
+                      | (jnp.abs(theta) > self.THETA_LIMIT))
+        truncated = (~terminated) & (t2 >= self.max_steps)
+        rewards = jnp.ones(self.num_envs, dtype=jnp.float32)
+
+        done = terminated | truncated
+        rng, sub = jax.random.split(state["rng"])
+        fresh = self._fresh(sub)
+        s2 = jnp.where(done[:, None], fresh, s2.astype(jnp.float32))
+        t2 = jnp.where(done, 0, t2)
+        new_state = {"s": s2, "t": t2, "rng": rng}
+        return new_state, s2, rewards, terminated, truncated
+
+
+class JaxPendulum(JaxVectorEnv):
+    """Pendulum-v1 dynamics as a pure function (g=10, m=1, l=1,
+    dt=0.05, torque clip ±2, speed clip ±8, 200-step truncation)."""
+
+    G = 10.0
+    M = 1.0
+    L = 1.0
+    DT = 0.05
+    MAX_TORQUE = 2.0
+    MAX_SPEED = 8.0
+    MAX_STEPS = 200
+
+    observation_size = 3
+    num_actions = 0
+    action_size = 1
+    action_scale = 2.0
+
+    def __init__(self, num_envs: int = 8, max_steps: int | None = None):
+        self.num_envs = num_envs
+        self.max_steps = max_steps or self.MAX_STEPS
+
+    def _fresh(self, rng):
+        r1, r2 = jax.random.split(rng)
+        theta = jax.random.uniform(r1, (self.num_envs,),
+                                   minval=-jnp.pi, maxval=jnp.pi)
+        thetadot = jax.random.uniform(r2, (self.num_envs,),
+                                      minval=-1.0, maxval=1.0)
+        return theta, thetadot
+
+    @staticmethod
+    def _obs(theta, thetadot):
+        return jnp.stack([jnp.cos(theta), jnp.sin(theta), thetadot],
+                         axis=1).astype(jnp.float32)
+
+    def reset(self, rng: jax.Array):
+        rng, sub = jax.random.split(rng)
+        theta, thetadot = self._fresh(sub)
+        state = {"theta": theta, "thetadot": thetadot,
+                 "t": jnp.zeros(self.num_envs, jnp.int32), "rng": rng}
+        return state, self._obs(theta, thetadot)
+
+    def step(self, state, actions):
+        u = jnp.clip(jnp.asarray(actions, jnp.float32).reshape(-1),
+                     -self.MAX_TORQUE, self.MAX_TORQUE)
+        theta, thetadot = state["theta"], state["thetadot"]
+        angle_norm = ((theta + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+        costs = angle_norm**2 + 0.1 * thetadot**2 + 0.001 * u**2
+
+        thetadot = thetadot + self.DT * (
+            3 * self.G / (2 * self.L) * jnp.sin(theta)
+            + 3.0 / (self.M * self.L**2) * u)
+        thetadot = jnp.clip(thetadot, -self.MAX_SPEED, self.MAX_SPEED)
+        theta = theta + self.DT * thetadot
+        t2 = state["t"] + 1
+
+        terminated = jnp.zeros(self.num_envs, dtype=bool)
+        truncated = t2 >= self.max_steps
+        rng, sub = jax.random.split(state["rng"])
+        f_theta, f_thetadot = self._fresh(sub)
+        theta = jnp.where(truncated, f_theta, theta)
+        thetadot = jnp.where(truncated, f_thetadot, thetadot)
+        t2 = jnp.where(truncated, 0, t2)
+        new_state = {"theta": theta, "thetadot": thetadot, "t": t2,
+                     "rng": rng}
+        return (new_state, self._obs(theta, thetadot),
+                (-costs).astype(jnp.float32), terminated, truncated)
+
+
+_JAX_ENVS = {"CartPole-v1": JaxCartPole, "Pendulum-v1": JaxPendulum}
+
+
+def get_jax_env(env_id: str, num_envs: int) -> JaxVectorEnv | None:
+    """A device-resident implementation of ``env_id``, or None (the
+    runner then falls back to the per-step numpy loop)."""
+    cls = _JAX_ENVS.get(env_id)
+    return cls(num_envs) if cls is not None else None
+
+
+def register_jax_env(env_id: str, factory) -> None:
+    _JAX_ENVS[env_id] = factory

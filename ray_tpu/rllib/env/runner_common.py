@@ -69,6 +69,36 @@ class EpisodeStats:
             self._ep_return[done] = 0.0
             self._ep_len[done] = 0
 
+    def record_fragment(self, rewards: np.ndarray, term: np.ndarray,
+                        trunc: np.ndarray) -> None:
+        """Whole-fragment [T, B] accounting in one call (the fused
+        rollout path — a Python loop over T here would reintroduce the
+        per-step overhead the fused path removes). Work is proportional
+        to the number of COMPLETED episodes, not T."""
+        T, B = rewards.shape
+        done = term | trunc
+        csum = np.cumsum(rewards, axis=0)
+        any_done = done.any(axis=0)
+        for b in np.flatnonzero(any_done):
+            prev_sum = 0.0
+            prev_t = -1
+            base_ret = self._ep_return[b]
+            base_len = self._ep_len[b]
+            for t in np.flatnonzero(done[:, b]):
+                self._completed_returns.append(
+                    float(base_ret + csum[t, b] - prev_sum))
+                self._completed_lengths.append(
+                    int(base_len + (t - prev_t)))
+                prev_sum = float(csum[t, b])
+                prev_t = int(t)
+                base_ret = 0.0
+                base_len = 0
+            self._ep_return[b] = csum[T - 1, b] - prev_sum
+            self._ep_len[b] = (T - 1) - prev_t
+        alive = ~any_done
+        self._ep_return[alive] += csum[T - 1, alive]
+        self._ep_len[alive] += T
+
     def drain(self) -> dict:
         rets, lens = self._completed_returns, self._completed_lengths
         self._completed_returns, self._completed_lengths = [], []
