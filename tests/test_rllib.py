@@ -882,3 +882,39 @@ def test_episode_stats_fragment_matches_per_step():
                        sorted(frag_stats._completed_returns), atol=1e-4)
     assert sorted(step_stats._completed_lengths) == \
         sorted(frag_stats._completed_lengths)
+
+
+def test_jax_pendulum_matches_numpy_env_dynamics():
+    """JaxPendulum must reproduce PendulumVectorEnv physics exactly so
+    fused rollouts train the same continuous-control task."""
+    import numpy as np
+
+    import jax
+
+    from ray_tpu.rllib.env.jax_env import JaxPendulum
+    from ray_tpu.rllib.env.vector_env import PendulumVectorEnv
+
+    B = 8
+    np_env = PendulumVectorEnv(B)
+    jx_env = JaxPendulum(B)
+    state, obs = jx_env.reset(jax.random.PRNGKey(0))
+    np_env._theta = np.asarray(state["theta"], dtype=np.float64).copy()
+    np_env._thetadot = np.asarray(state["thetadot"],
+                                  dtype=np.float64).copy()
+    np_env._t[:] = 0
+    rng = np.random.default_rng(2)
+    for _ in range(30):
+        actions = rng.uniform(-2, 2, size=(B, 1)).astype(np.float32)
+        np_obs, np_rew, np_term, np_trunc = np_env.step(actions)
+        state, jx_obs, jx_rew, jx_term, jx_trunc = jx_env.step(
+            state, actions)
+        live = ~np_trunc
+        assert np.allclose(np_obs[live], np.asarray(jx_obs)[live],
+                           atol=1e-4)
+        assert np.allclose(np_rew, np.asarray(jx_rew), atol=1e-4)
+        assert np.array_equal(np_trunc, np.asarray(jx_trunc))
+        np_env._theta = np.asarray(state["theta"],
+                                   dtype=np.float64).copy()
+        np_env._thetadot = np.asarray(state["thetadot"],
+                                      dtype=np.float64).copy()
+        np_env._t[:] = np.asarray(state["t"])
