@@ -121,9 +121,42 @@ def ensure_package_local(hash_hex: str, export_addr: str,
     return inner
 
 
+def ensure_file_local(hash_hex: str, export_addr: str,
+                      basename: str) -> str:
+    """A single packaged file (e.g. a wheel in a pip spec), downloaded
+    from the owner's export server on first use (per-node cache). The
+    cache path embeds the content hash, so changed content lands at a
+    new path."""
+    target_dir = os.path.join(_CACHE_ROOT, f"file-{hash_hex}")
+    target = os.path.join(target_dir, basename)
+    if os.path.exists(target):
+        return target
+    from ray_tpu._private.node_executor import fetch_blob
+    from ray_tpu._private.rpc import RpcClient
+
+    client = RpcClient(export_addr, timeout_s=120.0)
+    try:
+        blob = fetch_blob(client, bytes.fromhex(hash_hex))
+    finally:
+        client.close()
+    os.makedirs(target_dir, exist_ok=True)
+    tmp = target + f".tmp.{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    try:
+        os.rename(tmp, target)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    return target
+
+
 def resolve_runtime_env(renv: dict | None) -> dict | None:
-    """Worker-side: replace ``{"__pkg__": [hash, addr, member]}``
-    markers with locally extracted directories."""
+    """Worker-side: replace ``{"__pkg__": [hash, addr, member]}`` and
+    ``{"__pip_file__": [hash, addr, name]}`` markers with local
+    paths."""
     if not renv:
         return renv
 
@@ -138,4 +171,13 @@ def resolve_runtime_env(renv: dict | None) -> dict | None:
         out["working_dir"] = resolve(out["working_dir"])
     if out.get("py_modules"):
         out["py_modules"] = [resolve(m) for m in out["py_modules"]]
+    pip_spec = out.get("pip")
+    if isinstance(pip_spec, dict) and pip_spec.get("packages"):
+        packages = []
+        for entry in pip_spec["packages"]:
+            if isinstance(entry, dict) and "__pip_file__" in entry:
+                packages.append(ensure_file_local(*entry["__pip_file__"]))
+            else:
+                packages.append(entry)
+        out["pip"] = {**pip_spec, "packages": packages}
     return out

@@ -1,0 +1,152 @@
+"""Per-task/actor pip virtual environments.
+
+Reference: python/ray/_private/runtime_env/pip.py — a virtualenv per
+requirements hash, created once per node, cached, and activated for the
+workers that requested it. Here the venv is built with
+``--system-site-packages`` (the cluster's jax/numpy stay importable)
+and activation prepends the venv's site-packages onto ``sys.path`` for
+the task/actor's duration, with modules imported from it unloaded
+afterwards — pool workers are shared, so the env must not leak into the
+next task (same approach as working_dir/py_modules in
+worker_pool._runtime_env_ctx).
+
+Spec shapes (reference-compatible):
+    runtime_env={"pip": ["pkgA", "pkgB==1.2"]}
+    runtime_env={"pip": {"packages": [...],
+                         "pip_install_options": ["--no-index", ...]}}
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+_PIP_ENV_ROOT = os.environ.get("RAY_TPU_PIP_ENV_ROOT",
+                               "/tmp/ray_tpu_pip_envs")
+_CREATE_TIMEOUT_S = 600.0
+
+
+def normalize_pip_spec(spec) -> dict:
+    if isinstance(spec, (list, tuple)):
+        return {"packages": [str(p) for p in spec],
+                "pip_install_options": []}
+    if isinstance(spec, dict):
+        return {
+            "packages": [str(p) for p in spec.get("packages", [])],
+            "pip_install_options": [
+                str(o) for o in spec.get("pip_install_options", [])],
+        }
+    raise ValueError(
+        f"runtime_env['pip'] must be a list of requirements or a dict "
+        f"with 'packages'; got {type(spec).__name__}")
+
+
+def pip_env_hash(spec) -> str:
+    """Cache key: the normalized spec PLUS the content of any local
+    file entries — a wheel rebuilt at the same path must produce a new
+    env, never serve the stale cached one (same convention as
+    runtime_env directory packaging: content-hashed per submit)."""
+    norm = normalize_pip_spec(spec)
+    hasher = hashlib.sha1(json.dumps(norm, sort_keys=True).encode())
+    for entry in norm["packages"]:
+        if os.path.isfile(entry):
+            with open(entry, "rb") as f:
+                hasher.update(f.read())
+    return hasher.hexdigest()
+
+
+def _site_packages(target: str) -> str:
+    lib = os.path.join(target, "lib")
+    for entry in sorted(os.listdir(lib)) if os.path.isdir(lib) else []:
+        cand = os.path.join(lib, entry, "site-packages")
+        if os.path.isdir(cand):
+            return cand
+    raise FileNotFoundError(f"no site-packages under {target}")
+
+
+def env_info(target: str) -> dict:
+    return {
+        "path": target,
+        "python": os.path.join(target, "bin", "python"),
+        "site_packages": _site_packages(target),
+    }
+
+
+def ensure_pip_env(spec) -> dict:
+    """The cached venv for ``spec`` (created on first use per node).
+
+    -> {"path", "python", "site_packages"}. Creation is single-flight
+    across processes (lock dir); losers wait for the winner's
+    .complete marker.
+    """
+    norm = normalize_pip_spec(spec)
+    key = pip_env_hash(norm)
+    target = os.path.join(_PIP_ENV_ROOT, key)
+    marker = os.path.join(target, ".complete")
+    if os.path.exists(marker):
+        return env_info(target)
+    os.makedirs(_PIP_ENV_ROOT, exist_ok=True)
+    lock_dir = target + ".lock"
+    deadline = time.monotonic() + _CREATE_TIMEOUT_S
+    while True:
+        try:
+            os.mkdir(lock_dir)
+            break
+        except FileExistsError:
+            # Another process is creating this env: wait for it.
+            if os.path.exists(marker):
+                return env_info(target)
+            try:
+                # A creator killed without cleanup (SIGKILL/OOM) leaves
+                # the lock forever; reclaim it once it is older than any
+                # legitimate build could be.
+                age = time.time() - os.path.getmtime(lock_dir)
+                if age > _CREATE_TIMEOUT_S:
+                    os.rmdir(lock_dir)
+                    continue
+            except OSError:
+                pass  # lock vanished or unreadable; just retry
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"pip env {key} creation lock held too long "
+                    f"({lock_dir}); remove it if the creator crashed")
+            time.sleep(0.25)
+    try:
+        if os.path.exists(marker):  # winner finished while we locked
+            return env_info(target)
+        shutil.rmtree(target, ignore_errors=True)  # partial leftovers
+        _create_env(target, norm)
+        open(marker, "w").close()
+        return env_info(target)
+    except BaseException:
+        shutil.rmtree(target, ignore_errors=True)
+        raise
+    finally:
+        try:
+            os.rmdir(lock_dir)
+        except OSError:
+            pass
+
+
+def _create_env(target: str, norm: dict) -> None:
+    proc = subprocess.run(
+        [sys.executable, "-m", "venv", "--system-site-packages", target],
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"venv creation failed: {proc.stderr[-2000:]}")
+    if not norm["packages"]:
+        return
+    python = os.path.join(target, "bin", "python")
+    cmd = [python, "-m", "pip", "install", "--no-input",
+           "--disable-pip-version-check",
+           *norm["pip_install_options"], *norm["packages"]]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"pip install failed for {norm['packages']}: "
+            f"{(proc.stderr or proc.stdout)[-4000:]}")

@@ -936,7 +936,39 @@ class Runtime:
             # py_modules stay importable by their directory NAME.
             out["py_modules"] = [pack(m, keep_name=True)
                                  for m in out["py_modules"]]
+        if out.get("pip"):
+            out["pip"] = self._package_pip_spec(out["pip"])
         return out
+
+    def _package_pip_spec(self, spec):
+        """Local wheel/requirement FILES in a pip spec become content-
+        hashed export-store entries so remote daemons (no shared
+        filesystem) can fetch them; requirement strings pass through
+        (reference: runtime_env/pip.py + packaging.py URI scheme)."""
+        import hashlib
+
+        from ray_tpu._private.runtime_env_pip import normalize_pip_spec
+
+        norm = normalize_pip_spec(spec)
+        packages = []
+        for entry in norm["packages"]:
+            if os.path.isdir(entry):
+                raise ValueError(
+                    f"runtime_env pip entry {entry!r} is a directory; "
+                    "build a wheel (source installs need a build "
+                    "toolchain on every node)")
+            if os.path.isfile(entry):
+                with open(entry, "rb") as f:
+                    blob = f.read()
+                hash_hex = hashlib.sha1(blob).hexdigest()
+                self._export_store.put(bytes.fromhex(hash_hex), blob)
+                packages.append({"__pip_file__": [
+                    hash_hex, self._export_addr,
+                    os.path.basename(entry)]})
+            else:
+                packages.append(entry)
+        return {"packages": packages,
+                "pip_install_options": norm["pip_install_options"]}
 
     def lookup_block_context(self, token: str):
         """Block context of an in-flight pool task (client server calls

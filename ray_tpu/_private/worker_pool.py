@@ -112,6 +112,32 @@ class _runtime_env_ctx:
         self._unload_prefixes: list[str] = []
 
     def __enter__(self):
+        try:
+            self._enter_impl()
+        except BaseException:
+            # Partial application must not leak into the next task on
+            # this shared worker (e.g. env_vars applied, then pip
+            # failed): roll back what was done, then surface the error.
+            self.__exit__(None, None, None)
+            raise
+        return self
+
+    def _enter_impl(self):
+        pip_spec = self.env.get("pip")
+        if pip_spec:
+            # FIRST (it can fail — a venv/pip error must abort before
+            # any os.environ mutation): per-requirements-hash venv,
+            # created once per node and cached; its site-packages is
+            # prepended for this task's duration and its modules
+            # unloaded after (reference: runtime_env/pip.py).
+            from ray_tpu._private.runtime_env_pip import ensure_pip_env
+
+            info = ensure_pip_env(pip_spec)
+            site = info["site_packages"]
+            if site not in sys.path:
+                sys.path.insert(0, site)
+                self._added_sys_paths.append(site)
+            self._unload_prefixes.append(site)
         for k, v in (self.env.get("env_vars") or {}).items():
             self._saved_vars[k] = os.environ.get(k)
             os.environ[k] = str(v)
@@ -136,7 +162,6 @@ class _runtime_env_ctx:
             # parent directory (siblings may be imported legitimately
             # through other sys.path entries).
             self._unload_prefixes.append(abspath)
-        return self
 
     def __exit__(self, *exc):
         if self._saved_cwd is not None:

@@ -912,6 +912,7 @@ def test_jax_pendulum_matches_numpy_env_dynamics():
         assert np.allclose(np_obs[live], np.asarray(jx_obs)[live],
                            atol=1e-4)
         assert np.allclose(np_rew, np.asarray(jx_rew), atol=1e-4)
+        assert np.array_equal(np_term, np.asarray(jx_term))
         assert np.array_equal(np_trunc, np.asarray(jx_trunc))
         np_env._theta = np.asarray(state["theta"],
                                    dtype=np.float64).copy()

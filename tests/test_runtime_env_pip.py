@@ -1,0 +1,145 @@
+"""pip runtime environments: per-requirements-hash venvs, cached per
+node, activated for the requesting tasks/actors (VERDICT r3 #8).
+
+Reference test intent: python/ray/tests/test_runtime_env_conda_and_pip*
+— a package available ONLY through runtime_env={"pip": [...]} becomes
+importable inside the task. Offline-safe: installs a locally built
+wheel with --no-index (the cluster has zero egress).
+"""
+
+import os
+import time
+import zipfile
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+
+PKG_NAME = "rtenv_demo_pkg"
+
+
+def _build_wheel(dirpath) -> str:
+    """Minimal PEP-427 wheel for a one-module package (no setuptools,
+    no network — just a zip with the right dist-info)."""
+    wheel_path = os.path.join(
+        str(dirpath), f"{PKG_NAME}-1.0-py3-none-any.whl")
+    dist_info = f"{PKG_NAME}-1.0.dist-info"
+    files = {
+        f"{PKG_NAME}.py": "VALUE = 'pip-installed'\n"
+                          "def triple(x):\n    return x * 3\n",
+        f"{dist_info}/METADATA": (
+            "Metadata-Version: 2.1\n"
+            f"Name: {PKG_NAME}\nVersion: 1.0\n"),
+        f"{dist_info}/WHEEL": (
+            "Wheel-Version: 1.0\nGenerator: test\nRoot-Is-Purelib: true\n"
+            "Tag: py3-none-any\n"),
+        f"{dist_info}/RECORD": "",
+    }
+    with zipfile.ZipFile(wheel_path, "w") as zf:
+        for name, content in files.items():
+            zf.writestr(name, content)
+    return wheel_path
+
+
+def _pip_env(wheel_path: str) -> dict:
+    return {"pip": {"packages": [wheel_path],
+                    "pip_install_options": ["--no-index", "--no-deps"]}}
+
+
+def test_ensure_pip_env_creates_and_caches(tmp_path, monkeypatch):
+    import ray_tpu._private.runtime_env_pip as rep
+
+    monkeypatch.setattr(rep, "_PIP_ENV_ROOT", str(tmp_path / "envs"))
+    wheel = _build_wheel(tmp_path)
+    spec = _pip_env(wheel)["pip"]
+    t0 = time.monotonic()
+    info = rep.ensure_pip_env(spec)
+    create_time = time.monotonic() - t0
+    assert os.path.exists(
+        os.path.join(info["site_packages"], f"{PKG_NAME}.py"))
+    assert os.path.exists(info["python"])
+    # Second call is a pure cache hit (no venv/pip work).
+    t0 = time.monotonic()
+    again = rep.ensure_pip_env(spec)
+    assert again["path"] == info["path"]
+    assert time.monotonic() - t0 < create_time / 5
+    assert len(os.listdir(tmp_path / "envs")) == 1  # one env dir
+
+
+def test_bad_pip_spec_raises(tmp_path, monkeypatch):
+    import ray_tpu._private.runtime_env_pip as rep
+
+    monkeypatch.setattr(rep, "_PIP_ENV_ROOT", str(tmp_path / "envs"))
+    with pytest.raises(ValueError):
+        rep.normalize_pip_spec("not-a-list")
+    with pytest.raises(RuntimeError):
+        rep.ensure_pip_env({
+            "packages": ["definitely-not-a-real-pkg-xyz"],
+            "pip_install_options": ["--no-index"]})
+    # Failed creation leaves no half-built env behind.
+    leftovers = [d for d in os.listdir(tmp_path / "envs")
+                 if not d.endswith(".lock")] \
+        if (tmp_path / "envs").exists() else []
+    assert leftovers == []
+
+
+def test_pip_env_on_cluster_daemon(tmp_path):
+    """A package installed ONLY via runtime_env={"pip": [...]} imports
+    inside daemon tasks AND actors; the venv is created once per node
+    and reused."""
+    wheel = _build_wheel(tmp_path)
+    renv = _pip_env(wheel)
+
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir="/tmp/ray_tpu_test_pipenv")
+    cluster.add_node(num_cpus=2, pool_size=2)
+    try:
+        assert cluster.wait_for_nodes(1, timeout=30)
+        ray_tpu.init(num_cpus=0, address=cluster.address)
+        deadline = time.time() + 30
+        while time.time() < deadline and \
+                ray_tpu.cluster_resources().get("CPU", 0) < 2:
+            time.sleep(0.2)
+
+        @ray_tpu.remote(runtime_env=renv)
+        def use_pkg(x):
+            import rtenv_demo_pkg
+
+            assert os.environ.get("RAY_TPU_NODE_TAG"), "not on a daemon"
+            return rtenv_demo_pkg.VALUE, rtenv_demo_pkg.triple(x)
+
+        results = ray_tpu.get([use_pkg.remote(i) for i in range(4)],
+                              timeout=300)
+        assert all(v == "pip-installed" for v, _ in results)
+        assert [t for _, t in results] == [0, 3, 6, 9]
+
+        # The env must NOT leak into tasks without it.
+        @ray_tpu.remote
+        def no_pkg():
+            try:
+                import rtenv_demo_pkg  # noqa: F401
+
+                return "leaked"
+            except ImportError:
+                return "isolated"
+
+        assert ray_tpu.get(no_pkg.remote(), timeout=60) == "isolated"
+
+        # Actors take the same path (dedicated daemon process).
+        @ray_tpu.remote(num_cpus=1, runtime_env=renv)
+        class Uses:
+            def __init__(self):
+                import rtenv_demo_pkg
+
+                self.value = rtenv_demo_pkg.VALUE
+
+            def get(self):
+                return self.value
+
+        actor = Uses.remote()
+        assert ray_tpu.get(actor.get.remote(),
+                           timeout=120) == "pip-installed"
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
