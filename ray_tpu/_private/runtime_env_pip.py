@@ -46,6 +46,27 @@ def normalize_pip_spec(spec) -> dict:
         f"with 'packages'; got {type(spec).__name__}")
 
 
+# (path, mtime_ns, size) -> content sha1: hashing a wheel is paid once
+# per file VERSION, not once per task execution.
+_file_hash_memo: dict[tuple, str] = {}
+
+
+def _file_content_hash(path: str) -> str:
+    st = os.stat(path)
+    key = (os.path.abspath(path), st.st_mtime_ns, st.st_size)
+    cached = _file_hash_memo.get(key)
+    if cached is None:
+        hasher = hashlib.sha1()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                hasher.update(chunk)
+        cached = hasher.hexdigest()
+        _file_hash_memo[key] = cached
+        if len(_file_hash_memo) > 1024:
+            _file_hash_memo.pop(next(iter(_file_hash_memo)))
+    return cached
+
+
 def pip_env_hash(spec) -> str:
     """Cache key: the normalized spec PLUS the content of any local
     file entries — a wheel rebuilt at the same path must produce a new
@@ -55,8 +76,7 @@ def pip_env_hash(spec) -> str:
     hasher = hashlib.sha1(json.dumps(norm, sort_keys=True).encode())
     for entry in norm["packages"]:
         if os.path.isfile(entry):
-            with open(entry, "rb") as f:
-                hasher.update(f.read())
+            hasher.update(_file_content_hash(entry).encode())
     return hasher.hexdigest()
 
 
@@ -116,6 +136,23 @@ def ensure_pip_env(spec) -> dict:
                     f"pip env {key} creation lock held too long "
                     f"({lock_dir}); remove it if the creator crashed")
             time.sleep(0.25)
+    # Heartbeat: refresh the lock's mtime while building so waiters'
+    # stale-lock reclaim (age > timeout) never steals the lock from a
+    # LIVE builder whose install legitimately runs long.
+    import threading
+
+    stop_beat = threading.Event()
+
+    def _beat():
+        while not stop_beat.wait(30.0):
+            try:
+                os.utime(lock_dir)
+            except OSError:
+                return
+
+    beat = threading.Thread(target=_beat, daemon=True,
+                            name="pip-env-lock-heartbeat")
+    beat.start()
     try:
         if os.path.exists(marker):  # winner finished while we locked
             return env_info(target)
@@ -127,6 +164,7 @@ def ensure_pip_env(spec) -> dict:
         shutil.rmtree(target, ignore_errors=True)
         raise
     finally:
+        stop_beat.set()
         try:
             os.rmdir(lock_dir)
         except OSError:

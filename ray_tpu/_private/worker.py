@@ -945,9 +945,10 @@ class Runtime:
         hashed export-store entries so remote daemons (no shared
         filesystem) can fetch them; requirement strings pass through
         (reference: runtime_env/pip.py + packaging.py URI scheme)."""
-        import hashlib
-
-        from ray_tpu._private.runtime_env_pip import normalize_pip_spec
+        from ray_tpu._private.runtime_env_pip import (
+            _file_content_hash,
+            normalize_pip_spec,
+        )
 
         norm = normalize_pip_spec(spec)
         packages = []
@@ -958,10 +959,16 @@ class Runtime:
                     "build a wheel (source installs need a build "
                     "toolchain on every node)")
             if os.path.isfile(entry):
-                with open(entry, "rb") as f:
-                    blob = f.read()
-                hash_hex = hashlib.sha1(blob).hexdigest()
-                self._export_store.put(bytes.fromhex(hash_hex), blob)
+                # Content hash is memoized by (path, mtime, size); the
+                # export-store put is skipped when this exact content
+                # was already exported (repeat submits are free, like
+                # the working_dir path's _pkg_hashes memo).
+                hash_hex = _file_content_hash(entry)
+                if self._pkg_hashes.get(("pip", entry)) != hash_hex:
+                    with open(entry, "rb") as f:
+                        self._export_store.put(
+                            bytes.fromhex(hash_hex), f.read())
+                    self._pkg_hashes[("pip", entry)] = hash_hex
                 packages.append({"__pip_file__": [
                     hash_hex, self._export_addr,
                     os.path.basename(entry)]})

@@ -9,6 +9,14 @@ instead of DDP wrappers for multi-device learners.
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
+from ray_tpu.rllib.algorithms.bandits import (
+    BanditLinTS,
+    BanditLinTSConfig,
+    BanditLinUCB,
+    BanditLinUCBConfig,
+    LinearContextualBanditEnv,
+    register_bandit_env,
+)
 from ray_tpu.rllib.algorithms.bc import (
     BC,
     BCConfig,
@@ -25,6 +33,7 @@ from ray_tpu.rllib.algorithms.multi_agent_ppo import (
 )
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+from ray_tpu.rllib.algorithms.td3 import TD3, TD3Config
 from ray_tpu.rllib.core.learner import JaxLearner, Learner, compute_gae
 from ray_tpu.rllib.core.learner_group import LearnerGroup
 from ray_tpu.rllib.core.rl_module import (
@@ -61,6 +70,12 @@ from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
 __all__ = [
     "APPO",
     "APPOConfig",
+    "BanditLinTS",
+    "BanditLinTSConfig",
+    "BanditLinUCB",
+    "BanditLinUCBConfig",
+    "LinearContextualBanditEnv",
+    "register_bandit_env",
     "BC",
     "BCConfig",
     "MARWIL",
@@ -98,6 +113,8 @@ __all__ = [
     "ReplayBuffer",
     "SAC",
     "SACConfig",
+    "TD3",
+    "TD3Config",
     "SampleBatch",
     "SingleAgentEnvRunner",
     "VectorEnv",

@@ -1,0 +1,226 @@
+"""Contextual bandits: LinUCB and Linear Thompson Sampling.
+
+Reference: rllib/algorithms/bandit/ (BanditLinUCB / BanditLinTS —
+closed-form linear bandits over per-arm design matrices, no neural
+learner). TPU shape: the per-round arm scoring and the rank-1 design
+updates are vectorized over arms and batch lanes as dense linear
+algebra (solve/einsum) — one numpy/LAPACK call per round rather than
+per-arm Python loops.
+
+Environment contract: a :class:`LinearContextualBanditEnv`-style object
+with ``num_arms``, ``context_size``, ``observe(B) -> contexts [B, d]``,
+``pull(contexts, arms) -> rewards [B]``, and ``optimal(contexts) ->
+(best_arms, best_rewards)`` for regret accounting.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+
+
+class LinearContextualBanditEnv:
+    """Linear rewards: r = x . theta_arm + noise (the standard testbed,
+    reference: rllib's ParametricLinearBanditEnv family)."""
+
+    def __init__(self, num_arms: int = 5, context_size: int = 8,
+                 noise: float = 0.05, seed: int = 0):
+        self.num_arms = num_arms
+        self.context_size = context_size
+        self.noise = noise
+        rng = np.random.default_rng(seed)
+        theta = rng.normal(size=(num_arms, context_size))
+        self.theta = theta / np.linalg.norm(theta, axis=1, keepdims=True)
+        self._rng = rng
+
+    def observe(self, batch: int) -> np.ndarray:
+        x = self._rng.normal(size=(batch, self.context_size))
+        return (x / np.linalg.norm(x, axis=1, keepdims=True)).astype(
+            np.float32)
+
+    def pull(self, contexts: np.ndarray, arms: np.ndarray) -> np.ndarray:
+        mean = np.einsum("bd,bd->b", contexts, self.theta[arms])
+        return (mean + self._rng.normal(
+            scale=self.noise, size=len(arms))).astype(np.float32)
+
+    def optimal(self, contexts: np.ndarray):
+        means = contexts @ self.theta.T  # [B, K]
+        best = np.argmax(means, axis=1)
+        return best, means[np.arange(len(best)), best]
+
+
+_BANDIT_ENVS = {"LinearBandit-v0": LinearContextualBanditEnv}
+
+
+def register_bandit_env(env_id: str, factory) -> None:
+    _BANDIT_ENVS[env_id] = factory
+
+
+class BanditConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.env = "LinearBandit-v0"
+        self.num_arms = 5
+        self.context_size = 8
+        self.rounds_per_iteration = 64
+        self.batch_size = 16
+        self.alpha = 1.0          # LinUCB exploration bonus scale
+        self.lam = 1.0            # ridge regularizer on the design
+        self.ts_scale = 0.5       # LinTS posterior scale
+
+    def environment(self, env: str | None = None, **kwargs):
+        if env is not None:
+            self.env = env
+        for key, value in kwargs.items():
+            setattr(self, key, value)
+        return self
+
+    def build(self) -> "Algorithm":
+        assert self.algo_class is not None
+        return self.algo_class(config=self)
+
+
+class _LinearBandit(Algorithm):
+    """Shared closed-form machinery; subclasses pick the arm scorer."""
+
+    config_class = BanditConfig
+
+    def setup(self, config: dict) -> None:
+        # No module/learner/env-runner stack: bandits are closed-form
+        # (reference: the bandit algorithms bypass the RLModule path).
+        cfg = self.algo_config
+        factory = _BANDIT_ENVS.get(cfg.env)
+        if factory is None:
+            raise ValueError(
+                f"unknown bandit env {cfg.env!r}; register it with "
+                "register_bandit_env()")
+        self.env = factory(num_arms=cfg.num_arms,
+                           context_size=cfg.context_size,
+                           seed=cfg.seed)
+        K, d = self.env.num_arms, self.env.context_size
+        self._rng = np.random.default_rng(cfg.seed)
+        # Per-arm ridge design: A_k = lam*I + sum x x^T ; b_k = sum r x.
+        self.A = np.tile(np.eye(d) * cfg.lam, (K, 1, 1))
+        self.b = np.zeros((K, d))
+        self.cumulative_regret = 0.0
+        self.total_pulls = 0
+        self.total_optimal = 0
+
+    def _theta_hat(self) -> np.ndarray:
+        return np.linalg.solve(self.A, self.b[..., None])[..., 0]  # [K,d]
+
+    def _choose(self, contexts: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        rewards_sum = 0.0
+        for _ in range(cfg.rounds_per_iteration):
+            contexts = self.env.observe(cfg.batch_size)
+            arms = self._choose(contexts)
+            rewards = self.env.pull(contexts, arms)
+            best_arms, best_rewards = self.env.optimal(contexts)
+            chosen_means = np.einsum("bd,bd->b", contexts,
+                                     self.env.theta[arms])
+            self.cumulative_regret += float(
+                np.sum(best_rewards - chosen_means))
+            self.total_pulls += len(arms)
+            self.total_optimal += int(np.sum(arms == best_arms))
+            rewards_sum += float(rewards.sum())
+            # Rank-1 design updates, grouped per pulled arm.
+            for arm in np.unique(arms):
+                rows = contexts[arms == arm]
+                self.A[arm] += rows.T @ rows
+                self.b[arm] += rewards[arms == arm] @ rows
+            self._timesteps_total += len(arms)
+        pulls = cfg.rounds_per_iteration * cfg.batch_size
+        return {
+            "mean_reward": rewards_sum / pulls,
+            "cumulative_regret": self.cumulative_regret,
+            "regret_per_pull": self.cumulative_regret
+            / max(1, self.total_pulls),
+            "optimal_arm_rate": self.total_optimal
+            / max(1, self.total_pulls),
+        }
+
+    def cleanup(self) -> None:  # no actors to tear down
+        pass
+
+    def save_checkpoint(self, checkpoint_dir: str):
+        import os
+        import pickle
+
+        with open(os.path.join(checkpoint_dir, "bandit_state.pkl"),
+                  "wb") as f:
+            pickle.dump({
+                "A": self.A, "b": self.b,
+                "iteration": self.iteration,
+                "timesteps": self._timesteps_total,
+                "cumulative_regret": self.cumulative_regret,
+                "total_pulls": self.total_pulls,
+                "total_optimal": self.total_optimal,
+            }, f)
+        return checkpoint_dir
+
+    def load_checkpoint(self, checkpoint) -> None:
+        import os
+        import pickle
+
+        path = checkpoint if isinstance(checkpoint, str) else \
+            checkpoint.path
+        with open(os.path.join(path, "bandit_state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        self.A, self.b = state["A"], state["b"]
+        self.iteration = state["iteration"]
+        self._timesteps_total = state.get("timesteps", 0)
+        self.cumulative_regret = state.get("cumulative_regret", 0.0)
+        self.total_pulls = state.get("total_pulls", 0)
+        self.total_optimal = state.get("total_optimal", 0)
+
+
+class BanditLinUCB(_LinearBandit):
+    """LinUCB (Li et al. 2010): score = x.theta_hat + alpha *
+    sqrt(x A^-1 x) — optimism in the face of uncertainty."""
+
+    def _choose(self, contexts: np.ndarray) -> np.ndarray:
+        cfg = self.algo_config
+        theta = self._theta_hat()                       # [K, d]
+        means = contexts @ theta.T                      # [B, K]
+        # x A_k^-1 x per (lane, arm): solve K systems for all lanes.
+        Ainv_x = np.linalg.solve(
+            self.A[None, :, :, :],
+            np.broadcast_to(
+                contexts[:, None, :, None],
+                (contexts.shape[0], self.A.shape[0],
+                 contexts.shape[1], 1)))                 # [B, K, d, 1]
+        var = np.einsum("bd,bkd->bk", contexts, Ainv_x[..., 0])
+        ucb = means + cfg.alpha * np.sqrt(np.maximum(var, 0.0))
+        return np.argmax(ucb, axis=1)
+
+
+class BanditLinTS(_LinearBandit):
+    """Linear Thompson sampling: draw theta_k ~ N(theta_hat_k,
+    v^2 A_k^-1), pick the argmax arm under the sample."""
+
+    def _choose(self, contexts: np.ndarray) -> np.ndarray:
+        cfg = self.algo_config
+        theta = self._theta_hat()                       # [K, d]
+        K, d = theta.shape
+        Ainv = np.linalg.inv(self.A)                    # [K, d, d]
+        # One posterior sample per arm per round (shared across lanes —
+        # the standard batched-TS approximation).
+        chol = np.linalg.cholesky(
+            Ainv + 1e-9 * np.eye(d)[None])              # [K, d, d]
+        eps = self._rng.normal(size=(K, d, 1))
+        sampled = theta + cfg.ts_scale * (chol @ eps)[..., 0]
+        scores = contexts @ sampled.T                   # [B, K]
+        return np.argmax(scores, axis=1)
+
+
+class BanditLinUCBConfig(BanditConfig):
+    algo_class = BanditLinUCB
+
+
+class BanditLinTSConfig(BanditConfig):
+    algo_class = BanditLinTS

@@ -19,7 +19,11 @@ from ray_tpu.rllib.utils.replay_buffers import (
     PrioritizedReplayBuffer,
     ReplayBuffer,
 )
-from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
+from ray_tpu.rllib.utils.sample_batch import (
+    Columns,
+    SampleBatch,
+    fragment_to_transitions,
+)
 
 
 class QNetworkModule(RLModule):
@@ -194,29 +198,10 @@ class DQN(Algorithm):
         self._learner_steps = 0
 
     def _fragment_to_transitions(self, frag: SampleBatch) -> SampleBatch:
-        """[T, B] fragment -> flat (s, a, r, s', done) rows.
-
-        Drops (a) the last step of each lane (no stored successor) and
-        (b) TRUNCATED steps: the vector env auto-resets on done, so the
-        next stored obs belongs to a fresh episode — bootstrapping
-        r + gamma*Q(reset_obs) would poison the target. Terminated steps
-        are kept (their target ignores next_obs).
-        """
-        obs = np.asarray(frag[Columns.OBS])          # [T, B, obs]
-        next_obs = obs[1:]
-        keep = ~np.asarray(frag[Columns.TRUNCATEDS])[:-1].reshape(-1)
-        flat = SampleBatch({
-            Columns.OBS: obs[:-1].reshape((-1,) + obs.shape[2:])[keep],
-            Columns.NEXT_OBS: next_obs.reshape(
-                (-1,) + obs.shape[2:])[keep],
-            Columns.ACTIONS: np.asarray(
-                frag[Columns.ACTIONS])[:-1].reshape(-1)[keep],
-            Columns.REWARDS: np.asarray(
-                frag[Columns.REWARDS])[:-1].reshape(-1)[keep],
-            Columns.TERMINATEDS: np.asarray(
-                frag[Columns.TERMINATEDS])[:-1].reshape(-1)[keep],
-        })
-        return flat
+        """[T, B] fragment -> flat (s, a, r, s', done) rows (shared
+        truncation-boundary logic — see
+        utils/sample_batch.fragment_to_transitions)."""
+        return fragment_to_transitions(frag)
 
     def training_step(self) -> dict:
         cfg = self.algo_config

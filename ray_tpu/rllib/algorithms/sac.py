@@ -31,7 +31,11 @@ from ray_tpu.rllib.core.rl_module import (
     _mlp_init,
 )
 from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
-from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
+from ray_tpu.rllib.utils.sample_batch import (
+    Columns,
+    SampleBatch,
+    fragment_to_transitions,
+)
 
 LOG_STD_MIN = -20.0
 LOG_STD_MAX = 2.0
@@ -332,21 +336,7 @@ class SAC(Algorithm):
         self._learner_steps = 0
 
     def _fragment_to_transitions(self, frag: SampleBatch) -> SampleBatch:
-        obs = np.asarray(frag[Columns.OBS])          # [T, B, obs]
-        actions = np.asarray(frag[Columns.ACTIONS])  # [T, B, act]
-        next_obs = obs[1:]
-        keep = ~np.asarray(frag[Columns.TRUNCATEDS])[:-1].reshape(-1)
-        return SampleBatch({
-            Columns.OBS: obs[:-1].reshape((-1,) + obs.shape[2:])[keep],
-            Columns.NEXT_OBS: next_obs.reshape(
-                (-1,) + obs.shape[2:])[keep],
-            Columns.ACTIONS: actions[:-1].reshape(
-                (-1,) + actions.shape[2:])[keep],
-            Columns.REWARDS: np.asarray(
-                frag[Columns.REWARDS])[:-1].reshape(-1)[keep],
-            Columns.TERMINATEDS: np.asarray(
-                frag[Columns.TERMINATEDS])[:-1].reshape(-1)[keep],
-        })
+        return fragment_to_transitions(frag)
 
     def training_step(self) -> dict:
         cfg = self.algo_config

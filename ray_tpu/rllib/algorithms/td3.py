@@ -1,0 +1,274 @@
+"""TD3 — Twin Delayed Deep Deterministic policy gradient.
+
+Reference: rllib/algorithms/td3/ (config over DDPG: twin Q, delayed
+policy updates, target policy smoothing — Fujimoto et al. 2018). TPU
+shape: like SAC here, ONE jitted program per update kind — the critic
+step and the (delayed) critic+actor+polyak step are two compiled
+variants selected host-side by the step counter; no Python between the
+losses inside either program.
+
+Components:
+- deterministic tanh actor with Gaussian exploration noise;
+- twin Q critics with clipped double-Q targets;
+- target policy smoothing: clipped noise on the TARGET action;
+- delayed actor + target updates every ``policy_delay`` critic steps.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import (
+    RLModule,
+    RLModuleSpec,
+    _mlp_apply,
+    _mlp_init,
+)
+from ray_tpu.rllib.utils.replay_buffers import ReplayBuffer
+from ray_tpu.rllib.utils.sample_batch import (
+    Columns,
+    SampleBatch,
+    fragment_to_transitions,
+)
+
+
+class TD3Module(RLModule):
+    """Deterministic tanh actor + twin Q critics."""
+
+    def __init__(self, observation_size: int, num_actions: int = 0,
+                 action_size: int = 1, hidden: tuple = (256, 256),
+                 action_scale: float = 1.0, explore_noise: float = 0.1,
+                 **_):
+        assert num_actions == 0, "TD3 is continuous-control only"
+        self.observation_size = observation_size
+        self.action_size = action_size
+        self.hidden = tuple(hidden)
+        self.action_scale = float(action_scale)
+        self.explore_noise = float(explore_noise)
+
+    def init(self, rng):
+        pi_rng, q1_rng, q2_rng = jax.random.split(rng, 3)
+        obs, act, h = self.observation_size, self.action_size, self.hidden
+        return {
+            "pi": _mlp_init(pi_rng, (obs,) + h + (act,)),
+            "q1": _mlp_init(q1_rng, (obs + act,) + h + (1,)),
+            "q2": _mlp_init(q2_rng, (obs + act,) + h + (1,)),
+        }
+
+    def policy(self, params, obs):
+        return jnp.tanh(_mlp_apply(params["pi"], obs)) * self.action_scale
+
+    def q_values(self, params, obs, actions):
+        x = jnp.concatenate([obs, actions], axis=-1)
+        return (_mlp_apply(params["q1"], x)[..., 0],
+                _mlp_apply(params["q2"], x)[..., 0])
+
+    # -- RLModule passes ----------------------------------------------
+    def forward_inference(self, params, batch, rng=None):
+        a = self.policy(params, batch["obs"])
+        return {"actions": a, "action_logits": a,
+                "action_logp": jnp.zeros(a.shape[:-1])}
+
+    def forward_exploration(self, params, batch, rng=None):
+        a = self.policy(params, batch["obs"])
+        noise = self.explore_noise * self.action_scale * \
+            jax.random.normal(rng, a.shape)
+        a = jnp.clip(a + noise, -self.action_scale, self.action_scale)
+        return {"actions": a, "action_logits": a,
+                "action_logp": jnp.zeros(a.shape[:-1]),
+                "vf_preds": jnp.zeros(a.shape[:-1])}
+
+    def forward_train(self, params, batch, rng=None):
+        return {}
+
+
+class TD3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.env = "Pendulum-v1"
+        self.module_class = TD3Module
+        self.model_config = {"hidden": (256, 256)}
+        self.actor_lr = 1e-3
+        self.critic_lr = 1e-3
+        self.tau = 0.005
+        self.policy_delay = 2            # critic steps per actor step
+        self.target_noise = 0.2          # target policy smoothing sigma
+        self.target_noise_clip = 0.5
+        self.explore_noise = 0.1
+        self.buffer_capacity = 100_000
+        self.train_batch_size = 256
+        self.num_steps_sampled_before_learning = 1500
+        self.updates_per_iteration = 64
+
+    def module_spec(self):
+        spec = super().module_spec()
+        spec.model_config.setdefault("explore_noise", self.explore_noise)
+        return spec
+
+    def learner_class(self):
+        return TD3Learner
+
+
+class TD3Learner(Learner):
+    """Two compiled update variants: critic-only and
+    critic+actor+polyak (the delayed step). The host picks by step
+    counter (reference: td3 policy_delay)."""
+
+    def __init__(self, module_spec: RLModuleSpec, config=None, mesh=None):
+        super().__init__(module_spec, config, mesh)
+        self.target_params = jax.tree_util.tree_map(jnp.copy, self.params)
+        # The actor gets its OWN optimizer, touched only on delayed
+        # steps: routing zero grads through a shared Adam would still
+        # move the policy via leftover momentum on every critic step,
+        # violating policy_delay (the reference's separate optimizers
+        # have the same effect).
+        self._actor_opt = optax.adam(
+            getattr(config, "actor_lr", 1e-3) if config else 1e-3)
+        self._actor_opt_state = self._actor_opt.init(self.params["pi"])
+        self.opt_state = self.optimizer.init(
+            {"q1": self.params["q1"], "q2": self.params["q2"]})
+        self._updates = {}  # do_actor -> jitted fn
+
+    def configure_optimizer(self):
+        # Critic optimizer only (over {q1, q2}); see __init__ for the
+        # actor's dedicated transform.
+        return optax.adam(getattr(self.config, "critic_lr", 1e-3)
+                          if self.config else 1e-3)
+
+    def _build_update(self, do_actor: bool):
+        cfg = self.config
+        gamma = cfg.gamma
+        tau = getattr(cfg, "tau", 0.005)
+        target_noise = float(getattr(cfg, "target_noise", 0.2))
+        noise_clip = float(getattr(cfg, "target_noise_clip", 0.5))
+        module = self.module
+        scale = module.action_scale
+
+        def update(params, opt_state, actor_opt_state, target_params,
+                   batch, rng):
+            # --- critic: clipped double-Q with SMOOTHED target action
+            next_a = module.policy(target_params, batch[Columns.NEXT_OBS])
+            smoothing = jnp.clip(
+                target_noise * scale * jax.random.normal(
+                    rng, next_a.shape),
+                -noise_clip * scale, noise_clip * scale)
+            next_a = jnp.clip(next_a + smoothing, -scale, scale)
+            tq1, tq2 = module.q_values(
+                target_params, batch[Columns.NEXT_OBS], next_a)
+            not_done = 1.0 - batch[Columns.TERMINATEDS].astype(jnp.float32)
+            targets = jax.lax.stop_gradient(
+                batch[Columns.REWARDS]
+                + gamma * not_done * jnp.minimum(tq1, tq2))
+
+            def critic_loss_fn(p):
+                q1, q2 = module.q_values(
+                    p, batch[Columns.OBS], batch[Columns.ACTIONS])
+                return 0.5 * (jnp.mean(jnp.square(q1 - targets))
+                              + jnp.mean(jnp.square(q2 - targets))), q1
+
+            (critic_loss, q1_vals), critic_grads = jax.value_and_grad(
+                critic_loss_fn, has_aux=True)(params)
+            critic_only = {"q1": critic_grads["q1"],
+                           "q2": critic_grads["q2"]}
+            updates, opt_state = self.optimizer.update(
+                critic_only, opt_state,
+                {"q1": params["q1"], "q2": params["q2"]})
+            new_critics = optax.apply_updates(
+                {"q1": params["q1"], "q2": params["q2"]}, updates)
+            params = {**params, **new_critics}
+            actor_loss = jnp.zeros(())
+            if do_actor:
+                def actor_loss_fn(pi):
+                    p = {**params, "pi": pi}
+                    a = module.policy(p, batch[Columns.OBS])
+                    q1, _ = module.q_values(p, batch[Columns.OBS], a)
+                    return -jnp.mean(q1)
+
+                actor_loss, pi_grads = jax.value_and_grad(
+                    actor_loss_fn)(params["pi"])
+                pi_updates, actor_opt_state = self._actor_opt.update(
+                    pi_grads, actor_opt_state, params["pi"])
+                params = {**params, "pi": optax.apply_updates(
+                    params["pi"], pi_updates)}
+                target_params = jax.tree_util.tree_map(
+                    lambda t, o: (1 - tau) * t + tau * o,
+                    target_params, params)
+            metrics = {"critic_loss": critic_loss,
+                       "actor_loss": actor_loss,
+                       "q_mean": jnp.mean(q1_vals)}
+            return (params, opt_state, actor_opt_state, target_params,
+                    metrics)
+
+        return jax.jit(update)
+
+    def update_from_batch(self, batch: SampleBatch,
+                          sync_metrics: bool = True) -> dict:
+        delay = max(1, int(getattr(self.config, "policy_delay", 2)))
+        do_actor = (self._steps + 1) % delay == 0
+        fn = self._updates.get(do_actor)
+        if fn is None:
+            fn = self._updates[do_actor] = self._build_update(do_actor)
+        self._rng, rng = jax.random.split(self._rng)
+        arrays = self._device_batch(batch)
+        (self.params, self.opt_state, self._actor_opt_state,
+         self.target_params, metrics) = fn(
+            self.params, self.opt_state, self._actor_opt_state,
+            self.target_params, arrays, rng)
+        self._steps += 1
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_state(self) -> dict:
+        state = super().get_state()
+        state["target_params"] = jax.device_get(self.target_params)
+        state["actor_opt_state"] = jax.device_get(self._actor_opt_state)
+        return state
+
+    def set_state(self, state: dict) -> None:
+        super().set_state(state)
+        if "target_params" in state:
+            self.target_params = state["target_params"]
+        if "actor_opt_state" in state:
+            self._actor_opt_state = state["actor_opt_state"]
+
+
+class TD3(Algorithm):
+    """Off-policy loop: replay buffer of flat transitions, N jitted
+    updates per iteration (same skeleton as SAC/DQN)."""
+
+    config_class = TD3Config
+
+    def setup(self, config: dict) -> None:
+        cfg = self.algo_config
+        if cfg.num_learners > 0:
+            raise ValueError(
+                "TD3 runs on a local learner (one jitted program per "
+                "update); scale over devices with "
+                "num_devices_per_learner instead of num_learners")
+        super().setup(config)
+        self.replay = ReplayBuffer(cfg.buffer_capacity, seed=cfg.seed)
+        self._learner_steps = 0
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        for frag in self._sample_fragments():
+            self.replay.add(fragment_to_transitions(frag))
+        metrics: dict = {}
+        if len(self.replay) >= cfg.num_steps_sampled_before_learning:
+            for _ in range(cfg.updates_per_iteration):
+                batch = self.replay.sample(cfg.train_batch_size)
+                metrics = self.learner_group.update_from_batch(batch)
+                self._learner_steps += 1
+            self._sync_weights()
+        results = self._runner_metrics()
+        results.update(metrics)
+        results["replay_buffer_size"] = len(self.replay)
+        results["num_learner_steps"] = self._learner_steps
+        return results
+
+
+TD3Config.algo_class = TD3

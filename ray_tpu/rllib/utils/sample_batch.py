@@ -110,3 +110,25 @@ def pad_to_multiple(batch: SampleBatch, multiple: int,
             for k, v in batch.items()
         })
     return batch, mask
+
+
+def fragment_to_transitions(frag: "SampleBatch") -> "SampleBatch":
+    """Flatten a time-major [T, B] rollout fragment into (s, a, r, s',
+    done) transition rows for replay buffers, dropping rows whose
+    next_obs crosses a truncation boundary (the auto-reset obs belongs
+    to a NEW episode). Shared by the off-policy algorithms (SAC/TD3;
+    reference: the replay-ingest path of their torch learners)."""
+    obs = np.asarray(frag[Columns.OBS])          # [T, B, obs]
+    actions = np.asarray(frag[Columns.ACTIONS])  # [T, B, act]
+    next_obs = obs[1:]
+    keep = ~np.asarray(frag[Columns.TRUNCATEDS])[:-1].reshape(-1)
+    return SampleBatch({
+        Columns.OBS: obs[:-1].reshape((-1,) + obs.shape[2:])[keep],
+        Columns.NEXT_OBS: next_obs.reshape((-1,) + obs.shape[2:])[keep],
+        Columns.ACTIONS: actions[:-1].reshape(
+            (-1,) + actions.shape[2:])[keep],
+        Columns.REWARDS: np.asarray(
+            frag[Columns.REWARDS])[:-1].reshape(-1)[keep],
+        Columns.TERMINATEDS: np.asarray(
+            frag[Columns.TERMINATEDS])[:-1].reshape(-1)[keep],
+    })

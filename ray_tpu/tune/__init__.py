@@ -4,10 +4,12 @@ from ray_tpu.train.session import get_checkpoint
 from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    PB2,
     PopulationBasedTraining,
 )
 from ray_tpu.tune.search import (
     BasicVariantSearcher,
+    DefineByRunSearcher,
     Searcher,
     TPESearcher,
     choice,
@@ -76,7 +78,9 @@ __all__ = [
     "loguniform",
     "randint",
     "report",
+    "PB2",
     "PopulationBasedTraining",
+    "DefineByRunSearcher",
     "run",
     "sample_from",
     "uniform",

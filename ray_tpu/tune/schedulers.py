@@ -1,4 +1,4 @@
-"""Trial schedulers: FIFO, ASHA, and Population Based Training.
+"""Trial schedulers: FIFO, ASHA, Population Based Training, and PB2.
 
 Reference: python/ray/tune/schedulers/async_hyperband.py (ASHA) — rungs
 at grace_period * reduction_factor^k; a trial reaching a rung must be in
@@ -6,7 +6,10 @@ the top 1/reduction_factor of results seen at that rung or it stops.
 python/ray/tune/schedulers/pbt.py (PBT) — at each perturbation interval,
 bottom-quantile trials *exploit* a top-quantile trial (copy its config +
 checkpoint) and *explore* (mutate hyperparameters), continuing training
-from the copied checkpoint.
+from the copied checkpoint. python/ray/tune/schedulers/pb2.py (PB2) —
+PBT whose explore step is model-based: a time-aware Gaussian process
+over (t, hyperparams) -> reward change selects new configs by UCB
+instead of random perturbation (Parker-Holder et al., NeurIPS 2020).
 """
 
 from __future__ import annotations
@@ -187,4 +190,126 @@ class PopulationBasedTraining:
             elif isinstance(current, (int, float)):
                 config[key] = current * self._rng.choice(
                     self.perturbation_factors)
+        return config
+
+
+class PB2(PopulationBasedTraining):
+    """Population Based Bandits (reference:
+    python/ray/tune/schedulers/pb2.py; the reference wraps GPy — here
+    the GP is ~40 lines of numpy, same RBF-kernel UCB acquisition).
+
+    Instead of PBT's random perturbation, explore fits a Gaussian
+    process on observations ((t, hyperparams) -> score improvement)
+    and proposes the config maximizing UCB = mu + kappa * sigma within
+    ``hyperparam_bounds`` — sample-efficient for small populations,
+    where random perturbation thrashes.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 perturbation_interval: int = 5,
+                 hyperparam_bounds: dict | None = None,
+                 quantile_fraction: float = 0.25,
+                 time_attr: str = "training_iteration",
+                 kappa: float = 2.0, lengthscale: float = 0.3,
+                 noise: float = 1e-3, n_candidates: int = 128,
+                 max_observations: int = 256,
+                 seed: int | None = None):
+        if not hyperparam_bounds:
+            raise ValueError("PB2 requires hyperparam_bounds")
+        for key, bounds in hyperparam_bounds.items():
+            if len(bounds) != 2 or not bounds[0] < bounds[1]:
+                raise ValueError(
+                    f"hyperparam_bounds[{key!r}] must be (low, high); "
+                    f"got {bounds}")
+        # The base class only reads hyperparam_mutations in _explore,
+        # which PB2 overrides — pass bounds to satisfy the constructor.
+        super().__init__(
+            metric=metric, mode=mode,
+            perturbation_interval=perturbation_interval,
+            hyperparam_mutations=dict(hyperparam_bounds),
+            quantile_fraction=quantile_fraction, time_attr=time_attr,
+            seed=seed)
+        self.hyperparam_bounds = {
+            k: (float(lo), float(hi))
+            for k, (lo, hi) in hyperparam_bounds.items()}
+        self.kappa = kappa
+        self.lengthscale = lengthscale
+        self.noise = noise
+        self.n_candidates = n_candidates
+        self.max_observations = max_observations
+        self._prev_score: dict[str, float] = {}
+        # GP dataset: rows of [t_norm, x_norm...] -> score delta.
+        self._obs_x: list[list[float]] = []
+        self._obs_y: list[float] = []
+        self._t_max = 1.0
+
+    # -- observation feed ---------------------------------------------
+    def on_result(self, trial_id: str, metrics: dict) -> str:
+        t = metrics.get(self.time_attr)
+        value = metrics.get(self.metric)
+        if t is not None and value is not None:
+            score = self._score(float(value))
+            prev = self._prev_score.get(trial_id)
+            config = self._configs.get(trial_id)
+            if prev is not None and config is not None:
+                self._t_max = max(self._t_max, float(t))
+                self._obs_x.append(
+                    [float(t)] + self._vec(config))
+                self._obs_y.append(score - prev)
+                if len(self._obs_y) > self.max_observations:
+                    del self._obs_x[0]
+                    del self._obs_y[0]
+            self._prev_score[trial_id] = score
+        return super().on_result(trial_id, metrics)
+
+    def exploit(self, trial_id: str):
+        # The exploiting trial jumps to the source's checkpointed score;
+        # its next delta would otherwise record that jump as if the NEW
+        # hyperparams caused it, poisoning the GP with a huge outlier.
+        self._prev_score.pop(trial_id, None)
+        return super().exploit(trial_id)
+
+    # -- GP-UCB explore ------------------------------------------------
+    def _vec(self, config: dict) -> list[float]:
+        out = []
+        for key, (lo, hi) in self.hyperparam_bounds.items():
+            v = float(config.get(key, (lo + hi) / 2))
+            out.append((v - lo) / (hi - lo))
+        return out
+
+    def _explore(self, config: dict) -> dict:
+        import numpy as np
+
+        keys = list(self.hyperparam_bounds)
+        cands = np.array([
+            [self._rng.random() for _ in keys]
+            for _ in range(self.n_candidates)])          # [C, d] in [0,1]
+        if len(self._obs_y) >= 4:
+            X = np.asarray(self._obs_x, dtype=float)
+            X[:, 0] /= self._t_max                       # normalize t
+            y = np.asarray(self._obs_y, dtype=float)
+            y_std = y.std() or 1.0
+            y_n = (y - y.mean()) / y_std
+            t_now = np.full((len(cands), 1),
+                            min(1.0, (max(x[0] for x in self._obs_x)
+                                      / self._t_max)))
+            C = np.concatenate([t_now, cands], axis=1)   # [C, d+1]
+
+            def rbf(a, b):
+                d2 = ((a[:, None, :] - b[None, :, :]) ** 2).sum(-1)
+                return np.exp(-0.5 * d2 / self.lengthscale ** 2)
+
+            K = rbf(X, X) + self.noise * np.eye(len(X))
+            Ks = rbf(C, X)                               # [C, N]
+            alpha = np.linalg.solve(K, y_n)
+            mu = Ks @ alpha
+            v = np.linalg.solve(K, Ks.T)
+            var = np.maximum(1.0 - np.einsum("cn,nc->c", Ks, v), 1e-12)
+            best = int(np.argmax(mu + self.kappa * np.sqrt(var)))
+        else:
+            best = int(self._rng.random() * len(cands)) % len(cands)
+        chosen = cands[best]
+        for key, unit in zip(keys, chosen):
+            lo, hi = self.hyperparam_bounds[key]
+            config[key] = lo + float(unit) * (hi - lo)
         return config

@@ -294,3 +294,112 @@ class TPESearcher(Searcher):
                 (dom.high - dom.low) / 5.0, 1.0))))
             return min(max(x, dom.low), dom.high - 1)
         return dom.sample(self._rng)
+
+
+class TrialParams:
+    """The ``trial`` object handed to a define-by-run function
+    (reference: tune/search/optuna — OptunaSearch's define-by-run mode;
+    API mirrors optuna.Trial.suggest_*)."""
+
+    def __init__(self, sampler):
+        self._sampler = sampler
+        self.params: dict = {}
+
+    def _suggest(self, name: str, dom):
+        if name in self.params:
+            return self.params[name]
+        value = self._sampler(name, dom)
+        self.params[name] = value
+        return value
+
+    def suggest_float(self, name: str, low: float, high: float,
+                      log: bool = False):
+        return self._suggest(
+            name, LogUniform(low, high) if log else Uniform(low, high))
+
+    def suggest_int(self, name: str, low: int, high: int):
+        # Inclusive bounds like optuna; RandInt is exclusive-high.
+        return self._suggest(name, RandInt(low, high + 1))
+
+    def suggest_categorical(self, name: str, choices):
+        return self._suggest(name, Choice(list(choices)))
+
+
+class DefineByRunSearcher(Searcher):
+    """Optuna-style define-by-run search on the Searcher plugin API
+    (reference: tune/search/optuna/optuna_search.py's ``space`` as a
+    callable). The search space is DISCOVERED by executing the user's
+    ``define(trial)`` function; each parameter is sampled by a
+    per-parameter TPE over the completed trials where it appeared, so
+    conditional parameters (suggested only down some branch) are
+    handled naturally — absent parameters simply have no observations.
+
+    ``define`` may return a dict of extra constants merged into the
+    trial config, or None (the suggested params ARE the config).
+    """
+
+    def __init__(self, define, metric: str | None = None,
+                 mode: str | None = None, n_initial_points: int = 8,
+                 gamma: float = 0.25, n_candidates: int = 16,
+                 seed: int | None = None):
+        super().__init__(metric=metric, mode=mode)
+        self._define = define
+        self.n_initial = n_initial_points
+        self.gamma = gamma
+        self.n_candidates = n_candidates
+        self._rng = random.Random(seed)
+        # Density/jitter machinery shared with the space-dict TPE.
+        self._tpe = TPESearcher(seed=seed)
+        self._suggested: dict[str, dict] = {}
+        self._observed: list[tuple[dict, float]] = []
+
+    def set_search_properties(self, metric: str, mode: str,
+                              param_space: dict) -> None:
+        # The space comes from the define fn; a param_space dict (other
+        # than {}) would silently be ignored — refuse instead.
+        if param_space:
+            raise ValueError(
+                "DefineByRunSearcher discovers the space from its "
+                "define() function; pass param_space={} to the Tuner")
+        self.metric = self.metric or metric
+        self.mode = self.mode or mode
+
+    def _sample_param(self, name: str, dom):
+        relevant = [(cfg[name], score) for cfg, score in self._observed
+                    if name in cfg]
+        if len(relevant) < self.n_initial or not hasattr(dom, "sample"):
+            return dom.sample(self._rng)
+        ranked = sorted(relevant, key=lambda vs: vs[1])
+        n_good = max(1, int(self.gamma * len(ranked)))
+        good = [v for v, _ in ranked[:n_good]]
+        bad = [v for v, _ in ranked[n_good:]] or good
+        best, best_score = None, -float("inf")
+        for _ in range(self.n_candidates):
+            if isinstance(dom, Choice) or self._rng.random() < 0.25:
+                cand = dom.sample(self._rng)
+            else:
+                cand = self._tpe._jitter(dom, self._rng.choice(good))
+            score = self._tpe._dim_score(dom, good, bad, cand)
+            if score > best_score:
+                best, best_score = cand, score
+        return best if best is not None else dom.sample(self._rng)
+
+    def suggest(self, trial_id: str) -> dict | None:
+        trial = TrialParams(self._sample_param)
+        extras = self._define(trial)
+        config = dict(trial.params)
+        if isinstance(extras, dict):
+            config.update(extras)
+        self._suggested[trial_id] = dict(trial.params)
+        return config
+
+    def on_trial_complete(self, trial_id: str, result: dict | None,
+                          error: bool = False) -> None:
+        params = self._suggested.pop(trial_id, None)
+        if params is None or error or not result:
+            return
+        value = result.get(self.metric)
+        if value is None:
+            return
+        score = float(value) if self.mode == "min" else -float(value)
+        self._observed.append((params, score))

@@ -242,3 +242,115 @@ def test_tpe_rejects_grid_axes(fresh_runtime):
             tune_config=tune.TuneConfig(
                 search_alg=tune.TPESearcher(), num_samples=2),
         ).fit()
+
+
+# ----------------------------------------------------------------- PB2
+
+
+def test_pb2_explore_uses_gp_within_bounds():
+    """PB2's model-based explore proposes configs INSIDE the declared
+    bounds and, given clear observations (higher lr => bigger score
+    gains), prefers the good region over uniform sampling."""
+    from ray_tpu.train.checkpoint import Checkpoint
+    from ray_tpu.tune.schedulers import CONTINUE, EXPLOIT, PB2
+
+    pb2 = PB2(metric="score", mode="max", perturbation_interval=1,
+              hyperparam_bounds={"lr": (0.0, 1.0)}, seed=0,
+              quantile_fraction=0.5, n_candidates=256)
+    ckpt = Checkpoint.from_dict({"w": 1})
+    # Feed observations: trials with high lr improve fast.
+    score = {"hi": 0.0, "lo": 0.0}
+    for t in range(1, 8):
+        for tid, lr in (("hi", 0.9), ("lo", 0.1)):
+            pb2.on_trial_state(tid, {"lr": lr}, ckpt)
+            score[tid] += lr  # delta per step == lr
+            pb2.on_result(tid, {"training_iteration": t,
+                                "score": score[tid]})
+    assert len(pb2._obs_y) > 4
+    decision = pb2.on_result("lo", {"training_iteration": 8,
+                                    "score": score["lo"]})
+    assert decision == EXPLOIT or decision == CONTINUE
+    # Ask explore directly: the GP should propose a HIGH lr.
+    proposals = [pb2._explore({"lr": 0.1})["lr"] for _ in range(8)]
+    assert all(0.0 <= p <= 1.0 for p in proposals)
+    assert sum(p > 0.5 for p in proposals) >= 6, proposals
+
+
+def test_pb2_end_to_end_improves_bad_trials(ray_start_regular):
+    from ray_tpu.train.checkpoint import Checkpoint
+    from ray_tpu.tune.schedulers import PB2
+
+    def trainable(config):
+        ckpt = tune.get_checkpoint()
+        step = ckpt.to_dict()["step"] if ckpt is not None else 0
+        for i in range(step + 1, step + 21):
+            score = i * config["lr"]  # monotone in lr within (0, 1)
+            tune.report({"score": score, "training_iteration": i},
+                        checkpoint=Checkpoint.from_dict({"step": i}))
+            if i >= 20:
+                return
+
+    pb2 = PB2(metric="score", mode="max", perturbation_interval=5,
+              hyperparam_bounds={"lr": (0.0, 1.0)}, seed=1,
+              quantile_fraction=0.5)
+    results = Tuner(
+        trainable,
+        param_space={"lr": tune.grid_search([0.05, 0.9])},
+        tune_config=TuneConfig(metric="score", mode="max",
+                               scheduler=pb2),
+    ).fit()
+    best = results.get_best_result(metric="score", mode="max")
+    assert best.metrics["score"] >= 15
+    assert pb2.num_perturbations >= 1
+
+
+# ------------------------------------------------------- define-by-run
+
+
+def test_define_by_run_conditional_space(ray_start_regular):
+    """The space is discovered by executing define(trial); the
+    conditional branch parameter only exists for the trials that took
+    that branch, and the searcher still optimizes."""
+    from ray_tpu.tune import DefineByRunSearcher
+
+    def define(trial):
+        algo = trial.suggest_categorical("algo", ["quad", "abs"])
+        x = trial.suggest_float("x", -2.0, 2.0)
+        if algo == "quad":
+            # Conditional parameter: only quad trials have "scale".
+            trial.suggest_float("scale", 0.5, 2.0)
+        return None
+
+    def objective(config):
+        x = config["x"]
+        if config["algo"] == "quad":
+            loss = config["scale"] * (x - 1.0) ** 2
+        else:
+            loss = abs(x - 1.0) + 0.5
+        tune.report({"loss": loss, "training_iteration": 1})
+
+    searcher = DefineByRunSearcher(define, metric="loss", mode="min",
+                                   n_initial_points=6, seed=3)
+    results = Tuner(
+        objective, param_space={},
+        tune_config=TuneConfig(metric="loss", mode="min",
+                               search_alg=searcher, num_samples=40),
+    ).fit()
+    best = results.get_best_result(metric="loss", mode="min")
+    # Optimum is quad with x≈1 (loss→0); must beat the abs floor (0.5).
+    assert best.metrics["loss"] < 0.4, best.metrics
+    # Conditional param recorded only where suggested.
+    quad_trials = [cfg for cfg, _ in searcher._observed
+                   if cfg["algo"] == "quad"]
+    abs_trials = [cfg for cfg, _ in searcher._observed
+                  if cfg["algo"] == "abs"]
+    assert all("scale" in cfg for cfg in quad_trials)
+    assert all("scale" not in cfg for cfg in abs_trials)
+
+
+def test_define_by_run_rejects_param_space():
+    from ray_tpu.tune import DefineByRunSearcher
+
+    searcher = DefineByRunSearcher(lambda t: None)
+    with pytest.raises(ValueError):
+        searcher.set_search_properties("loss", "min", {"x": 1})
