@@ -3,8 +3,9 @@
 Reference: python/ray/_private/accelerators/tpu.py (398 LoC) detects TPU
 chips via GKE env vars / GCE metadata and advertises a pod-slice head
 resource ``TPU-{pod_type}-head`` so one task can claim a whole slice
-(tpu.py:382). Here TPU detection is JAX-native: if jax sees TPU devices we
-advertise them; topology labels come from the device kind.
+(tpu.py:382). Here detection is layered: GKE/GCE environment metadata
+first (cheap, no jax init — reference tpu.py:14-44), then JAX-native
+device enumeration; topology labels come from whichever layer answered.
 """
 
 from __future__ import annotations
@@ -13,6 +14,102 @@ import logging
 import os
 
 logger = logging.getLogger("ray_tpu")
+
+# Valid per-host chip counts (reference: tpu.py:13) — a metadata value
+# outside this set means a misconfigured node, not more chips.
+_VALID_CHIPS_PER_HOST = (1, 2, 4, 8)
+
+_GCE_METADATA_URL = ("http://metadata.google.internal/computeMetadata"
+                     "/v1/instance/attributes/")
+
+
+def _on_gce() -> bool:
+    """Cheap LOCAL check for Google Compute Engine (DMI product name) —
+    off-cloud hosts must never touch metadata DNS (getaddrinfo is not
+    bounded by urlopen's timeout and can stall node startup)."""
+    try:
+        with open("/sys/class/dmi/id/product_name") as f:
+            return "Google" in f.read()
+    except OSError:
+        return False
+
+
+def _gce_metadata(key: str, timeout_s: float = 0.5) -> str | None:
+    """GCE instance-attribute lookup (reference: tpu.py GCE branch)."""
+    if not _on_gce():
+        return None
+    import urllib.request
+
+    try:
+        req = urllib.request.Request(
+            _GCE_METADATA_URL + key,
+            headers={"Metadata-Flavor": "Google"})
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return resp.read().decode()
+    except Exception:  # noqa: BLE001 — no egress / metadata absent
+        return None
+
+
+def detect_tpu_topology() -> dict | None:
+    """GKE/GCE TPU topology from environment metadata, or None.
+
+    GKE injects TPU_ACCELERATOR_TYPE (e.g. "v5litepod-16") and
+    TPU_WORKER_ID / TPU_WORKER_HOSTNAMES (reference: tpu.py:14-28);
+    plain GCE TPU-VMs expose the same through the metadata server.
+    Returns {"accelerator_type", "worker_id", "num_workers",
+    "chips_per_host"}.
+    """
+    accel = os.environ.get("TPU_ACCELERATOR_TYPE") \
+        or _gce_metadata("accelerator-type")
+    if not accel:
+        return None
+    raw_worker = os.environ.get("TPU_WORKER_ID") \
+        or _gce_metadata("agent-worker-number") or "0"
+    try:
+        worker_id = int(raw_worker.strip())
+    except ValueError:
+        # Corrupt metadata (captive portal, proxy page): assume worker
+        # 0 rather than failing the whole node's resource detection.
+        worker_id = 0
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES") \
+        or _gce_metadata("worker-network-endpoints") or ""
+    num_workers = max(1, len([h for h in hostnames.split(",") if h]))
+    # Per-host chip count from TPU_CHIPS_PER_HOST_BOUNDS ("2,2,1" =>
+    # 4 chips — reference: tpu.py:44), else from the accelerator type.
+    chips = None
+    bounds = os.environ.get("TPU_CHIPS_PER_HOST_BOUNDS")
+    if bounds:
+        try:
+            n = 1
+            for part in bounds.split(","):
+                n *= int(part)
+            chips = n
+        except ValueError:
+            chips = None
+    if chips is None:
+        try:
+            total = int(accel.rsplit("-", 1)[1])
+            # v2/v3/v4/v5p accelerator-type suffixes count TENSORCORES
+            # (2 per chip); v5e (v5litepod) and v6e suffixes count
+            # chips (reference: tpu.py's per-generation tables).
+            gen = accel.split("-")[0].lower()
+            if gen in ("v2", "v3", "v4", "v5p"):
+                total = max(1, total // 2)
+            chips = max(1, total // num_workers)
+        except (ValueError, IndexError):
+            chips = 4
+    if chips not in _VALID_CHIPS_PER_HOST:
+        logger.warning(
+            "TPU metadata reports %s chips/host (valid: %s); clamping",
+            chips, _VALID_CHIPS_PER_HOST)
+        chips = min(_VALID_CHIPS_PER_HOST,
+                    key=lambda v: abs(v - chips))
+    return {
+        "accelerator_type": accel,
+        "worker_id": int(worker_id),
+        "num_workers": num_workers,
+        "chips_per_host": chips,
+    }
 
 
 def detect_resources() -> dict[str, float]:
@@ -26,6 +123,17 @@ def detect_resources() -> dict[str, float]:
         return resources
     if os.environ.get("RAY_TPU_SKIP_TPU_DETECTION"):
         return resources
+    # Layer 1: GKE/GCE metadata — no jax init, and it knows the SLICE
+    # shape, not just the local chips (reference: tpu.py:14-44, :382).
+    topo = detect_tpu_topology()
+    if topo is not None:
+        resources["TPU"] = float(topo["chips_per_host"])
+        if topo["worker_id"] == 0:
+            # Pod-slice gang resource on worker 0 ONLY: exactly one
+            # task per slice can claim the whole gang (tpu.py:363-382).
+            resources[f"TPU-{topo['accelerator_type']}-head"] = 1.0
+        return resources
+    # Layer 2: JAX device enumeration (single-host / dev boxes).
     try:
         import jax
 

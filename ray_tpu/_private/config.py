@@ -66,6 +66,20 @@ _DEFAULTS: dict[str, Any] = {
     # reference_count.h:61). 0 disables the sweeper.
     "owner_sweep_period_ms": 5000,
     "owner_dead_grace_s": 15.0,
+    # Node-to-node transfer plane (reference: the chunked Push/Pull
+    # sizing knobs among the 217 RAY_CONFIG entries).
+    "executor_inline_reply_kb": 256,   # results <= this ship inline
+    "fetch_chunk_kb": 4096,            # chunk size of node pulls
+    "node_pull_cache_mb": 512,         # pulled-copy cache per daemon
+    # Actor scheduling (reference: actor creation/restart timeouts).
+    "actor_lease_timeout_s": 300.0,
+    "actor_restart_relocate_timeout_s": 120.0,
+    # RPC plane.
+    "rpc_io_pool_workers": 16,         # pooled short-call dispatch
+    # Head control plane.
+    "gcs_heartbeat_timeout_s": 10.0,   # node declared dead after this
+    # Worker pipe transport.
+    "worker_inline_result_kb": 64,     # pool results <= this inline
 }
 
 

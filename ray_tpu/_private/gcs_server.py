@@ -173,8 +173,13 @@ class GcsServer:
 
     def __init__(self, host: str = "0.0.0.0", port: int = 0,
                  log_dir: str = "/tmp/ray_tpu/session",
-                 heartbeat_timeout_s: float = 10.0,
+                 heartbeat_timeout_s: float | None = None,
                  persist_path: str | None = None):
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        if heartbeat_timeout_s is None:
+            heartbeat_timeout_s = float(
+                GLOBAL_CONFIG.gcs_heartbeat_timeout_s)
         self.gcs = GlobalControlService()
         self.jobs = JobManager(self.gcs, os.path.join(log_dir, "jobs"))
         self.heartbeat_timeout_s = heartbeat_timeout_s

@@ -42,10 +42,21 @@ from ray_tpu._private.rpc import (
     RpcServer,
 )
 
-# Results at or below this ship inline in the execute_task reply;
-# larger ones stay in the producing node's store (driver pulls lazily).
-INLINE_REPLY_BYTES = 256 * 1024
-FETCH_CHUNK_BYTES = 4 * 1024 * 1024
+# Results at or below executor_inline_reply_kb (config) ship inline in
+# the execute_task reply; larger ones stay in the producing node's
+# store (driver pulls lazily in fetch_chunk_kb chunks).
+
+
+def _inline_reply_bytes() -> int:
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    return int(GLOBAL_CONFIG.executor_inline_reply_kb) * 1024
+
+
+def _fetch_chunk_bytes() -> int:
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    return int(GLOBAL_CONFIG.fetch_chunk_kb) * 1024
 
 
 @dataclass
@@ -80,7 +91,7 @@ class NodeObjectStore:
     until the owner frees them or dies (local_object_manager.h:110
     SpillObjects / owner-death cleanup)."""
 
-    def __init__(self, cache_limit_bytes: int = 512 * 1024 * 1024,
+    def __init__(self, cache_limit_bytes: int | None = None,
                  primary_limit_bytes: int | None = None,
                  spill_dir: str | None = None):
         from ray_tpu._private.config import GLOBAL_CONFIG
@@ -88,7 +99,9 @@ class NodeObjectStore:
         self._lock = threading.Lock()
         self._blobs: dict[bytes, bytes] = {}  # insertion-ordered
         self._cached: dict[bytes, None] = {}  # pulled copies, FIFO evict
-        self._cache_limit = cache_limit_bytes
+        self._cache_limit = (
+            cache_limit_bytes if cache_limit_bytes is not None
+            else int(GLOBAL_CONFIG.node_pull_cache_mb) * 1024 * 1024)
         self._cache_bytes = 0
         self._primary_limit = (
             primary_limit_bytes if primary_limit_bytes is not None
@@ -330,12 +343,13 @@ class _PeerClients:
 
 def fetch_blob(client: RpcClient, id_bytes: bytes) -> bytes:
     """Chunked pull of one object (reference: object_manager.h chunked
-    Push — here pull-oriented, sized by FETCH_CHUNK_BYTES)."""
+    Push — here pull-oriented, sized by fetch_chunk_kb)."""
     out = bytearray()
     offset = 0
+    chunk_bytes = _fetch_chunk_bytes()
     while True:
         reply = client.call("fetch_object", id_bytes, offset,
-                            FETCH_CHUNK_BYTES)
+                            chunk_bytes)
         if reply is None:
             raise KeyError(
                 f"object {id_bytes.hex()} not present on {client.address}")
@@ -728,7 +742,7 @@ class NodeExecutorService:
             except BaseException as exc:  # noqa: BLE001
                 out.append(("err", _exc_blob(exc)))
                 continue
-            if len(blob) <= INLINE_REPLY_BYTES:
+            if len(blob) <= _inline_reply_bytes():
                 out.append(("inline", blob))
             else:
                 self.store.put(id_bytes, blob, owner=client_addr)
@@ -914,7 +928,7 @@ class NodeExecutorService:
             if blob is None:
                 out.append(packed)  # ("err", blob) passthrough
                 continue
-            if len(blob) <= INLINE_REPLY_BYTES:
+            if len(blob) <= _inline_reply_bytes():
                 out.append(("inline", blob))
             else:
                 self.store.put(id_bytes, blob,
@@ -1000,7 +1014,13 @@ class NodeExecutorService:
              client_addr=None) -> list:
         if any(k.startswith("TPU") for k in resources):
             # TPU tasks run in the daemon process: it owns this node's
-            # JAX/TPU runtime (pool workers are pinned to CPU).
+            # JAX/TPU runtime (pool workers are pinned to CPU). Each
+            # runs on its own dispatch thread (mux server), so a long
+            # TPU task never blocks the connection loop; concurrency
+            # between TPU tasks is bounded by admission (TPU resource
+            # units), and JAX dispatch itself is thread-safe — a mutual-
+            # exclusion lock here would deadlock nested TPU-task
+            # submission (outer holds it while blocked in get()).
             result = func(*args, **kwargs)
         else:
             from ray_tpu._private.worker_pool import _RemoteTaskError

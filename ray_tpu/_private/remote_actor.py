@@ -391,8 +391,11 @@ class RemoteActor:
             # admission reservation leaks, and a stateful actor splits
             # brain.
             self._kill_remote_copy(handle)
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
         placed = self._runtime._relocate_actor_lease(
-            self.actor_id, self._resources, exclude=exclude, timeout=120.0)
+            self.actor_id, self._resources, exclude=exclude,
+            timeout=float(GLOBAL_CONFIG.actor_restart_relocate_timeout_s))
         if placed is None or placed == "pg_dead":
             self._mark_dead(
                 f"no surviving worker daemon to restart on ({reason})")
@@ -402,7 +405,9 @@ class RemoteActor:
         try:
             init_blob = self._runtime._convert_remote_args(
                 self._init_args, self._init_kwargs)
-            err = self._create_on_cluster(init_blob, timeout=120.0)
+            err = self._create_on_cluster(
+                init_blob,
+                timeout=float(GLOBAL_CONFIG.actor_restart_relocate_timeout_s))
         except BaseException as exc:  # noqa: BLE001
             err = exc
         if err == "dead":

@@ -104,8 +104,11 @@ class RpcServer:
 
         with self._io_pool_lock:
             if self._io_pool is None:
+                from ray_tpu._private.config import GLOBAL_CONFIG
+
                 self._io_pool = ThreadPoolExecutor(
-                    max_workers=16, thread_name_prefix="rpc-io")
+                    max_workers=int(GLOBAL_CONFIG.rpc_io_pool_workers),
+                    thread_name_prefix="rpc-io")
             return self._io_pool
 
     def register_object(self, obj: Any, prefix: str = "") -> None:

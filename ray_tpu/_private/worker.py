@@ -786,11 +786,13 @@ class Runtime:
         ownership directory, not payloads inlined per task)."""
         from ray_tpu._private import serialization
         from ray_tpu._private.node_executor import (
-            INLINE_REPLY_BYTES,
             FetchRef,
             RemoteBlob,
+            _inline_reply_bytes,
         )
         from ray_tpu._private.object_store import _sizeof
+
+        inline_max = _inline_reply_bytes()
 
         def convert(a):
             if not isinstance(a, ObjectRef):
@@ -803,7 +805,7 @@ class Runtime:
             if isinstance(value, RemoteBlob):
                 return FetchRef(id_bytes, value.addr)
             if self._export_store is not None \
-                    and _sizeof(value) > INLINE_REPLY_BYTES:
+                    and _sizeof(value) > inline_max:
                 # Export once; every node pulls + caches it by id
                 # instead of the driver re-shipping per task.
                 blob = serialization.serialize_framed(value)
@@ -1282,7 +1284,8 @@ class Runtime:
                         pg.id, strategy.placement_group_bundle_index, resources)
                     pg_info = (pg.id, strategy.placement_group_bundle_index)
                 else:
-                    deadline = time.monotonic() + 300.0
+                    deadline = time.monotonic() + float(
+                        GLOBAL_CONFIG.actor_lease_timeout_s)
                     while node_id is None:
                         node = self.cluster.pick_node(
                             resources, strategy, exclude=remote_exclude())
@@ -1292,8 +1295,9 @@ class Runtime:
                             break
                         if time.monotonic() > deadline:
                             raise TimeoutError(
-                                f"Could not lease resources {resources} for actor "
-                                f"{cls.__name__} within 300s")
+                                f"Could not lease resources {resources} "
+                                f"for actor {cls.__name__} within "
+                                f"{GLOBAL_CONFIG.actor_lease_timeout_s}s")
                         self.cluster.wait_for_change(0.05)
             except BaseException as exc:  # noqa: BLE001
                 self.store.put_error(creation_rid, exc)

@@ -62,12 +62,18 @@ from ray_tpu.exceptions import (
     WorkerCrashedError,
 )
 
-# Results smaller than this ship inline through the pipe; mid-size ones
-# go through the native shared arena (one lock round-trip, no syscalls);
-# larger ones get a dedicated shared-memory segment the driver adopts
-# (true zero-copy reads). The arena cutoff comes from config
-# (object_arena_max_object_bytes) via the RAY_TPU_ARENA_MAX env var.
-INLINE_RESULT_BYTES = 64 * 1024
+# Results smaller than worker_inline_result_kb (config) ship inline
+# through the pipe; mid-size ones go through the native shared arena
+# (one lock round-trip, no syscalls); larger ones get a dedicated
+# shared-memory segment the driver adopts (true zero-copy reads). The
+# arena cutoff comes from config (object_arena_max_object_bytes) via
+# the RAY_TPU_ARENA_MAX env var.
+
+
+def _inline_result_bytes() -> int:
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    return int(GLOBAL_CONFIG.worker_inline_result_kb) * 1024
 
 
 @dataclass
@@ -216,7 +222,7 @@ def _pack_results(values: list, arena=None, arena_max: int = 0) -> list:
             out.append(("err", _exception_blob(exc)))
             continue
         size = serialization.framed_size(header, buffers)
-        if size <= INLINE_RESULT_BYTES:
+        if size <= _inline_result_bytes():
             blob = bytearray(size)
             serialization.write_framed(memoryview(blob), header, buffers)
             out.append(("inline", bytes(blob)))

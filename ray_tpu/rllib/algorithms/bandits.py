@@ -121,10 +121,12 @@ class _LinearBandit(Algorithm):
             arms = self._choose(contexts)
             rewards = self.env.pull(contexts, arms)
             best_arms, best_rewards = self.env.optimal(contexts)
-            chosen_means = np.einsum("bd,bd->b", contexts,
-                                     self.env.theta[arms])
+            # Empirical regret from REALIZED rewards (reward noise is
+            # mean-zero, so this is unbiased) — keeps the env contract
+            # to observe/pull/optimal; custom envs need not expose
+            # their mean structure.
             self.cumulative_regret += float(
-                np.sum(best_rewards - chosen_means))
+                np.sum(best_rewards - rewards))
             self.total_pulls += len(arms)
             self.total_optimal += int(np.sum(arms == best_arms))
             rewards_sum += float(rewards.sum())

@@ -297,7 +297,10 @@ class SACLearner(Learner):
             self.params, self.opt_state, self.target_params,
             self.log_alpha, self._alpha_opt_state, arrays, rng)
         self._steps += 1
-        return {k: float(v) for k, v in metrics.items()}
+        if not sync_metrics:
+            return metrics  # device arrays; caller syncs when it reports
+        host = jax.device_get(metrics)  # one transfer for all scalars
+        return {k: float(v) for k, v in host.items()}
 
     def get_state(self) -> dict:
         state = super().get_state()

@@ -220,7 +220,10 @@ class TD3Learner(Learner):
             self.params, self.opt_state, self._actor_opt_state,
             self.target_params, arrays, rng)
         self._steps += 1
-        return {k: float(v) for k, v in metrics.items()}
+        if not sync_metrics:
+            return metrics  # device arrays; caller syncs when it reports
+        host = jax.device_get(metrics)  # one transfer for all scalars
+        return {k: float(v) for k, v in host.items()}
 
     def get_state(self) -> dict:
         state = super().get_state()

@@ -194,3 +194,74 @@ def test_actor_pool_task_error_surfaces_and_advances(ray_start):
     assert pool.get_next(timeout=10) == 20
     assert pool.get_next(timeout=10) == 30
     assert not pool.has_next()
+
+
+def test_tpu_topology_from_gke_env(monkeypatch):
+    """GKE-style env metadata yields slice topology + the pod-slice head
+    resource on worker 0 only (reference: accelerators/tpu.py:14-44,
+    :363-382)."""
+    from ray_tpu._private import accelerators
+
+    monkeypatch.delenv("RAY_TPU_SKIP_TPU_DETECTION", raising=False)
+    monkeypatch.delenv("RAY_TPU_NUM_TPU_CHIPS", raising=False)
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-16")
+    monkeypatch.setenv("TPU_WORKER_ID", "0")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "h0,h1,h2,h3")
+    monkeypatch.setenv("TPU_CHIPS_PER_HOST_BOUNDS", "2,2,1")
+
+    topo = accelerators.detect_tpu_topology()
+    assert topo == {"accelerator_type": "v5litepod-16", "worker_id": 0,
+                    "num_workers": 4, "chips_per_host": 4}
+    res = accelerators.detect_resources()
+    assert res["TPU"] == 4.0
+    assert res["TPU-v5litepod-16-head"] == 1.0
+
+    # Worker 3 carries chips but NOT the gang-head resource.
+    monkeypatch.setenv("TPU_WORKER_ID", "3")
+    res3 = accelerators.detect_resources()
+    assert res3["TPU"] == 4.0
+    assert not any(k.endswith("-head") for k in res3)
+
+
+def test_tpu_topology_chips_from_accel_type(monkeypatch):
+    from ray_tpu._private import accelerators
+
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-8")
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "a,b")
+    monkeypatch.delenv("TPU_CHIPS_PER_HOST_BOUNDS", raising=False)
+    topo = accelerators.detect_tpu_topology()
+    # v4-8 counts TENSORCORES: 8 cores = 4 chips, over 2 workers.
+    assert topo["chips_per_host"] == 2
+    assert topo["num_workers"] == 2
+
+    # v5e suffixes count CHIPS directly.
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5litepod-8")
+    topo = accelerators.detect_tpu_topology()
+    assert topo["chips_per_host"] == 4  # 8 chips / 2 workers
+
+    # Corrupt worker-id metadata falls back to 0, not a crash.
+    monkeypatch.setenv("TPU_WORKER_ID", "unknown")
+    assert accelerators.detect_tpu_topology()["worker_id"] == 0
+
+
+def test_config_knobs_reach_hot_paths(monkeypatch):
+    """The new flag-table keys actually steer behavior (not dead
+    config)."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu._private.node_executor import (
+        NodeObjectStore,
+        _fetch_chunk_bytes,
+        _inline_reply_bytes,
+    )
+
+    GLOBAL_CONFIG.update({"executor_inline_reply_kb": 8,
+                          "fetch_chunk_kb": 64,
+                          "node_pull_cache_mb": 1})
+    try:
+        assert _inline_reply_bytes() == 8 * 1024
+        assert _fetch_chunk_bytes() == 64 * 1024
+        store = NodeObjectStore()
+        assert store._cache_limit == 1024 * 1024
+    finally:
+        GLOBAL_CONFIG.reset()
