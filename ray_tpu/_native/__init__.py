@@ -14,7 +14,8 @@ import subprocess
 import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
-_SRC = os.path.join(_DIR, "plasma_store.cpp")
+_SOURCES = [os.path.join(_DIR, "plasma_store.cpp"),
+            os.path.join(_DIR, "node_store.cpp")]
 _LIB = os.path.join(_DIR, "libray_tpu_native.so")
 
 _lock = threading.Lock()
@@ -22,7 +23,7 @@ _lib: "ctypes.CDLL | None | bool" = None  # False = tried and failed
 
 
 def _build() -> bool:
-    cmd = ["g++", "-O2", "-shared", "-fPIC", _SRC, "-o", _LIB,
+    cmd = ["g++", "-O2", "-shared", "-fPIC", *_SOURCES, "-o", _LIB,
            "-lpthread", "-lrt"]
     try:
         proc = subprocess.run(cmd, capture_output=True, timeout=120)
@@ -43,7 +44,8 @@ def load() -> "ctypes.CDLL | None":
             return _lib or None
         try:
             if (not os.path.exists(_LIB)
-                    or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+                    or os.path.getmtime(_LIB) < max(
+                        os.path.getmtime(s) for s in _SOURCES)):
                 if not _build():
                     _lib = False
                     return None
@@ -81,5 +83,27 @@ def load() -> "ctypes.CDLL | None":
         lib.rt_store_contains.argtypes = [p, ctypes.c_char_p]
         lib.rt_store_stats.restype = None
         lib.rt_store_stats.argtypes = [p] + [ctypes.POINTER(u64)] * 5
+        # Node object store (node_store.cpp, rt_ns_*).
+        i64 = ctypes.c_int64
+        lib.rt_ns_create.restype = p
+        lib.rt_ns_create.argtypes = [u64, u64, ctypes.c_char_p]
+        lib.rt_ns_destroy.restype = None
+        lib.rt_ns_destroy.argtypes = [p]
+        lib.rt_ns_put.restype = ctypes.c_int
+        lib.rt_ns_put.argtypes = [p, ctypes.c_char_p, ctypes.c_char_p,
+                                  u64, ctypes.c_int, ctypes.c_char_p]
+        lib.rt_ns_read.restype = i64
+        lib.rt_ns_read.argtypes = [p, ctypes.c_char_p, u64, u8p, u64,
+                                   ctypes.POINTER(u64)]
+        lib.rt_ns_size.restype = i64
+        lib.rt_ns_size.argtypes = [p, ctypes.c_char_p]
+        lib.rt_ns_free.restype = ctypes.c_int
+        lib.rt_ns_free.argtypes = [p, ctypes.c_char_p, u32]
+        lib.rt_ns_free_owner.restype = ctypes.c_int
+        lib.rt_ns_free_owner.argtypes = [p, ctypes.c_char_p]
+        lib.rt_ns_owners.restype = i64
+        lib.rt_ns_owners.argtypes = [p, ctypes.c_char_p, u64]
+        lib.rt_ns_stats.restype = None
+        lib.rt_ns_stats.argtypes = [p, ctypes.POINTER(u64)]
         _lib = lib
         return lib

@@ -80,6 +80,9 @@ _DEFAULTS: dict[str, Any] = {
     "gcs_heartbeat_timeout_s": 10.0,   # node declared dead after this
     # Worker pipe transport.
     "worker_inline_result_kb": 64,     # pool results <= this inline
+    # Native (C++) daemon blob store (node_store.cpp); falls back to
+    # the Python store when the toolchain/library is unavailable.
+    "node_store_native": True,
 }
 
 

@@ -119,28 +119,11 @@ class NodeObjectStore:
         self._purge_stale_spills()
 
     def _purge_stale_spills(self) -> None:
-        """Delete spill files left by crashed prior daemons (filenames
-        are pid-prefixed; a dead pid's files have no owner and would
-        otherwise accumulate across crash cycles until the disk fills)."""
-        try:
-            names = os.listdir(self._spill_dir)
-        except OSError:
-            return
-        for name in names:
-            if not name.endswith(".blob"):
-                continue
-            pid_part = name.split("-", 1)[0]
-            if not pid_part.isdigit() or int(pid_part) == os.getpid():
-                continue
-            try:
-                os.kill(int(pid_part), 0)
-            except ProcessLookupError:
-                try:
-                    os.unlink(os.path.join(self._spill_dir, name))
-                except OSError:
-                    pass
-            except OSError:
-                pass  # alive but not ours (EPERM): leave it
+        """Delete spill files left by crashed prior daemons (shared
+        helper — pid-prefixed filenames, liveness-checked)."""
+        from ray_tpu._private.node_store_native import purge_stale_spills
+
+        purge_stale_spills(self._spill_dir)
 
     def put(self, id_bytes: bytes, blob: bytes, cached: bool = False,
             owner: str | None = None) -> None:
@@ -507,8 +490,12 @@ class NodeExecutorService:
                  resources: dict[str, float] | None = None):
         from ray_tpu._private.shm_store import ShmClient, ShmDirectory
 
+        from ray_tpu._private.node_store_native import make_node_store
+
         self._server = RpcServer(host, port)
-        self.store = NodeObjectStore()
+        # C++ store by default (reference: the raylet's object store is
+        # native); Python fallback keeps identical semantics.
+        self.store = make_node_store()
         self._peers = _PeerClients()
         self._resources = dict(resources or {})
         self._running_lock = threading.Lock()
@@ -656,6 +643,8 @@ class NodeExecutorService:
         self._peers.close()
         self._shm_client.close_all()
         self._shm_directory.shutdown()
+        if hasattr(self.store, "close"):
+            self.store.close()  # native store: free the C++ handle
 
     # ------------------------------------------------------------- endpoints
 

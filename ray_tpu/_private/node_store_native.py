@@ -1,0 +1,198 @@
+"""ctypes wrapper over the native (C++) node object store.
+
+Reference: the raylet's local object store is C++
+(src/ray/object_manager/object_store.h) — this replaces the daemon's
+Python blob store with ray_tpu/_native/node_store.cpp, keeping the
+EXACT NodeObjectStore interface (put/get/free/free_owner/owners/
+read_chunk/stats) so NodeExecutorService treats both uniformly.
+Because ctypes releases the GIL around calls, store reads never block
+the daemon's Python threads, and spilled-file restores stream outside
+the store mutex — the wins show on multi-core daemon hosts.
+Single-threaded, ctypes marshalling makes raw reads a few GB/s vs the
+Python store's in-GIL slice (~12 GB/s); both are orders of magnitude
+above the socket+pickle transfer path they feed, so end-to-end
+throughput is identical (measured: the distributed test suites run in
+the same time on either store).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+
+class NativeNodeObjectStore:
+    """Drop-in native implementation of NodeObjectStore."""
+
+    def __init__(self, lib, cache_limit_bytes: int | None = None,
+                 primary_limit_bytes: int | None = None,
+                 spill_dir: str | None = None):
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        self._lib = lib
+        cache = (cache_limit_bytes if cache_limit_bytes is not None
+                 else int(GLOBAL_CONFIG.node_pull_cache_mb) * 1024 * 1024)
+        primary = (primary_limit_bytes if primary_limit_bytes is not None
+                   else int(GLOBAL_CONFIG.node_store_primary_limit_mb)
+                   * 1024 * 1024)
+        self._spill_dir = spill_dir or GLOBAL_CONFIG.node_store_spill_dir
+        os.makedirs(self._spill_dir, exist_ok=True)
+        purge_stale_spills(self._spill_dir)
+        self._handle = lib.rt_ns_create(cache, primary,
+                                        self._spill_dir.encode())
+        if not self._handle:
+            raise RuntimeError("native node store creation failed")
+        self._closed = False
+
+    @staticmethod
+    def _key(id_bytes: bytes) -> bytes:
+        if len(id_bytes) == 16:
+            return id_bytes
+        # Foreign-length keys (tests, export hashes) fold to 16 bytes.
+        import hashlib
+
+        return hashlib.blake2b(id_bytes, digest_size=16).digest()
+
+    def put(self, id_bytes: bytes, blob: bytes, cached: bool = False,
+            owner: str | None = None) -> None:
+        if self._closed:
+            return
+        self._lib.rt_ns_put(self._handle, self._key(id_bytes), blob,
+                            len(blob), 1 if cached else 0,
+                            (owner or "").encode())
+
+    def _read_into(self, key: bytes, offset: int, length: int):
+        """-> (total, bytearray) with ONE copy (C++ writes straight
+        into the Python-owned buffer), or None when absent."""
+        ba = bytearray(max(1, length))
+        cbuf = (ctypes.c_char * len(ba)).from_buffer(ba)
+        copied = ctypes.c_uint64()
+        total = self._lib.rt_ns_read(
+            self._handle, key, offset,
+            ctypes.cast(cbuf, ctypes.POINTER(ctypes.c_uint8)), length,
+            ctypes.byref(copied))
+        if total < 0:
+            return None
+        if copied.value != len(ba):
+            # Short read (tail chunk): SLICE — the ctypes buffer export
+            # may still pin ba, so resizing it raises BufferError.
+            return int(total), ba[:copied.value]
+        return int(total), ba
+
+    def get(self, id_bytes: bytes) -> bytes | None:
+        if self._closed:
+            return None
+        key = self._key(id_bytes)
+        size = self._lib.rt_ns_size(self._handle, key)
+        if size < 0:
+            return None
+        out = self._read_into(key, 0, size)
+        if out is None:
+            return None  # freed between size and read
+        return bytes(out[1])
+
+    def free(self, ids: list[bytes]) -> int:
+        if not ids or self._closed:
+            return 0
+        packed = b"".join(self._key(i) for i in ids)
+        return self._lib.rt_ns_free(self._handle, packed, len(ids))
+
+    def free_owner(self, owner: str) -> int:
+        if self._closed:
+            return 0
+        return self._lib.rt_ns_free_owner(self._handle, owner.encode())
+
+    def owners(self) -> list[str]:
+        if self._closed:
+            return []
+        # The set may change between sizing and filling; retry with the
+        # SECOND call's own length until it fits (a stale first length
+        # would otherwise leave truncated/garbage owner names).
+        buflen = 256
+        for _ in range(8):
+            buf = ctypes.create_string_buffer(buflen)
+            got = self._lib.rt_ns_owners(self._handle, buf, buflen)
+            if got <= 0:
+                return []
+            if got <= buflen:
+                return buf.raw[:got].decode().split("\n")
+            buflen = int(got) * 2
+        return []
+
+    def read_chunk(self, id_bytes: bytes, offset: int,
+                   length: int) -> tuple[int, "bytearray"] | None:
+        # Returns a bytearray (pickles/concatenates like bytes): the
+        # C++ side writes directly into it — one copy total, same as
+        # the Python store's slice.
+        if self._closed:
+            return None
+        return self._read_into(self._key(id_bytes), offset, length)
+
+    def stats(self) -> dict:
+        out = (ctypes.c_uint64 * 9)()
+        if not self._closed:
+            self._lib.rt_ns_stats(self._handle, out)
+        return {
+            "num_blobs": int(out[0]),
+            "bytes": int(out[1]),
+            "fetches_served": int(out[2]),
+            "spilled_blobs": int(out[3]),
+            "spilled_bytes": int(out[4]),
+            "spills": int(out[5]),
+            "restores": int(out[6]),
+            "owners": int(out[7]),
+            "native": True,
+        }
+
+    def close(self) -> None:
+        """Mark closed WITHOUT destroying the C++ object: in-flight RPC
+        handler threads may still be inside a store call, and a
+        use-after-free would segfault the daemon. The allocation is
+        reclaimed at process exit (stop() is immediately followed by
+        daemon shutdown); orphaned spill files are purged by the next
+        daemon's pid-liveness sweep."""
+        self._closed = True
+
+
+def purge_stale_spills(spill_dir: str) -> None:
+    """Delete spill files left by crashed prior daemons (pid-prefixed
+    filenames; shared by the Python and native stores)."""
+    try:
+        names = os.listdir(spill_dir)
+    except OSError:
+        return
+    for name in names:
+        if not name.endswith(".blob"):
+            continue
+        pid_part = name.split("-", 1)[0]
+        if not pid_part.isdigit() or int(pid_part) == os.getpid():
+            continue
+        try:
+            os.kill(int(pid_part), 0)
+        except ProcessLookupError:
+            try:
+                os.unlink(os.path.join(spill_dir, name))
+            except OSError:
+                pass
+        except OSError:
+            pass  # alive but not ours (EPERM): leave it
+
+
+def make_node_store(**kwargs):
+    """Native store when the toolchain/library is available (the C++
+    data plane is the default, like the reference's raylet store);
+    Python fallback otherwise — both honor the same config knobs."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    if bool(GLOBAL_CONFIG.node_store_native):
+        from ray_tpu._native import load
+
+        lib = load()
+        if lib is not None:
+            try:
+                return NativeNodeObjectStore(lib, **kwargs)
+            except Exception:  # noqa: BLE001 — fall back to Python
+                pass
+    from ray_tpu._private.node_executor import NodeObjectStore
+
+    return NodeObjectStore(**kwargs)

@@ -79,7 +79,7 @@ class Dataset:
             rows = [fn(row) for row in BlockAccessor(block).iter_rows()]
             return BlockAccessor.rows_to_block(rows)
 
-        return self._with(MapBlocks(map_block, name="Map"), "map")
+        return self._with(MapBlocks(map_block, name="Map", row_preserving=True), "map")
 
     def map_batches(self, fn: Callable, *, batch_size: int | None = None,
                     batch_format: str = "numpy",
@@ -123,16 +123,19 @@ class Dataset:
             values = [fn(row) for row in BlockAccessor(block).iter_rows()]
             return block.append_column(name, pa.array(values))
 
-        return self._with(MapBlocks(map_block, name="AddColumn"), "add_column")
+        return self._with(MapBlocks(map_block, name="AddColumn", row_preserving=True), "add_column")
 
     def drop_columns(self, cols: list[str]) -> "Dataset":
         return self._with(
-            MapBlocks(lambda b: b.drop_columns(cols), name="DropColumns"),
+            MapBlocks(lambda b: b.drop_columns(cols), name="DropColumns",
+                      row_preserving=True),
             "drop_columns")
 
     def select_columns(self, cols: list[str]) -> "Dataset":
         return self._with(
-            MapBlocks(lambda b: b.select(cols), name="SelectColumns"),
+            MapBlocks(lambda b: b.select(cols), name="SelectColumns",
+                      row_preserving=True, kind="project",
+                      cols=list(cols)),
             "select_columns")
 
     def rename_columns(self, mapping: dict[str, str]) -> "Dataset":
@@ -140,7 +143,7 @@ class Dataset:
             return block.rename_columns(
                 [mapping.get(c, c) for c in block.column_names])
 
-        return self._with(MapBlocks(map_block, name="Rename"), "rename")
+        return self._with(MapBlocks(map_block, name="Rename", row_preserving=True), "rename")
 
     def limit(self, n: int) -> "Dataset":
         return self._with(Limit(limit=n), f"limit({n})")

@@ -22,6 +22,7 @@ from typing import Any, Iterator
 
 import ray_tpu
 from ray_tpu.data.block import Block, concat_blocks
+from ray_tpu.data.optimizer import optimize
 from ray_tpu.data.plan import (
     AllToAll,
     InputData,
@@ -49,6 +50,7 @@ class ExecutionStats:
         self.stages: list[StageStats] = []
         self.started_at: float | None = None
         self.finished_at: float | None = None
+        self.applied_rules: list[str] = []  # optimizer rewrites
 
     def stage(self, name: str) -> StageStats:
         st = StageStats(name)
@@ -57,6 +59,8 @@ class ExecutionStats:
 
     def summary(self) -> str:
         lines = ["Execution stats:"]
+        if self.applied_rules:
+            lines.append("  optimizer: " + ", ".join(self.applied_rules))
         for st in self.stages:
             line = (f"  {st.name}: {st.num_blocks} blocks, "
                     f"{st.wall_s:.3f}s wall")
@@ -127,7 +131,8 @@ def iter_block_refs(ops: list[LogicalOp],
                     ctx: ExecutionContext | None = None) -> Iterator[Any]:
     """Stream block refs through the fused plan, preserving block order."""
     ctx = ctx or ExecutionContext()
-    ops = fuse_stages(ops)
+    ops, applied_rules = optimize(ops)
+    ctx.stats.applied_rules = applied_rules
     assert ops and isinstance(ops[0], InputData), "plan must start with Input"
     source: InputData = ops[0]
     stages = ops[1:]

@@ -54,6 +54,12 @@ class MapBlocks(LogicalOp):
     fn: Callable[[Block], Block]
     name: str = "Map"
     needs_index: bool = False
+    # Optimizer metadata: row_preserving ops keep exactly one output row
+    # per input row (limits may move before them); kind/cols tag typed
+    # transforms ("project" carries its column list) for rewrite rules.
+    row_preserving: bool = False
+    kind: str = ""
+    cols: "list[str] | None" = None
 
 
 @dataclass
@@ -90,7 +96,8 @@ def fuse_stages(ops: list[LogicalOp]) -> list[LogicalOp]:
 
             fused.append(MapBlocks(
                 chained, name=f"{prev.name}->{op.name}",
-                needs_index=prev.needs_index or op.needs_index))
+                needs_index=prev.needs_index or op.needs_index,
+                row_preserving=prev.row_preserving and op.row_preserving))
         else:
             fused.append(op)
     return fused

@@ -442,3 +442,71 @@ def test_backpressure_policy_plugin(rt):
     out = sorted(r["i"] for r in ds.take_all())
     assert out == [i * 2 for i in range(8)]
     assert policy.consulted > 0, "policy never consulted"
+
+
+# -------------------------------------------------- logical optimizer
+
+
+def test_optimizer_limit_pushes_through_row_preserving_ops():
+    from ray_tpu.data.optimizer import optimize
+    from ray_tpu.data.plan import InputData, Limit, MapBlocks
+
+    ops = [InputData(block_refs=[]),
+           MapBlocks(lambda b: b, name="Map", row_preserving=True),
+           MapBlocks(lambda b: b, name="Rename", row_preserving=True),
+           Limit(limit=5)]
+    out, applied = optimize(ops)
+    assert "LimitPushdown" in applied
+    # The limit moved before both row-preserving maps (which then fused).
+    assert isinstance(out[1], Limit) and out[1].limit == 5
+    assert "OperatorFusion" in applied
+    names = [op.name for op in out]
+    assert names == ["Input", "Limit", "Map->Rename"], names
+
+
+def test_optimizer_limit_stops_at_non_preserving_ops():
+    from ray_tpu.data.optimizer import optimize
+    from ray_tpu.data.plan import InputData, Limit, MapBlocks
+
+    ops = [InputData(block_refs=[]),
+           MapBlocks(lambda b: b, name="Filter", row_preserving=False),
+           Limit(limit=5)]
+    out, _ = optimize(ops)
+    # Moving a limit before a filter would change results; it must stay.
+    assert isinstance(out[-1], Limit)
+    assert out[1].name == "Filter"
+
+
+def test_optimizer_collapses_adjacent_limits_and_projects():
+    from ray_tpu.data.optimizer import optimize
+    from ray_tpu.data.plan import InputData, Limit, MapBlocks
+
+    ops = [InputData(block_refs=[]),
+           MapBlocks(lambda b: b.select(["a", "b"]), name="SelectColumns",
+                     row_preserving=True, kind="project", cols=["a", "b"]),
+           MapBlocks(lambda b: b.select(["a"]), name="SelectColumns",
+                     row_preserving=True, kind="project", cols=["a"]),
+           Limit(limit=10), Limit(limit=3)]
+    out, applied = optimize(ops)
+    assert "ProjectionMerge" in applied
+    limits = [op for op in out if isinstance(op, Limit)]
+    assert len(limits) == 1 and limits[0].limit == 3
+    projects = [op for op in out
+                if isinstance(op, MapBlocks) and op.kind == "project"]
+    assert len(projects) == 1 and projects[0].cols == ["a"]
+
+
+def test_optimized_pipeline_results_unchanged(ray_start_regular):
+    """End-to-end: the optimizer must never change WHAT a pipeline
+    computes — only how much work it does."""
+    import ray_tpu.data as rd
+
+    ds = (rd.range(100)
+          .map(lambda r: {"id": r["id"], "sq": r["id"] ** 2})
+          .rename_columns({"sq": "square"})
+          .limit(7))
+    rows = ds.take_all()
+    assert [r["square"] for r in rows] == [i ** 2 for i in range(7)]
+    stats = ds.stats()
+    assert "optimizer:" in stats, stats
+    assert "LimitPushdown" in stats

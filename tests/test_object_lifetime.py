@@ -19,13 +19,28 @@ import ray_tpu
 from ray_tpu.cluster_utils import Cluster
 
 
-def test_node_store_spills_primaries_and_restores(tmp_path):
+def _make_store(impl: str, **kwargs):
+    """Both implementations honor the same interface + semantics; the
+    native store is the default daemon data plane (node_store.cpp)."""
+    if impl == "python":
+        from ray_tpu._private.node_executor import NodeObjectStore
+
+        return NodeObjectStore(**kwargs)
+    from ray_tpu._native import load
+    from ray_tpu._private.node_store_native import NativeNodeObjectStore
+
+    lib = load()
+    if lib is None:
+        pytest.skip("native toolchain unavailable")
+    return NativeNodeObjectStore(lib, **kwargs)
+
+
+@pytest.mark.parametrize("impl", ["python", "native"])
+def test_node_store_spills_primaries_and_restores(tmp_path, impl):
     """Over the primary cap the oldest blobs move to disk; fetches read
     them back chunk by chunk (restore-on-fetch)."""
-    from ray_tpu._private.node_executor import NodeObjectStore
-
-    store = NodeObjectStore(primary_limit_bytes=3 * 1024 * 1024,
-                            spill_dir=str(tmp_path / "spill"))
+    store = _make_store(impl, primary_limit_bytes=3 * 1024 * 1024,
+                        spill_dir=str(tmp_path / "spill"))
     blobs = {}
     for i in range(8):  # 8 x 1MB >> 3MB cap
         key = bytes([i]) * 16
@@ -51,10 +66,9 @@ def test_node_store_spills_primaries_and_restores(tmp_path):
     assert leftover == []
 
 
-def test_owner_free_drops_only_that_owners_blobs(tmp_path):
-    from ray_tpu._private.node_executor import NodeObjectStore
-
-    store = NodeObjectStore(spill_dir=str(tmp_path / "spill"))
+@pytest.mark.parametrize("impl", ["python", "native"])
+def test_owner_free_drops_only_that_owners_blobs(tmp_path, impl):
+    store = _make_store(impl, spill_dir=str(tmp_path / "spill"))
     store.put(b"a" * 16, b"x" * 100, owner="owner-a")
     store.put(b"b" * 16, b"y" * 100, owner="owner-b")
     store.put(b"c" * 16, b"z" * 100, owner="owner-a")
