@@ -56,6 +56,9 @@ struct NodeStore {
   std::mutex mu;
   std::unordered_map<Key, Entry, KeyHash> map;
   std::list<Key> cache_order;  // FIFO of cached (pulled) copies
+  // seq -> key for IN-MEMORY primaries: spill victims pop from the
+  // front in O(log n) instead of rescanning the whole map per victim.
+  std::map<uint64_t, Key> primary_order;
   uint64_t cache_bytes = 0;
   uint64_t primary_bytes = 0;
   uint64_t cache_limit = 0;
@@ -91,6 +94,7 @@ bool forget_locked(NodeStore* s, const Key& k) {
     }
   } else {
     s->primary_bytes -= e.data.size();
+    s->primary_order.erase(e.seq);
   }
   s->map.erase(it);
   return true;
@@ -116,24 +120,19 @@ void mkdir_p(const std::string& path) {
 // (rt_ns_read).
 void maybe_spill_locked(NodeStore* s, const Key& just_put) {
   while (s->primary_bytes > s->primary_limit) {
-    const Key* victim = nullptr;
-    uint64_t best_seq = UINT64_MAX;
-    for (auto& kv : s->map) {
-      Entry& e = kv.second;
-      if (e.cached || !e.spill_path.empty() || kv.first == just_put)
-        continue;
-      if (e.seq < best_seq) {
-        best_seq = e.seq;
-        victim = &kv.first;
-      }
-    }
-    if (victim == nullptr) return;
-    Entry& e = s->map[*victim];
+    // Oldest in-memory primary from the order index (never the blob
+    // being put right now).
+    auto ord = s->primary_order.begin();
+    if (ord != s->primary_order.end() && ord->second == just_put)
+      ++ord;
+    if (ord == s->primary_order.end()) return;
+    Key victim = ord->second;
+    Entry& e = s->map[victim];
     mkdir_p(s->spill_dir);
     char path[4096];
     snprintf(path, sizeof(path), "%s/%d-%s-native.blob",
              s->spill_dir.c_str(), (int)getpid(),
-             hex16(victim->b).c_str());
+             hex16(victim.b).c_str());
     FILE* f = fopen(path, "wb");
     if (f == nullptr) return;  // unwritable disk: keep in memory
     size_t n = fwrite(e.data.data(), 1, e.data.size(), f);
@@ -143,6 +142,7 @@ void maybe_spill_locked(NodeStore* s, const Key& just_put) {
       return;
     }
     s->primary_bytes -= e.data.size();
+    s->primary_order.erase(e.seq);
     e.spill_path = path;
     e.data.clear();
     e.data.shrink_to_fit();
@@ -198,6 +198,7 @@ int rt_ns_put(void* h, const uint8_t* id, const uint8_t* data,
     }
   } else {
     s->primary_bytes += len;
+    s->primary_order[s->map[k].seq] = k;
     maybe_spill_locked(s, k);
   }
   return 0;

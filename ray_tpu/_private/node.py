@@ -200,10 +200,12 @@ def run_head(port: int, resources: dict | None = None,
 
 
 def run_worker(gcs_address: str, resources: dict | None = None,
-               pool_size: int | None = None) -> None:
+               pool_size: int | None = None,
+               labels: dict | None = None) -> None:
     """Worker-node daemon: executor service + register + heartbeat.
     Blocks. (Reference: the raylet — lease-based dispatch onto this
-    node's worker pool, node_manager.cc:1714.)"""
+    node's worker pool, node_manager.cc:1714.) ``labels`` merge into
+    the node record (e.g. the autoscaler provider's tag)."""
     from ray_tpu._private.node_executor import NodeExecutorService
 
     resources = resources or default_resources()
@@ -213,7 +215,7 @@ def run_worker(gcs_address: str, resources: dict | None = None,
     executor = NodeExecutorService(
         pool_size=pool_size, resources=resources).start()
     agent = NodeAgent(gcs_address, resources,
-                      labels={"node_role": "worker"},
+                      labels={"node_role": "worker", **(labels or {})},
                       usage_fn=executor.available_resources,
                       executor_address=executor.address_for(_own_address()))
     stop_event = threading.Event()

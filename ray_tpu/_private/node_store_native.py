@@ -84,12 +84,20 @@ class NativeNodeObjectStore:
             return None
         key = self._key(id_bytes)
         size = self._lib.rt_ns_size(self._handle, key)
-        if size < 0:
-            return None
-        out = self._read_into(key, 0, size)
-        if out is None:
-            return None  # freed between size and read
-        return bytes(out[1])
+        for _ in range(8):
+            if size < 0:
+                return None
+            out = self._read_into(key, 0, size)
+            if out is None:
+                return None  # freed between size and read
+            total, ba = out
+            if total == size:
+                return bytes(ba)
+            # A concurrent reseal changed the object's size between the
+            # size probe and the copy; retry at the new size (the
+            # Python store does size+copy atomically under one lock).
+            size = total
+        return None
 
     def free(self, ids: list[bytes]) -> int:
         if not ids or self._closed:

@@ -113,7 +113,8 @@ class StandardAutoscaler:
         min_workers each reconcile)."""
         for nt in self._node_types.values():
             while self._count(nt.name) < nt.min_workers:
-                self._launch(nt)
+                if not self._launch(nt):
+                    break  # retry next reconcile, don't spin
 
     def _collect_demands(self) -> list[dict[str, float]]:
         demands = list(self._runtime.dispatcher.pending_demands())
@@ -186,12 +187,18 @@ class StandardAutoscaler:
             return sum(1 for t in self._tracked.values()
                        if t.node_type == node_type)
 
-    def _launch(self, nt: NodeTypeConfig) -> None:
+    def _launch(self, nt: NodeTypeConfig) -> bool:
         node_id = self._provider.create_node(nt.name, nt.resources)
+        if node_id is None:
+            # Daemon providers can fail a launch (process died before
+            # registering); the next reconcile retries.
+            logger.warning("autoscaler launch of %s failed", nt.name)
+            return False
         with self._lock:
             self._tracked[node_id] = _TrackedNode(node_id, nt.name)
         logger.info("autoscaler launched %s node %s", nt.name,
                     node_id.hex()[:8])
+        return True
 
     def _scale_down(self) -> None:
         now = time.monotonic()
@@ -203,6 +210,13 @@ class StandardAutoscaler:
             if node is None or not node.alive:
                 with self._lock:
                     self._tracked.pop(t.node_id, None)
+                # Tell the provider too: a daemon whose node was marked
+                # dead (missed heartbeats) may still have a live OS
+                # process that must be reaped, not orphaned.
+                try:
+                    self._provider.terminate_node(t.node_id)
+                except Exception:  # noqa: BLE001 — best-effort cleanup
+                    pass
                 continue
             busy = any(node.available.get(k, 0.0) + 1e-9 < v
                        for k, v in node.total.items())

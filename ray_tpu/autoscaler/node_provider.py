@@ -57,3 +57,111 @@ class VirtualNodeProvider(NodeProvider):
     def node_type(self, node_id: NodeID) -> str | None:
         with self._lock:
             return self._launched.get(node_id)
+
+
+class LocalDaemonNodeProvider(NodeProvider):
+    """Launches REAL worker-node daemons as local OS processes against
+    a running head (reference: autoscaler/_private/local/node_provider
+    + the fake_multi_node provider AutoscalingCluster drives — but
+    these daemons are full executor nodes: worker pool, object store,
+    actor plane).
+
+    create_node spawns the daemon with a unique provider tag label and
+    resolves its NodeID by polling the head's node table for that tag;
+    terminate_node SIGTERMs the process (the daemon drains, the head
+    marks it dead, connected drivers drop it)."""
+
+    def __init__(self, head_address: str, pool_size: int = 2,
+                 register_timeout_s: float = 30.0):
+        self._head = head_address
+        self._pool_size = pool_size
+        self._register_timeout = register_timeout_s
+        self._lock = threading.Lock()
+        self._procs: dict[NodeID, Any] = {}
+
+    def create_node(self, node_type: str,
+                    resources: dict[str, float]) -> NodeID | None:
+        import json
+        import os
+        import subprocess
+        import sys
+        import time
+
+        from ray_tpu._private.rpc import RpcClient, RpcError
+
+        tag = f"as-{os.urandom(6).hex()}"
+        env = dict(os.environ)
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        prior = env.get("PYTHONPATH", "")
+        if pkg_root not in prior.split(os.pathsep):
+            env["PYTHONPATH"] = (
+                pkg_root + (os.pathsep + prior if prior else ""))
+        env.setdefault("RAY_TPU_SKIP_TPU_DETECTION", "1")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.node", "worker",
+             json.dumps({"gcs_address": self._head,
+                         "resources": dict(resources),
+                         "pool_size": self._pool_size,
+                         "labels": {"provider_tag": tag,
+                                    "node_type": node_type,
+                                    "autoscaler": "1"}})],
+            env=env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        client = RpcClient(self._head, timeout_s=5.0)
+        deadline = time.monotonic() + self._register_timeout
+        try:
+            while time.monotonic() < deadline:
+                if proc.poll() is not None:
+                    return None  # daemon died during startup
+                try:
+                    nodes = client.call("list_nodes")
+                except (RpcError, OSError):
+                    nodes = []
+                for node in nodes:
+                    if (node.get("alive") and node.get(
+                            "labels", {}).get("provider_tag") == tag):
+                        node_id = NodeID(bytes.fromhex(node["node_id"]))
+                        with self._lock:
+                            self._procs[node_id] = proc
+                        return node_id
+                time.sleep(0.25)
+        finally:
+            client.close()
+        # Never registered: reap, don't leak a zombie (failed launches
+        # are an expected retry mode against a flaky head).
+        self._reap(proc)
+        return None
+
+    @staticmethod
+    def _reap(proc) -> None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except Exception:  # noqa: BLE001 — escalate
+            proc.kill()
+            try:
+                proc.wait(timeout=5)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def terminate_node(self, node_id: NodeID) -> None:
+        with self._lock:
+            proc = self._procs.pop(node_id, None)
+        if proc is not None:
+            self._reap(proc)
+
+    def non_terminated_nodes(self) -> list[NodeID]:
+        with self._lock:
+            return [nid for nid, proc in self._procs.items()
+                    if proc.poll() is None]
+
+    def shutdown(self) -> None:
+        with self._lock:
+            procs = list(self._procs.values())
+            self._procs.clear()
+        for proc in procs:
+            try:
+                self._reap(proc)
+            except OSError:
+                pass

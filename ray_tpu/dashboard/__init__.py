@@ -107,6 +107,11 @@ class Dashboard:
                 for k, v in cluster.items()) + "</table>")
         for name, cols in (
                 ("nodes", ["node_id", "alive", "resources", "labels"]),
+                ("node_stats", ["node_id", "address", "pid",
+                                "tasks_executed", "running", "actors",
+                                "store_blobs", "store_bytes",
+                                "spilled_blobs", "native_store",
+                                "error"]),
                 ("actors", ["actor_id", "class_name", "state", "name"]),
                 ("jobs", ["job_id", "status", "entrypoint",
                           "submission_id"]),
@@ -122,8 +127,89 @@ class Dashboard:
         self._server.server_close()
 
 
+class NodeStatsCollector:
+    """Live per-node stats for the dashboard (reference: the per-node
+    dashboard agents + reporter.proto feed node cards — here each
+    daemon's executor service IS the node agent, and the dashboard
+    polls its ``executor_stats``). Briefly cached so an auto-refreshing
+    page doesn't hammer the daemons."""
+
+    def __init__(self, list_nodes: Callable[[], list],
+                 cache_s: float = 2.0):
+        import time
+
+        self._list_nodes = list_nodes
+        self._cache_s = cache_s
+        self._time = time.monotonic
+        self._lock = threading.Lock()
+        self._cached: tuple[float, list] = (-1e9, [])
+
+    @staticmethod
+    def _poll_one(node: dict) -> dict:
+        from ray_tpu._private.rpc import RpcClient
+
+        row = {"node_id": node.get("node_id", "")[:12],
+               "address": node.get("executor_address")}
+        try:
+            client = RpcClient(row["address"], timeout_s=2.0,
+                               connect_timeout_s=1.0)
+            try:
+                stats = client.call("executor_stats")
+            finally:
+                client.close()
+            store = stats.get("store", {})
+            row.update({
+                "pid": stats.get("pid"),
+                "tasks_executed": stats.get("tasks_executed"),
+                "running": stats.get("running"),
+                "threads": stats.get("threads"),
+                "actors": stats.get("num_actors"),
+                "store_blobs": store.get("num_blobs"),
+                "store_bytes": store.get("bytes"),
+                "spilled_blobs": store.get("spilled_blobs", 0),
+                "native_store": bool(store.get("native", False)),
+            })
+        except Exception as exc:  # noqa: BLE001 — node unreachable
+            row["error"] = f"unreachable: {type(exc).__name__}"
+        return row
+
+    def collect(self) -> list[dict]:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with self._lock:
+            ts, rows = self._cached
+            if self._time() - ts < self._cache_s:
+                return rows
+        targets = [n for n in self._list_nodes()
+                   if n.get("alive") and n.get("executor_address")]
+        if targets:
+            # Fan out: one slow/unreachable-but-alive node must not
+            # stall the whole section (its probe still bounds at ~3s,
+            # but the others return in parallel).
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(targets))) as pool:
+                rows = list(pool.map(self._poll_one, targets))
+        else:
+            rows = []
+        with self._lock:
+            self._cached = (self._time(), rows)
+        return rows
+
+
 def runtime_provider(runtime) -> Callable:
     """Sections backed by a live driver Runtime via the state API."""
+
+    def _connected_nodes() -> list:
+        if runtime.gcs_client is None:
+            return []
+        from ray_tpu._private.rpc import RpcError
+
+        try:
+            return runtime.gcs_client.call("list_nodes")
+        except (RpcError, OSError):
+            return []
+
+    collector = NodeStatsCollector(_connected_nodes)
 
     def provide(section: str):
         from ray_tpu.util import state
@@ -136,6 +222,8 @@ def runtime_provider(runtime) -> Callable:
                 "alive_nodes": sum(
                     1 for n in runtime.gcs.list_nodes() if n.alive),
             }
+        if section == "node_stats":
+            return collector.collect()
         fn = {
             "nodes": state.list_nodes,
             "actors": state.list_actors,
@@ -152,6 +240,8 @@ def runtime_provider(runtime) -> Callable:
 def gcs_provider(gcs_server) -> Callable:
     """Sections backed by a head daemon's GcsServer."""
 
+    collector = NodeStatsCollector(gcs_server._list_nodes)
+
     def provide(section: str):
         if section == "cluster":
             return {
@@ -161,6 +251,8 @@ def gcs_provider(gcs_server) -> Callable:
             }
         if section == "nodes":
             return gcs_server._list_nodes()
+        if section == "node_stats":
+            return collector.collect()
         if section == "jobs":
             return [dict(j, job_id=j.get("submission_id", ""))
                     for j in gcs_server.jobs.list() if j]

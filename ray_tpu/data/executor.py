@@ -30,7 +30,6 @@ from ray_tpu.data.plan import (
     LogicalOp,
     MapBlocks,
     ReadTask,
-    fuse_stages,
 )
 
 

@@ -122,3 +122,58 @@ def test_infeasible_demand_not_launched(small_runtime):
         assert scaler.num_nodes() == 0
     finally:
         scaler.shutdown()
+
+
+def test_autoscaler_launches_real_daemons_on_demand():
+    """LocalDaemonNodeProvider: pending demand launches a REAL worker
+    daemon process against the head; idle timeout terminates it
+    (reference: the local node provider + AutoscalingCluster flow —
+    but with full executor daemons)."""
+    import time
+
+    import ray_tpu
+    from ray_tpu.autoscaler import NodeTypeConfig, StandardAutoscaler
+    from ray_tpu.autoscaler.node_provider import LocalDaemonNodeProvider
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir="/tmp/ray_tpu_test_as_daemon")  # head only
+    provider = LocalDaemonNodeProvider(cluster.address, pool_size=1)
+    scaler = None
+    try:
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        scaler = StandardAutoscaler(
+            runtime,
+            [NodeTypeConfig("cpu2", {"CPU": 2.0}, max_workers=2)],
+            idle_timeout_s=4.0, update_interval_s=0.5,
+            provider=provider).start()
+
+        @ray_tpu.remote
+        def work(x):
+            import os
+
+            return x + 1, os.environ.get("RAY_TPU_NODE_TAG")
+
+        # No CPU anywhere yet: these tasks force a daemon launch.
+        refs = [work.remote(i) for i in range(4)]
+        results = ray_tpu.get(refs, timeout=120)
+        assert [v for v, _ in results] == [1, 2, 3, 4]
+        assert all(tag for _, tag in results), "ran outside a daemon"
+        assert scaler.num_nodes("cpu2") >= 1
+        assert len(provider.non_terminated_nodes()) >= 1
+
+        # Idle: the daemon is terminated and capacity drains away.
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if (scaler.num_nodes("cpu2") == 0
+                    and not provider.non_terminated_nodes()):
+                break
+            time.sleep(0.5)
+        assert scaler.num_nodes("cpu2") == 0
+        assert provider.non_terminated_nodes() == []
+    finally:
+        if scaler is not None:
+            scaler.shutdown()
+        provider.shutdown()
+        ray_tpu.shutdown()
+        cluster.shutdown()
