@@ -91,8 +91,13 @@ class NativeNodeObjectStore:
             if out is None:
                 return None  # freed between size and read
             total, ba = out
-            if total == size:
+            if total == size and len(ba) == size:
                 return bytes(ba)
+            if total == size:
+                # Short copy at unchanged size: a spilled file came up
+                # truncated (I/O error) — surface absence, never a
+                # silently corrupt blob.
+                return None
             # A concurrent reseal changed the object's size between the
             # size probe and the copy; retry at the new size (the
             # Python store does size+copy atomically under one lock).

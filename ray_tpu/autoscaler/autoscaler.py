@@ -88,6 +88,11 @@ class StandardAutoscaler:
 
     def shutdown(self) -> None:
         self._stop.set()
+        # Join the reconcile thread: an in-flight daemon launch must
+        # finish registering (and get tracked) BEFORE the caller tears
+        # down the provider, or the fresh process is orphaned.
+        if self._thread is not None:
+            self._thread.join(timeout=60.0)
 
     def _run(self) -> None:
         while not self._stop.wait(self._interval):
@@ -160,8 +165,18 @@ class StandardAutoscaler:
             cap = dict(nt.resources)
             _consume(cap, demand)
             pending_capacity.append(cap)
-        for nt in launches:
-            self._launch(nt)
+        if len(launches) > 1:
+            # Parallel launches: daemon providers block on registration
+            # (seconds each); serializing a batch would stall the whole
+            # reconcile loop for N x startup latency.
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                    max_workers=min(4, len(launches))) as pool:
+                list(pool.map(self._launch, launches))
+        else:
+            for nt in launches:
+                self._launch(nt)
 
     def _pick_node_type(self, demand,
                         extra: dict[str, int] | None = None

@@ -200,13 +200,14 @@ def runtime_provider(runtime) -> Callable:
     """Sections backed by a live driver Runtime via the state API."""
 
     def _connected_nodes() -> list:
-        if runtime.gcs_client is None:
+        client = runtime.gcs_client  # snapshot: shutdown() may None it
+        if client is None:
             return []
         from ray_tpu._private.rpc import RpcError
 
         try:
-            return runtime.gcs_client.call("list_nodes")
-        except (RpcError, OSError):
+            return client.call("list_nodes")
+        except (RpcError, OSError, AttributeError):
             return []
 
     collector = NodeStatsCollector(_connected_nodes)

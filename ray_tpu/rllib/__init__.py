@@ -33,7 +33,7 @@ from ray_tpu.rllib.algorithms.multi_agent_ppo import (
 )
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
-from ray_tpu.rllib.algorithms.td3 import TD3, TD3Config
+from ray_tpu.rllib.algorithms.td3 import DDPG, DDPGConfig, TD3, TD3Config
 from ray_tpu.rllib.core.learner import JaxLearner, Learner, compute_gae
 from ray_tpu.rllib.core.learner_group import LearnerGroup
 from ray_tpu.rllib.core.rl_module import (
@@ -113,6 +113,8 @@ __all__ = [
     "ReplayBuffer",
     "SAC",
     "SACConfig",
+    "DDPG",
+    "DDPGConfig",
     "TD3",
     "TD3Config",
     "SampleBatch",

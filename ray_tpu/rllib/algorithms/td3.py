@@ -275,3 +275,24 @@ class TD3(Algorithm):
 
 
 TD3Config.algo_class = TD3
+
+
+class DDPGConfig(TD3Config):
+    """DDPG (reference: rllib/algorithms/ddpg/) as the TD3 ancestor it
+    is: no policy delay, no target-action smoothing — a single
+    deterministic actor-critic update per step. The twin critic stays
+    (strictly an upgrade over classic DDPG's single critic; the
+    reference's DDPG gained the same option)."""
+
+    def __init__(self):
+        super().__init__()
+        self.policy_delay = 1
+        self.target_noise = 0.0
+        self.target_noise_clip = 0.0
+
+
+class DDPG(TD3):
+    config_class = DDPGConfig
+
+
+DDPGConfig.algo_class = DDPG

@@ -1062,3 +1062,33 @@ def test_bandit_checkpoint_roundtrip(tmp_path):
     assert np.allclose(algo.b, algo2.b)
     algo.cleanup()
     algo2.cleanup()
+
+
+def test_ddpg_smoke_updates_actor_every_step():
+    """DDPG = TD3 with policy_delay=1 and no smoothing: the actor and
+    targets move on EVERY update."""
+    import jax
+
+    from ray_tpu.rllib import DDPGConfig
+    from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
+
+    algo = DDPGConfig().environment("Pendulum-v1").build()
+    learner = algo.learner_group._local
+    batch = SampleBatch({
+        Columns.OBS: np.random.randn(16, 3).astype(np.float32),
+        Columns.NEXT_OBS: np.random.randn(16, 3).astype(np.float32),
+        Columns.ACTIONS: np.random.uniform(
+            -2, 2, (16, 1)).astype(np.float32),
+        Columns.REWARDS: np.random.randn(16).astype(np.float32),
+        Columns.TERMINATEDS: np.zeros(16, dtype=bool),
+    })
+
+    def flat_pi(p):
+        return np.concatenate([np.asarray(x).ravel() for x in
+                               jax.tree_util.tree_leaves(p["pi"])])
+
+    pi0 = flat_pi(learner.params)
+    metrics = learner.update_from_batch(batch)
+    assert not np.allclose(flat_pi(learner.params), pi0)
+    assert np.isfinite(metrics["actor_loss"])
+    algo.cleanup()
