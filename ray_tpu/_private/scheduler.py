@@ -286,17 +286,38 @@ class Dispatcher:
                 self._ready.sort(key=lambda t: t.order)
                 pending = list(self._ready)
             launched_any = False
+            # Per-pass memo of demand signatures that failed admission:
+            # once {CPU: 1} can't fit anywhere, the other 900 queued
+            # {CPU: 1} tasks can't either — skip them instead of
+            # rescanning the cluster per task (burst submits otherwise
+            # go O(pending^2) while holding the GIL away from runners).
+            failed_sigs: set = set()
             for task in pending:
+                spec = task.spec
+                strategy = spec.scheduling_strategy
+                sig = (tuple(sorted(spec.resources.items())),
+                       strategy.kind,
+                       getattr(strategy, "node_id", None),
+                       getattr(strategy, "soft", False))
+                avoids = bool(getattr(spec, "_avoid_nodes", None))
+                if sig in failed_sigs and not avoids:
+                    continue
                 node = self._try_admit(task)
-                if node is not None:
-                    with self._lock:
-                        try:
-                            self._ready.remove(task)
-                        except ValueError:
-                            continue
-                        self._num_running += 1
-                    self._launch(task, node)
-                    launched_any = True
+                if node is None:
+                    # A spillback task's failure doesn't generalize (its
+                    # avoid set shrinks the candidate nodes); only plain
+                    # failures poison the signature for this pass.
+                    if not avoids:
+                        failed_sigs.add(sig)
+                    continue
+                with self._lock:
+                    try:
+                        self._ready.remove(task)
+                    except ValueError:
+                        continue
+                    self._num_running += 1
+                self._launch(task, node)
+                launched_any = True
             if not launched_any:
                 # Nothing admitted: wait for resources to free up.
                 self._cluster.wait_for_change(0.05)
@@ -331,6 +352,11 @@ class Dispatcher:
                     self._num_running -= 1
                     self._lock.notify_all()
 
+        # Thread-per-task, deliberately: a cached runner pool was
+        # A/B-measured SLOWER for burst dispatch on this class of host —
+        # Thread.start() blocks until the child runs, which hands the
+        # GIL straight to the task; a queue handoff returns instantly
+        # and lets the dispatch scan starve the runners.
         thread = threading.Thread(
             target=runner, name=f"ray_tpu-task-{task.spec.name}", daemon=True)
         thread.start()
