@@ -310,12 +310,19 @@ class Dispatcher:
                     if not avoids:
                         failed_sigs.add(sig)
                     continue
+                claimed = False
                 with self._lock:
                     try:
                         self._ready.remove(task)
+                        self._num_running += 1
+                        claimed = True
                     except ValueError:
-                        continue
-                    self._num_running += 1
+                        pass
+                if not claimed:
+                    # Concurrently cancelled after admission: give the
+                    # acquired resources back or the node leaks them.
+                    self._cluster.release(node.node_id, spec.resources)
+                    continue
                 self._launch(task, node)
                 launched_any = True
             if not launched_any:

@@ -4,6 +4,7 @@ from ray_tpu.train.session import get_checkpoint
 from ray_tpu.tune.schedulers import (
     ASHAScheduler,
     FIFOScheduler,
+    MedianStoppingRule,
     PB2,
     PopulationBasedTraining,
 )
@@ -78,6 +79,7 @@ __all__ = [
     "loguniform",
     "randint",
     "report",
+    "MedianStoppingRule",
     "PB2",
     "PopulationBasedTraining",
     "DefineByRunSearcher",

@@ -1,4 +1,4 @@
-"""Trial schedulers: FIFO, ASHA, Population Based Training, and PB2.
+"""Trial schedulers: FIFO, ASHA, PBT, PB2, and median stopping.
 
 Reference: python/ray/tune/schedulers/async_hyperband.py (ASHA) — rungs
 at grace_period * reduction_factor^k; a trial reaching a rung must be in
@@ -313,3 +313,65 @@ class PB2(PopulationBasedTraining):
             lo, hi = self.hyperparam_bounds[key]
             config[key] = lo + float(unit) * (hi - lo)
         return config
+
+
+class MedianStoppingRule:
+    """Stop trials whose best result falls below the median of running
+    averages at the same time step (reference:
+    python/ray/tune/schedulers/median_stopping_rule.py — the Vizier
+    median stopping rule).
+
+    A trial is evaluated after ``grace_period`` steps, against the
+    median of the OTHER trials' running-average scores; fewer than
+    ``min_samples_required`` completed/running peers means CONTINUE.
+    """
+
+    def __init__(self, metric: str = "loss", mode: str = "min",
+                 grace_period: int = 1, min_samples_required: int = 3,
+                 time_attr: str = "training_iteration"):
+        if mode not in ("min", "max"):
+            raise ValueError(f"mode must be min|max, got {mode}")
+        self.metric = metric
+        self.mode = mode
+        self.grace_period = grace_period
+        self.min_samples_required = min_samples_required
+        self.time_attr = time_attr
+        self._history: dict[str, list] = {}  # tid -> [(t, score)]
+        self._best: dict[str, float] = {}
+        self.num_stopped = 0
+
+    def _score(self, value: float) -> float:
+        return -value if self.mode == "min" else value
+
+    def on_result(self, trial_id: str, metrics: dict) -> str:
+        t = metrics.get(self.time_attr)
+        value = metrics.get(self.metric)
+        if t is None or value is None:
+            return CONTINUE
+        score = self._score(float(value))
+        self._history.setdefault(trial_id, []).append((t, score))
+        self._best[trial_id] = max(
+            self._best.get(trial_id, -float("inf")), score)
+        if t < self.grace_period:
+            return CONTINUE
+        # Peer averages ALIGNED to this trial's step: only results up
+        # to t count, else a slow-but-equal trial compares against
+        # peers' later (better) scores and dies unfairly (the Vizier
+        # rule restricts to the same step for exactly this reason).
+        peers = []
+        for tid, history in self._history.items():
+            if tid == trial_id:
+                continue
+            upto = [s for ts, s in history if ts <= t]
+            if upto:
+                peers.append(sum(upto) / len(upto))
+        if len(peers) < self.min_samples_required:
+            return CONTINUE
+        peers.sort()
+        n = len(peers)
+        median = (peers[n // 2] if n % 2
+                  else (peers[n // 2 - 1] + peers[n // 2]) / 2.0)
+        if self._best[trial_id] < median:
+            self.num_stopped += 1
+            return STOP
+        return CONTINUE

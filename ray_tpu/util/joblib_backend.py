@@ -1,0 +1,92 @@
+"""joblib parallel backend over ray_tpu tasks.
+
+Reference: python/ray/util/joblib/ (register_ray +
+ray_backend.RayBackend): scikit-learn-style ``Parallel(...)`` fan-outs
+run as framework tasks instead of local processes, so they ride the
+cluster's scheduler, spillback, and object store.
+
+Usage::
+
+    import joblib
+    from ray_tpu.util.joblib_backend import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu"):
+        joblib.Parallel()(joblib.delayed(f)(x) for x in data)
+"""
+
+from __future__ import annotations
+
+_run_joblib_batch = None  # created once, on first backend use
+
+
+def register_ray_tpu() -> None:
+    """Register the 'ray_tpu' joblib backend (idempotent)."""
+    import joblib
+    from joblib.parallel import ParallelBackendBase
+
+    import ray_tpu
+
+    class RayTpuBackend(ParallelBackendBase):
+        """Each joblib batch becomes one task (reference:
+        ray_backend.RayBackend submits batches as remote calls)."""
+
+        supports_timeout = True
+        uses_threads = False
+        supports_sharedmem = False
+
+        def configure(self, n_jobs=1, parallel=None, **kwargs):
+            if not ray_tpu.is_initialized():
+                ray_tpu.init()
+            self.parallel = parallel
+            return self.effective_n_jobs(n_jobs)
+
+        def effective_n_jobs(self, n_jobs):
+            if n_jobs == 0:
+                raise ValueError("n_jobs == 0 has no meaning")
+            total = ray_tpu.cluster_resources().get("CPU", 1)
+            if n_jobs is None or n_jobs < 0:
+                return max(1, int(total))
+            return n_jobs
+
+        def apply_async(self, func, callback=None):
+            global _run_joblib_batch
+            if _run_joblib_batch is None:
+                @ray_tpu.remote
+                def run_batch(batch):
+                    return batch()
+
+                _run_joblib_batch = run_batch
+            ref = _run_joblib_batch.remote(func)
+            return _RayTpuFuture(ref, callback)
+
+        def abort_everything(self, ensure_ready=True):
+            pass  # tasks already in flight run to completion
+
+    class _RayTpuFuture:
+        """joblib expects an AsyncResult-shaped handle."""
+
+        def __init__(self, ref, callback):
+            self._ref = ref
+            if callback is not None:
+                import threading
+
+                def resolve():
+                    try:
+                        callback(ray_tpu.get(self._ref))
+                    except BaseException:  # noqa: BLE001 — joblib
+                        pass  # surfaces errors through get() below
+
+                threading.Thread(target=resolve, daemon=True).start()
+
+        def get(self, timeout=None):
+            from ray_tpu.exceptions import TaskError
+
+            try:
+                return ray_tpu.get(self._ref, timeout=timeout)
+            except TaskError as exc:
+                # joblib callers expect the USER's exception type (the
+                # loky/threading backends re-raise it directly).
+                raise exc.cause from exc
+
+    joblib.register_parallel_backend("ray_tpu", RayTpuBackend)

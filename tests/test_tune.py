@@ -354,3 +354,45 @@ def test_define_by_run_rejects_param_space():
     searcher = DefineByRunSearcher(lambda t: None)
     with pytest.raises(ValueError):
         searcher.set_search_properties("loss", "min", {"x": 1})
+
+
+def test_median_stopping_rule_stops_below_median():
+    from ray_tpu.tune import MedianStoppingRule
+    from ray_tpu.tune.schedulers import CONTINUE, STOP
+
+    rule = MedianStoppingRule(metric="score", mode="max",
+                              grace_period=2, min_samples_required=3)
+    # Three healthy trials build the median.
+    for t in range(1, 4):
+        for tid, base in (("a", 10), ("b", 9), ("c", 11)):
+            assert rule.on_result(tid, {"training_iteration": t,
+                                        "score": base + t}) == CONTINUE
+    # A lagging trial past the grace period stops; a leading one doesn't.
+    assert rule.on_result("bad", {"training_iteration": 1,
+                                  "score": 1}) == CONTINUE  # grace
+    assert rule.on_result("bad", {"training_iteration": 3,
+                                  "score": 1}) == STOP
+    assert rule.on_result("c", {"training_iteration": 4,
+                                "score": 20}) == CONTINUE
+    assert rule.num_stopped == 1
+
+
+def test_median_stopping_end_to_end(ray_start_regular):
+    from ray_tpu.tune import MedianStoppingRule
+
+    def trainable(config):
+        for i in range(1, 16):
+            tune.report({"score": config["q"] * i,
+                         "training_iteration": i})
+
+    rule = MedianStoppingRule(metric="score", mode="max",
+                              grace_period=3, min_samples_required=2)
+    results = Tuner(
+        trainable,
+        param_space={"q": tune.grid_search([0.01, 1.0, 1.1, 1.2])},
+        tune_config=TuneConfig(metric="score", mode="max",
+                               scheduler=rule),
+    ).fit()
+    best = results.get_best_result(metric="score", mode="max")
+    assert best.metrics["score"] >= 15
+    assert rule.num_stopped >= 1  # the 0.01 trial died early

@@ -265,3 +265,28 @@ def test_config_knobs_reach_hot_paths(monkeypatch):
         assert store._cache_limit == 1024 * 1024
     finally:
         GLOBAL_CONFIG.reset()
+
+
+def test_joblib_backend_runs_batches_as_tasks(ray_start_regular):
+    """joblib.Parallel over the ray_tpu backend (reference:
+    util/joblib/register_ray)."""
+    import joblib
+
+    from ray_tpu.util.joblib_backend import register_ray_tpu
+
+    register_ray_tpu()
+    with joblib.parallel_backend("ray_tpu", n_jobs=4):
+        out = joblib.Parallel()(
+            joblib.delayed(lambda x: x * x)(i) for i in range(20))
+    assert out == [i * i for i in range(20)]
+
+    # Errors propagate like any joblib backend.
+    def boom(x):
+        raise ValueError("joblib-boom")
+
+    with joblib.parallel_backend("ray_tpu", n_jobs=2):
+        try:
+            joblib.Parallel()(joblib.delayed(boom)(i) for i in range(2))
+            raise AssertionError("expected ValueError")
+        except ValueError:
+            pass
