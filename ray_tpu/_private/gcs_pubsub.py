@@ -1,0 +1,144 @@
+"""Cluster-wide pub/sub channels served by the head GCS.
+
+Reference: src/ray/pubsub/publisher.h:307 (Publisher buffers messages
+per subscriber and drains them on long-poll requests; subscriber.h:70
+is the polling client) and python/ray/_private/gcs_pubsub.py. Channels
+are free-form strings; the head publishes its own node-membership
+events on ``nodes``, and any process in the cluster can publish or
+subscribe through the head's RPC surface:
+
+    pubsub_subscribe(sub_id, channels)
+    pubsub_poll(sub_id, timeout) -> [(channel, message), ...]
+    pubsub_publish(channel, message) -> receiver count
+    pubsub_unsubscribe(sub_id)
+
+Subscribers that stop polling past a TTL are pruned (their buffers
+would otherwise grow unbounded — same reason the reference caps
+per-subscriber buffers).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Any
+
+
+class ChannelHub:
+    """Server-side channel fan-out with per-subscriber buffers."""
+
+    def __init__(self, max_buffer: int = 1000,
+                 subscriber_ttl_s: float = 60.0):
+        self._cond = threading.Condition(threading.Lock())
+        self._max_buffer = max_buffer
+        self._ttl = subscriber_ttl_s
+        # sub_id -> {"channels": set, "queue": deque, "seen": float,
+        #            "dropped": int}
+        self._subs: dict[str, dict] = {}
+
+    def subscribe(self, sub_id: str, channels: list[str]) -> None:
+        with self._cond:
+            sub = self._subs.setdefault(sub_id, {
+                "channels": set(), "queue": collections.deque(),
+                "seen": time.monotonic(), "dropped": 0})
+            sub["channels"].update(channels)
+            sub["seen"] = time.monotonic()
+
+    def unsubscribe(self, sub_id: str) -> bool:
+        with self._cond:
+            return self._subs.pop(sub_id, None) is not None
+
+    def publish(self, channel: str, message: Any) -> int:
+        delivered = 0
+        with self._cond:
+            now = time.monotonic()
+            for sub_id in list(self._subs):
+                sub = self._subs[sub_id]
+                if now - sub["seen"] > self._ttl:
+                    # Stopped polling: prune, or its buffer grows forever.
+                    del self._subs[sub_id]
+                    continue
+                if channel not in sub["channels"]:
+                    continue
+                queue = sub["queue"]
+                if len(queue) >= self._max_buffer:
+                    queue.popleft()  # oldest-first drop, counted
+                    sub["dropped"] += 1
+                queue.append((channel, message))
+                delivered += 1
+            if delivered:
+                self._cond.notify_all()
+        return delivered
+
+    def poll(self, sub_id: str, timeout_s: float = 10.0) -> list | None:
+        """Drain the subscriber's buffer, blocking up to ``timeout_s``
+        for the first message (the long-poll shape: the server holds
+        the request, the client loops). ``None`` means the subscriber
+        is unknown/pruned — re-subscribe."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        with self._cond:
+            while True:
+                sub = self._subs.get(sub_id)
+                if sub is None:
+                    return None
+                sub["seen"] = time.monotonic()
+                if sub["queue"]:
+                    out = list(sub["queue"])
+                    sub["queue"].clear()
+                    return out
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return []
+                self._cond.wait(min(remaining, 1.0))
+
+    def num_subscribers(self) -> int:
+        with self._cond:
+            return len(self._subs)
+
+
+class GcsSubscriber:
+    """Client half (reference: subscriber.h:70 / gcs_pubsub.py
+    GcsSubscriber): subscribe once, then loop poll(); re-subscribes
+    transparently if the head pruned or restarted."""
+
+    def __init__(self, address: str, channels: list[str]):
+        from ray_tpu._private.rpc import RpcClient
+
+        self._client = RpcClient(address, timeout_s=30.0)
+        self._channels = list(channels)
+        self.sub_id = os.urandom(8).hex()
+        self._client.call("pubsub_subscribe", self.sub_id,
+                          self._channels)
+
+    def poll(self, timeout_s: float = 10.0) -> list:
+        events = self._client.call("pubsub_poll", self.sub_id,
+                                   timeout_s)
+        if events is None:
+            # Pruned (or head restarted): re-subscribe and retry once.
+            self._client.call("pubsub_subscribe", self.sub_id,
+                              self._channels)
+            events = self._client.call("pubsub_poll", self.sub_id, 0.0)
+        return events or []
+
+    def close(self) -> None:
+        # No goodbye RPC: with the head unreachable it would block a
+        # whole socket timeout inside shutdown paths. The hub prunes
+        # silent subscribers by TTL.
+        self._client.close()
+
+
+class GcsPublisher:
+    """Client publish half (reference: gcs_pubsub.py GcsPublisher)."""
+
+    def __init__(self, address: str):
+        from ray_tpu._private.rpc import RpcClient
+
+        self._client = RpcClient(address, timeout_s=10.0)
+
+    def publish(self, channel: str, message: Any) -> int:
+        return self._client.call("pubsub_publish", channel, message)
+
+    def close(self) -> None:
+        self._client.close()

@@ -198,6 +198,15 @@ class GcsServer:
         self._object_locations: dict[str, dict[str, str]] = {}
         self._obj_loc_seen: dict[str, float] = {}
         self._obj_loc_lock = threading.Lock()
+        # Cross-process channel hub; the head's own membership events
+        # bridge onto the "nodes" channel so any cluster process can
+        # react by push instead of polling list_nodes.
+        from ray_tpu._private.gcs_pubsub import ChannelHub
+
+        self.pubsub = ChannelHub()
+        self.gcs.pubsub.subscribe(
+            "nodes", lambda event: self.pubsub.publish(
+                "nodes", (event[0], event[1].hex())))
         self._register_methods()
         self._monitor = threading.Thread(
             target=self._monitor_loop, daemon=True, name="gcs-monitor")
@@ -234,6 +243,13 @@ class GcsServer:
         s.register("object_locations_update",
                    self._object_locations_update)
         s.register("list_object_locations", self._list_object_locations)
+        # Cluster-wide pub/sub channels (reference: the GCS pubsub
+        # handler over src/ray/pubsub/publisher.h:307). Polls block, so
+        # they dispatch concurrently like task execution does.
+        s.register("pubsub_subscribe", self.pubsub.subscribe)
+        s.register("pubsub_unsubscribe", self.pubsub.unsubscribe)
+        s.register("pubsub_publish", self.pubsub.publish)
+        s.register("pubsub_poll", self.pubsub.poll, concurrent=True)
 
     # -- node service -------------------------------------------------
     def _register_node(self, address: str, resources: dict,

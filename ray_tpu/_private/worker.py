@@ -344,21 +344,59 @@ class Runtime:
     # ------------------------------------------------------ remote exec plane
 
     def _watch_remote_nodes(self) -> None:
-        """Poll the head GCS node table; add/remove remote executor
-        nodes in ClusterState and flush queued object frees."""
+        """Mirror the head's node table into ClusterState, reacting to
+        membership PUSH events (the head's "nodes" pubsub channel —
+        reference: GcsNodeManager broadcasts node-dead over pubsub)
+        with a long-poll, plus a periodic resync as the safety net;
+        each wake also flushes queued object frees and location
+        deltas."""
+        from ray_tpu._private.gcs_pubsub import GcsSubscriber
         from ray_tpu._private.rpc import RpcError
 
-        while not self._watcher_stop.wait(0.5):
-            try:
-                nodes = self.gcs_client.call("list_nodes")
-            except (RpcError, OSError, AttributeError):
-                continue
-            try:
-                self._sync_remote_nodes(nodes)
-                self._flush_remote_frees()
-                self._flush_object_locations()
-            except Exception:  # noqa: BLE001 — watcher must survive
-                logger.exception("remote node sync failed")
+        subscriber = None
+        try:
+            subscriber = GcsSubscriber(self.gcs_client.address,
+                                       ["nodes"])
+        except Exception:  # noqa: BLE001 — pre-pubsub head: poll only
+            subscriber = None
+        last_sync = 0.0
+        try:
+            while not self._watcher_stop.is_set():
+                events = []
+                if subscriber is not None:
+                    try:
+                        # Blocks server-side until a membership event
+                        # (push) or the timeout.
+                        events = subscriber.poll(timeout_s=5.0)
+                    except Exception:  # noqa: BLE001 — head gone
+                        self._watcher_stop.wait(0.5)
+                else:
+                    self._watcher_stop.wait(0.5)
+                if self._watcher_stop.is_set():
+                    return
+                try:
+                    # Frees/location deltas flush every wake; the FULL
+                    # node-table resync only on a push event or the
+                    # periodic safety net (a pre-pubsub head keeps the
+                    # old per-wake cadence).
+                    self._flush_remote_frees()
+                    self._flush_object_locations()
+                    now = time.monotonic()
+                    if (events or subscriber is None
+                            or now - last_sync >= 10.0):
+                        self._sync_remote_nodes(
+                            self.gcs_client.call("list_nodes"))
+                        last_sync = now
+                except (RpcError, OSError, AttributeError):
+                    continue
+                except Exception:  # noqa: BLE001 — watcher must survive
+                    logger.exception("remote node sync failed")
+        finally:
+            # Closed HERE, not in shutdown(): the watcher may still be
+            # constructing/polling the subscriber when shutdown() runs,
+            # and only this thread knows the final reference.
+            if subscriber is not None:
+                subscriber.close()
 
     def _sync_remote_nodes(self, nodes: list[dict]) -> None:
         from ray_tpu._private.node_executor import RemoteNodeHandle

@@ -49,7 +49,7 @@ def register_ray_tpu() -> None:
                 return max(1, int(total))
             return n_jobs
 
-        def apply_async(self, func, callback=None):
+        def submit(self, func, callback=None):
             global _run_joblib_batch
             if _run_joblib_batch is None:
                 @ray_tpu.remote
@@ -59,6 +59,9 @@ def register_ray_tpu() -> None:
                 _run_joblib_batch = run_batch
             ref = _run_joblib_batch.remote(func)
             return _RayTpuFuture(ref, callback)
+
+        # joblib < 1.5 calls apply_async; >= 1.5 calls submit.
+        apply_async = submit
 
         def abort_everything(self, ensure_ready=True):
             pass  # tasks already in flight run to completion
@@ -71,13 +74,23 @@ def register_ray_tpu() -> None:
             if callback is not None:
                 import threading
 
-                def resolve():
+                def signal_done():
+                    # Completion SIGNAL only (joblib retrieves the real
+                    # value via get() below — fetching it here too
+                    # would transfer every batch result twice). wait()
+                    # also resolves for FAILED batches, so dispatch
+                    # bookkeeping keeps advancing on errors.
                     try:
-                        callback(ray_tpu.get(self._ref))
+                        ray_tpu.wait([self._ref], num_returns=1)
+                    except BaseException:  # noqa: BLE001
+                        pass
+                    try:
+                        callback(None)
                     except BaseException:  # noqa: BLE001 — joblib
-                        pass  # surfaces errors through get() below
+                        pass
 
-                threading.Thread(target=resolve, daemon=True).start()
+                threading.Thread(target=signal_done,
+                                 daemon=True).start()
 
         def get(self, timeout=None):
             from ray_tpu.exceptions import TaskError

@@ -1,0 +1,100 @@
+"""Cluster-wide pub/sub channels (reference: src/ray/pubsub/
+publisher.h:307 — per-subscriber buffers drained by long-poll — and
+python/ray/_private/gcs_pubsub.py)."""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.gcs_pubsub import (
+    ChannelHub,
+    GcsPublisher,
+    GcsSubscriber,
+)
+from ray_tpu.cluster_utils import Cluster
+
+
+def test_channel_hub_fanout_and_buffering():
+    hub = ChannelHub(max_buffer=3)
+    hub.subscribe("s1", ["a", "b"])
+    hub.subscribe("s2", ["a"])
+    assert hub.publish("a", {"x": 1}) == 2
+    assert hub.publish("b", "only-s1") == 1
+    assert hub.publish("c", "nobody") == 0
+    assert hub.poll("s1", 0) == [("a", {"x": 1}), ("b", "only-s1")]
+    assert hub.poll("s2", 0) == [("a", {"x": 1})]
+    # Over the buffer cap the OLDEST drops.
+    for i in range(5):
+        hub.publish("a", i)
+    assert [m for _, m in hub.poll("s2", 0)] == [2, 3, 4]
+    # Unknown subscriber -> None (caller re-subscribes).
+    assert hub.poll("ghost", 0) is None
+    assert hub.unsubscribe("s1") and not hub.unsubscribe("s1")
+
+
+def test_channel_hub_long_poll_blocks_until_publish():
+    hub = ChannelHub()
+    hub.subscribe("s", ["tick"])
+    got = {}
+
+    def poller():
+        got["events"] = hub.poll("s", timeout_s=10.0)
+
+    t = threading.Thread(target=poller)
+    t.start()
+    time.sleep(0.2)
+    hub.publish("tick", 42)
+    t.join(timeout=5)
+    assert got["events"] == [("tick", 42)]
+
+
+def test_channel_hub_prunes_stale_subscribers():
+    hub = ChannelHub(subscriber_ttl_s=0.2)
+    hub.subscribe("gone", ["a"])
+    time.sleep(0.3)
+    hub.publish("a", 1)  # prune happens on publish
+    assert hub.num_subscribers() == 0
+    assert hub.poll("gone", 0) is None
+
+
+def test_pubsub_over_cluster_head():
+    """Cross-process: node membership events arrive by PUSH, and user
+    channels fan out between separate clients."""
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir="/tmp/ray_tpu_test_pubsub")
+    sub = pub = None
+    try:
+        sub = GcsSubscriber(cluster.address, ["nodes", "user-chan"])
+        node = cluster.add_node(num_cpus=1)
+        deadline = time.time() + 30
+        events = []
+        while time.time() < deadline:
+            events += [msg for ch, msg in sub.poll(timeout_s=2.0)
+                       if ch == "nodes"]
+            if any(kind == "ALIVE" for kind, _ in events):
+                break
+        assert any(kind == "ALIVE" for kind, _ in events), events
+
+        pub = GcsPublisher(cluster.address)
+        assert pub.publish("user-chan", {"hello": "world"}) == 1
+        got = sub.poll(timeout_s=5.0)
+        assert ("user-chan", {"hello": "world"}) in got
+
+        # Daemon death arrives as a DEAD push (heartbeat timeout).
+        cluster.remove_node(node, allow_graceful=True)
+        deadline = time.time() + 30
+        events = []
+        while time.time() < deadline:
+            events += [msg for ch, msg in sub.poll(timeout_s=2.0)
+                       if ch == "nodes"]
+            if any(kind == "DEAD" for kind, _ in events):
+                break
+        assert any(kind == "DEAD" for kind, _ in events), events
+    finally:
+        if sub is not None:
+            sub.close()
+        if pub is not None:
+            pub.close()
+        cluster.shutdown()
