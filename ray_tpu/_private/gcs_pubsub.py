@@ -40,26 +40,37 @@ class ChannelHub:
 
     def subscribe(self, sub_id: str, channels: list[str]) -> None:
         with self._cond:
+            self._prune_locked(time.monotonic())
             sub = self._subs.setdefault(sub_id, {
                 "channels": set(), "queue": collections.deque(),
-                "seen": time.monotonic(), "dropped": 0})
+                "seen": time.monotonic(), "dropped": 0, "epoch": 0})
             sub["channels"].update(channels)
             sub["seen"] = time.monotonic()
+
+    def _prune_locked(self, now: float) -> None:
+        for sub_id in list(self._subs):
+            if now - self._subs[sub_id]["seen"] > self._ttl:
+                del self._subs[sub_id]
+
+    def prune(self) -> None:
+        """Periodic sweep (the head's monitor loop): dead subscribers'
+        buffers must not outlive the TTL just because their channels
+        went quiet (publish-time pruning alone never fires then)."""
+        with self._cond:
+            self._prune_locked(time.monotonic())
 
     def unsubscribe(self, sub_id: str) -> bool:
         with self._cond:
             return self._subs.pop(sub_id, None) is not None
 
     def publish(self, channel: str, message: Any) -> int:
+        """Fan ``message`` out to the channel's subscribers."""
         delivered = 0
         with self._cond:
             now = time.monotonic()
+            self._prune_locked(now)
             for sub_id in list(self._subs):
                 sub = self._subs[sub_id]
-                if now - sub["seen"] > self._ttl:
-                    # Stopped polling: prune, or its buffer grows forever.
-                    del self._subs[sub_id]
-                    continue
                 if channel not in sub["channels"]:
                     continue
                 queue = sub["queue"]
@@ -79,10 +90,22 @@ class ChannelHub:
         is unknown/pruned — re-subscribe."""
         deadline = time.monotonic() + max(0.0, timeout_s)
         with self._cond:
+            sub = self._subs.get(sub_id)
+            if sub is None:
+                return None
+            # Single-drainer epoch: a NEWER poll for the same sub_id
+            # supersedes this one (the client re-polled after a dropped
+            # connection); the superseded waiter must return WITHOUT
+            # draining, or its reply dies on the dead socket and the
+            # drained events are lost.
+            sub["epoch"] = sub.get("epoch", 0) + 1
+            my_epoch = sub["epoch"]
             while True:
                 sub = self._subs.get(sub_id)
                 if sub is None:
                     return None
+                if sub.get("epoch", 0) != my_epoch:
+                    return []  # superseded by a fresh poll
                 sub["seen"] = time.monotonic()
                 if sub["queue"]:
                     out = list(sub["queue"])
@@ -103,18 +126,26 @@ class GcsSubscriber:
     GcsSubscriber): subscribe once, then loop poll(); re-subscribes
     transparently if the head pruned or restarted."""
 
+    # Server polls must resolve well inside the RPC socket timeout, or
+    # every long poll would die as a zombie thread holding the buffer.
+    _MAX_POLL_S = 25.0
+
     def __init__(self, address: str, channels: list[str]):
         from ray_tpu._private.rpc import RpcClient
 
         self._client = RpcClient(address, timeout_s=30.0)
         self._channels = list(channels)
         self.sub_id = os.urandom(8).hex()
-        self._client.call("pubsub_subscribe", self.sub_id,
-                          self._channels)
+        try:
+            self._client.call("pubsub_subscribe", self.sub_id,
+                              self._channels)
+        except BaseException:
+            self._client.close()  # never leak the connected socket
+            raise
 
     def poll(self, timeout_s: float = 10.0) -> list:
         events = self._client.call("pubsub_poll", self.sub_id,
-                                   timeout_s)
+                                   min(timeout_s, self._MAX_POLL_S))
         if events is None:
             # Pruned (or head restarted): re-subscribe and retry once.
             self._client.call("pubsub_subscribe", self.sub_id,

@@ -336,6 +336,7 @@ class GcsServer:
                                      > self.heartbeat_timeout_s):
                     self.gcs.mark_node_dead(record.node_id)
             self._prune_object_locations()
+            self.pubsub.prune()
             if self._persist_path:
                 self._save_snapshot()
 

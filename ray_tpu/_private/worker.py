@@ -363,11 +363,21 @@ class Runtime:
         try:
             while not self._watcher_stop.is_set():
                 events = []
+                # Queued frees / dirty locations shorten the wait: the
+                # flush cadence must not degrade to the full long-poll
+                # interval while work is pending (the free queue is
+                # bounded; slow flushing would overflow it 10x sooner).
+                with self._remote_free_lock:
+                    pending_frees = bool(self._remote_free_queue)
+                with self._locations_lock:
+                    dirty_locs = bool(self._loc_dirty_adds
+                                      or self._loc_dirty_removes)
+                poll_s = 0.5 if (pending_frees or dirty_locs) else 5.0
                 if subscriber is not None:
                     try:
                         # Blocks server-side until a membership event
                         # (push) or the timeout.
-                        events = subscriber.poll(timeout_s=5.0)
+                        events = subscriber.poll(timeout_s=poll_s)
                     except Exception:  # noqa: BLE001 — head gone
                         self._watcher_stop.wait(0.5)
                 else:

@@ -1,0 +1,86 @@
+// ThreadSanitizer stress for the native node store (node_store.cpp).
+//
+// Reference test intent: the reference runs its C++ store/scheduler
+// gtests under TSAN bazel configs (ci/). Here a standalone binary
+// hammers the rt_ns_* API from many threads — puts (reseals included),
+// chunked reads, frees, owner sweeps, stats — and TSAN flags any data
+// race in the store's locking. Built and executed by
+// tests/test_native_tsan.py with -fsanitize=thread.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* rt_ns_create(uint64_t, uint64_t, const char*);
+void rt_ns_destroy(void*);
+int rt_ns_put(void*, const uint8_t*, const uint8_t*, uint64_t, int,
+              const char*);
+int64_t rt_ns_read(void*, const uint8_t*, uint64_t, uint8_t*, uint64_t,
+                   uint64_t*);
+int64_t rt_ns_size(void*, const uint8_t*);
+int rt_ns_free(void*, const uint8_t*, uint32_t);
+int rt_ns_free_owner(void*, const char*);
+int64_t rt_ns_owners(void*, char*, uint64_t);
+void rt_ns_stats(void*, uint64_t*);
+}
+
+namespace {
+
+void make_key(uint8_t* out, int worker, int index) {
+  memset(out, 0, 16);
+  out[0] = (uint8_t)worker;
+  out[1] = (uint8_t)(index & 0xFF);
+  out[2] = (uint8_t)(index >> 8);
+}
+
+std::atomic<long> ops{0};
+
+void hammer(void* store, int worker, int rounds) {
+  const uint64_t blob_len = 64 * 1024;
+  std::vector<uint8_t> blob(blob_len, (uint8_t)worker);
+  std::vector<uint8_t> buf(blob_len);
+  char owner[16];
+  snprintf(owner, sizeof(owner), "owner-%d", worker % 3);
+  uint8_t key[16];
+  for (int r = 0; r < rounds; r++) {
+    int index = r % 32;
+    make_key(key, worker % 4, index);  // keys COLLIDE across workers
+    rt_ns_put(store, key, blob.data(), blob_len, r % 5 == 0 ? 1 : 0,
+              owner);
+    uint64_t copied = 0;
+    rt_ns_read(store, key, (r % 4) * 1024, buf.data(), 4096, &copied);
+    rt_ns_size(store, key);
+    if (r % 7 == 0) rt_ns_free(store, key, 1);
+    if (r % 50 == 0) rt_ns_free_owner(store, owner);
+    if (r % 11 == 0) {
+      uint64_t stats[9];
+      rt_ns_stats(store, stats);
+      char owners_buf[256];
+      rt_ns_owners(store, owners_buf, sizeof(owners_buf));
+    }
+    ops.fetch_add(1, std::memory_order_relaxed);
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* spill_dir = argc > 1 ? argv[1] : "/tmp/tsan_ns_spill";
+  // Tiny primary cap: the spill/restore paths run under contention too.
+  void* store = rt_ns_create(1 << 20, 512 * 1024, spill_dir);
+  if (store == nullptr) return 2;
+  std::vector<std::thread> threads;
+  for (int w = 0; w < 8; w++)
+    threads.emplace_back(hammer, store, w, 400);
+  for (auto& t : threads) t.join();
+  uint64_t stats[9];
+  rt_ns_stats(store, stats);
+  printf("TSAN-STRESS-OK ops=%ld blobs=%llu spills=%llu\n", ops.load(),
+         (unsigned long long)stats[0], (unsigned long long)stats[5]);
+  rt_ns_destroy(store);
+  return 0;
+}

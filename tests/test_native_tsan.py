@@ -1,0 +1,50 @@
+"""ThreadSanitizer harness for the native components.
+
+Reference: the reference's C++ tests run under TSAN/ASAN bazel configs
+in CI (SURVEY §5 "race detection"). Here the native node store is
+compiled with -fsanitize=thread together with a multithreaded stress
+driver (native_tsan_stress.cpp); any data race in the store's locking
+fails the test through TSAN's report + nonzero exit.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_DIR)
+_BIN = os.path.join(_DIR, ".native_tsan_stress")
+_SOURCES = [
+    os.path.join(_DIR, "native_tsan_stress.cpp"),
+    os.path.join(_REPO, "ray_tpu", "_native", "node_store.cpp"),
+]
+
+
+def _toolchain_available() -> bool:
+    from shutil import which
+
+    return which("g++") is not None
+
+
+@pytest.mark.skipif(not _toolchain_available(), reason="no g++")
+def test_node_store_is_race_free_under_tsan(tmp_path):
+    if (not os.path.exists(_BIN)
+            or os.path.getmtime(_BIN) < max(
+                os.path.getmtime(s) for s in _SOURCES)):
+        build = subprocess.run(
+            ["g++", "-O1", "-g", "-fsanitize=thread", *_SOURCES,
+             "-o", _BIN, "-lpthread"],
+            capture_output=True, text=True, timeout=180)
+        if build.returncode != 0:
+            pytest.skip(f"tsan build unavailable: {build.stderr[-500:]}")
+    proc = subprocess.run(
+        [_BIN, str(tmp_path / "spill")], capture_output=True, text=True,
+        timeout=300,
+        env={**os.environ,
+             "TSAN_OPTIONS": "halt_on_error=0 exitcode=66"})
+    sys.stdout.write(proc.stdout[-500:])
+    assert "ThreadSanitizer" not in proc.stderr, proc.stderr[-3000:]
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-1000:])
+    assert "TSAN-STRESS-OK" in proc.stdout
