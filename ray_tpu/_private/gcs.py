@@ -114,6 +114,13 @@ class ActorRecord:
     death_cause: str | None = None
     handle: Any = None  # the live LocalActor executor (single-node slice)
     placement_hint: Any = None
+    # Where the actor executes (reference: the GCS actor table records
+    # the owner/executing address — gcs_actor_manager.h). Driver-hosted
+    # actors record the driver's own node; "" means placement is not
+    # (yet) known. pid is the executing process (the driver's for
+    # thread actors).
+    node_id_hex: str = ""
+    pid: int | None = None
     # Per-method defaults declared via @ray_tpu.method (e.g. num_returns).
     method_meta: dict = field(default_factory=dict)
 

@@ -242,6 +242,10 @@ class RemoteActor:
                 reply = ("busy",)
             if reply[0] == "ok":
                 self.pid = reply[1]
+                record = getattr(self, "_gcs_record", None)
+                if record is not None:
+                    record.pid = self.pid
+                    record.node_id_hex = self.node_id.hex()
                 with self._lock:
                     raced_kill = self._dead
                 if raced_kill:

@@ -1361,6 +1361,12 @@ class Runtime:
                 self._release_actor_lease(aid)
 
             def on_restart(aid):
+                actor = self._actors.get(aid)
+                rec = self.gcs.get_actor(aid)
+                if actor is not None and rec is not None:
+                    # Restarts may have RELOCATED the actor.
+                    self._record_actor_placement(
+                        rec, actor, getattr(actor, "node_id", None))
                 self.gcs.update_actor_state(aid, "ALIVE")
 
             # Record the lease BEFORE constructing the actor: a remote
@@ -1417,6 +1423,7 @@ class Runtime:
                     on_restart=on_restart)
             self._actors[actor_id] = actor
             record.handle = actor
+            self._record_actor_placement(record, actor, node_id)
             self.gcs.update_actor_state(actor_id, "ALIVE")
 
         threading.Thread(target=start_actor, daemon=True,
@@ -1514,6 +1521,34 @@ class Runtime:
                 pg_info[0], pg_info[1], resources)
         else:
             self.cluster.release(node_id, resources)
+
+    def _record_actor_placement(self, record, actor, node_id) -> None:
+        """Actor-table placement columns (reference: the GCS actor
+        table records the executing address, gcs_actor_manager.h).
+        Values only ever improve: a None/unknown reading never
+        overwrites something already recorded."""
+        # FIRST: async fillers (RemoteActor's create reply,
+        # ProcessActor's spawn) race this method and must find the
+        # record to complete it.
+        actor._gcs_record = record
+        current = getattr(actor, "node_id", None) or node_id
+        if current is None:
+            # Local/process actors don't carry a node attribute; their
+            # placement is wherever their lease sits (the driver's node
+            # unless relocated).
+            lease = self._actor_leases.get(record.actor_id)
+            if lease is not None:
+                current = lease[0]
+        if current is not None:
+            record.node_id_hex = current.hex()
+        pid = getattr(actor, "pid", None)
+        if pid is None and getattr(actor, "_worker", None) is not None:
+            pid = actor._worker.proc.pid
+        if pid is None and not hasattr(actor, "_worker")                 and not hasattr(actor, "pid"):
+            pid = os.getpid()  # thread actor: runs in this process
+        if pid is not None:
+            record.pid = pid
+        record.num_restarts = getattr(actor, "_num_restarts", 0)
 
     def _relocate_actor_lease(self, actor_id: ActorID,
                               resources: dict[str, float],

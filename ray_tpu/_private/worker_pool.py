@@ -914,6 +914,11 @@ class ProcessActor:
     def _run(self) -> None:
         try:
             self._worker = PoolWorker(-1)
+            record = getattr(self, "_gcs_record", None)
+            if record is not None:
+                # Actor-table placement: the dedicated process's pid
+                # (also corrects the stale pid after a restart respawn).
+                record.pid = self._worker.proc.pid
             cls_blob = serialization.dumps_function(self._cls)
             args_blob = self._marshal(self._init_args, self._init_kwargs)
             reply = self._worker.request(

@@ -82,6 +82,8 @@ def list_actors(filters=None, limit: int = 100) -> list[dict]:
             "namespace": rec.namespace,
             "num_restarts": rec.num_restarts,
             "death_cause": rec.death_cause,
+            "node_id": rec.node_id_hex,
+            "pid": rec.pid,
         }
         for rec in _runtime().gcs.list_actors()
     ]

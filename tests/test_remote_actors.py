@@ -324,3 +324,38 @@ def test_named_remote_actor_resolves(actor_cluster):
     assert ray_tpu.get(actor.set.remote("k", 42), timeout=60)
     again = ray_tpu.get_actor("reg-svc")
     assert ray_tpu.get(again.get.remote("k"), timeout=60) == 42
+
+
+def test_actor_table_records_placement(actor_cluster):
+    """`list actors` shows WHERE each actor executes: node + pid for
+    daemon-hosted actors, driver-local for the rest (reference: the GCS
+    actor table records the executing address)."""
+    from ray_tpu.util import state
+
+    cluster, runtime = actor_cluster
+    node_a = _remote_node_ids(runtime)[0]
+
+    @ray_tpu.remote(num_cpus=1, scheduling_strategy=(
+        NodeAffinitySchedulingStrategy(node_id=node_a.hex(), soft=False)))
+    class Placed:
+        def pid(self):
+            return os.getpid()
+
+    actor = Placed.remote()
+    remote_pid = ray_tpu.get(actor.pid.remote(), timeout=60)
+    row = state.get_actor(actor._actor_id.hex())
+    assert row["node_id"] == node_a.hex(), row
+    assert row["pid"] == remote_pid, row
+
+    @ray_tpu.remote
+    class Local:
+        def ping(self):
+            return "ok"
+
+    local = Local.remote()
+    ray_tpu.get(local.ping.remote(), timeout=30)
+    lrow = state.get_actor(local._actor_id.hex())
+    # Driver-hosted actors record the driver's own node.
+    assert lrow["node_id"] == runtime.head_node_id.hex(), lrow
+    ray_tpu.kill(actor)
+    ray_tpu.kill(local)
