@@ -244,8 +244,11 @@ class RemoteActor:
                 self.pid = reply[1]
                 record = getattr(self, "_gcs_record", None)
                 if record is not None:
-                    record.pid = self.pid
-                    record.node_id_hex = self.node_id.hex()
+                    # Shared lock + fresh attribute reads: can't be
+                    # overwritten by a creation thread holding stale
+                    # pre-relocation state (and vice versa).
+                    self._runtime._record_actor_placement(
+                        record, self, self.node_id)
                 with self._lock:
                     raced_kill = self._dead
                 if raced_kill:

@@ -154,6 +154,7 @@ class Runtime:
         self._actor_queues: dict[ActorID, Any] = {}
         self._foreign_proxies: dict[tuple[str, str], Any] = {}
         self._actor_leases: dict[ActorID, tuple[NodeID, dict, Any]] = {}
+        self._placement_record_lock = threading.Lock()
         self._futures_lock = threading.Lock()
         self._futures: dict[ObjectID, list[concurrent.futures.Future]] = {}
         self.store.add_seal_listener(self._resolve_futures)
@@ -1525,30 +1526,35 @@ class Runtime:
     def _record_actor_placement(self, record, actor, node_id) -> None:
         """Actor-table placement columns (reference: the GCS actor
         table records the executing address, gcs_actor_manager.h).
-        Values only ever improve: a None/unknown reading never
-        overwrites something already recorded."""
-        # FIRST: async fillers (RemoteActor's create reply,
-        # ProcessActor's spawn) race this method and must find the
+        The creation path and the async fillers (RemoteActor's create
+        reply, ProcessActor's spawn) all funnel through here: the lock
+        plus fresh reads of the actor's own attributes mean the last
+        writer always records current values — a thread that captured
+        state before a relocation can't overwrite the relocated
+        placement with its stale copy."""
+        # FIRST: async fillers race this method and must find the
         # record to complete it.
         actor._gcs_record = record
-        current = getattr(actor, "node_id", None) or node_id
-        if current is None:
-            # Local/process actors don't carry a node attribute; their
-            # placement is wherever their lease sits (the driver's node
-            # unless relocated).
-            lease = self._actor_leases.get(record.actor_id)
-            if lease is not None:
-                current = lease[0]
-        if current is not None:
-            record.node_id_hex = current.hex()
-        pid = getattr(actor, "pid", None)
-        if pid is None and getattr(actor, "_worker", None) is not None:
-            pid = actor._worker.proc.pid
-        if pid is None and not hasattr(actor, "_worker")                 and not hasattr(actor, "pid"):
-            pid = os.getpid()  # thread actor: runs in this process
-        if pid is not None:
-            record.pid = pid
-        record.num_restarts = getattr(actor, "_num_restarts", 0)
+        with self._placement_record_lock:
+            current = getattr(actor, "node_id", None) or node_id
+            if current is None:
+                # Local/process actors don't carry a node attribute;
+                # their placement is wherever their lease sits (the
+                # driver's node unless relocated).
+                lease = self._actor_leases.get(record.actor_id)
+                if lease is not None:
+                    current = lease[0]
+            if current is not None:
+                record.node_id_hex = current.hex()
+            pid = getattr(actor, "pid", None)
+            if pid is None and getattr(actor, "_worker", None) is not None:
+                pid = actor._worker.proc.pid
+            if (pid is None and not hasattr(actor, "_worker")
+                    and not hasattr(actor, "pid")):
+                pid = os.getpid()  # thread actor: runs in this process
+            if pid is not None:
+                record.pid = pid
+            record.num_restarts = getattr(actor, "_num_restarts", 0)
 
     def _relocate_actor_lease(self, actor_id: ActorID,
                               resources: dict[str, float],

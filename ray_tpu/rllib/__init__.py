@@ -9,6 +9,7 @@ instead of DDP wrappers for multi-device learners.
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
+from ray_tpu.rllib.algorithms.ars import ARS, ARSConfig
 from ray_tpu.rllib.algorithms.bandits import (
     BanditLinTS,
     BanditLinTSConfig,
@@ -31,8 +32,10 @@ from ray_tpu.rllib.algorithms.multi_agent_ppo import (
     MultiAgentPPO,
     MultiAgentPPOConfig,
 )
+from ray_tpu.rllib.algorithms.pg import A2C, A2CConfig, PG, PGConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
+from ray_tpu.rllib.algorithms.simple_q import SimpleQ, SimpleQConfig
 from ray_tpu.rllib.algorithms.td3 import DDPG, DDPGConfig, TD3, TD3Config
 from ray_tpu.rllib.core.learner import JaxLearner, Learner, compute_gae
 from ray_tpu.rllib.core.learner_group import LearnerGroup
@@ -104,9 +107,17 @@ __all__ = [
     "MultiAgentVectorEnv",
     "MultiRLModule",
     "MultiRLModuleSpec",
+    "A2C",
+    "A2CConfig",
+    "ARS",
+    "ARSConfig",
+    "PG",
+    "PGConfig",
     "PPO",
     "PPOConfig",
     "PendulumVectorEnv",
+    "SimpleQ",
+    "SimpleQConfig",
     "PrioritizedReplayBuffer",
     "RLModule",
     "RLModuleSpec",

@@ -1,0 +1,89 @@
+"""ARS — Augmented Random Search.
+
+Reference: rllib/algorithms/ars/ (Mania et al. 2018). Same
+antithetic-perturbation fan-out as ES (each direction is one stateless
+remote task regenerating its noise from a seed), with ARS's three
+augmentations over basic random search:
+
+- V1/V2 step: update uses only the **top-k directions** ranked by
+  max(R+, R-) (``num_top_directions``);
+- the step size is **normalized by the reward std** of the selected
+  directions (so the learning rate is scale-free);
+- raw rewards, not centered ranks, weight the update.
+
+Observation normalization (ARS-V2's running mean/std filter) is left
+to the module; CartPole-scale observations don't need it and the
+filter state would otherwise have to be merged across tasks.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.es import ES, ESConfig, _evaluate_pair
+
+
+class ARSConfig(ESConfig):
+    def __init__(self):
+        super().__init__()
+        self.population_size = 32        # directions sampled = pop / 2
+        self.num_top_directions = 8      # b in the paper (<= pop/2)
+        self.sigma = 0.05
+        self.lr = 0.02
+
+    def learner_class(self):  # pragma: no cover - ARS has no learner
+        return None
+
+
+class ARS(ES):
+    config_class = ARSConfig
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        pairs = max(1, cfg.population_size // 2)
+        top_k = min(max(1, cfg.num_top_directions), pairs)
+        seeds = [int(s) for s in
+                 self._rng.integers(0, 2 ** 31 - 1, size=pairs)]
+        theta_ref = ray_tpu.put(self._theta)
+        refs = [self._eval_task.remote(self.module_spec, theta_ref, seed,
+                                       cfg.sigma, cfg.env,
+                                       cfg.episodes_per_perturbation,
+                                       cfg.max_episode_steps)
+                for seed in seeds]
+        results = ray_tpu.get(refs, timeout=600)
+
+        # Rank directions by max(R+, R-) and keep the top k
+        # (reference: ars.py top-performing directions selection).
+        scored = sorted(results, key=lambda r: max(r[1], r[2]),
+                        reverse=True)[:top_k]
+        selected = np.array([[rp, rm] for _, rp, rm, _ in scored])
+        reward_std = float(selected.std()) or 1.0
+
+        grad = np.zeros_like(self._theta)
+        for seed, r_plus, r_minus, _ in scored:
+            eps = np.random.default_rng(seed).standard_normal(
+                self._theta.shape[0]).astype(np.float32)
+            grad += (r_plus - r_minus) * eps
+        self._theta = self._theta + (
+            cfg.lr / (top_k * reward_std)) * grad
+
+        from ray_tpu.rllib.env.vector_env import make_vector_env
+        from ray_tpu.rllib.algorithms.es import _rollout_return
+
+        eval_return, eval_steps = _rollout_return(
+            self._policy_step, self._unravel(self._theta),
+            make_vector_env(cfg.env, cfg.report_eval_episodes),
+            cfg.max_episode_steps)
+        self._timesteps_total += (
+            sum(n for _, _, _, n in results) + eval_steps)
+        return {
+            "episode_return_mean": eval_return,
+            "population_reward_mean": float(
+                np.array([[rp, rm] for _, rp, rm, _ in results]).mean()),
+            "top_direction_reward_mean": float(selected.mean()),
+            "num_perturbations": 2 * pairs,
+        }
+
+
+ARSConfig.algo_class = ARS

@@ -1,0 +1,141 @@
+"""PG / A2C — vanilla policy gradient and synchronous advantage A-C.
+
+Reference: rllib/algorithms/pg/ (REINFORCE: loss = -logp * return-to-go,
+no critic, no clipping) and rllib/algorithms/a2c/ (synchronous A3C:
+n-step bootstrapped advantages, shared actor-critic loss, one SGD pass
+per sampling round — PPO without the ratio clip or epochs).
+
+Both ride the PPO postprocessing path: PG sets lambda=1 and discards
+the value baseline in the loss (using raw discounted returns), A2C
+uses GAE(lambda) advantages with a single full-batch update per round.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.ppo import postprocess_fragment
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import (
+    categorical_entropy,
+    categorical_logp,
+)
+from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
+
+
+class PGConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 4e-3
+        self.entropy_coeff = 0.0
+        # REINFORCE uses full Monte-Carlo returns: GAE with lambda=1
+        # degenerates to discounted returns-to-go minus the baseline;
+        # adding V back recovers the raw return target.
+        self.lambda_ = 1.0
+
+    def learner_class(self):
+        return PGLearner
+
+
+class PGLearner(Learner):
+    """-logp * return loss (reference: pg/torch/pg_torch_policy.py)."""
+
+    def compute_loss(self, params, batch, rng):
+        cfg = self.config
+        out = self.module.forward_train(params, batch, rng)
+        logits = out["action_logits"]
+        logp = categorical_logp(logits, batch[Columns.ACTIONS])
+        # postprocess_fragment normalizes advantages; for REINFORCE the
+        # normalized advantage is still a valid (variance-reduced)
+        # return signal, so use it directly.
+        pg_loss = -jnp.mean(logp * batch[Columns.ADVANTAGES])
+        entropy = categorical_entropy(logits)
+        total = pg_loss - cfg.entropy_coeff * jnp.mean(entropy)
+        return total, {"policy_loss": pg_loss,
+                       "entropy": jnp.mean(entropy)}
+
+
+class PG(Algorithm):
+    config_class = PGConfig
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        fragments = self._sample_fragments()
+        train_batch = SampleBatch.concat(
+            [postprocess_fragment(f, cfg.gamma, cfg.lambda_)
+             for f in fragments])
+        metrics = self.learner_group.update_from_batch(train_batch)
+        self._sync_weights()
+
+        results = self._runner_metrics()
+        results.update(metrics)
+        results["num_env_steps_trained"] = len(train_batch)
+        return results
+
+
+PGConfig.algo_class = PG
+
+
+class A2CConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.lr = 1e-3
+        self.lambda_ = 1.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        # A2C applies one synchronous update per sampling round
+        # (reference: a2c.py training_step), optionally split into
+        # microbatches accumulated before the apply.
+        self.microbatch_size = None
+
+    def learner_class(self):
+        return A2CLearner
+
+
+class A2CLearner(Learner):
+    """Shared actor-critic loss (reference: a2c/a2c_torch_policy.py):
+    -logp*A + vf_coeff*mse(V, target) - entropy_coeff*H."""
+
+    def compute_loss(self, params, batch, rng):
+        cfg = self.config
+        out = self.module.forward_train(params, batch, rng)
+        logits = out["action_logits"]
+        values = out["vf_preds"]
+        logp = categorical_logp(logits, batch[Columns.ACTIONS])
+        pg_loss = -jnp.mean(logp * batch[Columns.ADVANTAGES])
+        vf_loss = jnp.mean(
+            jnp.square(values - batch[Columns.VALUE_TARGETS]))
+        entropy = jnp.mean(categorical_entropy(logits))
+        total = (pg_loss + cfg.vf_loss_coeff * vf_loss
+                 - cfg.entropy_coeff * entropy)
+        return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                       "entropy": entropy}
+
+
+class A2C(Algorithm):
+    config_class = A2CConfig
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        fragments = self._sample_fragments()
+        train_batch = SampleBatch.concat(
+            [postprocess_fragment(f, cfg.gamma, cfg.lambda_)
+             for f in fragments])
+
+        mb = cfg.microbatch_size or len(train_batch)
+        rng = np.random.default_rng(cfg.seed + self.iteration)
+        metrics: dict = {}
+        for minibatch in train_batch.minibatches(
+                min(mb, len(train_batch)), rng):
+            metrics = self.learner_group.update_from_batch(minibatch)
+        self._sync_weights()
+
+        results = self._runner_metrics()
+        results.update(metrics)
+        results["num_env_steps_trained"] = len(train_batch)
+        return results
+
+
+A2CConfig.algo_class = A2C

@@ -1,0 +1,28 @@
+"""SimpleQ — minimal Q-learning (DQN without the extensions).
+
+Reference: rllib/algorithms/simple_q/ (vanilla Q-learning: single
+target network, no double-Q, no prioritized replay, no dueling — the
+pedagogical baseline the full DQN layers on top of). Here it is DQN
+with the extensions switched off, which is exactly how the reference
+relates the two families.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+
+
+class SimpleQConfig(DQNConfig):
+    def __init__(self):
+        super().__init__()
+        self.double_q = False
+        self.prioritized_replay = False
+        self.target_update_freq = 100
+        self.updates_per_iteration = 16
+
+
+class SimpleQ(DQN):
+    config_class = SimpleQConfig
+
+
+SimpleQConfig.algo_class = SimpleQ
