@@ -8,6 +8,7 @@ instead of DDP wrappers for multi-device learners.
 """
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.algorithms.apex_dqn import ApexDQN, ApexDQNConfig
 from ray_tpu.rllib.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rllib.algorithms.ars import ARS, ARSConfig
 from ray_tpu.rllib.algorithms.bandits import (
@@ -25,6 +26,7 @@ from ray_tpu.rllib.algorithms.bc import (
     MARWILConfig,
 )
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
+from ray_tpu.rllib.algorithms.crr import CRR, CRRConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.es import ES, ESConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
@@ -111,6 +113,10 @@ __all__ = [
     "A2CConfig",
     "ARS",
     "ARSConfig",
+    "ApexDQN",
+    "ApexDQNConfig",
+    "CRR",
+    "CRRConfig",
     "PG",
     "PGConfig",
     "PPO",

@@ -1,0 +1,234 @@
+"""APEX-DQN — distributed prioritized experience replay.
+
+Reference: rllib/algorithms/apex_dqn/ (Horgan et al. 2018): many env
+runners explore with a per-runner epsilon ladder, transitions flow into
+sharded prioritized replay actors, a central learner samples from the
+shards asynchronously and streams priority corrections back.
+
+Runtime shape here:
+
+- env runners are process actors sampling with a bounded in-flight
+  request pool (the IMPALA pump);
+- each replay shard is a process actor wrapping
+  PrioritizedReplayBuffer; fragments are pushed round-robin as object
+  refs so the driver never relays transition bytes to the shard;
+- the learner (TPU) samples from shards round-robin, runs the jitted
+  DQN update, and fires priority updates back at the owning shard
+  without awaiting them.
+"""
+
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithms.algorithm import Algorithm
+from ray_tpu.rllib.algorithms.dqn import DQNConfig
+from ray_tpu.rllib.env.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.utils.actor_manager import FaultTolerantActorManager
+from ray_tpu.rllib.utils.replay_buffers import PrioritizedReplayBuffer
+from ray_tpu.rllib.utils.sample_batch import (
+    Columns,
+    SampleBatch,
+    fragment_to_transitions,
+)
+
+
+class ReplayShard:
+    """One shard of the distributed replay (reference: apex's
+    ReplayActor). Runs as a process actor so buffer inserts and
+    priority maintenance never contend with the driver's GIL."""
+
+    def __init__(self, capacity: int, alpha: float, beta: float,
+                 seed: int):
+        self.buffer = PrioritizedReplayBuffer(
+            capacity, alpha=alpha, beta=beta, seed=seed)
+
+    def add(self, transitions: SampleBatch) -> int:
+        self.buffer.add(SampleBatch(transitions))
+        return len(self.buffer)
+
+    def sample(self, batch_size: int, min_size: int):
+        if len(self.buffer) < max(min_size, batch_size):
+            return None
+        return self.buffer.sample(batch_size)
+
+    def update_priorities(self, idx, td) -> None:
+        self.buffer.update_priorities(np.asarray(idx), np.asarray(td))
+
+    def size(self) -> int:
+        return len(self.buffer)
+
+
+class ApexDQNConfig(DQNConfig):
+    def __init__(self):
+        super().__init__()
+        self.num_env_runners = 2
+        self.num_replay_shards = 1
+        self.replay_shard_capacity = 50_000
+        self.prioritized_replay = True
+        self.replay_alpha = 0.6
+        self.replay_beta = 0.4
+        # Per-runner epsilon ladder: eps_i = base^(1 + i*alpha/(N-1))
+        # (Horgan et al. eq. 1) — runner 0 explores the most.
+        self.epsilon_base = 0.4
+        self.epsilon_ladder_alpha = 7.0
+        self.max_requests_in_flight_per_env_runner = 2
+        self.updates_per_iteration = 16
+        self.broadcast_interval = 4      # learner steps between pushes
+        self.num_steps_sampled_before_learning = 1000
+
+    def learner_class(self):
+        from ray_tpu.rllib.algorithms.dqn import DQNLearner
+        return DQNLearner
+
+
+class ApexDQN(Algorithm):
+    config_class = ApexDQNConfig
+
+    def setup(self, config: dict) -> None:
+        super().setup(config)
+        cfg = self.algo_config
+        RemoteShard = ray_tpu.remote(ReplayShard).options(process=True)
+        self._shards = [
+            RemoteShard.remote(cfg.replay_shard_capacity,
+                               cfg.replay_alpha, cfg.replay_beta,
+                               cfg.seed + i)
+            for i in range(max(1, cfg.num_replay_shards))]
+        self._shard_rr = 0          # round-robin insert cursor
+        self._pending: list = []    # sample() requests in flight
+        self._push_refs: collections.deque = collections.deque(maxlen=64)
+        self._learner_steps = 0
+        self._total_added = 0
+
+    def _build_env_runners(self, cfg):
+        """Per-runner epsilon ladder: each runner gets a CONSTANT
+        epsilon from the ladder instead of the decay schedule (the
+        ladder replaces annealing in apex)."""
+        if cfg.num_env_runners <= 0:
+            return super()._build_env_runners(cfg)
+        n = cfg.num_env_runners
+        RemoteRunner = ray_tpu.remote(SingleAgentEnvRunner)
+        if getattr(cfg, "use_process_runners", False):
+            RemoteRunner = RemoteRunner.options(process=True)
+
+        def ladder(idx: int) -> float:
+            if n == 1:
+                return cfg.epsilon_base
+            return cfg.epsilon_base ** (
+                1.0 + idx * cfg.epsilon_ladder_alpha / (n - 1))
+
+        def factory(idx: int):
+            spec = self.module_spec
+            spec = type(spec)(
+                module_class=spec.module_class,
+                observation_size=spec.observation_size,
+                num_actions=spec.num_actions,
+                action_size=getattr(spec, "action_size", 0),
+                model_config={**spec.model_config,
+                              "epsilon_start": ladder(idx),
+                              "epsilon_end": ladder(idx)})
+            return RemoteRunner.remote(
+                env_id=cfg.env, module_spec=spec,
+                num_envs=cfg.num_envs_per_env_runner,
+                rollout_fragment_length=cfg.rollout_fragment_length,
+                seed=cfg.seed, worker_index=idx + 1, explore=cfg.explore)
+
+        actors = [factory(i) for i in range(n)]
+        self.local_env_runner = None
+        return FaultTolerantActorManager(actors, actor_factory=factory)
+
+    # -- sampling pump (IMPALA-style, bounded in-flight) --------------
+    def _pump_sampling(self) -> None:
+        group = self.env_runner_group
+        if group is None:
+            frag = self.local_env_runner.sample()
+            self._ingest_fragment(frag)
+            return
+        while True:
+            sub = group.submit("sample")
+            if sub is None:
+                break
+            self._pending.append(sub)
+        ready, self._pending = group.fetch_ready(
+            self._pending, timeout=0.05)
+        for _, frag in ready:
+            self._ingest_fragment(frag)
+
+    def _ingest_fragment(self, frag: SampleBatch) -> None:
+        T, B = np.shape(frag[Columns.OBS])[:2]
+        self._timesteps_total += T * B
+        transitions = fragment_to_transitions(frag)
+        if len(transitions) == 0:
+            return
+        self._total_added += len(transitions)
+        shard = self._shards[self._shard_rr % len(self._shards)]
+        self._shard_rr += 1
+        # Fire-and-forget insert; the bounded deque retains refs long
+        # enough to observe errors without blocking the pump.
+        self._push_refs.append(shard.add.remote(transitions))
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        metrics: dict = {}
+        self._pump_sampling()
+        min_size = (cfg.num_steps_sampled_before_learning
+                    // max(1, len(self._shards)))
+
+        def request(i: int):
+            shard = self._shards[i % len(self._shards)]
+            return shard, shard.sample.remote(
+                cfg.train_batch_size, min_size)
+
+        # Prefetch pipeline: the request for update i+1 is in flight
+        # while update i runs on the learner, hiding the shard-actor
+        # round trip behind the jitted update. The producing shard
+        # rides with each ref — priority corrections must go back to
+        # the shard the batch came from.
+        shard, next_ref = request(0)
+        updates = 0
+        attempts = 0
+        while updates < cfg.updates_per_iteration and attempts < 4 * max(
+                1, cfg.updates_per_iteration):
+            attempts += 1
+            batch = ray_tpu.get(next_ref)
+            producer = shard
+            shard, next_ref = request(attempts)
+            if batch is None:
+                # Shards still warming up: keep sampling instead.
+                self._pump_sampling()
+                continue
+            batch = SampleBatch(batch)
+            indexes = batch.pop("batch_indexes")
+            metrics = self.learner_group.update_from_batch(batch)
+            td = self.learner_group.call(
+                "compute_td_errors",
+                SampleBatch({k: v for k, v in batch.items()
+                             if k != "weights"}))
+            # Priority correction streams back without a driver wait.
+            producer.update_priorities.remote(indexes, td)
+            updates += 1
+            self._learner_steps += 1
+            if self._learner_steps % cfg.broadcast_interval == 0:
+                self._sync_weights()
+
+        results = self._runner_metrics()
+        results.update(metrics)
+        results["num_learner_steps"] = self._learner_steps
+        results["num_transitions_added"] = self._total_added
+        results["replay_shard_sizes"] = ray_tpu.get(
+            [s.size.remote() for s in self._shards])
+        return results
+
+    def cleanup(self) -> None:
+        for shard in getattr(self, "_shards", []):
+            try:
+                ray_tpu.kill(shard)
+            except Exception:
+                pass
+        super().cleanup()
+
+
+ApexDQNConfig.algo_class = ApexDQN

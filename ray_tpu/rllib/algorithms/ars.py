@@ -20,8 +20,7 @@ from __future__ import annotations
 
 import numpy as np
 
-import ray_tpu
-from ray_tpu.rllib.algorithms.es import ES, ESConfig, _evaluate_pair
+from ray_tpu.rllib.algorithms.es import ES, ESConfig
 
 
 class ARSConfig(ESConfig):
@@ -43,15 +42,7 @@ class ARS(ES):
         cfg = self.algo_config
         pairs = max(1, cfg.population_size // 2)
         top_k = min(max(1, cfg.num_top_directions), pairs)
-        seeds = [int(s) for s in
-                 self._rng.integers(0, 2 ** 31 - 1, size=pairs)]
-        theta_ref = ray_tpu.put(self._theta)
-        refs = [self._eval_task.remote(self.module_spec, theta_ref, seed,
-                                       cfg.sigma, cfg.env,
-                                       cfg.episodes_per_perturbation,
-                                       cfg.max_episode_steps)
-                for seed in seeds]
-        results = ray_tpu.get(refs, timeout=600)
+        results = self._fanout_population(pairs)
 
         # Rank directions by max(R+, R-) and keep the top k
         # (reference: ars.py top-performing directions selection).
@@ -68,15 +59,7 @@ class ARS(ES):
         self._theta = self._theta + (
             cfg.lr / (top_k * reward_std)) * grad
 
-        from ray_tpu.rllib.env.vector_env import make_vector_env
-        from ray_tpu.rllib.algorithms.es import _rollout_return
-
-        eval_return, eval_steps = _rollout_return(
-            self._policy_step, self._unravel(self._theta),
-            make_vector_env(cfg.env, cfg.report_eval_episodes),
-            cfg.max_episode_steps)
-        self._timesteps_total += (
-            sum(n for _, _, _, n in results) + eval_steps)
+        eval_return = self._eval_mean_policy(results)
         return {
             "episode_return_mean": eval_return,
             "population_reward_mean": float(

@@ -1,0 +1,208 @@
+"""CRR — Critic-Regularized Regression (offline RL, discrete actions).
+
+Reference: rllib/algorithms/crr/ (Wang et al. 2020). Offline policy
+learning where behavior cloning is filtered through a learned critic:
+
+- the critic Q(s, a) trains by expected-SARSA TD against a target
+  network, with the expectation over the CURRENT policy's action
+  distribution (no max — stays in-distribution on offline data);
+- the policy trains by advantage-weighted log-likelihood:
+  weight = 1[A(s,a) > 0]  ("binary", the paper's best-performing form)
+  or exp(A(s,a) / beta) clipped  ("exp"),
+  where A(s,a) = Q(s,a) - E_{a'~pi}[Q(s,a')].
+
+Both heads update in ONE jitted program; the offline input rides the
+same row format as BC/MARWIL/CQL (algorithm.load_offline_rows), with
+next_obs required for the TD target.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import (
+    Algorithm,
+    load_offline_rows,
+)
+from ray_tpu.rllib.algorithms.bc import MARWIL, MARWILConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import (
+    RLModule,
+    _mlp_apply,
+    _mlp_init,
+    categorical_logp,
+)
+from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
+
+
+class CRRModule(RLModule):
+    """Separate policy and Q networks over a shared MLP recipe."""
+
+    def __init__(self, observation_size: int, num_actions: int,
+                 hidden: tuple = (64, 64), **_):
+        self.observation_size = observation_size
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+
+    def init(self, rng):
+        pi_rng, q_rng = jax.random.split(rng)
+        sizes = ((self.observation_size,) + self.hidden
+                 + (self.num_actions,))
+        return {"pi": _mlp_init(pi_rng, sizes),
+                "q": _mlp_init(q_rng, sizes)}
+
+    def q_values(self, params, obs):
+        return _mlp_apply(params["q"], obs)
+
+    def _logits(self, params, obs):
+        return _mlp_apply(params["pi"], obs)
+
+    def forward_inference(self, params, batch, rng=None):
+        logits = self._logits(params, batch["obs"])
+        return {"action_logits": logits,
+                "actions": jnp.argmax(logits, axis=-1)}
+
+    def forward_exploration(self, params, batch, rng=None):
+        logits = self._logits(params, batch["obs"])
+        actions = jax.random.categorical(rng, logits)
+        return {"action_logits": logits, "actions": actions,
+                "action_logp": categorical_logp(logits, actions),
+                "vf_preds": jnp.zeros_like(logits[..., 0])}
+
+    def forward_train(self, params, batch, rng=None):
+        return {"action_logits": self._logits(params, batch["obs"]),
+                "q_values": self.q_values(params, batch["obs"])}
+
+
+class CRRConfig(MARWILConfig):
+    """Inherits MARWIL's offline plumbing (input_, offline_data(),
+    evaluation()); swaps in the critic-regularized module/learner."""
+
+    def __init__(self):
+        super().__init__()
+        self.module_class = CRRModule
+        self.lr = 1e-3
+        self.weight_type = "bin"      # "bin" | "exp"
+        self.temperature = 1.0        # beta for the "exp" weight
+        self.max_weight = 20.0        # exp-weight clip (paper's CWP cap)
+        self.critic_loss_coeff = 1.0
+        self.target_update_freq = 100
+        self.train_batch_size = 256
+        self.updates_per_iteration = 64
+
+    def learner_class(self):
+        return CRRLearner
+
+
+class CRRLearner(Learner):
+    def __init__(self, module_spec, config=None, mesh=None):
+        super().__init__(module_spec, config, mesh)
+        self.target_params = jax.tree_util.tree_map(
+            jnp.copy, self.params)
+
+    def compute_loss(self, params, batch, rng):
+        cfg = self.config
+        out = self.module.forward_train(
+            params, {"obs": batch[Columns.OBS]}, rng)
+        logits, q = out["action_logits"], out["q_values"]
+        actions = batch[Columns.ACTIONS].astype(jnp.int32)
+        q_taken = jnp.take_along_axis(
+            q, actions[..., None], axis=-1)[..., 0]
+
+        # Critic: expected SARSA against the target net, expectation
+        # under the current policy at s' (kept in-distribution).
+        next_logits = self.module._logits(params, batch[Columns.NEXT_OBS])
+        next_pi = jax.nn.softmax(
+            jax.lax.stop_gradient(next_logits), axis=-1)
+        q_next = self.module.q_values(
+            batch["target_params"], batch[Columns.NEXT_OBS])
+        v_next = jnp.sum(next_pi * q_next, axis=-1)
+        not_done = 1.0 - batch[Columns.TERMINATEDS].astype(jnp.float32)
+        targets = batch[Columns.REWARDS] + cfg.gamma * not_done * v_next
+        critic_loss = jnp.mean(jnp.square(
+            q_taken - jax.lax.stop_gradient(targets)))
+
+        # Policy: advantage-filtered behavior cloning.
+        pi = jax.nn.softmax(logits, axis=-1)
+        v = jnp.sum(jax.lax.stop_gradient(pi) * q, axis=-1)
+        adv = jax.lax.stop_gradient(q_taken - v)
+        if cfg.weight_type == "exp":
+            weights = jnp.minimum(
+                jnp.exp(adv / cfg.temperature), cfg.max_weight)
+        else:
+            weights = (adv > 0).astype(jnp.float32)
+        logp = categorical_logp(logits, actions)
+        policy_loss = -jnp.mean(weights * logp)
+
+        total = policy_loss + cfg.critic_loss_coeff * critic_loss
+        return total, {"policy_loss": policy_loss,
+                       "critic_loss": critic_loss,
+                       "mean_advantage_weight": jnp.mean(weights),
+                       "q_mean": jnp.mean(q_taken)}
+
+    def update_from_batch(self, batch: SampleBatch,
+                          sync_metrics: bool = True) -> dict:
+        batch = SampleBatch(batch)
+        batch["target_params"] = self.target_params
+        metrics = super().update_from_batch(batch,
+                                            sync_metrics=sync_metrics)
+        if self._steps % getattr(self.config, "target_update_freq",
+                                 100) == 0:
+            self.target_params = jax.tree_util.tree_map(
+                jnp.copy, self.params)
+        return metrics
+
+
+def _rows_to_transitions(rows: list[dict]) -> SampleBatch:
+    """Offline rows -> (s, a, r, s', done); rows missing next_obs are
+    reconstructed from episode order (next row's obs), dropping each
+    episode's final row when it terminated without a successor."""
+    have_next = all(("next_obs" in r or "new_obs" in r) for r in rows)
+    obs, actions, rewards, next_obs, dones = [], [], [], [], []
+    for i, r in enumerate(rows):
+        done = bool(r.get("terminateds", False)
+                    or r.get("truncateds", False))
+        if have_next:
+            nxt = r.get("next_obs", r.get("new_obs"))
+        elif not done and i + 1 < len(rows):
+            nxt = rows[i + 1]["obs"]
+        elif r.get("terminateds", False):
+            nxt = r["obs"]  # terminal: masked out by the done flag
+        else:
+            # Truncated (or trailing) without a successor: the target
+            # would need v(s_true_next), which the log doesn't have.
+            continue
+        obs.append(r["obs"])
+        actions.append(r["actions"])
+        rewards.append(float(r.get("rewards", 0.0)))
+        next_obs.append(nxt)
+        dones.append(bool(r.get("terminateds", False)))
+    return SampleBatch({
+        Columns.OBS: np.asarray(obs, dtype=np.float32),
+        Columns.ACTIONS: np.asarray(actions),
+        Columns.REWARDS: np.asarray(rewards, dtype=np.float32),
+        Columns.NEXT_OBS: np.asarray(next_obs, dtype=np.float32),
+        Columns.TERMINATEDS: np.asarray(dones),
+    })
+
+
+class CRR(MARWIL):
+    """Reuses MARWIL's offline loop/eval scaffolding with the
+    critic-regularized update and transition-format batches."""
+
+    config_class = CRRConfig
+
+    def setup(self, config: dict) -> None:
+        Algorithm.setup(self, config)
+        cfg = self.algo_config
+        self._train_batch = _rows_to_transitions(
+            load_offline_rows(cfg.input_))
+        if len(self._train_batch) == 0:
+            raise ValueError("CRR: offline input produced no transitions")
+        self._rng = np.random.default_rng(cfg.seed)
+        self._learner_steps = 0
+
+
+CRRConfig.algo_class = CRR

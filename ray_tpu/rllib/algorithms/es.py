@@ -133,18 +133,40 @@ class ES(Algorithm):
         self.env_runner_group = None
         self.local_env_runner = None
 
-    def training_step(self) -> dict:
+    def _fanout_population(self, pairs: int) -> list:
+        """Evaluate `pairs` antithetic perturbation pairs as remote
+        tasks; returns [(seed, R+, R-, steps), ...]. Shared by ES and
+        ARS (ars.py) so the fan-out/timeout mechanics live once."""
         cfg = self.algo_config
-        pairs = max(1, cfg.population_size // 2)
         seeds = [int(s) for s in
                  self._rng.integers(0, 2 ** 31 - 1, size=pairs)]
         theta_ref = ray_tpu.put(self._theta)
         refs = [self._eval_task.remote(self.module_spec, theta_ref, seed,
-                                 cfg.sigma, cfg.env,
-                                 cfg.episodes_per_perturbation,
-                                 cfg.max_episode_steps)
+                                       cfg.sigma, cfg.env,
+                                       cfg.episodes_per_perturbation,
+                                       cfg.max_episode_steps)
                 for seed in seeds]
-        results = ray_tpu.get(refs, timeout=600)
+        return ray_tpu.get(refs, timeout=600)
+
+    def _eval_mean_policy(self, results: list) -> float:
+        """Greedy eval of the unperturbed mean policy; also folds the
+        population's real env-step counts into the lifetime total."""
+        cfg = self.algo_config
+        from ray_tpu.rllib.env.vector_env import make_vector_env
+
+        eval_return, eval_steps = _rollout_return(
+            self._policy_step, self._unravel(self._theta),
+            make_vector_env(cfg.env, cfg.report_eval_episodes),
+            cfg.max_episode_steps)
+        # Real env steps from the evaluations, not the worst-case cap.
+        self._timesteps_total += (
+            sum(n for _, _, _, n in results) + eval_steps)
+        return eval_return
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        pairs = max(1, cfg.population_size // 2)
+        results = self._fanout_population(pairs)
 
         rewards = np.array([[rp, rm] for _, rp, rm, _ in results])
         ranks = _centered_ranks(rewards.reshape(-1)).reshape(rewards.shape)
@@ -156,16 +178,7 @@ class ES(Algorithm):
         grad /= 2 * pairs * cfg.sigma
         self._theta = self._theta + cfg.lr * grad
 
-        # Greedy eval of the (unperturbed) mean policy for reporting.
-        from ray_tpu.rllib.env.vector_env import make_vector_env
-
-        eval_return, eval_steps = _rollout_return(
-            self._policy_step, self._unravel(self._theta),
-            make_vector_env(cfg.env, cfg.report_eval_episodes),
-            cfg.max_episode_steps)
-        # Real env steps from the evaluations, not the worst-case cap.
-        self._timesteps_total += (
-            sum(n for _, _, _, n in results) + eval_steps)
+        eval_return = self._eval_mean_policy(results)
         return {
             "episode_return_mean": eval_return,
             "population_reward_mean": float(rewards.mean()),

@@ -12,6 +12,7 @@ uses GAE(lambda) advantages with a single full-batch update per round.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -30,17 +31,23 @@ class PGConfig(AlgorithmConfig):
         super().__init__()
         self.lr = 4e-3
         self.entropy_coeff = 0.0
-        # REINFORCE uses full Monte-Carlo returns: GAE with lambda=1
-        # degenerates to discounted returns-to-go minus the baseline;
-        # adding V back recovers the raw return target.
+        # REINFORCE-with-baseline: GAE with lambda=1 gives discounted
+        # returns-to-go minus V(s); fragments shorter than an episode
+        # bootstrap through V at the cut, so the baseline MUST train
+        # (vf_loss below) or the bootstrap is frozen random noise.
         self.lambda_ = 1.0
+        self.vf_loss_coeff = 0.5
 
     def learner_class(self):
         return PGLearner
 
 
 class PGLearner(Learner):
-    """-logp * return loss (reference: pg/torch/pg_torch_policy.py)."""
+    """-logp * return loss (reference: pg/torch/pg_torch_policy.py)
+    plus a trained value baseline: the reference assumes complete
+    episodes per batch; with fixed-length fragments the return-to-go
+    bootstraps from V at fragment ends, so V is fit to the value
+    targets to keep that bootstrap meaningful."""
 
     def compute_loss(self, params, batch, rng):
         cfg = self.config
@@ -51,9 +58,12 @@ class PGLearner(Learner):
         # normalized advantage is still a valid (variance-reduced)
         # return signal, so use it directly.
         pg_loss = -jnp.mean(logp * batch[Columns.ADVANTAGES])
+        vf_loss = jnp.mean(jnp.square(
+            out["vf_preds"] - batch[Columns.VALUE_TARGETS]))
         entropy = categorical_entropy(logits)
-        total = pg_loss - cfg.entropy_coeff * jnp.mean(entropy)
-        return total, {"policy_loss": pg_loss,
+        total = (pg_loss + cfg.vf_loss_coeff * vf_loss
+                 - cfg.entropy_coeff * jnp.mean(entropy))
+        return total, {"policy_loss": pg_loss, "vf_loss": vf_loss,
                        "entropy": jnp.mean(entropy)}
 
 
@@ -85,9 +95,10 @@ class A2CConfig(AlgorithmConfig):
         self.lambda_ = 1.0
         self.vf_loss_coeff = 0.5
         self.entropy_coeff = 0.01
-        # A2C applies one synchronous update per sampling round
-        # (reference: a2c.py training_step), optionally split into
-        # microbatches accumulated before the apply.
+        # A2C applies ONE synchronous optimizer step per sampling round
+        # (reference: a2c.py training_step); microbatch_size splits the
+        # forward/backward into chunks whose gradients are accumulated
+        # before the single apply (memory cap, same dynamics).
         self.microbatch_size = None
 
     def learner_class(self):
@@ -124,17 +135,41 @@ class A2C(Algorithm):
             [postprocess_fragment(f, cfg.gamma, cfg.lambda_)
              for f in fragments])
 
-        mb = cfg.microbatch_size or len(train_batch)
-        rng = np.random.default_rng(cfg.seed + self.iteration)
+        mb = cfg.microbatch_size
         metrics: dict = {}
-        for minibatch in train_batch.minibatches(
-                min(mb, len(train_batch)), rng):
-            metrics = self.learner_group.update_from_batch(minibatch)
+        # Multi-learner groups already split the batch across actors
+        # (a per-actor accumulate would drift learner 0); local-learner
+        # accumulation is the memory-capped path.
+        if mb is None or mb >= len(train_batch) or cfg.num_learners > 0:
+            metrics = self.learner_group.update_from_batch(train_batch)
+            trained = len(train_batch)
+        else:
+            # Gradient accumulation: N forward/backward chunks, ONE
+            # optimizer apply — identical dynamics to the full-batch
+            # step at a fraction of the activation memory.
+            rng = np.random.default_rng(cfg.seed + self.iteration)
+            grads_sum = None
+            metrics_list = []
+            trained = 0
+            for minibatch in train_batch.minibatches(mb, rng):
+                g, m = self.learner_group.call(
+                    "compute_gradients", minibatch)
+                metrics_list.append(m)
+                trained += len(minibatch)
+                grads_sum = g if grads_sum is None else (
+                    jax.tree_util.tree_map(jnp.add, grads_sum, g))
+            n = len(metrics_list)
+            self.learner_group.call(
+                "apply_gradients",
+                jax.tree_util.tree_map(lambda x: x / n, grads_sum))
+            metrics = {k: float(np.mean([float(m[k])
+                                         for m in metrics_list]))
+                       for k in metrics_list[0]}
         self._sync_weights()
 
         results = self._runner_metrics()
         results.update(metrics)
-        results["num_env_steps_trained"] = len(train_batch)
+        results["num_env_steps_trained"] = trained
         return results
 
 

@@ -8,7 +8,9 @@ import pytest
 import ray_tpu
 from ray_tpu.rllib import (
     A2CConfig,
+    ApexDQNConfig,
     ARSConfig,
+    CRRConfig,
     PGConfig,
     SimpleQConfig,
 )
@@ -108,6 +110,99 @@ def test_ars_top_direction_selection_biases_update():
     algo.train()
     delta = algo._theta - theta_before
     assert np.abs(delta).max() > 0
+    algo.cleanup()
+
+
+def test_apex_dqn_distributed_replay(ray_start_regular):
+    """APEX: transitions flow through replay-shard actors, priorities
+    get non-uniform after TD updates, and the epsilon ladder gives
+    runner 0 more exploration than runner N-1."""
+    config = (ApexDQNConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=2, num_envs_per_env_runner=8,
+                           rollout_fragment_length=64)
+              .training(lr=1e-3, train_batch_size=64,
+                        num_steps_sampled_before_learning=400,
+                        updates_per_iteration=8)
+              .debugging(seed=0))
+    config.num_replay_shards = 2
+    algo = config.build()
+
+    # Epsilon ladder: runner 0 explores at base, runner 1 decays deeper.
+    eps = [config.epsilon_base ** (
+        1.0 + i * config.epsilon_ladder_alpha / 1) for i in range(2)]
+    assert eps[0] > eps[1]
+
+    last = {}
+    for _ in range(6):
+        last = algo.train()
+    sizes = last["replay_shard_sizes"]
+    assert len(sizes) == 2 and sum(sizes) > 0, sizes
+    assert last["num_learner_steps"] > 0
+    assert last["num_transitions_added"] > 0
+
+    # Round-robin insertion keeps shards balanced within one fragment.
+    assert min(sizes) > 0
+    algo.cleanup()
+
+
+def _mixed_cartpole_rows(n_steps: int = 4000, seed: int = 0):
+    """Half-expert half-random logged transitions WITH next_obs; plain
+    BC imitates the mixture, CRR's critic should filter toward the
+    expert actions."""
+    from ray_tpu.rllib import CartPoleVectorEnv
+
+    env = CartPoleVectorEnv(num_envs=1)
+    rng = np.random.default_rng(seed)
+    rows = []
+    obs = env.reset(seed=seed)
+    for t in range(n_steps):
+        expert = int(obs[0, 2] + 0.5 * obs[0, 3] > 0)
+        action = expert if rng.random() < 0.5 else int(rng.integers(2))
+        next_obs, rew, term, trunc = env.step(np.array([action]))
+        rows.append({
+            "obs": obs[0].tolist(), "actions": action,
+            "rewards": float(rew[0]),
+            "next_obs": next_obs[0].tolist(),
+            "terminateds": bool(term[0]), "truncateds": bool(trunc[0]),
+        })
+        obs = next_obs
+    return rows
+
+
+def test_crr_filters_mixed_offline_data(ray_start_regular):
+    rows = _mixed_cartpole_rows()
+    config = (CRRConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=0, num_envs_per_env_runner=8,
+                           explore=False)
+              .training(train_batch_size=256, updates_per_iteration=150,
+                        lr=1e-3)
+              .debugging(seed=0))
+    config.offline_data(rows).evaluation(evaluation_num_episodes=8)
+    algo = config.build()
+    last_eval = None
+    for _ in range(6):
+        result = algo.train()
+        last_eval = result.get("evaluation_return_mean", last_eval)
+        assert "critic_loss" in result
+    algo.cleanup()
+    # The 50/50 behavior policy scores ~40-60 on CartPole; the
+    # advantage filter must recover something clearly better.
+    assert last_eval is not None and last_eval > 80, last_eval
+
+
+def test_crr_exp_weights_bounded():
+    """exp-weighted CRR clips the advantage weight at max_weight."""
+    rows = _mixed_cartpole_rows(600)
+    config = (CRRConfig().environment("CartPole-v1")
+              .training(train_batch_size=64, updates_per_iteration=5,
+                        weight_type="exp", temperature=0.5,
+                        max_weight=5.0))
+    config.offline_data(rows)
+    algo = config.build()
+    result = algo.train()
+    assert result["mean_advantage_weight"] <= 5.0
     algo.cleanup()
 
 
