@@ -9,15 +9,19 @@ from ray_tpu.data.dataset import Dataset
 from ray_tpu.data.grouped import GroupedData
 from ray_tpu.data.read_api import (
     from_arrow,
+    from_huggingface,
     from_items,
     from_numpy,
     from_pandas,
+    from_torch,
     range,  # noqa: A004 — mirrors ray.data.range
     read_binary_files,
     read_csv,
+    read_images,
     read_json,
     read_numpy,
     read_parquet,
+    read_sql,
     read_text,
 )
 
@@ -27,14 +31,18 @@ __all__ = [
     "Dataset",
     "GroupedData",
     "from_arrow",
+    "from_huggingface",
     "from_items",
     "from_numpy",
     "from_pandas",
+    "from_torch",
     "range",
     "read_binary_files",
     "read_csv",
+    "read_images",
     "read_json",
     "read_numpy",
     "read_parquet",
+    "read_sql",
     "read_text",
 ]

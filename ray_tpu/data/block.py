@@ -159,8 +159,23 @@ class BlockAccessor:
                          f"expected one of {BATCH_FORMATS}")
 
     def iter_rows(self) -> Iterator[dict]:
-        for batch in self._block.to_batches():
-            yield from batch.to_pylist()
+        # Tensor columns must come back as shaped ndarrays, not the
+        # flattened python lists to_pylist() would give.
+        schema = self._block.schema
+        tensor_cols = [f.name for f in schema
+                       if f.metadata and TENSOR_SHAPE_META in f.metadata]
+        if not tensor_cols:
+            for batch in self._block.to_batches():
+                yield from batch.to_pylist()
+            return
+        arrays = {name: _column_to_numpy(self._block.column(name),
+                                         schema.field(name))
+                  for name in tensor_cols}
+        plain = self._block.drop_columns(tensor_cols)
+        for i, row in enumerate(plain.to_pylist()):
+            for name in tensor_cols:
+                row[name] = arrays[name][i]
+            yield row
 
     def take_rows(self, indices: np.ndarray) -> Block:
         return self._block.take(pa.array(indices))

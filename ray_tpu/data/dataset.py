@@ -467,6 +467,20 @@ class Dataset:
         for i, ref in enumerate(self._block_ref_iter()):
             pacsv.write_csv(ray_tpu.get(ref), f"{path}/part-{i:05d}.csv")
 
+    def write_numpy(self, path: str, *, column: str) -> None:
+        """One .npy file per block from ``column`` (reference:
+        dataset.write_numpy)."""
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        for i, ref in enumerate(self._block_ref_iter()):
+            batch = BlockAccessor(ray_tpu.get(ref)).to_numpy()
+            if column not in batch:
+                raise KeyError(
+                    f"write_numpy: column {column!r} not in "
+                    f"{sorted(batch)}")
+            np.save(f"{path}/part-{i:05d}.npy", batch[column])
+
     def write_json(self, path: str) -> None:
         import json
         import os

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import glob as glob_mod
 import os
+from builtins import range as builtins_range
 from typing import Any, Callable, Iterable
 
 import numpy as np
@@ -152,3 +153,100 @@ def read_text(paths: str | list[str]):
             return pa.table({"text": [ln.rstrip("\n") for ln in fh]})
 
     return _file_reader(paths, None, parse, "read_text")
+
+
+def read_images(paths: str | list[str], *, size: tuple | None = None,
+                mode: str | None = None, include_paths: bool = False):
+    """Image files -> {"image": HxWxC uint8 array} rows (reference:
+    datasource/image_datasource.py). ``size`` resizes, ``mode``
+    converts (e.g. "RGB", "L"); one file per block so decode runs
+    inside the parallel read tasks, not on the driver."""
+    def parse(f: str) -> pa.Table:
+        from PIL import Image
+
+        img = Image.open(f)
+        if mode is not None:
+            img = img.convert(mode)
+        if size is not None:
+            img = img.resize((size[1], size[0]))
+        arr = np.asarray(img)
+        cols = {"image": [arr]}
+        if include_paths:
+            cols["path"] = [f]
+        return BlockAccessor.rows_to_block(
+            [{k: v[0] for k, v in cols.items()}])
+
+    return _file_reader(
+        paths, None, parse, "read_images")
+
+
+def read_sql(sql: str, connection_factory: Callable[[], Any], *,
+             shard_keys: list | None = None, shard_column: str | None = None):
+    """DBAPI-2 query -> Dataset (reference: read_api.read_sql /
+    datasource/sql_datasource.py).
+
+    ``connection_factory`` is a zero-arg callable returning a fresh
+    DBAPI connection — it ships to the read tasks, so it must be
+    picklable (import inside, e.g. ``lambda: sqlite3.connect(path)``).
+    With ``shard_keys`` + ``shard_column``, one read task runs per key
+    with ``WHERE shard_column = ?``; otherwise a single task runs the
+    query as-is."""
+    def run_query(query: str, params: tuple = ()) -> pa.Table:
+        conn = connection_factory()
+        try:
+            cur = conn.cursor()
+            cur.execute(query, params)
+            names = [d[0] for d in cur.description]
+            rows = cur.fetchall()
+        finally:
+            conn.close()
+        return BlockAccessor.rows_to_block(
+            [dict(zip(names, r)) for r in rows]) if rows else pa.table(
+                {n: [] for n in names})
+
+    if shard_keys and shard_column:
+        sharded = f"{sql} WHERE {shard_column} = ?"
+        tasks = [ReadTask((lambda k=k: run_query(sharded, (k,))),
+                          {"shard": k}) for k in shard_keys]
+    else:
+        tasks = [ReadTask(lambda: run_query(sql))]
+    return _dataset(InputData(read_tasks=tasks), "read_sql")
+
+
+def from_torch(dataset) -> Any:
+    """torch.utils.data.Dataset -> Dataset of {"item": ...} rows
+    (reference: read_api.from_torch).
+
+    Map-style datasets (``__len__`` + ``__getitem__``) are indexed
+    explicitly — plain ``for item in dataset`` would fall into the
+    legacy iteration protocol, which ignores ``__len__`` and loops
+    forever on datasets whose ``__getitem__`` never raises IndexError.
+    Iterable-style datasets are consumed with ``iter()``.
+    """
+    def read() -> pa.Table:
+        if hasattr(dataset, "__len__") and hasattr(dataset, "__getitem__"):
+            items = (dataset[i] for i in builtins_range(len(dataset)))
+        else:
+            items = iter(dataset)
+        rows = [item if isinstance(item, dict) else {"item": item}
+                for item in items]
+        return BlockAccessor.rows_to_block(rows)
+
+    return _dataset(InputData(read_tasks=[ReadTask(read)]), "from_torch")
+
+
+def from_huggingface(dataset) -> Any:
+    """datasets.Dataset -> Dataset (reference:
+    read_api.from_huggingface; zero-copy via the underlying Arrow
+    table, one block per record batch)."""
+    table = dataset.data.table if hasattr(dataset, "data") else None
+    if table is None:
+        raise ValueError(
+            "from_huggingface expects a datasets.Dataset (a "
+            "DatasetDict must be indexed by split first)")
+    batches = table.combine_chunks().to_batches(max_chunksize=64_000)
+    tasks = [ReadTask((lambda b=b: pa.Table.from_batches([b])),
+                      {"num_rows": b.num_rows}) for b in batches]
+    if not tasks:
+        tasks = [ReadTask(lambda: table.schema.empty_table())]
+    return _dataset(InputData(read_tasks=tasks), "from_huggingface")

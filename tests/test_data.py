@@ -510,3 +510,92 @@ def test_optimized_pipeline_results_unchanged(ray_start_regular):
     stats = ds.stats()
     assert "optimizer:" in stats, stats
     assert "LimitPushdown" in stats
+
+
+# ------------------------------------------------------- new connectors
+def test_read_sql_sharded_and_plain(ray_start_regular, tmp_path):
+    import sqlite3
+
+    import ray_tpu.data as rd
+
+    db = str(tmp_path / "t.db")
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE pets (name TEXT, kind TEXT, age INT)")
+    conn.executemany(
+        "INSERT INTO pets VALUES (?, ?, ?)",
+        [("rex", "dog", 3), ("tom", "cat", 2), ("ada", "dog", 5),
+         ("kit", "cat", 1)])
+    conn.commit()
+    conn.close()
+
+    ds = rd.read_sql("SELECT name, age FROM pets",
+                     lambda: __import__("sqlite3").connect(db))
+    rows = sorted(ds.take_all(), key=lambda r: r["name"])
+    assert [r["name"] for r in rows] == ["ada", "kit", "rex", "tom"]
+
+    # Sharded: one read task per kind, executed in parallel tasks.
+    ds = rd.read_sql("SELECT name, age FROM pets",
+                     lambda: __import__("sqlite3").connect(db),
+                     shard_keys=["dog", "cat"], shard_column="kind")
+    assert ds.num_blocks() == 2
+    assert ds.count() == 4
+
+
+def test_read_images_resize_and_paths(ray_start_regular, tmp_path):
+    from PIL import Image
+
+    import ray_tpu.data as rd
+
+    for i, color in enumerate([(255, 0, 0), (0, 255, 0)]):
+        Image.new("RGB", (8, 6), color).save(tmp_path / f"img{i}.png")
+
+    ds = rd.read_images(str(tmp_path), size=(3, 4), mode="RGB",
+                        include_paths=True)
+    rows = sorted(ds.take_all(), key=lambda r: r["path"])
+    assert len(rows) == 2
+    assert np.asarray(rows[0]["image"]).shape == (3, 4, 3)
+    assert np.asarray(rows[0]["image"])[0, 0, 0] == 255  # red first
+
+
+def test_from_torch_dataset(ray_start_regular):
+    import torch.utils.data as tud
+
+    import ray_tpu.data as rd
+
+    class Squares(tud.Dataset):
+        def __len__(self):
+            return 5
+
+        def __getitem__(self, i):
+            return {"x": i, "sq": i * i}
+
+    ds = rd.from_torch(Squares())
+    assert [r["sq"] for r in ds.take_all()] == [0, 1, 4, 9, 16]
+
+
+def test_from_huggingface_roundtrip(ray_start_regular):
+    import datasets as hf
+
+    import ray_tpu.data as rd
+
+    hfds = hf.Dataset.from_dict({"a": list(range(10)),
+                                 "b": [str(i) for i in range(10)]})
+    ds = rd.from_huggingface(hfds)
+    assert ds.count() == 10
+    assert sorted(r["a"] for r in ds.take_all()) == list(range(10))
+
+
+def test_write_numpy_roundtrip(ray_start_regular, tmp_path):
+    import ray_tpu.data as rd
+
+    out = str(tmp_path / "npy")
+    rd.range(20).map(lambda r: {"v": float(r["id"])}).write_numpy(
+        out, column="v")
+    import glob
+
+    parts = sorted(glob.glob(out + "/part-*.npy"))
+    vals = np.concatenate([np.load(p) for p in parts])
+    assert sorted(vals.tolist()) == [float(i) for i in range(20)]
+
+    with pytest.raises(KeyError):
+        rd.range(3).write_numpy(out, column="missing")
