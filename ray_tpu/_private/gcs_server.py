@@ -207,6 +207,10 @@ class GcsServer:
         self.gcs.pubsub.subscribe(
             "nodes", lambda event: self.pubsub.publish(
                 "nodes", (event[0], event[1].hex())))
+        # Last availability published per node (change detection for
+        # the "node_resources" syncer channel).
+        self._last_published_avail: dict[str, dict] = {}
+        self._avail_lock = threading.Lock()
         self._register_methods()
         self._monitor = threading.Thread(
             target=self._monitor_loop, daemon=True, name="gcs-monitor")
@@ -265,7 +269,24 @@ class GcsServer:
     def _heartbeat(self, node_id_bytes: bytes,
                    available: dict | None = None) -> bool:
         # False tells the agent it is unknown/dead and must re-register.
-        return self.gcs.heartbeat(NodeID(node_id_bytes), available)
+        accepted = self.gcs.heartbeat(NodeID(node_id_bytes), available)
+        if accepted and available is not None:
+            # Syncer push: availability CHANGES fan out on the
+            # "node_resources" channel so drivers' schedulers track
+            # other tenants' load without polling (reference: the
+            # ray_syncer resource-view stream, ray_syncer.h:88).
+            # Steady-state heartbeats with unchanged availability
+            # publish nothing.
+            hex_id = node_id_bytes.hex()
+            with self._avail_lock:
+                last = self._last_published_avail.get(hex_id)
+                changed = last != available
+                if changed:
+                    self._last_published_avail[hex_id] = dict(available)
+            if changed:
+                self.pubsub.publish(
+                    "node_resources", (hex_id, dict(available)))
+        return accepted
 
     def _list_nodes(self) -> list[dict]:
         return [{
@@ -331,10 +352,18 @@ class GcsServer:
         dirty."""
         while not self._shutdown.wait(1.0):
             now = time.monotonic()
+            alive_ids = set()
             for record in self.gcs.list_nodes():
                 if record.alive and (now - record.last_heartbeat
                                      > self.heartbeat_timeout_s):
                     self.gcs.mark_node_dead(record.node_id)
+                elif record.alive:
+                    alive_ids.add(record.node_id.hex())
+            # Dead/churned nodes must not leak change-detection state.
+            with self._avail_lock:
+                for hex_id in list(self._last_published_avail):
+                    if hex_id not in alive_ids:
+                        self._last_published_avail.pop(hex_id, None)
             self._prune_object_locations()
             self.pubsub.prune()
             if self._persist_path:

@@ -37,17 +37,24 @@ def _own_address() -> str:
 class NodeAgent:
     """Registers this node with the head GCS and heartbeats.
 
-    Reference: the raylet's NodeManager registration +
-    ReportHeartbeat loop."""
+    Reference: the raylet's NodeManager registration + ReportHeartbeat
+    loop, plus the ray_syncer's push-on-change semantics
+    (ray_syncer.h:88): ``poke()`` wakes the loop immediately when the
+    executor's load changes, so the head's resource view is event-fresh
+    instead of lagging up to a full heartbeat period."""
 
     def __init__(self, gcs_address: str, resources: dict,
                  labels: dict | None = None,
                  heartbeat_period_s: float = 1.0,
-                 usage_fn=None, executor_address: str = ""):
+                 usage_fn=None, executor_address: str = "",
+                 coalesce_s: float = 0.05):
         self.client = RpcClient(gcs_address)
         self.resources = dict(resources)
         self.labels = dict(labels or {})
         self.heartbeat_period_s = heartbeat_period_s
+        # Floor between consecutive pushes: a burst of admissions
+        # coalesces into one update instead of an RPC per task.
+        self.coalesce_s = coalesce_s
         # Optional live-usage callable: () -> {resource: available}
         # piggybacked on heartbeats (ray_syncer-lite).
         self.usage_fn = usage_fn
@@ -55,6 +62,7 @@ class NodeAgent:
         self._address = f"{_own_address()}:{os.getpid()}"
         self.node_id: bytes = self._register()
         self._shutdown = threading.Event()
+        self._poke = threading.Event()
         self._thread = threading.Thread(
             target=self._heartbeat_loop, daemon=True, name="node-heartbeat")
         self._thread.start()
@@ -64,8 +72,18 @@ class NodeAgent:
             "register_node", self._address, self.resources, self.labels,
             self.executor_address)
 
+    def poke(self) -> None:
+        """Load changed: push a heartbeat now (coalesced)."""
+        self._poke.set()
+
     def _heartbeat_loop(self) -> None:
-        while not self._shutdown.wait(self.heartbeat_period_s):
+        while not self._shutdown.is_set():
+            # Wake early on poke; always wake by the heartbeat period
+            # (liveness at the head depends on the periodic floor).
+            self._poke.wait(self.heartbeat_period_s)
+            self._poke.clear()
+            if self._shutdown.is_set():
+                return
             available = None
             if self.usage_fn is not None:
                 try:
@@ -82,6 +100,9 @@ class NodeAgent:
                     self.node_id = self._register()
             except RpcError:
                 pass  # head unreachable; keep trying (it may restart)
+            # Coalescing floor: pokes landing during the sleep fold
+            # into the next push.
+            self._shutdown.wait(self.coalesce_s)
 
     def stop(self, drain: bool = True) -> None:
         self._shutdown.set()
@@ -166,6 +187,7 @@ def run_head(port: int, resources: dict | None = None,
                       labels={"node_role": "head"},
                       usage_fn=head_usage,
                       executor_address=executor.address_for(_own_address()))
+    executor.set_load_listener(agent.poke)
 
     # Written LAST: `start` blocks on this file, so by the time the CLI
     # returns, the head's own node (executor included) is registered
@@ -201,7 +223,8 @@ def run_head(port: int, resources: dict | None = None,
 
 def run_worker(gcs_address: str, resources: dict | None = None,
                pool_size: int | None = None,
-               labels: dict | None = None) -> None:
+               labels: dict | None = None,
+               heartbeat_period_s: float = 1.0) -> None:
     """Worker-node daemon: executor service + register + heartbeat.
     Blocks. (Reference: the raylet — lease-based dispatch onto this
     node's worker pool, node_manager.cc:1714.) ``labels`` merge into
@@ -216,8 +239,10 @@ def run_worker(gcs_address: str, resources: dict | None = None,
         pool_size=pool_size, resources=resources).start()
     agent = NodeAgent(gcs_address, resources,
                       labels={"node_role": "worker", **(labels or {})},
+                      heartbeat_period_s=heartbeat_period_s,
                       usage_fn=executor.available_resources,
                       executor_address=executor.address_for(_own_address()))
+    executor.set_load_listener(agent.poke)
     stop_event = threading.Event()
 
     def on_term(signum, frame):

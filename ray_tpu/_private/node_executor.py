@@ -510,6 +510,11 @@ class NodeExecutorService:
         # pool workers with each task so by-reference pickles resolve.
         self._driver_sys_path: list[str] = []
         self.tasks_executed = 0
+        # Fired (outside the ledger lock) whenever admission state
+        # changes; the NodeAgent hooks this to push a syncer update
+        # instead of waiting out the heartbeat period (reference: the
+        # ray_syncer streams deltas on change, ray_syncer.h:88).
+        self._load_listener: Callable[[], None] | None = None
         # Actor plane: actor key (bytes) -> _DaemonActor.
         self._actors: dict[bytes, _DaemonActor] = {}
         self._actors_lock = threading.Lock()
@@ -722,6 +727,7 @@ class NodeExecutorService:
             with self._running_lock:
                 self._running.pop(token, None)
                 self._blocked_cpu.pop(token, None)
+            self._notify_load()
         self.tasks_executed += 1
 
         out = []
@@ -738,6 +744,17 @@ class NodeExecutorService:
                 out.append(("stored", len(blob)))
         return ("ok", out)
 
+    def set_load_listener(self, listener: Callable[[], None]) -> None:
+        self._load_listener = listener
+
+    def _notify_load(self) -> None:
+        listener = self._load_listener
+        if listener is not None:
+            try:
+                listener()
+            except Exception:  # noqa: BLE001 — sync is best-effort
+                pass
+
     def _try_reserve(self, token: str, demand: dict) -> bool:
         """Admission: reserve ``demand`` under ``token`` atomically with
         the capacity check (two concurrent calls must not both pass a
@@ -750,7 +767,8 @@ class NodeExecutorService:
                 if used + float(demand.get(key, 0.0)) > float(cap) + 1e-9:
                     return False
             self._running[token] = demand
-            return True
+        self._notify_load()
+        return True
 
     def fetch_object(self, id_bytes: bytes, offset: int,
                      length: int):
@@ -808,6 +826,7 @@ class NodeExecutorService:
             reduced = dict(demand)
             reduced["CPU"] = 0.0
             self._running[token] = reduced
+        self._notify_load()
         return True
 
     def task_unblock(self, token: str) -> bool:
@@ -821,6 +840,7 @@ class NodeExecutorService:
             restored = dict(demand)
             restored["CPU"] = restored.get("CPU", 0.0) + cpu
             self._running[token] = restored
+        self._notify_load()
         return True
 
     # --------------------------------------------------------- actor plane
@@ -870,10 +890,12 @@ class NodeExecutorService:
         except _ActorNewError as exc:
             with self._running_lock:
                 self._running.pop(token, None)
+            self._notify_load()
             return ("err", exc.blob)
         except BaseException as exc:  # noqa: BLE001 — shipped to driver
             with self._running_lock:
                 self._running.pop(token, None)
+            self._notify_load()
             return ("err", _exc_blob(exc))
         actor.owner = client_addr  # owner-death sweep kills orphans
         with self._actors_lock:
@@ -933,6 +955,7 @@ class NodeExecutorService:
             actor = self._actors.pop(actor_key, None)
         with self._running_lock:
             self._running.pop("actor-" + actor_key.hex(), None)
+        self._notify_load()
         if actor is None:
             return False
         actor.kill()

@@ -35,19 +35,51 @@ from ray_tpu._private.task import TaskSpec
 
 _DISPATCH_ORDER = _Counter()
 
+# How long a node's self-reported availability stays authoritative.
+# Push deltas only fire on change, so a lost delta would otherwise pin
+# a stale low-water mark forever; past the TTL admission falls back to
+# the driver's own lease ledger (the pre-syncer behavior, where busy
+# remote nodes are discovered via spillback rejections). Must exceed
+# the driver's 10s list_nodes safety-net resync (which refreshes
+# reported_at from the node table): in steady state — another tenant
+# holding a node at CONSTANT load publishes no deltas — the resync
+# re-arms the report before it expires, so multi-tenant admission
+# protection never lapses with a live head.
+REPORTED_AVAILABILITY_TTL_S = 12.0
+
 
 @dataclass
 class NodeState:
-    """One node's resource ledger."""
+    """One node's resource ledger.
+
+    ``available`` is this driver's lease ledger (debited/credited as it
+    dispatches). ``reported`` is the node's own last-pushed ground
+    truth (syncer channel) — it also reflects OTHER drivers' load.
+    Admission takes the min of both per key: the ledger is instantly
+    correct for our in-flight work, the report is authoritative for
+    everyone else's, and min() is conservative under the races between
+    them (reference: the raylet's local view vs the syncer'd global
+    view, cluster_resource_scheduler.h:44)."""
 
     node_id: NodeID
     total: dict[str, float]
     available: dict[str, float]
     labels: dict[str, str] = field(default_factory=dict)
     alive: bool = True
+    reported: dict[str, float] | None = None
+    reported_at: float = 0.0
+
+    def effective_available(self, key: str) -> float:
+        avail = self.available.get(key, 0.0)
+        if (self.reported is None
+                or time.monotonic() - self.reported_at
+                > REPORTED_AVAILABILITY_TTL_S):
+            return avail
+        return min(avail, self.reported.get(key, avail))
 
     def fits(self, demand: dict[str, float]) -> bool:
-        return all(self.available.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
+        return all(self.effective_available(k) + 1e-9 >= v
+                   for k, v in demand.items())
 
     def feasible(self, demand: dict[str, float]) -> bool:
         return all(self.total.get(k, 0.0) + 1e-9 >= v for k, v in demand.items())
@@ -188,6 +220,18 @@ class ClusterState:
             if node is not None:
                 node.release(demand)
             self._lock.notify_all()
+
+    def update_reported(self, node_id: NodeID,
+                        available: dict[str, float]) -> None:
+        """Syncer push: the node's own availability report arrived
+        (includes other drivers' load). Wakes the dispatcher — freed
+        remote capacity is a scheduling opportunity."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is not None:
+                node.reported = dict(available)
+                node.reported_at = time.monotonic()
+                self._lock.notify_all()
 
     def force_acquire(self, node_id: NodeID, demand: dict[str, float]) -> None:
         """Unconditional acquire (availability may go transiently

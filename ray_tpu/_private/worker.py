@@ -357,7 +357,7 @@ class Runtime:
         subscriber = None
         try:
             subscriber = GcsSubscriber(self.gcs_client.address,
-                                       ["nodes"])
+                                       ["nodes", "node_resources"])
         except Exception:  # noqa: BLE001 — pre-pubsub head: poll only
             subscriber = None
         last_sync = 0.0
@@ -385,15 +385,30 @@ class Runtime:
                     self._watcher_stop.wait(0.5)
                 if self._watcher_stop.is_set():
                     return
+                # Syncer pushes: per-node availability deltas update the
+                # scheduler's reported view directly — no list_nodes
+                # round trip (reference: ray_syncer resource stream).
+                membership_events = []
+                for channel, message in events:
+                    if channel == "node_resources":
+                        try:
+                            hex_id, available = message
+                            self.cluster.update_reported(
+                                NodeID(bytes.fromhex(hex_id)), available)
+                        except Exception:  # noqa: BLE001 — malformed push
+                            pass
+                    else:
+                        membership_events.append((channel, message))
                 try:
                     # Frees/location deltas flush every wake; the FULL
-                    # node-table resync only on a push event or the
-                    # periodic safety net (a pre-pubsub head keeps the
-                    # old per-wake cadence).
+                    # node-table resync only on a MEMBERSHIP push event
+                    # or the periodic safety net (a pre-pubsub head
+                    # keeps the old per-wake cadence); resource deltas
+                    # alone never trigger it.
                     self._flush_remote_frees()
                     self._flush_object_locations()
                     now = time.monotonic()
-                    if (events or subscriber is None
+                    if (membership_events or subscriber is None
                             or now - last_sync >= 10.0):
                         self._sync_remote_nodes(
                             self.gcs_client.call("list_nodes"))
@@ -438,8 +453,15 @@ class Runtime:
             if not info["alive"]:
                 continue
             with self._remote_nodes_lock:
-                if node_id in self._remote_nodes:
-                    continue
+                already = node_id in self._remote_nodes
+            if already:
+                # Safety net for the push channel: refresh the reported
+                # availability from the table (a missed pubsub delta
+                # must not wedge dispatch on a stale low-water mark).
+                if info.get("available"):
+                    self.cluster.update_reported(
+                        node_id, info["available"])
+                continue
             handle = RemoteNodeHandle(node_id, info["executor_address"])
             if not handle.ping():
                 handle.close()

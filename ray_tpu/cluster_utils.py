@@ -68,11 +68,15 @@ class Cluster:
     # -- membership ---------------------------------------------------
     def add_node(self, *, num_cpus: float = 2.0,
                  resources: dict | None = None,
-                 pool_size: int = 2, env: dict | None = None) -> NodeHandle:
+                 pool_size: int = 2, env: dict | None = None,
+                 heartbeat_period_s: float | None = None) -> NodeHandle:
         """Start a worker-node daemon (executor service + worker pool)
         as a real OS process (reference: cluster_utils.add_node)."""
         node_resources = {"CPU": float(num_cpus)}
         node_resources.update(resources or {})
+        extra_kwargs = {}
+        if heartbeat_period_s is not None:
+            extra_kwargs["heartbeat_period_s"] = heartbeat_period_s
         child_env = dict(os.environ)
         # The daemon must resolve THIS checkout's ray_tpu even when the
         # package isn't installed (tests run from the repo).
@@ -87,7 +91,7 @@ class Cluster:
             [sys.executable, "-m", "ray_tpu._private.node", "worker",
              json.dumps({"gcs_address": self.address,
                          "resources": node_resources,
-                         "pool_size": pool_size})],
+                         "pool_size": pool_size, **extra_kwargs})],
             env=child_env,
             stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
         handle = NodeHandle(proc=proc, resources=node_resources)

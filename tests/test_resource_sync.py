@@ -1,0 +1,132 @@
+"""Push-based resource syncing (ray_syncer equivalent).
+
+Reference intent: src/ray/common/ray_syncer/ — resource-view deltas
+stream to consumers when they CHANGE, instead of being discovered by
+polling. Here: daemon load changes poke an immediate heartbeat, the GCS
+publishes availability deltas on the "node_resources" channel, and the
+driver's scheduler keeps a per-node ``reported`` view consulted by
+admission (min with its own lease ledger, with a staleness TTL).
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.scheduler import (
+    ClusterState,
+    NodeState,
+    REPORTED_AVAILABILITY_TTL_S,
+)
+from ray_tpu.cluster_utils import Cluster
+
+
+# ------------------------------------------------------------- units
+def test_effective_available_uses_fresh_report_only():
+    node = NodeState(node_id=NodeID(), total={"CPU": 8.0},
+                     available={"CPU": 8.0})
+    # No report: ledger only.
+    assert node.fits({"CPU": 8.0})
+    # Fresh low report (another driver's load) blocks admission.
+    node.reported = {"CPU": 1.0}
+    node.reported_at = time.monotonic()
+    assert node.fits({"CPU": 1.0})
+    assert not node.fits({"CPU": 2.0})
+    # Stale report ages out: back to the ledger (spillback handles
+    # genuinely-busy nodes, as before the syncer).
+    node.reported_at = time.monotonic() - REPORTED_AVAILABILITY_TTL_S - 1
+    assert node.fits({"CPU": 8.0})
+
+
+def test_update_reported_wakes_waiters():
+    cluster = ClusterState()
+    node = NodeState(node_id=NodeID(), total={"CPU": 2.0},
+                     available={"CPU": 2.0})
+    cluster.add_node(node)
+    woke = []
+
+    import threading
+
+    def waiter():
+        cluster.wait_for_change(timeout=5.0)
+        woke.append(True)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)
+    cluster.update_reported(node.node_id, {"CPU": 1.0})
+    t.join(timeout=2.0)
+    assert woke, "update_reported must notify the dispatcher"
+    assert cluster.get_node(node.node_id).reported == {"CPU": 1.0}
+
+
+# ------------------------------------------------- cluster integration
+@pytest.fixture
+def slow_heartbeat_cluster():
+    """One daemon whose PERIODIC heartbeat is 20s away: any availability
+    update the driver sees inside the test window must have been pushed
+    (load-change poke -> immediate heartbeat -> pubsub delta)."""
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir="/tmp/ray_tpu_test_sync",
+                      heartbeat_timeout_s=90.0)
+    cluster.add_node(num_cpus=2, heartbeat_period_s=20.0)
+    try:
+        assert cluster.wait_for_nodes(1, timeout=30)
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if ray_tpu.cluster_resources().get("CPU", 0) >= 2:
+                break
+            time.sleep(0.2)
+        assert ray_tpu.cluster_resources().get("CPU", 0) >= 2
+        yield runtime
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def _remote_node_state(runtime):
+    for node in runtime.cluster.nodes():
+        if node.labels.get("remote"):
+            return node
+    return None
+
+
+def test_load_change_pushes_availability_to_driver(slow_heartbeat_cluster):
+    runtime = slow_heartbeat_cluster
+
+    @ray_tpu.remote(num_cpus=1)
+    def hold(seconds: float):
+        time.sleep(seconds)
+        return "done"
+
+    node = _remote_node_state(runtime)
+    assert node is not None
+
+    ref = hold.remote(6.0)
+    # The admission poke must reach the driver well before the 20s
+    # periodic heartbeat (or the 10s list_nodes safety net) could.
+    deadline = time.time() + 5.0
+    saw_busy = False
+    while time.time() < deadline:
+        reported = node.reported
+        if reported is not None and reported.get("CPU", 2.0) <= 1.0:
+            saw_busy = True
+            break
+        time.sleep(0.1)
+    assert saw_busy, (
+        f"busy push never arrived: reported={node.reported}")
+
+    assert ray_tpu.get(ref, timeout=30) == "done"
+    # Completion pushes the freed capacity the same way.
+    deadline = time.time() + 5.0
+    saw_free = False
+    while time.time() < deadline:
+        reported = node.reported
+        if reported is not None and reported.get("CPU", 0.0) >= 2.0:
+            saw_free = True
+            break
+        time.sleep(0.1)
+    assert saw_free, (
+        f"free push never arrived: reported={node.reported}")
