@@ -140,22 +140,14 @@ class ApexDQN(Algorithm):
         self.local_env_runner = None
         return FaultTolerantActorManager(actors, actor_factory=factory)
 
-    # -- sampling pump (IMPALA-style, bounded in-flight) --------------
+    # -- sampling pump (shared with IMPALA: actor_manager.pump) -------
     def _pump_sampling(self) -> None:
         group = self.env_runner_group
         if group is None:
-            frag = self.local_env_runner.sample()
-            self._ingest_fragment(frag)
+            self._ingest_fragment(self.local_env_runner.sample())
             return
-        while True:
-            sub = group.submit("sample")
-            if sub is None:
-                break
-            self._pending.append(sub)
-        ready, self._pending = group.fetch_ready(
-            self._pending, timeout=0.05)
-        for _, frag in ready:
-            self._ingest_fragment(frag)
+        self._pending = group.pump(
+            "sample", self._pending, self._ingest_fragment)
 
     def _ingest_fragment(self, frag: SampleBatch) -> None:
         T, B = np.shape(frag[Columns.OBS])[:2]
@@ -186,17 +178,27 @@ class ApexDQN(Algorithm):
         # while update i runs on the learner, hiding the shard-actor
         # round trip behind the jitted update. The producing shard
         # rides with each ref — priority corrections must go back to
-        # the shard the batch came from.
+        # the shard the batch came from. The LAST update consumes its
+        # batch without issuing a successor (an abandoned request would
+        # still cost the shard a full prioritized sampling pass).
+        max_attempts = 4 * max(1, cfg.updates_per_iteration)
         shard, next_ref = request(0)
         updates = 0
         attempts = 0
-        while updates < cfg.updates_per_iteration and attempts < 4 * max(
-                1, cfg.updates_per_iteration):
+        while True:
             attempts += 1
             batch = ray_tpu.get(next_ref)
             producer = shard
-            shard, next_ref = request(attempts)
+            # Another get happens iff the loop will run again; only
+            # then is a successor request worth its sampling cost.
+            more = (updates + (0 if batch is None else 1)
+                    < cfg.updates_per_iteration
+                    and attempts < max_attempts)
+            if more:
+                shard, next_ref = request(attempts)
             if batch is None:
+                if not more:
+                    break
                 # Shards still warming up: keep sampling instead.
                 self._pump_sampling()
                 continue
@@ -213,6 +215,8 @@ class ApexDQN(Algorithm):
             self._learner_steps += 1
             if self._learner_steps % cfg.broadcast_interval == 0:
                 self._sync_weights()
+            if not more:
+                break
 
         results = self._runner_metrics()
         results.update(metrics)

@@ -142,17 +142,32 @@ class CRRLearner(Learner):
                        "mean_advantage_weight": jnp.mean(weights),
                        "q_mean": jnp.mean(q_taken)}
 
+    def _maybe_refresh_target(self) -> None:
+        if self._steps % getattr(self.config, "target_update_freq",
+                                 100) == 0:
+            self.target_params = jax.tree_util.tree_map(
+                jnp.copy, self.params)
+
     def update_from_batch(self, batch: SampleBatch,
                           sync_metrics: bool = True) -> dict:
         batch = SampleBatch(batch)
         batch["target_params"] = self.target_params
         metrics = super().update_from_batch(batch,
                                             sync_metrics=sync_metrics)
-        if self._steps % getattr(self.config, "target_update_freq",
-                                 100) == 0:
-            self.target_params = jax.tree_util.tree_map(
-                jnp.copy, self.params)
+        self._maybe_refresh_target()
         return metrics
+
+    def compute_gradients(self, batch: SampleBatch) -> tuple:
+        # The sharded LearnerGroup path calls this directly (bypassing
+        # update_from_batch), so target params must ride in here too —
+        # same contract as DQNLearner.
+        batch = SampleBatch(batch)
+        batch["target_params"] = self.target_params
+        return super().compute_gradients(batch)
+
+    def apply_gradients(self, grads) -> None:
+        super().apply_gradients(grads)
+        self._maybe_refresh_target()
 
 
 def _rows_to_transitions(rows: list[dict]) -> SampleBatch:

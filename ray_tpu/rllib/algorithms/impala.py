@@ -137,16 +137,14 @@ class IMPALA(Algorithm):
         self._learner_steps = 0
 
     def _pump_sampling(self) -> None:
-        """Keep every env runner saturated with sample() requests."""
+        """Keep every env runner saturated with sample() requests
+        (shared bounded in-flight pump: actor_manager.pump)."""
         group = self.env_runner_group
         if group is None:
             self._batch_queue.append(self.local_env_runner.sample())
             return
-        while True:
-            sub = group.submit("sample")
-            if sub is None:
-                break
-            self._pending.append(sub)
+        self._pending = group.pump(
+            "sample", self._pending, self._batch_queue.append)
 
     def training_step(self) -> dict:
         cfg = self.algo_config
@@ -165,11 +163,6 @@ class IMPALA(Algorithm):
                         "could not be restarted")
                 self._sync_weights()
             self._pump_sampling()
-            if self.env_runner_group is not None:
-                ready, self._pending = self.env_runner_group.fetch_ready(
-                    self._pending, timeout=0.05)
-                for _, batch in ready:
-                    self._batch_queue.append(batch)
             while self._batch_queue and (
                     batches_this_step < cfg.num_batches_per_step):
                 batch = self._batch_queue.popleft()

@@ -105,6 +105,23 @@ class FaultTolerantActorManager:
             return i, ref
         return None
 
+    def pump(self, fn_name: str, pending: list, on_ready,
+             timeout: float = 0.05) -> list:
+        """One round of the async sampling pump shared by the
+        throughput algorithms (IMPALA, APEX): saturate every healthy
+        actor with ``fn_name`` requests up to the in-flight bound, then
+        deliver whatever completed to ``on_ready(result)``. Returns the
+        new pending list."""
+        while True:
+            sub = self.submit(fn_name)
+            if sub is None:
+                break
+            pending.append(sub)
+        ready, pending = self.fetch_ready(pending, timeout=timeout)
+        for _, result in ready:
+            on_ready(result)
+        return pending
+
     def fetch_ready(self, refs: list, timeout: float = 0.01) -> tuple:
         """(ready_results, remaining_refs); failures mark actors sick."""
         if not refs:
