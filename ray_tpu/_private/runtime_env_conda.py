@@ -1,0 +1,140 @@
+"""Per-task/actor conda environments.
+
+Reference: python/ray/_private/runtime_env/conda.py — either activate
+an EXISTING named conda env, or create one per dependencies-spec hash
+(cached per node, single-flight across processes). Activation follows
+the pip backend's model: prepend the env's site-packages for the
+task/actor's duration (worker_pool._runtime_env_ctx), no subprocess
+re-exec.
+
+Spec shapes (reference-compatible):
+    runtime_env={"conda": "existing-env-name"}
+    runtime_env={"conda": {"dependencies": ["python=3.12", "cowsay",
+                                            {"pip": ["pkgA"]}]}}
+
+The conda executable resolves from $RAY_TPU_CONDA_EXE, $CONDA_EXE, or
+PATH; a missing conda fails the task with an actionable error (same as
+the reference when no conda is installed).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+
+from ray_tpu._private.runtime_env_pip import (
+    _file_content_hash,
+    ensure_env_single_flight,
+    env_info,
+)
+
+_CONDA_ENV_ROOT = os.environ.get("RAY_TPU_CONDA_ENV_ROOT",
+                                 "/tmp/ray_tpu_conda_envs")
+# Conda solves + downloads can legitimately run far longer than a pip
+# install; waiters must not time out while the builder's lock heartbeat
+# shows it alive.
+_CONDA_CREATE_TIMEOUT_S = 3600.0
+
+
+def _conda_exe() -> str:
+    exe = (os.environ.get("RAY_TPU_CONDA_EXE")
+           or os.environ.get("CONDA_EXE")
+           or shutil.which("conda"))
+    if not exe:
+        raise RuntimeError(
+            "runtime_env={'conda': ...} requires a conda executable; "
+            "none found via RAY_TPU_CONDA_EXE, CONDA_EXE, or PATH")
+    return exe
+
+
+def _iter_file_entries(spec: dict):
+    """Local file paths anywhere in the dependencies tree (e.g. wheels
+    inside a nested {'pip': [...]} entry)."""
+    for dep in spec.get("dependencies", []):
+        if isinstance(dep, dict):
+            for sub in dep.get("pip", []):
+                if isinstance(sub, str) and os.path.isfile(sub):
+                    yield sub
+        elif isinstance(dep, str) and os.path.isfile(dep):
+            yield dep
+
+
+def conda_env_hash(spec: dict) -> str:
+    """Cache key: normalized spec PLUS the content of any local file
+    entries — a wheel rebuilt at the same path must produce a new env,
+    never serve the stale cached one (same convention as
+    pip_env_hash)."""
+    hasher = hashlib.sha1(json.dumps(spec, sort_keys=True).encode())
+    for path in _iter_file_entries(spec):
+        hasher.update(_file_content_hash(path).encode())
+    return hasher.hexdigest()
+
+
+# name -> env path: `conda env list` forks a subprocess; resolving on
+# every task entry would put a CLI round trip on the hot path.
+_named_env_memo: dict[str, str] = {}
+
+
+def _named_env_path(exe: str, name: str) -> str:
+    """Resolve a named env via `conda env list --json` (reference:
+    conda.py get_conda_env_dir), memoized per process."""
+    cached = _named_env_memo.get(name)
+    if cached is not None and os.path.isdir(cached):
+        return cached
+    proc = subprocess.run([exe, "env", "list", "--json"],
+                          capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"conda env list failed: {(proc.stderr or proc.stdout)[-1000:]}")
+    for env_path in json.loads(proc.stdout).get("envs", []):
+        if os.path.basename(env_path) == name or env_path == name:
+            _named_env_memo[name] = env_path
+            return env_path
+    raise RuntimeError(f"conda env {name!r} not found on this node")
+
+
+def _create_from_spec(exe: str, target: str, spec: dict) -> None:
+    """conda env create from an environment-dict written to a temp
+    yaml-ish json file (conda accepts json env files)."""
+    env_file = target + ".env.json"
+    payload = {"name": os.path.basename(target),
+               "dependencies": spec.get("dependencies", [])}
+    if spec.get("channels"):
+        payload["channels"] = spec["channels"]
+    with open(env_file, "w") as f:
+        json.dump(payload, f)
+    try:
+        proc = subprocess.run(
+            [exe, "env", "create", "-p", target, "-f", env_file],
+            capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"conda env create failed: "
+                f"{(proc.stderr or proc.stdout)[-4000:]}")
+    finally:
+        try:
+            os.unlink(env_file)
+        except OSError:
+            pass
+
+
+def ensure_conda_env(spec) -> dict:
+    """-> {"path", "python", "site_packages"} for ``spec``.
+
+    Named envs must already exist on the node; dict specs are created
+    once per content hash and cached (reference: conda.py caches envs
+    under the session dir keyed by spec hash)."""
+    exe = _conda_exe()
+    if isinstance(spec, str):
+        return env_info(_named_env_path(exe, spec))
+    if not isinstance(spec, dict):
+        raise ValueError(
+            f"runtime_env['conda'] must be an env name or a "
+            f"dependencies dict; got {type(spec).__name__}")
+    target = os.path.join(_CONDA_ENV_ROOT, conda_env_hash(spec))
+    return ensure_env_single_flight(
+        target, lambda t: _create_from_spec(exe, t, spec),
+        timeout_s=_CONDA_CREATE_TIMEOUT_S)

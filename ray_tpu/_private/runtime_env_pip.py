@@ -97,22 +97,17 @@ def env_info(target: str) -> dict:
     }
 
 
-def ensure_pip_env(spec) -> dict:
-    """The cached venv for ``spec`` (created on first use per node).
-
-    -> {"path", "python", "site_packages"}. Creation is single-flight
-    across processes (lock dir); losers wait for the winner's
-    .complete marker.
-    """
-    norm = normalize_pip_spec(spec)
-    key = pip_env_hash(norm)
-    target = os.path.join(_PIP_ENV_ROOT, key)
+def ensure_env_single_flight(target: str, create_fn,
+                             timeout_s: float = _CREATE_TIMEOUT_S) -> dict:
+    """Create ``target`` via ``create_fn(target)`` exactly once across
+    processes (lock dir); losers wait for the winner's .complete
+    marker. Shared by the pip and conda runtime-env backends."""
     marker = os.path.join(target, ".complete")
     if os.path.exists(marker):
         return env_info(target)
-    os.makedirs(_PIP_ENV_ROOT, exist_ok=True)
+    os.makedirs(os.path.dirname(target), exist_ok=True)
     lock_dir = target + ".lock"
-    deadline = time.monotonic() + _CREATE_TIMEOUT_S
+    deadline = time.monotonic() + timeout_s
     while True:
         try:
             os.mkdir(lock_dir)
@@ -126,14 +121,14 @@ def ensure_pip_env(spec) -> dict:
                 # the lock forever; reclaim it once it is older than any
                 # legitimate build could be.
                 age = time.time() - os.path.getmtime(lock_dir)
-                if age > _CREATE_TIMEOUT_S:
+                if age > timeout_s:
                     os.rmdir(lock_dir)
                     continue
             except OSError:
                 pass  # lock vanished or unreadable; just retry
             if time.monotonic() > deadline:
                 raise TimeoutError(
-                    f"pip env {key} creation lock held too long "
+                    f"env creation lock held too long "
                     f"({lock_dir}); remove it if the creator crashed")
             time.sleep(0.25)
     # Heartbeat: refresh the lock's mtime while building so waiters'
@@ -151,15 +146,21 @@ def ensure_pip_env(spec) -> dict:
                 return
 
     beat = threading.Thread(target=_beat, daemon=True,
-                            name="pip-env-lock-heartbeat")
+                            name="env-lock-heartbeat")
     beat.start()
     try:
         if os.path.exists(marker):  # winner finished while we locked
             return env_info(target)
         shutil.rmtree(target, ignore_errors=True)  # partial leftovers
-        _create_env(target, norm)
+        create_fn(target)
+        # Validate BEFORE writing the marker: a build that "succeeded"
+        # but yields no usable layout (e.g. a conda spec without
+        # python → no site-packages) must fail HERE, once, with the
+        # partial env removed — not loop build-then-delete on every
+        # subsequent task.
+        info = env_info(target)
         open(marker, "w").close()
-        return env_info(target)
+        return info
     except BaseException:
         shutil.rmtree(target, ignore_errors=True)
         raise
@@ -169,6 +170,18 @@ def ensure_pip_env(spec) -> dict:
             os.rmdir(lock_dir)
         except OSError:
             pass
+
+
+def ensure_pip_env(spec) -> dict:
+    """The cached venv for ``spec`` (created on first use per node).
+
+    -> {"path", "python", "site_packages"}.
+    """
+    norm = normalize_pip_spec(spec)
+    key = pip_env_hash(norm)
+    target = os.path.join(_PIP_ENV_ROOT, key)
+    return ensure_env_single_flight(
+        target, lambda t: _create_env(t, norm))
 
 
 def _create_env(target: str, norm: dict) -> None:

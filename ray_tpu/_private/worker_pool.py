@@ -117,6 +117,12 @@ class _runtime_env_ctx:
         self._added_sys_paths: list[str] = []
         self._unload_prefixes: list[str] = []
 
+    def _push_site(self, site: str) -> None:
+        if site not in sys.path:
+            sys.path.insert(0, site)
+            self._added_sys_paths.append(site)
+        self._unload_prefixes.append(site)
+
     def __enter__(self):
         try:
             self._enter_impl()
@@ -129,21 +135,31 @@ class _runtime_env_ctx:
         return self
 
     def _enter_impl(self):
+        # Env backends FIRST (they can fail — a venv/conda error must
+        # abort before any os.environ mutation): a per-hash env created
+        # once per node and cached; its site-packages is prepended for
+        # this task's duration and its modules unloaded after
+        # (reference: runtime_env/{pip,conda}.py).
         pip_spec = self.env.get("pip")
+        conda_spec = self.env.get("conda")
+        if pip_spec and conda_spec:
+            # Ambiguous layering (whose site-packages wins?); the
+            # reference rejects the combination too. Nested pip deps
+            # belong INSIDE the conda spec's dependencies.
+            raise ValueError(
+                "runtime_env cannot specify both 'pip' and 'conda'; "
+                "put pip packages in the conda spec's dependencies "
+                "({'conda': {'dependencies': [{'pip': [...]}]}})")
         if pip_spec:
-            # FIRST (it can fail — a venv/pip error must abort before
-            # any os.environ mutation): per-requirements-hash venv,
-            # created once per node and cached; its site-packages is
-            # prepended for this task's duration and its modules
-            # unloaded after (reference: runtime_env/pip.py).
             from ray_tpu._private.runtime_env_pip import ensure_pip_env
 
-            info = ensure_pip_env(pip_spec)
-            site = info["site_packages"]
-            if site not in sys.path:
-                sys.path.insert(0, site)
-                self._added_sys_paths.append(site)
-            self._unload_prefixes.append(site)
+            self._push_site(ensure_pip_env(pip_spec)["site_packages"])
+        if conda_spec:
+            from ray_tpu._private.runtime_env_conda import (
+                ensure_conda_env,
+            )
+
+            self._push_site(ensure_conda_env(conda_spec)["site_packages"])
         for k, v in (self.env.get("env_vars") or {}).items():
             self._saved_vars[k] = os.environ.get(k)
             os.environ[k] = str(v)
