@@ -89,8 +89,15 @@ def _named_env_path(exe: str, name: str) -> str:
     if proc.returncode != 0:
         raise RuntimeError(
             f"conda env list failed: {(proc.stderr or proc.stdout)[-1000:]}")
-    for env_path in json.loads(proc.stdout).get("envs", []):
-        if os.path.basename(env_path) == name or env_path == name:
+    envs = json.loads(proc.stdout).get("envs", [])
+    for env_path in envs:
+        # The root prefix is named "base" but its directory basename is
+        # the install dir (e.g. /opt/miniconda3): base = the env NOT
+        # under an envs/ parent (reference: conda.py get_conda_env_dir
+        # special-cases base the same way).
+        is_base = os.path.basename(os.path.dirname(env_path)) != "envs"
+        if (os.path.basename(env_path) == name or env_path == name
+                or (name == "base" and is_base)):
             _named_env_memo[name] = env_path
             return env_path
     raise RuntimeError(f"conda env {name!r} not found on this node")
@@ -121,6 +128,27 @@ def _create_from_spec(exe: str, target: str, spec: dict) -> None:
             pass
 
 
+def _check_python_compat(info: dict, spec) -> dict:
+    """Activation happens IN-PROCESS (site-packages prepend, no
+    re-exec), so an env built for another interpreter version would
+    import cp3XX extension modules into a mismatched python. Fail
+    actionably instead (the site-packages path encodes the version)."""
+    import re
+    import sys
+
+    m = re.search(r"python(\d+)\.(\d+)", info["site_packages"])
+    if m and (int(m.group(1)), int(m.group(2))) != (
+            sys.version_info.major, sys.version_info.minor):
+        raise RuntimeError(
+            f"conda env {spec!r} targets python "
+            f"{m.group(1)}.{m.group(2)} but this worker runs "
+            f"{sys.version_info.major}.{sys.version_info.minor}; "
+            f"in-process activation requires matching interpreter "
+            f"versions (pin python={sys.version_info.major}."
+            f"{sys.version_info.minor} in the env spec)")
+    return info
+
+
 def ensure_conda_env(spec) -> dict:
     """-> {"path", "python", "site_packages"} for ``spec``.
 
@@ -129,12 +157,15 @@ def ensure_conda_env(spec) -> dict:
     under the session dir keyed by spec hash)."""
     exe = _conda_exe()
     if isinstance(spec, str):
-        return env_info(_named_env_path(exe, spec))
+        return _check_python_compat(
+            env_info(_named_env_path(exe, spec)), spec)
     if not isinstance(spec, dict):
         raise ValueError(
             f"runtime_env['conda'] must be an env name or a "
             f"dependencies dict; got {type(spec).__name__}")
     target = os.path.join(_CONDA_ENV_ROOT, conda_env_hash(spec))
-    return ensure_env_single_flight(
-        target, lambda t: _create_from_spec(exe, t, spec),
-        timeout_s=_CONDA_CREATE_TIMEOUT_S)
+    return _check_python_compat(
+        ensure_env_single_flight(
+            target, lambda t: _create_from_spec(exe, t, spec),
+            timeout_s=_CONDA_CREATE_TIMEOUT_S),
+        spec)

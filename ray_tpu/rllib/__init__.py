@@ -36,6 +36,7 @@ from ray_tpu.rllib.algorithms.multi_agent_ppo import (
 )
 from ray_tpu.rllib.algorithms.pg import A2C, A2CConfig, PG, PGConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.r2d2 import GRUQModule, R2D2, R2D2Config
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.algorithms.simple_q import SimpleQ, SimpleQConfig
 from ray_tpu.rllib.algorithms.td3 import DDPG, DDPGConfig, TD3, TD3Config
@@ -68,6 +69,7 @@ from ray_tpu.rllib.env.vector_env import (
 from ray_tpu.rllib.utils.actor_manager import FaultTolerantActorManager
 from ray_tpu.rllib.utils.replay_buffers import (
     PrioritizedReplayBuffer,
+    PrioritizedSequenceReplayBuffer,
     ReplayBuffer,
 )
 from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
@@ -125,6 +127,10 @@ __all__ = [
     "SimpleQ",
     "SimpleQConfig",
     "PrioritizedReplayBuffer",
+    "PrioritizedSequenceReplayBuffer",
+    "GRUQModule",
+    "R2D2",
+    "R2D2Config",
     "RLModule",
     "RLModuleSpec",
     "ReplayBuffer",

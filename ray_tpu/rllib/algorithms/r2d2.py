@@ -1,0 +1,320 @@
+"""R2D2 — Recurrent Replay Distributed DQN.
+
+Reference: rllib/algorithms/r2d2/ (Kapturowski et al. 2019). Q-learning
+over SEQUENCES with a recurrent (GRU) Q-network:
+
+- env runners thread the GRU state through the rollout and record the
+  state at each fragment's first step (env_runner.py recurrent path);
+- replay stores whole sequences with their initial state
+  (PrioritizedSequenceReplayBuffer), prioritized by the eta-mix of max
+  and mean TD magnitude over the sequence;
+- the learner unrolls online and target networks over [T, B] with one
+  `lax.scan` each (state zeroed at in-sequence episode boundaries),
+  applies double-Q targets, masks a burn-in prefix out of the loss
+  (those steps only warm the state), and masks truncated steps (their
+  true next-state value is unknown).
+
+The whole update is ONE jitted program; the scan keeps the time
+dimension on device, so sequence length never touches Python.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import (
+    RLModule,
+    _mlp_apply,
+    _mlp_init,
+)
+from ray_tpu.rllib.utils.replay_buffers import (
+    PrioritizedSequenceReplayBuffer,
+)
+from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
+
+
+def _gru_init(rng, in_size: int, hidden: int) -> dict:
+    kx, kh = jax.random.split(rng)
+    scale_x = 1.0 / np.sqrt(in_size)
+    scale_h = 1.0 / np.sqrt(hidden)
+    return {
+        "wx": jax.random.uniform(kx, (in_size, 3 * hidden),
+                                 minval=-scale_x, maxval=scale_x),
+        "wh": jax.random.uniform(kh, (hidden, 3 * hidden),
+                                 minval=-scale_h, maxval=scale_h),
+        "b": jnp.zeros((3 * hidden,)),
+    }
+
+
+def _gru_cell(params: dict, x, h):
+    """Standard GRU cell: fused [r, z, n] gates."""
+    gates_x = x @ params["wx"] + params["b"]
+    gates_h = h @ params["wh"]
+    H = h.shape[-1]
+    r = jax.nn.sigmoid(gates_x[..., :H] + gates_h[..., :H])
+    z = jax.nn.sigmoid(gates_x[..., H:2 * H] + gates_h[..., H:2 * H])
+    n = jnp.tanh(gates_x[..., 2 * H:] + r * gates_h[..., 2 * H:])
+    return (1.0 - z) * n + z * h
+
+
+class GRUQModule(RLModule):
+    """Encoder MLP -> GRU -> Q head; epsilon-greedy exploration with
+    the same traced decay clock as the feed-forward DQN module."""
+
+    is_recurrent = True
+
+    def __init__(self, observation_size: int, num_actions: int,
+                 hidden: tuple = (64,), gru_hidden: int = 64,
+                 epsilon_start: float = 1.0, epsilon_end: float = 0.05,
+                 epsilon_decay_steps: int = 10_000, **_):
+        self.observation_size = observation_size
+        self.num_actions = num_actions
+        self.hidden = tuple(hidden)
+        self.gru_hidden = gru_hidden
+        self.epsilon_start = epsilon_start
+        self.epsilon_end = epsilon_end
+        self.epsilon_decay_steps = epsilon_decay_steps
+
+    def init(self, rng):
+        k_enc, k_gru, k_q = jax.random.split(rng, 3)
+        enc_sizes = (self.observation_size,) + self.hidden
+        return {
+            "enc": _mlp_init(k_enc, enc_sizes),
+            "gru": _gru_init(k_gru, self.hidden[-1], self.gru_hidden),
+            "q": _mlp_init(k_q, (self.gru_hidden, self.num_actions)),
+        }
+
+    def initial_state(self, batch_size: int) -> np.ndarray:
+        return np.zeros((batch_size, self.gru_hidden), dtype=np.float32)
+
+    def _q_step(self, params, obs, h):
+        x = _mlp_apply(params["enc"], obs)
+        h2 = _gru_cell(params["gru"], x, h)
+        return _mlp_apply(params["q"], h2), h2
+
+    def unroll(self, params, obs_seq, state0, reset_mask):
+        """Q over a [T, B] sequence: `lax.scan` of the cell, zeroing
+        state where reset_mask[t] marks an in-sequence episode start.
+        -> q_seq [T, B, A]."""
+        def scan_fn(h, xs):
+            obs_t, reset_t = xs
+            h = h * (1.0 - reset_t)[:, None]
+            q_t, h = self._q_step(params, obs_t, h)
+            return h, q_t
+
+        _, q_seq = jax.lax.scan(scan_fn, state0, (obs_seq, reset_mask))
+        return q_seq
+
+    # -- single-step forwards (rollout path) --------------------------
+    def forward_inference(self, params, batch, rng=None):
+        q, h2 = self._q_step(params, batch["obs"], batch["state_in"])
+        return {"action_logits": q, "actions": jnp.argmax(q, axis=-1),
+                "state_out": h2}
+
+    def forward_exploration(self, params, batch, rng=None):
+        q, h2 = self._q_step(params, batch["obs"], batch["state_in"])
+        greedy = jnp.argmax(q, axis=-1)
+        t = batch.get("t", self.epsilon_decay_steps)
+        frac = jnp.clip(t / self.epsilon_decay_steps, 0.0, 1.0)
+        eps = self.epsilon_start + frac * (
+            self.epsilon_end - self.epsilon_start)
+        explore_rng, action_rng = jax.random.split(rng)
+        random_actions = jax.random.randint(
+            action_rng, greedy.shape, 0, self.num_actions)
+        take_random = jax.random.uniform(explore_rng, greedy.shape) < eps
+        return {"action_logits": q,
+                "actions": jnp.where(take_random, random_actions, greedy),
+                "action_logp": jnp.zeros_like(q[..., 0]),
+                "vf_preds": jnp.max(q, axis=-1),
+                "state_out": h2}
+
+    def forward_train(self, params, batch, rng=None):
+        q, _ = self._q_step(params, batch["obs"], batch["state_in"])
+        return {"action_logits": q}
+
+
+class R2D2Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.module_class = GRUQModule
+        self.lr = 1e-3
+        self.rollout_fragment_length = 40   # = stored sequence length
+        self.burn_in = 8                    # state-warmup steps, no loss
+        self.replay_capacity_sequences = 4096
+        self.replay_alpha = 0.6
+        self.replay_beta = 0.4
+        self.priority_eta = 0.9             # eta*max + (1-eta)*mean TD
+        self.train_batch_size = 32          # SEQUENCES per update
+        self.target_update_freq = 100
+        self.num_sequences_before_learning = 64
+        self.updates_per_iteration = 16
+        self.epsilon_start = 1.0
+        self.epsilon_end = 0.05
+        self.epsilon_decay_steps = 10_000
+        # Learner recomputes Q under grad; runners ship only the core
+        # sequence columns.
+        self.runner_emit_columns = ()
+
+    def learner_class(self):
+        return R2D2Learner
+
+    def module_spec(self):
+        spec = super().module_spec()
+        spec.model_config.setdefault("epsilon_start", self.epsilon_start)
+        spec.model_config.setdefault("epsilon_end", self.epsilon_end)
+        spec.model_config.setdefault("epsilon_decay_steps",
+                                     self.epsilon_decay_steps)
+        return spec
+
+
+def _reset_mask(terminateds, truncateds):
+    """reset_mask[t] = episode boundary BEFORE step t (the stored
+    initial state covers t=0, so row 0 is never reset)."""
+    done = jnp.logical_or(terminateds, truncateds).astype(jnp.float32)
+    return jnp.concatenate(
+        [jnp.zeros_like(done[:1]), done[:-1]], axis=0)
+
+
+class R2D2Learner(Learner):
+    batch_axis = 1  # [T, B]: shard over sequences, scan stays local
+
+    def __init__(self, module_spec, config=None, mesh=None):
+        super().__init__(module_spec, config, mesh)
+        self.target_params = jax.tree_util.tree_map(
+            jnp.copy, self.params)
+
+    def compute_loss(self, params, batch, rng):
+        cfg = self.config
+        obs = batch[Columns.OBS]                       # [T, B, D]
+        actions = batch[Columns.ACTIONS].astype(jnp.int32)
+        rewards = batch[Columns.REWARDS]
+        term = batch[Columns.TERMINATEDS].astype(jnp.float32)
+        trunc = batch[Columns.TRUNCATEDS].astype(jnp.float32)
+        T = rewards.shape[0]
+        reset = _reset_mask(batch[Columns.TERMINATEDS],
+                            batch[Columns.TRUNCATEDS])
+
+        q_online = self.module.unroll(params, obs, batch["state_in"],
+                                      reset)                 # [T, B, A]
+        q_target = self.module.unroll(batch["target_params"], obs,
+                                      batch["state_in"], reset)
+        q_taken = jnp.take_along_axis(
+            q_online, actions[..., None], axis=-1)[..., 0]   # [T, B]
+
+        # Double-Q one-step targets from the NEXT row of the sequence:
+        # online argmax, target eval.
+        next_actions = jnp.argmax(q_online[1:], axis=-1)     # [T-1, B]
+        q_next = jnp.take_along_axis(
+            q_target[1:], next_actions[..., None], axis=-1)[..., 0]
+        targets = rewards[:-1] + cfg.gamma * (1.0 - term[:-1]) * q_next
+        td = q_taken[:-1] - jax.lax.stop_gradient(targets)   # [T-1, B]
+
+        # Valid steps: past burn-in, not truncated (no true next
+        # value), and the next row must belong to the SAME episode
+        # unless the step terminated (then the target is just r).
+        steps = jnp.arange(T - 1)[:, None]
+        valid = ((steps >= cfg.burn_in)
+                 & (trunc[:-1] < 0.5)).astype(jnp.float32)
+        weights = batch.get(
+            "weights", jnp.ones_like(td[0]))[None, :]        # [1, B]
+        denom = jnp.maximum(valid.sum(), 1.0)
+        loss = jnp.sum(weights * valid * jnp.square(td)) / denom
+
+        abs_td = jnp.abs(td) * valid
+        eta = cfg.priority_eta
+        # Per-sequence priorities come straight out of the TRAINING TD
+        # errors (the paper's choice): the update already computed
+        # them, so no second unroll or batch round trip is ever paid.
+        seq_priority = (eta * abs_td.max(axis=0)
+                        + (1 - eta) * abs_td.sum(axis=0)
+                        / jnp.maximum(valid.sum(axis=0), 1.0))
+        return loss, {"td_error_mean": abs_td.sum() / denom,
+                      "q_mean": jnp.mean(q_taken),
+                      "seq_priority": seq_priority}
+
+    def _maybe_refresh_target(self) -> None:
+        if self._steps % getattr(self.config, "target_update_freq",
+                                 100) == 0:
+            self.target_params = jax.tree_util.tree_map(
+                jnp.copy, self.params)
+
+    def update_from_batch(self, batch: SampleBatch,
+                          sync_metrics: bool = True) -> dict:
+        batch = SampleBatch(batch)
+        batch["target_params"] = self.target_params
+        metrics = dict(Learner.update_from_batch(
+            self, batch, sync_metrics=False))
+        self._maybe_refresh_target()
+        # The per-sequence priority ARRAY rides out through the metrics
+        # pytree (one transfer with everything else), stashed for
+        # get_last_seq_priorities — never float()-coerced.
+        prio = metrics.pop("seq_priority", None)
+        self._last_seq_priorities = (np.asarray(prio)
+                                     if prio is not None else None)
+        if not sync_metrics:
+            return metrics
+        host = jax.device_get(metrics)
+        return {k: float(v) for k, v in host.items()}
+
+    def get_last_seq_priorities(self):
+        return getattr(self, "_last_seq_priorities", None)
+
+    def compute_gradients(self, batch: SampleBatch) -> tuple:
+        batch = SampleBatch(batch)
+        batch["target_params"] = self.target_params
+        return super().compute_gradients(batch)
+
+    def apply_gradients(self, grads) -> None:
+        super().apply_gradients(grads)
+        self._maybe_refresh_target()
+
+
+class R2D2(Algorithm):
+    config_class = R2D2Config
+
+    def setup(self, config: dict) -> None:
+        cfg = self.algo_config
+        if cfg.num_learners > 0:
+            # Round-robin actor updates would slow each actor's
+            # target-refresh cadence by N and desync the priorities;
+            # the local learner's mesh already covers multi-device.
+            raise ValueError(
+                "R2D2 runs on a local learner "
+                "(num_devices_per_learner scales it across devices)")
+        super().setup(config)
+        self.replay = PrioritizedSequenceReplayBuffer(
+            cfg.replay_capacity_sequences, alpha=cfg.replay_alpha,
+            beta=cfg.replay_beta, seed=cfg.seed)
+        self._learner_steps = 0
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        for frag in self._sample_fragments():
+            self.replay.add_fragment(frag)
+
+        metrics: dict = {}
+        if len(self.replay) >= cfg.num_sequences_before_learning:
+            for _ in range(cfg.updates_per_iteration):
+                batch = self.replay.sample(cfg.train_batch_size)
+                indexes = batch.pop("batch_indexes")
+                metrics = self.learner_group.update_from_batch(
+                    batch, shard=False)
+                self._learner_steps += 1
+                prios = self.learner_group.call(
+                    "get_last_seq_priorities")
+                if prios is not None:
+                    self.replay.update_priorities(indexes, prios)
+            self._sync_weights()
+
+        results = self._runner_metrics()
+        results.update(metrics)
+        results["replay_sequences"] = len(self.replay)
+        results["num_learner_steps"] = self._learner_steps
+        return results
+
+
+R2D2Config.algo_class = R2D2

@@ -70,9 +70,16 @@ class SingleAgentEnvRunner:
         # (dispatch-bound, the scan wins); off on CPU, where XLA's
         # while-loop overhead per tiny step loses to the vectorized
         # numpy loop — measured, not assumed.
+        # Recurrent modules thread state through the per-step loop;
+        # the fused scan has no state plumbing (yet), so they always
+        # take the step-loop path.
+        self._recurrent = bool(getattr(self.module, "is_recurrent",
+                                       False))
         if fused_rollouts is None:
             fused_rollouts = (self._device is not None
                               and self._device.platform != "cpu")
+        if self._recurrent:
+            fused_rollouts = False
         self._jax_env = get_jax_env(env_id, num_envs) \
             if fused_rollouts else None
         if self._jax_env is not None:
@@ -89,8 +96,22 @@ class SingleAgentEnvRunner:
         fwd = (self.module.forward_exploration if explore
                else self.module.forward_inference)
         self._fwd = fwd
-        self._policy_step = make_policy_step(
-            fwd, self._seed_base, self._device)
+        if self._recurrent:
+            from ray_tpu.rllib.env.runner_common import (
+                make_recurrent_policy_step,
+            )
+
+            self._rnn_state = np.asarray(
+                self.module.initial_state(self.env.num_envs))
+            recurrent_step = make_recurrent_policy_step(
+                fwd, self._seed_base, self._device)
+            # One call shape for both module kinds: the recurrent
+            # variant reads the CURRENT state at call time.
+            self._policy_step = (
+                lambda w, o, t: recurrent_step(w, o, self._rnn_state, t))
+        else:
+            self._policy_step = make_policy_step(
+                fwd, self._seed_base, self._device)
 
     # -- weights sync ------------------------------------------------
     def set_weights(self, weights, version: int = 0) -> None:
@@ -205,6 +226,8 @@ class SingleAgentEnvRunner:
             Columns.TERMINATEDS, Columns.TRUNCATEDS, Columns.ACTION_LOGP,
             Columns.VF_PREDS, Columns.ACTION_LOGITS)}
 
+        state_in = (self._rnn_state.copy() if self._recurrent
+                    else None)
         obs = self._obs
         for _ in range(T):
             self._step_counter += 1
@@ -212,6 +235,17 @@ class SingleAgentEnvRunner:
                                     self._step_counter)
             actions = np.asarray(out["actions"])
             next_obs, rewards, term, trunc = self.env.step(actions)
+            if self._recurrent:
+                # Thread state; episode boundaries reset their lanes
+                # (the env auto-resets, so the next obs starts a NEW
+                # episode whose state must be the initial one).
+                state = np.asarray(out["state_out"])
+                done = term | trunc
+                if done.any():
+                    state = state.copy()
+                    state[done] = np.asarray(
+                        self.module.initial_state(int(done.sum())))
+                self._rnn_state = state
 
             cols[Columns.OBS].append(obs)
             cols[Columns.ACTIONS].append(actions)
@@ -231,6 +265,11 @@ class SingleAgentEnvRunner:
         self._obs = obs
         batch = SampleBatch(
             {k: np.stack(v, axis=0) for k, v in cols.items()})
+        if state_in is not None:
+            # The fragment's INITIAL recurrent state, [B, ...]: the
+            # learner unrolls from here (reference: R2D2 stores the
+            # recurrent state with each sequence).
+            batch["state_in"] = state_in
         # Bootstrap values for the final obs of each env lane: one more
         # policy call on the current obs.
         self._step_counter += 1

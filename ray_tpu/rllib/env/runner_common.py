@@ -25,6 +25,28 @@ def rollout_device(inference_backend: str):
         return None
 
 
+def make_recurrent_policy_step(fwd, seed_base: np.uint32, device):
+    """Recurrent variant: ``fwd(params, {"obs", "state_in", "t"}, rng)``
+    — the runner threads the returned "state_out" into the next call
+    (reference: RLlib's stateful RLModules carry STATE_IN/STATE_OUT
+    through the connector pipeline)."""
+
+    def policy_step(params, obs, state, seed):
+        rng = jax.random.fold_in(jax.random.PRNGKey(seed_base), seed)
+        return fwd(params, {"obs": obs, "state_in": state, "t": seed},
+                   rng)
+
+    jitted = jax.jit(policy_step)
+    if device is None:
+        return jitted
+
+    def on_device(params, obs, state, seed):
+        with jax.default_device(device):
+            return jitted(params, obs, state, seed)
+
+    return on_device
+
+
 def make_policy_step(fwd, seed_base: np.uint32, device):
     """Jit ``fwd(params, {"obs", "t"}, rng)`` with the PRNG key derived
     INSIDE the jitted fn from a host integer (no device-committed key

@@ -85,3 +85,86 @@ class PrioritizedReplayBuffer(ReplayBuffer):
         prios = np.abs(td_errors) + 1e-6
         self._priorities[idx] = prios
         self._max_priority = max(self._max_priority, float(prios.max()))
+
+
+class PrioritizedSequenceReplayBuffer:
+    """Fixed-length SEQUENCE storage for recurrent Q-learning.
+
+    Reference: R2D2's replay (Kapturowski et al. 2019) — units are
+    whole [T] sequences, each carrying the recurrent state observed at
+    its first step; priorities are per sequence (the eta-mix of max and
+    mean TD magnitude is computed learner-side and pushed back via
+    ``update_priorities``). Storage is a preallocated ring per column,
+    so sampled batches are fixed-shape time-major [T, b] and the jitted
+    learner update never recompiles.
+    """
+
+    SEQ_COLUMNS = (Columns.OBS, Columns.ACTIONS, Columns.REWARDS,
+                   Columns.TERMINATEDS, Columns.TRUNCATEDS)
+
+    def __init__(self, capacity_sequences: int = 4096,
+                 alpha: float = 0.6, beta: float = 0.4, seed: int = 0):
+        self.capacity = capacity_sequences
+        self.alpha = alpha
+        self.beta = beta
+        self._storage: dict[str, np.ndarray] = {}
+        self._idx = 0
+        self._size = 0
+        self._rng = np.random.default_rng(seed)
+        self._priorities = np.zeros(capacity_sequences, dtype=np.float64)
+        self._max_priority = 1.0
+
+    def __len__(self) -> int:
+        return self._size
+
+    def add_fragment(self, fragment: SampleBatch) -> int:
+        """Split a [T, B] rollout fragment (with its "state_in" [B, H])
+        into B sequences and append them. Returns sequences added."""
+        state_in = np.asarray(fragment["state_in"])
+        T, B = np.asarray(fragment[Columns.REWARDS]).shape
+        if not self._storage:
+            for k in self.SEQ_COLUMNS:
+                v = np.asarray(fragment[k])
+                self._storage[k] = np.zeros(
+                    (self.capacity, T) + v.shape[2:], dtype=v.dtype)
+            self._storage["state_in"] = np.zeros(
+                (self.capacity,) + state_in.shape[1:],
+                dtype=state_in.dtype)
+        stored_T = self._storage[Columns.REWARDS].shape[1]
+        if T != stored_T:
+            raise ValueError(
+                f"sequence length changed: buffer holds T={stored_T}, "
+                f"fragment has T={T} (fixed shapes keep the jitted "
+                f"update from recompiling)")
+        idx = (self._idx + np.arange(B)) % self.capacity
+        for k in self.SEQ_COLUMNS:
+            # [T, B, ...] -> [B, T, ...] rows.
+            self._storage[k][idx] = np.moveaxis(
+                np.asarray(fragment[k]), 0, 1)
+        self._storage["state_in"][idx] = state_in
+        self._priorities[idx] = self._max_priority
+        self._idx = (self._idx + B) % self.capacity
+        self._size = min(self._size + B, self.capacity)
+        return B
+
+    def sample(self, num_sequences: int) -> SampleBatch:
+        """Time-major [T, b] batch of ``num_sequences`` sequences with
+        IS weights and indexes for the priority write-back."""
+        prios = self._priorities[:self._size] ** self.alpha
+        probs = prios / prios.sum()
+        idx = self._rng.choice(self._size, size=num_sequences, p=probs)
+        weights = (self._size * probs[idx]) ** (-self.beta)
+        weights /= weights.max()
+        out = SampleBatch()
+        for k in self.SEQ_COLUMNS:
+            out[k] = np.moveaxis(self._storage[k][idx], 0, 1)
+        out["state_in"] = self._storage["state_in"][idx]
+        out["weights"] = weights.astype(np.float32)
+        out["batch_indexes"] = idx
+        return out
+
+    def update_priorities(self, idx: np.ndarray,
+                          seq_priorities: np.ndarray) -> None:
+        prios = np.abs(np.asarray(seq_priorities)) + 1e-6
+        self._priorities[np.asarray(idx)] = prios
+        self._max_priority = max(self._max_priority, float(prios.max()))
