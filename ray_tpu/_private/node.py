@@ -34,6 +34,24 @@ def _own_address() -> str:
         return "127.0.0.1"
 
 
+def daemon_child_env(extra: dict | None = None) -> dict:
+    """Environment for spawning a ray_tpu daemon subprocess: this
+    checkout resolves on PYTHONPATH even when the package isn't
+    installed, and TPU detection is skipped unless the caller opts in.
+    Shared by every daemon spawn site (cluster_utils, the autoscaler
+    provider, the YAML launcher)."""
+    env = dict(os.environ)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    prior = env.get("PYTHONPATH", "")
+    if pkg_root not in prior.split(os.pathsep):
+        env["PYTHONPATH"] = (
+            pkg_root + (os.pathsep + prior if prior else ""))
+    env.setdefault("RAY_TPU_SKIP_TPU_DETECTION", "1")
+    env.update(extra or {})
+    return env
+
+
 class NodeAgent:
     """Registers this node with the head GCS and heartbeats.
 

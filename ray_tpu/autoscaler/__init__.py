@@ -11,7 +11,13 @@ from ray_tpu.autoscaler.autoscaler import (  # noqa: F401
     NodeTypeConfig,
     StandardAutoscaler,
 )
+from ray_tpu.autoscaler.commands import (  # noqa: F401
+    create_or_update_cluster,
+    load_cluster_config,
+    teardown_cluster,
+)
 from ray_tpu.autoscaler.node_provider import (  # noqa: F401
+    LocalDaemonNodeProvider,
     NodeProvider,
     VirtualNodeProvider,
 )

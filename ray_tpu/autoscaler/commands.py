@@ -1,0 +1,388 @@
+"""`up` / `down` cluster launcher driven by a YAML config.
+
+Reference: python/ray/autoscaler/commands.py (`ray up cluster.yaml`
+creates/updates a cluster from a declarative config; `ray down` tears
+it down) with the reference's config field names
+(autoscaler/ray-schema.json): cluster_name, provider,
+available_node_types, head_node_type, max_workers, min_workers per
+node type, initialization/setup commands, idle_timeout_minutes.
+
+Cloud SDKs are out of scope here (zero-egress build environment), so
+the built-in provider types are:
+
+- ``local``   — real worker daemons as local OS processes
+  (LocalDaemonNodeProvider — full executor nodes);
+- ``external``— the reference's escape hatch: ``provider.module`` names
+  "pkg.mod:ClassName" implementing NodeProvider; cloud support plugs in
+  here without touching this file.
+
+State (head pid/address, launched worker pids) persists to
+``~/.ray_tpu/clusters/<name>.json`` so ``down`` works from the config
+alone in a fresh process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any
+
+import yaml
+
+def _state_root() -> str:
+    """Resolved at USE time so programmatic env changes take effect
+    (import-time capture would silently ignore them)."""
+    return os.environ.get(
+        "RAY_TPU_CLUSTER_STATE_ROOT",
+        os.path.expanduser("~/.ray_tpu/clusters"))
+
+_KNOWN_TOP_KEYS = {
+    "cluster_name", "max_workers", "provider", "available_node_types",
+    "head_node_type", "idle_timeout_minutes",
+    "initialization_commands", "setup_commands",
+    "head_setup_commands", "worker_setup_commands",
+    "head_start_ray_commands", "worker_start_ray_commands",
+}
+
+
+def load_cluster_config(path_or_dict) -> dict:
+    """Parse + validate a cluster YAML (reference: ray-schema.json's
+    required fields, validated here without jsonschema)."""
+    if isinstance(path_or_dict, dict):
+        config = dict(path_or_dict)
+    else:
+        with open(path_or_dict) as f:
+            config = yaml.safe_load(f) or {}
+    unknown = set(config) - _KNOWN_TOP_KEYS
+    if unknown:
+        raise ValueError(
+            f"unknown cluster-config keys: {sorted(unknown)} "
+            f"(known: {sorted(_KNOWN_TOP_KEYS)})")
+    config.setdefault("cluster_name", "default")
+    config.setdefault("max_workers", 8)
+    config.setdefault("provider", {"type": "local"})
+    node_types = config.get("available_node_types")
+    if not node_types:
+        node_types = {"worker": {"resources": {"CPU": 2},
+                                 "min_workers": 0,
+                                 "max_workers": config["max_workers"]}}
+        config["available_node_types"] = node_types
+    for name, nt in node_types.items():
+        if "resources" not in nt:
+            raise ValueError(
+                f"node type {name!r} needs a 'resources' mapping")
+        nt.setdefault("min_workers", 0)
+        nt.setdefault("max_workers", config["max_workers"])
+    return config
+
+
+def _state_path(cluster_name: str) -> str:
+    return os.path.join(_state_root(), f"{cluster_name}.json")
+
+
+def _save_state(state: dict) -> None:
+    os.makedirs(_state_root(), exist_ok=True)
+    with open(_state_path(state["cluster_name"]), "w") as f:
+        json.dump(state, f, indent=2)
+
+
+def load_cluster_state(cluster_name: str) -> dict | None:
+    try:
+        with open(_state_path(cluster_name)) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def make_provider(config: dict, head_address: str):
+    """Provider registry + the reference's external-module escape
+    hatch (provider.type="external", provider.module="pkg.mod:Cls")."""
+    from ray_tpu.autoscaler.node_provider import LocalDaemonNodeProvider
+
+    prov = config.get("provider") or {"type": "local"}
+    ptype = prov.get("type", "local")
+    if ptype == "local":
+        return LocalDaemonNodeProvider(
+            head_address, pool_size=int(prov.get("pool_size", 2)))
+    if ptype == "external":
+        module_path = prov.get("module", "")
+        if ":" not in module_path:
+            raise ValueError(
+                "provider.type=external needs provider.module="
+                "'pkg.mod:ClassName'")
+        import importlib
+
+        mod_name, cls_name = module_path.split(":", 1)
+        cls = getattr(importlib.import_module(mod_name), cls_name)
+        return cls(head_address,
+                   **{k: v for k, v in prov.items()
+                      if k not in ("type", "module")})
+    raise ValueError(
+        f"unknown provider type {ptype!r} (builtin: local, external)")
+
+
+def _run_commands(commands: list | None, phase: str) -> None:
+    for cmd in commands or []:
+        proc = subprocess.run(cmd, shell=True, capture_output=True,
+                              text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{phase} command failed ({cmd!r}): "
+                f"{(proc.stderr or proc.stdout)[-2000:]}")
+
+
+def _spawn_head(config: dict, session_dir: str) -> tuple[int, str]:
+    """Start the head daemon (GCS + dashboard + head executor node) and
+    wait for its advertised address."""
+    from ray_tpu._private.node import daemon_child_env
+
+    env = daemon_child_env({"RAY_TPU_SESSION_DIR": session_dir})
+    os.makedirs(session_dir, exist_ok=True)
+    addr_file = os.path.join(session_dir, "head_address")
+    # A leftover address file from an earlier head in a reused session
+    # dir would be read as the NEW head's address before it writes its
+    # own — always start clean.
+    for stale in (addr_file,
+                  os.path.join(session_dir, "gcs_snapshot.pkl")):
+        try:
+            os.unlink(stale)
+        except OSError:
+            pass
+    head_type = config.get("head_node_type")
+    resources = None
+    if head_type:
+        resources = dict(
+            config["available_node_types"][head_type]["resources"])
+    with open(os.path.join(session_dir, "head.log"), "ab") as log:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.node", "head",
+             json.dumps({"port": 0, "resources": resources})],
+            env=env, stdout=log, stderr=subprocess.STDOUT,
+            start_new_session=True)
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"head daemon exited during startup "
+                f"(see {session_dir}/head.log)")
+        try:
+            with open(addr_file) as f:
+                address = f.read().strip()
+            if address:
+                return proc.pid, address
+        except OSError:
+            pass
+        time.sleep(0.25)
+    _term(proc.pid)
+    raise TimeoutError("head daemon never advertised its address")
+
+
+def create_or_update_cluster(config_or_path, *,
+                             start_autoscaler: bool = False) -> dict:
+    """`up`: head + per-type min_workers (reference:
+    commands.create_or_update_cluster). Returns the persisted state:
+    {cluster_name, head_pid, head_address, session_dir, workers}.
+
+    ``start_autoscaler=True`` additionally runs a StandardAutoscaler in
+    THIS process against the launched cluster (the reference runs it in
+    the head's monitor daemon; embedding keeps `up` self-contained for
+    programmatic use — long-lived operation should hold the returned
+    handle's .autoscaler).
+    """
+    config = load_cluster_config(config_or_path)
+    name = config["cluster_name"]
+    existing = load_cluster_state(name)
+    if existing and _pid_is_ray_daemon(existing.get("head_pid")):
+        state = existing  # idempotent re-up: reuse the running head
+    else:
+        _run_commands(config.get("initialization_commands"),
+                      "initialization")
+        _run_commands(config.get("setup_commands"), "setup")
+        _run_commands(config.get("head_setup_commands"), "head_setup")
+        # Unique per up: a reused dir would feed the new head stale
+        # snapshot/address artifacts from the previous one.
+        session_dir = os.path.join(
+            _state_root(), f"session_{name}_{os.urandom(4).hex()}")
+        head_pid, head_address = _spawn_head(config, session_dir)
+        state = {"cluster_name": name, "head_pid": head_pid,
+                 "head_address": head_address,
+                 "session_dir": session_dir, "workers": []}
+        _save_state(state)
+
+    provider = make_provider(config, state["head_address"])
+    _run_commands(config.get("worker_setup_commands"), "worker_setup")
+    try:
+        for type_name, nt in config["available_node_types"].items():
+            want = int(nt.get("min_workers", 0))
+            have = sum(1 for w in state["workers"]
+                       if w.get("node_type") == type_name
+                       and _worker_alive(state, w))
+            for _ in range(max(0, want - have)):
+                node_id = provider.create_node(type_name,
+                                               dict(nt["resources"]))
+                if node_id is None:
+                    raise RuntimeError(
+                        f"provider failed to launch a {type_name!r} "
+                        f"worker")
+                meta = provider.node_metadata(node_id)
+                state["workers"].append({
+                    "node_type": type_name,
+                    "node_id": node_id.hex(),
+                    "pid": meta.get("pid"),
+                })
+                # Persist per launch: a later failure must not orphan
+                # the daemons already started.
+                _save_state(state)
+    finally:
+        _save_state(state)
+
+    handle = dict(state)
+    handle["provider"] = provider
+    if start_autoscaler:
+        import ray_tpu
+        from ray_tpu._private.worker import global_runtime
+        from ray_tpu.autoscaler.autoscaler import (
+            NodeTypeConfig,
+            StandardAutoscaler,
+        )
+
+        existing_rt = global_runtime()
+        connected = getattr(existing_rt, "gcs_client", None)
+        if existing_rt is not None and (
+                connected is None
+                or connected.address != state["head_address"]):
+            # ignore_reinit_error would hand back THAT runtime and the
+            # autoscaler would scale this cluster from another
+            # cluster's demand.
+            raise RuntimeError(
+                "start_autoscaler=True requires a runtime connected to "
+                f"this cluster ({state['head_address']}), but one is "
+                "already initialized elsewhere; call "
+                "ray_tpu.shutdown() first")
+        runtime = ray_tpu.init(
+            ignore_reinit_error=True, num_cpus=0,
+            address=state["head_address"])
+        # min_workers are already satisfied by the manual launch above
+        # (and recorded in the state file for `down`); the embedded
+        # autoscaler only scales BEYOND them on demand, with its max
+        # reduced by what is already running. Programmatic holders own
+        # its lifecycle (handle["autoscaler"].shutdown() +
+        # handle["provider"].shutdown()).
+        launched = {
+            n: sum(1 for w in state["workers"]
+                   if w.get("node_type") == n
+                   and _worker_alive(state, w))
+            for n in config["available_node_types"]}
+        node_types = [
+            NodeTypeConfig(
+                name=n, resources=dict(nt["resources"]),
+                min_workers=0,
+                max_workers=max(0, int(nt.get("max_workers", 1))
+                                - launched[n]))
+            for n, nt in config["available_node_types"].items()]
+        handle["autoscaler"] = StandardAutoscaler(
+            runtime, node_types, provider=provider,
+            idle_timeout_s=60.0 * float(
+                config.get("idle_timeout_minutes", 5))).start()
+    return handle
+
+
+def _pid_alive(pid) -> bool:
+    if not pid:
+        return False
+    try:
+        os.kill(int(pid), 0)
+        return True
+    except (OSError, ValueError):
+        return False
+
+
+def _pid_is_ray_daemon(pid) -> bool:
+    """Alive AND actually one of ours: PIDs recycle, and an arbitrarily
+    old state file must never cause an unrelated process to be adopted
+    as the head (or SIGKILLed by `down`)."""
+    if not _pid_alive(pid):
+        return False
+    try:
+        with open(f"/proc/{int(pid)}/cmdline", "rb") as f:
+            cmdline = f.read()
+        return b"ray_tpu" in cmdline
+    except OSError:
+        # No /proc (non-Linux): fall back to liveness only.
+        return True
+
+
+def _worker_alive(state: dict, worker: dict) -> bool:
+    """A recorded worker counts as running if its local pid checks out,
+    or — for providers without local pids (external/cloud) — if the
+    head's node table still lists its node as alive."""
+    if worker.get("pid"):
+        return _pid_is_ray_daemon(worker["pid"])
+    node_hex = worker.get("node_id")
+    if not node_hex:
+        return False
+    from ray_tpu._private.rpc import RpcClient, RpcError
+
+    client = RpcClient(state["head_address"], timeout_s=5.0)
+    try:
+        for node in client.call("list_nodes"):
+            if node.get("node_id") == node_hex:
+                return bool(node.get("alive"))
+    except (RpcError, OSError):
+        pass
+    finally:
+        client.close()
+    return False
+
+
+def teardown_cluster(config_or_path) -> int:
+    """`down`: SIGTERM the recorded workers then the head; removes the
+    state file. Returns how many processes were signaled."""
+    config = load_cluster_config(config_or_path)
+    state = load_cluster_state(config["cluster_name"])
+    if state is None:
+        return 0
+    signaled = 0
+    for worker in state.get("workers", []):
+        if _pid_is_ray_daemon(worker.get("pid")):
+            _term(worker["pid"])
+            signaled += 1
+    if _pid_is_ray_daemon(state.get("head_pid")):
+        _term(state["head_pid"])
+        signaled += 1
+    try:
+        os.unlink(_state_path(config["cluster_name"]))
+    except OSError:
+        pass
+    return signaled
+
+
+def _reap_if_child(pid: int) -> None:
+    """Collect the exit status when ``pid`` is OUR child — a SIGTERM'd
+    child stays a zombie (kill(pid, 0) still succeeds) until waited."""
+    try:
+        os.waitpid(int(pid), os.WNOHANG)
+    except (ChildProcessError, OSError):
+        pass  # not our child (CLI `down` in a fresh process) — fine
+
+
+def _term(pid: int, timeout_s: float = 10.0) -> None:
+    try:
+        os.kill(int(pid), signal.SIGTERM)
+    except OSError:
+        return
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        _reap_if_child(pid)
+        if not _pid_alive(pid):
+            return
+        time.sleep(0.1)
+    try:
+        os.kill(int(pid), signal.SIGKILL)
+    except OSError:
+        pass
+    _reap_if_child(pid)

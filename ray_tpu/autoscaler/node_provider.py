@@ -27,6 +27,12 @@ class NodeProvider:
     def non_terminated_nodes(self) -> list[NodeID]:
         raise NotImplementedError
 
+    def node_metadata(self, node_id: NodeID) -> dict:
+        """Provider-specific facts about a launched node (e.g. local
+        pid, instance id) — consumed by the cluster launcher's state
+        file so `down` works from a fresh process."""
+        return {}
+
 
 class VirtualNodeProvider(NodeProvider):
     """Adds/removes virtual nodes on the live runtime."""
@@ -89,15 +95,10 @@ class LocalDaemonNodeProvider(NodeProvider):
 
         from ray_tpu._private.rpc import RpcClient, RpcError
 
+        from ray_tpu._private.node import daemon_child_env
+
         tag = f"as-{os.urandom(6).hex()}"
-        env = dict(os.environ)
-        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
-        prior = env.get("PYTHONPATH", "")
-        if pkg_root not in prior.split(os.pathsep):
-            env["PYTHONPATH"] = (
-                pkg_root + (os.pathsep + prior if prior else ""))
-        env.setdefault("RAY_TPU_SKIP_TPU_DETECTION", "1")
+        env = daemon_child_env()
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.node", "worker",
              json.dumps({"gcs_address": self._head,
@@ -155,6 +156,11 @@ class LocalDaemonNodeProvider(NodeProvider):
         with self._lock:
             return [nid for nid, proc in self._procs.items()
                     if proc.poll() is None]
+
+    def node_metadata(self, node_id: NodeID) -> dict:
+        with self._lock:
+            proc = self._procs.get(node_id)
+        return {"pid": proc.pid} if proc is not None else {}
 
     def shutdown(self) -> None:
         with self._lock:

@@ -72,21 +72,14 @@ class Cluster:
                  heartbeat_period_s: float | None = None) -> NodeHandle:
         """Start a worker-node daemon (executor service + worker pool)
         as a real OS process (reference: cluster_utils.add_node)."""
+        from ray_tpu._private.node import daemon_child_env
+
         node_resources = {"CPU": float(num_cpus)}
         node_resources.update(resources or {})
         extra_kwargs = {}
         if heartbeat_period_s is not None:
             extra_kwargs["heartbeat_period_s"] = heartbeat_period_s
-        child_env = dict(os.environ)
-        # The daemon must resolve THIS checkout's ray_tpu even when the
-        # package isn't installed (tests run from the repo).
-        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        prior = child_env.get("PYTHONPATH", "")
-        if pkg_root not in prior.split(os.pathsep):
-            child_env["PYTHONPATH"] = (
-                pkg_root + (os.pathsep + prior if prior else ""))
-        child_env.setdefault("RAY_TPU_SKIP_TPU_DETECTION", "1")
-        child_env.update(env or {})
+        child_env = daemon_child_env(env)
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.node", "worker",
              json.dumps({"gcs_address": self.address,

@@ -12,6 +12,8 @@ dashboard/modules/job/cli.py). Usage::
     python -m ray_tpu job {status,logs,stop} SUBMISSION_ID
     python -m ray_tpu job list
     python -m ray_tpu list {tasks,actors,objects,nodes,...}  # state CLI
+    python -m ray_tpu up cluster.yaml                  # YAML launcher
+    python -m ray_tpu down cluster.yaml
 """
 
 from __future__ import annotations
@@ -210,6 +212,29 @@ def cmd_job(args) -> int:
     return 1
 
 
+def cmd_up(args) -> int:
+    from ray_tpu.autoscaler.commands import create_or_update_cluster
+
+    state = create_or_update_cluster(args.config)
+    print(f"cluster {state['cluster_name']!r} up: "
+          f"head {state['head_address']} (pid {state['head_pid']}), "
+          f"{len(state['workers'])} worker daemon(s)")
+    print(f"  connect: ray_tpu.init(address={state['head_address']!r})")
+    return 0
+
+
+def cmd_down(args) -> int:
+    from ray_tpu.autoscaler.commands import (
+        load_cluster_config,
+        teardown_cluster,
+    )
+
+    name = load_cluster_config(args.config)["cluster_name"]
+    n = teardown_cluster(args.config)
+    print(f"cluster {name!r}: stopped {n} process(es)")
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     # `list ...` routes to the state CLI (ray_tpu/util/state).
@@ -234,6 +259,16 @@ def main(argv: list[str] | None = None) -> int:
     p_status = sub.add_parser("status", help="show cluster nodes/resources")
     p_status.add_argument("--address", default=None)
     p_status.set_defaults(fn=cmd_status)
+
+    p_up = sub.add_parser(
+        "up", help="create/update a cluster from a YAML config")
+    p_up.add_argument("config")
+    p_up.set_defaults(fn=cmd_up)
+
+    p_down = sub.add_parser(
+        "down", help="tear down a YAML-launched cluster")
+    p_down.add_argument("config")
+    p_down.set_defaults(fn=cmd_down)
 
     p_job = sub.add_parser("job", help="job submission API")
     jsub = p_job.add_subparsers(dest="job_cmd", required=True)

@@ -1,0 +1,148 @@
+"""YAML cluster launcher (`up`/`down`) — reference:
+autoscaler/commands.py + ray-schema.json field names."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.autoscaler.commands import (
+    _pid_alive,
+    create_or_update_cluster,
+    load_cluster_config,
+    load_cluster_state,
+    make_provider,
+    teardown_cluster,
+)
+
+
+# ------------------------------------------------------------- config
+def test_load_config_defaults_and_validation(tmp_path):
+    cfg = load_cluster_config({"cluster_name": "c1"})
+    assert cfg["provider"]["type"] == "local"
+    assert "worker" in cfg["available_node_types"]
+    assert cfg["available_node_types"]["worker"]["min_workers"] == 0
+
+    with pytest.raises(ValueError, match="unknown cluster-config"):
+        load_cluster_config({"cluster_nam": "typo"})
+    with pytest.raises(ValueError, match="resources"):
+        load_cluster_config(
+            {"available_node_types": {"w": {"min_workers": 1}}})
+
+    path = tmp_path / "c.yaml"
+    path.write_text(
+        "cluster_name: filecfg\n"
+        "available_node_types:\n"
+        "  small:\n"
+        "    resources: {CPU: 1}\n"
+        "    min_workers: 2\n")
+    cfg = load_cluster_config(str(path))
+    assert cfg["cluster_name"] == "filecfg"
+    assert cfg["available_node_types"]["small"]["min_workers"] == 2
+
+
+def test_external_provider_loading():
+    with pytest.raises(ValueError, match="external"):
+        make_provider({"provider": {"type": "external"}}, "addr")
+    with pytest.raises(ValueError, match="unknown provider"):
+        make_provider({"provider": {"type": "gcp"}}, "addr")
+    # A real external module path loads and receives options.
+    prov = make_provider(
+        {"provider": {"type": "external",
+                      "module": "ray_tpu.autoscaler.node_provider:"
+                                "LocalDaemonNodeProvider",
+                      "pool_size": 3}},
+        "127.0.0.1:1")
+    assert prov._pool_size == 3
+
+
+# --------------------------------------------------------------- up/down
+@pytest.fixture
+def state_root(tmp_path, monkeypatch):
+    root = str(tmp_path / "clusters")
+    # Read at use time by _state_root(), so the env var is enough.
+    monkeypatch.setenv("RAY_TPU_CLUSTER_STATE_ROOT", root)
+    return root
+
+
+def test_up_down_lifecycle(state_root, tmp_path):
+    """`up` starts a head + min workers as real daemons; a driver can
+    connect and run work on them; re-up is idempotent; `down` stops
+    every recorded pid."""
+    marker = tmp_path / "setup_ran"
+    config = {
+        "cluster_name": "launchertest",
+        "provider": {"type": "local", "pool_size": 2},
+        "setup_commands": [f"touch {marker}"],
+        "available_node_types": {
+            "small": {"resources": {"CPU": 1}, "min_workers": 2},
+        },
+    }
+    ray_tpu.shutdown()
+    state = create_or_update_cluster(config)
+    try:
+        assert marker.exists(), "setup_commands never ran"
+        assert _pid_alive(state["head_pid"])
+        assert len(state["workers"]) == 2
+        assert all(_pid_alive(w["pid"]) for w in state["workers"])
+
+        # Idempotent re-up: same head, no extra workers.
+        state2 = create_or_update_cluster(config)
+        assert state2["head_pid"] == state["head_pid"]
+        assert len(state2["workers"]) == 2
+
+        # A driver connects and runs tasks on the launched daemons.
+        ray_tpu.init(num_cpus=0, address=state["head_address"])
+        deadline = time.time() + 30
+        while time.time() < deadline and \
+                ray_tpu.cluster_resources().get("CPU", 0) < 2:
+            time.sleep(0.2)
+
+        @ray_tpu.remote(num_cpus=1)
+        def where():
+            return os.environ.get("RAY_TPU_NODE_TAG", "")
+
+        tags = ray_tpu.get([where.remote() for _ in range(4)],
+                           timeout=60)
+        assert all(tags), "tasks did not run on launched daemons"
+        ray_tpu.shutdown()
+
+        st = load_cluster_state("launchertest")
+        assert st is not None and len(st["workers"]) == 2
+    finally:
+        ray_tpu.shutdown()
+        n = teardown_cluster(config)
+    assert n >= 3  # 2 workers + head
+    for w in state["workers"]:
+        assert not _pid_alive(w["pid"])
+    assert not _pid_alive(state["head_pid"])
+    assert load_cluster_state("launchertest") is None
+
+
+def test_cli_up_down(state_root, tmp_path):
+    cfg_path = tmp_path / "cli.yaml"
+    cfg_path.write_text(
+        "cluster_name: clitest\n"
+        "available_node_types:\n"
+        "  w:\n"
+        "    resources: {CPU: 1}\n"
+        "    min_workers: 1\n")
+    env = dict(os.environ)
+    env["RAY_TPU_CLUSTER_STATE_ROOT"] = state_root
+    env.setdefault("RAY_TPU_SKIP_TPU_DETECTION", "1")
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(
+        ray_tpu.__file__)))
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    up = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "up", str(cfg_path)],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert up.returncode == 0, up.stderr[-2000:]
+    assert "1 worker daemon(s)" in up.stdout
+    down = subprocess.run(
+        [sys.executable, "-m", "ray_tpu", "down", str(cfg_path)],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert down.returncode == 0, down.stderr[-2000:]
+    assert "stopped 2 process(es)" in down.stdout
