@@ -36,6 +36,11 @@ from ray_tpu.rllib.algorithms.multi_agent_ppo import (
 )
 from ray_tpu.rllib.algorithms.pg import A2C, A2CConfig, PG, PGConfig
 from ray_tpu.rllib.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rllib.algorithms.qmix import (
+    QMIX,
+    QMIXConfig,
+    TwoStepCooperativeGame,
+)
 from ray_tpu.rllib.algorithms.r2d2 import GRUQModule, R2D2, R2D2Config
 from ray_tpu.rllib.algorithms.sac import SAC, SACConfig
 from ray_tpu.rllib.algorithms.simple_q import SimpleQ, SimpleQConfig
@@ -129,6 +134,9 @@ __all__ = [
     "PrioritizedReplayBuffer",
     "PrioritizedSequenceReplayBuffer",
     "GRUQModule",
+    "QMIX",
+    "QMIXConfig",
+    "TwoStepCooperativeGame",
     "R2D2",
     "R2D2Config",
     "RLModule",

@@ -331,3 +331,72 @@ def test_r2d2_learns_cartpole():
     assert last["num_learner_steps"] > 0
     assert last.get("episode_return_mean", 0) > 50.0, (
         f"R2D2 failed to learn: {last.get('episode_return_mean')}")
+
+
+# ------------------------------------------------------------- QMIX
+def test_two_step_game_payoffs():
+    from ray_tpu.rllib import TwoStepCooperativeGame
+
+    env = TwoStepCooperativeGame(num_envs=4)
+    obs = env.reset(seed=0)
+    np.testing.assert_array_equal(obs, np.eye(3)[np.zeros(4, int)])
+    # Route: envs 0,1 -> 2A; envs 2,3 -> 2B.
+    obs, rew, done = env.step(np.array([[0, 0], [0, 1], [1, 0], [1, 1]]))
+    assert not done.any() and (rew == 0).all()
+    assert obs[:2, 1].all() and obs[2:, 2].all()
+    # Payoffs: 2A flat 7; 2B = [[0,1],[1,8]].
+    obs, rew, done = env.step(np.array([[0, 0], [1, 1], [0, 0], [1, 1]]))
+    np.testing.assert_array_equal(rew, [7.0, 7.0, 0.0, 8.0])
+    assert done.all()
+    np.testing.assert_array_equal(obs, np.eye(3)[np.zeros(4, int)])
+
+
+def test_qmix_monotonic_mixer_shapes_and_sign():
+    import jax
+
+    from ray_tpu.rllib.algorithms.qmix import QMIXModule
+
+    mod = QMIXModule(observation_size=3, num_actions=2, num_agents=2,
+                     state_size=3, mixing_embed=8)
+    params = mod.init(jax.random.PRNGKey(0))
+    obs = np.random.randn(5, 2, 3).astype(np.float32)
+    q = mod.agent_qs(params, obs)
+    assert q.shape == (5, 2, 2)
+    state = np.random.randn(5, 3).astype(np.float32)
+    base = np.asarray(mod.mix(params, np.zeros((5, 2), np.float32),
+                              state))
+    bumped = np.asarray(mod.mix(params, np.ones((5, 2), np.float32),
+                                state))
+    # Monotonic: raising any agent's utility can never lower Q_tot.
+    assert (bumped >= base - 1e-5).all()
+
+
+def test_qmix_coordinates_on_two_step_game():
+    """The paper's didactic game: the monotonic state-conditioned
+    mixer must reach the coordinated optimum (8 requires both agents
+    picking the risky 2B branch and joint action (1,1)); the VDN
+    ablation must at least train mechanically through the same path.
+    (No strict separation assert: with this payoff the additive fit's
+    argmax can also coordinate, so VDN's final return is seed-noisy.)"""
+    from ray_tpu.rllib import QMIXConfig
+
+    def run(mixer, iters):
+        cfg = QMIXConfig().debugging(seed=1)
+        cfg.mixer = mixer
+        algo = cfg.build()
+        last = {}
+        for _ in range(iters):
+            last = algo.train()
+        algo.cleanup()
+        return last
+
+    # Under the eps_end=0.05 exploration floor a PERFECTLY coordinated
+    # policy samples ~7.6 on average; 7.4 asserts coordination with
+    # headroom for exploration noise across 200 episodes.
+    qmix = run("qmix", 60)
+    assert qmix["episode_return_mean"] > 7.4, (
+        f"QMIX failed to coordinate: {qmix['episode_return_mean']}")
+    vdn = run("vdn", 25)
+    assert vdn["num_learner_steps"] > 0
+    assert vdn["episode_return_mean"] > 6.0, (
+        f"VDN mixer broke training: {vdn['episode_return_mean']}")
