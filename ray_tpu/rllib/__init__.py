@@ -28,6 +28,7 @@ from ray_tpu.rllib.algorithms.bc import (
 from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.crr import CRR, CRRConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rllib.algorithms.dt import DT, DTConfig, DTModule
 from ray_tpu.rllib.algorithms.es import ES, ESConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
 from ray_tpu.rllib.algorithms.multi_agent_ppo import (
@@ -98,6 +99,9 @@ __all__ = [
     "Columns",
     "DQN",
     "DQNConfig",
+    "DT",
+    "DTConfig",
+    "DTModule",
     "DefaultActorCriticModule",
     "FaultTolerantActorManager",
     "CQL",

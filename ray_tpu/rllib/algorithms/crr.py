@@ -27,7 +27,7 @@ from ray_tpu.rllib.algorithms.algorithm import (
     load_offline_rows,
 )
 from ray_tpu.rllib.algorithms.bc import MARWIL, MARWILConfig
-from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.learner import TargetNetworkLearner
 from ray_tpu.rllib.core.rl_module import (
     RLModule,
     _mlp_apply,
@@ -96,12 +96,7 @@ class CRRConfig(MARWILConfig):
         return CRRLearner
 
 
-class CRRLearner(Learner):
-    def __init__(self, module_spec, config=None, mesh=None):
-        super().__init__(module_spec, config, mesh)
-        self.target_params = jax.tree_util.tree_map(
-            jnp.copy, self.params)
-
+class CRRLearner(TargetNetworkLearner):
     def compute_loss(self, params, batch, rng):
         cfg = self.config
         out = self.module.forward_train(
@@ -141,34 +136,6 @@ class CRRLearner(Learner):
                        "critic_loss": critic_loss,
                        "mean_advantage_weight": jnp.mean(weights),
                        "q_mean": jnp.mean(q_taken)}
-
-    def _maybe_refresh_target(self) -> None:
-        if self._steps % getattr(self.config, "target_update_freq",
-                                 100) == 0:
-            self.target_params = jax.tree_util.tree_map(
-                jnp.copy, self.params)
-
-    def update_from_batch(self, batch: SampleBatch,
-                          sync_metrics: bool = True) -> dict:
-        batch = SampleBatch(batch)
-        batch["target_params"] = self.target_params
-        metrics = super().update_from_batch(batch,
-                                            sync_metrics=sync_metrics)
-        self._maybe_refresh_target()
-        return metrics
-
-    def compute_gradients(self, batch: SampleBatch) -> tuple:
-        # The sharded LearnerGroup path calls this directly (bypassing
-        # update_from_batch), so target params must ride in here too —
-        # same contract as DQNLearner.
-        batch = SampleBatch(batch)
-        batch["target_params"] = self.target_params
-        return super().compute_gradients(batch)
-
-    def apply_gradients(self, grads) -> None:
-        super().apply_gradients(grads)
-        self._maybe_refresh_target()
-
 
 def _rows_to_transitions(rows: list[dict]) -> SampleBatch:
     """Offline rows -> (s, a, r, s', done); rows missing next_obs are

@@ -13,7 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.learner import TargetNetworkLearner
 from ray_tpu.rllib.core.rl_module import RLModule, RLModuleSpec, _mlp_apply, _mlp_init
 from ray_tpu.rllib.utils.replay_buffers import (
     PrioritizedReplayBuffer,
@@ -103,12 +103,7 @@ class DQNConfig(AlgorithmConfig):
         return spec
 
 
-class DQNLearner(Learner):
-    def __init__(self, module_spec: RLModuleSpec, config=None, mesh=None):
-        super().__init__(module_spec, config, mesh)
-        self.target_params = jax.tree_util.tree_map(
-            jnp.copy, self.params)
-
+class DQNLearner(TargetNetworkLearner):
     def compute_loss(self, params, batch, rng):
         cfg = self.config
         q = self.module.q_values(params, batch[Columns.OBS])
@@ -137,31 +132,6 @@ class DQNLearner(Learner):
         loss = jnp.mean(weights * jnp.square(td_error))
         return loss, {"td_error_mean": jnp.mean(jnp.abs(td_error)),
                       "q_mean": jnp.mean(q_taken)}
-
-    def update_from_batch(self, batch: SampleBatch,
-                          sync_metrics: bool = True) -> dict:
-        batch = SampleBatch(batch)
-        batch["target_params"] = self.target_params
-        metrics = super().update_from_batch(batch,
-                                            sync_metrics=sync_metrics)
-        if self._steps % getattr(self.config, "target_update_freq", 200) == 0:
-            self.target_params = jax.tree_util.tree_map(
-                jnp.copy, self.params)
-        return metrics
-
-    def compute_gradients(self, batch: SampleBatch) -> tuple:
-        # The actor-based LearnerGroup sharded path calls this directly
-        # (bypassing update_from_batch), so target params must ride in
-        # here too.
-        batch = SampleBatch(batch)
-        batch["target_params"] = self.target_params
-        return super().compute_gradients(batch)
-
-    def apply_gradients(self, grads) -> None:
-        super().apply_gradients(grads)
-        if self._steps % getattr(self.config, "target_update_freq", 200) == 0:
-            self.target_params = jax.tree_util.tree_map(
-                jnp.copy, self.params)
 
     def compute_td_errors(self, batch: SampleBatch) -> np.ndarray:
         """Per-row |TD error| for priority updates (post-update params)."""

@@ -1,0 +1,363 @@
+"""DT — Decision Transformer (offline RL as sequence modeling).
+
+Reference: rllib/algorithms/dt/ (Chen et al. 2021). Trajectories become
+token sequences [R̂_1, s_1, a_1, ..., R̂_K, s_K, a_K] (returns-to-go,
+state, action embeddings with shared timestep embeddings); a small
+causal transformer predicts each action from the tokens before it, and
+at evaluation time the SAME model rolls out autoregressively while the
+user conditions behavior with a target return.
+
+TPU shape: training is one jitted update over [B, 3K] token grids
+(causal masking via a static lower-triangular mask — no dynamic
+shapes); windows are sampled host-side from the offline episodes.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.rllib.algorithms.algorithm import (
+    Algorithm,
+    AlgorithmConfig,
+    load_offline_rows,
+)
+from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.rl_module import RLModule
+from ray_tpu.rllib.utils.sample_batch import SampleBatch
+
+
+def _dense_init(key, fan_in: int, *shape):
+    return jax.random.normal(key, shape) * (1.0 / np.sqrt(fan_in))
+
+
+def _layer_norm(x, scale, bias, eps: float = 1e-5):
+    mean = x.mean(axis=-1, keepdims=True)
+    var = ((x - mean) ** 2).mean(axis=-1, keepdims=True)
+    return (x - mean) / jnp.sqrt(var + eps) * scale + bias
+
+
+class DTModule(RLModule):
+    """Causal transformer over (rtg, state, action) token triples."""
+
+    def __init__(self, observation_size: int, num_actions: int,
+                 context_length: int = 20, embed_dim: int = 64,
+                 num_layers: int = 2, num_heads: int = 4,
+                 max_timestep: int = 1024, **_):
+        self.observation_size = observation_size
+        self.num_actions = num_actions
+        self.context_length = context_length
+        self.embed_dim = embed_dim
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.max_timestep = max_timestep
+
+    def init(self, rng):
+        D, A, S = self.embed_dim, self.num_actions, self.observation_size
+        keys = jax.random.split(rng, 6 + 4 * self.num_layers)
+        params = {
+            "embed_rtg": {"w": _dense_init(keys[0], 1, 1, D),
+                          "b": jnp.zeros((D,))},
+            "embed_state": {"w": _dense_init(keys[1], S, S, D),
+                            "b": jnp.zeros((D,))},
+            "embed_action": {"w": _dense_init(keys[2], A, A, D)},
+            "embed_t": _dense_init(keys[3], D, self.max_timestep, D),
+            "ln_f": {"scale": jnp.ones((D,)), "bias": jnp.zeros((D,))},
+            "head": {"w": _dense_init(keys[4], D, D, A),
+                     "b": jnp.zeros((A,))},
+            "blocks": [],
+        }
+        for i in range(self.num_layers):
+            k1, k2, k3, k4 = jax.random.split(keys[6 + i], 4)
+            params["blocks"].append({
+                "ln1": {"scale": jnp.ones((D,)), "bias": jnp.zeros((D,))},
+                "attn": {"wqkv": _dense_init(k1, D, D, 3 * D),
+                         "wo": _dense_init(k2, D, D, D)},
+                "ln2": {"scale": jnp.ones((D,)), "bias": jnp.zeros((D,))},
+                "mlp": {"w1": _dense_init(k3, D, D, 4 * D),
+                        "b1": jnp.zeros((4 * D,)),
+                        "w2": _dense_init(k4, 4 * D, 4 * D, D),
+                        "b2": jnp.zeros((D,))},
+            })
+        return params
+
+    def _block(self, blk, x, causal_mask):
+        B, T, D = x.shape
+        H = self.num_heads
+        h = _layer_norm(x, blk["ln1"]["scale"], blk["ln1"]["bias"])
+        qkv = h @ blk["attn"]["wqkv"]                       # [B, T, 3D]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, T, H, D // H).transpose(0, 2, 1, 3)
+        k = k.reshape(B, T, H, D // H).transpose(0, 2, 1, 3)
+        v = v.reshape(B, T, H, D // H).transpose(0, 2, 1, 3)
+        scores = q @ k.transpose(0, 1, 3, 2) / np.sqrt(D // H)
+        scores = jnp.where(causal_mask, scores, -1e9)
+        attn = jax.nn.softmax(scores, axis=-1) @ v          # [B,H,T,d]
+        attn = attn.transpose(0, 2, 1, 3).reshape(B, T, D)
+        x = x + attn @ blk["attn"]["wo"]
+        h = _layer_norm(x, blk["ln2"]["scale"], blk["ln2"]["bias"])
+        h = jax.nn.gelu(h @ blk["mlp"]["w1"] + blk["mlp"]["b1"])
+        return x + h @ blk["mlp"]["w2"] + blk["mlp"]["b2"]
+
+    def action_logits(self, params, rtg, obs, actions, timesteps):
+        """rtg [B,K], obs [B,K,S], actions [B,K] (logged; the token at
+        position t is only attended AFTER predicting a_t thanks to the
+        causal mask), timesteps [B,K] -> logits [B,K,A] at the state
+        positions."""
+        B, K = rtg.shape
+        D = self.embed_dim
+        t_emb = params["embed_t"][jnp.clip(
+            timesteps, 0, self.max_timestep - 1)]           # [B,K,D]
+        r_tok = (rtg[..., None] @ params["embed_rtg"]["w"]
+                 + params["embed_rtg"]["b"]) + t_emb
+        s_tok = (obs @ params["embed_state"]["w"]
+                 + params["embed_state"]["b"]) + t_emb
+        a_onehot = jax.nn.one_hot(actions, self.num_actions)
+        a_tok = a_onehot @ params["embed_action"]["w"] + t_emb
+        # Interleave [r_1 s_1 a_1 r_2 s_2 a_2 ...] -> [B, 3K, D].
+        tokens = jnp.stack([r_tok, s_tok, a_tok],
+                           axis=2).reshape(B, 3 * K, D)
+        T = 3 * K
+        causal = jnp.tril(jnp.ones((T, T), dtype=bool))[None, None]
+        x = tokens
+        for blk in params["blocks"]:
+            x = self._block(blk, x, causal)
+        x = _layer_norm(x, params["ln_f"]["scale"],
+                        params["ln_f"]["bias"])
+        # Predict a_t from the STATE token at position 3t+1.
+        state_positions = x[:, 1::3]                        # [B, K, D]
+        return state_positions @ params["head"]["w"] + params[
+            "head"]["b"]
+
+    # RLModule protocol: used by the eval rollout (batch carries the
+    # whole context).
+    def forward_inference(self, params, batch, rng=None):
+        logits = self.action_logits(
+            params, batch["rtg"], batch["obs"], batch["actions"],
+            batch["timesteps"])
+        last = logits[:, -1]
+        return {"action_logits": last,
+                "actions": jnp.argmax(last, axis=-1)}
+
+    forward_exploration = forward_inference
+
+    def forward_train(self, params, batch, rng=None):
+        return {"action_logits": self.action_logits(
+            params, batch["rtg"], batch["obs"], batch["actions"],
+            batch["timesteps"])}
+
+
+class DTConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.module_class = DTModule
+        self.lr = 1e-3
+        self.context_length = 20
+        self.embed_dim = 64
+        self.num_layers = 2
+        self.num_heads = 4
+        self.train_batch_size = 64
+        self.updates_per_iteration = 50
+        self.rtg_scale = 100.0       # returns-to-go normalizer
+        self.input_ = None
+        # Evaluation: greedy autoregressive rollouts conditioned on
+        # this target return (reference: dt evaluation).
+        self.target_return = 200.0
+        self.evaluation_num_episodes = 0
+        self.max_eval_steps = 500
+
+    def offline_data(self, input_) -> "DTConfig":
+        self.input_ = input_
+        return self
+
+    def evaluation(self, *, evaluation_num_episodes: int | None = None,
+                   target_return: float | None = None) -> "DTConfig":
+        if evaluation_num_episodes is not None:
+            self.evaluation_num_episodes = evaluation_num_episodes
+        if target_return is not None:
+            self.target_return = target_return
+        return self
+
+    def learner_class(self):
+        return DTLearner
+
+    def module_spec(self):
+        spec = super().module_spec()
+        spec.model_config.setdefault("context_length",
+                                     self.context_length)
+        spec.model_config.setdefault("embed_dim", self.embed_dim)
+        spec.model_config.setdefault("num_layers", self.num_layers)
+        spec.model_config.setdefault("num_heads", self.num_heads)
+        return spec
+
+
+class DTLearner(Learner):
+    """Masked cross-entropy on logged actions at every context
+    position (reference: dt/dt_torch_policy.py loss)."""
+
+    def compute_loss(self, params, batch, rng):
+        logits = self.module.action_logits(
+            params, batch["rtg"], batch["obs"], batch["actions"],
+            batch["timesteps"])                             # [B,K,A]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        picked = jnp.take_along_axis(
+            logp, batch["actions"][..., None].astype(jnp.int32),
+            axis=-1)[..., 0]
+        mask = batch["mask"].astype(jnp.float32)
+        loss = -(picked * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        acc = ((logits.argmax(-1) == batch["actions"]) * mask).sum() \
+            / jnp.maximum(mask.sum(), 1.0)
+        return loss, {"action_ce": loss, "action_accuracy": acc}
+
+
+def _episodes_from_rows(rows: list[dict], rtg_scale: float) -> list[dict]:
+    """Offline rows -> episodes with per-step returns-to-go."""
+    episodes, cur = [], []
+    for row in rows:
+        cur.append(row)
+        if row.get("terminateds") or row.get("truncateds"):
+            episodes.append(cur)
+            cur = []
+    if cur:
+        episodes.append(cur)
+    out = []
+    for ep in episodes:
+        rewards = np.asarray([float(r.get("rewards", 0.0))
+                              for r in ep], dtype=np.float32)
+        rtg = np.cumsum(rewards[::-1])[::-1] / rtg_scale
+        out.append({
+            "obs": np.asarray([r["obs"] for r in ep], dtype=np.float32),
+            "actions": np.asarray([r["actions"] for r in ep]),
+            "rtg": rtg.astype(np.float32),
+            "timesteps": np.arange(len(ep), dtype=np.int32),
+        })
+    return out
+
+
+class DT(Algorithm):
+    config_class = DTConfig
+
+    def setup(self, config: dict) -> None:
+        cfg = self.algo_config
+        if cfg.num_learners > 0:
+            raise ValueError("DT runs on a local learner")
+        super().setup(config)
+        self._episodes = _episodes_from_rows(
+            load_offline_rows(cfg.input_), cfg.rtg_scale)
+        if not self._episodes:
+            raise ValueError("DT: offline input produced no episodes")
+        # Sample episodes proportional to length (every timestep
+        # equally likely — reference dt's SegmentationBuffer).
+        lens = np.asarray([len(e["actions"]) for e in self._episodes])
+        self._ep_probs = lens / lens.sum()
+        self._rng = np.random.default_rng(cfg.seed)
+        self._learner_steps = 0
+        # Built once: the jitted eval fn closes over this module.
+        self.module = self.module_spec.build()
+
+    def _build_env_runners(self, cfg):
+        self.local_env_runner = None  # offline; eval rolls out itself
+        return None
+
+    def _sync_weights(self) -> None:
+        self._weights_version += 1
+
+    def _runner_metrics(self) -> dict:
+        return {}
+
+    def _sample_windows(self, batch_size: int) -> SampleBatch:
+        cfg = self.algo_config
+        K = cfg.context_length
+        S = self.module_spec.observation_size
+        cols = {"rtg": np.zeros((batch_size, K), np.float32),
+                "obs": np.zeros((batch_size, K, S), np.float32),
+                "actions": np.zeros((batch_size, K), np.int64),
+                "timesteps": np.zeros((batch_size, K), np.int32),
+                "mask": np.zeros((batch_size, K), np.float32)}
+        ep_idx = self._rng.choice(len(self._episodes), size=batch_size,
+                                  p=self._ep_probs)
+        for i, ei in enumerate(ep_idx):
+            ep = self._episodes[ei]
+            L = len(ep["actions"])
+            end = int(self._rng.integers(1, L + 1))
+            start = max(0, end - K)
+            n = end - start
+            # RIGHT-align so the prediction target sits at the last
+            # position (same layout the eval rollout feeds).
+            cols["rtg"][i, K - n:] = ep["rtg"][start:end]
+            cols["obs"][i, K - n:] = ep["obs"][start:end]
+            cols["actions"][i, K - n:] = ep["actions"][start:end]
+            cols["timesteps"][i, K - n:] = ep["timesteps"][start:end]
+            cols["mask"][i, K - n:] = 1.0
+        return SampleBatch(cols)
+
+    def training_step(self) -> dict:
+        cfg = self.algo_config
+        metrics: dict = {}
+        for _ in range(cfg.updates_per_iteration):
+            metrics = self.learner_group.update_from_batch(
+                self._sample_windows(cfg.train_batch_size))
+            self._learner_steps += 1
+        results = dict(metrics)
+        results["num_learner_steps"] = self._learner_steps
+        if cfg.evaluation_num_episodes > 0:
+            results["evaluation_return_mean"] = self._evaluate(cfg)
+        return results
+
+    def _evaluate(self, cfg) -> float:
+        """Greedy autoregressive rollouts conditioned on the target
+        return (reference: dt eval loop). B parallel env lanes, one
+        jitted forward per step over the K-window context."""
+        from ray_tpu.rllib.env.vector_env import make_vector_env
+
+        module = self.module
+        params = self.learner_group.get_weights()
+        if not hasattr(self, "_eval_fn"):
+            self._eval_fn = jax.jit(
+                lambda p, b: module.forward_inference(p, b))
+        K = cfg.context_length
+        env = make_vector_env(cfg.env, cfg.evaluation_num_episodes)
+        B = env.num_envs
+        S = self.module_spec.observation_size
+        obs = env.reset(seed=cfg.seed + 17)
+        hist = {"rtg": np.zeros((B, 0), np.float32),
+                "obs": np.zeros((B, 0, S), np.float32),
+                "actions": np.zeros((B, 0), np.int64),
+                "timesteps": np.zeros((B, 0), np.int32)}
+        rtg_left = np.full(B, cfg.target_return / cfg.rtg_scale,
+                           np.float32)
+        totals = np.zeros(B)
+        alive = np.ones(B, bool)
+        for t in range(cfg.max_eval_steps):
+            hist["rtg"] = np.concatenate(
+                [hist["rtg"], rtg_left[:, None]], axis=1)[:, -K:]
+            hist["obs"] = np.concatenate(
+                [hist["obs"], obs[:, None]], axis=1)[:, -K:]
+            # Current step's action token is unknown: feed 0 (masked by
+            # causality — position 3t+1 never attends to it).
+            hist["actions"] = np.concatenate(
+                [hist["actions"], np.zeros((B, 1), np.int64)],
+                axis=1)[:, -K:]
+            hist["timesteps"] = np.concatenate(
+                [hist["timesteps"],
+                 np.full((B, 1), min(t, module.max_timestep - 1),
+                         np.int32)],
+                axis=1)[:, -K:]
+            n = hist["rtg"].shape[1]
+            pad = K - n
+            batch = {k: np.pad(v, ((0, 0), (pad, 0)) + ((0, 0),) * (
+                v.ndim - 2)) for k, v in hist.items()}
+            out = self._eval_fn(params, batch)
+            actions = np.asarray(out["actions"])
+            hist["actions"][:, -1] = actions
+            obs, rewards, term, trunc = env.step(actions)
+            totals += rewards * alive
+            rtg_left = rtg_left - (rewards / cfg.rtg_scale) * alive
+            alive &= ~(term | trunc)
+            if not alive.any():
+                break
+        return float(np.mean(totals))
+
+
+DTConfig.algo_class = DT

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ray_tpu.rllib.algorithms.algorithm import Algorithm, AlgorithmConfig
-from ray_tpu.rllib.core.learner import Learner
+from ray_tpu.rllib.core.learner import TargetNetworkLearner
 from ray_tpu.rllib.core.rl_module import (
     RLModule,
     _mlp_apply,
@@ -179,13 +179,8 @@ def _reset_mask(terminateds, truncateds):
         [jnp.zeros_like(done[:1]), done[:-1]], axis=0)
 
 
-class R2D2Learner(Learner):
+class R2D2Learner(TargetNetworkLearner):
     batch_axis = 1  # [T, B]: shard over sequences, scan stays local
-
-    def __init__(self, module_spec, config=None, mesh=None):
-        super().__init__(module_spec, config, mesh)
-        self.target_params = jax.tree_util.tree_map(
-            jnp.copy, self.params)
 
     def compute_loss(self, params, batch, rng):
         cfg = self.config
@@ -236,22 +231,14 @@ class R2D2Learner(Learner):
                       "q_mean": jnp.mean(q_taken),
                       "seq_priority": seq_priority}
 
-    def _maybe_refresh_target(self) -> None:
-        if self._steps % getattr(self.config, "target_update_freq",
-                                 100) == 0:
-            self.target_params = jax.tree_util.tree_map(
-                jnp.copy, self.params)
-
     def update_from_batch(self, batch: SampleBatch,
                           sync_metrics: bool = True) -> dict:
-        batch = SampleBatch(batch)
-        batch["target_params"] = self.target_params
-        metrics = dict(Learner.update_from_batch(
-            self, batch, sync_metrics=False))
-        self._maybe_refresh_target()
-        # The per-sequence priority ARRAY rides out through the metrics
-        # pytree (one transfer with everything else), stashed for
-        # get_last_seq_priorities — never float()-coerced.
+        # Target injection + refresh come from TargetNetworkLearner;
+        # this override only peels the per-sequence priority ARRAY out
+        # of the metrics pytree (one transfer with everything else,
+        # stashed for get_last_seq_priorities — never float()-coerced).
+        metrics = dict(super().update_from_batch(
+            batch, sync_metrics=False))
         prio = metrics.pop("seq_priority", None)
         self._last_seq_priorities = (np.asarray(prio)
                                      if prio is not None else None)
@@ -262,15 +249,6 @@ class R2D2Learner(Learner):
 
     def get_last_seq_priorities(self):
         return getattr(self, "_last_seq_priorities", None)
-
-    def compute_gradients(self, batch: SampleBatch) -> tuple:
-        batch = SampleBatch(batch)
-        batch["target_params"] = self.target_params
-        return super().compute_gradients(batch)
-
-    def apply_gradients(self, grads) -> None:
-        super().apply_gradients(grads)
-        self._maybe_refresh_target()
 
 
 class R2D2(Algorithm):

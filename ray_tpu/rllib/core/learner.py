@@ -197,6 +197,49 @@ class Learner:
         self._steps = state.get("steps", 0)
 
 
+class TargetNetworkLearner(Learner):
+    """Learner with a periodically-refreshed target network.
+
+    The target params ride INSIDE each batch so the jitted update stays
+    a pure function of its inputs (a closed-over pytree would be baked
+    in as a compile-time constant and never update), and both the
+    direct path (update_from_batch) and the sharded LearnerGroup path
+    (compute_gradients/apply_gradients, which bypasses
+    update_from_batch) inject + refresh identically. Shared by DQN,
+    CRR, QMIX, and R2D2 (reference: each torch learner carries its own
+    TargetNetworkAPI implementation)."""
+
+    def __init__(self, module_spec, config=None, mesh=None):
+        super().__init__(module_spec, config, mesh)
+        self.target_params = jax.tree_util.tree_map(
+            jnp.copy, self.params)
+
+    def _maybe_refresh_target(self) -> None:
+        if self._steps % getattr(self.config, "target_update_freq",
+                                 100) == 0:
+            self.target_params = jax.tree_util.tree_map(
+                jnp.copy, self.params)
+
+    def _with_target(self, batch: SampleBatch) -> SampleBatch:
+        batch = SampleBatch(batch)
+        batch["target_params"] = self.target_params
+        return batch
+
+    def update_from_batch(self, batch: SampleBatch,
+                          sync_metrics: bool = True) -> dict:
+        metrics = super().update_from_batch(
+            self._with_target(batch), sync_metrics=sync_metrics)
+        self._maybe_refresh_target()
+        return metrics
+
+    def compute_gradients(self, batch: SampleBatch) -> tuple:
+        return super().compute_gradients(self._with_target(batch))
+
+    def apply_gradients(self, grads) -> None:
+        super().apply_gradients(grads)
+        self._maybe_refresh_target()
+
+
 JaxLearner = Learner  # the only framework here is JAX
 
 
