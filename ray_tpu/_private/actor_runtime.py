@@ -137,6 +137,9 @@ class LocalActor:
             if call is None:
                 return
             self._execute(call)
+            # Unbind before re-blocking: a stale frame local would keep
+            # the last call's args (and any nested ObjectRefs) alive.
+            call = None
 
     def _run_threadpool(self) -> None:
         from concurrent.futures import ThreadPoolExecutor
@@ -147,6 +150,7 @@ class LocalActor:
                 if call is None:
                     return
                 pool.submit(self._execute, call)
+                call = None  # don't retain across the blocking get
 
     def _run_async_loop(self) -> None:
         loop = asyncio.new_event_loop()

@@ -294,6 +294,9 @@ class RemoteActor:
             if call is None:
                 return
             self._dispatch_call(call)
+            # Unbind before re-blocking: a stale frame local would keep
+            # the last call's args (and any nested ObjectRefs) alive.
+            call = None
 
     def _run_concurrent(self) -> None:
         from concurrent.futures import ThreadPoolExecutor
@@ -306,6 +309,7 @@ class RemoteActor:
                 if call is None:
                     return
                 pool.submit(self._dispatch_call, call)
+                call = None  # don't retain across the blocking get
 
     def _dispatch_call(self, call) -> None:
         from ray_tpu._private.rpc import RpcError, RpcMethodError

@@ -39,6 +39,13 @@ class RpcMethodError(Exception):
         self.cause = cause
         self.remote_tb = remote_tb
 
+    def __reduce__(self):
+        # Exception's default reduce re-calls __init__ with args=(the
+        # formatted message,) — one argument short; an RpcMethodError
+        # crossing ANOTHER pickle boundary (e.g. stored as a task error
+        # and shipped to a different process) must round-trip.
+        return (RpcMethodError, (self.cause, self.remote_tb))
+
 
 def _send_frame(sock: socket.socket, payload: bytes) -> None:
     sock.sendall(_LEN.pack(len(payload)) + payload)

@@ -21,6 +21,7 @@ fan-out workloads. Actors opt into a dedicated worker process with
 from __future__ import annotations
 
 import atexit
+import collections
 import concurrent.futures
 import logging
 import os
@@ -154,6 +155,9 @@ class Runtime:
         self._actor_queues: dict[ActorID, Any] = {}
         self._foreign_proxies: dict[tuple[str, str], Any] = {}
         self._actor_leases: dict[ActorID, tuple[NodeID, dict, Any]] = {}
+        # (deadline, [refs]) grace pins for nested args of in-flight
+        # submissions (see _pin_nested_arg_refs).
+        self._arg_pin_pen: collections.deque = collections.deque()
         self._placement_record_lock = threading.Lock()
         self._futures_lock = threading.Lock()
         self._futures: dict[ObjectID, list[concurrent.futures.Future]] = {}
@@ -278,6 +282,11 @@ class Runtime:
         # Refcount-zero eviction must also drop directory + lineage
         # entries, or they leak for the runtime's lifetime.
         self.reference_counter.on_evict = self._forget_object
+        # Grace pins expire on TIME, not on the next submission: an
+        # idle driver must still let its last pens lapse so normal
+        # refcounting can free the objects.
+        threading.Thread(target=self._arg_pin_sweeper, daemon=True,
+                         name="ray_tpu-arg-pin-sweeper").start()
         self.health_monitor = NodeHealthMonitor(
             self.gcs, period_s=cfg.health_check_period_ms / 1000.0,
             failure_threshold=cfg.health_check_failure_threshold,
@@ -636,6 +645,52 @@ class Runtime:
 
     # ----------------------------------------------------------------- tasks
 
+    _ARG_PIN_GRACE_S = 10.0
+
+    def _pin_nested_arg_refs(self, args, kwargs) -> None:
+        """Hold handles to refs NESTED in submitted args for a grace
+        period. Nested refs aren't resolved by the submitter — the
+        callee registers as a borrower — but that registration is
+        asynchronous; without this pin, a driver that drops its own
+        handle right after submit can free the object before the
+        borrow lands (reference: the owner keeps in-flight task args
+        reachable while the borrower list is being established,
+        reference_count.h:61)."""
+        refs: list = []
+
+        def walk(v, depth=0):
+            if isinstance(v, ObjectRef):
+                refs.append(v)
+            elif depth < 8 and type(v) in (list, tuple):
+                for x in v:
+                    walk(x, depth + 1)
+            elif depth < 8 and type(v) is dict:
+                for x in v.values():
+                    walk(x, depth + 1)
+
+        for a in args:
+            walk(a, 1)  # TOP-LEVEL refs resolve before execution
+        for v in kwargs.values():
+            walk(v, 1)
+        if refs:
+            self._arg_pin_pen.append(
+                (time.monotonic() + self._ARG_PIN_GRACE_S, refs))
+
+    def _sweep_arg_pins(self) -> None:
+        now = time.monotonic()
+        while self._arg_pin_pen:
+            deadline, _ = self._arg_pin_pen[0]
+            if deadline > now:
+                break
+            try:
+                self._arg_pin_pen.popleft()
+            except IndexError:
+                break
+
+    def _arg_pin_sweeper(self) -> None:
+        while not self._watcher_stop.wait(1.0):
+            self._sweep_arg_pins()
+
     def submit_task(
         self,
         func,
@@ -652,6 +707,7 @@ class Runtime:
     ) -> list[ObjectRef]:
         """Reference: CoreWorker::SubmitTask (core_worker.cc:1998)."""
         task_id = TaskID()
+        self._pin_nested_arg_refs(args, kwargs)
         return_ids = [ObjectID() for _ in range(num_returns)]
         strategy = scheduling_strategy or SchedulingStrategy()
         spec = TaskSpec(
@@ -1464,6 +1520,7 @@ class Runtime:
         transport/sequential_actor_submit_queue.h).
         """
         return_ids = [ObjectID() for _ in range(max(1, num_returns))]
+        self._pin_nested_arg_refs(args, kwargs)
         for rid in return_ids:
             self.store.create_pending(rid)
         refs = [ObjectRef(rid) for rid in return_ids]
@@ -1506,6 +1563,7 @@ class Runtime:
                     err = ActorDiedError(actor_id, "actor failed to start")
                     for rid in call.return_ids:
                         self.store.put_error(rid, err)
+                    call = None  # see below
                     continue
                 # Resolve ObjectRef args in queue order (blocking keeps order).
                 try:
@@ -1526,8 +1584,14 @@ class Runtime:
                 except BaseException as exc:  # noqa: BLE001
                     for rid in call.return_ids:
                         self.store.put_error(rid, exc)
+                    call = None
                     continue
                 actor.submit(call)
+                # Unbind before blocking in get(): the stale frame
+                # local would otherwise keep the LAST call's args —
+                # and any ObjectRefs nested in them — registered until
+                # the next call arrives, pinning freed objects.
+                call = None
 
         threading.Thread(target=drain, daemon=True,
                          name=f"ray_tpu-actor-submit-{actor_id.hex()[:8]}").start()
@@ -2026,6 +2090,10 @@ class _ForeignActorProxy:
             except BaseException as exc:  # noqa: BLE001
                 self._fail([r for r in return_ids if r not in sealed],
                            exc)
+            # Unbind before re-blocking in get(): stale frame locals
+            # would keep the last call's args (and nested ObjectRefs)
+            # alive until the next call arrives.
+            item = args = kwargs = None
 
 
 # --------------------------------------------------------------------------
@@ -2039,7 +2107,18 @@ def global_runtime():
     if os.environ.get("RAY_TPU_IN_POOL_WORKER"):
         from ray_tpu._private import worker_client
 
-        return worker_client.active_worker_runtime()
+        active = worker_client.active_worker_runtime()
+        if active is not None:
+            return active
+        # Refs can deserialize BEFORE the worker's first explicit API
+        # call (e.g. inside actor-constructor args); borrower
+        # registration needs the proxy runtime to exist at that moment,
+        # so build it eagerly when the driver address is known.
+        if os.environ.get("RAY_TPU_DRIVER_CLIENT_ADDR"):
+            try:
+                return worker_client.get_worker_runtime()
+            except Exception:  # noqa: BLE001 — keep refs inert instead
+                return None
     return None
 
 

@@ -94,20 +94,62 @@ class _ProxyReferenceCounter:
         self._lock = threading.Lock()
         self._counts: dict[ObjectID, int] = {}
         self._deferred: "collections.deque[ObjectID]" = collections.deque()
+        # Borrow registrations flush asynchronously: add_ref runs inside
+        # payload DESERIALIZATION (the RPC reader's stack) where a
+        # nested synchronous RPC would deadlock the connection.
+        self._pending_borrows: "collections.deque[ObjectID]" = \
+            collections.deque()
         threading.Thread(target=self._reap_loop, daemon=True,
                          name="ray_tpu-proxy-ref-reaper").start()
 
+    # Borrow leases expire server-side (RAY_TPU_BORROW_TTL_S, 60s
+    # default) so a killed borrower can't pin objects forever; live
+    # borrowers must therefore keepalive well inside the TTL.
+    _KEEPALIVE_S = float(os.environ.get(
+        "RAY_TPU_BORROW_TTL_S", "60")) / 4
+
     def add_ref(self, object_id: ObjectID) -> None:
         with self._lock:
-            self._counts[object_id] = self._counts.get(object_id, 0) + 1
+            count = self._counts.get(object_id, 0)
+            self._counts[object_id] = count + 1
+            if count == 0:
+                # First handle in this process: register as a borrower
+                # with the owner so the object outlives the owner's own
+                # handles (reference: reference_count.h:61). Queued —
+                # add_ref runs inside payload deserialization on the
+                # RPC reader's stack, where a nested call deadlocks.
+                self._pending_borrows.append(object_id)
 
     def defer_remove(self, object_id: ObjectID) -> None:
         # ONLY an append: even Event.set() takes a lock, which a nested
         # GC __del__ on the same thread could deadlock against.
         self._deferred.append(object_id)
 
+    def _flush_borrows(self, extra: list | None = None) -> None:
+        batch = list(extra or [])
+        with self._lock:
+            while True:
+                try:
+                    batch.append(self._pending_borrows.popleft().hex())
+                except IndexError:
+                    break
+        if batch:
+            try:
+                self._runtime._rpc.call(
+                    "client_borrow", self._runtime.borrower_id, batch)
+            except Exception:  # noqa: BLE001 — pre-borrow heads etc.
+                pass
+
     def _reap_loop(self) -> None:
+        last_keepalive = time.monotonic()
         while True:
+            now = time.monotonic()
+            keepalive = []
+            if now - last_keepalive >= self._KEEPALIVE_S:
+                last_keepalive = now
+                with self._lock:
+                    keepalive = [oid.hex() for oid in self._counts]
+            self._flush_borrows(keepalive)
             try:
                 object_id = self._deferred.popleft()
             except IndexError:
@@ -126,12 +168,22 @@ class _ProxyReferenceCounter:
             if count <= 1:
                 del self._counts[object_id]
                 release = True
+                # A still-queued borrow for this object must never be
+                # sent AFTER the release (it would re-pin a freed key
+                # forever); purge it while we hold the lock.
+                if object_id in self._pending_borrows:
+                    try:
+                        self._pending_borrows.remove(object_id)
+                    except ValueError:
+                        pass
             else:
                 self._counts[object_id] = count - 1
                 release = False
         if release:
             try:
-                self._runtime._rpc.call("client_release", [object_id.hex()])
+                self._runtime._rpc.call(
+                    "client_release", [object_id.hex()],
+                    borrower_id=self._runtime.borrower_id)
             except Exception:  # noqa: BLE001 — interpreter teardown etc.
                 pass
 
@@ -155,6 +207,10 @@ class WorkerModeRuntime:
 
     def __init__(self, address: str):
         self._rpc = RpcClient(address, timeout_s=60.0)
+        # Stable per-process borrower identity: the owner's pin on a
+        # borrowed object is keyed by it, so two worker processes
+        # borrowing the same ref release independently.
+        self.borrower_id = f"worker-{os.getpid()}-{os.urandom(3).hex()}"
         self.reference_counter = _ProxyReferenceCounter(self)
         self.gcs = _NullGcs()
         self.namespace = "default"
@@ -235,7 +291,8 @@ class WorkerModeRuntime:
         options.update(self._strategy_options(scheduling_strategy))
         func_blob = serialization.dumps_function(func)
         keys = self._rpc.call("client_task", func_blob,
-                              self._marshal(args, kwargs), options)
+                              self._marshal(args, kwargs), options,
+                              claimant=self.borrower_id)
         return self._new_refs(keys)
 
     # -- objects --------------------------------------------------------
@@ -243,7 +300,8 @@ class WorkerModeRuntime:
         if isinstance(value, ObjectRef):
             raise TypeError("Calling put() on an ObjectRef is not allowed")
         key = self._rpc.call("client_put",
-                             serialization.serialize_framed(value))
+                             serialization.serialize_framed(value),
+                             claimant=self.borrower_id)
         return self._new_refs([key])[0]
 
     def _abandon_block(self, token: str | None, blocked: bool) -> None:
@@ -340,7 +398,8 @@ class WorkerModeRuntime:
                           num_returns: int = 1) -> list[ObjectRef]:
         keys = self._rpc.call(
             "client_actor_call", actor_id.hex(), method_name,
-            self._marshal(args, kwargs), num_returns)
+            self._marshal(args, kwargs), num_returns,
+            claimant=self.borrower_id)
         return self._new_refs(keys)
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:

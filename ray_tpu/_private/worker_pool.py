@@ -959,13 +959,14 @@ class ProcessActor:
             call = self._queue.get()
             if call is None:
                 return
-            with self._lock:
-                self._pending -= 1
-                if self._dead:
-                    self._fail_call(call, ActorDiedError(
-                        self.actor_id, self._death_reason or "actor died"))
-                    continue
             try:
+                with self._lock:
+                    self._pending -= 1
+                    if self._dead:
+                        self._fail_call(call, ActorDiedError(
+                            self.actor_id,
+                            self._death_reason or "actor died"))
+                        continue
                 try:
                     args_blob = self._marshal(call.args, call.kwargs)
                 except Exception as exc:  # noqa: BLE001 — unpicklable args
@@ -973,22 +974,30 @@ class ProcessActor:
                         exc, "", f"{self._cls.__name__}.{call.method_name} "
                         f"(argument serialization)"))
                     continue
-                reply = self._worker.request(
-                    ("actor_call", call.method_name, args_blob,
-                     len(call.return_ids)))
-                if reply[0] == "err":
-                    exc, tb = serialization.deserialize_from_buffer(
-                        memoryview(reply[1]))
-                    self._fail_call(call, ActorError(
-                        exc, tb, f"{self._cls.__name__}.{call.method_name}"))
-                    continue
-                self._store_call_results(call, reply[1])
-            except (WorkerCrashedError, _WorkerUnavailable):
-                self._handle_crash(call)
-                return
-            except BaseException as exc:  # noqa: BLE001 — never kill the
-                # executor thread silently: fail the call and keep serving.
-                self._fail_call(call, exc)
+                try:
+                    reply = self._worker.request(
+                        ("actor_call", call.method_name, args_blob,
+                         len(call.return_ids)))
+                    if reply[0] == "err":
+                        exc, tb = serialization.deserialize_from_buffer(
+                            memoryview(reply[1]))
+                        self._fail_call(call, ActorError(
+                            exc, tb,
+                            f"{self._cls.__name__}.{call.method_name}"))
+                        continue
+                    self._store_call_results(call, reply[1])
+                except (WorkerCrashedError, _WorkerUnavailable):
+                    self._handle_crash(call)
+                    return
+                except BaseException as exc:  # noqa: BLE001 — never kill
+                    # the executor thread silently: fail the call and
+                    # keep serving.
+                    self._fail_call(call, exc)
+            finally:
+                # Unbind before re-blocking in get(): a stale frame
+                # local would keep the last call's args (and nested
+                # ObjectRefs) alive until the next call arrives.
+                call = None
 
     def _store_call_results(self, call, packed_list) -> None:
         for rid, packed in zip(call.return_ids, packed_list):
@@ -1135,6 +1144,11 @@ class ProcessActor:
                     self.actor_id,
                     f"actor process died sending {call.method_name}()"))
                 return
+            # Unbind before re-blocking (pending holds the call until
+            # the reader delivers its reply; the stale frame local
+            # would extend that past delivery).
+            call = None
+            args_blob = None
 
     def _handle_crash(self, call) -> None:
         reason = f"actor process died executing {call.method_name}()"

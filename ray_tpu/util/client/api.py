@@ -35,7 +35,8 @@ class ClientRemoteFunction:
     def remote(self, *args, **kwargs):
         keys = self._api._rpc.call(
             "client_task", self._func_blob,
-            self._api._marshal(args, kwargs), self._options)
+            self._api._marshal(args, kwargs), self._options,
+            claimant=self._api._borrower_id)
         refs = [self._api._new_ref(k) for k in keys]
         return refs[0] if len(refs) == 1 else refs
 
@@ -55,7 +56,8 @@ class _ClientActorMethod:
     def remote(self, *args, **kwargs):
         keys = self._api._rpc.call(
             "client_actor_call", self._actor_key, self._name,
-            self._api._marshal(args, kwargs), self._num_returns)
+            self._api._marshal(args, kwargs), self._num_returns,
+            claimant=self._api._borrower_id)
         refs = [self._api._new_ref(k) for k in keys]
         return refs[0] if len(refs) == 1 else refs
 
@@ -99,10 +101,15 @@ class ClientAPI:
     _POLL_S = 10.0
 
     def __init__(self, address: str, timeout_s: float = 60.0):
+        import os as _os
+
         self._rpc = RpcClient(address, timeout_s=timeout_s)
         if not self._rpc.ping():
             raise ConnectionError(
                 f"no ray_tpu client server at {address}")
+        # Identity for the server's per-claimant pin accounting: this
+        # session's releases can never drop another holder's pin.
+        self._borrower_id = f"client-{_os.getpid()}-{_os.urandom(3).hex()}"
         # Session-owned server state, cleaned up on disconnect().
         self._live_refs: set[str] = set()
         self._live_actors: set[str] = set()
@@ -144,7 +151,8 @@ class ClientAPI:
 
     def put(self, value: Any) -> ClientObjectRef:
         key = self._rpc.call(
-            "client_put", serialization.serialize_framed(value))
+            "client_put", serialization.serialize_framed(value),
+            claimant=self._borrower_id)
         return self._new_ref(key)
 
     def get(self, refs, timeout: float | None = None):
@@ -199,7 +207,8 @@ class ClientAPI:
     def release(self, refs) -> int:
         keys = [r._key for r in refs]
         self._live_refs.difference_update(keys)
-        return self._rpc.call("client_release", keys)
+        return self._rpc.call("client_release", keys,
+                              borrower_id=self._borrower_id)
 
     def disconnect(self) -> None:
         """Release this session's server-side refs and actors, then
@@ -208,7 +217,8 @@ class ClientAPI:
         try:
             self._rpc.call("client_disconnect",
                            sorted(self._live_refs),
-                           sorted(self._live_actors))
+                           sorted(self._live_actors),
+                           borrower_id=self._borrower_id)
         except Exception:  # noqa: BLE001 — best-effort cleanup
             pass
         self._live_refs.clear()

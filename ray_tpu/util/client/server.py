@@ -24,6 +24,22 @@ class ClientServer:
     def __init__(self, host: str = "0.0.0.0", port: int = 0):
         self._server = RpcServer(host, port)
         self._refs: dict[str, Any] = {}       # ref hex -> ObjectRef
+        # Borrower protocol (reference: reference_count.h:61 — the owner
+        # tracks which processes borrow each object and defers the free
+        # until every borrower releases): ref hex -> {borrower ids}.
+        # Workers that deserialize a driver-owned ref register here; a
+        # pin with live borrowers survives the driver dropping its own
+        # handles AND other borrowers' releases.
+        self._borrowers: dict[str, set] = {}
+        # (key, borrower_id) -> last keepalive; borrow claims are
+        # leases so a crashed borrower cannot pin objects forever.
+        self._borrow_seen: dict[tuple, float] = {}
+        import os as _os
+
+        self._borrow_ttl_s = float(
+            _os.environ.get("RAY_TPU_BORROW_TTL_S", "60"))
+        self._stop = threading.Event()
+        self._janitor: threading.Thread | None = None
         # Explicitly released keys: _resolve must reject them even while
         # the (deferred) refcount reaper hasn't evicted the object yet.
         self._released: set[str] = set()
@@ -39,6 +55,7 @@ class ClientServer:
         s.register("client_actor_call", self.actor_call)
         s.register("client_kill_actor", self.kill_actor)
         s.register("client_release", self.release)
+        s.register("client_borrow", self.borrow)
         s.register("client_disconnect", self.disconnect_cleanup)
         s.register("client_cancel", self.cancel)
         s.register("client_unblock", self.unblock)
@@ -55,17 +72,28 @@ class ClientServer:
 
     def start(self) -> "ClientServer":
         self._server.start()
+        self._janitor = threading.Thread(
+            target=self._janitor_loop, daemon=True,
+            name="ray_tpu-client-borrow-janitor")
+        self._janitor.start()
         return self
 
     def stop(self) -> None:
+        self._stop.set()
         self._server.stop()
 
     # -- helpers ------------------------------------------------------
-    def _track(self, ref) -> str:
+    def _track(self, ref, claimant: str | None = None) -> str:
+        """Pin a handed-out ref, claimed by the RECEIVING client's
+        identity: the pin survives until every claimant/borrower
+        releases, so one party dropping a shared ref cannot free it
+        under another still holding it."""
         key = ref.id().hex()
         with self._lock:
             self._refs[key] = ref
             self._released.discard(key)
+            self._borrowers.setdefault(key, set()).add(
+                claimant or "__direct__")
         return key
 
     def _resolve(self, key: str):
@@ -137,12 +165,12 @@ class ClientServer:
                 {k: convert(v) for k, v in kwargs.items()})
 
     # -- endpoints ----------------------------------------------------
-    def put(self, value_blob: bytes) -> str:
+    def put(self, value_blob: bytes, claimant: str | None = None) -> str:
         import ray_tpu
 
         value = serialization.deserialize_from_buffer(
             memoryview(value_blob))
-        return self._track(ray_tpu.put(value))
+        return self._track(ray_tpu.put(value), claimant)
 
     @staticmethod
     def _block_ctx(block_token: str | None):
@@ -226,10 +254,11 @@ class ClientServer:
         return True
 
     def disconnect_cleanup(self, ref_keys: list[str],
-                           actor_keys: list[str]) -> int:
+                           actor_keys: list[str],
+                           borrower_id: str | None = None) -> int:
         """Release a disconnecting client's refs and kill its actors
         (reference: client session cleanup on connection close)."""
-        n = self.release(ref_keys)
+        n = self.release(ref_keys, borrower_id=borrower_id)
         for key in actor_keys:
             try:
                 self.kill_actor(key)
@@ -238,7 +267,7 @@ class ClientServer:
         return n
 
     def task(self, func_blob: bytes, args_blob: bytes,
-             options: dict) -> list[str]:
+             options: dict, claimant: str | None = None) -> list[str]:
         import ray_tpu
 
         func = serialization.loads_function(func_blob)
@@ -248,7 +277,7 @@ class ClientServer:
             remote_fn = remote_fn.options(**options)
         out = remote_fn.remote(*args, **kwargs)
         refs = out if isinstance(out, (list, tuple)) else [out]
-        return [self._track(r) for r in refs]
+        return [self._track(r, claimant) for r in refs]
 
     def create_actor(self, cls_blob: bytes, args_blob: bytes,
                      options: dict) -> str:
@@ -266,7 +295,8 @@ class ClientServer:
         return key
 
     def actor_call(self, actor_key: str, method: str,
-                   args_blob: bytes, num_returns: int = 1) -> list[str]:
+                   args_blob: bytes, num_returns: int = 1,
+                   claimant: str | None = None) -> list[str]:
         handle = self._resolve_actor(actor_key)
         args, kwargs = self._deserialize_args(args_blob)
         bound = getattr(handle, method)
@@ -274,7 +304,7 @@ class ClientServer:
             bound = bound.options(num_returns=num_returns)
         out = bound.remote(*args, **kwargs)
         refs = out if isinstance(out, (list, tuple)) else [out]
-        return [self._track(r) for r in refs]
+        return [self._track(r, claimant) for r in refs]
 
     def kill_actor(self, actor_key: str) -> bool:
         import ray_tpu
@@ -289,10 +319,58 @@ class ClientServer:
         ray_tpu.kill(handle)
         return True
 
-    def release(self, keys: list[str]) -> int:
+    def borrow(self, borrower_id: str, keys: list[str]) -> int:
+        """A worker process deserialized these driver-owned refs and
+        may hold them past its current task: pin them here (an
+        ObjectRef registers a driver refcount, blocking eviction) until
+        the borrower releases — or until its LEASE expires (borrow
+        claims are leases refreshed by the worker's keepalive; a killed
+        borrower's claims age out instead of pinning forever). Objects
+        already gone simply don't pin — the borrower's eventual get()
+        fails with the normal path."""
+        import time as _time
+
+        from ray_tpu._private.ids import ObjectID
+        from ray_tpu._private.object_ref import ObjectRef
+        from ray_tpu._private.worker import global_runtime
+
+        runtime = global_runtime()
+        pinned = 0
+        now = _time.monotonic()
+        for k in keys:
+            oid = ObjectID(bytes.fromhex(k))
+            exists = runtime is not None and (
+                runtime.store.contains(oid)
+                or runtime.store.is_pending(oid))
+            # Whole per-key sequence under ONE lock hold: a concurrent
+            # release must never interleave between the re-pin and the
+            # borrower registration (it would leave a claimed key with
+            # no pin). ObjectRef construction nests only the store
+            # counter's leaf lock — safe under ours.
+            with self._lock:
+                have_pin = k in self._refs
+                if not have_pin:
+                    if not exists:
+                        continue
+                    self._refs[k] = ObjectRef(oid)
+                    self._released.discard(k)
+                self._borrowers.setdefault(k, set()).add(borrower_id)
+                self._borrow_seen[(k, borrower_id)] = now
+            pinned += 1
+        return pinned
+
+    def release(self, keys: list[str],
+                borrower_id: str | None = None) -> int:
         with self._lock:
             n = 0
             for k in keys:
+                holders = self._borrowers.get(k)
+                if holders is not None:
+                    holders.discard(borrower_id or "__direct__")
+                    self._borrow_seen.pop((k, borrower_id), None)
+                    if holders:
+                        continue  # other holders keep the pin alive
+                    self._borrowers.pop(k, None)
                 if self._refs.pop(k, None) is not None:
                     n += 1
                     self._released.add(k)
@@ -300,6 +378,36 @@ class ClientServer:
             if len(self._released) > 100_000:
                 self._released = set(list(self._released)[-50_000:])
         return n
+
+    def _sweep_expired_borrows(self) -> None:
+        """Drop borrow leases whose keepalives stopped (borrower
+        process died without releasing). Claimant pins from _track
+        carry no lease — they are cleaned by release/disconnect."""
+        import time as _time
+
+        now = _time.monotonic()
+        with self._lock:
+            expired = [(k, bid) for (k, bid), seen
+                       in self._borrow_seen.items()
+                       if now - seen > self._borrow_ttl_s]
+            for k, bid in expired:
+                self._borrow_seen.pop((k, bid), None)
+                holders = self._borrowers.get(k)
+                if holders is None:
+                    continue
+                holders.discard(bid)
+                if holders:
+                    continue
+                self._borrowers.pop(k, None)
+                if self._refs.pop(k, None) is not None:
+                    self._released.add(k)
+
+    def _janitor_loop(self) -> None:
+        while not self._stop.wait(min(5.0, self._borrow_ttl_s / 4)):
+            try:
+                self._sweep_expired_borrows()
+            except Exception:  # noqa: BLE001 — janitor must survive
+                pass
 
     def cancel(self, key: str) -> bool:
         import ray_tpu
