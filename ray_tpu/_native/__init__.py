@@ -15,7 +15,8 @@ import threading
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SOURCES = [os.path.join(_DIR, "plasma_store.cpp"),
-            os.path.join(_DIR, "node_store.cpp")]
+            os.path.join(_DIR, "node_store.cpp"),
+            os.path.join(_DIR, "gcs_kv.cpp")]
 _LIB = os.path.join(_DIR, "libray_tpu_native.so")
 
 _lock = threading.Lock()

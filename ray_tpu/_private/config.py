@@ -83,6 +83,9 @@ _DEFAULTS: dict[str, Any] = {
     # Native (C++) daemon blob store (node_store.cpp); falls back to
     # the Python store when the toolchain/library is unavailable.
     "node_store_native": True,
+    # Native (C++) GCS KV storage engine (gcs_kv.cpp) for HEAD
+    # processes; same fallback behavior.
+    "gcs_kv_native": True,
 }
 
 

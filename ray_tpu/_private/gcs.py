@@ -169,8 +169,13 @@ class TaskEvent:
 class GlobalControlService:
     """All control-plane tables in one place."""
 
-    def __init__(self):
-        self.kv = KVStore()
+    def __init__(self, kv=None):
+        # The HEAD's GcsServer injects the native (C++) storage engine
+        # (gcs_kv_native.make_kv_store); every other construction — a
+        # local driver's in-process tables, a driver connected to a
+        # remote head — keeps the Python store and never pays the
+        # native build.
+        self.kv = kv if kv is not None else KVStore()
         self.pubsub = PubSub()
         self._lock = threading.Lock()
         self._actors: dict[ActorID, ActorRecord] = {}

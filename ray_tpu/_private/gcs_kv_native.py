@@ -1,0 +1,179 @@
+"""ctypes binding for the native GCS KV storage engine (gcs_kv.cpp).
+
+Reference: the GCS's storage layer is C++ (gcs_kv_manager.h,
+store_client/in_memory_store_client.h:31); the Python control plane
+keeps only this thin binding. Drop-in for gcs.KVStore — same methods,
+same snapshot()/restore() dict shape (the head's crash persistence
+pickles that dict) — selected by make_kv_store() with the pure-Python
+store as the no-toolchain fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+from typing import Iterable
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    lib.gcs_kv_create.restype = ctypes.c_void_p
+    lib.gcs_kv_destroy.argtypes = [ctypes.c_void_p]
+    lib.gcs_kv_version.restype = ctypes.c_uint64
+    lib.gcs_kv_version.argtypes = [ctypes.c_void_p]
+    lib.gcs_kv_put.restype = ctypes.c_int
+    lib.gcs_kv_put.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int]
+    lib.gcs_kv_get.restype = ctypes.c_long
+    lib.gcs_kv_get.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_size_t, ctypes.c_void_p, ctypes.c_size_t]
+    lib.gcs_kv_del.restype = ctypes.c_int
+    lib.gcs_kv_del.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_size_t]
+    lib.gcs_kv_exists.restype = ctypes.c_int
+    lib.gcs_kv_exists.argtypes = lib.gcs_kv_del.argtypes
+    lib.gcs_kv_keys.restype = ctypes.c_long
+    lib.gcs_kv_keys.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_size_t, ctypes.c_void_p, ctypes.c_size_t]
+    lib.gcs_kv_snapshot.restype = ctypes.c_long
+    lib.gcs_kv_snapshot.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_size_t]
+    lib.gcs_kv_restore.restype = ctypes.c_long
+    lib.gcs_kv_restore.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+
+
+def _two_phase(call, start_cap: int = 4096) -> bytes | None:
+    """Run a (buf, cap) -> needed-size native call, growing the buffer
+    until the result fits. -1 means absent."""
+    cap = start_cap
+    while True:
+        buf = ctypes.create_string_buffer(cap)
+        need = call(buf, cap)
+        if need < 0:
+            return None
+        if need <= cap:
+            return buf.raw[:need]
+        cap = int(need)
+
+
+class NativeKVStore:
+    """Same interface/semantics as gcs.KVStore, C++-backed."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        _bind(lib)
+        self._lib = lib
+        self._h = lib.gcs_kv_create()
+
+    def __del__(self):
+        try:
+            if self._h:
+                self._lib.gcs_kv_destroy(self._h)
+                self._h = None
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+    @property
+    def version(self) -> int:
+        return int(self._lib.gcs_kv_version(self._h))
+
+    def put(self, key: bytes, value: bytes, namespace: str = "default",
+            overwrite: bool = True) -> bool:
+        ret = self._lib.gcs_kv_put(
+            self._h, namespace.encode(), key, len(key), value,
+            len(value), 1 if overwrite else 0)
+        if ret < 0:
+            raise ValueError(
+                "key/value exceeds the native KV's 4 GiB limit")
+        return bool(ret)
+
+    def get(self, key: bytes, namespace: str = "default") -> bytes | None:
+        return _two_phase(lambda buf, cap: self._lib.gcs_kv_get(
+            self._h, namespace.encode(), key, len(key), buf, cap))
+
+    def delete(self, key: bytes, namespace: str = "default") -> bool:
+        return bool(self._lib.gcs_kv_del(
+            self._h, namespace.encode(), key, len(key)))
+
+    def exists(self, key: bytes, namespace: str = "default") -> bool:
+        return bool(self._lib.gcs_kv_exists(
+            self._h, namespace.encode(), key, len(key)))
+
+    def keys(self, prefix: bytes = b"",
+             namespace: str = "default") -> list[bytes]:
+        raw = _two_phase(lambda buf, cap: self._lib.gcs_kv_keys(
+            self._h, namespace.encode(), prefix, len(prefix), buf, cap))
+        if not raw:
+            return []
+        (count,) = struct.unpack_from("<I", raw, 0)
+        off = 4
+        out = []
+        for _ in range(count):
+            (n,) = struct.unpack_from("<I", raw, off)
+            off += 4
+            out.append(raw[off:off + n])
+            off += n
+        return out
+
+    # -- persistence (same dict shape the Python store produces) ------
+    def snapshot(self) -> dict:
+        raw = _two_phase(lambda buf, cap: self._lib.gcs_kv_snapshot(
+            self._h, buf, cap), start_cap=1 << 16)
+        out: dict[str, dict[bytes, bytes]] = {}
+        if not raw:
+            return out
+        (count,) = struct.unpack_from("<I", raw, 0)
+        off = 4
+
+        def blob():
+            nonlocal off
+            (n,) = struct.unpack_from("<I", raw, off)
+            off += 4
+            b = raw[off:off + n]
+            off += n
+            return b
+
+        for _ in range(count):
+            ns = blob().decode()
+            key = blob()
+            value = blob()
+            out.setdefault(ns, {})[key] = value
+        return out
+
+    def restore(self, data: dict) -> None:
+        image = bytearray()
+        entries: list[tuple[bytes, bytes, bytes]] = []
+        for ns, kv in data.items():
+            for k, v in kv.items():
+                entries.append((ns.encode(), k, v))
+        image += struct.pack("<I", len(entries))
+        for ns, k, v in entries:
+            for blob in (ns, k, v):
+                image += struct.pack("<I", len(blob)) + blob
+        applied = self._lib.gcs_kv_restore(
+            self._h, bytes(image), len(image))
+        if applied < 0:
+            raise ValueError("corrupt KV snapshot image")
+
+
+def make_kv_store():
+    """Native engine when the toolchain builds, Python fallback
+    otherwise (or RAY_TPU_NATIVE_KV=0 to force the fallback)."""
+    import os
+
+    from ray_tpu._private.gcs import KVStore
+
+    if os.environ.get("RAY_TPU_NATIVE_KV", "1") != "1":
+        return KVStore()
+    try:
+        from ray_tpu._native import load
+
+        lib = load()
+        if lib is not None and hasattr(lib, "gcs_kv_create"):
+            return NativeKVStore(lib)
+    except Exception:  # noqa: BLE001 — fall back, never fail init
+        pass
+    return KVStore()

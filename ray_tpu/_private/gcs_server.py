@@ -180,7 +180,15 @@ class GcsServer:
         if heartbeat_timeout_s is None:
             heartbeat_timeout_s = float(
                 GLOBAL_CONFIG.gcs_heartbeat_timeout_s)
-        self.gcs = GlobalControlService()
+        # Native (C++) storage engine for the head's tables (reference:
+        # the GCS storage layer is C++, in_memory_store_client.h:31);
+        # gated by the same config convention as the daemon blob store.
+        kv = None
+        if bool(GLOBAL_CONFIG.gcs_kv_native):
+            from ray_tpu._private.gcs_kv_native import make_kv_store
+
+            kv = make_kv_store()
+        self.gcs = GlobalControlService(kv=kv)
         self.jobs = JobManager(self.gcs, os.path.join(log_dir, "jobs"))
         self.heartbeat_timeout_s = heartbeat_timeout_s
         # Fault tolerance: KV (incl. the cluster actor directory) + job
