@@ -22,11 +22,16 @@ from ray_tpu.serve.batching import batch
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions
 from ray_tpu.serve.deployment import Application, Deployment, deployment
 from ray_tpu.serve.multiplex import get_multiplexed_model_id, multiplexed
-from ray_tpu.serve.router import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.router import (
+    DeploymentHandle,
+    DeploymentResponse,
+    DeploymentStreamingResponse,
+)
 
 __all__ = [
     "Application", "AutoscalingConfig", "Deployment", "DeploymentConfig",
-    "DeploymentHandle", "DeploymentResponse", "HTTPOptions", "batch",
+    "DeploymentHandle", "DeploymentResponse",
+    "DeploymentStreamingResponse", "HTTPOptions", "batch",
     "delete", "deployment", "get_app_handle", "get_deployment_handle",
     "get_multiplexed_model_id", "multiplexed", "run", "shutdown", "start",
     "status",

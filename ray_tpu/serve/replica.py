@@ -61,7 +61,9 @@ class Replica:
 
     # ------------------------------------------------------------- data path
 
-    def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+    def _admit(self, kwargs: dict):
+        """Backpressure admission + multiplex-id extraction; returns
+        (kwargs, contextvar token)."""
         from ray_tpu.serve.multiplex import MODEL_ID_KWARG, _request_model_id
 
         # The router injects the multiplexed model id as a reserved kwarg;
@@ -82,27 +84,71 @@ class Replica:
             self._num_total += 1
         token = (_request_model_id.set(model_id)
                  if model_id is not None else None)
+        return kwargs, token
+
+    def _finish(self, token) -> None:
+        from ray_tpu.serve.multiplex import _request_model_id
+
+        if token is not None:
+            _request_model_id.reset(token)
+        with self._lock:
+            self._num_ongoing -= 1
+
+    def _invoke(self, method_name: str, args: tuple, kwargs: dict):
+        if method_name == "__call__":
+            target = self._callable
+            if not callable(target):
+                raise TypeError(
+                    f"Deployment {self._deployment_name} is not callable;"
+                    f" specify a method name")
+        else:
+            target = getattr(self._callable, method_name)
+        return target(*args, **kwargs)
+
+    def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+        kwargs, token = self._admit(kwargs)
         try:
-            if method_name == "__call__":
-                target = self._callable
-                if not callable(target):
-                    raise TypeError(
-                        f"Deployment {self._deployment_name} is not callable;"
-                        f" specify a method name")
-            else:
-                target = getattr(self._callable, method_name)
-            result = target(*args, **kwargs)
+            result = self._invoke(method_name, args, kwargs)
             if inspect.isgenerator(result):
-                # Streaming responses materialize to a chunk list (the
-                # in-process analogue of reference replica.py:471 — the
-                # handle re-streams them to the caller).
+                # Unary path: a generator result materializes to a
+                # chunk list; TRUE incremental delivery is
+                # handle.options(stream=True) -> handle_request_streaming.
                 result = list(result)
             return result
         finally:
-            if token is not None:
-                _request_model_id.reset(token)
-            with self._lock:
-                self._num_ongoing -= 1
+            self._finish(token)
+
+    def handle_request_streaming(self, method_name: str, args: tuple,
+                                 kwargs: dict, queue) -> int:
+        """True streaming (reference: replica.py:471): chunks flow
+        through the shared queue AS the generator yields, so the caller
+        consumes while this replica still produces. Protocol:
+        ("chunk", value)* then ("end", n) | ("err", exc)."""
+        kwargs, token = self._admit(kwargs)
+        n = 0
+        try:
+            result = self._invoke(method_name, args, kwargs)
+            if not inspect.isgenerator(result):
+                result = iter([result])
+            for chunk in result:
+                try:
+                    queue.put(("chunk", chunk))
+                except Exception:  # noqa: BLE001 — consumer abandoned
+                    # The caller tore down the queue (early break):
+                    # stop producing — cancellation, not an error.
+                    getattr(result, "close", lambda: None)()
+                    return n
+                n += 1
+            queue.put(("end", n))
+            return n
+        except BaseException as exc:  # noqa: BLE001 — shipped to caller
+            try:
+                queue.put(("err", exc))
+            except Exception:  # noqa: BLE001 — queue already gone
+                pass
+            raise
+        finally:
+            self._finish(token)
 
     # ---------------------------------------------------------- control path
 

@@ -19,6 +19,123 @@ from ray_tpu.serve.long_poll import LongPollClient
 from ray_tpu.serve.replica import BackPressureError
 
 
+class DeploymentStreamingResponse:
+    """Iterator over a streaming call's chunks (reference:
+    handle.options(stream=True) -> DeploymentResponseGenerator).
+
+    Chunks arrive through a shared queue AS the replica's generator
+    yields them — consumption overlaps production (an LLM's tokens
+    stream out during decode, not after). A replica that rejects with
+    BackPressureError before producing anything is retried on another
+    replica, like the unary path.
+    """
+
+    _POLL_S = 0.2
+
+    def __init__(self, queue, object_ref, router=None, replica_idx=None,
+                 request=None, model_id=None, timeout_s: float = 300.0):
+        self._queue = queue
+        self._ref = object_ref
+        self._router = router
+        self._replica_idx = replica_idx
+        self._request = request
+        self._model_id = model_id
+        self._timeout_s = timeout_s
+        self._done = False
+        self._yielded = 0
+
+    def _release(self):
+        if self._router is not None and self._replica_idx is not None:
+            self._router._release(self._replica_idx)
+            self._replica_idx = None
+
+    def _close(self):
+        """Terminal cleanup: give back the replica slot and tear down
+        the per-call queue actor — one leaks per streaming request
+        otherwise. The replica's next put into the dead queue fails and
+        stops its production (early-abandon cancellation)."""
+        self._done = True
+        self._release()
+        queue, self._queue = self._queue, None
+        if queue is not None:
+            try:
+                queue.shutdown()
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                pass
+
+    def _retry_backpressure(self, exc) -> bool:
+        """Reassign to another replica — only safe while no chunk has
+        been delivered (a partial stream must not restart silently).
+        The rejecting replica's in-flight count is returned first, and
+        affinity is skipped (it points at the replica that just
+        rejected)."""
+        cause = getattr(exc, "cause", exc)
+        if (self._yielded > 0 or self._router is None
+                or self._request is None or self._queue is None
+                or not isinstance(cause, BackPressureError)):
+            return False
+        self._release()
+        method_name, args, kwargs = self._request
+        idx, handle = self._router._pick(model_id=self._model_id,
+                                         skip_affinity=True)
+        self._replica_idx = idx
+        self._ref = handle.handle_request_streaming.remote(
+            method_name, args, kwargs, self._queue)
+        return True
+
+    def __iter__(self):
+        import time as _time
+
+        import ray_tpu
+        from ray_tpu.util.queue import Empty
+
+        # Stall clock, not a total budget: reset on every chunk — a
+        # healthy stream may produce far longer than timeout_s.
+        deadline = _time.monotonic() + self._timeout_s
+        try:
+            while not self._done:
+                try:
+                    kind, payload = self._queue.get(
+                        block=True, timeout=self._POLL_S)
+                except Empty:
+                    if _time.monotonic() > deadline:
+                        raise TimeoutError(
+                            "streaming response stalled past "
+                            f"{self._timeout_s}s")
+                    # No chunk yet: surface replica-call failures (e.g.
+                    # backpressure rejection, actor death) promptly.
+                    ready, _ = ray_tpu.wait([self._ref], timeout=0)
+                    if ready:
+                        try:
+                            ray_tpu.get(self._ref)
+                        except Exception as exc:  # noqa: BLE001
+                            if self._retry_backpressure(exc):
+                                continue
+                            raise
+                    continue
+                if kind == "chunk":
+                    self._yielded += 1
+                    deadline = _time.monotonic() + self._timeout_s
+                    yield payload
+                elif kind == "end":
+                    return
+                else:  # ("err", exc)
+                    if self._retry_backpressure(payload):
+                        continue
+                    raise payload
+        finally:
+            # Runs on completion, error, AND early abandon (break /
+            # GeneratorExit): the slot and queue must never outlive the
+            # consumer.
+            self._close()
+
+    def result(self, timeout_s: float | None = None) -> list:
+        """Materialize the whole stream (unary-style convenience)."""
+        if timeout_s is not None:
+            self._timeout_s = timeout_s
+        return list(self)
+
+
 class DeploymentResponse:
     """Future-like result of handle.remote() (reference:
     python/ray/serve/handle.py DeploymentResponse).
@@ -167,12 +284,19 @@ class Router:
 
     def assign_request(self, method_name: str, args: tuple, kwargs: dict,
                        timeout_s: float = 30.0,
-                       model_id: str | None = None) -> DeploymentResponse:
+                       model_id: str | None = None,
+                       stream_queue=None) -> "DeploymentResponse":
         if not self._have_replicas.wait(timeout_s):
             raise TimeoutError(
                 f"Deployment {self._deployment_name}: no replicas came up "
                 f"within {timeout_s}s")
         idx, handle = self._pick(model_id=model_id)
+        if stream_queue is not None:
+            ref = handle.handle_request_streaming.remote(
+                method_name, args, kwargs, stream_queue)
+            return DeploymentStreamingResponse(
+                stream_queue, ref, router=self, replica_idx=idx,
+                request=(method_name, args, kwargs), model_id=model_id)
         ref = handle.handle_request.remote(method_name, args, kwargs)
         # Backpressure rejections are retried on another replica inside
         # DeploymentResponse.result() (reference: pow-2 scheduler
@@ -221,6 +345,7 @@ class DeploymentHandle:
 
     def options(self, method_name: str | None = None,
                 multiplexed_model_id: str | None = None,
+                stream: bool | None = None,
                 ) -> "DeploymentHandle":
         handle = DeploymentHandle(
             self._deployment_name, self._app_name, self._controller,
@@ -228,6 +353,8 @@ class DeploymentHandle:
         handle._model_id = (multiplexed_model_id
                             if multiplexed_model_id is not None
                             else getattr(self, "_model_id", None))
+        handle._stream = (stream if stream is not None
+                          else getattr(self, "_stream", False))
         return handle
 
     def __getattr__(self, name: str):
@@ -236,6 +363,7 @@ class DeploymentHandle:
         handle = DeploymentHandle(
             self._deployment_name, self._app_name, self._controller, name)
         handle._model_id = getattr(self, "_model_id", None)
+        handle._stream = getattr(self, "_stream", False)
         return handle
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
@@ -246,21 +374,32 @@ class DeploymentHandle:
         model_id = getattr(self, "_model_id", None)
         if model_id is not None:
             kwargs = {**kwargs, MODEL_ID_KWARG: model_id}
+        stream_queue = None
+        if getattr(self, "_stream", False):
+            from ray_tpu.util.queue import Queue
+
+            # One channel per streaming call: chunks flow through it
+            # while the replica still produces.
+            stream_queue = Queue()
         return router.assign_request(self._method_name, args, kwargs,
-                                     model_id=model_id)
+                                     model_id=model_id,
+                                     stream_queue=stream_queue)
 
     def __reduce__(self):
         # Rebuild from names inside another process/replica.
         return (_rebuild_handle,
                 (self._deployment_name, self._app_name, self._method_name,
-                 getattr(self, "_model_id", None)))
+                 getattr(self, "_model_id", None),
+                 getattr(self, "_stream", False)))
 
 
-def _rebuild_handle(deployment_name, app_name, method_name, model_id=None):
+def _rebuild_handle(deployment_name, app_name, method_name, model_id=None,
+                    stream=False):
     from ray_tpu.serve.api import _get_controller
 
     handle = DeploymentHandle(
         deployment_name, app_name, _get_controller(), method_name)
     if model_id is not None:
         handle._model_id = model_id
+    handle._stream = stream
     return handle
