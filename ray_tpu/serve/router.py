@@ -92,6 +92,11 @@ class DeploymentStreamingResponse:
         # Stall clock, not a total budget: reset on every chunk — a
         # healthy stream may produce far longer than timeout_s.
         deadline = _time.monotonic() + self._timeout_s
+        # Backpressure retries are bounded with backoff, like the unary
+        # path (ADVICE r1: a saturated deployment must surface
+        # BackPressureError, not livelock hammering the router).
+        retries_left = 100
+        backoff_s = 0.01
         try:
             while not self._done:
                 try:
@@ -103,16 +108,33 @@ class DeploymentStreamingResponse:
                             "streaming response stalled past "
                             f"{self._timeout_s}s")
                     # No chunk yet: surface replica-call failures (e.g.
-                    # backpressure rejection, actor death) promptly.
+                    # backpressure rejection, actor death) promptly —
+                    # but chunks the replica delivered BEFORE failing
+                    # may still sit in the queue (they landed after
+                    # this poll started); drain them first.
                     ready, _ = ray_tpu.wait([self._ref], timeout=0)
                     if ready:
                         try:
                             ray_tpu.get(self._ref)
                         except Exception as exc:  # noqa: BLE001
-                            if self._retry_backpressure(exc):
-                                continue
-                            raise
-                    continue
+                            try:
+                                kind, payload = self._queue.get(
+                                    block=True, timeout=0.05)
+                                # Something was queued after all: fall
+                                # through to normal handling below.
+                            except Empty:
+                                if self._retry_backpressure(exc):
+                                    retries_left -= 1
+                                    if retries_left <= 0:
+                                        raise
+                                    _time.sleep(backoff_s)
+                                    backoff_s = min(backoff_s * 2, 1.0)
+                                    continue
+                                raise
+                        else:
+                            continue  # clean completion: await "end"
+                    else:
+                        continue
                 if kind == "chunk":
                     self._yielded += 1
                     deadline = _time.monotonic() + self._timeout_s
@@ -121,6 +143,11 @@ class DeploymentStreamingResponse:
                     return
                 else:  # ("err", exc)
                     if self._retry_backpressure(payload):
+                        retries_left -= 1
+                        if retries_left <= 0:
+                            raise payload
+                        _time.sleep(backoff_s)
+                        backoff_s = min(backoff_s * 2, 1.0)
                         continue
                     raise payload
         finally:
@@ -128,6 +155,16 @@ class DeploymentStreamingResponse:
             # GeneratorExit): the slot and queue must never outlive the
             # consumer.
             self._close()
+
+    def __del__(self):
+        # Safety net for a response constructed but never iterated:
+        # the queue actor and the router's in-flight slot must not
+        # outlive the abandoned handle. Best-effort (GC-time).
+        try:
+            if not self._done:
+                self._close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
 
     def result(self, timeout_s: float | None = None) -> list:
         """Materialize the whole stream (unary-style convenience)."""
@@ -378,12 +415,23 @@ class DeploymentHandle:
         if getattr(self, "_stream", False):
             from ray_tpu.util.queue import Queue
 
-            # One channel per streaming call: chunks flow through it
-            # while the replica still produces.
-            stream_queue = Queue()
-        return router.assign_request(self._method_name, args, kwargs,
-                                     model_id=model_id,
-                                     stream_queue=stream_queue)
+            # One channel per streaming call; BOUNDED so a producer
+            # outpacing the consumer blocks instead of buffering the
+            # whole stream in the queue actor.
+            stream_queue = Queue(maxsize=256)
+        try:
+            return router.assign_request(self._method_name, args, kwargs,
+                                         model_id=model_id,
+                                         stream_queue=stream_queue)
+        except BaseException:
+            # assign failed before a response took ownership: the
+            # queue actor must not leak.
+            if stream_queue is not None:
+                try:
+                    stream_queue.shutdown()
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
 
     def __reduce__(self):
         # Rebuild from names inside another process/replica.

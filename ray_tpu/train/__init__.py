@@ -20,6 +20,11 @@ from ray_tpu.train.trainer import (
     JaxTrainer,
     Result,
 )
+from ray_tpu.train.huggingface import (
+    TransformersTrainer,
+    causal_lm_loss_fn,
+    make_transformers_train_loop,
+)
 from ray_tpu.train.torch import TorchTrainer
 
 __all__ = [
@@ -31,6 +36,9 @@ __all__ = [
     "DataParallelTrainer",
     "FailureConfig",
     "JaxTrainer",
+    "TransformersTrainer",
+    "causal_lm_loss_fn",
+    "make_transformers_train_loop",
     "Result",
     "RunConfig",
     "ScalingConfig",

@@ -1,0 +1,143 @@
+"""HuggingFace Transformers integration for Train.
+
+Reference: python/ray/train/huggingface/ (TransformersTrainer wraps a
+🤗 training loop in Ray Train's worker-group orchestration). TPU-first
+shape: the model is a FLAX transformer whose params train under a
+jitted optax step inside JaxTrainer's worker loop — no torch, no
+Trainer-callback shimming; the integration is a prepared train loop
+plus helpers, and the orchestration (gangs, checkpoints, failure
+configs) is plain JaxTrainer.
+
+Usage::
+
+    from transformers import FlaxGPT2LMHeadModel, GPT2Config
+
+    def make_model():
+        return FlaxGPT2LMHeadModel(GPT2Config(...))
+
+    trainer = TransformersTrainer(
+        make_model,
+        train_dataset=token_batches,     # iterable of {"input_ids": [B, T]}
+        optimizer=optax.adamw(3e-4),
+        num_epochs=2,
+        scaling_config=ScalingConfig(num_workers=1),
+    )
+    result = trainer.fit()
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+from ray_tpu.train.trainer import JaxTrainer
+
+
+def causal_lm_loss_fn(model) -> Callable:
+    """Standard next-token cross-entropy for Flax causal-LM heads
+    (reference: transformers' CLM objective). Runs the model in TRAIN
+    mode with a per-step dropout rng — configured dropout must apply
+    during training."""
+    import jax.numpy as jnp
+    import optax as _optax
+
+    def loss_fn(params, batch, dropout_rng):
+        input_ids = batch["input_ids"]
+        outputs = model(input_ids=input_ids, params=params,
+                        dropout_rng=dropout_rng, train=True)
+        logits = outputs.logits[:, :-1]
+        targets = input_ids[:, 1:]
+        mask = batch.get("attention_mask")
+        token_losses = _optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets)
+        if mask is not None:
+            mask = mask[:, 1:].astype(token_losses.dtype)
+            return (token_losses * mask).sum() / jnp.maximum(
+                mask.sum(), 1.0)
+        return token_losses.mean()
+
+    return loss_fn
+
+
+def make_transformers_train_loop(
+        model_factory: Callable[[], Any],
+        train_dataset: Iterable,
+        optimizer=None,
+        loss_fn_factory: Callable = causal_lm_loss_fn,
+        num_epochs: int = 1,
+        report_every: int = 10) -> Callable:
+    """Build a JaxTrainer-compatible ``train_loop_per_worker``: one
+    jitted (loss, grad, optax update) program per worker, batches from
+    ``train_dataset`` (an iterable of numpy dicts or a
+    ray_tpu.data.Dataset), loss reported through the session.
+
+    ``loss_fn_factory(model)`` must return
+    ``loss_fn(params, batch, dropout_rng) -> scalar`` (the rng keeps
+    configured dropout active in training mode)."""
+
+    def train_loop(config: dict | None = None):
+        import jax
+        import numpy as np
+        import optax as _optax
+
+        from ray_tpu.train import session
+
+        model = model_factory()
+        opt = optimizer if optimizer is not None else _optax.adamw(3e-4)
+        loss_fn = loss_fn_factory(model)
+        params = model.params
+        opt_state = opt.init(params)
+        rng = jax.random.PRNGKey(
+            int((config or {}).get("seed", 0)))
+
+        @jax.jit
+        def step(params, opt_state, batch, rng):
+            rng, dropout_rng = jax.random.split(rng)
+            loss, grads = jax.value_and_grad(loss_fn)(
+                params, batch, dropout_rng)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            return (_optax.apply_updates(params, updates), opt_state,
+                    loss, rng)
+
+        def batches():
+            ds = train_dataset
+            if hasattr(ds, "iter_batches"):  # ray_tpu.data.Dataset
+                yield from ds.iter_batches(batch_format="numpy")
+            else:
+                yield from ds
+
+        step_idx = 0
+        last_loss = None
+        for _ in range(num_epochs):
+            for batch in batches():
+                batch = {k: np.asarray(v) for k, v in batch.items()}
+                params, opt_state, loss, rng = step(
+                    params, opt_state, batch, rng)
+                step_idx += 1
+                last_loss = float(loss)
+                if step_idx % report_every == 0:
+                    session.report({"loss": last_loss,
+                                    "step": step_idx})
+        session.report({"loss": last_loss, "step": step_idx,
+                        "done": True})
+
+    return train_loop
+
+
+class TransformersTrainer(JaxTrainer):
+    """JaxTrainer pre-wired for Flax 🤗 models (reference:
+    train/huggingface/transformers_trainer.py — same role, TPU-native
+    internals: the loop is a jitted optax step, not a wrapped
+    torch Trainer)."""
+
+    def __init__(self, model_factory: Callable[[], Any],
+                 *, train_dataset: Iterable,
+                 optimizer=None,
+                 loss_fn_factory: Callable = causal_lm_loss_fn,
+                 num_epochs: int = 1,
+                 report_every: int = 10,
+                 **kwargs):
+        super().__init__(
+            make_transformers_train_loop(
+                model_factory, train_dataset, optimizer,
+                loss_fn_factory, num_epochs, report_every),
+            **kwargs)
