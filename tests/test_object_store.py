@@ -58,8 +58,14 @@ def test_refcount_eviction(ray_start_regular):
     assert runtime.store.contains(oid)
     del ref
     import gc
+    import time as _time
 
     gc.collect()
+    # Eviction is deferred to the refcount reaper thread (lock-free
+    # __del__); poll instead of assuming it already ran.
+    deadline = _time.monotonic() + 10.0
+    while _time.monotonic() < deadline and runtime.store.contains(oid):
+        _time.sleep(0.05)
     assert not runtime.store.contains(oid)
 
 

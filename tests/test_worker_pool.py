@@ -414,10 +414,12 @@ def test_process_actor_concurrent_calls_overlap(pool_runtime):
 
     actor = Sleeper.remote()
     start = time.monotonic()
-    refs = [actor.nap.remote(0.5) for _ in range(4)]
-    idents = ray_tpu.get(refs, timeout=30)
+    # 4x1.0s: serialized would be >= 4s; the threshold leaves slack
+    # for process spawn under a loaded machine without ambiguity.
+    refs = [actor.nap.remote(1.0) for _ in range(4)]
+    idents = ray_tpu.get(refs, timeout=60)
     elapsed = time.monotonic() - start
-    assert elapsed < 1.5, f"calls serialized: {elapsed:.2f}s for 4x0.5s"
+    assert elapsed < 3.0, f"calls serialized: {elapsed:.2f}s for 4x1.0s"
     assert len(set(idents)) > 1, "all calls ran on one worker thread"
     ray_tpu.kill(actor)
 
