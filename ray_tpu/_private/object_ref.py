@@ -9,9 +9,31 @@ borrower lifetimes are tracked.
 from __future__ import annotations
 
 import concurrent.futures
+import contextlib
+import threading
 from typing import Any
 
 from ray_tpu._private.ids import ObjectID
+
+_reduce_sink = threading.local()
+
+
+@contextlib.contextmanager
+def collect_reduced_refs(out: list):
+    """Record every ObjectRef pickled on this thread into ``out``.
+
+    Structural walks over args can't see refs nested inside custom
+    objects / dataclasses / container subclasses — but pickling visits
+    all of them via __reduce__. Wrapping an argument serialization in
+    this collector is therefore the complete way to enumerate the refs
+    a payload carries (used for the owner's grace pin while borrower
+    registration is in flight)."""
+    prev = getattr(_reduce_sink, "out", None)
+    _reduce_sink.out = out
+    try:
+        yield out
+    finally:
+        _reduce_sink.out = prev
 
 
 class ObjectRef:
@@ -57,6 +79,9 @@ class ObjectRef:
 
     def __reduce__(self):
         # Deserializing creates a borrower reference on the receiving side.
+        sink = getattr(_reduce_sink, "out", None)
+        if sink is not None:
+            sink.append(self)
         return (ObjectRef, (self._id, self._owner))
 
     # -- convenience --------------------------------------------------------

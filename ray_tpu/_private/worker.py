@@ -655,7 +655,14 @@ class Runtime:
         handle right after submit can free the object before the
         borrow lands (reference: the owner keeps in-flight task args
         reachable while the borrower list is being established,
-        reference_count.h:61)."""
+        reference_count.h:61).
+
+        This walk covers plain list/tuple/dict shapes at SUBMIT time;
+        refs inside custom objects are caught later, completely, by the
+        pickle-time collector in _convert_remote_args (until that
+        serialization happens, the queued args tuple itself keeps every
+        nested ObjectRef Python object — and hence its registered
+        refcount — alive)."""
         refs: list = []
 
         def walk(v, depth=0):
@@ -940,9 +947,25 @@ class Runtime:
                 return FetchRef(id_bytes, self._export_addr)
             return value
 
-        conv_args = tuple(convert(a) for a in args)
-        conv_kwargs = {k: convert(v) for k, v in kwargs.items()}
-        return serialization.serialize_framed((conv_args, conv_kwargs))
+        # Refs nested in CUSTOM objects ship as pickled ObjectRefs the
+        # callee re-registers as a borrower; collect them here (pickle
+        # sees every ref, unlike any structural walk) and grace-pin so
+        # a driver dropping its handle right after this serialization
+        # can't free the object before that registration lands. The
+        # collector wraps the WHOLE conversion: convert() itself
+        # serializes large values into the export store, and refs
+        # nested inside those must be pinned too.
+        from ray_tpu._private.object_ref import collect_reduced_refs
+
+        nested: list = []
+        with collect_reduced_refs(nested):
+            conv_args = tuple(convert(a) for a in args)
+            conv_kwargs = {k: convert(v) for k, v in kwargs.items()}
+            blob = serialization.serialize_framed((conv_args, conv_kwargs))
+        if nested:
+            self._arg_pin_pen.append(
+                (time.monotonic() + self._ARG_PIN_GRACE_S, nested))
+        return blob
 
     def _seal_remote_results(self, return_ids, results, node_id,
                              address) -> None:

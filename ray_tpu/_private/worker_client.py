@@ -104,7 +104,10 @@ class _ProxyReferenceCounter:
 
     # Borrow leases expire server-side (RAY_TPU_BORROW_TTL_S, 60s
     # default) so a killed borrower can't pin objects forever; live
-    # borrowers must therefore keepalive well inside the TTL.
+    # borrowers must therefore keepalive well inside the TTL. The env
+    # var only seeds the interval — the authoritative TTL is whatever
+    # the OWNER reports on each client_borrow response (the driver's
+    # env need not be propagated to worker nodes).
     _KEEPALIVE_S = float(os.environ.get(
         "RAY_TPU_BORROW_TTL_S", "60")) / 4
 
@@ -135,8 +138,15 @@ class _ProxyReferenceCounter:
                     break
         if batch:
             try:
-                self._runtime._rpc.call(
+                reply = self._runtime._rpc.call(
                     "client_borrow", self._runtime.borrower_id, batch)
+                # Newer servers return (pinned, ttl_s); adopt the
+                # server's lease clock so a driver-side TTL change
+                # can't outpace our keepalives.
+                if isinstance(reply, tuple) and len(reply) == 2:
+                    ttl = float(reply[1])
+                    if ttl > 0:
+                        self._KEEPALIVE_S = ttl / 4
             except Exception:  # noqa: BLE001 — pre-borrow heads etc.
                 pass
 

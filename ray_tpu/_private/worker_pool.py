@@ -472,12 +472,38 @@ def _serve_actor_concurrent(conn, instance, client: ShmClient, arena,
 # --------------------------------------------------------------------------
 
 
+_factory_lock = threading.Lock()
+_factory = None
+
+
+def _get_factory():
+    """Process-global fork-server template, started on first use (and
+    restarted if it died). ~1-2s once, then every worker is a ~10ms
+    fork instead of a fresh interpreter boot."""
+    global _factory
+    from ray_tpu._private.worker_factory import start_factory
+
+    with _factory_lock:
+        if _factory is not None and not _factory.alive():
+            _factory = None
+        if _factory is None:
+            _factory = start_factory()
+            import atexit
+
+            atexit.register(_factory.stop)
+        return _factory
+
+
 def _spawn_worker(name: str, extra_env: dict | None = None,
                   allow_tpu: bool = False):
     """Start a worker as a fresh interpreter that connects back over a
     Unix socket (reference: worker_pool.h spawns language workers that
     connect to the raylet socket).
 
+    Fast path: fork from the pre-imported factory template
+    (worker_factory.py) — worker creation cost drops from an
+    interpreter boot to a fork. Fallback (TPU workers, factory
+    disabled via RAY_TPU_WORKER_FACTORY_DISABLE, or factory failure):
     subprocess + connect-back (rather than multiprocessing's spawn) so
     the child never re-imports the user's ``__main__`` — unguarded user
     scripts must keep working. The child env drops accelerator plugin
@@ -519,16 +545,36 @@ def _spawn_worker(name: str, extra_env: dict | None = None,
     # stdout/stderr files tailed by the log monitor); without a log dir
     # workers inherit the driver's console directly.
     log_dir = env.get("RAY_TPU_WORKER_LOG_DIR")
-    log_file = None
+    log_path = None
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
-        log_file = open(os.path.join(log_dir, f"worker-{name}.log"), "ab")
-    proc = subprocess.Popen(
-        [sys.executable, "-m", "ray_tpu._private.worker_pool", addr],
-        env=env, cwd=os.getcwd(),
-        stdout=log_file, stderr=log_file)
-    if log_file is not None:
-        log_file.close()  # the child holds the fd now
+        log_path = os.path.join(log_dir, f"worker-{name}.log")
+    proc = None
+    if not allow_tpu and not env.get("RAY_TPU_WORKER_FACTORY_DISABLE"):
+        try:
+            factory = _get_factory()
+            # Workers whose env demands different jax/XLA import-time
+            # config than the template booted with can't fork — the
+            # already-imported jax would silently ignore it.
+            proc = factory.spawn(
+                addr=addr, authkey_hex=authkey.hex(), env=env,
+                cwd=os.getcwd(), log_path=log_path) \
+                if factory.compatible(env) else None
+        except Exception:  # noqa: BLE001 — Popen path still works
+            import logging
+
+            logging.getLogger("ray_tpu").warning(
+                "worker factory unavailable; falling back to subprocess "
+                "spawn", exc_info=True)
+            proc = None
+    if proc is None:
+        log_file = open(log_path, "ab") if log_path else None
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_pool", addr],
+            env=env, cwd=os.getcwd(),
+            stdout=log_file, stderr=log_file)
+        if log_file is not None:
+            log_file.close()  # the child holds the fd now
     try:
         # Listener.accept has no timeout arg; guard with a thread join.
         conn_box: list = []
@@ -640,17 +686,34 @@ class WorkerPool:
         self._shutdown = False
         # Spawn in parallel: each worker blocks on interpreter boot +
         # socket handshake, so serial startup would be O(N).
+        # size=0 is a legal lazy pool — no prestart, growth on demand
+        # (many-node single-box clusters boot O(N) daemons; paying a
+        # worker spawn per daemon up front is pure wasted wall-clock).
         from concurrent.futures import ThreadPoolExecutor
 
+        if size <= 0:
+            return
         with ThreadPoolExecutor(max_workers=min(size, 8)) as tpe:
             self._idle.extend(tpe.map(lambda _: self._new_worker(),
                                       range(size)))
 
-    def _new_worker(self) -> PoolWorker:
+    @staticmethod
+    def _import_sensitive_env_vars(runtime_env: dict | None) -> dict:
+        if not runtime_env:
+            return {}
+        from ray_tpu._private.worker_factory import (
+            import_sensitive_subset,
+        )
+
+        return import_sensitive_subset(
+            {str(k): str(v)
+             for k, v in (runtime_env.get("env_vars") or {}).items()})
+
+    def _new_worker(self, extra_env: dict | None = None) -> PoolWorker:
         with self._index_lock:
             index = self._next_index
             self._next_index += 1
-        worker = PoolWorker(index)
+        worker = PoolWorker(index, extra_env=extra_env)
         with self._index_lock:
             self._all_workers.add(worker)
             self._all_workers = {w for w in self._all_workers
@@ -766,6 +829,39 @@ class WorkerPool:
         and the request retried on another — no work was started, so
         this is invisible to the caller.
         """
+        sensitive = self._import_sensitive_env_vars(runtime_env)
+        if sensitive:
+            # jax/XLA read these at IMPORT time; a shared worker (and
+            # any fork of the pre-imported factory template) has jax
+            # frozen already, so per-task os.environ application would
+            # be silently ignored. Such tasks get a dedicated fresh
+            # interpreter whose spawn env carries the vars — under the
+            # SAME lease accounting as the shared pool, so N in-flight
+            # env-sensitive tasks still respect max_size (and a
+            # shut-down pool refuses them).
+            with self._lock:
+                while self._num_leased >= self.max_size \
+                        and not self._shutdown:
+                    self._lock.wait(timeout=0.5)
+                if self._shutdown:
+                    raise RuntimeError("worker pool is shut down")
+                self._num_leased += 1
+            worker = None
+            try:
+                worker = self._new_worker(
+                    extra_env=dict(runtime_env.get("env_vars") or {}))
+                reply = worker.request(
+                    ("task", digest, func_blob, args_blob, n_returns,
+                     runtime_env, task_token, client_addr, sys_path))
+                return self._unpack_reply(reply, return_ids)
+            finally:
+                if worker is not None:
+                    worker.stop()
+                    with self._index_lock:
+                        self._all_workers.discard(worker)
+                with self._lock:
+                    self._num_leased -= 1
+                    self._lock.notify()
         while True:
             worker = self._acquire()
             send_blob = None if digest in worker.known_digests else func_blob

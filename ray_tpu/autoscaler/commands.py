@@ -199,6 +199,16 @@ def create_or_update_cluster(config_or_path, *,
     if existing and _pid_is_ray_daemon(existing.get("head_pid")):
         state = existing  # idempotent re-up: reuse the running head
     else:
+        if existing:
+            # The old head is dead but its recorded workers may have
+            # outlived it. They heartbeat a dead address and the new
+            # head listens on a new port, so they can never rejoin —
+            # stop them now, before the state file (the only record of
+            # their pids) is overwritten and `down` loses reach.
+            for w in existing.get("workers", ()):
+                pid = w.get("pid")
+                if pid and _pid_is_ray_daemon(pid):
+                    _term(pid)
         _run_commands(config.get("initialization_commands"),
                       "initialization")
         _run_commands(config.get("setup_commands"), "setup")

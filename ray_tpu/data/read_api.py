@@ -188,8 +188,11 @@ def read_sql(sql: str, connection_factory: Callable[[], Any], *,
     ``connection_factory`` is a zero-arg callable returning a fresh
     DBAPI connection — it ships to the read tasks, so it must be
     picklable (import inside, e.g. ``lambda: sqlite3.connect(path)``).
-    With ``shard_keys`` + ``shard_column``, one read task runs per key
-    with ``WHERE shard_column = ?``; otherwise a single task runs the
+    With ``shard_keys`` + ``shard_column``, one read task runs per key,
+    filtering the user query AS A SUBQUERY (``SELECT * FROM ({sql})
+    WHERE shard_column = ?``) so queries with their own WHERE / GROUP
+    BY / ORDER BY stay valid — which means ``shard_column`` must appear
+    in the query's output columns. Otherwise a single task runs the
     query as-is."""
     def run_query(query: str, params: tuple = ()) -> pa.Table:
         conn = connection_factory()
@@ -205,7 +208,14 @@ def read_sql(sql: str, connection_factory: Callable[[], Any], *,
                 {n: [] for n in names})
 
     if shard_keys and shard_column:
-        sharded = f"{sql} WHERE {shard_column} = ?"
+        # Wrap as a subquery (reference: sql_datasource shards the same
+        # way): appending WHERE to a query that already has its own
+        # WHERE / GROUP BY / ORDER BY would be invalid SQL or silently
+        # filter the wrong rows.
+        # The derived table needs an alias: SQLite tolerates its absence
+        # but PostgreSQL/MySQL reject it.
+        sharded = (f"SELECT * FROM ({sql}) AS _sharded "  # noqa: S608
+                   f"WHERE {shard_column} = ?")
         tasks = [ReadTask((lambda k=k: run_query(sharded, (k,))),
                           {"shard": k}) for k in shard_keys]
     else:

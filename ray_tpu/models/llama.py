@@ -242,10 +242,20 @@ def _attention_block(layer: dict, x: jax.Array, positions: jax.Array,
         # Already inside a shard_map with an "sp" axis.
         out = ring_attention(q, k, v, axis_name="sp", causal=True)
     elif config.attention == "flash":
-        # Pallas blockwise kernel (ray_tpu.ops.flash_attention).
-        from ray_tpu.ops import flash_attention
+        # Pallas blockwise kernel (ray_tpu.ops.flash_attention). Inside
+        # a manual-tp shard_map body (tp_axis set) arrays are already
+        # local shards — call the kernel directly; under plain GSPMD jit
+        # the wrapper drops into shard_map itself (mosaic kernels can't
+        # be auto-partitioned on a real multi-chip mesh).
+        from ray_tpu.ops.flash_attention import (
+            flash_attention,
+            flash_attention_gspmd,
+        )
 
-        out = flash_attention(q, k, v, causal=True)
+        if tp_axis is not None:
+            out = flash_attention(q, k, v, causal=True)
+        else:
+            out = flash_attention_gspmd(q, k, v, causal=True)
     else:
         out = plain_attention(q, k, v, causal=True)
     proj = jnp.einsum("blhd,hde->ble", out, layer["wo"].astype(dtype))

@@ -7,7 +7,7 @@ vjp) and falls back to pallas interpret mode off-TPU so the same code
 path runs in CPU tests.
 """
 
-from ray_tpu.ops.flash_attention import flash_attention
+from ray_tpu.ops.flash_attention import flash_attention, flash_attention_gspmd
 from ray_tpu.ops.fused import rms_norm
 
-__all__ = ["flash_attention", "rms_norm"]
+__all__ = ["flash_attention", "flash_attention_gspmd", "rms_norm"]

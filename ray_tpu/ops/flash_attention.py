@@ -339,6 +339,39 @@ def _core_bwd(causal, block_q, block_k, interpret, res, g):
 _flash_core.defvjp(_core_fwd, _core_bwd)
 
 
+def flash_attention_gspmd(q, k, v, causal: bool = True,
+                          block_q: int = 512, block_k: int = 512,
+                          interpret: bool | None = None):
+    """Flash attention callable from inside a GSPMD-jitted model on a
+    multi-device mesh.
+
+    Mosaic kernels cannot be auto-partitioned by GSPMD, so on a mesh
+    that actually splits batch/heads the pallas call must be dropped
+    into shard_map explicitly: batch stays over (dp, fsdp), heads over
+    tp, sequence unsharded (ring attention owns the sp axis). With no
+    ambient mesh — or a mesh whose dp/fsdp/tp axes are all singleton —
+    this is exactly ``flash_attention``.
+    """
+    import functools
+
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh.empty or all(mesh.shape.get(a, 1) == 1
+                         for a in ("dp", "fsdp", "tp")):
+        return flash_attention(q, k, v, causal, block_q, block_k,
+                               interpret)
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(("dp", "fsdp"), None, "tp", None)
+
+    @functools.partial(jax.shard_map, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    def inner(q, k, v):
+        return flash_attention(q, k, v, causal, block_q, block_k,
+                               interpret)
+
+    return inner(q, k, v)
+
+
 def flash_attention(q, k, v, causal: bool = True, block_q: int = 512,
                     block_k: int = 512, interpret: bool | None = None):
     """Flash attention over [B, L, H, D] (layout used by models/llama).

@@ -156,12 +156,17 @@ class A2C(Algorithm):
                     "compute_gradients", minibatch)
                 metrics_list.append(m)
                 trained += len(minibatch)
+                # Row-weighted sum: each chunk's per-row mean gradient
+                # scaled by its row count, so a smaller final chunk
+                # contributes exactly its share (sum len*g / total ==
+                # the full-batch per-row mean).
+                w = float(len(minibatch))
+                g = jax.tree_util.tree_map(lambda x: x * w, g)
                 grads_sum = g if grads_sum is None else (
                     jax.tree_util.tree_map(jnp.add, grads_sum, g))
-            n = len(metrics_list)
             self.learner_group.call(
                 "apply_gradients",
-                jax.tree_util.tree_map(lambda x: x / n, grads_sum))
+                jax.tree_util.tree_map(lambda x: x / trained, grads_sum))
             metrics = {k: float(np.mean([float(m[k])
                                          for m in metrics_list]))
                        for k in metrics_list[0]}

@@ -319,7 +319,7 @@ class ClientServer:
         ray_tpu.kill(handle)
         return True
 
-    def borrow(self, borrower_id: str, keys: list[str]) -> int:
+    def borrow(self, borrower_id: str, keys: list[str]) -> tuple:
         """A worker process deserialized these driver-owned refs and
         may hold them past its current task: pin them here (an
         ObjectRef registers a driver refcount, blocking eviction) until
@@ -357,7 +357,10 @@ class ClientServer:
                 self._borrowers.setdefault(k, set()).add(borrower_id)
                 self._borrow_seen[(k, borrower_id)] = now
             pinned += 1
-        return pinned
+        # The TTL rides back so borrowers pace their keepalives against
+        # THIS server's lease clock — the two processes need not share
+        # a RAY_TPU_BORROW_TTL_S env var.
+        return pinned, self._borrow_ttl_s
 
     def release(self, keys: list[str],
                 borrower_id: str | None = None) -> int:

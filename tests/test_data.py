@@ -533,8 +533,10 @@ def test_read_sql_sharded_and_plain(ray_start_regular, tmp_path):
     rows = sorted(ds.take_all(), key=lambda r: r["name"])
     assert [r["name"] for r in rows] == ["ada", "kit", "rex", "tom"]
 
-    # Sharded: one read task per kind, executed in parallel tasks.
-    ds = rd.read_sql("SELECT name, age FROM pets",
+    # Sharded: one read task per kind, executed in parallel tasks. The
+    # user query is wrapped as a subquery, so the shard column must be
+    # among its output columns — and a query with its own WHERE works.
+    ds = rd.read_sql("SELECT name, kind, age FROM pets WHERE age > 0",
                      lambda: __import__("sqlite3").connect(db),
                      shard_keys=["dog", "cat"], shard_column="kind")
     assert ds.num_blocks() == 2
