@@ -110,8 +110,52 @@ def bench_impala_e2e(num_runners: int, num_envs: int = 512,
                        "broadcast_interval": 1}}
 
 
+def bench_learner_only(num_envs: int = 512, fragment: int = 200,
+                       iters: int = 30) -> dict:
+    """Learner-path ceiling: V-trace updates on ONE pre-collected batch
+    in a tight loop — no sampling, no transport. Together with the raw
+    sampling number this bounds the achievable e2e rate on this host:
+    e2e <= 1 / (1/sampling + 1/learner) when both share the same
+    core(s), which is exactly the single-box regime."""
+    import jax
+
+    from ray_tpu.rllib import IMPALAConfig
+
+    config = (IMPALAConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=0,
+                           num_envs_per_env_runner=num_envs,
+                           rollout_fragment_length=fragment))
+    algo = config.build()
+    batch = algo.local_env_runner.sample(fragment)
+    from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
+
+    sb = SampleBatch({k: batch[k] for k in (
+        Columns.OBS, Columns.ACTIONS, Columns.REWARDS,
+        Columns.TERMINATEDS, Columns.TRUNCATEDS, Columns.ACTION_LOGP)})
+    sb["bootstrap_value"] = batch["bootstrap_value"]
+    steps_per_batch = int(np.shape(batch[Columns.REWARDS])[0]
+                          * np.shape(batch[Columns.REWARDS])[1])
+    metrics = algo.learner_group.update_from_batch(
+        sb, shard=False, sync_metrics=False)  # compile
+    jax.device_get(metrics)
+    start = time.perf_counter()
+    for _ in range(iters):
+        metrics = algo.learner_group.update_from_batch(
+            sb, shard=False, sync_metrics=False)
+    jax.device_get(metrics)
+    elapsed = time.perf_counter() - start
+    algo.cleanup()
+    return {"metric": "rllib_learner_only_env_steps_per_s",
+            "value": round(iters * steps_per_batch / elapsed, 1),
+            "unit": "steps/s",
+            "detail": {"batch_shape": [fragment, num_envs],
+                       "iters": iters}}
+
+
 def main() -> None:
-    num_runners = int(sys.argv[1]) if len(sys.argv) > 1 else min(
+    positional = [a for a in sys.argv[1:] if not a.startswith("-")]
+    num_runners = int(positional[0]) if positional else min(
         8, max(2, (os.cpu_count() or 4) - 2))
     ray_tpu.shutdown()
     ray_tpu.init(num_cpus=max(num_runners + 2, os.cpu_count() or 4))
@@ -119,7 +163,42 @@ def main() -> None:
     results = [
         bench_raw_sampling(num_runners),
         bench_impala_e2e(num_runners),
+        bench_learner_only(),
     ]
+
+    # Runner-count scaling curve: on a multi-core host the e2e number
+    # climbs with the fleet; on a 1-core host it plateaus at the
+    # serial-composition bound the learner-only/sampling ceilings
+    # predict — the curve is the evidence either way.
+    if "--no-scaling" not in sys.argv:
+        curve = []
+        for n in (1, 2, 4):
+            e2e = bench_impala_e2e(n, iters=4)
+            curve.append({"num_runners": n, "e2e_steps_per_s":
+                          e2e["value"]})
+            print(json.dumps({"scaling_point": curve[-1]}), flush=True)
+        sampling = next(r for r in results
+                        if r["metric"] == "rllib_sampling_env_steps_per_s")
+        learner = next(r for r in results
+                       if r["metric"] == "rllib_learner_only_env_steps_per_s")
+        bound = 1.0 / (1.0 / sampling["value"] + 1.0 / learner["value"])
+        results.append({
+            "metric": "rllib_impala_scaling_curve",
+            "value": curve[-1]["e2e_steps_per_s"],
+            "unit": "steps/s",
+            "detail": {
+                "curve": curve,
+                "host_cpus": os.cpu_count(),
+                "sampling_ceiling": sampling["value"],
+                "learner_ceiling": learner["value"],
+                "serial_composition_bound": round(bound, 1),
+                "note": "on a single-core host sampling and learning "
+                        "share the core, so e2e is bounded by the "
+                        "serial composition of the two ceilings; the "
+                        "1M steps/s target (BASELINE.md:29) assumes a "
+                        "multi-core rollout fleet",
+            }})
+
     for r in results:
         r["detail"]["host_cpus"] = os.cpu_count()
         print(json.dumps(r), flush=True)

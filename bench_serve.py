@@ -1,0 +1,114 @@
+"""Serve throughput benchmark: HTTP-path and handle-path QPS.
+
+The reference publishes serving throughput via its own microbenchmarks
+(serve benchmarks in release tests); this is the single-box analogue:
+an echo deployment, persistent HTTP/1.1 connections (one per client
+thread), and a direct DeploymentHandle loop to separate proxy cost
+from router+replica cost.
+
+Writes BENCH_SERVE.json; one JSON line per metric.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import threading
+import time
+
+os.environ.setdefault("RAY_TPU_SKIP_TPU_DETECTION", "1")
+
+import ray_tpu
+from ray_tpu import serve
+
+N_CLIENTS = int(os.environ.get("SERVE_BENCH_CLIENTS", "4"))
+DURATION_S = float(os.environ.get("SERVE_BENCH_DURATION_S", "10"))
+RESULTS: list[dict] = []
+
+
+def bench_http(port: int) -> None:
+    counts = [0] * N_CLIENTS
+    stop = threading.Event()
+
+    def client(i: int) -> None:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+        payload = json.dumps({"i": i}).encode()
+        while not stop.is_set():
+            conn.request("POST", "/", body=payload,
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"HTTP {resp.status}")
+            counts[i] += 1
+        conn.close()
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(N_CLIENTS)]
+    start = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(DURATION_S)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    elapsed = time.perf_counter() - start
+    RESULTS.append({
+        "metric": "serve_http_qps",
+        "value": round(sum(counts) / elapsed, 1),
+        "unit": "requests/s",
+        "detail": {"clients": N_CLIENTS, "keepalive": True,
+                   "duration_s": DURATION_S,
+                   "host_cpus": os.cpu_count()}})
+
+
+def bench_handle() -> None:
+    handle = serve.get_app_handle("bench")
+    # Pipeline depth 8: keep the router busy without unbounded queueing.
+    inflight: list = []
+    n = 0
+    start = time.perf_counter()
+    while time.perf_counter() - start < DURATION_S:
+        inflight.append(handle.remote({"i": n}))
+        if len(inflight) >= 8:
+            inflight.pop(0).result(timeout_s=30)
+            n += 1
+    for r in inflight:
+        r.result(timeout_s=30)
+        n += 1
+    elapsed = time.perf_counter() - start
+    RESULTS.append({
+        "metric": "serve_handle_qps",
+        "value": round(n / elapsed, 1),
+        "unit": "requests/s",
+        "detail": {"pipeline_depth": 8, "duration_s": DURATION_S,
+                   "host_cpus": os.cpu_count()}})
+
+
+def main() -> None:
+    ray_tpu.init(ignore_reinit_error=True)
+    serve.start(http_options={"host": "127.0.0.1", "port": 0})
+
+    @serve.deployment(num_replicas=2)
+    def echo(body):
+        return body
+
+    serve.run(echo.bind(), name="bench", route_prefix="/")
+    from ray_tpu.serve import api as serve_api
+
+    port = serve_api._proxy.port
+    bench_http(port)
+    bench_handle()
+    serve.shutdown()
+    ray_tpu.shutdown()
+    for r in RESULTS:
+        print(json.dumps(r), flush=True)
+    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "BENCH_SERVE.json"), "w") as f:
+        for r in RESULTS:
+            f.write(json.dumps(r) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -249,6 +249,10 @@ class GlobalControlService:
             self._nodes[record.node_id] = record
         self.pubsub.publish("nodes", ("ALIVE", record.node_id))
 
+    def get_node(self, node_id: NodeID) -> NodeRecord | None:
+        with self._lock:
+            return self._nodes.get(node_id)
+
     def mark_node_dead(self, node_id: NodeID) -> None:
         with self._lock:
             record = self._nodes.get(node_id)

@@ -266,8 +266,24 @@ class GcsServer:
     # -- node service -------------------------------------------------
     def _register_node(self, address: str, resources: dict,
                        labels: dict | None = None,
-                       executor_address: str = "") -> bytes:
-        node_id = NodeID()
+                       executor_address: str = "",
+                       prior_id: bytes | None = None) -> bytes:
+        """``prior_id``: a daemon re-registering after its heartbeat was
+        rejected asks to KEEP its id. Granted when this head has never
+        seen the id (head restart amnesia — reference: raylets keep
+        their NodeID across a GCS restart) or when the record matches
+        (retry of a lost reply). Refused when the id is known DEAD: the
+        death verdict stands, recovery may already be re-executing its
+        lineage — the daemon comes back as a fresh node."""
+        node_id = None
+        if prior_id is not None:
+            candidate = NodeID(prior_id)
+            existing = self.gcs.get_node(candidate)
+            if existing is None or (existing.alive
+                                    and existing.address == address):
+                node_id = candidate
+        if node_id is None:
+            node_id = NodeID()
         self.gcs.register_node(NodeRecord(
             node_id=node_id, address=address, resources=dict(resources),
             labels=dict(labels or {}),

@@ -78,7 +78,8 @@ class NodeAgent:
         self.usage_fn = usage_fn
         self.executor_address = executor_address
         self._address = f"{_own_address()}:{os.getpid()}"
-        self.node_id: bytes = self._register()
+        self.node_id: bytes = b""
+        self.node_id = self._register()
         self._shutdown = threading.Event()
         self._poke = threading.Event()
         self._thread = threading.Thread(
@@ -86,9 +87,12 @@ class NodeAgent:
         self._thread.start()
 
     def _register(self) -> bytes:
+        # prior_id: across a head restart the daemon asks to keep its
+        # node id, so drivers' mirrored node tables (and in-flight work
+        # keyed by the id) converge without a spurious death+rejoin.
         return self.client.call(
             "register_node", self._address, self.resources, self.labels,
-            self.executor_address)
+            self.executor_address, prior_id=self.node_id or None)
 
     def poke(self) -> None:
         """Load changed: push a heartbeat now (coalesced)."""
@@ -112,9 +116,11 @@ class NodeAgent:
                 accepted = self.client.call(
                     "heartbeat", self.node_id, available)
                 if not accepted:
-                    # Unknown/dead at the head (stall past the timeout or
-                    # a head restart): re-register under a fresh node id
-                    # (reference: raylet re-registration flow).
+                    # Unknown/dead at the head (stall past the timeout
+                    # or a head restart): re-register, asking to keep
+                    # our id — the head grants it unless it declared
+                    # this id dead (reference: raylet re-registration
+                    # after GCS restart keeps the NodeID).
                     self.node_id = self._register()
             except RpcError:
                 pass  # head unreachable; keep trying (it may restart)

@@ -68,6 +68,15 @@ class NodeState:
     alive: bool = True
     reported: dict[str, float] | None = None
     reported_at: float = 0.0
+    # This driver's outstanding leases, plus a snapshot of them taken
+    # when ``reported`` last arrived: the report is compensated by OUR
+    # lease delta since it was measured. Without this, a node whose
+    # report says "0 CPUs free" stays unschedulable for a full
+    # poke-coalesce + pubsub round trip AFTER our own task released its
+    # lease — capping slot turnover (and hence cluster-wide task
+    # throughput) at the sync latency instead of the task duration.
+    inflight: dict[str, float] = field(default_factory=dict)
+    reported_inflight: dict[str, float] = field(default_factory=dict)
 
     def effective_available(self, key: str) -> float:
         avail = self.available.get(key, 0.0)
@@ -75,7 +84,12 @@ class NodeState:
                 or time.monotonic() - self.reported_at
                 > REPORTED_AVAILABILITY_TTL_S):
             return avail
-        return min(avail, self.reported.get(key, avail))
+        if key not in self.reported:
+            return avail
+        rep = self.reported[key] + (
+            self.reported_inflight.get(key, 0.0)
+            - self.inflight.get(key, 0.0))
+        return min(avail, rep)
 
     def fits(self, demand: dict[str, float]) -> bool:
         return all(self.effective_available(k) + 1e-9 >= v
@@ -87,10 +101,12 @@ class NodeState:
     def acquire(self, demand: dict[str, float]) -> None:
         for key, value in demand.items():
             self.available[key] = self.available.get(key, 0.0) - value
+            self.inflight[key] = self.inflight.get(key, 0.0) + value
 
     def release(self, demand: dict[str, float]) -> None:
         for key, value in demand.items():
             self.available[key] = self.available.get(key, 0.0) + value
+            self.inflight[key] = self.inflight.get(key, 0.0) - value
 
     def utilization(self) -> float:
         best = 0.0
@@ -231,6 +247,10 @@ class ClusterState:
             if node is not None:
                 node.reported = dict(available)
                 node.reported_at = time.monotonic()
+                # The report reflects our leases AS OF NOW; future
+                # effective_available compensates only for our delta
+                # past this snapshot.
+                node.reported_inflight = dict(node.inflight)
                 self._lock.notify_all()
 
     def force_acquire(self, node_id: NodeID, demand: dict[str, float]) -> None:
@@ -258,6 +278,12 @@ class _QueuedTask:
     run: Callable[[TaskSpec, NodeState], None]
     order: int = field(default_factory=_DISPATCH_ORDER.next)
     unresolved_deps: int = 0
+    # Lifecycle flags (mutated under the dispatcher lock). Cancelled and
+    # claimed entries are purged LAZILY at the next dispatch pass: a
+    # 100k-deep queue makes every eager list.remove an O(queue) scan,
+    # turning drains and mass-cancels into O(queue x ops).
+    claimed: bool = False
+    cancelled: bool = False
 
 
 class Dispatcher:
@@ -275,6 +301,10 @@ class Dispatcher:
         self._lock = threading.Condition(threading.Lock())
         self._waiting: list[_QueuedTask] = []  # deps not ready
         self._ready: list[_QueuedTask] = []  # deps ready, awaiting resources
+        # return-object id -> queued task, for O(1) cancel at any queue
+        # depth; entries leave at claim (running tasks are not
+        # cancellable) or at cancel.
+        self._by_return_id: dict = {}
         self._shutdown = False
         self._infeasible_warned: set[str] = set()
         self._on_task_state = on_task_state
@@ -300,6 +330,8 @@ class Dispatcher:
             else:
                 task._dep_ids = {d.id() for d in pending_deps}
                 self._waiting.append(task)
+            for rid in task.spec.return_ids:
+                self._by_return_id[rid] = task
             self._lock.notify_all()
 
     def _on_object_sealed(self, object_id) -> None:
@@ -326,7 +358,11 @@ class Dispatcher:
                     self._lock.wait(timeout=0.2)
                 if self._shutdown:
                     return
-                # FIFO within the queue; stable by submission order.
+                # Purge claimed/cancelled entries once per pass (lazy
+                # removal — see _QueuedTask flags), then FIFO within
+                # the queue; stable by submission order.
+                self._ready = [t for t in self._ready
+                               if not (t.claimed or t.cancelled)]
                 self._ready.sort(key=lambda t: t.order)
                 pending = list(self._ready)
             launched_any = False
@@ -337,6 +373,8 @@ class Dispatcher:
             # go O(pending^2) while holding the GIL away from runners).
             failed_sigs: set = set()
             for task in pending:
+                if task.cancelled or task.claimed:
+                    continue
                 spec = task.spec
                 strategy = spec.scheduling_strategy
                 sig = (tuple(sorted(spec.resources.items())),
@@ -356,12 +394,15 @@ class Dispatcher:
                     continue
                 claimed = False
                 with self._lock:
-                    try:
-                        self._ready.remove(task)
+                    if not task.cancelled:
+                        task.claimed = True
                         self._num_running += 1
                         claimed = True
-                    except ValueError:
-                        pass
+                        # Running tasks are past cancellation: drop the
+                        # cancel index so a late cancel() can't race
+                        # the real result with a TaskCancelledError.
+                        for rid in spec.return_ids:
+                            self._by_return_id.pop(rid, None)
                 if not claimed:
                     # Concurrently cancelled after admission: give the
                     # acquired resources back or the node leaks them.
@@ -414,9 +455,17 @@ class Dispatcher:
 
     # --------------------------------------------------------------- control
 
+    def _live_ready_count(self) -> int:
+        # Caller holds the lock. Claimed/cancelled zombies sit in
+        # _ready until the next dispatch pass purges them (lazy
+        # removal); counts must not see them.
+        return sum(1 for t in self._ready
+                   if not (t.claimed or t.cancelled))
+
     def pending_count(self) -> int:
         with self._lock:
-            return len(self._waiting) + len(self._ready) + self._num_running
+            return (len(self._waiting) + self._live_ready_count()
+                    + self._num_running)
 
     def pending_demands(self) -> list[dict[str, float]]:
         """Resource demands of queued-not-running tasks — the autoscaler's
@@ -424,41 +473,46 @@ class Dispatcher:
         to the GCS for the autoscaler)."""
         with self._lock:
             return [dict(t.spec.resources)
-                    for t in self._ready + self._waiting if t.spec.resources]
+                    for t in self._ready + self._waiting
+                    if t.spec.resources
+                    and not (t.claimed or t.cancelled)]
 
     def wait_idle(self, timeout: float | None = None) -> bool:
         deadline = None if timeout is None else time.monotonic() + timeout
         with self._lock:
-            while len(self._waiting) + len(self._ready) + self._num_running > 0:
+            while (len(self._waiting) + self._live_ready_count()
+                   + self._num_running) > 0:
                 remaining = None if deadline is None else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
                     return False
                 self._lock.wait(timeout=0.1 if remaining is None else min(remaining, 0.1))
             return True
 
-    def cancel_pending(self, task_id) -> bool:
-        with self._lock:
-            for queue in (self._waiting, self._ready):
-                for task in queue:
-                    if task.spec.task_id == task_id:
-                        queue.remove(task)
-                        return True
-        return False
-
     def cancel_by_return_id(self, object_id) -> "TaskSpec | None":
-        """Remove the not-yet-dispatched task producing ``object_id``.
+        """Cancel the not-yet-dispatched task producing ``object_id``.
 
-        Returns the removed spec, or None if the task already started
+        Returns the cancelled spec, or None if the task already started
         (cancellation of running threads is not possible — matches the
         best-effort semantics of the reference's non-force cancel).
+        O(1) at any queue depth: the queue entry is only FLAGGED here
+        and physically purged by the next dispatch pass (a mass-cancel
+        of a deep backlog must not do an O(queue) list scan per call).
         """
         with self._lock:
-            for queue in (self._waiting, self._ready):
-                for task in queue:
-                    if any(rid == object_id for rid in task.spec.return_ids):
-                        queue.remove(task)
-                        return task.spec
-        return None
+            task = self._by_return_id.get(object_id)
+            if task is None or task.claimed or task.cancelled:
+                return None
+            task.cancelled = True
+            for rid in task.spec.return_ids:
+                self._by_return_id.pop(rid, None)
+            if task.unresolved_deps:
+                # Waiting tasks are few (deps gate them); eager removal
+                # keeps _on_object_sealed's scan honest.
+                try:
+                    self._waiting.remove(task)
+                except ValueError:
+                    pass
+            return task.spec
 
     def shutdown(self) -> None:
         with self._lock:

@@ -441,21 +441,29 @@ class Runtime:
             if info.get("executor_address"):
                 listed[NodeID(bytes.fromhex(info["node_id"]))] = info
 
-        # Reconcile disappearances: a node gone from the table entirely
-        # (head restart pruned it) or now dead must be dropped, and a
-        # daemon that re-registered under a fresh id must not leave its
-        # old id double-counting capacity (same executor_address).
+        # Reconcile disappearances: a node the head declared DEAD, or
+        # whose executor address changed, is dropped; so is an old id
+        # superseded by a re-registration under a fresh id (same
+        # executor_address must not double-count capacity). A node
+        # merely ABSENT from the table gets a direct-ping grace first:
+        # a freshly restarted head starts with an empty table, and the
+        # daemon (which keeps its node id across head restarts) may
+        # simply not have re-registered yet — its in-flight work is
+        # alive and must not be failed by head amnesia.
         with self._remote_nodes_lock:
             known = dict(self._remote_nodes)
         alive_addrs = {info["executor_address"] for nid, info
                        in listed.items() if info["alive"]}
         for node_id, handle in known.items():
             info = listed.get(node_id)
-            stale = (info is None or not info["alive"]
-                     or info["executor_address"] != handle.address)
             superseded = (info is None
                           and handle.address in alive_addrs)
-            if stale or superseded:
+            declared_dead = info is not None and (
+                not info["alive"]
+                or info["executor_address"] != handle.address)
+            amnesia = info is None and not superseded
+            if superseded or declared_dead or (
+                    amnesia and not handle.ping()):
                 self._drop_remote_node(node_id)
 
         for node_id, info in listed.items():

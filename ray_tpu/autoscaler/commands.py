@@ -108,6 +108,18 @@ def make_provider(config: dict, head_address: str):
     if ptype == "local":
         return LocalDaemonNodeProvider(
             head_address, pool_size=int(prov.get("pool_size", 2)))
+    if ptype == "gcp":
+        from ray_tpu.autoscaler.gcp import GcpTpuNodeProvider
+
+        node_configs = {
+            name: dict(nt.get("node_config") or {})
+            for name, nt in (config.get("available_node_types")
+                             or {}).items()}
+        return GcpTpuNodeProvider(
+            head_address, config.get("cluster_name", "ray-tpu"),
+            node_configs,
+            project=prov.get("project_id"),
+            zone=prov.get("availability_zone"))
     if ptype == "external":
         module_path = prov.get("module", "")
         if ":" not in module_path:
@@ -122,7 +134,7 @@ def make_provider(config: dict, head_address: str):
                    **{k: v for k, v in prov.items()
                       if k not in ("type", "module")})
     raise ValueError(
-        f"unknown provider type {ptype!r} (builtin: local, external)")
+        f"unknown provider type {ptype!r} (builtin: local, gcp, external)")
 
 
 def _run_commands(commands: list | None, phase: str) -> None:

@@ -1,0 +1,296 @@
+"""GCE/GKE TPU node provider: acquire real TPU pod slices as GANGS.
+
+Reference surface: python/ray/autoscaler/_private/gcp/node_provider.py:63
+(GCPNodeProvider with GCPCompute + GCPTPU resources) and gcp/node.py —
+but redesigned TPU-first:
+
+- The unit of acquisition is a pod SLICE, not a VM. One create_node call
+  provisions one slice (``tpu.projects.locations.nodes.create``), whose
+  per-host VMs each boot a worker-node daemon; the call succeeds only
+  when EVERY host has registered with the head (slice gang — a partial
+  slice cannot run an SPMD program and is torn down, not kept).
+- Slice workers self-describe via accelerators.detect_tpu_topology():
+  worker 0 advertises the ``TPU-{type}-head`` gang resource, so a
+  placement of the whole slice keys off ONE resource demand
+  (accelerators.py:131).
+- The cloud fabric sits behind ``TpuCloudClient`` — a four-call surface
+  (create/delete/get/list) the REST client implements with the GCE
+  metadata-server token, and tests implement with a local fake that
+  boots real daemon processes per slice host. Provider logic (naming,
+  gang wait, all-or-nothing teardown, retry/cleanup) is identical in
+  both cases and is what the tests exercise.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from typing import Any
+
+from ray_tpu._private.ids import NodeID
+from ray_tpu.autoscaler.node_provider import NodeProvider
+
+logger = logging.getLogger("ray_tpu")
+
+# accelerator_type -> hosts per slice (chips total / 4 chips per host,
+# v5e layouts; reference: gcp provider sizes TPU pods the same way).
+_SLICE_HOSTS = {
+    "v5litepod-4": 1, "v5litepod-8": 2, "v5litepod-16": 4,
+    "v5litepod-32": 8, "v5litepod-64": 16, "v5litepod-128": 32,
+    "v5litepod-256": 64,
+    "v4-8": 1, "v4-16": 2, "v4-32": 4,
+}
+
+
+def slice_num_hosts(accelerator_type: str) -> int:
+    try:
+        return _SLICE_HOSTS[accelerator_type]
+    except KeyError:
+        # v5litepod-N / v4-N: N chips, 4 per host.
+        chips = int(accelerator_type.rsplit("-", 1)[1])
+        return max(1, chips // 4)
+
+
+class TpuCloudClient:
+    """The cloud calls the provider needs. States follow the TPU API:
+    CREATING -> READY -> (DELETING ->) gone."""
+
+    def create_node(self, name: str, accelerator_type: str,
+                    runtime_version: str, labels: dict) -> None:
+        raise NotImplementedError
+
+    def delete_node(self, name: str) -> None:
+        raise NotImplementedError
+
+    def get_node(self, name: str) -> dict | None:
+        """-> {"name", "state", "labels"} or None when absent."""
+        raise NotImplementedError
+
+    def list_nodes(self, label_filter: dict | None = None) -> list[dict]:
+        raise NotImplementedError
+
+
+class RestTpuCloudClient(TpuCloudClient):
+    """tpu.googleapis.com v2 REST client authenticated via the GCE
+    metadata server (the identity a head node on GCE already has; no
+    SDK dependency — plain urllib)."""
+
+    _API = "https://tpu.googleapis.com/v2"
+    _TOKEN_URL = ("http://metadata.google.internal/computeMetadata/v1/"
+                  "instance/service-accounts/default/token")
+
+    def __init__(self, project: str, zone: str):
+        self._parent = f"projects/{project}/locations/{zone}"
+        self._token: str | None = None
+        self._token_expiry = 0.0
+
+    def _auth_token(self) -> str:
+        import json
+        import urllib.request
+
+        if self._token and time.time() < self._token_expiry - 60:
+            return self._token
+        req = urllib.request.Request(
+            self._TOKEN_URL, headers={"Metadata-Flavor": "Google"})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            payload = json.loads(resp.read())
+        self._token = payload["access_token"]
+        self._token_expiry = time.time() + float(
+            payload.get("expires_in", 300))
+        return self._token
+
+    def _call(self, method: str, path: str, body: dict | None = None):
+        import json
+        import urllib.error
+        import urllib.request
+
+        url = f"{self._API}/{path}"
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            url, data=data, method=method,
+            headers={"Authorization": f"Bearer {self._auth_token()}",
+                     "Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                return None
+            raise
+
+    def create_node(self, name: str, accelerator_type: str,
+                    runtime_version: str, labels: dict) -> None:
+        self._call(
+            "POST", f"{self._parent}/nodes?nodeId={name}",
+            {"acceleratorType": accelerator_type,
+             "runtimeVersion": runtime_version,
+             "labels": dict(labels)})
+
+    def delete_node(self, name: str) -> None:
+        self._call("DELETE", f"{self._parent}/nodes/{name}")
+
+    def get_node(self, name: str) -> dict | None:
+        node = self._call("GET", f"{self._parent}/nodes/{name}")
+        if node is None:
+            return None
+        return {"name": name, "state": node.get("state", "CREATING"),
+                "labels": node.get("labels", {})}
+
+    def list_nodes(self, label_filter: dict | None = None) -> list[dict]:
+        reply = self._call("GET", f"{self._parent}/nodes") or {}
+        out = []
+        for node in reply.get("nodes", []):
+            labels = node.get("labels", {})
+            if label_filter and any(labels.get(k) != v
+                                    for k, v in label_filter.items()):
+                continue
+            out.append({"name": node["name"].rsplit("/", 1)[-1],
+                        "state": node.get("state", "CREATING"),
+                        "labels": labels})
+        return out
+
+
+class GcpTpuNodeProvider(NodeProvider):
+    """Provisions TPU pod slices and returns the slice-head cluster
+    node once the WHOLE gang has registered with the head.
+
+    node_type config (available_node_types[...]["node_config"]):
+      {"tpu_accelerator": "v5litepod-16", "runtime_version": ...}
+    """
+
+    def __init__(self, head_address: str, cluster_name: str,
+                 node_configs: dict[str, dict],
+                 client: TpuCloudClient | None = None,
+                 project: str | None = None, zone: str | None = None,
+                 provision_timeout_s: float = 900.0,
+                 register_timeout_s: float = 300.0):
+        if client is None:
+            client = RestTpuCloudClient(
+                project or os.environ.get("GCP_PROJECT", ""),
+                zone or os.environ.get("GCP_ZONE", ""))
+        self._client = client
+        self._head = head_address
+        self._cluster = cluster_name
+        self._node_configs = node_configs
+        self._provision_timeout = provision_timeout_s
+        self._register_timeout = register_timeout_s
+        self._lock = threading.Lock()
+        # slice name -> {"head_node_id": NodeID, "accelerator": str}
+        self._slices: dict[str, dict] = {}
+        self._by_node: dict[NodeID, str] = {}
+
+    # ------------------------------------------------------------ helpers
+
+    def _cluster_nodes(self) -> list[dict]:
+        from ray_tpu._private.rpc import RpcClient, RpcError
+
+        client = RpcClient(self._head, timeout_s=5.0)
+        try:
+            return client.call("list_nodes")
+        except (RpcError, OSError):
+            return []
+        finally:
+            client.close()
+
+    def _slice_members(self, slice_name: str) -> list[dict]:
+        return [n for n in self._cluster_nodes()
+                if n.get("alive")
+                and n.get("labels", {}).get("tpu_slice") == slice_name]
+
+    # ------------------------------------------------------------ surface
+
+    def create_node(self, node_type: str,
+                    resources: dict[str, float]) -> NodeID | None:
+        cfg = self._node_configs.get(node_type, {})
+        accelerator = cfg.get("tpu_accelerator")
+        if not accelerator:
+            raise ValueError(
+                f"node type {node_type!r} has no tpu_accelerator; the "
+                "GCP TPU provider only launches TPU slices")
+        hosts = slice_num_hosts(accelerator)
+        slice_name = (f"{self._cluster}-{node_type}-"
+                      f"{os.urandom(4).hex()}")[:60].lower()
+        self._client.create_node(
+            slice_name, accelerator,
+            cfg.get("runtime_version", "tpu-ubuntu2204-base"),
+            {"ray-cluster": self._cluster, "ray-node-type": node_type})
+
+        # Phase 1: the cloud brings the slice to READY.
+        deadline = time.monotonic() + self._provision_timeout
+        while True:
+            node = self._client.get_node(slice_name)
+            state = (node or {}).get("state")
+            if state == "READY":
+                break
+            if state in (None, "FAILED", "TERMINATED") \
+                    or time.monotonic() > deadline:
+                logger.warning("TPU slice %s never became READY (%s)",
+                               slice_name, state)
+                self._client.delete_node(slice_name)
+                return None
+            time.sleep(1.0)
+
+        # Phase 2: every slice host's daemon registers (the GANG). The
+        # boot image's startup script points the daemon at the head;
+        # worker 0 carries the TPU-{type}-head resource
+        # (accelerators.detect_resources).
+        deadline = time.monotonic() + self._register_timeout
+        while time.monotonic() < deadline:
+            members = self._slice_members(slice_name)
+            if len(members) >= hosts:
+                head_node = next(
+                    (m for m in members
+                     if f"TPU-{accelerator}-head" in
+                     (m.get("resources") or {})), members[0])
+                node_id = NodeID(bytes.fromhex(head_node["node_id"]))
+                with self._lock:
+                    self._slices[slice_name] = {
+                        "head_node_id": node_id,
+                        "accelerator": accelerator,
+                    }
+                    self._by_node[node_id] = slice_name
+                return node_id
+            time.sleep(1.0)
+        # Partial gang: useless for SPMD — tear the slice down whole.
+        logger.warning(
+            "TPU slice %s: only %d/%d hosts registered; deleting",
+            slice_name, len(self._slice_members(slice_name)), hosts)
+        self._client.delete_node(slice_name)
+        return None
+
+    def terminate_node(self, node_id: NodeID) -> None:
+        with self._lock:
+            slice_name = self._by_node.pop(node_id, None)
+            if slice_name:
+                self._slices.pop(slice_name, None)
+        if slice_name:
+            self._client.delete_node(slice_name)
+
+    def non_terminated_nodes(self) -> list[NodeID]:
+        live = {n["name"] for n in self._client.list_nodes(
+            {"ray-cluster": self._cluster})
+            if n.get("state") in ("CREATING", "READY")}
+        with self._lock:
+            return [nid for nid, s in self._by_node.items() if s in live]
+
+    def node_metadata(self, node_id: NodeID) -> dict:
+        with self._lock:
+            slice_name = self._by_node.get(node_id)
+            info = self._slices.get(slice_name or "", {})
+        return {"tpu_slice": slice_name,
+                "accelerator": info.get("accelerator")}
+
+    def shutdown(self) -> None:
+        """Delete every slice this provider launched."""
+        with self._lock:
+            names = list(self._slices)
+            self._slices.clear()
+            self._by_node.clear()
+        for name in names:
+            try:
+                self._client.delete_node(name)
+            except Exception:  # noqa: BLE001 — best-effort teardown
+                logger.warning("failed deleting TPU slice %s", name,
+                               exc_info=True)

@@ -59,6 +59,12 @@ class LlamaConfig:
     # "ring" (context parallel over sp axis — requires running inside
     # shard_map with an "sp" axis; "ring_local" when already inside).
     attention: str = "plain"
+    # Chunked-vocab loss: >0 computes the training CE over sequence
+    # chunks of this many tokens so the [B, L, V] f32 logits are never
+    # materialized (the single biggest activation at training shapes —
+    # ~2 GiB at [8, 2048, 32000]); the per-chunk logits are recomputed
+    # in backward. 0 = classic full-logits path.
+    ce_chunk: int = 0
     # Mixture-of-Experts: >0 replaces the dense SwiGLU MLP with a top-1
     # routed expert layer (experts sharded over the ep mesh axis).
     num_experts: int = 0
@@ -290,13 +296,15 @@ def _moe_block(layer: dict, x: jax.Array,
 
 def forward(params: dict, tokens: jax.Array, config: LlamaConfig,
             positions: jax.Array | None = None,
-            with_aux: bool = False):
+            with_aux: bool = False, return_features: bool = False):
     """tokens [B, L] (local shard if under sp) -> logits [B, L, V] f32.
 
     When ``positions`` is provided they are the *global* token positions
     (needed for RoPE + causal masking under sequence parallelism).
     ``with_aux=True`` additionally returns the summed MoE load-balancing
-    loss (0.0 for dense configs).
+    loss (0.0 for dense configs). ``return_features=True`` returns the
+    final-norm hidden states INSTEAD of logits (the chunked-CE loss
+    applies the lm head itself, chunk by chunk).
     """
     if positions is None:
         b, l = tokens.shape
@@ -330,6 +338,8 @@ def forward(params: dict, tokens: jax.Array, config: LlamaConfig,
     (x, aux_sum), _ = lax.scan(
         step, (x, jnp.zeros((), dtype=jnp.float32)), params["layers"])
     x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
+    if return_features:
+        return (x, aux_sum) if with_aux else x
     # bf16 operands on the MXU with f32 accumulation: same numerics as
     # mixed-precision matmul everywhere else in the stack, ~2x the
     # throughput of an f32 matmul on v5e, and logits still come out f32.
@@ -349,16 +359,62 @@ def loss_fn(params: dict, tokens: jax.Array, targets: jax.Array,
     Written as ``logsumexp(logits) - logits[target]`` so XLA fuses the
     reduction instead of materializing a second [B, L, V] log-softmax
     array in HBM (the [B, L, V] f32 logits alone are ~2 GiB at the bench
-    shape — HBM bandwidth, not FLOPs, dominates this tail).
+    shape — HBM bandwidth, not FLOPs, dominates this tail). With
+    ``config.ce_chunk > 0`` even the logits themselves stay chunk-sized
+    (see _chunked_nll) — the freed HBM buys a larger batch.
 
     MoE configs add the router load-balancing loss scaled by
     ``moe_aux_loss_coef``.
     """
-    logits, aux = forward(params, tokens, config, positions, with_aux=True)
-    ce = cross_entropy(logits, targets, mask)
+    if config.ce_chunk > 0 and tokens.shape[1] % config.ce_chunk != 0:
+        # Silent fallback would materialize the very logits the user
+        # configured chunking to avoid — fail loudly instead.
+        raise ValueError(
+            f"ce_chunk={config.ce_chunk} must divide the sequence "
+            f"length {tokens.shape[1]}")
+    if config.ce_chunk > 0:
+        x, aux = forward(params, tokens, config, positions,
+                         with_aux=True, return_features=True)
+        nll = _chunked_nll(x, params["lm_head"], targets, config)
+        if mask is not None:
+            ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        else:
+            ce = jnp.mean(nll)
+    else:
+        logits, aux = forward(params, tokens, config, positions,
+                              with_aux=True)
+        ce = cross_entropy(logits, targets, mask)
     if config.num_experts > 0:
         return ce + config.moe_aux_loss_coef * aux
     return ce
+
+
+def _chunked_nll(x: jax.Array, lm_head: jax.Array, targets: jax.Array,
+                 config: LlamaConfig) -> jax.Array:
+    """Per-token NLL from final-norm features WITHOUT ever forming the
+    full [B, L, V] logits: lax.map over sequence chunks keeps one
+    [B, chunk, V] buffer live, and jax.checkpoint recomputes it in
+    backward (the lm-head matmul is ~9% of the model's FLOPs; the 2 GiB
+    f32 logits it would otherwise pin are the largest single activation
+    at training shapes)."""
+    B, L, E = x.shape
+    chunk = config.ce_chunk
+    n = L // chunk
+    w = lm_head.astype(config.dtype)
+    xs = x.reshape(B, n, chunk, E).transpose(1, 0, 2, 3)
+    ts = targets.reshape(B, n, chunk).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def chunk_nll(xc, tc):
+        logits = jnp.einsum("bce,ev->bcv", xc, w,
+                            preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        picked = jnp.take_along_axis(logits, tc[..., None],
+                                     axis=-1)[..., 0]
+        return lse - picked
+
+    nll = lax.map(lambda args: chunk_nll(*args), (xs, ts))  # [n, B, c]
+    return nll.transpose(1, 0, 2).reshape(B, L)
 
 
 # ------------------------------------------------------- KV-cache inference

@@ -74,6 +74,9 @@ class AlgorithmConfig:
         # -1 = all local devices (GSPMD shards the batch; XLA inserts the
         # gradient all-reduce over ICI).
         self.num_devices_per_learner = 1
+        # offline_output()
+        self.output: str | None = None
+        self.output_format = "parquet"
         # rl_module()
         self.model_config: dict = {"hidden": (64, 64)}
         self.module_class: type | None = None
@@ -108,6 +111,17 @@ class AlgorithmConfig:
             if not hasattr(self, k):
                 raise ValueError(f"Unknown training option: {k}")
             setattr(self, k, v)
+        return self
+
+    def offline_output(self, output: str,
+                       output_format: str = "parquet",
+                       ) -> "AlgorithmConfig":
+        """Log every sampled fragment to experience shard files while
+        training (reference: AlgorithmConfig.offline_data(output=...)
+        feeding JsonWriter/DatasetWriter). Read back with
+        rllib.offline.read_offline_dataset."""
+        self.output = output
+        self.output_format = output_format
         return self
 
     def learners(self, *, num_learners: int | None = None,
@@ -202,6 +216,7 @@ class Algorithm(Trainable):
     def setup(self, config: dict) -> None:
         cfg = self.algo_config
         self.module_spec = cfg.module_spec()
+        self._offline_writer = None  # created on first logged fragment
         self.learner_group = LearnerGroup(
             learner_class=cfg.learner_class(),
             module_spec=self.module_spec, config=cfg)
@@ -278,13 +293,27 @@ class Algorithm(Trainable):
     def _sample_fragments(self) -> list[SampleBatch]:
         """One synchronous sampling round across all env runners."""
         if self.env_runner_group is None:
-            batches = [self.local_env_runner.sample()]
+            sourced = [(0, self.local_env_runner.sample())]
         else:
-            batches = self.env_runner_group.foreach_actor("sample")
-        for b in batches:
+            # Stable actor ids, NOT positional indexes: a failed runner
+            # drops out of the results, and a shifted index would stitch
+            # one runner's steps onto another's open episodes in the
+            # offline log.
+            sourced = self.env_runner_group.foreach_actor_with_ids(
+                "sample")
+        for _, b in sourced:
             T, B = np.shape(b["obs"])[:2]
             self._timesteps_total += T * B
-        return batches
+        if getattr(self.algo_config, "output", None):
+            if self._offline_writer is None:
+                from ray_tpu.rllib.offline import OfflineWriter
+
+                self._offline_writer = OfflineWriter(
+                    self.algo_config.output,
+                    self.algo_config.output_format)
+            for i, b in sourced:
+                self._offline_writer.write_fragment(b, source=i)
+        return [b for _, b in sourced]
 
     def _runner_metrics(self) -> dict:
         if self.env_runner_group is None:
@@ -331,6 +360,8 @@ class Algorithm(Trainable):
         self.cleanup()
 
     def cleanup(self) -> None:
+        if getattr(self, "_offline_writer", None) is not None:
+            self._offline_writer.close()
         if self.env_runner_group is not None:
             for i in self.env_runner_group.healthy_actor_ids():
                 try:

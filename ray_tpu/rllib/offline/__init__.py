@@ -1,0 +1,208 @@
+"""Offline IO: log rollouts to files, read them back as datasets.
+
+Reference: rllib/offline/ — JsonWriter/DatasetWriter log each sampled
+batch as experience rows (dataset_writer.py, json_writer.py), and
+DatasetReader/JsonReader feed them to the offline algorithms
+(dataset_reader.py). Here both halves ride ray_tpu.data: the writer
+emits parquet/json shard files any engine can read, and the reader
+returns a ray_tpu.data Dataset that plugs straight into
+``config.offline_data(input_=...)`` for BC/MARWIL/CQL/CRR/DT.
+
+Row schema (one row per environment transition, episode-ordered within
+each env lane):
+  obs: list[float]        action-selection observation
+  next_obs: list[float]   successor observation
+  actions: int | list     the logged action
+  rewards: float
+  terminateds: bool       true terminal (resets the return accumulator)
+  truncateds: bool        time-limit cut (resets WITHOUT a terminal)
+  action_logp: float      behavior-policy log-prob (when sampled)
+  eps_id: int             unique per (worker, lane, episode)
+
+Usage::
+
+    config = (PPOConfig().environment("CartPole-v1")
+              .offline_output("/tmp/cartpole-out"))   # log while training
+    ...
+    ds = read_offline_dataset("/tmp/cartpole-out")
+    bc = (BCConfig().offline_data(input_=ds) ...).build()
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any
+
+import numpy as np
+
+from ray_tpu.rllib.utils.sample_batch import Columns, SampleBatch
+
+__all__ = ["OfflineWriter", "read_offline_dataset"]
+
+
+class OfflineWriter:
+    """Shard-file experience writer (reference: json_writer.py's
+    rotating output-*.json shards; parquet via pyarrow here because the
+    data stack is arrow-native)."""
+
+    def __init__(self, path: str, output_format: str = "parquet",
+                 worker_index: int = 0, rows_per_file: int = 100_000):
+        if output_format not in ("parquet", "json"):
+            raise ValueError(
+                f"output_format must be parquet|json, got "
+                f"{output_format!r}")
+        self.path = path
+        self.format = output_format
+        self.worker_index = worker_index
+        self.rows_per_file = rows_per_file
+        os.makedirs(path, exist_ok=True)
+        self._lock = threading.Lock()
+        self._rows: list[dict] = []
+        self._file_index = 0
+        self._eps_counter = 0
+        # lane key (source, b) -> live episode id; episodes span
+        # fragment boundaries.
+        self._lane_eps: dict[tuple, int] = {}
+        # lane key -> the lane's LAST step of the previous fragment,
+        # awaiting its successor obs (the next fragment's obs[0]):
+        # without this carry, every fragment boundary would either drop
+        # a step or break the obs -> next_obs chain inside an episode.
+        self._lane_carry: dict[tuple, dict] = {}
+
+    # ------------------------------------------------------------ ingest
+
+    def write_fragment(self, frag: SampleBatch, source: int = 0) -> int:
+        """Append one [T, B] rollout fragment as transition rows.
+
+        ``source`` distinguishes env runners: lane b of runner 0 and
+        lane b of runner 1 are different environments, and stitching
+        them together would chain unrelated episodes.
+
+        Rows are emitted lane-contiguous (all of lane b's steps, in
+        time order) so the offline readers' episode-segmented return
+        computation sees episodes as contiguous runs ended by a
+        terminated/truncated flag. Each lane's final (non-done) step is
+        CARRIED until the next fragment supplies its successor obs, so
+        episodes chain obs -> next_obs across fragment boundaries with
+        no dropped steps."""
+        obs = np.asarray(frag[Columns.OBS])
+        actions = np.asarray(frag[Columns.ACTIONS])
+        rewards = np.asarray(frag[Columns.REWARDS])
+        terms = np.asarray(frag[Columns.TERMINATEDS])
+        truncs = np.asarray(frag[Columns.TRUNCATEDS])
+        logp = np.asarray(frag[Columns.ACTION_LOGP]) \
+            if Columns.ACTION_LOGP in frag else None
+        T, B = rewards.shape[:2]
+        written = 0
+        with self._lock:
+            for b in range(B):
+                lane = (source, b)
+                eps = self._lane_eps.get(lane)
+                if eps is None:
+                    eps = self._next_eps()
+                    self._lane_eps[lane] = eps
+                carry = self._lane_carry.pop(lane, None)
+                if carry is not None:
+                    carry["next_obs"] = obs[0, b].tolist()
+                    self._rows.append(carry)
+                    written += 1
+                for t in range(T):
+                    done = bool(terms[t, b]) or bool(truncs[t, b])
+                    row: dict[str, Any] = {
+                        "obs": obs[t, b].tolist(),
+                        "next_obs": (obs[t, b] if done
+                                     else obs[t + 1, b]).tolist()
+                        if (done or t + 1 < T) else None,
+                        "actions": np.asarray(actions[t, b]).tolist(),
+                        "rewards": float(rewards[t, b]),
+                        "terminateds": bool(terms[t, b]),
+                        "truncateds": bool(truncs[t, b]),
+                        "eps_id": eps,
+                    }
+                    if logp is not None:
+                        row["action_logp"] = float(logp[t, b])
+                    if done:
+                        eps = self._next_eps()
+                        self._lane_eps[lane] = eps
+                    if row["next_obs"] is None:
+                        # Lane's last step, episode still live: hold it
+                        # for the next fragment's obs[0].
+                        self._lane_carry[lane] = row
+                    else:
+                        self._rows.append(row)
+                        written += 1
+            if len(self._rows) >= self.rows_per_file:
+                self._flush_locked()
+        return written
+
+    def _next_eps(self) -> int:
+        self._eps_counter += 1
+        return self.worker_index * 1_000_000_000 + self._eps_counter
+
+    # ------------------------------------------------------------- output
+
+    def _shard_path(self, ext: str) -> str:
+        path = os.path.join(
+            self.path,
+            f"output-{self.worker_index:03d}-{self._file_index:05d}.{ext}")
+        self._file_index += 1
+        return path
+
+    def _flush_locked(self) -> None:
+        if not self._rows:
+            return
+        rows, self._rows = self._rows, []
+        if self.format == "json":
+            with open(self._shard_path("json"), "w") as f:
+                for row in rows:
+                    f.write(json.dumps(row) + "\n")
+            return
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        table = pa.Table.from_pylist(rows)
+        pq.write_table(table, self._shard_path("parquet"))
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def close(self) -> None:
+        with self._lock:
+            # Carried lane tails have no successor anymore: emit them
+            # as truncated (the log ends mid-episode — same semantics
+            # as a time-limit cut).
+            for row in self._lane_carry.values():
+                row["next_obs"] = row["obs"]
+                row["truncateds"] = True
+                self._rows.append(row)
+            self._lane_carry.clear()
+            self._flush_locked()
+
+
+def read_offline_dataset(path: str):
+    """Logged experience dir/file -> ray_tpu.data Dataset (reference:
+    dataset_reader.py's input_=<path> resolution: format from the file
+    extensions)."""
+    import glob
+
+    import ray_tpu.data as rd
+
+    if os.path.isdir(path):
+        parquet = sorted(glob.glob(os.path.join(path, "*.parquet")))
+        jsons = sorted(glob.glob(os.path.join(path, "*.json")))
+        if parquet and jsons:
+            raise ValueError(
+                f"{path} mixes parquet and json shards; pass one format")
+        if parquet:
+            return rd.read_parquet(parquet)
+        if jsons:
+            return rd.read_json(jsons)
+        raise FileNotFoundError(f"no offline shards under {path}")
+    if path.endswith(".parquet"):
+        return rd.read_parquet([path])
+    if path.endswith(".json"):
+        return rd.read_json([path])
+    raise ValueError(f"unsupported offline input: {path}")

@@ -43,6 +43,16 @@ class FaultTolerantActorManager:
                       **kwargs) -> list:
         """Call ``fn_name(*args)`` on every healthy actor; returns results
         in actor-id order, skipping (and marking) failed actors."""
+        return [result for _, result in self.foreach_actor_with_ids(
+            fn_name, *args, timeout=timeout, **kwargs)]
+
+    def foreach_actor_with_ids(self, fn_name: str, *args,
+                               timeout: float | None = 60.0,
+                               **kwargs) -> list:
+        """Like foreach_actor but yields ``(actor_id, result)`` pairs —
+        for consumers that key per-actor state (e.g. the offline
+        writer's episode lanes), where a positional index would SHIFT
+        when an actor fails and silently mix actors' streams."""
         refs = {}
         for i in self.healthy_actor_ids():
             method = getattr(self._actors[i], fn_name)
@@ -50,7 +60,7 @@ class FaultTolerantActorManager:
         results = []
         for i, ref in refs.items():
             try:
-                results.append(ray_tpu.get(ref, timeout=timeout))
+                results.append((i, ray_tpu.get(ref, timeout=timeout)))
             except (ActorError, ActorDiedError, TaskError, TimeoutError):
                 self._healthy[i] = False
         return results

@@ -235,6 +235,38 @@ def cmd_down(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """`serve deploy/status/shutdown`: the declarative config path
+    (reference: `serve deploy` against ServeDeploySchema,
+    serve/schema.py:701). Runs against the cluster at --address (the
+    controller and replicas live in the connected cluster, so the CLI
+    process can exit after deploying)."""
+    import json as _json
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    if args.address:
+        ray_tpu.init(address=args.address, num_cpus=0,
+                     ignore_reinit_error=True)
+    else:
+        ray_tpu.init(ignore_reinit_error=True)
+    if args.serve_cmd == "deploy":
+        from ray_tpu.serve.schema import ServeDeployConfig, deploy_config
+
+        names = deploy_config(ServeDeployConfig.from_yaml(args.config))
+        print(f"deployed application(s): {', '.join(names)}")
+        return 0
+    if args.serve_cmd == "status":
+        print(_json.dumps(serve.status(), indent=2, default=str))
+        return 0
+    if args.serve_cmd == "shutdown":
+        serve.shutdown()
+        print("serve shut down")
+        return 0
+    return 2
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     # `list ...` routes to the state CLI (ray_tpu/util/state).
@@ -269,6 +301,17 @@ def main(argv: list[str] | None = None) -> int:
         "down", help="tear down a YAML-launched cluster")
     p_down.add_argument("config")
     p_down.set_defaults(fn=cmd_down)
+
+    p_serve = sub.add_parser(
+        "serve", help="declarative Serve deploy/status/shutdown")
+    ssub = p_serve.add_subparsers(dest="serve_cmd", required=True)
+    p_sdeploy = ssub.add_parser("deploy")
+    p_sdeploy.add_argument("config", help="YAML app config")
+    p_sdeploy.add_argument("--address", default=None)
+    for sname in ("status", "shutdown"):
+        p = ssub.add_parser(sname)
+        p.add_argument("--address", default=None)
+    p_serve.set_defaults(fn=cmd_serve)
 
     p_job = sub.add_parser("job", help="job submission API")
     jsub = p_job.add_subparsers(dest="job_cmd", required=True)

@@ -48,7 +48,16 @@ def test_external_provider_loading():
     with pytest.raises(ValueError, match="external"):
         make_provider({"provider": {"type": "external"}}, "addr")
     with pytest.raises(ValueError, match="unknown provider"):
-        make_provider({"provider": {"type": "gcp"}}, "addr")
+        make_provider({"provider": {"type": "aws"}}, "addr")
+    # gcp is a builtin now: constructs without touching the cloud API
+    # (the REST client authenticates lazily, on first call).
+    from ray_tpu.autoscaler.gcp import GcpTpuNodeProvider
+
+    prov = make_provider(
+        {"provider": {"type": "gcp", "project_id": "p",
+                      "availability_zone": "us-central1-a"},
+         "cluster_name": "t"}, "addr")
+    assert isinstance(prov, GcpTpuNodeProvider)
     # A real external module path loads and receives options.
     prov = make_provider(
         {"provider": {"type": "external",

@@ -1,0 +1,231 @@
+"""GCE/GKE TPU slice provider: gang acquisition against a mocked cloud.
+
+The mock implements the TpuCloudClient surface (create/delete/get/list,
+CREATING->READY states) and "boots" each slice host as a REAL local
+worker-node daemon labeled with the slice name — so everything above
+the cloud API (naming, readiness polling, the all-hosts-registered gang
+wait, all-or-nothing teardown, autoscaler integration) runs the same
+code it would against tpu.googleapis.com.
+
+Reference behavior being reproduced: the GCP provider's TPU resource
+(python/ray/autoscaler/_private/gcp/node_provider.py:63) plus the
+slice-gang semantics of accelerators.py's TPU-{type}-head resource.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.rpc import RpcClient
+from ray_tpu.autoscaler.gcp import (
+    GcpTpuNodeProvider,
+    TpuCloudClient,
+    slice_num_hosts,
+)
+
+
+class FakeTpuCloud(TpuCloudClient):
+    """In-memory TPU API; READY slices boot real daemon processes."""
+
+    def __init__(self, head_address: str, boot_delay_s: float = 0.2,
+                 hosts_that_boot: int | None = None):
+        self.head_address = head_address
+        self.boot_delay_s = boot_delay_s
+        # Fault injection: boot only this many hosts (None = all).
+        self.hosts_that_boot = hosts_that_boot
+        self.deleted: list[str] = []
+        self._lock = threading.Lock()
+        self._nodes: dict[str, dict] = {}
+        self._procs: dict[str, list] = {}
+
+    def create_node(self, name, accelerator_type, runtime_version,
+                    labels):
+        with self._lock:
+            self._nodes[name] = {
+                "name": name, "state": "CREATING",
+                "labels": dict(labels),
+                "accelerator": accelerator_type,
+                "created": time.monotonic(),
+            }
+
+    def _boot_hosts(self, name: str) -> None:
+        node = self._nodes[name]
+        hosts = slice_num_hosts(node["accelerator"])
+        boot = hosts if self.hosts_that_boot is None \
+            else min(hosts, self.hosts_that_boot)
+        from ray_tpu._private.node import daemon_child_env
+
+        procs = []
+        for worker_id in range(boot):
+            resources = {"CPU": 1.0, "TPU": 4.0}
+            if worker_id == 0:
+                resources[f"TPU-{node['accelerator']}-head"] = 1.0
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "ray_tpu._private.node", "worker",
+                 json.dumps({"gcs_address": self.head_address,
+                             "resources": resources,
+                             "pool_size": 0,
+                             "labels": {"tpu_slice": name,
+                                        "tpu_worker_id": str(worker_id)}})],
+                env=daemon_child_env(),
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL))
+        self._procs[name] = procs
+
+    def get_node(self, name):
+        with self._lock:
+            node = self._nodes.get(name)
+            if node is None:
+                return None
+            if node["state"] == "CREATING" and \
+                    time.monotonic() - node["created"] >= self.boot_delay_s:
+                node["state"] = "READY"
+                self._boot_hosts(name)
+            return {"name": name, "state": node["state"],
+                    "labels": node["labels"]}
+
+    def delete_node(self, name):
+        with self._lock:
+            self.deleted.append(name)
+            self._nodes.pop(name, None)
+            procs = self._procs.pop(name, [])
+        for p in procs:
+            p.kill()
+            p.wait(timeout=10)
+
+    def list_nodes(self, label_filter=None):
+        with self._lock:
+            out = []
+            for node in self._nodes.values():
+                if label_filter and any(
+                        node["labels"].get(k) != v
+                        for k, v in (label_filter or {}).items()):
+                    continue
+                out.append({"name": node["name"], "state": node["state"],
+                            "labels": node["labels"]})
+            return out
+
+    def shutdown(self):
+        for name in list(self._nodes):
+            self.delete_node(name)
+
+
+NODE_CONFIGS = {
+    "tpu_v5e_8": {"tpu_accelerator": "v5litepod-8",
+                  "runtime_version": "tpu-ubuntu2204-base"}}
+
+
+@pytest.fixture
+def head():
+    from ray_tpu.cluster_utils import Cluster
+
+    # Short failure-detection window: the teardown assertions wait for
+    # the head to notice killed slice hosts via heartbeat staleness.
+    cluster = Cluster(heartbeat_timeout_s=5.0)
+    yield cluster
+    try:
+        ray_tpu.shutdown()
+    finally:
+        cluster.shutdown()
+
+
+def _alive_slice_members(address: str, slice_name: str) -> list[dict]:
+    client = RpcClient(address, timeout_s=5.0)
+    try:
+        return [n for n in client.call("list_nodes")
+                if n.get("alive")
+                and n.get("labels", {}).get("tpu_slice") == slice_name]
+    finally:
+        client.close()
+
+
+def test_slice_gang_up_and_down(head):
+    cloud = FakeTpuCloud(head.address)
+    provider = GcpTpuNodeProvider(
+        head.address, "testclus", NODE_CONFIGS, client=cloud,
+        provision_timeout_s=30.0, register_timeout_s=120.0)
+    node_id = provider.create_node("tpu_v5e_8", {})
+    assert node_id is not None
+    meta = provider.node_metadata(node_id)
+    slice_name = meta["tpu_slice"]
+    assert meta["accelerator"] == "v5litepod-8"
+
+    # The WHOLE gang registered: 2 hosts for v5litepod-8, exactly one
+    # carrying the pod-slice head resource the scheduler gangs on.
+    members = _alive_slice_members(head.address, slice_name)
+    assert len(members) == 2
+    heads = [m for m in members
+             if "TPU-v5litepod-8-head" in (m.get("resources") or {})]
+    assert len(heads) == 1
+    assert provider.non_terminated_nodes() == [node_id]
+
+    provider.terminate_node(node_id)
+    assert slice_name in cloud.deleted
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if not _alive_slice_members(head.address, slice_name):
+            break
+        time.sleep(0.5)
+    else:
+        raise AssertionError("slice daemons survived terminate_node")
+    assert provider.non_terminated_nodes() == []
+
+
+def test_partial_slice_torn_down_whole(head):
+    # Cloud boots only 1 of the 2 hosts: a partial slice cannot run an
+    # SPMD program, so the provider must fail the launch AND delete the
+    # slice rather than keep a half gang.
+    cloud = FakeTpuCloud(head.address, hosts_that_boot=1)
+    provider = GcpTpuNodeProvider(
+        head.address, "testclus", NODE_CONFIGS, client=cloud,
+        provision_timeout_s=30.0, register_timeout_s=8.0)
+    assert provider.create_node("tpu_v5e_8", {}) is None
+    assert cloud.deleted, "partial slice was not deleted"
+    assert provider.non_terminated_nodes() == []
+
+
+def test_autoscaler_launches_slice_as_gang(head):
+    """Demand for the pod-slice head resource makes the autoscaler
+    acquire one SLICE (2 cluster nodes) through the cloud provider."""
+    from ray_tpu.autoscaler.autoscaler import (
+        NodeTypeConfig,
+        StandardAutoscaler,
+    )
+
+    runtime = ray_tpu.init(address=head.address, num_cpus=0)
+    cloud = FakeTpuCloud(head.address)
+    provider = GcpTpuNodeProvider(
+        head.address, "testclus", NODE_CONFIGS, client=cloud,
+        provision_timeout_s=30.0, register_timeout_s=120.0)
+    autoscaler = StandardAutoscaler(
+        runtime,
+        [NodeTypeConfig(
+            name="tpu_v5e_8",
+            resources={"CPU": 1.0, "TPU": 4.0,
+                       "TPU-v5litepod-8-head": 1.0},
+            min_workers=0, max_workers=2)],
+        provider=provider)
+
+    @ray_tpu.remote(resources={"TPU-v5litepod-8-head": 1})
+    def on_slice_head():
+        return "scheduled"
+
+    ref = on_slice_head.remote()
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        autoscaler.update()
+        if cloud.list_nodes():
+            break
+        time.sleep(0.5)
+    assert cloud.list_nodes(), "autoscaler never launched a slice"
+    assert ray_tpu.get(ref, timeout=120.0) == "scheduled"
+    slice_name = cloud.list_nodes()[0]["name"]
+    assert len(_alive_slice_members(head.address, slice_name)) == 2
+    cloud.shutdown()

@@ -1,0 +1,151 @@
+"""Offline IO round trip + DreamerV3 learning on a toy env.
+
+Reference: rllib/offline/dataset_reader.py / json_writer.py (logged
+experience feeding BC/CQL), and rllib/algorithms/dreamerv3 (model-based
+representative).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _cpu_jax(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_SKIP_TPU_DETECTION", "1")
+
+
+def test_offline_writer_reader_roundtrip(tmp_path):
+    """PPO logs experience while training; the files read back as a
+    Dataset whose rows feed BC and CQL."""
+    from ray_tpu.rllib import PPOConfig
+    from ray_tpu.rllib.offline import read_offline_dataset
+
+    out = str(tmp_path / "exp")
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=0,
+                           num_envs_per_env_runner=8,
+                           rollout_fragment_length=32)
+              .offline_output(out))
+    algo = config.build()
+    algo.train()
+    algo.train()
+    algo.cleanup()  # flushes the writer
+
+    shards = glob.glob(os.path.join(out, "*.parquet"))
+    assert shards, f"no parquet shards in {out}"
+
+    ds = read_offline_dataset(out)
+    rows = ds.take_all()
+    assert len(rows) > 200
+    row = rows[0]
+    assert set(row) >= {"obs", "next_obs", "actions", "rewards",
+                        "terminateds", "truncateds", "eps_id",
+                        "action_logp"}
+    assert len(row["obs"]) == 4 and len(row["next_obs"]) == 4
+
+    # Episode segmentation survives: within one eps_id the rows chain
+    # obs -> next_obs.
+    by_eps: dict = {}
+    for r in rows:
+        by_eps.setdefault(r["eps_id"], []).append(r)
+    chained = 0
+    for eps_rows in by_eps.values():
+        for a, b in zip(eps_rows, eps_rows[1:]):
+            if not (a["terminateds"] or a["truncateds"]):
+                assert np.allclose(a["next_obs"], b["obs"], atol=1e-5)
+                chained += 1
+    assert chained > 50
+
+    # BC trains from the logged dataset...
+    from ray_tpu.rllib import BCConfig
+
+    bc = (BCConfig()
+          .environment("CartPole-v1")
+          .offline_data(input_=ds))
+    bc.updates_per_iteration = 2
+    bc_algo = bc.build()
+    result = bc_algo.train()
+    assert np.isfinite(result.get("bc_loss", result.get("loss", 0.0)))
+    bc_algo.cleanup()
+    # CQL/CRR consume the identical row schema (obs/actions/rewards/
+    # next_obs/terminateds) — their offline ingestion is covered by
+    # test_rllib_families on schema-matched continuous-control rows.
+
+
+def test_offline_json_format(tmp_path):
+    from ray_tpu.rllib.offline import OfflineWriter, read_offline_dataset
+    from ray_tpu.rllib.utils.sample_batch import SampleBatch
+
+    out = str(tmp_path / "exp_json")
+    writer = OfflineWriter(out, output_format="json")
+    T, B = 6, 3
+    frag = SampleBatch({
+        "obs": np.random.rand(T, B, 4).astype(np.float32),
+        "actions": np.zeros((T, B), dtype=np.int64),
+        "rewards": np.ones((T, B), dtype=np.float32),
+        "terminateds": np.zeros((T, B), dtype=bool),
+        "truncateds": np.zeros((T, B), dtype=bool),
+    })
+    frag["terminateds"][2, 1] = True  # mid-fragment episode end
+    n = writer.write_fragment(frag)
+    # Every lane CARRIES its last (non-done) step until the next
+    # fragment arrives; the mid-fragment done keeps its own row.
+    assert n == B * (T - 1)
+    writer.close()  # carried tails flush as truncated rows
+    rows = read_offline_dataset(out).take_all()
+    assert len(rows) == n + B
+    assert sum(1 for r in rows if r["terminateds"]) == 1
+    assert sum(1 for r in rows if r["truncateds"]) == B
+
+
+def test_dreamerv3_smoke():
+    """Fast default-suite check: the full Dreamer step (world model
+    BPTT + imagination + actor/critic) runs, metrics are finite, and
+    the world model's loss falls. The REAL learning proof (CartPole
+    return 20 -> 90+ by ~40 iterations, ~8 min) runs under
+    RAY_TPU_LONG_TESTS=1 below."""
+    from ray_tpu.rllib import DreamerV3Config
+
+    cfg = DreamerV3Config().environment("CartPole-v1")
+    cfg.seed = 0
+    algo = cfg.build()
+    wm_first = wm_last = None
+    for _ in range(4):
+        r = algo.train()
+        if wm_first is None and "wm_loss" in r:
+            wm_first = r["wm_loss"]
+        wm_last = r.get("wm_loss", wm_last)
+        assert all(np.isfinite(v) for v in r.values()
+                   if isinstance(v, float)), r
+    assert wm_first is not None and wm_last < wm_first, (
+        f"world model did not learn: {wm_first} -> {wm_last}")
+
+
+@pytest.mark.skipif(not os.environ.get("RAY_TPU_LONG_TESTS"),
+                    reason="~8 min of training; set RAY_TPU_LONG_TESTS=1")
+def test_dreamerv3_improves_on_cartpole():
+    """The imagined-rollout policy must clearly beat acting at random
+    (reference target behavior: dreamerv3.py:469's
+    sample->model->imagine->AC loop). Last verified trajectory (seed 0,
+    defaults): return 22 -> 96 over 40 iterations."""
+    from ray_tpu.rllib import DreamerV3Config
+
+    cfg = DreamerV3Config().environment("CartPole-v1")
+    cfg.seed = 0
+    algo = cfg.build()
+    first = None
+    best = 0.0
+    for _ in range(40):
+        r = algo.train()
+        ret = r.get("episode_return_mean")
+        if ret is not None:
+            first = ret if first is None else first
+            best = max(best, ret)
+    assert best > max(60.0, (first or 0) + 30), (
+        f"policy did not improve: first={first}, best={best}")

@@ -462,8 +462,8 @@ def test_streaming_early_abandon_stops_production(serve_instance):
     @serve.deployment
     class Endless:
         def generate(self):
-            for i in range(1000):
-                time.sleep(0.05)
+            for i in range(200):
+                time.sleep(0.02)
                 yield i
 
     handle = serve.run(Endless.bind(), name="abandon_app")
@@ -478,7 +478,11 @@ def test_streaming_early_abandon_stops_production(serve_instance):
     assert stream._replica_idx is None, "replica slot must be released"
     # The replica stops producing shortly after the queue dies; a new
     # request on the same replica still serves (slot not leaked).
-    out = list(handle.options(method_name="generate",
-                              stream=True).remote())[:2]
+    # islice, not list(): draining all 200 chunks would serialize this
+    # test on the generator's sleeps.
+    import itertools
+
+    out = list(itertools.islice(
+        handle.options(method_name="generate", stream=True).remote(), 2))
     assert out == [0, 1]
     serve.delete("abandon_app")
