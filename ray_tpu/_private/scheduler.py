@@ -410,6 +410,15 @@ class Dispatcher:
                     continue
                 self._launch(task, node)
                 launched_any = True
+            with self._lock:
+                # Purge this pass's claimed/cancelled entries NOW, not
+                # at the next pass: leftovers make the loop-top
+                # "_ready non-empty" check skip its submit()-notified
+                # wait and fall into wait_for_change below, which
+                # submissions do NOT wake — a fresh task would then sit
+                # 50ms instead of launching immediately.
+                self._ready = [t for t in self._ready
+                               if not (t.claimed or t.cancelled)]
             if not launched_any:
                 # Nothing admitted: wait for resources to free up.
                 self._cluster.wait_for_change(0.05)

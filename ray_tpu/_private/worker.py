@@ -454,6 +454,7 @@ class Runtime:
             known = dict(self._remote_nodes)
         alive_addrs = {info["executor_address"] for nid, info
                        in listed.items() if info["alive"]}
+        amnesia_candidates = []
         for node_id, handle in known.items():
             info = listed.get(node_id)
             superseded = (info is None
@@ -461,10 +462,25 @@ class Runtime:
             declared_dead = info is not None and (
                 not info["alive"]
                 or info["executor_address"] != handle.address)
-            amnesia = info is None and not superseded
-            if superseded or declared_dead or (
-                    amnesia and not handle.ping()):
+            if superseded or declared_dead:
                 self._drop_remote_node(node_id)
+            elif info is None:
+                amnesia_candidates.append((node_id, handle))
+        if amnesia_candidates:
+            # Direct-ping grace pings run CONCURRENTLY: after a head
+            # restart with many genuinely dead daemons, serial 5s ping
+            # timeouts would stall this watcher for minutes while dead
+            # handles keep receiving (and failing) dispatches.
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(amnesia_candidates))) as tpe:
+                alive_flags = list(tpe.map(
+                    lambda nh: nh[1].ping(), amnesia_candidates))
+            for (node_id, _), is_alive in zip(amnesia_candidates,
+                                              alive_flags):
+                if not is_alive:
+                    self._drop_remote_node(node_id)
 
         for node_id, info in listed.items():
             if not info["alive"]:

@@ -494,8 +494,55 @@ def _get_factory():
         return _factory
 
 
+def _validate_container(container: dict) -> str:
+    """-> the container runtime binary; raises on a bad spec. Called
+    BEFORE any listener/log-file resources exist so config errors
+    (no podman on PATH, missing image) can't leak them."""
+    import shutil
+
+    runtime = container.get("runtime") or next(
+        (r for r in ("podman", "docker") if shutil.which(r)), None)
+    if runtime is None:
+        raise RuntimeError(
+            "runtime_env 'container' needs podman or docker on PATH")
+    if not container.get("image"):
+        raise ValueError("runtime_env 'container' needs an 'image'")
+    return runtime
+
+
+def _container_argv(container: dict, addr: str, env: dict,
+                    extra_env: dict | None = None) -> list[str]:
+    """podman/docker argv for a containerized worker (reference:
+    runtime_env/container.py builds `podman run` with the session dir
+    and plasma socket mounted; here the connect-back socket dir and the
+    framework checkout mount instead). Forwards the framework's own
+    env keys PLUS every caller-supplied extra_env var (a container
+    task's env_vars must be in the IN-IMAGE interpreter's env, not just
+    the host-side Popen env)."""
+    runtime = _validate_container(container)
+    image = container["image"]
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    sock_dir = os.path.dirname(addr)
+    argv = [runtime, "run", "--rm", "--network=host",
+            "-v", f"{sock_dir}:{sock_dir}",
+            "-v", f"{pkg_root}:{pkg_root}:ro"]
+    keys = ["RAY_TPU_WORKER_AUTHKEY", "PYTHONPATH",
+            "RAY_TPU_DRIVER_CLIENT_ADDR", "RAY_TPU_NODE_TAG",
+            "JAX_PLATFORMS", "RAY_TPU_SKIP_TPU_DETECTION"]
+    keys += [k for k in (extra_env or {}) if k not in keys]
+    for key in keys:
+        if env.get(key):
+            argv += ["-e", f"{key}={env[key]}"]
+    argv += list(container.get("run_options") or [])
+    argv += [image, container.get("python", "python3"), "-m",
+             "ray_tpu._private.worker_pool", addr]
+    return argv
+
+
 def _spawn_worker(name: str, extra_env: dict | None = None,
-                  allow_tpu: bool = False):
+                  allow_tpu: bool = False,
+                  container: dict | None = None):
     """Start a worker as a fresh interpreter that connects back over a
     Unix socket (reference: worker_pool.h spawns language workers that
     connect to the raylet socket).
@@ -508,6 +555,12 @@ def _spawn_worker(name: str, extra_env: dict | None = None,
     the child never re-imports the user's ``__main__`` — unguarded user
     scripts must keep working. The child env drops accelerator plugin
     registration and pins JAX to CPU: pool workers are CPU processes.
+
+    ``container``: a runtime_env container spec ({"image": ...,
+    "run_options": [...]}) — the worker runs inside podman/docker with
+    the connect-back socket dir and this checkout volume-mounted
+    (reference: _private/runtime_env/container.py:26 wraps worker
+    commands in `podman run`).
     """
     import secrets
     import subprocess
@@ -516,6 +569,8 @@ def _spawn_worker(name: str, extra_env: dict | None = None,
 
     from ray_tpu._private.config import GLOBAL_CONFIG
 
+    if container:
+        _validate_container(container)  # raise before creating resources
     # Random suffix: concurrent spawns (e.g. several process actors
     # created back-to-back) must never race on one socket path.
     addr = os.path.join(
@@ -550,7 +605,16 @@ def _spawn_worker(name: str, extra_env: dict | None = None,
         os.makedirs(log_dir, exist_ok=True)
         log_path = os.path.join(log_dir, f"worker-{name}.log")
     proc = None
-    if not allow_tpu and not env.get("RAY_TPU_WORKER_FACTORY_DISABLE"):
+    if container:
+        argv = _container_argv(container, addr, env,
+                               extra_env=extra_env)
+        log_file = open(log_path, "ab") if log_path else None
+        proc = subprocess.Popen(argv, env=env,
+                                stdout=log_file, stderr=log_file)
+        if log_file is not None:
+            log_file.close()
+    if proc is None and not allow_tpu \
+            and not env.get("RAY_TPU_WORKER_FACTORY_DISABLE"):
         try:
             factory = _get_factory()
             # Workers whose env demands different jax/XLA import-time
@@ -608,14 +672,15 @@ class PoolWorker:
     """One worker process + its pipe. One in-flight request at a time."""
 
     def __init__(self, index: int, extra_env: dict | None = None,
-                 allow_tpu: bool = False):
+                 allow_tpu: bool = False, container: dict | None = None):
         self.index = index
         self._lock = threading.Lock()
         # Function-blob digests this worker has already received (the
         # function-manager pattern: ship each function once per worker).
         self.known_digests: set[str] = set()
         self.proc, self.conn = _spawn_worker(
-            f"w{index}", extra_env=extra_env, allow_tpu=allow_tpu)
+            f"w{index}", extra_env=extra_env, allow_tpu=allow_tpu,
+            container=container)
 
     def request(self, msg: tuple) -> tuple:
         """Send one request and wait for its reply.
@@ -714,11 +779,13 @@ class WorkerPool:
             {str(k): str(v)
              for k, v in (runtime_env.get("env_vars") or {}).items()})
 
-    def _new_worker(self, extra_env: dict | None = None) -> PoolWorker:
+    def _new_worker(self, extra_env: dict | None = None,
+                    container: dict | None = None) -> PoolWorker:
         with self._index_lock:
             index = self._next_index
             self._next_index += 1
-        worker = PoolWorker(index, extra_env=extra_env)
+        worker = PoolWorker(index, extra_env=extra_env,
+                            container=container)
         with self._index_lock:
             self._all_workers.add(worker)
             self._all_workers = {w for w in self._all_workers
@@ -835,15 +902,17 @@ class WorkerPool:
         this is invisible to the caller.
         """
         sensitive = self._import_sensitive_env_vars(runtime_env)
-        if sensitive:
+        container = (runtime_env or {}).get("container")
+        if sensitive or container:
             # jax/XLA read these at IMPORT time; a shared worker (and
             # any fork of the pre-imported factory template) has jax
             # frozen already, so per-task os.environ application would
-            # be silently ignored. Such tasks get a dedicated fresh
-            # interpreter whose spawn env carries the vars — under the
-            # SAME lease accounting as the shared pool, so N in-flight
-            # env-sensitive tasks still respect max_size (and a
-            # shut-down pool refuses them).
+            # be silently ignored. Such tasks — and container tasks,
+            # whose interpreter must boot INSIDE the image — get a
+            # dedicated fresh worker whose spawn env carries the vars,
+            # under the SAME lease accounting as the shared pool, so N
+            # in-flight env-sensitive tasks still respect max_size (and
+            # a shut-down pool refuses them).
             with self._lock:
                 while self._num_leased >= self.max_size \
                         and not self._shutdown:
@@ -854,7 +923,8 @@ class WorkerPool:
             worker = None
             try:
                 worker = self._new_worker(
-                    extra_env=dict(runtime_env.get("env_vars") or {}))
+                    extra_env=dict(runtime_env.get("env_vars") or {}),
+                    container=container)
                 reply = worker.request(
                     ("task", digest, func_blob, args_blob, n_returns,
                      runtime_env, task_token, client_addr, sys_path))

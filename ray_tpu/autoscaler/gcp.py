@@ -48,9 +48,11 @@ def slice_num_hosts(accelerator_type: str) -> int:
     try:
         return _SLICE_HOSTS[accelerator_type]
     except KeyError:
-        # v5litepod-N / v4-N: N chips, 4 per host.
-        chips = int(accelerator_type.rsplit("-", 1)[1])
-        return max(1, chips // 4)
+        n = int(accelerator_type.rsplit("-", 1)[1])
+        # v4-N counts TensorCores (8 per host, matching the table's
+        # v4-8:1 / v4-16:2); v5litepod-N counts chips (4 per host).
+        per_host = 8 if accelerator_type.startswith("v4") else 4
+        return max(1, n // per_host)
 
 
 class TpuCloudClient:
@@ -217,13 +219,21 @@ class GcpTpuNodeProvider(NodeProvider):
             cfg.get("runtime_version", "tpu-ubuntu2204-base"),
             {"ray-cluster": self._cluster, "ray-node-type": node_type})
 
-        # Phase 1: the cloud brings the slice to READY.
+        # Phase 1: the cloud brings the slice to READY. A GET right
+        # after the create POST can 404 while the long-running create
+        # operation materializes the resource — absence is terminal
+        # only after a grace window, not on the first poll.
         deadline = time.monotonic() + self._provision_timeout
+        absent_grace = time.monotonic() + 60.0
         while True:
             node = self._client.get_node(slice_name)
             state = (node or {}).get("state")
             if state == "READY":
                 break
+            if state is None and time.monotonic() < absent_grace \
+                    and time.monotonic() < deadline:
+                time.sleep(1.0)
+                continue
             if state in (None, "FAILED", "TERMINATED") \
                     or time.monotonic() > deadline:
                 logger.warning("TPU slice %s never became READY (%s)",

@@ -29,6 +29,7 @@ from ray_tpu.rllib.algorithms.cql import CQL, CQLConfig
 from ray_tpu.rllib.algorithms.crr import CRR, CRRConfig
 from ray_tpu.rllib.algorithms.dqn import DQN, DQNConfig
 from ray_tpu.rllib.algorithms.dreamerv3 import DreamerV3, DreamerV3Config
+from ray_tpu.rllib.core.catalog import Catalog, ConvActorCriticModule
 from ray_tpu.rllib.algorithms.dt import DT, DTConfig, DTModule
 from ray_tpu.rllib.algorithms.es import ES, ESConfig
 from ray_tpu.rllib.algorithms.impala import IMPALA, IMPALAConfig
@@ -98,6 +99,8 @@ __all__ = [
     "AlgorithmConfig",
     "CartPoleVectorEnv",
     "Columns",
+    "Catalog",
+    "ConvActorCriticModule",
     "DQN",
     "DQNConfig",
     "DreamerV3",

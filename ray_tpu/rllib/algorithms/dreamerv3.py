@@ -159,8 +159,9 @@ class DreamerV3(Algorithm):
         self._replay = _SequenceReplay(
             cfg.replay_capacity, cfg.num_envs, self._obs_size)
         self._obs = self.env.reset(seed=cfg.seed)
-        # Per-lane live RSSM state for acting in the REAL env.
-        self._act_state = self._initial_state(cfg.num_envs)
+        # Per-lane live RSSM state (+ previous action) for acting in
+        # the REAL env.
+        self._act_state = self._initial_act_state(cfg.num_envs)
         self._update_fn = jax.jit(self._build_update())
         self._policy_fn = jax.jit(self._build_policy())
         self._episode_returns: list[float] = []
@@ -196,6 +197,10 @@ class DreamerV3(Algorithm):
                 jnp.zeros((batch,
                            cfg.stoch_groups * cfg.stoch_classes)))
 
+    def _initial_act_state(self, batch: int):
+        h, z = self._initial_state(batch)
+        return (h, z, jnp.zeros((batch, self._n_act)))
+
     # ------------------------------------------------ jitted programs
 
     def _obs_step(self, wm, h, z, action_onehot, embed, key):
@@ -222,22 +227,22 @@ class DreamerV3(Algorithm):
         return h, z
 
     def _build_policy(self):
-        cfg = self.algo_config
-
         def policy(params, state, obs, key):
+            """state = (h, z, a_prev): fold the CURRENT observation
+            into the posterior first, then act from it — training
+            feeds the actor feats whose z is the posterior of the
+            current step's observation, and acting must match (a
+            one-step-stale latent visibly degrades reactive envs)."""
             wm = params["wm"]
-            h, z = state
+            h, z, a_prev = state
             embed = _mlp(wm["encoder"], symlog(obs))
             k1, k2 = jax.random.split(key)
-            # The env transition consumed the PREVIOUS action; acting
-            # online we fold it in via the stored (h, z) directly: the
-            # last action is already inside h.
+            h, z, _ = self._obs_step(wm, h, z, a_prev, embed, k2)
             feat = jnp.concatenate([h, z], axis=-1)
             logits = _mlp(params["actor"], feat)
             action = jax.random.categorical(k1, logits)
             a_onehot = jax.nn.one_hot(action, self._n_act)
-            h, z, _ = self._obs_step(wm, h, z, a_onehot, embed, k2)
-            return action, (h, z)
+            return action, (h, z, a_onehot)
 
         return policy
 
@@ -428,10 +433,12 @@ class DreamerV3(Algorithm):
             dones = terms | truncs
             self._lane_return += rewards
             if dones.any():
-                # Reset the live RSSM state for finished lanes.
-                h, z = self._act_state
+                # Reset the live RSSM state (and a_prev) for finished
+                # lanes.
+                h, z, a_prev = self._act_state
                 mask = jnp.asarray(1.0 - dones.astype(np.float32))
-                self._act_state = (h * mask[:, None], z * mask[:, None])
+                self._act_state = (h * mask[:, None], z * mask[:, None],
+                                   a_prev * mask[:, None])
                 for i in np.where(dones)[0]:
                     self._episode_returns.append(
                         float(self._lane_return[i]))

@@ -1,0 +1,148 @@
+"""Model catalog: observation/action specs -> network architecture.
+
+Reference: rllib/core/models/catalog.py — the Catalog inspects the
+observation space and model_config and picks encoder + head components
+(MLP for vectors, CNN for images, the framework-specific builders).
+Here the same decision produces FUNCTIONAL jax modules: every component
+is an (init, apply) pair over explicit param pytrees, so whatever the
+catalog assembles is jittable and GSPMD-shardable unchanged.
+
+Selection rules (Catalog.resolve):
+- flat observations            -> DefaultActorCriticModule (MLP towers)
+- rank-3 observations [H,W,C]  -> ConvActorCriticModule (CNN encoder +
+  pi/vf heads); filters from model_config["conv_filters"] as a list of
+  (out_channels, kernel, stride), defaulting to an Atari-style stack
+- model_config["encoder"]      -> explicit override: "mlp" | "cnn"
+
+Recurrent policies are separate module families, not encoder options:
+R2D2's GRUQModule (rllib/algorithms/r2d2.py) and the Decision
+Transformer (rllib/algorithms/dt.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.core.rl_module import (
+    RLModule,
+    _mlp_apply,
+    _mlp_init,
+)
+
+DEFAULT_CONV_FILTERS = ((16, 4, 2), (32, 4, 2), (64, 3, 2))
+
+
+def build_cnn_encoder(obs_shape: tuple, conv_filters=None,
+                      hidden_out: int = 256):
+    """-> (init_fn(rng) -> params, apply_fn(params, x) -> [B, F], F).
+
+    x is [B, H, W, C] float. Conv stack + flatten + one dense layer;
+    NHWC layout with feature-last filters — the layout XLA prefers on
+    TPU (channels on the minor-most, 128-lane dimension).
+    """
+    filters = tuple(conv_filters or DEFAULT_CONV_FILTERS)
+    h, w, c = obs_shape
+
+    def init(rng):
+        params = {"conv": []}
+        in_c = c
+        hh, ww = h, w
+        for out_c, k, s in filters:
+            rng, key = jax.random.split(rng)
+            scale = jnp.sqrt(2.0 / (k * k * in_c))
+            params["conv"].append({
+                "w": jax.random.normal(key, (k, k, in_c, out_c)) * scale,
+                "b": jnp.zeros(out_c),
+            })
+            hh = max(1, -(-hh // s))
+            ww = max(1, -(-ww // s))
+            in_c = out_c
+        flat = hh * ww * in_c
+        rng, key = jax.random.split(rng)
+        params["dense"] = _mlp_init(key, (flat, hidden_out))
+        return params
+
+    strides = tuple(s for _, _, s in filters)
+
+    def apply(params, x):
+        for layer, s in zip(params["conv"], strides):
+            x = jax.lax.conv_general_dilated(
+                x, layer["w"], window_strides=(s, s), padding="SAME",
+                dimension_numbers=("NHWC", "HWIO", "NHWC"))
+            x = jax.nn.relu(x + layer["b"])
+        x = x.reshape(x.shape[:-3] + (-1,))
+        return jnp.tanh(_mlp_apply(params["dense"], x))
+
+    return init, apply, hidden_out
+
+
+class ConvActorCriticModule(RLModule):
+    """CNN encoder shared by pi/vf heads, for image observations
+    (reference: the catalog's CNN encoder + shared-encoder AC heads)."""
+
+    def __init__(self, observation_size: int, num_actions: int,
+                 obs_shape: tuple = (), conv_filters=None,
+                 hidden: tuple = (256,), **_):
+        if len(obs_shape) != 3:
+            raise ValueError(
+                f"ConvActorCriticModule needs [H, W, C] obs, got "
+                f"{obs_shape}")
+        self.obs_shape = tuple(obs_shape)
+        self.num_actions = num_actions
+        self._enc_init, self._enc_apply, enc_out = build_cnn_encoder(
+            self.obs_shape, conv_filters, hidden_out=int(hidden[0]))
+        self._enc_out = enc_out
+
+    def init(self, rng):
+        enc_rng, pi_rng, vf_rng = jax.random.split(rng, 3)
+        return {
+            "encoder": self._enc_init(enc_rng),
+            "pi": _mlp_init(pi_rng, (self._enc_out, self.num_actions)),
+            "vf": _mlp_init(vf_rng, (self._enc_out, 1)),
+        }
+
+    def _logits_and_value(self, params, obs):
+        obs = jnp.asarray(obs, dtype=jnp.float32)
+        if obs.ndim == len(self.obs_shape):  # unbatched guard
+            obs = obs[None]
+        feat = self._enc_apply(params["encoder"], obs)
+        return (_mlp_apply(params["pi"], feat),
+                _mlp_apply(params["vf"], feat)[..., 0])
+
+    def forward_inference(self, params, batch, rng=None):
+        logits, value = self._logits_and_value(params, batch["obs"])
+        return {"action_logits": logits, "vf_preds": value,
+                "actions": jnp.argmax(logits, axis=-1)}
+
+    def forward_exploration(self, params, batch, rng=None):
+        logits, value = self._logits_and_value(params, batch["obs"])
+        actions = jax.random.categorical(rng, logits)
+        logp = jax.nn.log_softmax(logits)
+        return {"action_logits": logits, "vf_preds": value,
+                "actions": actions,
+                "action_logp": jnp.take_along_axis(
+                    logp, actions[..., None], axis=-1)[..., 0]}
+
+    def forward_train(self, params, batch, rng=None):
+        logits, value = self._logits_and_value(params, batch["obs"])
+        return {"action_logits": logits, "vf_preds": value}
+
+
+class Catalog:
+    """Pick a module class for a spec (reference: catalog.py's
+    get_encoder_config + the default model pipeline)."""
+
+    @staticmethod
+    def resolve(spec) -> type:
+        from ray_tpu.rllib.core.rl_module import DefaultActorCriticModule
+
+        cfg = spec.model_config or {}
+        encoder = cfg.get("encoder")
+        obs_shape = tuple(cfg.get("obs_shape") or ())
+        if encoder == "cnn" or (encoder is None and len(obs_shape) == 3):
+            return ConvActorCriticModule
+        if encoder not in (None, "mlp"):
+            raise ValueError(
+                f"unknown encoder {encoder!r} (catalog: mlp, cnn)")
+        return DefaultActorCriticModule

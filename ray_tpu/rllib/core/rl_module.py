@@ -60,7 +60,14 @@ class RLModuleSpec:
     model_config: dict = field(default_factory=dict)
 
     def build(self) -> "RLModule":
-        cls = self.module_class or DefaultActorCriticModule
+        cls = self.module_class
+        if cls is None:
+            # Catalog selection: MLP towers for flat obs, CNN encoder
+            # for image obs / an explicit model_config["encoder"]
+            # (reference: the catalog picks the default model).
+            from ray_tpu.rllib.core.catalog import Catalog
+
+            cls = Catalog.resolve(self)
         kwargs = dict(self.model_config)
         if self.action_size:
             kwargs.setdefault("action_size", self.action_size)

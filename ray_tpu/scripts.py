@@ -238,9 +238,13 @@ def cmd_down(args) -> int:
 def cmd_serve(args) -> int:
     """`serve deploy/status/shutdown`: the declarative config path
     (reference: `serve deploy` against ServeDeploySchema,
-    serve/schema.py:701). Runs against the cluster at --address (the
-    controller and replicas live in the connected cluster, so the CLI
-    process can exit after deploying)."""
+    serve/schema.py:701).
+
+    The deploying process OWNS the serve app: the controller actor and
+    the HTTP proxy live in it (same lifecycle as `serve.run` in a
+    driver script). `deploy --blocking` keeps the process alive to
+    serve; without it the deploy is only useful for smoke-checking the
+    config against a cluster."""
     import json as _json
 
     import ray_tpu
@@ -256,6 +260,15 @@ def cmd_serve(args) -> int:
 
         names = deploy_config(ServeDeployConfig.from_yaml(args.config))
         print(f"deployed application(s): {', '.join(names)}")
+        if getattr(args, "blocking", False):
+            print("serving (ctrl-c to stop)")
+            import time as _time
+
+            try:
+                while True:
+                    _time.sleep(1)
+            except KeyboardInterrupt:
+                serve.shutdown()
         return 0
     if args.serve_cmd == "status":
         print(_json.dumps(serve.status(), indent=2, default=str))
@@ -308,6 +321,10 @@ def main(argv: list[str] | None = None) -> int:
     p_sdeploy = ssub.add_parser("deploy")
     p_sdeploy.add_argument("config", help="YAML app config")
     p_sdeploy.add_argument("--address", default=None)
+    p_sdeploy.add_argument(
+        "--blocking", action="store_true",
+        help="stay alive and serve (the deploying process owns the "
+             "controller and HTTP proxy)")
     for sname in ("status", "shutdown"):
         p = ssub.add_parser(sname)
         p.add_argument("--address", default=None)

@@ -81,12 +81,14 @@ class HTTPProxy:
                     self._reply(411, b"chunked request bodies are not "
                                      b"supported; send Content-Length")
                     return
+                # Drain the body BEFORE any reply: an unconsumed body
+                # on a kept-alive socket becomes the next request line.
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
                 handle = proxy._resolve_route(self.path)
                 if handle is None:
                     self._reply(404, b"no app bound to this route")
                     return
-                length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length) if length else b""
                 try:
                     arg = json.loads(body) if body else None
                 except json.JSONDecodeError:

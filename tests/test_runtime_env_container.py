@@ -1,0 +1,101 @@
+"""Container runtime envs: tasks run in a worker booted inside an
+image (reference: _private/runtime_env/container.py:26 wraps worker
+commands in `podman run`).
+
+No container runtime exists in this environment, so a FAKE podman on
+PATH asserts the full command contract — volume mounts for the
+connect-back socket dir and the checkout, -e env forwarding, image then
+worker argv — and then execs the worker command locally. Everything
+above the container boundary (dedicated-worker routing, lease
+accounting, the connect-back handshake) is the real code path.
+"""
+
+from __future__ import annotations
+
+import os
+import stat
+import textwrap
+
+import pytest
+
+import ray_tpu
+
+FAKE_PODMAN = textwrap.dedent("""\
+    #!/bin/bash
+    # fake podman: record argv, apply -e env, exec the in-image command
+    echo "$@" >> "$FAKE_PODMAN_LOG"
+    args=("$@")
+    [ "${args[0]}" = "run" ] || { echo "expected run" >&2; exit 64; }
+    i=1
+    while [ $i -lt ${#args[@]} ]; do
+      a="${args[$i]}"
+      case "$a" in
+        --rm|--network=*) i=$((i+1));;
+        -v) i=$((i+2));;
+        -e) export "${args[$((i+1))]}"; i=$((i+2));;
+        *) break;;
+      esac
+    done
+    # args[i] is the image; the rest is the worker command.
+    i=$((i+1))
+    exec "${args[@]:$i}"
+""")
+
+
+@pytest.fixture
+def fake_podman(tmp_path, monkeypatch):
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    podman = bin_dir / "podman"
+    podman.write_text(FAKE_PODMAN)
+    podman.chmod(podman.stat().st_mode | stat.S_IEXEC)
+    log = tmp_path / "podman.log"
+    monkeypatch.setenv("PATH", f"{bin_dir}:{os.environ['PATH']}")
+    monkeypatch.setenv("FAKE_PODMAN_LOG", str(log))
+    yield log
+
+
+def test_container_task_runs_in_image(fake_podman, tmp_path):
+    # A leftover runtime from an earlier test may have no worker pool,
+    # which would silently run the task in-thread (runtime_env ignored)
+    # — this test NEEDS its own pool-enabled runtime.
+    ray_tpu.shutdown()
+    ray_tpu.init(num_cpus=2, process_workers=1)
+    try:
+        @ray_tpu.remote(runtime_env={"container": {
+            "image": "myorg/compute:v1",
+            "run_options": ["-e", "IN_CONTAINER=yes"]}})
+        def probe():
+            return os.environ.get("IN_CONTAINER"), os.getpid()
+
+        marker, pid = ray_tpu.get(probe.remote(), timeout=120)
+        assert marker == "yes", "run_options env did not reach the task"
+        assert pid != os.getpid()
+
+        argv = fake_podman.read_text().splitlines()[-1].split()
+        assert argv[0] == "run" and "--rm" in argv
+        assert "myorg/compute:v1" in argv
+        # The connect-back socket dir and the checkout are mounted.
+        mounts = [argv[i + 1] for i, a in enumerate(argv) if a == "-v"]
+        assert any("ray_tpu" in m or "tmp" in m for m in mounts)
+        assert argv[argv.index("myorg/compute:v1") + 1].endswith(
+            "python3")
+    finally:
+        ray_tpu.shutdown()
+
+
+def test_container_without_runtime_fails_clearly(tmp_path, monkeypatch):
+    # Strip PATH of podman/docker: the error must name the requirement.
+    bin_dir = tmp_path / "emptybin"
+    bin_dir.mkdir()
+    for tool in ("python3", "python", "bash", "sh", "env"):
+        src = os.popen(f"command -v {tool}").read().strip()
+        if src:
+            (bin_dir / tool).symlink_to(src)
+    monkeypatch.setenv("PATH", str(bin_dir))
+    from ray_tpu._private.worker_pool import _container_argv
+
+    with pytest.raises(RuntimeError, match="podman or docker"):
+        _container_argv({"image": "x"}, "/tmp/sock/addr", {})
+    with pytest.raises(ValueError, match="image"):
+        _container_argv({"runtime": "podman"}, "/tmp/sock/addr", {})
