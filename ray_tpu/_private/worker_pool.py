@@ -500,8 +500,15 @@ def _validate_container(container: dict) -> str:
     (no podman on PATH, missing image) can't leak them."""
     import shutil
 
-    runtime = container.get("runtime") or next(
-        (r for r in ("podman", "docker") if shutil.which(r)), None)
+    runtime = container.get("runtime")
+    if runtime is not None and shutil.which(runtime) is None:
+        # An explicit runtime must exist too, or Popen would raise a
+        # raw FileNotFoundError AFTER the listener/log resources exist.
+        raise RuntimeError(
+            f"runtime_env 'container' runtime {runtime!r} not on PATH")
+    if runtime is None:
+        runtime = next((r for r in ("podman", "docker")
+                        if shutil.which(r)), None)
     if runtime is None:
         raise RuntimeError(
             "runtime_env 'container' needs podman or docker on PATH")
@@ -532,8 +539,13 @@ def _container_argv(container: dict, addr: str, env: dict,
             "JAX_PLATFORMS", "RAY_TPU_SKIP_TPU_DETECTION"]
     keys += [k for k in (extra_env or {}) if k not in keys]
     for key in keys:
-        if env.get(key):
-            argv += ["-e", f"{key}={env[key]}"]
+        # Bare `-e KEY`: podman/docker inherit the VALUE from the
+        # Popen env, so the auth key and user secrets never appear on
+        # the command line (/proc/<pid>/cmdline is world-readable).
+        # `key in env` (not truthiness): an explicit empty string must
+        # stay distinguishable from unset inside the image.
+        if key in env:
+            argv += ["-e", key]
     argv += list(container.get("run_options") or [])
     argv += [image, container.get("python", "python3"), "-m",
              "ray_tpu._private.worker_pool", addr]

@@ -261,9 +261,12 @@ class DreamerV3(Algorithm):
                 h, z, key = carry
                 emb_t, a_prev, reset_t = xs
                 # Episode boundary inside the sequence: restart the
-                # latent (the successor obs begins a new episode).
+                # latent AND a_prev (the policy acts with a_prev=0 at
+                # every episode start; training must see the same
+                # (0, 0, 0) input or the model never learns it).
                 h = h * (1.0 - reset_t)[:, None]
                 z = z * (1.0 - reset_t)[:, None]
+                a_prev = a_prev * (1.0 - reset_t)[:, None]
                 key, sub = jax.random.split(key)
                 h2 = _gru(wm["gru"], h, jnp.concatenate(
                     [z, a_prev], axis=-1))

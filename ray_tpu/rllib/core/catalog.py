@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from ray_tpu.rllib.core.rl_module import (
-    RLModule,
+    DefaultActorCriticModule,
     _mlp_apply,
     _mlp_init,
 )
@@ -77,9 +77,14 @@ def build_cnn_encoder(obs_shape: tuple, conv_filters=None,
     return init, apply, hidden_out
 
 
-class ConvActorCriticModule(RLModule):
+class ConvActorCriticModule(DefaultActorCriticModule):
     """CNN encoder shared by pi/vf heads, for image observations
-    (reference: the catalog's CNN encoder + shared-encoder AC heads)."""
+    (reference: the catalog's CNN encoder + shared-encoder AC heads).
+
+    Subclasses DefaultActorCriticModule: only param construction and
+    the obs -> (logits, value) mapping differ; the three forward_*
+    passes are inherited so the action/logp semantics cannot diverge.
+    """
 
     def __init__(self, observation_size: int, num_actions: int,
                  obs_shape: tuple = (), conv_filters=None,
@@ -88,11 +93,11 @@ class ConvActorCriticModule(RLModule):
             raise ValueError(
                 f"ConvActorCriticModule needs [H, W, C] obs, got "
                 f"{obs_shape}")
+        super().__init__(observation_size, num_actions, hidden=hidden)
         self.obs_shape = tuple(obs_shape)
-        self.num_actions = num_actions
-        self._enc_init, self._enc_apply, enc_out = build_cnn_encoder(
-            self.obs_shape, conv_filters, hidden_out=int(hidden[0]))
-        self._enc_out = enc_out
+        self._enc_init, self._enc_apply, self._enc_out = \
+            build_cnn_encoder(self.obs_shape, conv_filters,
+                              hidden_out=int(hidden[0]))
 
     def init(self, rng):
         enc_rng, pi_rng, vf_rng = jax.random.split(rng, 3)
@@ -109,24 +114,6 @@ class ConvActorCriticModule(RLModule):
         feat = self._enc_apply(params["encoder"], obs)
         return (_mlp_apply(params["pi"], feat),
                 _mlp_apply(params["vf"], feat)[..., 0])
-
-    def forward_inference(self, params, batch, rng=None):
-        logits, value = self._logits_and_value(params, batch["obs"])
-        return {"action_logits": logits, "vf_preds": value,
-                "actions": jnp.argmax(logits, axis=-1)}
-
-    def forward_exploration(self, params, batch, rng=None):
-        logits, value = self._logits_and_value(params, batch["obs"])
-        actions = jax.random.categorical(rng, logits)
-        logp = jax.nn.log_softmax(logits)
-        return {"action_logits": logits, "vf_preds": value,
-                "actions": actions,
-                "action_logp": jnp.take_along_axis(
-                    logp, actions[..., None], axis=-1)[..., 0]}
-
-    def forward_train(self, params, batch, rng=None):
-        logits, value = self._logits_and_value(params, batch["obs"])
-        return {"action_logits": logits, "vf_preds": value}
 
 
 class Catalog:

@@ -145,7 +145,11 @@ def test_autoscaler_launches_real_daemons_on_demand():
         scaler = StandardAutoscaler(
             runtime,
             [NodeTypeConfig("cpu2", {"CPU": 2.0}, max_workers=2)],
-            idle_timeout_s=4.0, update_interval_s=0.5,
+            # Wide enough that the num_nodes assertion right after the
+            # tasks finish wins the race against idle scale-down: with
+            # fork-server worker spawn the whole workload can complete
+            # in ~2s, and a 4s timeout fired before the assert ran.
+            idle_timeout_s=12.0, update_interval_s=0.5,
             provider=provider).start()
 
         @ray_tpu.remote
@@ -159,6 +163,12 @@ def test_autoscaler_launches_real_daemons_on_demand():
         results = ray_tpu.get(refs, timeout=120)
         assert [v for v, _ in results] == [1, 2, 3, 4]
         assert all(tag for _, tag in results), "ran outside a daemon"
+        # The tasks can finish (daemons registered + executed) moments
+        # before the autoscaler's launch thread records the node in its
+        # tracking table — poll briefly instead of asserting instantly.
+        deadline = time.time() + 30
+        while time.time() < deadline and scaler.num_nodes("cpu2") < 1:
+            time.sleep(0.2)
         assert scaler.num_nodes("cpu2") >= 1
         assert len(provider.non_terminated_nodes()) >= 1
 

@@ -97,5 +97,11 @@ def test_container_without_runtime_fails_clearly(tmp_path, monkeypatch):
 
     with pytest.raises(RuntimeError, match="podman or docker"):
         _container_argv({"image": "x"}, "/tmp/sock/addr", {})
+    # An EXPLICIT runtime that is absent must also fail up front (a
+    # late Popen FileNotFoundError would leak the listener/log).
+    with pytest.raises(RuntimeError, match="not on PATH"):
+        _container_argv({"runtime": "podman", "image": "x"},
+                        "/tmp/sock/addr", {})
+    (bin_dir / "podman").symlink_to(bin_dir / "bash")
     with pytest.raises(ValueError, match="image"):
         _container_argv({"runtime": "podman"}, "/tmp/sock/addr", {})
