@@ -76,6 +76,19 @@ _DEFAULTS: dict[str, Any] = {
     "actor_restart_relocate_timeout_s": 120.0,
     # RPC plane.
     "rpc_io_pool_workers": 16,         # pooled short-call dispatch
+    # Pipelined transport (reference: gRPC completion queues carry many
+    # in-flight calls per connection, src/ray/rpc/client_call.h).
+    "rpc_pipeline_depth": 8,           # in-flight chunk fetches per pull
+    "rpc_batch_flush_ms": 0.0,         # coalescing linger; 0 = natural
+    "rpc_batch_max_entries": 128,      # max calls per batched frame
+    # P2P chunked broadcast (reference: the object manager's chunked
+    # Push/Pull fans transfers out peer-to-peer via the directory).
+    "broadcast_chunk_fanout": 4,       # peer sources used per pull
+    "broadcast_min_p2p_chunks": 4,     # smaller objects pull owner-only
+    "node_relay_cache_mb": 4096,       # completed relay copies kept
+    # Driver-side node table: absent-but-pinging nodes survive this many
+    # consecutive sync passes before being dropped (head amnesia grace).
+    "node_amnesia_max_passes": 5,
     # Head control plane.
     "gcs_heartbeat_timeout_s": 10.0,   # node declared dead after this
     # Worker pipe transport.

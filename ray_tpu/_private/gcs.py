@@ -72,6 +72,62 @@ class KVStore:
             return [k for k in self._data[namespace] if k.startswith(prefix)]
 
 
+class ObjectDirectory:
+    """Cluster object-location table (reference:
+    ownership_based_object_directory.h): owners batch-publish which
+    nodes hold copies of their primary objects. Multi-holder: a
+    broadcast object accumulates every node that pulled a full copy, so
+    schedulers/recovery can pick ANY holder, not just the producer.
+    Entries are leased per owner — an owner that stops refreshing (its
+    driver exited) is pruned wholesale."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # owner addr -> {object hex -> {node hex, ...}}
+        self._locations: dict[str, dict[str, set[str]]] = {}
+        self._seen: dict[str, float] = {}
+
+    def update(self, owner: str, adds: list, removes: list) -> int:
+        """Apply one owner's batched deltas; an empty update is a
+        keepalive refreshing the owner's lease. ``adds`` entries are
+        (object_hex, node_hex) or (object_hex, [node_hex, ...])."""
+        with self._lock:
+            table = self._locations.setdefault(owner, {})
+            for obj_hex, nodes in adds:
+                holders = table.setdefault(obj_hex, set())
+                if isinstance(nodes, str):
+                    holders.add(nodes)
+                else:
+                    holders.update(nodes)
+            for obj_hex in removes:
+                table.pop(obj_hex, None)
+            self._seen[owner] = time.monotonic()
+            if not table:
+                self._locations.pop(owner, None)
+            return len(table)
+
+    def locations(self, owner: str | None = None) -> dict:
+        """{object hex -> sorted holder list}, for one owner or all."""
+        with self._lock:
+            if owner is not None:
+                return {o: sorted(nodes) for o, nodes
+                        in self._locations.get(owner, {}).items()}
+            out: dict[str, list[str]] = {}
+            for table in self._locations.values():
+                for obj_hex, nodes in table.items():
+                    out.setdefault(obj_hex, [])
+                    out[obj_hex] = sorted(set(out[obj_hex]) | nodes)
+            return out
+
+    def prune(self, ttl_s: float = 60.0) -> None:
+        now = time.monotonic()
+        with self._lock:
+            for owner in [o for o, seen in self._seen.items()
+                          if now - seen > ttl_s]:
+                self._seen.pop(owner, None)
+                self._locations.pop(owner, None)
+
+
 class PubSub:
     """In-process pub/sub hub (reference: src/ray/pubsub/publisher.h:307)."""
 

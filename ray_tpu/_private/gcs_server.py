@@ -201,11 +201,11 @@ class GcsServer:
             self._restore_snapshot()
         self._server = RpcServer(host, port)
         self._shutdown = threading.Event()
-        # owner addr -> {object hex -> node hex}; pruned when the owner
-        # stops refreshing (its driver exited).
-        self._object_locations: dict[str, dict[str, str]] = {}
-        self._obj_loc_seen: dict[str, float] = {}
-        self._obj_loc_lock = threading.Lock()
+        # Cluster object-location directory (multi-holder; pruned when
+        # an owner stops refreshing its lease — its driver exited).
+        from ray_tpu._private.gcs import ObjectDirectory
+
+        self.object_directory = ObjectDirectory()
         # Cross-process channel hub; the head's own membership events
         # bridge onto the "nodes" channel so any cluster process can
         # react by push instead of polling list_nodes.
@@ -331,30 +331,13 @@ class GcsServer:
                                  removes: list) -> int:
         """Batched owner-published location deltas; an empty update is a
         keepalive that refreshes the owner's lease on its entries."""
-        with self._obj_loc_lock:
-            table = self._object_locations.setdefault(owner, {})
-            for obj_hex, node_hex in adds:
-                table[obj_hex] = node_hex
-            for obj_hex in removes:
-                table.pop(obj_hex, None)
-            self._obj_loc_seen[owner] = time.monotonic()
-            if not table:
-                self._object_locations.pop(owner, None)
-            return len(table)
+        return self.object_directory.update(owner, adds, removes)
 
     def _list_object_locations(self, owner: str | None = None) -> dict:
-        with self._obj_loc_lock:
-            if owner is not None:
-                return dict(self._object_locations.get(owner, {}))
-            return {o: dict(t) for o, t in self._object_locations.items()}
+        return self.object_directory.locations(owner)
 
     def _prune_object_locations(self, ttl_s: float = 60.0) -> None:
-        now = time.monotonic()
-        with self._obj_loc_lock:
-            for owner in [o for o, seen in self._obj_loc_seen.items()
-                          if now - seen > ttl_s]:
-                self._obj_loc_seen.pop(owner, None)
-                self._object_locations.pop(owner, None)
+        self.object_directory.prune(ttl_s)
 
     def _cluster_resources(self) -> dict:
         total: dict[str, float] = {}

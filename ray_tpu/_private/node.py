@@ -22,7 +22,7 @@ import sys
 import threading
 import time
 
-from ray_tpu._private.rpc import RpcClient, RpcError
+from ray_tpu._private.rpc import MuxRpcClient, RpcClient, RpcError  # noqa: F401 — RpcClient re-exported for callers
 
 SESSION_DIR = os.environ.get("RAY_TPU_SESSION_DIR", "/tmp/ray_tpu")
 
@@ -66,7 +66,11 @@ class NodeAgent:
                  heartbeat_period_s: float = 1.0,
                  usage_fn=None, executor_address: str = "",
                  coalesce_s: float = 0.05):
-        self.client = RpcClient(gcs_address)
+        # Pipelined client: a heartbeat never queues behind a slow
+        # re-register (or any other in-flight call) on the same socket,
+        # and a dead head is detected by the reader thread the moment
+        # the connection drops instead of on the next call's timeout.
+        self.client = MuxRpcClient(gcs_address, timeout_s=30.0)
         self.resources = dict(resources)
         self.labels = dict(labels or {})
         self.heartbeat_period_s = heartbeat_period_s
@@ -204,7 +208,9 @@ def run_head(port: int, resources: dict | None = None,
 
     head_resources = resources or default_resources()
     os.environ.setdefault("RAY_TPU_NODE_TAG", f"head-{os.urandom(4).hex()}")
-    executor = NodeExecutorService(resources=head_resources).start()
+    executor = NodeExecutorService(resources=head_resources)
+    executor.advertised_address = executor.address_for(_own_address())
+    executor.start()
 
     agent = NodeAgent(f"127.0.0.1:{server._server.port}",
                       head_resources,
@@ -260,7 +266,9 @@ def run_worker(gcs_address: str, resources: dict | None = None,
     # BEFORE the pool spawns) — tasks can read it to learn where they ran.
     os.environ["RAY_TPU_NODE_TAG"] = os.urandom(6).hex()
     executor = NodeExecutorService(
-        pool_size=pool_size, resources=resources).start()
+        pool_size=pool_size, resources=resources)
+    executor.advertised_address = executor.address_for(_own_address())
+    executor.start()
     agent = NodeAgent(gcs_address, resources,
                       labels={"node_role": "worker", **(labels or {})},
                       heartbeat_period_s=heartbeat_period_s,

@@ -263,6 +263,18 @@ class NodeObjectStore:
         with self._lock:
             return list(self._owned_ids)
 
+    def size(self, id_bytes: bytes) -> int | None:
+        """Byte size of a stored blob without copying it (and without
+        counting as a served fetch — used by transfer-plan probes)."""
+        with self._lock:
+            blob = self._blobs.get(id_bytes)
+            if blob is not None:
+                return len(blob)
+            spilled = self._spilled.get(id_bytes)
+            if spilled is not None:
+                return spilled[1]
+        return None
+
     def read_chunk(self, id_bytes: bytes, offset: int,
                    length: int) -> tuple[int, bytes] | None:
         with self._lock:
@@ -324,23 +336,211 @@ class _PeerClients:
             self._clients.clear()
 
 
+def _pipeline_depth() -> int:
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    return max(1, int(GLOBAL_CONFIG.rpc_pipeline_depth))
+
+
 def fetch_blob(client: RpcClient, id_bytes: bytes) -> bytes:
     """Chunked pull of one object (reference: object_manager.h chunked
-    Push — here pull-oriented, sized by fetch_chunk_kb)."""
-    out = bytearray()
-    offset = 0
+    Push — here pull-oriented, sized by fetch_chunk_kb). On a pipelined
+    client (MuxRpcClient) up to rpc_pipeline_depth chunk requests ride
+    the socket concurrently, so throughput is not bounded by one
+    round-trip per chunk."""
+    from collections import deque
+
     chunk_bytes = _fetch_chunk_bytes()
-    while True:
-        reply = client.call("fetch_object", id_bytes, offset,
-                            chunk_bytes)
+    first = client.call("fetch_object", id_bytes, 0, chunk_bytes)
+    if first is None:
+        raise KeyError(
+            f"object {id_bytes.hex()} not present on {client.address}")
+    total, chunk = first
+    if len(chunk) >= total:
+        return bytes(chunk)
+    buf = bytearray(total)
+    buf[:len(chunk)] = chunk
+    offset = len(chunk)
+    call_async = getattr(client, "call_async", None)
+    if call_async is None:
+        while offset < total:
+            reply = client.call("fetch_object", id_bytes, offset,
+                                chunk_bytes)
+            if reply is None:
+                raise KeyError(
+                    f"object {id_bytes.hex()} vanished from "
+                    f"{client.address}")
+            _, chunk = reply
+            buf[offset:offset + len(chunk)] = chunk
+            offset += len(chunk)
+        return bytes(buf)
+    pending: deque = deque()
+    depth = _pipeline_depth()
+    next_off = offset
+    while next_off < total or pending:
+        while next_off < total and len(pending) < depth:
+            pending.append((next_off, call_async(
+                "fetch_object", id_bytes, next_off, chunk_bytes)))
+            next_off += chunk_bytes
+        off, slot = pending.popleft()
+        reply = slot.result()
         if reply is None:
             raise KeyError(
-                f"object {id_bytes.hex()} not present on {client.address}")
-        total, chunk = reply
-        out.extend(chunk)
-        offset += len(chunk)
-        if offset >= total:
-            return bytes(out)
+                f"object {id_bytes.hex()} vanished from {client.address}")
+        _, chunk = reply
+        buf[off:off + len(chunk)] = chunk
+    return bytes(buf)
+
+
+class ChunkDirectory:
+    """Owner-side holder registry for one node's (or the driver export
+    server's) objects: every puller that starts fetching an object
+    registers here and is handed the current holder set, so later
+    pullers spread their chunk fetches across peers instead of queueing
+    on the owner (reference: ownership_based_object_directory.h — the
+    owner hands out locations, data flows node-to-node)."""
+
+    TTL_S = 180.0
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # id -> {holder addr -> registered-at monotonic}
+        self._holders: dict[bytes, dict[str, float]] = {}
+
+    def register(self, id_bytes: bytes, addr: str | None) -> list[str]:
+        """Record ``addr`` as a (partial) holder; return the OTHER
+        currently-known holders, oldest first (oldest have the most
+        chunks)."""
+        import time
+
+        now = time.monotonic()
+        with self._lock:
+            table = self._holders.setdefault(id_bytes, {})
+            for holder, seen in list(table.items()):
+                if now - seen > self.TTL_S:
+                    del table[holder]
+            others = [a for a in table if a != addr]
+            if addr:
+                table.setdefault(addr, now)
+            return others
+
+    def drop(self, ids: list[bytes]) -> None:
+        with self._lock:
+            for id_bytes in ids:
+                self._holders.pop(id_bytes, None)
+
+    def prune(self) -> None:
+        import time
+
+        now = time.monotonic()
+        with self._lock:
+            for id_bytes in list(self._holders):
+                table = self._holders[id_bytes]
+                for holder, seen in list(table.items()):
+                    if now - seen > self.TTL_S:
+                        del table[holder]
+                if not table:
+                    del self._holders[id_bytes]
+
+
+def wrap_chunk_reply(reply):
+    """Bulk chunk replies ship as raw tail bytes (TailPayload): the
+    payload crosses the RPC layer without a pickle memcpy on either
+    side. Small replies keep the plain tuple shape."""
+    from ray_tpu._private.rpc import TailPayload
+
+    total, chunk = reply
+    if len(chunk) >= (1 << 16):
+        return TailPayload(total, chunk)
+    return (total, bytes(chunk) if isinstance(chunk, memoryview)
+            else chunk)
+
+
+def plan_holders(directory: ChunkDirectory, id_bytes: bytes,
+                 puller_addr: str | None, total: int) -> list[str]:
+    """Directory half of a fetch_plan reply: register the puller and
+    return the other holders — but only for objects large enough that
+    pullers actually take the P2P path; registering sub-threshold
+    pullers would advertise peers that never hold servable chunks."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    chunk = _fetch_chunk_bytes()
+    n_chunks = -(-total // chunk) if total else 0
+    if n_chunks < int(GLOBAL_CONFIG.broadcast_min_p2p_chunks):
+        return []
+    return directory.register(id_bytes, puller_addr)
+
+
+class _PartialBlob:
+    """An in-progress (or recently finished) pull whose present chunks
+    are servable to peers — the relay half of the broadcast tree: a
+    receiver starts re-serving chunks the moment it has them, so 1->N
+    broadcast throughput scales with the receivers, not the owner's
+    socket (Podracer-style weight broadcast; reference: the object
+    manager's chunked transfers + directory)."""
+
+    __slots__ = ("total", "chunk", "buf", "have", "lock", "done",
+                 "error", "completed_at", "served", "external")
+
+    def __init__(self, total: int, chunk: int, buf=None):
+        self.total = total
+        self.chunk = chunk
+        # ``buf`` may be an external writable buffer (a shared-memory
+        # mapping): chunks then land directly where the consuming
+        # worker will map them — zero intermediate full-object copies.
+        self.external = buf is not None
+        self.buf = buf if buf is not None else bytearray(total)
+        self.have: set[int] = set()
+        self.lock = threading.Lock()
+        self.done = threading.Event()
+        self.error: BaseException | None = None
+        self.completed_at: float | None = None
+        self.served = 0  # chunks relayed to peers from this partial
+
+    def n_chunks(self) -> int:
+        return -(-self.total // self.chunk) if self.total else 0
+
+    def write(self, index: int, data) -> None:
+        off = index * self.chunk
+        with self.lock:
+            self.buf[off:off + len(data)] = data
+            self.have.add(index)
+
+    def read_chunk(self, offset: int, length: int):
+        """Serve a range iff every covered chunk is present; None
+        otherwise (the puller falls back to another holder)."""
+        if offset >= self.total:
+            return (self.total, b"")
+        end = min(offset + length, self.total)
+        first = offset // self.chunk
+        last = (end - 1) // self.chunk if end > offset else first
+        with self.lock:
+            if any(i not in self.have for i in range(first, last + 1)):
+                return None
+            try:
+                data = bytes(self.buf[offset:end])
+            except ValueError:
+                return None  # buffer released by concurrent eviction
+            self.served += 1
+            return (self.total, data)
+
+    def finish(self) -> bytes | None:
+        """Mark complete; returns the assembled bytes for internal
+        buffers (external/shm buffers ARE the final resting place — no
+        copy is made and None is returned)."""
+        import time
+
+        blob = None
+        if not self.external:
+            with self.lock:
+                blob = bytes(self.buf)
+        self.completed_at = time.monotonic()
+        self.done.set()
+        return blob
+
+    def fail(self, exc: BaseException) -> None:
+        self.error = exc
+        self.done.set()
 
 
 class _ActorNewError(Exception):
@@ -429,13 +629,16 @@ class _DaemonActor:
     def __init__(self, cls_blob: bytes, args_blob: bytes,
                  runtime_env: dict | None, max_concurrency: int,
                  extra_env: dict | None, allow_tpu: bool,
-                 sys_path: list | None):
+                 sys_path: list | None, worker=None):
         from ray_tpu._private.worker_pool import PoolWorker
 
         self.max_concurrency = max(1, int(max_concurrency or 1))
         self.owner: str | None = None  # creating driver's client addr
-        self._worker = PoolWorker(-1, extra_env=extra_env,
-                                  allow_tpu=allow_tpu)
+        # ``worker``: a prestarted standby process (reference:
+        # worker_pool.h "Starts a number of workers ahead of time") —
+        # creation then skips the fork on the critical path.
+        self._worker = worker if worker is not None else PoolWorker(
+            -1, extra_env=extra_env, allow_tpu=allow_tpu)
         self._mux = None
         reply = self._worker.request(
             ("actor_new", cls_blob, args_blob, runtime_env,
@@ -497,6 +700,18 @@ class NodeExecutorService:
         # native); Python fallback keeps identical semantics.
         self.store = make_node_store()
         self._peers = _PeerClients()
+        # P2P transfer plane: in-progress/relay pulls servable to peers
+        # + the holder directory for objects THIS node owns.
+        self._partials: dict[bytes, _PartialBlob] = {}
+        self._partials_lock = threading.Lock()
+        self.chunk_directory = ChunkDirectory()
+        self._advertised_address: str | None = None
+        self.relay_chunks_served = 0  # cumulative, survives partial GC
+        # Worker-bound arg blobs promoted to shared memory: keyed by the
+        # object's id bytes in the node's shm directory; FIFO-bounded.
+        self._shm_args_lock = threading.Lock()
+        self._shm_args_order: list[tuple[bytes, int]] = []
+        self._shm_args_bytes = 0
         self._resources = dict(resources or {})
         self._running_lock = threading.Lock()
         self._running: dict[str, dict[str, float]] = {}
@@ -518,6 +733,14 @@ class NodeExecutorService:
         # Actor plane: actor key (bytes) -> _DaemonActor.
         self._actors: dict[bytes, _DaemonActor] = {}
         self._actors_lock = threading.Lock()
+        # Prestarted standby workers for actor creation, keyed by the
+        # spawn-relevant env (client addr); refilled asynchronously so
+        # forks overlap RPC waits instead of sitting on the creation
+        # critical path.
+        self._standby: dict[tuple, list] = {}
+        self._standby_lock = threading.Lock()
+        self._standby_refilling: set[tuple] = set()
+        self._standby_target = 2
         self._stop_event = threading.Event()
         self._sweep_thread: threading.Thread | None = None
 
@@ -540,6 +763,7 @@ class NodeExecutorService:
         s.register("execute_task", self.execute_task, concurrent=True)
         s.register("fetch_object", self.fetch_object,
                    concurrent="pooled")
+        s.register("fetch_plan", self.fetch_plan, concurrent="pooled")
         s.register("free_objects", self.free_objects)
         s.register("executor_stats", self.executor_stats)
         s.register("task_block", self.task_block)
@@ -555,6 +779,20 @@ class NodeExecutorService:
 
     def address_for(self, host: str) -> str:
         return f"{host}:{self._server.port}"
+
+    @property
+    def advertised_address(self) -> str:
+        """The address peers reach this executor at — what this node
+        registers in owners' chunk directories when pulling."""
+        if self._advertised_address is None:
+            from ray_tpu._private.node import _own_address
+
+            self._advertised_address = self.address_for(_own_address())
+        return self._advertised_address
+
+    @advertised_address.setter
+    def advertised_address(self, value: str) -> None:
+        self._advertised_address = value
 
     def start(self) -> "NodeExecutorService":
         self._server.start()
@@ -587,6 +825,7 @@ class NodeExecutorService:
         # stretch the sweep period and starve probes of live ones.
         fail_since: dict[str, float] = {}
         while not self._stop_event.wait(period_s):
+            self._sweep_transfer_plane()
             with self._actors_lock:
                 actor_owners = {a.owner: None for a in
                                 self._actors.values()
@@ -644,8 +883,25 @@ class NodeExecutorService:
             self._actors.clear()
         for actor in actors:
             actor.kill()
+        with self._standby_lock:
+            standby = [w for pool in self._standby.values()
+                       for w in pool]
+            self._standby.clear()
+        for worker in standby:
+            worker.stop()
         self.pool.shutdown()
         self._peers.close()
+        # Relay partials view shm segments; release the views before
+        # the directory unlinks/closes them.
+        with self._partials_lock:
+            parts, self._partials = list(self._partials.values()), {}
+        for part in parts:
+            if part.external:
+                with part.lock:
+                    try:
+                        part.buf.release()
+                    except BufferError:
+                        pass
         self._shm_client.close_all()
         self._shm_directory.shutdown()
         if hasattr(self.store, "close"):
@@ -676,6 +932,7 @@ class NodeExecutorService:
         # task_unblock, driven by the owning driver's block context —
         # reference: workers blocked in ray.get return their CPU to the
         # raylet).
+        self._warm_factory_once()
         demand = dict(resources or {})
         demand.setdefault("CPU", 1.0)
         token = task_token or f"exec-{digest[:8]}-{os.urandom(4).hex()}"
@@ -716,7 +973,12 @@ class NodeExecutorService:
                     self._func_cache[digest] = func
             args, kwargs = serialization.deserialize_from_buffer(
                 memoryview(args_blob))
-            args, kwargs = self._resolve_fetch_args(args, kwargs)
+            # CPU tasks execute in pool workers: hand large args over
+            # as shared-memory descriptors, not re-serialized payloads.
+            on_pool = not any(k.startswith("TPU")
+                              for k in (resources or {}))
+            args, kwargs = self._resolve_fetch_args(args, kwargs,
+                                                    to_shm=on_pool)
             values = self._run(func, digest, func_blob, args, kwargs,
                                n_returns, runtime_env,
                                resources or {}, task_token=token,
@@ -772,19 +1034,76 @@ class NodeExecutorService:
 
     def fetch_object(self, id_bytes: bytes, offset: int,
                      length: int):
-        return self.store.read_chunk(id_bytes, offset, length)
+        reply = self.store.read_chunk(id_bytes, offset, length)
+        if reply is None:
+            # Not (yet) in the store: an in-progress or relay pull may
+            # hold the requested chunks — serve them so 1->N broadcast
+            # fans out through receivers instead of queueing on the
+            # owner.
+            with self._partials_lock:
+                part = self._partials.get(id_bytes)
+            if part is None:
+                return None
+            reply = part.read_chunk(offset, length)
+            if reply is None:
+                return None
+            self.relay_chunks_served += 1
+        return wrap_chunk_reply(reply)
+
+    def fetch_plan(self, id_bytes: bytes,
+                   puller_addr: str | None = None):
+        """Transfer plan for one object: (total_size, other_holders).
+        Registers the puller as a partial holder so later pullers fetch
+        chunks from it too. None when the object is unknown here."""
+        total = self.store.size(id_bytes)
+        if total is None:
+            with self._partials_lock:
+                part = self._partials.get(id_bytes)
+            if part is None:
+                return None
+            total = part.total
+        return (total, plan_holders(self.chunk_directory, id_bytes,
+                                    puller_addr, total))
 
     def free_objects(self, ids: list[bytes]) -> int:
+        for id_bytes in ids:
+            self._drop_shm_arg(id_bytes)
+        self.chunk_directory.drop(ids)
         return self.store.free(ids)
+
+    def _drop_shm_arg(self, key: bytes) -> None:
+        """Owner GC of one object's transfer-plane state: relay
+        partial (buffer view released first — exported-view safety),
+        shm segment, and FIFO accounting."""
+        with self._partials_lock:
+            part = self._partials.pop(key, None)
+        if part is not None and part.external:
+            with part.lock:
+                try:
+                    part.buf.release()
+                except BufferError:
+                    pass
+        with self._shm_args_lock:
+            self._shm_args_order = [
+                (k, sz) for k, sz in self._shm_args_order if k != key]
+            self._shm_args_bytes = sum(
+                sz for _, sz in self._shm_args_order)
+        self._shm_directory.free(key)
 
     def executor_stats(self) -> dict:
         with self._running_lock:
             running = len(self._running)
         with self._actors_lock:
             num_actors = len(self._actors)
+        with self._partials_lock:
+            relay = {
+                "partials": len(self._partials),
+                "relay_chunks_served": self.relay_chunks_served,
+            }
         return {"tasks_executed": self.tasks_executed,
                 "running": running, "store": self.store.stats(),
                 "num_actors": num_actors, "pid": os.getpid(),
+                "relay": relay,
                 "threads": threading.active_count()}
 
     def adopt_sys_path(self, paths: list) -> int:
@@ -856,6 +1175,7 @@ class NodeExecutorService:
         constructor there. -> ("ok", pid) | ("busy",) | ("err", blob).
         (Reference: GcsActorScheduler leases a worker on the chosen node
         and pushes the creation task — gcs_actor_scheduler.h.)"""
+        self._warm_factory_once()
         with self._actors_lock:
             existing = self._actors.get(actor_key)
         if existing is not None:
@@ -872,7 +1192,10 @@ class NodeExecutorService:
         try:
             args, kwargs = serialization.deserialize_from_buffer(
                 memoryview(args_blob))
-            args, kwargs = self._resolve_fetch_args(args, kwargs)
+            # Actor workers resolve _ShmRef at actor_new: large init
+            # args cross as shm descriptors, not pipe payloads.
+            args, kwargs = self._resolve_fetch_args(args, kwargs,
+                                                    to_shm=True)
             init_blob = serialization.serialize_framed((args, kwargs))
             extra_env = {}
             if client_addr:
@@ -884,9 +1207,12 @@ class NodeExecutorService:
             # user's risk — same caveat as the reference's fractional
             # GPUs (reference: TPU_VISIBLE_CHIPS isolation, tpu.py:30).
             allow_tpu = any(k.startswith("TPU") for k in demand)
+            worker = None
+            if not allow_tpu:
+                worker = self._take_standby(extra_env)
             actor = _DaemonActor(cls_blob, init_blob, runtime_env,
                                  max_concurrency, extra_env, allow_tpu,
-                                 sys_path)
+                                 sys_path, worker=worker)
         except _ActorNewError as exc:
             with self._running_lock:
                 self._running.pop(token, None)
@@ -922,7 +1248,8 @@ class NodeExecutorService:
         try:
             args, kwargs = serialization.deserialize_from_buffer(
                 memoryview(args_blob))
-            args, kwargs = self._resolve_fetch_args(args, kwargs)
+            args, kwargs = self._resolve_fetch_args(args, kwargs,
+                                                    to_shm=True)
             call_blob = serialization.serialize_framed((args, kwargs))
             status, payload = actor.call(method, call_blob,
                                          max(1, n_returns))
@@ -946,6 +1273,77 @@ class NodeExecutorService:
                                owner=getattr(actor, "owner", None))
                 out.append(("stored", len(blob)))
         return ("ok", out)
+
+    def _warm_factory_once(self) -> None:
+        """First-work trigger: warm the fork-server template in the
+        background so the spawn that follows pays only the remaining
+        boot time (reference: worker_pool.h prestarts workers ahead of
+        demand). NOT at daemon start — a 100-daemon single-box cluster
+        would stampede 100 factory boots onto the cores before any
+        work arrives (nodes that never execute should never fork)."""
+        if getattr(self, "_factory_warmed", False) \
+                or os.environ.get("RAY_TPU_WORKER_FACTORY_DISABLE"):
+            return
+        self._factory_warmed = True
+
+        def _warm():
+            try:
+                from ray_tpu._private.worker_pool import _get_factory
+
+                _get_factory()
+            except Exception:  # noqa: BLE001 — spawns fall back
+                pass
+
+        threading.Thread(target=_warm, daemon=True,
+                         name="factory-prewarm").start()
+
+    def _take_standby(self, extra_env: dict | None):
+        """Pop a live prestarted worker for this spawn env (None on
+        miss) and kick an async refill either way."""
+        key = tuple(sorted((extra_env or {}).items()))
+        worker = None
+        with self._standby_lock:
+            pool = self._standby.get(key, [])
+            while pool:
+                candidate = pool.pop()
+                if candidate.alive():
+                    worker = candidate
+                    break
+                candidate.stop()
+        self._refill_standby(key, extra_env)
+        return worker
+
+    def _refill_standby(self, key: tuple, extra_env: dict | None) -> None:
+        with self._standby_lock:
+            if key in self._standby_refilling:
+                return
+            self._standby_refilling.add(key)
+
+        def refill():
+            from ray_tpu._private.worker_pool import PoolWorker
+
+            try:
+                while not self._stop_event.is_set():
+                    with self._standby_lock:
+                        if len(self._standby.get(key, [])) >= \
+                                self._standby_target:
+                            return
+                    try:
+                        worker = PoolWorker(-1, extra_env=dict(key),
+                                            allow_tpu=False)
+                    except Exception:  # noqa: BLE001 — next take forks
+                        return
+                    with self._standby_lock:
+                        if self._stop_event.is_set():
+                            worker.stop()
+                            return
+                        self._standby.setdefault(key, []).append(worker)
+            finally:
+                with self._standby_lock:
+                    self._standby_refilling.discard(key)
+
+        threading.Thread(target=refill, daemon=True,
+                         name="actor-standby-refill").start()
 
     def actor_kill(self, actor_key: bytes) -> bool:
         return self._reap_actor(actor_key)
@@ -1003,23 +1401,358 @@ class NodeExecutorService:
 
     # ------------------------------------------------------------- internals
 
-    def _resolve_fetch_args(self, args: tuple, kwargs: dict):
+    def _resolve_fetch_args(self, args: tuple, kwargs: dict,
+                            to_shm: bool = False):
+        """Resolve FetchRef placeholders. ``to_shm=True`` (worker-bound
+        paths) maps each pulled framed blob into a shared-memory
+        segment ONCE and substitutes an _ShmRef: the worker
+        deserializes straight from the mapping — the daemon never pays
+        a deserialize + re-serialize + pipe copy of the payload, and
+        repeated tasks using the same broadcast arg share one segment
+        (reference: plasma is host-shared by design,
+        object_manager/plasma/store_runner.h)."""
+        from ray_tpu._private.worker_pool import _ShmRef
+
         def convert(a):
-            if isinstance(a, FetchRef):
-                return self._load_object(a)
-            return a
+            if not isinstance(a, FetchRef):
+                return a
+            if to_shm:
+                return _ShmRef(self._shm_fetch_blob(a))
+            return self._load_object(a)
 
         return (tuple(convert(a) for a in args),
                 {k: convert(v) for k, v in kwargs.items()})
 
+    def _shm_fetch_blob(self, ref: FetchRef):
+        """Framed blob of ``ref`` as a shared-memory descriptor
+        (single-flight per object; bounded cache, FIFO eviction).
+        Remote pulls land straight in the segment; locally-stored
+        blobs are copied into one once and reused by every task."""
+        key = ref.id_bytes
+        with self._shm_args_lock:
+            desc = self._shm_directory.lookup(key)
+        if desc is not None:
+            return desc
+        blob = self.store.get(key)
+        if blob is not None:
+            return self._blob_to_shm(key, blob)
+        return self._fetch_remote(ref, to_shm=True)
+
     def _load_object(self, ref: FetchRef) -> Any:
         blob = self.store.get(ref.id_bytes)
         if blob is None:
+            with self._partials_lock:
+                part = self._partials.get(ref.id_bytes)
+                if part is not None and part.done.is_set() \
+                        and part.error is None:
+                    try:
+                        blob = bytes(part.buf)
+                    except ValueError:
+                        blob = None  # view released by eviction
+        if blob is None:
             # Peer pull (node-to-node; the driver is never in the path).
-            client = self._peers.get(ref.addr)
-            blob = fetch_blob(client, ref.id_bytes)
-            self.store.put(ref.id_bytes, blob, cached=True)
+            blob = self._fetch_remote(ref)
         return serialization.deserialize_from_buffer(memoryview(blob))
+
+    def _fetch_remote(self, ref: FetchRef, to_shm: bool = False):
+        """Pull ``ref`` from the cluster: P2P chunked when the object is
+        large enough — the owner hands out a plan (size + holders), this
+        node registers partial possession and fetches chunks in parallel
+        from every node that has them while relaying its own — plain
+        pipelined owner pull otherwise.
+
+        Returns the framed bytes, or (``to_shm=True``) a ShmDescriptor
+        whose segment the chunks were pulled STRAIGHT into — the
+        worker-bound path never materializes an intermediate copy of
+        the whole object."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        owner = self._peers.get(ref.addr)
+        try:
+            plan = owner.call("fetch_plan", ref.id_bytes,
+                              self.advertised_address)
+        except RpcMethodError:
+            plan = None  # owner predates fetch_plan
+        chunk = _fetch_chunk_bytes()
+        n_chunks = (-(-plan[0] // chunk)
+                    if plan is not None and plan[0] else 0)
+        if plan is None or \
+                n_chunks < int(GLOBAL_CONFIG.broadcast_min_p2p_chunks):
+            blob = fetch_blob(owner, ref.id_bytes)
+            if to_shm:
+                return self._blob_to_shm(ref.id_bytes, blob)
+            self.store.put(ref.id_bytes, blob, cached=True)
+            return blob
+        total, holders = plan
+        # Single-flight per object: concurrent tasks needing the same
+        # arg share one pull instead of racing duplicate transfers.
+        with self._partials_lock:
+            part = self._partials.get(ref.id_bytes)
+            leader = part is None or (part.done.is_set()
+                                      and part.error is not None)
+            if leader:
+                seg = None
+                if to_shm:
+                    from multiprocessing import shared_memory
+
+                    seg = shared_memory.SharedMemory(
+                        create=True, size=max(total, 1))
+                    part = _PartialBlob(total, chunk,
+                                        buf=memoryview(seg.buf))
+                else:
+                    part = _PartialBlob(total, chunk)
+                self._partials[ref.id_bytes] = part
+        if not leader:
+            part.done.wait()
+            if part.error is None:
+                if to_shm:
+                    return self._blob_to_shm(ref.id_bytes, None,
+                                             part=part)
+                with part.lock:
+                    return bytes(part.buf)
+            # Leader failed; retry as a plain owner pull.
+            blob = fetch_blob(owner, ref.id_bytes)
+            if to_shm:
+                return self._blob_to_shm(ref.id_bytes, blob)
+            self.store.put(ref.id_bytes, blob, cached=True)
+            return blob
+        try:
+            self._pull_chunks(ref, part, holders)
+        except BaseException as exc:  # noqa: BLE001 — release waiters
+            with self._partials_lock:
+                if self._partials.get(ref.id_bytes) is part:
+                    del self._partials[ref.id_bytes]
+            part.fail(exc)
+            if seg is not None:
+                try:
+                    part.buf.release()
+                    seg.unlink()
+                    seg.close()
+                except (OSError, BufferError):
+                    pass
+            raise
+        if to_shm:
+            # The segment is the final copy: register it (workers map
+            # it) BEFORE waking waiters, then keep the partial as the
+            # relay-serving view.
+            desc = self._register_shm_arg(ref.id_bytes, seg, total)
+            part.finish()
+            self._trim_relays()
+            return desc
+        blob = part.finish()
+        self.store.put(ref.id_bytes, blob, cached=True)
+        # Keep serving as a relay while peers are mid-pull — unless the
+        # store's pull cache retained the blob (then it serves).
+        if self.store.size(ref.id_bytes) is not None:
+            with self._partials_lock:
+                if self._partials.get(ref.id_bytes) is part:
+                    del self._partials[ref.id_bytes]
+        else:
+            self._trim_relays()
+        return blob
+
+    def _blob_to_shm(self, key: bytes, blob: bytes | None, part=None):
+        """Assembled-bytes fallback into a shared segment (small
+        objects, plain pulls, non-leader waiters)."""
+        from multiprocessing import shared_memory
+
+        with self._shm_args_lock:
+            existing = self._shm_directory.lookup(key)
+        if existing is not None:
+            return existing
+        if blob is None:
+            with part.lock:
+                blob = bytes(part.buf)
+        seg = shared_memory.SharedMemory(create=True,
+                                         size=max(len(blob), 1))
+        seg.buf[:len(blob)] = blob
+        return self._register_shm_arg(key, seg, len(blob))
+
+    def _register_shm_arg(self, key: bytes, seg, size: int):
+        """Record a worker-mappable segment in the node's shm
+        directory (FIFO-bounded; loser of a concurrent promote race
+        discards its segment)."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        from ray_tpu._private.shm_store import ShmDescriptor
+
+        desc = ShmDescriptor(seg.name, size)
+        evict: list = []
+        with self._shm_args_lock:
+            existing = self._shm_directory.lookup(key)
+            if existing is not None:
+                # Concurrent promote won (no partial references OUR
+                # segment here — leaders are single-flight): discard.
+                try:
+                    seg.unlink()
+                    seg.close()
+                except (OSError, BufferError):
+                    pass
+                return existing
+            self._shm_directory.register(key, desc, seg)
+            self._shm_args_order.append((key, size))
+            self._shm_args_bytes += size
+            limit = int(GLOBAL_CONFIG.node_pull_cache_mb) * 1024 * 1024
+            while self._shm_args_bytes > limit \
+                    and len(self._shm_args_order) > 1:
+                old_key, old_size = self._shm_args_order.pop(0)
+                self._shm_args_bytes -= old_size
+                evict.append(old_key)
+        for old_key in evict:
+            # Relay partials viewing the evicted segment must release
+            # their buffer before the unlink (exported-view safety).
+            with self._partials_lock:
+                old_part = self._partials.pop(old_key, None)
+            if old_part is not None and old_part.external:
+                with old_part.lock:
+                    try:
+                        old_part.buf.release()
+                    except BufferError:
+                        pass
+            self._shm_directory.free(old_key)
+        return desc
+
+    def _pull_chunks(self, ref: FetchRef, part: _PartialBlob,
+                     holders: list[str]) -> None:
+        """Sliding-window parallel chunk fetch across owner + peers.
+
+        Chunk order is rotated by a stable hash of this node's address,
+        so concurrent receivers start in different regions — the owner
+        seeds distinct chunks round-robin and receivers exchange the
+        rest among themselves. Routing is REGION-AWARE: every receiver
+        derives its peers' start offsets from the same hash, so a chunk
+        is requested from the peer that began pulling its region
+        earliest (highest hit probability); misses re-issue to the
+        owner asynchronously — never a window stall."""
+        import zlib
+        from collections import deque
+
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        owner_addr = ref.addr
+        fanout = max(0, int(GLOBAL_CONFIG.broadcast_chunk_fanout))
+        n_chunks = part.n_chunks()
+        my_addr = self.advertised_address
+
+        def peer_starts(addrs: list[str]) -> dict[str, int]:
+            return {a: zlib.crc32(a.encode()) % n_chunks
+                    for a in dict.fromkeys(addrs)
+                    if a and a != my_addr}
+
+        starts = peer_starts(holders[:fanout])
+        start = zlib.crc32(my_addr.encode()) % n_chunks
+        order = list(range(start, n_chunks)) + list(range(start))
+        owner = self._peers.get(owner_addr)
+        depth = _pipeline_depth()
+        pending: deque = deque()
+        completed = 0
+
+        def pick_source(idx: int) -> str:
+            # The peer whose rotated start is closest BEHIND idx pulled
+            # that region first; beyond half a revolution the owner is
+            # the better bet (the peer likely hasn't reached it).
+            best, bestd = owner_addr, n_chunks // 2
+            for src, s in starts.items():
+                d = (idx - s) % n_chunks
+                if d < bestd:
+                    best, bestd = src, d
+            return best
+
+        def issue(idx: int, src: str, retried: bool):
+            length = min(part.chunk, part.total - idx * part.chunk)
+            slot = self._peers.get(src).call_async(
+                "fetch_object", ref.id_bytes, idx * part.chunk, length)
+            pending.append((idx, src, slot, retried))
+
+        it = iter(order)
+        exhausted = False
+        while pending or not exhausted:
+            while not exhausted and len(pending) < depth:
+                try:
+                    idx = next(it)
+                except StopIteration:
+                    exhausted = True
+                    break
+                issue(idx, pick_source(idx), False)
+            if not pending:
+                continue
+            idx, src, slot, retried = pending.popleft()
+            try:
+                reply = slot.result()
+            except (RpcError, RpcMethodError):
+                reply = None
+            if reply is None:
+                if retried or src == owner_addr:
+                    raise KeyError(
+                        f"object {ref.id_bytes.hex()} not present on "
+                        f"{owner_addr}")
+                # Peer miss/death: re-issue to the authoritative owner
+                # WITHOUT blocking the window.
+                issue(idx, owner_addr, True)
+                continue
+            _, data = reply
+            part.write(idx, data)
+            completed += 1
+            if completed % 64 == 0:
+                # Refresh the holder set: pullers that registered after
+                # our plan are fresh relay sources (and this re-leases
+                # our own registration with the owner's directory).
+                try:
+                    plan = owner.call("fetch_plan", ref.id_bytes,
+                                      my_addr)
+                    if plan is not None:
+                        starts = peer_starts(plan[1][:fanout])
+                except (RpcError, RpcMethodError):
+                    pass
+
+    _RELAY_TTL_S = 180.0
+
+    def _sweep_transfer_plane(self) -> None:
+        """Periodic GC for the P2P plane: expired relay copies and
+        stale holder registrations."""
+        import time as _time
+
+        now = _time.monotonic()
+        expired = []
+        with self._partials_lock:
+            for id_bytes in [
+                    i for i, p in self._partials.items()
+                    if p.completed_at is not None
+                    and now - p.completed_at > self._RELAY_TTL_S]:
+                expired.append(self._partials.pop(id_bytes))
+        for part in expired:
+            if part.external:
+                with part.lock:
+                    try:
+                        part.buf.release()
+                    except BufferError:
+                        pass
+        self.chunk_directory.prune()
+
+    def _trim_relays(self) -> None:
+        """Bound completed relay copies by node_relay_cache_mb (oldest
+        finished pulls evicted first; in-progress pulls never are)."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        limit = int(GLOBAL_CONFIG.node_relay_cache_mb) * 1024 * 1024
+        evicted = []
+        with self._partials_lock:
+            finished = sorted(
+                ((id_bytes, p) for id_bytes, p in self._partials.items()
+                 if p.completed_at is not None),
+                key=lambda kv: kv[1].completed_at)
+            total = sum(p.total for _, p in finished)
+            for id_bytes, p in finished:
+                if total <= limit:
+                    break
+                evicted.append(self._partials.pop(id_bytes))
+                total -= p.total
+        for part in evicted:
+            if part.external:
+                with part.lock:
+                    try:
+                        part.buf.release()
+                    except BufferError:
+                        pass
 
     def _run(self, func, digest, func_blob, args, kwargs, n_returns,
              runtime_env, resources, task_token=None,
@@ -1136,10 +1869,13 @@ class RemoteNodeHandle:
         self.ensure_sys_path()
         with self._digest_lock:
             known = digest in self.known_digests
+        # Coalesced: burst submissions to this node share __batch__
+        # frames (one syscall/server wakeup per batch); replies are
+        # still per-call, so nothing head-of-line blocks.
         reply = self.pool.call(
             "execute_task", digest, None if known else func_blob,
             args_blob, n_returns, return_keys, runtime_env, resources,
-            task_token, client_addr)
+            task_token, client_addr, coalesce=True)
         if reply[0] == "need_func":
             # Node restarted / cache miss despite our bookkeeping: send
             # the function ALONE — the node stashed the args from the

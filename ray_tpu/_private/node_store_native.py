@@ -132,6 +132,13 @@ class NativeNodeObjectStore:
             buflen = int(got) * 2
         return []
 
+    def size(self, id_bytes: bytes) -> int | None:
+        """Blob size without copying (transfer-plan probes)."""
+        if self._closed:
+            return None
+        total = self._lib.rt_ns_size(self._handle, self._key(id_bytes))
+        return None if total < 0 else int(total)
+
     def read_chunk(self, id_bytes: bytes, offset: int,
                    length: int) -> tuple[int, "bytearray"] | None:
         # Returns a bytearray (pickles/concatenates like bytes): the

@@ -7,10 +7,16 @@ pickle-over-TCP with an 8-byte length prefix. Pickle is acceptable for
 the same reason the reference ships cloudpickle blobs inside its
 protobufs: cluster links are trusted (same security model).
 
-Server: thread-per-connection, sequential dispatch per connection (the
-reference's gRPC servers are also ordered per stream). Client: one
-socket, calls serialized under a lock, transparent reconnect on a dead
-socket (e.g. head restarted).
+Server: thread-per-connection; registered-concurrent methods dispatch
+off the connection loop (recycled threads / a pooled executor) with
+out-of-order replies, so one connection carries many interleaved calls
+(the gRPC completion-queue shape). Clients:
+
+- ``MuxRpcClient`` — pipelined: seq-tagged frames, a reader thread,
+  per-call futures (``call_async``), and per-destination coalescing of
+  chatty control calls into ``__batch__`` frames.
+- ``RpcClient`` — one call at a time under a lock with a transparent
+  reconnect; kept for short control probes and legacy paths.
 """
 
 from __future__ import annotations
@@ -31,6 +37,21 @@ class RpcError(ConnectionError):
     """Transport-level failure (peer unreachable / connection lost)."""
 
 
+class TailPayload:
+    """Reply wrapper for bulk data: ``head`` is pickled normally,
+    ``tail`` (any buffer) is appended RAW after the pickle inside the
+    same frame — the chunk bytes are never copied through pickle on
+    either side (the zero-copy serve path for fetch_object). The
+    caller receives ``(head, tail_view)`` where tail_view is a
+    memoryview into the received frame buffer."""
+
+    __slots__ = ("head", "tail")
+
+    def __init__(self, head: Any, tail):
+        self.head = head
+        self.tail = tail
+
+
 class RpcMethodError(Exception):
     """The remote method raised; carries the remote traceback."""
 
@@ -48,7 +69,13 @@ class RpcMethodError(Exception):
 
 
 def _send_frame(sock: socket.socket, payload: bytes) -> None:
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+    if len(payload) >= (1 << 16):
+        # Large frames (chunk replies): two sendalls beat concatenating
+        # header+payload into a fresh multi-MB buffer per frame.
+        sock.sendall(_LEN.pack(len(payload)))
+        sock.sendall(payload)
+    else:
+        sock.sendall(_LEN.pack(len(payload)) + payload)
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -66,7 +93,95 @@ def _recv_frame(sock: socket.socket) -> bytes:
     (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     if length > MAX_FRAME:
         raise RpcError(f"frame too large: {length}")
-    return _recv_exact(sock, length)
+    if length <= (1 << 16):
+        return _recv_exact(sock, length)
+    # Large frames: receive straight into one preallocated buffer —
+    # no per-recv chunk list and no final join copy.
+    buf = bytearray(length)
+    view = memoryview(buf)
+    off = 0
+    while off < length:
+        # No artificial cap: recv_into fills whatever the kernel has
+        # ready — fewer syscalls/GIL trips per large frame.
+        got = sock.recv_into(view[off:])
+        if not got:
+            raise RpcError("connection closed by peer")
+        off += got
+    return buf  # bytes-like; every caller feeds it to pickle.loads
+
+
+class _Recycled:
+    """One reusable dispatch thread; parks in its pool's LIFO free list
+    between jobs and expires after an idle timeout."""
+
+    __slots__ = ("_pool", "_event", "_job", "_thread")
+
+    def __init__(self, pool: "_ThreadRecycler"):
+        self._pool = pool
+        self._event = threading.Event()
+        self._job = None
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name=pool.name)
+        self._thread.start()
+
+    def run(self, fn, args) -> None:
+        self._job = (fn, args)
+        self._event.set()
+
+    def _loop(self) -> None:
+        while True:
+            if not self._event.wait(self._pool.idle_s):
+                # Idle expiry — but a submitter may have popped us off
+                # the free list concurrently; in that race the job is
+                # imminent and we must honor it.
+                with self._pool._lock:
+                    try:
+                        self._pool._idle.remove(self)
+                        claimed = False
+                    except ValueError:
+                        claimed = True
+                if not claimed:
+                    return
+                self._event.wait()
+            self._event.clear()
+            fn, args = self._job
+            self._job = None
+            try:
+                fn(*args)
+            except BaseException:  # noqa: BLE001 — match daemon threads
+                traceback.print_exc()
+            with self._pool._lock:
+                self._pool._idle.append(self)
+
+
+class _ThreadRecycler:
+    """Unbounded thread pool with LIFO reuse and idle expiry.
+
+    Same concurrency shape as thread-per-request — growth is unbounded,
+    so queueing can never head-of-line-deadlock a nested call the way a
+    capped executor would — but steady-state request dispatch reuses a
+    parked thread instead of paying a thread spawn per call (reference:
+    gRPC's completion-queue poller threads are long-lived, not
+    per-request)."""
+
+    def __init__(self, name: str, idle_s: float = 10.0):
+        self.name = name
+        self.idle_s = idle_s
+        self._lock = threading.Lock()
+        self._idle: list[_Recycled] = []
+
+    def submit(self, fn, *args) -> None:
+        with self._lock:
+            worker = self._idle.pop() if self._idle else None
+        if worker is None:
+            worker = _Recycled(self)
+        worker.run(fn, args)
+
+
+# Shared by RPC servers (concurrent method dispatch) and the driver's
+# remote-task launch path: at thousands of short calls per second the
+# per-call thread spawn is a measurable fraction of the work.
+DISPATCH_POOL = _ThreadRecycler("rpc-dispatch")
 
 
 class RpcServer:
@@ -155,20 +270,28 @@ class RpcServer:
                 except RpcError:
                     return
                 seq, method, args, kwargs = pickle.loads(frame)
-                mode = self._concurrent.get(method)
-                if mode == "pooled":
-                    self._get_io_pool().submit(
-                        self._handle_one, conn, send_lock, seq, method,
-                        args, kwargs)
+                if method == "__batch__":
+                    # Coalesced frame: many independently seq-tagged
+                    # calls in one frame; each entry dispatches per its
+                    # own method's concurrency mode and replies with its
+                    # own seq — no batch-level reply exists.
+                    for bseq, blob in args[0]:
+                        try:
+                            bmethod, bargs, bkwargs = pickle.loads(blob)
+                        except Exception as exc:  # noqa: BLE001
+                            if not self._reply(conn, send_lock, (
+                                    bseq, "err",
+                                    (RuntimeError(
+                                        f"bad batch entry: {exc!r}"),
+                                     ""))):
+                                return
+                            continue
+                        if not self._dispatch(conn, send_lock, bseq,
+                                              bmethod, bargs, bkwargs):
+                            return
                     continue
-                if mode is not None:
-                    threading.Thread(
-                        target=self._handle_one,
-                        args=(conn, send_lock, seq, method, args, kwargs),
-                        daemon=True, name=f"rpc-{method}").start()
-                    continue
-                if not self._handle_one(conn, send_lock, seq, method,
-                                        args, kwargs):
+                if not self._dispatch(conn, send_lock, seq, method,
+                                      args, kwargs):
                     return
         finally:
             try:
@@ -181,6 +304,65 @@ class RpcServer:
                 except ValueError:
                     pass
 
+    def _dispatch(self, conn, send_lock, seq, method, args,
+                  kwargs) -> bool:
+        """Route one decoded call per its method's concurrency mode.
+        Returns False when the connection must be torn down."""
+        mode = self._concurrent.get(method)
+        if mode == "pooled":
+            self._get_io_pool().submit(
+                self._handle_one, conn, send_lock, seq, method,
+                args, kwargs)
+            return True
+        if mode is not None:
+            # Recycled threads: same unbounded thread-per-request shape
+            # (no queueing deadlocks for nested calls), without a thread
+            # spawn per call.
+            DISPATCH_POOL.submit(
+                self._handle_one, conn, send_lock, seq, method, args,
+                kwargs)
+            return True
+        return self._handle_one(conn, send_lock, seq, method, args,
+                                kwargs)
+
+    def _send_tail(self, conn, send_lock, seq,
+                   result: TailPayload) -> bool:
+        """Emit a tail frame: [len][pickled (seq,'tail',(head,n))][raw
+        tail bytes] — the payload crosses the socket straight from the
+        server's buffer, no pickle memcpy on either side."""
+        tail = result.tail if isinstance(result.tail, memoryview) \
+            else memoryview(result.tail)
+        head_blob = pickle.dumps((seq, "tail", (result.head,
+                                                tail.nbytes)))
+        try:
+            with send_lock:
+                conn.sendall(_LEN.pack(len(head_blob) + tail.nbytes))
+                conn.sendall(head_blob)
+                conn.sendall(tail)
+            return True
+        except OSError:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return False
+
+    def _reply(self, conn, send_lock, reply) -> bool:
+        try:
+            blob = pickle.dumps(reply)
+        except BaseException:  # noqa: BLE001
+            return False
+        try:
+            with send_lock:
+                _send_frame(conn, blob)
+            return True
+        except OSError:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return False
+
     def _handle_one(self, conn, send_lock, seq, method, args,
                     kwargs) -> bool:
         try:
@@ -189,7 +371,10 @@ class RpcServer:
             reply = (seq, "err", (KeyError(f"no method {method}"), ""))
         else:
             try:
-                reply = (seq, "ok", fn(*args, **kwargs))
+                result = fn(*args, **kwargs)
+                if isinstance(result, TailPayload):
+                    return self._send_tail(conn, send_lock, seq, result)
+                reply = (seq, "ok", result)
             except BaseException as exc:  # noqa: BLE001
                 tb = traceback.format_exc()
                 try:
@@ -249,12 +434,41 @@ class RpcServer:
 
 
 class _MuxSlot:
-    __slots__ = ("event", "reply", "error")
+    """One in-flight pipelined call: a future the reader thread (or a
+    connection failure) resolves. ``conn`` is None while the call sits
+    in the coalescing queue, set once it is bound to a live socket."""
 
-    def __init__(self):
+    __slots__ = ("event", "reply", "error", "client", "conn", "seq",
+                 "method")
+
+    def __init__(self, client: "MuxRpcClient", method: str):
         self.event = threading.Event()
         self.reply = None
         self.error: BaseException | None = None
+        self.client = client
+        self.conn: "_MuxConn | None" = None
+        self.seq = 0
+        self.method = method
+
+    def done(self) -> bool:
+        return self.event.is_set()
+
+    def result(self, timeout_s: float | None = None) -> Any:
+        client = self.client
+        if not self.event.wait(timeout_s if timeout_s is not None
+                               else client._timeout):
+            client._abandon(self)
+            raise RpcError(
+                f"rpc {self.method} to {client.address} timed out")
+        if self.error is not None:
+            raise RpcError(
+                f"rpc {self.method} to {client.address} failed "
+                f"(may have executed): {self.error}") from self.error
+        status, payload = self.reply
+        if status == "err":
+            exc, tb = payload
+            raise RpcMethodError(exc, tb)
+        return payload
 
 
 class _MuxConn:
@@ -297,6 +511,14 @@ class MuxRpcClient:
         self._conn: _MuxConn | None = None
         self._seq = 0
         self._closed = False
+        # Coalescing queue: (slot, pre-pickled entry) pairs a flusher
+        # thread packs into __batch__ frames — many control calls per
+        # frame/syscall under bursts, zero added latency when idle
+        # (natural batching: entries accumulate only while a previous
+        # flush is in progress, plus the optional configured linger).
+        self._batch_pending: list = []
+        self._batch_event = threading.Event()
+        self._batch_thread: threading.Thread | None = None
 
     def _ensure_conn(self) -> _MuxConn:
         # Caller holds self._lock.
@@ -313,8 +535,23 @@ class MuxRpcClient:
         return self._conn
 
     def call(self, method: str, *args, timeout_s: float | None = None,
-             **kwargs) -> Any:
-        slot = _MuxSlot()
+             coalesce: bool = False, **kwargs) -> Any:
+        return self.call_async(
+            method, *args, coalesce=coalesce, **kwargs).result(timeout_s)
+
+    def call_async(self, method: str, *args, coalesce: bool = False,
+                   **kwargs) -> _MuxSlot:
+        """Issue a pipelined call and return its future immediately.
+
+        ``coalesce=True`` routes the call through the per-destination
+        batching queue: it rides a shared __batch__ frame with whatever
+        else is pending to this address (the right choice for chatty
+        control messages — task submission, actor registration/calls);
+        replies stay per-call. Latency-sensitive chunk fetches should
+        keep the direct path."""
+        if coalesce:
+            return self._submit_coalesced(method, args, kwargs)
+        slot = _MuxSlot(self, method)
         with self._lock:
             if self._closed:
                 raise RpcError(f"client to {self.address} is closed")
@@ -324,14 +561,15 @@ class MuxRpcClient:
                 raise RpcError(
                     f"cannot connect to {self.address}: {exc}") from exc
             self._seq += 1
-            seq = self._seq
+            slot.seq = self._seq
         # Pickle BEFORE registering the slot: an unpicklable argument
         # must raise cleanly, not leak a pending entry per attempt.
-        request = pickle.dumps((seq, method, args, kwargs))
+        request = pickle.dumps((slot.seq, method, args, kwargs))
         with self._lock:
             if self._closed:
                 raise RpcError(f"client to {self.address} is closed")
-            conn.pending[seq] = slot
+            slot.conn = conn
+            conn.pending[slot.seq] = slot
         try:
             with self._send_lock:
                 _send_frame(conn.sock, request)
@@ -339,21 +577,130 @@ class MuxRpcClient:
             self._fail_conn(conn, exc)
             raise RpcError(
                 f"rpc {method} to {self.address} failed: {exc}") from exc
-        if not slot.event.wait(timeout_s if timeout_s is not None
-                               else self._timeout):
+        return slot
+
+    def _abandon(self, slot: _MuxSlot) -> None:
+        """A caller gave up on the slot (timeout): unregister it so the
+        pending table (or coalescing queue) doesn't leak the entry."""
+        with self._lock:
+            if slot.conn is not None:
+                slot.conn.pending.pop(slot.seq, None)
+            else:
+                self._batch_pending = [
+                    (s, b) for s, b in self._batch_pending if s is not slot]
+
+    # -- coalescing -------------------------------------------------------
+
+    def _submit_coalesced(self, method: str, args, kwargs) -> _MuxSlot:
+        # Per-entry pickling happens on the caller's thread: a bad
+        # argument fails ITS caller, never poisons batch-mates.
+        blob = pickle.dumps((method, args, kwargs))
+        slot = _MuxSlot(self, method)
+        with self._lock:
+            if self._closed:
+                raise RpcError(f"client to {self.address} is closed")
+            # Adaptive: an UNCONTENDED socket with nothing queued sends
+            # immediately (a steady trickle pays zero batching tax);
+            # under contention — a writer mid-frame, i.e. a burst —
+            # entries queue and ride shared frames. Queue-empty is
+            # required for the direct path so per-destination enqueue
+            # order is never reordered around queued entries.
+            direct = (not self._batch_pending
+                      and self._send_lock.acquire(blocking=False))
+            if direct:
+                try:
+                    conn = self._ensure_conn()
+                    self._seq += 1
+                    slot.seq = self._seq
+                    slot.conn = conn
+                    conn.pending[slot.seq] = slot
+                except OSError as exc:
+                    self._send_lock.release()
+                    raise RpcError(
+                        f"cannot connect to {self.address}: "
+                        f"{exc}") from exc
+            else:
+                self._batch_pending.append((slot, blob))
+                if self._batch_thread is None:
+                    self._batch_thread = threading.Thread(
+                        target=self._flush_loop, daemon=True,
+                        name="mux-rpc-flusher")
+                    self._batch_thread.start()
+        if not direct:
+            self._batch_event.set()
+            return slot
+        frame = pickle.dumps((0, "__batch__", (((slot.seq, blob),),),
+                              {}))
+        try:
+            _send_frame(conn.sock, frame)
+        except OSError as exc:
+            self._send_lock.release()
+            self._fail_conn(conn, exc)
+            return slot
+        self._send_lock.release()
+        return slot
+
+    @staticmethod
+    def _batch_limits() -> tuple[float, int]:
+        try:
+            from ray_tpu._private.config import GLOBAL_CONFIG
+
+            return (float(GLOBAL_CONFIG.rpc_batch_flush_ms) / 1000.0,
+                    int(GLOBAL_CONFIG.rpc_batch_max_entries))
+        except Exception:  # noqa: BLE001 — config unavailable mid-teardown
+            return 0.0, 128
+
+    def _flush_loop(self) -> None:
+        import time as time_mod
+
+        while True:
+            self._batch_event.wait()
+            linger, max_entries = self._batch_limits()
+            if linger > 0:
+                time_mod.sleep(linger)
             with self._lock:
-                conn.pending.pop(seq, None)
-            raise RpcError(
-                f"rpc {method} to {self.address} timed out")
-        if slot.error is not None:
-            raise RpcError(
-                f"rpc {method} to {self.address} failed "
-                f"(may have executed): {slot.error}") from slot.error
-        status, payload = slot.reply
-        if status == "err":
-            exc, tb = payload
-            raise RpcMethodError(exc, tb)
-        return payload
+                self._batch_event.clear()
+                pending, self._batch_pending = self._batch_pending, []
+                closed = self._closed
+            if closed:
+                for slot, _ in pending:
+                    slot.error = RpcError("client closed")
+                    slot.event.set()
+                return
+            while pending:
+                self._flush_batch(pending[:max_entries])
+                pending = pending[max_entries:]
+
+    def _flush_batch(self, pending: list) -> None:
+        with self._lock:
+            if self._closed:
+                conn = None
+            else:
+                try:
+                    conn = self._ensure_conn()
+                except OSError as exc:
+                    conn = None
+                    error: BaseException = exc
+            if conn is None:
+                if self._closed:
+                    error = RpcError("client closed")
+                for slot, _ in pending:
+                    slot.error = error
+                    slot.event.set()
+                return
+            entries = []
+            for slot, blob in pending:
+                self._seq += 1
+                slot.seq = self._seq
+                slot.conn = conn
+                conn.pending[slot.seq] = slot
+                entries.append((slot.seq, blob))
+        frame = pickle.dumps((0, "__batch__", (entries,), {}))
+        try:
+            with self._send_lock:
+                _send_frame(conn.sock, frame)
+        except OSError as exc:
+            self._fail_conn(conn, exc)
 
     def _reader_loop(self, conn: _MuxConn) -> None:
         while True:
@@ -363,7 +710,14 @@ class MuxRpcClient:
                 self._fail_conn(conn, exc)
                 return
             try:
+                # Tail frames carry raw payload bytes after the pickle;
+                # loads ignores the trailing data.
                 seq, status, payload = pickle.loads(frame)
+                if status == "tail":
+                    head, tail_len = payload
+                    status = "ok"
+                    payload = (head, memoryview(frame)[-tail_len:]
+                               if tail_len else b"")
             except Exception as exc:  # noqa: BLE001 — corrupt stream
                 self._fail_conn(conn, exc)
                 return
@@ -406,12 +760,14 @@ class MuxRpcClient:
             pending = list(conn.pending.values()) if conn else []
             if conn:
                 conn.pending.clear()
+            queued, self._batch_pending = self._batch_pending, []
+        self._batch_event.set()  # flusher observes _closed and exits
         if conn is not None:
             try:
                 conn.sock.close()
             except OSError:
                 pass
-        for slot in pending:
+        for slot in pending + [s for s, _ in queued]:
             slot.error = RpcError("client closed")
             slot.event.set()
 
@@ -477,8 +833,13 @@ class RpcClient:
                         self._sock = self._connect()
                     _send_frame(self._sock, request)
                     sent = True
-                    rseq, status, payload = pickle.loads(
-                        _recv_frame(self._sock))
+                    frame = _recv_frame(self._sock)
+                    rseq, status, payload = pickle.loads(frame)
+                    if status == "tail":
+                        head, tail_len = payload
+                        status = "ok"
+                        payload = (head, memoryview(frame)[-tail_len:]
+                                   if tail_len else b"")
                     if rseq != seq:
                         raise RpcError(
                             f"out-of-order reply: {rseq} != {seq}")

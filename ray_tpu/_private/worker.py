@@ -125,9 +125,14 @@ class Runtime:
         self._node_agent = None
         if address:
             from ray_tpu._private.node import NodeAgent
-            from ray_tpu._private.rpc import RpcClient, RpcError
+            from ray_tpu._private.rpc import MuxRpcClient, RpcError
 
-            self.gcs_client = RpcClient(address)
+            # Pipelined head-GCS client: the watcher's long-poll sync,
+            # location flushes, named-actor publication and KV traffic
+            # ride one socket concurrently instead of serializing under
+            # a per-call lock (reference: gRPC channels multiplex every
+            # GCS service call).
+            self.gcs_client = MuxRpcClient(address, timeout_s=60.0)
             try:
                 self._node_agent = NodeAgent(
                     address,
@@ -152,6 +157,11 @@ class Runtime:
         self.dispatcher = Dispatcher(self.cluster, self.store)
         self.placement_groups = PlacementGroupManager(self.cluster, self.store)
         self._actors: dict[ActorID, LocalActor] = {}
+        # Signalled whenever an actor lands in _actors: submit queues
+        # block on it instead of spin-polling (hundreds of concurrent
+        # creations would otherwise busy-wake the GIL thousands of
+        # times a second).
+        self._actors_changed = threading.Condition()
         self._actor_queues: dict[ActorID, Any] = {}
         self._foreign_proxies: dict[tuple[str, str], Any] = {}
         self._actor_leases: dict[ActorID, tuple[NodeID, dict, Any]] = {}
@@ -271,11 +281,15 @@ class Runtime:
         self._remote_nodes: dict[NodeID, Any] = {}
         self._remote_nodes_lock = threading.Lock()
         self._remote_ever: set[NodeID] = set()
+        # node -> consecutive absent-but-pinging sync passes (only the
+        # watcher thread touches it; bounded by node_amnesia_max_passes).
+        self._amnesia_misses: dict[NodeID, int] = {}
         self._remote_free_queue: list[tuple[NodeID, bytes]] = []
         self._remote_free_lock = threading.Lock()
         self._watcher_stop = threading.Event()
         self._node_watcher = None
         self._export_store = None
+        self._export_directory = None
         self._obj_server = None
         self._export_addr = ""
         self._pkg_hashes: dict[str, str] = {}
@@ -335,14 +349,24 @@ class Runtime:
             # object manager — args are objects nodes fetch, not
             # payloads inlined per task).
             from ray_tpu._private.node import _own_address
-            from ray_tpu._private.node_executor import NodeObjectStore
+            from ray_tpu._private.node_executor import (
+                ChunkDirectory,
+                NodeObjectStore,
+            )
             from ray_tpu._private.rpc import RpcServer
 
             self._export_store = NodeObjectStore()
+            self._export_directory = ChunkDirectory()
             self._obj_server = RpcServer(host="0.0.0.0", port=0)
             self._obj_server.register("ping", lambda: "pong")
+            # Pooled: pipelined chunk pulls from many nodes interleave
+            # instead of serializing on each connection's serve loop.
             self._obj_server.register(
-                "fetch_object", self._export_store.read_chunk)
+                "fetch_object", self._export_fetch_object,
+                concurrent="pooled")
+            self._obj_server.register(
+                "fetch_plan", self._export_fetch_plan,
+                concurrent="pooled")
             self._obj_server.start()
             self._export_addr = \
                 f"{_own_address()}:{self._obj_server.port}"
@@ -352,6 +376,28 @@ class Runtime:
             self._node_watcher.start()
 
     # ------------------------------------------------------ remote exec plane
+
+    def _export_fetch_object(self, id_bytes: bytes, offset: int,
+                             length: int):
+        from ray_tpu._private.node_executor import wrap_chunk_reply
+
+        reply = self._export_store.read_chunk(id_bytes, offset, length)
+        return None if reply is None else wrap_chunk_reply(reply)
+
+    def _export_fetch_plan(self, id_bytes: bytes,
+                           puller_addr: str | None = None):
+        """Transfer plan for a driver-exported object: (size, holders).
+        Registers the puller so the NEXT puller fetches chunks from it
+        too — the driver seeds a broadcast once and receivers relay
+        (reference: the owner hands out locations via the object
+        directory; data flows node-to-node)."""
+        from ray_tpu._private.node_executor import plan_holders
+
+        total = self._export_store.size(id_bytes)
+        if total is None:
+            return None
+        return (total, plan_holders(
+            self._export_directory, id_bytes, puller_addr, total))
 
     def _watch_remote_nodes(self) -> None:
         """Mirror the head's node table into ClusterState, reacting to
@@ -449,7 +495,12 @@ class Runtime:
         # a freshly restarted head starts with an empty table, and the
         # daemon (which keeps its node id across head restarts) may
         # simply not have re-registered yet — its in-flight work is
-        # alive and must not be failed by head amnesia.
+        # alive and must not be failed by head amnesia. The grace is
+        # BOUNDED: a daemon that pings but stays absent from the head's
+        # table past node_amnesia_max_passes consecutive sync passes is
+        # partitioned from the control plane (it cannot re-register) —
+        # keeping it schedulable forever would strand its results
+        # outside the directory, so it is dropped like a dead node.
         with self._remote_nodes_lock:
             known = dict(self._remote_nodes)
         alive_addrs = {info["executor_address"] for nid, info
@@ -466,6 +517,8 @@ class Runtime:
                 self._drop_remote_node(node_id)
             elif info is None:
                 amnesia_candidates.append((node_id, handle))
+            else:
+                self._amnesia_misses.pop(node_id, None)
         if amnesia_candidates:
             # Direct-ping grace pings run CONCURRENTLY: after a head
             # restart with many genuinely dead daemons, serial 5s ping
@@ -473,14 +526,19 @@ class Runtime:
             # handles keep receiving (and failing) dispatches.
             from concurrent.futures import ThreadPoolExecutor
 
+            max_passes = max(1, int(GLOBAL_CONFIG.node_amnesia_max_passes))
             with ThreadPoolExecutor(
                     max_workers=min(8, len(amnesia_candidates))) as tpe:
                 alive_flags = list(tpe.map(
                     lambda nh: nh[1].ping(), amnesia_candidates))
             for (node_id, _), is_alive in zip(amnesia_candidates,
                                               alive_flags):
-                if not is_alive:
+                misses = self._amnesia_misses.get(node_id, 0) + 1
+                if not is_alive or misses > max_passes:
+                    self._amnesia_misses.pop(node_id, None)
                     self._drop_remote_node(node_id)
+                else:
+                    self._amnesia_misses[node_id] = misses
 
         for node_id, info in listed.items():
             if not info["alive"]:
@@ -1214,6 +1272,8 @@ class Runtime:
                 self._loc_dirty_adds.pop(object_id.hex(), None)
         if self._export_store is not None:
             self._export_store.free([object_id.binary()])
+        if self._export_directory is not None:
+            self._export_directory.drop([object_id.binary()])
         if node_id is not None:
             # Remote primary copy: tell the holder to drop it (owner-
             # driven GC — batched by the node watcher). Queue even when
@@ -1547,7 +1607,9 @@ class Runtime:
                     max_pending_calls=max_pending_calls,
                     creation_return_id=creation_rid, on_death=on_death,
                     on_restart=on_restart)
-            self._actors[actor_id] = actor
+            with self._actors_changed:
+                self._actors[actor_id] = actor
+                self._actors_changed.notify_all()
             record.handle = actor
             self._record_actor_placement(record, actor, node_id)
             self.gcs.update_actor_state(actor_id, "ALIVE")
@@ -1597,15 +1659,20 @@ class Runtime:
         def drain():
             while True:
                 call = submit_queue.get()
-                # Wait for the actor to come alive (or die trying).
+                # Wait for the actor to come alive (or die trying):
+                # condition-signalled by start_actor, with a periodic
+                # timeout to notice DEAD records.
                 actor = self._actors.get(actor_id)
                 deadline = time.monotonic() + 300.0
                 while actor is None and time.monotonic() < deadline:
                     rec = self.gcs.get_actor(actor_id)
                     if rec is None or rec.state == "DEAD":
                         break
-                    time.sleep(0.002)
-                    actor = self._actors.get(actor_id)
+                    with self._actors_changed:
+                        actor = self._actors.get(actor_id)
+                        if actor is None:
+                            self._actors_changed.wait(0.25)
+                            actor = self._actors.get(actor_id)
                 if actor is None:
                     err = ActorDiedError(actor_id, "actor failed to start")
                     for rid in call.return_ids:

@@ -27,7 +27,7 @@ from typing import Any, Sequence
 from ray_tpu._private import serialization
 from ray_tpu._private.ids import ActorID, ObjectID
 from ray_tpu._private.object_ref import ObjectRef
-from ray_tpu._private.rpc import RpcClient
+from ray_tpu._private.rpc import MuxRpcClient
 
 # Set by the pool worker's serve loop around each task execution; rides
 # along on blocking get/wait RPCs for driver-side CPU release.
@@ -216,7 +216,12 @@ class WorkerModeRuntime:
     _POLL_S = 10.0
 
     def __init__(self, address: str):
-        self._rpc = RpcClient(address, timeout_s=60.0)
+        # Pipelined: the reaper thread's borrow flushes/keepalives and
+        # release RPCs interleave with a long-poll get() in flight on
+        # the main thread instead of queueing behind it for up to the
+        # whole poll window (reference: every worker's CoreWorker holds
+        # one multiplexed connection to its raylet/owner).
+        self._rpc = MuxRpcClient(address, timeout_s=60.0)
         # Stable per-process borrower identity: the owner's pin on a
         # borrowed object is keyed by it, so two worker processes
         # borrowing the same ref release independently.

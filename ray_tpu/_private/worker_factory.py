@@ -64,7 +64,7 @@ def _recv_msg(sock: socket.socket):
 # --------------------------------------------------------------------------
 
 
-def _child_exec(req: dict) -> None:
+def _child_exec(req: dict, pipe_fd: int | None = None) -> None:
     """Post-fork setup then the normal worker serve loop. Never returns."""
     rc = 1
     try:
@@ -97,13 +97,22 @@ def _child_exec(req: dict) -> None:
         os.dup2(fd, 1)
         os.dup2(fd, 2)
         os.close(fd)
-        from multiprocessing.connection import Client
-
         from ray_tpu._private.worker_pool import worker_main
 
-        authkey = bytes.fromhex(req["authkey"])
         os.environ.pop("RAY_TPU_WORKER_AUTHKEY", None)
-        conn = Client(req["addr"], family="AF_UNIX", authkey=authkey)
+        if pipe_fd is not None:
+            # Kernel-passed socketpair end (SCM_RIGHTS through the
+            # factory): possession IS the authentication — no listener
+            # accept, no HMAC challenge round-trips.
+            from multiprocessing.connection import Connection
+
+            conn = Connection(pipe_fd)
+        else:
+            from multiprocessing.connection import Client
+
+            authkey = bytes.fromhex(req["authkey"])
+            conn = Client(req["addr"], family="AF_UNIX",
+                          authkey=authkey)
         worker_main(conn)
         rc = 0
     except BaseException:  # noqa: BLE001 — log to the worker's own log
@@ -117,12 +126,19 @@ def _child_exec(req: dict) -> None:
 def factory_main(sock_path: str, parent_pid: int) -> None:
     # Pre-import the worker stack ONCE; every fork shares these pages.
     # Workers are CPU processes (the daemon owns the TPU), so importing
-    # jax here is safe and saves each fork its heaviest import.
+    # jax here is safe and saves each fork its heaviest import. Lean
+    # mode (RAY_TPU_FACTORY_LEAN=1) skips the jax preimport: forks of a
+    # small template fault far fewer copy-on-write pages, which is the
+    # difference between ~40ms and ~15ms per actor/worker spawn on
+    # 1-core hosts — workloads whose workers never touch jax (control-
+    # plane actors, pure-python tasks) should set it.
     import ray_tpu._private.worker_pool  # noqa: F401
-    try:
-        import jax  # noqa: F401
-    except Exception:  # noqa: BLE001 — workers that need it will retry
-        pass
+    if os.environ.get("RAY_TPU_FACTORY_LEAN",
+                      "0").lower() in ("", "0", "false", "no"):
+        try:
+            import jax  # noqa: F401
+        except Exception:  # noqa: BLE001 — workers will import lazily
+            pass
 
     server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     server.bind(sock_path)
@@ -146,7 +162,18 @@ def factory_main(sock_path: str, parent_pid: int) -> None:
             continue
         except OSError:
             break
+        pipe_fd: int | None = None
         try:
+            # Every request starts with a 2-byte marker; b"FD" carries
+            # the worker's pre-connected pipe end as ancillary data.
+            marker, fds, _, _ = socket.recv_fds(conn, 2, 1)
+            while len(marker) < 2:
+                more = conn.recv(2 - len(marker))
+                if not more:
+                    raise EOFError("factory peer closed")
+                marker += more
+            if marker == b"FD" and fds:
+                pipe_fd = fds[0]
             req = _recv_msg(conn)
             if req.get("op") == "exit":
                 _send_msg(conn, {"ok": True})
@@ -155,7 +182,7 @@ def factory_main(sock_path: str, parent_pid: int) -> None:
             if pid == 0:
                 server.close()
                 conn.close()
-                _child_exec(req)  # never returns
+                _child_exec(req, pipe_fd)  # never returns
             _send_msg(conn, {"ok": True, "pid": pid})
         except BaseException as exc:  # noqa: BLE001 — keep serving
             try:
@@ -163,6 +190,11 @@ def factory_main(sock_path: str, parent_pid: int) -> None:
             except OSError:
                 pass
         finally:
+            if pipe_fd is not None:
+                try:
+                    os.close(pipe_fd)  # the child inherited its copy
+                except OSError:
+                    pass
             try:
                 conn.close()
             except OSError:
@@ -228,8 +260,33 @@ class PidHandle:
 
         readable, _, _ = select.select([self._pidfd], [], [], 0)
         if readable:
-            self._rc = -1
+            self._rc = self._exit_status()
         return self._rc
+
+    def _exit_status(self) -> int:
+        """Recover the worker's REAL exit status where the kernel
+        allows it: waitid(P_PIDFD, WEXITED|WNOWAIT) reads the status
+        without consuming it (the factory is the reaping parent, and
+        on same-process children a later wait must still succeed).
+        Falls back to -1 — 'exited, status unknown' — when the kernel
+        predates P_PIDFD or the process was already reaped by the
+        factory (waitid is parent-only)."""
+        try:
+            p_pidfd = os.P_PIDFD  # Python 3.9+/Linux 5.4+
+        except AttributeError:
+            return -1
+        try:
+            res = os.waitid(p_pidfd, self._pidfd,
+                            os.WEXITED | os.WNOWAIT | os.WNOHANG)
+        except (ChildProcessError, OSError):
+            return -1  # not our child / already reaped
+        if res is None:
+            return -1  # raced: readable but not yet waitable
+        if res.si_code == os.CLD_EXITED:
+            return res.si_status
+        # Killed by signal: report the negated signal number, matching
+        # subprocess.Popen.returncode semantics.
+        return -res.si_status
 
     def wait(self, timeout: float | None = None) -> int:
         import subprocess
@@ -291,13 +348,23 @@ class WorkerFactory:
     def compatible(self, env: dict) -> bool:
         return import_sensitive_subset(env) == self.baseline_sensitive
 
-    def spawn(self, *, addr: str, authkey_hex: str, env: dict,
+    def spawn(self, *, addr: str | None = None,
+              authkey_hex: str | None = None, env: dict,
               cwd: str | None, log_path: str | None,
+              pipe_fd: int | None = None,
               timeout_s: float = 20.0) -> PidHandle:
+        """Fork one worker. ``pipe_fd`` (preferred) ships a connected
+        socketpair end to the child over SCM_RIGHTS — no listener
+        accept or auth handshake; ``addr``/``authkey_hex`` keep the
+        connect-back path for callers without fd passing."""
         conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         conn.settimeout(timeout_s)
         try:
             conn.connect(self.sock_path)
+            if pipe_fd is not None:
+                socket.send_fds(conn, [b"FD"], [pipe_fd])
+            else:
+                conn.sendall(b"NO")
             _send_msg(conn, {"op": "spawn", "addr": addr,
                              "authkey": authkey_hex, "env": env,
                              "cwd": cwd, "log_path": log_path})
@@ -317,6 +384,7 @@ class WorkerFactory:
             conn = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             conn.settimeout(2.0)
             conn.connect(self.sock_path)
+            conn.sendall(b"NO")  # marker: no fd rides this request
             _send_msg(conn, {"op": "exit"})
             conn.close()
         except OSError:

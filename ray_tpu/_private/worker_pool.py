@@ -632,10 +632,31 @@ def _spawn_worker(name: str, extra_env: dict | None = None,
             # Workers whose env demands different jax/XLA import-time
             # config than the template booted with can't fork — the
             # already-imported jax would silently ignore it.
-            proc = factory.spawn(
-                addr=addr, authkey_hex=authkey.hex(), env=env,
-                cwd=os.getcwd(), log_path=log_path) \
-                if factory.compatible(env) else None
+            if factory.compatible(env):
+                # Fast path: a socketpair end rides SCM_RIGHTS through
+                # the factory into the fork — the whole Listener/
+                # accept/HMAC-challenge handshake disappears from the
+                # spawn critical path.
+                import socket as socket_mod
+                from multiprocessing.connection import Connection
+
+                parent_sock, child_sock = socket_mod.socketpair(
+                    socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+                try:
+                    proc = factory.spawn(
+                        env=env, cwd=os.getcwd(), log_path=log_path,
+                        pipe_fd=child_sock.fileno())
+                finally:
+                    child_sock.close()
+                if proc is not None:
+                    conn = Connection(parent_sock.detach())
+                    listener.close()
+                    try:
+                        os.unlink(addr)
+                    except FileNotFoundError:
+                        pass
+                    conn.send(("hello", list(sys.path)))
+                    return proc, conn
         except Exception:  # noqa: BLE001 — Popen path still works
             import logging
 

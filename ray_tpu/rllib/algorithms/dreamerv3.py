@@ -455,6 +455,14 @@ class DreamerV3(Algorithm):
             self._collect(
                 (cfg.prefill_steps - self._replay.size + cfg.num_envs - 1)
                 // cfg.num_envs)
+        # Prefill is counted in TOTAL transitions, but sampling needs
+        # per-LANE depth: with many envs, prefill_steps can be met with
+        # only a handful of rows per lane — fewer than seq_len — and
+        # sample_sequences would raise on the first update. Top up until
+        # every lane holds a full BPTT window.
+        min_rows = cfg.seq_len + 1
+        if self._replay.filled < min_rows:
+            self._collect(min_rows - self._replay.filled)
         metrics: dict = {}
         for _ in range(cfg.updates_per_iteration):
             self._collect(max(1, cfg.env_steps_per_update

@@ -48,8 +48,11 @@ class ClientServer:
         s = self._server
         s.register("ping", lambda: "pong")
         s.register("client_put", self.put)
-        s.register("client_get", self.get)
-        s.register("client_wait", self.wait)
+        # Long-polls dispatch off the connection loop: a pipelined
+        # proxy (worker_client's MuxRpcClient) interleaves borrow
+        # flushes and releases with a blocking get on one socket.
+        s.register("client_get", self.get, concurrent=True)
+        s.register("client_wait", self.wait, concurrent=True)
         s.register("client_task", self.task)
         s.register("client_create_actor", self.create_actor)
         s.register("client_actor_call", self.actor_call)

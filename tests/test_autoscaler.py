@@ -124,6 +124,7 @@ def test_infeasible_demand_not_launched(small_runtime):
         scaler.shutdown()
 
 
+@pytest.mark.slow  # long-running; excluded from the tier-1 gate (-m 'not slow')
 def test_autoscaler_launches_real_daemons_on_demand():
     """LocalDaemonNodeProvider: pending demand launches a REAL worker
     daemon process against the head; idle timeout terminates it

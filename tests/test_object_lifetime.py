@@ -334,6 +334,7 @@ def test_two_borrowers_release_independently(borrow_cluster):
     assert ray_tpu.get(b.read.remote(), timeout=60) == 64 * 64 * 2.0
 
 
+@pytest.mark.slow  # long-running; excluded from the tier-1 gate (-m 'not slow')
 def test_dead_borrower_lease_expires(monkeypatch):
     """A borrower killed without releasing must not pin the object
     forever: borrow claims are leases kept alive by worker keepalives,

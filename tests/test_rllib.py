@@ -465,6 +465,7 @@ def test_sac_tanh_logp_matches_numerical():
                                rtol=1e-3, atol=1e-3)
 
 
+@pytest.mark.slow  # long-running; excluded from the tier-1 gate (-m 'not slow')
 def test_sac_learns_pendulum():
     from ray_tpu.rllib import SACConfig
 
@@ -733,6 +734,7 @@ def test_cql_requires_offline_input(ray_start_regular):
         CQLConfig().environment("Pendulum-v1").build()
 
 
+@pytest.mark.slow  # long-running; excluded from the tier-1 gate (-m 'not slow')
 def test_impala_runners_on_cluster_daemons():
     """IMPALA with rollout runners as REMOTE actors on worker daemons:
     batches flow daemon -> driver learner through the distributed
@@ -986,6 +988,7 @@ def test_td3_delayed_actor_and_target_updates():
     algo.cleanup()
 
 
+@pytest.mark.slow  # long-running; excluded from the tier-1 gate (-m 'not slow')
 def test_td3_learns_pendulum():
     from ray_tpu.rllib import TD3Config
 

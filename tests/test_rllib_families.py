@@ -310,6 +310,7 @@ def test_recurrent_env_runner_emits_state():
     assert np.abs(b2["state_in"]).sum() > 0
 
 
+@pytest.mark.slow  # long-running; excluded from the tier-1 gate (-m 'not slow')
 def test_r2d2_learns_cartpole():
     from ray_tpu.rllib import R2D2Config
 

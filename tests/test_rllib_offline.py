@@ -149,3 +149,23 @@ def test_dreamerv3_improves_on_cartpole():
             best = max(best, ret)
     assert best > max(60.0, (first or 0) + 30), (
         f"policy did not improve: first={first}, best={best}")
+
+
+def test_dreamerv3_large_num_envs_prefill_covers_seq_len():
+    """ADVICE r5: with many envs, prefill_steps (counted in TOTAL
+    transitions) can be satisfied with fewer rows per lane than
+    seq_len, and the first update would raise 'replay has fewer rows
+    than seq_len'. Prefill must top up until every lane holds a full
+    BPTT window."""
+    from ray_tpu.rllib import DreamerV3Config
+
+    cfg = DreamerV3Config().environment("CartPole-v1")
+    cfg.seed = 0
+    cfg.num_envs = 64          # prefill_steps/num_envs ~ 8 rows/lane
+    cfg.prefill_steps = 128    # << seq_len * num_envs
+    cfg.seq_len = 16
+    cfg.updates_per_iteration = 1
+    algo = cfg.build()
+    r = algo.train()           # must not raise
+    assert algo._replay.filled > cfg.seq_len
+    assert np.isfinite(r.get("wm_loss", 0.0))

@@ -267,6 +267,7 @@ def test_torch_trainer_ranks_stay_synchronized(ray_start_regular):
 
 
 # ------------------------------------------------- huggingface (flax)
+@pytest.mark.slow  # long-running; excluded from the tier-1 gate (-m 'not slow')
 def test_transformers_trainer_finetunes_tiny_gpt2(ray_start_regular):
     """TransformersTrainer: a tiny Flax GPT-2 (from config, no
     network) trains end-to-end through the worker group and its causal
