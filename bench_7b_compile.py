@@ -99,9 +99,15 @@ def run() -> dict:
                                         sharding=batch_sh),
     }
 
-    with jax.set_mesh(mesh):
-        lowered = step.lower(state_avals, batch_avals)
-        compiled = lowered.compile()
+    set_mesh = getattr(jax, "set_mesh", None)
+    if set_mesh is not None:
+        with set_mesh(mesh):
+            lowered = step.lower(state_avals, batch_avals)
+            compiled = lowered.compile()
+    else:  # jax < 0.5: Mesh is itself the context manager
+        with mesh:
+            lowered = step.lower(state_avals, batch_avals)
+            compiled = lowered.compile()
 
     mem = compiled.memory_analysis()
     cost = compiled.cost_analysis()
@@ -119,6 +125,48 @@ def run() -> dict:
     flops_total = float(cost.get("flops", 0.0)) if cost else 0.0
     model_flops = llama.flops_per_token(config, SEQ) * BATCH * SEQ
 
+    # -- reconcile the XLA flop count against the analytic number ------
+    # HloCostAnalysis on the post-GSPMD executable measures something
+    # narrower than "model flops per step per device" (measured on this
+    # box with sharded-matmul and tiny-llama probes):
+    #   (a) it sees PER-PARTITION shapes (everything already / 64);
+    #   (b) the 32-layer lax.scan body is counted ONCE — while-loop
+    #       bodies are not scaled by trip count;
+    #   (c) pallas custom calls (flash attention fwd/bwd) carry no cost
+    #       model and contribute 0 flops.
+    # Under those rules the expected visible count is: lm_head fwd+bwd
+    # over all tokens, plus ONE layer's matmul flops (fwd + bwd + the
+    # dots-remat recompute of fwd), / 64 partitions — computed here so
+    # the artifact carries the reconciliation, not a bare mystery gap.
+    tokens = BATCH * SEQ
+    e, v = config.hidden_size, config.vocab_size
+    # Embedding + lm_head hold 2*e*v params, but only the lm_head
+    # matmul spends flops (the embedding is a gather): 6*e*v per token.
+    layer_param_flops = (6.0 * config.num_active_params
+                         - 6.0 * 2 * e * v) / config.num_layers
+    visible = (
+        6.0 * e * v * tokens                # lm_head fwd(2N) + bwd(4N)
+        + layer_param_flops * tokens        # one scan body: fwd+bwd
+        + (layer_param_flops / 3.0) * tokens  # dots-remat fwd recompute
+    ) / N_DEVICES
+    reconciliation = {
+        "xla_counts": "per-partition shapes (/64); lax.scan layer body "
+                      "once, not x32; pallas flash-attention custom "
+                      "calls excluded (no cost model)",
+        "expected_visible_flops_per_device": visible,
+        "xla_over_expected": round(flops_total / visible, 3)
+        if visible else None,
+        "headline_gap_x": round(
+            model_flops / N_DEVICES / flops_total, 1)
+        if flops_total else None,
+    }
+    # The artifact must not carry an unreconciled number: the reported
+    # count has to land near the expected-visible estimate.
+    if flops_total:
+        assert 0.4 < flops_total / visible < 2.5, (
+            f"XLA flop count no longer reconciles: reported "
+            f"{flops_total:.3e}, expected-visible {visible:.3e}")
+
     result = {
         "metric": "llama7b_v5e64_compile_check",
         "ok": bool(peak < V5E_HBM_BYTES),
@@ -134,6 +182,7 @@ def run() -> dict:
         "hbm_headroom_frac": round(1.0 - peak / V5E_HBM_BYTES, 4),
         "xla_flops_per_step_per_device": flops_total,
         "analytic_model_flops_per_step": model_flops,
+        "flops_reconciliation": reconciliation,
     }
     assert result["ok"], (
         f"7B step does not fit v5e HBM: peak {peak / 1024**3:.2f} GiB "

@@ -188,12 +188,31 @@ def main() -> None:
                         for _ in range(N_BCAST_NODES)], timeout=1800.0)
     t_bcast = time.monotonic() - t0
     assert len(set(outs)) == 1
+
+    # Per-path data-plane counters: which transport carried the bytes
+    # (same-host map / same-host memcpy / chunked RPC pull).
+    counters = {"same_host_map_hits": 0, "same_host_copy_hits": 0,
+                "chunked_pulls": 0}
+    try:
+        from ray_tpu._private.worker import global_runtime
+
+        runtime = global_runtime()
+        with runtime._remote_nodes_lock:
+            handles = list(runtime._remote_nodes.values())
+        for handle in handles:
+            stats = handle._control.call("executor_stats")
+            plane = stats.get("data_plane", {})
+            for key in counters:
+                counters[key] += int(plane.get(key, 0))
+    except Exception as exc:  # noqa: BLE001 — counters are best-effort
+        counters["error"] = repr(exc)
     record("broadcast", n_nodes=N_BCAST_NODES,
            gib=round(BCAST_BYTES / (1 << 30), 2), ok=True,
            put_wall_s=round(t_put, 1),
            broadcast_wall_s=round(t_bcast, 1),
            aggregate_gb_per_s=round(
-               BCAST_BYTES * N_BCAST_NODES / t_bcast / 1e9, 2))
+               BCAST_BYTES * N_BCAST_NODES / t_bcast / 1e9, 2),
+           data_plane=counters)
 
     ray_tpu.shutdown()
     cluster.shutdown()

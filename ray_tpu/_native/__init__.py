@@ -76,6 +76,9 @@ def load() -> "ctypes.CDLL | None":
         lib.rt_store_get.restype = u64
         lib.rt_store_get.argtypes = [p, ctypes.c_char_p,
                                      ctypes.POINTER(u64)]
+        lib.rt_store_peek.restype = u64
+        lib.rt_store_peek.argtypes = [p, ctypes.c_char_p,
+                                      ctypes.POINTER(u64)]
         lib.rt_store_release.restype = ctypes.c_int
         lib.rt_store_release.argtypes = [p, ctypes.c_char_p]
         lib.rt_store_delete.restype = ctypes.c_int

@@ -555,6 +555,27 @@ uint64_t rt_store_get(void* hv, const uint8_t* id, uint64_t* size_out) {
   return off;
 }
 
+// Peek: payload offset + size WITHOUT taking a reference (0 if
+// absent/unsealed). For same-host peers mapping another process's
+// arena: the peer stays read-only (never mutates refcounts in someone
+// else's arena — a crashed peer then cannot leak pins); the OWNER pins
+// on the peer's behalf for the lease's life (rt_store_get/release via
+// the lease table), which is what keeps the peeked offset valid.
+uint64_t rt_store_peek(void* hv, const uint8_t* id, uint64_t* size_out) {
+  Handle* h = static_cast<Handle*>(hv);
+  Header* hd = header(h);
+  if (lock_arena(h) != 0) return 0;
+  ObjectEntry* e = find_entry(h, id);
+  if (!e || e->state != kSealed) {
+    unlock_arena(hd);
+    return 0;
+  }
+  if (size_out) *size_out = e->size;
+  uint64_t off = e->offset;
+  unlock_arena(hd);
+  return off;
+}
+
 // Release a get() reference. Returns 0 ok, -1 not found.
 int rt_store_release(void* hv, const uint8_t* id) {
   Handle* h = static_cast<Handle*>(hv);

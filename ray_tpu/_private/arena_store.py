@@ -119,6 +119,37 @@ class ArenaStore:
         """Drop a reference taken by seal_pinned (or pin)."""
         self._lib.rt_store_release(self._handle, object_id)
 
+    def pin(self, object_id: bytes) -> int | None:
+        """Take a reference on a sealed object WITHOUT reading it
+        (plasma's Get, minus the buffer). Returns the payload size, or
+        None when absent/unsealed. The object cannot be evicted (and a
+        delete is deferred) until the matching ``unpin`` — the owner
+        uses this to pin objects on behalf of same-host peers that map
+        this arena (see same_host.LeaseTable)."""
+        size = ctypes.c_uint64()
+        offset = self._lib.rt_store_get(
+            self._handle, object_id, ctypes.byref(size))
+        if not offset:
+            return None
+        return size.value
+
+    def peek(self, object_id: bytes) -> tuple[int, int] | None:
+        """(offset, size) of a sealed object WITHOUT touching its
+        refcount — the read-only path for peers attached to someone
+        else's arena (the owner's lease pin keeps the offset valid;
+        sealed objects never move, eviction only frees)."""
+        size = ctypes.c_uint64()
+        offset = self._lib.rt_store_peek(
+            self._handle, object_id, ctypes.byref(size))
+        if not offset:
+            return None
+        return offset, size.value
+
+    def view_at(self, offset: int, size: int) -> memoryview:
+        """Public zero-copy view of an arena range (callers pair it
+        with ``peek`` under an active pin/lease)."""
+        return self._view(offset, size)
+
     def get_bytes(self, object_id: bytes) -> bytes | None:
         """Copy an object's payload out of the arena.
 

@@ -86,6 +86,19 @@ _DEFAULTS: dict[str, Any] = {
     "broadcast_chunk_fanout": 4,       # peer sources used per pull
     "broadcast_min_p2p_chunks": 4,     # smaller objects pull owner-only
     "node_relay_cache_mb": 4096,       # completed relay copies kept
+    # Same-host zero-copy plane: co-hosted daemons map each other's
+    # shared memory (dedicated segments / the native arena) instead of
+    # chunk-pulling bytes over RPC (reference: plasma is host-shared by
+    # design, object_manager/plasma/store_runner.h).
+    "same_host_plane": True,           # enable same-host mapping
+    # Objects at/above this are served to same-host peers by a named
+    # segment the peer maps zero-copy; below it the peer does a single
+    # memcpy out of the holder's arena/segment (map-vs-memcpy split:
+    # small objects aren't worth a per-consumer mapping).
+    "same_host_map_min_kb": 1024,
+    # Owner-side pin leases outlive this only while the holder still
+    # answers pings; a dead puller's pins are swept afterwards.
+    "same_host_pin_ttl_s": 30.0,
     # Driver-side node table: absent-but-pinging nodes survive this many
     # consecutive sync passes before being dropped (head amnesia grace).
     "node_amnesia_max_passes": 5,

@@ -190,6 +190,10 @@ class NodeRecord:
     # RPC address of the node's executor service (empty for nodes that
     # cannot run tasks, e.g. pure drivers).
     executor_address: str = ""
+    # Durable host identity (boot-id based, same_host.host_identity):
+    # daemons with equal host_id share POSIX shared memory and take the
+    # same-host zero-copy fetch path instead of chunked RPC pulls.
+    host_id: str = ""
     alive: bool = True
     last_heartbeat: float = field(default_factory=time.monotonic)
     # Live usage piggybacked on heartbeats (reference: ray_syncer's

@@ -267,7 +267,8 @@ class GcsServer:
     def _register_node(self, address: str, resources: dict,
                        labels: dict | None = None,
                        executor_address: str = "",
-                       prior_id: bytes | None = None) -> bytes:
+                       prior_id: bytes | None = None,
+                       host_id: str = "") -> bytes:
         """``prior_id``: a daemon re-registering after its heartbeat was
         rejected asks to KEEP its id. Granted when this head has never
         seen the id (head restart amnesia — reference: raylets keep
@@ -287,7 +288,7 @@ class GcsServer:
         self.gcs.register_node(NodeRecord(
             node_id=node_id, address=address, resources=dict(resources),
             labels=dict(labels or {}),
-            executor_address=executor_address))
+            executor_address=executor_address, host_id=host_id))
         return node_id.binary()
 
     def _heartbeat(self, node_id_bytes: bytes,
@@ -320,6 +321,7 @@ class GcsServer:
             "available": dict(r.available),
             "labels": dict(r.labels),
             "executor_address": r.executor_address,
+            "host_id": r.host_id,
             "alive": r.alive,
         } for r in self.gcs.list_nodes()]
 

@@ -54,6 +54,21 @@ def install_runtime_collectors(runtime):
         lines.append("# TYPE ray_tpu_nodes_alive gauge")
         lines.append(f"ray_tpu_nodes_alive {alive}")
 
+        # Same-host data-plane path split (driver side): mapped-copy
+        # fetches vs leases granted on this driver's exports (daemon
+        # counters live in each daemon's executor_stats).
+        lines.append("# TYPE ray_tpu_same_host_copy_hits counter")
+        lines.append(f"ray_tpu_same_host_copy_hits "
+                     f"{getattr(runtime, 'same_host_copy_hits', 0)}")
+        leases = getattr(runtime, "_export_leases", None)
+        if leases is not None:
+            ls = leases.stats()
+            lines.append("# TYPE ray_tpu_export_map_leases gauge")
+            for field in ("active", "granted", "released", "expired"):
+                lines.append(
+                    f'ray_tpu_export_map_leases{{state="{field}"}} '
+                    f'{ls[field]}')
+
         lines.append("# TYPE ray_tpu_resource_available gauge")
         for key, value in runtime.cluster.available_resources().items():
             # Label VALUES take any UTF-8 (escaped); only metric names

@@ -94,9 +94,12 @@ class NodeAgent:
         # prior_id: across a head restart the daemon asks to keep its
         # node id, so drivers' mirrored node tables (and in-flight work
         # keyed by the id) converge without a spurious death+rejoin.
+        from ray_tpu._private.same_host import host_identity
+
         return self.client.call(
             "register_node", self._address, self.resources, self.labels,
-            self.executor_address, prior_id=self.node_id or None)
+            self.executor_address, prior_id=self.node_id or None,
+            host_id=host_identity())
 
     def poke(self) -> None:
         """Load changed: push a heartbeat now (coalesced)."""

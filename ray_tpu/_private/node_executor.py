@@ -39,6 +39,7 @@ from ray_tpu._private.rpc import (
     MuxRpcClient,
     RpcClient,
     RpcError,
+    RpcMethodError,
     RpcServer,
 )
 
@@ -707,6 +708,28 @@ class NodeExecutorService:
         self.chunk_directory = ChunkDirectory()
         self._advertised_address: str | None = None
         self.relay_chunks_served = 0  # cumulative, survives partial GC
+        # Same-host zero-copy plane (same_host.py): co-hosted pullers
+        # map this daemon's segments/arena instead of chunk-pulling.
+        from ray_tpu._private.same_host import (
+            LeaseTable,
+            PeerArenaRegistry,
+            host_identity,
+        )
+
+        self.host_id = host_identity()
+        self.leases = LeaseTable()            # owner side: peers' pins
+        self._peer_arenas = PeerArenaRegistry()  # puller side
+        # key -> ("seg", seg_name, size): objects this daemon can serve
+        # to same-host peers by name (owned segments only).
+        self._map_sources: dict[bytes, tuple] = {}
+        # Puller side: key -> (owner_addr, lease_token, seg|None) for
+        # peer-owned mappings held by this daemon's shm-args cache.
+        self._attached: dict[bytes, tuple] = {}
+        # Data-plane path counters (map = zero-copy mapping handed out,
+        # copy = single same-host memcpy, chunked = RPC chunk pull).
+        self.same_host_map_hits = 0
+        self.same_host_copy_hits = 0
+        self.chunked_pulls = 0
         # Worker-bound arg blobs promoted to shared memory: keyed by the
         # object's id bytes in the node's shm directory; FIFO-bounded.
         self._shm_args_lock = threading.Lock()
@@ -764,6 +787,7 @@ class NodeExecutorService:
         s.register("fetch_object", self.fetch_object,
                    concurrent="pooled")
         s.register("fetch_plan", self.fetch_plan, concurrent="pooled")
+        s.register("unpin_object", self.unpin_object)
         s.register("free_objects", self.free_objects)
         s.register("executor_stats", self.executor_stats)
         s.register("task_block", self.task_block)
@@ -878,6 +902,20 @@ class NodeExecutorService:
     def stop(self) -> None:
         self._stop_event.set()
         self._server.stop()
+        # Same-host plane: drop owner-side pins (peers' leases) and
+        # this daemon's peer mappings before the directories unwind.
+        self.leases.clear()
+        with self._shm_args_lock:
+            attached = list(self._attached.values())
+            self._attached.clear()
+            self._map_sources.clear()
+        for _, _, seg in attached:
+            if seg is not None:
+                try:
+                    seg.close()
+                except (BufferError, OSError):
+                    pass
+        self._peer_arenas.close_all()
         with self._actors_lock:
             actors = list(self._actors.values())
             self._actors.clear()
@@ -1003,8 +1041,31 @@ class NodeExecutorService:
                 out.append(("inline", blob))
             else:
                 self.store.put(id_bytes, blob, owner=client_addr)
+                self._maybe_export_stored(id_bytes, blob)
                 out.append(("stored", len(blob)))
         return ("ok", out)
+
+    def _maybe_export_stored(self, id_bytes: bytes, blob) -> None:
+        """Give a large stored primary a named-segment twin so
+        same-host consumers (peer daemons, the driver) map it instead
+        of chunk-pulling. One memcpy here buys zero copies per
+        consumer; bounded by the shm-args FIFO cache."""
+        from ray_tpu._private.same_host import map_enabled, map_min_bytes
+
+        if not map_enabled() or len(blob) < map_min_bytes():
+            return
+        with self._shm_args_lock:
+            if self._shm_directory.lookup(id_bytes) is not None:
+                return
+        from multiprocessing import shared_memory
+
+        try:
+            seg = shared_memory.SharedMemory(create=True,
+                                             size=max(len(blob), 1))
+        except OSError:
+            return  # /dev/shm full: chunked fallback still serves
+        seg.buf[:len(blob)] = blob
+        self._register_shm_arg(id_bytes, seg, len(blob))
 
     def set_load_listener(self, listener: Callable[[], None]) -> None:
         self._load_listener = listener
@@ -1051,19 +1112,71 @@ class NodeExecutorService:
         return wrap_chunk_reply(reply)
 
     def fetch_plan(self, id_bytes: bytes,
-                   puller_addr: str | None = None):
-        """Transfer plan for one object: (total_size, other_holders).
-        Registers the puller as a partial holder so later pullers fetch
-        chunks from it too. None when the object is unknown here."""
+                   puller_addr: str | None = None,
+                   puller_host: str | None = None):
+        """Transfer plan for one object: (total_size, other_holders,
+        map_source). Registers the puller as a partial holder so later
+        pullers fetch chunks from it too. None when the object is
+        unknown here.
+
+        ``map_source``: when the puller declared a host identity equal
+        to ours and this daemon holds the object in named shared
+        memory, the reply carries how to map it directly — kind/name/
+        key/size plus a granted lease token that pins the object until
+        ``unpin_object`` (or the liveness-gated TTL sweep). Otherwise
+        None and the puller takes the chunked path."""
         total = self.store.size(id_bytes)
         if total is None:
             with self._partials_lock:
                 part = self._partials.get(id_bytes)
             if part is None:
-                return None
-            total = part.total
+                with self._shm_args_lock:
+                    source = self._map_sources.get(id_bytes)
+                if source is None:
+                    return None
+                total = source[2]
+        map_info = None
+        if puller_addr and puller_host and puller_host == self.host_id:
+            map_info = self._grant_map_lease(id_bytes, puller_addr)
+        # A mapping puller never holds servable CHUNKS — registering it
+        # as a relay holder would advertise a peer that serves nothing.
+        reg_addr = None if map_info is not None else puller_addr
         return (total, plan_holders(self.chunk_directory, id_bytes,
-                                    puller_addr, total))
+                                    reg_addr, total), map_info)
+
+    def _grant_map_lease(self, id_bytes: bytes,
+                         holder: str) -> dict | None:
+        """Owner half of the same-host protocol: find a shared-memory
+        source for the object and pin it under a lease for ``holder``.
+        Segments need no in-memory pin (POSIX keeps a mapped segment
+        alive past its unlink), so their lease only tracks the grant;
+        arena objects take a real refcount (ArenaStore.pin) that blocks
+        eviction/reuse until release."""
+        from ray_tpu._private.same_host import map_enabled
+
+        if not map_enabled():
+            return None
+        with self._shm_args_lock:
+            source = self._map_sources.get(id_bytes)
+        if source is None:
+            return None
+        kind, name, size = source[0], source[1], source[2]
+        key = source[3] if len(source) > 3 else b""
+        if kind == "arena":
+            arena = getattr(self, "_owned_arena", None)
+            if arena is None or arena.pin(key) is None:
+                return None
+            token = self.leases.grant(
+                id_bytes, holder, on_release=lambda: arena.unpin(key))
+        else:
+            token = self.leases.grant(id_bytes, holder)
+        return {"kind": kind, "name": name, "key": key, "size": size,
+                "host": self.host_id, "token": token}
+
+    def unpin_object(self, token: str) -> bool:
+        """Release one same-host map lease (puller dropped its
+        mapping)."""
+        return self.leases.release(token)
 
     def free_objects(self, ids: list[bytes]) -> int:
         for id_bytes in ids:
@@ -1088,6 +1201,7 @@ class NodeExecutorService:
                 (k, sz) for k, sz in self._shm_args_order if k != key]
             self._shm_args_bytes = sum(
                 sz for _, sz in self._shm_args_order)
+        self._release_plane_state(key)
         self._shm_directory.free(key)
 
     def executor_stats(self) -> dict:
@@ -1100,10 +1214,19 @@ class NodeExecutorService:
                 "partials": len(self._partials),
                 "relay_chunks_served": self.relay_chunks_served,
             }
+        with self._shm_args_lock:
+            data_plane = {
+                "same_host_map_hits": self.same_host_map_hits,
+                "same_host_copy_hits": self.same_host_copy_hits,
+                "chunked_pulls": self.chunked_pulls,
+                "map_sources": len(self._map_sources),
+                "attached_mappings": len(self._attached),
+            }
+        data_plane["leases"] = self.leases.stats()
         return {"tasks_executed": self.tasks_executed,
                 "running": running, "store": self.store.stats(),
                 "num_actors": num_actors, "pid": os.getpid(),
-                "relay": relay,
+                "relay": relay, "data_plane": data_plane,
                 "threads": threading.active_count()}
 
     def adopt_sys_path(self, paths: list) -> int:
@@ -1271,6 +1394,7 @@ class NodeExecutorService:
             else:
                 self.store.put(id_bytes, blob,
                                owner=getattr(actor, "owner", None))
+                self._maybe_export_stored(id_bytes, blob)
                 out.append(("stored", len(blob)))
         return ("ok", out)
 
@@ -1466,24 +1590,35 @@ class NodeExecutorService:
         worker-bound path never materializes an intermediate copy of
         the whole object."""
         from ray_tpu._private.config import GLOBAL_CONFIG
+        from ray_tpu._private.same_host import map_enabled
 
         owner = self._peers.get(ref.addr)
         try:
-            plan = owner.call("fetch_plan", ref.id_bytes,
-                              self.advertised_address)
+            plan = owner.call(
+                "fetch_plan", ref.id_bytes, self.advertised_address,
+                self.host_id if map_enabled() else None)
         except RpcMethodError:
             plan = None  # owner predates fetch_plan
+        map_info = plan[2] if plan is not None and len(plan) > 2 \
+            else None
+        if map_info is not None:
+            # Co-hosted holder: map its shared memory (or memcpy out of
+            # it) instead of moving the bytes through the transport.
+            result = self._try_same_host(ref, map_info, to_shm)
+            if result is not None:
+                return result
         chunk = _fetch_chunk_bytes()
         n_chunks = (-(-plan[0] // chunk)
                     if plan is not None and plan[0] else 0)
         if plan is None or \
                 n_chunks < int(GLOBAL_CONFIG.broadcast_min_p2p_chunks):
+            self.chunked_pulls += 1
             blob = fetch_blob(owner, ref.id_bytes)
             if to_shm:
                 return self._blob_to_shm(ref.id_bytes, blob)
             self.store.put(ref.id_bytes, blob, cached=True)
             return blob
-        total, holders = plan
+        total, holders = plan[0], plan[1]
         # Single-flight per object: concurrent tasks needing the same
         # arg share one pull instead of racing duplicate transfers.
         with self._partials_lock:
@@ -1511,11 +1646,13 @@ class NodeExecutorService:
                 with part.lock:
                     return bytes(part.buf)
             # Leader failed; retry as a plain owner pull.
+            self.chunked_pulls += 1
             blob = fetch_blob(owner, ref.id_bytes)
             if to_shm:
                 return self._blob_to_shm(ref.id_bytes, blob)
             self.store.put(ref.id_bytes, blob, cached=True)
             return blob
+        self.chunked_pulls += 1
         try:
             self._pull_chunks(ref, part, holders)
         except BaseException as exc:  # noqa: BLE001 — release waiters
@@ -1551,6 +1688,77 @@ class NodeExecutorService:
             self._trim_relays()
         return blob
 
+    def _try_same_host(self, ref: FetchRef, info: dict, to_shm: bool):
+        """Consume a granted same-host map lease: attach the holder's
+        segment (zero-copy hand-off to workers) or its arena (cross-
+        arena descriptor / single memcpy). Returns a descriptor
+        (``to_shm``) or the framed bytes, or None to fall back to the
+        chunked path — any failure releases the lease first."""
+        key = ref.id_bytes
+        token = info.get("token")
+        owner_addr = ref.addr
+        try:
+            if info.get("host") != self.host_id or not token:
+                if token:
+                    self._unpin_at(owner_addr, token)
+                return None
+            size = int(info.get("size", 0))
+            if info.get("kind") == "seg":
+                from ray_tpu._private.same_host import attach_segment
+                from ray_tpu._private.shm_store import ShmDescriptor
+
+                try:
+                    seg = attach_segment(info["name"])
+                except (OSError, ValueError):
+                    self._unpin_at(owner_addr, token)
+                    return None  # holder freed it: chunked decides
+                if to_shm:
+                    desc = self._register_shm_arg(
+                        key, seg, size,
+                        desc=ShmDescriptor(info["name"], size),
+                        attached=(owner_addr, token))
+                    self.same_host_map_hits += 1
+                    return desc
+                try:
+                    blob = bytes(seg.buf[:size])
+                finally:
+                    try:
+                        seg.close()
+                    except (BufferError, OSError):
+                        pass
+                self._unpin_at(owner_addr, token)
+                self.same_host_copy_hits += 1
+                self.store.put(key, blob, cached=True)
+                return blob
+            if info.get("kind") == "arena":
+                view = self._peer_arenas.view(info["name"], info["key"])
+                if view is None:
+                    self._unpin_at(owner_addr, token)
+                    return None
+                if to_shm:
+                    from ray_tpu._private.shm_store import (
+                        PeerArenaDescriptor,
+                    )
+
+                    desc = self._register_shm_arg(
+                        key, None, size,
+                        desc=PeerArenaDescriptor(
+                            info["name"], info["key"], size),
+                        attached=(owner_addr, token))
+                    self.same_host_map_hits += 1
+                    return desc
+                blob = bytes(view[:size])
+                self._unpin_at(owner_addr, token)
+                self.same_host_copy_hits += 1
+                self.store.put(key, blob, cached=True)
+                return blob
+            self._unpin_at(owner_addr, token)
+            return None
+        except Exception:  # noqa: BLE001 — any failure: chunked path
+            if token:
+                self._unpin_at(owner_addr, token)
+            return None
+
     def _blob_to_shm(self, key: bytes, blob: bytes | None, part=None):
         """Assembled-bytes fallback into a shared segment (small
         objects, plain pulls, non-leader waiters)."""
@@ -1568,35 +1776,61 @@ class NodeExecutorService:
         seg.buf[:len(blob)] = blob
         return self._register_shm_arg(key, seg, len(blob))
 
-    def _register_shm_arg(self, key: bytes, seg, size: int):
-        """Record a worker-mappable segment in the node's shm
+    def _register_shm_arg(self, key: bytes, seg, size: int,
+                          desc=None, attached: tuple | None = None):
+        """Record a worker-mappable descriptor in the node's shm
         directory (FIFO-bounded; loser of a concurrent promote race
-        discards its segment)."""
+        discards its segment).
+
+        Owned segments (``attached is None``) are also advertised as
+        same-host map sources. ``attached=(owner_addr, token)`` records
+        a PEER-owned mapping instead: never advertised, never
+        unlinked, and its lease is unpinned at the owner when the entry
+        is dropped."""
         from ray_tpu._private.config import GLOBAL_CONFIG
         from ray_tpu._private.shm_store import ShmDescriptor
 
-        desc = ShmDescriptor(seg.name, size)
+        if desc is None:
+            desc = ShmDescriptor(seg.name, size)
         evict: list = []
+        redundant_lease = None
         with self._shm_args_lock:
             existing = self._shm_directory.lookup(key)
             if existing is not None:
                 # Concurrent promote won (no partial references OUR
-                # segment here — leaders are single-flight): discard.
-                try:
-                    seg.unlink()
-                    seg.close()
-                except (OSError, BufferError):
-                    pass
-                return existing
-            self._shm_directory.register(key, desc, seg)
-            self._shm_args_order.append((key, size))
-            self._shm_args_bytes += size
-            limit = int(GLOBAL_CONFIG.node_pull_cache_mb) * 1024 * 1024
-            while self._shm_args_bytes > limit \
-                    and len(self._shm_args_order) > 1:
-                old_key, old_size = self._shm_args_order.pop(0)
-                self._shm_args_bytes -= old_size
-                evict.append(old_key)
+                # segment here — leaders are single-flight): discard,
+                # and release a now-redundant lease AFTER the lock
+                # (the unpin call may connect a socket).
+                if seg is not None:
+                    try:
+                        if attached is None:
+                            seg.unlink()
+                        seg.close()
+                    except (OSError, BufferError):
+                        pass
+                if attached is not None:
+                    redundant_lease = attached
+            else:
+                self._shm_directory.register(
+                    key, desc, seg if attached is None else None)
+                if attached is not None:
+                    self._attached[key] = (attached[0], attached[1], seg)
+                elif seg is not None:
+                    self._map_sources[key] = ("seg", seg.name, size)
+                self._shm_args_order.append((key, size))
+                self._shm_args_bytes += size
+                limit = int(GLOBAL_CONFIG.node_pull_cache_mb) \
+                    * 1024 * 1024
+                while self._shm_args_bytes > limit \
+                        and len(self._shm_args_order) > 1:
+                    old_key, old_size = self._shm_args_order.pop(0)
+                    self._shm_args_bytes -= old_size
+                    evict.append(old_key)
+        if redundant_lease is not None:
+            self._unpin_at(redundant_lease[0], redundant_lease[1])
+            return existing
+        if existing is not None:
+            return existing
         for old_key in evict:
             # Relay partials viewing the evicted segment must release
             # their buffer before the unlink (exported-view safety).
@@ -1608,8 +1842,35 @@ class NodeExecutorService:
                         old_part.buf.release()
                     except BufferError:
                         pass
+            self._release_plane_state(old_key)
             self._shm_directory.free(old_key)
         return desc
+
+    def _release_plane_state(self, key: bytes) -> None:
+        """Same-host plane GC for one object: drop its owner-side map
+        source (+ any leases peers hold on it) and, if this daemon
+        holds a PEER's mapping for it, close that and unpin at the
+        owner."""
+        with self._shm_args_lock:
+            self._map_sources.pop(key, None)
+            attached = self._attached.pop(key, None)
+        self.leases.release_object(key)
+        if attached is not None:
+            owner_addr, token, seg = attached
+            if seg is not None:
+                try:
+                    seg.close()
+                except (BufferError, OSError):
+                    pass
+            self._unpin_at(owner_addr, token)
+
+    def _unpin_at(self, owner_addr: str, token: str) -> None:
+        """Fire-and-forget lease release at the owner (its TTL sweep
+        is the backstop when this RPC is lost)."""
+        try:
+            self._peers.get(owner_addr).call_async("unpin_object", token)
+        except Exception:  # noqa: BLE001 — owner gone: nothing to unpin
+            pass
 
     def _pull_chunks(self, ref: FetchRef, part: _PartialBlob,
                      holders: list[str]) -> None:
@@ -1727,6 +1988,19 @@ class NodeExecutorService:
                     except BufferError:
                         pass
         self.chunk_directory.prune()
+        # Same-host pin leases: expire grants that outlived the TTL
+        # whose holder stopped answering pings (a SIGKILLed puller must
+        # not pin this daemon's memory forever).
+        from ray_tpu._private.same_host import pin_ttl_s
+
+        def _probe(addr: str) -> bool:
+            probe = RpcClient(addr, timeout_s=2.0, connect_timeout_s=1.0)
+            try:
+                return probe.call("ping") == "pong"
+            finally:
+                probe.close()
+
+        self.leases.sweep(pin_ttl_s(), _probe)
 
     def _trim_relays(self) -> None:
         """Bound completed relay copies by node_relay_cache_mb (oldest

@@ -1,0 +1,324 @@
+"""Same-host zero-copy object plane — shared pieces.
+
+When two daemons (or a daemon and the driver) share a host, a fetch
+does not need to move bytes through the RPC transport at all: the
+holder's copy already lives in named POSIX shared memory (a dedicated
+segment or the native arena, _native/plasma_store.cpp), and the puller
+can map it directly (reference: plasma is host-shared by design —
+src/ray/object_manager/plasma/store_runner.h; one store serves every
+worker on the node).
+
+Three pieces live here, used by both the node executor and the
+driver's export server:
+
+- ``host_identity()``: a durable host id (boot-id based, NOT the IP —
+  many daemons share one IP on a test box, and one host can have many
+  addresses). Published through GCS node registration and echoed in
+  ``fetch_plan`` replies so a puller can recognize a co-hosted holder.
+- ``LeaseTable``: the owner-side pin registry. A holder that maps (or
+  copies from) a peer's shared memory takes a lease first; the owner
+  pins the underlying object (arena refcount / segment reference) for
+  the lease's life, so eviction or reuse cannot invalidate the
+  mapping. Leases are released explicitly (``unpin_object``) or swept
+  when they outlive the TTL AND their holder stopped answering pings —
+  a dead puller cannot pin an object forever.
+- ``PeerArenaRegistry``: cached read-only attachments to other
+  processes' arenas (ArenaStore.attach — the same mechanism pool
+  workers already use), keyed by arena name.
+
+Map sources cross the RPC boundary as plain dicts (pickle-friendly):
+``{"kind": "seg"|"arena", "name": ..., "key": ..., "size": ...,
+"host": ..., "token": ...}``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable
+
+_HOST_ID: str | None = None
+_HOST_ID_LOCK = threading.Lock()
+
+
+def host_identity() -> str:
+    """Durable identity of this host (stable across processes, changes
+    on reboot). ``RAY_TPU_HOST_ID`` overrides — tests use it to
+    simulate cross-host daemons on one box."""
+    global _HOST_ID
+    override = os.environ.get("RAY_TPU_HOST_ID")
+    if override:
+        return override
+    with _HOST_ID_LOCK:
+        if _HOST_ID is None:
+            boot_id = ""
+            try:
+                with open("/proc/sys/kernel/random/boot_id") as f:
+                    boot_id = f.read().strip()
+            except OSError:
+                pass
+            if not boot_id:
+                import socket
+                import uuid
+
+                boot_id = f"{socket.gethostname()}-{uuid.getnode():x}"
+            # Shared memory is namespaced per user on some systems;
+            # same uid is also the permission boundary for shm_open.
+            _HOST_ID = f"{boot_id}:{os.getuid()}"
+        return _HOST_ID
+
+
+def map_enabled() -> bool:
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    return bool(GLOBAL_CONFIG.same_host_plane)
+
+
+def map_min_bytes() -> int:
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    return int(GLOBAL_CONFIG.same_host_map_min_kb) * 1024
+
+
+def pin_ttl_s() -> float:
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    return float(GLOBAL_CONFIG.same_host_pin_ttl_s)
+
+
+class LeaseTable:
+    """Owner-side pin registry for mapped-out objects.
+
+    ``grant`` pins (via ``on_release``'s dual: the caller pins before
+    granting and hands the unpin closure here); ``release`` unpins.
+    ``sweep`` releases leases that are BOTH older than the TTL and held
+    by an unreachable holder — liveness-gated expiry, so a healthy
+    puller holding a mapping for a long time keeps its lease, while a
+    SIGKILLed one cannot pin the owner's memory past one TTL + sweep
+    period."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next = 0
+        # token -> (id_bytes, holder_addr, granted_monotonic, on_release)
+        self._leases: dict[str, tuple] = {}
+        self.granted = 0
+        self.released = 0
+        self.expired = 0
+
+    def grant(self, id_bytes: bytes, holder: str,
+              on_release: Callable[[], None] | None = None) -> str:
+        with self._lock:
+            self._next += 1
+            token = f"{self._next}-{os.urandom(4).hex()}"
+            self._leases[token] = (id_bytes, holder, time.monotonic(),
+                                   on_release)
+            self.granted += 1
+        return token
+
+    def release(self, token: str) -> bool:
+        with self._lock:
+            lease = self._leases.pop(token, None)
+            if lease is not None:
+                self.released += 1
+        if lease is None:
+            return False
+        self._run_release(lease)
+        return True
+
+    def release_object(self, id_bytes: bytes) -> int:
+        """Owner freed the object: drop every lease on it (the
+        underlying unpin makes the final delete effective)."""
+        with self._lock:
+            victims = [t for t, l in self._leases.items()
+                       if l[0] == id_bytes]
+            leases = [self._leases.pop(t) for t in victims]
+            self.released += len(leases)
+        for lease in leases:
+            self._run_release(lease)
+        return len(leases)
+
+    def pinned_ids(self) -> set[bytes]:
+        with self._lock:
+            return {l[0] for l in self._leases.values()}
+
+    def sweep(self, ttl_s: float,
+              probe: Callable[[str], bool] | None = None) -> int:
+        """Release leases older than ``ttl_s`` whose holder is
+        unreachable (``probe`` returns False). With no probe, age alone
+        expires — callers that cannot ping (unit tests) get plain TTL
+        semantics."""
+        now = time.monotonic()
+        with self._lock:
+            stale = [(t, l) for t, l in self._leases.items()
+                     if now - l[2] > ttl_s]
+        expired = []
+        alive_holders: dict[str, bool] = {}
+        for token, lease in stale:
+            holder = lease[1]
+            if probe is not None:
+                if holder not in alive_holders:
+                    try:
+                        alive_holders[holder] = bool(probe(holder))
+                    except Exception:  # noqa: BLE001 — unreachable
+                        alive_holders[holder] = False
+                if alive_holders[holder]:
+                    # Holder lives: refresh the lease instead of
+                    # re-probing it every sweep pass.
+                    with self._lock:
+                        if token in self._leases:
+                            i, h, _, cb = self._leases[token]
+                            self._leases[token] = (i, h, now, cb)
+                    continue
+            with self._lock:
+                lease = self._leases.pop(token, None)
+                if lease is not None:
+                    self.expired += 1
+            if lease is not None:
+                expired.append(lease)
+        for lease in expired:
+            self._run_release(lease)
+        return len(expired)
+
+    def clear(self) -> None:
+        with self._lock:
+            leases = list(self._leases.values())
+            self._leases.clear()
+        for lease in leases:
+            self._run_release(lease)
+
+    @staticmethod
+    def _run_release(lease: tuple) -> None:
+        cb = lease[3]
+        if cb is not None:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — release is best-effort
+                pass
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"active": len(self._leases), "granted": self.granted,
+                    "released": self.released, "expired": self.expired}
+
+
+def attach_segment(name: str):
+    """Open a peer-owned segment by name for mapping. On Python 3.12+
+    (which registers attaches with the resource tracker) the attach is
+    untracked so THIS process's exit can never unlink the owner's
+    segment; earlier Pythons don't register attaches, and untracking
+    would instead unregister the owner's entry when both sides share a
+    tracker (in-process tests). Raises OSError when the name is gone."""
+    import sys
+    from multiprocessing import shared_memory
+
+    from ray_tpu._private.shm_store import untrack
+
+    seg = shared_memory.SharedMemory(name=name)
+    if sys.version_info >= (3, 12):
+        untrack(seg)
+    return seg
+
+
+def fetch_mapped_blob(call, id_bytes: bytes, my_addr: str,
+                      my_host: str):
+    """One-shot same-host fetch for consumers without a mapping cache
+    (the driver materializing a RemoteBlob): ask the holder for a plan,
+    and when it grants a map lease, copy the framed bytes straight out
+    of its shared memory — one memcpy, no chunked RPC. Returns the
+    bytes or None (caller falls back to the chunked pull). The lease is
+    released either way."""
+    try:
+        plan = call("fetch_plan", id_bytes, my_addr, my_host)
+    except Exception:  # noqa: BLE001 — holder gone / pre-plan peer
+        return None
+    info = plan[2] if plan is not None and len(plan) > 2 else None
+    if not info or info.get("host") != my_host \
+            or not info.get("token"):
+        return None
+    token = info["token"]
+    try:
+        size = int(info.get("size", 0))
+        if info.get("kind") == "seg":
+            try:
+                seg = attach_segment(info["name"])
+            except (OSError, ValueError):
+                return None
+            try:
+                return bytes(seg.buf[:size])
+            finally:
+                try:
+                    seg.close()
+                except (BufferError, OSError):
+                    pass
+        if info.get("kind") == "arena":
+            from ray_tpu._private.arena_store import ArenaStore
+
+            arena = ArenaStore.attach(info["name"])
+            if arena is None:
+                return None
+            try:
+                peek = arena.peek(info["key"])
+                if peek is None:
+                    return None
+                offset, obj_size = peek
+                return bytes(arena.view_at(offset, obj_size))
+            finally:
+                arena.close()
+        return None
+    except Exception:  # noqa: BLE001 — any failure: chunked fallback
+        return None
+    finally:
+        try:
+            call("unpin_object", token)
+        except Exception:  # noqa: BLE001 — TTL sweep is the backstop
+            pass
+
+
+class PeerArenaRegistry:
+    """Cached attachments to peer-owned arenas, by shm name.
+
+    Attachments are kept for the process's life (an mmap is cheap to
+    hold, expensive to churn); ``close_all`` detaches on shutdown. The
+    mapping is used READ-ONLY by convention — the puller never takes
+    in-arena references (the owner pins on its behalf via the lease),
+    so a crashed puller cannot corrupt or wedge the owner's arena."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._arenas: dict[str, object] = {}
+
+    def get(self, name: str):
+        from ray_tpu._private.arena_store import ArenaStore
+
+        with self._lock:
+            arena = self._arenas.get(name)
+            if arena is None and name not in self._arenas:
+                arena = ArenaStore.attach(name)
+                if arena is not None:
+                    self._arenas[name] = arena
+            return arena
+
+    def view(self, name: str, key: bytes):
+        """Zero-copy memoryview of a sealed object in a peer arena, or
+        None (arena gone / object evicted). Valid only while the
+        owner-side lease pins the object."""
+        arena = self.get(name)
+        if arena is None:
+            return None
+        peek = arena.peek(key)
+        if peek is None:
+            return None
+        offset, size = peek
+        return arena.view_at(offset, size)
+
+    def close_all(self) -> None:
+        with self._lock:
+            arenas = [a for a in self._arenas.values() if a is not None]
+            self._arenas.clear()
+        for arena in arenas:
+            try:
+                arena.close()
+            except Exception:  # noqa: BLE001 — detach is best-effort
+                pass

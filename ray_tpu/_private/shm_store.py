@@ -44,6 +44,22 @@ class ArenaDescriptor:
     name: str = "<arena>"
 
 
+@dataclass(frozen=True)
+class PeerArenaDescriptor:
+    """An object resident in ANOTHER process's arena on this host
+    (same-host plane): the arena's shm name + object key. Resolved by
+    attaching the peer arena read-only (ArenaStore.attach) and copying
+    the payload out — the holder's lease pin keeps the bytes valid
+    while the copy runs, and the copy (matching local ArenaDescriptor
+    semantics) keeps deserialized views valid after lease release.
+    ``name`` is a sentinel so segment call sites no-op."""
+
+    arena: str
+    key: bytes
+    size: int
+    name: str = "<peer-arena>"
+
+
 def untrack(seg: shared_memory.SharedMemory) -> None:
     """Remove a segment from this process's resource tracker.
 
@@ -145,11 +161,33 @@ class ShmClient:
         # Segments whose mappings still have live views at close time;
         # referenced here so __del__ never runs on them.
         self._leaked: list[shared_memory.SharedMemory] = []
+        # Cached attachments to peer-owned arenas (same-host plane),
+        # created lazily on the first PeerArenaDescriptor resolve.
+        self._peer_arenas = None
 
     def set_arena(self, arena) -> None:
         self._arena = arena
 
     def get(self, desc: "ShmDescriptor | ArenaDescriptor") -> Any:
+        if isinstance(desc, PeerArenaDescriptor):
+            with self._lock:
+                if self._peer_arenas is None:
+                    from ray_tpu._private.same_host import (
+                        PeerArenaRegistry,
+                    )
+
+                    self._peer_arenas = PeerArenaRegistry()
+                registry = self._peer_arenas
+            view = registry.view(desc.arena, desc.key)
+            if view is None:
+                raise KeyError(
+                    f"peer-arena object {desc.key.hex()} unavailable "
+                    f"in {desc.arena}")
+            # One memcpy out of the peer arena: the copy owns the
+            # memory, so deserialized zero-copy views survive the
+            # holder releasing its lease pin later.
+            return serialization.deserialize_from_buffer(
+                memoryview(bytes(view[:desc.size])))
         if isinstance(desc, ArenaDescriptor):
             if self._arena is None:
                 raise RuntimeError("arena object but no arena attached")
@@ -185,6 +223,9 @@ class ShmClient:
         with self._lock:
             segments = list(self._segments.items())
             self._segments.clear()
+            peer_arenas, self._peer_arenas = self._peer_arenas, None
+        if peer_arenas is not None:
+            peer_arenas.close_all()
         for _, seg in segments:
             try:
                 seg.close()

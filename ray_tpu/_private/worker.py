@@ -292,6 +292,18 @@ class Runtime:
         self._export_directory = None
         self._obj_server = None
         self._export_addr = ""
+        # Same-host plane, driver side: exported args above the map
+        # threshold get a named-segment (or arena) twin that co-hosted
+        # daemons map instead of chunk-pulling (same_host.py).
+        from ray_tpu._private.same_host import LeaseTable, host_identity
+
+        self.host_id = host_identity()
+        self._export_sources: dict[bytes, tuple] = {}
+        self._export_segments: dict[bytes, Any] = {}
+        self._export_leases = LeaseTable()
+        self._export_lock = threading.Lock()
+        self._lease_sweep_at = 0.0
+        self.same_host_copy_hits = 0  # driver-side mapped-copy fetches
         self._pkg_hashes: dict[str, str] = {}
         # Refcount-zero eviction must also drop directory + lineage
         # entries, or they leak for the runtime's lifetime.
@@ -367,6 +379,8 @@ class Runtime:
             self._obj_server.register(
                 "fetch_plan", self._export_fetch_plan,
                 concurrent="pooled")
+            self._obj_server.register(
+                "unpin_object", self._export_leases.release)
             self._obj_server.start()
             self._export_addr = \
                 f"{_own_address()}:{self._obj_server.port}"
@@ -385,19 +399,125 @@ class Runtime:
         return None if reply is None else wrap_chunk_reply(reply)
 
     def _export_fetch_plan(self, id_bytes: bytes,
-                           puller_addr: str | None = None):
-        """Transfer plan for a driver-exported object: (size, holders).
-        Registers the puller so the NEXT puller fetches chunks from it
-        too — the driver seeds a broadcast once and receivers relay
-        (reference: the owner hands out locations via the object
-        directory; data flows node-to-node)."""
+                           puller_addr: str | None = None,
+                           puller_host: str | None = None):
+        """Transfer plan for a driver-exported object: (size, holders,
+        map_source). Registers the puller so the NEXT puller fetches
+        chunks from it too — the driver seeds a broadcast once and
+        receivers relay (reference: the owner hands out locations via
+        the object directory; data flows node-to-node). Co-hosted
+        pullers instead get a map source + pin lease and move no bytes
+        at all (same_host.py)."""
         from ray_tpu._private.node_executor import plan_holders
+        from ray_tpu._private.same_host import map_enabled
 
         total = self._export_store.size(id_bytes)
         if total is None:
             return None
+        map_info = None
+        if puller_addr and puller_host and map_enabled() \
+                and puller_host == self.host_id:
+            map_info = self._grant_export_lease(id_bytes, puller_addr)
+        reg_addr = None if map_info is not None else puller_addr
         return (total, plan_holders(
-            self._export_directory, id_bytes, puller_addr, total))
+            self._export_directory, id_bytes, reg_addr, total), map_info)
+
+    def _grant_export_lease(self, id_bytes: bytes,
+                            holder: str) -> dict | None:
+        with self._export_lock:
+            source = self._export_sources.get(id_bytes)
+        if source is None:
+            return None
+        kind, name, size = source[0], source[1], source[2]
+        key = source[3] if len(source) > 3 else b""
+        if kind == "arena":
+            if self.arena is None or self.arena.pin(key) is None:
+                return None
+            arena = self.arena
+            token = self._export_leases.grant(
+                id_bytes, holder, on_release=lambda: arena.unpin(key))
+        else:
+            token = self._export_leases.grant(id_bytes, holder)
+        return {"kind": kind, "name": name, "key": key, "size": size,
+                "host": self.host_id, "token": token}
+
+    def _register_export_source(self, id_bytes: bytes, header,
+                                buffers, size: int):
+        """Back a large export with named shared memory so same-host
+        daemons map it. Returns the buffer the framed bytes were
+        written into (a segment's memoryview), or None when the caller
+        should keep a heap blob (plane off / segment unavailable).
+
+        ≥ map threshold -> dedicated segment (consumers map zero-copy);
+        below it but arena-sized -> the driver's arena (consumers take
+        a cross-arena descriptor or one memcpy)."""
+        from multiprocessing import shared_memory
+
+        from ray_tpu._private import serialization
+        from ray_tpu._private.same_host import (
+            map_enabled,
+            map_min_bytes,
+        )
+        from ray_tpu._private.shm_store import ShmObjectWriter
+
+        if not map_enabled():
+            return None
+        if size >= map_min_bytes():
+            try:
+                seg = shared_memory.SharedMemory(create=True,
+                                                 size=max(size, 1))
+            except OSError:
+                return None  # /dev/shm full: heap blob + chunked pull
+            serialization.write_framed(seg.buf, header, buffers)
+            with self._export_lock:
+                self._export_sources[id_bytes] = ("seg", seg.name, size)
+                self._export_segments[id_bytes] = seg
+            return memoryview(seg.buf)[:size]
+        if self.arena is not None and size <= int(
+                GLOBAL_CONFIG.object_arena_max_object_bytes):
+            # Arena twin under the object id — the same key the export
+            # carries, so peers peek it by id after attaching. The
+            # export store keeps its own heap copy (the arena twin is
+            # evictable state; the store copy serves chunked pulls).
+            adesc = ShmObjectWriter.put_arena_serialized(
+                self.arena, id_bytes, header, buffers, size)
+            if adesc is not None:
+                with self._export_lock:
+                    self._export_sources[id_bytes] = (
+                        "arena", self.arena.name, size, id_bytes)
+                buf = bytearray(size)
+                serialization.write_framed(memoryview(buf), header,
+                                           buffers)
+                return bytes(buf)
+        return None
+
+    def _drop_export_source(self, id_bytes: bytes) -> None:
+        """Free path: release peers' leases, then the backing shared
+        memory. Unlink-while-mapped is safe for segments (POSIX keeps
+        existing mappings); arena twins need their pin dropped before
+        delete can take effect."""
+        with self._export_lock:
+            source = self._export_sources.pop(id_bytes, None)
+            seg = self._export_segments.pop(id_bytes, None)
+        if source is None:
+            return
+        self._export_leases.release_object(id_bytes)
+        if source[0] == "arena" and self.arena is not None:
+            self.arena.unpin(id_bytes)   # the seal_pinned creation ref
+            self.arena.delete(id_bytes)
+        if seg is not None:
+            try:
+                seg.unlink()
+            except (OSError, FileNotFoundError):
+                pass
+            try:
+                seg.close()
+            except (BufferError, OSError):
+                # An in-flight chunk read still views the mapping:
+                # leak it until process exit rather than invalidating.
+                from ray_tpu._private.shm_store import _defuse
+
+                _defuse(seg)
 
     def _watch_remote_nodes(self) -> None:
         """Mirror the head's node table into ClusterState, reacting to
@@ -624,14 +744,40 @@ class Runtime:
         with self._remote_nodes_lock:
             handle = self._remote_nodes.get(node_id)
         try:
-            if handle is not None:
+            # Co-hosted holder: one memcpy out of its shared memory
+            # beats a chunked pull (same_host.py); falls through to the
+            # chunked path when no map lease is granted.
+            from ray_tpu._private.same_host import (
+                fetch_mapped_blob,
+                map_enabled,
+            )
+
+            blob = None
+            if map_enabled() and self._export_addr:
+                call = (handle.pool.call if handle is not None else None)
+                if call is not None:
+                    blob = fetch_mapped_blob(
+                        call, object_id.binary(), self._export_addr,
+                        self.host_id)
+                    if blob is not None:
+                        self.same_host_copy_hits += 1
+            if blob is not None:
+                pass
+            elif handle is not None:
                 blob = handle.fetch(object_id.binary())
             else:
                 from ray_tpu._private.rpc import RpcClient
 
                 client = RpcClient(value.addr)
                 try:
-                    blob = fetch_blob(client, object_id.binary())
+                    if map_enabled() and self._export_addr:
+                        blob = fetch_mapped_blob(
+                            client.call, object_id.binary(),
+                            self._export_addr, self.host_id)
+                        if blob is not None:
+                            self.same_host_copy_hits += 1
+                    if blob is None:
+                        blob = fetch_blob(client, object_id.binary())
                 finally:
                     client.close()
             real = serialization.deserialize_from_buffer(memoryview(blob))
@@ -777,8 +923,30 @@ class Runtime:
                 break
 
     def _arg_pin_sweeper(self) -> None:
+        from ray_tpu._private.same_host import pin_ttl_s
+
         while not self._watcher_stop.wait(1.0):
             self._sweep_arg_pins()
+            # Export map leases: liveness-gated TTL expiry, so a
+            # SIGKILLed daemon cannot pin driver shared memory forever.
+            now = time.monotonic()
+            if now - self._lease_sweep_at >= 5.0:
+                self._lease_sweep_at = now
+                try:
+                    self._export_leases.sweep(pin_ttl_s(),
+                                              self._probe_peer)
+                except Exception:  # noqa: BLE001 — sweep is best-effort
+                    pass
+
+    @staticmethod
+    def _probe_peer(addr: str) -> bool:
+        from ray_tpu._private.rpc import RpcClient
+
+        probe = RpcClient(addr, timeout_s=2.0, connect_timeout_s=1.0)
+        try:
+            return probe.call("ping") == "pong"
+        finally:
+            probe.close()
 
     def submit_task(
         self,
@@ -1023,9 +1191,20 @@ class Runtime:
             if self._export_store is not None \
                     and _sizeof(value) > inline_max:
                 # Export once; every node pulls + caches it by id
-                # instead of the driver re-shipping per task.
-                blob = serialization.serialize_framed(value)
-                self._export_store.put(id_bytes, blob)
+                # instead of the driver re-shipping per task. Large
+                # exports serialize STRAIGHT into named shared memory
+                # (no transient heap copy): same-host daemons then map
+                # the segment/arena zero-copy, and the chunked
+                # cross-host path serves from the same mapping.
+                header, buffers = serialization.serialize(value)
+                size = serialization.framed_size(header, buffers)
+                shm_blob = self._register_export_source(
+                    id_bytes, header, buffers, size)
+                if shm_blob is not None:
+                    self._export_store.put(id_bytes, shm_blob)
+                else:
+                    blob = serialization.serialize_framed(value)
+                    self._export_store.put(id_bytes, blob)
                 return FetchRef(id_bytes, self._export_addr)
             return value
 
@@ -1274,6 +1453,7 @@ class Runtime:
             self._export_store.free([object_id.binary()])
         if self._export_directory is not None:
             self._export_directory.drop([object_id.binary()])
+        self._drop_export_source(object_id.binary())
         if node_id is not None:
             # Remote primary copy: tell the holder to drop it (owner-
             # driven GC — batched by the node watcher). Queue even when
@@ -2001,6 +2181,9 @@ class Runtime:
             if desc is not None:
                 self.shm_client.close_segment(desc.name)
                 self.shm_directory.free(r.id())
+            if self._export_store is not None:
+                self._export_store.free([r.id().binary()])
+            self._drop_export_source(r.id().binary())
 
     # -------------------------------------------------------------- futures
 
@@ -2086,6 +2269,29 @@ class Runtime:
             self.log_monitor = None
         self.shm_client.close_all()
         self.shm_directory.shutdown()
+        # Export twins: leases die with the runtime; segments must be
+        # unlinked here or they outlive the process in /dev/shm. The
+        # export store's memoryviews into them are dropped FIRST so the
+        # close doesn't trip on exported pointers.
+        self._export_leases.clear()
+        with self._export_lock:
+            export_ids = list(self._export_segments)
+            export_segs = list(self._export_segments.values())
+            self._export_segments.clear()
+            self._export_sources.clear()
+        if self._export_store is not None and export_ids:
+            self._export_store.free(export_ids)
+        for seg in export_segs:
+            try:
+                seg.unlink()
+            except (OSError, FileNotFoundError):
+                pass
+            try:
+                seg.close()
+            except (BufferError, OSError):
+                from ray_tpu._private.shm_store import _defuse
+
+                _defuse(seg)
         if self.arena is not None:
             self.arena.close()  # owner: destroys the shared arena
             os.environ.pop("RAY_TPU_ARENA_NAME", None)

@@ -151,11 +151,16 @@ class SingleAgentEnvRunner:
                 rng = jax.random.fold_in(base, start_t + i)
                 out = fwd(weights, {"obs": obs, "t": start_t + i}, rng)
                 actions = out["actions"]
-                env_state, next_obs, rew, term, trunc = env.step(
-                    env_state, actions)
+                # step_final: the TRUE successor obs (pre-auto-reset)
+                # rides along so fused fragments carry the same
+                # next_obs column — and semantics — as the step loop.
+                env_state, next_obs, rew, term, trunc, final = \
+                    env.step_final(env_state, actions)
                 ys = {Columns.OBS: obs, Columns.ACTIONS: actions,
                       Columns.REWARDS: rew, Columns.TERMINATEDS: term,
                       Columns.TRUNCATEDS: trunc}
+                if emit is None or Columns.NEXT_OBS in emit:
+                    ys[Columns.NEXT_OBS] = final
                 # Filtered columns never enter the scan's stacked
                 # outputs, so their device->host transfer is never paid.
                 for key, value in (
@@ -202,7 +207,7 @@ class SingleAgentEnvRunner:
         return batch
 
     _OPTIONAL_COLUMNS = (Columns.ACTION_LOGP, Columns.VF_PREDS,
-                         Columns.ACTION_LOGITS)
+                         Columns.ACTION_LOGITS, Columns.NEXT_OBS)
 
     def _filter_columns(self, batch: SampleBatch) -> SampleBatch:
         if self._emit_columns is None:
@@ -224,7 +229,7 @@ class SingleAgentEnvRunner:
         cols: dict[str, list] = {k: [] for k in (
             Columns.OBS, Columns.ACTIONS, Columns.REWARDS,
             Columns.TERMINATEDS, Columns.TRUNCATEDS, Columns.ACTION_LOGP,
-            Columns.VF_PREDS, Columns.ACTION_LOGITS)}
+            Columns.VF_PREDS, Columns.ACTION_LOGITS, Columns.NEXT_OBS)}
 
         state_in = (self._rnn_state.copy() if self._recurrent
                     else None)
@@ -248,6 +253,13 @@ class SingleAgentEnvRunner:
                 self._rnn_state = state
 
             cols[Columns.OBS].append(obs)
+            # TRUE successor observation: at terminated/truncated steps
+            # the env's returned obs is the NEXT episode's reset obs —
+            # final_obs carries the pre-reset one, which is what
+            # V(next_obs) bootstrap and offline logs must see.
+            final = getattr(self.env, "final_obs", None)
+            cols[Columns.NEXT_OBS].append(
+                next_obs if final is None else final)
             cols[Columns.ACTIONS].append(actions)
             cols[Columns.REWARDS].append(rewards)
             cols[Columns.TERMINATEDS].append(term)

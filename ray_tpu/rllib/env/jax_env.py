@@ -36,6 +36,14 @@ class JaxVectorEnv:
     def step(self, state, actions):
         raise NotImplementedError
 
+    def step_final(self, state, actions):
+        """step() plus the TRUE post-step observation BEFORE any
+        auto-reset (the successor obs of terminated/truncated steps —
+        vector_env.VectorEnv.final_obs's jittable analogue). Default:
+        the returned obs (correct for envs that never auto-reset)."""
+        state, obs, rew, term, trunc = self.step(state, actions)
+        return state, obs, rew, term, trunc, obs
+
 
 class JaxCartPole(JaxVectorEnv):
     """CartPole-v1 dynamics as a pure function (same constants and
@@ -71,7 +79,7 @@ class JaxCartPole(JaxVectorEnv):
                  "rng": rng}
         return state, s
 
-    def step(self, state, actions):
+    def step_final(self, state, actions):
         x, x_dot, theta, theta_dot = (state["s"][:, 0], state["s"][:, 1],
                                       state["s"][:, 2], state["s"][:, 3])
         force = jnp.where(actions == 1, self.FORCE_MAG, -self.FORCE_MAG)
@@ -97,12 +105,16 @@ class JaxCartPole(JaxVectorEnv):
         rewards = jnp.ones(self.num_envs, dtype=jnp.float32)
 
         done = terminated | truncated
+        final = s2.astype(jnp.float32)  # pre-reset successor obs
         rng, sub = jax.random.split(state["rng"])
         fresh = self._fresh(sub)
-        s2 = jnp.where(done[:, None], fresh, s2.astype(jnp.float32))
+        s2 = jnp.where(done[:, None], fresh, final)
         t2 = jnp.where(done, 0, t2)
         new_state = {"s": s2, "t": t2, "rng": rng}
-        return new_state, s2, rewards, terminated, truncated
+        return new_state, s2, rewards, terminated, truncated, final
+
+    def step(self, state, actions):
+        return self.step_final(state, actions)[:5]
 
 
 class JaxPendulum(JaxVectorEnv):
@@ -146,7 +158,7 @@ class JaxPendulum(JaxVectorEnv):
                  "t": jnp.zeros(self.num_envs, jnp.int32), "rng": rng}
         return state, self._obs(theta, thetadot)
 
-    def step(self, state, actions):
+    def step_final(self, state, actions):
         u = jnp.clip(jnp.asarray(actions, jnp.float32).reshape(-1),
                      -self.MAX_TORQUE, self.MAX_TORQUE)
         theta, thetadot = state["theta"], state["thetadot"]
@@ -162,6 +174,7 @@ class JaxPendulum(JaxVectorEnv):
 
         terminated = jnp.zeros(self.num_envs, dtype=bool)
         truncated = t2 >= self.max_steps
+        final = self._obs(theta, thetadot)  # pre-reset successor obs
         rng, sub = jax.random.split(state["rng"])
         f_theta, f_thetadot = self._fresh(sub)
         theta = jnp.where(truncated, f_theta, theta)
@@ -170,7 +183,11 @@ class JaxPendulum(JaxVectorEnv):
         new_state = {"theta": theta, "thetadot": thetadot, "t": t2,
                      "rng": rng}
         return (new_state, self._obs(theta, thetadot),
-                (-costs).astype(jnp.float32), terminated, truncated)
+                (-costs).astype(jnp.float32), terminated, truncated,
+                final)
+
+    def step(self, state, actions):
+        return self.step_final(state, actions)[:5]
 
 
 _JAX_ENVS = {"CartPole-v1": JaxCartPole, "Pendulum-v1": JaxPendulum}

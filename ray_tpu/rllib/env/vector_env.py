@@ -22,13 +22,21 @@ class VectorEnv:
     action_size: int = 0  # continuous envs; 0 for discrete
     # Continuous action bounds (symmetric box, one scalar for all dims).
     action_scale: float = 1.0
+    # The TRUE post-step observation of the last step(), BEFORE any
+    # auto-reset ([B, obs]); equals the returned obs for non-done lanes.
+    # Consumers that record transitions (offline writers, replay) need
+    # the real successor at terminated/truncated steps — the returned
+    # obs there is the NEXT episode's reset obs (reference: gymnasium's
+    # final_observation info of autoreset vector envs).
+    final_obs: np.ndarray | None = None
 
     def reset(self, seed: int | None = None) -> np.ndarray:
         raise NotImplementedError
 
     def step(self, actions: np.ndarray):
         """-> (obs, rewards, terminateds, truncateds). Auto-resets done
-        envs; the returned obs for a done env is the fresh reset obs."""
+        envs; the returned obs for a done env is the fresh reset obs
+        (the pre-reset one is kept in ``final_obs``)."""
         raise NotImplementedError
 
 
@@ -92,6 +100,7 @@ class CartPoleVectorEnv(VectorEnv):
         rewards = np.ones(self.num_envs, dtype=np.float32)
 
         done = terminated | truncated
+        self.final_obs = self._state.astype(np.float32)
         if done.any():
             self._state[done] = self._sample_state(int(done.sum()))
             self._t[done] = 0
@@ -160,6 +169,7 @@ class PendulumVectorEnv(VectorEnv):
 
         terminated = np.zeros(self.num_envs, dtype=bool)
         truncated = self._t >= self.max_steps
+        self.final_obs = self._obs()
         if truncated.any():
             n = int(truncated.sum())
             new_theta, new_thetadot = self._sample_state(n)
@@ -195,9 +205,21 @@ class GymVectorEnv(VectorEnv):
         return obs.reshape(self.num_envs, -1).astype(np.float32)
 
     def step(self, actions: np.ndarray):
-        obs, rewards, term, trunc, _ = self._env.step(np.asarray(actions))
-        return (obs.reshape(self.num_envs, -1).astype(np.float32),
-                rewards.astype(np.float32), term, trunc)
+        obs, rewards, term, trunc, infos = self._env.step(
+            np.asarray(actions))
+        flat = obs.reshape(self.num_envs, -1).astype(np.float32)
+        # gymnasium autoreset: the pre-reset observation of done lanes
+        # rides infos["final_observation"] (older API: "final_obs").
+        self.final_obs = flat.copy()
+        finals = infos.get("final_observation",
+                           infos.get("final_obs")) \
+            if isinstance(infos, dict) else None
+        if finals is not None:
+            for i, f in enumerate(finals):
+                if f is not None:
+                    self.final_obs[i] = np.asarray(
+                        f, dtype=np.float32).reshape(-1)
+        return (flat, rewards.astype(np.float32), term, trunc)
 
 
 _BUILTIN = {"CartPole-v1": CartPoleVectorEnv,

@@ -94,6 +94,13 @@ class OfflineWriter:
         truncs = np.asarray(frag[Columns.TRUNCATEDS])
         logp = np.asarray(frag[Columns.ACTION_LOGP]) \
             if Columns.ACTION_LOGP in frag else None
+        # TRUE per-step successors (env runners emit them with the
+        # pre-reset final obs at done steps). Without the column, done
+        # steps would otherwise self-loop (obs == next_obs), corrupting
+        # V(next_obs) bootstrap for offline consumers — the in-fragment
+        # successor obs[t+1] is the NEXT episode's reset obs there.
+        next_obs = np.asarray(frag[Columns.NEXT_OBS]) \
+            if Columns.NEXT_OBS in frag else None
         T, B = rewards.shape[:2]
         written = 0
         with self._lock:
@@ -110,11 +117,22 @@ class OfflineWriter:
                     written += 1
                 for t in range(T):
                     done = bool(terms[t, b]) or bool(truncs[t, b])
+                    if next_obs is not None:
+                        successor = next_obs[t, b].tolist()
+                    elif done:
+                        # No successor column: keep the legacy
+                        # self-loop ONLY as a last resort (documented:
+                        # bootstrap at done steps then uses the
+                        # pre-step obs; terminated steps mask V(s')
+                        # anyway, truncated ones lose accuracy).
+                        successor = obs[t, b].tolist()
+                    elif t + 1 < T:
+                        successor = obs[t + 1, b].tolist()
+                    else:
+                        successor = None
                     row: dict[str, Any] = {
                         "obs": obs[t, b].tolist(),
-                        "next_obs": (obs[t, b] if done
-                                     else obs[t + 1, b]).tolist()
-                        if (done or t + 1 < T) else None,
+                        "next_obs": successor,
                         "actions": np.asarray(actions[t, b]).tolist(),
                         "rewards": float(rewards[t, b]),
                         "terminateds": bool(terms[t, b]),

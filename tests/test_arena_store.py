@@ -206,6 +206,39 @@ v = a.create_for_write({leak_key!r}, 400_000)
     assert stats["num_evictions"] >= 1
 
 
+def test_peek_locates_without_pinning(arena):
+    """peek returns a stable (offset, size) without touching the
+    refcount — the object stays evictable (the same-host plane's
+    read-only peer path; the OWNER pins via the lease)."""
+    oid = os.urandom(16)
+    payload = b"peekable" * 1000
+    arena.put_bytes(oid, [payload])
+    peek = arena.peek(oid)
+    assert peek is not None
+    offset, size = peek
+    assert size == len(payload)
+    assert bytes(arena.view_at(offset, size)) == payload
+    # Unsealed/absent objects are invisible to peek.
+    assert arena.peek(os.urandom(16)) is None
+    # Peeking took no reference: pressure evicts the object.
+    for _ in range(8):
+        arena.put_bytes(os.urandom(16), [b"e" * 200_000])
+    assert arena.peek(oid) is None
+
+
+def test_pin_blocks_eviction_until_unpin(arena):
+    oid = os.urandom(16)
+    arena.put_bytes(oid, [b"pinme" * 1000])
+    assert arena.pin(oid) == 5000
+    for _ in range(10):
+        arena.put_bytes(os.urandom(16), [b"x" * 200_000])
+    assert arena.get_bytes(oid) == b"pinme" * 1000
+    arena.unpin(oid)
+    for _ in range(10):
+        arena.put_bytes(os.urandom(16), [b"y" * 200_000])
+    assert arena.get_bytes(oid) is None
+
+
 def test_empty_object_roundtrip(arena):
     oid = os.urandom(16)
     assert arena.put_bytes(oid, [])

@@ -131,9 +131,14 @@ def test_peer_miss_falls_back_to_owner(executor_trio, monkeypatch):
 def test_multi_node_broadcast_peers_serve_chunks():
     """End-to-end: a driver-exported object broadcast to 3 daemons; at
     least one NON-OWNER daemon serves chunks to another (the owner no
-    longer carries every byte N times)."""
+    longer carries every byte N times).
+
+    The same-host plane is disabled so this exercises the CROSS-HOST
+    chunked path (on one box every daemon would otherwise just map the
+    driver's segment and no chunk would ever move)."""
     ray_tpu.shutdown()
     os.environ["RAY_TPU_FETCH_CHUNK_KB"] = "256"
+    os.environ["RAY_TPU_SAME_HOST_PLANE"] = "0"
     cluster = Cluster(log_dir="/tmp/ray_tpu_test_p2p")
     try:
         for _ in range(3):
@@ -170,6 +175,7 @@ def test_multi_node_broadcast_peers_serve_chunks():
         ray_tpu.shutdown()
         cluster.shutdown()
         os.environ.pop("RAY_TPU_FETCH_CHUNK_KB", None)
+        os.environ.pop("RAY_TPU_SAME_HOST_PLANE", None)
         from ray_tpu._private.config import GLOBAL_CONFIG
 
         GLOBAL_CONFIG.reset()

@@ -639,6 +639,7 @@ def test_sac_rejects_learner_actors():
 # ------------------------------------------------------------------ ES / CQL
 
 
+@pytest.mark.slow  # long-running; excluded from the tier-1 gate (-m 'not slow')
 def test_es_improves_cartpole(ray_start_regular):
     """Evolution strategies: population evaluations fan out as tasks;
     the mean policy's return improves over a few generations."""
@@ -703,6 +704,7 @@ def _pendulum_offline_rows(n: int, seed: int = 0) -> list[dict]:
     return rows[:n]
 
 
+@pytest.mark.slow  # long-running; excluded from the tier-1 gate (-m 'not slow')
 def test_cql_trains_offline_with_conservative_penalty(ray_start_regular):
     """CQL: pure offline updates; the conservative penalty is active
     (reported metric) and pushes data-action Q above random-action Q."""

@@ -446,6 +446,7 @@ def test_dt_module_causality():
     assert not np.allclose(base[:, 4:], pert[:, 4:])
 
 
+@pytest.mark.slow  # long-running; excluded from the tier-1 gate (-m 'not slow')
 def test_dt_learns_cartpole_from_offline(ray_start_regular):
     from ray_tpu.rllib import DTConfig
 

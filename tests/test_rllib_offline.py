@@ -78,6 +78,39 @@ def test_offline_writer_reader_roundtrip(tmp_path):
     # test_rllib_families on schema-matched continuous-control rows.
 
 
+def test_offline_writer_records_true_terminal_successor(tmp_path):
+    """ADVICE r5 / ISSUE 2 satellite: terminated (and truncated) rows
+    must carry the TRUE successor observation — the env's pre-reset
+    final obs — not a same-step self-loop and not the next episode's
+    reset obs. CartPole terminates OUT OF BOUNDS, so the real successor
+    is verifiable: |x| > 2.4 or |theta| > 12 deg."""
+    from ray_tpu.rllib import PPOConfig
+    from ray_tpu.rllib.offline import read_offline_dataset
+
+    out = str(tmp_path / "exp_term")
+    config = (PPOConfig()
+              .environment("CartPole-v1")
+              .env_runners(num_env_runners=0,
+                           num_envs_per_env_runner=8,
+                           rollout_fragment_length=64)
+              .offline_output(out))
+    algo = config.build()
+    algo.train()
+    algo.cleanup()
+
+    rows = read_offline_dataset(out).take_all()
+    term_rows = [r for r in rows if r["terminateds"]]
+    assert term_rows, "no terminated steps sampled"
+    theta_limit = 12 * 2 * np.pi / 360
+    for r in term_rows:
+        assert not np.allclose(r["next_obs"], r["obs"], atol=1e-7), \
+            "terminal next_obs self-loops to the same-step obs"
+        x, _, theta, _ = r["next_obs"]
+        assert abs(x) > 2.4 or abs(theta) > theta_limit, \
+            f"terminal next_obs {r['next_obs']} is not the " \
+            f"out-of-bounds successor (reset obs leaked in?)"
+
+
 def test_offline_json_format(tmp_path):
     from ray_tpu.rllib.offline import OfflineWriter, read_offline_dataset
     from ray_tpu.rllib.utils.sample_batch import SampleBatch

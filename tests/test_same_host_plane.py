@@ -1,0 +1,324 @@
+"""Same-host zero-copy object plane: co-hosted daemons map each
+other's shared memory (segments / the native arena) instead of
+chunk-pulling bytes over RPC, under a pin/lease protocol that keeps
+mapped objects alive until release (or a liveness-gated TTL when the
+puller died).
+
+Reference intent: plasma is host-shared by design
+(src/ray/object_manager/plasma/store_runner.h) — one store serves every
+process on the node; here that property is extended across co-hosted
+daemons."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu._private import serialization
+from ray_tpu._private.node_executor import FetchRef, NodeExecutorService
+from ray_tpu._private.same_host import LeaseTable, host_identity
+from ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def executor_pair():
+    """Owner + puller executors in-process, sharing this host's
+    identity (the default)."""
+    services = []
+    for _ in range(2):
+        svc = NodeExecutorService(host="127.0.0.1", pool_size=1,
+                                  resources={"CPU": 1})
+        svc.advertised_address = f"127.0.0.1:{svc.port}"
+        svc.start()
+        services.append(svc)
+    yield services
+    for svc in services:
+        svc.stop()
+
+
+def _store_exported(svc, payload: bytes) -> bytes:
+    blob = serialization.serialize_framed(payload)
+    oid = os.urandom(16)
+    svc.store.put(oid, blob, owner="test-owner")
+    svc._maybe_export_stored(oid, blob)
+    return oid
+
+
+def test_same_host_copy_short_circuits_chunk_pull(executor_pair):
+    """A co-hosted fetch moves no bytes through the transport: one
+    memcpy out of the owner's segment, zero chunk fetches served."""
+    owner, puller = executor_pair
+    payload = os.urandom(3 << 20)
+    oid = _store_exported(owner, payload)
+
+    got = puller._load_object(FetchRef(oid, owner.advertised_address))
+    assert got == payload
+    assert puller.same_host_copy_hits == 1
+    assert puller.chunked_pulls == 0
+    assert owner.store.stats().get("fetches_served", 0) == 0
+
+
+def test_same_host_map_hands_workers_the_owner_segment(executor_pair):
+    """The worker-bound path maps the OWNER's segment zero-copy (the
+    descriptor names the owner's shm, not a local copy), the owner
+    pins it under a lease, and freeing the arg releases the lease."""
+    from ray_tpu._private.shm_store import ShmClient
+
+    owner, puller = executor_pair
+    payload = os.urandom(3 << 20)
+    oid = _store_exported(owner, payload)
+    owner_source = owner._map_sources[oid]
+
+    desc = puller._shm_fetch_blob(FetchRef(oid, owner.advertised_address))
+    assert desc.name == owner_source[1]  # the owner's segment, mapped
+    assert puller.same_host_map_hits == 1
+    assert owner.leases.stats()["active"] == 1
+
+    client = ShmClient()
+    try:
+        assert client.get(desc) == payload
+    finally:
+        client.close_all()
+
+    puller.free_objects([oid])
+    deadline = time.time() + 10
+    while time.time() < deadline and owner.leases.stats()["active"]:
+        time.sleep(0.05)
+    assert owner.leases.stats()["active"] == 0
+
+
+def test_cross_host_pullers_fall_back_to_chunked(monkeypatch):
+    """A puller with a DIFFERENT host identity never gets a map lease:
+    the chunked pull carries the bytes (the cross-host path)."""
+    owner = NodeExecutorService(host="127.0.0.1", pool_size=1,
+                                resources={"CPU": 1})
+    owner.advertised_address = f"127.0.0.1:{owner.port}"
+    owner.start()
+    monkeypatch.setenv("RAY_TPU_HOST_ID", "other-host")
+    puller = NodeExecutorService(host="127.0.0.1", pool_size=1,
+                                 resources={"CPU": 1})
+    puller.advertised_address = f"127.0.0.1:{puller.port}"
+    puller.start()
+    try:
+        assert puller.host_id != owner.host_id
+        payload = os.urandom(2 << 20)
+        oid = _store_exported(owner, payload)
+        got = puller._load_object(
+            FetchRef(oid, owner.advertised_address))
+        assert got == payload
+        assert puller.same_host_map_hits == 0
+        assert puller.same_host_copy_hits == 0
+        assert puller.chunked_pulls == 1
+        assert owner.leases.stats()["granted"] == 0
+    finally:
+        owner.stop()
+        puller.stop()
+
+
+# ------------------------------------------------- pin/lease protocol
+
+
+@pytest.fixture
+def arena():
+    from ray_tpu._private.arena_store import ArenaStore
+
+    store = ArenaStore.create(f"/rt_lease_{os.getpid()}", 1 << 20, 256)
+    if store is None:
+        pytest.skip("native toolchain unavailable")
+    yield store
+    store.close()
+
+
+def _seal_arena_object(arena, payload: bytes) -> bytes:
+    key = os.urandom(16)
+    view = arena.create_for_write(key, len(payload))
+    view[:] = payload
+    arena.seal(key)
+    return key
+
+
+def test_lease_pins_object_through_arena_pressure(arena):
+    """Eviction-while-mapped: an object pinned via the lease protocol
+    survives heavy arena pressure with its mapped bytes intact; after
+    release it is evictable like anything else."""
+    payload = b"M" * 100_000
+    key = _seal_arena_object(arena, payload)
+
+    leases = LeaseTable()
+    assert arena.pin(key) == len(payload)
+    token = leases.grant(key, "holder:1",
+                         on_release=lambda: arena.unpin(key))
+    offset, size = arena.peek(key)
+
+    # Owner-side pressure: enough sealed churn to evict everything
+    # unpinned several times over.
+    for _ in range(40):
+        arena.put_bytes(os.urandom(16), [b"p" * 200_000])
+    assert arena.stats()["num_evictions"] > 0
+    # The mapped view (offset fixed at pin time) still reads the
+    # object's bytes — eviction could not reuse the pinned range.
+    assert bytes(arena.view_at(offset, size)) == payload
+    assert arena.peek(key) == (offset, size)
+
+    leases.release(token)  # unpins
+    for _ in range(10):
+        arena.put_bytes(os.urandom(16), [b"q" * 300_000])
+    assert arena.peek(key) is None  # evicted once unpinned
+
+
+def test_ttl_expires_pins_of_dead_pullers(arena):
+    """A puller that died holding a pin cannot pin forever: once the
+    lease outlives the TTL and the holder fails its liveness probe,
+    the sweep releases the pin."""
+    payload = b"T" * 50_000
+    key = _seal_arena_object(arena, payload)
+    leases = LeaseTable()
+    assert arena.pin(key) is not None
+    leases.grant(key, "dead-holder:1",
+                 on_release=lambda: arena.unpin(key))
+
+    # Within TTL: nothing expires even with a dead holder.
+    assert leases.sweep(ttl_s=60.0, probe=lambda a: False) == 0
+    # A LIVE holder past the TTL keeps its lease.
+    assert leases.sweep(ttl_s=0.0, probe=lambda a: True) == 0
+    assert leases.stats()["active"] == 1
+    # Dead holder past the TTL: swept, pin dropped, object evictable.
+    assert leases.sweep(ttl_s=0.0, probe=lambda a: False) == 1
+    assert leases.stats()["active"] == 0
+    for _ in range(10):
+        arena.put_bytes(os.urandom(16), [b"r" * 300_000])
+    assert arena.peek(key) is None
+
+
+def test_executor_sweep_releases_dead_puller_lease(executor_pair,
+                                                   monkeypatch):
+    """End-to-end TTL: the owner's transfer-plane sweep unpins a lease
+    whose holder address no longer answers (the puller was killed)."""
+    owner, puller = executor_pair
+    payload = os.urandom(2 << 20)
+    oid = _store_exported(owner, payload)
+    desc = puller._shm_fetch_blob(FetchRef(oid, owner.advertised_address))
+    assert desc is not None and owner.leases.stats()["active"] == 1
+
+    # Simulate puller death: rewrite the lease holder to a dead port so
+    # the probe fails, and force the TTL to zero.
+    monkeypatch.setenv("RAY_TPU_SAME_HOST_PIN_TTL_S", "0.0")
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    GLOBAL_CONFIG.reset()
+    try:
+        with owner.leases._lock:
+            for token, lease in list(owner.leases._leases.items()):
+                owner.leases._leases[token] = (
+                    lease[0], "127.0.0.1:1", lease[2], lease[3])
+        owner._sweep_transfer_plane()
+        assert owner.leases.stats()["active"] == 0
+        assert owner.leases.stats()["expired"] == 1
+    finally:
+        # monkeypatch restores the env var; the config must re-read it
+        # or later tests inherit the zero TTL.
+        monkeypatch.undo()
+        GLOBAL_CONFIG.reset()
+
+
+# ---------------------------------------------------- cluster-level
+
+
+def test_cluster_broadcast_rides_the_map_path():
+    """Driver-exported broadcast on co-hosted daemons: every daemon
+    maps the driver's segment (map hits), no daemon chunk-pulls, and
+    the task results are correct."""
+    ray_tpu.shutdown()
+    os.environ["RAY_TPU_SAME_HOST_MAP_MIN_KB"] = "64"
+    cluster = Cluster(log_dir="/tmp/ray_tpu_test_samehost")
+    try:
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        GLOBAL_CONFIG.reset()
+        for _ in range(2):
+            cluster.add_node(num_cpus=1)
+        assert cluster.wait_for_nodes(2, timeout=60)
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        deadline = time.time() + 30
+        while time.time() < deadline and \
+                ray_tpu.cluster_resources().get("CPU", 0) < 2:
+            time.sleep(0.2)
+
+        blob = np.arange(2 << 20, dtype=np.uint8)  # 2 MiB
+        ref = ray_tpu.put(blob)
+
+        @ray_tpu.remote(num_cpus=1, scheduling_strategy="SPREAD")
+        def touch(arr):
+            return int(arr[-1]) + len(arr)
+
+        outs = ray_tpu.get([touch.remote(ref) for _ in range(2)],
+                           timeout=120)
+        assert len(set(outs)) == 1
+
+        # GCS node table carries the host identity.
+        nodes = runtime.gcs_client.call("list_nodes")
+        workers = [n for n in nodes if n.get("executor_address")]
+        assert all(n.get("host_id") == host_identity() for n in workers)
+
+        map_hits = chunked = 0
+        with runtime._remote_nodes_lock:
+            handles = list(runtime._remote_nodes.values())
+        for handle in handles:
+            stats = handle._control.call("executor_stats")
+            map_hits += stats["data_plane"]["same_host_map_hits"]
+            chunked += stats["data_plane"]["chunked_pulls"]
+        assert map_hits >= 2, f"broadcast did not ride the map path: " \
+            f"map={map_hits} chunked={chunked}"
+        assert chunked == 0
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+        os.environ.pop("RAY_TPU_SAME_HOST_MAP_MIN_KB", None)
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        GLOBAL_CONFIG.reset()
+
+
+def test_cluster_arena_export_feeds_workers_cross_arena():
+    """Mid-size exports (arena-sized, below the map threshold) ride
+    the driver's ARENA: the daemon hands its pool worker a cross-arena
+    descriptor, the worker attaches the driver's arena and copies the
+    payload out once — no chunked pull."""
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir="/tmp/ray_tpu_test_samehost_arena")
+    try:
+        cluster.add_node(num_cpus=1)
+        assert cluster.wait_for_nodes(1, timeout=60)
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        if runtime.arena is None:
+            pytest.skip("native arena unavailable")
+        deadline = time.time() + 30
+        while time.time() < deadline and \
+                ray_tpu.cluster_resources().get("CPU", 0) < 1:
+            time.sleep(0.2)
+
+        # Above the inline threshold (256 KiB), below the arena object
+        # cap (1 MiB) and the map threshold (1 MiB) -> arena source.
+        payload = np.full(90_000, 7, dtype=np.int64)  # ~720 KB
+        ref = ray_tpu.put(payload)
+
+        @ray_tpu.remote(num_cpus=1)
+        def consume(x):
+            return int(x.sum())
+
+        assert ray_tpu.get(consume.remote(ref), timeout=120) \
+            == 7 * 90_000
+        assert any(s[0] == "arena"
+                   for s in runtime._export_sources.values())
+        with runtime._remote_nodes_lock:
+            handles = list(runtime._remote_nodes.values())
+        stats = [h._control.call("executor_stats") for h in handles]
+        assert sum(s["data_plane"]["same_host_map_hits"]
+                   for s in stats) >= 1
+        assert sum(s["data_plane"]["chunked_pulls"]
+                   for s in stats) == 0
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
