@@ -156,13 +156,42 @@ def main() -> None:
     t_cancel = time.monotonic() - t0
     probe = ray_tpu.get(noop.remote(-1), timeout=120.0)
     assert probe == -1
+    # Per-stage drain counters (dispatch / rpc / worker / seal):
+    # driver-side stages from the runtime, daemon-side stages summed
+    # over the nodes' executor_stats — a throughput regression in a
+    # future refresh localizes to one stage in this row.
+    stages: dict = {}
+    try:
+        from ray_tpu._private.worker import global_runtime
+
+        runtime = global_runtime()
+        stages = runtime.execution_pipeline_stats()
+        rpc = {"batch_rpcs": 0, "batch_tasks": 0, "reply_groups": 0}
+        wrk = {"lease_runs": 0, "lease_tasks": 0, "pipelined_frames": 0}
+        with runtime._remote_nodes_lock:
+            handles = list(runtime._remote_nodes.values())
+        for handle in handles:
+            pipe = handle._control.call("executor_stats").get(
+                "pipeline", {})
+            rpc["batch_rpcs"] += int(pipe.get("batch_rpcs", 0))
+            rpc["batch_tasks"] += int(pipe.get("batch_tasks", 0))
+            rpc["reply_groups"] += int(pipe.get("reply_groups", 0))
+            wrk["lease_runs"] += int(pipe.get("worker_lease_runs", 0))
+            wrk["lease_tasks"] += int(pipe.get("worker_lease_tasks", 0))
+            wrk["pipelined_frames"] += int(
+                pipe.get("worker_pipelined_frames", 0))
+        stages["rpc"] = rpc
+        stages["worker"] = wrk
+    except Exception as exc:  # noqa: BLE001 — counters are best-effort
+        stages["error"] = repr(exc)
     record("tasks", n=N_TASKS, ok=True,
            submit_wall_s=round(t_submit, 1),
            submit_per_s=round(N_TASKS / t_submit, 1),
            drained=drain_n,
            drain_wall_s=round(t_drain, 1),
            throughput_per_s=round(drain_n / t_drain, 1),
-           cancel_remaining_wall_s=round(t_cancel, 1))
+           cancel_remaining_wall_s=round(t_cancel, 1),
+           drain_stages=stages)
     del refs, out
 
     # -- phase 4: 1 GiB broadcast -----------------------------------------

@@ -81,6 +81,10 @@ _DEFAULTS: dict[str, Any] = {
     "rpc_pipeline_depth": 8,           # in-flight chunk fetches per pull
     "rpc_batch_flush_ms": 0.0,         # coalescing linger; 0 = natural
     "rpc_batch_max_entries": 128,      # max calls per batched frame
+    # Pipelined task execution (batched dispatch -> execute_task_batch
+    # -> multi-task worker leases -> grouped completion replies).
+    "dispatch_batch_max": 32,          # tasks per execute_task_batch RPC
+    "worker_pipeline_depth": 4,        # frames in flight per worker lease
     # P2P chunked broadcast (reference: the object manager's chunked
     # Push/Pull fans transfers out peer-to-peer via the directory).
     "broadcast_chunk_fanout": 4,       # peer sources used per pull

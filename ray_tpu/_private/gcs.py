@@ -365,6 +365,18 @@ class GlobalControlService:
                 return
             self._task_events[event.task_id] = event
 
+    def record_task_events(self, events: "list[TaskEvent]") -> None:
+        """Coalesced state recording: one lock pass for a whole batch
+        of task transitions (the pipelined execute path records a
+        dispatch batch's RUNNING — and each completion group's
+        FINISHED — in a single call)."""
+        with self._lock:
+            for event in events:
+                if len(self._task_events) >= self._task_event_limit \
+                        and event.task_id not in self._task_events:
+                    continue
+                self._task_events[event.task_id] = event
+
     def get_task_event(self, task_id: TaskID) -> TaskEvent | None:
         with self._lock:
             return self._task_events.get(task_id)

@@ -1,0 +1,73 @@
+"""Version-portability shims for the handful of jax APIs that moved
+between jax 0.4.x and 0.5+.
+
+The model/parallelism code targets the modern ambient-mesh world
+(``jax.set_mesh`` + ``jax.shard_map`` + abstract meshes). Older jax
+(< 0.5) spells these ``jax.experimental.shard_map.shard_map`` (with
+``check_rep`` instead of ``check_vma``) and has no ambient abstract
+mesh — only the ``with mesh:`` physical-mesh context. These wrappers
+pick whichever spelling the installed jax provides so importing the
+library never raises AttributeError on an older jax; call sites that
+genuinely need ``jax.set_mesh`` semantics should gate on
+:data:`HAS_SET_MESH` (tests skip via the same flag).
+"""
+
+from __future__ import annotations
+
+import jax
+
+#: True when this jax has the ambient-mesh API (jax.set_mesh /
+#: jax.sharding.get_abstract_mesh). Tests that drive models under
+#: ``with jax.set_mesh(...)`` skip when False.
+HAS_SET_MESH = hasattr(jax, "set_mesh")
+
+
+def ambient_mesh():
+    """The ambient mesh, or None when none is set (or unknowable).
+
+    New jax: ``jax.sharding.get_abstract_mesh()`` (empty mesh → None).
+    Old jax: the ``with mesh:`` physical-mesh context, which is what
+    pjit-era code used as its ambient mesh.
+    """
+    getter = getattr(jax.sharding, "get_abstract_mesh", None)
+    if getter is not None:
+        mesh = getter()
+        return None if mesh.empty else mesh
+    try:
+        from jax.interpreters import pxla
+
+        mesh = pxla.thread_resources.env.physical_mesh
+        return None if mesh.empty else mesh
+    except Exception:  # noqa: BLE001 — no context machinery at all
+        return None
+
+
+def shard_map(f, *, mesh=None, in_specs, out_specs, check_vma=None):
+    """``jax.shard_map`` (new) or the jax.experimental spelling (old).
+
+    ``mesh=None`` means "use the ambient mesh": passed through on new
+    jax, resolved via :func:`ambient_mesh` for the legacy API (which
+    requires an explicit mesh). ``check_vma`` maps to the legacy
+    ``check_rep``.
+    """
+    new = getattr(jax, "shard_map", None)
+    if new is not None:
+        kwargs = {"in_specs": in_specs, "out_specs": out_specs}
+        if mesh is not None:
+            kwargs["mesh"] = mesh
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return new(f, **kwargs)
+    from jax.experimental.shard_map import shard_map as legacy
+
+    if mesh is None:
+        mesh = ambient_mesh()
+        if mesh is None:
+            raise RuntimeError(
+                "shard_map needs a mesh: this jax has no ambient-mesh "
+                "API (jax.set_mesh) — pass mesh= explicitly, enter a "
+                "`with mesh:` context, or upgrade jax")
+    kwargs = {"mesh": mesh, "in_specs": in_specs, "out_specs": out_specs}
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    return legacy(f, **kwargs)

@@ -544,6 +544,100 @@ class _PartialBlob:
         self.done.set()
 
 
+class _PipelineInflight:
+    """Per-lease token ordering for the pipelined execute path, used
+    for blocked-head parking: when the task at a lease's pipe head
+    blocks in a nested get(), the frames queued behind it are sent but
+    NOT running — their CPU reservations must be returned (daemon
+    ledger via task_block, driver ledger via a streamed "parked"
+    notification) or a nested child needing that capacity deadlocks
+    against tasks that cannot start until the head resumes."""
+
+    def __init__(self, service: "NodeExecutorService"):
+        self._service = service
+        self._lock = threading.Lock()
+        self._leases: dict = {}        # lease key -> [token, ...]
+        self._token_lease: dict = {}   # token -> lease key
+        self._parked: set = set()
+        # notify(kind, tokens): stream a parked/resumed control part to
+        # the owning driver; installed per batch by the handler.
+        self._notify: dict = {}        # token -> notify callable
+
+    def register_notify(self, tokens, notify) -> None:
+        with self._lock:
+            for token in tokens:
+                self._notify[token] = notify
+
+    def forget_notify(self, tokens) -> None:
+        with self._lock:
+            for token in tokens:
+                self._notify.pop(token, None)
+
+    def sent(self, key, token) -> None:
+        with self._lock:
+            self._leases.setdefault(key, []).append(token)
+            self._token_lease[token] = key
+
+    def done(self, key, token) -> None:
+        resumed = None
+        with self._lock:
+            order = self._leases.get(key)
+            if order is None:
+                return
+            try:
+                order.remove(token)
+            except ValueError:
+                pass
+            self._token_lease.pop(token, None)
+            self._parked.discard(token)
+            if not order:
+                self._leases.pop(key, None)
+            elif order[0] in self._parked:
+                # The next frame starts executing the moment this
+                # reply was written: it is no longer parked.
+                resumed = order[0]
+                self._parked.discard(resumed)
+        if resumed is not None:
+            self._service.task_unblock(resumed)
+            self._fire(resumed, "resumed")
+
+    def drop_lease(self, key) -> None:
+        """Lease died (worker crash): unpark everything it held —
+        unstarted frames are requeued and re-tracked on a new lease."""
+        with self._lock:
+            order = self._leases.pop(key, [])
+            parked = [t for t in order if t in self._parked]
+            for token in order:
+                self._token_lease.pop(token, None)
+                self._parked.discard(token)
+        for token in parked:
+            self._service.task_unblock(token)
+            self._fire(token, "resumed")
+
+    def on_block(self, token) -> None:
+        """A running task blocked in a nested get(): park every frame
+        queued behind it on its lease."""
+        with self._lock:
+            key = self._token_lease.get(token)
+            order = self._leases.get(key) if key is not None else None
+            if not order or order[0] != token:
+                return
+            parked = [t for t in order[1:] if t not in self._parked]
+            self._parked.update(parked)
+        for queued in parked:
+            self._service.task_block(queued)
+            self._fire(queued, "parked")
+
+    def _fire(self, token, kind: str) -> None:
+        with self._lock:
+            notify = self._notify.get(token)
+        if notify is not None:
+            try:
+                notify(kind, token)
+            except Exception:  # noqa: BLE001 — stream gone
+                pass
+
+
 class _ActorNewError(Exception):
     """Daemon-actor constructor failed; carries the serialized
     (exception, traceback) blob from the worker."""
@@ -742,8 +836,17 @@ class NodeExecutorService:
         self._blocked_cpu: dict[str, float] = {}
         self._func_cache: dict[str, Callable] = {}
         self._func_lock = threading.Lock()
+        # Raw function blobs by digest: the batch path forwards these
+        # to pool workers verbatim (the daemon never loads them).
+        self._func_blob_cache: dict[str, bytes] = {}
         # need_func retries fetch their stashed args by nonce (bounded).
         self._stashed_args: dict[str, bytes] = {}
+        # Pipelined execute path: per-lease frame ordering for
+        # blocked-head parking + the per-stage drain counters.
+        self._pipeline_inflight = _PipelineInflight(self)
+        self.batch_rpcs = 0          # execute_task_batch calls served
+        self.batch_tasks_received = 0
+        self.reply_groups = 0        # grouped completion parts emitted
         # Driver import paths adopted via adopt_sys_path; forwarded to
         # pool workers with each task so by-reference pickles resolve.
         self._driver_sys_path: list[str] = []
@@ -756,6 +859,13 @@ class NodeExecutorService:
         # Actor plane: actor key (bytes) -> _DaemonActor.
         self._actors: dict[bytes, _DaemonActor] = {}
         self._actors_lock = threading.Lock()
+        # Creation gate: keys whose constructor is in flight. An
+        # actor_call declaring awaiting_create waits here instead of
+        # bouncing "gone" — the driver pipelines __init__ with the
+        # first method call(s) and the daemon orders them.
+        self._actors_creating: set[bytes] = set()
+        self._actors_creating_cond = threading.Condition(
+            self._actors_lock)
         # Prestarted standby workers for actor creation, keyed by the
         # spawn-relevant env (client addr); refilled asynchronously so
         # forks overlap RPC waits instead of sitting on the creation
@@ -784,6 +894,8 @@ class NodeExecutorService:
         # connection carries all of a driver's in-flight work (reference:
         # async completion queues, client_call.h — not a socket per task).
         s.register("execute_task", self.execute_task, concurrent=True)
+        s.register("execute_task_batch", self.execute_task_batch,
+                   concurrent=True, streaming=True)
         s.register("fetch_object", self.fetch_object,
                    concurrent="pooled")
         s.register("fetch_plan", self.fetch_plan, concurrent="pooled")
@@ -984,6 +1096,9 @@ class NodeExecutorService:
         try:
             with self._func_lock:
                 func = self._func_cache.get(digest)
+                if func_blob is not None:
+                    # Raw blob kept for the batch path's pool forwards.
+                    self._func_blob_cache[digest] = func_blob
             if func is None:
                 if func_blob is None:
                     # Stash the args so the retry ships the function
@@ -1092,6 +1207,228 @@ class NodeExecutorService:
             self._running[token] = demand
         self._notify_load()
         return True
+
+    def _try_reserve_many(self, wants: list) -> list[bool]:
+        """Batched admission: one lock pass reserves every entry that
+        fits (per-entry accept/reject — a saturating batch admits its
+        prefix and the rest spill, exactly like per-task admission)."""
+        out = []
+        with self._running_lock:
+            for token, demand in wants:
+                ok = True
+                for key, cap in self._resources.items():
+                    used = sum(float(d.get(key, 0.0))
+                               for d in self._running.values())
+                    if used + float(demand.get(key, 0.0)) \
+                            > float(cap) + 1e-9:
+                        ok = False
+                        break
+                if ok:
+                    self._running[token] = demand
+                out.append(ok)
+        if any(out):
+            self._notify_load()
+        return out
+
+    @staticmethod
+    def _needs_dedicated_worker(runtime_env: dict | None) -> bool:
+        """Entries whose runtime_env demands a fresh interpreter
+        (containers, import-sensitive jax/XLA env vars) cannot ride a
+        shared pipelined lease."""
+        if not runtime_env:
+            return False
+        if runtime_env.get("container"):
+            return True
+        from ray_tpu._private.worker_pool import WorkerPool
+
+        return bool(WorkerPool._import_sensitive_env_vars(runtime_env))
+
+    def _pipe_reply_to_task_reply(self, return_keys: list, status: str,
+                                  payload, owner: str | None) -> tuple:
+        """Worker-pipe batch completion -> the execute_task per-task
+        reply shape. Inline worker results are already framed blobs, so
+        small results cross daemon-side with ZERO deserialize/
+        re-serialize passes (the classic path pays both)."""
+        from ray_tpu.exceptions import WorkerCrashedError
+
+        if status == "crash":
+            # Normalize to WorkerCrashedError (the payload may be a
+            # pool-internal _WorkerUnavailable) so the driver's retry
+            # policy recognizes the system failure.
+            if isinstance(payload, WorkerCrashedError):
+                exc = payload
+            else:
+                exc = WorkerCrashedError(str(payload))
+                exc.__cause__ = payload if isinstance(
+                    payload, BaseException) else None
+            return ("err", _exc_blob(exc))
+        if status == "err":
+            return ("err", payload)
+        out = []
+        for id_bytes, packed in zip(return_keys, payload):
+            if packed[0] == "inline":
+                blob = packed[1]
+            else:
+                blob = self._packed_to_blob(id_bytes, packed)
+                if blob is None:
+                    out.append(packed)  # ("err", blob) passthrough
+                    continue
+            if len(blob) <= _inline_reply_bytes():
+                out.append(("inline", blob))
+            else:
+                self.store.put(id_bytes, blob, owner=owner)
+                self._maybe_export_stored(id_bytes, blob)
+                out.append(("stored", len(blob)))
+        self.tasks_executed += 1
+        return ("ok", out)
+
+    def execute_task_batch(self, entries: list,
+                           client_addr: str | None = None,
+                           _emit_part=None) -> tuple:
+        """Run a batch of tasks leased to this node in one RPC,
+        streaming grouped completions back as they finish (no barrier
+        on the slowest task).
+
+        Each entry: (digest, func_blob, args_blob, n_returns,
+        return_keys, runtime_env, resources, task_token, flags) with
+        flags bit 0 = args contain FetchRef placeholders. Ref-bearing,
+        TPU and dedicated-env entries take the classic per-task path on
+        their own dispatch threads; everything else fans across
+        pipelined multi-task worker leases (worker_pool.run_task_batch).
+
+        Streamed parts: ("results", [(idx, reply), ...]) with the
+        execute_task reply shape per task, plus ("parked", idx) /
+        ("resumed", idx) control parts when frames queue behind a
+        blocked lease head. Final reply: ("done", n)."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        from ray_tpu._private.rpc import DISPATCH_POOL
+        from ray_tpu._private.worker_pool import _BatchTask
+
+        self._warm_factory_once()
+        self.batch_rpcs += 1
+        self.batch_tasks_received += len(entries)
+        n = len(entries)
+        cond = threading.Condition(threading.Lock())
+        completions: list = []
+        control: list = []
+
+        def complete(idx: int, reply: tuple) -> None:
+            with cond:
+                completions.append((idx, reply))
+                cond.notify()
+
+        with self._func_lock:
+            sys_path = list(self._driver_sys_path) or None
+        pipeline: list[_BatchTask] = []
+        reserve_wants: list = []
+        token_idx: dict[str, int] = {}
+        for idx, entry in enumerate(entries):
+            (digest, func_blob, args_blob, n_returns, return_keys,
+             runtime_env, resources, token, flags) = entry
+            if func_blob is not None:
+                with self._func_lock:
+                    self._func_blob_cache[digest] = func_blob
+            demand = dict(resources or {})
+            demand.setdefault("CPU", 1.0)
+            token = token or f"exec-{digest[:8]}-{os.urandom(4).hex()}"
+            classic = ((flags & 1)
+                       or any(k.startswith("TPU") for k in demand)
+                       or self._needs_dedicated_worker(runtime_env))
+            if classic:
+                def classic_run(idx=idx, digest=digest,
+                                func_blob=func_blob,
+                                args_blob=args_blob, n_returns=n_returns,
+                                return_keys=return_keys,
+                                runtime_env=runtime_env,
+                                resources=resources, token=token):
+                    try:
+                        reply = self.execute_task(
+                            digest, func_blob, args_blob, n_returns,
+                            return_keys, runtime_env, resources, token,
+                            client_addr)
+                    except BaseException as exc:  # noqa: BLE001
+                        reply = ("err", _exc_blob(exc))
+                    complete(idx, reply)
+
+                DISPATCH_POOL.submit(classic_run)
+                continue
+            blob = func_blob
+            if blob is None:
+                with self._func_lock:
+                    blob = self._func_blob_cache.get(digest)
+            if blob is None:
+                # Daemon restarted since the driver learned the digest:
+                # that task retries via the single execute path.
+                complete(idx, ("need_func", None))
+                continue
+            token_idx[token] = idx
+            reserve_wants.append((token, demand))
+            pipeline.append(_BatchTask(
+                idx=idx, digest=digest, func_blob=blob,
+                args_blob=args_blob, n_returns=max(1, n_returns),
+                runtime_env=runtime_env, token=token,
+                client_addr=client_addr, sys_path=sys_path))
+        if pipeline:
+            accepted = self._try_reserve_many(reserve_wants)
+            admitted = []
+            for task, ok in zip(pipeline, accepted):
+                if ok:
+                    admitted.append(task)
+                else:
+                    complete(task.idx, ("busy",))
+            pipeline = admitted
+        if pipeline:
+            return_keys_by_idx = {
+                idx: entries[idx][4] for idx in
+                (t.idx for t in pipeline)}
+
+            def notify(kind: str, token: str) -> None:
+                with cond:
+                    control.append((kind, token_idx.get(token)))
+                    cond.notify()
+
+            self._pipeline_inflight.register_notify(
+                [t.token for t in pipeline], notify)
+
+            def on_result(task, status, payload):
+                with self._running_lock:
+                    self._running.pop(task.token, None)
+                    self._blocked_cpu.pop(task.token, None)
+                try:
+                    reply = self._pipe_reply_to_task_reply(
+                        return_keys_by_idx[task.idx], status, payload,
+                        client_addr)
+                except BaseException as exc:  # noqa: BLE001
+                    reply = ("err", _exc_blob(exc))
+                complete(task.idx, reply)
+
+            depth = max(1, int(GLOBAL_CONFIG.worker_pipeline_depth))
+            threading.Thread(
+                target=self.pool.run_task_batch,
+                args=(pipeline, on_result, depth,
+                      self._pipeline_inflight),
+                daemon=True, name="exec-batch-pool").start()
+        try:
+            done_n = 0
+            while done_n < n:
+                with cond:
+                    while not completions and not control:
+                        cond.wait()
+                    group, completions = completions, []
+                    ctrl, control = control, []
+                for kind, idx in ctrl:
+                    if idx is not None:
+                        _emit_part((kind, idx))
+                if group:
+                    _emit_part(("results", group))
+                    self.reply_groups += 1
+                    done_n += len(group)
+                    self._notify_load()
+        finally:
+            if pipeline:
+                self._pipeline_inflight.forget_notify(
+                    [t.token for t in pipeline])
+        return ("done", n)
 
     def fetch_object(self, id_bytes: bytes, offset: int,
                      length: int):
@@ -1223,10 +1560,23 @@ class NodeExecutorService:
                 "attached_mappings": len(self._attached),
             }
         data_plane["leases"] = self.leases.stats()
+        # Per-stage drain counters for the pipelined execute path
+        # (dispatch batches -> batch RPCs -> worker leases/frames ->
+        # grouped seal replies) so a throughput regression localizes
+        # to one stage in a single read.
+        pipeline = {
+            "batch_rpcs": self.batch_rpcs,
+            "batch_tasks": self.batch_tasks_received,
+            "reply_groups": self.reply_groups,
+            "worker_lease_runs": self.pool.batch_runs,
+            "worker_lease_tasks": self.pool.batch_tasks,
+            "worker_pipelined_frames": self.pool.batch_frames,
+        }
         return {"tasks_executed": self.tasks_executed,
                 "running": running, "store": self.store.stats(),
                 "num_actors": num_actors, "pid": os.getpid(),
                 "relay": relay, "data_plane": data_plane,
+                "pipeline": pipeline,
                 "threads": threading.active_count()}
 
     def adopt_sys_path(self, paths: list) -> int:
@@ -1269,6 +1619,9 @@ class NodeExecutorService:
             reduced["CPU"] = 0.0
             self._running[token] = reduced
         self._notify_load()
+        # Pipelined lease head blocked: frames queued behind it hold
+        # CPU without running — park them too (deadlock avoidance).
+        self._pipeline_inflight.on_block(token)
         return True
 
     def task_unblock(self, token: str) -> bool:
@@ -1299,6 +1652,24 @@ class NodeExecutorService:
         (Reference: GcsActorScheduler leases a worker on the chosen node
         and pushes the creation task — gcs_actor_scheduler.h.)"""
         self._warm_factory_once()
+        with self._actors_creating_cond:
+            self._actors_creating.add(actor_key)
+        try:
+            return self._create_actor_gated(
+                actor_key, cls_blob, args_blob, runtime_env,
+                max_concurrency, resources, client_addr, sys_path)
+        finally:
+            with self._actors_creating_cond:
+                self._actors_creating.discard(actor_key)
+                self._actors_creating_cond.notify_all()
+
+    def _create_actor_gated(self, actor_key: bytes, cls_blob: bytes,
+                            args_blob: bytes,
+                            runtime_env: dict | None = None,
+                            max_concurrency: int = 1,
+                            resources: dict | None = None,
+                            client_addr: str | None = None,
+                            sys_path: list | None = None) -> tuple:
         with self._actors_lock:
             existing = self._actors.get(actor_key)
         if existing is not None:
@@ -1353,12 +1724,20 @@ class NodeExecutorService:
 
     def actor_call(self, actor_key: bytes, method: str,
                    args_blob: bytes, n_returns: int,
-                   return_keys: list[bytes]) -> tuple:
+                   return_keys: list[bytes],
+                   awaiting_create: bool = False) -> tuple:
         """Invoke a method on a hosted actor. -> ("ok", descriptors)
         with the execute_task result shape (inline/stored per return),
         ("err", blob) for application errors, ("dead", blob) when the
         actor process died, ("gone",) when this daemon does not host the
-        actor (e.g. it restarted)."""
+        actor (e.g. it restarted).
+
+        ``awaiting_create``: the caller pipelined this call behind an
+        in-flight create_actor on the same connection — wait for the
+        constructor to land (or fail) instead of bouncing "gone", so
+        __init__ and the first method call(s) execute back-to-back with
+        no driver round trip between them. Plain calls keep the instant
+        "gone" (crash detection must not stall)."""
         from ray_tpu._private.worker_pool import (
             _WorkerUnavailable,
         )
@@ -1366,6 +1745,8 @@ class NodeExecutorService:
 
         with self._actors_lock:
             actor = self._actors.get(actor_key)
+        if actor is None and awaiting_create:
+            actor = self._await_actor(actor_key)
         if actor is None:
             return ("gone",)
         try:
@@ -1397,6 +1778,39 @@ class NodeExecutorService:
                 self._maybe_export_stored(id_bytes, blob)
                 out.append(("stored", len(blob)))
         return ("ok", out)
+
+    def _await_actor(self, actor_key: bytes,
+                     grace_s: float = 10.0,
+                     create_timeout_s: float = 600.0):
+        """Gate for pipelined first calls: wait for the key's in-flight
+        creation. The short grace also covers the race where the call's
+        dispatch thread outran the create frame's (the driver sent
+        create first on the same connection, so the key turns
+        "creating" within moments)."""
+        import time as _time
+
+        grace_deadline = _time.monotonic() + grace_s
+        deadline = _time.monotonic() + create_timeout_s
+        seen_creating = False
+        with self._actors_creating_cond:
+            while True:
+                actor = self._actors.get(actor_key)
+                if actor is not None:
+                    return actor
+                now = _time.monotonic()
+                if actor_key in self._actors_creating:
+                    seen_creating = True
+                    if now > deadline:
+                        return None
+                    self._actors_creating_cond.wait(
+                        min(1.0, deadline - now))
+                else:
+                    # Creation finished without hosting the actor
+                    # (busy/err): bounce immediately — the driver
+                    # resends once its creation settles elsewhere.
+                    if seen_creating or now > grace_deadline:
+                        return None
+                    self._actors_creating_cond.wait(0.05)
 
     def _warm_factory_once(self) -> None:
         """First-work trigger: warm the fork-server template in the
@@ -2176,6 +2590,35 @@ class RemoteNodeHandle:
             exc.__ray_tpu_remote_tb__ = tb
             raise exc
         return reply[1]
+
+    def execute_batch(self, entries: list, on_results,
+                      on_parked=None, on_resumed=None,
+                      client_addr: str | None = None) -> int:
+        """One execute_task_batch RPC for a run of tasks leased to this
+        node. ``on_results(group)`` fires per streamed completion group
+        with [(idx, reply), ...] (execute_task reply shape per task);
+        parked/resumed control parts report frames stuck behind a
+        blocked lease head. Returns the number of replies delivered —
+        the caller fails any missing indexes (stream cut mid-batch).
+        Raises RpcError/RpcMethodError like ``execute``."""
+        self.ensure_sys_path()
+        slot = self.pool.call_streaming(
+            "execute_task_batch", entries, client_addr)
+        delivered = 0
+        while True:
+            part = slot.next_part()
+            if part is None:
+                break
+            kind, payload = part
+            if kind == "results":
+                delivered += len(payload)
+                on_results(payload)
+            elif kind == "parked" and on_parked is not None:
+                on_parked(payload)
+            elif kind == "resumed" and on_resumed is not None:
+                on_resumed(payload)
+        slot.result()  # surfaces transport/method failures
+        return delivered
 
     def fetch(self, id_bytes: bytes) -> bytes:
         return fetch_blob(self.pool, id_bytes)

@@ -98,6 +98,13 @@ class ObjectStore:
         self._restored_bytes_total = 0
         # Callbacks fired (outside the lock) when an object is sealed.
         self._seal_listeners: list[Callable[[ObjectID], None]] = []
+        # Batch-aware listeners: put_batch fires them ONCE with the
+        # whole sealed group (per-id listeners still fire per object).
+        self._batch_seal_listeners: list[Callable] = []
+        # Seal-coalescing counters (the "seal" drain stage): how many
+        # grouped seals happened and how many objects rode them.
+        self.batch_seals = 0
+        self.batch_sealed_objects = 0
 
     # ------------------------------------------------------------------ put
 
@@ -110,6 +117,29 @@ class ObjectStore:
     def put(self, object_id: ObjectID, value: Any) -> None:
         self._seal(object_id, value=value, error=None)
 
+    def put_batch(self, items: "list[tuple[ObjectID, Any]]") -> None:
+        """Seal a group of objects under ONE lock pass and notify
+        batch listeners once for the whole group — the coalesced
+        result-seal path for grouped task-batch completions."""
+        if not items:
+            return
+        sizes = [_sizeof(value) for _, value in items]
+        with self._lock:
+            for (object_id, value), size_bytes in zip(items, sizes):
+                self._seal_locked(object_id, value, None, size_bytes)
+            self._lock.notify_all()
+            self.batch_seals += 1
+            self.batch_sealed_objects += len(items)
+            listeners = list(self._seal_listeners)
+            batch_listeners = list(self._batch_seal_listeners)
+        ids = [object_id for object_id, _ in items]
+        for cb in batch_listeners:
+            cb(ids)
+        for object_id in ids:
+            for cb in listeners:
+                cb(object_id)
+        self._maybe_spill()
+
     def put_error(self, object_id: ObjectID, error: BaseException) -> None:
         self._seal(object_id, value=None, error=error)
 
@@ -118,38 +148,54 @@ class ObjectStore:
         # can run arbitrary __del__s via GC.
         size_bytes = _sizeof(value) if error is None else 256
         with self._lock:
-            entry = self._entries.get(object_id)
-            if entry is None:
-                entry = ObjectEntry(object_id)
-                self._entries[object_id] = entry
-            if entry.sealed and not entry.freed:
-                # Idempotent reseal (e.g. task retry recomputed the value).
-                if entry.spilled_path is not None:
-                    # Spilled copies already gave their bytes back; just drop
-                    # the stale file.
-                    try:
-                        os.unlink(entry.spilled_path)
-                    except OSError:
-                        pass
-                else:
-                    self._memory_used -= entry.size_bytes
-            entry.value = value
-            entry.error = error
-            entry.sealed = True
-            entry.freed = False
-            entry.lost = False
-            entry.spilled_path = None
-            entry.size_bytes = size_bytes
-            self._memory_used += entry.size_bytes
+            self._seal_locked(object_id, value, error, size_bytes)
             self._lock.notify_all()
             listeners = list(self._seal_listeners)
+            batch_listeners = list(self._batch_seal_listeners)
+        for cb in batch_listeners:
+            cb((object_id,))
         for cb in listeners:
             cb(object_id)
         self._maybe_spill()
 
+    def _seal_locked(self, object_id: ObjectID, value: Any,
+                     error: BaseException | None,
+                     size_bytes: int) -> None:
+        # Caller holds self._lock.
+        entry = self._entries.get(object_id)
+        if entry is None:
+            entry = ObjectEntry(object_id)
+            self._entries[object_id] = entry
+        if entry.sealed and not entry.freed:
+            # Idempotent reseal (e.g. task retry recomputed the value).
+            if entry.spilled_path is not None:
+                # Spilled copies already gave their bytes back; just drop
+                # the stale file.
+                try:
+                    os.unlink(entry.spilled_path)
+                except OSError:
+                    pass
+            else:
+                self._memory_used -= entry.size_bytes
+        entry.value = value
+        entry.error = error
+        entry.sealed = True
+        entry.freed = False
+        entry.lost = False
+        entry.spilled_path = None
+        entry.size_bytes = size_bytes
+        self._memory_used += entry.size_bytes
+
     def add_seal_listener(self, cb: Callable[[ObjectID], None]) -> None:
         with self._lock:
             self._seal_listeners.append(cb)
+
+    def add_batch_seal_listener(self, cb: Callable) -> None:
+        """``cb(ids)`` fires once per seal GROUP (a 1-tuple for plain
+        puts) — consumers scanning state per notification amortize the
+        scan across a grouped batch completion."""
+        with self._lock:
+            self._batch_seal_listeners.append(cb)
 
     # ------------------------------------------------------------------ get
 

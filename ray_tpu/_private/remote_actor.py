@@ -87,6 +87,13 @@ class RemoteActor:
         self._gen = 0  # bumps on every crash-handling pass (single-flight)
         self.pid: int | None = None
         self._started = threading.Event()
+        # Pipelined creation: the dispatch loop opens as soon as the
+        # create RPC is SENT — early calls ride the same connection
+        # tagged awaiting_create and the daemon orders them behind the
+        # constructor. _create_acked flips once the create reply
+        # landed; _create_settled resolves either way.
+        self._create_acked = False
+        self._create_settled = threading.Event()
         self._thread = threading.Thread(
             target=self._run, daemon=True,
             name=f"ray_tpu-ractor-{cls.__name__}")
@@ -175,27 +182,43 @@ class RemoteActor:
                     ActorError(exc, format_traceback(exc),
                                f"{self._cls.__name__}.__init__"))
             return
-        err = self._create_on_cluster(init_blob)
-        if err == "dead":
-            # kill() raced creation; _mark_dead already ran there.
-            if self._creation_return_id is not None:
-                self._runtime.store.put_error(
-                    self._creation_return_id, ActorDiedError(
-                        self.actor_id,
-                        self._death_reason or "killed during creation"))
-            return
-        if err is not None:
-            self._mark_dead(f"constructor failed: {err!r}")
-            if self._creation_return_id is not None:
-                self._runtime.store.put_error(self._creation_return_id, err)
-            return
-        if self._creation_return_id is not None:
-            self._runtime.store.put(self._creation_return_id, None)
-        self._started.set()
+        # Pipelined __init__: creation resolves on its own thread while
+        # THIS thread starts dispatching queued calls immediately —
+        # the first method call ships right behind the create frame
+        # and the daemon runs constructor + call back-to-back with no
+        # driver round trip between them.
+        threading.Thread(
+            target=self._create_async, args=(init_blob,), daemon=True,
+            name=f"ray_tpu-ractor-create-{self._cls.__name__}").start()
         if self._max_concurrency > 1:
             self._run_concurrent()
         else:
             self._run_sequential()
+
+    def _create_async(self, init_blob: bytes) -> None:
+        try:
+            err = self._create_on_cluster(init_blob)
+            if err == "dead":
+                # kill() raced creation; _mark_dead already ran there.
+                if self._creation_return_id is not None:
+                    self._runtime.store.put_error(
+                        self._creation_return_id, ActorDiedError(
+                            self.actor_id,
+                            self._death_reason
+                            or "killed during creation"))
+                return
+            if err is not None:
+                self._mark_dead(f"constructor failed: {err!r}")
+                if self._creation_return_id is not None:
+                    self._runtime.store.put_error(
+                        self._creation_return_id, err)
+                return
+            if self._creation_return_id is not None:
+                self._runtime.store.put(self._creation_return_id, None)
+            self._create_acked = True
+            self._started.set()
+        finally:
+            self._create_settled.set()
 
     def _create_on_cluster(self, init_blob: bytes,
                            timeout: float = 300.0):
@@ -337,11 +360,16 @@ class RemoteActor:
             self._fail_call(call, ActorError(
                 exc, "", f"{site} (argument serialization)"))
             return
+        # Calls dispatched before the create reply landed are tagged:
+        # the daemon holds them for the in-flight constructor instead
+        # of bouncing "gone" (pipelined __init__ + first call).
+        pre_ack = not self._create_acked
         try:
             reply = handle.pool.call(
                 "actor_call", self._key, call.method_name, args_blob,
                 len(call.return_ids),
-                [r.binary() for r in call.return_ids], coalesce=True)
+                [r.binary() for r in call.return_ids], pre_ack,
+                coalesce=True)
         except RpcMethodError as exc:
             self._fail_call(call, ActorError(exc.cause, exc.remote_tb, site))
             return
@@ -373,6 +401,18 @@ class RemoteActor:
                 memoryview(reply[1]))
             self._fail_call(call, ActorError(exc, tb, site))
         else:  # ("dead", blob) | ("gone",)
+            if reply[0] == "gone" and pre_ack \
+                    and not getattr(call, "_gone_retry", False):
+                # The pipelined call raced a creation that relocated
+                # (busy daemon): wait for creation to settle, then
+                # re-dispatch once on the final handle. Never a crash —
+                # the actor was not lost, it was never there.
+                call._gone_retry = True
+                self._create_settled.wait(timeout=600.0)
+                with self._lock:
+                    self._pending += 1  # re-dispatch re-decrements
+                self._dispatch_call(call)
+                return
             reason = "actor process died"
             if reply[0] == "gone":
                 reason = "hosting daemon lost the actor (restarted?)"
@@ -453,5 +493,6 @@ class RemoteActor:
         for call in drained:
             self._fail_call(call, ActorDiedError(self.actor_id, reason))
         self._started.set()  # never leave waiters hanging
+        self._create_settled.set()
         if notify and self._on_death is not None:
             self._on_death(self.actor_id, reason)

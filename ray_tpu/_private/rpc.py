@@ -52,6 +52,14 @@ class TailPayload:
         self.tail = tail
 
 
+class _StreamEnd:
+    """Sentinel closing a streaming call's parts queue (the final
+    reply or a connection failure has resolved the slot)."""
+
+
+_STREAM_END = _StreamEnd()
+
+
 class RpcMethodError(Exception):
     """The remote method raised; carries the remote traceback."""
 
@@ -203,6 +211,7 @@ class RpcServer:
         # upstream by admission); "pooled" = a small shared executor
         # (short calls like chunk fetches — no thread churn per chunk).
         self._concurrent: dict[str, str] = {}
+        self._streaming: set[str] = set()
         self._io_pool = None
         self._io_pool_lock = threading.Lock()
         self._shutdown = threading.Event()
@@ -215,8 +224,17 @@ class RpcServer:
         return f"{self.host}:{self.port}"
 
     def register(self, name: str, fn: Callable,
-                 concurrent: "bool | str" = False) -> None:
+                 concurrent: "bool | str" = False,
+                 streaming: bool = False) -> None:
+        """``streaming=True``: the method is invoked with an extra
+        ``_emit_part(payload)`` keyword that sends an intermediate
+        ``part`` frame tagged with the call's seq — grouped progress
+        replies flow while the call is still executing (the final
+        return value resolves the call as usual). Callers must use
+        MuxRpcClient.call_streaming to consume the parts."""
         self._methods[name] = fn
+        if streaming:
+            self._streaming.add(name)
         if concurrent:
             self._concurrent[name] = (
                 concurrent if isinstance(concurrent, str) else "thread")
@@ -371,6 +389,16 @@ class RpcServer:
             reply = (seq, "err", (KeyError(f"no method {method}"), ""))
         else:
             try:
+                if method in self._streaming:
+                    def _emit_part(payload) -> None:
+                        # A dead connection must abort the producer, not
+                        # let it stream into the void until completion.
+                        if not self._reply(conn, send_lock,
+                                           (seq, "part", payload)):
+                            raise RpcError("connection lost mid-stream")
+
+                    kwargs = dict(kwargs)
+                    kwargs["_emit_part"] = _emit_part
                 result = fn(*args, **kwargs)
                 if isinstance(result, TailPayload):
                     return self._send_tail(conn, send_lock, seq, result)
@@ -439,7 +467,7 @@ class _MuxSlot:
     in the coalescing queue, set once it is bound to a live socket."""
 
     __slots__ = ("event", "reply", "error", "client", "conn", "seq",
-                 "method")
+                 "method", "parts")
 
     def __init__(self, client: "MuxRpcClient", method: str):
         self.event = threading.Event()
@@ -449,9 +477,31 @@ class _MuxSlot:
         self.conn: "_MuxConn | None" = None
         self.seq = 0
         self.method = method
+        # Streaming calls only: a queue of intermediate "part" payloads
+        # closed by _STREAM_END when the call resolves (either way).
+        self.parts = None
 
     def done(self) -> bool:
         return self.event.is_set()
+
+    def next_part(self, timeout_s: float | None = None):
+        """Next intermediate payload of a streaming call, or None once
+        the stream ended (then ``result()`` holds the final reply /
+        raises the failure). Raises RpcError on timeout."""
+        import queue as queue_mod
+
+        try:
+            item = self.parts.get(
+                timeout=timeout_s if timeout_s is not None
+                else self.client._timeout)
+        except queue_mod.Empty:
+            self.client._abandon(self)
+            raise RpcError(
+                f"rpc {self.method} to {self.client.address} stream "
+                f"timed out") from None
+        if item is _STREAM_END:
+            return None
+        return item
 
     def result(self, timeout_s: float | None = None) -> Any:
         client = self.client
@@ -551,7 +601,23 @@ class MuxRpcClient:
         keep the direct path."""
         if coalesce:
             return self._submit_coalesced(method, args, kwargs)
+        return self._call_direct(method, args, kwargs)
+
+    def call_streaming(self, method: str, *args, **kwargs) -> _MuxSlot:
+        """Issue a call whose server method streams intermediate
+        ``part`` frames (RpcServer.register(..., streaming=True)).
+        Consume them with ``slot.next_part()`` until it returns None,
+        then ``slot.result()`` for the final reply."""
+        import queue as queue_mod
+
+        slot = self._call_direct(method, args, kwargs,
+                                 parts=queue_mod.SimpleQueue())
+        return slot
+
+    def _call_direct(self, method: str, args, kwargs,
+                     parts=None) -> _MuxSlot:
         slot = _MuxSlot(self, method)
+        slot.parts = parts
         with self._lock:
             if self._closed:
                 raise RpcError(f"client to {self.address} is closed")
@@ -721,11 +787,21 @@ class MuxRpcClient:
             except Exception as exc:  # noqa: BLE001 — corrupt stream
                 self._fail_conn(conn, exc)
                 return
+            if status == "part":
+                # Intermediate streaming payload: the call stays
+                # pending; deliver to its parts queue.
+                with self._lock:
+                    slot = conn.pending.get(seq)
+                if slot is not None and slot.parts is not None:
+                    slot.parts.put(payload)
+                continue
             with self._lock:
                 slot = conn.pending.pop(seq, None)
             if slot is not None:
                 slot.reply = (status, payload)
                 slot.event.set()
+                if slot.parts is not None:
+                    slot.parts.put(_STREAM_END)
 
     def _fail_conn(self, conn: _MuxConn, exc: BaseException) -> None:
         """Fail exactly the calls riding THIS connection; calls on a
@@ -742,6 +818,8 @@ class MuxRpcClient:
         for slot in pending:
             slot.error = exc
             slot.event.set()
+            if slot.parts is not None:
+                slot.parts.put(_STREAM_END)
 
     def ping(self) -> bool:
         try:
@@ -770,6 +848,8 @@ class MuxRpcClient:
         for slot in pending + [s for s, _ in queued]:
             slot.error = RpcError("client closed")
             slot.event.set()
+            if slot.parts is not None:
+                slot.parts.put(_STREAM_END)
 
 
 class RpcClient:

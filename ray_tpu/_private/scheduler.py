@@ -312,6 +312,11 @@ class Dispatcher:
         # individually.
         self._ready_groups: "dict[tuple, collections.deque]" = {}
         self._ready_odd: list[_QueuedTask] = []
+        # Live (unclaimed, uncancelled) ready-task count, maintained
+        # INCREMENTALLY: enqueue +1, claim/ready-cancel -1. The O(ready)
+        # _ready_tasks scan under the lock, run per pending_count/
+        # wait_idle call at 100k queue depths, starved submission.
+        self._num_ready_live = 0
         # return-object id -> queued task, for O(1) cancel at any queue
         # depth; entries leave at claim (running tasks are not
         # cancellable) or at cancel.
@@ -320,10 +325,22 @@ class Dispatcher:
         self._infeasible_warned: set[str] = set()
         self._on_task_state = on_task_state
         self._num_running = 0
+        # Batched remote dispatch (set_batch_hooks): tasks claimed for
+        # the same batch key within one pass coalesce into one runner.
+        self._batch_key = None
+        self._run_batch = None
+        self.batches_launched = 0
+        self.batch_tasks_launched = 0
+        self.singles_launched = 0
         self._dispatch_thread = threading.Thread(
             target=self._dispatch_loop, name="ray_tpu-dispatcher", daemon=True)
         self._dispatch_thread.start()
-        store.add_seal_listener(self._on_object_sealed)
+        if hasattr(store, "add_batch_seal_listener"):
+            # Coalesced seals (grouped batch completions) cost ONE
+            # _waiting scan per group instead of one per object.
+            store.add_batch_seal_listener(self._on_objects_sealed)
+        else:
+            store.add_seal_listener(self._on_object_sealed)
 
     @staticmethod
     def _sig(spec: TaskSpec) -> tuple:
@@ -333,8 +350,18 @@ class Dispatcher:
                 getattr(strategy, "node_id", None),
                 getattr(strategy, "soft", False))
 
+    def set_batch_hooks(self, batch_key, run_batch) -> None:
+        """Enable batched dispatch: ``batch_key(spec, node, run)``
+        returns a coalescing key (same key within one pass -> one
+        batch) or None for the classic thread-per-task launch;
+        ``run_batch(specs, node, complete)`` executes a batch and calls
+        ``complete(spec)`` as each task finishes."""
+        self._batch_key = batch_key
+        self._run_batch = run_batch
+
     def _enqueue_ready(self, task: _QueuedTask) -> None:
         # Caller holds self._lock.
+        self._num_ready_live += 1
         if getattr(task.spec, "_avoid_nodes", None):
             self._ready_odd.append(task)
             return
@@ -368,12 +395,16 @@ class Dispatcher:
             self._lock.notify_all()
 
     def _on_object_sealed(self, object_id) -> None:
+        self._on_objects_sealed((object_id,))
+
+    def _on_objects_sealed(self, object_ids) -> None:
+        sealed = set(object_ids)
         with self._lock:
             still_waiting = []
             for task in self._waiting:
                 dep_ids = getattr(task, "_dep_ids", set())
-                if object_id in dep_ids:
-                    dep_ids.discard(object_id)
+                if dep_ids & sealed:
+                    dep_ids -= sealed
                     task.unresolved_deps = len(dep_ids)
                 if task.unresolved_deps == 0:
                     self._enqueue_ready(task)
@@ -391,8 +422,13 @@ class Dispatcher:
                     self._lock.wait(timeout=0.2)
                 if self._shutdown:
                     return
-            launched_any = bool(self._drain_groups())
-            launched_any |= bool(self._drain_odd())
+            # Tasks claimed for the same batch key (one remote node)
+            # within this pass coalesce; _flush_batches launches them
+            # as single execute_task_batch runners.
+            batches: dict = {}
+            launched_any = bool(self._drain_groups(batches))
+            launched_any |= bool(self._drain_odd(batches))
+            self._flush_batches(batches)
             if not launched_any:
                 # Nothing admitted: wait for resources to free up.
                 self._cluster.wait_for_change(0.05)
@@ -418,6 +454,7 @@ class Dispatcher:
                 self._cluster.release(node.node_id, task.spec.resources)
                 return False
             task.claimed = True
+            self._num_ready_live -= 1
             self._num_running += 1
             # Running tasks are past cancellation: drop the cancel
             # index so a late cancel() can't race the real result
@@ -426,7 +463,7 @@ class Dispatcher:
                 self._by_return_id.pop(rid, None)
         return True
 
-    def _drain_groups(self) -> int:
+    def _drain_groups(self, batches: dict | None = None) -> int:
         """One pass over the signature groups: each group launches from
         its FIFO head until admission fails for that signature — the
         other queued thousands with the same demand are never touched."""
@@ -452,11 +489,13 @@ class Dispatcher:
                         dq.popleft()
                 if not self._claim(task, node):
                     continue
-                self._launch(task, node)
+                if batches is None or not self._stage_batch(
+                        batches, task, node):
+                    self._launch(task, node)
                 launched += 1
         return launched
 
-    def _drain_odd(self) -> int:
+    def _drain_odd(self, batches: dict | None = None) -> int:
         """Spillback tasks carry per-task avoid sets: their admission
         failures don't generalize, so they are probed individually
         (the set is small — bounded by in-flight spillbacks)."""
@@ -480,9 +519,91 @@ class Dispatcher:
                     self._ready_odd.remove(task)
                 except ValueError:
                     pass
-            self._launch(task, node)
+            if batches is None or not self._stage_batch(
+                    batches, task, node):
+                self._launch(task, node)
             launched += 1
         return launched
+
+    @staticmethod
+    def _batch_max() -> int:
+        try:
+            from ray_tpu._private.config import GLOBAL_CONFIG
+
+            return max(1, int(GLOBAL_CONFIG.dispatch_batch_max))
+        except Exception:  # noqa: BLE001 — config gone mid-teardown
+            return 32
+
+    def _stage_batch(self, batches: dict, task: _QueuedTask,
+                     node: NodeState) -> bool:
+        """Coalesce a claimed task into this pass's batch for its key
+        (one execute_task_batch runner per key). Returns False when the
+        task must take the classic thread-per-task launch (no hooks,
+        local node, custom run callable, ...)."""
+        key_fn = self._batch_key
+        if key_fn is None:
+            return False
+        try:
+            key = key_fn(task.spec, node, task.run)
+        except Exception:  # noqa: BLE001 — never wedge dispatch
+            key = None
+        if key is None:
+            return False
+        entry = batches.get(key)
+        if entry is None:
+            entry = batches[key] = (node, [])
+        entry[1].append(task)
+        if len(entry[1]) >= self._batch_max():
+            del batches[key]
+            self._launch_batch(entry[1], entry[0])
+        return True
+
+    def _flush_batches(self, batches: dict) -> None:
+        for node, tasks in batches.values():
+            if len(tasks) == 1:
+                # A batch of one gains nothing over the measured
+                # thread-per-task single path.
+                self._launch(tasks[0], node)
+            else:
+                self._launch_batch(tasks, node)
+        batches.clear()
+
+    def _launch_batch(self, tasks: "list[_QueuedTask]",
+                      node: NodeState) -> None:
+        """One runner thread drives a whole batch; each task's
+        resources release individually as its completion streams back
+        (no barrier on the slowest sibling)."""
+        run_batch = self._run_batch
+        by_spec = {id(t.spec): t for t in tasks}
+        done_lock = threading.Lock()
+        self.batches_launched += 1
+        self.batch_tasks_launched += len(tasks)
+
+        def complete(spec) -> None:
+            with done_lock:
+                task = by_spec.pop(id(spec), None)
+            if task is None:
+                return  # double-complete guard
+            self._cluster.release(node.node_id, task.spec.resources)
+            with self._lock:
+                self._num_running -= 1
+                self._lock.notify_all()
+
+        def runner() -> None:
+            try:
+                run_batch([t.spec for t in tasks], node, complete)
+            finally:
+                # A runner that died (or under-reported) must not leak
+                # admissions: complete whatever it left behind.
+                with done_lock:
+                    leftover = [t.spec for t in by_spec.values()]
+                for spec in leftover:
+                    complete(spec)
+
+        thread = threading.Thread(
+            target=runner, daemon=True,
+            name=f"ray_tpu-task-batch-{len(tasks)}")
+        thread.start()
 
     def _try_admit(self, task: _QueuedTask) -> NodeState | None:
         spec = task.spec
@@ -514,8 +635,10 @@ class Dispatcher:
                     self._num_running -= 1
                     self._lock.notify_all()
 
-        # Thread-per-task, deliberately (for BOTH local and remote
-        # dispatch): a recycled/queued runner pool was A/B-measured
+        self.singles_launched += 1
+        # Thread-per-task, deliberately (for local dispatch and
+        # un-batchable remote tasks): a recycled/queued runner pool was
+        # A/B-measured
         # SLOWER for burst dispatch on this class of host —
         # Thread.start() blocks until the child runs, which hands the
         # GIL straight to the task; a queue handoff returns instantly
@@ -538,9 +661,18 @@ class Dispatcher:
     def _live_ready_count(self) -> int:
         # Caller holds the lock. Claimed/cancelled zombies sit in the
         # ready queues until a dispatch pass purges them (lazy
-        # removal); counts must not see them.
-        return sum(1 for t in self._ready_tasks()
-                   if not (t.claimed or t.cancelled))
+        # removal); the incrementally-maintained counter already
+        # excludes them — no O(ready) scan at 100k queue depths.
+        return self._num_ready_live
+
+    def pipeline_stats(self) -> dict:
+        """Dispatch-stage drain counters (batched vs single launches)."""
+        with self._lock:
+            return {
+                "batches_launched": self.batches_launched,
+                "batch_tasks_launched": self.batch_tasks_launched,
+                "singles_launched": self.singles_launched,
+            }
 
     def pending_count(self) -> int:
         with self._lock:
@@ -585,6 +717,10 @@ class Dispatcher:
             task.cancelled = True
             for rid in task.spec.return_ids:
                 self._by_return_id.pop(rid, None)
+            if not task.unresolved_deps:
+                # It sat in a ready queue: keep the live count honest
+                # (the zombie entry is purged lazily by dispatch).
+                self._num_ready_live -= 1
             if task.unresolved_deps:
                 # Waiting tasks are few (deps gate them); eager removal
                 # keeps _on_object_sealed's scan honest.

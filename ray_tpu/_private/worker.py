@@ -25,6 +25,7 @@ import collections
 import concurrent.futures
 import logging
 import os
+import queue
 import threading
 import time
 from typing import Any, Iterable, Sequence
@@ -78,6 +79,51 @@ def _warn_runtime_env_ignored(context: str) -> None:
 
 _runtime_lock = threading.Lock()
 _runtime: "Runtime | None" = None
+
+
+class _DaemonPool:
+    """Fixed-size pool of daemon threads draining a work queue.
+
+    Replaces thread-per-actor spawning on the submission path:
+    ``threading.Thread.start`` blocks until the new thread's bootstrap
+    runs, which costs tens of milliseconds per call once the box has
+    hundreds of runnable threads — at a 100-actor creation wave those
+    stalls serialize and dominate the wave (measured ~40ms/actor).
+    A stdlib ThreadPoolExecutor is unsuitable here: its workers are
+    non-daemon and its atexit hook joins them, so one creation body
+    parked in a lease wait would hang interpreter exit."""
+
+    def __init__(self, max_workers: int, name: str):
+        self._queue: "queue.Queue" = queue.Queue()
+        self._max = max(1, max_workers)
+        self._name = name
+        self._spawned = 0
+        self._idle = 0
+        self._lock = threading.Lock()
+
+    def submit(self, fn, *args) -> None:
+        self._queue.put((fn, args))
+        with self._lock:
+            if self._idle == 0 and self._spawned < self._max:
+                self._spawned += 1
+                n = self._spawned
+                threading.Thread(
+                    target=self._work, daemon=True,
+                    name=f"{self._name}-{n}").start()
+
+    def _work(self) -> None:
+        while True:
+            with self._lock:
+                self._idle += 1
+            try:
+                fn, args = self._queue.get()
+            finally:
+                with self._lock:
+                    self._idle -= 1
+            try:
+                fn(*args)
+            except BaseException:  # noqa: BLE001 — bodies own their errors
+                logger.exception("daemon-pool task failed (%s)", self._name)
 
 
 class RuntimeContext:
@@ -163,6 +209,17 @@ class Runtime:
         # times a second).
         self._actors_changed = threading.Condition()
         self._actor_queues: dict[ActorID, Any] = {}
+        # Actor-creation bodies (lease + handle construction) run on a
+        # shared pool instead of a thread per .remote(): at creation
+        # waves, per-actor Thread.start stalls (~tens of ms each under
+        # load) otherwise serialize on the submitting thread. Bodies
+        # can park in lease waits, so the pool is deep; beyond it,
+        # creations queue FIFO — a saner regime than 1000 unthrottled
+        # creation threads anyway.
+        self._actor_create_pool = _DaemonPool(64, "ray_tpu-actor-create")
+        # Separate tiny pool for plain Thread.start offloads: those
+        # must never queue behind parked creation bodies.
+        self._thread_start_pool = _DaemonPool(4, "ray_tpu-thread-start")
         self._foreign_proxies: dict[tuple[str, str], Any] = {}
         self._actor_leases: dict[ActorID, tuple[NodeID, dict, Any]] = {}
         # (deadline, [refs]) grace pins for nested args of in-flight
@@ -388,6 +445,10 @@ class Runtime:
                 target=self._watch_remote_nodes, daemon=True,
                 name="ray_tpu-node-watcher")
             self._node_watcher.start()
+            # Pipelined execute path: tasks claimed for one remote node
+            # in a dispatch pass ride a single execute_task_batch RPC.
+            self.dispatcher.set_batch_hooks(self._task_batch_key,
+                                            self._run_task_batch)
 
     # ------------------------------------------------------ remote exec plane
 
@@ -1040,37 +1101,7 @@ class Runtime:
                     ran_on_pool = self._try_execute_remote(
                         spec, node, remote_handle)
                 except NodeBusyError:
-                    # Spillback (reference: the raylet redirects the
-                    # lease): requeue avoiding this node; once every
-                    # remote node has rejected, the avoid set resets so
-                    # the task keeps probing as capacity frees up —
-                    # after a growing delay, so saturated clusters are
-                    # polled, not hammered with submit/RPC hot spins.
-                    avoid = getattr(spec, "_avoid_nodes", set())
-                    avoid.add(node.node_id)
-                    delay = 0.0
-                    with self._remote_nodes_lock:
-                        if avoid >= set(self._remote_nodes):
-                            avoid = set()
-                            spills = getattr(spec, "_spill_rounds", 0) + 1
-                            spec._spill_rounds = spills
-                            delay = min(0.05 * (2 ** min(spills, 6)), 2.0)
-                    spec._avoid_nodes = avoid
-                    deps = [a for a in spec.args
-                            if isinstance(a, ObjectRef)] + [
-                        v for v in spec.kwargs.values()
-                        if isinstance(v, ObjectRef)]
-
-                    def requeue():
-                        self.dispatcher.submit(
-                            spec, self._execute_task, deps)
-
-                    if delay > 0:
-                        timer = threading.Timer(delay, requeue)
-                        timer.daemon = True
-                        timer.start()
-                    else:
-                        requeue()
+                    self._spillback_requeue(spec, node)
                     return
             elif self.worker_pool is not None:
                 ran_on_pool = self._try_execute_on_pool(spec, node)
@@ -1095,25 +1126,65 @@ class Runtime:
                 end_time=time.time(),
                 node_id=node.node_id.hex() if node else ""))
         except BaseException as exc:  # noqa: BLE001 — becomes a TaskError ref
-            if self._maybe_retry(spec, exc):
-                return
-            from ray_tpu.exceptions import ObjectLostError
-
-            # ObjectLostError passes through unwrapped: a task that failed
-            # because its input is unrecoverable should surface the loss,
-            # not a generic TaskError around it.
-            error = exc if isinstance(
-                exc, (TaskError, TaskCancelledError, ObjectLostError)) else \
-                TaskError(exc,
-                          getattr(exc, "__ray_tpu_remote_tb__", None)
-                          or format_traceback(exc), spec.name)
-            for rid in spec.return_ids:
-                self.store.put_error(rid, error)
-            self.gcs.record_task_event(TaskEvent(
-                spec.task_id, spec.name, "FAILED", start_time=start,
-                end_time=time.time(), error=repr(exc)))
+            self._finish_task_failure(spec, exc, start)
         finally:
             RuntimeContext.clear()
+
+    def _finish_task_failure(self, spec: TaskSpec, exc: BaseException,
+                             start: float) -> None:
+        """Terminal failure handling shared by the single and batched
+        execute paths: retry when policy allows, else seal the error."""
+        if self._maybe_retry(spec, exc):
+            return
+        from ray_tpu.exceptions import ObjectLostError, WorkerCrashedError
+
+        # ObjectLostError and WorkerCrashedError pass through unwrapped:
+        # a task that failed because its input is unrecoverable (or its
+        # worker died under it) should surface the system failure, not a
+        # generic TaskError around it (reference: ray.exceptions raises
+        # WorkerCrashedError directly).
+        error = exc if isinstance(
+            exc, (TaskError, TaskCancelledError, ObjectLostError,
+                  WorkerCrashedError)) else \
+            TaskError(exc,
+                      getattr(exc, "__ray_tpu_remote_tb__", None)
+                      or format_traceback(exc), spec.name)
+        for rid in spec.return_ids:
+            self.store.put_error(rid, error)
+        self.gcs.record_task_event(TaskEvent(
+            spec.task_id, spec.name, "FAILED", start_time=start,
+            end_time=time.time(), error=repr(exc)))
+
+    def _spillback_requeue(self, spec: TaskSpec, node: NodeState) -> None:
+        """Spillback (reference: the raylet redirects the lease):
+        requeue avoiding this node; once every remote node has
+        rejected, the avoid set resets so the task keeps probing as
+        capacity frees up — after a growing delay, so saturated
+        clusters are polled, not hammered with submit/RPC hot spins."""
+        avoid = getattr(spec, "_avoid_nodes", set())
+        avoid.add(node.node_id)
+        delay = 0.0
+        with self._remote_nodes_lock:
+            if avoid >= set(self._remote_nodes):
+                avoid = set()
+                spills = getattr(spec, "_spill_rounds", 0) + 1
+                spec._spill_rounds = spills
+                delay = min(0.05 * (2 ** min(spills, 6)), 2.0)
+        spec._avoid_nodes = avoid
+        deps = [a for a in spec.args
+                if isinstance(a, ObjectRef)] + [
+            v for v in spec.kwargs.values()
+            if isinstance(v, ObjectRef)]
+
+        def requeue():
+            self.dispatcher.submit(spec, self._execute_task, deps)
+
+        if delay > 0:
+            timer = threading.Timer(delay, requeue)
+            timer.daemon = True
+            timer.start()
+        else:
+            requeue()
 
     def _try_execute_on_pool(self, spec: TaskSpec, node=None) -> bool:
         """Run the task on a pool worker process behind the serialization
@@ -1299,6 +1370,211 @@ class Runtime:
         self._seal_remote_results(spec.return_ids, results,
                                   node.node_id, handle.address)
         return True
+
+    # ----------------------------------------------------- batched dispatch
+
+    def _task_batch_key(self, spec: TaskSpec, node, run):
+        """Dispatcher hook: tasks claimed for the same REMOTE node in
+        one pass coalesce into a single execute_task_batch RPC. Local
+        tasks, TPU tasks and custom run callables (placement-group
+        wrappers) keep the A/B-measured thread-per-task path."""
+        if node is None or run != self._execute_task:
+            return None
+        if any(k.startswith("TPU") for k in spec.resources):
+            return None
+        with self._remote_nodes_lock:
+            if node.node_id not in self._remote_nodes:
+                return None
+        return node.node_id
+
+    def _collect_remote_results(self, return_ids, results, node_id,
+                                address, out_pairs) -> None:
+        """Per-task reply descriptors -> (rid, value) seal pairs
+        appended to ``out_pairs`` (the caller seals the whole
+        completion group in one store.put_batch). Raises on an err
+        descriptor — failing only ITS task."""
+        from ray_tpu._private import serialization
+        from ray_tpu._private.node_executor import RemoteBlob
+
+        for rid, packed in zip(return_ids, results):
+            if packed[0] == "inline":
+                out_pairs.append((rid, serialization
+                                  .deserialize_from_buffer(
+                                      memoryview(packed[1]))))
+            elif packed[0] == "stored":
+                out_pairs.append((rid, RemoteBlob(
+                    node_id.hex(), address, packed[1])))
+                self._record_location(rid, node_id)
+            else:  # ("err", blob): this return value failed to pickle
+                exc, tb = serialization.deserialize_from_buffer(
+                    memoryview(packed[1]))
+                exc.__ray_tpu_remote_tb__ = tb
+                raise exc
+
+    def _run_task_batch(self, specs: list[TaskSpec], node: NodeState,
+                        complete) -> None:
+        """Batch runner handed to the dispatcher: ONE
+        execute_task_batch RPC carries the whole run to ``node``;
+        grouped completions seal in batches as they stream back, and
+        each task's admission releases individually via ``complete``
+        (no barrier on the slowest sibling)."""
+        from ray_tpu._private import serialization
+        from ray_tpu._private.rpc import RpcError, RpcMethodError
+        from ray_tpu.exceptions import WorkerCrashedError
+
+        with self._remote_nodes_lock:
+            handle = self._remote_nodes.get(node.node_id)
+        if handle is None:
+            # Node dropped between claim and launch: the single path
+            # owns the unreachable-node bookkeeping.
+            for spec in specs:
+                try:
+                    self._execute_task(spec, node)
+                finally:
+                    complete(spec)
+            return
+        start = time.time()
+        client_addr = self._client_server_addr() or None
+        entries: list = []
+        ctx_by_idx: dict[int, Any] = {}
+        spec_by_idx: dict[int, TaskSpec] = {}
+        fallback: list[TaskSpec] = []
+        events = []
+        for spec in specs:
+            try:
+                digest, func_blob = self._function_blob(spec.func)
+                args_blob = self._convert_remote_args(spec.args,
+                                                      spec.kwargs)
+            except Exception:  # noqa: BLE001 — unpicklable: run locally
+                fallback.append(spec)
+                continue
+            has_refs = any(isinstance(a, ObjectRef) for a in spec.args) \
+                or any(isinstance(v, ObjectRef)
+                       for v in spec.kwargs.values())
+            token = spec.task_id.hex()
+            with handle._digest_lock:
+                known = digest in handle.known_digests
+                # Optimistic: a daemon restart surfaces as a per-task
+                # need_func reply, retried through the single path.
+                handle.known_digests.add(digest)
+            idx = len(entries)
+            entries.append((
+                digest, None if known else func_blob, args_blob,
+                spec.num_returns,
+                [rid.binary() for rid in spec.return_ids],
+                spec.runtime_env, spec.resources, token,
+                1 if has_refs else 0))
+            spec_by_idx[idx] = spec
+            ctx = _RemoteBlockContext(self.cluster, node.node_id,
+                                      spec.resources, handle, token)
+            ctx_by_idx[idx] = ctx
+            with self._inflight_blocks_lock:
+                self._inflight_blocks[token] = ctx
+            events.append(TaskEvent(
+                spec.task_id, spec.name, "RUNNING", start_time=start,
+                node_id=node.node_id.hex()))
+        self.gcs.record_task_events(events)
+
+        def finish_idx(idx: int) -> None:
+            spec = spec_by_idx.pop(idx, None)
+            if spec is None:
+                return
+            ctx = ctx_by_idx.pop(idx, None)
+            if ctx is not None:
+                with self._inflight_blocks_lock:
+                    self._inflight_blocks.pop(spec.task_id.hex(), None)
+                ctx.drain()
+            complete(spec)
+
+        def on_results(group) -> None:
+            pairs: list = []
+            done_events = []
+            end = time.time()
+            for idx, reply in group:
+                spec = spec_by_idx.get(idx)
+                if spec is None:
+                    continue  # duplicate reply
+                if reply[0] == "ok":
+                    try:
+                        self._collect_remote_results(
+                            spec.return_ids, reply[1], node.node_id,
+                            handle.address, pairs)
+                        done_events.append(TaskEvent(
+                            spec.task_id, spec.name, "FINISHED",
+                            start_time=start, end_time=end,
+                            node_id=node.node_id.hex()))
+                    except BaseException as exc:  # noqa: BLE001
+                        self._finish_task_failure(spec, exc, start)
+                    finish_idx(idx)
+                elif reply[0] == "err":
+                    exc, tb = serialization.deserialize_from_buffer(
+                        memoryview(reply[1]))
+                    exc.__ray_tpu_remote_tb__ = tb
+                    self._finish_task_failure(spec, exc, start)
+                    finish_idx(idx)
+                elif reply[0] == "busy":
+                    finish_idx(idx)
+                    self._spillback_requeue(spec, node)
+                else:  # ("need_func", _): single path re-ships the blob
+                    def redo(spec=spec):
+                        try:
+                            self._execute_task(spec, node)
+                        finally:
+                            complete(spec)
+
+                    spec_by_idx.pop(idx, None)
+                    ctx = ctx_by_idx.pop(idx, None)
+                    if ctx is not None:
+                        with self._inflight_blocks_lock:
+                            self._inflight_blocks.pop(
+                                spec.task_id.hex(), None)
+                        ctx.drain()
+                    threading.Thread(target=redo, daemon=True,
+                                     name="ray_tpu-task-refunc").start()
+            if pairs:
+                self.store.put_batch(pairs)
+            if done_events:
+                self.gcs.record_task_events(done_events)
+
+        def on_parked(idx: int) -> None:
+            # The daemon queued this task's frame behind a blocked
+            # lease head: it holds admission without running — release
+            # its CPU on the driver ledger until it actually starts.
+            ctx = ctx_by_idx.get(idx)
+            if ctx is not None:
+                ctx.block()
+
+        def on_resumed(idx: int) -> None:
+            ctx = ctx_by_idx.get(idx)
+            if ctx is not None:
+                ctx.unblock(force=True)
+
+        transport_exc: BaseException | None = None
+        if entries:
+            try:
+                handle.execute_batch(entries, on_results, on_parked,
+                                     on_resumed, client_addr)
+            except (RpcError, RpcMethodError, OSError) as exc:
+                transport_exc = exc
+        if spec_by_idx:
+            # Stream cut (or daemon replied short): the leftovers are
+            # in the same in-flight-loss state as a failed single RPC.
+            if transport_exc is not None and not handle.ping():
+                self._drop_remote_node(node.node_id)
+            for idx in list(spec_by_idx):
+                spec = spec_by_idx.get(idx)
+                if spec is None:
+                    continue
+                err = WorkerCrashedError(
+                    f"node {node.node_id.hex()[:8]} lost task "
+                    f"{spec.name} mid-batch: {transport_exc}")
+                self._finish_task_failure(spec, err, start)
+                finish_idx(idx)
+        for spec in fallback:
+            try:
+                self._execute_task(spec, node)
+            finally:
+                complete(spec)
 
     def ensure_client_server(self) -> None:
         """Start the client server on first need (idempotent)."""
@@ -1794,8 +2070,7 @@ class Runtime:
             self._record_actor_placement(record, actor, node_id)
             self.gcs.update_actor_state(actor_id, "ALIVE")
 
-        threading.Thread(target=start_actor, daemon=True,
-                         name=f"ray_tpu-actor-create-{cls.__name__}").start()
+        self._actor_create_pool.submit(start_actor)
         return actor_id, creation_ref
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str,
@@ -1887,9 +2162,33 @@ class Runtime:
                 # the next call arrives, pinning freed objects.
                 call = None
 
-        threading.Thread(target=drain, daemon=True,
-                         name=f"ray_tpu-actor-submit-{actor_id.hex()[:8]}").start()
+        # The drain thread is long-lived per actor, but its START is
+        # offloaded: Thread.start blocks until the child's bootstrap
+        # gets scheduled, and on a loaded box that stall lands on every
+        # first method call of a creation wave. The queue buffers calls
+        # until the drain attaches.
+        drain_thread = threading.Thread(
+            target=drain, daemon=True,
+            name=f"ray_tpu-actor-submit-{actor_id.hex()[:8]}")
+        self._thread_start_pool.submit(drain_thread.start)
         return submit_queue
+
+    def execution_pipeline_stats(self) -> dict:
+        """Driver-side per-stage drain counters for the pipelined
+        execute path (the daemon-side stages live in each node's
+        ``executor_stats()['pipeline']``): dispatch = scheduler batch
+        coalescing, seal = grouped result sealing."""
+        return {
+            "dispatch": {
+                "batches": self.dispatcher.batches_launched,
+                "batch_tasks": self.dispatcher.batch_tasks_launched,
+                "singles": self.dispatcher.singles_launched,
+            },
+            "seal": {
+                "batch_seals": self.store.batch_seals,
+                "batch_sealed_objects": self.store.batch_sealed_objects,
+            },
+        }
 
     def _release_actor_lease(self, actor_id: ActorID) -> None:
         """Give back an actor's resource lease (idempotent)."""

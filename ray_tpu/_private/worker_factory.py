@@ -68,8 +68,13 @@ def _child_exec(req: dict, pipe_fd: int | None = None) -> None:
     """Post-fork setup then the normal worker serve loop. Never returns."""
     rc = 1
     try:
+        import gc
         import signal
 
+        # The template disabled gc around its freeze(); workers do real
+        # work and must collect cycles again (frozen template objects
+        # stay permanent — the child never pages them in via gc).
+        gc.enable()
         signal.signal(signal.SIGCHLD, signal.SIG_DFL)
         env = req.get("env") or {}
         # REPLACE the environment (Popen semantics), don't merge: a var
@@ -77,6 +82,12 @@ def _child_exec(req: dict, pipe_fd: int | None = None) -> None:
         # worker.
         os.environ.clear()
         os.environ.update({k: str(v) for k, v in env.items()})
+        # Back-channel to the template (argv survives the fork): a
+        # worker that ends up importing jax touches this marker so the
+        # template upgrades itself for future forks (two-stage boot).
+        if len(sys.argv) > 1:
+            os.environ["RAY_TPU_FACTORY_MARKER"] = os.path.join(
+                os.path.dirname(sys.argv[1]), JAX_MARKER)
         # The Popen path hands PYTHONPATH to a fresh interpreter; a fork
         # must apply it by hand (and pip/conda runtime envs layer their
         # site-packages the same way at task level).
@@ -123,22 +134,90 @@ def _child_exec(req: dict, pipe_fd: int | None = None) -> None:
         os._exit(rc)
 
 
+def _freeze_heap() -> None:
+    # Freeze the template heap: everything imported so far moves to the
+    # permanent generation, so the CHILDREN's garbage collector never
+    # scans (and copy-on-write-faults) those pages. Without this every
+    # fork pays ~tens of ms of CoW churn the moment its first gc cycle
+    # walks the inherited jax/numpy object graph — at actor-creation
+    # waves that churn IS the bottleneck on 1-core hosts.
+    import gc
+
+    gc.disable()
+    gc.collect()
+    gc.freeze()
+
+
+JAX_MARKER = "jax_wanted"
+
+
 def factory_main(sock_path: str, parent_pid: int) -> None:
     # Pre-import the worker stack ONCE; every fork shares these pages.
     # Workers are CPU processes (the daemon owns the TPU), so importing
-    # jax here is safe and saves each fork its heaviest import. Lean
-    # mode (RAY_TPU_FACTORY_LEAN=1) skips the jax preimport: forks of a
-    # small template fault far fewer copy-on-write pages, which is the
-    # difference between ~40ms and ~15ms per actor/worker spawn on
-    # 1-core hosts — workloads whose workers never touch jax (control-
-    # plane actors, pure-python tasks) should set it.
+    # jax here is safe and saves each fork its heaviest import. But the
+    # jax import is ~3x the rest of the template boot, and a fleet of
+    # daemons booting factories serializes those imports on the host's
+    # cores right when an actor/task wave needs them — so the default
+    # is a TWO-STAGE boot: come up with only the worker stack + numpy
+    # (fast READY, cheap-but-jaxless forks) and import jax later, the
+    # first time a forked worker actually pulls jax in. The children
+    # can't message us over the spawn socket (they hold no client), so
+    # the signal is a marker file in the socket's private 0700 dir:
+    # forks inherit this argv, notice 'jax' landing in their
+    # sys.modules, and touch it; we poll it from the accept loop and
+    # upgrade between spawn requests.
+    #   RAY_TPU_FACTORY_JAX=eager restores the old import-at-boot
+    #   behaviour; RAY_TPU_FACTORY_LEAN=1 (or FACTORY_JAX=off) never
+    #   imports jax into the template at all.
     import ray_tpu._private.worker_pool  # noqa: F401
+
+    mode = os.environ.get("RAY_TPU_FACTORY_JAX", "auto").lower()
     if os.environ.get("RAY_TPU_FACTORY_LEAN",
-                      "0").lower() in ("", "0", "false", "no"):
+                      "0").lower() not in ("", "0", "false", "no"):
+        mode = "off"
+    jax_loaded = False
+    if mode == "eager":
         try:
             import jax  # noqa: F401
+
+            jax_loaded = True
         except Exception:  # noqa: BLE001 — workers will import lazily
             pass
+    elif mode != "off":
+        try:
+            # Everything a non-jax worker touches on its first task,
+            # so stage-one forks are as cheap as fully-warmed ones:
+            # numpy (result packing, user arrays) plus the worker-side
+            # runtime modules and their stdlib closure (measured as the
+            # sys.modules delta of a fresh fork's first no-op task).
+            import multiprocessing.connection  # noqa: F401
+            import pathlib  # noqa: F401
+            import shutil  # noqa: F401
+            import tempfile  # noqa: F401
+            import zipfile  # noqa: F401
+
+            import numpy  # noqa: F401
+
+            import ray_tpu._private.rpc  # noqa: F401
+            import ray_tpu._private.runtime_env_packaging  # noqa: F401
+            import ray_tpu._private.worker_client  # noqa: F401
+        except Exception:  # noqa: BLE001
+            pass
+    _freeze_heap()
+    marker_path = os.path.join(os.path.dirname(sock_path), JAX_MARKER)
+
+    def _maybe_upgrade() -> None:
+        nonlocal jax_loaded
+        if jax_loaded or mode in ("off", "eager"):
+            return
+        if not os.path.exists(marker_path):
+            return
+        try:
+            import jax  # noqa: F401
+        except Exception:  # noqa: BLE001 — keep serving lean forks
+            pass
+        jax_loaded = True  # don't re-attempt either way
+        _freeze_heap()
 
     server = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     server.bind(sock_path)
@@ -159,6 +238,9 @@ def factory_main(sock_path: str, parent_pid: int) -> None:
         try:
             conn, _ = server.accept()
         except socket.timeout:
+            # Idle moment: safe to pay the ~0.5s jax import without
+            # stalling a queued spawn request.
+            _maybe_upgrade()
             continue
         except OSError:
             break

@@ -84,6 +84,21 @@ class _ShmRef:
     desc: ShmDescriptor
 
 
+@dataclass
+class _BatchTask:
+    """One task of a pipelined batch run (WorkerPool.run_task_batch)."""
+
+    idx: int                    # caller's position in the batch
+    digest: str
+    func_blob: bytes | None     # resolved by the caller (never None
+    args_blob: bytes            # unless the worker already knows digest)
+    n_returns: int
+    runtime_env: dict | None = None
+    token: str | None = None
+    client_addr: str | None = None
+    sys_path: list | None = None
+
+
 # --------------------------------------------------------------------------
 # Worker process side
 # --------------------------------------------------------------------------
@@ -295,11 +310,84 @@ def worker_main(conn) -> None:
             arena.close()
 
 
+def _exec_task_body(fields: tuple, func_cache: dict,
+                    client: ShmClient, arena, arena_max: int) -> list:
+    """Execute one task message body (the fields after the kind/call-id
+    prefix) and return the packed result descriptors. Shared by the
+    classic one-in-flight ``task`` protocol and the pipelined
+    ``task_seq`` protocol."""
+    (digest, func_blob, args_blob, n_returns, renv, token) = fields[:6]
+    # Daemon pools serve many drivers: the owning driver's
+    # client-server address rides with each task so nested
+    # API calls reach the right owner (reference: every
+    # worker knows its owner's CoreWorker address).
+    client_addr = fields[6] if len(fields) > 6 else None
+    if len(fields) > 7 and fields[7]:
+        # Driver import paths for by-reference pickles.
+        sys.path.extend(p for p in fields[7]
+                        if p not in sys.path)
+    if func_blob is not None:
+        func = serialization.loads_function(func_blob)
+        func_cache[digest] = func
+    else:
+        func = func_cache[digest]
+    args, kwargs = serialization.deserialize_from_buffer(
+        memoryview(args_blob))
+    args, kwargs = _resolve_shm_args(args, kwargs, client)
+    # Token rides along on nested get()/wait() RPCs so the
+    # driver can release this task's CPU while it blocks.
+    from ray_tpu._private import worker_client
+
+    if client_addr:
+        worker_client.set_driver_addr(client_addr)
+    worker_client.set_task_token(token)
+    try:
+        with _runtime_env_ctx(renv):
+            result = func(*args, **kwargs)
+    finally:
+        worker_client.set_task_token(None)
+    if n_returns == 0:
+        values = []
+    elif n_returns == 1:
+        values = [result]
+    else:
+        if (not isinstance(result, (tuple, list))
+                or len(result) != n_returns):
+            raise ValueError(
+                f"task declared num_returns={n_returns} but "
+                f"returned {type(result).__name__}")
+        values = list(result)
+    return _pack_results(values, arena, arena_max)
+
+
+_jax_marked = False
+
+
+def _mark_jax_if_imported() -> None:
+    """Tell the fork-server template when this worker pulled jax in:
+    the template (two-stage boot, worker_factory.py) watches for the
+    marker and preimports jax for every LATER fork. One bool check per
+    message once the marker is dropped."""
+    global _jax_marked
+    if _jax_marked or "jax" not in sys.modules:
+        return
+    _jax_marked = True
+    path = os.environ.get("RAY_TPU_FACTORY_MARKER")
+    if not path:
+        return
+    try:
+        with open(path, "w"):
+            pass
+    except OSError:
+        pass
+
+
 def _serve(conn, client: ShmClient, arena=None,
            arena_max: int = 0) -> None:
     actor_instance = None
     func_cache: dict[str, Any] = {}
     while True:
+        _mark_jax_if_imported()
         try:
             msg = conn.recv()
         except (EOFError, OSError):
@@ -311,49 +399,22 @@ def _serve(conn, client: ShmClient, arena=None,
             elif kind == "ping":
                 conn.send(("pong", os.getpid()))
             elif kind == "task":
-                (_, digest, func_blob, args_blob, n_returns, renv,
-                 token) = msg[:7]
-                # Daemon pools serve many drivers: the owning driver's
-                # client-server address rides with each task so nested
-                # API calls reach the right owner (reference: every
-                # worker knows its owner's CoreWorker address).
-                client_addr = msg[7] if len(msg) > 7 else None
-                if len(msg) > 8 and msg[8]:
-                    # Driver import paths for by-reference pickles.
-                    sys.path.extend(p for p in msg[8]
-                                    if p not in sys.path)
-                if func_blob is not None:
-                    func = serialization.loads_function(func_blob)
-                    func_cache[digest] = func
-                else:
-                    func = func_cache[digest]
-                args, kwargs = serialization.deserialize_from_buffer(
-                    memoryview(args_blob))
-                args, kwargs = _resolve_shm_args(args, kwargs, client)
-                # Token rides along on nested get()/wait() RPCs so the
-                # driver can release this task's CPU while it blocks.
-                from ray_tpu._private import worker_client
-
-                if client_addr:
-                    worker_client.set_driver_addr(client_addr)
-                worker_client.set_task_token(token)
+                conn.send(("ok", _exec_task_body(
+                    msg[1:], func_cache, client, arena, arena_max)))
+            elif kind == "task_seq":
+                # Pipelined protocol: frames arrive back-to-back (the
+                # sender does not wait for replies), execute serially
+                # in receive order, and each reply carries its call id
+                # so the daemon-side lease matches them out of order.
+                call_id = msg[1]
                 try:
-                    with _runtime_env_ctx(renv):
-                        result = func(*args, **kwargs)
-                finally:
-                    worker_client.set_task_token(None)
-                if n_returns == 0:
-                    values = []
-                elif n_returns == 1:
-                    values = [result]
+                    packed = _exec_task_body(
+                        msg[2:], func_cache, client, arena, arena_max)
+                except BaseException as exc:  # noqa: BLE001 — per-task
+                    conn.send(("task_done", call_id, "err",
+                               _exception_blob(exc)))
                 else:
-                    if (not isinstance(result, (tuple, list))
-                            or len(result) != n_returns):
-                        raise ValueError(
-                            f"task declared num_returns={n_returns} but "
-                            f"returned {type(result).__name__}")
-                    values = list(result)
-                conn.send(("ok", _pack_results(values, arena, arena_max)))
+                    conn.send(("task_done", call_id, "ok", packed))
             elif kind == "actor_new":
                 _, cls_blob, args_blob, renv, max_concurrency = msg[:5]
                 # Remote actors: the creating driver's sys.path entries
@@ -443,6 +504,7 @@ def _serve_actor_concurrent(conn, instance, client: ShmClient, arena,
             pass  # driver gone; the process is about to exit anyway
 
     while True:
+        _mark_jax_if_imported()
         try:
             msg = conn.recv()
         except (EOFError, OSError):
@@ -739,6 +801,30 @@ class PoolWorker:
                 err.worker_pid = self.proc.pid  # OOM-kill attribution
                 raise err from exc
 
+    def send_nowait(self, msg: tuple) -> None:
+        """Pipelined send: deliver one frame without waiting for its
+        reply (the lease owner matches tagged replies itself). Raises
+        _WorkerUnavailable when the frame never reached the worker."""
+        with self._lock:
+            try:
+                self.conn.send(msg)
+            except (OSError, BrokenPipeError, ValueError) as exc:
+                raise _WorkerUnavailable(
+                    f"worker {self.index} (pid {self.proc.pid}) "
+                    f"unreachable: {exc!r}") from exc
+
+    def recv_reply(self) -> tuple:
+        """Pipelined receive (single reader: the lease owner). Raises
+        WorkerCrashedError when the process died."""
+        try:
+            return self.conn.recv()
+        except (EOFError, OSError) as exc:
+            err = WorkerCrashedError(
+                f"worker {self.index} (pid {self.proc.pid}) "
+                f"died: {exc!r}")
+            err.worker_pid = self.proc.pid  # OOM-kill attribution
+            raise err from exc
+
     def alive(self) -> bool:
         return self.proc.poll() is None
 
@@ -787,6 +873,11 @@ class WorkerPool:
         self._next_index = 0
         self._num_leased = 0
         self._shutdown = False
+        # Pipelined-batch counters (executor_stats drain stages).
+        self._batch_lock = threading.Lock()
+        self.batch_runs = 0     # multi-task lease runs
+        self.batch_tasks = 0    # tasks entering run_task_batch
+        self.batch_frames = 0   # pipelined frames actually sent
         # Spawn in parallel: each worker blocks on interpreter boot +
         # socket handshake, so serial startup would be O(N).
         # size=0 is a legal lazy pool — no prestart, growth on demand
@@ -897,6 +988,175 @@ class WorkerPool:
                     threading.Thread(target=worker.stop,
                                      daemon=True).start()
             self._lock.notify()
+
+    # ----------------------------------------------------- pipelined batches
+
+    def try_acquire_idle(self) -> "PoolWorker | None":
+        """Non-blocking lease of an IDLE worker: never grows the pool,
+        never waits (opportunistic extra lease runners for a batch)."""
+        with self._lock:
+            if self._shutdown:
+                return None
+            while self._idle:
+                worker = self._idle.pop()
+                if worker.alive():
+                    self._num_leased += 1
+                    return worker
+                worker.stop()
+        return None
+
+    def run_task_batch(self, tasks: "list[_BatchTask]", on_result,
+                       depth: int, tracker=None) -> None:
+        """Execute a batch over pipelined multi-task worker leases.
+
+        One blocking lease is taken up front; whenever a runner's
+        pipeline is full (or deeper than the remaining queue) and tasks
+        are still queued, IDLE workers are leased opportunistically —
+        short tasks drain through one amortized lease, long tasks fan
+        out across workers. Each lease keeps up to ``depth`` call-id-
+        tagged frames in flight (the acquire/release and the function-
+        digest check are paid once per run, not once per task).
+
+        ``on_result(task, status, payload)`` fires exactly once per
+        task from runner threads: status is "ok" (packed descriptors),
+        "err" (exception blob) or "crash" (WorkerCrashedError — the
+        task may have started). Worker death mid-pipeline fails ONLY
+        the oldest in-flight frame; the rest were never started and are
+        requeued onto a fresh lease.
+
+        ``tracker`` (optional) observes lease composition for
+        blocked-head parking: sent(key, token), done(key, token),
+        drop_lease(key).
+        """
+        from collections import deque
+
+        if not tasks:
+            return
+        state = _BatchState(deque(tasks), on_result, max(1, depth),
+                            tracker, len(tasks))
+        with self._batch_lock:
+            self.batch_runs += 1
+            self.batch_tasks += len(tasks)
+        worker = self._acquire()
+        self._batch_runner(worker, state)
+        # The primary runner returned (queue empty, its frames done);
+        # sibling runners may still hold in-flight frames.
+        state.done.wait()
+
+    def _maybe_extra_runner(self, state: "_BatchState") -> None:
+        with state.lock:
+            if not state.queue:
+                return
+        worker = self.try_acquire_idle()
+        if worker is None:
+            return
+        threading.Thread(target=self._batch_runner,
+                         args=(worker, state), daemon=True,
+                         name="pool-batch-lease").start()
+
+    def _batch_runner(self, worker: "PoolWorker",
+                      state: "_BatchState") -> None:
+        from collections import deque
+
+        tracker = state.tracker
+        while True:  # one iteration per lease (worker replaced on crash)
+            lease_key = object()
+            inflight: deque = deque()  # (call_id, task)
+            next_id = 0
+            crashed: BaseException | None = None
+            while True:
+                while len(inflight) < state.depth:
+                    with state.lock:
+                        task = (state.queue.popleft()
+                                if state.queue else None)
+                    if task is None:
+                        break
+                    blob = (None if task.digest in worker.known_digests
+                            else task.func_blob)
+                    next_id += 1
+                    try:
+                        worker.send_nowait(
+                            ("task_seq", next_id, task.digest, blob,
+                             task.args_blob, task.n_returns,
+                             task.runtime_env, task.token,
+                             task.client_addr,
+                             task.sys_path if blob is not None
+                             else None))
+                    except _WorkerUnavailable as exc:
+                        # Never delivered: this task is retryable as
+                        # unstarted alongside the queued in-flight ones.
+                        with state.lock:
+                            state.queue.appendleft(task)
+                        crashed = exc
+                        break
+                    worker.known_digests.add(task.digest)
+                    inflight.append((next_id, task))
+                    with self._batch_lock:
+                        self.batch_frames += 1
+                    if tracker is not None and task.token:
+                        tracker.sent(lease_key, task.token)
+                if crashed is not None:
+                    break
+                if not inflight:
+                    self._release(worker)
+                    return
+                with state.lock:
+                    more = bool(state.queue)
+                if more:
+                    self._maybe_extra_runner(state)
+                try:
+                    msg = worker.recv_reply()
+                except WorkerCrashedError as exc:
+                    crashed = exc
+                    break
+                if msg[0] != "task_done":
+                    continue  # stray classic-protocol frame
+                _, call_id, status, payload = msg
+                task = None
+                for i, (cid, t) in enumerate(inflight):
+                    if cid == call_id:
+                        task = t
+                        del inflight[i]
+                        break
+                if task is None:
+                    continue
+                if tracker is not None and task.token:
+                    tracker.done(lease_key, task.token)
+                self._complete_one(state, task, status, payload)
+            # Worker died (or refused the frame). The OLDEST in-flight
+            # frame was executing — it may have side effects, so it
+            # fails; everything behind it never started and is retried
+            # on a fresh lease.
+            if tracker is not None:
+                tracker.drop_lease(lease_key)
+            started = inflight.popleft() if inflight else None
+            if started is not None:
+                self._complete_one(state, started[1], "crash", crashed)
+            with state.lock:
+                state.queue.extendleft(t for _, t in reversed(inflight))
+                remaining = bool(state.queue)
+            self._release(worker)
+            if not remaining:
+                return
+            try:
+                worker = self._acquire()
+            except BaseException:  # noqa: BLE001 — pool shut down
+                with state.lock:
+                    stranded = list(state.queue)
+                    state.queue.clear()
+                for task in stranded:
+                    self._complete_one(state, task, "crash", crashed)
+                return
+
+    def _complete_one(self, state: "_BatchState", task: "_BatchTask",
+                      status: str, payload) -> None:
+        try:
+            state.on_result(task, status, payload)
+        finally:
+            with state.lock:
+                state.remaining -= 1
+                if state.remaining <= 0:
+                    state.done.set()
 
     # ------------------------------------------------------------- task path
 
@@ -1019,6 +1279,24 @@ class WorkerPool:
             self._lock.notify_all()
         for w in workers:
             w.stop()
+
+
+class _BatchState:
+    """Shared state of one run_task_batch call: the task queue lease
+    runners pull from, completion accounting, and the parking
+    tracker."""
+
+    __slots__ = ("queue", "on_result", "depth", "tracker", "remaining",
+                 "lock", "done")
+
+    def __init__(self, queue, on_result, depth, tracker, n):
+        self.queue = queue
+        self.on_result = on_result
+        self.depth = depth
+        self.tracker = tracker
+        self.remaining = n
+        self.lock = threading.Lock()
+        self.done = threading.Event()
 
 
 class _RemoteTaskError(Exception):

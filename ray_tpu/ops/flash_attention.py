@@ -354,16 +354,19 @@ def flash_attention_gspmd(q, k, v, causal: bool = True,
     """
     import functools
 
-    mesh = jax.sharding.get_abstract_mesh()
-    if mesh.empty or all(mesh.shape.get(a, 1) == 1
-                         for a in ("dp", "fsdp", "tp")):
+    from ray_tpu._private import jax_compat
+
+    mesh = jax_compat.ambient_mesh()
+    if mesh is None or all(dict(mesh.shape).get(a, 1) == 1
+                           for a in ("dp", "fsdp", "tp")):
         return flash_attention(q, k, v, causal, block_q, block_k,
                                interpret)
     from jax.sharding import PartitionSpec as P
 
     spec = P(("dp", "fsdp"), None, "tp", None)
 
-    @functools.partial(jax.shard_map, in_specs=(spec, spec, spec),
+    @functools.partial(jax_compat.shard_map,
+                       in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     def inner(q, k, v):
         return flash_attention(q, k, v, causal, block_q, block_k,

@@ -28,6 +28,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ray_tpu._private import jax_compat
+
 
 def _block_attention(q, k, v, bias, scale):
     """One (q-block, kv-block) flash step: returns (unnormalized o, lse-max
@@ -105,7 +107,7 @@ def ring_attention_sharded(q: jax.Array, k: jax.Array, v: jax.Array,
     spec = P(("dp", "fsdp"), "sp", "tp", None)
 
     @functools.partial(
-        jax.shard_map, mesh=mesh,
+        jax_compat.shard_map, mesh=mesh,
         in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     def inner(q, k, v):
@@ -125,7 +127,8 @@ def ring_attention_gspmd(q: jax.Array, k: jax.Array, v: jax.Array,
     """
     spec = P(("dp", "fsdp"), "sp", "tp", None)
 
-    @functools.partial(jax.shard_map, in_specs=(spec, spec, spec),
+    @functools.partial(jax_compat.shard_map,
+                       in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     def inner(q, k, v):
         return ring_attention(q, k, v, axis_name="sp", causal=causal)

@@ -25,7 +25,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-shard_map = jax.shard_map
+from ray_tpu._private.jax_compat import shard_map
 
 # In-SPMD primitives (layer 1).
 psum = lax.psum

@@ -1,0 +1,91 @@
+"""Guard against silently-regressing committed benchmark refreshes.
+
+BENCH_CORE.json is committed alongside the code that produced it. This
+test compares the working-tree copy against the previously committed
+version (``git show HEAD:BENCH_CORE.json``): any core metric that
+drops more than REGRESSION_TOLERANCE vs the committed baseline fails
+the suite, so a perf regression cannot ride in under a "refreshed
+benchmarks" commit without being called out. All core metrics are
+throughput-shaped (ops/s, GB/s, metric count) — higher is better.
+
+When the working tree and HEAD agree (the common case: no refresh in
+flight) the comparison is trivially flat and the test passes.
+"""
+
+import json
+import subprocess
+from pathlib import Path
+
+import pytest
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+BENCH_CORE = REPO_ROOT / "BENCH_CORE.json"
+
+# A committed refresh may regress a metric by at most this fraction.
+REGRESSION_TOLERANCE = 0.25
+
+
+def _parse_metrics(text: str) -> dict:
+    out = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        row = json.loads(line)
+        out[row["metric"]] = float(row["value"])
+    return out
+
+
+def _committed_bench_core() -> str | None:
+    try:
+        proc = subprocess.run(
+            ["git", "show", "HEAD:BENCH_CORE.json"],
+            cwd=REPO_ROOT, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    return proc.stdout
+
+
+def test_bench_core_no_silent_regression():
+    if not BENCH_CORE.exists():
+        pytest.skip("BENCH_CORE.json not present in the working tree")
+    baseline_text = _committed_bench_core()
+    if baseline_text is None:
+        pytest.skip("no committed BENCH_CORE.json baseline (git "
+                    "unavailable or file not tracked)")
+    baseline = _parse_metrics(baseline_text)
+    current = _parse_metrics(BENCH_CORE.read_text())
+
+    regressions = []
+    for name, base in baseline.items():
+        if name not in current:
+            regressions.append(f"{name}: dropped from the refresh "
+                               f"(baseline {base:g})")
+            continue
+        if base <= 0:
+            continue
+        cur = current[name]
+        drop = (base - cur) / base
+        if drop > REGRESSION_TOLERANCE:
+            regressions.append(
+                f"{name}: {base:g} -> {cur:g} "
+                f"(-{drop * 100:.1f}% > {REGRESSION_TOLERANCE:.0%})")
+    assert not regressions, (
+        "BENCH_CORE.json refresh regresses committed metrics:\n  "
+        + "\n  ".join(regressions))
+
+
+def test_bench_core_parses_and_is_nonempty():
+    """The committed artifact itself must stay well-formed JSONL with
+    the metric/value/unit schema the regression guard reads."""
+    if not BENCH_CORE.exists():
+        pytest.skip("BENCH_CORE.json not present in the working tree")
+    metrics = _parse_metrics(BENCH_CORE.read_text())
+    assert metrics, "BENCH_CORE.json parsed to zero metrics"
+    for line in BENCH_CORE.read_text().splitlines():
+        if not line.strip():
+            continue
+        row = json.loads(line)
+        assert {"metric", "value", "unit"} <= set(row), row

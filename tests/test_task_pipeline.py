@@ -1,0 +1,204 @@
+"""Pipelined task execution: batched dispatch + multi-task worker
+leases + coalesced result sealing.
+
+Covers the execute-path pipeline end to end on a real daemon cluster:
+ordering across a batched dispatch, per-task failure isolation inside
+a batch, cancellation while a batch is in flight, and worker-crash-
+mid-pipeline retry semantics (only unstarted frames are retried; the
+frame that may have started surfaces/ retries as a system failure).
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.exceptions import TaskCancelledError, WorkerCrashedError
+
+
+@pytest.fixture
+def pipeline_cluster():
+    """One daemon, zero driver CPU: every task must ride the remote
+    execute path (and, with several queued at once, the batched
+    execute_task_batch pipeline)."""
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir="/tmp/ray_tpu_test_pipeline")
+    cluster.add_node(num_cpus=2)
+    try:
+        assert cluster.wait_for_nodes(1, timeout=60), \
+            "worker daemon never registered"
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if ray_tpu.cluster_resources().get("CPU", 0) >= 2:
+                break
+            time.sleep(0.2)
+        assert ray_tpu.cluster_resources().get("CPU", 0) >= 2, \
+            "remote node never joined the driver's cluster view"
+        yield runtime
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def _batch_counters(runtime):
+    with runtime._remote_nodes_lock:
+        handles = list(runtime._remote_nodes.values())
+    agg = {"batch_rpcs": 0, "batch_tasks": 0, "frames": 0}
+    for handle in handles:
+        pipe = handle._control.call("executor_stats").get("pipeline", {})
+        agg["batch_rpcs"] += int(pipe.get("batch_rpcs", 0))
+        agg["batch_tasks"] += int(pipe.get("batch_tasks", 0))
+        agg["frames"] += int(pipe.get("worker_pipelined_frames", 0))
+    return agg
+
+
+def test_batch_dispatch_preserves_result_mapping(pipeline_cluster):
+    """A burst larger than the worker count must coalesce into batch
+    RPCs and every ObjectRef must resolve to ITS OWN task's result,
+    not a sibling's (ordering/identity across out-of-order pipelined
+    replies)."""
+
+    @ray_tpu.remote
+    def ident(i):
+        return (i, os.getpid())
+
+    refs = [ident.remote(i) for i in range(120)]
+    out = ray_tpu.get(refs, timeout=120.0)
+    assert [v[0] for v in out] == list(range(120))
+    # The run must actually have used the pipelined path.
+    agg = _batch_counters(pipeline_cluster)
+    assert agg["batch_tasks"] > 0, \
+        f"no tasks rode execute_task_batch: {agg}"
+    assert agg["frames"] > 0, "no pipelined task_seq frames were sent"
+
+
+def test_failure_isolation_inside_batch(pipeline_cluster):
+    """One raising task inside a batched burst must fail alone —
+    siblings before and after it in the same pipeline complete."""
+
+    @ray_tpu.remote
+    def maybe_boom(i):
+        if i % 10 == 3:
+            raise ValueError(f"boom-{i}")
+        return i
+
+    refs = [maybe_boom.remote(i) for i in range(60)]
+    failures, values = 0, 0
+    for i, ref in enumerate(refs):
+        if i % 10 == 3:
+            with pytest.raises(Exception) as exc_info:
+                ray_tpu.get(ref, timeout=120.0)
+            assert f"boom-{i}" in str(exc_info.value)
+            failures += 1
+        else:
+            assert ray_tpu.get(ref, timeout=120.0) == i
+            values += 1
+    assert failures == 6 and values == 54
+
+
+def test_cancellation_mid_batch(pipeline_cluster):
+    """Cancelling queued tasks while a batch drains: cancelled refs
+    raise TaskCancelledError, the rest still complete, and the
+    scheduler stays healthy for new submissions."""
+
+    @ray_tpu.remote(num_cpus=1)
+    def slowish(i):
+        time.sleep(0.25)
+        return i
+
+    # 2 CPUs -> ~2 run at a time; the rest queue (and batch).
+    refs = [slowish.remote(i) for i in range(40)]
+    # Let the first few start, then cancel the tail.
+    first = ray_tpu.get(refs[0], timeout=60.0)
+    assert first == 0
+    for ref in refs[20:]:
+        ray_tpu.cancel(ref)
+    # Head tasks (uncancelled) complete with their own values.
+    head = ray_tpu.get(refs[1:8], timeout=120.0)
+    assert head == list(range(1, 8))
+    # Cancelled tail: TaskCancelledError (a late cancel may lose the
+    # race with an already-running task — allow its value too, but at
+    # least some must actually cancel).
+    cancelled = 0
+    for i, ref in enumerate(refs[20:], start=20):
+        try:
+            val = ray_tpu.get(ref, timeout=120.0)
+            assert val == i
+        except TaskCancelledError:
+            cancelled += 1
+    assert cancelled > 0, "no queued task was actually cancelled"
+    # Scheduler must come back healthy.
+    assert ray_tpu.get(slowish.remote(-1), timeout=60.0) == -1
+
+
+def test_worker_crash_mid_pipeline_retries_unstarted(pipeline_cluster):
+    """A worker dying with frames in flight: the maybe-started frame
+    surfaces as a retryable system failure (WorkerCrashedError or a
+    successful system retry), and the unstarted frames queued behind
+    it on the same lease complete without the user ever seeing the
+    crash."""
+
+    @ray_tpu.remote(max_retries=0)
+    def die_once(i, marker_dir):
+        # First execution of i==5 kills the worker mid-pipeline; any
+        # retry (there should be none with max_retries=0) would leave
+        # a second marker.
+        if i == 5:
+            marker = os.path.join(marker_dir, f"died-{i}")
+            if not os.path.exists(marker):
+                with open(marker, "w"):
+                    pass
+                os._exit(1)
+        return i
+
+    import tempfile
+
+    marker_dir = tempfile.mkdtemp(prefix="ray_tpu_crash_test_")
+    refs = [die_once.remote(i, marker_dir) for i in range(30)]
+    crashed, completed = [], []
+    for i, ref in enumerate(refs):
+        try:
+            val = ray_tpu.get(ref, timeout=120.0)
+            assert val == i
+            completed.append(i)
+        except WorkerCrashedError:
+            crashed.append(i)
+    # Exactly the suicide task crashed; every sibling — including
+    # frames that were queued behind it on the same worker lease —
+    # completed with its own value.
+    assert crashed == [5], f"crashed={crashed} completed={completed}"
+    assert len(completed) == 29
+
+
+def test_worker_crash_retry_reruns_only_killed_task(pipeline_cluster):
+    """With max_retries, the crashed task is re-executed (system
+    failure retry) while already-completed siblings are NOT re-run."""
+    import tempfile
+
+    marker_dir = tempfile.mkdtemp(prefix="ray_tpu_retry_test_")
+
+    @ray_tpu.remote(max_retries=2)
+    def attempt(i, marker_dir):
+        marker = os.path.join(marker_dir, f"attempts-{i}")
+        with open(marker, "a") as f:
+            f.write("x")
+        if i == 7 and os.path.getsize(marker) == 1:
+            os._exit(1)
+        return i
+
+    refs = [attempt.remote(i, marker_dir) for i in range(20)]
+    out = ray_tpu.get(refs, timeout=120.0)
+    assert out == list(range(20))
+    # The suicide task ran twice (crash + retry); siblings ran once.
+    for i in range(20):
+        attempts = os.path.getsize(
+            os.path.join(marker_dir, f"attempts-{i}"))
+        if i == 7:
+            assert attempts == 2, f"task 7 ran {attempts} times"
+        else:
+            assert attempts == 1, \
+                f"sibling {i} re-ran ({attempts} attempts) after a " \
+                "crash that was not its own"

@@ -13,7 +13,8 @@ import numpy as np
 import pytest
 
 import ray_tpu
-from ray_tpu.exceptions import ActorDiedError, ActorError, TaskError
+from ray_tpu.exceptions import (ActorDiedError, ActorError, TaskError,
+                                WorkerCrashedError)
 
 
 @pytest.fixture(scope="module")
@@ -153,7 +154,10 @@ def test_pool_worker_crash_no_retries_errors(pool_runtime):
     def die():
         os._exit(1)
 
-    with pytest.raises(TaskError):
+    # Worker death surfaces as the system failure itself, unwrapped
+    # (reference: ray.exceptions.WorkerCrashedError), not a generic
+    # TaskError around it.
+    with pytest.raises(WorkerCrashedError):
         ray_tpu.get(die.remote(), timeout=30)
 
 
