@@ -184,6 +184,24 @@ def main() -> None:
         stages["worker"] = wrk
     except Exception as exc:  # noqa: BLE001 — counters are best-effort
         stages["error"] = repr(exc)
+    # Failure counters (driver + daemons summed): in a chaos-free run
+    # these should be ~0 — a refresh showing nonzero requeues or
+    # blacklists means the fast path silently leaned on recovery.
+    faults: dict = {}
+    try:
+        from ray_tpu._private.worker import global_runtime
+
+        runtime = global_runtime()
+        faults = dict(runtime.fault_stats())
+        with runtime._remote_nodes_lock:
+            handles = list(runtime._remote_nodes.values())
+        for handle in handles:
+            node_faults = handle._control.call("executor_stats").get(
+                "faults", {})
+            for key, value in node_faults.items():
+                faults[key] = faults.get(key, 0) + int(value)
+    except Exception as exc:  # noqa: BLE001 — counters are best-effort
+        faults["error"] = repr(exc)
     record("tasks", n=N_TASKS, ok=True,
            submit_wall_s=round(t_submit, 1),
            submit_per_s=round(N_TASKS / t_submit, 1),
@@ -191,7 +209,7 @@ def main() -> None:
            drain_wall_s=round(t_drain, 1),
            throughput_per_s=round(drain_n / t_drain, 1),
            cancel_remaining_wall_s=round(t_cancel, 1),
-           drain_stages=stages)
+           drain_stages=stages, faults=faults)
     del refs, out
 
     # -- phase 4: 1 GiB broadcast -----------------------------------------
@@ -228,11 +246,16 @@ def main() -> None:
         runtime = global_runtime()
         with runtime._remote_nodes_lock:
             handles = list(runtime._remote_nodes.values())
+        bcast_faults: dict = {}
         for handle in handles:
             stats = handle._control.call("executor_stats")
             plane = stats.get("data_plane", {})
             for key in counters:
                 counters[key] += int(plane.get(key, 0))
+            for key, value in stats.get("faults", {}).items():
+                bcast_faults[key] = bcast_faults.get(key, 0) \
+                    + int(value)
+        counters["faults"] = bcast_faults
     except Exception as exc:  # noqa: BLE001 — counters are best-effort
         counters["error"] = repr(exc)
     record("broadcast", n_nodes=N_BCAST_NODES,

@@ -1,0 +1,133 @@
+"""Deterministic fault injection for the distributed fast paths.
+
+TPU-native analogue of the reference's chaos tooling
+(python/ray/_private/test_utils.py NodeKillerActor and the
+RAY_testing_* failure-injection config entries): named injection
+points are threaded through the transport (rpc.py), the node agent
+(node.py) and the same-host lease plane (same_host.py). Production
+builds pay ONE branch per site — ``chaos.ACTIVE`` is a module global
+that stays ``None`` unless ``RAY_TPU_CHAOS`` is set, so every site is
+``if chaos.ACTIVE is not None and ...``.
+
+Spec grammar (``RAY_TPU_CHAOS`` or ``configure()``)::
+
+    seed=42,rpc.sever=0.1,rpc.drop_frame=0.05x3,heartbeat.skip=1.0
+
+``site=rate`` fires with probability ``rate`` per hit from ONE seeded
+RNG (same seed + same call order => same fire pattern, the property
+the deterministic tier-1 chaos tests assert); ``site=ratexN`` caps the
+site at N total fires (``1.0x1`` = "exactly the first hit").
+
+Injection sites (the site string is the contract; counters surface in
+``ChaosController.stats()``):
+
+- ``rpc.sever``       client: fail the connection before a frame send
+- ``rpc.drop_frame``  client: silently drop one request frame
+- ``rpc.delay``       client: sleep 5-50 ms before a frame send
+- ``rpc.kill_stream`` server: kill a streaming reply mid-parts
+- ``heartbeat.skip``  node agent: skip one heartbeat period
+- ``daemon.die``      node agent: SIGKILL its own daemon process
+- ``lease.expire``    same-host LeaseTable: expire a lease early
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+
+
+class ChaosController:
+    """Seeded, named injection points with per-site rates and caps."""
+
+    def __init__(self, rates: "dict[str, tuple[float, int | None]]",
+                 seed: int = 0):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._rates = dict(rates)
+        self._lock = threading.Lock()
+        self.injected: dict[str, int] = {}
+
+    def should(self, site: str) -> bool:
+        """One seeded draw for ``site``; True means the caller must
+        inject the fault (and the fire was counted)."""
+        entry = self._rates.get(site)
+        if entry is None:
+            return False
+        rate, cap = entry
+        if rate <= 0.0:
+            return False
+        with self._lock:
+            if cap is not None and self.injected.get(site, 0) >= cap:
+                return False
+            fire = self._rng.random() < rate
+            if fire:
+                self.injected[site] = self.injected.get(site, 0) + 1
+            return fire
+
+    def uniform(self) -> float:
+        """A seeded draw in [0, 1) for sites that need a magnitude
+        (delay length) on top of the fire decision."""
+        with self._lock:
+            return self._rng.random()
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"seed": self.seed, "injected": dict(self.injected)}
+
+
+def _parse(spec: str) -> "tuple[dict, int]":
+    rates: dict[str, tuple[float, int | None]] = {}
+    seed = 0
+    for item in spec.replace(";", ",").split(","):
+        item = item.strip()
+        if not item or "=" not in item:
+            continue
+        key, _, value = item.partition("=")
+        key = key.strip()
+        value = value.strip()
+        if key == "seed":
+            seed = int(value)
+            continue
+        cap: int | None = None
+        if "x" in value:
+            value, _, cap_s = value.partition("x")
+            cap = int(cap_s)
+        rates[key] = (float(value), cap)
+    return rates, seed
+
+
+# The ONE production branch: None unless chaos is configured.
+ACTIVE: ChaosController | None = None
+
+
+def configure(spec: "str | None") -> ChaosController | None:
+    """Install (or clear, with a falsy spec) the process-wide
+    controller. Tests call this directly; daemons inherit the
+    ``RAY_TPU_CHAOS`` environment through ``daemon_child_env``."""
+    global ACTIVE
+    if not spec:
+        ACTIVE = None
+        return None
+    rates, seed = _parse(spec)
+    ACTIVE = ChaosController(rates, seed)
+    return ACTIVE
+
+
+def disable() -> None:
+    configure(None)
+
+
+def should(site: str) -> bool:
+    """Convenience for non-hot paths; hot sites read ``ACTIVE``
+    directly so the disabled cost is one attribute load."""
+    controller = ACTIVE
+    return controller is not None and controller.should(site)
+
+
+# Env-driven install at import: spawned daemons enable chaos without
+# any code path having to thread the flag (config.py declares the
+# matching ``chaos`` knob for init(system_config=...) visibility).
+_env_spec = os.environ.get("RAY_TPU_CHAOS", "")
+if _env_spec:
+    configure(_env_spec)

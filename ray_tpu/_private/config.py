@@ -76,6 +76,17 @@ _DEFAULTS: dict[str, Any] = {
     "actor_restart_relocate_timeout_s": 120.0,
     # RPC plane.
     "rpc_io_pool_workers": 16,         # pooled short-call dispatch
+    # Shared retry/backoff/deadline policy for IDEMPOTENT control-plane
+    # calls (rpc.call_with_retry — heartbeats, fetch_plan, GCS reads).
+    # Non-idempotent submits never ride it: a maybe-executed failure
+    # must surface, not silently re-execute.
+    "rpc_retry_attempts": 3,
+    "rpc_retry_base_ms": 50,           # exponential backoff base
+    "rpc_retry_deadline_s": 15.0,      # overall per-call retry budget
+    # Deterministic fault injection (chaos.py); "" disables — every
+    # injection site then costs one module-attribute branch. Spec:
+    # "seed=42,rpc.sever=0.1,rpc.drop_frame=0.05x3,...".
+    "chaos": "",
     # Pipelined transport (reference: gRPC completion queues carry many
     # in-flight calls per connection, src/ray/rpc/client_call.h).
     "rpc_pipeline_depth": 8,           # in-flight chunk fetches per pull

@@ -127,6 +127,29 @@ class ObjectDirectory:
                 self._seen.pop(owner, None)
                 self._locations.pop(owner, None)
 
+    def prune_node(self, node_hex: str) -> list[str]:
+        """A node died: remove it from every holder set so pullers and
+        recovery are never handed a dead holder (reference: the object
+        directory unsubscribes a dead node's locations,
+        ownership_based_object_directory.h). Returns the object hexes
+        that lost their LAST holder — their owners must reconstruct
+        from lineage or fail waiters."""
+        orphaned: list[str] = []
+        with self._lock:
+            for owner in list(self._locations):
+                table = self._locations[owner]
+                for obj_hex in list(table):
+                    holders = table[obj_hex]
+                    if node_hex not in holders:
+                        continue
+                    holders.discard(node_hex)
+                    if not holders:
+                        del table[obj_hex]
+                        orphaned.append(obj_hex)
+                if not table:
+                    self._locations.pop(owner, None)
+        return orphaned
+
 
 class PubSub:
     """In-process pub/sub hub (reference: src/ray/pubsub/publisher.h:307)."""

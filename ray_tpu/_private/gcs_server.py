@@ -212,9 +212,7 @@ class GcsServer:
         from ray_tpu._private.gcs_pubsub import ChannelHub
 
         self.pubsub = ChannelHub()
-        self.gcs.pubsub.subscribe(
-            "nodes", lambda event: self.pubsub.publish(
-                "nodes", (event[0], event[1].hex())))
+        self.gcs.pubsub.subscribe("nodes", self._on_node_event)
         # Last availability published per node (change detection for
         # the "node_resources" syncer channel).
         self._last_published_avail: dict[str, dict] = {}
@@ -264,6 +262,21 @@ class GcsServer:
         s.register("pubsub_poll", self.pubsub.poll, concurrent=True)
 
     # -- node service -------------------------------------------------
+    def _on_node_event(self, event) -> None:
+        """Bridge membership events onto the cluster channel hub; a
+        DEAD verdict additionally prunes the dead node from every
+        object-directory holder set and publishes the objects whose
+        last holder died, so owners stop being handed dead holders and
+        can fire lineage reconstruction by push (reference:
+        GcsNodeManager node-dead broadcast + the directory dropping the
+        node's locations)."""
+        kind, node_id = event
+        if kind == "DEAD":
+            orphaned = self.object_directory.prune_node(node_id.hex())
+            if orphaned:
+                self.pubsub.publish("object_loss", orphaned)
+        self.pubsub.publish("nodes", (kind, node_id.hex()))
+
     def _register_node(self, address: str, resources: dict,
                        labels: dict | None = None,
                        executor_address: str = "",

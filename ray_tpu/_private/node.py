@@ -22,7 +22,14 @@ import sys
 import threading
 import time
 
-from ray_tpu._private.rpc import MuxRpcClient, RpcClient, RpcError  # noqa: F401 — RpcClient re-exported for callers
+from ray_tpu._private import chaos
+from ray_tpu._private.rpc import (  # noqa: F401 — RpcClient re-exported for callers
+    MuxRpcClient,
+    RpcClient,
+    RpcError,
+    RpcMethodError,
+    call_with_retry,
+)
 
 SESSION_DIR = os.environ.get("RAY_TPU_SESSION_DIR", "/tmp/ray_tpu")
 
@@ -94,9 +101,14 @@ class NodeAgent:
         # prior_id: across a head restart the daemon asks to keep its
         # node id, so drivers' mirrored node tables (and in-flight work
         # keyed by the id) converge without a spurious death+rejoin.
+        # Registration is idempotent under prior_id (the head grants
+        # the same id back on a retried request), so it rides the
+        # shared retry policy — a dropped frame must not cost the node
+        # a death verdict.
         from ray_tpu._private.same_host import host_identity
 
-        return self.client.call(
+        return call_with_retry(
+            self.client.call,
             "register_node", self._address, self.resources, self.labels,
             self.executor_address, prior_id=self.node_id or None,
             host_id=host_identity())
@@ -113,6 +125,16 @@ class NodeAgent:
             self._poke.clear()
             if self._shutdown.is_set():
                 return
+            if chaos.ACTIVE is not None:
+                # Chaos: a skipped beat ages this node toward the
+                # head's death verdict; daemon.die is the harness's
+                # in-process SIGKILL (the whole daemon vanishes the way
+                # a crashed host does).
+                if chaos.ACTIVE.should("daemon.die"):
+                    os.kill(os.getpid(), signal.SIGKILL)
+                if chaos.ACTIVE.should("heartbeat.skip"):
+                    self._shutdown.wait(self.coalesce_s)
+                    continue
             available = None
             if self.usage_fn is not None:
                 try:
@@ -120,8 +142,13 @@ class NodeAgent:
                 except Exception:  # noqa: BLE001 — usage is best-effort
                     available = None
             try:
-                accepted = self.client.call(
-                    "heartbeat", self.node_id, available)
+                # Heartbeats are idempotent: ride the shared retry
+                # policy with a short per-try timeout so one dropped
+                # frame costs a retry, not a liveness-timeout stall.
+                accepted = call_with_retry(
+                    self.client.call, "heartbeat", self.node_id,
+                    available, attempts=2,
+                    timeout_s=max(3.0, self.heartbeat_period_s * 3))
                 if not accepted:
                     # Unknown/dead at the head (stall past the timeout
                     # or a head restart): re-register, asking to keep
@@ -129,7 +156,7 @@ class NodeAgent:
                     # this id dead (reference: raylet re-registration
                     # after GCS restart keeps the NodeID).
                     self.node_id = self._register()
-            except RpcError:
+            except (RpcError, RpcMethodError, OSError):
                 pass  # head unreachable; keep trying (it may restart)
             # Coalescing floor: pokes landing during the sleep fold
             # into the next push.
@@ -140,7 +167,7 @@ class NodeAgent:
         if drain:
             try:
                 self.client.call("drain_node", self.node_id)
-            except RpcError:
+            except (RpcError, RpcMethodError, OSError):
                 pass
         self.client.close()
 

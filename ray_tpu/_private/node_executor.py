@@ -577,6 +577,11 @@ class _PipelineInflight:
         with self._lock:
             self._leases.setdefault(key, []).append(token)
             self._token_lease[token] = key
+        # Stream a "started" mark to the owning driver: the frame is in
+        # a worker's pipe, so from here on the task is MAYBE-STARTED —
+        # if this daemon dies, the driver retries it under the
+        # system-failure budget instead of requeueing it invisibly.
+        self._fire(token, "started")
 
     def done(self, key, token) -> None:
         resumed = None
@@ -824,6 +829,15 @@ class NodeExecutorService:
         self.same_host_map_hits = 0
         self.same_host_copy_hits = 0
         self.chunked_pulls = 0
+        # Fault-path counters (executor_stats()["faults"]): peers/owners
+        # blacklisted mid-pull and peer-owned mappings swept after their
+        # owner died. fail-strike ledger for the attached-mapping sweep
+        # (one transient probe miss must not drop a live owner's
+        # mappings).
+        self.peer_blacklists = 0
+        self.lease_orphans_swept = 0
+        self.arena_orphans_swept = 0
+        self._attached_owner_strikes: dict[str, int] = {}
         # Worker-bound arg blobs promoted to shared memory: keyed by the
         # object's id bytes in the node's shm directory; FIFO-bounded.
         self._shm_args_lock = threading.Lock()
@@ -1350,6 +1364,12 @@ class NodeExecutorService:
                         reply = ("err", _exc_blob(exc))
                     complete(idx, reply)
 
+                # Classic entries begin executing the moment they are
+                # submitted: mark them maybe-started for the driver's
+                # death accounting before the dispatch.
+                with cond:
+                    control.append(("started", idx))
+                    cond.notify()
                 DISPATCH_POOL.submit(classic_run)
                 continue
             blob = func_blob
@@ -1572,11 +1592,25 @@ class NodeExecutorService:
             "worker_lease_tasks": self.pool.batch_tasks,
             "worker_pipelined_frames": self.pool.batch_frames,
         }
+        # Failure counters: every recovery path the chaos tests (and
+        # the envelope rows) assert — retried idempotent RPCs, batch
+        # entries requeued after a worker/daemon death, chunk sources
+        # blacklisted mid-pull, orphaned peer mappings swept.
+        from ray_tpu._private.rpc import rpc_retry_count
+
+        faults = {
+            "rpc_retries": rpc_retry_count(),
+            "batch_requeues": self.pool.batch_requeues,
+            "peer_blacklists": self.peer_blacklists,
+            "lease_orphans_swept": self.lease_orphans_swept,
+            "arena_orphans_swept": self.arena_orphans_swept,
+            "lineage_rebuilds": 0,  # daemons hold no lineage (owners do)
+        }
         return {"tasks_executed": self.tasks_executed,
                 "running": running, "store": self.store.stats(),
                 "num_actors": num_actors, "pid": os.getpid(),
                 "relay": relay, "data_plane": data_plane,
-                "pipeline": pipeline,
+                "pipeline": pipeline, "faults": faults,
                 "threads": threading.active_count()}
 
     def adopt_sys_path(self, paths: list) -> int:
@@ -2008,9 +2042,17 @@ class NodeExecutorService:
 
         owner = self._peers.get(ref.addr)
         try:
-            plan = owner.call(
-                "fetch_plan", ref.id_bytes, self.advertised_address,
-                self.host_id if map_enabled() else None)
+            # fetch_plan is an idempotent read: ride the shared retry
+            # policy so one dropped frame doesn't fail a pull whose
+            # owner is alive (exhausted retries propagate — the caller
+            # owns the lost-node fallback).
+            from ray_tpu._private.rpc import call_with_retry
+
+            plan = call_with_retry(
+                owner.call, "fetch_plan", ref.id_bytes,
+                self.advertised_address,
+                self.host_id if map_enabled() else None,
+                attempts=2, timeout_s=30.0)
         except RpcMethodError:
             plan = None  # owner predates fetch_plan
         map_info = plan[2] if plan is not None and len(plan) > 2 \
@@ -2297,7 +2339,14 @@ class NodeExecutorService:
         derives its peers' start offsets from the same hash, so a chunk
         is requested from the peer that began pulling its region
         earliest (highest hit probability); misses re-issue to the
-        owner asynchronously — never a window stall."""
+        owner asynchronously — never a window stall.
+
+        Node-death hardening: a peer that DIES mid-chunk (transport
+        failure, not a mere chunk miss) is blacklisted for the rest of
+        the pull; when the OWNER dies, the pull re-plans against a
+        surviving full holder (any daemon answering ``fetch_plan`` for
+        the object) and continues from there — a 1->N broadcast
+        survives the producer's crash once one receiver finished."""
         import zlib
         from collections import deque
 
@@ -2307,11 +2356,13 @@ class NodeExecutorService:
         fanout = max(0, int(GLOBAL_CONFIG.broadcast_chunk_fanout))
         n_chunks = part.n_chunks()
         my_addr = self.advertised_address
+        dead: set[str] = set()
+        known_holders = [a for a in holders if a and a != my_addr]
 
         def peer_starts(addrs: list[str]) -> dict[str, int]:
             return {a: zlib.crc32(a.encode()) % n_chunks
                     for a in dict.fromkeys(addrs)
-                    if a and a != my_addr}
+                    if a and a != my_addr and a not in dead}
 
         starts = peer_starts(holders[:fanout])
         start = zlib.crc32(my_addr.encode()) % n_chunks
@@ -2332,11 +2383,67 @@ class NodeExecutorService:
                     best, bestd = src, d
             return best
 
-        def issue(idx: int, src: str, retried: bool):
+        def issue(idx: int, src: str, attempts: int):
+            nonlocal owner_addr, owner
             length = min(part.chunk, part.total - idx * part.chunk)
-            slot = self._peers.get(src).call_async(
-                "fetch_object", ref.id_bytes, idx * part.chunk, length)
-            pending.append((idx, src, slot, retried))
+            while True:
+                try:
+                    slot = self._peers.get(src).call_async(
+                        "fetch_object", ref.id_bytes,
+                        idx * part.chunk, length)
+                except (RpcError, RpcMethodError, OSError):
+                    # Connect-time death (the async path surfaces a
+                    # dead peer synchronously): same failover as a
+                    # failed in-flight chunk.
+                    blacklist(src)
+                    if src == owner_addr:
+                        survivor = replan_owner()
+                        if survivor is None:
+                            raise KeyError(
+                                f"object {ref.id_bytes.hex()}: owner "
+                                f"{owner_addr} unreachable and no "
+                                f"surviving holder has a full copy")
+                        owner_addr = survivor
+                        owner = self._peers.get(owner_addr)
+                    attempts += 1
+                    if attempts > 3:
+                        raise KeyError(
+                            f"object {ref.id_bytes.hex()} unreachable "
+                            f"on every source")
+                    src = owner_addr
+                    continue
+                pending.append((idx, src, slot, attempts))
+                return
+
+        def blacklist(src: str) -> None:
+            if src not in dead:
+                dead.add(src)
+                starts.pop(src, None)
+                self.peer_blacklists += 1
+
+        def replan_owner() -> str | None:
+            # The authoritative owner died mid-pull: any surviving
+            # holder with the FULL object (its fetch_plan reports the
+            # total) can serve as the new authority for re-issues and
+            # holder refreshes. Partial relays stay chunk sources but
+            # cannot anchor retries — a miss there must escalate
+            # somewhere that provably has the byte range.
+            for addr in dict.fromkeys(list(starts) + known_holders):
+                if addr in dead or addr == my_addr:
+                    continue
+                try:
+                    plan = self._peers.get(addr).call(
+                        "fetch_plan", ref.id_bytes, my_addr,
+                        timeout_s=5.0)
+                except (RpcError, RpcMethodError, OSError):
+                    blacklist(addr)
+                    continue
+                if plan is not None and plan[0] == part.total \
+                        and self._peers.get(addr).call(
+                            "fetch_object", ref.id_bytes, 0, 1,
+                            timeout_s=5.0) is not None:
+                    return addr
+            return None
 
         it = iter(order)
         exhausted = False
@@ -2347,22 +2454,37 @@ class NodeExecutorService:
                 except StopIteration:
                     exhausted = True
                     break
-                issue(idx, pick_source(idx), False)
+                issue(idx, pick_source(idx), 0)
             if not pending:
                 continue
-            idx, src, slot, retried = pending.popleft()
+            idx, src, slot, attempts = pending.popleft()
+            transport_dead = False
             try:
                 reply = slot.result()
             except (RpcError, RpcMethodError):
                 reply = None
+                transport_dead = True
             if reply is None:
-                if retried or src == owner_addr:
+                if transport_dead:
+                    # The SOURCE died (vs a mere chunk miss: the peer
+                    # answered "don't have it" and stays a candidate).
+                    blacklist(src)
+                    if src == owner_addr:
+                        survivor = replan_owner()
+                        if survivor is None:
+                            raise KeyError(
+                                f"object {ref.id_bytes.hex()}: owner "
+                                f"{owner_addr} died mid-pull and no "
+                                f"surviving holder has a full copy")
+                        owner_addr = survivor
+                        owner = self._peers.get(owner_addr)
+                if attempts >= 3:
                     raise KeyError(
                         f"object {ref.id_bytes.hex()} not present on "
                         f"{owner_addr}")
-                # Peer miss/death: re-issue to the authoritative owner
-                # WITHOUT blocking the window.
-                issue(idx, owner_addr, True)
+                # Re-issue to the authoritative owner (possibly just
+                # re-planned) WITHOUT blocking the window.
+                issue(idx, owner_addr, attempts + 1)
                 continue
             _, data = reply
             part.write(idx, data)
@@ -2415,6 +2537,40 @@ class NodeExecutorService:
                 probe.close()
 
         self.leases.sweep(pin_ttl_s(), _probe)
+        # Puller side: peer-owned mappings whose OWNER died are orphans
+        # — the lease backing the pin is gone with the owner, so the
+        # attachment is released (segment closed, directory entry
+        # dropped; the next consumer re-pulls and falls back to the
+        # chunked path / lineage). Two consecutive failed probes
+        # required: one transient miss must not drop a live owner's
+        # mappings out from under its workers.
+        with self._shm_args_lock:
+            owners = {addr for addr, _, _ in self._attached.values()}
+        for addr in owners:
+            alive = False
+            try:
+                alive = _probe(addr)
+            except Exception:  # noqa: BLE001 — unreachable
+                alive = False
+            if alive:
+                self._attached_owner_strikes.pop(addr, None)
+                continue
+            strikes = self._attached_owner_strikes.get(addr, 0) + 1
+            self._attached_owner_strikes[addr] = strikes
+            if strikes < 2:
+                continue
+            self._attached_owner_strikes.pop(addr, None)
+            with self._shm_args_lock:
+                victims = [k for k, (a, _, _) in self._attached.items()
+                           if a == addr]
+            for key in victims:
+                self._drop_shm_arg(key)
+                self.lease_orphans_swept += 1
+        # Crashed co-hosted owners' native arena segments have no
+        # surviving unlinker; any live daemon reaps them.
+        from ray_tpu._private.same_host import sweep_orphan_shm
+
+        self.arena_orphans_swept += sweep_orphan_shm()
 
     def _trim_relays(self) -> None:
         """Bound completed relay copies by node_relay_cache_mb (oldest
@@ -2593,14 +2749,19 @@ class RemoteNodeHandle:
 
     def execute_batch(self, entries: list, on_results,
                       on_parked=None, on_resumed=None,
-                      client_addr: str | None = None) -> int:
+                      client_addr: str | None = None,
+                      on_started=None) -> int:
         """One execute_task_batch RPC for a run of tasks leased to this
         node. ``on_results(group)`` fires per streamed completion group
         with [(idx, reply), ...] (execute_task reply shape per task);
         parked/resumed control parts report frames stuck behind a
-        blocked lease head. Returns the number of replies delivered —
-        the caller fails any missing indexes (stream cut mid-batch).
-        Raises RpcError/RpcMethodError like ``execute``."""
+        blocked lease head; ``on_started(idx)`` marks an entry
+        MAYBE-STARTED (its frame reached a worker) — the caller's
+        node-death accounting splits unstarted entries (requeued
+        invisibly) from started ones (retried under the system-failure
+        budget). Returns the number of replies delivered — the caller
+        fails any missing indexes (stream cut mid-batch). Raises
+        RpcError/RpcMethodError like ``execute``."""
         self.ensure_sys_path()
         slot = self.pool.call_streaming(
             "execute_task_batch", entries, client_addr)
@@ -2613,6 +2774,8 @@ class RemoteNodeHandle:
             if kind == "results":
                 delivered += len(payload)
                 on_results(payload)
+            elif kind == "started" and on_started is not None:
+                on_started(payload)
             elif kind == "parked" and on_parked is not None:
                 on_parked(payload)
             elif kind == "resumed" and on_resumed is not None:

@@ -26,15 +26,29 @@ import select
 import socket
 import struct
 import threading
+import time
 import traceback
 from typing import Any, Callable
+
+from ray_tpu._private import chaos
 
 _LEN = struct.Struct(">Q")
 MAX_FRAME = 1 << 31  # 2GB sanity bound
 
 
 class RpcError(ConnectionError):
-    """Transport-level failure (peer unreachable / connection lost)."""
+    """Transport-level failure (peer unreachable / connection lost).
+
+    ``maybe_executed`` classifies the failure for retry policy: False
+    means the request provably never reached the server (connect
+    refused, client closed, stale-socket detection) — ALWAYS safe to
+    retry; True means the frame was (or may have been) handed to the
+    kernel before the failure — only IDEMPOTENT callers may retry, a
+    non-idempotent submit riding a blind retry would double-execute."""
+
+    def __init__(self, *args, maybe_executed: bool = False):
+        super().__init__(*args)
+        self.maybe_executed = maybe_executed
 
 
 class TailPayload:
@@ -74,6 +88,79 @@ class RpcMethodError(Exception):
         # crossing ANOTHER pickle boundary (e.g. stored as a task error
         # and shipped to a different process) must round-trip.
         return (RpcMethodError, (self.cause, self.remote_tb))
+
+
+def classify_rpc_failure(exc: BaseException) -> str:
+    """Retry classification for a failed RPC:
+
+    - ``"retryable"``: the request never reached the server — safe for
+      ANY caller to retry.
+    - ``"maybe_executed"``: the request was (or may have been) sent;
+      only idempotent callers retry, non-idempotent submits must
+      surface the failure (double-execution risk).
+    - ``"poisoned"``: the remote method itself raised — retrying
+      re-raises; the failure is the answer.
+    """
+    if isinstance(exc, RpcMethodError):
+        return "poisoned"
+    if isinstance(exc, RpcError):
+        return "maybe_executed" if exc.maybe_executed else "retryable"
+    # Bare socket errors surface from connect paths only (everything
+    # post-send is wrapped into RpcError by the clients).
+    return "retryable" if isinstance(exc, OSError) else "poisoned"
+
+
+# Process-wide transport fault counters, surfaced through
+# executor_stats()["faults"] / Runtime.fault_stats().
+_FAULTS_LOCK = threading.Lock()
+_RPC_RETRIES = 0
+
+
+def _record_retry() -> None:
+    global _RPC_RETRIES
+    with _FAULTS_LOCK:
+        _RPC_RETRIES += 1
+
+
+def rpc_retry_count() -> int:
+    with _FAULTS_LOCK:
+        return _RPC_RETRIES
+
+
+def call_with_retry(call: Callable, method: str, *args,
+                    attempts: int | None = None,
+                    base_delay_s: float | None = None,
+                    deadline_s: float | None = None,
+                    **kwargs) -> Any:
+    """Shared retry/backoff/deadline policy for IDEMPOTENT
+    control-plane calls (heartbeats, fetch_plan, GCS reads).
+
+    MuxRpcClient documents "the caller owns the retry policy"; this is
+    the one policy idempotent callers share, so each site stops owning
+    nothing. Maybe-executed failures ARE retried here — by contract
+    the wrapped method must be idempotent; never route task submits or
+    actor creations through this (classify_rpc_failure + surfacing is
+    their path)."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    if attempts is None:
+        attempts = max(1, int(GLOBAL_CONFIG.rpc_retry_attempts))
+    if base_delay_s is None:
+        base_delay_s = float(GLOBAL_CONFIG.rpc_retry_base_ms) / 1000.0
+    if deadline_s is None:
+        deadline_s = float(GLOBAL_CONFIG.rpc_retry_deadline_s)
+    deadline = time.monotonic() + deadline_s
+    for attempt in range(attempts):
+        try:
+            return call(method, *args, **kwargs)
+        # RpcMethodError ("poisoned" — the remote raised) propagates:
+        # it is not an OSError, so only transport failures retry.
+        except (RpcError, OSError):
+            if attempt + 1 >= attempts or time.monotonic() >= deadline:
+                raise
+            _record_retry()
+            time.sleep(min(base_delay_s * (2 ** attempt), 2.0))
+    raise RpcError(f"rpc {method} retry loop exhausted")  # unreachable
 
 
 def _send_frame(sock: socket.socket, payload: bytes) -> None:
@@ -285,7 +372,10 @@ class RpcServer:
             while not self._shutdown.is_set():
                 try:
                     frame = _recv_frame(conn)
-                except RpcError:
+                # OSError: the socket was closed under the loop
+                # (stop(), a dispatch thread failing the conn) — same
+                # terminal state as a peer-closed RpcError.
+                except (RpcError, OSError):
                     return
                 seq, method, args, kwargs = pickle.loads(frame)
                 if method == "__batch__":
@@ -391,6 +481,26 @@ class RpcServer:
             try:
                 if method in self._streaming:
                     def _emit_part(payload) -> None:
+                        # Chaos: kill the stream mid-parts — the
+                        # producer aborts and the client sees the
+                        # connection drop with parts outstanding (the
+                        # TailPayload-death shape node death produces).
+                        if chaos.ACTIVE is not None and \
+                                chaos.ACTIVE.should("rpc.kill_stream"):
+                            # shutdown before close: the conn-handler
+                            # thread blocked in recv holds the socket
+                            # open, so close() alone would never send
+                            # the FIN the peer must observe.
+                            try:
+                                conn.shutdown(socket.SHUT_RDWR)
+                            except OSError:
+                                pass
+                            try:
+                                conn.close()
+                            except OSError:
+                                pass
+                            raise RpcError("chaos: stream killed "
+                                           "mid-parts")
                         # A dead connection must abort the producer, not
                         # let it stream into the void until completion.
                         if not self._reply(conn, send_lock,
@@ -509,11 +619,18 @@ class _MuxSlot:
                                else client._timeout):
             client._abandon(self)
             raise RpcError(
-                f"rpc {self.method} to {client.address} timed out")
+                f"rpc {self.method} to {client.address} timed out",
+                maybe_executed=True)
         if self.error is not None:
+            # The request frame was written before the connection died
+            # (pre-send failures raise synchronously in call_async), so
+            # the method may have executed server-side.
+            maybe = not isinstance(self.error, RpcError) \
+                or self.error.maybe_executed
             raise RpcError(
                 f"rpc {self.method} to {client.address} failed "
-                f"(may have executed): {self.error}") from self.error
+                f"(may have executed): {self.error}",
+                maybe_executed=maybe) from self.error
         status, payload = self.reply
         if status == "err":
             exc, tb = payload
@@ -636,13 +753,28 @@ class MuxRpcClient:
                 raise RpcError(f"client to {self.address} is closed")
             slot.conn = conn
             conn.pending[slot.seq] = slot
+        if chaos.ACTIVE is not None:
+            # Chaos sites on the request path: sever the connection
+            # (every in-flight call fails like a node death), drop just
+            # this frame (the call times out — a lost packet the
+            # transport never detects), or delay the send.
+            if chaos.ACTIVE.should("rpc.sever"):
+                self._fail_conn(conn, RpcError("chaos: severed"))
+                raise RpcError(
+                    f"rpc {method} to {self.address} failed: "
+                    f"chaos severed the connection")
+            if chaos.ACTIVE.should("rpc.drop_frame"):
+                return slot  # never sent; resolves by timeout/sever
+            if chaos.ACTIVE.should("rpc.delay"):
+                time.sleep(0.005 + 0.045 * chaos.ACTIVE.uniform())
         try:
             with self._send_lock:
                 _send_frame(conn.sock, request)
         except OSError as exc:
             self._fail_conn(conn, exc)
             raise RpcError(
-                f"rpc {method} to {self.address} failed: {exc}") from exc
+                f"rpc {method} to {self.address} failed: {exc}",
+                maybe_executed=True) from exc
         return slot
 
     def _abandon(self, slot: _MuxSlot) -> None:
@@ -746,7 +878,9 @@ class MuxRpcClient:
                     conn = self._ensure_conn()
                 except OSError as exc:
                     conn = None
-                    error: BaseException = exc
+                    # Never sent: provably retryable.
+                    error: BaseException = RpcError(
+                        f"cannot connect to {self.address}: {exc}")
             if conn is None:
                 if self._closed:
                     error = RpcError("client closed")
@@ -805,7 +939,9 @@ class MuxRpcClient:
 
     def _fail_conn(self, conn: _MuxConn, exc: BaseException) -> None:
         """Fail exactly the calls riding THIS connection; calls on a
-        reconnected successor are untouched."""
+        reconnected successor are untouched. Slots bound to a live
+        connection had their request frames written, so their failure
+        is classified maybe-executed (only idempotent callers retry)."""
         with self._lock:
             if self._conn is conn:
                 self._conn = None  # next call reconnects fresh
@@ -815,6 +951,9 @@ class MuxRpcClient:
             conn.sock.close()
         except OSError:
             pass
+        if not (isinstance(exc, RpcError) and exc.maybe_executed):
+            exc = RpcError(f"connection lost with the call in flight: "
+                           f"{exc}", maybe_executed=True)
         for slot in pending:
             slot.error = exc
             slot.event.set()
@@ -935,7 +1074,8 @@ class RpcClient:
                     if sent:
                         raise RpcError(
                             f"rpc {method} to {self.address} failed after "
-                            f"send (may have executed): {exc}") from exc
+                            f"send (may have executed): {exc}",
+                            maybe_executed=True) from exc
             else:
                 raise RpcError(
                     f"rpc to {self.address} failed: {last_exc}") \

@@ -149,15 +149,28 @@ class LeaseTable:
         unreachable (``probe`` returns False). With no probe, age alone
         expires — callers that cannot ping (unit tests) get plain TTL
         semantics."""
+        from ray_tpu._private import chaos
+
         now = time.monotonic()
+        forced: set[str] = set()
         with self._lock:
             stale = [(t, l) for t, l in self._leases.items()
                      if now - l[2] > ttl_s]
+            if chaos.ACTIVE is not None:
+                # Chaos: expire a lease early, bypassing the liveness
+                # probe — pullers must survive their mapping's pin
+                # vanishing under them (the owner-crash shape without
+                # the crash).
+                for t, l in self._leases.items():
+                    if (t, l) not in stale \
+                            and chaos.ACTIVE.should("lease.expire"):
+                        stale.append((t, l))
+                        forced.add(t)
         expired = []
         alive_holders: dict[str, bool] = {}
         for token, lease in stale:
             holder = lease[1]
-            if probe is not None:
+            if probe is not None and token not in forced:
                 if holder not in alive_holders:
                     try:
                         alive_holders[holder] = bool(probe(holder))
@@ -201,6 +214,51 @@ class LeaseTable:
         with self._lock:
             return {"active": len(self._leases), "granted": self.granted,
                     "released": self.released, "expired": self.expired}
+
+
+def sweep_orphan_shm() -> int:
+    """Unlink native arena segments (``/dev/shm/ray_tpu_arena_<pid>``)
+    whose owning process died without cleaning up.
+
+    The native arena is created by shm_open (plasma_store.cpp), so a
+    SIGKILLed daemon's segment has NO surviving unlinker — unlike
+    Python ``SharedMemory`` segments, which the multiprocessing
+    resource tracker reclaims. Any co-hosted survivor (daemon sweep
+    loops, the driver's pin sweeper) reaps them: the name carries the
+    owner pid, so liveness is one 0-signal probe, and only same-uid
+    segments are touched. Existing mappings of an unlinked segment
+    stay valid (POSIX); only new attaches — already doomed, the owner
+    is dead — fail."""
+    import re
+
+    try:
+        names = os.listdir("/dev/shm")
+    except OSError:
+        return 0
+    swept = 0
+    for name in names:
+        match = re.fullmatch(r"ray_tpu_arena_(\d+)", name)
+        if not match:
+            continue
+        pid = int(match.group(1))
+        if pid == os.getpid():
+            continue
+        try:
+            os.kill(pid, 0)
+            continue  # owner alive
+        except ProcessLookupError:
+            pass  # owner dead: orphan
+        except PermissionError:
+            continue  # alive under another user
+        path = os.path.join("/dev/shm", name)
+        try:
+            if os.stat(path).st_uid != os.getuid():
+                continue
+            os.unlink(path)
+            swept += 1
+        except OSError:
+            continue  # raced another sweeper / permissions
+    return swept
 
 
 def attach_segment(name: str):

@@ -730,6 +730,42 @@ class Dispatcher:
                     pass
             return task.spec
 
+    def fail_hard_affinity(self, node_id_hex: str) -> "list[TaskSpec]":
+        """Pop every queued task HARD-pinned to a node that just died.
+
+        A hard NODE_AFFINITY task can never reschedule off its node
+        (recovery.py applies the same rule to lineage resubmission);
+        leaving it queued hangs its waiters forever. Returns the
+        cancelled specs — the caller seals their returns with the
+        node-death error."""
+        def pinned(task: _QueuedTask) -> bool:
+            strategy = task.spec.scheduling_strategy
+            return (strategy is not None
+                    and getattr(strategy, "kind", None) == "NODE_AFFINITY"
+                    and not getattr(strategy, "soft", True)
+                    and getattr(strategy, "node_id", None) == node_id_hex
+                    and not task.claimed and not task.cancelled)
+
+        failed: list = []
+        with self._lock:
+            victims = [t for t in self._waiting if pinned(t)]
+            victims += [t for t in self._ready_odd if pinned(t)]
+            for dq in self._ready_groups.values():
+                victims += [t for t in dq if pinned(t)]
+            for task in victims:
+                task.cancelled = True
+                for rid in task.spec.return_ids:
+                    self._by_return_id.pop(rid, None)
+                if not task.unresolved_deps:
+                    self._num_ready_live -= 1
+                else:
+                    try:
+                        self._waiting.remove(task)
+                    except ValueError:
+                        pass
+                failed.append(task.spec)
+        return failed
+
     def shutdown(self) -> None:
         with self._lock:
             self._shutdown = True

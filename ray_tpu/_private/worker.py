@@ -361,6 +361,10 @@ class Runtime:
         self._export_lock = threading.Lock()
         self._lease_sweep_at = 0.0
         self.same_host_copy_hits = 0  # driver-side mapped-copy fetches
+        # Driver-side failure counters (fault_stats): batch entries
+        # requeued invisibly after a daemon death.
+        self._fault_lock = threading.Lock()
+        self._fault_batch_requeues = 0
         self._pkg_hashes: dict[str, str] = {}
         # Refcount-zero eviction must also drop directory + lineage
         # entries, or they leak for the runtime's lifetime.
@@ -588,12 +592,17 @@ class Runtime:
         each wake also flushes queued object frees and location
         deltas."""
         from ray_tpu._private.gcs_pubsub import GcsSubscriber
-        from ray_tpu._private.rpc import RpcError
+        from ray_tpu._private.rpc import (
+            RpcError,
+            RpcMethodError,
+            call_with_retry,
+        )
 
         subscriber = None
         try:
-            subscriber = GcsSubscriber(self.gcs_client.address,
-                                       ["nodes", "node_resources"])
+            subscriber = GcsSubscriber(
+                self.gcs_client.address,
+                ["nodes", "node_resources", "object_loss"])
         except Exception:  # noqa: BLE001 — pre-pubsub head: poll only
             subscriber = None
         last_sync = 0.0
@@ -633,6 +642,15 @@ class Runtime:
                                 NodeID(bytes.fromhex(hex_id)), available)
                         except Exception:  # noqa: BLE001 — malformed push
                             pass
+                    elif channel == "object_loss":
+                        # Head pruned the LAST holder of these objects
+                        # (node death): rebuild from lineage now
+                        # instead of waiting for a get() to trip over
+                        # the dead holder.
+                        try:
+                            self._handle_object_loss(message)
+                        except Exception:  # noqa: BLE001 — best-effort
+                            logger.exception("object-loss push failed")
                     else:
                         membership_events.append((channel, message))
                 try:
@@ -646,10 +664,15 @@ class Runtime:
                     now = time.monotonic()
                     if (membership_events or subscriber is None
                             or now - last_sync >= 10.0):
-                        self._sync_remote_nodes(
-                            self.gcs_client.call("list_nodes"))
+                        # Idempotent GCS read on the shared retry
+                        # policy: one dropped frame must not stall the
+                        # node view a full poll interval.
+                        self._sync_remote_nodes(call_with_retry(
+                            self.gcs_client.call, "list_nodes",
+                            attempts=2, timeout_s=10.0))
                         last_sync = now
-                except (RpcError, OSError, AttributeError):
+                except (RpcError, RpcMethodError, OSError,
+                        AttributeError):
                     continue
                 except Exception:  # noqa: BLE001 — watcher must survive
                     logger.exception("remote node sync failed")
@@ -903,6 +926,17 @@ class Runtime:
         logger.warning("Node %s died; reconstructing its objects",
                        node_id.hex()[:8])
         self.remove_node(node_id)
+        # Queued tasks HARD-pinned to the dead node can never run; fail
+        # them now instead of hanging their waiters forever (soft
+        # affinity and unpinned tasks reschedule on survivors).
+        for spec in self.dispatcher.fail_hard_affinity(node_id.hex()):
+            err = TaskError(
+                RuntimeError(
+                    f"node {node_id.hex()[:8]} died and task "
+                    f"{spec.name} is hard-pinned to it"),
+                None, spec.name)
+            for rid in spec.return_ids:
+                self.store.put_error(rid, err)
         # Actors hosted on the dead node restart on a survivor (or die
         # permanently) — even parked ones with no call in flight
         # (reference: GcsActorManager restarts actors on node death).
@@ -930,6 +964,39 @@ class Runtime:
                         f"{node_id.hex()[:8]} and has no lineage"))
             except Exception:  # noqa: BLE001 — one object must not strand
                 logger.exception("failed to handle loss of object %s",
+                                 oid.hex())
+
+    def _handle_object_loss(self, obj_hexes) -> None:
+        """Push-path twin of _on_node_dead's object handling: the head
+        pruned the LAST holder of these objects from its directory (the
+        holding node died). Only objects this driver still tracks as
+        remote placeholders react — a locally materialized copy
+        survives its producer's node, and foreign owners' ids simply
+        don't resolve here."""
+        from ray_tpu._private.node_executor import RemoteBlob
+        from ray_tpu.exceptions import ObjectLostError
+
+        for obj_hex in obj_hexes:
+            try:
+                oid = ObjectID(bytes.fromhex(obj_hex))
+            except (ValueError, TypeError):
+                continue
+            with self.store._lock:
+                entry = self.store._entries.get(oid)
+                remote = (entry is not None and entry.sealed
+                          and isinstance(entry.value, RemoteBlob))
+            if not remote or not self.store.mark_lost(oid):
+                continue
+            with self._locations_lock:
+                self._object_locations.pop(oid, None)
+            try:
+                if not self.recovery.recover(oid):
+                    self.store.put_error(oid, ObjectLostError(
+                        ObjectRef(oid, _register=False),
+                        f"object {oid.hex()} lost its last holder "
+                        f"and has no lineage"))
+            except Exception:  # noqa: BLE001 — one object must not strand
+                logger.exception("failed to rebuild lost object %s",
                                  oid.hex())
 
     # ----------------------------------------------------------------- tasks
@@ -996,6 +1063,17 @@ class Runtime:
                 try:
                     self._export_leases.sweep(pin_ttl_s(),
                                               self._probe_peer)
+                except Exception:  # noqa: BLE001 — sweep is best-effort
+                    pass
+                # Crashed co-hosted daemons' native arena segments
+                # have no surviving unlinker; the driver reaps them
+                # too (same_host.sweep_orphan_shm).
+                try:
+                    from ray_tpu._private.same_host import (
+                        sweep_orphan_shm,
+                    )
+
+                    sweep_orphan_shm()
                 except Exception:  # noqa: BLE001 — sweep is best-effort
                     pass
 
@@ -1549,21 +1627,46 @@ class Runtime:
             if ctx is not None:
                 ctx.unblock(force=True)
 
+        # Entries the daemon marked maybe-started (their frame reached
+        # a worker before the stream cut): on node death these retry
+        # under the system-failure budget; everything else provably
+        # never ran and requeues invisibly.
+        started_idx: set[int] = set()
+
         transport_exc: BaseException | None = None
         if entries:
             try:
                 handle.execute_batch(entries, on_results, on_parked,
-                                     on_resumed, client_addr)
+                                     on_resumed, client_addr,
+                                     on_started=started_idx.add)
             except (RpcError, RpcMethodError, OSError) as exc:
                 transport_exc = exc
         if spec_by_idx:
-            # Stream cut (or daemon replied short): the leftovers are
-            # in the same in-flight-loss state as a failed single RPC.
+            # Stream cut (or daemon replied short): maybe-started
+            # leftovers are in the same in-flight-loss state as a
+            # failed single RPC; unstarted ones requeue invisibly (no
+            # retry budget consumed — mirroring the daemon-internal
+            # per-worker crash semantics one level up). A bounded
+            # invisible-requeue count per spec stops a flapping daemon
+            # from cycling a task forever without consuming budget.
             if transport_exc is not None and not handle.ping():
                 self._drop_remote_node(node.node_id)
             for idx in list(spec_by_idx):
                 spec = spec_by_idx.get(idx)
                 if spec is None:
+                    continue
+                invisible = getattr(spec, "_invisible_requeues", 0)
+                if idx not in started_idx and invisible < 3:
+                    spec._invisible_requeues = invisible + 1
+                    with self._fault_lock:
+                        self._fault_batch_requeues += 1
+                    finish_idx(idx)  # releases claim + block context
+                    deps = [a for a in spec.args
+                            if isinstance(a, ObjectRef)] + [
+                        v for v in spec.kwargs.values()
+                        if isinstance(v, ObjectRef)]
+                    self.dispatcher.submit(spec, self._execute_task,
+                                           deps)
                     continue
                 err = WorkerCrashedError(
                     f"node {node.node_id.hex()[:8]} lost task "
@@ -2188,6 +2291,23 @@ class Runtime:
                 "batch_seals": self.store.batch_seals,
                 "batch_sealed_objects": self.store.batch_sealed_objects,
             },
+        }
+
+    def fault_stats(self) -> dict:
+        """Driver-side failure counters, same shape as the daemon's
+        executor_stats()["faults"]: how often each recovery path fired
+        in this process. The deterministic chaos tests assert these;
+        the envelope records them per row."""
+        from ray_tpu._private.rpc import rpc_retry_count
+
+        with self._fault_lock:
+            batch_requeues = self._fault_batch_requeues
+        return {
+            "rpc_retries": rpc_retry_count(),
+            "batch_requeues": batch_requeues,
+            "peer_blacklists": 0,  # drivers pull whole blobs, not chunks
+            "lease_orphans_swept": self._export_leases.expired,
+            "lineage_rebuilds": self.recovery.num_recoveries,
         }
 
     def _release_actor_lease(self, actor_id: ActorID) -> None:

@@ -878,6 +878,7 @@ class WorkerPool:
         self.batch_runs = 0     # multi-task lease runs
         self.batch_tasks = 0    # tasks entering run_task_batch
         self.batch_frames = 0   # pipelined frames actually sent
+        self.batch_requeues = 0  # unstarted frames requeued (crashes)
         # Spawn in parallel: each worker blocks on interpreter boot +
         # socket handshake, so serial startup would be O(N).
         # size=0 is a legal lazy pool — no prestart, growth on demand
@@ -1087,6 +1088,8 @@ class WorkerPool:
                         # unstarted alongside the queued in-flight ones.
                         with state.lock:
                             state.queue.appendleft(task)
+                        with self._batch_lock:
+                            self.batch_requeues += 1
                         crashed = exc
                         break
                     worker.known_digests.add(task.digest)
@@ -1132,6 +1135,9 @@ class WorkerPool:
             started = inflight.popleft() if inflight else None
             if started is not None:
                 self._complete_one(state, started[1], "crash", crashed)
+            if inflight:
+                with self._batch_lock:
+                    self.batch_requeues += len(inflight)
             with state.lock:
                 state.queue.extendleft(t for _, t in reversed(inflight))
                 remaining = bool(state.queue)

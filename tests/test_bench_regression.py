@@ -20,9 +20,21 @@ import pytest
 
 REPO_ROOT = Path(__file__).resolve().parent.parent
 BENCH_CORE = REPO_ROOT / "BENCH_CORE.json"
+BENCH_ENVELOPE = REPO_ROOT / "BENCH_ENVELOPE.json"
 
 # A committed refresh may regress a metric by at most this fraction.
 REGRESSION_TOLERANCE = 0.25
+# The envelope phases are noisier than the micro benches (multi-daemon
+# wall clocks on a shared box); a refresh gets more headroom before the
+# guard calls it a regression.
+ENVELOPE_TOLERANCE = 0.40
+
+# Envelope throughput metrics guarded per phase — all higher-is-better.
+ENVELOPE_GUARDED = {
+    "actors": ["actors_per_s"],
+    "tasks": ["throughput_per_s", "submit_per_s"],
+    "broadcast": ["aggregate_gb_per_s"],
+}
 
 
 def _parse_metrics(text: str) -> dict:
@@ -36,16 +48,31 @@ def _parse_metrics(text: str) -> dict:
     return out
 
 
-def _committed_bench_core() -> str | None:
+def _committed(name: str) -> str | None:
     try:
         proc = subprocess.run(
-            ["git", "show", "HEAD:BENCH_CORE.json"],
+            ["git", "show", f"HEAD:{name}"],
             cwd=REPO_ROOT, capture_output=True, text=True, timeout=30)
     except (OSError, subprocess.TimeoutExpired):
         return None
     if proc.returncode != 0:
         return None
     return proc.stdout
+
+
+def _committed_bench_core() -> str | None:
+    return _committed("BENCH_CORE.json")
+
+
+def _envelope_metrics(text: str) -> dict:
+    """{phase.metric: value} for the guarded envelope throughputs."""
+    doc = json.loads(text)
+    out = {}
+    for row in doc.get("phases", []):
+        for metric in ENVELOPE_GUARDED.get(row.get("phase"), ()):
+            if metric in row:
+                out[f"{row['phase']}.{metric}"] = float(row[metric])
+    return out
 
 
 def test_bench_core_no_silent_regression():
@@ -75,6 +102,54 @@ def test_bench_core_no_silent_regression():
     assert not regressions, (
         "BENCH_CORE.json refresh regresses committed metrics:\n  "
         + "\n  ".join(regressions))
+
+
+def test_bench_envelope_no_silent_regression():
+    """Same guard for BENCH_ENVELOPE.json: the envelope throughputs
+    (tasks drained/s, broadcast GB/s, actors/s) cannot silently ride a
+    refresh down — hardening PRs especially must not give back the
+    fast paths."""
+    if not BENCH_ENVELOPE.exists():
+        pytest.skip("BENCH_ENVELOPE.json not present in the working "
+                    "tree")
+    baseline_text = _committed("BENCH_ENVELOPE.json")
+    if baseline_text is None:
+        pytest.skip("no committed BENCH_ENVELOPE.json baseline")
+    baseline = _envelope_metrics(baseline_text)
+    current = _envelope_metrics(BENCH_ENVELOPE.read_text())
+
+    regressions = []
+    for name, base in baseline.items():
+        if name not in current:
+            regressions.append(f"{name}: dropped from the refresh "
+                               f"(baseline {base:g})")
+            continue
+        if base <= 0:
+            continue
+        cur = current[name]
+        drop = (base - cur) / base
+        if drop > ENVELOPE_TOLERANCE:
+            regressions.append(
+                f"{name}: {base:g} -> {cur:g} "
+                f"(-{drop * 100:.1f}% > {ENVELOPE_TOLERANCE:.0%})")
+    assert not regressions, (
+        "BENCH_ENVELOPE.json refresh regresses committed metrics:\n  "
+        + "\n  ".join(regressions))
+
+
+def test_bench_envelope_parses_with_guarded_phases():
+    """The committed envelope must stay well-formed: a phases list
+    carrying every guarded phase with its throughput metric."""
+    if not BENCH_ENVELOPE.exists():
+        pytest.skip("BENCH_ENVELOPE.json not present in the working "
+                    "tree")
+    doc = json.loads(BENCH_ENVELOPE.read_text())
+    assert isinstance(doc.get("phases"), list) and doc["phases"]
+    metrics = _envelope_metrics(BENCH_ENVELOPE.read_text())
+    for phase, names in ENVELOPE_GUARDED.items():
+        for metric in names:
+            assert f"{phase}.{metric}" in metrics, (
+                f"envelope phase {phase!r} lost metric {metric!r}")
 
 
 def test_bench_core_parses_and_is_nonempty():

@@ -85,6 +85,90 @@ def _alive_nodes(addr: str) -> list[dict]:
         client.close()
 
 
+def test_head_kill_with_inflight_batch_and_broadcast_drains(tmp_path):
+    """Head killed while worker daemons hold in-flight BATCHED tasks
+    and an in-progress driver-export broadcast: the execute/data
+    planes are head-free (driver<->daemon RPC + export pulls), so the
+    cluster must drain after the restart+re-register with no task lost
+    or doubled."""
+    import numpy as np
+
+    import ray_tpu
+
+    session = str(tmp_path / "session")
+    os.makedirs(session)
+    head_proc, addr = _spawn_head(session)
+    port = int(addr.rsplit(":", 1)[1])
+    workers = [_spawn_worker_daemon(addr) for _ in range(2)]
+    runtime = None
+    try:
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline and len(_alive_nodes(addr)) < 3:
+            time.sleep(0.3)
+        assert len(_alive_nodes(addr)) >= 3
+
+        runtime = ray_tpu.init(address=addr, num_cpus=0)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and \
+                ray_tpu.cluster_resources().get("worker", 0) < 8:
+            time.sleep(0.2)
+
+        @ray_tpu.remote(num_cpus=1, resources={"worker": 1},
+                        max_retries=3)
+        def slow_batch(i):
+            import time as _t
+
+            _t.sleep(5.0)
+            return i
+
+        # Large enough that daemons pull it from the driver's export
+        # server (never through the head).
+        blob = np.arange(1_000_000, dtype=np.float64)  # ~8 MB
+        blob_ref = ray_tpu.put(blob)
+
+        @ray_tpu.remote(num_cpus=1, resources={"worker": 1},
+                        max_retries=3)
+        def touch(arr, i):
+            return (i, float(arr[0]), len(arr))
+
+        refs = [slow_batch.remote(i) for i in range(12)]
+        bcast = [touch.remote(blob_ref, i) for i in range(6)]
+        time.sleep(1.5)  # batches dispatched; pulls in progress
+
+        # ---- kill the head mid-flight, restart on the same port ----
+        head_proc.send_signal(signal.SIGKILL)
+        head_proc.wait(timeout=10)
+        head_proc, addr2 = _spawn_head(session, port=port)
+        assert addr2.rsplit(":", 1)[1] == str(port)
+
+        # Every batched task drains exactly once; the broadcast
+        # completes against the driver's export plane.
+        results = ray_tpu.get(refs, timeout=180.0)
+        assert sorted(results) == list(range(12)), results
+        bres = ray_tpu.get(bcast, timeout=180.0)
+        assert sorted(bres) == [(i, 0.0, 1_000_000) for i in range(6)]
+
+        # Worker daemons re-registered under the restarted head.
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline and len(_alive_nodes(addr)) < 3:
+            time.sleep(0.5)
+        assert len(_alive_nodes(addr)) >= 3, (
+            "worker daemons did not re-register after head restart")
+
+        # The cluster still executes NEW work after the restart.
+        assert ray_tpu.get(slow_batch.remote(99), timeout=120.0) == 99
+    finally:
+        if runtime is not None:
+            ray_tpu.shutdown()
+        for proc in [head_proc, *workers]:
+            proc.terminate()
+        for proc in [head_proc, *workers]:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
 def test_head_kill_restart_cluster_resumes(tmp_path):
     session = str(tmp_path / "session")
     os.makedirs(session)
