@@ -202,6 +202,8 @@ def main() -> None:
                 faults[key] = faults.get(key, 0) + int(value)
     except Exception as exc:  # noqa: BLE001 — counters are best-effort
         faults["error"] = repr(exc)
+    from ray_tpu.util import tracing as _tracing
+
     record("tasks", n=N_TASKS, ok=True,
            submit_wall_s=round(t_submit, 1),
            submit_per_s=round(N_TASKS / t_submit, 1),
@@ -209,7 +211,12 @@ def main() -> None:
            drain_wall_s=round(t_drain, 1),
            throughput_per_s=round(drain_n / t_drain, 1),
            cancel_remaining_wall_s=round(t_cancel, 1),
-           drain_stages=stages, faults=faults)
+           drain_stages=stages, faults=faults,
+           # The guarded drained-tasks baseline is a TRACING-DISABLED
+           # number: test_bench_regression refuses a refresh recorded
+           # with tracing armed (its per-site branches and stage
+           # stamps are not the envelope being guarded).
+           tracing_enabled=_tracing.is_enabled())
     del refs, out
 
     # -- phase 4: 1 GiB broadcast -----------------------------------------

@@ -63,7 +63,24 @@ class ChaosController:
             fire = self._rng.random() < rate
             if fire:
                 self.injected[site] = self.injected.get(site, 0) + 1
-            return fire
+        if fire:
+            # Chaos firings become instant pins in merged timelines —
+            # a soak trace shows WHERE each injected fault landed
+            # relative to the pipeline stages around it. Lazy import +
+            # one branch: tracing-off and chaos-off both pay nothing.
+            from ray_tpu.util import tracing
+
+            if tracing.TRACE_ON:
+                tag = os.environ.get("RAY_TPU_NODE_TAG")
+                if tag:
+                    # Daemon process: queue for heartbeat piggyback so
+                    # the pin lands in the DRIVER's merged timeline.
+                    tracing.buffer_instant(f"chaos:{site}",
+                                           f"node:{tag[:8]}",
+                                           {"seed": self.seed})
+                else:
+                    tracing.instant(f"chaos:{site}", {"seed": self.seed})
+        return fire
 
     def uniform(self) -> float:
         """A seeded draw in [0, 1) for sites that need a magnitude

@@ -121,6 +121,17 @@ _DEFAULTS: dict[str, Any] = {
     "gcs_heartbeat_timeout_s": 10.0,   # node declared dead after this
     # Worker pipe transport.
     "worker_inline_result_kb": 64,     # pool results <= this inline
+    # Distributed tracing plane (util/tracing.py). Disabled, every
+    # instrumentation site costs one module-attribute branch
+    # (tracing.TRACE_ON — same discipline as chaos.ACTIVE).
+    "tracing_enabled": False,
+    # Per-process span buffer cap (local records AND the remote-shipping
+    # outbox); overflow increments the dropped-span counter.
+    "tracing_buffer_max_spans": 4096,
+    # Per-stage TaskEvent timestamps (submit/dispatch/rpc/admit/worker/
+    # exec/seal) — stamped only while tracing is enabled; this gates
+    # them off independently if the stage map itself is unwanted.
+    "tracing_stage_timestamps": True,
     # Native (C++) daemon blob store (node_store.cpp); falls back to
     # the Python store when the toolchain/library is unavailable.
     "node_store_native": True,

@@ -247,6 +247,12 @@ class TaskEvent:
     node_id: str = ""
     error: str | None = None
     actor_id: str | None = None
+    # Per-stage lifecycle timestamps (driver clock, offset-corrected for
+    # remote stages): submit / dispatch / rpc_sent / admitted /
+    # worker_start / exec_start / exec_end / seal. Populated only while
+    # tracing is enabled (tracing_stage_timestamps); successive state
+    # records for one task MERGE their maps (record_task_event).
+    stage_ts: dict = field(default_factory=dict)
 
 
 class GlobalControlService:
@@ -267,6 +273,16 @@ class GlobalControlService:
         self._jobs: dict[JobID, JobRecord] = {}
         self._task_events: dict[TaskID, TaskEvent] = {}
         self._task_event_limit = 100_000
+        # Events silently refused at the cap used to vanish untraceably;
+        # the counter surfaces as ray_tpu_task_events_dropped_total in
+        # /metrics (reference: gcs_task_manager's dropped-task-attempts
+        # accounting).
+        self.task_events_dropped = 0
+        # Per-node executor stats pushed on heartbeats (pipeline /
+        # data_plane / faults), served to drivers as labeled /metrics
+        # series — the GCS-side aggregation table.
+        self._node_stats: dict[str, dict] = {}
+        self._node_stats_lock = threading.Lock()
 
     # ---------------------------------------------------------------- actors
 
@@ -381,12 +397,25 @@ class GlobalControlService:
 
     # ----------------------------------------------------------- task events
 
+    def _record_one_locked(self, event: TaskEvent) -> None:
+        # Caller holds self._lock.
+        if len(self._task_events) >= self._task_event_limit \
+                and event.task_id not in self._task_events:
+            self.task_events_dropped += 1
+            return
+        prior = self._task_events.get(event.task_id)
+        if prior is not None and prior.stage_ts:
+            # Later state records replace the event object; stage
+            # stamps accumulated by earlier states (submit/dispatch)
+            # must survive the replacement.
+            merged = dict(prior.stage_ts)
+            merged.update(event.stage_ts)
+            event.stage_ts = merged
+        self._task_events[event.task_id] = event
+
     def record_task_event(self, event: TaskEvent) -> None:
         with self._lock:
-            if len(self._task_events) >= self._task_event_limit \
-                    and event.task_id not in self._task_events:
-                return
-            self._task_events[event.task_id] = event
+            self._record_one_locked(event)
 
     def record_task_events(self, events: "list[TaskEvent]") -> None:
         """Coalesced state recording: one lock pass for a whole batch
@@ -395,10 +424,33 @@ class GlobalControlService:
         FINISHED — in a single call)."""
         with self._lock:
             for event in events:
-                if len(self._task_events) >= self._task_event_limit \
-                        and event.task_id not in self._task_events:
-                    continue
-                self._task_events[event.task_id] = event
+                self._record_one_locked(event)
+
+    def merge_stage_ts(self, task_id: TaskID, stages: dict) -> None:
+        """Fold late-arriving stage stamps (a reply's offset-corrected
+        remote timestamps, the seal time) into an existing event."""
+        if not stages:
+            return
+        with self._lock:
+            event = self._task_events.get(task_id)
+            if event is not None:
+                event.stage_ts.update(stages)
+
+    # ----------------------------------------------------- node stats
+
+    def record_node_stats(self, node_hex: str, stats: dict) -> None:
+        """Heartbeat piggyback: one node's executor stats snapshot."""
+        with self._node_stats_lock:
+            self._node_stats[node_hex] = stats
+
+    def drop_node_stats(self, node_hex: str) -> None:
+        with self._node_stats_lock:
+            self._node_stats.pop(node_hex, None)
+
+    def node_stats(self) -> dict:
+        """{node hex -> last pushed executor stats snapshot}."""
+        with self._node_stats_lock:
+            return dict(self._node_stats)
 
     def get_task_event(self, task_id: TaskID) -> TaskEvent | None:
         with self._lock:

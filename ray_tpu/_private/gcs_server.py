@@ -217,6 +217,12 @@ class GcsServer:
         # the "node_resources" syncer channel).
         self._last_published_avail: dict[str, dict] = {}
         self._avail_lock = threading.Lock()
+        # Daemon trace spans shipped on heartbeats, staged until a
+        # driver drains them into its merged timeline. Bounded: a
+        # cluster tracing with no driver exporting must not grow this
+        # without limit.
+        self._trace_spans: list[dict] = []
+        self._trace_lock = threading.Lock()
         self._register_methods()
         self._monitor = threading.Thread(
             target=self._monitor_loop, daemon=True, name="gcs-monitor")
@@ -247,6 +253,11 @@ class GcsServer:
         s.register("list_jobs", self.jobs.list)
         # Cluster-wide info.
         s.register("cluster_resources", self._cluster_resources)
+        # Observability: per-node executor stats (heartbeat-pushed;
+        # drivers fold them into /metrics as labeled series) and the
+        # heartbeat-shipped daemon trace spans.
+        s.register("node_stats", self.gcs.node_stats)
+        s.register("drain_trace_spans", self._drain_trace_spans)
         # Object-location table (reference:
         # ownership_based_object_directory.h — owner -> holding nodes;
         # here owners batch-publish their primary-copy locations).
@@ -305,9 +316,29 @@ class GcsServer:
         return node_id.binary()
 
     def _heartbeat(self, node_id_bytes: bytes,
-                   available: dict | None = None) -> bool:
+                   available: dict | None = None,
+                   stats: dict | None = None,
+                   trace: dict | None = None) -> bool:
         # False tells the agent it is unknown/dead and must re-register.
         accepted = self.gcs.heartbeat(NodeID(node_id_bytes), available)
+        if accepted and stats is not None:
+            # Executor-stats piggyback: the GCS-side aggregation table
+            # drivers scrape into per-node /metrics series.
+            self.gcs.record_node_stats(node_id_bytes.hex(), stats)
+        if accepted and trace:
+            # Daemon spans piggybacked on the heartbeat. The offset is
+            # a one-way estimate (recv wall clock minus the daemon's
+            # send stamp) — coarser than the half-RTT reply path, but
+            # these spans had no driver reply to anchor on.
+            spans = trace.get("spans") or []
+            anchor = trace.get("now")
+            offset = (time.time() - float(anchor)) if anchor else 0.0
+            with self._trace_lock:
+                room = 65536 - len(self._trace_spans)
+                if room > 0:
+                    self._trace_spans.append(
+                        {"spans": spans[:room], "offset": offset,
+                         "node": node_id_bytes.hex()})
         if accepted and available is not None:
             # Syncer push: availability CHANGES fan out on the
             # "node_resources" channel so drivers' schedulers track
@@ -340,7 +371,15 @@ class GcsServer:
 
     def _drain_node(self, node_id_bytes: bytes) -> bool:
         self.gcs.mark_node_dead(NodeID(node_id_bytes))
+        self.gcs.drop_node_stats(node_id_bytes.hex())
         return True
+
+    def _drain_trace_spans(self) -> list[dict]:
+        """Hand the staged heartbeat-shipped span batches to the
+        draining driver (one-shot: drained batches are gone)."""
+        with self._trace_lock:
+            out, self._trace_spans = self._trace_spans, []
+            return out
 
     def _object_locations_update(self, owner: str, adds: list,
                                  removes: list) -> int:
@@ -381,11 +420,15 @@ class GcsServer:
                     self.gcs.mark_node_dead(record.node_id)
                 elif record.alive:
                     alive_ids.add(record.node_id.hex())
-            # Dead/churned nodes must not leak change-detection state.
+            # Dead/churned nodes must not leak change-detection state
+            # (or stale per-node stats series in /metrics).
             with self._avail_lock:
                 for hex_id in list(self._last_published_avail):
                     if hex_id not in alive_ids:
                         self._last_published_avail.pop(hex_id, None)
+            for hex_id in list(self.gcs.node_stats()):
+                if hex_id not in alive_ids:
+                    self.gcs.drop_node_stats(hex_id)
             self._prune_object_locations()
             self.pubsub.prune()
             if self._persist_path:

@@ -76,9 +76,93 @@ def install_runtime_collectors(runtime):
             lines.append(
                 f'ray_tpu_resource_available'
                 f'{{resource="{_escape_label(key)}"}} {value}')
+
+        # Task events silently refused at the GCS cap: drops were
+        # previously invisible — a truncated timeline looked complete.
+        lines.append("# TYPE ray_tpu_task_events_dropped_total counter")
+        lines.append(f"ray_tpu_task_events_dropped_total "
+                     f"{runtime.gcs.task_events_dropped}")
+
+        # Dropped trace spans (buffer cap overflow) — only meaningful
+        # while tracing is armed, but always cheap to emit.
+        from ray_tpu.util import tracing
+
+        lines.append("# TYPE ray_tpu_trace_spans_dropped_total counter")
+        lines.append(f"ray_tpu_trace_spans_dropped_total "
+                     f"{tracing.dropped_spans()}")
+
+        # Driver-side recovery-path counters as one labeled family
+        # (node="driver" keeps them joinable with the per-node series).
+        try:
+            faults = runtime.fault_stats()
+        except Exception:  # noqa: BLE001 — partial runtime teardown
+            faults = {}
+        lines.append("# TYPE ray_tpu_faults_total counter")
+        for key, value in sorted(faults.items()):
+            lines.append(
+                f'ray_tpu_faults_total{{node="driver",'
+                f'kind="{_escape_label(key)}"}} {value}')
+
+        # Cluster-wide per-node series: each daemon pushes its
+        # executor_stats subset (pipeline / data_plane / faults) on
+        # heartbeats into the GCS aggregation table; the driver folds
+        # them into its scrape as labeled series — replacing the old
+        # driver-only view (reference: per-node metrics agents all
+        # scraped under one job in the reference deployment).
+        lines.extend(_node_stat_lines(runtime))
         return lines
 
     return REGISTRY.add_collector(collect)
+
+
+def _node_stat_lines(runtime) -> list[str]:
+    client = getattr(runtime, "gcs_client", None)
+    if client is not None:
+        try:
+            by_node = client.call("node_stats", timeout_s=2.0)
+        except Exception:  # noqa: BLE001 — head unreachable: skip series
+            return []
+    else:
+        by_node = runtime.gcs.node_stats()
+    lines: list[str] = []
+    if not by_node:
+        return lines
+    lines.append("# TYPE ray_tpu_node_tasks_executed counter")
+    lines.append("# TYPE ray_tpu_node_running_tasks gauge")
+    lines.append("# TYPE ray_tpu_node_pipeline counter")
+    lines.append("# TYPE ray_tpu_node_data_plane counter")
+    lines.append("# TYPE ray_tpu_node_faults counter")
+    for node_hex, stats in sorted(by_node.items()):
+        node = _escape_label(node_hex[:16])
+        if not isinstance(stats, dict):
+            continue
+        if "tasks_executed" in stats:
+            lines.append(f'ray_tpu_node_tasks_executed{{node="{node}"}} '
+                         f'{stats["tasks_executed"]}')
+        if "running" in stats:
+            lines.append(f'ray_tpu_node_running_tasks{{node="{node}"}} '
+                         f'{stats["running"]}')
+        for family, metric in (("pipeline", "ray_tpu_node_pipeline"),
+                               ("data_plane", "ray_tpu_node_data_plane"),
+                               ("faults", "ray_tpu_node_faults")):
+            group = stats.get(family)
+            if not isinstance(group, dict):
+                continue
+            for key, value in sorted(group.items()):
+                if isinstance(value, dict):
+                    # Nested tables (lease stats) flatten one level.
+                    for sub, subv in sorted(value.items()):
+                        if isinstance(subv, (int, float)):
+                            lines.append(
+                                f'{metric}{{node="{node}",key='
+                                f'"{_escape_label(f"{key}.{sub}")}"}} '
+                                f'{subv}')
+                    continue
+                if isinstance(value, (int, float)):
+                    lines.append(
+                        f'{metric}{{node="{node}",'
+                        f'key="{_escape_label(key)}"}} {value}')
+    return lines
 
 
 class _Handler(BaseHTTPRequestHandler):

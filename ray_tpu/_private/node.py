@@ -72,7 +72,7 @@ class NodeAgent:
                  labels: dict | None = None,
                  heartbeat_period_s: float = 1.0,
                  usage_fn=None, executor_address: str = "",
-                 coalesce_s: float = 0.05):
+                 coalesce_s: float = 0.05, stats_fn=None):
         # Pipelined client: a heartbeat never queues behind a slow
         # re-register (or any other in-flight call) on the same socket,
         # and a dead head is detected by the reader thread the moment
@@ -87,6 +87,11 @@ class NodeAgent:
         # Optional live-usage callable: () -> {resource: available}
         # piggybacked on heartbeats (ray_syncer-lite).
         self.usage_fn = usage_fn
+        # Optional executor-stats callable: () -> dict, piggybacked on
+        # heartbeats into the GCS node-stats aggregation table (the
+        # per-node /metrics series — no extra RPC, the heartbeat IS the
+        # stats channel).
+        self.stats_fn = stats_fn
         self.executor_address = executor_address
         self._address = f"{_own_address()}:{os.getpid()}"
         self.node_id: bytes = b""
@@ -141,13 +146,29 @@ class NodeAgent:
                     available = self.usage_fn()
                 except Exception:  # noqa: BLE001 — usage is best-effort
                     available = None
+            stats = None
+            if self.stats_fn is not None:
+                try:
+                    stats = self.stats_fn()
+                except Exception:  # noqa: BLE001 — stats are best-effort
+                    stats = None
+            trace = None
+            from ray_tpu.util import tracing
+
+            if tracing.TRACE_ON:
+                # Piggyback this daemon's buffered spans (user spans,
+                # orphans no reply frame carried) with a wall-clock
+                # anchor for the head's one-way offset estimate.
+                spans = tracing.drain_buffered()
+                if spans:
+                    trace = {"spans": spans, "now": time.time()}
             try:
                 # Heartbeats are idempotent: ride the shared retry
                 # policy with a short per-try timeout so one dropped
                 # frame costs a retry, not a liveness-timeout stall.
                 accepted = call_with_retry(
                     self.client.call, "heartbeat", self.node_id,
-                    available, attempts=2,
+                    available, stats, trace, attempts=2,
                     timeout_s=max(3.0, self.heartbeat_period_s * 3))
                 if not accepted:
                     # Unknown/dead at the head (stall past the timeout
@@ -238,6 +259,12 @@ def run_head(port: int, resources: dict | None = None,
 
     head_resources = resources or default_resources()
     os.environ.setdefault("RAY_TPU_NODE_TAG", f"head-{os.urandom(4).hex()}")
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    if bool(GLOBAL_CONFIG.tracing_enabled):
+        from ray_tpu.util import tracing
+
+        tracing.enable()
     executor = NodeExecutorService(resources=head_resources)
     executor.advertised_address = executor.address_for(_own_address())
     executor.start()
@@ -246,7 +273,8 @@ def run_head(port: int, resources: dict | None = None,
                       head_resources,
                       labels={"node_role": "head"},
                       usage_fn=head_usage,
-                      executor_address=executor.address_for(_own_address()))
+                      executor_address=executor.address_for(_own_address()),
+                      stats_fn=executor.stats_for_sync)
     executor.set_load_listener(agent.poke)
 
     # Written LAST: `start` blocks on this file, so by the time the CLI
@@ -295,6 +323,15 @@ def run_worker(gcs_address: str, resources: dict | None = None,
     # Unique per-daemon tag, inherited by this node's pool workers (set
     # BEFORE the pool spawns) — tasks can read it to learn where they ran.
     os.environ["RAY_TPU_NODE_TAG"] = os.urandom(6).hex()
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    if bool(GLOBAL_CONFIG.tracing_enabled):
+        # Daemons inherit RAY_TPU_TRACING_ENABLED through the child env:
+        # user spans opened inside daemon-hosted tasks collect and ship
+        # on heartbeats without any driver involvement.
+        from ray_tpu.util import tracing
+
+        tracing.enable()
     executor = NodeExecutorService(
         pool_size=pool_size, resources=resources)
     executor.advertised_address = executor.address_for(_own_address())
@@ -303,7 +340,8 @@ def run_worker(gcs_address: str, resources: dict | None = None,
                       labels={"node_role": "worker", **(labels or {})},
                       heartbeat_period_s=heartbeat_period_s,
                       usage_fn=executor.available_resources,
-                      executor_address=executor.address_for(_own_address()))
+                      executor_address=executor.address_for(_own_address()),
+                      stats_fn=executor.stats_for_sync)
     executor.set_load_listener(agent.poke)
     stop_event = threading.Event()
 

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Callable
 
@@ -81,6 +82,26 @@ class RemoteBlob:
 class NodeBusyError(Exception):
     """The node rejected the lease at admission (another driver's work
     saturates it); the submitter should spill to a different node."""
+
+
+# Canonical executor_stats() counter keys, exported so the README
+# doc-drift check (tests/test_doc_drift.py) can assert every counter is
+# documented without standing up a daemon.
+PIPELINE_STAT_KEYS = ("batch_rpcs", "batch_tasks", "reply_groups",
+                      "worker_lease_runs", "worker_lease_tasks",
+                      "worker_pipelined_frames")
+DATA_PLANE_STAT_KEYS = ("same_host_map_hits", "same_host_copy_hits",
+                        "chunked_pulls", "map_sources",
+                        "attached_mappings", "leases")
+FAULT_STAT_KEYS = ("rpc_retries", "batch_requeues", "peer_blacklists",
+                   "lease_orphans_swept", "arena_orphans_swept",
+                   "lineage_rebuilds")
+
+
+def _proc_label() -> str:
+    """This daemon's process-lane label in merged timelines."""
+    tag = os.environ.get("RAY_TPU_NODE_TAG", "")
+    return f"node:{tag[:8]}" if tag else f"node:pid{os.getpid()}"
 
 
 class NodeObjectStore:
@@ -1080,12 +1101,19 @@ class NodeExecutorService:
                      resources: dict | None = None,
                      task_token: str | None = None,
                      client_addr: str | None = None,
-                     args_ref: str | None = None) -> tuple:
+                     args_ref: str | None = None,
+                     trace_ctx: tuple | None = None) -> tuple:
         """Run one task; reply ("ok", [result descriptors]) where each
         descriptor is ("inline", blob) or ("stored", size), or
         ("need_func", nonce) when the digest is unknown here (args are
         stashed under the nonce so the retry ships the function alone),
-        or ("err", exc_blob)."""
+        or ("err", exc_blob).
+
+        ``trace_ctx`` (trace_id, parent span_id, anchor): the driver is
+        tracing this task — stamp daemon-side stage timestamps, open a
+        linked span, and piggyback both (plus any buffered spans) on
+        the reply as a third tuple element. The context's presence IS
+        the enable signal; without it this path costs nothing."""
         # Admission: with several drivers sharing this node, each one
         # accounts only its own leases — reject work beyond capacity and
         # let the submitter spill to another node (reference: raylet
@@ -1107,6 +1135,8 @@ class NodeExecutorService:
                 return ("stale_args",)
         if not self._try_reserve(token, demand):
             return ("busy",)
+        trace_stages = {"admitted": time.time()} \
+            if trace_ctx is not None else None
         try:
             with self._func_lock:
                 func = self._func_cache.get(digest)
@@ -1146,10 +1176,44 @@ class NodeExecutorService:
                               for k in (resources or {}))
             args, kwargs = self._resolve_fetch_args(args, kwargs,
                                                     to_shm=on_pool)
-            values = self._run(func, digest, func_blob, args, kwargs,
-                               n_returns, runtime_env,
-                               resources or {}, task_token=token,
-                               client_addr=client_addr)
+            if trace_stages is None:
+                values = self._run(func, digest, func_blob, args,
+                                   kwargs, n_returns, runtime_env,
+                                   resources or {}, task_token=token,
+                                   client_addr=client_addr)
+            else:
+                from ray_tpu.util import tracing
+
+                t_exec = time.time()
+                with tracing.remote_span(
+                        "daemon:execute", trace_ctx, _proc_label(),
+                        {"digest": digest[:8]}):
+                    values = self._run(func, digest, func_blob, args,
+                                       kwargs, n_returns, runtime_env,
+                                       resources or {},
+                                       task_token=token,
+                                       client_addr=client_addr,
+                                       trace=trace_ctx,
+                                       trace_stages=trace_stages)
+                # Pool-worker runs reported their own (finer) stamps
+                # into trace_stages; in-daemon runs (TPU tasks) get the
+                # daemon-level envelope.
+                trace_stages.setdefault("exec_start", t_exec)
+                trace_stages.setdefault("exec_end", time.time())
+                wpid = trace_stages.pop("pid", None)
+                if wpid is not None and "exec_start" in trace_stages \
+                        and "exec_end" in trace_stages:
+                    tracing.buffer_span({
+                        "name": "worker:execute",
+                        "span_id": os.urandom(8).hex(),
+                        "parent_id": trace_ctx[1],
+                        "trace_id": trace_ctx[0],
+                        "start_time": trace_stages["exec_start"],
+                        "end_time": trace_stages["exec_end"],
+                        "thread": "task",
+                        "proc": f"worker:{wpid}",
+                        "attributes": {"token": token},
+                    })
         except BaseException as exc:  # noqa: BLE001 — shipped to driver
             return ("err", _exc_blob(exc))
         finally:
@@ -1172,7 +1236,19 @@ class NodeExecutorService:
                 self.store.put(id_bytes, blob, owner=client_addr)
                 self._maybe_export_stored(id_bytes, blob)
                 out.append(("stored", len(blob)))
+        if trace_stages is not None:
+            return ("ok", out, self._trace_payload(trace_stages))
         return ("ok", out)
+
+    def _trace_payload(self, stages: dict) -> dict:
+        """Reply piggyback: this task's daemon-clock stage stamps, any
+        buffered spans (this task's + orphans), and the daemon wall
+        clock NOW — the driver's ClockSync anchors its half-RTT offset
+        on it so merged timelines line up."""
+        from ray_tpu.util import tracing
+
+        return {"stages": stages, "spans": tracing.drain_buffered(),
+                "now": time.time()}
 
     def _maybe_export_stored(self, id_bytes: bytes, blob) -> None:
         """Give a large stored primary a named-segment twin so
@@ -1338,7 +1414,10 @@ class NodeExecutorService:
         token_idx: dict[str, int] = {}
         for idx, entry in enumerate(entries):
             (digest, func_blob, args_blob, n_returns, return_keys,
-             runtime_env, resources, token, flags) = entry
+             runtime_env, resources, token, flags) = entry[:9]
+            # Optional 10th element: the driver's trace context for
+            # this entry (absent ⇒ tracing off for it — zero cost).
+            trace_ctx = entry[9] if len(entry) > 9 else None
             if func_blob is not None:
                 with self._func_lock:
                     self._func_blob_cache[digest] = func_blob
@@ -1354,12 +1433,13 @@ class NodeExecutorService:
                                 args_blob=args_blob, n_returns=n_returns,
                                 return_keys=return_keys,
                                 runtime_env=runtime_env,
-                                resources=resources, token=token):
+                                resources=resources, token=token,
+                                trace_ctx=trace_ctx):
                     try:
                         reply = self.execute_task(
                             digest, func_blob, args_blob, n_returns,
                             return_keys, runtime_env, resources, token,
-                            client_addr)
+                            client_addr, trace_ctx=trace_ctx)
                     except BaseException as exc:  # noqa: BLE001
                         reply = ("err", _exc_blob(exc))
                     complete(idx, reply)
@@ -1387,13 +1467,18 @@ class NodeExecutorService:
                 idx=idx, digest=digest, func_blob=blob,
                 args_blob=args_blob, n_returns=max(1, n_returns),
                 runtime_env=runtime_env, token=token,
-                client_addr=client_addr, sys_path=sys_path))
+                client_addr=client_addr, sys_path=sys_path,
+                trace=trace_ctx))
+        admit_ts: dict[int, float] = {}
         if pipeline:
             accepted = self._try_reserve_many(reserve_wants)
+            t_admit = time.time()
             admitted = []
             for task, ok in zip(pipeline, accepted):
                 if ok:
                     admitted.append(task)
+                    if task.trace is not None:
+                        admit_ts[task.idx] = t_admit
                 else:
                     complete(task.idx, ("busy",))
             pipeline = admitted
@@ -1410,7 +1495,7 @@ class NodeExecutorService:
             self._pipeline_inflight.register_notify(
                 [t.token for t in pipeline], notify)
 
-            def on_result(task, status, payload):
+            def on_result(task, status, payload, wtrace=None):
                 with self._running_lock:
                     self._running.pop(task.token, None)
                     self._blocked_cpu.pop(task.token, None)
@@ -1420,6 +1505,9 @@ class NodeExecutorService:
                         client_addr)
                 except BaseException as exc:  # noqa: BLE001
                     reply = ("err", _exc_blob(exc))
+                if task.trace is not None and reply[0] == "ok":
+                    reply = (reply[0], reply[1], self._batch_trace(
+                        task, admit_ts.get(task.idx), wtrace))
                 complete(task.idx, reply)
 
             depth = max(1, int(GLOBAL_CONFIG.worker_pipeline_depth))
@@ -1561,6 +1649,92 @@ class NodeExecutorService:
         self._release_plane_state(key)
         self._shm_directory.free(key)
 
+    def _batch_trace(self, task, admitted: float | None,
+                     wtrace: dict | None) -> dict:
+        """Per-task trace payload for a pipelined batch completion:
+        daemon admission stamp + the worker's frame/exec stamps (same
+        host, same clock), plus a daemon-lane span and a worker-lane
+        span so the merged timeline shows the full hop chain."""
+        from ray_tpu.util import tracing
+
+        now = time.time()
+        stages: dict = {}
+        if admitted is not None:
+            stages["admitted"] = admitted
+        ctx = task.trace
+        if wtrace:
+            for key in ("worker_start", "exec_start", "exec_end"):
+                if key in wtrace:
+                    stages[key] = wtrace[key]
+            if "exec_start" in wtrace and "exec_end" in wtrace:
+                tracing.buffer_span({
+                    "name": "worker:execute",
+                    "span_id": os.urandom(8).hex(),
+                    "parent_id": ctx[1] if ctx else None,
+                    "trace_id": ctx[0] if ctx else "",
+                    "start_time": wtrace["exec_start"],
+                    "end_time": wtrace["exec_end"],
+                    "thread": "task_seq",
+                    "proc": f"worker:{wtrace.get('pid', '?')}",
+                    "attributes": {"token": task.token or ""},
+                })
+        if admitted is not None:
+            tracing.buffer_span({
+                "name": "daemon:task",
+                "span_id": os.urandom(8).hex(),
+                "parent_id": ctx[1] if ctx else None,
+                "trace_id": ctx[0] if ctx else "",
+                "start_time": admitted,
+                "end_time": now,
+                "thread": "batch",
+                "proc": _proc_label(),
+                "attributes": {"token": task.token or ""},
+            })
+        return {"stages": stages, "spans": tracing.drain_buffered(),
+                "now": now}
+
+    def _pipeline_stats(self) -> dict:
+        # Per-stage drain counters for the pipelined execute path
+        # (dispatch batches -> batch RPCs -> worker leases/frames ->
+        # grouped seal replies) so a throughput regression localizes
+        # to one stage in a single read.
+        return {
+            "batch_rpcs": self.batch_rpcs,
+            "batch_tasks": self.batch_tasks_received,
+            "reply_groups": self.reply_groups,
+            "worker_lease_runs": self.pool.batch_runs,
+            "worker_lease_tasks": self.pool.batch_tasks,
+            "worker_pipelined_frames": self.pool.batch_frames,
+        }
+
+    def _data_plane_stats(self) -> dict:
+        with self._shm_args_lock:
+            data_plane = {
+                "same_host_map_hits": self.same_host_map_hits,
+                "same_host_copy_hits": self.same_host_copy_hits,
+                "chunked_pulls": self.chunked_pulls,
+                "map_sources": len(self._map_sources),
+                "attached_mappings": len(self._attached),
+            }
+        data_plane["leases"] = self.leases.stats()
+        return data_plane
+
+    def _fault_stats(self) -> dict:
+        # Failure counters: every recovery path the chaos tests (and
+        # the envelope rows) assert — retried idempotent RPCs, batch
+        # entries requeued after a worker/daemon death, chunk sources
+        # blacklisted mid-pull, orphaned peer mappings swept.
+        from ray_tpu._private.rpc import rpc_retry_count
+
+        return {
+            "rpc_retries": rpc_retry_count(),
+            "batch_requeues": self.pool.batch_requeues,
+            "peer_blacklists": self.peer_blacklists,
+            "lease_orphans_swept": self.lease_orphans_swept,
+            "arena_orphans_swept": self.arena_orphans_swept,
+            "lineage_rebuilds": 0,  # daemons hold no lineage (owners do)
+        }
+
     def executor_stats(self) -> dict:
         with self._running_lock:
             running = len(self._running)
@@ -1571,47 +1745,27 @@ class NodeExecutorService:
                 "partials": len(self._partials),
                 "relay_chunks_served": self.relay_chunks_served,
             }
-        with self._shm_args_lock:
-            data_plane = {
-                "same_host_map_hits": self.same_host_map_hits,
-                "same_host_copy_hits": self.same_host_copy_hits,
-                "chunked_pulls": self.chunked_pulls,
-                "map_sources": len(self._map_sources),
-                "attached_mappings": len(self._attached),
-            }
-        data_plane["leases"] = self.leases.stats()
-        # Per-stage drain counters for the pipelined execute path
-        # (dispatch batches -> batch RPCs -> worker leases/frames ->
-        # grouped seal replies) so a throughput regression localizes
-        # to one stage in a single read.
-        pipeline = {
-            "batch_rpcs": self.batch_rpcs,
-            "batch_tasks": self.batch_tasks_received,
-            "reply_groups": self.reply_groups,
-            "worker_lease_runs": self.pool.batch_runs,
-            "worker_lease_tasks": self.pool.batch_tasks,
-            "worker_pipelined_frames": self.pool.batch_frames,
-        }
-        # Failure counters: every recovery path the chaos tests (and
-        # the envelope rows) assert — retried idempotent RPCs, batch
-        # entries requeued after a worker/daemon death, chunk sources
-        # blacklisted mid-pull, orphaned peer mappings swept.
-        from ray_tpu._private.rpc import rpc_retry_count
-
-        faults = {
-            "rpc_retries": rpc_retry_count(),
-            "batch_requeues": self.pool.batch_requeues,
-            "peer_blacklists": self.peer_blacklists,
-            "lease_orphans_swept": self.lease_orphans_swept,
-            "arena_orphans_swept": self.arena_orphans_swept,
-            "lineage_rebuilds": 0,  # daemons hold no lineage (owners do)
-        }
         return {"tasks_executed": self.tasks_executed,
                 "running": running, "store": self.store.stats(),
                 "num_actors": num_actors, "pid": os.getpid(),
-                "relay": relay, "data_plane": data_plane,
-                "pipeline": pipeline, "faults": faults,
+                "relay": relay,
+                "data_plane": self._data_plane_stats(),
+                "pipeline": self._pipeline_stats(),
+                "faults": self._fault_stats(),
                 "threads": threading.active_count()}
+
+    def stats_for_sync(self) -> dict:
+        """Heartbeat-piggyback subset of ``executor_stats()``: the
+        counter groups the cluster /metrics aggregation serves per node
+        (pipeline / data_plane / faults), cheap enough for a 1 s
+        cadence — no store-wide byte sums."""
+        with self._running_lock:
+            running = len(self._running)
+        return {"tasks_executed": self.tasks_executed,
+                "running": running,
+                "pipeline": self._pipeline_stats(),
+                "data_plane": self._data_plane_stats(),
+                "faults": self._fault_stats()}
 
     def adopt_sys_path(self, paths: list) -> int:
         """Adopt a driver's import paths (existing directories only) so
@@ -2600,7 +2754,7 @@ class NodeExecutorService:
 
     def _run(self, func, digest, func_blob, args, kwargs, n_returns,
              runtime_env, resources, task_token=None,
-             client_addr=None) -> list:
+             client_addr=None, trace=None, trace_stages=None) -> list:
         if any(k.startswith("TPU") for k in resources):
             # TPU tasks run in the daemon process: it owns this node's
             # JAX/TPU runtime (pool workers are pinned to CPU). Each
@@ -2624,7 +2778,8 @@ class NodeExecutorService:
                 pairs = self.pool.run_task_blobs(
                     digest, func_blob, args_blob, n_returns, return_ids,
                     runtime_env=runtime_env, task_token=task_token,
-                    client_addr=client_addr, sys_path=sys_path)
+                    client_addr=client_addr, sys_path=sys_path,
+                    trace=trace, stages_out=trace_stages)
             except _RemoteTaskError as rte:
                 rte.cause.__ray_tpu_remote_tb__ = rte.remote_tb
                 raise rte.cause from None
@@ -2670,6 +2825,12 @@ class RemoteNodeHandle:
         # "pool" kept for call-site compatibility: it is one multiplexed
         # connection that behaves like an unbounded pool.
         self.pool = MuxRpcClient(address)
+        # Monotonic→driver-clock offset estimate for THIS node, anchored
+        # half-RTT on traced execute replies (util/tracing.ClockSync):
+        # merged timelines correct the daemon's stage stamps with it.
+        from ray_tpu.util import tracing
+
+        self.clock = tracing.ClockSync()
         # Short-timeout client for watcher-thread control calls: a ping
         # to an unreachable address must fail fast, never stall the
         # watcher behind the pool's task-length timeouts.
@@ -2707,19 +2868,25 @@ class RemoteNodeHandle:
                 runtime_env: dict | None,
                 resources: dict[str, float],
                 task_token: str | None = None,
-                client_addr: str | None = None) -> list:
+                client_addr: str | None = None,
+                trace_ctx: tuple | None = None) -> tuple:
         """Lease + push + reply. Ships the function blob only the first
-        time this node sees its digest."""
+        time this node sees its digest. Returns ``(results, trace)``
+        where ``trace`` is the daemon's piggybacked trace payload
+        (stage stamps + spans + wall clock) or None."""
         self.ensure_sys_path()
         with self._digest_lock:
             known = digest in self.known_digests
+        # Tracing rides as an RPC kwarg only when armed: the untraced
+        # wire shape is byte-identical to before.
+        extra = {} if trace_ctx is None else {"trace_ctx": trace_ctx}
         # Coalesced: burst submissions to this node share __batch__
         # frames (one syscall/server wakeup per batch); replies are
         # still per-call, so nothing head-of-line blocks.
         reply = self.pool.call(
             "execute_task", digest, None if known else func_blob,
             args_blob, n_returns, return_keys, runtime_env, resources,
-            task_token, client_addr, coalesce=True)
+            task_token, client_addr, coalesce=True, **extra)
         if reply[0] == "need_func":
             # Node restarted / cache miss despite our bookkeeping: send
             # the function ALONE — the node stashed the args from the
@@ -2729,13 +2896,13 @@ class RemoteNodeHandle:
                 "execute_task", digest, func_blob,
                 None if nonce else args_blob, n_returns,
                 return_keys, runtime_env, resources, task_token,
-                client_addr, nonce)
+                client_addr, nonce, **extra)
             if reply[0] == "stale_args":
                 # The stash was evicted between the two calls: full resend.
                 reply = self.pool.call(
                     "execute_task", digest, func_blob, args_blob,
                     n_returns, return_keys, runtime_env, resources,
-                    task_token, client_addr)
+                    task_token, client_addr, **extra)
         if reply[0] == "busy":
             raise NodeBusyError(self.address)
         with self._digest_lock:
@@ -2745,7 +2912,7 @@ class RemoteNodeHandle:
                 memoryview(reply[1]))
             exc.__ray_tpu_remote_tb__ = tb
             raise exc
-        return reply[1]
+        return reply[1], (reply[2] if len(reply) > 2 else None)
 
     def execute_batch(self, entries: list, on_results,
                       on_parked=None, on_resumed=None,

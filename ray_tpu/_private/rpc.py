@@ -120,6 +120,19 @@ def _record_retry() -> None:
     global _RPC_RETRIES
     with _FAULTS_LOCK:
         _RPC_RETRIES += 1
+    # Fault counters double as timeline pins: a retried control call
+    # shows up at its wall-clock position next to the stage slices it
+    # delayed. One lazy-import branch; retries are rare by definition.
+    from ray_tpu.util import tracing
+
+    if tracing.TRACE_ON:
+        import os as _os
+
+        tag = _os.environ.get("RAY_TPU_NODE_TAG")
+        if tag:
+            tracing.buffer_instant("fault:rpc_retry", f"node:{tag[:8]}")
+        else:
+            tracing.instant("fault:rpc_retry")
 
 
 def rpc_retry_count() -> int:

@@ -32,6 +32,7 @@ from typing import Any, Callable
 
 from ray_tpu._private.ids import NodeID, _Counter
 from ray_tpu._private.task import TaskSpec
+from ray_tpu.util import tracing
 
 _DISPATCH_ORDER = _Counter()
 
@@ -456,6 +457,10 @@ class Dispatcher:
             task.claimed = True
             self._num_ready_live -= 1
             self._num_running += 1
+            if tracing.TRACE_ON:
+                # Dispatch-claim stage stamp: the run callable's owner
+                # (worker.py) folds it into the task's stage_ts map.
+                task.spec._stage_dispatch = time.time()
             # Running tasks are past cancellation: drop the cancel
             # index so a late cancel() can't race the real result
             # with a TaskCancelledError.

@@ -52,6 +52,7 @@ from ray_tpu._private.scheduler import (
 )
 from ray_tpu._private.task import SchedulingStrategy, TaskSpec
 from ray_tpu._private.actor_runtime import LocalActor, _ActorCall
+from ray_tpu.util import tracing
 from ray_tpu.exceptions import (
     ActorDiedError,
     GetTimeoutError,
@@ -1117,7 +1118,16 @@ class Runtime:
             self.store.create_pending(rid)
         refs = [ObjectRef(rid) for rid in return_ids]
         self.lineage.record(spec)
-        self.gcs.record_task_event(TaskEvent(task_id, name, "PENDING"))
+        submit_stages = {}
+        if tracing.TRACE_ON:
+            # Root of this task's distributed trace: the context rides
+            # the execute RPCs so daemon/worker spans link back here.
+            now = time.time()
+            spec._trace_ctx = tracing.make_trace_context(anchor=now)
+            if bool(GLOBAL_CONFIG.tracing_stage_timestamps):
+                submit_stages = {"submit": now}
+        self.gcs.record_task_event(TaskEvent(task_id, name, "PENDING",
+                                             stage_ts=submit_stages))
         deps = [a for a in args if isinstance(a, ObjectRef)] + [
             v for v in kwargs.values() if isinstance(v, ObjectRef)]
 
@@ -1156,12 +1166,63 @@ class Runtime:
         pg_spec._original = spec
         self.dispatcher.submit(pg_spec, lambda s, n: run_when_ready(), deps)
 
+    @staticmethod
+    def _dispatch_stages(spec: TaskSpec) -> dict:
+        """Stage stamps accumulated driver-side before execution (the
+        scheduler's claim time); {} when tracing was off at claim."""
+        ts = getattr(spec, "_stage_dispatch", None)
+        return {"dispatch": ts} if ts is not None else {}
+
+    def _ingest_reply_trace(self, spec: TaskSpec, handle, trace,
+                            t_send: float, t_recv: float) -> None:
+        """Fold a reply's piggybacked trace payload into the merged
+        view: anchor the node's ClockSync on the exchange (half-RTT),
+        offset-correct the daemon/worker stage stamps into driver
+        clock, merge them into the task's event, and ingest the
+        shipped spans."""
+        if trace is None:
+            return
+        offset = 0.0
+        now_remote = trace.get("now")
+        if now_remote is not None:
+            # Full NTP form: the daemon's admission stamp is its
+            # request-receive time (t1), its "now" the reply-send time
+            # (t2) — server processing time cancels out of the RTT.
+            remote_recv = (trace.get("stages") or {}).get("admitted")
+            offset = handle.clock.observe(t_send, t_recv,
+                                          float(now_remote),
+                                          remote_recv)
+        stages = {}
+        for key, value in (trace.get("stages") or {}).items():
+            if key in tracing.STAGES and isinstance(value, (int, float)):
+                stages[key] = float(value) + offset
+        stages["rpc_sent"] = t_send
+        stages["seal"] = time.time()
+        # Causal floor: a sub-ms offset-estimation error must never
+        # reorder stages across the clock boundary (admitted cannot
+        # precede the rpc that carried it) — enforce happened-before
+        # along the canonical chain.
+        prev = None
+        for key in tracing.STAGES:
+            ts = stages.get(key)
+            if ts is None:
+                continue
+            if prev is not None and ts < prev:
+                stages[key] = ts = prev
+            prev = ts
+        self.gcs.merge_stage_ts(spec.task_id, stages)
+        spans = trace.get("spans")
+        if spans:
+            tracing.ingest_spans(spans, offset)
+
     def _execute_task(self, spec: TaskSpec, node: NodeState, acquired: bool = True) -> None:
         """Reference: CoreWorker::ExecuteTask (core_worker.cc:2717)."""
         start = time.time()
         self.gcs.record_task_event(TaskEvent(
             spec.task_id, spec.name, "RUNNING", start_time=start,
-            node_id=node.node_id.hex() if node else ""))
+            node_id=node.node_id.hex() if node else "",
+            stage_ts=self._dispatch_stages(spec)
+            if tracing.TRACE_ON else {}))
         RuntimeContext.set(
             task_id=spec.task_id, task_name=spec.name, job_id=self.job_id,
             node_id=node.node_id if node else None, actor_id=None)
@@ -1424,12 +1485,16 @@ class Runtime:
                                   spec.resources, handle, token)
         with self._inflight_blocks_lock:
             self._inflight_blocks[token] = ctx
+        trace_ctx = getattr(spec, "_trace_ctx", None) \
+            if tracing.TRACE_ON else None
+        t_send = time.time()
         try:
-            results = handle.execute(
+            results, reply_trace = handle.execute(
                 digest, func_blob, args_blob, spec.num_returns,
                 return_keys, spec.runtime_env, spec.resources,
                 task_token=token,
-                client_addr=self._client_server_addr() or None)
+                client_addr=self._client_server_addr() or None,
+                trace_ctx=trace_ctx)
         except (RpcError, OSError) as exc:
             # Distinguish a dead node from a transient call failure: a
             # drop marks every object on the node lost and fires
@@ -1447,6 +1512,9 @@ class Runtime:
                 popped.drain()
         self._seal_remote_results(spec.return_ids, results,
                                   node.node_id, handle.address)
+        if reply_trace is not None:
+            self._ingest_reply_trace(spec, handle, reply_trace, t_send,
+                                     time.time())
         return True
 
     # ----------------------------------------------------- batched dispatch
@@ -1536,12 +1604,19 @@ class Runtime:
                 # need_func reply, retried through the single path.
                 handle.known_digests.add(digest)
             idx = len(entries)
-            entries.append((
+            entry = (
                 digest, None if known else func_blob, args_blob,
                 spec.num_returns,
                 [rid.binary() for rid in spec.return_ids],
                 spec.runtime_env, spec.resources, token,
-                1 if has_refs else 0))
+                1 if has_refs else 0)
+            trace_ctx = getattr(spec, "_trace_ctx", None) \
+                if tracing.TRACE_ON else None
+            if trace_ctx is not None:
+                # 10th element: the trace context — absent entries keep
+                # the untraced wire shape byte-identical.
+                entry = entry + (trace_ctx,)
+            entries.append(entry)
             spec_by_idx[idx] = spec
             ctx = _RemoteBlockContext(self.cluster, node.node_id,
                                       spec.resources, handle, token)
@@ -1550,7 +1625,9 @@ class Runtime:
                 self._inflight_blocks[token] = ctx
             events.append(TaskEvent(
                 spec.task_id, spec.name, "RUNNING", start_time=start,
-                node_id=node.node_id.hex()))
+                node_id=node.node_id.hex(),
+                stage_ts=self._dispatch_stages(spec)
+                if trace_ctx is not None else {}))
         self.gcs.record_task_events(events)
 
         def finish_idx(idx: int) -> None:
@@ -1581,6 +1658,12 @@ class Runtime:
                             spec.task_id, spec.name, "FINISHED",
                             start_time=start, end_time=end,
                             node_id=node.node_id.hex()))
+                        if len(reply) > 2 and reply[2] is not None:
+                            # Piggybacked trace payload: daemon/worker
+                            # stage stamps + spans, offset-corrected
+                            # against this exchange.
+                            self._ingest_reply_trace(
+                                spec, handle, reply[2], t_send, end)
                     except BaseException as exc:  # noqa: BLE001
                         self._finish_task_failure(spec, exc, start)
                     finish_idx(idx)
@@ -1634,6 +1717,7 @@ class Runtime:
         started_idx: set[int] = set()
 
         transport_exc: BaseException | None = None
+        t_send = time.time()  # rpc_sent stamp + the ClockSync anchor
         if entries:
             try:
                 handle.execute_batch(entries, on_results, on_parked,
@@ -2908,6 +2992,11 @@ def init(
             GLOBAL_CONFIG.update(system_config)
         if logging_level:
             logging.getLogger("ray_tpu").setLevel(logging_level)
+        if bool(GLOBAL_CONFIG.tracing_enabled):
+            # Arm the tracing plane up front (RAY_TPU_TRACING_ENABLED
+            # or init(system_config={"tracing_enabled": True})); daemons
+            # inherit the env through daemon_child_env.
+            tracing.enable()
         if address == "auto":
             from ray_tpu.scripts import resolve_address
 
@@ -3039,17 +3128,13 @@ def nodes() -> list[dict]:
 
 
 def timeline() -> list[dict]:
-    """Chrome-trace-style task events (reference: `ray timeline`)."""
-    runtime = _require_runtime()
-    out = []
-    for ev in runtime.gcs.list_task_events():
-        out.append({
-            "name": ev.name,
-            "cat": "task",
-            "ph": "X",
-            "ts": ev.start_time * 1e6,
-            "dur": max(0.0, (ev.end_time - ev.start_time)) * 1e6,
-            "pid": ev.node_id or "driver",
-            "args": {"state": ev.state, "task_id": ev.task_id.hex()},
-        })
-    return out
+    """Chrome-trace-style task events (reference: `ray timeline`).
+
+    With tracing enabled, each task expands into per-stage slices
+    (submit→dispatch→rpc→admit→worker→execute→seal) across one process
+    lane per node, linked by flow arrows; untraced tasks keep the
+    single-slice view. ``util.tracing.export_chrome_trace(path)``
+    writes the same merged view (plus spans) to a file."""
+    from ray_tpu.util import tracing as _tracing
+
+    return _tracing.build_task_events(_require_runtime())

@@ -41,6 +41,7 @@ from __future__ import annotations
 import os
 import sys
 import threading
+import time
 import traceback
 from dataclasses import dataclass
 from typing import Any, Callable
@@ -97,6 +98,10 @@ class _BatchTask:
     token: str | None = None
     client_addr: str | None = None
     sys_path: list | None = None
+    # Driver trace context (trace_id, parent span_id, anchor): rides the
+    # task_seq frame so the worker stamps frame/exec times and the reply
+    # carries them back. None ⇒ tracing off for this task (zero cost).
+    trace: tuple | None = None
 
 
 # --------------------------------------------------------------------------
@@ -311,11 +316,13 @@ def worker_main(conn) -> None:
 
 
 def _exec_task_body(fields: tuple, func_cache: dict,
-                    client: ShmClient, arena, arena_max: int) -> list:
+                    client: ShmClient, arena, arena_max: int,
+                    stages: dict | None = None) -> list:
     """Execute one task message body (the fields after the kind/call-id
     prefix) and return the packed result descriptors. Shared by the
     classic one-in-flight ``task`` protocol and the pipelined
-    ``task_seq`` protocol."""
+    ``task_seq`` protocol. ``stages`` (traced frames only) receives
+    exec_start/exec_end stamps around the user function call."""
     (digest, func_blob, args_blob, n_returns, renv, token) = fields[:6]
     # Daemon pools serve many drivers: the owning driver's
     # client-server address rides with each task so nested
@@ -342,8 +349,12 @@ def _exec_task_body(fields: tuple, func_cache: dict,
         worker_client.set_driver_addr(client_addr)
     worker_client.set_task_token(token)
     try:
+        if stages is not None:
+            stages["exec_start"] = time.time()
         with _runtime_env_ctx(renv):
             result = func(*args, **kwargs)
+        if stages is not None:
+            stages["exec_end"] = time.time()
     finally:
         worker_client.set_task_token(None)
     if n_returns == 0:
@@ -399,22 +410,44 @@ def _serve(conn, client: ShmClient, arena=None,
             elif kind == "ping":
                 conn.send(("pong", os.getpid()))
             elif kind == "task":
-                conn.send(("ok", _exec_task_body(
-                    msg[1:], func_cache, client, arena, arena_max)))
+                # Optional 10th message element: the driver's trace
+                # context — stamp frame pickup + exec times and return
+                # them as a third reply element (same shape as the
+                # pipelined task_seq protocol).
+                traced = len(msg) > 9 and msg[9] is not None
+                stages = {"worker_start": time.time(),
+                          "pid": os.getpid()} if traced else None
+                packed = _exec_task_body(
+                    msg[1:], func_cache, client, arena, arena_max,
+                    stages=stages)
+                conn.send(("ok", packed, stages) if traced
+                          else ("ok", packed))
             elif kind == "task_seq":
                 # Pipelined protocol: frames arrive back-to-back (the
                 # sender does not wait for replies), execute serially
                 # in receive order, and each reply carries its call id
                 # so the daemon-side lease matches them out of order.
+                # An 11th frame element is the driver's trace context:
+                # stamp frame-pickup + exec times and ship them back as
+                # a 5th reply element (worker and daemon share a host,
+                # so these are daemon-clock timestamps).
                 call_id = msg[1]
+                traced = len(msg) > 10 and msg[10] is not None
+                stages = {"worker_start": time.time(),
+                          "pid": os.getpid()} if traced else None
                 try:
                     packed = _exec_task_body(
-                        msg[2:], func_cache, client, arena, arena_max)
+                        msg[2:], func_cache, client, arena, arena_max,
+                        stages=stages)
                 except BaseException as exc:  # noqa: BLE001 — per-task
-                    conn.send(("task_done", call_id, "err",
-                               _exception_blob(exc)))
+                    reply = ("task_done", call_id, "err",
+                             _exception_blob(exc))
+                    conn.send(reply + (stages,) if traced else reply)
                 else:
-                    conn.send(("task_done", call_id, "ok", packed))
+                    reply = ("task_done", call_id, "ok", packed)
+                    if traced:
+                        reply = reply + (stages,)
+                    conn.send(reply)
             elif kind == "actor_new":
                 _, cls_blob, args_blob, renv, max_concurrency = msg[:5]
                 # Remote actors: the creating driver's sys.path entries
@@ -1075,14 +1108,16 @@ class WorkerPool:
                     blob = (None if task.digest in worker.known_digests
                             else task.func_blob)
                     next_id += 1
-                    try:
-                        worker.send_nowait(
-                            ("task_seq", next_id, task.digest, blob,
+                    frame = ("task_seq", next_id, task.digest, blob,
                              task.args_blob, task.n_returns,
                              task.runtime_env, task.token,
                              task.client_addr,
                              task.sys_path if blob is not None
-                             else None))
+                             else None)
+                    if task.trace is not None:
+                        frame = frame + (task.trace,)
+                    try:
+                        worker.send_nowait(frame)
                     except _WorkerUnavailable as exc:
                         # Never delivered: this task is retryable as
                         # unstarted alongside the queued in-flight ones.
@@ -1114,7 +1149,10 @@ class WorkerPool:
                     break
                 if msg[0] != "task_done":
                     continue  # stray classic-protocol frame
-                _, call_id, status, payload = msg
+                call_id, status, payload = msg[1], msg[2], msg[3]
+                # Traced frames carry the worker's stage stamps as a
+                # 5th element (frame pickup + exec start/end).
+                wtrace = msg[4] if len(msg) > 4 else None
                 task = None
                 for i, (cid, t) in enumerate(inflight):
                     if cid == call_id:
@@ -1125,7 +1163,7 @@ class WorkerPool:
                     continue
                 if tracker is not None and task.token:
                     tracker.done(lease_key, task.token)
-                self._complete_one(state, task, status, payload)
+                self._complete_one(state, task, status, payload, wtrace)
             # Worker died (or refused the frame). The OLDEST in-flight
             # frame was executing — it may have side effects, so it
             # fails; everything behind it never started and is retried
@@ -1155,9 +1193,9 @@ class WorkerPool:
                 return
 
     def _complete_one(self, state: "_BatchState", task: "_BatchTask",
-                      status: str, payload) -> None:
+                      status: str, payload, wtrace=None) -> None:
         try:
-            state.on_result(task, status, payload)
+            state.on_result(task, status, payload, wtrace)
         finally:
             with state.lock:
                 state.remaining -= 1
@@ -1187,8 +1225,14 @@ class WorkerPool:
                        task_token: str | None = None,
                        client_addr: str | None = None,
                        sys_path: list | None = None,
+                       trace: tuple | None = None,
+                       stages_out: dict | None = None,
                        ) -> list[tuple[ObjectID, Any]]:
         """Execute on a pool worker; returns [(return_id, value)] pairs.
+
+        ``trace`` arms worker-side stage stamping for this task;
+        ``stages_out`` (a dict) receives the worker's frame/exec
+        timestamps from the reply.
 
         The function blob only crosses the pipe the first time a given
         worker sees its digest (function-manager pattern); afterwards
@@ -1224,9 +1268,12 @@ class WorkerPool:
                 worker = self._new_worker(
                     extra_env=dict(runtime_env.get("env_vars") or {}),
                     container=container)
-                reply = worker.request(
-                    ("task", digest, func_blob, args_blob, n_returns,
-                     runtime_env, task_token, client_addr, sys_path))
+                msg = ("task", digest, func_blob, args_blob, n_returns,
+                       runtime_env, task_token, client_addr, sys_path)
+                if trace is not None:
+                    msg = msg + (trace,)
+                reply = worker.request(msg)
+                self._copy_reply_stages(reply, stages_out)
                 return self._unpack_reply(reply, return_ids)
             finally:
                 if worker is not None:
@@ -1239,17 +1286,25 @@ class WorkerPool:
         while True:
             worker = self._acquire()
             send_blob = None if digest in worker.known_digests else func_blob
+            msg = ("task", digest, send_blob, args_blob, n_returns,
+                   runtime_env, task_token, client_addr,
+                   sys_path if send_blob is not None else None)
+            if trace is not None:
+                msg = msg + (trace,)
             try:
-                reply = worker.request(
-                    ("task", digest, send_blob, args_blob, n_returns,
-                     runtime_env, task_token, client_addr,
-                     sys_path if send_blob is not None else None))
+                reply = worker.request(msg)
             except _WorkerUnavailable:
                 continue  # _release (in finally) already spawns a live one
             finally:
                 self._release(worker)
             worker.known_digests.add(digest)
+            self._copy_reply_stages(reply, stages_out)
             return self._unpack_reply(reply, return_ids)
+
+    @staticmethod
+    def _copy_reply_stages(reply: tuple, stages_out: dict | None) -> None:
+        if stages_out is not None and len(reply) > 2 and reply[2]:
+            stages_out.update(reply[2])
 
     def _unpack_reply(self, reply: tuple,
                       return_ids: list[ObjectID]) -> list[tuple[ObjectID, Any]]:

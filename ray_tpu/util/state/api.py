@@ -211,8 +211,11 @@ def _cli(argv: list[str]) -> int:
     }
     summaries = {"tasks": summarize_tasks, "actors": summarize_actors,
                  "objects": summarize_objects}
+    if argv and argv[0] == "timeline":
+        return _cli_timeline(argv[1:])
     if len(argv) < 2:
-        print("usage: python -m ray_tpu.util.state {list|summary} <resource>")
+        print("usage: python -m ray_tpu.util.state "
+              "{list|summary} <resource> | timeline [output.json]")
         return 2
     verb, resource = argv[0], argv[1]
     table = listings if verb == "list" else summaries if verb == "summary" else None
@@ -220,4 +223,27 @@ def _cli(argv: list[str]) -> int:
         print(f"unknown: {verb} {resource}; resources: {sorted(table or listings)}")
         return 2
     print(json.dumps(table[resource](), indent=2, default=str))
+    return 0
+
+
+def _cli_timeline(argv: list[str]) -> int:
+    """``ray_tpu timeline [output.json]`` — export the merged chrome
+    trace (reference: `ray timeline`). Connects to a running cluster
+    when one is reachable (pulling the daemons' heartbeat-shipped
+    spans); otherwise exports the local runtime's view. Task events
+    live per driver, so a driver exporting from inside its own script
+    (``tracing.export_chrome_trace``) sees strictly more."""
+    out = argv[0] if argv else "ray_tpu_timeline.json"
+    import ray_tpu
+    from ray_tpu.util import tracing
+
+    if worker_mod.global_runtime() is None:
+        try:
+            ray_tpu.init(address="auto", num_cpus=0,
+                         ignore_reinit_error=True)
+        except (ConnectionError, OSError):
+            ray_tpu.init(ignore_reinit_error=True)
+    n = tracing.export_chrome_trace(out)
+    print(f"wrote {n} events to {out} "
+          f"(open in chrome://tracing or https://ui.perfetto.dev)")
     return 0

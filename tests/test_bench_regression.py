@@ -152,6 +152,26 @@ def test_bench_envelope_parses_with_guarded_phases():
                 f"envelope phase {phase!r} lost metric {metric!r}")
 
 
+def test_bench_envelope_tasks_row_recorded_tracing_disabled():
+    """The guarded drained-tasks envelope row is a TRACING-DISABLED
+    number. bench_envelope.py records the tracing state with the row;
+    a refresh recorded with tracing armed would quietly lower the
+    baseline the ±tolerance guard protects (stage stamps + span
+    buffers are per-task work), so the guard refuses it outright."""
+    if not BENCH_ENVELOPE.exists():
+        pytest.skip("BENCH_ENVELOPE.json not present in the working "
+                    "tree")
+    doc = json.loads(BENCH_ENVELOPE.read_text())
+    tasks_rows = [r for r in doc.get("phases", [])
+                  if r.get("phase") == "tasks"]
+    assert tasks_rows, "envelope lost its tasks phase"
+    for row in tasks_rows:
+        assert row.get("tracing_enabled") is False, (
+            "envelope tasks row was recorded with tracing enabled (or "
+            "predates the flag): rerun bench_envelope.py without "
+            "RAY_TPU_TRACING_ENABLED")
+
+
 def test_bench_core_parses_and_is_nonempty():
     """The committed artifact itself must stay well-formed JSONL with
     the metric/value/unit schema the regression guard reads."""

@@ -1,0 +1,123 @@
+"""Doc-drift guard: the README "Observability" section must document
+every counter the runtime actually exports.
+
+A counter renamed/added in code without a README row silently rots the
+operator docs; this test diffs the real key sets against the text so
+the drift fails the suite instead of a pager rotation.
+"""
+
+from pathlib import Path
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.node_executor import (
+    DATA_PLANE_STAT_KEYS,
+    FAULT_STAT_KEYS,
+    PIPELINE_STAT_KEYS,
+)
+
+README = Path(__file__).resolve().parent.parent / "README.md"
+
+# Metric families the agent can emit; per-node families never show in
+# a local scrape, so they are asserted from this list rather than a
+# live body.
+EXPORTED_SERIES = (
+    "ray_tpu_tasks",
+    "ray_tpu_actors",
+    "ray_tpu_object_store_memory_bytes",
+    "ray_tpu_object_store_num_objects",
+    "ray_tpu_spilled_bytes_total",
+    "ray_tpu_nodes_alive",
+    "ray_tpu_resource_available",
+    "ray_tpu_same_host_copy_hits",
+    "ray_tpu_export_map_leases",
+    "ray_tpu_task_events_dropped_total",
+    "ray_tpu_trace_spans_dropped_total",
+    "ray_tpu_faults_total",
+    "ray_tpu_node_tasks_executed",
+    "ray_tpu_node_running_tasks",
+    "ray_tpu_node_pipeline",
+    "ray_tpu_node_data_plane",
+    "ray_tpu_node_faults",
+)
+
+
+@pytest.fixture(scope="module")
+def observability_text() -> str:
+    text = README.read_text()
+    start = text.find("## Observability")
+    assert start != -1, "README lost its Observability section"
+    end = text.find("\n## ", start + 1)
+    return text[start:end if end != -1 else len(text)]
+
+
+def test_every_executor_stats_counter_documented(observability_text):
+    missing = [key for key in (PIPELINE_STAT_KEYS
+                               + DATA_PLANE_STAT_KEYS
+                               + FAULT_STAT_KEYS)
+               if f"`{key}`" not in observability_text]
+    assert not missing, (
+        f"executor_stats() counter keys missing from the README "
+        f"Observability tables: {missing}")
+
+
+def test_every_driver_stats_counter_documented(observability_text,
+                                               ray_start_regular):
+    runtime = ray_start_regular
+    driver_keys = set(runtime.fault_stats())
+    pipeline = runtime.execution_pipeline_stats()
+    for group, table in pipeline.items():
+        driver_keys.add(group)
+        driver_keys.update(table)
+    missing = [key for key in sorted(driver_keys)
+               if f"`{key}`" not in observability_text]
+    assert not missing, (
+        f"driver fault_stats()/execution_pipeline_stats() keys missing "
+        f"from the README Observability tables: {missing}")
+
+
+def test_every_exported_series_documented(observability_text):
+    missing = [name for name in EXPORTED_SERIES
+               if f"`{name}`" not in observability_text]
+    assert not missing, (
+        f"/metrics series missing from the README metrics table: "
+        f"{missing}")
+
+
+def test_exported_series_list_matches_agent_source():
+    """EXPORTED_SERIES itself must not rot: every family name the
+    metrics agent writes appears in the list, so a new series forces
+    both this list and the README row."""
+    import inspect
+
+    from ray_tpu._private import metrics_agent
+
+    source = inspect.getsource(metrics_agent)
+    import re
+
+    emitted = set(re.findall(r"(ray_tpu_[a-z_]+)", source))
+    # Drop derived suffix forms (e.g. histogram _bucket) — none today.
+    missing = sorted(emitted - set(EXPORTED_SERIES))
+    assert not missing, (
+        f"metrics_agent emits series absent from EXPORTED_SERIES "
+        f"(add README rows too): {missing}")
+
+
+def test_tracing_knobs_documented(observability_text):
+    from ray_tpu._private.config import _DEFAULTS
+
+    knobs = [k for k in _DEFAULTS if k.startswith("tracing_")]
+    assert knobs, "tracing knobs vanished from config"
+    missing = [k for k in knobs if f"`{k}`" not in observability_text]
+    assert not missing, (
+        f"tracing knobs missing from the README knob table: {missing}")
+
+
+def test_readme_stage_list_matches_tracing_stages():
+    from ray_tpu.util import tracing
+
+    text = README.read_text()
+    chain = " → ".join(tracing.STAGES)
+    assert chain in text.replace("\n", " ").replace("  ", " "), (
+        f"README stage chain drifted from tracing.STAGES: {chain}")
