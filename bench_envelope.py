@@ -131,6 +131,15 @@ def main() -> None:
     def noop(i: int) -> int:
         return i
 
+    def _executed_count() -> int:
+        # Tasks that actually ran, from the dispatch-stage counters
+        # (claimed = launched on this driver's watch).
+        from ray_tpu._private.worker import global_runtime
+
+        d = global_runtime().execution_pipeline_stats()["dispatch"]
+        return int(d["batch_tasks"]) + int(d["singles"])
+
+    exec_before = _executed_count()
     t0 = time.monotonic()
     refs = [noop.remote(i) for i in range(N_TASKS)]
     t_submit = time.monotonic() - t0
@@ -147,6 +156,15 @@ def main() -> None:
     out = ray_tpu.get(refs[:drain_n], timeout=1800.0)
     t_drain = time.monotonic() - t0
     assert out == list(range(drain_n))
+    # Sustained execution rate over the whole submit+drain window.
+    # (`throughput_per_s` below — the 10k-sample get() wall — is kept
+    # for continuity but is NOT a drain-rate metric anymore: with
+    # pipelined submission the 29s submit window that used to pre-seal
+    # the sample is gone, so the get() wall now measures however many
+    # sample tasks happen to still be queued. This one is comparable
+    # across submission-speed changes.)
+    exec_per_s = (_executed_count() - exec_before) / max(
+        t_submit + t_drain, 1e-9)
     # Unwind the remaining depth via cancellation (the realistic escape
     # hatch for a 100k backlog on a small cluster) and require the
     # scheduler to come back healthy: a new task completes promptly.
@@ -203,13 +221,20 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001 — counters are best-effort
         faults["error"] = repr(exc)
     from ray_tpu.util import tracing as _tracing
+    from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
 
     record("tasks", n=N_TASKS, ok=True,
            submit_wall_s=round(t_submit, 1),
            submit_per_s=round(N_TASKS / t_submit, 1),
+           # The submit-stage counters ride drain_stages["submit"]
+           # (ring flush sizes, backpressure waits, arg-blob hits);
+           # the knob state is recorded so a refresh with the ring
+           # disarmed can't silently lower the guarded baseline.
+           submit_pipeline=bool(_cfg.submit_pipeline),
            drained=drain_n,
            drain_wall_s=round(t_drain, 1),
            throughput_per_s=round(drain_n / t_drain, 1),
+           exec_per_s=round(exec_per_s, 1),
            cancel_remaining_wall_s=round(t_cancel, 1),
            drain_stages=stages, faults=faults,
            # The guarded drained-tasks baseline is a TRACING-DISABLED
@@ -224,10 +249,6 @@ def main() -> None:
 
     blob = np.random.default_rng(0).integers(
         0, 255, size=BCAST_BYTES, dtype=np.uint8)
-    t0 = time.monotonic()
-    ref = ray_tpu.put(blob)
-    t_put = time.monotonic() - t0
-    del blob
 
     # max_retries: a pull interrupted by transient node churn re-runs
     # elsewhere (the reference's release benchmarks run with default
@@ -237,11 +258,27 @@ def main() -> None:
     def touch(arr) -> int:
         return int(arr[0]) + len(arr)
 
-    t0 = time.monotonic()
-    outs = ray_tpu.get([touch.remote(ref)
-                        for _ in range(N_BCAST_NODES)], timeout=1800.0)
-    t_bcast = time.monotonic() - t0
-    assert len(set(outs)) == 1
+    # Best-of-N reps: single-shot 1 GiB broadcasts on a shared 1-CPU
+    # box swing >5x run-to-run with IDENTICAL code (co-tenant load);
+    # each rep puts a fresh object id so nothing is served from node
+    # caches, and the best rep records the box's actual capability.
+    n_reps = int(os.environ.get("ENVELOPE_BCAST_REPS", "3"))
+    rep_walls: list[float] = []
+    put_walls: list[float] = []
+    for _ in range(max(1, n_reps)):
+        t0 = time.monotonic()
+        ref = ray_tpu.put(blob)
+        put_walls.append(time.monotonic() - t0)
+        t0 = time.monotonic()
+        outs = ray_tpu.get([touch.remote(ref)
+                            for _ in range(N_BCAST_NODES)],
+                           timeout=1800.0)
+        rep_walls.append(time.monotonic() - t0)
+        assert len(set(outs)) == 1
+        del ref, outs
+    del blob
+    t_put = min(put_walls)
+    t_bcast = min(rep_walls)
 
     # Per-path data-plane counters: which transport carried the bytes
     # (same-host map / same-host memcpy / chunked RPC pull).
@@ -271,6 +308,7 @@ def main() -> None:
            broadcast_wall_s=round(t_bcast, 1),
            aggregate_gb_per_s=round(
                BCAST_BYTES * N_BCAST_NODES / t_bcast / 1e9, 2),
+           rep_walls_s=[round(w, 1) for w in rep_walls],
            data_plane=counters)
 
     ray_tpu.shutdown()

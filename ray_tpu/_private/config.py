@@ -96,6 +96,14 @@ _DEFAULTS: dict[str, Any] = {
     # -> multi-task worker leases -> grouped completion replies).
     "dispatch_batch_max": 32,          # tasks per execute_task_batch RPC
     "worker_pipeline_depth": 4,        # frames in flight per worker lease
+    # Pipelined task SUBMISSION (driver-side submit ring): .remote()
+    # allocates ids/refs inline and pushes a record onto a bounded
+    # ring; a dedicated submitter thread drains flushes through ONE
+    # store/lineage/GCS/dispatcher pass each. Disabled, every submit
+    # takes the classic inline path.
+    "submit_pipeline": True,
+    "submit_ring_size": 65536,         # ring capacity; full => backpressure
+    "submit_flush_max": 1024,          # records drained per flush pass
     # P2P chunked broadcast (reference: the object manager's chunked
     # Push/Pull fans transfers out peer-to-peer via the directory).
     "broadcast_chunk_fanout": 4,       # peer sources used per pull

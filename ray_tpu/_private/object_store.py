@@ -114,6 +114,16 @@ class ObjectStore:
             if object_id not in self._entries:
                 self._entries[object_id] = ObjectEntry(object_id)
 
+    def create_pending_batch(self, object_ids) -> None:
+        """Register a whole submit flush's return objects under ONE
+        lock pass (the pipelined submit path's analogue of
+        ``put_batch`` on the seal side)."""
+        with self._lock:
+            entries = self._entries
+            for object_id in object_ids:
+                if object_id not in entries:
+                    entries[object_id] = ObjectEntry(object_id)
+
     def put(self, object_id: ObjectID, value: Any) -> None:
         self._seal(object_id, value=value, error=None)
 

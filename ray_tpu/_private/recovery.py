@@ -50,6 +50,20 @@ class LineageTable:
                 # lineage eviction under RAY_max_lineage_bytes).
                 self._by_object.popitem(last=False)
 
+    def record_many(self, specs) -> None:
+        """One lock pass for a whole submit flush (the pipelined
+        submit path amortizes the per-task acquire)."""
+        with self._lock:
+            by_object = self._by_object
+            for spec in specs:
+                for rid in spec.return_ids:
+                    if rid in by_object:
+                        # Re-record (retry/recovery): refresh recency.
+                        by_object.move_to_end(rid)
+                    by_object[rid] = spec
+            while len(by_object) > self._max_entries:
+                by_object.popitem(last=False)
+
     def lookup(self, object_id: ObjectID) -> TaskSpec | None:
         with self._lock:
             return self._by_object.get(object_id)

@@ -24,17 +24,18 @@ their CPU to the raylet), so nested task graphs cannot starve.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import traceback
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
-from ray_tpu._private.ids import NodeID, _Counter
+from ray_tpu._private.ids import NodeID
 from ray_tpu._private.task import TaskSpec
 from ray_tpu.util import tracing
 
-_DISPATCH_ORDER = _Counter()
+_DISPATCH_ORDER = itertools.count(1).__next__
 
 # How long a node's self-reported availability stays authoritative.
 # Push deltas only fire on change, so a lost delta would otherwise pin
@@ -273,11 +274,15 @@ class ClusterState:
             self._lock.notify_all()
 
 
-@dataclass
+@dataclass(eq=False)
 class _QueuedTask:
+    # eq=False: tasks hash/compare by IDENTITY so the waiting set and
+    # the per-dep wakeup index get O(1) membership ops. The order
+    # counter is an itertools.count __next__ (GIL-atomic) — the old
+    # locked counter was a per-task acquire on the submit flush path.
     spec: TaskSpec
     run: Callable[[TaskSpec, NodeState], None]
-    order: int = field(default_factory=_DISPATCH_ORDER.next)
+    order: int = field(default_factory=_DISPATCH_ORDER)
     unresolved_deps: int = 0
     # Lifecycle flags (mutated under the dispatcher lock). Cancelled and
     # claimed entries are purged LAZILY at the next dispatch pass: a
@@ -303,7 +308,17 @@ class Dispatcher:
         self._cluster = cluster
         self._store = store
         self._lock = threading.Condition(threading.Lock())
-        self._waiting: list[_QueuedTask] = []  # deps not ready
+        # Dep-gated tasks, indexed BY DEPENDENCY ID: a seal group
+        # touches only its dependents (O(deps sealed)), never the whole
+        # waiting population — with 100k buffered submits parked in
+        # _waiting, the old per-seal full rescan was O(seals x waiting).
+        self._waiting: set[_QueuedTask] = set()
+        self._dep_index: dict = {}  # dep ObjectID -> set[_QueuedTask]
+        # True while the dispatch loop is parked in a cond-wait; wakeups
+        # (submission, seals) only notify then — an active dispatch pass
+        # re-checks _have_ready() itself, so notifying it is pure
+        # syscall/contention overhead at high submit rates.
+        self._parked = False
         # Ready tasks grouped BY ADMISSION SIGNATURE (resources +
         # strategy): one admission probe answers for a group's whole
         # FIFO, so a dispatch pass costs O(launched + groups), not
@@ -379,40 +394,76 @@ class Dispatcher:
 
     def submit(self, spec: TaskSpec, run: Callable[[TaskSpec, NodeState], None],
                deps: list) -> None:
-        task = _QueuedTask(spec=spec, run=run)
-        # The contains() checks must happen under self._lock: _on_object_sealed
-        # also takes it, so a dep sealing concurrently either shows up in
-        # contains() here or finds the task already appended to _waiting.
+        self.submit_many(((spec, run, deps),))
+
+    def submit_many(self, items) -> None:
+        """Enqueue a whole submit flush under ONE lock acquire with at
+        most one wakeup: ``items`` is an iterable of (spec, run, deps).
+        The contains() checks must happen under self._lock:
+        _on_objects_sealed also takes it, so a dep sealing concurrently
+        either shows up in contains() here or finds the task already
+        indexed under that dep."""
+        sig_memo: dict = {}
         with self._lock:
-            pending_deps = [d for d in deps if not self._store.contains(d.id())]
-            task.unresolved_deps = len(pending_deps)
-            if task.unresolved_deps == 0:
-                self._enqueue_ready(task)
-            else:
-                task._dep_ids = {d.id() for d in pending_deps}
-                self._waiting.append(task)
-            for rid in task.spec.return_ids:
-                self._by_return_id[rid] = task
-            self._lock.notify_all()
+            for spec, run, deps in items:
+                task = _QueuedTask(spec=spec, run=run)
+                if deps:
+                    pending = {d.id() for d in deps
+                               if not self._store.contains(d.id())}
+                else:
+                    pending = None  # dep-free: skip the set build
+                task.unresolved_deps = len(pending) if pending else 0
+                if task.unresolved_deps == 0:
+                    if getattr(spec, "_avoid_nodes", None):
+                        self._num_ready_live += 1
+                        self._ready_odd.append(task)
+                    else:
+                        # One _sig per distinct (resources, strategy)
+                        # object pair per flush: a burst from one
+                        # RemoteFunction shares both, so the sorted-
+                        # tuple build is paid once, not per task. id()
+                        # keys are safe within this call — the specs
+                        # keep the objects alive.
+                        key = (id(spec.resources),
+                               id(spec.scheduling_strategy))
+                        sig = sig_memo.get(key)
+                        if sig is None:
+                            sig = sig_memo[key] = self._sig(spec)
+                        self._num_ready_live += 1
+                        self._ready_groups.setdefault(
+                            sig, self._collections.deque()).append(task)
+                else:
+                    task._dep_ids = pending
+                    self._waiting.add(task)
+                    for dep_id in pending:
+                        self._dep_index.setdefault(dep_id, set()).add(task)
+                for rid in task.spec.return_ids:
+                    self._by_return_id[rid] = task
+            if self._parked:
+                self._lock.notify_all()
 
     def _on_object_sealed(self, object_id) -> None:
         self._on_objects_sealed((object_id,))
 
     def _on_objects_sealed(self, object_ids) -> None:
-        sealed = set(object_ids)
         with self._lock:
-            still_waiting = []
-            for task in self._waiting:
-                dep_ids = getattr(task, "_dep_ids", set())
-                if dep_ids & sealed:
-                    dep_ids -= sealed
+            woke = False
+            for object_id in object_ids:
+                dependents = self._dep_index.pop(object_id, None)
+                if not dependents:
+                    continue
+                for task in dependents:
+                    if task.cancelled:
+                        continue
+                    dep_ids = task._dep_ids
+                    dep_ids.discard(object_id)
                     task.unresolved_deps = len(dep_ids)
-                if task.unresolved_deps == 0:
-                    self._enqueue_ready(task)
-                else:
-                    still_waiting.append(task)
-            self._waiting = still_waiting
-            self._lock.notify_all()
+                    if task.unresolved_deps == 0:
+                        self._waiting.discard(task)
+                        self._enqueue_ready(task)
+                        woke = True
+            if woke and self._parked:
+                self._lock.notify_all()
 
     # -------------------------------------------------------------- dispatch
 
@@ -420,7 +471,11 @@ class Dispatcher:
         while True:
             with self._lock:
                 while not self._have_ready() and not self._shutdown:
-                    self._lock.wait(timeout=0.2)
+                    self._parked = True
+                    try:
+                        self._lock.wait(timeout=0.2)
+                    finally:
+                        self._parked = False
                 if self._shutdown:
                     return
             # Tasks claimed for the same batch key (one remote node)
@@ -482,13 +537,31 @@ class Dispatcher:
                         if not dq]:
                 del self._ready_groups[sig]
         for sig, dq in groups:
+            sticky: NodeState | None = None
             while True:
                 task = self._pop_next(dq)
                 if task is None:
                     break
-                node = self._try_admit(task)
+                # Sticky fast path: a run of same-signature tasks
+                # re-acquires the last admitted node with one ledger op
+                # while it still fits, instead of a full O(nodes)
+                # pick_node scan per task (the dominant dispatch cost
+                # at 100k-submit bursts). Falls back to the policy scan
+                # the moment the node rejects; DEFAULT-policy intent is
+                # preserved (hybrid packs below the spread threshold —
+                # reference: hybrid_scheduling_policy.cc).
+                node = None
+                strategy = task.spec.scheduling_strategy
+                if sticky is not None and (
+                        strategy is None or strategy.kind == "DEFAULT") \
+                        and self._cluster.try_acquire(
+                            sticky.node_id, task.spec.resources):
+                    node = sticky
                 if node is None:
-                    break  # signature saturated for this pass
+                    node = self._try_admit(task)
+                    if node is None:
+                        break  # signature saturated for this pass
+                    sticky = node
                 with self._lock:
                     if dq and dq[0] is task:
                         dq.popleft()
@@ -592,7 +665,10 @@ class Dispatcher:
             self._cluster.release(node.node_id, task.spec.resources)
             with self._lock:
                 self._num_running -= 1
-                self._lock.notify_all()
+                if self._parked:
+                    # wait_idle() pollers re-check on their own 0.1s
+                    # beat; only a parked dispatch loop needs the kick.
+                    self._lock.notify_all()
 
         def runner() -> None:
             try:
@@ -638,7 +714,8 @@ class Dispatcher:
                 self._cluster.release(node.node_id, task.spec.resources)
                 with self._lock:
                     self._num_running -= 1
-                    self._lock.notify_all()
+                    if self._parked:
+                        self._lock.notify_all()
 
         self.singles_launched += 1
         # Thread-per-task, deliberately (for local dispatch and
@@ -690,7 +767,7 @@ class Dispatcher:
         to the GCS for the autoscaler)."""
         with self._lock:
             return [dict(t.spec.resources)
-                    for t in self._ready_tasks() + self._waiting
+                    for t in self._ready_tasks() + list(self._waiting)
                     if t.spec.resources
                     and not (t.claimed or t.cancelled)]
 
@@ -726,14 +803,21 @@ class Dispatcher:
                 # It sat in a ready queue: keep the live count honest
                 # (the zombie entry is purged lazily by dispatch).
                 self._num_ready_live -= 1
-            if task.unresolved_deps:
-                # Waiting tasks are few (deps gate them); eager removal
-                # keeps _on_object_sealed's scan honest.
-                try:
-                    self._waiting.remove(task)
-                except ValueError:
-                    pass
+            else:
+                self._drop_waiting(task)
             return task.spec
+
+    def _drop_waiting(self, task: _QueuedTask) -> None:
+        # Caller holds self._lock. Remove a dep-gated task from the
+        # waiting set AND its dep-index entries (else a cancelled task
+        # whose deps never seal would pin the index entry forever).
+        self._waiting.discard(task)
+        for dep_id in getattr(task, "_dep_ids", ()):
+            dependents = self._dep_index.get(dep_id)
+            if dependents is not None:
+                dependents.discard(task)
+                if not dependents:
+                    del self._dep_index[dep_id]
 
     def fail_hard_affinity(self, node_id_hex: str) -> "list[TaskSpec]":
         """Pop every queued task HARD-pinned to a node that just died.
@@ -764,10 +848,7 @@ class Dispatcher:
                 if not task.unresolved_deps:
                     self._num_ready_live -= 1
                 else:
-                    try:
-                        self._waiting.remove(task)
-                    except ValueError:
-                        pass
+                    self._drop_waiting(task)
                 failed.append(task.spec)
         return failed
 

@@ -59,6 +59,26 @@ class RemoteFunction:
         bad = set(self._default_options) - _VALID_OPTIONS
         if bad:
             raise ValueError(f"Invalid @remote options: {sorted(bad)}")
+        # The per-call submit arguments are pure functions of the
+        # options, which are frozen per RemoteFunction instance
+        # (.options() builds a NEW instance) — precompute them once so
+        # a 100k-submit burst doesn't re-derive resources/strategy/name
+        # per call. The strategy object is shared across calls: specs
+        # only ever read it.
+        opts = self._default_options
+        self._call_kwargs = dict(
+            name=opts.get("name") or func.__qualname__,
+            num_returns=opts.get("num_returns", 1),
+            resources=normalize_resources(
+                opts.get("num_cpus"),
+                opts.get("num_tpus") or opts.get("num_gpus"),
+                opts.get("resources"),
+            ),
+            max_retries=opts.get("max_retries", 0),
+            retry_exceptions=opts.get("retry_exceptions", False),
+            scheduling_strategy=_build_strategy(opts),
+            runtime_env=opts.get("runtime_env"),
+        )
         functools.update_wrapper(self, func)
 
     def __call__(self, *args, **kwargs):
@@ -80,24 +100,10 @@ class RemoteFunction:
 
     def remote(self, *args, **kwargs):
         runtime = worker_mod.auto_init()
-        opts = self._default_options
-        resources = normalize_resources(
-            opts.get("num_cpus"),
-            opts.get("num_tpus") or opts.get("num_gpus"),
-            opts.get("resources"),
-        )
-        num_returns = opts.get("num_returns", 1)
-        refs = runtime.submit_task(
-            self._function, args, kwargs,
-            name=opts.get("name") or self._function.__qualname__,
-            num_returns=num_returns,
-            resources=resources,
-            max_retries=opts.get("max_retries", 0),
-            retry_exceptions=opts.get("retry_exceptions", False),
-            scheduling_strategy=_build_strategy(opts),
-            runtime_env=opts.get("runtime_env"),
-        )
-        if num_returns == 1:
+        call_kwargs = self._call_kwargs
+        refs = runtime.submit_task(self._function, args, kwargs,
+                                   **call_kwargs)
+        if call_kwargs["num_returns"] == 1:
             return refs[0]
         return refs
 

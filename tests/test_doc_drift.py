@@ -114,6 +114,33 @@ def test_tracing_knobs_documented(observability_text):
         f"tracing knobs missing from the README knob table: {missing}")
 
 
+def test_submit_pipeline_knobs_documented():
+    """The submit-ring knobs must keep their README rows (the
+    'Pipelined submission' knob table)."""
+    from ray_tpu._private.config import _DEFAULTS
+
+    knobs = [k for k in _DEFAULTS if k.startswith("submit_")]
+    assert knobs, "submit-pipeline knobs vanished from config"
+    text = README.read_text()
+    missing = [k for k in knobs if f"`{k}`" not in text]
+    assert not missing, (
+        f"submit-pipeline knobs missing from the README knob table: "
+        f"{missing}")
+
+
+def test_submit_stage_counter_keys_documented(observability_text):
+    """The submit-stage counter keys are asserted statically (the
+    dynamic driver-stats test only sees them while the ring is armed):
+    dropping one from execution_pipeline_stats()["submit"] or from the
+    README must fail here."""
+    keys = ("submit", "ring_submits", "flushes", "flush_tasks",
+            "ring_full_waits", "buffered_cancels", "arg_cache_hits")
+    missing = [k for k in keys if f"`{k}`" not in observability_text]
+    assert not missing, (
+        f"submit-stage counter keys missing from the README "
+        f"Observability tables: {missing}")
+
+
 def test_readme_stage_list_matches_tracing_stages():
     from ray_tpu.util import tracing
 

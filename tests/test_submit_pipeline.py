@@ -1,0 +1,331 @@
+"""Pipelined task submission: the driver-side submit ring.
+
+Covers the submit half of the pipeline the way test_task_pipeline.py
+covers the execute half: ref identity/result correctness across a deep
+ring burst, cancellation racing a still-buffered submit, daemon death
+with queued submits (no loss, no double-execute), ring-overflow
+backpressure, placement-group submits routed through the ring, and
+byte-for-byte fallback equivalence with ``submit_pipeline=0``.
+"""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.exceptions import TaskCancelledError
+from ray_tpu.util import tracing
+
+
+def _wait_for(cond, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# ------------------------------------------------------------ correctness
+
+
+def test_ring_submits_preserve_ref_identity_and_results(
+        ray_start_regular):
+    """10k submits ride the ring; every ref must resolve to ITS OWN
+    task's value, and the flush counters must show real coalescing
+    (many records per store/lineage/GCS/dispatcher pass)."""
+    runtime = ray_start_regular
+    assert runtime._submit_ring is not None, \
+        "submit pipeline should be armed by default"
+
+    @ray_tpu.remote
+    def ident(i):
+        return i * 3
+
+    before = runtime.execution_pipeline_stats()["submit"]
+    refs = [ident.remote(i) for i in range(10_000)]
+    assert len({r.id() for r in refs}) == 10_000, "return ids collided"
+    out = ray_tpu.get(refs, timeout=300.0)
+    assert out == [i * 3 for i in range(10_000)]
+    after = runtime.execution_pipeline_stats()["submit"]
+    submits = after["ring_submits"] - before["ring_submits"]
+    flushes = after["flushes"] - before["flushes"]
+    assert submits >= 10_000
+    assert 0 < flushes < submits, \
+        f"no coalescing: {flushes} flushes for {submits} submits"
+
+
+def test_dependencies_across_buffered_submits(ray_start_regular):
+    """A chain submitted faster than the ring drains still gates on
+    its deps: each link waits for the previous link's seal."""
+
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    ref = ray_tpu.put(0)
+    for _ in range(50):
+        ref = inc.remote(ref)
+    assert ray_tpu.get(ref, timeout=120.0) == 50
+
+
+# ----------------------------------------------------------- cancellation
+
+
+def test_cancel_races_buffered_submit(ray_start_regular):
+    """Cancelling a ref whose record is still BUFFERED (drain held by
+    the test gate) must seal TaskCancelledError and the task must
+    never run."""
+    runtime = ray_start_regular
+    ring = runtime._submit_ring
+    hits = []
+
+    @ray_tpu.remote
+    def tracked(i):
+        hits.append(i)
+        return i
+
+    ring._gate.clear()
+    try:
+        victim = tracked.remote(99)
+        survivor = tracked.remote(1)
+        before = ring.buffered_cancels
+        ray_tpu.cancel(victim)
+        assert ring.buffered_cancels == before + 1
+        # The error is sealed immediately — no flush needed.
+        with pytest.raises(TaskCancelledError):
+            ray_tpu.get(victim, timeout=5.0)
+    finally:
+        ring._gate.set()
+    assert ray_tpu.get(survivor, timeout=60.0) == 1
+    time.sleep(0.2)
+    assert hits == [1], f"cancelled buffered task ran: {hits}"
+
+
+# ----------------------------------------------------------- backpressure
+
+
+def test_ring_overflow_backpressures_submitter(monkeypatch):
+    """A full ring blocks .remote() (bounded memory, no loss) until
+    the drain frees slots."""
+    import threading
+
+    monkeypatch.setenv("RAY_TPU_SUBMIT_RING_SIZE", "32")
+    monkeypatch.setenv("RAY_TPU_SUBMIT_FLUSH_MAX", "8")
+    GLOBAL_CONFIG.reset()
+    ray_tpu.shutdown()
+    try:
+        runtime = ray_tpu.init(num_cpus=8)
+        ring = runtime._submit_ring
+        assert ring._capacity == 32
+
+        @ray_tpu.remote
+        def ident(i):
+            return i
+
+        ring._gate.clear()
+        refs = [ident.remote(i) for i in range(32)]  # fills the ring
+        done = threading.Event()
+        overflow_refs = []
+
+        def push_one_more():
+            overflow_refs.append(ident.remote(32))
+            done.set()
+
+        t = threading.Thread(target=push_one_more, daemon=True)
+        t.start()
+        # The 33rd submit must be blocked, not dropped or raised.
+        assert not done.wait(1.0), "overflow submit did not backpressure"
+        ring._gate.set()
+        assert done.wait(30.0), "backpressured submit never completed"
+        assert ring.ring_full_waits >= 1
+        out = ray_tpu.get(refs + overflow_refs, timeout=120.0)
+        assert out == list(range(33))
+    finally:
+        ray_tpu.shutdown()
+        GLOBAL_CONFIG.reset()
+
+
+# ------------------------------------------------------- placement groups
+
+
+def test_pg_submits_route_through_ring(ray_start_regular):
+    """Placement-group tasks ride the same ring: refs come back
+    synchronously, the flush routes them through the bundle ledger."""
+    from ray_tpu.util.placement_group import (
+        placement_group,
+        remove_placement_group,
+    )
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy,
+    )
+
+    runtime = ray_start_regular
+    pg = placement_group([{"CPU": 2}], strategy="PACK")
+    assert pg.wait(60.0)
+
+    @ray_tpu.remote(num_cpus=1)
+    def where(i):
+        return i
+
+    before = runtime.execution_pipeline_stats()["submit"]["ring_submits"]
+    strategy = PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=0)
+    refs = [where.options(scheduling_strategy=strategy).remote(i)
+            for i in range(8)]
+    assert ray_tpu.get(refs, timeout=120.0) == list(range(8))
+    after = runtime.execution_pipeline_stats()["submit"]["ring_submits"]
+    assert after - before >= 8, "PG submits bypassed the ring"
+    remove_placement_group(pg)
+
+
+def test_pg_task_keeps_trace_context_and_stage_stamps():
+    """Regression (the PG bypass built a shadow TaskSpec that dropped
+    _trace_ctx and the dispatch stamp): a traced placement-group task
+    must record submit AND dispatch stages — it may not vanish from
+    merged traces."""
+    from ray_tpu.util.placement_group import placement_group
+    from ray_tpu.util.scheduling_strategies import (
+        PlacementGroupSchedulingStrategy,
+    )
+
+    tracing.clear()
+    tracing.enable()
+    ray_tpu.shutdown()
+    try:
+        runtime = ray_tpu.init(num_cpus=8, ignore_reinit_error=True)
+        pg = placement_group([{"CPU": 2}], strategy="PACK")
+        assert pg.wait(60.0)
+
+        @ray_tpu.remote(num_cpus=1)
+        def traced_task():
+            return "ok"
+
+        strategy = PlacementGroupSchedulingStrategy(
+            placement_group=pg, placement_group_bundle_index=0)
+        ref = traced_task.options(
+            scheduling_strategy=strategy).remote()
+        assert ray_tpu.get(ref, timeout=60.0) == "ok"
+        events = [e for e in runtime.gcs.list_task_events()
+                  if e.name.endswith("traced_task")]
+        assert events, "PG task left no task event"
+        stages = events[-1].stage_ts
+        assert "submit" in stages, f"submit stage lost: {stages}"
+        _wait_for(lambda: "dispatch" in events[-1].stage_ts, 10,
+                  "dispatch stage stamp")
+        assert stages["submit"] <= events[-1].stage_ts["dispatch"]
+    finally:
+        ray_tpu.shutdown()
+        tracing.disable()
+        tracing.clear()
+
+
+# ------------------------------------------------------------------ chaos
+
+
+def test_daemon_death_with_queued_submits_no_loss_no_double_run(
+        tmp_path):
+    """SIGKILL the only daemon while submits are still buffered in the
+    ring: every task completes exactly once on the replacement node —
+    queued (never-started) submits are neither lost nor re-executed."""
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir=str(tmp_path / "cluster"))
+    cluster.add_node(num_cpus=4, resources={"vic": 100.0}, pool_size=1,
+                     heartbeat_period_s=0.5)
+    runtime = None
+    try:
+        assert cluster.wait_for_nodes(1, timeout=60)
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        _wait_for(lambda: ray_tpu.cluster_resources().get("vic", 0) > 0,
+                  30, "victim node to join the driver view")
+        victim_daemon = next(h for h in cluster._nodes if h.alive())
+
+        marker_dir = tmp_path / "markers"
+        marker_dir.mkdir()
+
+        @ray_tpu.remote(num_cpus=1, resources={"vic": 1.0},
+                        max_retries=3)
+        def run_once(i, mdir):
+            import os as _os
+
+            with open(f"{mdir}/ran-{i}-{_os.getpid()}", "w"):
+                pass
+            return i
+
+        ring = runtime._submit_ring
+        ring._gate.clear()  # hold the drain: submits stay buffered
+        refs = [run_once.remote(i, str(marker_dir)) for i in range(12)]
+        assert ring.depth() == 12
+        os.kill(victim_daemon.pid, signal.SIGKILL)
+        cluster.add_node(num_cpus=4, resources={"vic": 100.0},
+                         pool_size=1, heartbeat_period_s=0.5)
+        ring._gate.set()
+
+        results = ray_tpu.get(refs, timeout=180)
+        assert sorted(results) == list(range(12)), \
+            "queued submits were lost through the daemon death"
+        # None of these tasks had started before the kill, so each may
+        # have executed exactly once.
+        for i in range(12):
+            runs = [f for f in os.listdir(marker_dir)
+                    if f.startswith(f"ran-{i}-")]
+            assert len(runs) == 1, \
+                f"task {i} ran {len(runs)} times: {runs}"
+    finally:
+        if runtime is not None:
+            ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+# --------------------------------------------------------------- fallback
+
+
+def test_submit_pipeline_disabled_fallback_equivalence(monkeypatch):
+    """submit_pipeline=0: the classic inline path serves everything —
+    same results, same cancel semantics, zero ring activity."""
+    monkeypatch.setenv("RAY_TPU_SUBMIT_PIPELINE", "0")
+    GLOBAL_CONFIG.reset()
+    ray_tpu.shutdown()
+    try:
+        runtime = ray_tpu.init(num_cpus=8)
+        assert runtime._submit_ring is None
+
+        @ray_tpu.remote
+        def ident(i):
+            return i * 3
+
+        refs = [ident.remote(i) for i in range(500)]
+        assert ray_tpu.get(refs, timeout=120.0) == \
+            [i * 3 for i in range(500)]
+        stats = runtime.execution_pipeline_stats()["submit"]
+        assert stats["ring_submits"] == 0 and stats["flushes"] == 0
+
+        # Dependencies and cancel take the same shapes as the ring path.
+        @ray_tpu.remote
+        def add(a, b):
+            return a + b
+
+        assert ray_tpu.get(add.remote(ray_tpu.put(1), 2),
+                           timeout=60.0) == 3
+
+        @ray_tpu.remote(num_cpus=8)
+        def hog():
+            time.sleep(1.0)
+
+        @ray_tpu.remote(num_cpus=8)
+        def queued():
+            return "ran"
+
+        blocker = hog.remote()
+        tail = queued.remote()
+        ray_tpu.cancel(tail)
+        with pytest.raises(TaskCancelledError):
+            ray_tpu.get(tail, timeout=60.0)
+        ray_tpu.get(blocker, timeout=60.0)
+    finally:
+        ray_tpu.shutdown()
+        GLOBAL_CONFIG.reset()
