@@ -19,6 +19,7 @@ import asyncio
 import inspect
 import queue
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Callable
 
@@ -43,6 +44,22 @@ class _ActorCall:
     kwargs: dict
     return_ids: list[ObjectID]
     cancelled: bool = False
+    # Absolute end-to-end deadline (time.time()); checked before the
+    # method runs so a call whose budget died queued behind earlier
+    # calls seals TaskTimeoutError instead of executing.
+    deadline: "float | None" = None
+
+
+def _call_deadline_error(call: _ActorCall, cls_name: str):
+    """TaskTimeoutError for an actor call whose budget died queued
+    (None while the deadline is still live) — shared by every actor
+    executor (LocalActor / ProcessActor / RemoteActor)."""
+    if call.deadline is None or time.time() <= call.deadline:
+        return None
+    from ray_tpu.exceptions import TaskTimeoutError
+
+    return TaskTimeoutError(f"{cls_name}.{call.method_name}",
+                            "actor_queue", call.deadline)
 
 
 class LocalActor:
@@ -188,6 +205,10 @@ class LocalActor:
         if call.cancelled:
             self._fail_call(call, TaskCancelledError())
             return
+        expired = _call_deadline_error(call, self._cls.__name__)
+        if expired is not None:
+            self._fail_call(call, expired)
+            return
         try:
             method = getattr(self._instance, call.method_name)
             result = method(*call.args, **call.kwargs)
@@ -205,6 +226,10 @@ class LocalActor:
             self._pending -= 1
         if call.cancelled:
             self._fail_call(call, TaskCancelledError())
+            return
+        expired = _call_deadline_error(call, self._cls.__name__)
+        if expired is not None:
+            self._fail_call(call, expired)
             return
         try:
             method = getattr(self._instance, call.method_name)

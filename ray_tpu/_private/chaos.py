@@ -28,6 +28,10 @@ Injection sites (the site string is the contract; counters surface in
 - ``heartbeat.skip``  node agent: skip one heartbeat period
 - ``daemon.die``      node agent: SIGKILL its own daemon process
 - ``lease.expire``    same-host LeaseTable: expire a lease early
+- ``overload.saturate`` daemon admission: shed the lease/batch as
+  ``("overloaded", ...)`` — the driver fails deadline-armed tasks fast
+  with SystemOverloadedError and spillback-requeues the rest (one draw
+  per execute RPC / batch, node_executor._overload_reason)
 """
 
 from __future__ import annotations

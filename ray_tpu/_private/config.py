@@ -74,6 +74,25 @@ _DEFAULTS: dict[str, Any] = {
     # Actor scheduling (reference: actor creation/restart timeouts).
     "actor_lease_timeout_s": 300.0,
     "actor_restart_relocate_timeout_s": 120.0,
+    # End-to-end deadlines (overload-control plane). A task submitted
+    # without an explicit ``_deadline_s`` inherits this budget; 0
+    # disables. The absolute deadline is stamped on the TaskSpec and
+    # checked at every pipeline stage (ring flush, dispatcher claim,
+    # daemon admission, worker frame pickup) — expired work seals
+    # TaskTimeoutError instead of executing.
+    "task_default_deadline_s": 0.0,
+    # Admission control / load shedding. Queue-depth cap on the
+    # driver's dispatcher (waiting + ready + running); over it, the
+    # submit ring blocks deadline-free flushes (bounded backpressure)
+    # and sheds deadline-armed submits with SystemOverloadedError.
+    # Daemons apply the same cap to their admitted-reservation count.
+    # 0 = unlimited.
+    "admission_max_queue_depth": 0,
+    # Host-memory fraction above which admission sheds instead of
+    # queueing (fed by memory_monitor's /proc/meminfo reader, checked
+    # with a short memo so the hot path never re-reads per task).
+    # 0 disables.
+    "admission_memory_watermark": 0.0,
     # RPC plane.
     "rpc_io_pool_workers": 16,         # pooled short-call dispatch
     # Shared retry/backoff/deadline policy for IDEMPOTENT control-plane
@@ -83,6 +102,16 @@ _DEFAULTS: dict[str, Any] = {
     "rpc_retry_attempts": 3,
     "rpc_retry_base_ms": 50,           # exponential backoff base
     "rpc_retry_deadline_s": 15.0,      # overall per-call retry budget
+    # Per-destination circuit breaker riding the same retry policy: a
+    # destination failing this many CONSECUTIVE logical calls (each
+    # call_with_retry invocation counts at most once, however many
+    # attempts it burned) opens its breaker — further calls fail fast
+    # with a retryable RpcError instead of eating whole retry budgets
+    # against a sick node. After rpc_breaker_reset_s one half-open
+    # probe is let through; success closes the breaker, failure
+    # re-opens it. rpc_breaker_failures=0 disables.
+    "rpc_breaker_failures": 5,
+    "rpc_breaker_reset_s": 5.0,
     # Deterministic fault injection (chaos.py); "" disables — every
     # injection site then costs one module-attribute branch. Spec:
     # "seed=42,rpc.sever=0.1,rpc.drop_frame=0.05x3,...".

@@ -202,6 +202,10 @@ class ActorRecord:
     pid: int | None = None
     # Per-method defaults declared via @ray_tpu.method (e.g. num_returns).
     method_meta: dict = field(default_factory=dict)
+    # Default end-to-end budget (seconds) every method call of this
+    # actor inherits (@remote(_deadline_s=...)); 0 = none. Per-call
+    # .options(_deadline_s=...) overrides.
+    default_deadline_s: float = 0.0
 
 
 @dataclass

@@ -33,6 +33,45 @@ def host_memory_usage_fraction() -> float:
         return 0.0
 
 
+# Admission-watermark memo: the overload-control plane asks "is host
+# memory above the watermark?" on every admission decision; re-reading
+# /proc/meminfo per task would dominate small-task admission, so the
+# fraction is sampled at most once per _WATERMARK_TTL_S. Tests inject
+# a fake reading via _set_usage_override.
+_WATERMARK_TTL_S = 0.2
+_watermark_lock = threading.Lock()
+_watermark_sample = (0.0, -1e9)  # (fraction, sampled_at monotonic)
+_usage_override: float | None = None
+
+
+def _set_usage_override(fraction: "float | None") -> None:
+    """Test seam: pin the memory-usage fraction (None restores the
+    real /proc/meminfo reader) and invalidate the memo."""
+    global _usage_override, _watermark_sample
+    with _watermark_lock:
+        _usage_override = fraction
+        _watermark_sample = (0.0, -1e9)
+
+
+def memory_watermark_exceeded(watermark: float) -> bool:
+    """True when host memory usage is at/above ``watermark`` (a
+    fraction; <= 0 disables). Memoized for _WATERMARK_TTL_S."""
+    if watermark <= 0.0:
+        return False
+    import time
+
+    global _watermark_sample
+    now = time.monotonic()
+    with _watermark_lock:
+        frac, at = _watermark_sample
+        if now - at <= _WATERMARK_TTL_S:
+            return frac >= watermark
+        frac = (_usage_override if _usage_override is not None
+                else host_memory_usage_fraction())
+        _watermark_sample = (frac, now)
+        return frac >= watermark
+
+
 def process_rss_bytes(pid: int) -> int:
     try:
         with open(f"/proc/{pid}/statm") as f:

@@ -84,6 +84,18 @@ class NodeBusyError(Exception):
     saturates it); the submitter should spill to a different node."""
 
 
+class NodeOverloadedError(Exception):
+    """The node SHED the lease at admission (queue-depth cap, memory
+    watermark, or the overload.saturate chaos site): distinct from
+    plain busy — the driver fails deadline-armed tasks fast with
+    SystemOverloadedError instead of spilling them into a backlog."""
+
+
+class TaskDeadlineExpired(Exception):
+    """Internal driver-side signal: the daemon found the task's
+    end-to-end deadline already dead and refused to execute it."""
+
+
 # Canonical executor_stats() counter keys, exported so the README
 # doc-drift check (tests/test_doc_drift.py) can assert every counter is
 # documented without standing up a daemon.
@@ -95,7 +107,8 @@ DATA_PLANE_STAT_KEYS = ("same_host_map_hits", "same_host_copy_hits",
                         "attached_mappings", "leases")
 FAULT_STAT_KEYS = ("rpc_retries", "batch_requeues", "peer_blacklists",
                    "lease_orphans_swept", "arena_orphans_swept",
-                   "lineage_rebuilds")
+                   "lineage_rebuilds", "task_timeouts",
+                   "admission_shed", "breaker_open")
 
 
 def _proc_label() -> str:
@@ -858,6 +871,12 @@ class NodeExecutorService:
         self.peer_blacklists = 0
         self.lease_orphans_swept = 0
         self.arena_orphans_swept = 0
+        # Overload-control counters: tasks refused because their
+        # end-to-end deadline was already dead on arrival (daemon
+        # admission or worker-frame pickup) and leases shed by the
+        # queue-depth/memory-watermark admission caps.
+        self.task_timeouts = 0
+        self.admission_shed = 0
         self._attached_owner_strikes: dict[str, int] = {}
         # Worker-bound arg blobs promoted to shared memory: keyed by the
         # object's id bytes in the node's shm directory; FIFO-bounded.
@@ -1102,7 +1121,8 @@ class NodeExecutorService:
                      task_token: str | None = None,
                      client_addr: str | None = None,
                      args_ref: str | None = None,
-                     trace_ctx: tuple | None = None) -> tuple:
+                     trace_ctx: tuple | None = None,
+                     deadline: float | None = None) -> tuple:
         """Run one task; reply ("ok", [result descriptors]) where each
         descriptor is ("inline", blob) or ("stored", size), or
         ("need_func", nonce) when the digest is unknown here (args are
@@ -1133,6 +1153,15 @@ class NodeExecutorService:
                 args_blob = self._stashed_args.pop(args_ref, None)
             if args_blob is None:
                 return ("stale_args",)
+        if deadline is not None and time.time() > deadline:
+            # End-to-end budget already dead on arrival: refuse the
+            # lease — the driver seals TaskTimeoutError, nothing runs.
+            self.task_timeouts += 1
+            return ("timeout", "admitted")
+        shed_why = self._overload_reason()
+        if shed_why is not None:
+            self.admission_shed += 1
+            return ("overloaded", shed_why)
         if not self._try_reserve(token, demand):
             return ("busy",)
         trace_stages = {"admitted": time.time()} \
@@ -1283,6 +1312,38 @@ class NodeExecutorService:
             except Exception:  # noqa: BLE001 — sync is best-effort
                 pass
 
+    def _overload_reason(self) -> "str | None":
+        """Why admission should SHED (not merely spill) right now:
+        the overload.saturate chaos site, the admitted-reservation
+        depth cap, or the host-memory watermark. None = admit
+        normally. One seeded chaos draw per call — callers check once
+        per RPC/batch, keeping injection deterministic."""
+        from ray_tpu._private import chaos
+
+        if chaos.ACTIVE is not None \
+                and chaos.ACTIVE.should("overload.saturate"):
+            return "chaos: overload.saturate"
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        cap = int(GLOBAL_CONFIG.admission_max_queue_depth or 0)
+        if cap > 0:
+            with self._running_lock:
+                depth = len(self._running)
+            if depth >= cap:
+                return (f"admitted reservations at "
+                        f"admission_max_queue_depth={cap}")
+        watermark = float(
+            GLOBAL_CONFIG.admission_memory_watermark or 0)
+        if watermark > 0:
+            from ray_tpu._private.memory_monitor import (
+                memory_watermark_exceeded,
+            )
+
+            if memory_watermark_exceeded(watermark):
+                return (f"host memory over admission_memory_watermark"
+                        f"={watermark}")
+        return None
+
     def _try_reserve(self, token: str, demand: dict) -> bool:
         """Admission: reserve ``demand`` under ``token`` atomically with
         the capacity check (two concurrent calls must not both pass a
@@ -1341,6 +1402,12 @@ class NodeExecutorService:
         re-serialize passes (the classic path pays both)."""
         from ray_tpu.exceptions import WorkerCrashedError
 
+        if status == "timeout":
+            # The worker found the frame's deadline dead at pickup
+            # (budget died queued behind the lease head): typed refusal,
+            # nothing executed.
+            self.task_timeouts += 1
+            return ("timeout", "worker")
         if status == "crash":
             # Normalize to WorkerCrashedError (the payload may be a
             # pool-internal _WorkerUnavailable) so the driver's retry
@@ -1412,15 +1479,31 @@ class NodeExecutorService:
         pipeline: list[_BatchTask] = []
         reserve_wants: list = []
         token_idx: dict[str, int] = {}
+        # One shed decision per batch RPC (one chaos draw; depth and
+        # watermark barely move within a batch): under overload the
+        # whole batch sheds — the driver fails deadline-armed entries
+        # fast and spillback-requeues the rest.
+        shed_why = self._overload_reason()
+        now = time.time()
         for idx, entry in enumerate(entries):
             (digest, func_blob, args_blob, n_returns, return_keys,
              runtime_env, resources, token, flags) = entry[:9]
-            # Optional 10th element: the driver's trace context for
-            # this entry (absent ⇒ tracing off for it — zero cost).
+            # Optional 10th/11th elements: the driver's trace context
+            # and the absolute end-to-end deadline for this entry
+            # (absent ⇒ off for it — zero cost).
             trace_ctx = entry[9] if len(entry) > 9 else None
+            deadline = entry[10] if len(entry) > 10 else None
             if func_blob is not None:
                 with self._func_lock:
                     self._func_blob_cache[digest] = func_blob
+            if deadline is not None and now > deadline:
+                self.task_timeouts += 1
+                complete(idx, ("timeout", "admitted"))
+                continue
+            if shed_why is not None:
+                self.admission_shed += 1
+                complete(idx, ("overloaded", shed_why))
+                continue
             demand = dict(resources or {})
             demand.setdefault("CPU", 1.0)
             token = token or f"exec-{digest[:8]}-{os.urandom(4).hex()}"
@@ -1434,12 +1517,13 @@ class NodeExecutorService:
                                 return_keys=return_keys,
                                 runtime_env=runtime_env,
                                 resources=resources, token=token,
-                                trace_ctx=trace_ctx):
+                                trace_ctx=trace_ctx, deadline=deadline):
                     try:
                         reply = self.execute_task(
                             digest, func_blob, args_blob, n_returns,
                             return_keys, runtime_env, resources, token,
-                            client_addr, trace_ctx=trace_ctx)
+                            client_addr, trace_ctx=trace_ctx,
+                            deadline=deadline)
                     except BaseException as exc:  # noqa: BLE001
                         reply = ("err", _exc_blob(exc))
                     complete(idx, reply)
@@ -1468,7 +1552,7 @@ class NodeExecutorService:
                 args_blob=args_blob, n_returns=max(1, n_returns),
                 runtime_env=runtime_env, token=token,
                 client_addr=client_addr, sys_path=sys_path,
-                trace=trace_ctx))
+                trace=trace_ctx, deadline=deadline))
         admit_ts: dict[int, float] = {}
         if pipeline:
             accepted = self._try_reserve_many(reserve_wants)
@@ -1724,7 +1808,7 @@ class NodeExecutorService:
         # the envelope rows) assert — retried idempotent RPCs, batch
         # entries requeued after a worker/daemon death, chunk sources
         # blacklisted mid-pull, orphaned peer mappings swept.
-        from ray_tpu._private.rpc import rpc_retry_count
+        from ray_tpu._private.rpc import breaker_stats, rpc_retry_count
 
         return {
             "rpc_retries": rpc_retry_count(),
@@ -1733,6 +1817,10 @@ class NodeExecutorService:
             "lease_orphans_swept": self.lease_orphans_swept,
             "arena_orphans_swept": self.arena_orphans_swept,
             "lineage_rebuilds": 0,  # daemons hold no lineage (owners do)
+            # Overload-control plane (see FAULT_STAT_KEYS).
+            "task_timeouts": self.task_timeouts,
+            "admission_shed": self.admission_shed,
+            "breaker_open": breaker_stats()["opens"],
         }
 
     def executor_stats(self) -> dict:
@@ -2869,17 +2957,23 @@ class RemoteNodeHandle:
                 resources: dict[str, float],
                 task_token: str | None = None,
                 client_addr: str | None = None,
-                trace_ctx: tuple | None = None) -> tuple:
+                trace_ctx: tuple | None = None,
+                deadline: float | None = None) -> tuple:
         """Lease + push + reply. Ships the function blob only the first
         time this node sees its digest. Returns ``(results, trace)``
         where ``trace`` is the daemon's piggybacked trace payload
-        (stage stamps + spans + wall clock) or None."""
+        (stage stamps + spans + wall clock) or None. Raises
+        TaskDeadlineExpired / NodeOverloadedError when the daemon
+        refused the lease (deadline dead on arrival / admission shed).
+        """
         self.ensure_sys_path()
         with self._digest_lock:
             known = digest in self.known_digests
-        # Tracing rides as an RPC kwarg only when armed: the untraced
-        # wire shape is byte-identical to before.
+        # Tracing/deadlines ride as RPC kwargs only when armed: the
+        # plain wire shape is byte-identical to before.
         extra = {} if trace_ctx is None else {"trace_ctx": trace_ctx}
+        if deadline is not None:
+            extra["deadline"] = deadline
         # Coalesced: burst submissions to this node share __batch__
         # frames (one syscall/server wakeup per batch); replies are
         # still per-call, so nothing head-of-line blocks.
@@ -2905,6 +2999,12 @@ class RemoteNodeHandle:
                     task_token, client_addr, **extra)
         if reply[0] == "busy":
             raise NodeBusyError(self.address)
+        if reply[0] == "overloaded":
+            raise NodeOverloadedError(
+                reply[1] if len(reply) > 1 else "admission shed")
+        if reply[0] == "timeout":
+            raise TaskDeadlineExpired(
+                reply[1] if len(reply) > 1 else "admitted")
         with self._digest_lock:
             self.known_digests.add(digest)
         if reply[0] == "err":

@@ -352,6 +352,14 @@ class RemoteActor:
         if getattr(call, "cancelled", False):
             self._fail_call(call, TaskCancelledError())
             return
+        from ray_tpu._private.actor_runtime import _call_deadline_error
+
+        expired = _call_deadline_error(call, self._cls.__name__)
+        if expired is not None:
+            # Budget died in the submit queue: typed refusal, the RPC
+            # is never issued.
+            self._fail_call(call, expired)
+            return
         site = f"{self._cls.__name__}.{call.method_name}"
         try:
             args_blob = self._runtime._convert_remote_args(

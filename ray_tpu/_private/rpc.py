@@ -116,6 +116,105 @@ _FAULTS_LOCK = threading.Lock()
 _RPC_RETRIES = 0
 
 
+class _Breaker:
+    """Per-destination circuit breaker state (see call_with_retry).
+
+    closed -> open after ``rpc_breaker_failures`` CONSECUTIVE logical-
+    call failures; open -> one half-open probe after
+    ``rpc_breaker_reset_s``; probe success closes, probe failure
+    re-opens. All transitions under the module breaker lock."""
+
+    __slots__ = ("failures", "open", "opened_at", "probing")
+
+    def __init__(self):
+        self.failures = 0
+        self.open = False
+        self.opened_at = 0.0
+        self.probing = False
+
+
+_BREAKERS_LOCK = threading.Lock()
+_BREAKERS: dict[str, _Breaker] = {}
+_BREAKER_OPENS = 0  # monotonic: total closed->open transitions
+
+
+def _breaker_knobs() -> tuple[int, float]:
+    try:
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        return (int(GLOBAL_CONFIG.rpc_breaker_failures),
+                float(GLOBAL_CONFIG.rpc_breaker_reset_s))
+    except Exception:  # noqa: BLE001 — config gone mid-teardown
+        return 0, 5.0
+
+
+def breaker_allow(dest: str) -> bool:
+    """May a logical call to ``dest`` hit the wire right now? An open
+    breaker admits exactly ONE half-open probe per reset interval."""
+    threshold, reset_s = _breaker_knobs()
+    if threshold <= 0:
+        return True
+    with _BREAKERS_LOCK:
+        breaker = _BREAKERS.get(dest)
+        if breaker is None or not breaker.open:
+            return True
+        if breaker.probing:
+            return False
+        if time.monotonic() - breaker.opened_at >= reset_s:
+            breaker.probing = True  # this caller is the probe
+            return True
+        return False
+
+
+def breaker_record(dest: str, ok: bool) -> None:
+    """Outcome of one LOGICAL call to ``dest`` (a call_with_retry
+    invocation reports at most one failure, however many attempts it
+    burned — retries of the same call must not multi-count)."""
+    global _BREAKER_OPENS
+    threshold, _ = _breaker_knobs()
+    if threshold <= 0:
+        return
+    with _BREAKERS_LOCK:
+        breaker = _BREAKERS.get(dest)
+        if ok:
+            if breaker is not None:
+                breaker.failures = 0
+                breaker.open = False
+                breaker.probing = False
+            return
+        if breaker is None:
+            breaker = _BREAKERS[dest] = _Breaker()
+        was_open = breaker.open
+        breaker.failures += 1
+        breaker.probing = False
+        if breaker.failures >= threshold or was_open:
+            # Reaching the threshold opens; a failed half-open probe
+            # re-arms the timer without re-counting an open.
+            if not was_open:
+                _BREAKER_OPENS += 1
+            breaker.open = True
+            breaker.opened_at = time.monotonic()
+
+
+def breaker_stats() -> dict:
+    """Breaker state for fault_stats()/metrics: total opens plus the
+    destinations currently open."""
+    with _BREAKERS_LOCK:
+        return {
+            "opens": _BREAKER_OPENS,
+            "open_now": sorted(d for d, b in _BREAKERS.items()
+                               if b.open),
+        }
+
+
+def reset_breakers() -> None:
+    """Test seam: drop all breaker state and the opens counter."""
+    global _BREAKER_OPENS
+    with _BREAKERS_LOCK:
+        _BREAKERS.clear()
+        _BREAKER_OPENS = 0
+
+
 def _record_retry() -> None:
     global _RPC_RETRIES
     with _FAULTS_LOCK:
@@ -153,7 +252,17 @@ def call_with_retry(call: Callable, method: str, *args,
     nothing. Maybe-executed failures ARE retried here — by contract
     the wrapped method must be idempotent; never route task submits or
     actor creations through this (classify_rpc_failure + surfacing is
-    their path)."""
+    their path).
+
+    A per-destination circuit breaker rides the policy: a destination
+    failing ``rpc_breaker_failures`` consecutive LOGICAL calls opens,
+    and further calls fail fast with a retryable RpcError instead of
+    burning whole attempt/backoff budgets against a sick node. Breaker
+    accounting uses classify_rpc_failure: "poisoned" (the remote
+    method raised — the node is demonstrably alive) counts as success,
+    while retryable AND maybe_executed transport failures (including
+    bare OSErrors off connect paths) count as failure — once per
+    logical call, however many attempts it burned."""
     from ray_tpu._private.config import GLOBAL_CONFIG
 
     if attempts is None:
@@ -162,17 +271,38 @@ def call_with_retry(call: Callable, method: str, *args,
         base_delay_s = float(GLOBAL_CONFIG.rpc_retry_base_ms) / 1000.0
     if deadline_s is None:
         deadline_s = float(GLOBAL_CONFIG.rpc_retry_deadline_s)
+    # The destination is the bound client's address (MuxRpcClient /
+    # RpcClient .call); free functions without one skip the breaker.
+    dest = getattr(getattr(call, "__self__", None), "address", None)
+    counted = False  # breaker: at most one failure per logical call
     deadline = time.monotonic() + deadline_s
     for attempt in range(attempts):
+        if dest is not None and not breaker_allow(dest):
+            raise RpcError(
+                f"rpc {method} to {dest} rejected: circuit breaker "
+                f"open (destination failing consecutively)")
         try:
-            return call(method, *args, **kwargs)
-        # RpcMethodError ("poisoned" — the remote raised) propagates:
-        # it is not an OSError, so only transport failures retry.
-        except (RpcError, OSError):
+            result = call(method, *args, **kwargs)
+        except RpcMethodError:
+            # "poisoned": the remote raised — the node is alive and
+            # answering. Propagate (retrying re-raises) and close the
+            # failure streak.
+            if dest is not None:
+                breaker_record(dest, True)
+            raise
+        except (RpcError, OSError) as exc:
+            if dest is not None and not counted \
+                    and classify_rpc_failure(exc) != "poisoned":
+                counted = True
+                breaker_record(dest, False)
             if attempt + 1 >= attempts or time.monotonic() >= deadline:
                 raise
             _record_retry()
             time.sleep(min(base_delay_s * (2 ** attempt), 2.0))
+        else:
+            if dest is not None:
+                breaker_record(dest, True)
+            return result
     raise RpcError(f"rpc {method} retry loop exhausted")  # unreachable
 
 

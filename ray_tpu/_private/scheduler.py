@@ -24,6 +24,7 @@ their CPU to the raylet), so nested task graphs cannot starve.
 
 from __future__ import annotations
 
+import heapq
 import itertools
 import threading
 import traceback
@@ -341,6 +342,13 @@ class Dispatcher:
         self._infeasible_warned: set[str] = set()
         self._on_task_state = on_task_state
         self._num_running = 0
+        # Deadline-armed queued tasks, ordered by expiry: the dispatch
+        # loop pops expired heads each pass (O(log n) per armed task,
+        # free when no task carries a deadline) and hands them to the
+        # owner's hook instead of scanning the whole queue.
+        self._deadline_heap: list = []  # (deadline, order, task)
+        self._on_deadline = None
+        self.deadline_expired = 0
         # Batched remote dispatch (set_batch_hooks): tasks claimed for
         # the same batch key within one pass coalesce into one runner.
         self._batch_key = None
@@ -365,6 +373,13 @@ class Dispatcher:
                 strategy.kind if strategy is not None else "DEFAULT",
                 getattr(strategy, "node_id", None),
                 getattr(strategy, "soft", False))
+
+    def set_deadline_hook(self, on_deadline) -> None:
+        """``on_deadline(spec, stage)`` seals a task whose end-to-end
+        deadline expired while queued (stage "queued") or at the claim
+        (stage "dispatch") — the dispatcher only cancels bookkeeping;
+        the owner seals the typed TaskTimeoutError."""
+        self._on_deadline = on_deadline
 
     def set_batch_hooks(self, batch_key, run_batch) -> None:
         """Enable batched dispatch: ``batch_key(spec, node, run)``
@@ -439,6 +454,9 @@ class Dispatcher:
                         self._dep_index.setdefault(dep_id, set()).add(task)
                 for rid in task.spec.return_ids:
                     self._by_return_id[rid] = task
+                if getattr(spec, "deadline", None) is not None:
+                    heapq.heappush(self._deadline_heap,
+                                   (spec.deadline, task.order, task))
             if self._parked:
                 self._lock.notify_all()
 
@@ -467,6 +485,33 @@ class Dispatcher:
 
     # -------------------------------------------------------------- dispatch
 
+    def _expire_deadlines(self) -> None:
+        """Cancel queued tasks whose deadline passed (mid-queue expiry
+        rides the same lazy-purge cancel machinery as user cancels) and
+        hand their specs to the deadline hook for sealing."""
+        if not self._deadline_heap:
+            return
+        now = time.time()
+        expired: list = []
+        with self._lock:
+            while self._deadline_heap and self._deadline_heap[0][0] <= now:
+                _, _, task = heapq.heappop(self._deadline_heap)
+                if task.claimed or task.cancelled:
+                    continue  # ran (or was cancelled) in time
+                task.cancelled = True
+                self.deadline_expired += 1
+                for rid in task.spec.return_ids:
+                    self._by_return_id.pop(rid, None)
+                if not task.unresolved_deps:
+                    self._num_ready_live -= 1
+                else:
+                    self._drop_waiting(task)
+                expired.append(task.spec)
+        hook = self._on_deadline
+        for spec in expired:
+            if hook is not None:
+                hook(spec, "queued")
+
     def _dispatch_loop(self) -> None:
         while True:
             with self._lock:
@@ -476,8 +521,11 @@ class Dispatcher:
                         self._lock.wait(timeout=0.2)
                     finally:
                         self._parked = False
+                    if self._deadline_heap:
+                        break  # sweep expiries even while idle-parked
                 if self._shutdown:
                     return
+            self._expire_deadlines()
             # Tasks claimed for the same batch key (one remote node)
             # within this pass coalesce; _flush_batches launches them
             # as single execute_task_batch runners.
@@ -503,24 +551,44 @@ class Dispatcher:
         return None
 
     def _claim(self, task: _QueuedTask, node: NodeState) -> bool:
+        expired = False
         with self._lock:
             if task.cancelled:
                 # Concurrently cancelled after admission: give the
                 # acquired resources back or the node leaks them.
                 self._cluster.release(node.node_id, task.spec.resources)
                 return False
-            task.claimed = True
-            self._num_ready_live -= 1
-            self._num_running += 1
-            if tracing.TRACE_ON:
-                # Dispatch-claim stage stamp: the run callable's owner
-                # (worker.py) folds it into the task's stage_ts map.
-                task.spec._stage_dispatch = time.time()
-            # Running tasks are past cancellation: drop the cancel
-            # index so a late cancel() can't race the real result
-            # with a TaskCancelledError.
-            for rid in task.spec.return_ids:
-                self._by_return_id.pop(rid, None)
+            deadline = getattr(task.spec, "deadline", None)
+            if deadline is not None and time.time() > deadline:
+                # Budget died between enqueue and claim: never launch
+                # dead work — release the admission; the hook seals the
+                # typed error outside the lock.
+                task.cancelled = True
+                expired = True
+                self.deadline_expired += 1
+                self._num_ready_live -= 1
+                for rid in task.spec.return_ids:
+                    self._by_return_id.pop(rid, None)
+                self._cluster.release(node.node_id, task.spec.resources)
+            else:
+                task.claimed = True
+                self._num_ready_live -= 1
+                self._num_running += 1
+                if tracing.TRACE_ON:
+                    # Dispatch-claim stage stamp: the run callable's
+                    # owner (worker.py) folds it into the task's
+                    # stage_ts map.
+                    task.spec._stage_dispatch = time.time()
+                # Running tasks are past cancellation: drop the cancel
+                # index so a late cancel() can't race the real result
+                # with a TaskCancelledError.
+                for rid in task.spec.return_ids:
+                    self._by_return_id.pop(rid, None)
+        if expired:
+            hook = self._on_deadline
+            if hook is not None:
+                hook(task.spec, "dispatch")
+            return False
         return True
 
     def _drain_groups(self, batches: dict | None = None) -> int:

@@ -62,6 +62,12 @@ class TaskSpec:
     actor_id: ActorID | None = None
     is_actor_creation: bool = False
     runtime_env: dict | None = None
+    # Absolute end-to-end deadline (driver wall clock, time.time());
+    # None = no budget. Stamped at .remote() and carried through the
+    # submit ring, dispatcher claim, execute_task_batch entries and
+    # worker pipe frames — every stage checks it before doing work and
+    # seals TaskTimeoutError instead of executing dead work.
+    deadline: float | None = None
     # Internal bookkeeping.
     attempt: int = 0
 

@@ -56,8 +56,10 @@ from ray_tpu.util import tracing
 from ray_tpu.exceptions import (
     ActorDiedError,
     GetTimeoutError,
+    SystemOverloadedError,
     TaskCancelledError,
     TaskError,
+    TaskTimeoutError,
 )
 
 logger = logging.getLogger("ray_tpu")
@@ -172,7 +174,8 @@ class _SubmitRecord:
     __slots__ = ("func", "args", "kwargs", "name", "num_returns",
                  "resources", "max_retries", "retry_exceptions",
                  "strategy", "runtime_env", "task_id", "return_ids",
-                 "submit_ts", "trace_ctx", "cancelled", "state")
+                 "submit_ts", "trace_ctx", "cancelled", "state",
+                 "deadline")
 
     # Lifecycle (state transitions under the ring condition lock):
     BUFFERED = 0   # in the ring; a cancel is handled ring-side
@@ -380,6 +383,11 @@ class Runtime:
         self.reference_counter = ReferenceCounter(self.store)
         self.cluster = ClusterState(spread_threshold=cfg.scheduler_spread_threshold)
         self.dispatcher = Dispatcher(self.cluster, self.store)
+        # Overload-control counters (under _fault_lock, surfaced via
+        # fault_stats): deadline-sealed tasks and admission sheds.
+        self._task_timeouts = 0
+        self._admission_shed = 0
+        self.dispatcher.set_deadline_hook(self._seal_deadline)
         self.placement_groups = PlacementGroupManager(self.cluster, self.store)
         self._actors: dict[ActorID, LocalActor] = {}
         # Signalled whenever an actor lands in _actors: submit queues
@@ -1280,6 +1288,67 @@ class Runtime:
         finally:
             probe.close()
 
+    # ------------------------------------------------- overload control
+
+    @staticmethod
+    def _absolute_deadline(deadline_s: float | None) -> float | None:
+        """now + budget, falling back to task_default_deadline_s."""
+        if deadline_s is None:
+            default = float(GLOBAL_CONFIG.task_default_deadline_s or 0)
+            if default <= 0:
+                return None
+            deadline_s = default
+        return time.time() + float(deadline_s)
+
+    def _seal_deadline(self, spec_or_rec, stage: str) -> None:
+        """Seal TaskTimeoutError onto a task whose end-to-end budget
+        died at ``stage`` (shared by the ring flush, the dispatcher's
+        queued/claim expiry hook, and the execute paths). The FAILED
+        event records the stage so timeline() shows where the budget
+        died."""
+        err = TaskTimeoutError(
+            getattr(spec_or_rec, "name", ""), stage,
+            getattr(spec_or_rec, "deadline", 0.0) or 0.0)
+        for rid in spec_or_rec.return_ids:
+            self.store.put_error(rid, err)
+        with self._fault_lock:
+            self._task_timeouts += 1
+        self.gcs.record_task_event(TaskEvent(
+            spec_or_rec.task_id, getattr(spec_or_rec, "name", ""),
+            "FAILED", end_time=time.time(),
+            error=f"deadline expired at stage {stage!r}"))
+
+    def _seal_overloaded(self, spec_or_rec, reason: str) -> None:
+        """Shed a deadline-armed submit at admission: seal a retryable
+        SystemOverloadedError instead of queueing unboundedly."""
+        err = SystemOverloadedError(reason)
+        for rid in spec_or_rec.return_ids:
+            self.store.put_error(rid, err)
+        with self._fault_lock:
+            self._admission_shed += 1
+        self.gcs.record_task_event(TaskEvent(
+            spec_or_rec.task_id, getattr(spec_or_rec, "name", ""),
+            "FAILED", end_time=time.time(), error=f"shed: {reason}"))
+
+    def _admission_overload_reason(self) -> str | None:
+        """Why admission should shed right now, or None. Queue-depth
+        cap on the dispatcher backlog + host-memory watermark (both
+        off by default; the watermark read is memoized)."""
+        cap = int(GLOBAL_CONFIG.admission_max_queue_depth or 0)
+        if cap > 0 and self.dispatcher.pending_count() > cap:
+            return (f"dispatcher backlog over admission_max_queue_depth"
+                    f"={cap}")
+        watermark = float(GLOBAL_CONFIG.admission_memory_watermark or 0)
+        if watermark > 0:
+            from ray_tpu._private.memory_monitor import (
+                memory_watermark_exceeded,
+            )
+
+            if memory_watermark_exceeded(watermark):
+                return (f"host memory over admission_memory_watermark"
+                        f"={watermark}")
+        return None
+
     def submit_task(
         self,
         func,
@@ -1293,6 +1362,7 @@ class Runtime:
         retry_exceptions: bool | list = False,
         scheduling_strategy: SchedulingStrategy | None = None,
         runtime_env: dict | None = None,
+        deadline_s: float | None = None,
     ) -> list[ObjectRef]:
         """Reference: CoreWorker::SubmitTask (core_worker.cc:1998).
 
@@ -1302,7 +1372,13 @@ class Runtime:
         pre-dispatch failures (runtime_env packaging, cancellation of
         a buffered submit) surface as errors sealed onto those refs.
         The ring's flush thread performs the batched record-keeping
-        (_flush_submits)."""
+        (_flush_submits).
+
+        ``deadline_s`` arms the end-to-end deadline: an ABSOLUTE
+        expiry (now + deadline_s) stamped on the spec and checked at
+        every later stage; tasks without one inherit
+        ``task_default_deadline_s`` (0 = no budget)."""
+        deadline = self._absolute_deadline(deadline_s)
         ring = self._submit_ring
         if ring is None:
             return self._submit_task_inline(
@@ -1310,7 +1386,7 @@ class Runtime:
                 resources=resources, max_retries=max_retries,
                 retry_exceptions=retry_exceptions,
                 scheduling_strategy=scheduling_strategy,
-                runtime_env=runtime_env)
+                runtime_env=runtime_env, deadline=deadline)
         rec = _SubmitRecord()
         rec.func = func
         rec.args = args
@@ -1327,6 +1403,7 @@ class Runtime:
         rec.submit_ts = 0.0
         rec.trace_ctx = None
         rec.cancelled = False
+        rec.deadline = deadline
         rec.state = _SubmitRecord.BUFFERED
         if tracing.TRACE_ON:
             # The trace context roots at the TRUE .remote() call (and
@@ -1361,8 +1438,19 @@ class Runtime:
         retry_exceptions: bool | list = False,
         scheduling_strategy: SchedulingStrategy | None = None,
         runtime_env: dict | None = None,
+        deadline: float | None = None,
     ) -> list[ObjectRef]:
         """The classic per-task submit path (submit_pipeline=0)."""
+        if deadline is not None:
+            # Fail-fast admission for deadline-armed inline submits:
+            # the caller declared a latency budget, so reject instead
+            # of queueing into a backlog that will eat it (the ring
+            # path makes the same call per flush).
+            reason = self._admission_overload_reason()
+            if reason is not None:
+                with self._fault_lock:
+                    self._admission_shed += 1
+                raise SystemOverloadedError(reason)
         task_id = TaskID()
         self._pin_nested_arg_refs(args, kwargs)
         return_ids = [ObjectID() for _ in range(num_returns)]
@@ -1373,6 +1461,7 @@ class Runtime:
             max_retries=max_retries, retry_exceptions=retry_exceptions,
             scheduling_strategy=strategy, return_ids=return_ids,
             runtime_env=self._package_runtime_env(runtime_env),
+            deadline=deadline,
         )
         for rid in return_ids:
             self.store.create_pending(rid)
@@ -1441,10 +1530,42 @@ class Runtime:
             return
         stamp_stages = tracing.TRACE_ON \
             and bool(GLOBAL_CONFIG.tracing_stage_timestamps)
+        # Admission control at the flush boundary: over the queue-depth
+        # cap / memory watermark, deadline-armed records are shed with
+        # a retryable SystemOverloadedError (fail-fast — their budget
+        # would die in the backlog anyway) while deadline-free records
+        # wait here, which backpressures the ring and ultimately blocks
+        # .remote() (bounded blocking, never loss).
+        overload = self._admission_overload_reason()
+        if overload is not None:
+            armed = [rec for rec in live if rec.deadline is not None]
+            if armed:
+                for rec in armed:
+                    self._seal_overloaded(rec, overload)
+                shed_ids = {id(rec) for rec in armed}
+                live = [rec for rec in live
+                        if id(rec) not in shed_ids]
+                with ring._cond:
+                    for rec in armed:
+                        rec.state = _SubmitRecord.SUBMITTED
+                        for rid in rec.return_ids:
+                            ring._by_rid.pop(rid, None)
+            while live and self._admission_overload_reason() is not None:
+                if ring._stop:
+                    break  # shutdown flush must not wedge on overload
+                time.sleep(0.02)
+        now = time.time()
         specs: list[tuple[_SubmitRecord, TaskSpec, list]] = []
         events: list[TaskEvent] = []
         failed: list[tuple[_SubmitRecord, BaseException]] = []
+        expired: list[_SubmitRecord] = []
         for rec in live:
+            if rec.deadline is not None and now > rec.deadline:
+                # The budget died while the record sat BUFFERED in the
+                # ring (stage "submit"): seal the typed timeout without
+                # ever creating scheduler-side state.
+                expired.append(rec)
+                continue
             try:
                 # One scan serves both dep collection and the
                 # container check gating the nested-ref grace pin
@@ -1474,6 +1595,7 @@ class Runtime:
                     scheduling_strategy=rec.strategy,
                     return_ids=rec.return_ids,
                     runtime_env=self._package_runtime_env(rec.runtime_env),
+                    deadline=rec.deadline,
                 )
             except BaseException as exc:  # noqa: BLE001 — pre-dispatch
                 failed.append((rec, exc))
@@ -1514,6 +1636,8 @@ class Runtime:
                 self.store.put_error(rid, exc)
             self.gcs.record_task_event(TaskEvent(
                 rec.task_id, rec.name, "FAILED", error=str(exc)))
+        for rec in expired:
+            self._seal_deadline(rec, "submit")
         # Hand the records over: cancels from here on ride the
         # dispatcher. A cancel that raced THIS flush (arrived while
         # DRAINING) is replayed against the dispatcher now.
@@ -1561,7 +1685,8 @@ class Runtime:
         pg_spec = TaskSpec(
             task_id=spec.task_id, name=spec.name, func=spec.func, args=spec.args,
             kwargs=spec.kwargs, num_returns=spec.num_returns, resources={},
-            return_ids=spec.return_ids, scheduling_strategy=SchedulingStrategy())
+            return_ids=spec.return_ids, scheduling_strategy=SchedulingStrategy(),
+            deadline=spec.deadline)
         pg_spec._original = spec
         # The shadow must carry the trace context too: the dispatcher
         # and event paths read the spec THEY were handed, and dropping
@@ -1623,6 +1748,11 @@ class Runtime:
     def _execute_task(self, spec: TaskSpec, node: NodeState, acquired: bool = True) -> None:
         """Reference: CoreWorker::ExecuteTask (core_worker.cc:2717)."""
         start = time.time()
+        if spec.deadline is not None and start > spec.deadline:
+            # Budget died between claim and launch (PG gating, requeue
+            # waits, spillback backoff): seal, never execute dead work.
+            self._seal_deadline(spec, "execute")
+            return
         self.gcs.record_task_event(TaskEvent(
             spec.task_id, spec.name, "RUNNING", start_time=start,
             node_id=node.node_id.hex() if node else "",
@@ -1639,13 +1769,24 @@ class Runtime:
                 remote_handle = self._remote_nodes.get(node.node_id)
         try:
             if remote_handle is not None:
-                from ray_tpu._private.node_executor import NodeBusyError
+                from ray_tpu._private.node_executor import (
+                    NodeBusyError,
+                    NodeOverloadedError,
+                    TaskDeadlineExpired,
+                )
 
                 try:
                     ran_on_pool = self._try_execute_remote(
                         spec, node, remote_handle)
                 except NodeBusyError:
                     self._spillback_requeue(spec, node)
+                    return
+                except TaskDeadlineExpired:
+                    # The daemon found the budget dead at admission.
+                    self._seal_deadline(spec, "admitted")
+                    return
+                except NodeOverloadedError as exc:
+                    self._handle_overloaded_reply(spec, node, str(exc))
                     return
             elif self.worker_pool is not None:
                 ran_on_pool = self._try_execute_on_pool(spec, node)
@@ -1698,6 +1839,22 @@ class Runtime:
         self.gcs.record_task_event(TaskEvent(
             spec.task_id, spec.name, "FAILED", start_time=start,
             end_time=time.time(), error=repr(exc)))
+
+    def _handle_overloaded_reply(self, spec: TaskSpec, node: NodeState,
+                                 reason: str) -> None:
+        """A daemon shed this task at admission (queue-depth cap /
+        memory watermark / overload.saturate chaos). Deadline-armed
+        tasks fail fast with the retryable SystemOverloadedError —
+        their budget would die waiting anyway; deadline-free ones
+        requeue like a busy spillback (bounded blocking, never loss)."""
+        if spec.deadline is not None:
+            self._seal_overloaded(
+                spec, f"node {node.node_id.hex()[:8]} shed the task: "
+                      f"{reason}")
+            return
+        with self._fault_lock:
+            self._admission_shed += 1
+        self._spillback_requeue(spec, node)
 
     def _spillback_requeue(self, spec: TaskSpec, node: NodeState) -> None:
         """Spillback (reference: the raylet redirects the lease):
@@ -1917,7 +2074,7 @@ class Runtime:
                 return_keys, spec.runtime_env, spec.resources,
                 task_token=token,
                 client_addr=self._client_server_addr() or None,
-                trace_ctx=trace_ctx)
+                trace_ctx=trace_ctx, deadline=spec.deadline)
         except (RpcError, OSError) as exc:
             # Distinguish a dead node from a transient call failure: a
             # drop marks every object on the node lost and fires
@@ -2035,10 +2192,13 @@ class Runtime:
                 1 if has_refs else 0)
             trace_ctx = getattr(spec, "_trace_ctx", None) \
                 if tracing.TRACE_ON else None
-            if trace_ctx is not None:
-                # 10th element: the trace context — absent entries keep
-                # the untraced wire shape byte-identical.
+            if trace_ctx is not None or spec.deadline is not None:
+                # Optional 10th/11th elements: trace context and the
+                # absolute deadline — absent on both counts keeps the
+                # plain wire shape byte-identical.
                 entry = entry + (trace_ctx,)
+            if spec.deadline is not None:
+                entry = entry + (spec.deadline,)
             entries.append(entry)
             spec_by_idx[idx] = spec
             ctx = _RemoteBlockContext(self.cluster, node.node_id,
@@ -2099,6 +2259,18 @@ class Runtime:
                 elif reply[0] == "busy":
                     finish_idx(idx)
                     self._spillback_requeue(spec, node)
+                elif reply[0] == "timeout":
+                    # Daemon-side deadline expiry at admission or on
+                    # the worker pipe (the reply names no stage; the
+                    # error does).
+                    self._seal_deadline(
+                        spec, reply[1] if len(reply) > 1 and reply[1]
+                        else "admitted")
+                    finish_idx(idx)
+                elif reply[0] == "overloaded":
+                    finish_idx(idx)
+                    self._handle_overloaded_reply(
+                        spec, node, "daemon admission shed")
                 else:  # ("need_func", _): single path re-ships the blob
                     def redo(spec=spec):
                         try:
@@ -2488,9 +2660,11 @@ class Runtime:
         get_if_exists: bool = False,
         process: bool = False,
         runtime_env: dict | None = None,
+        deadline_s: float | None = None,
     ) -> tuple[ActorID, ObjectRef]:
         """Reference: CoreWorker::CreateActor (core_worker.cc:2069) +
-        GcsActorManager registration."""
+        GcsActorManager registration. ``deadline_s`` becomes the
+        actor's default per-call end-to-end budget."""
         ns = namespace or self.namespace
         if name is not None and get_if_exists:
             existing = self.gcs.get_named_actor(name, ns)
@@ -2511,7 +2685,8 @@ class Runtime:
         record = ActorRecord(
             actor_id=actor_id, name=name, namespace=ns,
             class_name=cls.__name__, max_restarts=max_restarts,
-            method_meta=method_meta)
+            method_meta=method_meta,
+            default_deadline_s=float(deadline_s or 0.0))
         try:
             self.gcs.register_actor(record)
             # Publish synchronously at registration so an actor is
@@ -2685,20 +2860,26 @@ class Runtime:
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str,
                           args: tuple, kwargs: dict,
-                          num_returns: int = 1) -> list[ObjectRef]:
+                          num_returns: int = 1,
+                          deadline_s: float | None = None) -> list[ObjectRef]:
         """Reference: CoreWorker::SubmitActorTask (core_worker.cc:2304).
 
         All calls for one actor flow through a per-actor ordered submission
         queue so per-caller call order is preserved even across actor
         startup and ObjectRef-argument resolution (reference:
         transport/sequential_actor_submit_queue.h).
-        """
+
+        ``deadline_s`` (or the actor's default, or
+        task_default_deadline_s) arms an end-to-end budget: a call
+        whose deadline dies queued seals TaskTimeoutError instead of
+        executing."""
         return_ids = [ObjectID() for _ in range(max(1, num_returns))]
         self._pin_nested_arg_refs(args, kwargs)
         for rid in return_ids:
             self.store.create_pending(rid)
         refs = [ObjectRef(rid) for rid in return_ids]
-        call = _ActorCall(method_name, args, kwargs, return_ids)
+        call = _ActorCall(method_name, args, kwargs, return_ids,
+                          deadline=self._absolute_deadline(deadline_s))
 
         record = self.gcs.get_actor(actor_id)
         if record is None or (record.state == "DEAD" and actor_id not in self._actors):
@@ -2815,16 +2996,24 @@ class Runtime:
         executor_stats()["faults"]: how often each recovery path fired
         in this process. The deterministic chaos tests assert these;
         the envelope records them per row."""
-        from ray_tpu._private.rpc import rpc_retry_count
+        from ray_tpu._private.rpc import breaker_stats, rpc_retry_count
 
         with self._fault_lock:
             batch_requeues = self._fault_batch_requeues
+            task_timeouts = self._task_timeouts
+            admission_shed = self._admission_shed
         return {
             "rpc_retries": rpc_retry_count(),
             "batch_requeues": batch_requeues,
             "peer_blacklists": 0,  # drivers pull whole blobs, not chunks
             "lease_orphans_swept": self._export_leases.expired,
             "lineage_rebuilds": self.recovery.num_recoveries,
+            # Overload-control plane: deadline-sealed tasks (driver-side
+            # seals, all stages), admission sheds (driver + daemon
+            # replies), and circuit-breaker opens in this process.
+            "task_timeouts": task_timeouts,
+            "admission_shed": admission_shed,
+            "breaker_open": breaker_stats()["opens"],
         }
 
     def _release_actor_lease(self, actor_id: ActorID) -> None:

@@ -296,13 +296,18 @@ class WorkerModeRuntime:
                     num_returns: int = 1, resources: dict[str, float],
                     max_retries: int = 0, retry_exceptions=False,
                     scheduling_strategy=None,
-                    runtime_env: dict | None = None) -> list[ObjectRef]:
+                    runtime_env: dict | None = None,
+                    deadline_s: float | None = None) -> list[ObjectRef]:
         options = self._resource_options(resources)
         options.update(name=name, num_returns=num_returns,
                        max_retries=max_retries,
                        retry_exceptions=retry_exceptions)
         if runtime_env:
             options["runtime_env"] = runtime_env
+        if deadline_s is not None:
+            # Relative budget forwarded as an option: the owning
+            # driver stamps the absolute deadline at its own submit.
+            options["_deadline_s"] = deadline_s
         options.update(self._strategy_options(scheduling_strategy))
         func_blob = serialization.dumps_function(func)
         keys = self._rpc.call("client_task", func_blob,
@@ -387,11 +392,14 @@ class WorkerModeRuntime:
                      max_restarts: int = 0, max_pending_calls: int = -1,
                      lifetime: str | None = None, scheduling_strategy=None,
                      get_if_exists: bool = False, process: bool = False,
-                     runtime_env: dict | None = None):
+                     runtime_env: dict | None = None,
+                     deadline_s: float | None = None):
         options = self._resource_options(resources)
         options.update(max_concurrency=max_concurrency,
                        max_restarts=max_restarts,
                        max_pending_calls=max_pending_calls)
+        if deadline_s is not None:
+            options["_deadline_s"] = deadline_s
         options.update(self._strategy_options(scheduling_strategy))
         if name is not None:
             options["name"] = name
@@ -410,11 +418,13 @@ class WorkerModeRuntime:
 
     def submit_actor_task(self, actor_id: ActorID, method_name: str,
                           args: tuple, kwargs: dict,
-                          num_returns: int = 1) -> list[ObjectRef]:
+                          num_returns: int = 1,
+                          deadline_s: float | None = None,
+                          ) -> list[ObjectRef]:
         keys = self._rpc.call(
             "client_actor_call", actor_id.hex(), method_name,
             self._marshal(args, kwargs), num_returns,
-            claimant=self.borrower_id)
+            claimant=self.borrower_id, deadline_s=deadline_s)
         return self._new_refs(keys)
 
     def kill_actor(self, actor_id: ActorID, no_restart: bool = True) -> None:

@@ -102,6 +102,10 @@ class _BatchTask:
     # task_seq frame so the worker stamps frame/exec times and the reply
     # carries them back. None ⇒ tracing off for this task (zero cost).
     trace: tuple | None = None
+    # Absolute end-to-end deadline: rides the task_seq frame so the
+    # worker refuses frames whose budget died queued behind the lease
+    # head (reply status "timeout" — nothing executed).
+    deadline: float | None = None
 
 
 # --------------------------------------------------------------------------
@@ -433,6 +437,14 @@ def _serve(conn, client: ShmClient, arena=None,
                 # so these are daemon-clock timestamps).
                 call_id = msg[1]
                 traced = len(msg) > 10 and msg[10] is not None
+                # Optional 12th element: the absolute end-to-end
+                # deadline — a frame whose budget died queued behind
+                # the lease head is refused, never executed.
+                deadline = msg[11] if len(msg) > 11 else None
+                if deadline is not None and time.time() > deadline:
+                    reply = ("task_done", call_id, "timeout", None)
+                    conn.send(reply + (None,) if traced else reply)
+                    continue
                 stages = {"worker_start": time.time(),
                           "pid": os.getpid()} if traced else None
                 try:
@@ -1114,8 +1126,14 @@ class WorkerPool:
                              task.client_addr,
                              task.sys_path if blob is not None
                              else None)
-                    if task.trace is not None:
+                    if task.trace is not None or \
+                            task.deadline is not None:
+                        # Optional 11th/12th elements: trace context
+                        # and the absolute deadline (absent on both ⇒
+                        # the plain frame shape, byte-identical).
                         frame = frame + (task.trace,)
+                    if task.deadline is not None:
+                        frame = frame + (task.deadline,)
                     try:
                         worker.send_nowait(frame)
                     except _WorkerUnavailable as exc:
@@ -1510,6 +1528,14 @@ class ProcessActor:
                             self.actor_id,
                             self._death_reason or "actor died"))
                         continue
+                from ray_tpu._private.actor_runtime import (
+                    _call_deadline_error,
+                )
+
+                expired = _call_deadline_error(call, self._cls.__name__)
+                if expired is not None:
+                    self._fail_call(call, expired)
+                    continue
                 try:
                     args_blob = self._marshal(call.args, call.kwargs)
                 except Exception as exc:  # noqa: BLE001 — unpicklable args
@@ -1658,6 +1684,16 @@ class ProcessActor:
                     self._fail_call(call, ActorDiedError(
                         self.actor_id, self._death_reason or "actor died"))
                     continue
+            from ray_tpu._private.actor_runtime import (
+                _call_deadline_error,
+            )
+
+            expired = _call_deadline_error(call, self._cls.__name__)
+            if expired is not None:
+                with self._lock:
+                    self._pending = max(0, self._pending - 1)
+                self._fail_call(call, expired)
+                continue
             try:
                 args_blob = self._marshal(call.args, call.kwargs)
             except Exception as exc:  # noqa: BLE001 — unpicklable args

@@ -24,21 +24,28 @@ _ACTOR_OPTIONS = _VALID_OPTIONS | {
 class ActorMethod:
     """Bound remote method: ``handle.method.remote(...)``."""
 
-    def __init__(self, actor_id: ActorID, method_name: str, num_returns: int = 1):
+    def __init__(self, actor_id: ActorID, method_name: str,
+                 num_returns: int = 1,
+                 deadline_s: "float | None" = None):
         self._actor_id = actor_id
         self._method_name = method_name
         self._num_returns = num_returns
+        # Per-call end-to-end budget default (the actor's
+        # ``_deadline_s`` option); .options(_deadline_s=...) overrides.
+        self._deadline_s = deadline_s
 
     def options(self, **opts) -> "ActorMethod":
         method = ActorMethod(self._actor_id, self._method_name,
-                             opts.get("num_returns", self._num_returns))
+                             opts.get("num_returns", self._num_returns),
+                             opts.get("_deadline_s", self._deadline_s))
         return method
 
     def remote(self, *args, **kwargs):
         runtime = worker_mod.auto_init()
         refs = runtime.submit_actor_task(
             self._actor_id, self._method_name, args, kwargs,
-            num_returns=self._num_returns)
+            num_returns=self._num_returns,
+            deadline_s=self._deadline_s)
         if self._num_returns == 1:
             return refs[0]
         return refs
@@ -67,12 +74,14 @@ class ActorHandle:
         if name.startswith("_"):
             raise AttributeError(name)
         num_returns = 1
+        deadline_s = None
         runtime = worker_mod.global_runtime()
         if runtime is not None:
             record = runtime.gcs.get_actor(self._actor_id)
             if record is not None:
                 num_returns = record.method_meta.get(name, {}).get("num_returns", 1)
-        return ActorMethod(self._actor_id, name, num_returns)
+                deadline_s = record.default_deadline_s or None
+        return ActorMethod(self._actor_id, name, num_returns, deadline_s)
 
     def _actor_record(self):
         runtime = worker_mod.auto_init()
@@ -209,6 +218,7 @@ class ActorClass:
             get_if_exists=opts.get("get_if_exists", False),
             process=opts.get("process", False),
             runtime_env=opts.get("runtime_env"),
+            deadline_s=opts.get("_deadline_s"),
         )
         handle = ActorHandle(actor_id, self._cls.__name__)
         handle._creation_ref = creation_ref  # keeps creation error observable

@@ -67,6 +67,48 @@ class GetTimeoutError(RayTpuError, TimeoutError):
     """``get()`` did not complete within the requested timeout."""
 
 
+class TaskTimeoutError(TaskError):
+    """The task's end-to-end deadline expired before it produced a
+    result. Sealed onto the task's return refs by whichever pipeline
+    stage found the budget dead (``.stage``: submit / queued / dispatch
+    / admitted / worker / execute / actor_queue), so ``get()`` raises
+    it instead of executing dead work. NOT retryable by the runtime —
+    the deadline belongs to the caller; resubmit with a fresh budget.
+    """
+
+    def __init__(self, task_name: str = "", stage: str = "",
+                 deadline: float = 0.0):
+        self.stage = stage
+        self.deadline = deadline
+        cause = TimeoutError(
+            f"end-to-end deadline expired at stage {stage!r}")
+        super().__init__(cause, "", task_name)
+
+    def __reduce__(self):
+        # TaskError's base reduce re-calls __init__ with the formatted
+        # message; this subclass takes different args and must round-
+        # trip through store seals and RPC error blobs.
+        return (TaskTimeoutError,
+                (self.task_name, self.stage, self.deadline))
+
+
+class SystemOverloadedError(RayTpuError):
+    """Admission control rejected the work instead of queueing it
+    unboundedly (queue-depth cap, memory watermark, or a serve tier at
+    ``max_queued_requests``). RETRYABLE: nothing executed — back off
+    and resubmit (the HTTP tier maps this to a 503)."""
+
+    def __init__(self, reason: str = "system overloaded",
+                 retry_after_s: float = 0.1):
+        self.retry_after_s = retry_after_s
+        super().__init__(reason)
+
+    def __reduce__(self):
+        return (SystemOverloadedError,
+                (self.args[0] if self.args else "system overloaded",
+                 self.retry_after_s))
+
+
 class TaskCancelledError(RayTpuError):
     """The task was cancelled before or during execution."""
 

@@ -16,7 +16,7 @@ _VALID_OPTIONS = {
     "num_cpus", "num_tpus", "num_gpus", "resources", "num_returns",
     "max_retries", "retry_exceptions", "name", "scheduling_strategy",
     "placement_group", "placement_group_bundle_index", "runtime_env",
-    "memory", "max_calls", "_metadata",
+    "memory", "max_calls", "_metadata", "_deadline_s",
 }
 
 
@@ -78,6 +78,7 @@ class RemoteFunction:
             retry_exceptions=opts.get("retry_exceptions", False),
             scheduling_strategy=_build_strategy(opts),
             runtime_env=opts.get("runtime_env"),
+            deadline_s=opts.get("_deadline_s"),
         )
         functools.update_wrapper(self, func)
 
@@ -98,9 +99,15 @@ class RemoteFunction:
         merged = {**self._default_options, **options}
         return RemoteFunction(self._function, merged)
 
-    def remote(self, *args, **kwargs):
+    def remote(self, *args, _deadline_s: float | None = None, **kwargs):
+        """``_deadline_s`` arms an end-to-end deadline for THIS call
+        (overrides the @remote/options default): the task must seal a
+        result within the budget or its refs raise TaskTimeoutError —
+        checked at every pipeline stage, never executed once dead."""
         runtime = worker_mod.auto_init()
         call_kwargs = self._call_kwargs
+        if _deadline_s is not None:
+            call_kwargs = {**call_kwargs, "deadline_s": _deadline_s}
         refs = runtime.submit_task(self._function, args, kwargs,
                                    **call_kwargs)
         if call_kwargs["num_returns"] == 1:

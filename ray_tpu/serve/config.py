@@ -51,6 +51,13 @@ class DeploymentConfig:
 
     num_replicas: int = 1
     max_ongoing_requests: int = 100
+    # Router-level load shedding: with more than this many requests
+    # in flight across the deployment's replicas (the router's local
+    # queue), new assignments are rejected with a retryable
+    # SystemOverloadedError (HTTP tier: 503) instead of queueing
+    # unboundedly. -1 = unlimited (reference: serve/config.py
+    # max_queued_requests).
+    max_queued_requests: int = -1
     autoscaling_config: AutoscalingConfig | None = None
     user_config: Any = None
     health_check_period_s: float = 2.0
@@ -84,3 +91,8 @@ class HTTPOptions:
 
     host: str = "127.0.0.1"
     port: int = 8000
+    # Per-request budget: inherited by the replica call as an
+    # end-to-end deadline (the call is refused once the budget dies —
+    # never executed late) and enforced on the proxy's result wait.
+    # Expiry maps to 504, an admission shed to 503.
+    request_timeout_s: float = 60.0

@@ -91,6 +91,16 @@ class ServeController:
                             deployment_config.user_config)
             state.target_replicas = deployment_config.target_num_replicas
 
+    def get_max_queued(self, app_name: str, name: str) -> int:
+        """Router-side shedding limit for one deployment
+        (DeploymentConfig.max_queued_requests; -1 = unlimited)."""
+        with self._lock:
+            state = self._deployments.get((app_name, name))
+            if state is None:
+                return -1
+            return int(getattr(state.deployment_config,
+                               "max_queued_requests", -1))
+
     def set_ingress(self, app_name: str, deployment_name: str) -> None:
         with self._lock:
             self._ingress[app_name] = deployment_name

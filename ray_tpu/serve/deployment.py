@@ -42,6 +42,7 @@ class Deployment:
                 autoscaling_config: AutoscalingConfig | dict | None = None,
                 user_config: Any = None,
                 max_ongoing_requests: int | None = None,
+                max_queued_requests: int | None = None,
                 ray_actor_options: dict | None = None,
                 name: str | None = None,
                 route_prefix: str | None = None,
@@ -63,6 +64,8 @@ class Deployment:
             cfg.user_config = user_config
         if max_ongoing_requests is not None:
             cfg.max_ongoing_requests = max_ongoing_requests
+        if max_queued_requests is not None:
+            cfg.max_queued_requests = max_queued_requests
         if health_check_period_s is not None:
             cfg.health_check_period_s = health_check_period_s
         if graceful_shutdown_timeout_s is not None:
@@ -86,6 +89,7 @@ def deployment(_func_or_class: Any = None, *, name: str | None = None,
                autoscaling_config: AutoscalingConfig | dict | None = None,
                user_config: Any = None,
                max_ongoing_requests: int | None = None,
+               max_queued_requests: int | None = None,
                ray_actor_options: dict | None = None,
                route_prefix: str | None = None,
                health_check_period_s: float | None = None,
@@ -102,6 +106,7 @@ def deployment(_func_or_class: Any = None, *, name: str | None = None,
             autoscaling_config=autoscaling_config,
             user_config=user_config,
             max_ongoing_requests=max_ongoing_requests,
+            max_queued_requests=max_queued_requests,
             ray_actor_options=ray_actor_options,
             health_check_period_s=health_check_period_s,
             graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,

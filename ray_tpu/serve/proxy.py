@@ -93,8 +93,38 @@ class HTTPProxy:
                     arg = json.loads(body) if body else None
                 except json.JSONDecodeError:
                     arg = body
+                from ray_tpu.exceptions import (
+                    GetTimeoutError,
+                    SystemOverloadedError,
+                    TaskTimeoutError,
+                )
+
+                # The HTTP budget is inherited end to end: the replica
+                # call carries it as a deadline (refused typed once
+                # dead, never executed late) and the result wait is
+                # bounded by the same clock.
+                timeout_s = float(proxy._options.request_timeout_s)
                 try:
-                    result = handle.remote(arg).result(timeout_s=60.0)
+                    result = handle.options(
+                        deadline_s=timeout_s).remote(arg).result(
+                        timeout_s=timeout_s)
+                except SystemOverloadedError as exc:
+                    # Load shed (router max_queued_requests or cluster
+                    # admission): retryable — tell the client when.
+                    self.send_response(503)
+                    payload = str(exc).encode()
+                    self.send_header("Retry-After", str(max(1, int(
+                        getattr(exc, "retry_after_s", 1) or 1))))
+                    self.send_header("Content-Type", "text/plain")
+                    self.send_header("Content-Length",
+                                     str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                except (TaskTimeoutError, GetTimeoutError,
+                        TimeoutError) as exc:
+                    self._reply(504, str(exc).encode())
+                    return
                 except Exception as exc:  # noqa: BLE001 — 500 + message
                     self._reply(500, str(exc).encode())
                     return

@@ -183,12 +183,13 @@ class DeploymentResponse:
     """
 
     def __init__(self, object_ref, router=None, replica_idx=None,
-                 request=None, model_id=None):
+                 request=None, model_id=None, deadline=None):
         self._ref = object_ref
         self._router = router
         self._replica_idx = replica_idx
         self._request = request  # (method_name, args, kwargs)
         self._model_id = model_id  # multiplex affinity on retries
+        self._deadline = deadline  # absolute; re-armed on retries
 
     def _release(self):
         if self._router is not None and self._replica_idx is not None:
@@ -211,28 +212,50 @@ class DeploymentResponse:
                 self._release()
                 return value
             except Exception as exc:  # noqa: BLE001 — inspect for backpressure
-                self._release()
                 cause = getattr(exc, "cause", exc)
                 retriable = (isinstance(cause, BackPressureError)
                              and self._router is not None
                              and self._request is not None)
+                if retriable and self._deadline is not None \
+                        and time.time() > self._deadline:
+                    # The request's inherited budget died while every
+                    # replica kept rejecting: typed expiry (the proxy
+                    # maps it to 504), never a late execution.
+                    from ray_tpu.exceptions import TaskTimeoutError
+
+                    self._release()
+                    raise TaskTimeoutError(
+                        self._request[0] if self._request else "",
+                        "serve_queue", self._deadline) from exc
                 if not retriable or (deadline is not None
                                      and time.monotonic() > deadline):
+                    self._release()
                     raise
                 if retries_left is not None:
                     retries_left -= 1
                     if retries_left <= 0:
+                        self._release()
                         raise
+                # Transfer the in-flight slot to the retry target FIRST
+                # and hold it through the backoff: a backing-off retry
+                # still occupies deployment queue capacity, so the
+                # router's max_queued_requests check sees it and sheds
+                # NEW arrivals instead of letting the queue grow hidden.
+                old_idx, self._replica_idx = self._replica_idx, None
+                idx, handle = self._router._pick(
+                    model_id=self._model_id, skip_affinity=True)
+                self._replica_idx = idx
+                if old_idx is not None:
+                    self._router._release(old_idx)
                 sleep_s = backoff_s
                 if deadline is not None:
                     sleep_s = min(sleep_s, max(0.0,
                                                deadline - time.monotonic()))
                 time.sleep(sleep_s)
                 backoff_s = min(backoff_s * 2, 1.0)
-                idx, handle = self._router._pick(
-                    model_id=self._model_id, skip_affinity=True)
-                self._replica_idx = idx
-                self._ref = handle.handle_request.remote(*self._request)
+                self._ref = Router._bind_deadline(
+                    handle.handle_request, self._deadline).remote(
+                    *self._request)
                 if deadline is not None:
                     timeout_s = max(0.0, deadline - time.monotonic())
 
@@ -248,8 +271,16 @@ class Router:
                  deployment_name: str):
         self._controller = controller_handle
         self._key = f"replicas::{app_name}::{deployment_name}"
+        self._app_name = app_name
         self._deployment_name = deployment_name
         self._lock = threading.Lock()
+        # max_queued_requests shedding: fetched lazily from the
+        # controller's deployment config (invalidated on membership
+        # pushes — a redeploy may change it); requests over the limit
+        # are rejected with a retryable SystemOverloadedError instead
+        # of queueing unboundedly. shed_total feeds the overload bench.
+        self._max_queued: int | None = None
+        self.shed_total = 0
         self._replicas: list[Any] = []          # ActorHandles
         # In-flight counts keyed by replica IDENTITY (actor id), so
         # membership changes neither zero live load nor cross-release a
@@ -268,6 +299,7 @@ class Router:
     def _update_replicas(self, handles: list) -> None:
         with self._lock:
             self._replicas = list(handles or [])
+            self._max_queued = None  # redeploy may have changed it
             keep = {self._rkey(h) for h in self._replicas}
             self._inflight = {k: v for k, v in self._inflight.items()
                               if k in keep}
@@ -319,28 +351,86 @@ class Router:
             if self._inflight.get(key, 0) > 0:
                 self._inflight[key] -= 1
 
+    def _max_queued_limit(self) -> int:
+        """DeploymentConfig.max_queued_requests, cached (-1 =
+        unlimited; controller unreachable degrades to unlimited)."""
+        with self._lock:
+            cached = self._max_queued
+        if cached is not None:
+            return cached
+        import ray_tpu
+
+        try:
+            limit = int(ray_tpu.get(self._controller.get_max_queued
+                                    .remote(self._app_name,
+                                            self._deployment_name),
+                                    timeout=5.0))
+        except Exception:  # noqa: BLE001 — controller busy/unreachable
+            return -1  # don't cache: retry the fetch next request
+        with self._lock:
+            self._max_queued = limit
+        return limit
+
+    def _check_shed(self) -> None:
+        """Reject at the router when the deployment's in-flight count
+        is at max_queued_requests (typed + retryable; HTTP maps to
+        503)."""
+        limit = self._max_queued_limit()
+        if limit < 0:
+            return
+        with self._lock:
+            total = sum(self._inflight.values())
+            if total >= limit:
+                self.shed_total += 1
+                from ray_tpu.exceptions import SystemOverloadedError
+
+                raise SystemOverloadedError(
+                    f"deployment {self._deployment_name} at "
+                    f"max_queued_requests={limit} "
+                    f"({total} in flight)")
+
+    @staticmethod
+    def _bind_deadline(method, deadline: "float | None"):
+        """Arm the replica actor call with the request's REMAINING
+        budget (deadline is absolute, time.time()); an already-dead
+        budget still issues with ~0 remaining so the refusal is typed
+        (TaskTimeoutError), not a silent hang."""
+        if deadline is None:
+            return method
+        return method.options(
+            _deadline_s=max(0.001, deadline - time.time()))
+
     def assign_request(self, method_name: str, args: tuple, kwargs: dict,
                        timeout_s: float = 30.0,
                        model_id: str | None = None,
-                       stream_queue=None) -> "DeploymentResponse":
+                       stream_queue=None,
+                       deadline_s: float | None = None,
+                       ) -> "DeploymentResponse":
         if not self._have_replicas.wait(timeout_s):
             raise TimeoutError(
                 f"Deployment {self._deployment_name}: no replicas came up "
                 f"within {timeout_s}s")
+        self._check_shed()
+        deadline = (time.time() + deadline_s
+                    if deadline_s is not None else None)
         idx, handle = self._pick(model_id=model_id)
         if stream_queue is not None:
-            ref = handle.handle_request_streaming.remote(
+            ref = self._bind_deadline(
+                handle.handle_request_streaming, deadline).remote(
                 method_name, args, kwargs, stream_queue)
             return DeploymentStreamingResponse(
                 stream_queue, ref, router=self, replica_idx=idx,
                 request=(method_name, args, kwargs), model_id=model_id)
-        ref = handle.handle_request.remote(method_name, args, kwargs)
+        ref = self._bind_deadline(
+            handle.handle_request, deadline).remote(
+            method_name, args, kwargs)
         # Backpressure rejections are retried on another replica inside
         # DeploymentResponse.result() (reference: pow-2 scheduler
         # requeues on replica rejection).
         return DeploymentResponse(
             ref, router=self, replica_idx=idx,
-            request=(method_name, args, kwargs), model_id=model_id)
+            request=(method_name, args, kwargs), model_id=model_id,
+            deadline=deadline)
 
     def shutdown(self) -> None:
         self._long_poll.stop()
@@ -383,6 +473,7 @@ class DeploymentHandle:
     def options(self, method_name: str | None = None,
                 multiplexed_model_id: str | None = None,
                 stream: bool | None = None,
+                deadline_s: float | None = None,
                 ) -> "DeploymentHandle":
         handle = DeploymentHandle(
             self._deployment_name, self._app_name, self._controller,
@@ -392,6 +483,8 @@ class DeploymentHandle:
                             else getattr(self, "_model_id", None))
         handle._stream = (stream if stream is not None
                           else getattr(self, "_stream", False))
+        handle._deadline_s = (deadline_s if deadline_s is not None
+                              else getattr(self, "_deadline_s", None))
         return handle
 
     def __getattr__(self, name: str):
@@ -401,6 +494,7 @@ class DeploymentHandle:
             self._deployment_name, self._app_name, self._controller, name)
         handle._model_id = getattr(self, "_model_id", None)
         handle._stream = getattr(self, "_stream", False)
+        handle._deadline_s = getattr(self, "_deadline_s", None)
         return handle
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
@@ -420,9 +514,10 @@ class DeploymentHandle:
             # whole stream in the queue actor.
             stream_queue = Queue(maxsize=256)
         try:
-            return router.assign_request(self._method_name, args, kwargs,
-                                         model_id=model_id,
-                                         stream_queue=stream_queue)
+            return router.assign_request(
+                self._method_name, args, kwargs, model_id=model_id,
+                stream_queue=stream_queue,
+                deadline_s=getattr(self, "_deadline_s", None))
         except BaseException:
             # assign failed before a response took ownership: the
             # queue actor must not leak.
@@ -438,11 +533,12 @@ class DeploymentHandle:
         return (_rebuild_handle,
                 (self._deployment_name, self._app_name, self._method_name,
                  getattr(self, "_model_id", None),
-                 getattr(self, "_stream", False)))
+                 getattr(self, "_stream", False),
+                 getattr(self, "_deadline_s", None)))
 
 
 def _rebuild_handle(deployment_name, app_name, method_name, model_id=None,
-                    stream=False):
+                    stream=False, deadline_s=None):
     from ray_tpu.serve.api import _get_controller
 
     handle = DeploymentHandle(
@@ -450,4 +546,5 @@ def _rebuild_handle(deployment_name, app_name, method_name, model_id=None,
     if model_id is not None:
         handle._model_id = model_id
     handle._stream = stream
+    handle._deadline_s = deadline_s
     return handle

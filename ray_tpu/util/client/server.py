@@ -299,11 +299,15 @@ class ClientServer:
 
     def actor_call(self, actor_key: str, method: str,
                    args_blob: bytes, num_returns: int = 1,
-                   claimant: str | None = None) -> list[str]:
+                   claimant: str | None = None,
+                   deadline_s: float | None = None) -> list[str]:
         handle = self._resolve_actor(actor_key)
         args, kwargs = self._deserialize_args(args_blob)
         bound = getattr(handle, method)
-        if num_returns != 1:
+        if deadline_s is not None:
+            bound = bound.options(num_returns=num_returns,
+                                  _deadline_s=deadline_s)
+        elif num_returns != 1:
             bound = bound.options(num_returns=num_returns)
         out = bound.remote(*args, **kwargs)
         refs = out if isinstance(out, (list, tuple)) else [out]

@@ -217,6 +217,54 @@ def test_bench_envelope_tasks_row_records_submit_stage_counters():
             "not measured through the ring")
 
 
+def test_bench_envelope_tasks_row_records_overload_counters():
+    """The tasks row's fault counters must carry the overload-control
+    plane (timeouts / sheds / breaker opens): a refresh that loses the
+    keys — or records nonzero sheds on a supposedly chaos-free
+    overload-free run — cannot ride in silently."""
+    if not BENCH_ENVELOPE.exists():
+        pytest.skip("BENCH_ENVELOPE.json not present in the working "
+                    "tree")
+    doc = json.loads(BENCH_ENVELOPE.read_text())
+    tasks_rows = [r for r in doc.get("phases", [])
+                  if r.get("phase") == "tasks"]
+    assert tasks_rows, "envelope lost its tasks phase"
+    for row in tasks_rows:
+        faults = row.get("faults") or {}
+        for key in ("task_timeouts", "admission_shed", "breaker_open"):
+            assert key in faults, (
+                f"tasks row faults lost the overload counter {key!r}")
+
+
+BENCH_SERVE = REPO_ROOT / "BENCH_SERVE.json"
+
+
+def test_bench_serve_records_overload_row():
+    """bench_serve.py's p99-under-2x-overload row must keep its schema:
+    the p99 metric plus the shed/timeout/breaker counters that make it
+    interpretable (ISSUE 7 acceptance row)."""
+    if not BENCH_SERVE.exists():
+        pytest.skip("BENCH_SERVE.json not present in the working tree")
+    rows = _parse_metrics(BENCH_SERVE.read_text())
+    assert "serve_overload_p99_ms" in rows, (
+        "BENCH_SERVE.json lost the overload row; rerun bench_serve.py")
+    for line in BENCH_SERVE.read_text().splitlines():
+        if not line.strip():
+            continue
+        row = json.loads(line)
+        if row["metric"] != "serve_overload_p99_ms":
+            continue
+        detail = row.get("detail") or {}
+        for key in ("ok", "shed", "timeouts", "breaker_open",
+                    "overload_factor", "clients"):
+            assert key in detail, (
+                f"serve overload row lost detail key {key!r}")
+        # Under 2x closed-loop overload the cap MUST have shed
+        # something — a zero-shed refresh means the row wasn't measured
+        # under overload at all.
+        assert detail["shed"] > 0, detail
+
+
 def test_bench_core_parses_and_is_nonempty():
     """The committed artifact itself must stay well-formed JSONL with
     the metric/value/unit schema the regression guard reads."""

@@ -39,14 +39,19 @@ DEAD_ADDR = "127.0.0.1:9"
 
 @pytest.fixture(autouse=True)
 def _chaos_clean():
-    """Every test starts and ends with chaos disabled and default
-    config (several tests shrink fetch_chunk_kb etc.)."""
+    """Every test starts and ends with chaos disabled, default config
+    (several tests shrink fetch_chunk_kb etc.) and empty breaker
+    state (destination failures in one test must not fail-fast the
+    next)."""
     from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu._private.rpc import reset_breakers
 
     chaos.disable()
+    reset_breakers()
     yield
     chaos.disable()
     GLOBAL_CONFIG.reset()
+    reset_breakers()
 
 
 # ---------------------------------------------------------------- controller
@@ -479,6 +484,219 @@ def test_daemon_sigkill_mid_batch_requeues_unstarted(tmp_path):
         cluster.shutdown()
 
 
+# --------------------------------------------- overload-control under chaos
+
+
+def test_breaker_opens_under_rpc_sever():
+    """rpc.sever makes every send fail against a LIVE server: the
+    per-destination breaker opens after rpc_breaker_failures logical
+    calls, and while open the call never touches the wire (the sever
+    site's injected count stops growing) — a sick node stops eating
+    whole retry budgets. Recovery: chaos off + reset window -> the
+    half-open probe closes the breaker."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu._private.rpc import breaker_stats, reset_breakers
+
+    GLOBAL_CONFIG.update({"rpc_breaker_failures": 2,
+                          "rpc_breaker_reset_s": 0.2,
+                          "rpc_retry_base_ms": 1})
+    reset_breakers()
+    server = RpcServer(host="127.0.0.1")
+    server.register("ping", lambda: "pong")
+    server.start()
+    client = MuxRpcClient(f"127.0.0.1:{server.port}", timeout_s=10.0)
+    try:
+        chaos.configure("seed=11,rpc.sever=1.0")
+        for _ in range(2):
+            with pytest.raises(RpcError):
+                call_with_retry(client.call, "ping", attempts=2,
+                                deadline_s=5)
+        assert breaker_stats()["open_now"] == [client.address]
+        severed_before = chaos.ACTIVE.stats()["injected"]["rpc.sever"]
+        with pytest.raises(RpcError, match="breaker"):
+            call_with_retry(client.call, "ping", attempts=3,
+                            deadline_s=5)
+        # Fail-fast: no wire attempt, so no new sever injections.
+        assert chaos.ACTIVE.stats()["injected"]["rpc.sever"] \
+            == severed_before
+        # Heal the transport; the half-open probe recovers the path.
+        chaos.disable()
+        time.sleep(0.25)
+        assert call_with_retry(client.call, "ping", attempts=1,
+                               deadline_s=5) == "pong"
+        assert breaker_stats()["open_now"] == []
+    finally:
+        reset_breakers()
+        client.close()
+        server.stop()
+
+
+def test_overload_saturate_sheds_typed(tmp_path):
+    """overload.saturate on a daemon: deadline-armed tasks fail fast
+    with the retryable SystemOverloadedError; deadline-free tasks
+    spillback-requeue until the site's cap exhausts and then execute
+    (bounded blocking, never loss). Both driver and daemon count the
+    sheds."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.exceptions import SystemOverloadedError
+
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir=str(tmp_path / "cluster"))
+    cluster.add_node(
+        num_cpus=2, pool_size=1, heartbeat_period_s=0.5,
+        env={"RAY_TPU_CHAOS": "seed=7,overload.saturate=1.0x4"})
+    runtime = None
+    try:
+        assert cluster.wait_for_nodes(1, timeout=30)
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        _wait_for(lambda: ray_tpu.cluster_resources().get("CPU", 0) >= 2,
+                  30, "worker node to join")
+
+        @ray_tpu.remote(num_cpus=1)
+        def quick(x):
+            return x
+
+        with pytest.raises(SystemOverloadedError):
+            ray_tpu.get(quick.remote(1, _deadline_s=10), timeout=30)
+        # Deadline-free: the remaining 3 capped sheds burn down as
+        # spillback requeues, then the task lands normally.
+        assert ray_tpu.get(quick.remote(2), timeout=60) == 2
+        assert runtime.fault_stats()["admission_shed"] >= 1
+        with runtime._remote_nodes_lock:
+            handle = next(iter(runtime._remote_nodes.values()))
+        daemon_faults = handle._control.call("executor_stats")["faults"]
+        assert daemon_faults["admission_shed"] == 4
+    finally:
+        if runtime is not None:
+            ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_deadline_through_rpc_delay(tmp_path):
+    """With rpc.delay slowing every driver-side send, a deadline-armed
+    task stuck behind a saturating blocker times out with the typed
+    TaskTimeoutError instead of hanging — and the delayed control
+    plane keeps serving the blocker's real result."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.exceptions import TaskTimeoutError
+
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir=str(tmp_path / "cluster"))
+    cluster.add_node(num_cpus=1, pool_size=1, heartbeat_period_s=0.5)
+    runtime = None
+    try:
+        assert cluster.wait_for_nodes(1, timeout=30)
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        _wait_for(lambda: ray_tpu.cluster_resources().get("CPU", 0) >= 1,
+                  30, "worker node to join")
+
+        @ray_tpu.remote(num_cpus=1)
+        def blocker():
+            import time as _t
+
+            _t.sleep(1.5)
+            return "done"
+
+        @ray_tpu.remote(num_cpus=1)
+        def quick(x):
+            return x
+
+        blocker_ref = blocker.remote()
+        time.sleep(0.2)  # blocker occupies the node's only CPU
+        chaos.configure("seed=5,rpc.delay=1.0")
+        ref = quick.remote(1, _deadline_s=0.3)
+        with pytest.raises(TaskTimeoutError):
+            ray_tpu.get(ref, timeout=30)
+        chaos.disable()
+        assert ray_tpu.get(blocker_ref, timeout=60) == "done"
+        assert runtime.fault_stats()["task_timeouts"] >= 1
+    finally:
+        chaos.disable()
+        if runtime is not None:
+            ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_daemon_sigkill_expired_in_queue_no_ghost_execution(tmp_path):
+    """SIGKILL a daemon whose batch holds deadline-armed tasks queued
+    behind a long head: the unstarted entries requeue invisibly, their
+    budgets die in the queue (no surviving capacity), and they seal
+    TaskTimeoutError WITHOUT ever executing — no ghost run after the
+    requeue (marker files prove it)."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.exceptions import TaskTimeoutError, WorkerCrashedError
+
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir=str(tmp_path / "cluster"))
+    cluster.add_node(num_cpus=8, resources={"vic": 100.0}, pool_size=1,
+                     heartbeat_period_s=0.5,
+                     env={"RAY_TPU_WORKER_PIPELINE_DEPTH": "1"})
+    runtime = None
+    try:
+        assert cluster.wait_for_nodes(1, timeout=30)
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        _wait_for(lambda: ray_tpu.cluster_resources().get("vic", 0) > 0,
+                  30, "victim node to join the driver view")
+        with runtime._remote_nodes_lock:
+            vic_handle = next(iter(runtime._remote_nodes.values()))
+        vic_pid = vic_handle.pool.call("exec_ping")
+
+        marker_dir = tmp_path / "markers"
+        marker_dir.mkdir()
+
+        @ray_tpu.remote(num_cpus=8, resources={"vic": 1.0})
+        def blocker():
+            time.sleep(1.5)
+            return "unblocked"
+
+        @ray_tpu.remote(num_cpus=1, resources={"vic": 1.0})
+        def victim(i, mdir):
+            import os as _os
+
+            with open(f"{mdir}/ran-{i}-{_os.getpid()}", "w"):
+                pass
+            time.sleep(5.0)
+            return i
+
+        blocker_ref = blocker.remote()
+        refs = [victim.remote(i, str(marker_dir), _deadline_s=6.0)
+                for i in range(6)]
+        assert ray_tpu.get(blocker_ref, timeout=60) == "unblocked"
+        # The batch lands; the pipeline head starts executing.
+        _wait_for(lambda: any(f.startswith("ran-")
+                              for f in os.listdir(marker_dir)),
+                  60, "first victim to start")
+        started = {f.split("-")[1] for f in os.listdir(marker_dir)}
+        os.kill(vic_pid, signal.SIGKILL)
+        # No replacement capacity: the invisibly-requeued entries can
+        # only wait; their deadlines die in the dispatcher queue.
+        outcomes = {"timeout": 0, "crash": 0, "ok": 0}
+        for i, ref in enumerate(refs):
+            try:
+                ray_tpu.get(ref, timeout=60)
+                outcomes["ok"] += 1
+            except TaskTimeoutError:
+                outcomes["timeout"] += 1
+                # Ghost check: a deadline-sealed victim must never have
+                # run anywhere, before or after the requeue.
+                runs = [f for f in os.listdir(marker_dir)
+                        if f.startswith(f"ran-{i}-")]
+                assert not runs, (i, runs)
+            except WorkerCrashedError:
+                # The maybe-started head of the pipeline: its budget is
+                # charged to the system-failure path, not re-executed.
+                outcomes["crash"] += 1
+        assert outcomes["timeout"] >= 1, outcomes
+        assert outcomes["ok"] == 0, outcomes
+        # Nothing executed after the kill: the marker set is frozen.
+        after = {f.split("-")[1] for f in os.listdir(marker_dir)}
+        assert after == started, (started, after)
+    finally:
+        if runtime is not None:
+            ray_tpu.shutdown()
+        cluster.shutdown()
+
+
 # ----------------------------------------------------------- randomized soak
 
 
@@ -494,17 +712,25 @@ def test_chaos_soak_survives_kill_epochs(tmp_path):
     """Randomized (fixed-seed) soak: a mixed task/actor/broadcast
     workload keeps completing while one worker daemon is SIGKILLed
     every epoch. Asserts zero lost/duplicated task results per epoch
-    and zero leaked /dev/shm segments at the end."""
+    and zero leaked /dev/shm segments at the end. Runs with DEADLINES
+    ARMED (a generous default budget on every task): the overload-
+    control plane must ride along without ever falsely expiring work
+    that survives node death within its budget."""
     import random
 
     import numpy as np
 
+    from ray_tpu._private.config import GLOBAL_CONFIG
     from ray_tpu.cluster_utils import Cluster
 
     SEED = 20260804
     EPOCHS = 20
     rng = random.Random(SEED)
     print(f"chaos soak seed={SEED}")
+    # Deadlines armed, generously: every task carries a real budget
+    # through the whole requeue/retry machinery (the _chaos_clean
+    # fixture resets the knob afterwards).
+    GLOBAL_CONFIG.update({"task_default_deadline_s": 120.0})
 
     shm_before = _shm_names()
     ray_tpu.shutdown()

@@ -223,6 +223,7 @@ def test_driver_spills_to_other_node_on_busy(two_node_cluster):
         handles = list(runtime._remote_nodes.values())
     for handle in handles:
         orig = handle.execute
+        orig_batch = handle.execute_batch
         busy_counts[handle.address] = 0
 
         def flaky(*args, _orig=orig, _addr=handle.address, **kwargs):
@@ -231,7 +232,22 @@ def test_driver_spills_to_other_node_on_busy(two_node_cluster):
                 raise NodeBusyError(_addr)
             return _orig(*args, **kwargs)
 
+        def flaky_batch(entries, on_results, *args,
+                        _orig=orig_batch, _addr=handle.address,
+                        **kwargs):
+            # Whether the first dispatch rides the single execute RPC
+            # or an execute_task_batch is a claim-timing coin flip;
+            # both must spill on busy, so both are made to reject once
+            # (per-entry "busy" replies are the batch-path shape).
+            if busy_counts[_addr] < 1:
+                busy_counts[_addr] += 1
+                on_results([(i, ("busy",))
+                            for i in range(len(entries))])
+                return len(entries)
+            return _orig(entries, on_results, *args, **kwargs)
+
         handle.execute = flaky
+        handle.execute_batch = flaky_batch
 
     @ray_tpu.remote
     def plus(x):

@@ -141,6 +141,46 @@ def test_submit_stage_counter_keys_documented(observability_text):
         f"Observability tables: {missing}")
 
 
+def test_overload_knobs_documented():
+    """Every overload-control knob (deadlines, admission caps, circuit
+    breaker) plus the serve-tier shedding knobs must keep README rows
+    (the 'Fault tolerance' knob tables)."""
+    from ray_tpu._private.config import _DEFAULTS
+
+    knobs = [k for k in _DEFAULTS
+             if k.startswith(("admission_", "rpc_breaker_"))
+             or k == "task_default_deadline_s"]
+    assert len(knobs) >= 5, f"overload knobs vanished from config: {knobs}"
+    text = README.read_text()
+    missing = [k for k in knobs if f"`{k}`" not in text]
+    assert not missing, (
+        f"overload-control knobs missing from the README knob tables: "
+        f"{missing}")
+    for serve_knob in ("max_queued_requests", "request_timeout_s"):
+        assert f"`{serve_knob}`" in text, (
+            f"serve shedding knob {serve_knob!r} missing from README")
+
+
+def test_overload_counters_documented(observability_text):
+    """The shed/expiry/breaker counters must be documented next to the
+    other fault counters (they ride the same fault_stats() family)."""
+    for key in ("task_timeouts", "admission_shed", "breaker_open"):
+        assert f"`{key}`" in observability_text, (
+            f"overload counter {key!r} missing from the README "
+            f"Observability tables")
+
+
+def test_deadline_stage_table_documented():
+    """The 'where a budget can die' semantics table must keep a row per
+    stage the runtime actually seals (TaskTimeoutError.stage values)."""
+    text = README.read_text()
+    for stage in ("submit", "queued", "dispatch", "execute",
+                  "admitted", "worker", "actor_queue", "serve_queue"):
+        assert f"`{stage}`" in text, (
+            f"deadline stage {stage!r} missing from the README "
+            f"semantics table")
+
+
 def test_readme_stage_list_matches_tracing_stages():
     from ray_tpu.util import tracing
 

@@ -1,0 +1,471 @@
+"""End-to-end deadlines, admission control and load shedding.
+
+The overload-control plane (ISSUE 7): `.remote(_deadline_s=...)` stamps
+an absolute deadline every pipeline stage checks (ring flush,
+dispatcher queue/claim, daemon admission, worker frame pickup) and
+seals a typed TaskTimeoutError instead of executing dead work;
+admission caps (queue depth / memory watermark) shed deadline-armed
+work with a retryable SystemOverloadedError while deadline-free work
+keeps the bounded-blocking behavior; rpc.call_with_retry carries a
+per-destination circuit breaker; the serve tier sheds at
+max_queued_requests. Reference intent: the Ray paper's bottom-up
+scheduling assumes callers time out and shed (arxiv 1712.05889).
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import rpc
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.memory_monitor import _set_usage_override
+from ray_tpu.exceptions import (
+    GetTimeoutError,
+    SystemOverloadedError,
+    TaskTimeoutError,
+)
+
+
+@pytest.fixture
+def tiny_runtime():
+    """A 1-CPU runtime: one blocker saturates it, so queue-wait
+    scenarios are deterministic."""
+    ray_tpu.shutdown()
+    runtime = ray_tpu.init(num_cpus=1, ignore_reinit_error=True)
+    yield runtime
+    ray_tpu.shutdown()
+    GLOBAL_CONFIG.reset()
+    _set_usage_override(None)
+    rpc.reset_breakers()
+
+
+@ray_tpu.remote(num_cpus=1)
+def _sleeper(t, x):
+    time.sleep(t)
+    return x
+
+
+@ray_tpu.remote(num_cpus=1)
+def _quick(x):
+    return x
+
+
+# --------------------------------------------------------------- deadlines
+
+
+def test_deadline_expires_in_queue_seals_task_timeout(tiny_runtime):
+    blocker = _sleeper.remote(0.8, "b")
+    ref = _quick.remote(1, _deadline_s=0.2)
+    with pytest.raises(TaskTimeoutError) as exc_info:
+        ray_tpu.get(ref, timeout=20)
+    # The budget died before execution — queued at the dispatcher or
+    # refused at the claim; never a silent hang, never executed.
+    assert exc_info.value.stage in ("queued", "dispatch", "execute")
+    assert ray_tpu.get(blocker, timeout=20) == "b"
+    assert tiny_runtime.fault_stats()["task_timeouts"] >= 1
+
+
+def test_live_deadline_executes_normally(tiny_runtime):
+    assert ray_tpu.get(_quick.remote(7, _deadline_s=30), timeout=20) == 7
+    # Option-level default on the RemoteFunction also works.
+    fn = _quick.options(_deadline_s=30)
+    assert ray_tpu.get(fn.remote(8), timeout=20) == 8
+
+
+def test_get_timeout_vs_task_timeout_both_orderings(tiny_runtime):
+    # Ordering A: the task's deadline seals FIRST -> get(timeout=...)
+    # raises the task's TaskTimeoutError, not GetTimeoutError.
+    blocker = _sleeper.remote(0.6, "b")
+    ref = _quick.remote(1, _deadline_s=0.15)
+    time.sleep(0.4)  # deadline sealed while still blocked
+    with pytest.raises(TaskTimeoutError):
+        ray_tpu.get(ref, timeout=5)
+    # Ordering B: get()'s own timeout fires while the task (deadline
+    # still live) is queued -> GetTimeoutError; the task then completes.
+    ref2 = _quick.remote(2, _deadline_s=30)
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(ref2, timeout=0.05)
+    assert ray_tpu.get(blocker, timeout=20) == "b"
+    assert ray_tpu.get(ref2, timeout=20) == 2
+
+
+def test_buffered_ring_submit_deadline_expires_before_flush(tiny_runtime):
+    """A BUFFERED ring submit whose deadline dies before the flush
+    seals TaskTimeoutError at stage "submit" — it never reaches the
+    dispatcher, and get() composes with it."""
+    ring = tiny_runtime._submit_ring
+    assert ring is not None, "submit pipeline must be armed"
+    ring._gate.clear()  # deterministic: hold the drain
+    try:
+        ref = _quick.remote(1, _deadline_s=0.1)
+        time.sleep(0.3)
+    finally:
+        ring._gate.set()
+    with pytest.raises(TaskTimeoutError) as exc_info:
+        ray_tpu.get(ref, timeout=20)
+    assert exc_info.value.stage == "submit"
+    # A get(timeout=...) on the same sealed ref raises the task error,
+    # not GetTimeoutError (the seal happened first).
+    with pytest.raises(TaskTimeoutError):
+        ray_tpu.get(ref, timeout=0.01)
+
+
+def test_default_deadline_config_applies(tiny_runtime):
+    GLOBAL_CONFIG.update({"task_default_deadline_s": 0.2})
+    blocker = _sleeper.remote(0.8, "b")
+    ref = _quick.remote(1)  # inherits the default budget
+    with pytest.raises(TaskTimeoutError):
+        ray_tpu.get(ref, timeout=20)
+    GLOBAL_CONFIG.update({"task_default_deadline_s": 0.0})
+    assert ray_tpu.get(blocker, timeout=20) == "b"
+
+
+def test_actor_call_deadline(tiny_runtime):
+    @ray_tpu.remote
+    class A:
+        def slow(self):
+            time.sleep(0.5)
+            return "s"
+
+        def fast(self):
+            return "f"
+
+    a = A.remote()
+    assert ray_tpu.get(a.fast.remote(), timeout=20) == "f"
+    slow_ref = a.slow.remote()
+    dead_ref = a.fast.options(_deadline_s=0.1).remote()
+    with pytest.raises(TaskTimeoutError) as exc_info:
+        ray_tpu.get(dead_ref, timeout=20)
+    assert exc_info.value.stage == "actor_queue"
+    assert ray_tpu.get(slow_ref, timeout=20) == "s"
+
+
+def test_actor_default_deadline_option(tiny_runtime):
+    @ray_tpu.remote(_deadline_s=0.1)
+    class B:
+        def slow(self):
+            time.sleep(0.5)
+            return "s"
+
+        def fast(self):
+            return "f"
+
+    b = B.remote()
+    first = b.slow.remote()  # starts immediately: budget is live
+    queued = b.fast.remote()  # inherits 0.1s budget; dies in the queue
+    with pytest.raises(TaskTimeoutError):
+        ray_tpu.get(queued, timeout=20)
+    assert ray_tpu.get(first, timeout=20) == "s"
+
+
+def test_cancel_still_wins_over_deadline(tiny_runtime):
+    """Explicit cancel of a queued deadline-armed task seals
+    TaskCancelledError (the cancel protocol is unchanged)."""
+    from ray_tpu.exceptions import TaskCancelledError
+
+    blocker = _sleeper.remote(0.5, "b")
+    ref = _quick.remote(1, _deadline_s=30)
+    ray_tpu.cancel(ref)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(ref, timeout=20)
+    assert ray_tpu.get(blocker, timeout=20) == "b"
+
+
+# ------------------------------------------------------- admission control
+
+
+def test_queue_depth_shed_and_bounded_blocking(tiny_runtime):
+    GLOBAL_CONFIG.update({"admission_max_queue_depth": 5})
+    backlog = [_sleeper.remote(0.05, i) for i in range(40)]
+    # Give the ring flush a moment to land the backlog in the
+    # dispatcher so the depth cap is observably exceeded.
+    deadline = time.monotonic() + 10
+    while tiny_runtime.dispatcher.pending_count() <= 5:
+        assert time.monotonic() < deadline, "backlog never built up"
+        time.sleep(0.01)
+    shed_ref = _quick.remote(1, _deadline_s=30)
+    with pytest.raises(SystemOverloadedError):
+        ray_tpu.get(shed_ref, timeout=30)
+    # Deadline-free work is never lost: the flush blocks (bounded
+    # backpressure) until the backlog drains, then everything lands.
+    assert ray_tpu.get(backlog, timeout=60) == list(range(40))
+    assert tiny_runtime.fault_stats()["admission_shed"] >= 1
+
+
+def test_memory_watermark_shed(tiny_runtime):
+    GLOBAL_CONFIG.update({"admission_memory_watermark": 0.9})
+    _set_usage_override(0.95)
+    try:
+        ref = _quick.remote(1, _deadline_s=30)
+        with pytest.raises(SystemOverloadedError):
+            ray_tpu.get(ref, timeout=30)
+    finally:
+        _set_usage_override(None)
+    # Pressure gone: admission opens back up.
+    assert ray_tpu.get(_quick.remote(2, _deadline_s=30), timeout=20) == 2
+
+
+# --------------------------------------------------------- circuit breaker
+
+
+class _FlakyClient:
+    """call_with_retry target with a controllable failure mode."""
+
+    def __init__(self, address="10.99.0.1:7"):
+        self.address = address
+        self.calls = 0
+        self.mode = "fail"  # fail | fail_maybe | ok | poisoned
+
+    def call(self, method, *args, **kwargs):
+        self.calls += 1
+        if self.mode == "fail":
+            raise rpc.RpcError("connect refused")
+        if self.mode == "fail_maybe":
+            raise rpc.RpcError("lost in flight", maybe_executed=True)
+        if self.mode == "poisoned":
+            raise rpc.RpcMethodError(ValueError("app"), "tb")
+        return "ok"
+
+
+@pytest.fixture
+def breaker_env():
+    rpc.reset_breakers()
+    GLOBAL_CONFIG.update({"rpc_breaker_failures": 3,
+                          "rpc_breaker_reset_s": 0.3,
+                          "rpc_retry_base_ms": 1})
+    yield
+    rpc.reset_breakers()
+    GLOBAL_CONFIG.reset()
+
+
+def test_breaker_opens_and_fails_fast(breaker_env):
+    client = _FlakyClient()
+    for _ in range(3):
+        with pytest.raises(rpc.RpcError):
+            rpc.call_with_retry(client.call, "m", attempts=2,
+                                deadline_s=5)
+    stats = rpc.breaker_stats()
+    assert stats["opens"] == 1
+    assert stats["open_now"] == [client.address]
+    wire_calls = client.calls
+    with pytest.raises(rpc.RpcError, match="breaker"):
+        rpc.call_with_retry(client.call, "m", attempts=3, deadline_s=5)
+    # Fail-fast: the open breaker never let the call hit the wire.
+    assert client.calls == wire_calls
+
+
+def test_breaker_counts_one_failure_per_logical_call(breaker_env):
+    """attempts=2 means each call_with_retry burns two wire attempts —
+    but the breaker counts ONE failure per logical call, so it opens
+    only at the third call, not mid-way through the second."""
+    client = _FlakyClient()
+    with pytest.raises(rpc.RpcError):
+        rpc.call_with_retry(client.call, "m", attempts=2, deadline_s=5)
+    with pytest.raises(rpc.RpcError):
+        rpc.call_with_retry(client.call, "m", attempts=2, deadline_s=5)
+    assert rpc.breaker_stats()["opens"] == 0  # 2 logical failures < 3
+    with pytest.raises(rpc.RpcError):
+        rpc.call_with_retry(client.call, "m", attempts=2, deadline_s=5)
+    assert rpc.breaker_stats()["opens"] == 1
+
+
+def test_breaker_counts_maybe_executed_and_oserror(breaker_env):
+    """OSError-vs-RpcError drift: bare OSErrors and maybe_executed
+    RpcErrors both count toward breaker state (classification is
+    shared with classify_rpc_failure)."""
+    client = _FlakyClient()
+    client.mode = "fail_maybe"
+    with pytest.raises(rpc.RpcError):
+        rpc.call_with_retry(client.call, "m", attempts=1, deadline_s=5)
+
+    class _OsClient:
+        address = client.address
+
+        def call(self, method, *a, **k):
+            raise OSError("raw socket error")
+
+    for _ in range(2):
+        with pytest.raises(OSError):
+            rpc.call_with_retry(_OsClient().call, "m", attempts=1,
+                                deadline_s=5)
+    assert rpc.breaker_stats()["opens"] == 1  # 1 maybe + 2 OSError = 3
+
+
+def test_breaker_half_open_probe_and_recovery(breaker_env):
+    client = _FlakyClient()
+    for _ in range(3):
+        with pytest.raises(rpc.RpcError):
+            rpc.call_with_retry(client.call, "m", attempts=1,
+                                deadline_s=5)
+    assert rpc.breaker_stats()["open_now"] == [client.address]
+    # Half-open probe fails -> re-opens WITHOUT a second open count.
+    time.sleep(0.35)
+    with pytest.raises(rpc.RpcError):
+        rpc.call_with_retry(client.call, "m", attempts=1, deadline_s=5)
+    assert rpc.breaker_stats()["opens"] == 1
+    # Next probe succeeds -> closed; traffic flows again.
+    time.sleep(0.35)
+    client.mode = "ok"
+    assert rpc.call_with_retry(client.call, "m", attempts=1,
+                               deadline_s=5) == "ok"
+    assert rpc.breaker_stats()["open_now"] == []
+
+
+def test_breaker_poisoned_counts_as_alive(breaker_env):
+    """A remote method RAISING is proof the node answers: RpcMethodError
+    must close the failure streak, never open the breaker."""
+    client = _FlakyClient()
+    client.mode = "poisoned"
+    for _ in range(10):
+        with pytest.raises(rpc.RpcMethodError):
+            rpc.call_with_retry(client.call, "m", attempts=1,
+                                deadline_s=5)
+    assert rpc.breaker_stats()["opens"] == 0
+
+
+# ------------------------------------------------------------- serve tier
+
+
+def test_serve_max_queued_requests_sheds(ray_start_regular):
+    from ray_tpu import serve
+
+    serve.start()
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=2,
+                      max_queued_requests=3)
+    class Sleepy:
+        def __call__(self, body):
+            time.sleep(0.4)
+            return body
+
+    try:
+        serve.run(Sleepy.bind(), name="odl_shed", route_prefix="/shed")
+        handle = serve.get_app_handle("odl_shed")
+        assert handle.remote({"i": 0}).result(timeout_s=30) == {"i": 0}
+        accepted, sheds = [], 0
+        for i in range(12):
+            try:
+                accepted.append(handle.remote({"i": i}))
+            except SystemOverloadedError:
+                sheds += 1
+        assert sheds > 0, "router never shed past max_queued_requests"
+        # Accepted requests all complete (shed is loss-free for the
+        # admitted set).
+        for resp in accepted:
+            resp.result(timeout_s=30)
+    finally:
+        serve.shutdown()
+
+
+def test_serve_deadline_inheritance(ray_start_regular):
+    """The handle's deadline_s option rides to the replica actor call:
+    a request whose budget dies queued behind a slow one is refused
+    with TaskTimeoutError (the 504 path), not silently executed late."""
+    from ray_tpu import serve
+
+    serve.start()
+
+    @serve.deployment(num_replicas=1, max_ongoing_requests=1)
+    class OneAtATime:
+        def __call__(self, body):
+            time.sleep(0.5)
+            return body
+
+    try:
+        serve.run(OneAtATime.bind(), name="odl_ddl", route_prefix="/ddl")
+        handle = serve.get_app_handle("odl_ddl")
+        assert handle.remote("warm").result(timeout_s=30) == "warm"
+        slow_resp = handle.remote("first")
+        dead_resp = handle.options(deadline_s=0.15).remote("second")
+        with pytest.raises((TaskTimeoutError, GetTimeoutError)):
+            dead_resp.result(timeout_s=10)
+        assert slow_resp.result(timeout_s=30) == "first"
+    finally:
+        serve.shutdown()
+
+
+# --------------------------------------------------- closed-loop overload
+
+
+def _overload_soak(duration_s: float, arrival_factor: int = 5):
+    """Closed-loop overload: keep ``arrival_factor`` x the box's
+    concurrency in flight with short deadlines armed; every ref must
+    resolve (value or typed shed), queues must stay bounded, nothing
+    may hang."""
+    import resource
+
+    runtime = ray_tpu.init(num_cpus=1, ignore_reinit_error=True)
+
+    @ray_tpu.remote(num_cpus=1)
+    def unit(i):
+        time.sleep(0.01)
+        return i
+
+    rss_start = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    inflight: list = []
+    outcomes = {"ok": 0, "timeout": 0, "shed": 0}
+    max_pending = 0
+    stop_at = time.monotonic() + duration_s
+    i = 0
+    while time.monotonic() < stop_at:
+        # Closed loop: top the window up, then harvest the head.
+        while len(inflight) < arrival_factor * 8:
+            # Budget ≈ half the steady-state queue wait (window x task
+            # time): the head of the window usually survives, the tail
+            # must shed as typed timeouts.
+            inflight.append(unit.remote(i, _deadline_s=0.2))
+            i += 1
+        max_pending = max(max_pending,
+                          runtime.dispatcher.pending_count())
+        ref = inflight.pop(0)
+        try:
+            ray_tpu.get(ref, timeout=30)
+            outcomes["ok"] += 1
+        except TaskTimeoutError:
+            outcomes["timeout"] += 1
+        except SystemOverloadedError:
+            outcomes["shed"] += 1
+    for ref in inflight:
+        try:
+            ray_tpu.get(ref, timeout=30)
+            outcomes["ok"] += 1
+        except TaskTimeoutError:
+            outcomes["timeout"] += 1
+        except SystemOverloadedError:
+            outcomes["shed"] += 1
+    rss_end = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    # Zero hung gets: every submitted ref resolved (we got here).
+    assert outcomes["ok"] + outcomes["timeout"] + outcomes["shed"] == i
+    # The box drains ~100/s at 10ms/task; 5x arrival means the excess
+    # MUST shed as typed timeouts — queues stay bounded by the window.
+    assert outcomes["timeout"] > 0, outcomes
+    assert outcomes["ok"] > 0, outcomes
+    assert max_pending <= arrival_factor * 8 + 16, max_pending
+    # Bounded RSS: the run must not accumulate per-task state (ru_maxrss
+    # is KB on Linux; allow generous slack for allocator noise).
+    assert rss_end - rss_start < 512 * 1024, (rss_start, rss_end)
+    return outcomes
+
+
+def test_closed_loop_overload_short(tiny_runtime):
+    """Tier-1 slice of the acceptance soak: ~4s at 5x the drain rate
+    with deadlines armed — bounded queue, typed shedding, no hangs."""
+    ray_tpu.shutdown()
+    outcomes = _overload_soak(4.0)
+    ray_tpu.shutdown()
+    assert sum(outcomes.values()) > 50, outcomes
+
+
+@pytest.mark.slow
+def test_closed_loop_overload_60s():
+    """The acceptance criterion: a 60s closed-loop overload run at 5x
+    sustained drain completes with bounded RSS and queue depth, sheds
+    the excess as typed errors, zero hung get()s."""
+    ray_tpu.shutdown()
+    try:
+        outcomes = _overload_soak(60.0)
+        assert sum(outcomes.values()) > 500, outcomes
+    finally:
+        ray_tpu.shutdown()
