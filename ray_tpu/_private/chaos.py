@@ -68,6 +68,12 @@ class ChaosController:
             if fire:
                 self.injected[site] = self.injected.get(site, 0) + 1
         if fire:
+            # Every firing lands in the process's flight-recorder ring
+            # (cheap tuple append): a post-mortem bundle shows which
+            # injected faults this process absorbed before it died.
+            from ray_tpu._private import flight_recorder
+
+            flight_recorder.record("chaos", site)
             # Chaos firings become instant pins in merged timelines —
             # a soak trace shows WHERE each injected fault landed
             # relative to the pipeline stages around it. Lazy import +

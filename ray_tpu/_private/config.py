@@ -169,6 +169,18 @@ _DEFAULTS: dict[str, Any] = {
     # exec/seal) — stamped only while tracing is enabled; this gates
     # them off independently if the stage map itself is unwanted.
     "tracing_stage_timestamps": True,
+    # Always-on performance plane (perf_plane.py): stage-latency
+    # histograms + per-task resource attribution, recorded WITHOUT
+    # tracing being armed and shipped on heartbeats. Disarmed, every
+    # site costs one module-attribute branch (perf_plane.PERF_ON);
+    # RAY_TPU_PERF_PLANE=0 disarms a whole cluster via the daemon env.
+    "perf_plane": True,
+    # Crash flight recorder (flight_recorder.py): bounded per-process
+    # event ring, persisted to the session dir by daemons so a
+    # SIGKILLed process leaves its last N events for `ray_tpu debug`.
+    "flight_recorder_events": 512,
+    # Daemon-side ring-flush period (seconds); 0 = dump-on-demand only.
+    "flight_recorder_flush_s": 2.0,
     # Native (C++) daemon blob store (node_store.cpp); falls back to
     # the Python store when the toolchain/library is unavailable.
     "node_store_native": True,

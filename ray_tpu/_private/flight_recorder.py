@@ -1,0 +1,206 @@
+"""Crash flight recorder: a bounded per-process ring of lifecycle /
+fault / chaos events, persisted to the session dir so post-mortems
+survive SIGKILL.
+
+Reference intent: the reference's event/export surface (``ray_tpu
+debug`` plays the role of `ray cluster-dump`): when a daemon dies —
+gracefully, fatally, or by SIGKILL — the operator wants the last N
+things that process saw WITHOUT having had debug logging armed.
+
+Cost discipline:
+
+- ``record(kind, *args)`` on the hot-ish paths appends a raw tuple
+  ``(ts, kind, args)`` to a bounded ``deque`` — no formatting, no I/O,
+  no lock (deque.append is atomic under the GIL). Formatting happens
+  only at dump time.
+- Daemons run a flusher thread (``flight_recorder_flush_s``) that
+  rewrites this process's ring file when new events arrived, plus one
+  dump at install — so a SIGKILLed daemon's ring is on disk within one
+  flush period of its last event. Drivers and pool workers install
+  WITHOUT a flusher (their rings are read live by ``ray_tpu debug`` /
+  the ``flight_ring`` RPC, and dumped only on fatal errors) so a busy
+  test box isn't littered with per-driver files.
+
+Ring files live under ``$RAY_TPU_SESSION_DIR/flight/<role>-<pid>.json``
+and carry the ring plus the process's fault counters, breaker state
+and recent stage histograms; ``python -m ray_tpu debug`` collects the
+files and every reachable process's LIVE ring into one bundle.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+
+def _session_dir() -> str:
+    return os.environ.get("RAY_TPU_SESSION_DIR", "/tmp/ray_tpu")
+
+
+def flight_dir() -> str:
+    return os.path.join(_session_dir(), "flight")
+
+
+class FlightRecorder:
+    def __init__(self, role: str, capacity: int = 512,
+                 flush_period_s: float = 0.0,
+                 extra_fn=None):
+        self.role = role
+        self.pid = os.getpid()
+        self.started_at = time.time()
+        self._ring: deque = deque(maxlen=max(8, int(capacity)))
+        # Extra state included in dumps: () -> dict (fault counters,
+        # breaker state, stage histograms — wired at the install site).
+        self._extra_fn = extra_fn
+        self._flushed_len = -1
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if flush_period_s and flush_period_s > 0:
+            self._thread = threading.Thread(
+                target=self._flush_loop, args=(float(flush_period_s),),
+                daemon=True, name="flight-recorder")
+            self._thread.start()
+
+    # ------------------------------------------------------------- hot path
+
+    def record(self, kind: str, *args) -> None:
+        self._ring.append((time.time(), kind, args))
+
+    # ---------------------------------------------------------------- dumps
+
+    def snapshot(self) -> dict:
+        """The ring + process state as plain data (events formatted
+        HERE, never on the record path)."""
+        events = [{"ts": ts, "kind": kind,
+                   "args": [str(a) for a in args]}
+                  for ts, kind, args in list(self._ring)]
+        extra = {}
+        if self._extra_fn is not None:
+            try:
+                extra = self._extra_fn() or {}
+            except Exception:  # noqa: BLE001 — dump must never raise
+                extra = {}
+        return {"role": self.role, "pid": self.pid,
+                "started_at": self.started_at, "events": events,
+                **extra}
+
+    def path(self) -> str:
+        return os.path.join(flight_dir(), f"{self.role}-{self.pid}.json")
+
+    def dump(self, reason: str) -> str | None:
+        """Write the ring file atomically (tmp+rename); returns the
+        path, or None when the session dir is unwritable."""
+        snap = self.snapshot()
+        snap["reason"] = reason
+        snap["dumped_at"] = time.time()
+        path = self.path()
+        try:
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            tmp = f"{path}.tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(snap, f)
+            os.replace(tmp, path)
+        except OSError:
+            return None
+        self._flushed_len = len(self._ring)
+        return path
+
+    def _flush_loop(self, period_s: float) -> None:
+        # Immediate first dump: a daemon SIGKILLed between install and
+        # the first period must still leave its boot events on disk.
+        self.dump("periodic")
+        while not self._stop.wait(period_s):
+            if len(self._ring) != self._flushed_len:
+                self.dump("periodic")
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+# --------------------------------------------------------------------------
+# Process singleton
+# --------------------------------------------------------------------------
+
+_REC: FlightRecorder | None = None
+
+
+def install(role: str, flush: bool = False, extra_fn=None
+            ) -> FlightRecorder:
+    """Install the process-wide recorder (idempotent per process —
+    a re-init keeps the existing ring so events survive driver
+    shutdown/init cycles within one process)."""
+    global _REC
+    if _REC is not None:
+        return _REC
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    capacity = int(GLOBAL_CONFIG.flight_recorder_events or 512)
+    period = float(GLOBAL_CONFIG.flight_recorder_flush_s or 0.0) \
+        if flush else 0.0
+    if flush:
+        _prune_stale_dumps()
+    _REC = FlightRecorder(role, capacity=capacity,
+                          flush_period_s=period, extra_fn=extra_fn)
+    _REC.record("start", role)
+    return _REC
+
+
+def _prune_stale_dumps(max_age_s: float = 3 * 86400) -> None:
+    """Best-effort sweep of days-old ring files: the session dir is
+    shared across sessions, and a machine cycling many daemons must
+    not accumulate dumps forever. Recent files stay — they are the
+    post-mortems `ray_tpu debug` exists to collect."""
+    try:
+        names = os.listdir(flight_dir())
+    except OSError:
+        return
+    cutoff = time.time() - max_age_s
+    for name in names:
+        path = os.path.join(flight_dir(), name)
+        try:
+            if os.path.getmtime(path) < cutoff:
+                os.unlink(path)
+        except OSError:
+            continue
+
+
+def get() -> FlightRecorder | None:
+    return _REC
+
+
+def record(kind: str, *args) -> None:
+    """Module-level record: one attribute load + a deque append when a
+    recorder is installed, one branch when not."""
+    rec = _REC
+    if rec is not None:
+        rec._ring.append((time.time(), kind, args))
+
+
+def dump(reason: str) -> str | None:
+    rec = _REC
+    return rec.dump(reason) if rec is not None else None
+
+
+def collect_session_dumps() -> list[dict]:
+    """Parse every ring file under the session dir (dead processes'
+    flushed rings included). Malformed/partial files are skipped."""
+    out: list[dict] = []
+    try:
+        names = sorted(os.listdir(flight_dir()))
+    except OSError:
+        return out
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(flight_dir(), name)) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if isinstance(doc, dict):
+            doc["file"] = name
+            out.append(doc)
+    return out

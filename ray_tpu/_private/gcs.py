@@ -456,6 +456,28 @@ class GlobalControlService:
         with self._node_stats_lock:
             return dict(self._node_stats)
 
+    def cluster_stage_latency(self) -> dict:
+        """Cluster-wide stage histograms: every node's heartbeat-
+        shipped snapshot folded by bucket addition (exact — log-bucket
+        histograms merge losslessly). {stage: merged snapshot}; node
+        death pruning (drop_node_stats) removes a dead node's
+        contribution on the next call."""
+        from ray_tpu._private import perf_plane
+
+        merged: dict[str, dict] = {}
+        with self._node_stats_lock:
+            tables = [stats.get("stage_hist")
+                      for stats in self._node_stats.values()
+                      if isinstance(stats, dict)]
+        for table in tables:
+            if not isinstance(table, dict):
+                continue
+            for stage, snap in table.items():
+                if isinstance(snap, dict):
+                    perf_plane.merge_snapshots(
+                        merged.setdefault(stage, {}), snap)
+        return merged
+
     def get_task_event(self, task_id: TaskID) -> TaskEvent | None:
         with self._lock:
             return self._task_events.get(task_id)

@@ -2,9 +2,25 @@
 
 Reference: python/ray/_private/log_monitor.py (tails per-worker log
 files under the session dir and republishes lines to drivers with a
-``(pid=...)`` prefix). Pool workers write stdout/stderr to files under
-the session log dir; this monitor tails them and echoes new lines to
-the driver's stdout.
+``(pid=...)`` prefix; its LogFileInfo tracks inode churn so rotation
+never replays or drops lines). Pool workers write stdout/stderr to
+files under the session log dir; this monitor tails them and echoes new
+lines to the driver's stdout.
+
+Hardening beyond the naive offset tail:
+
+- **Rotation/truncation**: the monitor holds each tailed file OPEN and
+  compares the path's current inode against the held handle's. A
+  replaced file is detected reliably — the held handle pins the old
+  inode, so the filesystem cannot reuse it for the replacement (a
+  stat-only scheme misses exactly that reuse) — and tailing restarts
+  from byte 0 of the new file. In-place truncation (size < offset on
+  the SAME inode) rewinds to 0. The old code seeked past new content
+  and silently dropped it, or misread a garbage suffix.
+- **Owner attribution**: an optional ``context_fn(name) -> str | None``
+  lets the runtime label lines with the owning actor/task id, so
+  interleaved output reads as ``(worker-w3 actor=4f2a91c3)`` instead of
+  an anonymous pid.
 """
 
 from __future__ import annotations
@@ -12,15 +28,22 @@ from __future__ import annotations
 import os
 import sys
 import threading
+from typing import Callable
 
 
 class LogMonitor:
     def __init__(self, log_dir: str, period_s: float = 0.2,
-                 out=None):
+                 out=None, context_fn: "Callable | None" = None):
         self.log_dir = log_dir
         self.period_s = period_s
         self._out = out or sys.stdout
-        self._offsets: dict[str, int] = {}
+        # name -> (open file object, offset): the held handle pins the
+        # inode, making rotation detection exact (see module docs).
+        self._files: dict[str, list] = {}
+        self._context_fn = context_fn
+        # name -> cached owner label (refreshed when it becomes known;
+        # lookups can be a GCS scan, so don't pay one per line).
+        self._labels: dict[str, str | None] = {}
         self._shutdown = threading.Event()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="log-monitor")
@@ -34,6 +57,21 @@ class LogMonitor:
             self.poll_once()
         self.poll_once()  # final drain
 
+    def _label(self, name: str) -> str:
+        base = name[:-len(".log")]
+        if self._context_fn is None:
+            return base
+        cached = self._labels.get(name)
+        if cached is None:
+            # Unknown (or not yet known — an actor's record lands
+            # after its worker's first output): retry the lookup.
+            try:
+                cached = self._context_fn(base)
+            except Exception:  # noqa: BLE001 — attribution is best-effort
+                cached = None
+            self._labels[name] = cached
+        return f"{base} {cached}" if cached else base
+
     def poll_once(self) -> int:
         """Tail every log file once; returns lines emitted."""
         emitted = 0
@@ -45,12 +83,34 @@ class LogMonitor:
             if not name.endswith(".log"):
                 continue
             path = os.path.join(self.log_dir, name)
-            offset = self._offsets.get(name, 0)
+            entry = self._files.get(name)
             try:
-                with open(path, "rb") as f:
-                    f.seek(offset)
-                    chunk = f.read()
+                if entry is not None:
+                    held = os.fstat(entry[0].fileno())
+                    current = os.stat(path)
+                    if (current.st_ino, current.st_dev) != \
+                            (held.st_ino, held.st_dev):
+                        # Rotated: the path now names a DIFFERENT file
+                        # (the held handle pins the old inode, so this
+                        # comparison cannot be fooled by inode reuse).
+                        entry[0].close()
+                        entry = None
+                    elif current.st_size < entry[1]:
+                        # Truncated in place: rewind to the top.
+                        entry[1] = 0
+                if entry is None:
+                    entry = [open(path, "rb"), 0]
+                    self._files[name] = entry
+                f, offset = entry
+                f.seek(offset)
+                chunk = f.read()
             except OSError:
+                stale = self._files.pop(name, None)
+                if stale is not None:
+                    try:
+                        stale[0].close()
+                    except OSError:
+                        pass
                 continue
             if not chunk:
                 continue
@@ -58,8 +118,8 @@ class LogMonitor:
             last_nl = chunk.rfind(b"\n")
             if last_nl < 0:
                 continue
-            self._offsets[name] = offset + last_nl + 1
-            prefix = f"({name[:-len('.log')]}) "
+            entry[1] = offset + last_nl + 1
+            prefix = f"({self._label(name)}) "
             for line in chunk[:last_nl].decode(
                     "utf-8", errors="replace").splitlines():
                 try:
@@ -77,3 +137,9 @@ class LogMonitor:
     def stop(self) -> None:
         self._shutdown.set()
         self._thread.join(timeout=2.0)
+        for entry in self._files.values():
+            try:
+                entry[0].close()
+            except OSError:
+                pass
+        self._files.clear()

@@ -109,21 +109,30 @@ def install_runtime_collectors(runtime):
         # them into its scrape as labeled series — replacing the old
         # driver-only view (reference: per-node metrics agents all
         # scraped under one job in the reference deployment).
-        lines.extend(_node_stat_lines(runtime))
+        by_node = _node_stats_table(runtime)
+        lines.extend(_node_stat_lines(by_node))
+        # Always-on performance plane: stage-latency histogram families
+        # (driver's own registry + every node's heartbeat-shipped
+        # snapshot) and the per-function resource attribution series.
+        lines.extend(_perf_plane_lines(runtime, by_node))
         return lines
 
     return REGISTRY.add_collector(collect)
 
 
-def _node_stat_lines(runtime) -> list[str]:
+def _node_stats_table(runtime) -> dict:
+    """The GCS node-stats aggregation table ({node hex -> last pushed
+    executor stats}), fetched once per scrape."""
     client = getattr(runtime, "gcs_client", None)
     if client is not None:
         try:
-            by_node = client.call("node_stats", timeout_s=2.0)
+            return client.call("node_stats", timeout_s=2.0) or {}
         except Exception:  # noqa: BLE001 — head unreachable: skip series
-            return []
-    else:
-        by_node = runtime.gcs.node_stats()
+            return {}
+    return runtime.gcs.node_stats()
+
+
+def _node_stat_lines(by_node: dict) -> list[str]:
     lines: list[str] = []
     if not by_node:
         return lines
@@ -162,6 +171,75 @@ def _node_stat_lines(runtime) -> list[str]:
                     lines.append(
                         f'{metric}{{node="{node}",'
                         f'key="{_escape_label(key)}"}} {value}')
+    return lines
+
+
+def _hist_lines(lines: list, stage: str, node: str, snap: dict) -> None:
+    """One (stage, node) histogram in Prometheus exposition form:
+    cumulative ``_bucket`` lines per bound plus +Inf, ``_sum`` and
+    ``_count`` (the families a real Prometheus computes p50/p99 from
+    via histogram_quantile)."""
+    from ray_tpu._private.perf_plane import BUCKET_BOUNDS
+
+    counts = snap.get("counts") or []
+    label = (f'stage="{_escape_label(stage)}",'
+             f'node="{_escape_label(node)}"')
+    cum = 0
+    for i, bound in enumerate(BUCKET_BOUNDS):
+        cum += int(counts[i]) if i < len(counts) else 0
+        lines.append(f'ray_tpu_stage_latency_bucket{{{label},'
+                     f'le="{bound:g}"}} {cum}')
+    total = int(snap.get("count", 0))
+    lines.append(f'ray_tpu_stage_latency_bucket{{{label},'
+                 f'le="+Inf"}} {total}')
+    lines.append(f'ray_tpu_stage_latency_sum{{{label}}} '
+                 f'{float(snap.get("sum", 0.0)):.6f}')
+    lines.append(f'ray_tpu_stage_latency_count{{{label}}} {total}')
+
+
+def _perf_plane_lines(runtime, by_node: dict) -> list[str]:
+    """Always-on plane families: the ``ray_tpu_stage_latency``
+    histogram series labeled (stage, node) — the driver's own hops under
+    node="driver", each daemon's under its node hex — and
+    ``ray_tpu_task_resources`` per-function attribution (count /
+    cpu-seconds / wall / peak-RSS), all recorded with tracing
+    disabled."""
+    from ray_tpu._private import perf_plane
+
+    lines: list[str] = []
+    if not perf_plane.PERF_ON:
+        return lines
+    lines.append("# TYPE ray_tpu_stage_latency histogram")
+    for stage, snap in sorted(perf_plane.stage_snapshot().items()):
+        _hist_lines(lines, stage, "driver", snap)
+    for node_hex, stats in sorted(by_node.items()):
+        hists = stats.get("stage_hist") \
+            if isinstance(stats, dict) else None
+        if not isinstance(hists, dict):
+            continue
+        for stage, snap in sorted(hists.items()):
+            if isinstance(snap, dict):
+                _hist_lines(lines, stage, node_hex[:16], snap)
+
+    lines.append("# TYPE ray_tpu_task_resources gauge")
+
+    def emit_resources(node: str, table: dict) -> None:
+        for func, row in sorted(table.items()):
+            if not isinstance(row, dict):
+                continue
+            for key in ("count", "wall_s", "cpu_s", "peak_rss_kb"):
+                lines.append(
+                    f'ray_tpu_task_resources{{'
+                    f'node="{_escape_label(node)}",'
+                    f'func="{_escape_label(func)}",'
+                    f'key="{key}"}} {float(row.get(key, 0.0)):g}')
+
+    emit_resources("driver", perf_plane.resource_snapshot())
+    for node_hex, stats in sorted(by_node.items()):
+        table = stats.get("task_resources") \
+            if isinstance(stats, dict) else None
+        if isinstance(table, dict):
+            emit_resources(node_hex[:16], table)
     return lines
 
 

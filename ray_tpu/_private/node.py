@@ -136,6 +136,13 @@ class NodeAgent:
                 # in-process SIGKILL (the whole daemon vanishes the way
                 # a crashed host does).
                 if chaos.ACTIVE.should("daemon.die"):
+                    # The one death the flusher can't race: this
+                    # process is about to SIGKILL itself, so flush the
+                    # flight ring synchronously — the post-mortem
+                    # bundle must carry the dying daemon's last events.
+                    from ray_tpu._private import flight_recorder
+
+                    flight_recorder.dump("chaos.daemon.die")
                     os.kill(os.getpid(), signal.SIGKILL)
                 if chaos.ACTIVE.should("heartbeat.skip"):
                     self._shutdown.wait(self.coalesce_s)
@@ -176,7 +183,12 @@ class NodeAgent:
                     # our id — the head grants it unless it declared
                     # this id dead (reference: raylet re-registration
                     # after GCS restart keeps the NodeID).
+                    from ray_tpu._private import flight_recorder
+
+                    flight_recorder.record("heartbeat.rejected")
                     self.node_id = self._register()
+                    flight_recorder.record("re-registered",
+                                           self.node_id.hex()[:16])
             except (RpcError, RpcMethodError, OSError):
                 pass  # head unreachable; keep trying (it may restart)
             # Coalescing floor: pokes landing during the sleep fold
@@ -191,6 +203,22 @@ class NodeAgent:
             except (RpcError, RpcMethodError, OSError):
                 pass
         self.client.close()
+
+
+def _install_daemon_recorder(role: str, executor) -> "object":
+    """Daemon-side flight recorder: flushing armed (the ring file must
+    survive SIGKILL) and dumps enriched with this daemon's fault
+    counters, breaker state and recent stage histograms — the
+    post-mortem trio `ray_tpu debug` bundles."""
+    from ray_tpu._private import flight_recorder, perf_plane
+    from ray_tpu._private.rpc import breaker_stats
+
+    def extra() -> dict:
+        return {"fault_stats": executor._fault_stats(),
+                "breaker": breaker_stats(),
+                "stage_hist": perf_plane.stage_snapshot()}
+
+    return flight_recorder.install(role, flush=True, extra_fn=extra)
 
 
 def default_resources() -> dict:
@@ -268,6 +296,7 @@ def run_head(port: int, resources: dict | None = None,
     executor = NodeExecutorService(resources=head_resources)
     executor.advertised_address = executor.address_for(_own_address())
     executor.start()
+    _install_daemon_recorder("daemon-head", executor)
 
     agent = NodeAgent(f"127.0.0.1:{server._server.port}",
                       head_resources,
@@ -336,6 +365,8 @@ def run_worker(gcs_address: str, resources: dict | None = None,
         pool_size=pool_size, resources=resources)
     executor.advertised_address = executor.address_for(_own_address())
     executor.start()
+    _install_daemon_recorder(
+        f"daemon-{os.environ['RAY_TPU_NODE_TAG'][:8]}", executor)
     agent = NodeAgent(gcs_address, resources,
                       labels={"node_role": "worker", **(labels or {})},
                       heartbeat_period_s=heartbeat_period_s,
@@ -354,6 +385,10 @@ def run_worker(gcs_address: str, resources: dict | None = None,
         while not stop_event.wait(0.5):
             pass
     finally:
+        from ray_tpu._private import flight_recorder
+
+        flight_recorder.record("daemon.stop")
+        flight_recorder.dump("shutdown")
         agent.stop()
         executor.stop()
 

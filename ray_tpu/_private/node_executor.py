@@ -34,6 +34,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable
 
+from ray_tpu._private import perf_plane as perf
 from ray_tpu._private import serialization
 from ray_tpu._private.ids import ObjectID
 from ray_tpu._private.rpc import (
@@ -109,6 +110,11 @@ FAULT_STAT_KEYS = ("rpc_retries", "batch_requeues", "peer_blacklists",
                    "lease_orphans_swept", "arena_orphans_swept",
                    "lineage_rebuilds", "task_timeouts",
                    "admission_shed", "breaker_open")
+# Always-on performance-plane stage names (perf_plane.py): every hop a
+# process can measure inside its own clock. Daemon stages ship on
+# heartbeats; driver stages export straight from the local registry.
+STAGE_HIST_KEYS = ("submit_dispatch", "dispatch_rpc", "rpc_seal",
+                   "exec_local", "admit_worker", "exec")
 
 
 def _proc_label() -> str:
@@ -956,6 +962,8 @@ class NodeExecutorService:
         s.register("unpin_object", self.unpin_object)
         s.register("free_objects", self.free_objects)
         s.register("executor_stats", self.executor_stats)
+        s.register("flight_ring", self._flight_ring)
+        s.register("configure_perf", self._configure_perf)
         s.register("task_block", self.task_block)
         s.register("task_unblock", self.task_unblock)
         s.register("adopt_sys_path", self.adopt_sys_path)
@@ -1164,8 +1172,15 @@ class NodeExecutorService:
             return ("overloaded", shed_why)
         if not self._try_reserve(token, demand):
             return ("busy",)
-        trace_stages = {"admitted": time.time()} \
-            if trace_ctx is not None else None
+        # ``trace_stages`` doubles as the always-on perf-plane carrier:
+        # traced tasks get the full admitted/worker/exec stamp chain,
+        # perf-armed untraced tasks a bare dict that only collects the
+        # worker's pickup stamp + resource sample (no span machinery).
+        perf_on = perf.PERF_ON
+        t_admit = time.time() if (trace_ctx is not None or perf_on) \
+            else 0.0
+        trace_stages = {"admitted": t_admit} \
+            if trace_ctx is not None else ({} if perf_on else None)
         try:
             with self._func_lock:
                 func = self._func_cache.get(digest)
@@ -1210,6 +1225,18 @@ class NodeExecutorService:
                                    kwargs, n_returns, runtime_env,
                                    resources or {}, task_token=token,
                                    client_addr=client_addr)
+            elif trace_ctx is None:
+                # Perf-armed, tracing off: thread the stages dict so
+                # the pool reply's pickup stamp + resource sample land
+                # here, without any span/trace-payload work.
+                t_exec = time.time()
+                values = self._run(func, digest, func_blob, args,
+                                   kwargs, n_returns, runtime_env,
+                                   resources or {}, task_token=token,
+                                   client_addr=client_addr,
+                                   trace_stages=trace_stages)
+                trace_stages.setdefault("exec_start", t_exec)
+                trace_stages.setdefault("exec_end", time.time())
             else:
                 from ray_tpu.util import tracing
 
@@ -1251,6 +1278,8 @@ class NodeExecutorService:
                 self._blocked_cpu.pop(token, None)
             self._notify_load()
         self.tasks_executed += 1
+        if perf_on and trace_stages is not None:
+            self._record_task_perf(trace_stages, t_admit)
 
         out = []
         for id_bytes, value in zip(return_keys, values):
@@ -1265,9 +1294,54 @@ class NodeExecutorService:
                 self.store.put(id_bytes, blob, owner=client_addr)
                 self._maybe_export_stored(id_bytes, blob)
                 out.append(("stored", len(blob)))
-        if trace_stages is not None:
+        if trace_ctx is not None:
             return ("ok", out, self._trace_payload(trace_stages))
         return ("ok", out)
+
+    def _record_task_perf(self, stages: dict, t_admit: float) -> None:
+        """Always-on plane: fold one finished task's stamps into this
+        daemon's stage histograms and attribution table. Pops the
+        worker's resource sample so traced replies never ship it to the
+        driver (resources roll up per node, not per task event)."""
+        sample = stages.pop("perf", None)
+        pickup = stages.get("worker_start") or stages.get("exec_start")
+        if pickup and t_admit:
+            perf.record_stage("admit_worker", max(0.0, pickup - t_admit))
+        if sample is not None:
+            try:
+                perf.record_task_resources(sample[0], sample[1],
+                                           sample[2], sample[3])
+                perf.record_stage("exec", float(sample[1]))
+                return
+            except (TypeError, IndexError):
+                pass
+        exec_start = stages.get("exec_start")
+        exec_end = stages.get("exec_end")
+        if exec_start and exec_end:
+            # In-daemon run (TPU task) or a worker without the plane:
+            # the daemon-level envelope is the exec wall.
+            perf.record_stage("exec", max(0.0, exec_end - exec_start))
+
+    def _flight_ring(self) -> dict:
+        """Live post-mortem surface for ``ray_tpu debug``: this
+        process's flight-recorder ring plus the fault/breaker/stage
+        state the dumped ring files carry."""
+        from ray_tpu._private import flight_recorder
+        from ray_tpu._private.rpc import breaker_stats
+
+        rec = flight_recorder.get()
+        snap = rec.snapshot() if rec is not None else {
+            "role": _proc_label(), "pid": os.getpid(), "events": []}
+        snap.setdefault("fault_stats", self._fault_stats())
+        snap.setdefault("breaker", breaker_stats())
+        snap.setdefault("stage_hist", perf.stage_snapshot())
+        return snap
+
+    def _configure_perf(self, on: bool) -> bool:
+        """Arm/disarm this daemon's always-on plane at runtime (the
+        overhead-calibration seam bench_envelope drives)."""
+        (perf.enable if on else perf.disable)()
+        return perf.PERF_ON
 
     def _trace_payload(self, stages: dict) -> dict:
         """Reply piggyback: this task's daemon-clock stage stamps, any
@@ -1409,6 +1483,9 @@ class NodeExecutorService:
             self.task_timeouts += 1
             return ("timeout", "worker")
         if status == "crash":
+            from ray_tpu._private import flight_recorder
+
+            flight_recorder.record("worker.crash", str(payload)[:120])
             # Normalize to WorkerCrashedError (the payload may be a
             # pool-internal _WorkerUnavailable) so the driver's retry
             # policy recognizes the system failure.
@@ -1561,7 +1638,7 @@ class NodeExecutorService:
             for task, ok in zip(pipeline, accepted):
                 if ok:
                     admitted.append(task)
-                    if task.trace is not None:
+                    if task.trace is not None or perf.PERF_ON:
                         admit_ts[task.idx] = t_admit
                 else:
                     complete(task.idx, ("busy",))
@@ -1583,6 +1660,12 @@ class NodeExecutorService:
                 with self._running_lock:
                     self._running.pop(task.token, None)
                     self._blocked_cpu.pop(task.token, None)
+                if wtrace and perf.PERF_ON:
+                    # Always-on plane: the worker's pickup stamp and
+                    # resource sample ride the reply whether or not
+                    # tracing armed this task.
+                    self._record_task_perf(wtrace,
+                                           admit_ts.get(task.idx, 0.0))
                 try:
                     reply = self._pipe_reply_to_task_reply(
                         return_keys_by_idx[task.idx], status, payload,
@@ -1849,11 +1932,19 @@ class NodeExecutorService:
         cadence — no store-wide byte sums."""
         with self._running_lock:
             running = len(self._running)
-        return {"tasks_executed": self.tasks_executed,
-                "running": running,
-                "pipeline": self._pipeline_stats(),
-                "data_plane": self._data_plane_stats(),
-                "faults": self._fault_stats()}
+        stats = {"tasks_executed": self.tasks_executed,
+                 "running": running,
+                 "pipeline": self._pipeline_stats(),
+                 "data_plane": self._data_plane_stats(),
+                 "faults": self._fault_stats()}
+        if perf.PERF_ON:
+            # Always-on plane piggyback: mergeable-by-addition stage
+            # histograms + the per-function attribution table ride the
+            # same heartbeat into the GCS node-stats table (the cluster
+            # /metrics scrape and summarize_tasks() read them there).
+            stats["stage_hist"] = perf.stage_snapshot()
+            stats["task_resources"] = perf.resource_snapshot()
+        return stats
 
     def adopt_sys_path(self, paths: list) -> int:
         """Adopt a driver's import paths (existing directories only) so
@@ -2852,7 +2943,15 @@ class NodeExecutorService:
             # units), and JAX dispatch itself is thread-safe — a mutual-
             # exclusion lock here would deadlock nested TPU-task
             # submission (outer holds it while blocked in get()).
-            result = func(*args, **kwargs)
+            if perf.PERF_ON:
+                # In-daemon run: this dispatch thread IS the executor,
+                # so thread_time here is the task's real cpu-seconds.
+                sample = perf.sample_start()
+                result = func(*args, **kwargs)
+                perf.record_task_resources(*perf.sample_end(
+                    getattr(func, "__qualname__", digest[:8]), sample))
+            else:
+                result = func(*args, **kwargs)
         else:
             from ray_tpu._private.worker_pool import _RemoteTaskError
 

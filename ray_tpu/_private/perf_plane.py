@@ -1,0 +1,281 @@
+"""Always-on performance plane: stage-latency histograms + per-task
+resource attribution.
+
+TPU-native analogue of the reference's always-on task metrics (the
+per-stage task latencies behind ``ray summary tasks`` and the
+``ray_tasks``/state-summary surfaces layered on the GCS task-events
+service, gcs_task_manager.h) — the standing signal feed scheduling and
+autoscaling read, as opposed to the tracing plane's armed-on-demand
+timelines.
+
+Design constraints (the reasons this can stay on by default):
+
+- **Fixed log-bucketed histograms** (``StageHistogram``): 26 power-of-2
+  buckets from 1µs to ~33s. Observing is one integer ``bit_length``
+  plus two adds under a short lock — no allocation, no formatting, no
+  per-task object. Snapshots are plain count lists, **mergeable by
+  bucket addition**, so daemons ship them piggybacked on the existing
+  heartbeat ``stats_for_sync()`` path and the GCS/driver fold them
+  without losing information.
+- **Durations, not timestamps**: every recorded hop is measured inside
+  ONE process's clock (submit→dispatch on the driver, admission→worker
+  on the daemon, the user function wall in the worker), so the plane
+  needs none of the tracing plane's ClockSync machinery.
+- **One module-attribute branch when disarmed** (``PERF_ON`` — the
+  ``chaos.ACTIVE`` / ``tracing.TRACE_ON`` discipline), armed by the
+  ``perf_plane`` config knob (default on; ``RAY_TPU_PERF_PLANE=0``
+  disarms a whole cluster through the daemon child env).
+
+Stage names (each names the hop that ENDS there; README documents the
+mapping onto the stage_ts chain):
+
+- driver:  ``submit_dispatch`` (.remote() → scheduler claim),
+           ``dispatch_rpc`` (claim → execute RPC sent),
+           ``rpc_seal`` (RPC sent → result sealed, the remote
+           round-trip envelope), ``exec_local`` (driver-local
+           in-thread/pool execution wall)
+- daemon:  ``admit_worker`` (admission → worker frame pickup),
+           ``exec`` (user-function wall, worker-reported)
+
+Per-task resource attribution: workers sample ``time.thread_time`` /
+``getrusage`` / peak-RSS delta around the task body and attach a
+4-tuple to the reply; the owning process rolls it up per function
+signature (count / cpu-seconds / wall / peak RSS). Surfaces:
+``ray_tpu.util.state.summarize_tasks()`` and the
+``ray_tpu_task_resources`` + ``ray_tpu_stage_latency_*`` /metrics
+families.
+"""
+
+from __future__ import annotations
+
+import resource
+import threading
+import time
+
+_thread_time = time.thread_time
+_wall_time = time.time
+_getrusage = resource.getrusage
+_RUSAGE_SELF = resource.RUSAGE_SELF
+
+# Bucket i covers (2^(i-1) µs, 2^i µs]; the last bucket is +Inf.
+N_BUCKETS = 26
+BUCKET_BOUNDS = tuple(1e-6 * (1 << i) for i in range(N_BUCKETS))
+
+# The ONE production branch: instrumentation sites across the runtime
+# check this module attribute and pay nothing else while the plane is
+# disarmed. Armed from config at first Runtime/daemon init.
+PERF_ON: bool = True
+
+
+def _bucket_index(dt_s: float) -> int:
+    """Deterministic log2 bucket for a duration: bucket i holds
+    durations in (2^(i-1), 2^i] microseconds (sub-µs lands in bucket
+    0; overflow saturates into the +Inf bucket)."""
+    if dt_s <= 0.0:
+        return 0
+    n = int(dt_s * 1e6)
+    if n <= 1:
+        return 0
+    idx = (n - 1).bit_length()
+    return idx if idx < N_BUCKETS else N_BUCKETS
+
+
+class StageHistogram:
+    """Lock-cheap fixed-bucket latency histogram.
+
+    ``observe`` is the hot path: one bucket-index computation and three
+    updates under a short lock. ``snapshot()`` returns the mergeable
+    plain-data form ({"counts": [...N_BUCKETS+1 ints], "sum": s,
+    "count": n}) that rides heartbeats and /metrics."""
+
+    __slots__ = ("_counts", "_sum", "_count", "_lock")
+
+    def __init__(self):
+        self._counts = [0] * (N_BUCKETS + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, dt_s: float) -> None:
+        idx = _bucket_index(dt_s)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += dt_s
+            self._count += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"counts": list(self._counts), "sum": self._sum,
+                    "count": self._count}
+
+
+def merge_snapshots(into: dict, snap: dict) -> dict:
+    """Fold one snapshot into an accumulator IN PLACE (bucket-wise
+    addition — the property that makes per-node histograms cluster-
+    aggregatable without approximation). Returns ``into``."""
+    counts = into.setdefault("counts", [0] * (N_BUCKETS + 1))
+    other = snap.get("counts") or []
+    for i in range(min(len(counts), len(other))):
+        counts[i] += int(other[i])
+    into["sum"] = float(into.get("sum", 0.0)) + float(snap.get("sum", 0.0))
+    into["count"] = int(into.get("count", 0)) + int(snap.get("count", 0))
+    return into
+
+
+def quantile(snap: dict, q: float) -> float:
+    """Estimate a quantile from a snapshot by linear interpolation
+    inside the target bucket (upper-bounded by the bucket edge). The
+    +Inf bucket reports the largest finite bound."""
+    counts = snap.get("counts") or []
+    total = int(snap.get("count", 0))
+    if total <= 0 or not counts:
+        return 0.0
+    target = q * total
+    seen = 0
+    for i, c in enumerate(counts):
+        if c <= 0:
+            continue
+        if seen + c >= target:
+            hi = BUCKET_BOUNDS[i] if i < N_BUCKETS \
+                else BUCKET_BOUNDS[-1]
+            lo = BUCKET_BOUNDS[i - 1] if 0 < i <= N_BUCKETS else 0.0
+            frac = (target - seen) / c
+            return lo + (hi - lo) * min(1.0, max(0.0, frac))
+        seen += c
+    return BUCKET_BOUNDS[-1]
+
+
+# --------------------------------------------------------------------------
+# Process-wide stage registry
+# --------------------------------------------------------------------------
+
+_hist_lock = threading.Lock()
+_hists: dict[str, StageHistogram] = {}
+
+
+def record_stage(stage: str, dt_s: float) -> None:
+    """Record one hop duration into this process's histogram for
+    ``stage``. Callers gate on ``PERF_ON`` so the disarmed cost is one
+    module-attribute branch."""
+    hist = _hists.get(stage)
+    if hist is None:
+        with _hist_lock:
+            hist = _hists.setdefault(stage, StageHistogram())
+    hist.observe(dt_s)
+
+
+def stage_snapshot() -> dict:
+    """{stage: histogram snapshot} for every stage this process has
+    recorded (the heartbeat/scrape payload)."""
+    with _hist_lock:
+        hists = dict(_hists)
+    return {stage: h.snapshot() for stage, h in hists.items()}
+
+
+# --------------------------------------------------------------------------
+# Per-task resource attribution
+# --------------------------------------------------------------------------
+
+_res_lock = threading.Lock()
+# func signature -> [count, wall_s sum, cpu_s sum, peak rss delta kb]
+_resources: dict[str, list] = {}
+
+
+def sample_start() -> tuple:
+    """(thread_time, wall, ru_maxrss_kb) before a task body."""
+    return (_thread_time(), _wall_time(),
+            _getrusage(_RUSAGE_SELF).ru_maxrss)
+
+
+def sample_end(name: str, start: tuple) -> tuple:
+    """Finish a sample: (name, wall_s, cpu_s, rss_delta_kb) — the
+    4-tuple that rides worker replies and feeds
+    ``record_task_resources``. RSS is a high-water mark, so the delta
+    is how much this task RAISED the process peak (0 for tasks that
+    fit under it)."""
+    cpu0, wall0, rss0 = start
+    return (name,
+            _wall_time() - wall0,
+            _thread_time() - cpu0,
+            max(0, _getrusage(_RUSAGE_SELF).ru_maxrss - rss0))
+
+
+def record_task_resources(name: str, wall_s: float, cpu_s: float,
+                          rss_delta_kb: float) -> None:
+    with _res_lock:
+        row = _resources.get(name)
+        if row is None:
+            _resources[name] = [1, float(wall_s), float(cpu_s),
+                                float(rss_delta_kb)]
+        else:
+            row[0] += 1
+            row[1] += float(wall_s)
+            row[2] += float(cpu_s)
+            row[3] = max(row[3], float(rss_delta_kb))
+
+
+def resource_snapshot() -> dict:
+    """{func: {count, wall_s, cpu_s, peak_rss_kb}} for this process."""
+    with _res_lock:
+        return {name: {"count": row[0], "wall_s": row[1],
+                       "cpu_s": row[2], "peak_rss_kb": row[3]}
+                for name, row in _resources.items()}
+
+
+def merge_resource_tables(into: dict, table: dict) -> dict:
+    """Fold one per-function table into an accumulator IN PLACE
+    (counts/sums add, peak RSS takes the max)."""
+    for name, row in (table or {}).items():
+        if not isinstance(row, dict):
+            continue
+        acc = into.setdefault(name, {"count": 0, "wall_s": 0.0,
+                                     "cpu_s": 0.0, "peak_rss_kb": 0.0})
+        acc["count"] += int(row.get("count", 0))
+        acc["wall_s"] += float(row.get("wall_s", 0.0))
+        acc["cpu_s"] += float(row.get("cpu_s", 0.0))
+        acc["peak_rss_kb"] = max(acc["peak_rss_kb"],
+                                 float(row.get("peak_rss_kb", 0.0)))
+    return into
+
+
+# --------------------------------------------------------------------------
+# Arm/disarm
+# --------------------------------------------------------------------------
+
+
+def enable() -> None:
+    global PERF_ON
+    PERF_ON = True
+
+
+def disable() -> None:
+    global PERF_ON
+    PERF_ON = False
+
+
+def reset() -> None:
+    """Clear every histogram and the attribution table (tests; a
+    shutdown/init cycle must not replay the previous session's
+    latencies)."""
+    with _hist_lock:
+        _hists.clear()
+    with _res_lock:
+        _resources.clear()
+
+
+def init_from_config() -> None:
+    """Arm/disarm from the ``perf_plane`` knob (driver init and daemon
+    boot both call this; workers inherit RAY_TPU_PERF_PLANE through
+    the child env at import of their config)."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    global PERF_ON
+    PERF_ON = bool(GLOBAL_CONFIG.perf_plane)
+
+
+# Env-driven default: forked/spawned processes (pool workers, daemons)
+# arm the plane at import to match their parent without any handshake.
+try:
+    init_from_config()
+except Exception:  # noqa: BLE001 — config unavailable mid-bootstrap
+    pass

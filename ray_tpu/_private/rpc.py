@@ -192,6 +192,11 @@ def breaker_record(dest: str, ok: bool) -> None:
             # re-arms the timer without re-counting an open.
             if not was_open:
                 _BREAKER_OPENS += 1
+                # Breaker transitions are exactly the kind of rare,
+                # load-bearing event a post-mortem ring should carry.
+                from ray_tpu._private import flight_recorder
+
+                flight_recorder.record("breaker.open", dest)
             breaker.open = True
             breaker.opened_at = time.monotonic()
 

@@ -32,6 +32,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from ray_tpu._private import perf_plane as perf
 from ray_tpu._private.ids import NodeID
 from ray_tpu._private.task import TaskSpec
 from ray_tpu.util import tracing
@@ -574,10 +575,11 @@ class Dispatcher:
                 task.claimed = True
                 self._num_ready_live -= 1
                 self._num_running += 1
-                if tracing.TRACE_ON:
+                if tracing.TRACE_ON or perf.PERF_ON:
                     # Dispatch-claim stage stamp: the run callable's
                     # owner (worker.py) folds it into the task's
-                    # stage_ts map.
+                    # stage_ts map (tracing) and the perf plane's
+                    # dispatch→rpc histogram anchors on it (always-on).
                     task.spec._stage_dispatch = time.time()
                 # Running tasks are past cancellation: drop the cancel
                 # index so a late cancel() can't race the real result
@@ -589,6 +591,15 @@ class Dispatcher:
             if hook is not None:
                 hook(task.spec, "dispatch")
             return False
+        if perf.PERF_ON:
+            # submit→dispatch hop, measured entirely on the driver
+            # clock (outside the scheduler lock — the histogram has
+            # its own short lock).
+            sub = getattr(task.spec, "_submit_ts", None)
+            claim = getattr(task.spec, "_stage_dispatch", None)
+            if sub is not None and claim is not None:
+                perf.record_stage("submit_dispatch",
+                                  max(0.0, claim - sub))
         return True
 
     def _drain_groups(self, batches: dict | None = None) -> int:

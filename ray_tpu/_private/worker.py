@@ -31,6 +31,7 @@ import time
 from typing import Any, Iterable, Sequence
 
 from ray_tpu._private import accelerators
+from ray_tpu._private import perf_plane as perf
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.gcs import (
     ActorRecord,
@@ -345,6 +346,17 @@ class Runtime:
         cfg = GLOBAL_CONFIG
         self.namespace = namespace
         self.job_id = JobID()
+        # Always-on performance plane: arm/disarm from the (possibly
+        # system_config-overridden) knob, and clear the previous
+        # session's histograms — an init/shutdown cycle must not
+        # replay old latencies into this session's scrape.
+        perf.init_from_config()
+        perf.reset()
+        # Driver-side flight recorder: ring only (no flusher thread,
+        # no per-driver files) — `ray_tpu debug` reads it live.
+        from ray_tpu._private import flight_recorder
+
+        flight_recorder.install("driver")
         # Connected-cluster mode: register this driver with an external
         # head GCS (python -m ray_tpu start --head) and mirror its node
         # table into nodes()/state listings. Task execution stays local
@@ -489,7 +501,9 @@ class Runtime:
                 os.environ["RAY_TPU_WORKER_LOG_DIR"] = log_dir
                 from ray_tpu._private.log_monitor import LogMonitor
 
-                self.log_monitor = LogMonitor(log_dir).start()
+                self.log_monitor = LogMonitor(
+                    log_dir,
+                    context_fn=self._worker_log_context).start()
             self.worker_pool = WorkerPool(
                 int(pool_size), self.shm_directory, self.shm_client)
             refresh_ms = int(cfg.memory_monitor_refresh_ms or 0)
@@ -1126,6 +1140,9 @@ class Runtime:
 
         logger.warning("Node %s died; reconstructing its objects",
                        node_id.hex()[:8])
+        from ray_tpu._private import flight_recorder
+
+        flight_recorder.record("node.dead", node_id.hex()[:16])
         self.remove_node(node_id)
         # Queued tasks HARD-pinned to the dead node can never run; fail
         # them now instead of hanging their waiters forever (soft
@@ -1177,6 +1194,9 @@ class Runtime:
         from ray_tpu._private.node_executor import RemoteBlob
         from ray_tpu.exceptions import ObjectLostError
 
+        from ray_tpu._private import flight_recorder
+
+        flight_recorder.record("object.loss", len(obj_hexes))
         for obj_hex in obj_hexes:
             try:
                 oid = ObjectID(bytes.fromhex(obj_hex))
@@ -1405,13 +1425,17 @@ class Runtime:
         rec.cancelled = False
         rec.deadline = deadline
         rec.state = _SubmitRecord.BUFFERED
-        if tracing.TRACE_ON:
-            # The trace context roots at the TRUE .remote() call (and
-            # links to the caller's open span — the flush thread has no
-            # ambient span context, so it cannot be made there).
+        if tracing.TRACE_ON or perf.PERF_ON:
+            # Submit stamped at the TRUE .remote() call: the perf
+            # plane's submit→dispatch histogram measures ring + queue
+            # wait from here (always-on); the trace context (tracing
+            # armed only) additionally links to the caller's open span
+            # — the flush thread has no ambient span context, so
+            # neither can be made there.
             now = time.time()
             rec.submit_ts = now
-            rec.trace_ctx = tracing.make_trace_context(anchor=now)
+            if tracing.TRACE_ON:
+                rec.trace_ctx = tracing.make_trace_context(anchor=now)
         # Register the refs directly against OUR counter: the generic
         # ObjectRef constructor re-resolves the global runtime per ref,
         # which is measurable at 100k submits.
@@ -1468,13 +1492,16 @@ class Runtime:
         refs = [ObjectRef(rid) for rid in return_ids]
         self.lineage.record(spec)
         submit_stages = {}
-        if tracing.TRACE_ON:
-            # Root of this task's distributed trace: the context rides
-            # the execute RPCs so daemon/worker spans link back here.
+        if tracing.TRACE_ON or perf.PERF_ON:
             now = time.time()
-            spec._trace_ctx = tracing.make_trace_context(anchor=now)
-            if bool(GLOBAL_CONFIG.tracing_stage_timestamps):
-                submit_stages = {"submit": now}
+            spec._submit_ts = now
+            if tracing.TRACE_ON:
+                # Root of this task's distributed trace: the context
+                # rides the execute RPCs so daemon/worker spans link
+                # back here.
+                spec._trace_ctx = tracing.make_trace_context(anchor=now)
+                if bool(GLOBAL_CONFIG.tracing_stage_timestamps):
+                    submit_stages = {"submit": now}
         self.gcs.record_task_event(TaskEvent(task_id, name, "PENDING",
                                              stage_ts=submit_stages))
         deps = [a for a in args if isinstance(a, ObjectRef)] + [
@@ -1602,6 +1629,10 @@ class Runtime:
                 continue
             if rec.trace_ctx is not None:
                 spec._trace_ctx = rec.trace_ctx
+            if rec.submit_ts:
+                # Perf plane: the submit→dispatch histogram anchors on
+                # the true .remote() stamp, not the flush time.
+                spec._submit_ts = rec.submit_ts
             events.append(TaskEvent(
                 rec.task_id, rec.name, "PENDING",
                 stage_ts={"submit": rec.submit_ts}
@@ -1800,11 +1831,18 @@ class Runtime:
                     spec.args, spec.kwargs, lambda ref: self.get([ref])[0])
                 if block_ctx is not None:
                     block_ctx.__enter__()
+                sample = perf.sample_start() if perf.PERF_ON else None
                 try:
                     result = spec.func(*resolved_args, **resolved_kwargs)
                 finally:
                     if block_ctx is not None:
                         block_ctx.__exit__(None, None, None)
+                if sample is not None:
+                    # In-thread execution: the driver is the worker, so
+                    # attribution samples land directly.
+                    s = perf.sample_end(spec.name, sample)
+                    perf.record_task_resources(*s)
+                    perf.record_stage("exec_local", s[1])
                 self._store_task_result(spec, result, node)
             self.gcs.record_task_event(TaskEvent(
                 spec.task_id, spec.name, "FINISHED", start_time=start,
@@ -1911,11 +1949,14 @@ class Runtime:
             with self._inflight_blocks_lock:
                 self._inflight_blocks[token] = BlockedResourceContext(
                     self.cluster, node.node_id, spec.resources)
+        # stages_out doubles as the perf-plane carrier even untraced:
+        # the pool reply's resource sample rolls up on this driver.
+        perf_stages: dict | None = {} if perf.PERF_ON else None
         try:
             results = self.worker_pool.run_task_blobs(
                 digest, func_blob, args_blob, spec.num_returns,
                 spec.return_ids, runtime_env=spec.runtime_env,
-                task_token=token)
+                task_token=token, stages_out=perf_stages)
         except _RemoteTaskError as rte:
             rte.cause.__ray_tpu_remote_tb__ = rte.remote_tb
             raise rte.cause from None
@@ -1927,6 +1968,15 @@ class Runtime:
                 # release is still outstanding; undo it before the
                 # dispatcher's own release double-counts availability.
                 ctx.drain()
+        if perf_stages:
+            sample = perf_stages.get("perf")
+            if sample is not None:
+                try:
+                    perf.record_task_resources(sample[0], sample[1],
+                                               sample[2], sample[3])
+                    perf.record_stage("exec_local", float(sample[1]))
+                except (TypeError, IndexError):
+                    pass
         for rid, value in results:
             self.store.put(rid, value)
             if node is not None:
@@ -2068,6 +2118,11 @@ class Runtime:
         trace_ctx = getattr(spec, "_trace_ctx", None) \
             if tracing.TRACE_ON else None
         t_send = time.time()
+        if perf.PERF_ON:
+            claim = getattr(spec, "_stage_dispatch", None)
+            if claim is not None:
+                perf.record_stage("dispatch_rpc",
+                                  max(0.0, t_send - claim))
         try:
             results, reply_trace = handle.execute(
                 digest, func_blob, args_blob, spec.num_returns,
@@ -2092,6 +2147,11 @@ class Runtime:
                 popped.drain()
         self._seal_remote_results(spec.return_ids, results,
                                   node.node_id, handle.address)
+        if perf.PERF_ON:
+            # The remote round-trip envelope (rpc_sent → seal): the
+            # daemon-side breakdown of this window lives in ITS
+            # admit_worker/exec histograms.
+            perf.record_stage("rpc_seal", time.time() - t_send)
         if reply_trace is not None:
             self._ingest_reply_trace(spec, handle, reply_trace, t_send,
                                      time.time())
@@ -2232,6 +2292,10 @@ class Runtime:
                 spec = spec_by_idx.get(idx)
                 if spec is None:
                     continue  # duplicate reply
+                if perf.PERF_ON and reply[0] in ("ok", "err"):
+                    # rpc_sent→seal per task (the streamed group's
+                    # arrival is each member's seal moment).
+                    perf.record_stage("rpc_seal", max(0.0, end - t_send))
                 if reply[0] == "ok":
                     try:
                         self._collect_remote_results(
@@ -2313,6 +2377,12 @@ class Runtime:
 
         transport_exc: BaseException | None = None
         t_send = time.time()  # rpc_sent stamp + the ClockSync anchor
+        if perf.PERF_ON:
+            for spec in spec_by_idx.values():
+                claim = getattr(spec, "_stage_dispatch", None)
+                if claim is not None:
+                    perf.record_stage("dispatch_rpc",
+                                      max(0.0, t_send - claim))
         if entries:
             try:
                 handle.execute_batch(entries, on_results, on_parked,
@@ -2447,6 +2517,37 @@ class Runtime:
                 packages.append(entry)
         return {"packages": packages,
                 "pip_install_options": norm["pip_install_options"]}
+
+    def _worker_log_context(self, base: str) -> "str | None":
+        """Owner attribution for tailed worker logs: map the log file's
+        worker index → live pid → the actor record executing there
+        (ActorRecord.pid), so interleaved actor output is labeled with
+        the actor id rather than an anonymous worker name."""
+        pool = self.worker_pool
+        if pool is None or not base.startswith("worker-w"):
+            return None
+        try:
+            index = int(base[len("worker-w"):])
+        except ValueError:
+            return None
+        pids = []
+        with pool._index_lock:
+            for w in pool._all_workers:
+                if w.index == index:
+                    pids.append(w.proc.pid)
+        if not pids:
+            # Process actors own dedicated workers outside the shared
+            # pool (ProcessActor -> PoolWorker(-1)).
+            for actor in list(self._actors.values()):
+                w = getattr(actor, "_worker", None)
+                if w is not None and getattr(w, "index", None) == index:
+                    pids.append(w.proc.pid)
+        if len(pids) != 1:
+            return None  # unknown or ambiguous: keep the plain prefix
+        for rec in self.gcs.list_actors():
+            if rec.pid == pids[0] and rec.state == "ALIVE":
+                return f"actor={rec.actor_id.hex()[:8]}"
+        return None
 
     def lookup_block_context(self, token: str):
         """Block context of an in-flight pool task (client server calls

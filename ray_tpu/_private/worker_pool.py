@@ -46,6 +46,7 @@ import traceback
 from dataclasses import dataclass
 from typing import Any, Callable
 
+from ray_tpu._private import perf_plane as perf
 from ray_tpu._private import serialization
 from ray_tpu._private.ids import ActorID, ObjectID
 from ray_tpu._private.shm_store import (
@@ -311,8 +312,18 @@ def worker_main(conn) -> None:
         arena = ArenaStore.attach(arena_name)
         client.set_arena(arena)
     arena_max = int(os.environ.get("RAY_TPU_ARENA_MAX", 1024 * 1024))
+    # Flight recorder: no flusher thread (workers are many and
+    # short-lived) — the ring dumps only on a fatal serve-loop error,
+    # and lives in memory for lifecycle records until then.
+    from ray_tpu._private import flight_recorder
+
+    flight_recorder.install("worker")
     try:
         _serve(conn, client, arena, arena_max)
+    except BaseException:
+        flight_recorder.record("worker.fatal")
+        flight_recorder.dump("fatal")
+        raise
     finally:
         client.close_all()
         if arena is not None:
@@ -355,8 +366,18 @@ def _exec_task_body(fields: tuple, func_cache: dict,
     try:
         if stages is not None:
             stages["exec_start"] = time.time()
+        # Always-on attribution sample (perf_plane): cpu-seconds, wall
+        # and peak-RSS delta around the user function, shipped back as
+        # a 4-tuple in the stages element — the daemon/driver rolls it
+        # up per function signature. Gated by the SENDER (stages is
+        # only created when the owning daemon/driver asked), so a
+        # runtime disarm propagates to workers with the next frame.
+        sample = perf.sample_start() if stages is not None else None
         with _runtime_env_ctx(renv):
             result = func(*args, **kwargs)
+        if sample is not None:
+            stages["perf"] = perf.sample_end(
+                getattr(func, "__qualname__", digest[:8]), sample)
         if stages is not None:
             stages["exec_end"] = time.time()
     finally:
@@ -417,14 +438,19 @@ def _serve(conn, client: ShmClient, arena=None,
                 # Optional 10th message element: the driver's trace
                 # context — stamp frame pickup + exec times and return
                 # them as a third reply element (same shape as the
-                # pipelined task_seq protocol).
-                traced = len(msg) > 9 and msg[9] is not None
+                # pipelined task_seq protocol). The always-on perf
+                # plane rides the SAME slot as the sentinel ``False``:
+                # the sender's plane is armed but tracing is not, so
+                # stamp pickup + the resource sample without any trace
+                # machinery (None/absent ⇒ both planes off).
+                slot = msg[9] if len(msg) > 9 else None
                 stages = {"worker_start": time.time(),
-                          "pid": os.getpid()} if traced else None
+                          "pid": os.getpid()} \
+                    if slot is not None else None
                 packed = _exec_task_body(
                     msg[1:], func_cache, client, arena, arena_max,
                     stages=stages)
-                conn.send(("ok", packed, stages) if traced
+                conn.send(("ok", packed, stages) if stages is not None
                           else ("ok", packed))
             elif kind == "task_seq":
                 # Pipelined protocol: frames arrive back-to-back (the
@@ -436,7 +462,10 @@ def _serve(conn, client: ShmClient, arena=None,
                 # a 5th reply element (worker and daemon share a host,
                 # so these are daemon-clock timestamps).
                 call_id = msg[1]
-                traced = len(msg) > 10 and msg[10] is not None
+                # 11th element: trace context, or the ``False`` perf
+                # sentinel (see the "task" protocol above).
+                slot = msg[10] if len(msg) > 10 else None
+                traced = slot is not None
                 # Optional 12th element: the absolute end-to-end
                 # deadline — a frame whose budget died queued behind
                 # the lease head is refused, never executed.
@@ -446,7 +475,8 @@ def _serve(conn, client: ShmClient, arena=None,
                     conn.send(reply + (None,) if traced else reply)
                     continue
                 stages = {"worker_start": time.time(),
-                          "pid": os.getpid()} if traced else None
+                          "pid": os.getpid()} \
+                    if slot is not None else None
                 try:
                     packed = _exec_task_body(
                         msg[2:], func_cache, client, arena, arena_max,
@@ -454,10 +484,11 @@ def _serve(conn, client: ShmClient, arena=None,
                 except BaseException as exc:  # noqa: BLE001 — per-task
                     reply = ("task_done", call_id, "err",
                              _exception_blob(exc))
-                    conn.send(reply + (stages,) if traced else reply)
+                    conn.send(reply + (stages,)
+                              if stages is not None else reply)
                 else:
                     reply = ("task_done", call_id, "ok", packed)
-                    if traced:
+                    if stages is not None:
                         reply = reply + (stages,)
                     conn.send(reply)
             elif kind == "actor_new":
@@ -1126,12 +1157,16 @@ class WorkerPool:
                              task.client_addr,
                              task.sys_path if blob is not None
                              else None)
-                    if task.trace is not None or \
-                            task.deadline is not None:
-                        # Optional 11th/12th elements: trace context
+                    # Trace context, or the False perf-plane sentinel
+                    # (this process's gate — workers follow the sender
+                    # so a runtime disarm takes effect frame-by-frame).
+                    slot = task.trace if task.trace is not None \
+                        else (False if perf.PERF_ON else None)
+                    if slot is not None or task.deadline is not None:
+                        # Optional 11th/12th elements: trace/perf slot
                         # and the absolute deadline (absent on both ⇒
                         # the plain frame shape, byte-identical).
-                        frame = frame + (task.trace,)
+                        frame = frame + (slot,)
                     if task.deadline is not None:
                         frame = frame + (task.deadline,)
                     try:
@@ -1288,8 +1323,10 @@ class WorkerPool:
                     container=container)
                 msg = ("task", digest, func_blob, args_blob, n_returns,
                        runtime_env, task_token, client_addr, sys_path)
-                if trace is not None:
-                    msg = msg + (trace,)
+                slot = trace if trace is not None \
+                    else (False if perf.PERF_ON else None)
+                if slot is not None:
+                    msg = msg + (slot,)
                 reply = worker.request(msg)
                 self._copy_reply_stages(reply, stages_out)
                 return self._unpack_reply(reply, return_ids)
@@ -1307,8 +1344,10 @@ class WorkerPool:
             msg = ("task", digest, send_blob, args_blob, n_returns,
                    runtime_env, task_token, client_addr,
                    sys_path if send_blob is not None else None)
-            if trace is not None:
-                msg = msg + (trace,)
+            slot = trace if trace is not None \
+                else (False if perf.PERF_ON else None)
+            if slot is not None:
+                msg = msg + (slot,)
             try:
                 reply = worker.request(msg)
             except _WorkerUnavailable:

@@ -60,6 +60,8 @@ class Dashboard:
                 pass
 
             def do_GET(self):
+                truncated = False
+                total = None
                 try:
                     if self.path in ("/", "/index.html"):
                         payload = dashboard._render_html().encode()
@@ -70,6 +72,13 @@ class Dashboard:
                         if data is None:
                             self.send_error(404, f"unknown: {section}")
                             return
+                        # State listings know when limit= dropped rows
+                        # (util.state.ListResult); surface it as a
+                        # header so API consumers never mistake a
+                        # capped listing for the whole table.
+                        truncated = bool(getattr(data, "truncated",
+                                                 False))
+                        total = getattr(data, "total", None)
                         payload = json.dumps(data, default=str).encode()
                         ctype = "application/json"
                     else:
@@ -81,6 +90,10 @@ class Dashboard:
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(payload)))
+                if truncated:
+                    self.send_header("X-Ray-Tpu-Truncated", "true")
+                    if total is not None:
+                        self.send_header("X-Ray-Tpu-Total", str(total))
                 self.end_headers()
                 self.wfile.write(payload)
 

@@ -282,8 +282,10 @@ def cmd_serve(args) -> int:
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    # `list ...` routes to the state CLI (ray_tpu/util/state).
-    if argv and argv[0] in ("list", "summary", "timeline"):
+    # `list ...` routes to the state CLI (ray_tpu/util/state);
+    # `summary` (per-function latency/resource percentiles) and
+    # `debug` (flight-recorder post-mortem bundle) live there too.
+    if argv and argv[0] in ("list", "summary", "timeline", "debug"):
         from ray_tpu.util.state.api import _cli
 
         return _cli(argv)

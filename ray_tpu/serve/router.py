@@ -15,6 +15,7 @@ import threading
 import time
 from typing import Any
 
+from ray_tpu._private import perf_plane
 from ray_tpu.serve.long_poll import LongPollClient
 from ray_tpu.serve.replica import BackPressureError
 
@@ -33,7 +34,8 @@ class DeploymentStreamingResponse:
     _POLL_S = 0.2
 
     def __init__(self, queue, object_ref, router=None, replica_idx=None,
-                 request=None, model_id=None, timeout_s: float = 300.0):
+                 request=None, model_id=None, timeout_s: float = 300.0,
+                 started=None):
         self._queue = queue
         self._ref = object_ref
         self._router = router
@@ -43,11 +45,15 @@ class DeploymentStreamingResponse:
         self._timeout_s = timeout_s
         self._done = False
         self._yielded = 0
+        self._started = started
 
     def _release(self):
         if self._router is not None and self._replica_idx is not None:
             self._router._release(self._replica_idx)
             self._replica_idx = None
+            if self._started is not None:
+                self._router.observe_latency(time.time() - self._started)
+                self._started = None
 
     def _close(self):
         """Terminal cleanup: give back the replica slot and tear down
@@ -183,18 +189,26 @@ class DeploymentResponse:
     """
 
     def __init__(self, object_ref, router=None, replica_idx=None,
-                 request=None, model_id=None, deadline=None):
+                 request=None, model_id=None, deadline=None,
+                 started=None):
         self._ref = object_ref
         self._router = router
         self._replica_idx = replica_idx
         self._request = request  # (method_name, args, kwargs)
         self._model_id = model_id  # multiplex affinity on retries
         self._deadline = deadline  # absolute; re-armed on retries
+        self._started = started  # router latency stamp (assign time)
 
     def _release(self):
         if self._router is not None and self._replica_idx is not None:
             self._router._release(self._replica_idx)
             self._replica_idx = None
+            if self._started is not None:
+                # End-to-end router latency (assign → final release,
+                # backpressure retries included): the per-deployment
+                # p99 the autoscaler consumes.
+                self._router.observe_latency(time.time() - self._started)
+                self._started = None
 
     def result(self, timeout_s: float | None = None):
         import ray_tpu
@@ -281,6 +295,11 @@ class Router:
         # of queueing unboundedly. shed_total feeds the overload bench.
         self._max_queued: int | None = None
         self.shed_total = 0
+        # Always-on per-deployment latency histogram (assign→release,
+        # perf_plane log buckets): exported as ray_tpu_serve_latency_*
+        # and queryable live via latency_stats() — the p99 the serve
+        # autoscaler (ROADMAP item 2) reads without arming tracing.
+        self._latency = perf_plane.StageHistogram()
         self._replicas: list[Any] = []          # ActorHandles
         # In-flight counts keyed by replica IDENTITY (actor id), so
         # membership changes neither zero live load nor cross-release a
@@ -351,6 +370,21 @@ class Router:
             if self._inflight.get(key, 0) > 0:
                 self._inflight[key] -= 1
 
+    def observe_latency(self, dt_s: float) -> None:
+        self._latency.observe(max(0.0, dt_s))
+
+    def latency_stats(self) -> dict:
+        """Live latency summary for this deployment: count / mean /
+        p50 / p99 (bucket-interpolated upper bounds)."""
+        snap = self._latency.snapshot()
+        count = snap["count"]
+        return {
+            "count": count,
+            "mean_s": (snap["sum"] / count) if count else 0.0,
+            "p50_s": perf_plane.quantile(snap, 0.5),
+            "p99_s": perf_plane.quantile(snap, 0.99),
+        }
+
     def _max_queued_limit(self) -> int:
         """DeploymentConfig.max_queued_requests, cached (-1 =
         unlimited; controller unreachable degrades to unlimited)."""
@@ -411,7 +445,8 @@ class Router:
                 f"Deployment {self._deployment_name}: no replicas came up "
                 f"within {timeout_s}s")
         self._check_shed()
-        deadline = (time.time() + deadline_s
+        started = time.time()
+        deadline = (started + deadline_s
                     if deadline_s is not None else None)
         idx, handle = self._pick(model_id=model_id)
         if stream_queue is not None:
@@ -420,7 +455,8 @@ class Router:
                 method_name, args, kwargs, stream_queue)
             return DeploymentStreamingResponse(
                 stream_queue, ref, router=self, replica_idx=idx,
-                request=(method_name, args, kwargs), model_id=model_id)
+                request=(method_name, args, kwargs), model_id=model_id,
+                started=started)
         ref = self._bind_deadline(
             handle.handle_request, deadline).remote(
             method_name, args, kwargs)
@@ -430,7 +466,7 @@ class Router:
         return DeploymentResponse(
             ref, router=self, replica_idx=idx,
             request=(method_name, args, kwargs), model_id=model_id,
-            deadline=deadline)
+            deadline=deadline, started=started)
 
     def shutdown(self) -> None:
         self._long_poll.stop()
@@ -438,24 +474,67 @@ class Router:
 
 _routers_lock = threading.Lock()
 _routers: dict[tuple[str, str], Router] = {}
+_latency_collector_remove = None
+
+
+def _serve_latency_lines() -> list[str]:
+    """Scrape-time collector: every live router's latency histogram as
+    ray_tpu_serve_latency_* families labeled by deployment."""
+    from ray_tpu.util.metrics import _escape_label
+
+    with _routers_lock:
+        routers = dict(_routers)
+    lines: list[str] = []
+    if not routers:
+        return lines
+    lines.append("# TYPE ray_tpu_serve_latency histogram")
+    for (_app, name), router in sorted(routers.items()):
+        snap = router._latency.snapshot()
+        counts = snap.get("counts") or []
+        label = f'deployment="{_escape_label(name)}"'
+        cum = 0
+        for i, bound in enumerate(perf_plane.BUCKET_BOUNDS):
+            cum += int(counts[i]) if i < len(counts) else 0
+            lines.append(f'ray_tpu_serve_latency_bucket{{{label},'
+                         f'le="{bound:g}"}} {cum}')
+        total = int(snap.get("count", 0))
+        lines.append(f'ray_tpu_serve_latency_bucket{{{label},'
+                     f'le="+Inf"}} {total}')
+        lines.append(f'ray_tpu_serve_latency_sum{{{label}}} '
+                     f'{float(snap.get("sum", 0.0)):.6f}')
+        lines.append(f'ray_tpu_serve_latency_count{{{label}}} {total}')
+    return lines
 
 
 def get_or_create_router(controller_handle, app_name: str,
                          deployment_name: str) -> Router:
+    global _latency_collector_remove
     with _routers_lock:
         key = (app_name, deployment_name)
         router = _routers.get(key)
         if router is None:
             router = Router(controller_handle, app_name, deployment_name)
             _routers[key] = router
+        if _latency_collector_remove is None:
+            from ray_tpu.util.metrics import REGISTRY
+
+            _latency_collector_remove = REGISTRY.add_collector(
+                _serve_latency_lines)
         return router
 
 
 def clear_routers() -> None:
+    global _latency_collector_remove
     with _routers_lock:
         for router in _routers.values():
             router.shutdown()
         _routers.clear()
+        if _latency_collector_remove is not None:
+            try:
+                _latency_collector_remove()
+            except Exception:  # noqa: BLE001 — registry already cleared
+                pass
+            _latency_collector_remove = None
 
 
 class DeploymentHandle:

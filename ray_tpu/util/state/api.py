@@ -7,9 +7,12 @@ supports ``=``/``!=``, and ``limit`` caps the result size.
 
 from __future__ import annotations
 
+import logging
 from typing import Any
 
 from ray_tpu._private import worker as worker_mod
+
+logger = logging.getLogger("ray_tpu")
 
 
 def _runtime():
@@ -19,7 +22,19 @@ def _runtime():
     return runtime
 
 
-def _apply_filters(rows: list[dict], filters, limit: int) -> list[dict]:
+class ListResult(list):
+    """A listing that KNOWS it was capped: ``truncated`` is True when
+    ``limit`` dropped rows and ``total`` is the pre-cap match count
+    (reference: the state API's NUM_AFTER_TRUNCATION warning — a
+    silently capped list reads as 'that's everything' otherwise).
+    Serializes as a plain JSON list; the dashboard surfaces the flag
+    as an X-Ray-Tpu-Truncated response header."""
+
+    truncated: bool = False
+    total: int = 0
+
+
+def _apply_filters(rows: list[dict], filters, limit: int) -> ListResult:
     for key, op, value in (filters or []):
         if op == "=":
             rows = [r for r in rows if str(r.get(key)) == str(value)]
@@ -27,7 +42,14 @@ def _apply_filters(rows: list[dict], filters, limit: int) -> list[dict]:
             rows = [r for r in rows if str(r.get(key)) != str(value)]
         else:
             raise ValueError(f"Unsupported filter op {op!r}; use '=' or '!='")
-    return rows[:limit]
+    out = ListResult(rows[:limit])
+    out.total = len(rows)
+    out.truncated = len(rows) > limit
+    if out.truncated:
+        logger.warning(
+            "state listing truncated: %d of %d rows returned "
+            "(raise limit= to see the rest)", limit, len(rows))
+    return out
 
 
 # ------------------------------------------------------------------- tasks
@@ -59,13 +81,73 @@ def get_task(task_id: str) -> dict | None:
     return None
 
 
+def _percentile(sorted_vals: list, q: float) -> float:
+    """Nearest-rank percentile of a pre-sorted sample."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(q * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def _cluster_task_resources(runtime) -> dict:
+    """Per-function attribution merged across the cluster: this
+    driver's table + every node's heartbeat-shipped snapshot from the
+    GCS aggregation table."""
+    from ray_tpu._private import perf_plane
+
+    merged: dict[str, dict] = {}
+    perf_plane.merge_resource_tables(
+        merged, perf_plane.resource_snapshot())
+    client = getattr(runtime, "gcs_client", None)
+    if client is not None:
+        try:
+            by_node = client.call("node_stats", timeout_s=2.0) or {}
+        except Exception:  # noqa: BLE001 — head unreachable: local view
+            by_node = {}
+    else:
+        by_node = runtime.gcs.node_stats()
+    for stats in by_node.values():
+        if isinstance(stats, dict):
+            perf_plane.merge_resource_tables(
+                merged, stats.get("task_resources") or {})
+    return merged
+
+
 def summarize_tasks() -> dict:
-    """Counts by (name, state) (reference: summarize_tasks api.py:1376)."""
+    """Counts by (name, state), plus the always-on performance plane's
+    per-function views (reference: summarize_tasks api.py:1376 and the
+    per-stage task latency summaries):
+
+    - ``latency``: wall-clock count/mean/p50/p95/p99 per function from
+      the task-event table (exact sample percentiles — recorded with
+      tracing disabled);
+    - ``resources``: cpu-seconds / wall / peak-RSS attribution per
+      function signature, merged across the driver and every node.
+    """
     summary: dict[str, dict[str, int]] = {}
-    for row in list_tasks(limit=10**9):
-        per_name = summary.setdefault(row["name"], {})
-        per_name[row["state"]] = per_name.get(row["state"], 0) + 1
-    return {"node_count": len(list_nodes(limit=10**9)), "summary": summary}
+    durations: dict[str, list] = {}
+    runtime = _runtime()
+    for ev in runtime.gcs.list_task_events():
+        per_name = summary.setdefault(ev.name, {})
+        per_name[ev.state] = per_name.get(ev.state, 0) + 1
+        if ev.state == "FINISHED" and ev.end_time and ev.start_time:
+            durations.setdefault(ev.name, []).append(
+                ev.end_time - ev.start_time)
+    latency: dict[str, dict] = {}
+    for name, vals in durations.items():
+        vals.sort()
+        latency[name] = {
+            "count": len(vals),
+            "mean_s": sum(vals) / len(vals),
+            "p50_s": _percentile(vals, 0.50),
+            "p95_s": _percentile(vals, 0.95),
+            "p99_s": _percentile(vals, 0.99),
+        }
+    return {"node_count": len(list_nodes(limit=10**9)),
+            "summary": summary,
+            "latency": latency,
+            "resources": _cluster_task_resources(runtime)}
 
 
 # ------------------------------------------------------------------ actors
@@ -213,16 +295,120 @@ def _cli(argv: list[str]) -> int:
                  "objects": summarize_objects}
     if argv and argv[0] == "timeline":
         return _cli_timeline(argv[1:])
+    if argv and argv[0] == "debug":
+        return _cli_debug(argv[1:])
+    if argv and argv[0] == "summary" and len(argv) == 1:
+        # `python -m ray_tpu summary` — the per-function latency/
+        # resource summary is the flagship view; default to tasks.
+        argv = ["summary", "tasks"]
     if len(argv) < 2:
         print("usage: python -m ray_tpu.util.state "
-              "{list|summary} <resource> | timeline [output.json]")
+              "{list|summary} <resource> | summary | "
+              "timeline [output.json] | debug [bundle.json]")
         return 2
     verb, resource = argv[0], argv[1]
     table = listings if verb == "list" else summaries if verb == "summary" else None
     if table is None or resource not in table:
         print(f"unknown: {verb} {resource}; resources: {sorted(table or listings)}")
         return 2
+    _ensure_connected()
     print(json.dumps(table[resource](), indent=2, default=str))
+    return 0
+
+
+def _ensure_connected() -> None:
+    """CLI entry: attach to a running cluster when one is reachable,
+    else a local runtime (mirrors the timeline CLI's behavior)."""
+    import ray_tpu
+
+    if worker_mod.global_runtime() is not None:
+        return
+    try:
+        ray_tpu.init(address="auto", num_cpus=0,
+                     ignore_reinit_error=True)
+    except (ConnectionError, OSError):
+        ray_tpu.init(ignore_reinit_error=True)
+
+
+def collect_debug_bundle(out_path: str) -> dict:
+    """``ray_tpu debug``: one post-mortem bundle from everything
+    reachable — the session dir's dumped flight-recorder rings (dead
+    daemons included), every live node's ring + fault/breaker/stage
+    state over the ``flight_ring`` RPC, this driver's own ring, and
+    the GCS node-stats aggregation table (reference intent: `ray
+    cluster-dump`). Works degraded: with no cluster reachable the
+    bundle still carries the session-dir dumps."""
+    import json
+    import time
+
+    from ray_tpu._private import flight_recorder, perf_plane
+    from ray_tpu._private.rpc import RpcClient, breaker_stats
+
+    bundle: dict = {
+        "collected_at": time.time(),
+        "session_dir": flight_recorder.flight_dir(),
+        "session_dumps": flight_recorder.collect_session_dumps(),
+        "nodes": {},
+    }
+    runtime = worker_mod.global_runtime()
+    if runtime is not None:
+        rec = flight_recorder.get()
+        bundle["driver"] = {
+            **(rec.snapshot() if rec is not None else {"events": []}),
+            "fault_stats": runtime.fault_stats(),
+            "breaker": breaker_stats(),
+            "stage_hist": perf_plane.stage_snapshot(),
+        }
+        client = getattr(runtime, "gcs_client", None)
+        if client is not None:
+            try:
+                bundle["gcs_node_stats"] = client.call(
+                    "node_stats", timeout_s=3.0)
+            except Exception:  # noqa: BLE001 — head unreachable
+                bundle["gcs_node_stats"] = {}
+            try:
+                node_rows = client.call("list_nodes")
+            except Exception:  # noqa: BLE001
+                node_rows = []
+        else:
+            bundle["gcs_node_stats"] = runtime.gcs.node_stats()
+            node_rows = [{"node_id": r.node_id.hex(),
+                          "alive": r.alive,
+                          "executor_address": r.executor_address}
+                         for r in runtime.gcs.list_nodes()]
+        for row in node_rows:
+            addr = row.get("executor_address")
+            if not row.get("alive") or not addr:
+                continue
+            try:
+                client = RpcClient(addr, timeout_s=3.0,
+                                   connect_timeout_s=2.0)
+                try:
+                    ring = client.call("flight_ring")
+                finally:
+                    client.close()
+            except Exception as exc:  # noqa: BLE001 — skip unreachable
+                ring = {"error": f"unreachable: {type(exc).__name__}"}
+            bundle["nodes"][row.get("node_id", addr)[:16]] = ring
+    with open(out_path, "w") as f:
+        json.dump(bundle, f, indent=2, default=str)
+    return bundle
+
+
+def _cli_debug(argv: list[str]) -> int:
+    out = argv[0] if argv else "ray_tpu_debug_bundle.json"
+    try:
+        _ensure_connected()
+    except Exception as exc:  # noqa: BLE001 — degraded bundle still useful
+        print(f"note: no cluster reachable ({exc}); collecting "
+              f"session-dir dumps only")
+    bundle = collect_debug_bundle(out)
+    rings = len(bundle.get("session_dumps", [])) \
+        + len(bundle.get("nodes", {})) \
+        + (1 if "driver" in bundle else 0)
+    print(f"wrote {out}: {rings} flight-recorder rings "
+          f"({len(bundle.get('session_dumps', []))} dumped files, "
+          f"{len(bundle.get('nodes', {}))} live nodes)")
     return 0
 
 

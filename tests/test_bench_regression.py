@@ -236,6 +236,39 @@ def test_bench_envelope_tasks_row_records_overload_counters():
                 f"tasks row faults lost the overload counter {key!r}")
 
 
+def test_bench_envelope_tasks_row_records_perf_plane_budget():
+    """The always-on performance plane (ISSUE 8) must be ARMED in the
+    committed envelope row — its cost is part of the product — and the
+    row must carry the A/B calibration proving that arming it costs
+    ≤5% exec_per_s vs the disarmed number. A refresh that loses the
+    annotation, records with the plane disarmed, or shows the plane
+    eating more than the budget is refused outright."""
+    if not BENCH_ENVELOPE.exists():
+        pytest.skip("BENCH_ENVELOPE.json not present in the working "
+                    "tree")
+    doc = json.loads(BENCH_ENVELOPE.read_text())
+    tasks_rows = [r for r in doc.get("phases", [])
+                  if r.get("phase") == "tasks"]
+    assert tasks_rows, "envelope lost its tasks phase"
+    for row in tasks_rows:
+        plane = row.get("perf_plane")
+        assert isinstance(plane, dict), (
+            "envelope tasks row lost its perf_plane annotation: rerun "
+            "bench_envelope.py")
+        assert plane.get("armed") is True, (
+            "envelope tasks row was recorded with the perf plane "
+            "disarmed (or predates the flag): rerun bench_envelope.py "
+            "without RAY_TPU_PERF_PLANE=0")
+        armed = float(plane.get("calib_exec_per_s_armed", 0))
+        disarmed = float(plane.get("calib_exec_per_s_disarmed", 0))
+        assert armed > 0 and disarmed > 0, plane
+        overhead = (disarmed - armed) / disarmed
+        assert overhead <= 0.05, (
+            f"always-on plane costs {overhead:.1%} exec_per_s in the "
+            f"calibration (armed {armed:g}/s vs disarmed "
+            f"{disarmed:g}/s) — over the 5% observability budget")
+
+
 BENCH_SERVE = REPO_ROOT / "BENCH_SERVE.json"
 
 

@@ -708,6 +708,92 @@ def _shm_names() -> set:
 
 
 @pytest.mark.slow
+def test_daemon_die_leaves_flight_ring_in_debug_bundle(tmp_path):
+    """Acceptance (ISSUE 8): a chaos daemon-SIGKILL run leaves a
+    `ray_tpu debug` bundle containing the DEAD daemon's flight-recorder
+    ring (flushed synchronously before the self-SIGKILL) plus rings
+    from ≥2 distinct processes (survivor daemons answer flight_ring
+    live)."""
+    import json
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.state.api import collect_debug_bundle
+
+    ray_tpu.shutdown()
+    session_dir = str(tmp_path / "session")
+    prior = os.environ.get("RAY_TPU_SESSION_DIR")
+    os.environ["RAY_TPU_SESSION_DIR"] = session_dir
+    cluster = Cluster(log_dir=str(tmp_path / "cluster"))
+    runtime = None
+    try:
+        cluster.add_node(num_cpus=2)
+        # The victim inherits chaos through its child env only — the
+        # survivor and the driver stay chaos-free.
+        victim = cluster.add_node(
+            num_cpus=2, env={"RAY_TPU_CHAOS": "seed=7,daemon.die=1.0x1"})
+        assert cluster.wait_for_nodes(2, timeout=60)
+        # daemon.die fires on the victim's first heartbeat tick; its
+        # dying act is a synchronous flight-ring dump.
+        _wait_for(lambda: any(
+            d.get("pid") == victim.pid and d.get("reason") ==
+            "chaos.daemon.die"
+            for d in _session_dumps(session_dir)),
+            60, "the dying daemon's flight-recorder dump")
+
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        out = str(tmp_path / "bundle.json")
+        bundle = collect_debug_bundle(out)
+
+        # The dead daemon's ring is in the bundle, dumped by its own
+        # hand, carrying the chaos firing that killed it.
+        dead = [d for d in bundle["session_dumps"]
+                if d.get("pid") == victim.pid]
+        assert dead, bundle["session_dumps"]
+        assert dead[0]["reason"] == "chaos.daemon.die"
+        kinds = [e["kind"] for e in dead[0]["events"]]
+        assert "start" in kinds and "chaos" in kinds, kinds
+        # Dumps carry the post-mortem trio alongside the ring.
+        assert "fault_stats" in dead[0] and "stage_hist" in dead[0]
+
+        # Rings from >= 2 distinct processes: the dead daemon's file +
+        # a live survivor's flight_ring RPC (and the driver's own).
+        pids = {d.get("pid") for d in bundle["session_dumps"]}
+        pids |= {r.get("pid") for r in bundle["nodes"].values()
+                 if isinstance(r, dict) and r.get("pid")}
+        assert len(pids) >= 2, pids
+        assert "driver" in bundle and bundle["driver"]["events"]
+
+        # The bundle file itself round-trips.
+        with open(out) as f:
+            assert json.load(f)["session_dumps"]
+    finally:
+        if runtime is not None:
+            ray_tpu.shutdown()
+        cluster.shutdown()
+        if prior is None:
+            os.environ.pop("RAY_TPU_SESSION_DIR", None)
+        else:
+            os.environ["RAY_TPU_SESSION_DIR"] = prior
+
+
+def _session_dumps(session_dir: str) -> list:
+    import json
+
+    flight = os.path.join(session_dir, "flight")
+    out = []
+    try:
+        names = os.listdir(flight)
+    except OSError:
+        return out
+    for name in names:
+        try:
+            with open(os.path.join(flight, name)) as f:
+                out.append(json.load(f))
+        except (OSError, ValueError):
+            continue
+    return out
+
+
 def test_chaos_soak_survives_kill_epochs(tmp_path):
     """Randomized (fixed-seed) soak: a mixed task/actor/broadcast
     workload keeps completing while one worker daemon is SIGKILLed

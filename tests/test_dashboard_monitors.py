@@ -128,6 +128,97 @@ def test_worker_prints_echoed_to_driver():
         ray_tpu.shutdown()
 
 
+def test_log_monitor_handles_truncation_and_rotation(tmp_path):
+    """A log file truncated in place (or replaced wholesale — new
+    inode) must restart from byte 0: the old offset belongs to a
+    different incarnation, and seeking past the fresh content silently
+    dropped it before."""
+    import io
+
+    from ray_tpu._private.log_monitor import LogMonitor
+
+    path = tmp_path / "worker-w0.log"
+    sink = io.StringIO()
+    monitor = LogMonitor(str(tmp_path), out=sink)
+    path.write_text("first-line-" + "x" * 64 + "\n")
+    assert monitor.poll_once() == 1
+
+    # Truncate in place to SHORTER content (size < stored offset —
+    # the detectable in-place truncation; a same-inode rewrite that
+    # regrows past the old offset between polls is inherently
+    # ambiguous, which is why real rotation replaces the file).
+    path.write_text("after-truncate\n")
+    assert monitor.poll_once() == 1
+    assert "after-truncate" in sink.getvalue()
+
+    # Rotate: unlink + recreate (new inode), content longer than the
+    # old offset — the naive size check alone would misread a suffix.
+    os.unlink(path)
+    path.write_text("rotated-line-one\nrotated-line-two\n")
+    assert monitor.poll_once() == 2
+    text = sink.getvalue()
+    assert "rotated-line-one" in text and "rotated-line-two" in text
+    # Nothing replayed: each line was emitted exactly once.
+    assert text.count("first-line") == 1
+    assert text.count("after-truncate") == 1
+
+
+def test_log_monitor_prefixes_owner_when_known(tmp_path):
+    """Lines from a worker whose owner is known carry the actor/task
+    label, not just the worker name; unknown owners keep the plain
+    prefix and the lookup is retried once it becomes known."""
+    import io
+
+    from ray_tpu._private.log_monitor import LogMonitor
+
+    owners = {}
+    monitor = LogMonitor(str(tmp_path), out=(sink := io.StringIO()),
+                         context_fn=owners.get)
+    (tmp_path / "worker-w1.log").write_text("anon-line\n")
+    monitor.poll_once()
+    assert "(worker-w1) anon-line" in sink.getvalue()
+
+    owners["worker-w1"] = "actor=deadbeef"
+    (tmp_path / "worker-w1.log").open("a").write("owned-line\n")
+    monitor.poll_once()
+    assert "(worker-w1 actor=deadbeef) owned-line" in sink.getvalue()
+
+
+def test_log_monitor_actor_attribution_live():
+    """End to end: a process actor's prints are attributed to its
+    actor id via the runtime's pid→actor lookup."""
+    from ray_tpu._private.log_monitor import LogMonitor
+
+    ray_tpu.shutdown()
+    runtime = ray_tpu.init(num_cpus=4, process_workers=2)
+    try:
+        @ray_tpu.remote(process=True)
+        class Talker:
+            def say(self):
+                print("talker-output")
+                return "ok"
+
+        t = Talker.remote()
+        assert ray_tpu.get(t.say.remote()) == "ok"
+        sink = io.StringIO()
+        monitor = LogMonitor(runtime.log_monitor.log_dir, out=sink,
+                             context_fn=runtime._worker_log_context)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            monitor.poll_once()
+            if "talker-output" in sink.getvalue():
+                break
+            time.sleep(0.1)
+        text = sink.getvalue()
+        assert "talker-output" in text
+        line = next(ln for ln in text.splitlines()
+                    if "talker-output" in ln)
+        assert " actor=" in line, line
+        ray_tpu.kill(t)
+    finally:
+        ray_tpu.shutdown()
+
+
 # -------------------------------------------------------- memory monitor
 def test_memory_monitor_kills_fattest_worker():
     from ray_tpu._private.memory_monitor import (

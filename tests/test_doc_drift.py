@@ -40,6 +40,19 @@ EXPORTED_SERIES = (
     "ray_tpu_node_pipeline",
     "ray_tpu_node_data_plane",
     "ray_tpu_node_faults",
+    # Always-on performance plane (ISSUE 8): stage-latency histogram
+    # triplets per (stage, node), per-function attribution, and the
+    # serve router's per-deployment latency histograms (emitted from
+    # serve/router.py's collector, same scrape).
+    "ray_tpu_stage_latency",
+    "ray_tpu_stage_latency_bucket",
+    "ray_tpu_stage_latency_sum",
+    "ray_tpu_stage_latency_count",
+    "ray_tpu_task_resources",
+    "ray_tpu_serve_latency",
+    "ray_tpu_serve_latency_bucket",
+    "ray_tpu_serve_latency_sum",
+    "ray_tpu_serve_latency_count",
 )
 
 
@@ -179,6 +192,52 @@ def test_deadline_stage_table_documented():
         assert f"`{stage}`" in text, (
             f"deadline stage {stage!r} missing from the README "
             f"semantics table")
+
+
+def test_perf_plane_knobs_documented(observability_text):
+    """The always-on plane's knobs (master switch + flight-recorder
+    sizing) must keep README rows."""
+    from ray_tpu._private.config import _DEFAULTS
+
+    knobs = [k for k in _DEFAULTS
+             if k == "perf_plane" or k.startswith("flight_recorder_")]
+    assert len(knobs) >= 3, f"perf-plane knobs vanished from config: {knobs}"
+    missing = [k for k in knobs
+               if f"`{k}`" not in observability_text]
+    assert not missing, (
+        f"perf-plane knobs missing from the README knob table: "
+        f"{missing}")
+
+
+def test_stage_histogram_names_documented(observability_text):
+    """Every stage-histogram name the runtime records must be in the
+    README's stage table (STAGE_HIST_KEYS is the canonical list)."""
+    from ray_tpu._private.node_executor import STAGE_HIST_KEYS
+
+    missing = [s for s in STAGE_HIST_KEYS
+               if f"`{s}`" not in observability_text]
+    assert not missing, (
+        f"perf-plane stage names missing from the README: {missing}")
+
+
+def test_summary_and_debug_clis_documented():
+    """The summary and debug subcommands (and the timeline one from
+    PR 5) must keep their README mentions."""
+    text = README.read_text()
+    for cmd in ("python -m ray_tpu summary",
+                "python -m ray_tpu debug",
+                "python -m ray_tpu timeline"):
+        assert cmd in text, f"CLI {cmd!r} missing from README"
+
+
+def test_summarize_tasks_keys_documented(observability_text):
+    """The summarize_tasks() per-function views must be documented
+    next to the CLI that prints them."""
+    for key in ("latency", "resources", "p50_s", "p99_s",
+                "cpu_s", "peak_rss_kb"):
+        assert f"`{key}`" in observability_text, (
+            f"summarize_tasks key {key!r} missing from the README "
+            f"Observability section")
 
 
 def test_readme_stage_list_matches_tracing_stages():

@@ -156,6 +156,168 @@ def test_task_event_drops_are_counted(metrics_runtime):
     assert "ray_tpu_task_events_dropped_total 2" in body
 
 
+# ------------------------------------------- always-on performance plane
+
+
+def test_stage_histogram_buckets_and_merge_determinism():
+    """Log-bucket placement is deterministic (identical observation
+    sequences give identical snapshots), boundaries land in the right
+    bucket, and merging is exact bucket addition."""
+    from ray_tpu._private import perf_plane
+
+    def fill(values):
+        h = perf_plane.StageHistogram()
+        for v in values:
+            h.observe(v)
+        return h.snapshot()
+
+    vals = [0.0, 1e-7, 1e-6, 1.5e-6, 2e-6, 3e-6, 1e-3, 0.5, 100.0,
+            1e9]
+    a, b = fill(vals), fill(vals)
+    assert a == b, "same observations must give identical snapshots"
+    assert a["count"] == len(vals)
+    # Boundary semantics: bucket i covers (2^(i-1), 2^i] µs.
+    assert perf_plane._bucket_index(1e-6) == 0
+    assert perf_plane._bucket_index(2e-6) == 1
+    assert perf_plane._bucket_index(3e-6) == 2
+    assert perf_plane._bucket_index(4e-6) == 2
+    assert perf_plane._bucket_index(1e9) == perf_plane.N_BUCKETS
+
+    other = fill([1e-6, 0.5])
+    merged: dict = {}
+    perf_plane.merge_snapshots(merged, a)
+    perf_plane.merge_snapshots(merged, other)
+    assert merged["count"] == a["count"] + other["count"]
+    assert merged["counts"] == [x + y for x, y in
+                                zip(a["counts"], other["counts"])]
+    assert merged["sum"] == pytest.approx(a["sum"] + other["sum"])
+    # Quantile estimates are bucket-bounded: p50 of ten 0.5s samples
+    # lands in the bucket containing 0.5s.
+    snap = fill([0.5] * 10)
+    q = perf_plane.quantile(snap, 0.5)
+    assert 0.25 <= q <= 1.1
+
+
+def test_gcs_stage_aggregation_prunes_dead_nodes():
+    """The GCS-side merged view folds every node's heartbeat-shipped
+    histograms by bucket addition, and a pruned (dead) node's
+    contribution disappears with it."""
+    from ray_tpu._private import perf_plane
+    from ray_tpu._private.gcs import GlobalControlService
+
+    def hist_with(n, dt):
+        h = perf_plane.StageHistogram()
+        for _ in range(n):
+            h.observe(dt)
+        return h.snapshot()
+
+    gcs = GlobalControlService()
+    gcs.record_node_stats("aa" * 8, {
+        "stage_hist": {"exec": hist_with(3, 0.01)}})
+    gcs.record_node_stats("bb" * 8, {
+        "stage_hist": {"exec": hist_with(5, 0.01),
+                       "admit_worker": hist_with(2, 0.001)}})
+    merged = gcs.cluster_stage_latency()
+    assert merged["exec"]["count"] == 8
+    assert merged["admit_worker"]["count"] == 2
+
+    gcs.drop_node_stats("aa" * 8)  # node death pruning
+    merged = gcs.cluster_stage_latency()
+    assert merged["exec"]["count"] == 5
+
+
+def test_summarize_tasks_percentiles_match_sleeps(ray_start_regular):
+    """summarize_tasks() per-function latency percentiles track the
+    injected sleeps (recorded with tracing DISABLED — the always-on
+    plane, not the tracing plane)."""
+    import time as time_mod
+
+    from ray_tpu.util import tracing
+
+    assert not tracing.is_enabled()
+
+    @ray_tpu.remote
+    def quick():
+        time_mod.sleep(0.01)
+        return 1
+
+    @ray_tpu.remote
+    def slow():
+        time_mod.sleep(0.12)
+        return 2
+
+    ray_tpu.get([quick.remote() for _ in range(8)]
+                + [slow.remote() for _ in range(4)])
+    summary = state.summarize_tasks()
+    lat = summary["latency"]
+    q = next(v for k, v in lat.items() if "quick" in k)
+    s = next(v for k, v in lat.items() if "slow" in k)
+    assert q["count"] == 8 and s["count"] == 4
+    assert 0.01 <= q["p50_s"] < 0.12, q
+    assert s["p50_s"] >= 0.12, s
+    assert s["p99_s"] >= s["p50_s"] >= 0.0
+    # Resource attribution rode along: per-function wall sums.
+    res = summary["resources"]
+    rq = next(v for k, v in res.items() if "quick" in k)
+    assert rq["count"] == 8 and rq["wall_s"] >= 8 * 0.01
+
+
+def test_local_scrape_serves_stage_latency_and_resources(
+        metrics_runtime):
+    """A driver scrape serves the stage-latency histogram families and
+    the per-function attribution series with tracing disabled."""
+    import re
+
+    from ray_tpu.util import tracing
+
+    assert not tracing.is_enabled()
+
+    @ray_tpu.remote
+    def work(x):
+        return x * 2
+
+    assert ray_tpu.get([work.remote(i) for i in range(4)]) \
+        == [0, 2, 4, 6]
+    port = metrics_runtime.metrics_agent.port
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+    # Histogram triplet per (stage, node): _bucket (incl. +Inf), _sum,
+    # _count — under node="driver" for locally executed hops.
+    assert re.search(
+        r'ray_tpu_stage_latency_bucket\{stage="submit_dispatch",'
+        r'node="driver",le="\+Inf"\} [1-9]', body), body[-2000:]
+    assert re.search(
+        r'ray_tpu_stage_latency_count\{stage="exec_local",'
+        r'node="driver"\} [1-9]', body)
+    assert re.search(
+        r'ray_tpu_stage_latency_sum\{stage="exec_local",'
+        r'node="driver"\} ', body)
+    assert re.search(
+        r'ray_tpu_task_resources\{node="driver",func="[^"]*work[^"]*",'
+        r'key="cpu_s"\} ', body)
+
+
+def test_list_apis_surface_truncation(metrics_runtime):
+    """list_* results know when limit= dropped rows: .truncated /
+    .total instead of a silently capped plain list."""
+    from ray_tpu._private.gcs import TaskEvent
+    from ray_tpu._private.ids import TaskID
+
+    for i in range(12):
+        metrics_runtime.gcs.record_task_event(
+            TaskEvent(TaskID(), f"trunc-{i}", "PENDING"))
+    rows = state.list_tasks(limit=5)
+    assert len(rows) == 5
+    assert rows.truncated is True
+    assert rows.total >= 12
+    full = state.list_tasks(limit=10**6)
+    assert full.truncated is False
+    assert full.total == len(full)
+    # Filters count toward total AFTER filtering.
+    one = state.list_tasks(filters=[("name", "=", "trunc-3")], limit=5)
+    assert one.total == 1 and one.truncated is False
+
+
 def test_cluster_scrape_serves_per_node_series():
     """A live-cluster scrape serves each daemon's executor stats as
     per-node labeled series (pipeline / data_plane / faults), pushed
@@ -215,6 +377,72 @@ def test_cluster_scrape_serves_per_node_series():
         assert re.search(
             r'ray_tpu_node_pipeline\{node="[0-9a-f]+",'
             r'key="batch_tasks"\} \d+', body)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+        REGISTRY.clear()
+
+
+def test_cluster_scrape_serves_stage_latency_histograms():
+    """Acceptance (ISSUE 8): a live-cluster scrape serves the
+    ray_tpu_stage_latency histogram families for ≥2 nodes and ≥3
+    stages with tracing_enabled=false — the always-on plane, shipped
+    on heartbeats and aggregated next to the node-stats table."""
+    import re
+    import time
+
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util import tracing
+
+    assert not tracing.is_enabled()
+    ray_tpu.shutdown()
+    REGISTRY.clear()
+    cluster = Cluster(log_dir="/tmp/ray_tpu_test_stage_hist")
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    try:
+        assert cluster.wait_for_nodes(2, timeout=90)
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address,
+                               metrics_port=0)
+        deadline = time.time() + 30
+        while time.time() < deadline and \
+                ray_tpu.cluster_resources().get("CPU", 0) < 4:
+            time.sleep(0.2)
+
+        @ray_tpu.remote
+        def work(x):
+            return x
+
+        # SPREAD lands tasks on both daemons so each records
+        # admit_worker/exec into its own histograms.
+        spread = work.options(scheduling_strategy="SPREAD")
+        assert sorted(ray_tpu.get(
+            [spread.remote(i) for i in range(16)])) == list(range(16))
+        port = runtime.metrics_agent.port
+
+        def series() -> "tuple[set, set, str]":
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics",
+                timeout=10).read().decode()
+            pairs = re.findall(
+                r'ray_tpu_stage_latency_count\{stage="([a-z_]+)",'
+                r'node="([0-9a-f]+|driver)"\} ([1-9][0-9]*)', body)
+            return ({n for _s, n, _c in pairs},
+                    {s for s, _n, _c in pairs}, body)
+
+        deadline = time.time() + 20
+        nodes, stages, body = series()
+        while time.time() < deadline and (
+                len(nodes) < 3 or len(stages) < 3):
+            time.sleep(0.5)
+            nodes, stages, body = series()
+        # ≥2 nodes beyond the driver, ≥3 distinct stages, tracing off.
+        assert len(nodes - {"driver"}) >= 2, (nodes, body[-2000:])
+        assert len(stages) >= 3, stages
+        assert "driver" in nodes
+        # The daemon-side hops are among them (recorded remotely and
+        # shipped on heartbeats, not derived driver-side).
+        assert "exec" in stages and "rpc_seal" in stages, stages
     finally:
         ray_tpu.shutdown()
         cluster.shutdown()
