@@ -298,6 +298,146 @@ def main() -> None:
            perf_plane=perf_plane_row)
     del refs, out
 
+    # -- phase 3b: skewed-load placement + straggler speculation ----------
+    # The observability loop closed (ISSUE 9): byte-weighted locality
+    # on a broadcast arg, the load/stale counters, and a straggler-p99
+    # A/B with speculation armed vs disarmed against one chaos-slowed
+    # node (sched.straggle delays every exec on it).
+    from ray_tpu._private.config import GLOBAL_CONFIG as _gcfg
+    from ray_tpu._private.worker import global_runtime as _grt2
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    runtime = _grt2()
+    sched0 = dict(runtime.execution_pipeline_stats()["sched"])
+
+    # Locality: one 4 MB driver-exported arg; wave 1 spreads and
+    # teaches the residency map, wave 2 scores holders.
+    big_arg = ray_tpu.put(b"x" * (4 << 20))
+
+    @ray_tpu.remote(num_cpus=1)
+    def consume(blob) -> int:
+        return len(blob)
+
+    wave1 = int(os.environ.get("ENVELOPE_SCHED_WAVE1", "8"))
+    wave2 = int(os.environ.get("ENVELOPE_SCHED_WAVE2", "16"))
+    assert all(v == 4 << 20 for v in ray_tpu.get(
+        [consume.remote(big_arg) for _ in range(wave1)], timeout=600))
+    hits_before = runtime.execution_pipeline_stats()["sched"][
+        "locality_hits"]
+    assert all(v == 4 << 20 for v in ray_tpu.get(
+        [consume.remote(big_arg) for _ in range(wave2)], timeout=600))
+    locality_hits = runtime.execution_pipeline_stats()["sched"][
+        "locality_hits"] - hits_before
+    del big_arg
+
+    # Straggler A/B: add ONE chaos-slowed node; soft-pin probes to it.
+    straggle_s = float(os.environ.get("ENVELOPE_STRAGGLE_S", "1.5"))
+    slow_node = cluster.add_node(
+        num_cpus=2, pool_size=1, heartbeat_period_s=1.0,
+        resources={"slownode": 1.0},
+        env={"RAY_TPU_CHAOS": "seed=9,sched.straggle=1.0",
+             "RAY_TPU_STRAGGLE_S": str(straggle_s)})
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and \
+            ray_tpu.cluster_resources().get("slownode", 0) < 1:
+        time.sleep(0.2)
+    slow_hex = next(n["NodeID"] for n in ray_tpu.nodes()
+                    if "slownode" in n.get("Resources", {}))
+    slow_aff = NodeAffinitySchedulingStrategy(node_id=slow_hex,
+                                              soft=True)
+
+    @ray_tpu.remote(num_cpus=1)
+    def probe(i: int) -> int:
+        return i
+
+    from ray_tpu._private.ids import NodeID as _NodeID
+
+    slow_id = _NodeID(bytes.fromhex(slow_hex))
+
+    def wait_slow_capacity(timeout_s: float) -> None:
+        # A speculation loser keeps draining its straggle delay on the
+        # slow node after the winner sealed; the NEXT probe must find
+        # slow-node capacity or its soft pin silently falls back to a
+        # healthy node and measures nothing.
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            node = runtime.cluster.get_node(slow_id)
+            if node is not None and node.fits({"CPU": 1.0}):
+                return
+            time.sleep(0.1)
+
+    def straggler_walls(n: int) -> list[float]:
+        walls = []
+        for i in range(n):
+            wait_slow_capacity(straggle_s * 3 + 10)
+            t0 = time.monotonic()
+            assert ray_tpu.get(
+                probe.options(scheduling_strategy=slow_aff).remote(i),
+                timeout=600) == i
+            walls.append(time.monotonic() - t0)
+        return sorted(walls)
+
+    # Boot pools everywhere BEFORE arming so boot outliers never
+    # pollute the p99 baseline the trigger multiplies...
+    healthy_hex = next(n["NodeID"] for n in ray_tpu.nodes()
+                       if n.get("Resources", {}).get("CPU")
+                       and "slownode" not in n.get("Resources", {}))
+    healthy_aff = NodeAffinitySchedulingStrategy(node_id=healthy_hex,
+                                                 soft=True)
+    ray_tpu.get([probe.remote(i) for i in range(20)], timeout=600)
+    _gcfg.update({"speculation_min_samples": 8,
+                  "speculation_watch_period_ms": 50})
+    runtime.configure_speculation(True)
+    # ...then warm the per-function sample ring SEQUENTIALLY on one
+    # healthy node (samples only record while armed; a burst would
+    # spill probes onto the straggler and poison the p99 with 1.5s
+    # walls, disarming the trigger).
+    for i in range(12):
+        assert ray_tpu.get(
+            probe.options(scheduling_strategy=healthy_aff).remote(i),
+            timeout=600) == i
+    n_straggle = int(os.environ.get("ENVELOPE_SCHED_STRAGGLERS", "8"))
+    walls_armed = straggler_walls(n_straggle)
+    spec_counts = {
+        k: v for k, v in runtime.execution_pipeline_stats()[
+            "sched"].items() if k.startswith("speculations_")}
+    runtime.configure_speculation(False)
+    walls_disarmed = straggler_walls(n_straggle)
+    sched1 = runtime.execution_pipeline_stats()["sched"]
+    p99_armed = walls_armed[-1]
+    p99_disarmed = walls_disarmed[-1]
+    record("sched", ok=True,
+           locality_aware_scheduling=bool(
+               _gcfg.locality_aware_scheduling),
+           locality_hits=int(locality_hits),
+           locality_hit_rate=round(locality_hits / wave2, 3),
+           locality_bytes_saved=int(
+               sched1["locality_bytes_saved"]
+               - sched0["locality_bytes_saved"]),
+           load_spillbacks=int(sched1["load_spillbacks"]
+                               - sched0["load_spillbacks"]),
+           stale_stats_skips=int(sched1["stale_stats_skips"]
+                                 - sched0["stale_stats_skips"]),
+           straggle_s=straggle_s, n_stragglers=n_straggle,
+           straggler_p99_ms_armed=round(p99_armed * 1e3, 1),
+           straggler_p99_ms_disarmed=round(p99_disarmed * 1e3, 1),
+           straggler_p50_ms_armed=round(
+               walls_armed[len(walls_armed) // 2] * 1e3, 1),
+           straggler_p50_ms_disarmed=round(
+               walls_disarmed[len(walls_disarmed) // 2] * 1e3, 1),
+           speculation_p99_gain=round(
+               p99_disarmed / max(p99_armed, 1e-9), 2),
+           speculation=spec_counts)
+    # The chaos-slowed node must NOT pollute the broadcast phase below
+    # (SPREAD would land a straggled 1 GiB task on it).
+    cluster.remove_node(slow_node)
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline and \
+            ray_tpu.cluster_resources().get("slownode", 0) > 0:
+        time.sleep(0.2)
+
     # -- phase 4: 1 GiB broadcast -----------------------------------------
     import numpy as np
 

@@ -32,6 +32,11 @@ Injection sites (the site string is the contract; counters surface in
   ``("overloaded", ...)`` — the driver fails deadline-armed tasks fast
   with SystemOverloadedError and spillback-requeues the rest (one draw
   per execute RPC / batch, node_executor._overload_reason)
+- ``sched.straggle``   daemon exec: artificially delay this node's
+  execution (``RAY_TPU_STRAGGLE_S`` seconds, default 2.0) BEFORE the
+  user function runs — makes straggler-speculation triggers
+  deterministic; the delay loop aborts early when the task's token is
+  loser-cancelled, so first-seal-wins is provable with marker files
 """
 
 from __future__ import annotations

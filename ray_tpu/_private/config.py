@@ -95,6 +95,34 @@ _DEFAULTS: dict[str, Any] = {
     "admission_memory_watermark": 0.0,
     # RPC plane.
     "rpc_io_pool_workers": 16,         # pooled short-call dispatch
+    # Locality- and load-aware placement (closing the observability
+    # loop: pick_node consumes the object directory + the heartbeat-
+    # shipped node-stats feed). Disarmed, every site costs one
+    # module-attribute branch (scheduler.LOCALITY_ON) and pick_node is
+    # byte-identical to the classic hybrid policy.
+    "locality_aware_scheduling": True,
+    # Arguments at/above this size count toward byte-weighted locality
+    # scoring (small args are cheaper to move than to chase).
+    "locality_min_arg_kb": 64,
+    # Node-stats entries older than this (GCS receipt age + local
+    # decay) stop contributing to the load score: a wedged daemon that
+    # stops heartbeating must not keep looking idle to the scorer.
+    "sched_stats_stale_s": 6.0,
+    # Straggler speculation (driver-side watcher): an in-flight task
+    # whose elapsed wall exceeds speculation_p99_factor x the
+    # per-function p99 from the perf plane gets a speculative copy
+    # re-dispatched to a different node; first seal wins, the loser is
+    # cancelled best-effort. Off by default (speculation re-executes
+    # work); disarmed cost is one module-attribute branch
+    # (speculation.SPEC_ON) per site.
+    "speculation_enabled": False,
+    "speculation_p99_factor": 3.0,
+    # Max speculative copies per task (bounds wasted re-execution).
+    "speculation_max_copies": 1,
+    # Completed-sample floor before the per-function p99 is trusted.
+    "speculation_min_samples": 8,
+    # Watcher sweep cadence.
+    "speculation_watch_period_ms": 200,
     # Shared retry/backoff/deadline policy for IDEMPOTENT control-plane
     # calls (rpc.call_with_retry — heartbeats, fetch_plan, GCS reads).
     # Non-idempotent submits never ride it: a maybe-executed failure

@@ -284,8 +284,10 @@ class GlobalControlService:
         self.task_events_dropped = 0
         # Per-node executor stats pushed on heartbeats (pipeline /
         # data_plane / faults), served to drivers as labeled /metrics
-        # series — the GCS-side aggregation table.
-        self._node_stats: dict[str, dict] = {}
+        # series — the GCS-side aggregation table. Values are
+        # (stats, receipt monotonic): the receipt stamp ages a wedged
+        # daemon's last report out of the load-aware scheduler's view.
+        self._node_stats: dict[str, tuple] = {}
         self._node_stats_lock = threading.Lock()
 
     # ---------------------------------------------------------------- actors
@@ -443,18 +445,28 @@ class GlobalControlService:
     # ----------------------------------------------------- node stats
 
     def record_node_stats(self, node_hex: str, stats: dict) -> None:
-        """Heartbeat piggyback: one node's executor stats snapshot."""
+        """Heartbeat piggyback: one node's executor stats snapshot,
+        stamped with the RECEIPT time — a wedged daemon that stops
+        heartbeating (but isn't declared dead yet) keeps aging here,
+        so ``node_stats()`` consumers (the load-aware scheduler above
+        all) can decay its last report out of their scores instead of
+        treating the frozen snapshot as a live idle signal."""
         with self._node_stats_lock:
-            self._node_stats[node_hex] = stats
+            self._node_stats[node_hex] = (stats, time.monotonic())
 
     def drop_node_stats(self, node_hex: str) -> None:
         with self._node_stats_lock:
             self._node_stats.pop(node_hex, None)
 
     def node_stats(self) -> dict:
-        """{node hex -> last pushed executor stats snapshot}."""
+        """{node hex -> last pushed executor stats snapshot}, each
+        carrying ``age_s`` — seconds since the snapshot's heartbeat
+        arrived (receipt clock, monotonic)."""
+        now = time.monotonic()
         with self._node_stats_lock:
-            return dict(self._node_stats)
+            return {node_hex: {**stats, "age_s": round(now - at, 3)}
+                    for node_hex, (stats, at)
+                    in self._node_stats.items()}
 
     def cluster_stage_latency(self) -> dict:
         """Cluster-wide stage histograms: every node's heartbeat-
@@ -467,7 +479,7 @@ class GlobalControlService:
         merged: dict[str, dict] = {}
         with self._node_stats_lock:
             tables = [stats.get("stage_hist")
-                      for stats in self._node_stats.values()
+                      for stats, _at in self._node_stats.values()
                       if isinstance(stats, dict)]
         for table in tables:
             if not isinstance(table, dict):

@@ -103,6 +103,19 @@ def install_runtime_collectors(runtime):
                 f'ray_tpu_faults_total{{node="driver",'
                 f'kind="{_escape_label(key)}"}} {value}')
 
+        # Scheduler decision plane (locality hits / bytes saved / load
+        # spillbacks / stale-stats skips / speculation outcomes): the
+        # observability loop's own observability.
+        try:
+            sched = runtime.execution_pipeline_stats().get("sched", {})
+        except Exception:  # noqa: BLE001 — partial runtime teardown
+            sched = {}
+        lines.append("# TYPE ray_tpu_sched_decisions_total counter")
+        for key, value in sorted(sched.items()):
+            lines.append(
+                f'ray_tpu_sched_decisions_total'
+                f'{{kind="{_escape_label(key)}"}} {value}')
+
         # Cluster-wide per-node series: each daemon pushes its
         # executor_stats subset (pipeline / data_plane / faults) on
         # heartbeats into the GCS aggregation table; the driver folds
@@ -111,6 +124,7 @@ def install_runtime_collectors(runtime):
         # scraped under one job in the reference deployment).
         by_node = _node_stats_table(runtime)
         lines.extend(_node_stat_lines(by_node))
+        lines.extend(_sched_node_lines(by_node))
         # Always-on performance plane: stage-latency histogram families
         # (driver's own registry + every node's heartbeat-shipped
         # snapshot) and the per-function resource attribution series.
@@ -171,6 +185,39 @@ def _node_stat_lines(by_node: dict) -> list[str]:
                     lines.append(
                         f'{metric}{{node="{node}",'
                         f'key="{_escape_label(key)}"}} {value}')
+    return lines
+
+
+def _sched_node_lines(by_node: dict) -> list[str]:
+    """Per-node load view the scheduler scores: admitted-reservation
+    depth / running, the report's receipt age (stale entries decay out
+    of the score past sched_stats_stale_s), and the admit/exec p50s
+    from the heartbeat-shipped stage histograms."""
+    from ray_tpu._private import perf_plane
+
+    lines: list[str] = []
+    if not by_node:
+        return lines
+    lines.append("# TYPE ray_tpu_sched_node_load gauge")
+    for node_hex, stats in sorted(by_node.items()):
+        if not isinstance(stats, dict):
+            continue
+        node = _escape_label(node_hex[:16])
+        hist = stats.get("stage_hist") \
+            if isinstance(stats.get("stage_hist"), dict) else {}
+        rows = {
+            "running": float(stats.get("running", 0.0) or 0.0),
+            "depth": float(stats.get(
+                "depth", stats.get("running", 0.0)) or 0.0),
+            "age_s": float(stats.get("age_s", 0.0) or 0.0),
+            "admit_p50_s": perf_plane.quantile(
+                hist.get("admit_worker") or {}, 0.5),
+            "exec_p50_s": perf_plane.quantile(
+                hist.get("exec") or {}, 0.5),
+        }
+        for key, value in rows.items():
+            lines.append(f'ray_tpu_sched_node_load{{node="{node}",'
+                         f'key="{key}"}} {value:g}')
     return lines
 
 

@@ -28,6 +28,7 @@ chunked pull only when the value is actually read locally.
 
 from __future__ import annotations
 
+import collections
 import os
 import threading
 import time
@@ -83,6 +84,12 @@ class RemoteBlob:
 class NodeBusyError(Exception):
     """The node rejected the lease at admission (another driver's work
     saturates it); the submitter should spill to a different node."""
+
+
+class TaskSpeculationCancelled(Exception):
+    """The daemon refused the execution because its task token was
+    cancelled (speculation first-seal-wins: a sibling copy already
+    sealed the result) — nothing ran, nothing to seal."""
 
 
 class NodeOverloadedError(Exception):
@@ -911,6 +918,13 @@ class NodeExecutorService:
         # pool workers with each task so by-reference pickles resolve.
         self._driver_sys_path: list[str] = []
         self.tasks_executed = 0
+        # Speculation loser-cancel tokens (cancel_task RPC): checked
+        # before a task's user function runs — a straggler still held
+        # in admission (or a chaos sched.straggle delay) whose sibling
+        # copy already sealed provably never executes. Bounded FIFO.
+        self._cancel_lock = threading.Lock()
+        self._cancelled_tokens: "collections.OrderedDict" = \
+            collections.OrderedDict()
         # Fired (outside the ledger lock) whenever admission state
         # changes; the NodeAgent hooks this to push a syncer update
         # instead of waiting out the heartbeat period (reference: the
@@ -964,6 +978,7 @@ class NodeExecutorService:
         s.register("executor_stats", self.executor_stats)
         s.register("flight_ring", self._flight_ring)
         s.register("configure_perf", self._configure_perf)
+        s.register("cancel_task", self.cancel_task)
         s.register("task_block", self.task_block)
         s.register("task_unblock", self.task_unblock)
         s.register("adopt_sys_path", self.adopt_sys_path)
@@ -1182,6 +1197,19 @@ class NodeExecutorService:
         trace_stages = {"admitted": t_admit} \
             if trace_ctx is not None else ({} if perf_on else None)
         try:
+            from ray_tpu._private import chaos
+
+            if chaos.ACTIVE is not None \
+                    and chaos.ACTIVE.should("sched.straggle"):
+                # One slow node: the delay sits BEFORE the user
+                # function, so a speculation loser-cancel landing
+                # mid-delay provably prevents the execution.
+                self._chaos_straggle(task_token)
+            if self._token_cancelled(task_token):
+                # Speculation first-seal-wins: a sibling copy already
+                # sealed and the driver cancelled this token before we
+                # ran anything — refuse without executing.
+                return ("cancelled",)
             with self._func_lock:
                 func = self._func_cache.get(digest)
                 if func_blob is not None:
@@ -1386,6 +1414,40 @@ class NodeExecutorService:
             except Exception:  # noqa: BLE001 — sync is best-effort
                 pass
 
+    def cancel_task(self, token: str) -> bool:
+        """Speculation loser-cancel: flag ``token`` so an execution
+        that hasn't reached its user function yet refuses with
+        ("cancelled",) instead of running (first-seal-wins — the
+        winner's value is already sealed driver-side). Best-effort: a
+        task already executing completes normally and its reseal is
+        skipped by the driver's claim_win gate."""
+        with self._cancel_lock:
+            self._cancelled_tokens[token] = True
+            while len(self._cancelled_tokens) > 4096:
+                self._cancelled_tokens.popitem(last=False)
+        return True
+
+    def _token_cancelled(self, token: "str | None") -> bool:
+        if token is None:
+            return False
+        with self._cancel_lock:
+            return self._cancelled_tokens.pop(token, None) is not None
+
+    def _chaos_straggle(self, token: "str | None") -> None:
+        """sched.straggle chaos site: artificially delay this node's
+        exec (making straggler-speculation triggers deterministic in
+        tests/benches). Sleeps in short slices so a loser-cancel
+        arriving mid-delay aborts the wait — the straggler then
+        provably never runs its user function."""
+        total = float(os.environ.get("RAY_TPU_STRAGGLE_S", "2.0"))
+        deadline = time.monotonic() + total
+        while time.monotonic() < deadline:
+            if token is not None:
+                with self._cancel_lock:
+                    if token in self._cancelled_tokens:
+                        return  # popped by the caller's cancel check
+            time.sleep(0.05)
+
     def _overload_reason(self) -> "str | None":
         """Why admission should SHED (not merely spill) right now:
         the overload.saturate chaos site, the admitted-reservation
@@ -1539,6 +1601,14 @@ class NodeExecutorService:
         from ray_tpu._private.worker_pool import _BatchTask
 
         self._warm_factory_once()
+        from ray_tpu._private import chaos as _chaos
+
+        if _chaos.ACTIVE is not None \
+                and _chaos.ACTIVE.should("sched.straggle"):
+            # Slow-node chaos: one delay per batch RPC (the per-token
+            # cancel-aware slicing lives on the single-task path).
+            time.sleep(float(os.environ.get("RAY_TPU_STRAGGLE_S",
+                                            "2.0")))
         self.batch_rpcs += 1
         self.batch_tasks_received += len(entries)
         n = len(entries)
@@ -1932,8 +2002,17 @@ class NodeExecutorService:
         cadence — no store-wide byte sums."""
         with self._running_lock:
             running = len(self._running)
+            # Admitted-reservation depth net of blocked-in-get tokens:
+            # the scheduler's load score wants queue pressure, not
+            # parked waiters.
+            depth = max(0, running - len(self._blocked_cpu))
         stats = {"tasks_executed": self.tasks_executed,
                  "running": running,
+                 "depth": depth,
+                 # Snapshot wall stamp: the stats feed carries its own
+                 # timestamp so consumers (and the GCS receipt age) can
+                 # tell a fresh report from a wedged daemon's last one.
+                 "stats_ts": time.time(),
                  "pipeline": self._pipeline_stats(),
                  "data_plane": self._data_plane_stats(),
                  "faults": self._fault_stats()}
@@ -3104,6 +3183,8 @@ class RemoteNodeHandle:
         if reply[0] == "timeout":
             raise TaskDeadlineExpired(
                 reply[1] if len(reply) > 1 else "admitted")
+        if reply[0] == "cancelled":
+            raise TaskSpeculationCancelled(self.address)
         with self._digest_lock:
             self.known_digests.add(digest)
         if reply[0] == "err":

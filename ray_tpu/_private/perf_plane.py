@@ -239,6 +239,53 @@ def merge_resource_tables(into: dict, table: dict) -> dict:
 
 
 # --------------------------------------------------------------------------
+# Per-function wall samples (straggler-speculation feed)
+# --------------------------------------------------------------------------
+
+# Exact recent wall-clock samples per function signature, recorded by
+# the OWNER at task completion (submit -> seal on the driver's own
+# clock, so every node's execution of the function lands in one merged
+# sample set — the cluster view of the function's distribution). The
+# speculation watcher compares in-flight elapsed walls against the p99
+# of this ring; exact samples, not histogram buckets, because the
+# trigger multiplies the p99 and a bucket-edge estimate would swing
+# the threshold by up to 2x.
+WALL_SAMPLE_CAP = 512
+
+_wall_lock = threading.Lock()
+_walls: dict[str, list] = {}  # name -> [next_idx, [samples...]]
+
+
+def record_task_wall(name: str, wall_s: float) -> None:
+    """One completed task's end-to-end wall (owner clock)."""
+    with _wall_lock:
+        entry = _walls.get(name)
+        if entry is None:
+            _walls[name] = [0, [float(wall_s)]]
+            return
+        idx, samples = entry
+        if len(samples) < WALL_SAMPLE_CAP:
+            samples.append(float(wall_s))
+        else:
+            samples[idx] = float(wall_s)
+            entry[0] = (idx + 1) % WALL_SAMPLE_CAP
+
+
+def wall_quantile(name: str, q: float) -> "tuple[int, float]":
+    """(sample count, exact q-quantile wall) for ``name``; (0, 0.0)
+    when the function has no completed samples yet."""
+    with _wall_lock:
+        entry = _walls.get(name)
+        samples = list(entry[1]) if entry is not None else []
+    if not samples:
+        return 0, 0.0
+    samples.sort()
+    idx = min(len(samples) - 1,
+              max(0, int(round(q * (len(samples) - 1)))))
+    return len(samples), samples[idx]
+
+
+# --------------------------------------------------------------------------
 # Arm/disarm
 # --------------------------------------------------------------------------
 
@@ -261,6 +308,8 @@ def reset() -> None:
         _hists.clear()
     with _res_lock:
         _resources.clear()
+    with _wall_lock:
+        _walls.clear()
 
 
 def init_from_config() -> None:

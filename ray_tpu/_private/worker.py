@@ -32,6 +32,8 @@ from typing import Any, Iterable, Sequence
 
 from ray_tpu._private import accelerators
 from ray_tpu._private import perf_plane as perf
+from ray_tpu._private import scheduler as scheduler_mod
+from ray_tpu._private import speculation as spec_mod
 from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.gcs import (
     ActorRecord,
@@ -352,6 +354,11 @@ class Runtime:
         # replay old latencies into this session's scrape.
         perf.init_from_config()
         perf.reset()
+        # Locality-/load-aware placement + straggler speculation: arm
+        # the module gates from the (possibly system_config-overridden)
+        # knobs — same discipline as the perf plane above.
+        scheduler_mod.init_sched_from_config()
+        spec_mod.init_from_config()
         # Driver-side flight recorder: ring only (no flusher thread,
         # no per-driver files) — `ray_tpu debug` reads it live.
         from ray_tpu._private import flight_recorder
@@ -400,6 +407,28 @@ class Runtime:
         self._task_timeouts = 0
         self._admission_shed = 0
         self.dispatcher.set_deadline_hook(self._seal_deadline)
+        # Locality-aware placement inputs: the dispatcher asks this
+        # hook for byte-weighted argument residency per admission
+        # (scheduler.LOCALITY_ON gates every call). The threshold is
+        # cached here so the dispatch hot path never takes the config
+        # lock per task.
+        self._locality_min_bytes = int(cfg.locality_min_arg_kb) * 1024
+        # Learned residency: args >= the threshold accrue the nodes
+        # that executed tasks consuming them (a pulled copy is cached
+        # there) — bounded LRU. Plus the head ObjectDirectory's
+        # multi-holder view, synced by the node watcher.
+        self._arg_locality: collections.OrderedDict = \
+            collections.OrderedDict()
+        self._arg_locality_lock = threading.Lock()
+        self._holder_cache: dict = {}
+        self._sched_feed_at = 0.0
+        self.dispatcher.set_locality_hook(self._locality_for_spec)
+        # Straggler speculation: driver-side watcher comparing each
+        # in-flight task's elapsed wall against the perf plane's
+        # per-function p99 (speculation.py); only exists while armed.
+        self._spec_watcher = None
+        if spec_mod.SPEC_ON:
+            self._spec_watcher = spec_mod.SpeculationWatcher(self)
         self.placement_groups = PlacementGroupManager(self.cluster, self.store)
         self._actors: dict[ActorID, LocalActor] = {}
         # Signalled whenever an actor lands in _actors: submit queues
@@ -877,6 +906,12 @@ class Runtime:
                     self._flush_remote_frees()
                     self._flush_object_locations()
                     now = time.monotonic()
+                    if scheduler_mod.LOCALITY_ON \
+                            and now - self._sched_feed_at >= 2.0:
+                        # Load-/locality-aware placement inputs: the
+                        # node-stats ages + the holder table.
+                        self._sched_feed_at = now
+                        self._sync_sched_feed()
                     if (membership_events or subscriber is None
                             or now - last_sync >= 10.0):
                         # Idempotent GCS read on the shared retry
@@ -1798,12 +1833,16 @@ class Runtime:
         if node is not None:
             with self._remote_nodes_lock:
                 remote_handle = self._remote_nodes.get(node.node_id)
+        watcher = self._spec_watcher
+        tracked = spec_mod.SPEC_ON and watcher is not None \
+            and watcher.track(spec, node)
         try:
             if remote_handle is not None:
                 from ray_tpu._private.node_executor import (
                     NodeBusyError,
                     NodeOverloadedError,
                     TaskDeadlineExpired,
+                    TaskSpeculationCancelled,
                 )
 
                 try:
@@ -1811,6 +1850,17 @@ class Runtime:
                         spec, node, remote_handle)
                 except NodeBusyError:
                     self._spillback_requeue(spec, node)
+                    return
+                except TaskSpeculationCancelled:
+                    # The daemon refused the lease: this member's token
+                    # was loser-cancelled before its user function ran
+                    # (a sibling copy already sealed). Nothing to seal.
+                    if watcher is not None:
+                        watcher.mark_cancelled(spec)
+                    self.gcs.record_task_event(TaskEvent(
+                        spec.task_id, spec.name, "FAILED",
+                        start_time=start, end_time=time.time(),
+                        error="speculation: cancelled before exec"))
                     return
                 except TaskDeadlineExpired:
                     # The daemon found the budget dead at admission.
@@ -1844,6 +1894,11 @@ class Runtime:
                     perf.record_task_resources(*s)
                     perf.record_stage("exec_local", s[1])
                 self._store_task_result(spec, result, node)
+            if tracked:
+                # Completed wall sample for the speculation trigger's
+                # per-function p99 (only successful completions feed
+                # it — spillbacks/failures would skew the baseline).
+                watcher.untrack(spec, completed=True)
             self.gcs.record_task_event(TaskEvent(
                 spec.task_id, spec.name, "FINISHED", start_time=start,
                 end_time=time.time(),
@@ -1851,12 +1906,24 @@ class Runtime:
         except BaseException as exc:  # noqa: BLE001 — becomes a TaskError ref
             self._finish_task_failure(spec, exc, start)
         finally:
+            if tracked:
+                watcher.untrack(spec)
             RuntimeContext.clear()
 
     def _finish_task_failure(self, spec: TaskSpec, exc: BaseException,
                              start: float) -> None:
         """Terminal failure handling shared by the single and batched
         execute paths: retry when policy allows, else seal the error."""
+        watcher = self._spec_watcher
+        if watcher is not None and watcher.absorb_failure(spec):
+            # A speculation sibling already sealed the result (or is
+            # still live and may yet): never seal an error over it —
+            # speculation doubles as a hedge against node death.
+            self.gcs.record_task_event(TaskEvent(
+                spec.task_id, spec.name, "FAILED", start_time=start,
+                end_time=time.time(),
+                error=f"speculation: absorbed {exc!r}"))
+            return
         if self._maybe_retry(spec, exc):
             return
         from ray_tpu.exceptions import ObjectLostError, WorkerCrashedError
@@ -1977,6 +2044,9 @@ class Runtime:
                     perf.record_stage("exec_local", float(sample[1]))
                 except (TypeError, IndexError):
                     pass
+        watcher = self._spec_watcher
+        if watcher is not None and not watcher.claim_win(spec):
+            return True  # sibling sealed first: skip the loser's write
         for rid, value in results:
             self.store.put(rid, value)
             if node is not None:
@@ -2145,8 +2215,14 @@ class Runtime:
                 popped = self._inflight_blocks.pop(token, None)
             if popped is not None:
                 popped.drain()
-        self._seal_remote_results(spec.return_ids, results,
-                                  node.node_id, handle.address)
+        watcher = self._spec_watcher
+        if watcher is None or watcher.claim_win(spec):
+            self._seal_remote_results(spec.return_ids, results,
+                                      node.node_id, handle.address)
+            if scheduler_mod.LOCALITY_ON:
+                # The node now caches this task's pulled large args:
+                # future tasks consuming them score it for locality.
+                self._learn_arg_locality(spec, node)
         if perf.PERF_ON:
             # The remote round-trip envelope (rpc_sent → seal): the
             # daemon-side breakdown of this window lives in ITS
@@ -2261,6 +2337,8 @@ class Runtime:
                 entry = entry + (spec.deadline,)
             entries.append(entry)
             spec_by_idx[idx] = spec
+            if spec_mod.SPEC_ON and self._spec_watcher is not None:
+                self._spec_watcher.track(spec, node)
             ctx = _RemoteBlockContext(self.cluster, node.node_id,
                                       spec.resources, handle, token)
             ctx_by_idx[idx] = ctx
@@ -2277,6 +2355,8 @@ class Runtime:
             spec = spec_by_idx.pop(idx, None)
             if spec is None:
                 return
+            if self._spec_watcher is not None:
+                self._spec_watcher.untrack(spec)
             ctx = ctx_by_idx.pop(idx, None)
             if ctx is not None:
                 with self._inflight_blocks_lock:
@@ -2297,10 +2377,21 @@ class Runtime:
                     # arrival is each member's seal moment).
                     perf.record_stage("rpc_seal", max(0.0, end - t_send))
                 if reply[0] == "ok":
+                    watcher = self._spec_watcher
+                    if watcher is not None \
+                            and not watcher.claim_win(spec):
+                        # Speculation loser: sibling sealed first —
+                        # skip the write, just release the claim.
+                        finish_idx(idx)
+                        continue
                     try:
                         self._collect_remote_results(
                             spec.return_ids, reply[1], node.node_id,
                             handle.address, pairs)
+                        if watcher is not None:
+                            watcher.untrack(spec, completed=True)
+                        if scheduler_mod.LOCALITY_ON:
+                            self._learn_arg_locality(spec, node)
                         done_events.append(TaskEvent(
                             spec.task_id, spec.name, "FINISHED",
                             start_time=start, end_time=end,
@@ -2335,6 +2426,12 @@ class Runtime:
                     finish_idx(idx)
                     self._handle_overloaded_reply(
                         spec, node, "daemon admission shed")
+                elif reply[0] == "cancelled":
+                    # Loser-cancelled before exec (speculation): the
+                    # sibling's seal already carries the result.
+                    if self._spec_watcher is not None:
+                        self._spec_watcher.mark_cancelled(spec)
+                    finish_idx(idx)
                 else:  # ("need_func", _): single path re-ships the blob
                     def redo(spec=spec):
                         try:
@@ -2555,6 +2652,143 @@ class Runtime:
         with self._inflight_blocks_lock:
             return self._inflight_blocks.get(token)
 
+    # ------------------------------------------- locality-aware placement
+
+    def _arg_bytes(self, object_id: ObjectID) -> "tuple[int, str | None]":
+        """(resident bytes, primary holder hex) of a sealed argument:
+        RemoteBlob placeholders report the producing node and true
+        blob size; driver-exported args their export-store size (no
+        single holder — pullers accrue via the learned map)."""
+        from ray_tpu._private.node_executor import RemoteBlob
+
+        with self.store._lock:
+            entry = self.store._entries.get(object_id)
+            if entry is None or not entry.sealed \
+                    or entry.error is not None:
+                return 0, None
+            value = entry.value
+            size = entry.size_bytes
+        if isinstance(value, RemoteBlob):
+            return int(value.size), value.node_hex
+        if self._export_store is not None:
+            exported = self._export_store.size(object_id.binary())
+            if exported:
+                return int(exported), None
+        return int(size), None
+
+    def _locality_for_spec(self, spec: TaskSpec) -> dict | None:
+        """Dispatcher locality hook: {node hex -> resident bytes of
+        this task's large args}. Sources, byte-weighted per arg at or
+        above locality_min_arg_kb: the primary holder recorded by the
+        owner-side object directory (stored results), the learned
+        residency map (nodes that already pulled+cached the arg), and
+        the head ObjectDirectory's multi-holder view."""
+        min_bytes = self._locality_min_bytes
+        if min_bytes <= 0:
+            return None
+        refs = [a for a in spec.args if isinstance(a, ObjectRef)]
+        refs += [v for v in spec.kwargs.values()
+                 if isinstance(v, ObjectRef)]
+        if not refs:
+            return None
+        out: dict[str, float] = {}
+        holder_cache = self._holder_cache
+        for ref in refs:
+            oid = ref.id()
+            size, primary = self._arg_bytes(oid)
+            if size < min_bytes:
+                continue
+            holders: set[str] = set()
+            if primary:
+                holders.add(primary)
+            with self._arg_locality_lock:
+                learned = self._arg_locality.get(oid)
+                if learned:
+                    holders |= learned
+            extra = holder_cache.get(oid.hex())
+            if extra:
+                holders.update(extra)
+            for node_hex in holders:
+                out[node_hex] = out.get(node_hex, 0.0) + size
+        return out or None
+
+    def _learn_arg_locality(self, spec: TaskSpec,
+                            node: NodeState) -> None:
+        """A task consuming large args just completed on ``node``: the
+        node's pull cache now holds those args, so score it for future
+        placements (bounded LRU; the broadcast-arg pattern turns into
+        locality hits from the second wave on)."""
+        refs = [a for a in spec.args if isinstance(a, ObjectRef)]
+        refs += [v for v in spec.kwargs.values()
+                 if isinstance(v, ObjectRef)]
+        if not refs or node is None:
+            return
+        min_bytes = self._locality_min_bytes
+        eligible = [r.id() for r in refs
+                    if self._arg_bytes(r.id())[0] >= min_bytes]
+        if not eligible:
+            return
+        node_hex = node.node_id.hex()
+        with self._arg_locality_lock:
+            for oid in eligible:
+                holders = self._arg_locality.get(oid)
+                if holders is None:
+                    holders = self._arg_locality[oid] = set()
+                holders.add(node_hex)
+                self._arg_locality.move_to_end(oid)
+            while len(self._arg_locality) > 4096:
+                self._arg_locality.popitem(last=False)
+
+    def _sync_sched_feed(self) -> None:
+        """Node-watcher beat: fold the GCS node-stats table (with
+        receipt ages) into the scheduler's load view and refresh the
+        ObjectDirectory holder cache — the two live inputs of
+        locality-/load-aware pick_node."""
+        if self.gcs_client is None:
+            return
+        try:
+            table = self.gcs_client.call("node_stats",
+                                         timeout_s=5.0) or {}
+        except Exception:  # noqa: BLE001 — head unreachable: keep last
+            return
+        for hex_id, stats in table.items():
+            if not isinstance(stats, dict):
+                continue
+            try:
+                node_id = NodeID(bytes.fromhex(hex_id))
+            except (ValueError, TypeError):
+                continue
+            hist = stats.get("stage_hist") or {}
+            wait = 0.0
+            for stage in ("admit_worker", "exec"):
+                snap = hist.get(stage)
+                if isinstance(snap, dict):
+                    wait += perf.quantile(snap, 0.5)
+            self.cluster.update_node_stats(
+                node_id,
+                running=float(stats.get("running", 0.0) or 0.0),
+                depth=float(stats.get(
+                    "depth", stats.get("running", 0.0)) or 0.0),
+                wait_s=wait,
+                age_s=float(stats.get("age_s", 0.0) or 0.0))
+        try:
+            locs = self.gcs_client.call("list_object_locations",
+                                        timeout_s=5.0)
+            if isinstance(locs, dict):
+                self._holder_cache = locs
+        except Exception:  # noqa: BLE001 — best-effort holder view
+            pass
+
+    def configure_speculation(self, enabled: bool) -> None:
+        """Arm/disarm straggler speculation at runtime (benches A/B
+        this; init honors the speculation_enabled knob). The watcher
+        thread is created on first arm and survives disarms (SPEC_ON
+        gates every site)."""
+        GLOBAL_CONFIG.update({"speculation_enabled": bool(enabled)})
+        (spec_mod.enable if enabled else spec_mod.disable)()
+        if enabled and self._spec_watcher is None:
+            self._spec_watcher = spec_mod.SpeculationWatcher(self)
+
     def _record_location(self, object_id: ObjectID, node_id: NodeID) -> None:
         """Owner-side object directory (reference:
         ownership_based_object_directory.h): which node holds the primary
@@ -2726,6 +2960,11 @@ class Runtime:
 
     def _store_task_result(self, spec: TaskSpec, result: Any,
                            node: NodeState | None = None) -> None:
+        watcher = self._spec_watcher
+        if watcher is not None and not watcher.claim_win(spec):
+            # Speculation first-seal-wins: a sibling already sealed —
+            # never overwrite the winning value with a late loser's.
+            return
         if spec.num_returns == 1:
             self.store.put(spec.return_ids[0], result)
         elif spec.num_returns == 0:
@@ -3090,7 +3329,23 @@ class Runtime:
                 "batch_seals": self.store.batch_seals,
                 "batch_sealed_objects": self.store.batch_sealed_objects,
             },
+            # Placement decisions (locality/load scoring) + straggler
+            # speculation outcomes — the observability loop's own
+            # observability (also exported as the
+            # ray_tpu_sched_decisions_total /metrics family).
+            "sched": self._sched_stats(),
         }
+
+    def _sched_stats(self) -> dict:
+        out = dict(self.cluster.sched_counters())
+        watcher = self._spec_watcher
+        if watcher is not None:
+            out.update(watcher.counters())
+        else:
+            out.update({"speculations_launched": 0,
+                        "speculations_won": 0,
+                        "speculations_lost": 0})
+        return out
 
     def fault_stats(self) -> dict:
         """Driver-side failure counters, same shape as the daemon's
@@ -3453,6 +3708,8 @@ class Runtime:
         return self.cluster.available_resources()
 
     def shutdown(self) -> None:
+        if self._spec_watcher is not None:
+            self._spec_watcher.stop()
         if self._submit_ring is not None:
             # Flush buffered submits (their owners may still hold refs)
             # and retire the submitter before the planes below close.

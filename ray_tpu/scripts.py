@@ -12,6 +12,8 @@ dashboard/modules/job/cli.py). Usage::
     python -m ray_tpu job {status,logs,stop} SUBMISSION_ID
     python -m ray_tpu job list
     python -m ray_tpu list {tasks,actors,objects,nodes,...}  # state CLI
+    python -m ray_tpu summary [tasks|placement]  # per-function latency/
+                                    # resources + per-node placement/load
     python -m ray_tpu up cluster.yaml                  # YAML launcher
     python -m ray_tpu down cluster.yaml
 """

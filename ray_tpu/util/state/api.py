@@ -90,6 +90,18 @@ def _percentile(sorted_vals: list, q: float) -> float:
     return sorted_vals[idx]
 
 
+def _node_stats_table(runtime) -> dict:
+    """The GCS node-stats aggregation table (with receipt ages),
+    fetched once per call."""
+    client = getattr(runtime, "gcs_client", None)
+    if client is not None:
+        try:
+            return client.call("node_stats", timeout_s=2.0) or {}
+        except Exception:  # noqa: BLE001 — head unreachable: local view
+            return {}
+    return runtime.gcs.node_stats()
+
+
 def _cluster_task_resources(runtime) -> dict:
     """Per-function attribution merged across the cluster: this
     driver's table + every node's heartbeat-shipped snapshot from the
@@ -99,19 +111,43 @@ def _cluster_task_resources(runtime) -> dict:
     merged: dict[str, dict] = {}
     perf_plane.merge_resource_tables(
         merged, perf_plane.resource_snapshot())
-    client = getattr(runtime, "gcs_client", None)
-    if client is not None:
-        try:
-            by_node = client.call("node_stats", timeout_s=2.0) or {}
-        except Exception:  # noqa: BLE001 — head unreachable: local view
-            by_node = {}
-    else:
-        by_node = runtime.gcs.node_stats()
-    for stats in by_node.values():
+    for stats in _node_stats_table(runtime).values():
         if isinstance(stats, dict):
             perf_plane.merge_resource_tables(
                 merged, stats.get("task_resources") or {})
     return merged
+
+
+def summarize_placement() -> dict:
+    """Per-node placement/load table + the driver's scheduler decision
+    counters (the view `python -m ray_tpu summary` prints alongside
+    the task summary): for each node its admitted-reservation
+    ``depth`` / ``running``, the stats feed's receipt ``age_s`` (stale
+    past ``sched_stats_stale_s`` = decayed out of the load score),
+    executed-task count and the heartbeat-shipped ``admit_p50_ms`` /
+    ``exec_p50_ms``; plus ``decisions`` — locality hits / bytes saved,
+    load spillbacks, stale-stats skips and speculation outcomes."""
+    from ray_tpu._private import perf_plane
+
+    runtime = _runtime()
+    nodes: dict[str, dict] = {}
+    for node_hex, stats in sorted(_node_stats_table(runtime).items()):
+        if not isinstance(stats, dict):
+            continue
+        hist = stats.get("stage_hist") \
+            if isinstance(stats.get("stage_hist"), dict) else {}
+        nodes[node_hex[:16]] = {
+            "running": stats.get("running", 0),
+            "depth": stats.get("depth", stats.get("running", 0)),
+            "tasks_executed": stats.get("tasks_executed", 0),
+            "age_s": round(float(stats.get("age_s", 0.0) or 0.0), 3),
+            "admit_p50_ms": round(perf_plane.quantile(
+                hist.get("admit_worker") or {}, 0.5) * 1e3, 3),
+            "exec_p50_ms": round(perf_plane.quantile(
+                hist.get("exec") or {}, 0.5) * 1e3, 3),
+        }
+    decisions = runtime.execution_pipeline_stats().get("sched", {})
+    return {"nodes": nodes, "decisions": decisions}
 
 
 def summarize_tasks() -> dict:
@@ -147,7 +183,11 @@ def summarize_tasks() -> dict:
     return {"node_count": len(list_nodes(limit=10**9)),
             "summary": summary,
             "latency": latency,
-            "resources": _cluster_task_resources(runtime)}
+            "resources": _cluster_task_resources(runtime),
+            # Placement/load table + scheduler decision counters: the
+            # default `ray_tpu summary` view shows WHERE work landed
+            # and why (locality hits, load spillbacks, speculation).
+            "placement": summarize_placement()}
 
 
 # ------------------------------------------------------------------ actors
@@ -292,7 +332,8 @@ def _cli(argv: list[str]) -> int:
         "jobs": list_jobs,
     }
     summaries = {"tasks": summarize_tasks, "actors": summarize_actors,
-                 "objects": summarize_objects}
+                 "objects": summarize_objects,
+                 "placement": summarize_placement}
     if argv and argv[0] == "timeline":
         return _cli_timeline(argv[1:])
     if argv and argv[0] == "debug":
@@ -304,6 +345,7 @@ def _cli(argv: list[str]) -> int:
     if len(argv) < 2:
         print("usage: python -m ray_tpu.util.state "
               "{list|summary} <resource> | summary | "
+              "summary placement | "
               "timeline [output.json] | debug [bundle.json]")
         return 2
     verb, resource = argv[0], argv[1]

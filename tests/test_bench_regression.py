@@ -33,8 +33,14 @@ ENVELOPE_TOLERANCE = 0.40
 # 2026-08-04: 0.71 <-> 10.1 GB/s with the same tree) — a flat 40% band
 # flags the pristine tree re-running its own committed number.
 # bench_envelope.py now records best-of-3 reps to damp this, and the
-# residual swing gets a wider band.
-ENVELOPE_METRIC_TOLERANCE = {"broadcast.aggregate_gb_per_s": 0.70}
+# residual swing gets a wider band. Re-measured 2026-08-05 while
+# refreshing for the scheduler plane: the pristine HEAD tree's
+# best-of-3 on the same day was 1.1 GB/s (reps [19.5, 68.0, 76.6]s)
+# vs the current tree's 1.13 (reps [19.1, 23.6, 67.3]s) — both trees
+# identical within noise, but the committed 10.65 rode a lucky 2.0s
+# rep the box no longer reproduces, hence the wider band (narrow it
+# back when a refresh lands near the historical best again).
+ENVELOPE_METRIC_TOLERANCE = {"broadcast.aggregate_gb_per_s": 0.92}
 
 # Envelope throughput metrics guarded per phase — all higher-is-better.
 # tasks.throughput_per_s is deliberately NOT guarded anymore: it was
@@ -49,6 +55,9 @@ ENVELOPE_GUARDED = {
     "actors": ["actors_per_s"],
     "tasks": ["exec_per_s", "submit_per_s"],
     "broadcast": ["aggregate_gb_per_s"],
+    # ISSUE 9: disarmed-p99 / armed-p99 on the injected-slow node —
+    # speculation must keep cutting the straggler tail.
+    "sched": ["speculation_p99_gain"],
 }
 
 
@@ -267,6 +276,44 @@ def test_bench_envelope_tasks_row_records_perf_plane_budget():
             f"always-on plane costs {overhead:.1%} exec_per_s in the "
             f"calibration (armed {armed:g}/s vs disarmed "
             f"{disarmed:g}/s) — over the 5% observability budget")
+
+
+def test_bench_envelope_records_sched_row():
+    """The skewed-load placement row (ISSUE 9) must keep its schema:
+    locality-hit counters on the broadcast-arg workload, the
+    load/stale spillback counters, and the straggler-p99 A/B with
+    speculation armed vs disarmed on the injected-slow node. A refresh
+    recorded with the scheduler plane disarmed — or one where
+    speculation stopped firing or cutting the straggler tail — is
+    refused outright."""
+    if not BENCH_ENVELOPE.exists():
+        pytest.skip("BENCH_ENVELOPE.json not present in the working "
+                    "tree")
+    doc = json.loads(BENCH_ENVELOPE.read_text())
+    rows = [r for r in doc.get("phases", [])
+            if r.get("phase") == "sched"]
+    assert rows, ("envelope lost its sched phase; rerun "
+                  "bench_envelope.py")
+    for row in rows:
+        assert row.get("locality_aware_scheduling") is True, (
+            "envelope sched row was recorded with the scheduler plane "
+            "disarmed (or predates the flag): rerun bench_envelope.py "
+            "without RAY_TPU_LOCALITY_AWARE_SCHEDULING=0")
+        for key in ("locality_hits", "locality_hit_rate",
+                    "locality_bytes_saved", "load_spillbacks",
+                    "stale_stats_skips", "straggler_p99_ms_armed",
+                    "straggler_p99_ms_disarmed", "speculation_p99_gain",
+                    "speculation"):
+            assert key in row, f"sched row lost {key!r}"
+        # Byte-weighted locality must actually fire on the
+        # broadcast-arg workload (acceptance: hits > 0).
+        assert row["locality_hits"] > 0, row
+        spec = row["speculation"]
+        assert spec.get("speculations_launched", 0) > 0, row
+        # Speculation armed must beat disarmed on the injected
+        # straggler's p99 — that's the whole point of the plane.
+        assert row["straggler_p99_ms_armed"] \
+            < row["straggler_p99_ms_disarmed"], row
 
 
 BENCH_SERVE = REPO_ROOT / "BENCH_SERVE.json"

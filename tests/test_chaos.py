@@ -697,6 +697,230 @@ def test_daemon_sigkill_expired_in_queue_no_ghost_execution(tmp_path):
         cluster.shutdown()
 
 
+# -------------------------------------------- straggler speculation (chaos)
+
+
+def _speculation_cluster(tmp_path, straggle_s: str = "4.0"):
+    """One fast node + one chaos-straggled node (sched.straggle delays
+    every exec on it BEFORE the user function, cancel-aware)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir=str(tmp_path / "cluster"))
+    cluster.add_node(num_cpus=2, pool_size=1, heartbeat_period_s=0.5,
+                     resources={"fastnode": 1.0})
+    cluster.add_node(
+        num_cpus=2, pool_size=1, heartbeat_period_s=0.5,
+        resources={"slownode": 1.0},
+        env={"RAY_TPU_CHAOS": "seed=13,sched.straggle=1.0",
+             "RAY_TPU_STRAGGLE_S": straggle_s})
+    return cluster
+
+
+def _arm_speculation(runtime):
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    GLOBAL_CONFIG.update({"speculation_min_samples": 4,
+                          "speculation_p99_factor": 3.0,
+                          "speculation_watch_period_ms": 50})
+    runtime.configure_speculation(True)
+
+
+def _node_hex(resource: str) -> str:
+    return next(n["NodeID"] for n in ray_tpu.nodes()
+                if resource in n["Resources"])
+
+
+def test_speculation_straggle_first_seal_wins_exactly_once(tmp_path):
+    """sched.straggle slows ONE node's exec: the driver-side watcher
+    speculates a copy to the fast node, first seal wins, and the
+    loser-cancel lands DURING the straggle delay — marker files prove
+    the straggler never ran its user function (side-effect
+    exactly-once)."""
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    cluster = _speculation_cluster(tmp_path)
+    runtime = None
+    try:
+        assert cluster.wait_for_nodes(2, timeout=30)
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        _wait_for(lambda: ray_tpu.cluster_resources().get("CPU", 0) >= 4,
+                  30, "both nodes to join")
+        _arm_speculation(runtime)
+        fast_hex = _node_hex("fastnode")
+        slow_hex = _node_hex("slownode")
+        marker_dir = tmp_path / "markers"
+        marker_dir.mkdir()
+
+        @ray_tpu.remote(num_cpus=1)
+        def work(i, mdir):
+            import os as _os
+
+            with open(f"{mdir}/ran-{i}-{_os.getpid()}", "w"):
+                pass
+            return i * 10
+
+        # Warm the per-function p99 SEQUENTIALLY on the fast node
+        # (concurrent warmup would spill onto the straggler).
+        fast_aff = NodeAffinitySchedulingStrategy(node_id=fast_hex,
+                                                  soft=True)
+        for i in range(5):
+            assert ray_tpu.get(
+                work.options(scheduling_strategy=fast_aff)
+                .remote(i, str(marker_dir)), timeout=30) == i * 10
+        base = runtime.execution_pipeline_stats()["sched"]
+
+        # The straggler: a lone submit soft-pinned to the slow node
+        # (single execute path -> the cancel-aware straggle delay).
+        t0 = time.monotonic()
+        ref = work.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=slow_hex, soft=True)).remote(
+                    99, str(marker_dir))
+        assert ray_tpu.get(ref, timeout=60) == 990
+        wall = time.monotonic() - t0
+        # Speculation cut the injected 4s straggle.
+        assert wall < 3.5, wall
+        _wait_for(lambda: runtime.execution_pipeline_stats()["sched"][
+            "speculations_won"] > base["speculations_won"],
+            30, "the speculative copy to be scored as the winner")
+        sched = runtime.execution_pipeline_stats()["sched"]
+        assert sched["speculations_launched"] \
+            > base["speculations_launched"], sched
+        # Exactly-once: the loser-cancel aborted the straggler inside
+        # its delay — ONE marker, written by the winning copy.
+        markers = [f for f in os.listdir(marker_dir)
+                   if f.startswith("ran-99-")]
+        assert len(markers) == 1, markers
+    finally:
+        if runtime is not None:
+            ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_speculation_copy_survives_daemon_death(tmp_path):
+    """SIGKILL the straggling node while its task is in flight and a
+    speculative copy is already running elsewhere: the original's
+    WorkerCrashedError is ABSORBED (the copy is live) and the result
+    arrives exactly once from the survivor — speculation doubles as a
+    hedge against node death."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir=str(tmp_path / "cluster"))
+    cluster.add_node(num_cpus=2, pool_size=1, heartbeat_period_s=0.5,
+                     resources={"fastnode": 1.0})
+    victim = cluster.add_node(num_cpus=2, pool_size=1,
+                              heartbeat_period_s=0.5,
+                              resources={"slownode": 1.0})
+    runtime = None
+    try:
+        assert cluster.wait_for_nodes(2, timeout=30)
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        _wait_for(lambda: ray_tpu.cluster_resources().get("CPU", 0) >= 4,
+                  30, "both nodes to join")
+        _arm_speculation(runtime)
+        fast_hex = _node_hex("fastnode")
+        slow_hex = _node_hex("slownode")
+
+        @ray_tpu.remote(num_cpus=1)
+        def work(i, slow_s):
+            import time as _t
+
+            _t.sleep(slow_s)
+            return i * 10
+
+        fast_aff = NodeAffinitySchedulingStrategy(node_id=fast_hex,
+                                                  soft=True)
+        for i in range(5):
+            assert ray_tpu.get(
+                work.options(scheduling_strategy=fast_aff)
+                .remote(i, 0.0), timeout=30) == i * 10
+
+        # Victim task: sleeps on the doomed node; the watcher
+        # speculates a copy to the fast node (same args -> it sleeps
+        # too, but survives).
+        ref = work.options(
+            scheduling_strategy=NodeAffinitySchedulingStrategy(
+                node_id=slow_hex, soft=True)).remote(99, 3.0)
+        _wait_for(lambda: runtime.execution_pipeline_stats()["sched"][
+            "speculations_launched"] >= 1, 30,
+            "the watcher to launch a speculative copy")
+        victim.proc.kill()
+        # The original dies with its node; the copy's seal carries the
+        # result — no error surfaces to the caller.
+        assert ray_tpu.get(ref, timeout=60) == 990
+        sched = runtime.execution_pipeline_stats()["sched"]
+        assert sched["speculations_won"] >= 1, sched
+    finally:
+        if runtime is not None:
+            ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_speculation_first_seal_wins_through_rpc_delay(tmp_path):
+    """The straggle scenario with rpc.delay ALSO slowing every
+    driver-side send: the speculation control flow (copy dispatch,
+    loser cancel, first-seal-wins) rides delayed transport without
+    double side effects or a wrong result."""
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    cluster = _speculation_cluster(tmp_path, straggle_s="5.0")
+    runtime = None
+    try:
+        assert cluster.wait_for_nodes(2, timeout=30)
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        _wait_for(lambda: ray_tpu.cluster_resources().get("CPU", 0) >= 4,
+                  30, "both nodes to join")
+        _arm_speculation(runtime)
+        fast_hex = _node_hex("fastnode")
+        slow_hex = _node_hex("slownode")
+        marker_dir = tmp_path / "markers"
+        marker_dir.mkdir()
+
+        @ray_tpu.remote(num_cpus=1)
+        def work(i, mdir):
+            import os as _os
+
+            with open(f"{mdir}/ran-{i}-{_os.getpid()}", "w"):
+                pass
+            return i + 1
+
+        fast_aff = NodeAffinitySchedulingStrategy(node_id=fast_hex,
+                                                  soft=True)
+        for i in range(5):
+            assert ray_tpu.get(
+                work.options(scheduling_strategy=fast_aff)
+                .remote(i, str(marker_dir)), timeout=30) == i + 1
+
+        chaos.configure("seed=5,rpc.delay=1.0")
+        try:
+            ref = work.options(
+                scheduling_strategy=NodeAffinitySchedulingStrategy(
+                    node_id=slow_hex, soft=True)).remote(
+                        99, str(marker_dir))
+            assert ray_tpu.get(ref, timeout=60) == 100
+        finally:
+            chaos.disable()
+        _wait_for(lambda: runtime.execution_pipeline_stats()["sched"][
+            "speculations_won"] >= 1, 30, "speculation to resolve")
+        markers = [f for f in os.listdir(marker_dir)
+                   if f.startswith("ran-99-")]
+        assert len(markers) == 1, markers
+    finally:
+        chaos.disable()
+        if runtime is not None:
+            ray_tpu.shutdown()
+        cluster.shutdown()
+
+
 # ----------------------------------------------------------- randomized soak
 
 

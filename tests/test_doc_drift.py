@@ -44,6 +44,10 @@ EXPORTED_SERIES = (
     # triplets per (stage, node), per-function attribution, and the
     # serve router's per-deployment latency histograms (emitted from
     # serve/router.py's collector, same scrape).
+    # Scheduler decision plane (ISSUE 9): placement/speculation
+    # counters and the per-node load view pick_node scores.
+    "ray_tpu_sched_decisions_total",
+    "ray_tpu_sched_node_load",
     "ray_tpu_stage_latency",
     "ray_tpu_stage_latency_bucket",
     "ray_tpu_stage_latency_sum",
@@ -218,6 +222,67 @@ def test_stage_histogram_names_documented(observability_text):
                if f"`{s}`" not in observability_text]
     assert not missing, (
         f"perf-plane stage names missing from the README: {missing}")
+
+
+def test_sched_knobs_documented():
+    """Every locality-/speculation-scheduling knob must keep a README
+    row (the "Scheduling" knob table)."""
+    from ray_tpu._private.config import _DEFAULTS
+
+    knobs = [k for k in _DEFAULTS
+             if k.startswith(("locality_", "speculation_"))
+             or k == "sched_stats_stale_s"]
+    assert len(knobs) >= 8, f"sched knobs vanished from config: {knobs}"
+    text = README.read_text()
+    missing = [k for k in knobs if f"`{k}`" not in text]
+    assert not missing, (
+        f"scheduling knobs missing from the README knob table: "
+        f"{missing}")
+
+
+def test_sched_counter_keys_documented(observability_text,
+                                       ray_start_regular):
+    """The sched decision counters must be documented both in the
+    Scheduling section and next to the other driver counter keys
+    (they ride execution_pipeline_stats()['sched'])."""
+    runtime = ray_start_regular
+    keys = set(runtime.execution_pipeline_stats()["sched"])
+    assert {"locality_hits", "locality_bytes_saved", "load_spillbacks",
+            "stale_stats_skips", "speculations_launched",
+            "speculations_won", "speculations_lost"} <= keys, keys
+    sched_section = README.read_text()
+    start = sched_section.find("## Scheduling")
+    assert start != -1, "README lost its Scheduling section"
+    end = sched_section.find("\n## ", start + 1)
+    sched_section = sched_section[start:end]
+    for key in sorted(keys):
+        assert f"`{key}`" in observability_text, (
+            f"sched counter {key!r} missing from the README "
+            f"Observability tables")
+        assert f"`{key}`" in sched_section, (
+            f"sched counter {key!r} missing from the README "
+            f"Scheduling section")
+
+
+def test_sched_node_load_keys_documented():
+    """The per-node load-view keys (the ray_tpu_sched_node_load series
+    + the `summary placement` table) must keep README rows."""
+    text = README.read_text()
+    for key in ("running", "depth", "age_s", "admit_p50_s",
+                "exec_p50_s", "admit_p50_ms", "exec_p50_ms",
+                "tasks_executed"):
+        assert f"`{key}`" in text, (
+            f"placement/load key {key!r} missing from the README")
+    assert "summary placement" in text, (
+        "the `summary placement` CLI lost its README mention")
+
+
+def test_straggle_chaos_site_documented():
+    """The sched.straggle injection site (and its delay env knob) must
+    stay documented in the fault-tolerance chaos list."""
+    text = README.read_text()
+    assert "`sched.straggle`" in text
+    assert "RAY_TPU_STRAGGLE_S" in text
 
 
 def test_summary_and_debug_clis_documented():
