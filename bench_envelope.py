@@ -12,6 +12,11 @@ Phases (sizes via env, defaults are the committed artifact's):
                 drained to completion.
   4. broadcast: a 1 GiB object fetched by one task per node on B nodes
                 (ref row: 1 GiB broadcast to 50+ nodes).
+  5. spill:     put+get a working set 2x the configured store capacity
+                through the watermark spill tier — completes with zero
+                SystemOverloadedError, records spill/restore counts
+                and the restore p50 (ref: object spilling lets the
+                store back working sets far beyond memory).
 
 Writes BENCH_ENVELOPE.json and prints one JSON line per phase.
 """
@@ -507,6 +512,79 @@ def main() -> None:
 
     ray_tpu.shutdown()
     cluster.shutdown()
+
+    # -- phase 5: spill tier — working set 2x the store capacity ----------
+    # A fresh LOCAL runtime with a deliberately small value store: put
+    # twice the capacity, then get every object back. The job must
+    # complete end to end with ZERO SystemOverloadedError — the spill
+    # tier degrades it to disk instead of shedding it — and the row
+    # records how much spilled/restored and the restore p50.
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu.exceptions import SystemOverloadedError
+
+    capacity_mb = int(os.environ.get("ENVELOPE_SPILL_CAPACITY_MB",
+                                     "128"))
+    obj_mb = 4
+    n_objs = capacity_mb * 2 // obj_mb
+    runtime = ray_tpu.init(num_cpus=2,
+                           object_store_memory=capacity_mb << 20)
+    spill_enabled = bool(GLOBAL_CONFIG.spill_enabled) \
+        and getattr(runtime.store, "_spill", None) is not None
+    rng = np.random.default_rng(1)
+    payloads = [rng.integers(0, 255, size=obj_mb << 20,
+                             dtype=np.uint8).tobytes()
+                for _ in range(4)]
+    digests = []
+    refs = []
+    overloaded = 0
+    t0 = time.monotonic()
+    for i in range(n_objs):
+        blob = (b"%08d" % i) + payloads[i % len(payloads)][8:]
+        digests.append(blob[:8])
+        try:
+            refs.append(ray_tpu.put(blob))
+        except SystemOverloadedError:
+            overloaded += 1
+    put_wall = time.monotonic() - t0
+    # Let the async spiller converge below the high watermark before
+    # the read pass: the row then measures genuine disk restores, not
+    # a race against a lagging spiller.
+    mgr = getattr(runtime.store, "_spill", None)
+    if mgr is not None:
+        deadline = time.monotonic() + 60
+        while runtime.store._memory_used > mgr.high_bytes() \
+                and time.monotonic() < deadline:
+            mgr.request_spill()
+            time.sleep(0.05)
+    t0 = time.monotonic()
+    ok = True
+    for i, ref in enumerate(refs):
+        try:
+            blob = ray_tpu.get(ref, timeout=600.0)
+        except SystemOverloadedError:
+            overloaded += 1
+            ok = False
+            continue
+        if blob[:8] != digests[i] or len(blob) != obj_mb << 20:
+            ok = False
+    get_wall = time.monotonic() - t0
+    spill = runtime.spill_stats()
+    record("spill", ok=ok and overloaded == 0,
+           spill_enabled=spill_enabled,
+           capacity_mb=capacity_mb,
+           working_set_mb=n_objs * obj_mb,
+           n_objects=n_objs,
+           overloaded=overloaded,
+           spills=spill["spills"], restores=spill["restores"],
+           spilled_mb=round(spill["spilled_bytes"] / (1 << 20), 1),
+           restored_mb=round(spill["restored_bytes"] / (1 << 20), 1),
+           torn_restores=spill["torn_restores"],
+           disk_full=spill["disk_full"],
+           restore_p50_ms=spill["restore_p50_ms"],
+           put_wall_s=round(put_wall, 2),
+           get_wall_s=round(get_wall, 2))
+    del refs, payloads
+    ray_tpu.shutdown()
 
     out_path = os.environ.get("ENVELOPE_OUT") or os.path.join(
         os.path.dirname(os.path.abspath(__file__)),

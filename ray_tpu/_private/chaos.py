@@ -37,6 +37,15 @@ Injection sites (the site string is the contract; counters surface in
   user function runs — makes straggler-speculation triggers
   deterministic; the delay loop aborts early when the task's token is
   loser-cancelled, so first-seal-wins is provable with marker files
+- ``spill.torn_write``  spill tier: truncate a spill file's payload
+  mid-write (the header still promises the full length — the
+  crash-mid-write shape); the next restore detects the tear by CRC
+  and falls back to lineage reconstruction
+- ``spill.disk_full``   spill tier: fail the spill write with
+  SpillDiskFullError — the spiller backs off and admission degrades
+  store pressure to the typed shed instead of crashing the daemon
+- ``spill.restore_delay`` spill tier: sleep 50-500 ms before a
+  restore read, racing restores against concurrent gets/frees
 """
 
 from __future__ import annotations

@@ -47,6 +47,24 @@ _DEFAULTS: dict[str, Any] = {
     # Native shared-memory arena (plasma-lite, _native/plasma_store.cpp).
     "object_arena_bytes": 64 * 1024 * 1024,  # 0 => segment-per-object only
     "object_arena_max_object_bytes": 1024 * 1024,
+    # Watermark-driven spill tier (spill_manager.py): when a store's
+    # resident bytes cross spill_high_watermark x capacity, an async
+    # spiller moves unpinned/unleased primaries to checksummed files
+    # under $RAY_TPU_SESSION_DIR/spill/<pid>/ and frees the memory
+    # (and any shm/arena twin), restoring transparently on read —
+    # working sets >> RAM degrade to disk instead of shedding.
+    # Disarmed (spill_enabled=0), every site costs one
+    # module-attribute branch (spill_manager.SPILL_ON) and the stores
+    # keep their legacy inline cap-based spilling byte-identically.
+    "spill_enabled": True,
+    "spill_high_watermark": 0.85,   # wake the spiller above this
+    "spill_low_watermark": 0.60,    # spill down to this (hysteresis)
+    "spill_fsync": False,           # fsync each file before rename
+    "spill_min_object_kb": 16,      # smallest spillable object
+    # Disk-full backoff: after a failed spill write, admission treats
+    # store pressure as unrelievable (typed shed) for this long
+    # instead of hammering a full disk or crashing the daemon.
+    "spill_disk_full_backoff_s": 5.0,
     # Memory monitor (reference: memory_monitor.h kill-on-pressure).
     "memory_usage_threshold": 0.95,
     "memory_monitor_refresh_ms": 1000,  # 0 => disabled

@@ -22,9 +22,17 @@ Cost discipline:
   test box isn't littered with per-driver files.
 
 Ring files live under ``$RAY_TPU_SESSION_DIR/flight/<role>-<pid>.json``
-and carry the ring plus the process's fault counters, breaker state
-and recent stage histograms; ``python -m ray_tpu debug`` collects the
-files and every reachable process's LIVE ring into one bundle.
+and carry the ring plus the process's fault counters, breaker state,
+spill-tier counters and recent stage histograms; ``python -m ray_tpu
+debug`` collects the files and every reachable process's LIVE ring
+into one bundle.
+
+Record sites: chaos firings, breaker opens (rpc.py), worker crashes,
+node death, object loss, heartbeat re-registration, daemon stop, and
+the spill tier's lifecycle (``spill.spill`` / ``spill.restore`` /
+``spill.evict`` / ``spill.torn`` / ``spill.disk_full`` /
+``spill.orphan_sweep`` — spill_manager.py), so a post-mortem shows
+what the disk tier was doing when the process died.
 """
 
 from __future__ import annotations

@@ -85,6 +85,13 @@ class ObjectDirectory:
         self._lock = threading.Lock()
         # owner addr -> {object hex -> {node hex, ...}}
         self._locations: dict[str, dict[str, set[str]]] = {}
+        # owner addr -> {object hex -> node hex}: copies currently on
+        # DISK at their holder (spill tier). A spilled holder still
+        # holds the object — restore is transparent — but consumers
+        # (locality scoring above all) must not credit it with
+        # zero-copy residency, and node death prunes the spill mark
+        # with the holder (the disk dies with the node).
+        self._spilled: dict[str, dict[str, str]] = {}
         self._seen: dict[str, float] = {}
 
     def update(self, owner: str, adds: list, removes: list) -> int:
@@ -93,6 +100,7 @@ class ObjectDirectory:
         (object_hex, node_hex) or (object_hex, [node_hex, ...])."""
         with self._lock:
             table = self._locations.setdefault(owner, {})
+            spilled = self._spilled.get(owner)
             for obj_hex, nodes in adds:
                 holders = table.setdefault(obj_hex, set())
                 if isinstance(nodes, str):
@@ -101,10 +109,58 @@ class ObjectDirectory:
                     holders.update(nodes)
             for obj_hex in removes:
                 table.pop(obj_hex, None)
+                if spilled is not None:
+                    spilled.pop(obj_hex, None)
             self._seen[owner] = time.monotonic()
             if not table:
                 self._locations.pop(owner, None)
+            if spilled is not None and not spilled:
+                self._spilled.pop(owner, None)
             return len(table)
+
+    def mark_spilled(self, owner: str, obj_hex: str,
+                     node_hex: str) -> None:
+        """One holder moved its copy of ``obj_hex`` to its spill tier
+        (heartbeat-piggybacked event). The node STAYS a holder —
+        fetches restore transparently — but the mark makes fetch
+        plans/locality spill-aware.
+
+        ``owner`` is the DAEMON's view of the owner (the driver's
+        client endpoint); location buckets are keyed by the driver's
+        export address — so the mark attaches to whichever bucket
+        already holds the object (one scan over the handful of live
+        owners), keeping prune/update GC authoritative. The raw owner
+        key is the fallback bucket for marks arriving before the
+        location publish."""
+        with self._lock:
+            bucket = owner
+            for loc_owner, table in self._locations.items():
+                if obj_hex in table:
+                    bucket = loc_owner
+                    break
+            self._spilled.setdefault(bucket, {})[obj_hex] = node_hex
+
+    def clear_spilled(self, owner: str, obj_hex: str) -> None:
+        """The holder restored its copy into memory: the node is a
+        full in-memory holder again (this IS the re-registration —
+        spilling never removed it from the holder set)."""
+        with self._lock:
+            for bucket in [b for b, spilled in self._spilled.items()
+                           if obj_hex in spilled]:
+                spilled = self._spilled[bucket]
+                spilled.pop(obj_hex, None)
+                if not spilled:
+                    self._spilled.pop(bucket, None)
+
+    def spilled(self, owner: str | None = None) -> dict:
+        """{object hex -> spilled-holder node hex}, one owner or all."""
+        with self._lock:
+            if owner is not None:
+                return dict(self._spilled.get(owner, {}))
+            out: dict[str, str] = {}
+            for table in self._spilled.values():
+                out.update(table)
+            return out
 
     def locations(self, owner: str | None = None) -> dict:
         """{object hex -> sorted holder list}, for one owner or all."""
@@ -126,6 +182,20 @@ class ObjectDirectory:
                           if now - seen > ttl_s]:
                 self._seen.pop(owner, None)
                 self._locations.pop(owner, None)
+                self._spilled.pop(owner, None)
+            # Fallback-bucket GC: marks that landed under a raw owner
+            # key (no location publish yet) are orphans once no lease
+            # tracks them and their objects appear in no bucket.
+            for owner in [o for o in self._spilled
+                          if o not in self._seen]:
+                table = self._spilled[owner]
+                for obj_hex in [
+                        h for h in table
+                        if not any(h in t
+                                   for t in self._locations.values())]:
+                    del table[obj_hex]
+                if not table:
+                    self._spilled.pop(owner, None)
 
     def prune_node(self, node_hex: str) -> list[str]:
         """A node died: remove it from every holder set so pullers and
@@ -148,6 +218,15 @@ class ObjectDirectory:
                         orphaned.append(obj_hex)
                 if not table:
                     self._locations.pop(owner, None)
+            # Spill marks die with the node: its disk tier is as gone
+            # as its memory, so a spilled-only copy is a lost copy.
+            for owner in list(self._spilled):
+                spilled = self._spilled[owner]
+                for obj_hex in [o for o, n in spilled.items()
+                                if n == node_hex]:
+                    del spilled[obj_hex]
+                if not spilled:
+                    self._spilled.pop(owner, None)
         return orphaned
 
 

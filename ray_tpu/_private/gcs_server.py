@@ -322,6 +322,20 @@ class GcsServer:
         # False tells the agent it is unknown/dead and must re-register.
         accepted = self.gcs.heartbeat(NodeID(node_id_bytes), available)
         if accepted and stats is not None:
+            # Spill-event piggyback: the daemon's spill tier reports
+            # (owner, object hex, "spilled"|"restored") transitions so
+            # the object directory stays spill-aware (popped — events
+            # are deltas, not stats to aggregate).
+            events = stats.pop("spill_events", None)
+            if events:
+                node_hex = node_id_bytes.hex()
+                for owner, obj_hex, kind in events:
+                    if kind == "spilled":
+                        self.object_directory.mark_spilled(
+                            owner, obj_hex, node_hex)
+                    else:
+                        self.object_directory.clear_spilled(
+                            owner, obj_hex)
             # Executor-stats piggyback: the GCS-side aggregation table
             # drivers scrape into per-node /metrics series.
             self.gcs.record_node_stats(node_id_bytes.hex(), stats)
@@ -387,8 +401,15 @@ class GcsServer:
         keepalive that refreshes the owner's lease on its entries."""
         return self.object_directory.update(owner, adds, removes)
 
-    def _list_object_locations(self, owner: str | None = None) -> dict:
-        return self.object_directory.locations(owner)
+    def _list_object_locations(self, owner: str | None = None,
+                               include_spilled: bool = False):
+        """Holder table, optionally paired with the spilled-location
+        view (``include_spilled``): consumers like the locality scorer
+        discount holders whose only copy is on disk."""
+        locations = self.object_directory.locations(owner)
+        if not include_spilled:
+            return locations
+        return (locations, self.object_directory.spilled(owner))
 
     def _prune_object_locations(self, ttl_s: float = 60.0) -> None:
         self.object_directory.prune(ttl_s)

@@ -42,6 +42,13 @@ _WATERMARK_TTL_S = 0.2
 _watermark_lock = threading.Lock()
 _watermark_sample = (0.0, -1e9)  # (fraction, sampled_at monotonic)
 _usage_override: float | None = None
+# Store-pressure axis: a registered provider reports how many of the
+# host's used bytes are RESIDENT SPILLABLE STORE BYTES — pressure the
+# spill tier can relieve without shedding. Tests pin the resulting
+# fraction directly via _set_store_fraction_override.
+_store_bytes_provider = None
+_store_fraction_override: float | None = None
+_host_total_kb = 0
 
 
 def _set_usage_override(fraction: "float | None") -> None:
@@ -51,6 +58,46 @@ def _set_usage_override(fraction: "float | None") -> None:
     with _watermark_lock:
         _usage_override = fraction
         _watermark_sample = (0.0, -1e9)
+
+
+def set_store_bytes_provider(fn) -> None:
+    """Register the () -> resident-spillable-store-bytes callable the
+    pressure classifier subtracts from host usage (the runtime/daemon
+    installs its store's resident-bytes reader here)."""
+    global _store_bytes_provider
+    _store_bytes_provider = fn
+
+
+def _set_store_fraction_override(fraction: "float | None") -> None:
+    """Test seam for the store axis: pin the store-bytes share of
+    host memory directly (None restores the provider path)."""
+    global _store_fraction_override
+    _store_fraction_override = fraction
+
+
+def _store_fraction() -> float:
+    """Resident spillable store bytes as a fraction of host memory."""
+    if _store_fraction_override is not None:
+        return _store_fraction_override
+    provider = _store_bytes_provider
+    if provider is None:
+        return 0.0
+    global _host_total_kb
+    if _host_total_kb <= 0:
+        try:
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal"):
+                        _host_total_kb = int(line.split()[1])
+                        break
+        except OSError:
+            return 0.0
+    if _host_total_kb <= 0:
+        return 0.0
+    try:
+        return float(provider()) / (_host_total_kb * 1024.0)
+    except Exception:  # noqa: BLE001 — classification must never raise
+        return 0.0
 
 
 def memory_watermark_exceeded(watermark: float) -> bool:
@@ -70,6 +117,25 @@ def memory_watermark_exceeded(watermark: float) -> bool:
                 else host_memory_usage_fraction())
         _watermark_sample = (frac, now)
         return frac >= watermark
+
+
+def memory_pressure_kind(watermark: float) -> "str | None":
+    """Classify admission memory pressure on TWO axes instead of
+    conflating them (the PR-7 watermark shed treated every byte the
+    same): ``None`` = under the watermark, ``"store"`` = over it but
+    evicting resident store bytes would bring the host back under
+    (recoverable — trigger spilling, admit), ``"host"`` = true host
+    RSS pressure spilling cannot relieve (shed).
+
+    Both axes are unit-testable via _set_usage_override (host) and
+    _set_store_fraction_override (store)."""
+    if watermark <= 0.0 or not memory_watermark_exceeded(watermark):
+        return None
+    with _watermark_lock:
+        host_frac = _watermark_sample[0]
+    if host_frac - _store_fraction() < watermark:
+        return "store"
+    return "host"
 
 
 def process_rss_bytes(pid: int) -> int:

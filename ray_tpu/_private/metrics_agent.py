@@ -50,6 +50,23 @@ def install_runtime_collectors(runtime):
         lines.append(
             f"ray_tpu_spilled_bytes_total {stats['spilled_bytes_total']}")
 
+        # Spill tier (spill_manager.py): driver-side counters for the
+        # value + export stores as one labeled family (daemon counters
+        # ride the per-node series below as the "spill" group).
+        try:
+            spill = runtime.spill_stats()
+        except Exception:  # noqa: BLE001 — partial runtime teardown
+            spill = {}
+        lines.append("# TYPE ray_tpu_spill_total counter")
+        for key, value in sorted(spill.items()):
+            if isinstance(value, (int, float)) and key != "restore_p50_ms":
+                lines.append(
+                    f'ray_tpu_spill_total{{node="driver",'
+                    f'kind="{_escape_label(key)}"}} {int(value)}')
+        lines.append("# TYPE ray_tpu_spill_restore_p50_ms gauge")
+        lines.append(f"ray_tpu_spill_restore_p50_ms "
+                     f"{spill.get('restore_p50_ms', 0.0)}")
+
         alive = sum(1 for n in runtime.gcs.list_nodes() if n.alive)
         lines.append("# TYPE ray_tpu_nodes_alive gauge")
         lines.append(f"ray_tpu_nodes_alive {alive}")
@@ -155,6 +172,7 @@ def _node_stat_lines(by_node: dict) -> list[str]:
     lines.append("# TYPE ray_tpu_node_pipeline counter")
     lines.append("# TYPE ray_tpu_node_data_plane counter")
     lines.append("# TYPE ray_tpu_node_faults counter")
+    lines.append("# TYPE ray_tpu_node_spill counter")
     for node_hex, stats in sorted(by_node.items()):
         node = _escape_label(node_hex[:16])
         if not isinstance(stats, dict):
@@ -167,7 +185,8 @@ def _node_stat_lines(by_node: dict) -> list[str]:
                          f'{stats["running"]}')
         for family, metric in (("pipeline", "ray_tpu_node_pipeline"),
                                ("data_plane", "ray_tpu_node_data_plane"),
-                               ("faults", "ray_tpu_node_faults")):
+                               ("faults", "ray_tpu_node_faults"),
+                               ("spill", "ray_tpu_node_spill")):
             group = stats.get(family)
             if not isinstance(group, dict):
                 continue

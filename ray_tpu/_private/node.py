@@ -216,6 +216,7 @@ def _install_daemon_recorder(role: str, executor) -> "object":
     def extra() -> dict:
         return {"fault_stats": executor._fault_stats(),
                 "breaker": breaker_stats(),
+                "spill": executor._spill_stats(),
                 "stage_hist": perf_plane.stage_snapshot()}
 
     return flight_recorder.install(role, flush=True, extra_fn=extra)

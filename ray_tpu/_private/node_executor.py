@@ -158,6 +158,17 @@ class NodeObjectStore:
         self._primary_bytes = 0
         # id -> (path, size): primaries moved to disk; restored on fetch.
         self._spilled: dict[bytes, tuple[str, int]] = {}
+        # Managed spill tier (spill_manager.py, armed via
+        # enable_managed_spill): watermark-driven async spilling with
+        # checksummed session-dir files replaces the legacy inline
+        # cap-based path. _managed_spills marks which _spilled entries
+        # use the headered format.
+        self._spill_mgr = None
+        self._managed_spills: set[bytes] = set()
+        self._spill_min_bytes = 0
+        self._leased_fn = None
+        self._on_spilled = None
+        self._on_restored = None
         # Ownership: id -> owner key; owner -> ids (owner-death sweep).
         self._owner_of: dict[bytes, str] = {}
         self._owned_ids: dict[str, set[bytes]] = {}
@@ -172,6 +183,138 @@ class NodeObjectStore:
         from ray_tpu._private.node_store_native import purge_stale_spills
 
         purge_stale_spills(self._spill_dir)
+
+    def enable_managed_spill(self, spill_dir: str | None = None,
+                             leased_fn=None, on_spilled=None,
+                             on_restored=None):
+        """Arm the watermark-driven spill tier on this store: primaries
+        above spill_high_watermark x the primary cap move to
+        checksummed files asynchronously (legacy inline spilling is
+        bypassed), freeing memory AND — via ``on_spilled`` — any
+        shm/arena twin. ``leased_fn`` returns the id set currently
+        pinned by same-host peers (never spilled); ``on_restored``
+        fires after a transparent restore re-registers the copy in
+        memory. Returns the SpillManager."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        from ray_tpu._private.spill_manager import SpillManager
+
+        self._leased_fn = leased_fn
+        self._on_spilled = on_spilled
+        self._on_restored = on_restored
+        self._spill_min_bytes = \
+            int(GLOBAL_CONFIG.spill_min_object_kb) * 1024
+        self._spill_mgr = SpillManager(
+            "node-store", self._primary_limit,
+            usage_fn=lambda: self._primary_bytes,
+            victims_fn=self._spill_victims,
+            extract_fn=self._spill_extract,
+            commit_fn=self._spill_commit,
+            spill_dir=spill_dir)
+        return self._spill_mgr
+
+    def _spill_victims(self, need_bytes: int) -> list:
+        """Spillable keys covering ``need_bytes``: PRIMARY copies only
+        (pulled cache copies already evict), never ids leased to
+        same-host peers, size floor applied — ordered size-descending
+        (fewest files free the most bytes) with insertion (FIFO/LRU)
+        age as the tiebreak."""
+        leased: set = set()
+        if self._leased_fn is not None:
+            try:
+                leased = set(self._leased_fn())
+            except Exception:  # noqa: BLE001 — no filter beats no spill
+                leased = set()
+        with self._lock:
+            cands = [(key, len(blob), age)
+                     for age, (key, blob) in enumerate(self._blobs.items())
+                     if key not in self._cached and key not in leased
+                     and len(blob) >= self._spill_min_bytes]
+        cands.sort(key=lambda c: (-c[1], c[2]))
+        out, covered = [], 0
+        for key, size, _age in cands:
+            out.append(key)
+            covered += size
+            if covered >= need_bytes:
+                break
+        return out
+
+    def _spill_extract(self, key: bytes):
+        with self._lock:
+            if key in self._cached:
+                return None
+            return self._blobs.get(key)
+
+    def _spill_commit(self, key: bytes, path: str, size: int) -> bool:
+        with self._lock:
+            blob = self._blobs.get(key)
+            if blob is None or key in self._cached or len(blob) != size:
+                return False  # freed/resealed since extraction
+            del self._blobs[key]
+            self._primary_bytes -= size
+            self._spilled[key] = (path, size)
+            self._managed_spills.add(key)
+            self.spills += 1
+            owner = self._owner_of.get(key)
+        if self._on_spilled is not None:
+            self._on_spilled(key, owner)
+        return True
+
+    def _restore_managed(self, key: bytes) -> bytes | None:
+        """Transparent restore of a managed spilled primary: verify the
+        checksummed file, re-insert the blob as the in-memory primary
+        (the node is a full holder again — ``on_restored`` clears the
+        directory's spill mark), delete the file. Concurrent restores
+        race benignly on the path snapshot; a torn file drops the
+        entry entirely (the caller sees absence and the owner falls
+        back to lineage reconstruction)."""
+        from ray_tpu._private.spill_manager import TornSpillError
+
+        mgr = self._spill_mgr
+        while True:
+            with self._lock:
+                blob = self._blobs.get(key)
+                if blob is not None:
+                    return blob
+                entry = self._spilled.get(key)
+                if entry is None:
+                    return None  # freed (or torn-dropped) meanwhile
+                path, size = entry
+            try:
+                payload = bytes(mgr.restore(key, path))
+            except TornSpillError:
+                with self._lock:
+                    if self._spilled.get(key) == (path, size):
+                        # The disk copy is garbage and the memory copy
+                        # is long gone: the object is LOST here. Drop
+                        # it entirely so fetchers see absence and the
+                        # owner reconstructs from lineage.
+                        self._forget(key)
+                return None
+            except OSError:
+                continue  # another reader restored + unlinked; re-check
+            with self._lock:
+                if self._spilled.get(key) != (path, size):
+                    if key in self._blobs:
+                        # Another reader restored it first: our
+                        # verified payload is the same bytes.
+                        return self._blobs[key]
+                    continue  # raced a free; re-check
+                del self._spilled[key]
+                self._managed_spills.discard(key)
+                self._blobs[key] = payload
+                self._primary_bytes += size
+                self.restores += 1
+                owner = self._owner_of.get(key)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            if self._on_restored is not None:
+                self._on_restored(key, owner)
+            # The restore may have pushed usage back over the HIGH
+            # watermark: let the spiller pick a different victim.
+            mgr.notify()
+            return payload
 
     def put(self, id_bytes: bytes, blob: bytes, cached: bool = False,
             owner: str | None = None) -> None:
@@ -199,23 +342,29 @@ class NodeObjectStore:
                         self._cache_bytes -= len(dropped)
             else:
                 self._primary_bytes += len(blob)
-                # Over the cap: spill the OLDEST primaries to disk (the
-                # newest blob is the one most likely to be fetched next).
-                # Victims are only SELECTED here — they stay readable in
-                # _blobs until the disk write lands (_spill_one), so a
-                # concurrent fetch/free never sees the object in neither
-                # map.
-                projected = self._primary_bytes
-                for victim in list(self._blobs):
-                    if projected <= self._primary_limit:
-                        break
-                    if victim in self._cached or victim == id_bytes:
-                        continue
-                    vblob = self._blobs[victim]
-                    projected -= len(vblob)
-                    spill_victims.append((victim, vblob))
+                if self._spill_mgr is None:
+                    # Legacy inline path (spill_enabled=0): over the
+                    # cap, spill the OLDEST primaries to disk (the
+                    # newest blob is the one most likely to be fetched
+                    # next). Victims are only SELECTED here — they stay
+                    # readable in _blobs until the disk write lands
+                    # (_spill_one), so a concurrent fetch/free never
+                    # sees the object in neither map.
+                    projected = self._primary_bytes
+                    for victim in list(self._blobs):
+                        if projected <= self._primary_limit:
+                            break
+                        if victim in self._cached or victim == id_bytes:
+                            continue
+                        vblob = self._blobs[victim]
+                        projected -= len(vblob)
+                        spill_victims.append((victim, vblob))
         for victim, vblob in spill_victims:
             self._spill_one(victim, vblob)
+        if self._spill_mgr is not None and not cached:
+            # Managed tier: one usage-vs-watermark comparison; the
+            # async spiller does the victim work off the put path.
+            self._spill_mgr.notify()
 
     def _spill_one(self, id_bytes: bytes, blob: bytes) -> None:
         os.makedirs(self._spill_dir, exist_ok=True)
@@ -251,7 +400,14 @@ class NodeObjectStore:
     def _drop_spilled(self, id_bytes: bytes) -> None:
         # Caller holds self._lock.
         entry = self._spilled.pop(id_bytes, None)
+        managed = id_bytes in self._managed_spills
+        self._managed_spills.discard(id_bytes)
         if entry is not None:
+            if managed and self._spill_mgr is not None:
+                # free/owner-death pruning of a managed spill file —
+                # counted (files_deleted) + flight-recorded.
+                self._spill_mgr.delete_file(entry[0])
+                return
             try:
                 os.unlink(entry[0])
             except OSError:
@@ -261,9 +417,15 @@ class NodeObjectStore:
         with self._lock:
             blob = self._blobs.get(id_bytes)
             spilled = self._spilled.get(id_bytes)
+            managed = id_bytes in self._managed_spills
         if blob is not None:
             return blob
         if spilled is not None:
+            if managed:
+                # Checksum-verified restore that re-registers the blob
+                # as the in-memory primary (None on a torn file — the
+                # object is lost here, lineage rebuilds it).
+                return self._restore_managed(id_bytes)
             try:
                 with open(spilled[0], "rb") as f:
                     data = f.read()
@@ -323,14 +485,34 @@ class NodeObjectStore:
                 return spilled[1]
         return None
 
+    def is_spilled(self, id_bytes: bytes) -> bool:
+        """True while the only local copy lives on disk (fetch plans
+        advertise it so pullers know a restore precedes the bytes)."""
+        with self._lock:
+            return (id_bytes in self._spilled
+                    and id_bytes not in self._blobs)
+
     def read_chunk(self, id_bytes: bytes, offset: int,
                    length: int) -> tuple[int, bytes] | None:
         with self._lock:
             blob = self._blobs.get(id_bytes)
             spilled = self._spilled.get(id_bytes)
+            managed = id_bytes in self._managed_spills
             if blob is not None:
                 self.fetches_served += 1
                 return len(blob), blob[offset:offset + length]
+        if spilled is not None and managed:
+            # Managed tier: restore the WHOLE object once (checksum
+            # verification needs the full payload; the restore
+            # re-registers this node as an in-memory holder) and serve
+            # every chunk from memory — torn files surface as absence,
+            # never as silently corrupt chunks.
+            blob = self._restore_managed(id_bytes)
+            if blob is None:
+                return None
+            with self._lock:
+                self.fetches_served += 1
+            return len(blob), blob[offset:offset + length]
         if spilled is None:
             return None
         # Spilled primary: stream the chunk straight from disk (restore
@@ -847,6 +1029,32 @@ class NodeExecutorService:
         # native); Python fallback keeps identical semantics.
         self.store = make_node_store()
         self._peers = _PeerClients()
+        # Watermark-driven spill tier (spill_manager.py): armed on the
+        # Python store only (the managed tier needs the lease filter +
+        # shm-twin/directory integration below; disarmed keeps the
+        # legacy native/inline behavior byte-identically).
+        from ray_tpu._private import spill_manager as _spill_mod
+
+        self._spill_mgr = None
+        # (owner, obj_hex, "spilled"|"restored") deltas pending the
+        # next heartbeat's stats piggyback into the GCS directory.
+        self._spill_events: list = []
+        self._spill_events_lock = threading.Lock()
+        self.spilled_plan_hits = 0  # pulls whose plan flagged a spill
+        if _spill_mod.SPILL_ON and isinstance(self.store,
+                                              NodeObjectStore):
+            self._spill_mgr = self.store.enable_managed_spill(
+                leased_fn=self._spill_protected,
+                on_spilled=self._on_blob_spilled,
+                on_restored=self._on_blob_restored)
+            # Admission's two-axis pressure classifier subtracts THIS
+            # store's resident (spillable) bytes from host usage.
+            from ray_tpu._private.memory_monitor import (
+                set_store_bytes_provider,
+            )
+
+            set_store_bytes_provider(
+                lambda: getattr(self.store, "_primary_bytes", 0))
         # P2P transfer plane: in-progress/relay pulls servable to peers
         # + the holder directory for objects THIS node owns.
         self._partials: dict[bytes, _PartialBlob] = {}
@@ -896,6 +1104,12 @@ class NodeExecutorService:
         self._shm_args_lock = threading.Lock()
         self._shm_args_order: list[tuple[bytes, int]] = []
         self._shm_args_bytes = 0
+        # key -> monotonic stamp of the last worker-bound _ShmRef
+        # hand-out: the spiller must not unlink a segment a dispatched
+        # frame is about to attach (attach-after-unlink fails even
+        # though existing mappings survive), so recently-out keys are
+        # spill-protected for _SHM_ARG_GRACE_S.
+        self._shm_out_stamp: dict[bytes, float] = {}
         self._resources = dict(resources or {})
         self._running_lock = threading.Lock()
         self._running: dict[str, dict[str, float]] = {}
@@ -1091,6 +1305,8 @@ class NodeExecutorService:
     def stop(self) -> None:
         self._stop_event.set()
         self._server.stop()
+        if self._spill_mgr is not None:
+            self._spill_mgr.stop()
         # Same-host plane: drop owner-side pins (peers' leases) and
         # this daemon's peer mappings before the directories unwind.
         self.leases.clear()
@@ -1362,6 +1578,7 @@ class NodeExecutorService:
             "role": _proc_label(), "pid": os.getpid(), "events": []}
         snap.setdefault("fault_stats", self._fault_stats())
         snap.setdefault("breaker", breaker_stats())
+        snap.setdefault("spill", self._spill_stats())
         snap.setdefault("stage_hist", perf.stage_snapshot())
         return snap
 
@@ -1402,6 +1619,48 @@ class NodeExecutorService:
             return  # /dev/shm full: chunked fallback still serves
         seg.buf[:len(blob)] = blob
         self._register_shm_arg(id_bytes, seg, len(blob))
+
+    _SHM_ARG_GRACE_S = 30.0
+
+    def _spill_protected(self) -> set:
+        """Ids the spiller must skip: same-host peers' lease pins plus
+        keys whose worker-bound _ShmRef went out within the grace
+        window (their frames may not have attached the segment yet)."""
+        out = set(self.leases.pinned_ids())
+        now = time.monotonic()
+        with self._shm_args_lock:
+            for key in [k for k, at in self._shm_out_stamp.items()
+                        if now - at > self._SHM_ARG_GRACE_S]:
+                del self._shm_out_stamp[key]
+            out.update(self._shm_out_stamp)
+        return out
+
+    def _on_blob_spilled(self, key: bytes, owner: str | None) -> None:
+        """A primary moved to the disk tier: free its shm/arena twin
+        (the spiller's victim filter already excluded leased ids, so
+        no same-host peer holds a pin; POSIX keeps already-mapped
+        segments valid past the unlink) and queue the spilled-location
+        delta for the next heartbeat's directory piggyback."""
+        self._drop_shm_arg(key)
+        if owner:
+            with self._spill_events_lock:
+                self._spill_events.append((owner, key.hex(), "spilled"))
+                del self._spill_events[:-4096]  # bounded
+
+    def _on_blob_restored(self, key: bytes, owner: str | None) -> None:
+        """A spilled primary is back in memory: the node never left the
+        holder set, so clearing the directory's spill mark IS the
+        re-registration (the shm twin rebuilds lazily on the next
+        worker-bound fetch via _blob_to_shm)."""
+        if owner:
+            with self._spill_events_lock:
+                self._spill_events.append((owner, key.hex(), "restored"))
+                del self._spill_events[:-4096]
+
+    def _drain_spill_events(self) -> list:
+        with self._spill_events_lock:
+            out, self._spill_events = self._spill_events, []
+        return out
 
     def set_load_listener(self, listener: Callable[[], None]) -> None:
         self._load_listener = listener
@@ -1471,11 +1730,33 @@ class NodeExecutorService:
         watermark = float(
             GLOBAL_CONFIG.admission_memory_watermark or 0)
         if watermark > 0:
+            from ray_tpu._private import spill_manager as _spill_mod
             from ray_tpu._private.memory_monitor import (
+                memory_pressure_kind,
                 memory_watermark_exceeded,
             )
 
-            if memory_watermark_exceeded(watermark):
+            if _spill_mod.SPILL_ON and self._spill_mgr is not None:
+                # Two-axis classification: STORE pressure is
+                # recoverable — kick the spiller and admit (degrade to
+                # disk, not to failure) — unless disk-full backoff
+                # means spilling cannot relieve it, which falls
+                # through to the typed shed exactly like true HOST
+                # pressure.
+                kind = memory_pressure_kind(watermark)
+                if kind == "store":
+                    if not self._spill_mgr.backing_off():
+                        self._spill_mgr.request_spill()
+                        kind = None
+                    else:
+                        return ("store memory over admission_memory_"
+                                f"watermark={watermark} and the spill "
+                                "disk is full (backing off)")
+                if kind == "host":
+                    return (f"host memory over admission_memory_"
+                            f"watermark={watermark}")
+            elif memory_watermark_exceeded(watermark):
+                # Disarmed tier: the PR-7 single-axis shed, unchanged.
                 return (f"host memory over admission_memory_watermark"
                         f"={watermark}")
         return None
@@ -1823,8 +2104,15 @@ class NodeExecutorService:
         # A mapping puller never holds servable CHUNKS — registering it
         # as a relay holder would advertise a peer that serves nothing.
         reg_addr = None if map_info is not None else puller_addr
+        # Spill-aware reply: a spilled local copy has no shm twin to
+        # map (map_info is naturally None — the twin was freed at
+        # spill time) and the chunked pull will pay a verify+restore
+        # first; the 4th element tells the puller so.
+        spilled = bool(getattr(self.store, "is_spilled",
+                               lambda _k: False)(id_bytes))
         return (total, plan_holders(self.chunk_directory, id_bytes,
-                                    reg_addr, total), map_info)
+                                    reg_addr, total), map_info,
+                {"spilled": spilled})
 
     def _grant_map_lease(self, id_bytes: bytes,
                          holder: str) -> dict | None:
@@ -1993,7 +2281,15 @@ class NodeExecutorService:
                 "data_plane": self._data_plane_stats(),
                 "pipeline": self._pipeline_stats(),
                 "faults": self._fault_stats(),
+                "spill": self._spill_stats(),
                 "threads": threading.active_count()}
+
+    def _spill_stats(self) -> dict:
+        from ray_tpu._private.spill_manager import merged_stats
+
+        stats = merged_stats(self._spill_mgr)
+        stats["spilled_plan_hits"] = self.spilled_plan_hits
+        return stats
 
     def stats_for_sync(self) -> dict:
         """Heartbeat-piggyback subset of ``executor_stats()``: the
@@ -2016,6 +2312,13 @@ class NodeExecutorService:
                  "pipeline": self._pipeline_stats(),
                  "data_plane": self._data_plane_stats(),
                  "faults": self._fault_stats()}
+        if self._spill_mgr is not None:
+            stats["spill"] = self._spill_stats()
+            # Spilled/restored location deltas for the GCS object
+            # directory (the head pops them before recording stats).
+            events = self._drain_spill_events()
+            if events:
+                stats["spill_events"] = events
         if perf.PERF_ON:
             # Always-on plane piggyback: mergeable-by-addition stage
             # histograms + the per-function attribution table ride the
@@ -2415,6 +2718,10 @@ class NodeExecutorService:
         key = ref.id_bytes
         with self._shm_args_lock:
             desc = self._shm_directory.lookup(key)
+            # Spill protection: this desc is about to ride a worker
+            # frame — the spiller must not unlink its segment before
+            # the worker attaches.
+            self._shm_out_stamp[key] = time.monotonic()
         if desc is not None:
             return desc
         blob = self.store.get(key)
@@ -2469,6 +2776,11 @@ class NodeExecutorService:
             plan = None  # owner predates fetch_plan
         map_info = plan[2] if plan is not None and len(plan) > 2 \
             else None
+        if plan is not None and len(plan) > 3 and plan[3] \
+                and plan[3].get("spilled"):
+            # The holder's copy is on its disk tier: no map lease can
+            # exist and the first chunk pays the holder's restore.
+            self.spilled_plan_hits += 1
         if map_info is not None:
             # Co-hosted holder: map its shared memory (or memcpy out of
             # it) instead of moving the bytes through the transport.
@@ -2983,6 +3295,16 @@ class NodeExecutorService:
         from ray_tpu._private.same_host import sweep_orphan_shm
 
         self.arena_orphans_swept += sweep_orphan_shm()
+        # Same for a SIGKILLed owner's per-pid spill directory: its
+        # files back objects whose store died with it — any co-hosted
+        # survivor deletes the whole tier (pid-liveness gated).
+        from ray_tpu._private import spill_manager as _spill_mod
+
+        if _spill_mod.SPILL_ON:
+            swept = _spill_mod.sweep_orphan_spill_dirs()
+            if swept and self._spill_mgr is not None:
+                with self._spill_mgr._lock:
+                    self._spill_mgr.orphan_dirs_swept += swept
 
     def _trim_relays(self) -> None:
         """Bound completed relay copies by node_relay_cache_mb (oldest

@@ -201,10 +201,19 @@ def purge_stale_spills(spill_dir: str) -> None:
 def make_node_store(**kwargs):
     """Native store when the toolchain/library is available (the C++
     data plane is the default, like the reference's raylet store);
-    Python fallback otherwise — both honor the same config knobs."""
+    Python fallback otherwise — both honor the same config knobs.
+
+    With the managed spill tier armed (``spill_enabled``, the default)
+    the Python store is used: the watermark spiller needs the
+    lease-filter/shm-twin/directory integration the executor wires
+    through NodeObjectStore.enable_managed_spill (the C++ store keeps
+    its own internal cap-based spilling, without checksums or
+    directory awareness). ``spill_enabled=0`` restores the legacy
+    native-first selection byte-identically."""
+    from ray_tpu._private import spill_manager
     from ray_tpu._private.config import GLOBAL_CONFIG
 
-    if bool(GLOBAL_CONFIG.node_store_native):
+    if bool(GLOBAL_CONFIG.node_store_native) and not spill_manager.SPILL_ON:
         from ray_tpu._native import load
 
         lib = load()

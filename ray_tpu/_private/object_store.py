@@ -78,6 +78,16 @@ class ObjectEntry:
     created_at: float = field(default_factory=time.monotonic)
     # Pinned while a get() is materializing it; pinned entries never spill.
     pin_count: int = 0
+    # Managed spill tier (spill_manager.py): the spilled file carries
+    # the length+CRC header and restores verify it (torn -> lineage).
+    managed_spill: bool = False
+    # LRU signal for the managed victim policy (stamped on get).
+    last_used: float = field(default_factory=time.monotonic)
+
+
+class _TornRestore(Exception):
+    """Internal: a managed spill file failed its checksum — the entry
+    was marked lost and the getter must wait for lineage recovery."""
 
 
 class ObjectStore:
@@ -105,6 +115,122 @@ class ObjectStore:
         # grouped seals happened and how many objects rode them.
         self.batch_seals = 0
         self.batch_sealed_objects = 0
+        # Managed spill tier (spill_manager.py) — armed by the runtime
+        # via enable_managed_spill; None keeps the legacy inline path.
+        self._spill = None
+        self._spill_min_bytes = 4096
+        self._leased_fn = None
+        self._on_backing_free = None
+        self._on_torn = None
+        # Values that failed to pickle once: never re-selected (an
+        # unpicklable giant would otherwise be re-serialized per pass).
+        self._unspillable: set[ObjectID] = set()
+
+    # ------------------------------------------------------- managed spill
+
+    def enable_managed_spill(self, spill_dir: str | None = None,
+                             leased_fn=None, on_backing_free=None,
+                             on_torn=None):
+        """Arm the watermark-driven spill tier: sealed unpinned values
+        above spill_high_watermark x the memory limit move to
+        checksummed session-dir files asynchronously; restores verify
+        the CRC and a torn file falls back to lineage reconstruction
+        via ``on_torn(object_id)``. ``leased_fn`` yields id BYTES
+        currently leased to same-host peers (never spilled);
+        ``on_backing_free(object_id)`` drops the object's shm/arena
+        twin after its heap copy moved to disk."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        from ray_tpu._private.spill_manager import SpillManager
+
+        self._leased_fn = leased_fn
+        self._on_backing_free = on_backing_free
+        self._on_torn = on_torn
+        self._spill_min_bytes = max(
+            4096, int(GLOBAL_CONFIG.spill_min_object_kb) * 1024)
+        self._spill = SpillManager(
+            "driver-store", self._memory_limit,
+            usage_fn=lambda: self._memory_used,
+            victims_fn=self._spill_victims,
+            extract_fn=self._spill_extract,
+            commit_fn=self._spill_commit,
+            spill_dir=spill_dir)
+        return self._spill
+
+    def _spill_victims(self, need_bytes: int) -> list:
+        leased: set = set()
+        if self._leased_fn is not None:
+            try:
+                leased = {bytes(b) for b in self._leased_fn()}
+            except Exception:  # noqa: BLE001
+                leased = set()
+        with self._lock:
+            cands = [
+                (e.object_id, e.size_bytes, e.last_used)
+                for e in self._entries.values()
+                if e.sealed and not e.freed and e.error is None
+                and e.spilled_path is None and e.pin_count == 0
+                and e.size_bytes >= self._spill_min_bytes
+                and e.object_id not in self._unspillable
+                and e.object_id.binary() not in leased]
+        # Size-ordered (largest first — fewest files free the most
+        # bytes), least-recently-used as the tiebreak.
+        cands.sort(key=lambda c: (-c[1], c[2]))
+        out, covered = [], 0
+        for oid, size, _used in cands:
+            out.append(oid)
+            covered += size
+            if covered >= need_bytes:
+                break
+        return out
+
+    def _spill_extract(self, object_id: ObjectID):
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None or not entry.sealed or entry.freed \
+                    or entry.error is not None or entry.pin_count > 0 \
+                    or entry.spilled_path is not None:
+                return None
+            value = entry.value
+        # Pickle OUTSIDE the lock (walks user containers; GC can run
+        # arbitrary __del__s — same discipline as _sizeof in _seal).
+        try:
+            return pickle.dumps(value, protocol=pickle.HIGHEST_PROTOCOL)
+        except Exception:  # noqa: BLE001 — unpicklable stays in memory
+            with self._lock:
+                self._unspillable.add(object_id)
+            return None
+
+    def _spill_commit(self, object_id: ObjectID, path: str,
+                      size: int) -> bool:
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None or not entry.sealed or entry.freed \
+                    or entry.error is not None or entry.pin_count > 0 \
+                    or entry.spilled_path is not None:
+                return False
+            entry.spilled_path = path
+            entry.managed_spill = True
+            entry.value = None
+            self._memory_used -= entry.size_bytes
+            self._spilled_bytes_total += entry.size_bytes
+        if self._on_backing_free is not None:
+            self._on_backing_free(object_id)
+        return True
+
+    def _unlink_spill(self, entry: ObjectEntry) -> None:
+        """Drop an entry's spill file (free/evict/reseal pruning) —
+        counted by the manager when it owns the format."""
+        path, entry.spilled_path = entry.spilled_path, None
+        entry.managed_spill = False
+        if path is None:
+            return
+        if self._spill is not None:
+            self._spill.delete_file(path)
+            return
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
 
     # ------------------------------------------------------------------ put
 
@@ -181,10 +307,7 @@ class ObjectStore:
             if entry.spilled_path is not None:
                 # Spilled copies already gave their bytes back; just drop
                 # the stale file.
-                try:
-                    os.unlink(entry.spilled_path)
-                except OSError:
-                    pass
+                self._unlink_spill(entry)
             else:
                 self._memory_used -= entry.size_bytes
         entry.value = value
@@ -193,8 +316,10 @@ class ObjectStore:
         entry.freed = False
         entry.lost = False
         entry.spilled_path = None
+        entry.managed_spill = False
         entry.size_bytes = size_bytes
         self._memory_used += entry.size_bytes
+        self._unspillable.discard(object_id)
 
     def add_seal_listener(self, cb: Callable[[ObjectID], None]) -> None:
         with self._lock:
@@ -210,50 +335,120 @@ class ObjectStore:
     # ------------------------------------------------------------------ get
 
     def get(self, object_id: ObjectID, timeout: float | None = None) -> Any:
-        """Block until the object is sealed; raise stored errors."""
+        """Block until the object is sealed; raise stored errors.
+
+        A managed spill restore that finds its file TORN re-enters the
+        wait loop after firing the runtime's lineage-recovery hook —
+        the getter blocks until the producing task reseals the value
+        (or an ObjectLostError is sealed in), never sees garbage."""
         deadline = None if timeout is None else time.monotonic() + timeout
-        with self._lock:
-            while True:
-                entry = self._entries.get(object_id)
-                if entry is not None and entry.freed:
-                    raise ObjectFreedError(object_id, f"object {object_id.hex()} was freed")
-                if entry is not None and entry.sealed:
-                    break
-                if entry is None:
-                    # Unknown id: wait for it to appear (it may be in flight).
-                    pass
-                remaining = None if deadline is None else deadline - time.monotonic()
-                if remaining is not None and remaining <= 0:
-                    raise GetTimeoutError(
-                        f"get() timed out waiting for object {object_id.hex()}")
-                self._lock.wait(timeout=remaining if remaining is None else min(remaining, 1.0))
-            entry.pin_count += 1
-        try:
-            value, error = self._materialize(entry)
-        finally:
+        while True:
             with self._lock:
-                entry.pin_count -= 1
-        if error is not None:
-            raise error
-        return value
+                while True:
+                    entry = self._entries.get(object_id)
+                    if entry is not None and entry.freed:
+                        raise ObjectFreedError(object_id, f"object {object_id.hex()} was freed")
+                    if entry is not None and entry.sealed:
+                        break
+                    if entry is None:
+                        # Unknown id: wait for it to appear (it may be in flight).
+                        pass
+                    remaining = None if deadline is None else deadline - time.monotonic()
+                    if remaining is not None and remaining <= 0:
+                        raise GetTimeoutError(
+                            f"get() timed out waiting for object {object_id.hex()}")
+                    self._lock.wait(timeout=remaining if remaining is None else min(remaining, 1.0))
+                entry.pin_count += 1
+                entry.last_used = time.monotonic()
+            torn = False
+            try:
+                value, error = self._materialize(entry)
+            except _TornRestore:
+                torn = True
+            finally:
+                with self._lock:
+                    entry.pin_count -= 1
+            if torn:
+                # The entry was marked lost under the lock; hand the
+                # loss to the runtime's recovery hook (lineage rebuild
+                # or a sealed ObjectLostError) and wait for the reseal.
+                if self._on_torn is not None:
+                    try:
+                        self._on_torn(object_id)
+                    except Exception:  # noqa: BLE001
+                        pass
+                else:
+                    # No recovery hook (standalone store): fail the
+                    # waiters instead of blocking on a reseal that can
+                    # never come.
+                    from ray_tpu._private.object_ref import ObjectRef
+
+                    self.put_error(object_id, ObjectLostError(
+                        ObjectRef(object_id, _register=False),
+                        f"object {object_id.hex()} spill file was torn "
+                        f"and no lineage recovery is wired"))
+                continue
+            if error is not None:
+                raise error
+            return value
 
     def _materialize(self, entry: ObjectEntry):
         """Load a (possibly spilled) sealed entry. Called outside hot lock.
 
         Concurrent restores of the same object race benignly: each reader
         snapshots the path under the lock, and only the thread whose
-        snapshot still matches performs the restore/unlink.
+        snapshot still matches performs the restore/unlink. Managed
+        spill files additionally verify their length+CRC header; a
+        torn file marks the entry LOST and raises _TornRestore (the
+        getter fires lineage recovery and re-waits).
         """
+        from ray_tpu._private.spill_manager import TornSpillError
+
         while True:
             with self._lock:
                 path = entry.spilled_path
+                managed = entry.managed_spill
             if path is None:
                 return entry.value, entry.error
-            try:
-                with open(path, "rb") as f:
-                    value = pickle.load(f)
-            except FileNotFoundError:
-                continue  # another reader restored it; re-check
+            if managed:
+                try:
+                    payload = self._spill.restore(
+                        entry.object_id.binary(), path)
+                except TornSpillError:
+                    with self._lock:
+                        if entry.spilled_path != path:
+                            continue  # raced a reseal; re-check
+                        entry.spilled_path = None
+                        entry.managed_spill = False
+                        entry.value = None
+                        entry.sealed = False
+                        entry.lost = True
+                    raise _TornRestore() from None
+                except OSError:
+                    continue  # another reader restored it; re-check
+                try:
+                    value = pickle.loads(payload)
+                except Exception as exc:  # noqa: BLE001 — poisoned pickle
+                    # The CRC passed but the payload won't load (e.g. a
+                    # class definition changed): same fallback as torn.
+                    with self._lock:
+                        if entry.spilled_path != path:
+                            continue
+                        entry.spilled_path = None
+                        entry.managed_spill = False
+                        entry.sealed = False
+                        entry.lost = True
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+                    raise _TornRestore() from exc
+            else:
+                try:
+                    with open(path, "rb") as f:
+                        value = pickle.load(f)
+                except FileNotFoundError:
+                    continue  # another reader restored it; re-check
             with self._lock:
                 if entry.spilled_path == path:
                     try:
@@ -261,11 +456,16 @@ class ObjectStore:
                     except OSError:
                         pass
                     entry.spilled_path = None
+                    entry.managed_spill = False
                     entry.value = value
                     self._memory_used += entry.size_bytes
                     self._restored_bytes_total += entry.size_bytes
             self._maybe_spill()
-            return entry.value, entry.error
+            # Return OUR loaded copy, not entry.value: a concurrent
+            # reader may have restored and the async spiller re-spilled
+            # (entry.value None again) between our read and the lock —
+            # the bytes we verified are the object either way.
+            return value, entry.error
 
     def mark_lost(self, object_id: ObjectID) -> bool:
         """Transition a sealed object back to pending because its node
@@ -281,11 +481,7 @@ class ObjectStore:
                 # spilling): the driver-held copy survives the node.
                 return False
             if entry.spilled_path is not None:
-                try:
-                    os.unlink(entry.spilled_path)
-                except OSError:
-                    pass
-                entry.spilled_path = None
+                self._unlink_spill(entry)
             else:
                 self._memory_used -= entry.size_bytes
             entry.value = None
@@ -347,29 +543,25 @@ class ObjectStore:
                 if entry.sealed and entry.spilled_path is None:
                     self._memory_used -= entry.size_bytes
                 if entry.spilled_path is not None:
-                    try:
-                        os.unlink(entry.spilled_path)
-                    except OSError:
-                        pass
+                    self._unlink_spill(entry)
                 entry.value = None
                 entry.error = None
                 entry.freed = True
                 entry.sealed = True
                 entry.spilled_path = None
+                self._unspillable.discard(oid)
             self._lock.notify_all()
 
     def evict(self, object_id: ObjectID) -> None:
         """Drop an object entirely (refcount reached zero)."""
         with self._lock:
             entry = self._entries.pop(object_id, None)
+            self._unspillable.discard(object_id)
             if entry is not None and entry.sealed and not entry.freed \
                     and entry.spilled_path is None:
                 self._memory_used -= entry.size_bytes
             if entry is not None and entry.spilled_path is not None:
-                try:
-                    os.unlink(entry.spilled_path)
-                except OSError:
-                    pass
+                self._unlink_spill(entry)
 
     # ----------------------------------------------------------------- spill
 
@@ -377,8 +569,14 @@ class ObjectStore:
         """Spill least-recently-created unpinned objects above the budget.
 
         Reference: LocalObjectManager::SpillObjects
-        (src/ray/raylet/local_object_manager.h:110).
+        (src/ray/raylet/local_object_manager.h:110). With the managed
+        tier armed, the async spiller replaces this inline pass — one
+        watermark comparison here, the victim work happens off the
+        seal path.
         """
+        if self._spill is not None:
+            self._spill.notify()
+            return
         to_spill: list[ObjectEntry] = []
         with self._lock:
             if self._memory_used <= self._memory_limit:

@@ -86,14 +86,19 @@ class ObjectRecoveryManager:
         self._lock = threading.Lock()
         self._in_flight: set[ObjectID] = set()
         self.num_recoveries = 0
+        # Rebuilds triggered by a torn SPILL file (checksum mismatch on
+        # restore, spill_manager.py) — split out so the chaos tests and
+        # /metrics can tell disk corruption from node death.
+        self.num_torn_recoveries = 0
 
-    def recover(self, object_id: ObjectID) -> bool:
+    def recover(self, object_id: ObjectID, reason: str = "lost") -> bool:
         """Resubmit the producing task (and lost deps, recursively).
 
         Returns False when no lineage exists (e.g. ``put()`` objects or
         evicted lineage) — the caller should fail waiters with
-        ObjectLostError. Idempotent per in-flight object.
-        """
+        ObjectLostError. Idempotent per in-flight object. ``reason``
+        attributes the rebuild ("lost" = node death/object loss,
+        "spill_torn" = corrupt spill file)."""
         spec = self._runtime.lineage.lookup(object_id)
         if spec is None:
             return False
@@ -112,6 +117,8 @@ class ObjectRecoveryManager:
                 return True
             self._in_flight.update(spec.return_ids)
             self.num_recoveries += 1
+            if reason == "spill_torn":
+                self.num_torn_recoveries += 1
 
         store = self._runtime.store
         deps = []

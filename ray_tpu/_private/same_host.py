@@ -216,6 +216,21 @@ class LeaseTable:
                     "released": self.released, "expired": self.expired}
 
 
+def pid_is_dead(pid: int) -> bool:
+    """0-signal liveness probe shared by the orphan sweepers (native
+    arena segments here, per-pid spill directories in
+    spill_manager.sweep_orphan_spill_dirs): True ONLY for a pid that
+    provably does not exist — alive-under-another-user (EPERM) counts
+    as alive, so cross-user state is never touched."""
+    try:
+        os.kill(pid, 0)
+        return False
+    except ProcessLookupError:
+        return True
+    except PermissionError:
+        return False
+
+
 def sweep_orphan_shm() -> int:
     """Unlink native arena segments (``/dev/shm/ray_tpu_arena_<pid>``)
     whose owning process died without cleaning up.
@@ -241,15 +256,8 @@ def sweep_orphan_shm() -> int:
         if not match:
             continue
         pid = int(match.group(1))
-        if pid == os.getpid():
+        if pid == os.getpid() or not pid_is_dead(pid):
             continue
-        try:
-            os.kill(pid, 0)
-            continue  # owner alive
-        except ProcessLookupError:
-            pass  # owner dead: orphan
-        except PermissionError:
-            continue  # alive under another user
         path = os.path.join("/dev/shm", name)
         try:
             if os.stat(path).st_uid != os.getuid():

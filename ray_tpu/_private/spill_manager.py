@@ -1,0 +1,473 @@
+"""Watermark-driven object spilling — the per-node disk tier.
+
+TPU-native analogue of the reference's spill stack (reference:
+src/ray/raylet/local_object_manager.h:110 SpillObjects +
+src/ray/object_manager/spilled_object_reader.h): when a store's
+resident bytes cross ``spill_high_watermark`` × capacity, an async
+spiller thread moves unpinned/unleased victims to files under
+``$RAY_TPU_SESSION_DIR/spill/<pid>/`` and frees their memory (and any
+shm/arena twin), restoring transparently on the next read. The store
+survives working sets far beyond RAM instead of shedding them.
+
+Design points, all robustness-first:
+
+- **File format**: a 16-byte header — magic ``RTS1``, payload length
+  (u64 LE) and CRC32 — precedes the payload. Every restore verifies
+  length AND checksum; a torn file (crash mid-write, disk corruption)
+  raises ``TornSpillError`` and the caller falls back to lineage
+  reconstruction (recovery.py) instead of returning silent garbage.
+  Files are written tmp-then-rename with an ``spill_fsync`` policy
+  knob (durability vs latency).
+- **Hysteresis**: the spiller wakes above the HIGH watermark and
+  spills until resident bytes drop below the LOW watermark, so store
+  churn near the boundary doesn't thrash one-object spill/restore
+  cycles.
+- **Victim policy**: the owning store supplies candidates — sealed
+  PRIMARY copies only (pulled cache copies are already evictable),
+  never pinned readers, never objects leased to same-host peers —
+  ordered size-descending (fewest files free the most bytes) with
+  LRU/FIFO age as the tiebreak.
+- **Disk-full backs off, never crashes**: any OSError on the write
+  path (ENOSPC above all) raises ``SpillDiskFullError``; the manager
+  enters a backoff window during which admission's store-pressure
+  classification degrades to the existing typed shed
+  (SystemOverloadedError) instead of the daemon dying with a full
+  disk.
+- **Orphan sweep**: spill files live in a per-pid directory, so any
+  co-hosted survivor can reap a SIGKILLed owner's files the same way
+  arenas are swept (same_host.sweep_orphan_shm) — 0-signal liveness
+  probe, same-uid only.
+
+Chaos sites (chaos.py): ``spill.torn_write`` truncates a spill file's
+payload mid-write (the header still promises the full length, so the
+next restore detects the tear), ``spill.disk_full`` fails the write
+with SpillDiskFullError, ``spill.restore_delay`` sleeps before a
+restore read (races restores against concurrent gets/frees).
+
+Disarmed (``spill_enabled=0``), no manager is ever constructed and
+every integration site costs one module-attribute branch
+(``spill_manager.SPILL_ON`` — same discipline as perf_plane.PERF_ON /
+chaos.ACTIVE); the stores keep their legacy inline cap-based spilling
+byte-identically.
+"""
+
+from __future__ import annotations
+
+import errno
+import os
+import shutil
+import struct
+import threading
+import time
+import zlib
+from typing import Callable
+
+# The ONE disarmed branch per integration site.
+SPILL_ON = True
+
+
+def init_from_config() -> None:
+    """Arm/disarm the module gate from the (possibly system_config-
+    overridden) ``spill_enabled`` knob — called at runtime init."""
+    global SPILL_ON
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    SPILL_ON = bool(GLOBAL_CONFIG.spill_enabled)
+
+
+class TornSpillError(Exception):
+    """A spill file failed its length/CRC check on restore: the bytes
+    on disk are NOT the object. The caller must treat the object as
+    lost (lineage reconstruction), never serve the payload."""
+
+
+class SpillDiskFullError(Exception):
+    """The spill write could not land (ENOSPC/EDQUOT/any OSError):
+    the spiller backs off and admission degrades store pressure to
+    the typed shed path instead of crashing."""
+
+
+_MAGIC = b"RTS1"
+_HEADER = struct.Struct("<4sQI")  # magic, payload length, crc32
+
+
+def session_spill_root() -> str:
+    return os.path.join(
+        os.environ.get("RAY_TPU_SESSION_DIR", "/tmp/ray_tpu"), "spill")
+
+
+def process_spill_dir(pid: int | None = None) -> str:
+    """Per-pid spill directory: the pid in the PATH (not the filename)
+    is what lets survivors sweep a dead owner's whole tier in one
+    liveness probe."""
+    return os.path.join(session_spill_root(), str(pid or os.getpid()))
+
+
+def write_spill_file(path: str, payload, fsync: bool = False) -> None:
+    """Write ``payload`` with the length+CRC header, tmp-then-rename.
+
+    Raises SpillDiskFullError on ANY write-path OSError (disk full is
+    the expected production cause; an unwritable dir behaves the
+    same — back off, don't crash)."""
+    from ray_tpu._private import chaos
+
+    if chaos.ACTIVE is not None and chaos.ACTIVE.should("spill.disk_full"):
+        raise SpillDiskFullError("chaos: spill.disk_full")
+    torn = (chaos.ACTIVE is not None
+            and chaos.ACTIVE.should("spill.torn_write"))
+    header = _HEADER.pack(_MAGIC, len(payload),
+                          zlib.crc32(payload) & 0xFFFFFFFF)
+    tmp = f"{path}.tmp{os.getpid()}"
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(tmp, "wb") as f:
+            f.write(header)
+            if torn:
+                # Torn write: the header promises the full payload but
+                # only half lands (the crash-mid-write shape). The
+                # rename still happens — exactly what a power cut
+                # after a partial flush leaves behind.
+                f.write(memoryview(payload)[:len(payload) // 2])
+            else:
+                f.write(payload)
+            if fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except OSError as exc:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise SpillDiskFullError(
+            f"spill write failed ({errno.errorcode.get(exc.errno, '?')}): "
+            f"{exc}") from exc
+
+
+def read_spill_file(path: str) -> bytes:
+    """Read + verify one spill file. Raises TornSpillError on a bad
+    magic/length/CRC, OSError when the file is gone."""
+    from ray_tpu._private import chaos
+
+    if chaos.ACTIVE is not None \
+            and chaos.ACTIVE.should("spill.restore_delay"):
+        time.sleep(0.05 + 0.45 * chaos.ACTIVE.uniform())
+    with open(path, "rb") as f:
+        header = f.read(_HEADER.size)
+        if len(header) != _HEADER.size:
+            raise TornSpillError(f"{path}: truncated header")
+        magic, length, crc = _HEADER.unpack(header)
+        if magic != _MAGIC:
+            raise TornSpillError(f"{path}: bad magic {magic!r}")
+        payload = f.read(length + 1)  # +1 detects trailing garbage
+    if len(payload) != length:
+        raise TornSpillError(
+            f"{path}: payload {len(payload)} != header length {length}")
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise TornSpillError(f"{path}: CRC mismatch")
+    return payload
+
+
+class SpillManager:
+    """Async spiller for ONE store: watermark hysteresis, victim
+    selection via store callbacks, checksummed file IO, disk-full
+    backoff, restore accounting.
+
+    The owning store keeps its own locking and supplies:
+
+    - ``usage_fn() -> int``: resident managed bytes right now;
+    - ``victims_fn(need_bytes) -> list[bytes]``: spillable keys
+      covering ``need_bytes`` (primary, unpinned, unleased — the
+      store applies the filters, size-ordered with age tiebreak);
+    - ``extract_fn(key) -> payload | None``: the bytes to write (None
+      when the object became ineligible since selection);
+    - ``commit_fn(key, path, size) -> bool``: atomically swap the
+      in-memory copy for the disk pointer; False means a concurrent
+      free/reseal raced the write and the manager unlinks the stale
+      file.
+    """
+
+    def __init__(self, role: str, capacity_bytes: int,
+                 usage_fn: Callable[[], int],
+                 victims_fn: Callable[[int], list],
+                 extract_fn: Callable, commit_fn: Callable,
+                 spill_dir: str | None = None,
+                 high_watermark: float | None = None,
+                 low_watermark: float | None = None,
+                 fsync: bool | None = None,
+                 backoff_s: float | None = None):
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        self.role = role
+        self.capacity = int(capacity_bytes)
+        self.spill_dir = spill_dir or process_spill_dir()
+        self.high = float(high_watermark
+                          if high_watermark is not None
+                          else GLOBAL_CONFIG.spill_high_watermark)
+        self.low = float(low_watermark if low_watermark is not None
+                         else GLOBAL_CONFIG.spill_low_watermark)
+        self.fsync = bool(GLOBAL_CONFIG.spill_fsync
+                          if fsync is None else fsync)
+        self._backoff_s = float(
+            GLOBAL_CONFIG.spill_disk_full_backoff_s
+            if backoff_s is None else backoff_s)
+        self._usage = usage_fn
+        self._victims = victims_fn
+        self._extract = extract_fn
+        self._commit = commit_fn
+        self._lock = threading.Lock()
+        self._backoff_until = 0.0
+        self._forced = False
+        # Counters (read under the lock via stats()).
+        self.spills = 0
+        self.restores = 0
+        self.spilled_bytes = 0
+        self.restored_bytes = 0
+        self.torn_restores = 0
+        self.disk_full = 0
+        self.files_deleted = 0
+        self.orphan_dirs_swept = 0
+        # Bounded restore-latency samples (exact p50 for the bench's
+        # restore-path row; 512 samples bound the memory).
+        self._restore_walls: list[float] = []
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"ray_tpu-spiller-{role}")
+        self._thread.start()
+        with _LIVE_LOCK:
+            _LIVE.add(self)
+
+    # ------------------------------------------------------------ triggers
+
+    def high_bytes(self) -> int:
+        return int(self.capacity * self.high)
+
+    def low_bytes(self) -> int:
+        return int(self.capacity * self.low)
+
+    def notify(self) -> None:
+        """Store usage changed: wake the spiller if over the HIGH
+        watermark (one comparison on the store's put path)."""
+        if self._usage() > self.high_bytes():
+            self._wake.set()
+
+    def request_spill(self) -> None:
+        """Admission kick: store pressure was classified as spillable —
+        spill toward the LOW watermark regardless of the high check."""
+        self._forced = True
+        self._wake.set()
+
+    def backing_off(self) -> bool:
+        """True while a disk-full backoff window is open: spilling
+        cannot relieve pressure right now, admission must shed."""
+        with self._lock:
+            return time.monotonic() < self._backoff_until
+
+    # ---------------------------------------------------------- spill pass
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait()
+            if self._stop.is_set():
+                return
+            self._wake.clear()
+            forced, self._forced = self._forced, False
+            try:
+                self.spill_pass(force=forced)
+            except Exception:  # noqa: BLE001 — the spiller must survive
+                pass
+
+    def spill_pass(self, force: bool = False) -> int:
+        """One synchronous spill pass down to the LOW watermark (the
+        thread's body; tests call it directly for determinism).
+        Hysteresis: nothing happens until usage crosses the HIGH
+        watermark — except ``force`` (the admission kick), which
+        spills toward LOW from wherever usage stands. Returns the
+        number of objects spilled."""
+        if self.backing_off():
+            return 0
+        if not force and self._usage() <= self.high_bytes():
+            return 0
+        spilled = 0
+        target = self.low_bytes()
+        need = self._usage() - target
+        if need <= 0:
+            return 0
+        for key in self._victims(need):
+            if self._usage() <= target:
+                break
+            if not self._spill_one(key):
+                # Disk full: stop the pass, the backoff window is open.
+                if self.backing_off():
+                    break
+                continue
+            spilled += 1
+        return spilled
+
+    def _spill_one(self, key: bytes) -> bool:
+        from ray_tpu._private import flight_recorder
+
+        payload = self._extract(key)
+        if payload is None:
+            return True  # became ineligible: not a failure
+        path = os.path.join(
+            self.spill_dir, f"{key.hex()}-{os.urandom(4).hex()}.spill")
+        try:
+            write_spill_file(path, payload, fsync=self.fsync)
+        except SpillDiskFullError:
+            with self._lock:
+                self.disk_full += 1
+                self._backoff_until = time.monotonic() + self._backoff_s
+            flight_recorder.record("spill.disk_full", self.role)
+            return False
+        size = len(payload)
+        if not self._commit(key, path, size):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return True
+        with self._lock:
+            self.spills += 1
+            self.spilled_bytes += size
+        flight_recorder.record("spill.spill", key.hex()[:16], size)
+        return True
+
+    # ------------------------------------------------------------- restore
+
+    def restore(self, key: bytes, path: str) -> bytes:
+        """Read + verify one spilled object. Raises TornSpillError
+        (after unlinking the bad file and recording the event) —
+        the caller owns the lineage fallback."""
+        from ray_tpu._private import flight_recorder
+
+        start = time.monotonic()
+        try:
+            payload = read_spill_file(path)
+        except TornSpillError:
+            with self._lock:
+                self.torn_restores += 1
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            flight_recorder.record("spill.torn", key.hex()[:16])
+            raise
+        wall = time.monotonic() - start
+        with self._lock:
+            self.restores += 1
+            self.restored_bytes += len(payload)
+            if len(self._restore_walls) < 512:
+                self._restore_walls.append(wall)
+        flight_recorder.record("spill.restore", key.hex()[:16],
+                               len(payload))
+        return payload
+
+    def delete_file(self, path: str) -> None:
+        """free/owner-death/evict pruning of one spill file."""
+        from ray_tpu._private import flight_recorder
+
+        try:
+            os.unlink(path)
+        except OSError:
+            return
+        with self._lock:
+            self.files_deleted += 1
+        flight_recorder.record("spill.evict", os.path.basename(path))
+
+    # --------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        with self._lock:
+            walls = sorted(self._restore_walls)
+            p50 = walls[len(walls) // 2] * 1000.0 if walls else 0.0
+            return {
+                "spills": self.spills,
+                "restores": self.restores,
+                "spilled_bytes": self.spilled_bytes,
+                "restored_bytes": self.restored_bytes,
+                "torn_restores": self.torn_restores,
+                "disk_full": self.disk_full,
+                "files_deleted": self.files_deleted,
+                "orphan_dirs_swept": self.orphan_dirs_swept,
+                "restore_p50_ms": round(p50, 3),
+                "backing_off": time.monotonic() < self._backoff_until,
+            }
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        with _LIVE_LOCK:
+            _LIVE.discard(self)
+
+
+# Live managers in this process: the per-pid spill directory is shared
+# by every store here (driver value store, export store, in-process
+# executors), so shutdown cleanup only removes it once the LAST
+# manager stopped.
+_LIVE: set = set()
+_LIVE_LOCK = threading.Lock()
+
+
+def live_manager_count() -> int:
+    with _LIVE_LOCK:
+        return len(_LIVE)
+
+
+# Canonical counter keys (executor_stats()["spill"] / driver
+# spill_stats()), exported for the README doc-drift check.
+SPILL_STAT_KEYS = ("spills", "restores", "spilled_bytes",
+                   "restored_bytes", "torn_restores", "disk_full",
+                   "files_deleted", "orphan_dirs_swept")
+
+
+def merged_stats(*managers) -> dict:
+    """Sum the counter keys across managers (None entries skipped);
+    restore_p50_ms takes the max (worst store dominates the row)."""
+    out = {key: 0 for key in SPILL_STAT_KEYS}
+    out["restore_p50_ms"] = 0.0
+    out["backing_off"] = False
+    for mgr in managers:
+        if mgr is None:
+            continue
+        stats = mgr.stats()
+        for key in SPILL_STAT_KEYS:
+            out[key] += stats[key]
+        out["restore_p50_ms"] = max(out["restore_p50_ms"],
+                                    stats["restore_p50_ms"])
+        out["backing_off"] = out["backing_off"] or stats["backing_off"]
+    return out
+
+
+def sweep_orphan_spill_dirs(root: str | None = None) -> int:
+    """Delete per-pid spill directories whose owner died without
+    cleanup — the spill-tier twin of same_host.sweep_orphan_shm (any
+    co-hosted survivor reaps; 0-signal liveness probe; same-uid only).
+    Returns the number of directories removed."""
+    from ray_tpu._private import flight_recorder
+    from ray_tpu._private.same_host import pid_is_dead
+
+    root = root or session_spill_root()
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return 0
+    swept = 0
+    for name in names:
+        if not name.isdigit() or int(name) == os.getpid():
+            continue
+        if not pid_is_dead(int(name)):
+            continue
+        path = os.path.join(root, name)
+        try:
+            if os.stat(path).st_uid != os.getuid():
+                continue
+            shutil.rmtree(path, ignore_errors=True)
+            swept += 1
+        except OSError:
+            continue  # raced another sweeper
+    if swept:
+        flight_recorder.record("spill.orphan_sweep", swept)
+    return swept

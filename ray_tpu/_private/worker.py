@@ -359,6 +359,12 @@ class Runtime:
         # knobs — same discipline as the perf plane above.
         scheduler_mod.init_sched_from_config()
         spec_mod.init_from_config()
+        # Watermark-driven spill tier (spill_manager.py): arm the
+        # module gate; the managers themselves attach to the stores
+        # further down (after the lease tables they filter on exist).
+        from ray_tpu._private import spill_manager as spill_mod
+
+        spill_mod.init_from_config()
         # Driver-side flight recorder: ring only (no flusher thread,
         # no per-driver files) — `ray_tpu debug` reads it live.
         from ray_tpu._private import flight_recorder
@@ -421,6 +427,9 @@ class Runtime:
             collections.OrderedDict()
         self._arg_locality_lock = threading.Lock()
         self._holder_cache: dict = {}
+        # {object hex -> node hex} of holders whose copy is currently
+        # on their disk tier (spill-aware locality discount).
+        self._spilled_holders: dict = {}
         self._sched_feed_at = 0.0
         self.dispatcher.set_locality_hook(self._locality_for_spec)
         # Straggler speculation: driver-side watcher comparing each
@@ -605,6 +614,25 @@ class Runtime:
         self._export_lock = threading.Lock()
         self._lease_sweep_at = 0.0
         self.same_host_copy_hits = 0  # driver-side mapped-copy fetches
+        # Driver-side spill tier: the value store's heap copies move
+        # to checksummed session-dir files past the high watermark
+        # (their shm/arena twins freed with them — unleased victims
+        # only), torn restores fall back to lineage reconstruction.
+        self._export_spill_mgr = None
+        # ObjectID -> monotonic stamp of the last worker-bound shm
+        # promotion: the spiller must not free a segment an in-flight
+        # pool frame is about to attach.
+        self._recent_promotes: dict = {}
+        if spill_mod.SPILL_ON:
+            self.store.enable_managed_spill(
+                leased_fn=self._spill_protected_ids,
+                on_backing_free=self._on_value_spilled,
+                on_torn=self._recover_torn_object)
+            from ray_tpu._private.memory_monitor import (
+                set_store_bytes_provider,
+            )
+
+            set_store_bytes_provider(self._resident_store_bytes)
         # Driver-side failure counters (fault_stats): batch entries
         # requeued invisibly after a daemon death.
         self._fault_lock = threading.Lock()
@@ -673,6 +701,15 @@ class Runtime:
             from ray_tpu._private.rpc import RpcServer
 
             self._export_store = NodeObjectStore()
+            if spill_mod.SPILL_ON:
+                # Exported args ride the same tier: spilling a blob
+                # frees its segment/arena twin (unleased only — the
+                # lease filter covers co-hosted daemons mid-map).
+                self._export_spill_mgr = \
+                    self._export_store.enable_managed_spill(
+                        leased_fn=self._export_leases.pinned_ids,
+                        on_spilled=lambda key, _owner:
+                            self._drop_export_source(key))
             self._export_directory = ChunkDirectory()
             self._obj_server = RpcServer(host="0.0.0.0", port=0)
             self._obj_server.register("ping", lambda: "pong")
@@ -1137,6 +1174,76 @@ class Runtime:
         self.store.put(object_id, real)  # reseal with the local copy
         return real
 
+    # ------------------------------------------------------------ spill tier
+
+    _SHM_PROMOTE_GRACE_S = 30.0
+
+    def _spill_protected_ids(self) -> set:
+        """Id bytes the driver spiller must skip: export leases held
+        by co-hosted daemons plus values promoted to worker-bound shm
+        within the grace window (their frames may not have attached
+        the segment yet)."""
+        out = set(self._export_leases.pinned_ids())
+        now = time.monotonic()
+        with self._promote_lock:
+            for oid in [o for o, at in self._recent_promotes.items()
+                        if now - at > self._SHM_PROMOTE_GRACE_S]:
+                del self._recent_promotes[oid]
+            out.update(oid.binary() for oid in self._recent_promotes)
+        return out
+
+    def _on_value_spilled(self, object_id: ObjectID) -> None:
+        """A driver-store value moved to the disk tier: free its
+        shm/arena twin (the victim filter excluded leased ids, so no
+        co-hosted daemon holds a pin; already-mapped segments stay
+        valid past the unlink) and its export-plane state."""
+        try:
+            self.shm_directory.free(object_id)
+        except Exception:  # noqa: BLE001 — backing free is best-effort
+            pass
+        self._drop_export_source(object_id.binary())
+
+    def _recover_torn_object(self, object_id: ObjectID) -> None:
+        """A managed spill file failed its checksum on restore: the
+        store marked the entry lost — rebuild it from lineage (the
+        getter is blocked on the reseal), or seal ObjectLostError so
+        waiters fail typed instead of hanging."""
+        from ray_tpu.exceptions import ObjectLostError
+
+        from ray_tpu._private import flight_recorder
+
+        flight_recorder.record("spill.torn", object_id.hex()[:16])
+        recovered = False
+        try:
+            recovered = self.recovery.recover(object_id,
+                                              reason="spill_torn")
+        except Exception:  # noqa: BLE001 — fall through to the error
+            pass
+        if not recovered:
+            self.store.put_error(object_id, ObjectLostError(
+                ObjectRef(object_id, _register=False),
+                f"object {object_id.hex()} spill file was torn and no "
+                f"lineage can rebuild it"))
+
+    def _resident_store_bytes(self) -> int:
+        """Resident SPILLABLE bytes for admission's two-axis pressure
+        classifier: the value store's heap usage plus exported blobs
+        (both relieved by the spill tier, unlike true host RSS)."""
+        total = self.store._memory_used  # int read, no lock needed
+        if self._export_store is not None:
+            total += getattr(self._export_store, "_primary_bytes", 0)
+        return total
+
+    def spill_stats(self) -> dict:
+        """Driver-side spill tier counters (value store + export
+        store), zero-valued when the tier is disarmed — the
+        ``ray_tpu_spill_*`` /metrics families and the envelope's spill
+        row read these."""
+        from ray_tpu._private.spill_manager import merged_stats
+
+        return merged_stats(getattr(self.store, "_spill", None),
+                            self._export_spill_mgr)
+
     # -------------------------------------------------------------- cluster
 
     def add_node(self, resources: dict[str, float],
@@ -1332,6 +1439,17 @@ class Runtime:
                     sweep_orphan_shm()
                 except Exception:  # noqa: BLE001 — sweep is best-effort
                     pass
+                # Same for SIGKILLed co-hosted owners' per-pid spill
+                # directories (spill_manager.sweep_orphan_spill_dirs).
+                try:
+                    from ray_tpu._private import (
+                        spill_manager as spill_mod,
+                    )
+
+                    if spill_mod.SPILL_ON:
+                        spill_mod.sweep_orphan_spill_dirs()
+                except Exception:  # noqa: BLE001 — sweep is best-effort
+                    pass
 
     @staticmethod
     def _probe_peer(addr: str) -> bool:
@@ -1395,11 +1513,34 @@ class Runtime:
                     f"={cap}")
         watermark = float(GLOBAL_CONFIG.admission_memory_watermark or 0)
         if watermark > 0:
+            from ray_tpu._private import spill_manager as spill_mod
             from ray_tpu._private.memory_monitor import (
+                memory_pressure_kind,
                 memory_watermark_exceeded,
             )
 
-            if memory_watermark_exceeded(watermark):
+            mgr = getattr(self.store, "_spill", None)
+            if spill_mod.SPILL_ON and mgr is not None:
+                # Two-axis split: STORE pressure is recoverable — kick
+                # the spillers and admit (the job degrades to disk
+                # instead of failing) unless the spill disk is full,
+                # which sheds exactly like true HOST pressure.
+                kind = memory_pressure_kind(watermark)
+                if kind == "store":
+                    if not mgr.backing_off():
+                        mgr.request_spill()
+                        if self._export_spill_mgr is not None:
+                            self._export_spill_mgr.request_spill()
+                        kind = None
+                    else:
+                        return ("store memory over admission_memory_"
+                                f"watermark={watermark} and the spill "
+                                "disk is full (backing off)")
+                if kind == "host":
+                    return (f"host memory over admission_memory_"
+                            f"watermark={watermark}")
+            elif memory_watermark_exceeded(watermark):
+                # Spill tier disarmed: the PR-7 single-axis shed.
                 return (f"host memory over admission_memory_watermark"
                         f"={watermark}")
         return None
@@ -2693,6 +2834,7 @@ class Runtime:
             return None
         out: dict[str, float] = {}
         holder_cache = self._holder_cache
+        spilled = self._spilled_holders
         for ref in refs:
             oid = ref.id()
             size, primary = self._arg_bytes(oid)
@@ -2708,8 +2850,16 @@ class Runtime:
             extra = holder_cache.get(oid.hex())
             if extra:
                 holders.update(extra)
+            # Spill-aware discount: a holder whose copy currently
+            # lives on its disk tier must pay a restore before serving
+            # — it gets no free byte credit over pulling from memory
+            # elsewhere (it still counts, at a fraction, since disk
+            # beats a cross-node transfer).
+            spilled_at = spilled.get(oid.hex())
             for node_hex in holders:
-                out[node_hex] = out.get(node_hex, 0.0) + size
+                credit = size * (0.25 if node_hex == spilled_at
+                                 else 1.0)
+                out[node_hex] = out.get(node_hex, 0.0) + credit
         return out or None
 
     def _learn_arg_locality(self, spec: TaskSpec,
@@ -2773,8 +2923,14 @@ class Runtime:
                 age_s=float(stats.get("age_s", 0.0) or 0.0))
         try:
             locs = self.gcs_client.call("list_object_locations",
-                                        timeout_s=5.0)
-            if isinstance(locs, dict):
+                                        None, True, timeout_s=5.0)
+            if isinstance(locs, tuple) and len(locs) == 2:
+                # Spill-aware view: holders whose only copy is on
+                # their disk tier should not win byte-weighted
+                # locality (a restore costs disk IO the byte credit
+                # assumed was free).
+                self._holder_cache, self._spilled_holders = locs
+            elif isinstance(locs, dict):  # pre-spill-aware head
                 self._holder_cache = locs
         except Exception:  # noqa: BLE001 — best-effort holder view
             pass
@@ -2895,6 +3051,7 @@ class Runtime:
         from ray_tpu._private import serialization
 
         with self._promote_lock:
+            self._recent_promotes[ref.id()] = time.monotonic()
             desc = self.shm_directory.lookup(ref.id())
             if desc is not None:
                 return desc
@@ -3745,6 +3902,23 @@ class Runtime:
             self.metrics_agent.shutdown()
         self.health_monitor.shutdown()
         self.dispatcher.shutdown()
+        # Spill tier: retire the spiller threads and drop this
+        # session's spill files (the per-pid dir would otherwise wait
+        # for a survivor's orphan sweep after the process exits).
+        for mgr in (getattr(self.store, "_spill", None),
+                    self._export_spill_mgr):
+            if mgr is not None:
+                mgr.stop()
+        from ray_tpu._private import spill_manager as _spill_mod
+
+        if _spill_mod.live_manager_count() == 0:
+            # Last manager in this process: the per-pid dir holds no
+            # live store's files anymore (in-process executors would
+            # still be registered).
+            import shutil as _shutil
+
+            _shutil.rmtree(_spill_mod.process_spill_dir(),
+                           ignore_errors=True)
         if self.memory_monitor is not None:
             self.memory_monitor.stop()
         if self.worker_pool is not None:

@@ -1126,3 +1126,220 @@ def test_chaos_soak_survives_kill_epochs(tmp_path):
         time.sleep(1.0)
         leaked = _shm_names() - shm_before
     assert not leaked, f"leaked /dev/shm segments: {sorted(leaked)[:10]}"
+
+
+# ------------------------------------------------- spill tier under chaos
+
+
+def test_spill_torn_write_rebuilds_from_lineage_exactly_once(tmp_path):
+    """spill.torn_write corrupts the FIRST spill file a daemon writes
+    (half the payload lands under a full-length header — the
+    crash-mid-write shape). The driver's get detects the tear through
+    the chunked fetch (the daemon's restore fails its CRC and drops
+    the object), marks the object lost and re-executes its lineage:
+    every value comes back correct, the torn producer ran exactly
+    twice (original + rebuild, marker-file proof), all others exactly
+    once."""
+    import random as _random
+
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir=str(tmp_path / "cluster"))
+    cluster.add_node(
+        num_cpus=4, resources={"spl": 10.0}, pool_size=2,
+        heartbeat_period_s=0.5,
+        env={"RAY_TPU_NODE_STORE_PRIMARY_LIMIT_MB": "1",
+             "RAY_TPU_SPILL_MIN_OBJECT_KB": "16",
+             "RAY_TPU_CHAOS": "seed=7,spill.torn_write=1.0x1"})
+    runtime = None
+    marker_dir = tmp_path / "markers"
+    marker_dir.mkdir()
+    n = 6
+    try:
+        assert cluster.wait_for_nodes(1, timeout=30)
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        deadline = time.monotonic() + 30
+        while ray_tpu.cluster_resources().get("spl", 0) <= 0:
+            assert time.monotonic() < deadline
+            time.sleep(0.2)
+
+        @ray_tpu.remote(resources={"spl": 1.0})
+        def produce(i, mdir):
+            import os as _os
+
+            with open(f"{mdir}/produced-{i}-{_os.getpid()}-"
+                      f"{_os.urandom(4).hex()}", "w"):
+                pass
+            # Deterministic per i: the lineage rebuild must recompute
+            # the SAME value (the reference's recovery caveat).
+            import random as _r
+
+            return b"%d:" % i + _r.Random(i).randbytes(600 * 1024)
+
+        refs = [produce.remote(i, str(marker_dir)) for i in range(n)]
+        blobs = ray_tpu.get(refs, timeout=180)
+
+        # Zero lost, zero corrupted: every blob is exactly its
+        # deterministic recomputation.
+        for i, blob in enumerate(blobs):
+            expect = b"%d:" % i + _random.Random(i).randbytes(600 * 1024)
+            assert blob == expect, f"object {i} corrupt or lost"
+
+        # Exactly-once rebuild: one producer ran twice (its spill file
+        # was the torn one), the rest once — nothing re-ran that did
+        # not have to, nothing ran a third time.
+        runs = [len([f for f in os.listdir(marker_dir)
+                     if f.startswith(f"produced-{i}-")])
+                for i in range(n)]
+        assert sorted(runs) == [1] * (n - 1) + [2], runs
+        assert runtime.recovery.num_recoveries >= 1
+    finally:
+        if runtime is not None:
+            ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_spill_disk_full_sheds_typed_daemon_survives(tmp_path):
+    """spill.disk_full fails every spill write: the spiller backs off
+    (blobs stay readable in memory — nothing is lost), the daemon
+    keeps serving RPCs, and admission classifies the un-relievable
+    store pressure as the typed-shed path instead of crashing or
+    looping the spiller against a full disk."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu._private.memory_monitor import (
+        _set_store_fraction_override,
+        _set_usage_override,
+    )
+    from ray_tpu._private.node_executor import NodeExecutorService
+
+    GLOBAL_CONFIG.update({"spill_min_object_kb": 1,
+                          "node_store_primary_limit_mb": 1,
+                          "admission_memory_watermark": 0.8,
+                          "spill_disk_full_backoff_s": 30.0})
+    from ray_tpu._private import spill_manager as spill_mod
+
+    spill_mod.init_from_config()
+    chaos.configure("seed=3,spill.disk_full=1.0")
+    svc = NodeExecutorService(host="127.0.0.1", pool_size=1,
+                              resources={"CPU": 1})
+    svc.advertised_address = f"127.0.0.1:{svc.port}"
+    svc.start()
+    try:
+        blobs = {}
+        for _ in range(5):
+            key = os.urandom(16)
+            blobs[key] = os.urandom(300 * 1024)
+            svc.store.put(key, blobs[key], owner="test-owner")
+        # The async spiller hit the full disk and entered backoff.
+        deadline = time.monotonic() + 10
+        while not svc._spill_mgr.backing_off():
+            svc._spill_mgr.spill_pass()
+            assert time.monotonic() < deadline, "backoff never engaged"
+        stats = svc._spill_mgr.stats()
+        assert stats["disk_full"] >= 1 and stats["spills"] == 0
+
+        # No daemon crash, no data loss: every blob still serves.
+        from ray_tpu._private.rpc import RpcClient
+
+        client = RpcClient(svc.advertised_address, timeout_s=5.0)
+        try:
+            assert client.call("ping") == "pong"
+        finally:
+            client.close()
+        for key, blob in blobs.items():
+            assert svc.store.get(key) == blob
+
+        # Store pressure that spilling cannot relieve -> the typed
+        # shed (the driver turns this reply into
+        # SystemOverloadedError, PR-7 machinery).
+        _set_usage_override(0.9)
+        _set_store_fraction_override(0.5)
+        try:
+            reason = svc._overload_reason()
+            assert reason is not None and "disk is full" in reason
+        finally:
+            _set_usage_override(None)
+            _set_store_fraction_override(None)
+    finally:
+        svc.stop()
+
+
+def test_owner_sigkill_mid_spill_survivor_sweeps_dir(tmp_path):
+    """SIGKILL a process mid-spill (files on disk, owner gone): any
+    co-hosted survivor's sweep pass removes the orphaned per-pid spill
+    directory — zero leaked files — while a LIVE owner's directory is
+    never touched."""
+    import subprocess
+    import sys
+    import textwrap
+
+    from ray_tpu._private import spill_manager as spill_mod
+    from ray_tpu._private.node_executor import NodeExecutorService
+
+    session = tmp_path / "session"
+    env = dict(os.environ)
+    env["RAY_TPU_SESSION_DIR"] = str(session)
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(ray_tpu.__file__)))
+    env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+    # The victim spills forever; the parent SIGKILLs it mid-stream.
+    script = textwrap.dedent("""
+        import os, time
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        GLOBAL_CONFIG.update({"spill_min_object_kb": 1})
+        from ray_tpu._private.node_executor import NodeObjectStore
+        store = NodeObjectStore(primary_limit_bytes=128 * 1024,
+                                spill_dir="/tmp/unused-legacy")
+        store.enable_managed_spill()
+        print("READY", flush=True)
+        while True:
+            store.put(os.urandom(16), os.urandom(64 * 1024), owner="o")
+            time.sleep(0.005)
+    """)
+    victim = subprocess.Popen([sys.executable, "-c", script], env=env,
+                              stdout=subprocess.PIPE)
+    try:
+        assert victim.stdout.readline().strip() == b"READY"
+        victim_dir = os.path.join(str(session), "spill",
+                                  str(victim.pid))
+        deadline = time.monotonic() + 30
+        while not (os.path.isdir(victim_dir) and os.listdir(victim_dir)):
+            assert time.monotonic() < deadline, "victim never spilled"
+            time.sleep(0.05)
+        os.kill(victim.pid, signal.SIGKILL)
+        victim.wait()
+
+        # A co-hosted survivor (its session dir env points at the same
+        # root) sweeps the orphan on its periodic transfer-plane pass.
+        prior = os.environ.get("RAY_TPU_SESSION_DIR")
+        os.environ["RAY_TPU_SESSION_DIR"] = str(session)
+        try:
+            survivor = NodeExecutorService(host="127.0.0.1",
+                                           pool_size=1,
+                                           resources={"CPU": 1})
+            try:
+                # The survivor's own live dir must not be touched.
+                own_dir = spill_mod.process_spill_dir()
+                os.makedirs(own_dir, exist_ok=True)
+                with open(os.path.join(own_dir, "live.spill"),
+                          "wb") as f:
+                    f.write(b"live")
+                survivor._sweep_transfer_plane()
+                assert not os.path.exists(victim_dir), \
+                    "orphaned spill dir leaked"
+                assert os.path.exists(
+                    os.path.join(own_dir, "live.spill"))
+                assert survivor._spill_mgr.stats()[
+                    "orphan_dirs_swept"] >= 1
+            finally:
+                survivor.stop()
+        finally:
+            if prior is None:
+                os.environ.pop("RAY_TPU_SESSION_DIR", None)
+            else:
+                os.environ["RAY_TPU_SESSION_DIR"] = prior
+    finally:
+        if victim.poll() is None:
+            victim.kill()
+        victim.stdout.close()

@@ -40,6 +40,11 @@ EXPORTED_SERIES = (
     "ray_tpu_node_pipeline",
     "ray_tpu_node_data_plane",
     "ray_tpu_node_faults",
+    # Spill tier (ISSUE 10): driver counters as one labeled family
+    # (+ the restore-latency gauge) and the per-node heartbeat series.
+    "ray_tpu_spill_total",
+    "ray_tpu_spill_restore_p50_ms",
+    "ray_tpu_node_spill",
     # Always-on performance plane (ISSUE 8): stage-latency histogram
     # triplets per (stage, node), per-function attribution, and the
     # serve router's per-deployment latency histograms (emitted from
@@ -113,7 +118,7 @@ def test_exported_series_list_matches_agent_source():
     source = inspect.getsource(metrics_agent)
     import re
 
-    emitted = set(re.findall(r"(ray_tpu_[a-z_]+)", source))
+    emitted = set(re.findall(r"(ray_tpu_[a-z0-9_]+)", source))
     # Drop derived suffix forms (e.g. histogram _bucket) — none today.
     missing = sorted(emitted - set(EXPORTED_SERIES))
     assert not missing, (
@@ -312,3 +317,67 @@ def test_readme_stage_list_matches_tracing_stages():
     chain = " → ".join(tracing.STAGES)
     assert chain in text.replace("\n", " ").replace("  ", " "), (
         f"README stage chain drifted from tracing.STAGES: {chain}")
+
+
+# ---------------------------------------------------------- spill tier
+
+
+@pytest.fixture(scope="module")
+def spilling_text() -> str:
+    text = README.read_text()
+    start = text.find("## Object spilling & tiering")
+    assert start != -1, "README lost its spilling section"
+    end = text.find("\n## ", start + 1)
+    return text[start:end if end != -1 else len(text)]
+
+
+def test_spill_knobs_documented(spilling_text):
+    from ray_tpu._private.config import _DEFAULTS
+
+    knobs = [k for k in _DEFAULTS if k.startswith("spill_")]
+    assert len(knobs) >= 6, "spill knobs vanished from config"
+    missing = [k for k in knobs if f"`{k}`" not in spilling_text]
+    assert not missing, (
+        f"spill knobs missing from the README knob table: {missing}")
+
+
+def test_spill_counter_keys_documented(spilling_text):
+    """Every executor_stats()["spill"] / runtime.spill_stats() key
+    (SPILL_STAT_KEYS is the canonical source) plus the derived fields
+    must keep README rows."""
+    from ray_tpu._private.spill_manager import SPILL_STAT_KEYS
+
+    keys = list(SPILL_STAT_KEYS) + ["restore_p50_ms",
+                                    "spilled_plan_hits"]
+    missing = [k for k in keys if f"`{k}`" not in spilling_text]
+    assert not missing, (
+        f"spill counter keys missing from the README spilling "
+        f"section: {missing}")
+
+
+def test_spill_chaos_sites_documented(spilling_text):
+    """The three spill chaos sites are part of the chaos-spec contract
+    (chaos.py docstring) and the README spilling section."""
+    import ray_tpu._private.chaos as chaos_mod
+
+    for site in ("spill.torn_write", "spill.disk_full",
+                 "spill.restore_delay"):
+        assert site in (chaos_mod.__doc__ or ""), (
+            f"chaos site {site} missing from chaos.py docstring")
+        assert f"`{site}`" in spilling_text, (
+            f"chaos site {site} missing from the README spilling "
+            f"section")
+
+
+def test_spill_stats_shape_matches_docs():
+    """merged_stats() (the spill_stats()/executor_stats shape) must
+    emit exactly the documented keys — a new counter forces a README
+    row via test_spill_counter_keys_documented."""
+    from ray_tpu._private.spill_manager import (
+        SPILL_STAT_KEYS,
+        merged_stats,
+    )
+
+    stats = merged_stats(None)
+    assert set(stats) == set(SPILL_STAT_KEYS) | {"restore_p50_ms",
+                                                 "backing_off"}

@@ -79,9 +79,15 @@ def test_a2c_microbatching_counts_all_rows():
 
 
 def test_ars_improves_cartpole(ray_start_regular):
+    # Seed 5 is pinned deliberately: ARS training is deterministic per
+    # seed on this stack (verified 3 identical reps), and this seed
+    # starts from a genuinely bad initial policy (9.5) and learns to
+    # the 200-step cap. The old seed 3 drew a lucky init whose FIRST
+    # eval already saturated the cap, making "last > first"
+    # unsatisfiable — the long-standing tier-1 flake.
     config = (ARSConfig()
               .environment("CartPole-v1")
-              .debugging(seed=3))
+              .debugging(seed=5))
     cfg = config
     cfg.population_size = 16
     cfg.num_top_directions = 4
@@ -104,7 +110,12 @@ def test_ars_top_direction_selection_biases_update():
     config.population_size = 8
     config.num_top_directions = 1
     config.report_eval_episodes = 1
-    config.max_episode_steps = 20
+    # The cap must sit ABOVE the natural length of random-policy
+    # episodes (~10-30 steps): a cap of 20 truncated every rollout to
+    # an identical return, so R+ == R- for the top direction and the
+    # ARS update was exactly zero — the test failed deterministically,
+    # not flakily, whenever initial episodes outlived the cap.
+    config.max_episode_steps = 200
     algo = config.build()
     theta_before = algo._theta.copy()
     algo.train()
