@@ -169,8 +169,40 @@ _DEFAULTS: dict[str, Any] = {
     "rpc_batch_max_entries": 128,      # max calls per batched frame
     # Pipelined task execution (batched dispatch -> execute_task_batch
     # -> multi-task worker leases -> grouped completion replies).
-    "dispatch_batch_max": 32,          # tasks per execute_task_batch RPC
+    # Tasks per execute_task_batch RPC. Raised 32 -> 128 with fused
+    # execution: the dispatcher's batch-fill over-subscription now
+    # actually reaches this depth (claims were capped at per-node free
+    # slots before), and on a many-node single-core box every batch
+    # costs a daemon wake — deeper batches amortize it. The fill
+    # budget adapts to backlog//nodes, so small bursts still spread.
+    "dispatch_batch_max": 128,
     "worker_pipeline_depth": 4,        # frames in flight per worker lease
+    # Fused in-daemon execution: runs of tiny DEFAULT tasks inside an
+    # execute_task_batch RPC run directly on the daemon's dispatch
+    # thread — no worker-pipe hop, no per-task pickle round trip —
+    # sealed back as grouped completions. Ref-bearing / TPU /
+    # runtime_env / dedicated-worker entries always take the classic
+    # or pipelined worker path. Disarmed (fused_execution=0), every
+    # site costs one module-attribute branch (node_executor.FUSED_ON)
+    # and the batch path is byte-identical to the worker pipeline.
+    "fused_execution": True,
+    # Per-RPC fused-run budget: at most this many tasks fuse per batch
+    # RPC, and once the run's wall clock exceeds the budget the
+    # remaining fused-eligible entries fall back to the pipelined
+    # worker path (fused_fallbacks counter) — one long task cannot
+    # wedge the daemon's reply stream for the whole batch.
+    "fused_max_run_tasks": 256,
+    "fused_run_wall_budget_s": 0.25,
+    # Raw-bytes framing for small immutable args/results (ints,
+    # floats, bools, str/bytes, flat tuples/dicts of them): a compact
+    # tag-length encoding written into a thread-local scratch arena
+    # replaces the pickle round trip on BOTH ends of the worker pipe
+    # and the fused path. Disarmed (raw_framing=0), every encode site
+    # costs one module-attribute branch (serialization.RAW_ON) and
+    # frames are byte-identical pickles; decoding raw frames stays
+    # supported either way (the sentinel header length cannot collide
+    # with a pickled frame).
+    "raw_framing": True,
     # Pipelined task SUBMISSION (driver-side submit ring): .remote()
     # allocates ids/refs inline and pushes a record onto a bounded
     # ring; a dedicated submitter thread drains flushes through ONE

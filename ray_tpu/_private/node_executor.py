@@ -104,12 +104,38 @@ class TaskDeadlineExpired(Exception):
     end-to-end deadline already dead and refused to execute it."""
 
 
+# Fused in-daemon execution (the fused_execution knob): runs of tiny
+# DEFAULT tasks inside an execute_task_batch RPC execute directly on
+# the daemon's dispatch thread — no worker-pipe hop — bounded by the
+# fused_max_run_tasks / fused_run_wall_budget_s per-run budget.
+# Disarmed cost is this one module-attribute branch per site (the
+# chaos.ACTIVE / perf.PERF_ON discipline); daemons inherit
+# RAY_TPU_FUSED_EXECUTION through the child env at import.
+FUSED_ON: bool = True
+
+
+def init_fused_from_config() -> None:
+    """Arm/disarm fused in-daemon execution from config (Runtime init
+    and daemon boot both reach this through import)."""
+    global FUSED_ON
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    FUSED_ON = bool(GLOBAL_CONFIG.fused_execution)
+
+
+try:
+    init_fused_from_config()
+except Exception:  # noqa: BLE001 — config unavailable mid-bootstrap
+    pass
+
 # Canonical executor_stats() counter keys, exported so the README
 # doc-drift check (tests/test_doc_drift.py) can assert every counter is
 # documented without standing up a daemon.
 PIPELINE_STAT_KEYS = ("batch_rpcs", "batch_tasks", "reply_groups",
                       "worker_lease_runs", "worker_lease_tasks",
-                      "worker_pipelined_frames")
+                      "worker_pipelined_frames",
+                      "fused_runs", "fused_tasks", "fused_fallbacks",
+                      "runner_spawns", "runner_reuses")
 DATA_PLANE_STAT_KEYS = ("same_host_map_hits", "same_host_copy_hits",
                         "chunked_pulls", "map_sources",
                         "attached_mappings", "leases")
@@ -1128,6 +1154,20 @@ class NodeExecutorService:
         self.batch_rpcs = 0          # execute_task_batch calls served
         self.batch_tasks_received = 0
         self.reply_groups = 0        # grouped completion parts emitted
+        # Fused in-daemon execution counters (FUSED_ON): runs executed
+        # on the dispatch thread, tasks fused, and fused-eligible
+        # entries that fell back to the worker pipeline because the
+        # per-run wall budget expired.
+        self.fused_runs = 0
+        self.fused_tasks = 0
+        self.fused_fallbacks = 0
+        # Persistent batch runners: long-lived threads fed by a queue
+        # replace the old thread-per-batch spawn — steady-state
+        # execution allocates zero threads (reuses >> spawns).
+        from ray_tpu._private.rpc import _ThreadRecycler
+
+        self._batch_runners = _ThreadRecycler("exec-batch-runner",
+                                              idle_s=30.0)
         # Driver import paths adopted via adopt_sys_path; forwarded to
         # pool workers with each task so by-reference pickles resolve.
         self._driver_sys_path: list[str] = []
@@ -1876,7 +1916,19 @@ class NodeExecutorService:
         Streamed parts: ("results", [(idx, reply), ...]) with the
         execute_task reply shape per task, plus ("parked", idx) /
         ("resumed", idx) control parts when frames queue behind a
-        blocked lease head. Final reply: ("done", n)."""
+        blocked lease head or an over-subscribed entry waits in daemon
+        admission, and ("started", idx) before an entry can first
+        side-effect. Final reply: ("done", n, fused_stats).
+
+        While FUSED_ON, a run of eligible entries (no refs, no TPU, no
+        runtime_env) executes directly on this dispatch thread — no
+        worker-pipe hop — under the fused_max_run_tasks /
+        fused_run_wall_budget_s budget; the remainder falls back to the
+        pipelined worker path. Entries the driver over-subscribed
+        beyond this node's free slots (flags bit 2) PARK in daemon
+        admission when the reservation fails — completions free
+        capacity and re-admit them — instead of bouncing ("busy",)
+        spillbacks per slot."""
         from ray_tpu._private.config import GLOBAL_CONFIG
         from ray_tpu._private.rpc import DISPATCH_POOL
         from ray_tpu._private.worker_pool import _BatchTask
@@ -1905,7 +1957,12 @@ class NodeExecutorService:
         with self._func_lock:
             sys_path = list(self._driver_sys_path) or None
         pipeline: list[_BatchTask] = []
+        fused: list[_BatchTask] = []
+        # Over-subscribed entries whose reservation failed, waiting for
+        # capacity: [(task, demand)] — drained by the reply loop.
+        parked: list = []
         reserve_wants: list = []
+        demand_by_idx: dict[int, dict] = {}
         token_idx: dict[str, int] = {}
         # One shed decision per batch RPC (one chaos draw; depth and
         # watermark barely move within a batch): under overload the
@@ -1913,6 +1970,8 @@ class NodeExecutorService:
         # fast and spillback-requeues the rest.
         shed_why = self._overload_reason()
         now = time.time()
+        fused_cap = (max(1, int(GLOBAL_CONFIG.fused_max_run_tasks))
+                     if FUSED_ON else 0)
         for idx, entry in enumerate(entries):
             (digest, func_blob, args_blob, n_returns, return_keys,
              runtime_env, resources, token, flags) = entry[:9]
@@ -1974,72 +2033,123 @@ class NodeExecutorService:
                 complete(idx, ("need_func", None))
                 continue
             token_idx[token] = idx
-            reserve_wants.append((token, demand))
-            pipeline.append(_BatchTask(
+            demand_by_idx[idx] = demand
+            task = _BatchTask(
                 idx=idx, digest=digest, func_blob=blob,
                 args_blob=args_blob, n_returns=max(1, n_returns),
                 runtime_env=runtime_env, token=token,
                 client_addr=client_addr, sys_path=sys_path,
-                trace=trace_ctx, deadline=deadline))
+                trace=trace_ctx, deadline=deadline,
+                overcommit=bool(flags & 2), return_keys=return_keys)
+            if len(fused) < fused_cap and not runtime_env:
+                # Fused-eligible: executes on this dispatch thread, no
+                # per-entry reservation (the run is one serial thread).
+                fused.append(task)
+                continue
+            reserve_wants.append((task, demand))
         admit_ts: dict[int, float] = {}
-        if pipeline:
-            accepted = self._try_reserve_many(reserve_wants)
+        return_keys_by_idx = {t.idx: entries[t.idx][4] for t in fused}
+        for task, _ in reserve_wants:
+            return_keys_by_idx[task.idx] = entries[task.idx][4]
+
+        def notify(kind: str, token: str) -> None:
+            with cond:
+                control.append((kind, token_idx.get(token)))
+                cond.notify()
+
+        def on_result(task, status, payload, wtrace=None):
+            with self._running_lock:
+                self._running.pop(task.token, None)
+                self._blocked_cpu.pop(task.token, None)
+            if wtrace and perf.PERF_ON:
+                # Always-on plane: the worker's pickup stamp and
+                # resource sample ride the reply whether or not
+                # tracing armed this task.
+                self._record_task_perf(wtrace,
+                                       admit_ts.get(task.idx, 0.0))
+            try:
+                reply = self._pipe_reply_to_task_reply(
+                    return_keys_by_idx[task.idx], status, payload,
+                    client_addr)
+            except BaseException as exc:  # noqa: BLE001
+                reply = ("err", _exc_blob(exc))
+            if task.trace is not None and reply[0] == "ok":
+                reply = (reply[0], reply[1], self._batch_trace(
+                    task, admit_ts.get(task.idx), wtrace))
+            complete(task.idx, reply)
+
+        notified_tokens: list = []
+
+        def launch(run_tasks: "list[_BatchTask]") -> None:
+            tokens = [t.token for t in run_tasks]
+            self._pipeline_inflight.register_notify(tokens, notify)
+            notified_tokens.extend(tokens)
+            depth = max(1, int(GLOBAL_CONFIG.worker_pipeline_depth))
+            # Persistent runner threads (LIFO-recycled, fed by a
+            # queue): steady-state batch execution spawns no threads.
+            self._batch_runners.submit(
+                self.pool.run_task_batch, run_tasks, on_result, depth,
+                self._pipeline_inflight)
+
+        def reserve_or_park(wants: list, emit_parked) -> list:
+            """Batched admission for [(task, demand)]: admitted tasks
+            are returned; over-subscribed entries park (the reply loop
+            re-admits them as capacity frees); plain rejects spill back
+            ("busy",) to the driver exactly as before."""
+            accepted = self._try_reserve_many(
+                [(t.token, d) for t, d in wants])
             t_admit = time.time()
             admitted = []
-            for task, ok in zip(pipeline, accepted):
+            for (task, demand), ok in zip(wants, accepted):
                 if ok:
                     admitted.append(task)
                     if task.trace is not None or perf.PERF_ON:
                         admit_ts[task.idx] = t_admit
+                elif task.overcommit:
+                    parked.append((task, demand))
+                    emit_parked(task.idx)
                 else:
                     complete(task.idx, ("busy",))
-            pipeline = admitted
+            return admitted
+
+        if reserve_wants:
+            pipeline = reserve_or_park(
+                reserve_wants,
+                lambda idx: _emit_part(("parked", idx)))
         if pipeline:
-            return_keys_by_idx = {
-                idx: entries[idx][4] for idx in
-                (t.idx for t in pipeline)}
+            launch(pipeline)
 
-            def notify(kind: str, token: str) -> None:
-                with cond:
-                    control.append((kind, token_idx.get(token)))
-                    cond.notify()
+        fused_stats = {"fused": 0, "fused_fallbacks": 0}
 
-            self._pipeline_inflight.register_notify(
-                [t.token for t in pipeline], notify)
+        def spill_fused(rest: "list[_BatchTask]") -> None:
+            # Per-run budget expired mid-fused-run: the remaining
+            # fused-eligible entries take the pipelined worker path
+            # (admission applies to them like any worker-path entry).
+            self.fused_fallbacks += len(rest)
+            fused_stats["fused_fallbacks"] += len(rest)
+            go = reserve_or_park(
+                [(t, demand_by_idx[t.idx]) for t in rest],
+                lambda idx: _emit_part(("parked", idx)))
+            if go:
+                launch(go)
 
-            def on_result(task, status, payload, wtrace=None):
-                with self._running_lock:
-                    self._running.pop(task.token, None)
-                    self._blocked_cpu.pop(task.token, None)
-                if wtrace and perf.PERF_ON:
-                    # Always-on plane: the worker's pickup stamp and
-                    # resource sample ride the reply whether or not
-                    # tracing armed this task.
-                    self._record_task_perf(wtrace,
-                                           admit_ts.get(task.idx, 0.0))
-                try:
-                    reply = self._pipe_reply_to_task_reply(
-                        return_keys_by_idx[task.idx], status, payload,
-                        client_addr)
-                except BaseException as exc:  # noqa: BLE001
-                    reply = ("err", _exc_blob(exc))
-                if task.trace is not None and reply[0] == "ok":
-                    reply = (reply[0], reply[1], self._batch_trace(
-                        task, admit_ts.get(task.idx), wtrace))
-                complete(task.idx, reply)
-
-            depth = max(1, int(GLOBAL_CONFIG.worker_pipeline_depth))
-            threading.Thread(
-                target=self.pool.run_task_batch,
-                args=(pipeline, on_result, depth,
-                      self._pipeline_inflight),
-                daemon=True, name="exec-batch-pool").start()
         try:
             done_n = 0
+            if fused:
+                done_n += self._run_fused(fused, client_addr,
+                                          _emit_part, spill_fused,
+                                          fused_stats)
             while done_n < n:
                 with cond:
                     while not completions and not control:
-                        cond.wait()
+                        if parked:
+                            # Capacity freed by OTHER RPCs' completions
+                            # never signals this cond: poll admission
+                            # for the parked entries on a short beat.
+                            if not cond.wait(timeout=0.05):
+                                break
+                        else:
+                            cond.wait()
                     group, completions = completions, []
                     ctrl, control = control, []
                 for kind, idx in ctrl:
@@ -2050,11 +2160,213 @@ class NodeExecutorService:
                     self.reply_groups += 1
                     done_n += len(group)
                     self._notify_load()
+                if parked:
+                    self._admit_parked(parked, launch, _emit_part,
+                                       complete, admit_ts)
         finally:
-            if pipeline:
-                self._pipeline_inflight.forget_notify(
-                    [t.token for t in pipeline])
-        return ("done", n)
+            if notified_tokens:
+                self._pipeline_inflight.forget_notify(notified_tokens)
+        return ("done", n, fused_stats)
+
+    # Maybe-started ambiguity window: fused entries are announced to
+    # the driver in ("started_many", [idx…]) windows of this many
+    # BEFORE any of them can side-effect — one stream part per window
+    # instead of one per task. On daemon death, announced-but-
+    # never-started entries retry under the system-failure budget
+    # (instead of the invisible requeue an unannounced entry gets), so
+    # the window bounds how many spurious budget consumptions a death
+    # can cost. Results flush in groups of _FUSED_GROUP.
+    _FUSED_STARTED_WINDOW = 8
+    _FUSED_GROUP = 64
+
+    def _run_fused(self, tasks: list, client_addr: "str | None",
+                   emit, spill, fused_stats: dict) -> int:
+        """Execute a run of fused entries serially on the calling
+        (dispatch) thread, streaming ("started_many", [idx…]) windows
+        before their entries can side-effect and grouped
+        ("results", ...) parts as they finish. Returns how many entries
+        were COMPLETED here; entries past the wall budget are handed to
+        ``spill`` (worker path) and complete through the reply loop
+        instead.
+
+        Exactly-once accounting leans on stream ordering: a window's
+        socket write completes before any of its user functions run,
+        and a SIGKILLed daemon's kernel still flushes written stream
+        data — so the driver can never invisibly requeue an entry that
+        may have executed."""
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        budget_s = float(GLOBAL_CONFIG.fused_run_wall_budget_s)
+        t0 = time.monotonic()
+        self.fused_runs += 1
+        group: list = []
+        done = 0
+        announced = 0
+        window = self._FUSED_STARTED_WINDOW
+        # One resource sample brackets the whole run; per-task wall
+        # comes from cheap clock reads and the run's cpu/rss attribute
+        # proportionally at the end (per-task getrusage syscalls were
+        # a measurable slice of the fused budget).
+        perf_on = perf.PERF_ON
+        run_sample = perf.sample_start() if perf_on else None
+        for pos, task in enumerate(tasks):
+            if budget_s > 0 and time.monotonic() - t0 > budget_s:
+                if group:
+                    emit(("results", group))
+                    self.reply_groups += 1
+                    done += len(group)
+                    group = []
+                spill(tasks[pos:])
+                break
+            if task.deadline is not None and time.time() > task.deadline:
+                self.task_timeouts += 1
+                group.append((task.idx, ("timeout", "admitted")))
+            elif self._cancelled_tokens and \
+                    self._token_cancelled(task.token):
+                # Speculation first-seal-wins: the sibling copy sealed
+                # and this token was loser-cancelled before we ran.
+                group.append((task.idx, ("cancelled",)))
+            else:
+                if pos >= announced:
+                    emit(("started_many",
+                          [t.idx for t in
+                           tasks[announced:announced + window]]))
+                    announced += window
+                group.append((task.idx,
+                              self._exec_fused(task, client_addr)))
+                self.fused_tasks += 1
+                fused_stats["fused"] += 1
+            if len(group) >= self._FUSED_GROUP:
+                emit(("results", group))
+                self.reply_groups += 1
+                done += len(group)
+                group = []
+        else:
+            if group:
+                emit(("results", group))
+                self.reply_groups += 1
+                done += len(group)
+        ran = fused_stats["fused"]
+        if run_sample is not None and ran:
+            # Run-level attribution: exact cpu/wall sums with the
+            # task count folded in (per-task getrusage syscalls were a
+            # measurable slice of the fused per-task budget). The run
+            # is same-signature in the hot path; a mixed run
+            # attributes to its first function.
+            func = self._func_cache.get(tasks[0].digest)
+            name = getattr(func, "__qualname__", tasks[0].digest[:8])
+            _, wall, cpu, rss = perf.sample_end(name, run_sample)
+            perf.record_task_resources(name, wall, cpu, rss, count=ran)
+        self._notify_load()
+        return done
+
+    def _exec_fused(self, task, client_addr: "str | None") -> tuple:
+        """Run ONE fused entry in-process; returns the execute_task
+        reply shape (("ok", descriptors[, trace]) / ("err", blob)).
+        No admission reservation, no worker pipe, no per-task pickle of
+        the surrounding protocol — the per-task cost is the user
+        function plus one args decode and one result encode (both with
+        the raw small-immutable fast path)."""
+        from ray_tpu._private import worker_client
+
+        try:
+            func = self._func_cache.get(task.digest)
+            if func is None:
+                with self._func_lock:
+                    func = self._func_cache.get(task.digest)
+                if func is None:
+                    func = serialization.loads_function(task.func_blob)
+                    with self._func_lock:
+                        self._func_cache[task.digest] = func
+            args, kwargs = serialization.deserialize_from_buffer(
+                memoryview(task.args_blob))
+            if client_addr and client_addr != \
+                    getattr(self, "_fused_client_addr", None):
+                # One env/proxy rebind per owner change, not per task.
+                worker_client.set_driver_addr(client_addr)
+                self._fused_client_addr = client_addr
+            worker_client.set_task_token(task.token)
+            perf_on = perf.PERF_ON
+            # Cheap per-task exec-stage wall (vDSO clock reads); the
+            # cpu/rss attribution samples once per RUN in _run_fused.
+            t_exec = time.time() if (perf_on or task.trace is not None) \
+                else 0.0
+            try:
+                result = func(*args, **kwargs)
+            finally:
+                worker_client.set_task_token(None)
+            t_end = time.time() if t_exec else 0.0
+            if perf_on and t_exec:
+                perf.record_stage("exec", max(0.0, t_end - t_exec))
+            n_returns = task.n_returns
+            if n_returns == 1:
+                values = [result]
+            elif n_returns == 0:
+                values = []
+            else:
+                if (not isinstance(result, (tuple, list))
+                        or len(result) != n_returns):
+                    raise ValueError(
+                        f"task declared num_returns={n_returns} but "
+                        f"returned {type(result).__name__}")
+                values = list(result)
+        except BaseException as exc:  # noqa: BLE001 — shipped to driver
+            return ("err", _exc_blob(exc))
+        out = []
+        inline_max = _inline_reply_bytes()
+        for id_bytes, value in zip(task.return_keys or (), values):
+            try:
+                blob = serialization.try_serialize_raw(value)
+                if blob is None:
+                    blob = serialization.serialize_framed(value)
+            except BaseException as exc:  # noqa: BLE001
+                out.append(("err", _exc_blob(exc)))
+                continue
+            if len(blob) <= inline_max:
+                out.append(("inline", blob))
+            else:
+                self.store.put(id_bytes, blob, owner=client_addr)
+                self._maybe_export_stored(id_bytes, blob)
+                out.append(("stored", len(blob)))
+        self.tasks_executed += 1
+        if task.trace is not None:
+            return ("ok", out, self._batch_trace(
+                task, t_exec, {"exec_start": t_exec, "exec_end": t_end,
+                               "pid": os.getpid()}))
+        return ("ok", out)
+
+    def _admit_parked(self, parked: list, launch, emit, complete,
+                      admit_ts: dict) -> None:
+        """Daemon-side admission queueing: retry reservation for
+        over-subscribed entries parked by this batch RPC. Expired
+        budgets seal typed timeouts; newly admitted entries emit
+        ("resumed", idx) — the driver re-acquires their CPU — and join
+        the worker pipeline as a fresh run."""
+        now = time.time()
+        still: list = []
+        for task, demand in parked:
+            if task.deadline is not None and now > task.deadline:
+                self.task_timeouts += 1
+                complete(task.idx, ("timeout", "admitted"))
+            else:
+                still.append((task, demand))
+        parked[:] = []
+        if not still:
+            return
+        accepted = self._try_reserve_many(
+            [(t.token, d) for t, d in still])
+        t_admit = time.time()
+        go: list = []
+        for (task, demand), ok in zip(still, accepted):
+            if ok:
+                emit(("resumed", task.idx))
+                if task.trace is not None or perf.PERF_ON:
+                    admit_ts[task.idx] = t_admit
+                go.append(task)
+            else:
+                parked.append((task, demand))
+        if go:
+            launch(go)
 
     def fetch_object(self, id_bytes: bytes, offset: int,
                      length: int):
@@ -2230,6 +2542,11 @@ class NodeExecutorService:
             "worker_lease_runs": self.pool.batch_runs,
             "worker_lease_tasks": self.pool.batch_tasks,
             "worker_pipelined_frames": self.pool.batch_frames,
+            "fused_runs": self.fused_runs,
+            "fused_tasks": self.fused_tasks,
+            "fused_fallbacks": self.fused_fallbacks,
+            "runner_spawns": self._batch_runners.spawns,
+            "runner_reuses": self._batch_runners.reuses,
         }
 
     def _data_plane_stats(self) -> dict:
@@ -3528,9 +3845,10 @@ class RemoteNodeHandle:
         MAYBE-STARTED (its frame reached a worker) — the caller's
         node-death accounting splits unstarted entries (requeued
         invisibly) from started ones (retried under the system-failure
-        budget). Returns the number of replies delivered — the caller
-        fails any missing indexes (stream cut mid-batch). Raises
-        RpcError/RpcMethodError like ``execute``."""
+        budget). Returns (replies delivered, fused stats from the
+        final ("done", n, stats) reply — {} from a pre-fused daemon);
+        the caller fails any missing indexes (stream cut mid-batch).
+        Raises RpcError/RpcMethodError like ``execute``."""
         self.ensure_sys_path()
         slot = self.pool.call_streaming(
             "execute_task_batch", entries, client_addr)
@@ -3545,12 +3863,20 @@ class RemoteNodeHandle:
                 on_results(payload)
             elif kind == "started" and on_started is not None:
                 on_started(payload)
+            elif kind == "started_many" and on_started is not None:
+                # Fused-run ambiguity window: every member is
+                # maybe-started from this part on (one part per window
+                # instead of one per task).
+                for idx in payload:
+                    on_started(idx)
             elif kind == "parked" and on_parked is not None:
                 on_parked(payload)
             elif kind == "resumed" and on_resumed is not None:
                 on_resumed(payload)
-        slot.result()  # surfaces transport/method failures
-        return delivered
+        done = slot.result()  # surfaces transport/method failures
+        stats = done[2] if isinstance(done, tuple) and len(done) > 2 \
+            else {}
+        return delivered, stats
 
     def fetch(self, id_bytes: bytes) -> bytes:
         return fetch_blob(self.pool, id_bytes)

@@ -201,14 +201,17 @@ def sample_end(name: str, start: tuple) -> tuple:
 
 
 def record_task_resources(name: str, wall_s: float, cpu_s: float,
-                          rss_delta_kb: float) -> None:
+                          rss_delta_kb: float, count: int = 1) -> None:
+    """Fold one sample into the per-function table. ``count`` lets a
+    run-level sample (fused in-daemon runs measure once around N
+    tasks) keep the task count honest while the sums stay exact."""
     with _res_lock:
         row = _resources.get(name)
         if row is None:
-            _resources[name] = [1, float(wall_s), float(cpu_s),
+            _resources[name] = [int(count), float(wall_s), float(cpu_s),
                                 float(rss_delta_kb)]
         else:
-            row[0] += 1
+            row[0] += int(count)
             row[1] += float(wall_s)
             row[2] += float(cpu_s)
             row[3] = max(row[3], float(rss_delta_kb))

@@ -412,10 +412,19 @@ class _ThreadRecycler:
         self.idle_s = idle_s
         self._lock = threading.Lock()
         self._idle: list[_Recycled] = []
+        # Reuse accounting: steady-state submitters should ride parked
+        # threads (reuses), not pay spawns — the persistent-runner
+        # stats (executor_stats()["pipeline"]) assert exactly that.
+        self.spawns = 0
+        self.reuses = 0
 
     def submit(self, fn, *args) -> None:
         with self._lock:
             worker = self._idle.pop() if self._idle else None
+            if worker is None:
+                self.spawns += 1
+            else:
+                self.reuses += 1
         if worker is None:
             worker = _Recycled(self)
         worker.run(fn, args)

@@ -410,6 +410,17 @@ class ClusterState:
                 node.release(demand)
             self._lock.notify_all()
 
+    def release_many(self, node_id: NodeID, demands: list) -> None:
+        """One lock pass + one wakeup for a completion group's worth of
+        releases (the per-task release was two lock acquires per task
+        on the batch completion hot path)."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is not None:
+                for demand in demands:
+                    node.release(demand)
+            self._lock.notify_all()
+
     def update_reported(self, node_id: NodeID,
                         available: dict[str, float]) -> None:
         """Syncer push: the node's own availability report arrived
@@ -530,6 +541,19 @@ class Dispatcher:
         self.batches_launched = 0
         self.batch_tasks_launched = 0
         self.singles_launched = 0
+        # Claims over-subscribed past a node's free slots into an open
+        # batch (force-acquired; the daemon queues them in admission):
+        # without this, batches were capped at the per-node free slot
+        # count (~4 tasks/RPC) regardless of dispatch_batch_max.
+        self.batch_overcommit = 0
+        # Persistent batch-runner threads (LIFO-recycled): a 100k-task
+        # drain launches thousands of batches — steady state must not
+        # pay a thread spawn per batch. Singles keep the A/B-measured
+        # thread-per-task launch (see _launch).
+        from ray_tpu._private.rpc import _ThreadRecycler
+
+        self._batch_runners = _ThreadRecycler("ray_tpu-task-batch",
+                                              idle_s=30.0)
         self._dispatch_thread = threading.Thread(
             target=self._dispatch_loop, name="ray_tpu-dispatcher", daemon=True)
         self._dispatch_thread.start()
@@ -807,6 +831,27 @@ class Dispatcher:
                 del self._ready_groups[sig]
         for sig, dq in groups:
             sticky: NodeState | None = None
+            # Batch-fill over-subscription: once a remote batch to the
+            # sticky node is open, keep claiming into it PAST the
+            # node's free slots (force-acquired; the daemon queues the
+            # excess in admission) until the fill budget runs out, then
+            # rotate to the next node via pick_node. Without this,
+            # batch depth was capped at the per-node free slot count
+            # (~4 tasks/RPC) however large dispatch_batch_max is — and
+            # on a many-node box each shallow batch pays a full daemon
+            # wake. The budget adapts to the backlog: a deep queue
+            # fills whole batches (amortization wins), a small burst
+            # keeps the classic spread (a handful of long tasks must
+            # not pile onto one node while others idle).
+            staged_remote = False
+            fill_left = 0
+            fill_budget = 0
+            if batches is not None and self._run_batch is not None:
+                with self._lock:
+                    backlog = len(dq)
+                n_nodes = max(1, len(self._cluster.nodes()))
+                fill_budget = min(self._batch_max(),
+                                  max(0, backlog // n_nodes))
             while True:
                 task = self._pop_next(dq)
                 if task is None:
@@ -841,19 +886,46 @@ class Dispatcher:
                             # The shortcut re-confirmed the max-bytes
                             # holder: that IS a locality placement.
                             self._cluster.record_locality_hit(best)
+                overcommitted = False
+                if node is None and sticky is not None \
+                        and staged_remote and fill_left > 0 \
+                        and (strategy is None
+                             or strategy.kind == "DEFAULT"):
+                    # Fill the open batch: take the sticky node's real
+                    # capacity when it still fits, else force-acquire
+                    # past it (availability goes negative, so pick_node
+                    # skips the node for OTHER work until completions
+                    # release — the ledger stays symmetric).
+                    if self._cluster.try_acquire(sticky.node_id,
+                                                 task.spec.resources):
+                        node = sticky
+                    else:
+                        self._cluster.force_acquire(
+                            sticky.node_id, task.spec.resources)
+                        node = sticky
+                        overcommitted = True
+                        self.batch_overcommit += 1
+                    fill_left -= 1
                 if node is None:
                     node = self._try_admit(task, hints)
                     if node is None:
                         break  # signature saturated for this pass
+                    # Fresh sticky: open a new fill cycle for it.
                     sticky = node
+                    staged_remote = False
+                    fill_left = fill_budget
                 with self._lock:
                     if dq and dq[0] is task:
                         dq.popleft()
                 if not self._claim(task, node):
                     continue
-                if batches is None or not self._stage_batch(
-                        batches, task, node):
+                task.spec._overcommit = overcommitted
+                staged = None if batches is None else \
+                    self._stage_batch(batches, task, node)
+                if staged is None:
                     self._launch(task, node)
+                elif not overcommitted:
+                    staged_remote = True
                 launched += 1
         return launched
 
@@ -876,13 +948,16 @@ class Dispatcher:
                 continue
             if not self._claim(task, node):
                 continue
+            # A spillback re-claim must not carry a stale overcommit
+            # mark from an earlier over-subscribed claim.
+            task.spec._overcommit = False
             with self._lock:
                 try:
                     self._ready_odd.remove(task)
                 except ValueError:
                     pass
-            if batches is None or not self._stage_batch(
-                    batches, task, node):
+            if batches is None or self._stage_batch(
+                    batches, task, node) is None:
                 self._launch(task, node)
             launched += 1
         return launched
@@ -897,20 +972,20 @@ class Dispatcher:
             return 32
 
     def _stage_batch(self, batches: dict, task: _QueuedTask,
-                     node: NodeState) -> bool:
+                     node: NodeState):
         """Coalesce a claimed task into this pass's batch for its key
-        (one execute_task_batch runner per key). Returns False when the
-        task must take the classic thread-per-task launch (no hooks,
-        local node, custom run callable, ...)."""
+        (one execute_task_batch runner per key). Returns the batch key,
+        or None when the task must take the classic thread-per-task
+        launch (no hooks, local node, custom run callable, ...)."""
         key_fn = self._batch_key
         if key_fn is None:
-            return False
+            return None
         try:
             key = key_fn(task.spec, node, task.run)
         except Exception:  # noqa: BLE001 — never wedge dispatch
             key = None
         if key is None:
-            return False
+            return None
         entry = batches.get(key)
         if entry is None:
             entry = batches[key] = (node, [])
@@ -918,7 +993,7 @@ class Dispatcher:
         if len(entry[1]) >= self._batch_max():
             del batches[key]
             self._launch_batch(entry[1], entry[0])
-        return True
+        return key
 
     def _flush_batches(self, batches: dict) -> None:
         for node, tasks in batches.values():
@@ -954,6 +1029,26 @@ class Dispatcher:
                     # beat; only a parked dispatch loop needs the kick.
                     self._lock.notify_all()
 
+        def complete_many(specs) -> None:
+            """Group completion: one ledger pass + one wakeup for a
+            whole streamed result group (fused runs seal 64 at a
+            time — two lock acquires per TASK was a measurable slice
+            of the drain budget)."""
+            with done_lock:
+                tasks_done = [t for t in (by_spec.pop(id(s), None)
+                                          for s in specs)
+                              if t is not None]
+            if not tasks_done:
+                return
+            self._cluster.release_many(
+                node.node_id, [t.spec.resources for t in tasks_done])
+            with self._lock:
+                self._num_running -= len(tasks_done)
+                if self._parked:
+                    self._lock.notify_all()
+
+        complete.many = complete_many
+
         def runner() -> None:
             try:
                 run_batch([t.spec for t in tasks], node, complete)
@@ -965,10 +1060,7 @@ class Dispatcher:
                 for spec in leftover:
                     complete(spec)
 
-        thread = threading.Thread(
-            target=runner, daemon=True,
-            name=f"ray_tpu-task-batch-{len(tasks)}")
-        thread.start()
+        self._batch_runners.submit(runner)
 
     def _try_admit(self, task: _QueuedTask,
                    locality: dict | None = None) -> NodeState | None:
@@ -1040,6 +1132,7 @@ class Dispatcher:
                 "batches_launched": self.batches_launched,
                 "batch_tasks_launched": self.batch_tasks_launched,
                 "singles_launched": self.singles_launched,
+                "batch_overcommit": self.batch_overcommit,
             }
 
     def pending_count(self) -> int:

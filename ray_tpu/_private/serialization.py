@@ -22,11 +22,164 @@ from __future__ import annotations
 
 import pickle
 import struct
+import threading
 from typing import Any
 
 import cloudpickle
 
 _U64 = struct.Struct("<Q")
+
+# Raw small-immutable framing (the worker-pipe fast path): eligible
+# values are encoded with a compact tag-length scheme instead of a
+# cloudpickle round trip. A raw frame starts with a header length no
+# pickled frame can produce (2**64 - 1), so readers distinguish the two
+# layouts from the first 8 bytes — decoding support is unconditional,
+# only PRODUCING raw frames is gated (RAW_ON, armed from the
+# raw_framing knob; disarmed frames are byte-identical pickles).
+RAW_ON: bool = True
+_RAW_SENTINEL = (1 << 64) - 1
+_RAW_SENTINEL_BYTES = _U64.pack(_RAW_SENTINEL)
+# Values above this never take the raw path: the win is the per-tiny-
+# object pickle overhead, not bulk encode throughput.
+_RAW_MAX_BYTES = 8192
+_I64 = struct.Struct("<q")
+_F64 = struct.Struct("<d")
+_U32 = struct.Struct("<I")
+_I64_MIN, _I64_MAX = -(1 << 63), (1 << 63) - 1
+
+
+def init_raw_from_config() -> None:
+    """Arm/disarm the raw framing fast path from config (Runtime init
+    and daemon/worker boot paths call this; import falls back to the
+    env-overridden default)."""
+    global RAW_ON
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    RAW_ON = bool(GLOBAL_CONFIG.raw_framing)
+
+
+try:
+    init_raw_from_config()
+except Exception:  # noqa: BLE001 — config unavailable mid-bootstrap
+    pass
+
+
+class _RawIneligible(Exception):
+    """Internal: the value contains a type the raw encoding has no tag
+    for (or is too large) — caller falls back to the pickle path."""
+
+
+_scratch = threading.local()
+
+
+def _raw_encode(out: bytearray, value: Any) -> None:
+    # Exact type checks only: subclasses (np.float64, IntEnum, ...)
+    # must round-trip through pickle to preserve their type.
+    t = type(value)
+    if value is None:
+        out.append(0x4E)  # 'N'
+    elif t is bool:
+        out.append(0x54 if value else 0x46)  # 'T' / 'F'
+    elif t is int:
+        if not _I64_MIN <= value <= _I64_MAX:
+            raise _RawIneligible
+        out.append(0x69)  # 'i'
+        out += _I64.pack(value)
+    elif t is float:
+        out.append(0x66)  # 'f'
+        out += _F64.pack(value)
+    elif t is str:
+        b = value.encode("utf-8")
+        out.append(0x73)  # 's'
+        out += _U32.pack(len(b))
+        out += b
+    elif t is bytes:
+        out.append(0x62)  # 'b'
+        out += _U32.pack(len(value))
+        out += value
+    elif t is tuple:
+        out.append(0x74)  # 't'
+        out += _U32.pack(len(value))
+        for item in value:
+            _raw_encode(out, item)
+    elif t is dict:
+        out.append(0x64)  # 'd'
+        out += _U32.pack(len(value))
+        for k, v in value.items():
+            if type(k) is not str:
+                raise _RawIneligible
+            kb = k.encode("utf-8")
+            out += _U32.pack(len(kb))
+            out += kb
+            _raw_encode(out, v)
+    else:
+        raise _RawIneligible
+    if len(out) > _RAW_MAX_BYTES:
+        raise _RawIneligible
+
+
+def try_serialize_raw(value: Any) -> "bytes | None":
+    """Frame ``value`` with the raw small-immutable encoding, or None
+    when it is ineligible (unsupported type, too large) or the fast
+    path is disarmed. The returned blob is a drop-in replacement for a
+    ``serialize_framed`` blob — ``deserialize_from_buffer`` dispatches
+    on the sentinel prefix."""
+    if not RAW_ON:
+        return None
+    out = getattr(_scratch, "buf", None)
+    if out is None:
+        out = _scratch.buf = bytearray()
+    else:
+        del out[:]
+    out += _RAW_SENTINEL_BYTES
+    try:
+        _raw_encode(out, value)
+    except _RawIneligible:
+        return None
+    return bytes(out)
+
+
+def _raw_decode(source: memoryview, off: int) -> tuple[Any, int]:
+    tag = source[off]
+    off += 1
+    if tag == 0x4E:
+        return None, off
+    if tag == 0x54:
+        return True, off
+    if tag == 0x46:
+        return False, off
+    if tag == 0x69:
+        return _I64.unpack(source[off:off + 8])[0], off + 8
+    if tag == 0x66:
+        return _F64.unpack(source[off:off + 8])[0], off + 8
+    if tag == 0x73:
+        (n,) = _U32.unpack(source[off:off + 4])
+        off += 4
+        return str(source[off:off + n], "utf-8"), off + n
+    if tag == 0x62:
+        (n,) = _U32.unpack(source[off:off + 4])
+        off += 4
+        return bytes(source[off:off + n]), off + n
+    if tag == 0x74:
+        (n,) = _U32.unpack(source[off:off + 4])
+        off += 4
+        items = []
+        for _ in range(n):
+            item, off = _raw_decode(source, off)
+            items.append(item)
+        return tuple(items), off
+    if tag == 0x64:
+        (n,) = _U32.unpack(source[off:off + 4])
+        off += 4
+        d = {}
+        for _ in range(n):
+            (kn,) = _U32.unpack(source[off:off + 4])
+            off += 4
+            key = str(source[off:off + kn], "utf-8")
+            off += kn
+            d[key], off = _raw_decode(source, off)
+        return d, off
+    raise ValueError(f"corrupt raw frame: unknown tag {tag:#x}")
 
 
 def serialize(value: Any) -> tuple[bytes, list[pickle.PickleBuffer]]:
@@ -79,7 +232,13 @@ def serialize_framed(value: Any) -> bytes:
 
 
 def deserialize_from_buffer(source: memoryview) -> Any:
-    """Read the framed layout; buffers are zero-copy views of ``source``."""
+    """Read the framed layout; buffers are zero-copy views of ``source``.
+
+    A raw small-immutable frame (sentinel header length) decodes via
+    the tag scheme instead — one u64 compare on every classic frame."""
+    if len(source) >= 8 and bytes(source[:8]) == _RAW_SENTINEL_BYTES:
+        value, _ = _raw_decode(source, 8)
+        return value
     off = 0
 
     def take(n: int) -> memoryview:

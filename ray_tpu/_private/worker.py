@@ -359,6 +359,14 @@ class Runtime:
         # knobs — same discipline as the perf plane above.
         scheduler_mod.init_sched_from_config()
         spec_mod.init_from_config()
+        # Fused in-daemon execution + raw small-immutable framing:
+        # driver-side module gates (daemons and pool workers re-arm
+        # from config/env at their own import).
+        from ray_tpu._private import node_executor as node_executor_mod
+        from ray_tpu._private import serialization as serialization_mod
+
+        node_executor_mod.init_fused_from_config()
+        serialization_mod.init_raw_from_config()
         # Watermark-driven spill tier (spill_manager.py): arm the
         # module gate; the managers themselves attach to the stores
         # further down (after the lease tables they filter on exist).
@@ -637,6 +645,12 @@ class Runtime:
         # requeued invisibly after a daemon death.
         self._fault_lock = threading.Lock()
         self._fault_batch_requeues = 0
+        # Fused in-daemon execution, as seen from this driver (the
+        # batch RPCs' ("done", n, stats) replies): surfaced via
+        # execution_pipeline_stats()["fused"].
+        self._fused_runs = 0
+        self._fused_tasks = 0
+        self._fused_fallbacks = 0
         self._pkg_hashes: dict[str, str] = {}
         # Refcount-zero eviction must also drop directory + lineage
         # entries, or they leak for the runtime's lifetime.
@@ -2220,6 +2234,17 @@ class Runtime:
                     self._arg_blob_cache.move_to_end(cache_key)
                     self.arg_cache_hits += 1
                     return blob
+            # Simple-arg tuples are exactly the raw-framing-eligible
+            # shape: encode with the tag scheme instead of pickling
+            # (the daemon/worker decode dispatches on the sentinel).
+            raw = serialization.try_serialize_raw((args, kwargs))
+            if raw is not None:
+                with self._arg_blob_lock:
+                    self._arg_blob_cache[cache_key] = raw
+                    while len(self._arg_blob_cache) \
+                            > _ARG_CACHE_MAX_ENTRIES:
+                        self._arg_blob_cache.popitem(last=False)
+                return raw
 
         inline_max = _inline_reply_bytes()
 
@@ -2461,12 +2486,17 @@ class Runtime:
                 # need_func reply, retried through the single path.
                 handle.known_digests.add(digest)
             idx = len(entries)
+            # Flags bit 0: args carry FetchRef placeholders; bit 2: the
+            # dispatcher over-subscribed this claim past the node's
+            # free slots (the daemon parks it in admission instead of
+            # bouncing a busy spillback).
             entry = (
                 digest, None if known else func_blob, args_blob,
                 spec.num_returns,
                 [rid.binary() for rid in spec.return_ids],
                 spec.runtime_env, spec.resources, token,
-                1 if has_refs else 0)
+                (1 if has_refs else 0)
+                | (2 if getattr(spec, "_overcommit", False) else 0))
             trace_ctx = getattr(spec, "_trace_ctx", None) \
                 if tracing.TRACE_ON else None
             if trace_ctx is not None or spec.deadline is not None:
@@ -2492,7 +2522,9 @@ class Runtime:
                 if trace_ctx is not None else {}))
         self.gcs.record_task_events(events)
 
-        def finish_idx(idx: int) -> None:
+        complete_many = getattr(complete, "many", None)
+
+        def finish_idx(idx: int, defer: "list | None" = None) -> None:
             spec = spec_by_idx.pop(idx, None)
             if spec is None:
                 return
@@ -2503,11 +2535,18 @@ class Runtime:
                 with self._inflight_blocks_lock:
                     self._inflight_blocks.pop(spec.task_id.hex(), None)
                 ctx.drain()
-            complete(spec)
+            if defer is not None:
+                # Group path: the caller releases the whole group's
+                # claims in one ledger pass (complete_many).
+                defer.append(spec)
+            else:
+                complete(spec)
 
         def on_results(group) -> None:
             pairs: list = []
             done_events = []
+            deferred: "list | None" = [] if complete_many is not None \
+                else None
             end = time.time()
             for idx, reply in group:
                 spec = spec_by_idx.get(idx)
@@ -2523,7 +2562,7 @@ class Runtime:
                             and not watcher.claim_win(spec):
                         # Speculation loser: sibling sealed first —
                         # skip the write, just release the claim.
-                        finish_idx(idx)
+                        finish_idx(idx, deferred)
                         continue
                     try:
                         self._collect_remote_results(
@@ -2545,15 +2584,15 @@ class Runtime:
                                 spec, handle, reply[2], t_send, end)
                     except BaseException as exc:  # noqa: BLE001
                         self._finish_task_failure(spec, exc, start)
-                    finish_idx(idx)
+                    finish_idx(idx, deferred)
                 elif reply[0] == "err":
                     exc, tb = serialization.deserialize_from_buffer(
                         memoryview(reply[1]))
                     exc.__ray_tpu_remote_tb__ = tb
                     self._finish_task_failure(spec, exc, start)
-                    finish_idx(idx)
+                    finish_idx(idx, deferred)
                 elif reply[0] == "busy":
-                    finish_idx(idx)
+                    finish_idx(idx, deferred)
                     self._spillback_requeue(spec, node)
                 elif reply[0] == "timeout":
                     # Daemon-side deadline expiry at admission or on
@@ -2562,9 +2601,9 @@ class Runtime:
                     self._seal_deadline(
                         spec, reply[1] if len(reply) > 1 and reply[1]
                         else "admitted")
-                    finish_idx(idx)
+                    finish_idx(idx, deferred)
                 elif reply[0] == "overloaded":
-                    finish_idx(idx)
+                    finish_idx(idx, deferred)
                     self._handle_overloaded_reply(
                         spec, node, "daemon admission shed")
                 elif reply[0] == "cancelled":
@@ -2572,7 +2611,7 @@ class Runtime:
                     # sibling's seal already carries the result.
                     if self._spec_watcher is not None:
                         self._spec_watcher.mark_cancelled(spec)
-                    finish_idx(idx)
+                    finish_idx(idx, deferred)
                 else:  # ("need_func", _): single path re-ships the blob
                     def redo(spec=spec):
                         try:
@@ -2593,6 +2632,11 @@ class Runtime:
                 self.store.put_batch(pairs)
             if done_events:
                 self.gcs.record_task_events(done_events)
+            if deferred:
+                # One ledger pass + one wakeup for the whole group's
+                # claim releases (after the seal, so pending_count
+                # never undercounts sealed-but-running work).
+                complete_many(deferred)
 
         def on_parked(idx: int) -> None:
             # The daemon queued this task's frame behind a blocked
@@ -2623,9 +2667,18 @@ class Runtime:
                                       max(0.0, t_send - claim))
         if entries:
             try:
-                handle.execute_batch(entries, on_results, on_parked,
-                                     on_resumed, client_addr,
-                                     on_started=started_idx.add)
+                _, fused_stats = handle.execute_batch(
+                    entries, on_results, on_parked, on_resumed,
+                    client_addr, on_started=started_idx.add)
+                if fused_stats.get("fused") \
+                        or fused_stats.get("fused_fallbacks"):
+                    with self._fault_lock:
+                        if fused_stats.get("fused"):
+                            self._fused_runs += 1
+                            self._fused_tasks += int(
+                                fused_stats["fused"])
+                        self._fused_fallbacks += int(
+                            fused_stats.get("fused_fallbacks", 0))
             except (RpcError, RpcMethodError, OSError) as exc:
                 transport_exc = exc
         if spec_by_idx:
@@ -3481,17 +3534,32 @@ class Runtime:
                 "batches": self.dispatcher.batches_launched,
                 "batch_tasks": self.dispatcher.batch_tasks_launched,
                 "singles": self.dispatcher.singles_launched,
+                "batch_overcommit": self.dispatcher.batch_overcommit,
             },
             "seal": {
                 "batch_seals": self.store.batch_seals,
                 "batch_sealed_objects": self.store.batch_sealed_objects,
             },
+            # Fused in-daemon execution, accumulated from the batch
+            # RPCs' done replies: batch RPCs whose runs fused at least
+            # one task, tasks executed on daemon dispatch threads, and
+            # fused-eligible entries that fell back to the worker
+            # pipeline when a run's wall budget expired.
+            "fused": self._fused_stats(),
             # Placement decisions (locality/load scoring) + straggler
             # speculation outcomes — the observability loop's own
             # observability (also exported as the
             # ray_tpu_sched_decisions_total /metrics family).
             "sched": self._sched_stats(),
         }
+
+    def _fused_stats(self) -> dict:
+        with self._fault_lock:
+            return {
+                "fused_runs": self._fused_runs,
+                "fused_tasks": self._fused_tasks,
+                "fused_fallbacks": self._fused_fallbacks,
+            }
 
     def _sched_stats(self) -> dict:
         out = dict(self.cluster.sched_counters())

@@ -107,6 +107,13 @@ class _BatchTask:
     # worker refuses frames whose budget died queued behind the lease
     # head (reply status "timeout" — nothing executed).
     deadline: float | None = None
+    # Driver over-subscribed this entry past the node's free slots
+    # (entry flags bit 2): a failed reservation PARKS it in daemon
+    # admission instead of bouncing a ("busy",) spillback.
+    overcommit: bool = False
+    # Return-object keys, needed daemon-side by the fused in-daemon
+    # path (the worker path resolves them from the batch entries).
+    return_keys: list | None = None
 
 
 # --------------------------------------------------------------------------
@@ -257,6 +264,12 @@ def _pack_results(values: list, arena=None, arena_max: int = 0) -> list:
 
     out = []
     for value in values:
+        raw = serialization.try_serialize_raw(value)
+        if raw is not None:
+            # Small immutable result: the raw tag encoding skips the
+            # pickle round trip on both ends of the pipe.
+            out.append(("inline", raw))
+            continue
         try:
             header, buffers = serialization.serialize(value)
         except Exception as exc:  # noqa: BLE001 — unpicklable result
@@ -1262,6 +1275,15 @@ class WorkerPool:
         """Replace top-level ObjectRef args with _ShmRef descriptors
         (promoting driver-held values into shm) and frame the rest."""
         from ray_tpu._private.object_ref import ObjectRef
+
+        if not any(isinstance(a, ObjectRef) for a in args) \
+                and not any(isinstance(v, ObjectRef)
+                            for v in kwargs.values()):
+            # Ref-free small-immutable calls skip the pickle round trip
+            # (the worker's deserialize dispatches on the raw sentinel).
+            raw = serialization.try_serialize_raw((args, kwargs))
+            if raw is not None:
+                return raw
 
         def convert(a):
             if isinstance(a, ObjectRef):

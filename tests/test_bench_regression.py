@@ -226,6 +226,39 @@ def test_bench_envelope_tasks_row_records_submit_stage_counters():
             "not measured through the ring")
 
 
+def test_bench_envelope_tasks_row_records_fused_counters():
+    """ISSUE 11: the guarded exec_per_s baseline is a FUSED number —
+    the tasks row must carry the fused_execution knob state and the
+    fused_runs/fused_tasks/fused_fallbacks counters, a refresh with
+    the fused path disarmed (or one where no task actually fused) is
+    refused outright, and the row must clear the absolute exec_per_s
+    floor the fused path was built to reach."""
+    if not BENCH_ENVELOPE.exists():
+        pytest.skip("BENCH_ENVELOPE.json not present in the working "
+                    "tree")
+    doc = json.loads(BENCH_ENVELOPE.read_text())
+    tasks_rows = [r for r in doc.get("phases", [])
+                  if r.get("phase") == "tasks"]
+    assert tasks_rows, "envelope lost its tasks phase"
+    for row in tasks_rows:
+        assert row.get("fused_execution") is True, (
+            "envelope tasks row was recorded with fused execution "
+            "disarmed (or predates the flag): rerun bench_envelope.py "
+            "without RAY_TPU_FUSED_EXECUTION=0")
+        fused = row.get("fused") or {}
+        for key in ("fused_runs", "fused_tasks", "fused_fallbacks"):
+            assert key in fused, (
+                f"tasks row fused counters lost {key!r}")
+        assert fused["fused_tasks"] > 0, (
+            "zero fused tasks: the guarded exec_per_s was not measured "
+            "through the fused path — refusing the refresh")
+        # Absolute floor (ISSUE 11 acceptance): ≥5,000 sustained
+        # exec/s over the submit+drain window on the reference box.
+        assert float(row.get("exec_per_s", 0)) >= 5000.0, (
+            f"exec_per_s {row.get('exec_per_s')} under the 5,000/s "
+            f"fused-execution floor")
+
+
 def test_bench_envelope_tasks_row_records_overload_counters():
     """The tasks row's fault counters must carry the overload-control
     plane (timeouts / sheds / breaker opens): a refresh that loses the

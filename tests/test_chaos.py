@@ -413,9 +413,14 @@ def test_daemon_sigkill_mid_batch_requeues_unstarted(tmp_path):
 
     ray_tpu.shutdown()
     cluster = Cluster(log_dir=str(tmp_path / "cluster"))
+    # Fused off: this test guards the WORKER-PIPE death accounting
+    # (per-frame started marks, invisible requeue of unsent frames);
+    # the fused path announces in windows and has its own exactly-once
+    # test (test_daemon_sigkill_mid_fused_run_exactly_once).
     cluster.add_node(num_cpus=8, resources={"vic": 100.0}, pool_size=1,
                      heartbeat_period_s=0.5,
-                     env={"RAY_TPU_WORKER_PIPELINE_DEPTH": "1"})
+                     env={"RAY_TPU_WORKER_PIPELINE_DEPTH": "1",
+                          "RAY_TPU_FUSED_EXECUTION": "0"})
     runtime = None
     try:
         assert cluster.wait_for_nodes(1, timeout=30)
@@ -478,6 +483,94 @@ def test_daemon_sigkill_mid_batch_requeues_unstarted(tmp_path):
                     if f.startswith(f"started-{i}-")]
             if str(i) not in started_before_kill:
                 assert len(runs) == 1, (i, runs)
+    finally:
+        if runtime is not None:
+            ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_daemon_sigkill_mid_fused_run_exactly_once(tmp_path):
+    """SIGKILL the daemon while a FUSED run is executing on its
+    dispatch thread (ISSUE 11): entries the run never reached requeue
+    invisibly and execute exactly once on the replacement node;
+    maybe-started entries (whose ("started", idx) part was written
+    before the user function ran) retry under the system-failure
+    budget — at most one extra execution, never a lost or double-sealed
+    result. Marker files carry the executing pid, which doubles as
+    proof the run really was in-daemon (victim markers bear the daemon
+    pid)."""
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir=str(tmp_path / "cluster"))
+    # A generous wall budget keeps the WHOLE run fused (no worker-path
+    # spill muddying the accounting); 0.05s/task makes the kill land
+    # mid-run deterministically.
+    cluster.add_node(num_cpus=4, resources={"vic": 100.0}, pool_size=0,
+                     heartbeat_period_s=0.5,
+                     env={"RAY_TPU_FUSED_RUN_WALL_BUDGET_S": "30"})
+    runtime = None
+    try:
+        assert cluster.wait_for_nodes(1, timeout=30)
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        _wait_for(lambda: ray_tpu.cluster_resources().get("vic", 0) > 0,
+                  30, "victim node to join the driver view")
+        with runtime._remote_nodes_lock:
+            vic_handle = next(iter(runtime._remote_nodes.values()))
+        vic_pid = vic_handle.pool.call("exec_ping")
+
+        marker_dir = tmp_path / "markers"
+        marker_dir.mkdir()
+
+        @ray_tpu.remote(num_cpus=1, resources={"vic": 1.0},
+                        max_retries=3)
+        def victim(i, mdir):
+            import os as _os
+            import time as _t
+
+            with open(f"{mdir}/ran-{i}-{_os.getpid()}", "w"):
+                pass
+            _t.sleep(0.1)
+            return i
+
+        n = 16
+        refs = [victim.remote(i, str(marker_dir)) for i in range(n)]
+        # Kill once the fused run has chewed through a few entries —
+        # some executed (victim-pid markers), the rest never started.
+        _wait_for(lambda: len(os.listdir(marker_dir)) >= 3,
+                  60, "fused run to start executing")
+        requeues_before = runtime.fault_stats()["batch_requeues"]
+        os.kill(vic_pid, signal.SIGKILL)
+        cluster.add_node(num_cpus=4, resources={"vic": 100.0},
+                         pool_size=0, heartbeat_period_s=0.5,
+                         env={"RAY_TPU_FUSED_RUN_WALL_BUDGET_S": "30"})
+
+        results = ray_tpu.get(refs, timeout=180)
+        assert sorted(results) == list(range(n)), results
+
+        markers = os.listdir(marker_dir)
+        started_on_victim = {int(f.split("-")[1]) for f in markers
+                             if f.endswith(f"-{vic_pid}")}
+        # The kill really landed mid-fused-run: some entries executed
+        # in the daemon process, some never started there.
+        assert started_on_victim, markers
+        assert len(started_on_victim) < n, markers
+        for i in range(n):
+            runs = [f for f in markers if f.startswith(f"ran-{i}-")]
+            victim_runs = [f for f in runs if f.endswith(f"-{vic_pid}")]
+            if i not in started_on_victim:
+                # Never-started: requeued invisibly, executed exactly
+                # once (on the replacement).
+                assert len(runs) == 1, (i, runs)
+            else:
+                # Maybe-started: ran once on the victim; the
+                # system-failure retry may have re-run it at most once
+                # (its first result could have been delivered already).
+                assert len(victim_runs) == 1, (i, runs)
+                assert len(runs) - len(victim_runs) <= 1, (i, runs)
+        # At least one never-started entry rode the invisible requeue.
+        stats = runtime.fault_stats()
+        assert stats["batch_requeues"] - requeues_before >= 1, stats
     finally:
         if runtime is not None:
             ray_tpu.shutdown()
@@ -628,9 +721,13 @@ def test_daemon_sigkill_expired_in_queue_no_ghost_execution(tmp_path):
 
     ray_tpu.shutdown()
     cluster = Cluster(log_dir=str(tmp_path / "cluster"))
+    # Fused off (worker-pipe semantics under test — see the SIGKILL
+    # mid-batch test above; the fused path's window accounting has its
+    # own dedicated exactly-once coverage).
     cluster.add_node(num_cpus=8, resources={"vic": 100.0}, pool_size=1,
                      heartbeat_period_s=0.5,
-                     env={"RAY_TPU_WORKER_PIPELINE_DEPTH": "1"})
+                     env={"RAY_TPU_WORKER_PIPELINE_DEPTH": "1",
+                          "RAY_TPU_FUSED_EXECUTION": "0"})
     runtime = None
     try:
         assert cluster.wait_for_nodes(1, timeout=30)

@@ -319,6 +319,57 @@ def test_readme_stage_list_matches_tracing_stages():
         f"README stage chain drifted from tracing.STAGES: {chain}")
 
 
+# -------------------------------------------------------- fused execution
+
+
+@pytest.fixture(scope="module")
+def fused_text() -> str:
+    text = README.read_text()
+    start = text.find("## Fused execution")
+    assert start != -1, "README lost its Fused execution section"
+    end = text.find("\n## ", start + 1)
+    return text[start:end if end != -1 else len(text)]
+
+
+def test_fused_knobs_documented(fused_text):
+    """Every fused-execution / raw-framing knob must keep a README row
+    in the Fused execution knob table."""
+    from ray_tpu._private.config import _DEFAULTS
+
+    knobs = [k for k in _DEFAULTS
+             if k.startswith("fused_") or k == "raw_framing"]
+    assert len(knobs) >= 4, f"fused knobs vanished from config: {knobs}"
+    missing = [k for k in knobs if f"`{k}`" not in fused_text]
+    assert not missing, (
+        f"fused-execution knobs missing from the README knob table: "
+        f"{missing}")
+
+
+def test_fused_decision_table_documented(fused_text):
+    """The fused-vs-classic-vs-pipelined decision table must keep a row
+    per path, and the counter keys their README mention."""
+    for path in ("**fused**", "**pipelined**", "**classic**"):
+        assert path in fused_text, (
+            f"decision-table row {path} missing from the README Fused "
+            f"execution section")
+    for key in ("fused_runs", "fused_tasks", "fused_fallbacks",
+                "batch_overcommit", "runner_spawns", "runner_reuses"):
+        assert f"`{key}`" in fused_text, (
+            f"fused counter {key!r} missing from the README Fused "
+            f"execution section")
+
+
+def test_fused_counters_match_driver_stats(ray_start_regular):
+    """execution_pipeline_stats()["fused"] must emit exactly the
+    documented keys (a new counter forces a README row via the
+    Observability-table drift tests)."""
+    fused = ray_start_regular.execution_pipeline_stats()["fused"]
+    assert set(fused) == {"fused_runs", "fused_tasks",
+                          "fused_fallbacks"}, fused
+    dispatch = ray_start_regular.execution_pipeline_stats()["dispatch"]
+    assert "batch_overcommit" in dispatch, dispatch
+
+
 # ---------------------------------------------------------- spill tier
 
 

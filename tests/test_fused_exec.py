@@ -1,0 +1,380 @@
+"""Fused in-daemon execution + dispatch over-subscription + raw
+small-immutable framing (ISSUE 11).
+
+Covers the fast paths that break the ~300µs/task execute bound: runs
+of tiny DEFAULT tasks executing on the daemon dispatch thread with no
+worker-pipe hop (fused counters, budget fallback, deadline/cancel
+semantics), dispatch batches over-subscribed past the per-node slot
+cap (batch_overcommit, >4 tasks/RPC), the persistent batch runners,
+the raw tag framing that replaces pickle for small immutable
+args/results, and the fused_execution=0 fallback equivalence.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import serialization
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu.cluster_utils import Cluster
+from ray_tpu.exceptions import TaskCancelledError
+
+
+def _wait_for(predicate, timeout_s, what):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+@pytest.fixture
+def fused_cluster(tmp_path):
+    """One 4-CPU daemon, zero driver CPU: every task rides the remote
+    batch path, and tiny DEFAULT tasks fuse in-daemon."""
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir=str(tmp_path / "cluster"))
+    cluster.add_node(num_cpus=4)
+    try:
+        assert cluster.wait_for_nodes(1, timeout=60)
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        _wait_for(lambda: ray_tpu.cluster_resources().get("CPU", 0) >= 4,
+                  30, "remote node joining the driver view")
+        yield runtime
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def _daemon_pipeline(runtime) -> dict:
+    with runtime._remote_nodes_lock:
+        handles = list(runtime._remote_nodes.values())
+    agg: dict = {}
+    for handle in handles:
+        pipe = handle._control.call("executor_stats").get("pipeline", {})
+        for key, value in pipe.items():
+            agg[key] = agg.get(key, 0) + int(value)
+    return agg
+
+
+# ------------------------------------------------------------- fused path
+
+
+def test_fused_run_executes_in_daemon_without_worker_pipe(fused_cluster):
+    """A burst of tiny tasks fuses: results are correct and sealed per
+    ref, the daemon executed them IN PROCESS (result pid == daemon
+    pid), and zero worker-pipe frames were paid."""
+
+    @ray_tpu.remote(num_cpus=1)
+    def ident(i):
+        return (i, os.getpid())
+
+    # Warm the function digest daemon-side first: concurrent first-
+    # contact batches can race the optimistic known-digest set into
+    # need_func single-path retries, which execute classically and
+    # would muddy the fused accounting below.
+    assert ray_tpu.get(ident.remote(-1), timeout=60.0)[0] == -1
+    refs = [ident.remote(i) for i in range(300)]
+    out = ray_tpu.get(refs, timeout=120.0)
+    assert [v[0] for v in out] == list(range(300))
+    daemon_pids = {v[1] for v in out}
+    pipe = _daemon_pipeline(fused_cluster)
+    assert pipe["fused_tasks"] > 0, pipe
+    assert pipe["fused_runs"] > 0, pipe
+    # In-daemon: fused entries executed under the daemon's own service
+    # pid (a loaded box may spill a tail of entries to pool workers via
+    # the wall budget — those report worker pids and are counted as
+    # fallbacks; the accounting must agree either way).
+    with fused_cluster._remote_nodes_lock:
+        handle = next(iter(fused_cluster._remote_nodes.values()))
+    daemon_pid = handle._control.call("exec_ping")
+    assert daemon_pid in daemon_pids, (daemon_pids, daemon_pid)
+    assert pipe["fused_tasks"] + pipe["fused_fallbacks"] >= 300, pipe
+    if pipe["fused_fallbacks"] == 0:
+        # Fully fused burst: no worker-pipe hop at all, one pid.
+        assert pipe["worker_pipelined_frames"] == 0, pipe
+        assert daemon_pids == {daemon_pid}, (daemon_pids, daemon_pid)
+    # Driver-side mirror of the same counters.
+    fused = fused_cluster.execution_pipeline_stats()["fused"]
+    assert fused["fused_tasks"] == pipe["fused_tasks"] > 0
+    assert fused["fused_runs"] > 0
+
+
+def test_fused_wall_budget_spills_to_worker_path(fused_cluster):
+    """Once a fused run's wall budget expires, the remaining entries
+    fall back to the pipelined worker path (fused_fallbacks) — one
+    long task cannot monopolize the daemon's dispatch thread — and
+    every result still seals correctly."""
+
+    @ray_tpu.remote(num_cpus=1)
+    def slow(i):
+        time.sleep(0.15)
+        return i
+
+    refs = [slow.remote(i) for i in range(10)]
+    assert ray_tpu.get(refs, timeout=120.0) == list(range(10))
+    pipe = _daemon_pipeline(fused_cluster)
+    assert pipe["fused_tasks"] >= 1, pipe
+    assert pipe["fused_fallbacks"] >= 1, pipe
+    # The spilled entries really rode the worker pipeline.
+    assert pipe["worker_pipelined_frames"] >= 1, pipe
+    fused = fused_cluster.execution_pipeline_stats()["fused"]
+    assert fused["fused_fallbacks"] >= 1
+
+
+def test_fused_deadline_seals_typed_timeout(fused_cluster):
+    """A deadline that dies while the entry waits in the daemon's
+    fused run seals TaskTimeoutError, and the user function provably
+    never runs (marker files)."""
+    from ray_tpu.exceptions import TaskTimeoutError
+
+    @ray_tpu.remote(num_cpus=1)
+    def mark(path):
+        with open(path, "w"):
+            pass
+        return "ran"
+
+    import tempfile
+
+    mdir = tempfile.mkdtemp(prefix="ray_tpu_fused_dl_")
+    ref = mark.options(_deadline_s=0.0001).remote(
+        os.path.join(mdir, "m0"))
+    with pytest.raises(TaskTimeoutError):
+        ray_tpu.get(ref, timeout=60.0)
+    time.sleep(0.3)
+    assert not os.listdir(mdir), "expired fused entry still executed"
+
+
+def test_fused_cancel_queued_task(fused_cluster):
+    """Cancel of a not-yet-claimed task still works with the fused
+    path armed, and the scheduler stays healthy."""
+
+    @ray_tpu.remote(num_cpus=4)
+    def hog():
+        time.sleep(0.8)
+        return "hog"
+
+    @ray_tpu.remote(num_cpus=4)
+    def queued():
+        return "ran"
+
+    blocker = hog.remote()
+    tail = queued.remote()
+    ray_tpu.cancel(tail)
+    with pytest.raises(TaskCancelledError):
+        ray_tpu.get(tail, timeout=60.0)
+    assert ray_tpu.get(blocker, timeout=60.0) == "hog"
+
+    @ray_tpu.remote(num_cpus=1)
+    def probe():
+        return 7
+
+    assert ray_tpu.get(probe.remote(), timeout=60.0) == 7
+
+
+# ----------------------------------------------------- batch over-subscribe
+
+
+def test_batch_overcommit_beats_per_node_slot_cap(fused_cluster):
+    """The dispatcher over-subscribes claims past the node's 4 free
+    slots into open batches: batch_overcommit fires and the average
+    batch carries MORE than 4 tasks/RPC (the pre-fix ceiling was the
+    free-slot count regardless of dispatch_batch_max)."""
+
+    @ray_tpu.remote(num_cpus=1)
+    def noop(i):
+        return i
+
+    refs = [noop.remote(i) for i in range(3000)]
+    out = ray_tpu.get(refs, timeout=300.0)
+    assert out == list(range(3000))
+    stats = fused_cluster.execution_pipeline_stats()["dispatch"]
+    assert stats["batch_overcommit"] > 0, stats
+    pipe = _daemon_pipeline(fused_cluster)
+    assert pipe["batch_rpcs"] > 0
+    avg = pipe["batch_tasks"] / pipe["batch_rpcs"]
+    assert avg > 4.0, (
+        f"batches still capped near the 4-slot ceiling: "
+        f"{avg:.1f} tasks/RPC over {pipe['batch_rpcs']} RPCs")
+
+
+@pytest.mark.slow
+def test_batch_overcommit_under_100k_drain(tmp_path):
+    """ISSUE 11 satellite acceptance at full scale: a 100k-task drain
+    shows over-subscribed batches (>4 tasks/RPC average) end to end."""
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir=str(tmp_path / "cluster"))
+    cluster.add_node(num_cpus=4)
+    try:
+        assert cluster.wait_for_nodes(1, timeout=60)
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        _wait_for(lambda: ray_tpu.cluster_resources().get("CPU", 0) >= 4,
+                  30, "remote node joining the driver view")
+
+        @ray_tpu.remote(num_cpus=1)
+        def noop(i):
+            return i
+
+        refs = [noop.remote(i) for i in range(100_000)]
+        drained = ray_tpu.get(refs[:10_000], timeout=1800.0)
+        assert drained == list(range(10_000))
+        stats = runtime.execution_pipeline_stats()["dispatch"]
+        assert stats["batch_overcommit"] > 0, stats
+        pipe = _daemon_pipeline(runtime)
+        avg = pipe["batch_tasks"] / max(1, pipe["batch_rpcs"])
+        assert avg > 4.0, f"{avg:.1f} tasks/RPC"
+        for ref in refs[10_000:]:
+            ray_tpu.cancel(ref)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+# ------------------------------------------------------------ raw framing
+
+
+def test_raw_framing_round_trip_and_eligibility():
+    """The raw tag encoding round-trips exactly the small-immutable
+    shapes (types preserved — bool is not int, tuple is not list) and
+    refuses everything else; classic pickled frames keep decoding
+    through the same reader."""
+    eligible = [None, True, False, 0, -1, 2**62, 3.5, float("inf"),
+                "", "héllo", b"\x00bytes", (), (1, "x", (2.5, None)),
+                {"k": 1, "nested": ("a", b"b")},
+                ((1, 2), {"kw": True})]
+    for value in eligible:
+        blob = serialization.try_serialize_raw(value)
+        assert blob is not None, value
+        back = serialization.deserialize_from_buffer(memoryview(blob))
+        assert back == value and type(back) is type(value), (value, back)
+    ineligible = [2**70, [1, 2], {1: "non-str key"}, {"k": [1]},
+                  object(), b"x" * 9000, "y" * 9000]
+    for value in ineligible:
+        assert serialization.try_serialize_raw(value) is None, value
+    # Classic frames and raw frames coexist behind one reader.
+    classic = serialization.serialize_framed({"a": [1, 2, 3]})
+    assert serialization.deserialize_from_buffer(
+        memoryview(classic)) == {"a": [1, 2, 3]}
+    # bool/int distinction survives (a naive int tag would conflate).
+    a, b = serialization.deserialize_from_buffer(memoryview(
+        serialization.try_serialize_raw((True, 1))))
+    assert a is True and type(b) is int
+
+
+def test_raw_framing_disarmed_produces_no_raw_frames(monkeypatch):
+    monkeypatch.setattr(serialization, "RAW_ON", False)
+    assert serialization.try_serialize_raw(1) is None
+    monkeypatch.setattr(serialization, "RAW_ON", True)
+    assert serialization.try_serialize_raw(1) is not None
+
+
+def test_mixed_arg_result_types_through_fused_path(fused_cluster):
+    """End-to-end correctness across raw-eligible and raw-ineligible
+    args/results through the fused path (numpy falls back to pickle
+    framing transparently)."""
+    import numpy as np
+
+    @ray_tpu.remote(num_cpus=1)
+    def echo(x):
+        return x
+
+    values = [42, 3.5, "str", b"bytes", None, True, (1, "t"),
+              {"k": (1, 2)}, [1, 2, 3], np.arange(16)]
+    refs = [echo.remote(v) for v in values]
+    out = ray_tpu.get(refs, timeout=120.0)
+    for sent, got in zip(values, out):
+        if isinstance(sent, np.ndarray):
+            assert (got == sent).all()
+        else:
+            assert got == sent and type(got) is type(sent)
+
+
+# -------------------------------------------------- disarmed equivalence
+
+
+def test_fused_disarmed_fallback_equivalence(tmp_path, monkeypatch):
+    """fused_execution=0: the batch path is the pre-fused worker
+    pipeline — same results, same cancel and deadline semantics, zero
+    fused counters — and the persistent batch runners still recycle
+    threads across waves (reuses > 0)."""
+    from ray_tpu._private import node_executor
+    from ray_tpu.exceptions import TaskTimeoutError
+
+    monkeypatch.setenv("RAY_TPU_FUSED_EXECUTION", "0")
+    GLOBAL_CONFIG.reset()
+    node_executor.init_fused_from_config()
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir=str(tmp_path / "cluster"))
+    cluster.add_node(num_cpus=4,
+                     env={"RAY_TPU_FUSED_EXECUTION": "0"})
+    try:
+        assert cluster.wait_for_nodes(1, timeout=60)
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        _wait_for(lambda: ray_tpu.cluster_resources().get("CPU", 0) >= 4,
+                  30, "remote node joining the driver view")
+
+        @ray_tpu.remote(num_cpus=1)
+        def ident(i):
+            return (i, os.getpid())
+
+        out = ray_tpu.get([ident.remote(i) for i in range(200)],
+                          timeout=120.0)
+        assert [v[0] for v in out] == list(range(200))
+        pipe = _daemon_pipeline(runtime)
+        assert pipe["fused_runs"] == 0 and pipe["fused_tasks"] == 0, pipe
+        # Disarmed, everything rides the worker pipeline — in worker
+        # processes, not the daemon.
+        assert pipe["worker_pipelined_frames"] > 0, pipe
+        with runtime._remote_nodes_lock:
+            handle = next(iter(runtime._remote_nodes.values()))
+        daemon_pid = handle._control.call("exec_ping")
+        assert daemon_pid not in {v[1] for v in out}
+        # Second wave: the persistent runners recycle parked threads.
+        out2 = ray_tpu.get([ident.remote(i) for i in range(200)],
+                           timeout=120.0)
+        assert [v[0] for v in out2] == list(range(200))
+        pipe = _daemon_pipeline(runtime)
+        assert pipe["runner_reuses"] > 0, pipe
+        assert runtime.execution_pipeline_stats()["fused"] == {
+            "fused_runs": 0, "fused_tasks": 0, "fused_fallbacks": 0}
+
+        # Cancel semantics, disarmed.
+        @ray_tpu.remote(num_cpus=4)
+        def hog():
+            time.sleep(0.8)
+            return "hog"
+
+        @ray_tpu.remote(num_cpus=4)
+        def queued():
+            return "ran"
+
+        blocker = hog.remote()
+        tail = queued.remote()
+        ray_tpu.cancel(tail)
+        with pytest.raises(TaskCancelledError):
+            ray_tpu.get(tail, timeout=60.0)
+        assert ray_tpu.get(blocker, timeout=60.0) == "hog"
+
+        # Deadline semantics, disarmed: typed timeout, nothing runs.
+        @ray_tpu.remote(num_cpus=1)
+        def mark(path):
+            with open(path, "w"):
+                pass
+            return "ran"
+
+        mdir = tmp_path / "markers"
+        mdir.mkdir()
+        with pytest.raises(TaskTimeoutError):
+            ray_tpu.get(mark.options(_deadline_s=0.0001).remote(
+                str(mdir / "m0")), timeout=60.0)
+        time.sleep(0.3)
+        assert not os.listdir(mdir)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+        monkeypatch.delenv("RAY_TPU_FUSED_EXECUTION", raising=False)
+        GLOBAL_CONFIG.reset()
+        node_executor.init_fused_from_config()

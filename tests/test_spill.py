@@ -231,7 +231,11 @@ def test_restore_under_concurrent_get_races(tmp_path):
     time.sleep(1.5)
     stop.set()
     for t in threads:
-        t.join(timeout=10)
+        # A saturated box can stretch the churner's LAST spill_pass
+        # past a short join; a silently-timed-out join then reads the
+        # spill dir mid-write and flags its .tmp file as a leak.
+        t.join(timeout=60)
+        assert not t.is_alive(), "spill hammer thread wedged"
     assert not errors
     stats = mgr.stats()
     assert stats["restores"] > 0 and stats["torn_restores"] == 0

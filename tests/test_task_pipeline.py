@@ -22,10 +22,14 @@ from ray_tpu.exceptions import TaskCancelledError, WorkerCrashedError
 def pipeline_cluster():
     """One daemon, zero driver CPU: every task must ride the remote
     execute path (and, with several queued at once, the batched
-    execute_task_batch pipeline)."""
+    execute_task_batch pipeline). Fused in-daemon execution is pinned
+    OFF for this daemon — these tests exercise the worker-pipe
+    pipeline itself (frame ordering, per-worker crash isolation),
+    which tiny tasks would otherwise bypass entirely; the fused path
+    has its own suite (test_fused_exec.py / test_chaos.py)."""
     ray_tpu.shutdown()
     cluster = Cluster(log_dir="/tmp/ray_tpu_test_pipeline")
-    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2, env={"RAY_TPU_FUSED_EXECUTION": "0"})
     try:
         assert cluster.wait_for_nodes(1, timeout=60), \
             "worker daemon never registered"
