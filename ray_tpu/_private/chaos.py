@@ -25,6 +25,20 @@ Injection sites (the site string is the contract; counters surface in
 - ``rpc.drop_frame``  client: silently drop one request frame
 - ``rpc.delay``       client: sleep 5-50 ms before a frame send
 - ``rpc.kill_stream`` server: kill a streaming reply mid-parts
+- ``net.partition``   client: SUSTAINED partition — one fire opens a
+  seeded window (``RAY_TPU_PARTITION_S`` base seconds x a seeded
+  0.5-1.5 jitter) during which EVERY send to that destination fails
+  like a severed link, then the window heals and traffic resumes (vs
+  ``rpc.sever``'s one-shot failure). ``RAY_TPU_PARTITION_TARGET``
+  restricts the site to destinations containing the substring (e.g.
+  the head's port) so tests sever exactly the node<->head or
+  node<->node link they mean to
+- ``gcs.torn_snapshot`` head persistence: truncate a GCS snapshot's
+  payload mid-write under a full-length header — restore must detect
+  the tear by CRC and fall back to the previous good snapshot + WAL
+- ``gcs.torn_wal``      head persistence: write a WAL record's payload
+  short under a full-length header (the SIGKILL-mid-append shape) —
+  restart must truncate the torn tail and replay everything before it
 - ``heartbeat.skip``  node agent: skip one heartbeat period
 - ``daemon.die``      node agent: SIGKILL its own daemon process
 - ``lease.expire``    same-host LeaseTable: expire a lease early
@@ -65,6 +79,10 @@ class ChaosController:
         self._rates = dict(rates)
         self._lock = threading.Lock()
         self.injected: dict[str, int] = {}
+        # net.partition windows: destination address -> heal time
+        # (monotonic). While a window is open EVERY send to that
+        # destination fails; expiry heals the link in place.
+        self._partitions: dict[str, float] = {}
 
     def should(self, site: str) -> bool:
         """One seeded draw for ``site``; True means the caller must
@@ -105,6 +123,38 @@ class ChaosController:
                 else:
                     tracing.instant(f"chaos:{site}", {"seed": self.seed})
         return fire
+
+    def partitioned(self, dest: str) -> bool:
+        """Is a partition window currently open toward ``dest``?
+        Expired windows heal (and are forgotten) here."""
+        import time
+
+        with self._lock:
+            heal = self._partitions.get(dest)
+            if heal is None:
+                return False
+            if time.monotonic() >= heal:
+                del self._partitions[dest]
+                return False
+            return True
+
+    def maybe_partition(self, dest: str) -> bool:
+        """One seeded ``net.partition`` draw for a send toward
+        ``dest``; a fire opens the sustained window. Destinations not
+        matching ``RAY_TPU_PARTITION_TARGET`` (when set) never draw —
+        the RNG stream stays deterministic for the links under test."""
+        import time
+
+        target = os.environ.get("RAY_TPU_PARTITION_TARGET", "")
+        if target and target not in dest:
+            return False
+        if not self.should("net.partition"):
+            return False
+        base = float(os.environ.get("RAY_TPU_PARTITION_S", "2.0"))
+        duration = base * (0.5 + self.uniform())
+        with self._lock:
+            self._partitions[dest] = time.monotonic() + duration
+        return True
 
     def uniform(self) -> float:
         """A seeded draw in [0, 1) for sites that need a magnitude

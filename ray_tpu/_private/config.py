@@ -234,6 +234,32 @@ _DEFAULTS: dict[str, Any] = {
     "node_amnesia_max_passes": 5,
     # Head control plane.
     "gcs_heartbeat_timeout_s": 10.0,   # node declared dead after this
+    # Durable control plane (gcs_persistence.py): the head persists
+    # its FULL hot set — KV, jobs, node table, actor registry, object
+    # directory incl. spilled marks, placement groups — as a
+    # checksummed snapshot plus a length+CRC32-framed WAL between
+    # snapshots, with torn-tail truncation and seq-gated replay on
+    # restart. Disarmed (gcs_persistence=0) the head keeps the legacy
+    # {kv, jobs} raw-pickle snapshot byte-identically and mints no
+    # epoch.
+    "gcs_persistence": True,
+    # Full-snapshot cadence while armed; between snapshots every
+    # mutation is WAL-durable, so this bounds restart replay length,
+    # not durability.
+    "gcs_snapshot_interval_s": 30.0,
+    # WAL size that forces an early snapshot + rotate.
+    "gcs_wal_max_mb": 64,
+    # fsync each WAL append / snapshot (durability vs latency; the
+    # default flushes to the OS only — a head SIGKILL loses nothing,
+    # a host power cut may lose the tail).
+    "gcs_wal_fsync": False,
+    # Epoch fencing (requires gcs_persistence): the head mints a
+    # persisted incarnation number each start; every RPC reply and
+    # heartbeat carries it, stale-epoch writes are rejected typed
+    # (StaleEpochError, retryable after re-sync) so a partitioned
+    # daemon or lingering old head can never double-register a node,
+    # resurrect a dead actor, or corrupt the object directory.
+    "gcs_epoch_fencing": True,
     # Worker pipe transport.
     "worker_inline_result_kb": 64,     # pool results <= this inline
     # Distributed tracing plane (util/tracing.py). Disabled, every

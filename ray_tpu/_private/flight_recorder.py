@@ -28,11 +28,16 @@ debug`` collects the files and every reachable process's LIVE ring
 into one bundle.
 
 Record sites: chaos firings, breaker opens (rpc.py), worker crashes,
-node death, object loss, heartbeat re-registration, daemon stop, and
-the spill tier's lifecycle (``spill.spill`` / ``spill.restore`` /
+node death, object loss, heartbeat re-registration, daemon stop, the
+spill tier's lifecycle (``spill.spill`` / ``spill.restore`` /
 ``spill.evict`` / ``spill.torn`` / ``spill.disk_full`` /
-``spill.orphan_sweep`` — spill_manager.py), so a post-mortem shows
-what the disk tier was doing when the process died.
+``spill.orphan_sweep`` — spill_manager.py), and the durable control
+plane (``gcs.restore`` / ``gcs.torn_snapshot`` / ``gcs.persist_error``
+/ ``gcs.fenced_write`` head-side; ``epoch.bump`` /
+``heartbeat.stale_epoch`` / ``gcs.stale_epoch`` on daemons and
+drivers re-syncing across a head restart), so a post-mortem shows
+what the disk tier and the head's recovery were doing when the
+process died.
 """
 
 from __future__ import annotations
@@ -67,10 +72,19 @@ class FlightRecorder:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         if flush_period_s and flush_period_s > 0:
-            self._thread = threading.Thread(
-                target=self._flush_loop, args=(float(flush_period_s),),
-                daemon=True, name="flight-recorder")
-            self._thread.start()
+            self.arm_flush(float(flush_period_s))
+
+    def arm_flush(self, period_s: float) -> None:
+        """Start (idempotently) the flusher thread — lets a process
+        install the recorder EARLY (so boot-time events like the GCS
+        restore land in the ring) and arm persistence once the rest of
+        the daemon is up."""
+        if self._thread is not None or period_s <= 0:
+            return
+        self._thread = threading.Thread(
+            target=self._flush_loop, args=(float(period_s),),
+            daemon=True, name="flight-recorder")
+        self._thread.start()
 
     # ------------------------------------------------------------- hot path
 
@@ -139,12 +153,21 @@ def install(role: str, flush: bool = False, extra_fn=None
             ) -> FlightRecorder:
     """Install the process-wide recorder (idempotent per process —
     a re-init keeps the existing ring so events survive driver
-    shutdown/init cycles within one process)."""
+    shutdown/init cycles within one process). A re-install UPGRADES in
+    place: the head installs a bare ring before the GCS restore (so
+    recovery events are captured) and the later daemon install arms
+    flushing + dump enrichment without losing those events."""
     global _REC
-    if _REC is not None:
-        return _REC
     from ray_tpu._private.config import GLOBAL_CONFIG
 
+    if _REC is not None:
+        if extra_fn is not None and _REC._extra_fn is None:
+            _REC._extra_fn = extra_fn
+        if flush and _REC._thread is None:
+            _prune_stale_dumps()
+            _REC.arm_flush(float(
+                GLOBAL_CONFIG.flight_recorder_flush_s or 0.0))
+        return _REC
     capacity = int(GLOBAL_CONFIG.flight_recorder_events or 512)
     period = float(GLOBAL_CONFIG.flight_recorder_flush_s or 0.0) \
         if flush else 0.0

@@ -23,6 +23,28 @@ from typing import Any, Callable
 from ray_tpu._private.ids import ActorID, JobID, NodeID, TaskID
 
 
+class StaleEpochError(Exception):
+    """A control-plane WRITE carried an epoch stamp from a previous
+    head incarnation: the writer is a lingering old head's client or a
+    daemon/driver partitioned across a head restart. The write was
+    REJECTED — retryable after the caller re-syncs (re-registers /
+    re-publishes under the current epoch). Carries the head's current
+    epoch so the caller can converge without another round trip."""
+
+    def __init__(self, current_epoch: int, stale_epoch: int | None = None):
+        super().__init__(
+            f"stale epoch {stale_epoch} (head is at epoch "
+            f"{current_epoch}); re-sync and retry")
+        self.current_epoch = current_epoch
+        self.stale_epoch = stale_epoch
+
+    def __reduce__(self):
+        # Default Exception reduce re-calls __init__ with the formatted
+        # message; this error crosses the RPC pickle boundary and must
+        # round-trip its epoch payload.
+        return (StaleEpochError, (self.current_epoch, self.stale_epoch))
+
+
 class KVStore:
     """Namespaced key-value store (reference: gcs_kv_manager.h)."""
 
@@ -93,6 +115,47 @@ class ObjectDirectory:
         # with the holder (the disk dies with the node).
         self._spilled: dict[str, dict[str, str]] = {}
         self._seen: dict[str, float] = {}
+        # Persist-relevant change counter (the head's snapshot dirty
+        # check) + optional WAL emit hook: every durable mutation
+        # appends its op while the table lock is held, so WAL order
+        # matches application order. TTL pruning deliberately bumps
+        # neither — it re-derives on restore from the reset lease
+        # clocks.
+        self.version = 0
+        self.wal_emit = None
+
+    def _mutated(self, op) -> None:
+        # Caller holds self._lock.
+        self.version += 1
+        if self.wal_emit is not None:
+            self.wal_emit(op)
+
+    def snapshot_state(self) -> dict:
+        """Plain-data view for the head snapshot (holder sets become
+        sorted lists — deterministic bytes on disk)."""
+        with self._lock:
+            return {
+                "locations": {
+                    owner: {obj: sorted(nodes)
+                            for obj, nodes in table.items()}
+                    for owner, table in self._locations.items()},
+                "spilled": {owner: dict(table)
+                            for owner, table in self._spilled.items()},
+            }
+
+    def restore_state(self, state: dict) -> None:
+        """Rehydrate from a snapshot. Owner leases restart NOW: live
+        owners re-publish within their keepalive period and dead
+        owners' entries age out through the normal TTL prune."""
+        now = time.monotonic()
+        with self._lock:
+            for owner, table in (state.get("locations") or {}).items():
+                dst = self._locations.setdefault(owner, {})
+                for obj_hex, nodes in table.items():
+                    dst.setdefault(obj_hex, set()).update(nodes)
+                self._seen[owner] = now
+            for owner, table in (state.get("spilled") or {}).items():
+                self._spilled.setdefault(owner, {}).update(table)
 
     def update(self, owner: str, adds: list, removes: list) -> int:
         """Apply one owner's batched deltas; an empty update is a
@@ -116,6 +179,9 @@ class ObjectDirectory:
                 self._locations.pop(owner, None)
             if spilled is not None and not spilled:
                 self._spilled.pop(owner, None)
+            if adds or removes:
+                self._mutated(("dir_update", owner, list(adds),
+                               list(removes)))
             return len(table)
 
     def mark_spilled(self, owner: str, obj_hex: str,
@@ -139,6 +205,7 @@ class ObjectDirectory:
                     bucket = loc_owner
                     break
             self._spilled.setdefault(bucket, {})[obj_hex] = node_hex
+            self._mutated(("dir_spill", bucket, obj_hex, node_hex))
 
     def clear_spilled(self, owner: str, obj_hex: str) -> None:
         """The holder restored its copy into memory: the node is a
@@ -151,6 +218,7 @@ class ObjectDirectory:
                 spilled.pop(obj_hex, None)
                 if not spilled:
                     self._spilled.pop(bucket, None)
+                self._mutated(("dir_unspill", bucket, obj_hex))
 
     def spilled(self, owner: str | None = None) -> dict:
         """{object hex -> spilled-holder node hex}, one owner or all."""
@@ -227,6 +295,7 @@ class ObjectDirectory:
                     del spilled[obj_hex]
                 if not spilled:
                     self._spilled.pop(owner, None)
+            self._mutated(("dir_prune_node", node_hex))
         return orphaned
 
 
@@ -354,6 +423,13 @@ class GlobalControlService:
         self._named_actors: dict[tuple[str, str], ActorID] = {}
         self._nodes: dict[NodeID, NodeRecord] = {}
         self._jobs: dict[JobID, JobRecord] = {}
+        # Per-table persist-relevant change counters (the head's
+        # snapshot dirty check — heartbeat liveness refreshes bump
+        # nothing, actor/node/job MUTATIONS do) + optional WAL emit
+        # hook, called with the op while the table lock is held so WAL
+        # order matches application order.
+        self.table_versions = {"actors": 0, "nodes": 0, "jobs": 0}
+        self.wal_emit = None
         self._task_events: dict[TaskID, TaskEvent] = {}
         self._task_event_limit = 100_000
         # Events silently refused at the cap used to vanish untraceably;
@@ -368,6 +444,166 @@ class GlobalControlService:
         # daemon's last report out of the load-aware scheduler's view.
         self._node_stats: dict[str, tuple] = {}
         self._node_stats_lock = threading.Lock()
+
+    # ----------------------------------------------------------- persistence
+
+    def _mutated(self, table: str, op) -> None:
+        # Caller holds self._lock.
+        self.table_versions[table] += 1
+        if self.wal_emit is not None:
+            self.wal_emit(op)
+
+    @staticmethod
+    def _actor_plain(record: ActorRecord) -> dict:
+        """Persistable fields only: the live ``handle`` (an executor
+        object) and ``placement_hint`` never cross a restart."""
+        return {
+            "actor_id": record.actor_id.binary(), "name": record.name,
+            "namespace": record.namespace,
+            "class_name": record.class_name, "state": record.state,
+            "max_restarts": record.max_restarts,
+            "num_restarts": record.num_restarts,
+            "death_cause": record.death_cause,
+            "node_id_hex": record.node_id_hex, "pid": record.pid,
+            "method_meta": dict(record.method_meta),
+            "default_deadline_s": record.default_deadline_s,
+        }
+
+    @staticmethod
+    def _actor_from_plain(plain: dict) -> ActorRecord:
+        return ActorRecord(
+            actor_id=ActorID(plain["actor_id"]),
+            name=plain.get("name"),
+            namespace=plain.get("namespace", "default"),
+            class_name=plain.get("class_name", ""),
+            state=plain.get("state", "PENDING"),
+            max_restarts=int(plain.get("max_restarts", 0)),
+            num_restarts=int(plain.get("num_restarts", 0)),
+            death_cause=plain.get("death_cause"),
+            node_id_hex=plain.get("node_id_hex", ""),
+            pid=plain.get("pid"),
+            method_meta=dict(plain.get("method_meta") or {}),
+            default_deadline_s=float(
+                plain.get("default_deadline_s", 0.0)))
+
+    @staticmethod
+    def _node_plain(record: NodeRecord) -> dict:
+        return {
+            "node_id": record.node_id.binary(),
+            "address": record.address,
+            "resources": dict(record.resources),
+            "labels": dict(record.labels),
+            "executor_address": record.executor_address,
+            "host_id": record.host_id, "alive": record.alive,
+            "available": dict(record.available),
+        }
+
+    @staticmethod
+    def _job_plain(record: JobRecord) -> dict:
+        return {
+            "job_id": record.job_id.binary(),
+            "start_time": record.start_time,
+            "end_time": record.end_time, "status": record.status,
+            "entrypoint": record.entrypoint, "message": record.message,
+            "submission_id": record.submission_id,
+        }
+
+    def control_snapshot(self) -> dict:
+        """Plain-data dump of the persisted tables (KV rides its own
+        ``snapshot()``; task events are observability, deliberately
+        not persisted)."""
+        with self._lock:
+            return {
+                "actors": [self._actor_plain(r)
+                           for r in self._actors.values()],
+                "nodes": [self._node_plain(r)
+                          for r in self._nodes.values()],
+                "jobs": [self._job_plain(r)
+                         for r in self._jobs.values()],
+            }
+
+    def restore_control(self, state: dict) -> None:
+        """Rehydrate actors/nodes/jobs from a snapshot (or replay one
+        WAL upsert via apply_op). No pubsub is published during
+        restore — subscribers reconnect after the server starts."""
+        for plain in state.get("actors", []):
+            self.apply_op(("actor", plain))
+        for plain in state.get("nodes", []):
+            self.apply_op(("node", plain))
+        for plain in state.get("jobs", []):
+            self.apply_op(("job", plain))
+
+    def apply_op(self, op: tuple) -> None:
+        """Apply one WAL record. Ops are state-bearing upserts (full
+        record values, absolute counters), so re-applying a record the
+        snapshot already covers is harmless — the property that makes
+        replay effects-exactly-once under the snapshot/rotate race."""
+        kind = op[0]
+        if kind == "actor":
+            plain = op[1]
+            record = self._actor_from_plain(plain)
+            with self._lock:
+                self._actors[record.actor_id] = record
+                if record.name is not None:
+                    key = (record.namespace, record.name)
+                    if record.state == "DEAD":
+                        if self._named_actors.get(key) == record.actor_id:
+                            self._named_actors.pop(key, None)
+                    else:
+                        self._named_actors[key] = record.actor_id
+        elif kind == "node":
+            plain = op[1]
+            record = NodeRecord(
+                node_id=NodeID(plain["node_id"]),
+                address=plain.get("address", ""),
+                resources=dict(plain.get("resources") or {}),
+                labels=dict(plain.get("labels") or {}),
+                executor_address=plain.get("executor_address", ""),
+                host_id=plain.get("host_id", ""),
+                alive=bool(plain.get("alive", True)),
+                available=dict(plain.get("available") or {}))
+            # last_heartbeat restarts NOW: restored-alive nodes get a
+            # full timeout window to re-heartbeat before the monitor
+            # declares them dead (their daemons may have survived the
+            # head outage).
+            with self._lock:
+                self._nodes[record.node_id] = record
+        elif kind == "job":
+            plain = op[1]
+            with self._lock:
+                self._jobs[JobID(plain["job_id"])] = JobRecord(
+                    job_id=JobID(plain["job_id"]),
+                    start_time=plain.get("start_time", 0.0),
+                    end_time=plain.get("end_time"),
+                    status=plain.get("status", "RUNNING"),
+                    entrypoint=plain.get("entrypoint", ""),
+                    message=plain.get("message", ""),
+                    submission_id=plain.get("submission_id", ""))
+
+    def upsert_actor_mirror(self, plain: dict) -> bool:
+        """Head-side upsert of a driver-published actor record (the
+        cluster actor registry the snapshot persists). The death
+        verdict FENCE lives here: once the head saw DEAD, no publish —
+        from any epoch — resurrects the record to a live state
+        (reference: the GCS actor table never revives a destroyed
+        actor; recovery creates a NEW actor id). Returns False when
+        the fence refused the transition."""
+        record = self._actor_from_plain(plain)
+        with self._lock:
+            existing = self._actors.get(record.actor_id)
+            if existing is not None and existing.state == "DEAD" \
+                    and record.state != "DEAD":
+                return False
+            self._actors[record.actor_id] = record
+            if record.name is not None:
+                key = (record.namespace, record.name)
+                if record.state == "DEAD":
+                    if self._named_actors.get(key) == record.actor_id:
+                        self._named_actors.pop(key, None)
+                else:
+                    self._named_actors[key] = record.actor_id
+            self._mutated("actors", ("actor", self._actor_plain(record)))
+        return True
 
     # ---------------------------------------------------------------- actors
 
@@ -384,6 +620,7 @@ class GlobalControlService:
                             f"in namespace {record.namespace!r}")
                 self._named_actors[key] = record.actor_id
             self._actors[record.actor_id] = record
+            self._mutated("actors", ("actor", self._actor_plain(record)))
         self.pubsub.publish("actors", ("REGISTERED", record.actor_id))
 
     def update_actor_state(self, actor_id: ActorID, state: str,
@@ -395,6 +632,7 @@ class GlobalControlService:
             record.state = state
             if death_cause is not None:
                 record.death_cause = death_cause
+            self._mutated("actors", ("actor", self._actor_plain(record)))
         self.pubsub.publish("actors", (state, actor_id))
 
     def get_actor(self, actor_id: ActorID) -> ActorRecord | None:
@@ -420,6 +658,7 @@ class GlobalControlService:
             record.death_cause = reason
             if record.name is not None:
                 self._named_actors.pop((record.namespace, record.name), None)
+            self._mutated("actors", ("actor", self._actor_plain(record)))
         self.pubsub.publish("actors", ("DEAD", actor_id))
 
     def list_actors(self) -> list[ActorRecord]:
@@ -431,6 +670,7 @@ class GlobalControlService:
     def register_node(self, record: NodeRecord) -> None:
         with self._lock:
             self._nodes[record.node_id] = record
+            self._mutated("nodes", ("node", self._node_plain(record)))
         self.pubsub.publish("nodes", ("ALIVE", record.node_id))
 
     def get_node(self, node_id: NodeID) -> NodeRecord | None:
@@ -442,6 +682,10 @@ class GlobalControlService:
             record = self._nodes.get(node_id)
             if record is not None:
                 record.alive = False
+                # Death verdicts are durable: a restarted head still
+                # refuses the dead id at re-registration (the daemon
+                # comes back as a fresh node, never a resurrection).
+                self._mutated("nodes", ("node", self._node_plain(record)))
         self.pubsub.publish("nodes", ("DEAD", node_id))
 
     def heartbeat(self, node_id: NodeID,
@@ -468,6 +712,7 @@ class GlobalControlService:
     def register_job(self, record: JobRecord) -> None:
         with self._lock:
             self._jobs[record.job_id] = record
+            self._mutated("jobs", ("job", self._job_plain(record)))
 
     def finish_job(self, job_id: JobID, status: str = "SUCCEEDED") -> None:
         with self._lock:
@@ -475,6 +720,7 @@ class GlobalControlService:
             if record is not None:
                 record.status = status
                 record.end_time = time.time()
+                self._mutated("jobs", ("job", self._job_plain(record)))
 
     def list_jobs(self) -> list[JobRecord]:
         with self._lock:

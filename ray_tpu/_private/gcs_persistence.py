@@ -1,0 +1,265 @@
+"""Durable GCS state — checksummed snapshots + an append-only WAL.
+
+TPU-native analogue of the reference's GCS fault-tolerance storage
+(reference: src/ray/gcs/store_client/redis_store_client.h:33 — the GCS
+keeps its tables in replicated Redis so a restarted gcs_server
+rehydrates). Here the head persists to the session dir with the same
+framing discipline as the spill tier (spill_manager.py "RTS1"): every
+byte that will be read back is length- and CRC32-guarded, so a crash
+can tear a file but can never serve garbage.
+
+Two artifacts, one recovery contract:
+
+- **Snapshot** (``RGS1``): the full control-plane hot set — KV, jobs,
+  node table, actor registry, object directory (incl. spilled-location
+  marks), placement groups — pickled behind a 16-byte
+  magic+length+CRC32 header, written tmp-then-rename with the previous
+  good snapshot rotated to ``<path>.prev``. A torn snapshot (crash or
+  ``gcs.torn_snapshot`` chaos) fails its CRC on restore and the reader
+  falls back to ``.prev`` — reject-don't-crash, never silent garbage.
+- **WAL** (``RGW1``): between snapshots every table mutation appends
+  one ``(seq, op)`` record framed magic+seq+length+CRC32. Records are
+  state-bearing upserts (full record values, absolute counters — never
+  increments), so replay is idempotent; the snapshot stores the seq it
+  covers (``wal_seq``) and restore applies only records with
+  ``seq > wal_seq`` — effects-exactly-once even across the
+  snapshot/rotate race. A torn tail (head SIGKILLed mid-append, or
+  ``gcs.torn_wal`` chaos) is detected by the frame check, truncated in
+  place, and counted — everything before the tear replays.
+
+Rotation: after a snapshot commits, the live WAL rotates to
+``<wal>.prev`` and a fresh one opens. Restore therefore reads: current
+snapshot (else ``.prev`` snapshot), then ``wal.prev`` then ``wal``,
+seq-gated — the torn-snapshot fallback keeps the records that span the
+previous generation.
+
+Disarmed (``gcs_persistence=0``), none of this is constructed and the
+head keeps its legacy ``{kv, jobs}`` raw-pickle snapshot byte-
+identically (gcs_server.py keeps that path verbatim).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import threading
+import zlib
+
+_SNAP_MAGIC = b"RGS1"
+_SNAP_HEADER = struct.Struct("<4sQI")       # magic, payload len, crc32
+_WAL_MAGIC = b"RGW1"
+_WAL_HEADER = struct.Struct("<4sQQI")       # magic, seq, payload len, crc32
+
+
+class TornSnapshotError(Exception):
+    """A snapshot file failed its magic/length/CRC check: the bytes on
+    disk are NOT the control-plane state. The caller must fall back to
+    the previous good snapshot (+ WAL), never load the payload."""
+
+
+class LegacySnapshotError(Exception):
+    """The file predates the framed format (a raw-pickle ``{kv, jobs}``
+    snapshot from a pre-WAL head): the caller may try the legacy
+    loader."""
+
+
+# ----------------------------------------------------------------- snapshots
+
+
+def write_snapshot(path: str, payload: bytes, fsync: bool = False) -> None:
+    """Write ``payload`` behind the RGS1 header, tmp-then-rename, with
+    the previous good snapshot rotated to ``<path>.prev`` first.
+
+    Chaos ``gcs.torn_snapshot`` truncates the payload mid-write while
+    the header still promises the full length — the crash-mid-write
+    shape restore must detect and reject. OSErrors propagate: the
+    caller owns the count-and-back-off policy."""
+    from ray_tpu._private import chaos
+
+    torn = (chaos.ACTIVE is not None
+            and chaos.ACTIVE.should("gcs.torn_snapshot"))
+    header = _SNAP_HEADER.pack(_SNAP_MAGIC, len(payload),
+                               zlib.crc32(payload) & 0xFFFFFFFF)
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "wb") as f:
+        f.write(header)
+        f.write(payload[:len(payload) // 2] if torn else payload)
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
+    if os.path.exists(path):
+        # ``.prev`` must stay an always-GOOD fallback: a torn current
+        # (an earlier interrupted write) is discarded, never rotated
+        # over the last good generation — two torn writes in a row
+        # would otherwise leave no loadable snapshot at all.
+        try:
+            read_snapshot(path)
+        except LegacySnapshotError:
+            os.replace(path, path + ".prev")  # readable, keep it
+        except (TornSnapshotError, OSError):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+        else:
+            os.replace(path, path + ".prev")
+    os.replace(tmp, path)
+
+
+def read_snapshot(path: str) -> bytes:
+    """Read + verify one snapshot file. Raises TornSnapshotError on any
+    length/CRC mismatch, LegacySnapshotError when the magic is absent
+    (pre-WAL raw pickle), OSError when the file is unreadable."""
+    with open(path, "rb") as f:
+        header = f.read(_SNAP_HEADER.size)
+        if len(header) < _SNAP_HEADER.size:
+            raise TornSnapshotError(f"{path}: short header")
+        magic, length, crc = _SNAP_HEADER.unpack(header)
+        if magic != _SNAP_MAGIC:
+            raise LegacySnapshotError(path)
+        payload = f.read(length + 1)
+    if len(payload) != length:
+        raise TornSnapshotError(
+            f"{path}: payload {len(payload)} != header {length}")
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise TornSnapshotError(f"{path}: CRC mismatch")
+    return payload
+
+
+# ----------------------------------------------------------------------- WAL
+
+
+class WalWriter:
+    """Append-only framed WAL. One writer per head; appends are
+    serialized by the caller (the GCS table locks order the records),
+    an internal lock guards the file handle across rotate()."""
+
+    def __init__(self, path: str, fsync: bool = False):
+        self.path = path
+        self.fsync = fsync
+        self._lock = threading.Lock()
+        self._f = open(path, "ab")
+
+    def append(self, seq: int, payload: bytes) -> None:
+        """Frame + append one record; flushes to the OS so a SIGKILL
+        loses at most the in-flight append (the torn tail restore
+        truncates). Chaos ``gcs.torn_wal`` writes a deliberately short
+        payload under a full-length header — the deterministic
+        SIGKILL-mid-append shape."""
+        from ray_tpu._private import chaos
+
+        torn = (chaos.ACTIVE is not None
+                and chaos.ACTIVE.should("gcs.torn_wal"))
+        header = _WAL_HEADER.pack(_WAL_MAGIC, seq, len(payload),
+                                  zlib.crc32(payload) & 0xFFFFFFFF)
+        with self._lock:
+            self._f.write(header)
+            self._f.write(payload[:len(payload) // 2] if torn else payload)
+            self._f.flush()
+            if self.fsync:
+                os.fsync(self._f.fileno())
+
+    def size(self) -> int:
+        with self._lock:
+            try:
+                return self._f.tell()
+            except (OSError, ValueError):
+                return 0
+
+    def rotate(self) -> None:
+        """Close the live WAL, move it to ``<path>.prev`` (replacing
+        the prior generation — its records are covered by the snapshot
+        that just committed), open a fresh one."""
+        with self._lock:
+            self._f.close()
+            os.replace(self.path, self.path + ".prev")
+            self._f = open(self.path, "ab")
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._f.close()
+            except OSError:
+                pass
+
+
+def replay_wal(path: str, min_seq: int, apply_fn) -> dict:
+    """Read ``path`` sequentially, calling ``apply_fn(op)`` for each
+    record whose ``seq > min_seq`` (op = the unpickled payload).
+
+    Any framing violation — short header, bad magic, short payload,
+    CRC mismatch — is a torn tail: the file is truncated in place at
+    the last good record boundary and replay stops (everything before
+    the tear was applied). Returns counters:
+    ``{replayed, skipped, truncated, last_seq}``."""
+    stats = {"replayed": 0, "skipped": 0, "truncated": 0,
+             "last_seq": min_seq}
+    try:
+        f = open(path, "r+b")
+    except OSError:
+        return stats
+    with f:
+        good_end = 0
+        while True:
+            header = f.read(_WAL_HEADER.size)
+            if not header:
+                break  # clean end
+            if len(header) < _WAL_HEADER.size:
+                stats["truncated"] = 1
+                break
+            try:
+                magic, seq, length, crc = _WAL_HEADER.unpack(header)
+            except struct.error:
+                stats["truncated"] = 1
+                break
+            if magic != _WAL_MAGIC:
+                stats["truncated"] = 1
+                break
+            payload = f.read(length)
+            if len(payload) != length \
+                    or zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                stats["truncated"] = 1
+                break
+            try:
+                op = pickle.loads(payload)
+            except Exception:  # noqa: BLE001 — undecodable = torn
+                stats["truncated"] = 1
+                break
+            good_end = f.tell()
+            if seq <= min_seq:
+                stats["skipped"] += 1
+                continue
+            apply_fn(op)
+            stats["replayed"] += 1
+            stats["last_seq"] = max(stats["last_seq"], seq)
+        if stats["truncated"]:
+            try:
+                f.truncate(good_end)
+            except OSError:
+                pass
+    return stats
+
+
+# --------------------------------------------------------------------- epoch
+
+
+def mint_epoch(path: str) -> int:
+    """Read the persisted incarnation number, bump it, persist the bump
+    (tmp+rename) and return it. Every head START gets a fresh epoch —
+    the fencing token a lingering previous incarnation (or a daemon
+    partitioned across the restart) can never present."""
+    prior = 0
+    try:
+        with open(path) as f:
+            prior = int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        prior = 0
+    epoch = prior + 1
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(str(epoch))
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return epoch

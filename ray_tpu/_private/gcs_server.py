@@ -27,6 +27,12 @@ from ray_tpu._private.gcs import (
 from ray_tpu._private.ids import JobID, NodeID
 from ray_tpu._private.rpc import RpcServer
 
+# Persistence-failure back-off window (seconds): after a failed
+# snapshot/WAL write the head stops hammering the disk for this long —
+# the same degrade-don't-die discipline as the spill tier's disk-full
+# back-off. Durability degrades; the control plane never dies.
+_PERSIST_BACKOFF_S = 5.0
+
 
 class JobManager:
     """Head-side job submission (reference:
@@ -191,21 +197,73 @@ class GcsServer:
         self.gcs = GlobalControlService(kv=kv)
         self.jobs = JobManager(self.gcs, os.path.join(log_dir, "jobs"))
         self.heartbeat_timeout_s = heartbeat_timeout_s
-        # Fault tolerance: KV (incl. the cluster actor directory) + job
-        # table snapshot to disk, restored on restart (reference:
-        # store_client/redis_store_client.h:33 — redis-backed GCS FT;
-        # here a file-backed snapshot, same recovery semantics).
+        # Fault tolerance (reference: store_client/
+        # redis_store_client.h:33 — redis-backed GCS FT). Armed
+        # (gcs_persistence=1, the default): the FULL control-plane hot
+        # set — KV, jobs, node table, actor registry, object directory
+        # incl. spilled marks, placement groups — rides a checksummed
+        # snapshot plus a framed WAL (gcs_persistence.py), and the head
+        # mints a persisted incarnation epoch every start that fences
+        # stale writers (StaleEpochError). Disarmed: the legacy
+        # {kv, jobs} raw-pickle snapshot, byte-identical to the
+        # pre-WAL head, no epoch, no fencing.
         self._persist_path = persist_path
-        self._persisted_version = -1
-        if persist_path:
-            self._restore_snapshot()
-        self._server = RpcServer(host, port)
-        self._shutdown = threading.Event()
+        self._persisted_version = None
+        self._persist_armed = bool(persist_path) and bool(
+            GLOBAL_CONFIG.gcs_persistence)
+        self._fencing = self._persist_armed and bool(
+            GLOBAL_CONFIG.gcs_epoch_fencing)
+        self.epoch = 0
+        self._wal = None
+        self._wal_seq = 0
+        self._persist_lock = threading.Lock()
+        self._persist_backoff_until = 0.0
+        self._last_snapshot_at = 0.0
+        self._persist_stats = {
+            "wal_records_written": 0, "wal_records_replayed": 0,
+            "wal_replay_skipped": 0, "snapshots_written": 0,
+            "snapshot_restore_ms": 0.0, "torn_wal_tails": 0,
+            "torn_snapshots": 0, "persist_errors": 0,
+            "fenced_writes": 0,
+        }
         # Cluster object-location directory (multi-holder; pruned when
         # an owner stops refreshing its lease — its driver exited).
+        # Constructed BEFORE restore so the snapshot can rehydrate it.
         from ray_tpu._private.gcs import ObjectDirectory
 
         self.object_directory = ObjectDirectory()
+        # Head-side placement-group mirror: drivers publish their PG
+        # managers' snapshots (pg_update) so the table survives a head
+        # crash with the rest of the hot set.
+        self._pg_table: dict[str, list] = {}
+        self._pg_version = 0
+        self._pg_lock = threading.Lock()
+        if persist_path and self._persist_armed:
+            from ray_tpu._private import gcs_persistence as gp
+
+            self.epoch = gp.mint_epoch(os.path.join(
+                os.path.dirname(persist_path) or ".", "gcs_epoch"))
+            self._restore_full()
+            try:
+                self._wal = gp.WalWriter(
+                    persist_path + ".wal",
+                    fsync=bool(GLOBAL_CONFIG.gcs_wal_fsync))
+            except OSError:
+                self._count_persist_error("wal_open")
+            # Every durable mutation from here on appends its op while
+            # the owning table's lock is held (WAL order == apply
+            # order).
+            self.gcs.wal_emit = self._wal_append
+            self.object_directory.wal_emit = self._wal_append
+        elif persist_path:
+            self._restore_snapshot()
+        self._server = RpcServer(host, port)
+        if self._fencing:
+            # Every reply out of this server carries the incarnation
+            # epoch as reply metadata — daemons and drivers detect a
+            # bump on ANY call and re-register/re-publish.
+            self._server.reply_meta_fn = lambda: {"epoch": self.epoch}
+        self._shutdown = threading.Event()
         # Cross-process channel hub; the head's own membership events
         # bridge onto the "nodes" channel so any cluster process can
         # react by push instead of polling list_nodes.
@@ -234,10 +292,12 @@ class GcsServer:
     def _register_methods(self) -> None:
         s = self._server
         s.register("ping", lambda: "pong")
-        # KV (reference: gcs InternalKV service).
-        s.register("kv_put", self.gcs.kv.put)
+        # KV (reference: gcs InternalKV service). Writes WAL at the
+        # RPC boundary — the one seam that covers the native (C++)
+        # store, whose internals can't emit records.
+        s.register("kv_put", self._kv_put)
         s.register("kv_get", self.gcs.kv.get)
-        s.register("kv_del", self.gcs.kv.delete)
+        s.register("kv_del", self._kv_del)
         s.register("kv_exists", self.gcs.kv.exists)
         s.register("kv_keys", self.gcs.kv.keys)
         # Nodes.
@@ -264,6 +324,18 @@ class GcsServer:
         s.register("object_locations_update",
                    self._object_locations_update)
         s.register("list_object_locations", self._list_object_locations)
+        # Cluster actor registry + placement-group mirror: drivers
+        # publish lifecycle upserts so the head's snapshot covers the
+        # whole hot set (reference: gcs_actor_manager.h /
+        # gcs_placement_group_manager.h own these tables GCS-side).
+        s.register("actor_update", self._actor_update)
+        s.register("list_cluster_actors", self._list_cluster_actors)
+        s.register("pg_update", self._pg_update)
+        s.register("list_cluster_placement_groups",
+                   self._list_cluster_placement_groups)
+        # Epoch fencing + persistence observability.
+        s.register("gcs_epoch", lambda: self.epoch)
+        s.register("gcs_persist_stats", self.persist_stats)
         # Cluster-wide pub/sub channels (reference: the GCS pubsub
         # handler over src/ray/pubsub/publisher.h:307). Polls block, so
         # they dispatch concurrently like task execution does.
@@ -318,7 +390,14 @@ class GcsServer:
     def _heartbeat(self, node_id_bytes: bytes,
                    available: dict | None = None,
                    stats: dict | None = None,
-                   trace: dict | None = None) -> bool:
+                   trace: dict | None = None,
+                   epoch: int | None = None) -> bool:
+        # Fence FIRST: a daemon partitioned across a head restart
+        # presents the old incarnation's epoch — its liveness refresh
+        # (and every piggyback riding it) is rejected typed instead of
+        # silently refreshing a record it no longer owns. It re-syncs
+        # by re-registering, then this call succeeds.
+        self._check_epoch(epoch, "heartbeat")
         # False tells the agent it is unknown/dead and must re-register.
         accepted = self.gcs.heartbeat(NodeID(node_id_bytes), available)
         if accepted and stats is not None:
@@ -396,9 +475,15 @@ class GcsServer:
             return out
 
     def _object_locations_update(self, owner: str, adds: list,
-                                 removes: list) -> int:
+                                 removes: list,
+                                 epoch: int | None = None) -> int:
         """Batched owner-published location deltas; an empty update is a
-        keepalive that refreshes the owner's lease on its entries."""
+        keepalive that refreshes the owner's lease on its entries. A
+        stale-epoch owner (partitioned across a head restart) is
+        rejected typed — it re-syncs and FULL-republishes, so an old
+        incarnation's deltas can never interleave into (and corrupt)
+        the restored directory."""
+        self._check_epoch(epoch, "object_locations_update")
         return self.object_directory.update(owner, adds, removes)
 
     def _list_object_locations(self, owner: str | None = None,
@@ -413,6 +498,139 @@ class GcsServer:
 
     def _prune_object_locations(self, ttl_s: float = 60.0) -> None:
         self.object_directory.prune(ttl_s)
+
+    # -- cluster actor / placement-group mirrors ----------------------
+    def _actor_update(self, records: list, epoch: int | None = None
+                      ) -> int:
+        """Driver-published actor lifecycle upserts (full records,
+        RESTARTING state and num_restarts included). Two fences: a
+        stale-epoch publisher is rejected typed, and a DEAD actor is
+        never resurrected to a live state by any publish — the death
+        verdict stands (upsert_actor_mirror). Returns the applied
+        count."""
+        self._check_epoch(epoch, "actor_update")
+        applied = 0
+        for plain in records:
+            if self.gcs.upsert_actor_mirror(plain):
+                applied += 1
+        return applied
+
+    def _list_cluster_actors(self) -> list[dict]:
+        return [self.gcs._actor_plain(r) for r in self.gcs.list_actors()]
+
+    def _pg_update(self, owner: str, records: list,
+                   epoch: int | None = None) -> int:
+        """Driver-published placement-group snapshot (the whole
+        manager view — PGs are few, deltas aren't worth the
+        bookkeeping). Keyed per owner so two drivers never clobber
+        each other's groups."""
+        self._check_epoch(epoch, "pg_update")
+        with self._pg_lock:
+            self._pg_table[owner] = list(records)
+            self._pg_version += 1
+            if self._wal is not None:
+                self._wal_append(("pg_owner", owner, list(records)))
+        return len(records)
+
+    def _list_cluster_placement_groups(self) -> dict:
+        with self._pg_lock:
+            return {owner: list(records)
+                    for owner, records in self._pg_table.items()}
+
+    # -- epoch fencing ------------------------------------------------
+    def _check_epoch(self, epoch: int | None, site: str) -> None:
+        """Reject a write stamped with a previous incarnation's epoch.
+        ``epoch=None`` (a writer that has not yet learned any epoch —
+        first contact, or a fencing-disarmed cluster) passes: fencing
+        exists to catch writers that KNOW a stale incarnation, not to
+        lock out bootstrapping ones."""
+        if epoch is None or not self._fencing or epoch == self.epoch:
+            return
+        from ray_tpu._private import flight_recorder
+        from ray_tpu._private.gcs import StaleEpochError
+
+        with self._persist_lock:
+            self._persist_stats["fenced_writes"] += 1
+        flight_recorder.record("gcs.fenced_write", site, epoch)
+        raise StaleEpochError(self.epoch, epoch)
+
+    # -- WAL ----------------------------------------------------------
+    def _wal_append(self, op: tuple) -> None:
+        """Append one durable mutation (called from the table mutators
+        with their lock held — WAL order matches apply order). A
+        failed append degrades, never dies: the error is counted, the
+        writer backs off, and the periodic full snapshot re-covers the
+        lost records."""
+        import pickle
+
+        wal = self._wal
+        if wal is None:
+            return
+        now = time.monotonic()
+        with self._persist_lock:
+            if now < self._persist_backoff_until:
+                return
+            self._wal_seq += 1
+            seq = self._wal_seq
+        try:
+            wal.append(seq, pickle.dumps(
+                op, protocol=pickle.HIGHEST_PROTOCOL))
+        except OSError:
+            self._count_persist_error("wal_append")
+            return
+        with self._persist_lock:
+            self._persist_stats["wal_records_written"] += 1
+
+    def _apply_wal_op(self, op: tuple) -> None:
+        kind = op[0]
+        if kind == "kv_put":
+            _, namespace, key, value = op
+            self.gcs.kv.put(key, value, namespace)
+        elif kind == "kv_del":
+            _, namespace, key = op
+            self.gcs.kv.delete(key, namespace)
+        elif kind in ("actor", "node", "job"):
+            self.gcs.apply_op(op)
+        elif kind == "dir_update":
+            _, owner, adds, removes = op
+            self.object_directory.update(owner, adds, removes)
+        elif kind == "dir_spill":
+            _, owner, obj_hex, node_hex = op
+            self.object_directory.mark_spilled(owner, obj_hex, node_hex)
+        elif kind == "dir_unspill":
+            _, owner, obj_hex = op
+            self.object_directory.clear_spilled(owner, obj_hex)
+        elif kind == "dir_prune_node":
+            self.object_directory.prune_node(op[1])
+        elif kind == "pg_owner":
+            _, owner, records = op
+            with self._pg_lock:
+                self._pg_table[owner] = list(records)
+                self._pg_version += 1
+
+    def _count_persist_error(self, where: str) -> None:
+        """Satellite to the old bare ``except OSError: pass``: every
+        persistence failure is counted, flight-recorded, and opens a
+        back-off window (same degrade-don't-die discipline as the
+        spill tier's disk-full path) so a full disk costs durability,
+        not the control plane."""
+        from ray_tpu._private import flight_recorder
+
+        with self._persist_lock:
+            self._persist_stats["persist_errors"] += 1
+            self._persist_backoff_until = (
+                time.monotonic() + _PERSIST_BACKOFF_S)
+        flight_recorder.record("gcs.persist_error", where)
+
+    def persist_stats(self) -> dict:
+        """Counters + live epoch, served over RPC (drivers fold them
+        into /metrics as the ray_tpu_gcs_* families)."""
+        with self._persist_lock:
+            out = dict(self._persist_stats)
+        out["epoch"] = self.epoch
+        out["armed"] = self._persist_armed
+        out["fencing"] = self._fencing
+        return out
 
     def _cluster_resources(self) -> dict:
         total: dict[str, float] = {}
@@ -453,12 +671,172 @@ class GcsServer:
             self._prune_object_locations()
             self.pubsub.prune()
             if self._persist_path:
-                self._save_snapshot()
+                self._persist_tick()
 
     # -- persistence --------------------------------------------------
-    def _save_snapshot(self) -> None:
+    def _kv_put(self, key: bytes, value: bytes,
+                namespace: str = "default",
+                overwrite: bool = True) -> bool:
+        ok = self.gcs.kv.put(key, value, namespace, overwrite)
+        if ok and self._wal is not None:
+            self._wal_append(("kv_put", namespace, key, value))
+        return ok
+
+    def _kv_del(self, key: bytes, namespace: str = "default") -> bool:
+        existed = self.gcs.kv.delete(key, namespace)
+        if existed and self._wal is not None:
+            self._wal_append(("kv_del", namespace, key))
+        return existed
+
+    def _dirty_version(self):
+        """Per-table change counters (satellite to the old
+        kv.version + job-status tuple, which never saw actor/node/PG
+        mutations). JobManager mutates some record fields in place, so
+        the job-status tuple stays in the mix."""
+        with self._pg_lock:
+            pg_version = self._pg_version
+        return (self.gcs.kv.version, dict(self.gcs.table_versions),
+                self.object_directory.version, pg_version,
+                tuple(sorted((r.submission_id, r.status, r.message)
+                             for r in self.gcs.list_jobs())))
+
+    def _persist_tick(self, force: bool = False) -> None:
+        """Monitor-tick persistence. Armed: mutations are already
+        durable in the WAL, so the FULL snapshot lands only every
+        ``gcs_snapshot_interval_s``, when the WAL outgrows
+        ``gcs_wal_max_mb``, or at shutdown — then the WAL rotates.
+        Disarmed: the legacy dirty-check {kv, jobs} snapshot, every
+        tick, byte-identical to the pre-WAL head."""
+        if not self._persist_armed:
+            self._save_snapshot()
+            return
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        now = time.monotonic()
+        with self._persist_lock:
+            if now < self._persist_backoff_until:
+                return
+        wal_over = (self._wal is not None and self._wal.size()
+                    > float(GLOBAL_CONFIG.gcs_wal_max_mb) * 1024 * 1024)
+        interval = float(GLOBAL_CONFIG.gcs_snapshot_interval_s)
+        if not force and not wal_over \
+                and now - self._last_snapshot_at < interval:
+            return
+        version = self._dirty_version()
+        if version == self._persisted_version and not wal_over:
+            self._last_snapshot_at = now
+            return
+        self._save_snapshot_full()
+
+    def _save_snapshot_full(self) -> None:
         import pickle
 
+        from ray_tpu._private import gcs_persistence as gp
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        version = self._dirty_version()
+        # The seq captured BEFORE the table dump: a mutation landing
+        # between capture and dump is both in the snapshot and (seq >
+        # wal_seq) replayed — harmless, ops are idempotent upserts.
+        with self._persist_lock:
+            wal_seq = self._wal_seq
+        with self._pg_lock:
+            pgs = {o: list(r) for o, r in self._pg_table.items()}
+        state = {
+            "format": 2, "wal_seq": wal_seq, "epoch": self.epoch,
+            "kv": self.gcs.kv.snapshot(),
+            **self.gcs.control_snapshot(),
+            "directory": self.object_directory.snapshot_state(),
+            "placement_groups": pgs,
+        }
+        try:
+            gp.write_snapshot(
+                self._persist_path,
+                pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL),
+                fsync=bool(GLOBAL_CONFIG.gcs_wal_fsync))
+            if self._wal is not None:
+                self._wal.rotate()
+        except OSError:
+            self._count_persist_error("snapshot")
+            return
+        self._persisted_version = version
+        self._last_snapshot_at = time.monotonic()
+        with self._persist_lock:
+            self._persist_stats["snapshots_written"] += 1
+
+    def _restore_full(self) -> None:
+        """Crash recovery: newest good snapshot (current, else .prev —
+        reject-don't-crash on a torn one), then WAL replay with
+        seq-gating and torn-tail truncation. Counted + flight-recorded
+        so head recovery is observable, not hoped-for."""
+        import pickle
+
+        from ray_tpu._private import flight_recorder
+        from ray_tpu._private import gcs_persistence as gp
+
+        t0 = time.perf_counter()
+        state = None
+        for path in (self._persist_path, self._persist_path + ".prev"):
+            try:
+                state = pickle.loads(gp.read_snapshot(path))
+                break
+            except gp.TornSnapshotError:
+                with self._persist_lock:
+                    self._persist_stats["torn_snapshots"] += 1
+                flight_recorder.record("gcs.torn_snapshot", path)
+            except gp.LegacySnapshotError:
+                # Pre-WAL head's raw-pickle {kv, jobs} file: load it
+                # through the legacy path, then persist forward in the
+                # framed format.
+                self._restore_snapshot()
+                return
+            except (OSError, EOFError, pickle.UnpicklingError):
+                continue
+        base_seq = 0
+        if state is not None:
+            base_seq = int(state.get("wal_seq", 0))
+            self.gcs.kv.restore(state.get("kv", {}))
+            self.gcs.restore_control(state)
+            self.object_directory.restore_state(
+                state.get("directory") or {})
+            with self._pg_lock:
+                self._pg_table.update(
+                    state.get("placement_groups") or {})
+        replayed = skipped = torn = 0
+        last_seq = base_seq
+        for wal_path in (self._persist_path + ".wal.prev",
+                         self._persist_path + ".wal"):
+            stats = gp.replay_wal(wal_path, base_seq, self._apply_wal_op)
+            replayed += stats["replayed"]
+            skipped += stats["skipped"]
+            torn += stats["truncated"]
+            last_seq = max(last_seq, stats["last_seq"])
+        self._wal_seq = last_seq
+        # Restored RUNNING jobs: their entrypoint processes died with
+        # the old head (legacy-restore semantics, kept).
+        for record in self.gcs.list_jobs():
+            if record.status == "RUNNING":
+                self.gcs.finish_job(record.job_id, status="FAILED")
+        restore_ms = (time.perf_counter() - t0) * 1000.0
+        with self._persist_lock:
+            self._persist_stats["wal_records_replayed"] += replayed
+            self._persist_stats["wal_replay_skipped"] += skipped
+            self._persist_stats["torn_wal_tails"] += torn
+            self._persist_stats["snapshot_restore_ms"] = round(
+                restore_ms, 3)
+        if state is not None or replayed:
+            flight_recorder.record(
+                "gcs.restore", replayed, round(restore_ms, 1))
+
+    def _save_snapshot(self) -> None:
+        """Legacy (gcs_persistence=0) snapshot: {kv, jobs} raw pickle,
+        byte-identical to the pre-WAL head — except the old bare
+        ``except OSError: pass`` now counts, flight-records and backs
+        off (degrade-don't-die, same discipline as spill disk-full)."""
+        import pickle
+
+        if time.monotonic() < self._persist_backoff_until:
+            return
         version = (self.gcs.kv.version,
                    tuple(sorted((r.submission_id, r.status)
                                 for r in self.gcs.list_jobs())))
@@ -480,7 +858,7 @@ class GcsServer:
             os.replace(tmp, self._persist_path)  # atomic swap
             self._persisted_version = version
         except OSError:
-            pass  # disk hiccup: retry next tick
+            self._count_persist_error("snapshot_legacy")
 
     def _restore_snapshot(self) -> None:
         import pickle
@@ -507,5 +885,7 @@ class GcsServer:
         if self._persist_path:
             # Final snapshot: mutations from the last monitor tick must
             # survive a clean shutdown.
-            self._save_snapshot()
+            self._persist_tick(force=True)
+        if self._wal is not None:
+            self._wal.close()
         self._server.stop()

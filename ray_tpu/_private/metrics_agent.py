@@ -139,6 +139,35 @@ def install_runtime_collectors(runtime):
         # them into its scrape as labeled series — replacing the old
         # driver-only view (reference: per-node metrics agents all
         # scraped under one job in the reference deployment).
+        # Durable control plane (connected mode): the head's
+        # persistence counters + live incarnation epoch, fetched from
+        # the GCS with a short cache so head recovery is observable
+        # from any driver's scrape. Absent entirely for local-only
+        # runtimes (no head to ask).
+        gcs_persist = None
+        try:
+            gcs_persist = runtime.gcs_persist_stats()
+        except Exception:  # noqa: BLE001 — partial runtime teardown
+            gcs_persist = None
+        if gcs_persist:
+            lines.append("# TYPE ray_tpu_gcs_epoch gauge")
+            lines.append(
+                f"ray_tpu_gcs_epoch {gcs_persist.get('epoch', 0)}")
+            lines.append(
+                "# TYPE ray_tpu_gcs_snapshot_restore_ms gauge")
+            lines.append(
+                f"ray_tpu_gcs_snapshot_restore_ms "
+                f"{gcs_persist.get('snapshot_restore_ms', 0)}")
+            lines.append("# TYPE ray_tpu_gcs_persist_total counter")
+            for key in ("wal_records_written", "wal_records_replayed",
+                        "wal_replay_skipped", "snapshots_written",
+                        "torn_wal_tails", "torn_snapshots",
+                        "persist_errors", "fenced_writes"):
+                lines.append(
+                    f'ray_tpu_gcs_persist_total'
+                    f'{{kind="{_escape_label(key)}"}} '
+                    f'{gcs_persist.get(key, 0)}')
+
         by_node = _node_stats_table(runtime)
         lines.extend(_node_stat_lines(by_node))
         lines.extend(_sched_node_lines(by_node))

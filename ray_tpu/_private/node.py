@@ -95,6 +95,17 @@ class NodeAgent:
         self.executor_address = executor_address
         self._address = f"{_own_address()}:{os.getpid()}"
         self.node_id: bytes = b""
+        # Epoch fencing: the head stamps its incarnation number on
+        # every reply (rpc reply metadata). ``gcs_epoch`` is the epoch
+        # this agent REGISTERED under and stamps on its heartbeats;
+        # ``_seen_epoch`` is the latest observed — a mismatch means
+        # the head restarted and this agent must re-register before
+        # its writes are accepted again (StaleEpochError fences them
+        # meanwhile). None end to end against a fencing-disarmed head.
+        self.gcs_epoch: "int | None" = None
+        self._seen_epoch: "int | None" = None
+        self._epoch_stale = threading.Event()
+        self.client.on_reply_meta = self._on_reply_meta
         self.node_id = self._register()
         self._shutdown = threading.Event()
         self._poke = threading.Event()
@@ -112,11 +123,34 @@ class NodeAgent:
         # a death verdict.
         from ray_tpu._private.same_host import host_identity
 
-        return call_with_retry(
+        node_id = call_with_retry(
             self.client.call,
             "register_node", self._address, self.resources, self.labels,
             self.executor_address, prior_id=self.node_id or None,
             host_id=host_identity())
+        # The register reply's metadata carried the head's current
+        # epoch (observed by _on_reply_meta before the call resolved):
+        # registration IS the re-sync, subsequent writes stamp it.
+        self.gcs_epoch = self._seen_epoch
+        self._epoch_stale.clear()
+        return node_id
+
+    def _on_reply_meta(self, meta: dict) -> None:
+        """Reader-thread observer for the head's reply metadata: an
+        epoch differing from the one we registered under means the
+        head restarted — wake the loop to re-register (its next
+        stamped write would be fenced anyway)."""
+        epoch = meta.get("epoch") if isinstance(meta, dict) else None
+        if not isinstance(epoch, int):
+            return
+        self._seen_epoch = epoch
+        if self.gcs_epoch is not None and epoch != self.gcs_epoch \
+                and not self._epoch_stale.is_set():
+            from ray_tpu._private import flight_recorder
+
+            flight_recorder.record("epoch.bump", self.gcs_epoch, epoch)
+            self._epoch_stale.set()
+            self._poke.set()
 
     def poke(self) -> None:
         """Load changed: push a heartbeat now (coalesced)."""
@@ -170,13 +204,19 @@ class NodeAgent:
                 if spans:
                     trace = {"spans": spans, "now": time.time()}
             try:
+                if self._epoch_stale.is_set():
+                    # The head restarted under us (epoch bump seen on
+                    # a reply): re-register BEFORE the next stamped
+                    # write — the fence would reject it anyway.
+                    self.node_id = self._register()
                 # Heartbeats are idempotent: ride the shared retry
                 # policy with a short per-try timeout so one dropped
                 # frame costs a retry, not a liveness-timeout stall.
                 accepted = call_with_retry(
                     self.client.call, "heartbeat", self.node_id,
                     available, stats, trace, attempts=2,
-                    timeout_s=max(3.0, self.heartbeat_period_s * 3))
+                    timeout_s=max(3.0, self.heartbeat_period_s * 3),
+                    epoch=self.gcs_epoch)
                 if not accepted:
                     # Unknown/dead at the head (stall past the timeout
                     # or a head restart): re-register, asking to keep
@@ -189,7 +229,24 @@ class NodeAgent:
                     self.node_id = self._register()
                     flight_recorder.record("re-registered",
                                            self.node_id.hex()[:16])
-            except (RpcError, RpcMethodError, OSError):
+            except RpcMethodError as exc:
+                from ray_tpu._private.gcs import StaleEpochError
+
+                if isinstance(exc.cause, StaleEpochError):
+                    # Typed fence: this agent heartbeated with a
+                    # previous incarnation's epoch (partitioned across
+                    # the head restart). Re-sync by re-registering;
+                    # the next beat is accepted.
+                    from ray_tpu._private import flight_recorder
+
+                    flight_recorder.record(
+                        "heartbeat.stale_epoch",
+                        exc.cause.current_epoch)
+                    try:
+                        self.node_id = self._register()
+                    except (RpcError, RpcMethodError, OSError):
+                        pass  # head flapped again; next beat retries
+            except (RpcError, OSError):
                 pass  # head unreachable; keep trying (it may restart)
             # Coalescing floor: pokes landing during the sleep fold
             # into the next push.
@@ -242,6 +299,12 @@ def run_head(port: int, resources: dict | None = None,
 
     os.makedirs(SESSION_DIR, exist_ok=True)
     snapshot_path = os.path.join(SESSION_DIR, "gcs_snapshot.pkl")
+    # Bare ring BEFORE the GCS restores: recovery events (WAL replay,
+    # torn-tail truncation, epoch mint) must land in the head's flight
+    # ring; _install_daemon_recorder upgrades it with flushing later.
+    from ray_tpu._private import flight_recorder
+
+    flight_recorder.install("daemon-head")
     server = GcsServer(port=port, log_dir=SESSION_DIR,
                        persist_path=snapshot_path)
     server.start()
@@ -330,13 +393,17 @@ def run_head(port: int, resources: dict | None = None,
         if dashboard is not None:
             dashboard.stop()
         server.stop()
-        # Clean stop = session over: the snapshot exists for CRASH
-        # recovery only. Leaving it would resurrect stale jobs/actors
-        # into the NEXT, unrelated cluster on this machine.
-        try:
-            os.unlink(snapshot_path)
-        except OSError:
-            pass
+        # Clean stop = session over: the snapshot/WAL exist for CRASH
+        # recovery only. Leaving them would resurrect stale jobs/actors
+        # into the NEXT, unrelated cluster on this machine. The epoch
+        # file deliberately SURVIVES: incarnation numbers are monotonic
+        # per session dir, so a daemon partitioned across sessions can
+        # still never present a current-looking epoch.
+        for suffix in ("", ".prev", ".wal", ".wal.prev"):
+            try:
+                os.unlink(snapshot_path + suffix)
+            except OSError:
+                pass
 
 
 def run_worker(gcs_address: str, resources: dict | None = None,

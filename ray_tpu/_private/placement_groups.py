@@ -52,6 +52,11 @@ class PlacementGroupManager:
         self._store = store
         self._lock = threading.Lock()
         self._groups: dict[PlacementGroupID, PlacementGroupRecord] = {}
+        # Change counter for the connected-mode mirror: the runtime's
+        # watcher re-publishes snapshot() to the head whenever this
+        # moves (create / state transition / remove), making the PG
+        # table part of the head's durable hot set.
+        self.version = 0
 
     def snapshot(self) -> list[dict]:
         """State-API listing of all placement groups."""
@@ -95,6 +100,7 @@ class PlacementGroupManager:
         )
         with self._lock:
             self._groups[record.pg_id] = record
+            self.version += 1
         self._store.create_pending(record.ready_object_id)
         # Reservation runs in the background; ready_object seals on commit.
         threading.Thread(
@@ -117,6 +123,7 @@ class PlacementGroupManager:
                         self._rollback(record)
                         return
                     record.state = "CREATED"
+                    self.version += 1
                 self._store.put(record.ready_object_id, None)
                 return
             time.sleep(0.05)
@@ -213,6 +220,7 @@ class PlacementGroupManager:
                 return
             was_created = record.state == "CREATED"
             record.state = "REMOVED"
+            self.version += 1
         if was_created:
             self._rollback(record)
 

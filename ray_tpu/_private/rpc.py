@@ -462,6 +462,12 @@ class RpcServer:
         self._accept_thread: threading.Thread | None = None
         self._conns: list[socket.socket] = []
         self._conns_lock = threading.Lock()
+        # Optional reply metadata: when set (() -> dict), every plain
+        # "ok" reply is tagged "okm" and carries (meta, result) — the
+        # GCS server rides this to stamp its incarnation epoch on
+        # every reply so clients detect a head restart on ANY call.
+        # None (every other server) keeps replies byte-identical.
+        self.reply_meta_fn: Callable[[], dict] | None = None
 
     @property
     def address(self) -> str:
@@ -669,7 +675,10 @@ class RpcServer:
                 result = fn(*args, **kwargs)
                 if isinstance(result, TailPayload):
                     return self._send_tail(conn, send_lock, seq, result)
-                reply = (seq, "ok", result)
+                if self.reply_meta_fn is not None:
+                    reply = (seq, "okm", (self.reply_meta_fn(), result))
+                else:
+                    reply = (seq, "ok", result)
             except BaseException as exc:  # noqa: BLE001
                 tb = traceback.format_exc()
                 try:
@@ -843,6 +852,11 @@ class MuxRpcClient:
         self._batch_pending: list = []
         self._batch_event = threading.Event()
         self._batch_thread: threading.Thread | None = None
+        # Reply-metadata listener: invoked (reader thread, must be
+        # cheap and non-raising) with the meta dict of every "okm"
+        # reply BEFORE the call's future resolves — epoch observers
+        # see the bump no later than the call result.
+        self.on_reply_meta: Callable[[dict], None] | None = None
 
     def _ensure_conn(self) -> _MuxConn:
         # Caller holds self._lock.
@@ -915,6 +929,16 @@ class MuxRpcClient:
             # (every in-flight call fails like a node death), drop just
             # this frame (the call times out — a lost packet the
             # transport never detects), or delay the send.
+            # net.partition: a SUSTAINED window — while it is open,
+            # every send to this destination dies like a cut link
+            # (in-flight calls fail with it), and the link heals in
+            # place when the seeded window expires.
+            if chaos.ACTIVE.partitioned(self.address) \
+                    or chaos.ACTIVE.maybe_partition(self.address):
+                self._fail_conn(conn, RpcError("chaos: net.partition"))
+                raise RpcError(
+                    f"rpc {method} to {self.address} failed: chaos "
+                    f"net.partition window open")
             if chaos.ACTIVE.should("rpc.sever"):
                 self._fail_conn(conn, RpcError("chaos: severed"))
                 raise RpcError(
@@ -1075,6 +1099,15 @@ class MuxRpcClient:
                     status = "ok"
                     payload = (head, memoryview(frame)[-tail_len:]
                                if tail_len else b"")
+                elif status == "okm":
+                    meta, payload = payload
+                    status = "ok"
+                    cb = self.on_reply_meta
+                    if cb is not None:
+                        try:
+                            cb(meta)
+                        except Exception:  # noqa: BLE001 — observer only
+                            pass
             except Exception as exc:  # noqa: BLE001 — corrupt stream
                 self._fail_conn(conn, exc)
                 return
@@ -1165,6 +1198,9 @@ class RpcClient:
         self._lock = threading.Lock()
         self._sock: socket.socket | None = None
         self._seq = 0
+        # Same reply-metadata hook as MuxRpcClient (invoked on the
+        # calling thread, before the result returns).
+        self.on_reply_meta: Callable[[dict], None] | None = None
 
     def _connect(self) -> socket.socket:
         sock = socket.create_connection(
@@ -1216,6 +1252,14 @@ class RpcClient:
                         status = "ok"
                         payload = (head, memoryview(frame)[-tail_len:]
                                    if tail_len else b"")
+                    elif status == "okm":
+                        meta, payload = payload
+                        status = "ok"
+                        if self.on_reply_meta is not None:
+                            try:
+                                self.on_reply_meta(meta)
+                            except Exception:  # noqa: BLE001
+                                pass
                     if rseq != seq:
                         raise RpcError(
                             f"out-of-order reply: {rseq} != {seq}")

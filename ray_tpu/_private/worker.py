@@ -394,6 +394,7 @@ class Runtime:
             # a per-call lock (reference: gRPC channels multiplex every
             # GCS service call).
             self.gcs_client = MuxRpcClient(address, timeout_s=60.0)
+            self.gcs_client.on_reply_meta = self._on_gcs_reply_meta
             try:
                 self._node_agent = NodeAgent(
                     address,
@@ -408,6 +409,10 @@ class Runtime:
                     f"cannot connect to ray_tpu head at {address}: "
                     f"{exc}") from exc
         self.gcs = GlobalControlService()
+        if self.gcs_client is not None:
+            # Mirror local actor lifecycle to the head's cluster actor
+            # registry (queued here, flushed by the node watcher).
+            self.gcs.pubsub.subscribe("actors", self._queue_actor_mirror)
         self.store = ObjectStore(
             memory_limit_bytes=(object_store_memory
                                 or cfg.object_store_memory_mb * 1024 * 1024),
@@ -594,6 +599,21 @@ class Runtime:
         self._loc_dirty_adds: dict[str, str] = {}
         self._loc_dirty_removes: set[str] = set()
         self._loc_keepalive = 0.0
+        # Epoch fencing (connected mode): the head's incarnation epoch
+        # observed on reply metadata. Stamped on every control-plane
+        # WRITE this driver publishes (locations, actors, PGs); a bump
+        # or a typed StaleEpochError triggers a full re-publish under
+        # the new epoch (_flush_control_mirror / location keepalive).
+        self._gcs_epoch: int | None = None
+        self._epoch_republish = False
+        # Cluster actor-registry mirror: local actor lifecycle events
+        # queue their ids here; the watcher flushes batched
+        # actor_update upserts to the head (whose snapshot+WAL make
+        # the registry durable). PG snapshots publish on version bumps.
+        self._mirror_lock = threading.Lock()
+        self._actor_dirty: set = set()
+        self._pg_published_version = -1
+        self._gcs_persist_cache: tuple = (0.0, None)
         # Remote execution plane state (threads start at the end of
         # __init__, but callbacks may touch these during construction).
         self._remote_nodes: dict[NodeID, Any] = {}
@@ -956,6 +976,7 @@ class Runtime:
                     # alone never trigger it.
                     self._flush_remote_frees()
                     self._flush_object_locations()
+                    self._flush_control_mirror()
                     now = time.monotonic()
                     if scheduler_mod.LOCALITY_ON \
                             and now - self._sched_feed_at >= 2.0:
@@ -2988,6 +3009,27 @@ class Runtime:
         except Exception:  # noqa: BLE001 — best-effort holder view
             pass
 
+    def gcs_persist_stats(self) -> dict | None:
+        """The head's durable-control-plane counters + live epoch
+        (``/metrics`` ray_tpu_gcs_* families), cached a few seconds so
+        scrapes don't turn into head RPC storms. None when there is no
+        head to ask (local-only runtime)."""
+        if self.gcs_client is None:
+            return None
+        now = time.monotonic()
+        fetched_at, cached = self._gcs_persist_cache
+        if cached is not None and now - fetched_at < 5.0:
+            return cached
+        try:
+            stats = self.gcs_client.call("gcs_persist_stats",
+                                         timeout_s=2.0)
+        except Exception:  # noqa: BLE001 — head unreachable: last known
+            return cached
+        if isinstance(stats, dict):
+            self._gcs_persist_cache = (now, stats)
+            return stats
+        return cached
+
     def configure_speculation(self, enabled: bool) -> None:
         """Arm/disarm straggler speculation at runtime (benches A/B
         this; init honors the speculation_enabled knob). The watcher
@@ -3013,6 +3055,96 @@ class Runtime:
             self._loc_dirty_adds[object_id.hex()] = node_id.hex()
             self._loc_dirty_removes.discard(object_id.hex())
 
+    def _on_gcs_reply_meta(self, meta: dict) -> None:
+        """Reader-thread observer for the head's reply metadata: an
+        epoch bump (head restart) schedules a full re-publish of
+        everything this driver owns at the head — locations, actor
+        registry, placement groups — under the new epoch."""
+        epoch = meta.get("epoch") if isinstance(meta, dict) else None
+        if not isinstance(epoch, int):
+            return
+        prior = self._gcs_epoch
+        self._gcs_epoch = epoch
+        if prior is not None and epoch != prior:
+            from ray_tpu._private import flight_recorder
+
+            flight_recorder.record("epoch.bump", prior, epoch)
+            self._epoch_republish = True
+            self._loc_keepalive = 0.0  # next flush full-republishes
+
+    def _handle_stale_epoch(self, exc) -> bool:
+        """True when ``exc`` is the typed stale-epoch fence: re-sync
+        the epoch (the rejecting reply's error carries it) and
+        schedule the full re-publish; the caller requeues its payload
+        and the next flush lands under the current epoch."""
+        from ray_tpu._private.gcs import StaleEpochError
+        from ray_tpu._private.rpc import RpcMethodError
+
+        cause = exc.cause if isinstance(exc, RpcMethodError) else exc
+        if not isinstance(cause, StaleEpochError):
+            return False
+        from ray_tpu._private import flight_recorder
+
+        flight_recorder.record("gcs.stale_epoch", cause.current_epoch)
+        self._gcs_epoch = cause.current_epoch
+        self._epoch_republish = True
+        self._loc_keepalive = 0.0
+        return True
+
+    def _queue_actor_mirror(self, event) -> None:
+        """Local pubsub 'actors' callback (any lifecycle transition —
+        REGISTERED/ALIVE/RESTARTING/DEAD): queue the id for the
+        watcher's batched publish. Must stay cheap — it runs inline
+        with the transition."""
+        try:
+            _state, actor_id = event
+        except (TypeError, ValueError):
+            return
+        with self._mirror_lock:
+            self._actor_dirty.add(actor_id)
+
+    def _flush_control_mirror(self) -> None:
+        """Watcher-beat publish of the driver's control-plane state to
+        the head: dirty actor records (full upserts — RESTARTING state
+        and num_restarts included) and the placement-group snapshot on
+        version bumps. After an epoch bump EVERYTHING re-publishes —
+        the restarted head's snapshot may predate recent transitions,
+        and a stale-epoch rejection proves the head never saw them."""
+        if self.gcs_client is None:
+            return
+        if self._epoch_republish:
+            self._epoch_republish = False
+            with self._mirror_lock:
+                self._actor_dirty.update(
+                    r.actor_id for r in self.gcs.list_actors())
+                self._pg_published_version = -1
+        with self._mirror_lock:
+            dirty, self._actor_dirty = self._actor_dirty, set()
+        records = []
+        for actor_id in dirty:
+            record = self.gcs.get_actor(actor_id)
+            if record is not None:
+                records.append(self.gcs._actor_plain(record))
+        if records:
+            try:
+                self.gcs_client.call(
+                    "actor_update", records, epoch=self._gcs_epoch,
+                    timeout_s=10.0)
+            except Exception as exc:  # noqa: BLE001 — requeue, retry next beat
+                self._handle_stale_epoch(exc)
+                with self._mirror_lock:
+                    self._actor_dirty.update(dirty)
+        pg_version = getattr(self.placement_groups, "version", 0)
+        if pg_version != self._pg_published_version:
+            try:
+                self.gcs_client.call(
+                    "pg_update", self.job_id.hex(),
+                    self.placement_groups.snapshot(),
+                    epoch=self._gcs_epoch, timeout_s=10.0)
+                self._pg_published_version = pg_version
+            except Exception as exc:  # noqa: BLE001 — retry next beat
+                self._handle_stale_epoch(exc)
+
     def _flush_object_locations(self) -> None:
         """Batched publish of location deltas to the head's object-
         location table; an empty update every 10s keeps the owner's
@@ -3037,9 +3169,15 @@ class Runtime:
                         in self._object_locations.items()]
         try:
             self.gcs_client.call("object_locations_update",
-                                 self._export_addr, adds, removes)
+                                 self._export_addr, adds, removes,
+                                 epoch=self._gcs_epoch)
             self._loc_keepalive = now
-        except Exception:  # noqa: BLE001 — head unreachable: requeue
+        except Exception as exc:  # noqa: BLE001 — head unreachable: requeue
+            # Stale-epoch fence: the head restarted and this driver's
+            # deltas were rejected typed so an old incarnation's view
+            # can't corrupt the restored directory. Re-sync + requeue;
+            # the next flush FULL-republishes under the new epoch.
+            self._handle_stale_epoch(exc)
             with self._locations_lock:
                 for obj_hex, node_hex in adds:
                     self._loc_dirty_adds.setdefault(obj_hex, node_hex)

@@ -47,17 +47,61 @@ class Cluster:
 
     def __init__(self, *, initialize_head: bool = True,
                  log_dir: str | None = None,
-                 heartbeat_timeout_s: float = 10.0):
+                 heartbeat_timeout_s: float = 10.0,
+                 persist_path: str | None = None):
         from ray_tpu._private.gcs_server import GcsServer
 
         self._nodes: list[NodeHandle] = []
         self.gcs = None
+        self._log_dir = log_dir or f"/tmp/ray_tpu_cluster_{os.getpid()}"
+        self._heartbeat_timeout_s = heartbeat_timeout_s
+        # Durable head (gcs_persistence): hand a persist_path to arm
+        # snapshot+WAL+epoch — restart_head() then exercises the full
+        # crash-recovery path in-process (chaos soaks ride this).
+        self._persist_path = persist_path
         if initialize_head:
             self.gcs = GcsServer(
                 host="127.0.0.1", port=0,
-                log_dir=log_dir or f"/tmp/ray_tpu_cluster_{os.getpid()}",
-                heartbeat_timeout_s=heartbeat_timeout_s)
+                log_dir=self._log_dir,
+                heartbeat_timeout_s=heartbeat_timeout_s,
+                persist_path=persist_path)
             self.gcs.start()
+
+    def restart_head(self, graceful: bool = False) -> None:
+        """Kill the in-process head and restart it on the SAME port
+        from its persisted state (reference: the GCS-restart test
+        harnesses). ``graceful=False`` is the crash shape: the RPC
+        server dies without a final snapshot — recovery must come from
+        the durable snapshot + WAL alone."""
+        from ray_tpu._private.gcs_server import GcsServer
+
+        if self.gcs is None:
+            raise RuntimeError("cluster has no head")
+        port = self.gcs._server.port
+        if graceful:
+            self.gcs.stop()
+        else:
+            # Crash: tear down the transport + monitor only. No final
+            # snapshot, no WAL close — exactly what SIGKILL leaves.
+            self.gcs._shutdown.set()
+            self.gcs._server.stop()
+        deadline = time.monotonic() + 10
+        last_exc = None
+        while time.monotonic() < deadline:
+            try:
+                self.gcs = GcsServer(
+                    host="127.0.0.1", port=port,
+                    log_dir=self._log_dir,
+                    heartbeat_timeout_s=self._heartbeat_timeout_s,
+                    persist_path=self._persist_path)
+                break
+            except OSError as exc:  # port still in TIME_WAIT
+                last_exc = exc
+                time.sleep(0.2)
+        else:
+            raise RuntimeError(
+                f"head failed to rebind port {port}: {last_exc}")
+        self.gcs.start()
 
     @property
     def address(self) -> str:

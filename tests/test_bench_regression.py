@@ -440,6 +440,48 @@ def test_bench_envelope_records_spill_row():
     assert row["torn_restores"] == 0 and row["disk_full"] == 0
 
 
+def test_bench_envelope_records_recovery_row():
+    """ISSUE 12 acceptance: the recovery row proves a crashed head
+    restored its FULL control plane (N nodes / M actors / K directory
+    entries) from the durable snapshot+WAL. A refresh is refused when
+    persistence was disarmed (gcs_persistence=0 records the legacy
+    amnesiac head), when recovery came from anything but the WAL
+    (wal_records_replayed == 0), or when any entry was lost or doubled
+    across the crash."""
+    if not BENCH_ENVELOPE.exists():
+        pytest.skip("BENCH_ENVELOPE.json not present")
+    doc = json.loads(BENCH_ENVELOPE.read_text())
+    rows = [r for r in doc.get("phases", [])
+            if r.get("phase") == "recovery"]
+    assert rows, "envelope lost its recovery row"
+    row = rows[-1]
+    for key in ("gcs_persistence", "nodes", "actors", "dir_entries",
+                "time_to_recovered_s", "wal_records_written",
+                "wal_records_replayed", "snapshot_restore_ms",
+                "torn_wal_tails", "epoch", "lost_entries",
+                "doubled_entries"):
+        assert key in row, f"recovery row lost its {key!r} column"
+    assert row["gcs_persistence"] is True, (
+        "recovery row refreshed with persistence DISARMED — re-run "
+        "with gcs_persistence=1")
+    assert row["wal_records_replayed"] > 0, (
+        "zero WAL replays: the restart never exercised the durable "
+        "path — refusing the refresh")
+    assert row["lost_entries"] == 0, (
+        f"{row['lost_entries']} control-plane entries LOST across the "
+        f"head crash")
+    assert row["doubled_entries"] == 0, (
+        f"{row['doubled_entries']} control-plane entries DOUBLED "
+        f"across the head crash")
+    assert row["nodes"] >= 50 and row["actors"] >= 100 \
+        and row["dir_entries"] >= 1000, (
+        "recovery row shrank below its committed scale")
+    assert row["time_to_recovered_s"] > 0
+    assert row["epoch"] >= 2, (
+        "epoch did not advance across the restart — fencing has no "
+        "token to reject the old incarnation with")
+
+
 def test_bench_envelope_spill_restore_overhead_bounded():
     """The restore path is LOWER-is-better (unlike the throughput
     guards): a refresh may not balloon restore_p50_ms past 5x the

@@ -1097,6 +1097,166 @@ def test_daemon_die_leaves_flight_ring_in_debug_bundle(tmp_path):
             os.environ["RAY_TPU_SESSION_DIR"] = prior
 
 
+def test_net_partition_window_opens_and_heals():
+    """The sustained-partition site vs the one-shot rpc.sever: one
+    fire opens a seeded window during which EVERY send to that
+    destination fails, then the link heals in place and the same
+    client works again — no reconnect ceremony."""
+    server = RpcServer(host="127.0.0.1")
+    server.register("echo", lambda x: x)
+    server.start()
+    client = MuxRpcClient(server.address)
+    try:
+        assert client.call("echo", 1) == 1
+        os.environ["RAY_TPU_PARTITION_S"] = "1.0"
+        chaos.configure("seed=4,net.partition=1.0x1")
+        with pytest.raises(RpcError):
+            client.call("echo", 2)
+        # The window is open: every send fails fast, no seeded draw
+        # consumed (x1 cap already burned).
+        for _ in range(3):
+            with pytest.raises(RpcError):
+                client.call("echo", 3)
+        assert chaos.ACTIVE.stats()["injected"]["net.partition"] == 1
+        # Heal: the window expires (base 1.0s x 0.5-1.5 jitter) and
+        # traffic resumes on the same client.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            try:
+                assert client.call("echo", 4) == 4
+                break
+            except RpcError:
+                time.sleep(0.1)
+        else:
+            raise AssertionError("partition never healed")
+    finally:
+        os.environ.pop("RAY_TPU_PARTITION_S", None)
+        client.close()
+        server.stop()
+
+
+def test_net_partition_target_scopes_the_link():
+    """RAY_TPU_PARTITION_TARGET severs exactly the destination under
+    test: a non-matching destination neither fails nor consumes a
+    seeded draw."""
+    server_a = RpcServer(host="127.0.0.1")
+    server_a.register("echo", lambda x: x)
+    server_a.start()
+    server_b = RpcServer(host="127.0.0.1")
+    server_b.register("echo", lambda x: x)
+    server_b.start()
+    client_a = MuxRpcClient(server_a.address)
+    client_b = MuxRpcClient(server_b.address)
+    try:
+        os.environ["RAY_TPU_PARTITION_S"] = "30.0"
+        os.environ["RAY_TPU_PARTITION_TARGET"] = f":{server_a.port}"
+        chaos.configure("seed=4,net.partition=1.0x1")
+        # The untargeted link never draws: many sends, zero fires.
+        for i in range(5):
+            assert client_b.call("echo", i) == i
+        assert "net.partition" not in chaos.ACTIVE.stats()["injected"]
+        with pytest.raises(RpcError):
+            client_a.call("echo", 0)
+        # The b-link still flows while a's window is open.
+        assert client_b.call("echo", 99) == 99
+    finally:
+        os.environ.pop("RAY_TPU_PARTITION_S", None)
+        os.environ.pop("RAY_TPU_PARTITION_TARGET", None)
+        client_a.close()
+        client_b.close()
+        server_a.stop()
+        server_b.stop()
+
+
+def test_partition_across_head_restart_fences_then_resyncs(tmp_path):
+    """The acceptance shape: a driver partitioned from the head across
+    a head crash+restart (epoch bump) gets its first post-heal write
+    REJECTED typed (StaleEpochError — the stale incarnation provably
+    cannot touch the restored tables), re-syncs, re-publishes, and the
+    cluster drains every in-flight task exactly once through the
+    healed window."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu.cluster_utils import Cluster
+
+    marker_dir = tmp_path / "markers"
+    marker_dir.mkdir()
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir=str(tmp_path / "cluster"),
+                      persist_path=str(tmp_path / "gcs_snapshot.pkl"))
+    head_port = cluster.gcs._server.port
+    runtime = None
+    try:
+        for _ in range(2):
+            cluster.add_node(num_cpus=2, resources={"pool": 4.0},
+                             pool_size=0, heartbeat_period_s=0.5)
+        assert cluster.wait_for_nodes(2, timeout=60)
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        _wait_for(lambda: ray_tpu.cluster_resources().get("pool", 0)
+                  >= 8, 60, "cluster to assemble")
+        old_epoch = cluster.gcs.epoch
+        _wait_for(lambda: runtime._gcs_epoch == old_epoch, 30,
+                  "driver to learn the epoch")
+
+        @ray_tpu.remote(num_cpus=1, resources={"pool": 1.0},
+                        max_retries=3)
+        def work(path, i):
+            import os as _os
+            import time as _t
+
+            _t.sleep(1.0)
+            with open(_os.path.join(path, f"m-{i}-{_os.getpid()}-"
+                      f"{_t.monotonic_ns()}"), "w"):
+                pass
+            return i
+
+        # In-flight work spanning the partition + head restart: the
+        # execute plane is head-free, so these must drain exactly once.
+        refs = [work.remote(str(marker_dir), i) for i in range(8)]
+        time.sleep(0.3)  # dispatched
+
+        # Sever ONLY the driver<->head link for a seeded window...
+        os.environ["RAY_TPU_PARTITION_S"] = "3.0"
+        os.environ["RAY_TPU_PARTITION_TARGET"] = f":{head_port}"
+        chaos.configure("seed=9,net.partition=1.0x1")
+        try:
+            runtime.gcs_client.call("ping", timeout_s=2.0)
+        except (RpcError, Exception):  # noqa: BLE001 — opens the window
+            pass
+        assert chaos.ACTIVE.partitioned(f"127.0.0.1:{head_port}")
+        # ...and crash+restart the head INSIDE the window: the driver
+        # cannot observe the new epoch until the link heals.
+        cluster.restart_head(graceful=False)
+        assert cluster.gcs.epoch > old_epoch
+
+        results = ray_tpu.get(refs, timeout=120)
+        assert sorted(results) == list(range(8))
+
+        # Post-heal: the driver's stale-stamped writes were fenced
+        # typed, then it re-synced to the new epoch and was accepted.
+        _wait_for(lambda: runtime._gcs_epoch == cluster.gcs.epoch, 60,
+                  "driver to re-sync the new epoch")
+        _wait_for(lambda: cluster.gcs.persist_stats()["fenced_writes"]
+                  >= 1, 30, "a stale write to be fenced")
+        # Exactly one marker per task: nothing doubled through the
+        # partition + restart.
+        markers = sorted(os.listdir(marker_dir))
+        counts = {}
+        for name in markers:
+            counts[name.split("-")[1]] = \
+                counts.get(name.split("-")[1], 0) + 1
+        assert counts == {str(i): 1 for i in range(8)}, counts
+        # New work still flows under the new incarnation.
+        assert ray_tpu.get(work.remote(str(marker_dir), 99),
+                           timeout=60) == 99
+    finally:
+        os.environ.pop("RAY_TPU_PARTITION_S", None)
+        os.environ.pop("RAY_TPU_PARTITION_TARGET", None)
+        chaos.disable()
+        if runtime is not None:
+            ray_tpu.shutdown()
+        cluster.shutdown()
+
+
 def _session_dumps(session_dir: str) -> list:
     import json
 
@@ -1118,11 +1278,14 @@ def _session_dumps(session_dir: str) -> list:
 def test_chaos_soak_survives_kill_epochs(tmp_path):
     """Randomized (fixed-seed) soak: a mixed task/actor/broadcast
     workload keeps completing while one worker daemon is SIGKILLed
-    every epoch. Asserts zero lost/duplicated task results per epoch
-    and zero leaked /dev/shm segments at the end. Runs with DEADLINES
-    ARMED (a generous default budget on every task): the overload-
-    control plane must ride along without ever falsely expiring work
-    that survives node death within its budget."""
+    every epoch — and the HEAD itself is crash-restarted every few
+    epochs (durable snapshot+WAL recovery + epoch-fenced re-sync of
+    every daemon and the driver, mid-workload). Asserts zero
+    lost/duplicated task results per epoch and zero leaked /dev/shm
+    segments at the end. Runs with DEADLINES ARMED (a generous default
+    budget on every task): the overload-control plane must ride along
+    without ever falsely expiring work that survives node death within
+    its budget."""
     import random
 
     import numpy as np
@@ -1141,7 +1304,9 @@ def test_chaos_soak_survives_kill_epochs(tmp_path):
 
     shm_before = _shm_names()
     ray_tpu.shutdown()
-    cluster = Cluster(log_dir=str(tmp_path / "cluster"))
+    cluster = Cluster(log_dir=str(tmp_path / "cluster"),
+                      persist_path=str(tmp_path / "gcs_snapshot.pkl"))
+    head_kills = 0
     for _ in range(3):
         cluster.add_node(num_cpus=4, resources={"pool": 8.0},
                          pool_size=1, heartbeat_period_s=0.5)
@@ -1180,12 +1345,18 @@ def test_chaos_soak_survives_kill_epochs(tmp_path):
                     for i in range(6)]
             bcast = [touch.remote(blob_ref, epoch) for _ in range(3)]
 
-            # Kill one live worker daemon mid-workload, then replace it.
-            victims = [h for h in cluster._nodes if h.alive()]
-            victim = rng.choice(victims)
-            os.kill(victim.pid, signal.SIGKILL)
-            cluster.add_node(num_cpus=4, resources={"pool": 8.0},
-                             pool_size=1, heartbeat_period_s=0.5)
+            # Kill one live worker daemon mid-workload, then replace
+            # it. Every few epochs kill the HEAD instead: durable
+            # recovery + fenced re-sync must hold under the same load.
+            if epoch % 5 == 2:
+                cluster.restart_head(graceful=False)
+                head_kills += 1
+            else:
+                victims = [h for h in cluster._nodes if h.alive()]
+                victim = rng.choice(victims)
+                os.kill(victim.pid, signal.SIGKILL)
+                cluster.add_node(num_cpus=4, resources={"pool": 8.0},
+                                 pool_size=1, heartbeat_period_s=0.5)
 
             results = ray_tpu.get(refs, timeout=180)
             assert sorted(results) == [(epoch, i) for i in range(6)], \
@@ -1205,6 +1376,13 @@ def test_chaos_soak_survives_kill_epochs(tmp_path):
                         raise
                     time.sleep(1.0)
             del blob_ref
+        # The head died and recovered head_kills times: the last
+        # incarnation restored from snapshot+WAL (its epoch counts
+        # every restart) and replayed records on at least one pass.
+        assert head_kills >= 3
+        stats = cluster.gcs.persist_stats()
+        assert stats["epoch"] >= head_kills + 1, stats
+        assert stats["wal_records_replayed"] > 0, stats
     finally:
         if runtime is not None:
             ray_tpu.shutdown()

@@ -62,6 +62,12 @@ EXPORTED_SERIES = (
     "ray_tpu_serve_latency_bucket",
     "ray_tpu_serve_latency_sum",
     "ray_tpu_serve_latency_count",
+    # Durable control plane (ISSUE 12): the head's persistence
+    # counters + live incarnation epoch, scraped via the driver's
+    # cached gcs_persist_stats() fetch (connected mode only).
+    "ray_tpu_gcs_epoch",
+    "ray_tpu_gcs_persist_total",
+    "ray_tpu_gcs_snapshot_restore_ms",
 )
 
 
@@ -432,3 +438,86 @@ def test_spill_stats_shape_matches_docs():
     stats = merged_stats(None)
     assert set(stats) == set(SPILL_STAT_KEYS) | {"restore_p50_ms",
                                                  "backing_off"}
+
+
+# ------------------------------------------- durable control plane
+
+
+@pytest.fixture(scope="module")
+def fault_tolerance_text() -> str:
+    text = README.read_text()
+    start = text.find("## Fault tolerance")
+    assert start != -1, "README lost its Fault tolerance section"
+    end = text.find("\n## ", start + 1)
+    return text[start:end if end != -1 else len(text)]
+
+
+def test_gcs_persistence_knobs_documented(fault_tolerance_text):
+    from ray_tpu._private.config import _DEFAULTS
+
+    knobs = [k for k in _DEFAULTS
+             if k.startswith(("gcs_persistence", "gcs_snapshot_",
+                              "gcs_wal_", "gcs_epoch_"))]
+    assert len(knobs) >= 5, "gcs persistence knobs vanished from config"
+    missing = [k for k in knobs
+               if f"`{k}`" not in fault_tolerance_text]
+    assert not missing, (
+        f"gcs persistence/epoch knobs missing from the README fault-"
+        f"tolerance knob table: {missing}")
+
+
+def test_head_failure_model_table_documented(fault_tolerance_text):
+    """The head-failure-model contract: what survives a head crash,
+    what re-syncs, what fences."""
+    assert "Durable, fenced control plane" in fault_tolerance_text
+    flat = " ".join(fault_tolerance_text.split())
+    for phrase in ("node table", "actor registry", "object directory",
+                   "placement groups", "re-syncs",
+                   "`StaleEpochError`", "`fenced_writes`",
+                   "never resurrect a dead actor",
+                   "double-register a node"):
+        assert phrase in flat, (
+            f"head-failure-model text lost {phrase!r}")
+
+
+def test_gcs_persist_counter_keys_documented(fault_tolerance_text):
+    """Every counter persist_stats() serves (minus the live
+    epoch/armed/fencing fields) must appear in the fault-tolerance
+    section — the keys the ray_tpu_gcs_persist_total family labels."""
+    import tempfile
+
+    from ray_tpu._private.gcs_server import GcsServer
+
+    with tempfile.TemporaryDirectory() as tmp:
+        server = GcsServer(
+            host="127.0.0.1", port=0, log_dir=tmp,
+            persist_path=f"{tmp}/snap.pkl")
+        stats = server.persist_stats()
+        server._shutdown.set()
+        server._server.stop()
+    counter_keys = set(stats) - {"epoch", "armed", "fencing"}
+    missing = [k for k in sorted(counter_keys)
+               if f"`{k}`" not in fault_tolerance_text]
+    assert not missing, (
+        f"gcs persist counters missing from the README fault-"
+        f"tolerance section: {missing}")
+
+
+def test_partition_and_gcs_chaos_sites_documented(fault_tolerance_text):
+    import ray_tpu._private.chaos as chaos_mod
+
+    for site in ("net.partition", "gcs.torn_snapshot", "gcs.torn_wal"):
+        assert site in (chaos_mod.__doc__ or ""), (
+            f"chaos site {site} missing from chaos.py docstring")
+        assert f"`{site}`" in fault_tolerance_text, (
+            f"chaos site {site} missing from the README fault-"
+            f"tolerance section")
+
+
+def test_recovery_envelope_row_documented(fault_tolerance_text):
+    """The guarded recovery row and its refresh knob are part of the
+    operator contract."""
+    assert "`recovery` row" in fault_tolerance_text
+    assert "ENVELOPE_RECOVERY_ONLY" in fault_tolerance_text
+    assert "time_to_recovered_s" in fault_tolerance_text
+    assert "wal_records_replayed > 0" in fault_tolerance_text

@@ -1,0 +1,579 @@
+"""Durable, fenced control plane: full-state GCS snapshot+WAL and
+cluster epoch fencing (gcs_persistence.py + gcs_server.py).
+
+Reference: the GCS fault-tolerance contract (src/ray/gcs/store_client/
+redis_store_client.h:33 — durable tables; gcs_actor_manager.h — the
+actor table never resurrects a destroyed actor). Deterministic tier-1
+coverage: framing round trips, torn-snapshot/torn-tail rejection,
+seq-gated exactly-once replay, epoch mint + typed stale-write fencing,
+and the disarmed path staying byte-compatible with the legacy head.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import time
+
+import pytest
+
+from ray_tpu._private import chaos
+from ray_tpu._private import gcs_persistence as gp
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.gcs import StaleEpochError
+from ray_tpu._private.gcs_server import GcsServer
+from ray_tpu._private.rpc import MuxRpcClient, RpcMethodError
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    chaos.disable()
+    yield
+    chaos.disable()
+    GLOBAL_CONFIG.reset()
+
+
+def _crash(server: GcsServer) -> None:
+    """The SIGKILL shape for an in-process head: transport + monitor
+    die, NO final snapshot, NO WAL close."""
+    server._shutdown.set()
+    server._server.stop()
+
+
+def _head(tmp_path, port: int = 0) -> GcsServer:
+    if port == 0:
+        return GcsServer(host="127.0.0.1", port=port,
+                         log_dir=str(tmp_path / "log"),
+                         persist_path=str(tmp_path / "gcs_snapshot.pkl"))
+    # Same-port restart: lingering accepted sockets from the crashed
+    # incarnation can hold the port briefly.
+    deadline = time.monotonic() + 15
+    while True:
+        try:
+            return GcsServer(
+                host="127.0.0.1", port=port,
+                log_dir=str(tmp_path / "log"),
+                persist_path=str(tmp_path / "gcs_snapshot.pkl"))
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.2)
+
+
+# ------------------------------------------------------------- file framing
+
+
+def test_snapshot_round_trip_and_prev_rotation(tmp_path):
+    path = str(tmp_path / "snap")
+    gp.write_snapshot(path, b"generation-1")
+    assert gp.read_snapshot(path) == b"generation-1"
+    gp.write_snapshot(path, b"generation-2")
+    assert gp.read_snapshot(path) == b"generation-2"
+    # The previous GOOD snapshot rotated to .prev — the torn-current
+    # fallback target.
+    assert gp.read_snapshot(path + ".prev") == b"generation-1"
+
+
+def test_torn_snapshot_rejected_never_served(tmp_path):
+    path = str(tmp_path / "snap")
+    gp.write_snapshot(path, b"x" * 4096)
+    # Crash-mid-write shape: the header promises 4096 payload bytes,
+    # the file holds fewer.
+    with open(path, "r+b") as f:
+        f.truncate(16 + 1000)
+    with pytest.raises(gp.TornSnapshotError):
+        gp.read_snapshot(path)
+    # Bit rot: full length, wrong bytes -> CRC rejects.
+    gp.write_snapshot(path, b"y" * 4096)
+    with open(path, "r+b") as f:
+        f.seek(16 + 100)
+        f.write(b"Z" * 8)
+    with pytest.raises(gp.TornSnapshotError):
+        gp.read_snapshot(path)
+
+
+def test_legacy_raw_pickle_detected(tmp_path):
+    path = str(tmp_path / "snap")
+    with open(path, "wb") as f:
+        pickle.dump({"kv": {}, "jobs": []}, f)
+    with pytest.raises(gp.LegacySnapshotError):
+        gp.read_snapshot(path)
+
+
+def test_wal_replay_is_seq_gated(tmp_path):
+    path = str(tmp_path / "wal")
+    w = gp.WalWriter(path)
+    for seq in range(1, 6):
+        w.append(seq, pickle.dumps(("op", seq)))
+    w.close()
+    seen = []
+    stats = gp.replay_wal(path, 3, lambda op: seen.append(op[1]))
+    # Records <= the snapshot's covered seq are skipped: the
+    # effects-exactly-once contract across the snapshot/rotate race.
+    assert seen == [4, 5]
+    assert stats["replayed"] == 2 and stats["skipped"] == 3
+    assert stats["truncated"] == 0 and stats["last_seq"] == 5
+
+
+def test_wal_torn_tail_truncated_in_place(tmp_path):
+    path = str(tmp_path / "wal")
+    w = gp.WalWriter(path)
+    for seq in range(1, 4):
+        w.append(seq, pickle.dumps(("op", seq)))
+    w.close()
+    # SIGKILL mid-append: a fourth record's header promises more
+    # payload than made it to disk.
+    header = struct.Struct("<4sQQI")
+    with open(path, "ab") as f:
+        f.write(header.pack(b"RGW1", 4, 1000, 0xDEADBEEF))
+        f.write(b"short")
+    good_size = os.path.getsize(path) - header.size - 5
+    seen = []
+    stats = gp.replay_wal(path, 0, lambda op: seen.append(op[1]))
+    assert seen == [1, 2, 3]
+    assert stats["truncated"] == 1
+    # Truncated IN PLACE at the last good boundary: the next append
+    # extends a clean file.
+    assert os.path.getsize(path) == good_size
+
+
+def test_mint_epoch_monotonic_and_persisted(tmp_path):
+    path = str(tmp_path / "epoch")
+    assert gp.mint_epoch(path) == 1
+    assert gp.mint_epoch(path) == 2
+    assert gp.mint_epoch(path) == 3
+    with open(path) as f:
+        assert int(f.read()) == 3
+
+
+# --------------------------------------------------- full-state crash cycle
+
+
+def test_full_hot_set_survives_crash_restart(tmp_path):
+    server = _head(tmp_path)
+    server.start()
+    client = MuxRpcClient(server.address)
+    try:
+        node_id = client.call("register_node", "10.0.0.1:42",
+                              {"CPU": 4.0}, {"rack": "r1"},
+                              "10.0.0.1:999", host_id="hostA")
+        dead_id = client.call("register_node", "10.0.0.2:43",
+                              {"CPU": 2.0}, {}, "", host_id="hostB")
+        client.call("drain_node", dead_id)  # durable death verdict
+        client.call("kv_put", b"k1", b"v1", "ns")
+        # Directory entries + a spilled-location mark (the heartbeat
+        # piggyback is the production path for spill events).
+        client.call("object_locations_update", "owner-1",
+                    [("aa" * 10, ["n1", "n2"]), ("bb" * 10, "n1")], [],
+                    epoch=server.epoch)
+        assert client.call(
+            "heartbeat", node_id, None,
+            {"spill_events": [("owner-1", "bb" * 10, "spilled")]},
+            None, epoch=server.epoch) is True
+        client.call("actor_update", [{
+            "actor_id": b"\x07" * 16, "name": "keeper",
+            "namespace": "default", "class_name": "Keeper",
+            "state": "RESTARTING", "max_restarts": 5,
+            "num_restarts": 2}], epoch=server.epoch)
+        client.call("pg_update", "job-1",
+                    [{"pg_id": "cc" * 14, "state": "CREATED",
+                      "strategy": "STRICT_SPREAD", "bundles": []}],
+                    epoch=server.epoch)
+    finally:
+        client.close()
+    first_epoch = server.epoch
+    _crash(server)
+
+    restarted = _head(tmp_path)
+    try:
+        stats = restarted.persist_stats()
+        assert stats["wal_records_replayed"] > 0
+        assert stats["snapshot_restore_ms"] >= 0
+        assert restarted.epoch > first_epoch
+        # KV.
+        assert restarted.gcs.kv.get(b"k1", "ns") == b"v1"
+        # Node table: the live node restored ALIVE (its daemon gets a
+        # heartbeat window), the drained one restored DEAD.
+        by_addr = {r.address: r for r in restarted.gcs.list_nodes()}
+        assert by_addr["10.0.0.1:42"].alive
+        assert by_addr["10.0.0.1:42"].labels == {"rack": "r1"}
+        assert not by_addr["10.0.0.2:43"].alive
+        # Actor registry incl. RESTARTING + num_restarts.
+        actor = restarted.gcs.list_actors()[0]
+        assert (actor.name, actor.state, actor.num_restarts) == \
+            ("keeper", "RESTARTING", 2)
+        # Object directory incl. the spilled mark.
+        locs, spilled = restarted._list_object_locations(
+            None, include_spilled=True)
+        assert locs["aa" * 10] == ["n1", "n2"]
+        assert spilled.get("bb" * 10) == node_id.hex()
+        # Placement groups.
+        pgs = restarted._list_cluster_placement_groups()
+        assert pgs["job-1"][0]["pg_id"] == "cc" * 14
+    finally:
+        _crash(restarted)
+
+
+def test_dead_node_id_refused_across_restart(tmp_path):
+    """The death verdict is durable: a daemon re-registering with an
+    id the OLD head declared dead gets a FRESH id from the restarted
+    head — node resurrection is provably impossible."""
+    server = _head(tmp_path)
+    server.start()
+    client = MuxRpcClient(server.address)
+    try:
+        dead_id = client.call("register_node", "10.9.9.9:1",
+                              {"CPU": 1.0}, {}, "")
+        client.call("drain_node", dead_id)
+    finally:
+        client.close()
+    _crash(server)
+    restarted = _head(tmp_path)
+    restarted.start()
+    client = MuxRpcClient(restarted.address)
+    try:
+        granted = client.call("register_node", "10.9.9.9:1",
+                              {"CPU": 1.0}, {}, "", prior_id=dead_id)
+        assert granted != dead_id
+    finally:
+        client.close()
+        _crash(restarted)
+
+
+def test_torn_snapshot_falls_back_to_prev_plus_wal(tmp_path):
+    """Satellite: a torn CURRENT snapshot restores from the previous
+    good snapshot plus both WAL generations — nothing between the two
+    snapshots is lost."""
+    server = _head(tmp_path)
+    server.gcs.kv.put(b"a", b"1")
+    server._persist_tick(force=True)  # good snapshot (gen 1)
+    server._kv_put(b"b", b"2")        # lands in the rotated-out WAL
+    chaos.configure("seed=11,gcs.torn_snapshot=1.0x1")
+    server._persist_tick(force=True)  # torn snapshot (gen 2) + rotate
+    chaos.disable()
+    server._kv_put(b"c", b"3")        # lands in the fresh WAL
+    _crash(server)
+
+    restarted = _head(tmp_path)
+    try:
+        stats = restarted.persist_stats()
+        assert stats["torn_snapshots"] == 1
+        for key, value in ((b"a", b"1"), (b"b", b"2"), (b"c", b"3")):
+            assert restarted.gcs.kv.get(key) == value, key
+    finally:
+        _crash(restarted)
+
+
+def test_crash_mid_wal_append_truncates_tail_only(tmp_path):
+    """The head-SIGKILL-mid-WAL-append shape, made deterministic by
+    the gcs.torn_wal chaos site: everything before the torn record
+    replays, the tail is truncated and counted — consistent state,
+    never garbage."""
+    server = _head(tmp_path)
+    for i in range(8):
+        server._kv_put(f"k{i}".encode(), b"v")
+    chaos.configure("seed=3,gcs.torn_wal=1.0x1")
+    server._kv_put(b"torn-tail", b"v")  # the append the crash tears
+    chaos.disable()
+    _crash(server)
+
+    restarted = _head(tmp_path)
+    try:
+        stats = restarted.persist_stats()
+        assert stats["torn_wal_tails"] == 1
+        assert stats["wal_records_replayed"] == 8
+        for i in range(8):
+            assert restarted.gcs.kv.get(f"k{i}".encode()) == b"v"
+        # The torn record is ABSENT, not half-applied.
+        assert restarted.gcs.kv.get(b"torn-tail") is None
+    finally:
+        _crash(restarted)
+
+
+# ---------------------------------------------------------------- dirty check
+
+
+def test_actor_and_directory_mutations_trigger_snapshot(tmp_path):
+    """Satellite: the legacy dirty check tracked only kv.version +
+    job statuses — actor/node/directory/PG mutations never persisted.
+    The per-table change counters catch them all."""
+    server = _head(tmp_path)
+    server._persist_tick(force=True)
+    base = server.persist_stats()["snapshots_written"]
+    server._persist_tick(force=True)  # no mutation: no new snapshot
+    assert server.persist_stats()["snapshots_written"] == base
+
+    server._actor_update([{"actor_id": b"\x01" * 16, "name": None,
+                           "namespace": "default", "class_name": "A",
+                           "state": "ALIVE"}])
+    server._persist_tick(force=True)
+    assert server.persist_stats()["snapshots_written"] == base + 1
+
+    server.object_directory.update("o", [("dd" * 10, "n1")], [])
+    server._persist_tick(force=True)
+    assert server.persist_stats()["snapshots_written"] == base + 2
+
+    server._pg_update("j", [{"pg_id": "ee" * 14, "state": "PENDING",
+                             "strategy": "PACK", "bundles": []}])
+    server._persist_tick(force=True)
+    assert server.persist_stats()["snapshots_written"] == base + 3
+    _crash(server)
+
+
+def test_persist_error_counts_and_backs_off(tmp_path):
+    """Satellite to the old bare ``except OSError: pass``: a failed
+    snapshot write is counted + opens a back-off window during which
+    no further write is attempted (degrade-don't-die)."""
+    server = _head(tmp_path)
+    server._persist_path = str(tmp_path / "missing-dir" / "snap.pkl")
+    server.gcs.kv.put(b"x", b"y")
+    server._persist_tick(force=True)
+    assert server.persist_stats()["persist_errors"] == 1
+    # Inside the back-off window: no second attempt, no second count.
+    server.gcs.kv.put(b"x2", b"y2")
+    server._persist_tick(force=True)
+    assert server.persist_stats()["persist_errors"] == 1
+    _crash(server)
+
+
+# -------------------------------------------------------------- epoch fencing
+
+
+def test_reply_meta_carries_epoch_on_every_call(tmp_path):
+    server = _head(tmp_path)
+    server.start()
+    client = MuxRpcClient(server.address)
+    metas = []
+    client.on_reply_meta = metas.append
+    try:
+        client.call("ping")
+        client.call("list_nodes")
+        assert [m["epoch"] for m in metas] == [server.epoch] * 2
+    finally:
+        client.close()
+        _crash(server)
+
+
+def test_stale_epoch_write_rejected_typed_then_accepted(tmp_path):
+    """The fence end to end: a write stamped with the previous
+    incarnation's epoch raises StaleEpochError (typed, carrying the
+    current epoch), is counted, and the SAME write succeeds after the
+    re-sync (re-registration)."""
+    server = _head(tmp_path)
+    server.start()
+    port = server._server.port
+    client = MuxRpcClient(server.address)
+    try:
+        node_id = client.call("register_node", "10.1.1.1:7",
+                              {"CPU": 1.0}, {}, "")
+        old_epoch = server.epoch
+        assert client.call("heartbeat", node_id, None, None, None,
+                           epoch=old_epoch) is True
+    finally:
+        client.close()
+    _crash(server)
+
+    restarted = _head(tmp_path, port=port)
+    restarted.start()
+    client = MuxRpcClient(restarted.address)
+    try:
+        assert restarted.epoch > old_epoch
+        # The partitioned daemon's first beat after heal: stamped with
+        # the OLD epoch -> typed rejection.
+        with pytest.raises(RpcMethodError) as excinfo:
+            client.call("heartbeat", node_id, None, None, None,
+                        epoch=old_epoch)
+        assert isinstance(excinfo.value.cause, StaleEpochError)
+        assert excinfo.value.cause.current_epoch == restarted.epoch
+        assert restarted.persist_stats()["fenced_writes"] == 1
+        # Re-sync: re-register (same id granted — the record was
+        # restored alive with a matching address), then the same write
+        # is accepted under the current epoch.
+        granted = client.call("register_node", "10.1.1.1:7",
+                              {"CPU": 1.0}, {}, "", prior_id=node_id)
+        assert granted == node_id
+        assert client.call("heartbeat", node_id, None, None, None,
+                           epoch=restarted.epoch) is True
+    finally:
+        client.close()
+        _crash(restarted)
+
+
+def test_dead_actor_never_resurrected(tmp_path):
+    """An actor the head saw DEAD stays DEAD whatever a (stale or
+    current) publisher later claims — recovery must mint a new actor,
+    never revive the old id."""
+    server = _head(tmp_path)
+    plain = {"actor_id": b"\x09" * 16, "name": "ghost",
+             "namespace": "default", "class_name": "G",
+             "state": "ALIVE"}
+    assert server._actor_update([plain]) == 1
+    assert server._actor_update([{**plain, "state": "DEAD",
+                                  "death_cause": "killed"}]) == 1
+    # Resurrection attempts are refused (applied count 0)...
+    assert server._actor_update([{**plain, "state": "ALIVE"}]) == 0
+    assert server._actor_update([{**plain, "state": "RESTARTING"}]) == 0
+    record = server.gcs.list_actors()[0]
+    assert record.state == "DEAD"
+    # ...and the verdict survives a crash-restart.
+    _crash(server)
+    restarted = _head(tmp_path)
+    try:
+        assert restarted.gcs.list_actors()[0].state == "DEAD"
+        assert restarted._actor_update(
+            [{**plain, "state": "ALIVE"}]) == 0
+    finally:
+        _crash(restarted)
+
+
+def test_node_agent_resyncs_across_head_restart(tmp_path):
+    """A live NodeAgent rides the full loop: epoch learned at
+    registration, stamped on heartbeats, fenced after the head
+    restarts (its node record was RESTORED alive, so only the fence —
+    not a heartbeat rejection — tells it to re-sync), re-registered
+    under the new epoch."""
+    server = _head(tmp_path)
+    server.start()
+    port = server._server.port
+    from ray_tpu._private.node import NodeAgent
+
+    agent = NodeAgent(f"127.0.0.1:{port}", {"CPU": 1.0},
+                      heartbeat_period_s=0.2)
+    try:
+        assert agent.gcs_epoch == server.epoch
+        first_epoch = server.epoch
+        _crash(server)
+        server = _head(tmp_path, port=port)
+        server.start()
+        assert server.epoch > first_epoch
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline \
+                and agent.gcs_epoch != server.epoch:
+            time.sleep(0.1)
+        assert agent.gcs_epoch == server.epoch, \
+            "agent never re-synced to the new epoch"
+        # The stale beat was fenced typed (not silently accepted), and
+        # the agent's record is alive under the restarted head.
+        assert server.persist_stats()["fenced_writes"] >= 1
+        record = server.gcs.get_node(
+            __import__("ray_tpu._private.ids", fromlist=["NodeID"])
+            .NodeID(agent.node_id))
+        assert record is not None and record.alive
+    finally:
+        agent.stop(drain=False)
+        _crash(server)
+
+
+# ------------------------------------------------------------- disarmed path
+
+
+def test_disarmed_is_legacy_raw_pickle_no_epoch(tmp_path):
+    """gcs_persistence=0: the head writes the legacy {kv, jobs} raw
+    pickle (no framing, no WAL file, no .prev), mints no epoch, tags
+    no reply metadata — byte-identical to the pre-WAL head."""
+    GLOBAL_CONFIG.update({"gcs_persistence": False})
+    path = str(tmp_path / "gcs_snapshot.pkl")
+    server = GcsServer(host="127.0.0.1", port=0,
+                       log_dir=str(tmp_path / "log"), persist_path=path)
+    server.start()
+    assert server.epoch == 0 and server._wal is None
+    assert server._server.reply_meta_fn is None
+    client = MuxRpcClient(server.address)
+    metas = []
+    client.on_reply_meta = metas.append
+    try:
+        client.call("kv_put", b"k", b"v")
+        assert metas == []
+        # Unfenced: any epoch stamp passes.
+        nid = client.call("register_node", "1.1.1.1:1", {}, {}, "")
+        assert client.call("heartbeat", nid, None, None, None,
+                           epoch=12345) is True
+    finally:
+        client.close()
+    server._save_snapshot()
+    with open(path, "rb") as f:
+        state = pickle.load(f)  # raw pickle: loads with NO framing
+    assert set(state) == {"kv", "jobs"}
+    assert not os.path.exists(path + ".wal")
+    assert not os.path.exists(path + ".prev")
+    server.stop()
+
+    # And the legacy restore path still reads it.
+    GLOBAL_CONFIG.update({"gcs_persistence": True})
+    restarted = GcsServer(host="127.0.0.1", port=0,
+                          log_dir=str(tmp_path / "log"),
+                          persist_path=path)
+    try:
+        assert restarted.gcs.kv.get(b"k") == b"v"
+    finally:
+        _crash(restarted)
+
+
+def test_driver_mirrors_actors_and_pgs_to_head(tmp_path):
+    """Connected-mode mirror publish: a driver's actor lifecycle and
+    placement groups appear in the head's cluster tables (the state
+    the snapshot+WAL then make durable), stamped with the epoch the
+    driver learned from reply metadata."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir=str(tmp_path / "cluster"),
+                      persist_path=str(tmp_path / "gcs_snapshot.pkl"))
+    runtime = None
+    try:
+        cluster.add_node(num_cpus=2, pool_size=0)
+        assert cluster.wait_for_nodes(1, timeout=60)
+        runtime = ray_tpu.init(num_cpus=2, address=cluster.address)
+
+        @ray_tpu.remote
+        class Mirrored:
+            def ping(self):
+                return "pong"
+
+        handle = Mirrored.options(name="mirrored").remote()
+        assert ray_tpu.get(handle.ping.remote(), timeout=60) == "pong"
+
+        deadline = time.monotonic() + 30
+        names = set()
+        while time.monotonic() < deadline:
+            names = {a.get("name")
+                     for a in cluster.gcs._list_cluster_actors()}
+            if "mirrored" in names:
+                break
+            time.sleep(0.3)
+        assert "mirrored" in names, names
+        # The driver learned the head's epoch off reply metadata.
+        assert runtime._gcs_epoch == cluster.gcs.epoch
+        # The PG mirror publishes on version bumps (the initial
+        # publish lands this owner's — empty — snapshot).
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and \
+                runtime.job_id.hex() not in \
+                cluster.gcs._list_cluster_placement_groups():
+            time.sleep(0.3)
+        assert runtime.job_id.hex() in \
+            cluster.gcs._list_cluster_placement_groups()
+    finally:
+        if runtime is not None:
+            ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_torn_current_never_clobbers_good_prev(tmp_path):
+    """.prev is an always-GOOD fallback: a torn current snapshot (an
+    earlier interrupted write) is discarded at the next write, never
+    rotated over the last good generation."""
+    path = str(tmp_path / "snap")
+    gp.write_snapshot(path, b"good-gen-1")
+    chaos.configure("seed=2,gcs.torn_snapshot=1.0x1")
+    gp.write_snapshot(path, b"torn-gen-2")
+    chaos.disable()
+    assert gp.read_snapshot(path + ".prev") == b"good-gen-1"
+    with pytest.raises(gp.TornSnapshotError):
+        gp.read_snapshot(path)
+    gp.write_snapshot(path, b"good-gen-3")
+    # gen-1 (good) survived as .prev; the torn gen-2 was discarded.
+    assert gp.read_snapshot(path + ".prev") == b"good-gen-1"
+    assert gp.read_snapshot(path) == b"good-gen-3"

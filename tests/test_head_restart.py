@@ -169,6 +169,103 @@ def test_head_kill_with_inflight_batch_and_broadcast_drains(tmp_path):
                 proc.kill()
 
 
+def test_head_sigkill_mid_mutation_full_state_survives(tmp_path):
+    """Head SIGKILLed in the middle of a write burst (no clean stop,
+    no final snapshot): every ACKED mutation must rehydrate from the
+    snapshot+WAL — node records, a RESTARTING actor with its restart
+    count, object-directory entries including a spilled-location mark,
+    placement groups, and the KV — with ``wal_records_replayed > 0``
+    and a bumped incarnation epoch."""
+    session = str(tmp_path / "session")
+    os.makedirs(session)
+    head_proc, addr = _spawn_head(session)
+    port = int(addr.rsplit(":", 1)[1])
+    client = RpcClient(addr, timeout_s=10.0)
+    try:
+        old_epoch = client.call("gcs_epoch")
+        assert isinstance(old_epoch, int) and old_epoch >= 1
+        node_id = client.call("register_node", "10.3.3.3:17",
+                              {"CPU": 4.0}, {"rack": "r9"},
+                              "10.3.3.3:900", host_id="hostZ")
+        # In-flight object state: directory entries + a spilled mark
+        # shipped the production way (heartbeat stats piggyback).
+        client.call("object_locations_update", "owner-x",
+                    [("ab" * 10, ["n1", "n2"]), ("cd" * 10, "n2")], [],
+                    epoch=old_epoch)
+        assert client.call(
+            "heartbeat", node_id, None,
+            {"spill_events": [("owner-x", "cd" * 10, "spilled")]},
+            None, epoch=old_epoch) is True
+        client.call("actor_update", [{
+            "actor_id": b"\x21" * 16, "name": "survivor",
+            "namespace": "default", "class_name": "Keeper",
+            "state": "RESTARTING", "max_restarts": 4,
+            "num_restarts": 3}], epoch=old_epoch)
+        client.call("pg_update", "job-x",
+                    [{"pg_id": "ee" * 14, "state": "CREATED",
+                      "strategy": "PACK", "bundles": []}],
+                    epoch=old_epoch)
+        # Write burst; the SIGKILL lands mid-stream. Every ACKED put
+        # (the call returned) is already WAL-framed on disk.
+        acked = []
+        for i in range(50):
+            client.call("kv_put", f"burst-{i}".encode(), b"v", "t")
+            acked.append(i)
+            if i == 29:
+                head_proc.send_signal(signal.SIGKILL)
+            # After the kill the next call fails somewhere mid-burst.
+    except (RpcError, OSError):
+        pass  # the burst died with the head — expected
+    finally:
+        client.close()
+    head_proc.wait(timeout=10)
+
+    head_proc, addr2 = _spawn_head(session, port=port)
+    client = RpcClient(addr2, timeout_s=10.0)
+    try:
+        stats = client.call("gcs_persist_stats")
+        assert stats["wal_records_replayed"] > 0, stats
+        assert stats["epoch"] > old_epoch
+        # Node table (restored alive — its daemon gets a grace window).
+        nodes = {n["address"]: n for n in client.call("list_nodes")}
+        assert nodes["10.3.3.3:17"]["alive"]
+        assert nodes["10.3.3.3:17"]["labels"] == {"rack": "r9"}
+        # Actor registry incl. RESTARTING + num_restarts.
+        actors = {a["name"]: a
+                  for a in client.call("list_cluster_actors")}
+        assert actors["survivor"]["state"] == "RESTARTING"
+        assert actors["survivor"]["num_restarts"] == 3
+        # Object directory + the spilled mark.
+        locs, spilled = client.call("list_object_locations", None, True)
+        assert locs["ab" * 10] == ["n1", "n2"]
+        assert spilled.get("cd" * 10) == node_id.hex()
+        # Placement groups.
+        pgs = client.call("list_cluster_placement_groups")
+        assert pgs["job-x"][0]["pg_id"] == "ee" * 14
+        # Every ACKED KV write survived the SIGKILL.
+        missing = [i for i in acked
+                   if client.call("kv_get", f"burst-{i}".encode(), "t")
+                   != b"v"]
+        assert not missing, f"acked writes lost: {missing}"
+        # A stale-epoch write is still fenced by the restarted head.
+        from ray_tpu._private.gcs import StaleEpochError
+        from ray_tpu._private.rpc import RpcMethodError
+
+        try:
+            client.call("heartbeat", node_id, None, None, None,
+                        epoch=old_epoch)
+            raise AssertionError("stale-epoch heartbeat not fenced")
+        except RpcMethodError as exc:
+            assert isinstance(exc.cause, StaleEpochError)
+    finally:
+        client.close()
+        head_proc.terminate()
+        try:
+            head_proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            head_proc.kill()
+
+
 def test_head_kill_restart_cluster_resumes(tmp_path):
     session = str(tmp_path / "session")
     os.makedirs(session)

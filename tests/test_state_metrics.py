@@ -447,3 +447,43 @@ def test_cluster_scrape_serves_stage_latency_histograms():
         ray_tpu.shutdown()
         cluster.shutdown()
         REGISTRY.clear()
+
+
+def test_cluster_scrape_serves_gcs_persist_families(tmp_path):
+    """Connected to a persistence-armed head, the driver's scrape
+    serves the durable-control-plane families: the live incarnation
+    epoch, the persist counter family, and the restore-latency gauge
+    (fetched from the head's gcs_persist_stats with a short cache)."""
+    import re
+    import time
+
+    from ray_tpu.cluster_utils import Cluster
+
+    ray_tpu.shutdown()
+    REGISTRY.clear()
+    cluster = Cluster(log_dir=str(tmp_path / "cluster"),
+                      persist_path=str(tmp_path / "gcs_snapshot.pkl"))
+    cluster.add_node(num_cpus=2)
+    try:
+        assert cluster.wait_for_nodes(1, timeout=60)
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address,
+                               metrics_port=0)
+        port = runtime.metrics_agent.port
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics",
+            timeout=10).read().decode()
+        epoch_match = re.search(r"ray_tpu_gcs_epoch (\d+)", body)
+        assert epoch_match, body[-2000:]
+        assert int(epoch_match.group(1)) == cluster.gcs.epoch
+        for kind in ("wal_records_written", "wal_records_replayed",
+                     "snapshots_written", "torn_wal_tails",
+                     "torn_snapshots", "persist_errors",
+                     "fenced_writes"):
+            assert re.search(
+                r'ray_tpu_gcs_persist_total\{kind="%s"\} \d+' % kind,
+                body), f"{kind} missing from the scrape"
+        assert re.search(r"ray_tpu_gcs_snapshot_restore_ms \d", body)
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+        REGISTRY.clear()
