@@ -298,6 +298,7 @@ def main() -> None:
                       **perf_plane_row}), flush=True)
 
     from ray_tpu.util import tracing as _tracing
+    from ray_tpu._private import lock_witness as _witness
     from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
 
     record("tasks", n=N_TASKS, ok=True,
@@ -331,6 +332,12 @@ def main() -> None:
            # perf plane, by contrast, ships ARMED — its cost is part
            # of the product and bounded by the calibration above.
            tracing_enabled=_tracing.is_enabled(),
+           # Same honesty contract for the lock-order witness (ISSUE
+           # 13): the guarded numbers are DISARMED numbers — armed,
+           # every hot-module acquire pays held-set + graph
+           # bookkeeping. test_bench_regression refuses a refresh
+           # recorded with the witness armed.
+           lock_witness_armed=bool(_witness.WITNESS_ON),
            perf_plane=perf_plane_row)
 
     # -- phase 3b: skewed-load placement + straggler speculation ----------

@@ -1,0 +1,286 @@
+"""AST invariant linter for the runtime's concurrency conventions.
+
+The threaded core rests on prose conventions — lock discipline around
+shared tables, one-module-attribute disarm gates, registered chaos
+sites, ``*_STAT_KEYS`` counter registries, no silent exception
+swallows. Each was guarded only by spot checks; this package turns
+them into mechanical passes over the tree (pure-stdlib ``ast``, no
+imports of the code under analysis) that a tier-1 test and the
+``python -m ray_tpu.analysis`` CLI run with zero tolerance for
+unsuppressed findings.
+
+Passes (ids are the suppression-file keys):
+
+- ``lock-discipline``  fields written under a class's ``with
+  self._lock`` in one method must not be written bare in another
+  (heuristic; see lock_discipline.py for the exact rules)
+- ``chaos-sites``      every ``chaos.should("<site>")`` string is in
+  chaos.py's ``SITES`` registry + docstring and exercised in tests/
+- ``counter-keys``     every ``*_STAT_KEYS`` registry matches the
+  stats dict its module actually builds, and its family is exported
+  through metrics_agent.py
+- ``disarm-gates``     every ``*_ON`` disarm gate is declared once at
+  module level, actually branches somewhere, and hot paths never read
+  the config knob where the gate exists
+- ``swallows``         bare ``except:`` and pass-only broad handlers
+  (Exception/BaseException/OSError) without a why-comment
+
+Suppression file (``suppressions.txt`` next to this module)::
+
+    <pass-id> <path>::<qualifier>  # why this finding is acceptable
+
+Every entry needs the why-comment; the tier-1 gate caps the file at
+25 entries so triage cannot rot into wholesale silencing. Stale
+entries (matching no current finding) are reported so the file shrinks
+as fixes land.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import sys
+import tokenize
+from dataclasses import dataclass
+
+# Suppression-file budget, enforced by the CLI and the tier-1 gate:
+# past this, suppressing stops being triage.
+MAX_SUPPRESSIONS = 25
+
+PASS_IDS = ("lock-discipline", "chaos-sites", "counter-keys",
+            "disarm-gates", "swallows")
+
+
+@dataclass(frozen=True)
+class Finding:
+    pass_id: str
+    path: str        # repo-relative, forward slashes
+    line: int
+    ident: str       # stable suppression qualifier (no line numbers)
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.pass_id} {self.path}::{self.ident}"
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.pass_id}] "
+                f"{self.message}\n    suppress with: {self.key}")
+
+
+class SourceFile:
+    """One parsed module: source text, AST, and the set of line
+    numbers carrying a comment (passes use comments as the in-place
+    justification pragma — ast alone cannot see them)."""
+
+    def __init__(self, path: str, rel: str):
+        import ast
+
+        self.path = path
+        self.rel = rel
+        self.text = open(path, encoding="utf-8").read()
+        self.lines = self.text.splitlines()
+        self.tree = ast.parse(self.text, filename=path)
+        self.comment_lines: set[int] = set()
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(self.text).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comment_lines.add(tok.start[0])
+        except tokenize.TokenizeError:  # pragma: no cover — parse ok'd
+            pass
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+
+
+def default_package_root() -> str:
+    return os.path.join(repo_root(), "ray_tpu")
+
+
+def iter_sources(package_root: str) -> "list[SourceFile]":
+    out = []
+    base = os.path.dirname(os.path.abspath(package_root.rstrip("/")))
+    for dirpath, dirnames, filenames in os.walk(package_root):
+        dirnames[:] = [d for d in sorted(dirnames)
+                       if d != "__pycache__"]
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, name)
+            rel = os.path.relpath(path, base).replace(os.sep, "/")
+            out.append(SourceFile(path, rel))
+    return out
+
+
+# --------------------------------------------------------------------------
+# Suppressions
+# --------------------------------------------------------------------------
+
+
+def suppressions_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "suppressions.txt")
+
+
+@dataclass(frozen=True)
+class Suppression:
+    key: str      # "<pass-id> <path>::<qualifier>"
+    why: str
+    line: int
+
+
+def load_suppressions(path: "str | None" = None
+                      ) -> "tuple[list[Suppression], list[str]]":
+    """Parse the suppression file. Returns (entries, format_errors) —
+    an entry without a why-comment is a format error, not a working
+    suppression."""
+    path = path or suppressions_path()
+    entries: list[Suppression] = []
+    errors: list[str] = []
+    try:
+        raw = open(path, encoding="utf-8").read()
+    except OSError:
+        return entries, errors
+    for lineno, line in enumerate(raw.splitlines(), 1):
+        stripped = line.strip()
+        if not stripped or stripped.startswith("#"):
+            continue
+        body, sep, why = stripped.partition("#")
+        body = body.strip()
+        why = why.strip()
+        parts = body.split(None, 1)
+        if len(parts) != 2 or parts[0] not in PASS_IDS \
+                or "::" not in parts[1]:
+            errors.append(
+                f"suppressions.txt:{lineno}: malformed entry "
+                f"{stripped!r} (want '<pass-id> <path>::<qualifier>"
+                f"  # why')")
+            continue
+        if not sep or not why:
+            errors.append(
+                f"suppressions.txt:{lineno}: entry {body!r} has no "
+                f"why-comment — every suppression carries its triage "
+                f"rationale")
+            continue
+        entries.append(Suppression(key=f"{parts[0]} {parts[1]}",
+                                   why=why, line=lineno))
+    return entries, errors
+
+
+# --------------------------------------------------------------------------
+# Driver
+# --------------------------------------------------------------------------
+
+
+def run_passes(package_root: "str | None" = None,
+               pass_ids: "tuple[str, ...] | None" = None
+               ) -> "list[Finding]":
+    """Run the selected passes over the tree; returns RAW findings
+    (suppressions not yet applied)."""
+    from ray_tpu._private.analysis import (
+        chaos_sites,
+        counter_keys,
+        disarm_gates,
+        lock_discipline,
+        swallows,
+    )
+
+    package_root = package_root or default_package_root()
+    selected = pass_ids or PASS_IDS
+    sources = iter_sources(package_root)
+    registry = {
+        "lock-discipline": lock_discipline.run,
+        "chaos-sites": chaos_sites.run,
+        "counter-keys": counter_keys.run,
+        "disarm-gates": disarm_gates.run,
+        "swallows": swallows.run,
+    }
+    findings: list[Finding] = []
+    for pass_id in selected:
+        findings.extend(registry[pass_id](sources))
+    findings.sort(key=lambda f: (f.path, f.line, f.pass_id))
+    return findings
+
+
+def apply_suppressions(findings: "list[Finding]",
+                       entries: "list[Suppression]"
+                       ) -> "tuple[list[Finding], list[Suppression]]":
+    """Split raw findings against the suppression entries. Returns
+    (unsuppressed findings, stale entries that matched nothing)."""
+    by_key = {e.key: e for e in entries}
+    used: set[str] = set()
+    open_findings = []
+    for finding in findings:
+        if finding.key in by_key:
+            used.add(finding.key)
+        else:
+            open_findings.append(finding)
+    stale = [e for e in entries if e.key not in used]
+    return open_findings, stale
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="python -m ray_tpu.analysis",
+        description="AST invariant linter for the ray_tpu runtime "
+                    "(lock discipline, chaos sites, counter keys, "
+                    "disarm gates, exception swallows).")
+    parser.add_argument("passes", nargs="*",
+                        help=f"passes to run (default: all of "
+                             f"{', '.join(PASS_IDS)})")
+    parser.add_argument("--root", default=None,
+                        help="package root to analyze (default: the "
+                             "ray_tpu/ tree this module lives in)")
+    parser.add_argument("--list-passes", action="store_true",
+                        help="list pass ids and exit")
+    parser.add_argument("--no-suppressions", action="store_true",
+                        help="report raw findings, ignoring "
+                             "suppressions.txt")
+    parser.add_argument("--strict", action="store_true",
+                        help="also fail on stale suppression entries")
+    args = parser.parse_args(argv)
+
+    if args.list_passes:
+        for pass_id in PASS_IDS:
+            print(pass_id)
+        return 0
+    for pass_id in args.passes:
+        if pass_id not in PASS_IDS:
+            print(f"unknown pass {pass_id!r}; valid: "
+                  f"{', '.join(PASS_IDS)}", file=sys.stderr)
+            return 2
+
+    findings = run_passes(args.root,
+                          tuple(args.passes) or None)
+    entries, format_errors = ([], []) if args.no_suppressions \
+        else load_suppressions()
+    for err in format_errors:
+        print(err, file=sys.stderr)
+    open_findings, stale = apply_suppressions(findings, entries)
+
+    for finding in open_findings:
+        print(finding.render())
+    for entry in stale:
+        print(f"suppressions.txt:{entry.line}: stale entry (matches "
+              f"no current finding): {entry.key}",
+              file=sys.stderr)
+    over_budget = len(entries) > MAX_SUPPRESSIONS
+    if over_budget:
+        print(f"suppressions.txt carries {len(entries)} entries — "
+              f"over the {MAX_SUPPRESSIONS}-entry triage budget",
+              file=sys.stderr)
+
+    suppressed = len(findings) - len(open_findings)
+    print(f"{len(open_findings)} finding(s) "
+          f"({suppressed} suppressed, {len(stale)} stale "
+          f"suppression(s))", file=sys.stderr)
+    if open_findings or format_errors or over_budget:
+        return 1
+    if args.strict and stale:
+        return 1
+    return 0

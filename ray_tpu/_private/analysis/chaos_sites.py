@@ -1,0 +1,134 @@
+"""Pass ``chaos-sites``: the injection-site registry cannot drift.
+
+``chaos.SITES`` (chaos.py) is the canonical list. This pass parses it
+straight out of the AST (never importing the module) and enforces, in
+both directions:
+
+- every ``*.should("<site>")`` / ``maybe_partition`` site string used
+  anywhere in the tree is registered in ``SITES``;
+- every registered site is documented in chaos.py's module docstring
+  (the operator-facing spec grammar);
+- every registered site appears somewhere under ``tests/`` — an
+  injection point nothing exercises is dead chaos;
+- every registered site is actually drawn somewhere in the tree (a
+  site with no ``should()`` caller is a stale registry row).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from ray_tpu._private.analysis import Finding, repo_root
+
+CHAOS_REL = "ray_tpu/_private/chaos.py"
+
+
+def _registry(sources) -> "tuple[set[str], str, int]":
+    """(SITES entries, module docstring, SITES lineno) from chaos.py's
+    AST."""
+    for src in sources:
+        if src.rel != CHAOS_REL:
+            continue
+        doc = ast.get_docstring(src.tree) or ""
+        for node in src.tree.body:
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                if not any(isinstance(t, ast.Name) and t.id == "SITES"
+                           for t in targets):
+                    continue
+                value = node.value
+                if isinstance(value, ast.Tuple):
+                    sites = {elt.value for elt in value.elts
+                             if isinstance(elt, ast.Constant)
+                             and isinstance(elt.value, str)}
+                    return sites, doc, node.lineno
+        return set(), doc, 1
+    return set(), "", 1
+
+
+def used_sites(sources) -> "dict[str, tuple[str, int]]":
+    """{site -> first (path, line)} for every should("<lit>") call in
+    the tree (chaos.py's own internal draw included)."""
+    out: dict[str, tuple[str, int]] = {}
+    for src in sources:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = func.attr if isinstance(func, ast.Attribute) \
+                else func.id if isinstance(func, ast.Name) else None
+            if name != "should" or not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value,
+                                                            str):
+                out.setdefault(arg.value, (src.rel, node.lineno))
+    return out
+
+
+def registered_sites(sources=None) -> "set[str]":
+    """The SITES registry, parsed from chaos.py's AST (exported for
+    tests/test_doc_drift.py so docs assertions share this parser)."""
+    if sources is None:
+        from ray_tpu._private.analysis import (
+            default_package_root,
+            iter_sources,
+        )
+
+        sources = iter_sources(default_package_root())
+    sites, _, _ = _registry(sources)
+    return sites
+
+
+def _tests_text() -> str:
+    tests_dir = os.path.join(repo_root(), "tests")
+    chunks = []
+    try:
+        names = sorted(os.listdir(tests_dir))
+    except OSError:
+        return ""
+    for name in names:
+        if name.endswith((".py", ".cpp")):
+            try:
+                chunks.append(open(os.path.join(tests_dir, name),
+                                   encoding="utf-8").read())
+            except OSError:
+                continue  # unreadable test file: skip it
+    return "\n".join(chunks)
+
+
+def run(sources) -> "list[Finding]":
+    findings: list[Finding] = []
+    sites, doc, sites_line = _registry(sources)
+    if not sites:
+        findings.append(Finding(
+            "chaos-sites", CHAOS_REL, sites_line, "SITES",
+            "chaos.py lost its SITES registry tuple"))
+        return findings
+    used = used_sites(sources)
+    for site, (path, line) in sorted(used.items()):
+        if site not in sites:
+            findings.append(Finding(
+                "chaos-sites", path, line, f"site.{site}",
+                f"chaos site {site!r} drawn here but not registered "
+                f"in chaos.SITES"))
+    tests_text = _tests_text()
+    for site in sorted(sites):
+        if site not in doc:
+            findings.append(Finding(
+                "chaos-sites", CHAOS_REL, sites_line, f"doc.{site}",
+                f"registered chaos site {site!r} missing from "
+                f"chaos.py's docstring (the spec-grammar contract)"))
+        if tests_text and site not in tests_text:
+            findings.append(Finding(
+                "chaos-sites", CHAOS_REL, sites_line, f"tests.{site}",
+                f"registered chaos site {site!r} never appears under "
+                f"tests/ — dead injection point"))
+        if site not in used:
+            findings.append(Finding(
+                "chaos-sites", CHAOS_REL, sites_line, f"unused.{site}",
+                f"registered chaos site {site!r} has no should() "
+                f"caller in the tree — stale registry row"))
+    return findings

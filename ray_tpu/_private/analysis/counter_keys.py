@@ -1,0 +1,166 @@
+"""Pass ``counter-keys``: ``*_STAT_KEYS`` registries cannot drift.
+
+A counter key is a three-way contract: the module increments it, its
+``*_STAT_KEYS`` registry names it (tests and the README doc-drift
+check read the registry), and metrics_agent.py exports its family.
+This pass enforces all three from the AST:
+
+- every module-level ``*_STAT_KEYS`` tuple is matched against the
+  stats dicts its own module builds (dict literals, ``d["k"] = ...``
+  follow-up assignments, and ``{k: 0 for k in REGISTRY}`` seeding);
+  a registry key no builder emits, or an emitted key missing from the
+  registry, is a finding;
+- the registry's per-node family (``ray_tpu_node_<group>``) must
+  appear in metrics_agent.py, so heartbeat-shipped counters actually
+  reach ``/metrics``.
+
+Derived non-counter fields a stats dict carries alongside the
+registry (gauges like ``restore_p50_ms``) are expected findings —
+they live in the suppression file with their why.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu._private.analysis import Finding
+
+METRICS_AGENT_REL = "ray_tpu/_private/metrics_agent.py"
+
+
+def _registries(sources) -> "list[tuple[object, str, int, tuple]]":
+    """[(source, registry name, line, keys)] for every module-level
+    ``*_STAT_KEYS = ("...", ...)``."""
+    out = []
+    for src in sources:
+        for node in src.tree.body:
+            if not isinstance(node, ast.Assign):
+                continue
+            for target in node.targets:
+                if not (isinstance(target, ast.Name)
+                        and target.id.endswith("_STAT_KEYS")):
+                    continue
+                if isinstance(node.value, ast.Tuple):
+                    keys = tuple(
+                        elt.value for elt in node.value.elts
+                        if isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, str))
+                    out.append((src, target.id, node.lineno, keys))
+    return out
+
+
+def registry_keys(module_rel_contains: str, registry_name: str,
+                  sources=None) -> "tuple[str, ...]":
+    """Parse one registry's keys from the AST (exported so
+    tests/test_doc_drift.py asserts docs against the same parser the
+    linter uses)."""
+    if sources is None:
+        from ray_tpu._private.analysis import (
+            default_package_root,
+            iter_sources,
+        )
+
+        sources = iter_sources(default_package_root())
+    for src, name, _, keys in _registries(sources):
+        if name == registry_name and module_rel_contains in src.rel:
+            return keys
+    return ()
+
+
+def _function_key_sets(tree, registry_name: str
+                       ) -> "list[tuple[str, set, bool]]":
+    """[(func qualname, emitted string keys, seeded-from-registry)]
+    per function: dict-literal keys + ``var["k"] =`` constants, and
+    whether a ``{k: ... for k in REGISTRY}`` comprehension seeds it."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        keys: set = set()
+        seeded = False
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Dict):
+                for key_node in sub.keys:
+                    if isinstance(key_node, ast.Constant) \
+                            and isinstance(key_node.value, str):
+                        keys.add(key_node.value)
+            elif isinstance(sub, ast.Assign):
+                for target in sub.targets:
+                    if isinstance(target, ast.Subscript) \
+                            and isinstance(target.slice, ast.Constant) \
+                            and isinstance(target.slice.value, str):
+                        keys.add(target.slice.value)
+            elif isinstance(sub, ast.DictComp):
+                for gen in sub.generators:
+                    it = gen.iter
+                    if isinstance(it, ast.Name) \
+                            and it.id == registry_name:
+                        seeded = True
+        if keys or seeded:
+            out.append((node.name, keys, seeded))
+    return out
+
+
+def run(sources) -> "list[Finding]":
+    findings: list[Finding] = []
+    seen_idents: set = set()
+
+    def emit(finding: Finding) -> None:
+        if finding.ident not in seen_idents:
+            seen_idents.add(finding.ident)
+            findings.append(finding)
+
+    metrics_text = ""
+    for src in sources:
+        if src.rel == METRICS_AGENT_REL:
+            metrics_text = src.text
+
+    for src, name, line, keys in _registries(sources):
+        if not keys:
+            emit(Finding("counter-keys", src.rel, line, name,
+                         f"{name} registry is empty"))
+            continue
+        builders = _function_key_sets(src.tree, name)
+        # Candidate stats builders: functions emitting at least half
+        # of this registry's keys (or seeded straight from it).
+        candidates = [(fn, ks, seeded) for fn, ks, seeded in builders
+                      if seeded or len(ks & set(keys)) * 2 >= len(keys)]
+        if not candidates:
+            emit(Finding(
+                "counter-keys", src.rel, line, f"{name}.builder",
+                f"no stats builder in {src.rel} emits {name}'s keys — "
+                f"the registry no longer matches any dict the module "
+                f"returns"))
+            continue
+        emitted_anywhere: set = set()
+        seeded_any = False
+        for _, ks, seeded in candidates:
+            emitted_anywhere |= ks
+            seeded_any = seeded_any or seeded
+        for key in keys:
+            if key not in emitted_anywhere and not seeded_any:
+                emit(Finding(
+                    "counter-keys", src.rel, line, f"{name}.{key}",
+                    f"registry key {key!r} ({name}) is never emitted "
+                    f"by the module's stats builders — stale registry "
+                    f"row"))
+        for fn, ks, seeded in candidates:
+            for key in sorted(ks - set(keys)):
+                emit(Finding(
+                    "counter-keys", src.rel, line,
+                    f"{name}.{fn}.{key}",
+                    f"{fn}() emits {key!r} next to the {name} "
+                    f"counters but the key is not registered — add it "
+                    f"to {name} (and a README row) or suppress with "
+                    f"its why"))
+        # Export check: the per-node family must exist in the agent.
+        group = name[: -len("_STAT_KEYS")].lower()
+        families = (f"ray_tpu_node_{group}", f"ray_tpu_node_{group}s")
+        if metrics_text and not any(f in metrics_text
+                                    for f in families):
+            emit(Finding(
+                "counter-keys", src.rel, line, f"{name}.family",
+                f"{name} has no ray_tpu_node_{group} family in "
+                f"metrics_agent.py — heartbeat-shipped counters never "
+                f"reach /metrics"))
+    return findings

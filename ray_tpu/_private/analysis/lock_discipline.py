@@ -1,0 +1,179 @@
+"""Pass ``lock-discipline``: a field guarded in one method is not
+touched bare in another.
+
+For every class the pass finds its lock attributes (``self.X``
+assigned from ``threading.Lock/RLock/Condition`` or the
+``lock_witness`` factories), then tracks which ``self.<field>``
+accesses happen inside a ``with self.X:`` block (or in a method that
+manually calls ``self.X.acquire()`` — conservatively treated as
+guarded throughout, since block extent is not statically knowable).
+
+A finding is a field that is WRITTEN under a lock in one method and
+written bare in a different method. The deliberately-conservative
+scope keeps the signal honest on this codebase's idioms:
+
+- ``__init__`` (and other pre-publication constructors named
+  ``_init*``) is exempt: objects under construction have no
+  concurrent readers;
+- methods whose name ends with ``_locked`` are treated as guarded —
+  the caller holds the lock by naming convention;
+- bare READS are not flagged: the runtime's hot paths read shared
+  counters and tables lock-free by design (GIL-atomic loads, memo
+  reads double-checked under the lock) and flagging every one would
+  drown the writes that actually corrupt state;
+- classes with no lock attribute are skipped — unlocked classes are
+  single-threaded by contract, a different review.
+
+Findings that survive triage as intentional (e.g. a monotonic counter
+bumped bare on the hot path, summed under the lock only for stats)
+get a suppression entry with the why.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu._private.analysis import Finding
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+# Constructor-like methods whose bare writes are pre-publication.
+_EXEMPT_METHODS = ("__init__", "__new__")
+
+
+def _is_lock_ctor(node: ast.expr) -> bool:
+    """``threading.Lock()`` / ``lock_witness.Condition(...)`` /
+    ``threading.Condition(threading.Lock())`` shapes."""
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    name = func.attr if isinstance(func, ast.Attribute) \
+        else func.id if isinstance(func, ast.Name) else None
+    return name in _LOCK_FACTORIES
+
+
+def _self_attr(node: ast.expr) -> "str | None":
+    if isinstance(node, ast.Attribute) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id == "self":
+        return node.attr
+    return None
+
+
+class _MethodScan(ast.NodeVisitor):
+    """One method body: which self-attrs are read/written, and under
+    which held lock attrs."""
+
+    def __init__(self, lock_attrs: "set[str]"):
+        self.lock_attrs = lock_attrs
+        self.held: list[str] = []
+        self.manual_acquire = False
+        # field -> list of (is_write, guarded, line)
+        self.accesses: dict[str, list] = {}
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired = []
+        for item in node.items:
+            ctx = item.context_expr
+            attr = _self_attr(ctx)
+            if attr in self.lock_attrs:
+                acquired.append(attr)
+        self.held.extend(acquired)
+        # The context expressions themselves evaluate unguarded.
+        for item in node.items:
+            self.visit(item.context_expr)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in acquired:
+            self.held.pop()
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = _self_attr(node)
+        if attr is not None and attr not in self.lock_attrs:
+            is_write = isinstance(node.ctx, (ast.Store, ast.Del))
+            self.accesses.setdefault(attr, []).append(
+                (is_write, bool(self.held), node.lineno))
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        # ``self.x += 1`` parses its target as Store only; count it as
+        # a write (it is also a read, but one site, one record).
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # ``self._lock.acquire()`` / ``.wait()``: block extent unknown
+        # — treat the whole method as guarded (conservative: hides
+        # bare accesses in such methods rather than inventing them).
+        func = node.func
+        if isinstance(func, ast.Attribute) \
+                and func.attr in ("acquire", "wait", "wait_for") \
+                and _self_attr(func.value) in self.lock_attrs:
+            self.manual_acquire = True
+        self.generic_visit(node)
+
+
+def _scan_class(src, cls: ast.ClassDef) -> "list[Finding]":
+    lock_attrs: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            for target in node.targets:
+                attr = _self_attr(target)
+                if attr:
+                    lock_attrs.add(attr)
+    if not lock_attrs:
+        return []
+
+    methods = [n for n in cls.body
+               if isinstance(n, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef))]
+    # field -> {"guarded": [(method, line)], "bare": [(method, line)]}
+    table: dict[str, dict] = {}
+    for method in methods:
+        scan = _MethodScan(lock_attrs)
+        for stmt in method.body:
+            scan.visit(stmt)
+        exempt = method.name in _EXEMPT_METHODS \
+            or method.name.startswith("_init")
+        convention_guarded = method.name.endswith("_locked") \
+            or scan.manual_acquire
+        for field, hits in scan.accesses.items():
+            rec = table.setdefault(field,
+                                   {"guarded": [], "bare": []})
+            for is_write, guarded, line in hits:
+                if not is_write:
+                    continue
+                if guarded or convention_guarded:
+                    rec["guarded"].append((method.name, line))
+                elif not exempt:
+                    rec["bare"].append((method.name, line))
+
+    findings: list[Finding] = []
+    for field, rec in sorted(table.items()):
+        if not rec["guarded"] or not rec["bare"]:
+            continue
+        guarded_methods = {m for m, _ in rec["guarded"]}
+        cross = [(m, ln) for m, ln in rec["bare"]
+                 if m not in guarded_methods]
+        if not cross:
+            # Same-method mixes are usually check-then-lock staging on
+            # locals; the cross-method writes are the corruption risk.
+            continue
+        method, line = cross[0]
+        others = "".join(f", {m}:{ln}" for m, ln in cross[1:3])
+        findings.append(Finding(
+            "lock-discipline", src.rel, line,
+            f"{cls.name}.{field}",
+            f"{cls.name}.{field} is written under "
+            f"{'/'.join(sorted(lock_attrs))} in "
+            f"{', '.join(sorted(guarded_methods))} but written BARE "
+            f"in {method}(){others} — take the lock or suppress with "
+            f"the why"))
+    return findings
+
+
+def run(sources) -> "list[Finding]":
+    findings: list[Finding] = []
+    for src in sources:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(_scan_class(src, node))
+    return findings

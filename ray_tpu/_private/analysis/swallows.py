@@ -1,0 +1,83 @@
+"""Pass ``swallows``: silent broad exception swallows.
+
+The class of bug PR 12's persist path fixed: ``_save_snapshot`` ate
+every OSError with a bare ``pass``, so a full disk silently disabled
+durability. The rule:
+
+- a bare ``except:`` is always a finding (even commented — name the
+  exception);
+- a handler whose body is ONLY ``pass``/``continue`` and whose type
+  includes ``Exception``, ``BaseException`` or ``OSError`` (alone or
+  in a tuple) is a finding UNLESS a comment on the handler's lines
+  states why the swallow is safe — the comment is the in-place
+  justification pragma, reviewed like any other code.
+
+Narrow-typed swallows (``except queue.Empty: pass``,
+``except FileNotFoundError: pass``) are idiomatic and not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu._private.analysis import Finding
+
+BROAD = {"Exception", "BaseException", "OSError"}
+
+
+def _type_names(node: "ast.expr | None") -> "list[str]":
+    if node is None:
+        return []
+    if isinstance(node, ast.Tuple):
+        return [n for elt in node.elts for n in _type_names(elt)]
+    if isinstance(node, ast.Attribute):
+        return [node.attr]
+    if isinstance(node, ast.Name):
+        return [node.id]
+    return ["<expr>"]
+
+
+def _qualifier(src, handler: ast.ExceptHandler) -> str:
+    """Stable suppression ident: the enclosing def/class chain."""
+    chain = []
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            if node.lineno <= handler.lineno \
+                    <= (node.end_lineno or node.lineno):
+                chain.append((node.lineno, node.name))
+    chain.sort()
+    return ".".join(name for _, name in chain) or "<module>"
+
+
+def run(sources) -> "list[Finding]":
+    findings: list[Finding] = []
+    for src in sources:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            names = _type_names(node.type)
+            bare = node.type is None
+            pass_only = all(isinstance(stmt, (ast.Pass, ast.Continue))
+                            for stmt in node.body)
+            if bare:
+                findings.append(Finding(
+                    "swallows", src.rel, node.lineno,
+                    f"{_qualifier(src, node)}:bare-except",
+                    "bare `except:` — name the exception type(s) this "
+                    "handler means to absorb"))
+                continue
+            if not pass_only or not (set(names) & BROAD):
+                continue
+            span = range(node.lineno,
+                         (node.body[-1].end_lineno or node.lineno) + 1)
+            if any(line in src.comment_lines for line in span):
+                continue  # justified in place
+            findings.append(Finding(
+                "swallows", src.rel, node.lineno,
+                f"{_qualifier(src, node)}:"
+                f"{'-'.join(sorted(names))}",
+                f"silent swallow of {'/'.join(sorted(names))} — "
+                f"handle it (counter + flight_recorder), narrow the "
+                f"type, or justify with a comment on the handler"))
+    return findings

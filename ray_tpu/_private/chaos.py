@@ -69,6 +69,31 @@ import random
 import threading
 
 
+# Canonical injection-site registry — THE contract between code,
+# spec strings, tests and docs. The analysis pass ``chaos-sites``
+# (ray_tpu/_private/analysis/chaos_sites.py) mechanically enforces:
+# every ``should("<site>")`` in the tree names a registered site, and
+# every registered site is documented in this module's docstring and
+# exercised somewhere under tests/. Add the site here FIRST.
+SITES: "tuple[str, ...]" = (
+    "rpc.sever",
+    "rpc.drop_frame",
+    "rpc.delay",
+    "rpc.kill_stream",
+    "net.partition",
+    "gcs.torn_snapshot",
+    "gcs.torn_wal",
+    "heartbeat.skip",
+    "daemon.die",
+    "lease.expire",
+    "overload.saturate",
+    "sched.straggle",
+    "spill.torn_write",
+    "spill.disk_full",
+    "spill.restore_delay",
+)
+
+
 class ChaosController:
     """Seeded, named injection points with per-site rates and caps."""
 

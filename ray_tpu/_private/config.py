@@ -285,6 +285,17 @@ _DEFAULTS: dict[str, Any] = {
     "flight_recorder_events": 512,
     # Daemon-side ring-flush period (seconds); 0 = dump-on-demand only.
     "flight_recorder_flush_s": 2.0,
+    # Runtime lock-order witness (lock_witness.py): armed, the hot
+    # modules' locks record a per-thread held-set and a global
+    # acquisition-order graph; a cycle (two lock classes taken in both
+    # orders — a potential deadlock) flight-records both stacks and
+    # raises LockOrderError. Tier-1 and the chaos soak arm it
+    # (RAY_TPU_LOCK_WITNESS=1); production stays disarmed — the
+    # factories then return plain threading objects, so the acquire
+    # path is byte-identical to an unwitnessed build. Bench envelope
+    # refreshes record the state and test_bench_regression refuses a
+    # witness-armed refresh.
+    "lock_witness": False,
     # Native (C++) daemon blob store (node_store.cpp); falls back to
     # the Python store when the toolchain/library is unavailable.
     "node_store_native": True,

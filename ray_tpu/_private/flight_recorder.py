@@ -195,7 +195,7 @@ def _prune_stale_dumps(max_age_s: float = 3 * 86400) -> None:
             if os.path.getmtime(path) < cutoff:
                 os.unlink(path)
         except OSError:
-            continue
+            continue  # raced with another pruner / RO fs
 
 
 def get() -> FlightRecorder | None:
@@ -230,7 +230,7 @@ def collect_session_dumps() -> list[dict]:
             with open(os.path.join(flight_dir(), name)) as f:
                 doc = json.load(f)
         except (OSError, ValueError):
-            continue
+            continue  # malformed or mid-write ring file: skip
         if isinstance(doc, dict):
             doc["file"] = name
             out.append(doc)

@@ -15,6 +15,8 @@ fault tolerance (reference: store_client/redis_store_client.h:33).
 from __future__ import annotations
 
 import threading
+
+from ray_tpu._private import lock_witness
 import time
 from collections import defaultdict
 from dataclasses import dataclass, field
@@ -49,7 +51,7 @@ class KVStore:
     """Namespaced key-value store (reference: gcs_kv_manager.h)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lock_witness.Lock("gcs.KVStore")
         self._data: dict[str, dict[bytes, bytes]] = defaultdict(dict)
         # Monotonic change counter: persistence snapshots only when dirty.
         self.version = 0
@@ -104,7 +106,7 @@ class ObjectDirectory:
     driver exited) is pruned wholesale."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lock_witness.Lock("gcs.ObjectDirectory")
         # owner addr -> {object hex -> {node hex, ...}}
         self._locations: dict[str, dict[str, set[str]]] = {}
         # owner addr -> {object hex -> node hex}: copies currently on
@@ -303,7 +305,7 @@ class PubSub:
     """In-process pub/sub hub (reference: src/ray/pubsub/publisher.h:307)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lock_witness.Lock("gcs.PubSub")
         self._subs: dict[str, list[Callable[[Any], None]]] = defaultdict(list)
 
     def subscribe(self, channel: str, callback: Callable[[Any], None]) -> Callable[[], None]:
@@ -325,8 +327,13 @@ class PubSub:
         for cb in callbacks:
             try:
                 cb(message)
-            except Exception:
-                pass
+            except Exception:  # noqa: BLE001 — one bad subscriber must not starve the rest
+                # Flight-recorded instead of silently eaten: a
+                # subscriber raising on every publish is a real bug
+                # (lost actor/node events) that used to be invisible.
+                from ray_tpu._private import flight_recorder
+
+                flight_recorder.record("pubsub.callback_error", channel)
 
 
 @dataclass
@@ -418,7 +425,7 @@ class GlobalControlService:
         # native build.
         self.kv = kv if kv is not None else KVStore()
         self.pubsub = PubSub()
-        self._lock = threading.Lock()
+        self._lock = lock_witness.Lock("gcs.GlobalControlService")
         self._actors: dict[ActorID, ActorRecord] = {}
         self._named_actors: dict[tuple[str, str], ActorID] = {}
         self._nodes: dict[NodeID, NodeRecord] = {}
@@ -443,7 +450,8 @@ class GlobalControlService:
         # (stats, receipt monotonic): the receipt stamp ages a wedged
         # daemon's last report out of the load-aware scheduler's view.
         self._node_stats: dict[str, tuple] = {}
-        self._node_stats_lock = threading.Lock()
+        self._node_stats_lock = lock_witness.Lock(
+            "gcs.GlobalControlService.node_stats")
 
     # ----------------------------------------------------------- persistence
 

@@ -101,7 +101,7 @@ def write_snapshot(path: str, payload: bytes, fsync: bool = False) -> None:
             try:
                 os.unlink(path)
             except OSError:
-                pass
+                pass  # tear already handled; unlink is tidy-up
         else:
             os.replace(path, path + ".prev")
     os.replace(tmp, path)
@@ -181,7 +181,7 @@ class WalWriter:
             try:
                 self._f.close()
             except OSError:
-                pass
+                pass  # WAL handle already torn down
 
 
 def replay_wal(path: str, min_seq: int, apply_fn) -> dict:
@@ -237,7 +237,7 @@ def replay_wal(path: str, min_seq: int, apply_fn) -> dict:
             try:
                 f.truncate(good_end)
             except OSError:
-                pass
+                pass  # RO fs: replay still proceeded
     return stats
 
 

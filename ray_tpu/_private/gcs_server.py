@@ -16,6 +16,8 @@ from __future__ import annotations
 import os
 import subprocess
 import threading
+
+from ray_tpu._private import lock_witness
 import time
 from typing import Any
 
@@ -44,7 +46,7 @@ class JobManager:
         self.log_dir = log_dir
         os.makedirs(log_dir, exist_ok=True)
         self._procs: dict[str, subprocess.Popen] = {}
-        self._lock = threading.Lock()
+        self._lock = lock_witness.Lock("gcs_server.JobManager")
 
     def submit(self, entrypoint: str, *, submission_id: str | None = None,
                env: dict | None = None, cwd: str | None = None) -> str:
@@ -171,7 +173,7 @@ class JobManager:
             try:
                 proc.terminate()
             except OSError:
-                pass
+                pass  # entrypoint already exited
 
 
 class GcsServer:
@@ -216,7 +218,7 @@ class GcsServer:
         self.epoch = 0
         self._wal = None
         self._wal_seq = 0
-        self._persist_lock = threading.Lock()
+        self._persist_lock = lock_witness.Lock("gcs_server.GcsServer.persist")
         self._persist_backoff_until = 0.0
         self._last_snapshot_at = 0.0
         self._persist_stats = {
@@ -237,7 +239,7 @@ class GcsServer:
         # crash with the rest of the hot set.
         self._pg_table: dict[str, list] = {}
         self._pg_version = 0
-        self._pg_lock = threading.Lock()
+        self._pg_lock = lock_witness.Lock("gcs_server.GcsServer.pg")
         if persist_path and self._persist_armed:
             from ray_tpu._private import gcs_persistence as gp
 
@@ -274,13 +276,13 @@ class GcsServer:
         # Last availability published per node (change detection for
         # the "node_resources" syncer channel).
         self._last_published_avail: dict[str, dict] = {}
-        self._avail_lock = threading.Lock()
+        self._avail_lock = lock_witness.Lock("gcs_server.GcsServer.avail")
         # Daemon trace spans shipped on heartbeats, staged until a
         # driver drains them into its merged timeline. Bounded: a
         # cluster tracing with no driver exporting must not grow this
         # without limit.
         self._trace_spans: list[dict] = []
-        self._trace_lock = threading.Lock()
+        self._trace_lock = lock_witness.Lock("gcs_server.GcsServer.trace")
         self._register_methods()
         self._monitor = threading.Thread(
             target=self._monitor_loop, daemon=True, name="gcs-monitor")
@@ -790,7 +792,17 @@ class GcsServer:
                 # framed format.
                 self._restore_snapshot()
                 return
+            except FileNotFoundError:
+                continue  # no snapshot at this path yet: first boot
             except (OSError, EOFError, pickle.UnpicklingError):
+                # Unreadable (not merely absent) snapshot: count and
+                # flight-record it — restore falls back to .prev +
+                # WAL, but silently eating a corrupt current snapshot
+                # is how durability bugs hide (the PR 12 lesson).
+                with self._persist_lock:
+                    self._persist_stats["persist_errors"] += 1
+                flight_recorder.record("gcs.persist_error",
+                                       "restore", path)
                 continue
         base_seq = 0
         if state is not None:

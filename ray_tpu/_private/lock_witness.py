@@ -1,0 +1,311 @@
+"""Runtime lock-order witness: deadlock detection for the threaded core.
+
+Reference intent: the reference enforces its C++ lock discipline with
+sanitizer walls (TSAN bazel configs, absl lock annotations); the Python
+runtime here has ~40 threaded modules whose lock ordering is enforced
+only by convention. This module is the mechanical check: the hot
+modules (scheduler, object_store, gcs, gcs_server, node_executor,
+spill_manager, same_host, rpc) create their locks through the
+``Lock``/``RLock``/``Condition`` factories below, and when the witness
+is ARMED (``lock_witness`` knob / ``RAY_TPU_LOCK_WITNESS=1`` — tier-1
+and the chaos soak arm it; production never does) every blocking
+acquire:
+
+- records the acquisition edge ``held-class -> acquiring-class`` into
+  a process-global order graph (lock CLASS = the factory's name
+  string, so every instance of ``"rpc.MuxRpcClient.state"`` shares one
+  node), and
+- on a NEW edge, searches the graph for a path back — a cycle means
+  two code paths take the same two lock classes in opposite orders,
+  i.e. a potential deadlock that only needs the right thread
+  interleaving to become a real one.
+
+A detected cycle flight-records BOTH stacks (the acquire that closed
+the cycle and the first acquire that created the reverse edge) and
+raises ``LockOrderError`` so the test that drove the interleaving
+fails loudly instead of the deadlock surfacing as a CI timeout months
+later.
+
+Disarm discipline (same idiom as the other planes' ``TRACE_ON`` /
+``PERF_ON`` / ``SPILL_ON`` gates): the factories branch on the ONE
+module attribute ``WITNESS_ON`` at lock-construction time and return
+plain ``threading`` objects when disarmed — the production acquire
+path is byte-identical to an unwitnessed build, not merely cheap.
+
+Known limits (by design, kept simple):
+
+- Same-class edges are skipped: two instances of one lock class
+  acquired together (ordered iteration over per-connection locks)
+  would self-loop the class node and drown real findings.
+- Non-blocking ``acquire(False)`` records no edge — a trylock cannot
+  deadlock its own acquisition — but the held-set still tracks it so
+  later blocking acquires see the order.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+
+# The ONE production branch (read at lock construction): False unless
+# the lock_witness knob / RAY_TPU_LOCK_WITNESS env is set.
+WITNESS_ON: bool = False
+
+
+class LockOrderError(RuntimeError):
+    """Two lock classes were acquired in both orders — a potential
+    deadlock. Carries both acquisition stacks."""
+
+    def __init__(self, message: str, cycle: dict):
+        super().__init__(message)
+        self.cycle = cycle
+
+
+# --------------------------------------------------------------------------
+# Witness state (process-global; the graph lock is a PLAIN lock and is
+# never held while calling out — the witness must not deadlock itself).
+# --------------------------------------------------------------------------
+
+_GRAPH_LOCK = threading.Lock()
+_EDGES: "dict[str, set[str]]" = {}          # class -> classes acquired under it
+_EDGE_SITES: "dict[tuple[str, str], str]" = {}  # first stack per edge
+_CYCLES: "list[dict]" = []                  # detected findings (kept forever)
+_ACQUIRES = 0                               # armed blocking acquires observed
+
+_TLS = threading.local()
+
+
+def _held() -> list:
+    held = getattr(_TLS, "held", None)
+    if held is None:
+        held = _TLS.held = []
+    return held
+
+
+def _find_path(src: str, dst: str) -> "list[str] | None":
+    """DFS path src -> dst over _EDGES (caller holds _GRAPH_LOCK)."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        for nxt in _EDGES.get(node, ()):
+            if nxt == dst:
+                return path + [dst]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _note_acquire(lock) -> None:
+    """Pre-acquire bookkeeping for a blocking acquire: record edges
+    from every held lock class and check each NEW edge for a cycle."""
+    global _ACQUIRES
+    _ACQUIRES += 1
+    held = _held()
+    if not held:
+        return
+    for entry in held:
+        if entry is lock:
+            return  # reentrant re-acquire: no new ordering information
+    name = lock._witness_name
+    prior_names = {entry._witness_name for entry in held}
+    prior_names.discard(name)  # same-class edges skipped (see docstring)
+    finding = None
+    for prior in prior_names:
+        with _GRAPH_LOCK:
+            known = _EDGES.get(prior)
+            if known is not None and name in known:
+                continue  # edge already proven safe (or already reported)
+            if known is None:
+                _EDGES[prior] = known = set()
+            known.add(name)
+            stack_here = "".join(traceback.format_stack(limit=16)[:-2])
+            _EDGE_SITES[(prior, name)] = stack_here
+            # The new edge prior->name closes a cycle iff name already
+            # reaches prior.
+            path = _find_path(name, prior)
+            if path is None:
+                continue
+            reverse_stack = _EDGE_SITES.get((path[0], path[1]), "")
+            finding = {
+                "cycle": path + [name],
+                "edge": (prior, name),
+                "thread": threading.current_thread().name,
+                "stack": stack_here,
+                "reverse_stack": reverse_stack,
+            }
+            _CYCLES.append(finding)
+        if finding is not None:
+            break
+    if finding is not None:
+        from ray_tpu._private import flight_recorder
+
+        flight_recorder.record("lock.cycle", "->".join(finding["cycle"]))
+        raise LockOrderError(
+            f"lock-order cycle: acquiring {name!r} while holding "
+            f"{finding['edge'][0]!r}, but the reverse order "
+            f"{' -> '.join(finding['cycle'])} is already on record.\n"
+            f"--- this acquire ---\n{finding['stack']}"
+            f"--- first reverse acquire ---\n{finding['reverse_stack']}",
+            finding)
+
+
+def _pop_held(lock) -> None:
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] is lock:
+            del held[i]
+            return
+    # Released on a thread that never acquired it (plain Locks allow
+    # this — handoff patterns); nothing to pop.
+
+
+# --------------------------------------------------------------------------
+# Wrappers
+# --------------------------------------------------------------------------
+
+
+class _WitnessLockBase:
+    _inner_factory = staticmethod(threading.Lock)
+
+    def __init__(self, name: str):
+        self._witness_name = name
+        self._inner = self._inner_factory()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if blocking:
+            _note_acquire(self)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _held().append(self)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        _pop_held(self)
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        return self.acquire()
+
+    def __exit__(self, exc_type, exc, tb):
+        self.release()
+
+    def __repr__(self):
+        return (f"<witness {type(self).__name__} "
+                f"{self._witness_name!r} over {self._inner!r}>")
+
+
+class _WitnessLock(_WitnessLockBase):
+    pass
+
+
+class _WitnessRLock(_WitnessLockBase):
+    _inner_factory = staticmethod(threading.RLock)
+
+    # threading.Condition protocol: delegate the save/restore trio to
+    # the inner RLock, keeping the thread's held-set in sync so a
+    # wait() (full release) doesn't leave phantom held entries.
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        state = self._inner._release_save()
+        held = _held()
+        count = 0
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is self:
+                del held[i]
+                count += 1
+        return (state, count)
+
+    def _acquire_restore(self, state) -> None:
+        inner_state, count = state
+        self._inner._acquire_restore(inner_state)
+        held = _held()
+        for _ in range(count):
+            held.append(self)
+
+
+def Lock(name: str):
+    """A mutex for lock class ``name`` ("module.Class.role"): plain
+    ``threading.Lock`` disarmed, witness-wrapped armed."""
+    if not WITNESS_ON:
+        return threading.Lock()
+    return _WitnessLock(name)
+
+
+def RLock(name: str):
+    if not WITNESS_ON:
+        return threading.RLock()
+    return _WitnessRLock(name)
+
+
+def Condition(name: str, plain_lock: bool = False):
+    """A condition variable whose underlying mutex joins the witness
+    graph as ``name``. ``plain_lock`` keeps the non-reentrant inner
+    Lock some call sites choose for its lower acquire cost."""
+    if not WITNESS_ON:
+        return threading.Condition(
+            threading.Lock() if plain_lock else None)
+    inner = _WitnessLock(name) if plain_lock else _WitnessRLock(name)
+    return threading.Condition(inner)
+
+
+# --------------------------------------------------------------------------
+# Arming + introspection
+# --------------------------------------------------------------------------
+
+
+def arm(on: bool = True) -> None:
+    global WITNESS_ON
+    WITNESS_ON = bool(on)
+
+
+def init_from_config() -> None:
+    """Arm/disarm from the ``lock_witness`` knob (Runtime init and
+    daemon boot both pass through here; locks created before a late
+    re-arm stay plain — arm via the environment to witness a whole
+    process)."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    arm(bool(GLOBAL_CONFIG.lock_witness))
+
+
+def stats() -> dict:
+    with _GRAPH_LOCK:
+        return {"armed": WITNESS_ON,
+                "acquires": _ACQUIRES,
+                "lock_classes": len(
+                    set(_EDGES) | {b for bs in _EDGES.values()
+                                   for b in bs}),
+                "edges": sum(len(v) for v in _EDGES.values()),
+                "cycles": len(_CYCLES)}
+
+
+def cycles() -> "list[dict]":
+    with _GRAPH_LOCK:
+        return list(_CYCLES)
+
+
+def reset() -> None:
+    """Clear the order graph and recorded findings (tests only; held
+    sets are per-thread and drain naturally as locks release)."""
+    global _ACQUIRES
+    with _GRAPH_LOCK:
+        _EDGES.clear()
+        _EDGE_SITES.clear()
+        _CYCLES.clear()
+        _ACQUIRES = 0
+
+
+# Env-driven arming at import (same pattern as chaos.py): spawned
+# daemons inherit RAY_TPU_LOCK_WITNESS through daemon_child_env, so
+# arming a test session witnesses every process in the cluster.
+if os.environ.get("RAY_TPU_LOCK_WITNESS", "").lower() in (
+        "1", "true", "yes", "on"):
+    arm(True)

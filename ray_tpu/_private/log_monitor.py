@@ -110,7 +110,7 @@ class LogMonitor:
                     try:
                         stale[0].close()
                     except OSError:
-                        pass
+                        pass  # rotated file already closed
                 continue
             if not chunk:
                 continue
@@ -141,5 +141,5 @@ class LogMonitor:
             try:
                 entry[0].close()
             except OSError:
-                pass
+                pass  # shutdown: handle may be closed
         self._files.clear()

@@ -258,7 +258,7 @@ class NodeAgent:
             try:
                 self.client.call("drain_node", self.node_id)
             except (RpcError, RpcMethodError, OSError):
-                pass
+                pass  # drain is advisory; head may be gone
         self.client.close()
 
 
@@ -403,7 +403,7 @@ def run_head(port: int, resources: dict | None = None,
             try:
                 os.unlink(snapshot_path + suffix)
             except OSError:
-                pass
+                pass  # generation file already absent
 
 
 def run_worker(gcs_address: str, resources: dict | None = None,

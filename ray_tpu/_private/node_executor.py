@@ -31,6 +31,8 @@ from __future__ import annotations
 import collections
 import os
 import threading
+
+from ray_tpu._private import lock_witness
 import time
 from dataclasses import dataclass
 from typing import Any, Callable
@@ -170,7 +172,7 @@ class NodeObjectStore:
                  spill_dir: str | None = None):
         from ray_tpu._private.config import GLOBAL_CONFIG
 
-        self._lock = threading.Lock()
+        self._lock = lock_witness.Lock("node_executor.NodeObjectStore")
         self._blobs: dict[bytes, bytes] = {}  # insertion-ordered
         self._cached: dict[bytes, None] = {}  # pulled copies, FIFO evict
         self._cache_limit = (
@@ -314,7 +316,7 @@ class NodeObjectStore:
                         # is long gone: the object is LOST here. Drop
                         # it entirely so fetchers see absence and the
                         # owner reconstructs from lineage.
-                        self._forget(key)
+                        self._forget_locked(key)
                 return None
             except OSError:
                 continue  # another reader restored + unlinked; re-check
@@ -334,7 +336,7 @@ class NodeObjectStore:
             try:
                 os.unlink(path)
             except OSError:
-                pass
+                pass  # restored copy is safe; file is tidy-up
             if self._on_restored is not None:
                 self._on_restored(key, owner)
             # The restore may have pushed usage back over the HIGH
@@ -421,7 +423,7 @@ class NodeObjectStore:
             try:
                 os.unlink(path)
             except OSError:
-                pass
+                pass  # stale spill file already swept
 
     def _drop_spilled(self, id_bytes: bytes) -> None:
         # Caller holds self._lock.
@@ -437,7 +439,7 @@ class NodeObjectStore:
             try:
                 os.unlink(entry[0])
             except OSError:
-                pass
+                pass  # spill file already gone
 
     def get(self, id_bytes: bytes) -> bytes | None:
         with self._lock:
@@ -462,8 +464,9 @@ class NodeObjectStore:
             return data
         return None
 
-    def _forget(self, id_bytes: bytes) -> bool:
-        # Caller holds self._lock. Returns True if the id existed.
+    def _forget_locked(self, id_bytes: bytes) -> bool:
+        # _locked suffix: caller holds self._lock (the lock-discipline
+        # pass verifies the convention). Returns True if the id existed.
         existed = False
         blob = self._blobs.pop(id_bytes, None)
         if blob is not None:
@@ -487,13 +490,13 @@ class NodeObjectStore:
 
     def free(self, ids: list[bytes]) -> int:
         with self._lock:
-            return sum(1 for id_bytes in ids if self._forget(id_bytes))
+            return sum(1 for id_bytes in ids if self._forget_locked(id_bytes))
 
     def free_owner(self, owner: str) -> int:
         """Owner-death sweep: drop every primary the owner left here."""
         with self._lock:
             ids = list(self._owned_ids.get(owner, ()))
-            return sum(1 for id_bytes in ids if self._forget(id_bytes))
+            return sum(1 for id_bytes in ids if self._forget_locked(id_bytes))
 
     def owners(self) -> list[str]:
         with self._lock:
@@ -574,7 +577,7 @@ class _PeerClients:
     concurrent chunk fetches interleave on a single socket per pair)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lock_witness.Lock("node_executor._PeerClients")
         self._clients: dict[str, MuxRpcClient] = {}
 
     def get(self, addr: str) -> MuxRpcClient:
@@ -659,7 +662,7 @@ class ChunkDirectory:
     TTL_S = 180.0
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lock_witness.Lock("node_executor.ChunkDirectory")
         # id -> {holder addr -> registered-at monotonic}
         self._holders: dict[bytes, dict[str, float]] = {}
 
@@ -747,7 +750,7 @@ class _PartialBlob:
         self.external = buf is not None
         self.buf = buf if buf is not None else bytearray(total)
         self.have: set[int] = set()
-        self.lock = threading.Lock()
+        self.lock = lock_witness.Lock("node_executor._PartialBlob")
         self.done = threading.Event()
         self.error: BaseException | None = None
         self.completed_at: float | None = None
@@ -810,7 +813,7 @@ class _PipelineInflight:
 
     def __init__(self, service: "NodeExecutorService"):
         self._service = service
-        self._lock = threading.Lock()
+        self._lock = lock_witness.Lock("node_executor._PipelineInflight")
         self._leases: dict = {}        # lease key -> [token, ...]
         self._token_lease: dict = {}   # token -> lease key
         self._parked: set = set()
@@ -919,8 +922,8 @@ class _MuxPipe:
 
         self._queue_mod = queue_mod
         self._conn = conn
-        self._send_lock = threading.Lock()
-        self._lock = threading.Lock()
+        self._send_lock = lock_witness.Lock("node_executor._MuxPipe.send")
+        self._lock = lock_witness.Lock("node_executor._MuxPipe.state")
         self._pending: dict[int, Any] = {}
         self._next_id = 0
         self._closed = False
@@ -1035,7 +1038,7 @@ class _DaemonActor:
         try:
             self._worker.conn.close()
         except OSError:
-            pass
+            pass  # worker pipe already torn down
 
 
 class NodeExecutorService:
@@ -1065,7 +1068,8 @@ class NodeExecutorService:
         # (owner, obj_hex, "spilled"|"restored") deltas pending the
         # next heartbeat's stats piggyback into the GCS directory.
         self._spill_events: list = []
-        self._spill_events_lock = threading.Lock()
+        self._spill_events_lock = lock_witness.Lock(
+            "node_executor.NodeExecutorService.spill_events")
         self.spilled_plan_hits = 0  # pulls whose plan flagged a spill
         if _spill_mod.SPILL_ON and isinstance(self.store,
                                               NodeObjectStore):
@@ -1084,7 +1088,8 @@ class NodeExecutorService:
         # P2P transfer plane: in-progress/relay pulls servable to peers
         # + the holder directory for objects THIS node owns.
         self._partials: dict[bytes, _PartialBlob] = {}
-        self._partials_lock = threading.Lock()
+        self._partials_lock = lock_witness.Lock(
+            "node_executor.NodeExecutorService.partials")
         self.chunk_directory = ChunkDirectory()
         self._advertised_address: str | None = None
         self.relay_chunks_served = 0  # cumulative, survives partial GC
@@ -1127,7 +1132,8 @@ class NodeExecutorService:
         self._attached_owner_strikes: dict[str, int] = {}
         # Worker-bound arg blobs promoted to shared memory: keyed by the
         # object's id bytes in the node's shm directory; FIFO-bounded.
-        self._shm_args_lock = threading.Lock()
+        self._shm_args_lock = lock_witness.Lock(
+            "node_executor.NodeExecutorService.shm_args")
         self._shm_args_order: list[tuple[bytes, int]] = []
         self._shm_args_bytes = 0
         # key -> monotonic stamp of the last worker-bound _ShmRef
@@ -1137,12 +1143,14 @@ class NodeExecutorService:
         # spill-protected for _SHM_ARG_GRACE_S.
         self._shm_out_stamp: dict[bytes, float] = {}
         self._resources = dict(resources or {})
-        self._running_lock = threading.Lock()
+        self._running_lock = lock_witness.Lock(
+            "node_executor.NodeExecutorService.running")
         self._running: dict[str, dict[str, float]] = {}
         # token -> CPU share temporarily returned by a blocked task.
         self._blocked_cpu: dict[str, float] = {}
         self._func_cache: dict[str, Callable] = {}
-        self._func_lock = threading.Lock()
+        self._func_lock = lock_witness.Lock(
+            "node_executor.NodeExecutorService.func")
         # Raw function blobs by digest: the batch path forwards these
         # to pool workers verbatim (the daemon never loads them).
         self._func_blob_cache: dict[str, bytes] = {}
@@ -1176,7 +1184,8 @@ class NodeExecutorService:
         # before a task's user function runs — a straggler still held
         # in admission (or a chaos sched.straggle delay) whose sibling
         # copy already sealed provably never executes. Bounded FIFO.
-        self._cancel_lock = threading.Lock()
+        self._cancel_lock = lock_witness.Lock(
+            "node_executor.NodeExecutorService.cancel")
         self._cancelled_tokens: "collections.OrderedDict" = \
             collections.OrderedDict()
         # Fired (outside the ledger lock) whenever admission state
@@ -1186,7 +1195,8 @@ class NodeExecutorService:
         self._load_listener: Callable[[], None] | None = None
         # Actor plane: actor key (bytes) -> _DaemonActor.
         self._actors: dict[bytes, _DaemonActor] = {}
-        self._actors_lock = threading.Lock()
+        self._actors_lock = lock_witness.Lock(
+            "node_executor.NodeExecutorService.actors")
         # Creation gate: keys whose constructor is in flight. An
         # actor_call declaring awaiting_create waits here instead of
         # bouncing "gone" — the driver pipelines __init__ with the
@@ -1199,7 +1209,8 @@ class NodeExecutorService:
         # forks overlap RPC waits instead of sitting on the creation
         # critical path.
         self._standby: dict[tuple, list] = {}
-        self._standby_lock = threading.Lock()
+        self._standby_lock = lock_witness.Lock(
+            "node_executor.NodeExecutorService.standby")
         self._standby_refilling: set[tuple] = set()
         self._standby_target = 2
         self._stop_event = threading.Event()
@@ -1359,7 +1370,7 @@ class NodeExecutorService:
                 try:
                     seg.close()
                 except (BufferError, OSError):
-                    pass
+                    pass  # exported buffers pin the map; tracker reaps
         self._peer_arenas.close_all()
         with self._actors_lock:
             actors = list(self._actors.values())
@@ -1945,7 +1956,8 @@ class NodeExecutorService:
         self.batch_rpcs += 1
         self.batch_tasks_received += len(entries)
         n = len(entries)
-        cond = threading.Condition(threading.Lock())
+        cond = lock_witness.Condition(
+            "node_executor.batch_wait", plain_lock=True)
         completions: list = []
         control: list = []
 
@@ -3163,7 +3175,7 @@ class NodeExecutorService:
                     seg.unlink()
                     seg.close()
                 except (OSError, BufferError):
-                    pass
+                    pass  # partial already unusable; raising below
             raise
         if to_shm:
             # The segment is the final copy: register it (workers map
@@ -3222,7 +3234,7 @@ class NodeExecutorService:
                     try:
                         seg.close()
                     except (BufferError, OSError):
-                        pass
+                        pass  # peer may hold exports; tracker reaps
                 self._unpin_at(owner_addr, token)
                 self.same_host_copy_hits += 1
                 self.store.put(key, blob, cached=True)
@@ -3304,7 +3316,7 @@ class NodeExecutorService:
                             seg.unlink()
                         seg.close()
                     except (OSError, BufferError):
-                        pass
+                        pass  # peer may hold exports; tracker reaps
                 if attached is not None:
                     redundant_lease = attached
             else:
@@ -3358,7 +3370,7 @@ class NodeExecutorService:
                 try:
                     seg.close()
                 except (BufferError, OSError):
-                    pass
+                    pass  # peer may hold exports; tracker reaps
             self._unpin_at(owner_addr, token)
 
     def _unpin_at(self, owner_addr: str, token: str) -> None:
@@ -3741,7 +3753,8 @@ class RemoteNodeHandle:
         # watcher behind the pool's task-length timeouts.
         self._control = RpcClient(address, timeout_s=5.0,
                                   connect_timeout_s=2.0)
-        self._digest_lock = threading.Lock()
+        self._digest_lock = lock_witness.Lock(
+            "node_executor.RemoteNodeHandle.digest")
         self.known_digests: set[str] = set()
         self._sys_path_sent = False
 

@@ -193,7 +193,7 @@ def purge_stale_spills(spill_dir: str) -> None:
             try:
                 os.unlink(os.path.join(spill_dir, name))
             except OSError:
-                pass
+                pass  # another sweeper won the unlink
         except OSError:
             pass  # alive but not ours (EPERM): leave it
 

@@ -75,7 +75,7 @@ class ObjectRef:
                 if runtime is not None:
                     runtime.reference_counter.defer_remove(self._id)
             except BaseException:
-                pass
+                pass  # interpreter teardown: runtime half-gone
 
     def __reduce__(self):
         # Deserializing creates a borrower reference on the receiving side.

@@ -24,6 +24,8 @@ from __future__ import annotations
 import os
 import pickle
 import threading
+
+from ray_tpu._private import lock_witness
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
@@ -44,14 +46,14 @@ def _sizeof(value: Any) -> int:
         if isinstance(value, np.ndarray):
             return int(value.nbytes)
     except Exception:
-        pass
+        pass  # numpy absent/half-imported: not an ndarray
     try:
         import jax
 
         if isinstance(value, jax.Array):
             return int(value.size * value.dtype.itemsize)
     except Exception:
-        pass
+        pass  # jax absent/half-imported: not a jax.Array
     if isinstance(value, (bytes, bytearray, memoryview)):
         return len(value)
     if isinstance(value, str):
@@ -99,7 +101,7 @@ class ObjectStore:
         # THIS store from the same thread. A plain lock deadlocks there
         # (observed: _seal's _sizeof iterating a container whose temp
         # refs die mid-iteration).
-        self._lock = threading.Condition(threading.RLock())
+        self._lock = lock_witness.Condition("object_store.ObjectStore")
         self._entries: dict[ObjectID, ObjectEntry] = {}
         self._memory_limit = memory_limit_bytes
         self._memory_used = 0
@@ -230,7 +232,7 @@ class ObjectStore:
         try:
             os.unlink(path)
         except OSError:
-            pass
+            pass  # spill file already gone
 
     # ------------------------------------------------------------------ put
 
@@ -441,7 +443,7 @@ class ObjectStore:
                     try:
                         os.unlink(path)
                     except OSError:
-                        pass
+                        pass  # torn file: loss handled via _TornRestore
                     raise _TornRestore() from exc
             else:
                 try:
@@ -454,7 +456,7 @@ class ObjectStore:
                     try:
                         os.unlink(path)
                     except OSError:
-                        pass
+                        pass  # restore won; file unlink is tidy-up
                     entry.spilled_path = None
                     entry.managed_spill = False
                     entry.value = value
@@ -614,7 +616,7 @@ class ObjectStore:
                     try:
                         os.unlink(path)
                     except OSError:
-                        pass
+                        pass  # evicted copy's file already gone
 
     # ----------------------------------------------------------------- stats
 
@@ -671,7 +673,7 @@ class ReferenceCounter:
     def __init__(self, store: ObjectStore):
         import collections
 
-        self._lock = threading.Lock()
+        self._lock = lock_witness.Lock("object_store.ReferenceCounter")
         self._counts: dict[ObjectID, int] = {}
         self._store = store
         # Optional hook fired after refcount-zero eviction (the runtime

@@ -26,6 +26,8 @@ import select
 import socket
 import struct
 import threading
+
+from ray_tpu._private import lock_witness
 import time
 import traceback
 from typing import Any, Callable
@@ -112,7 +114,7 @@ def classify_rpc_failure(exc: BaseException) -> str:
 
 # Process-wide transport fault counters, surfaced through
 # executor_stats()["faults"] / Runtime.fault_stats().
-_FAULTS_LOCK = threading.Lock()
+_FAULTS_LOCK = lock_witness.Lock("rpc.FAULTS")
 _RPC_RETRIES = 0
 
 
@@ -133,7 +135,7 @@ class _Breaker:
         self.probing = False
 
 
-_BREAKERS_LOCK = threading.Lock()
+_BREAKERS_LOCK = lock_witness.Lock("rpc.BREAKERS")
 _BREAKERS: dict[str, _Breaker] = {}
 _BREAKER_OPENS = 0  # monotonic: total closed->open transitions
 
@@ -410,7 +412,7 @@ class _ThreadRecycler:
     def __init__(self, name: str, idle_s: float = 10.0):
         self.name = name
         self.idle_s = idle_s
-        self._lock = threading.Lock()
+        self._lock = lock_witness.Lock("rpc._ThreadRecycler")
         self._idle: list[_Recycled] = []
         # Reuse accounting: steady-state submitters should ride parked
         # threads (reuses), not pay spawns — the persistent-runner
@@ -457,11 +459,11 @@ class RpcServer:
         self._concurrent: dict[str, str] = {}
         self._streaming: set[str] = set()
         self._io_pool = None
-        self._io_pool_lock = threading.Lock()
+        self._io_pool_lock = lock_witness.Lock("rpc.RpcServer.io_pool")
         self._shutdown = threading.Event()
         self._accept_thread: threading.Thread | None = None
         self._conns: list[socket.socket] = []
-        self._conns_lock = threading.Lock()
+        self._conns_lock = lock_witness.Lock("rpc.RpcServer.conns")
         # Optional reply metadata: when set (() -> dict), every plain
         # "ok" reply is tagged "okm" and carries (meta, result) — the
         # GCS server rides this to stamp its incarnation epoch on
@@ -530,7 +532,8 @@ class RpcServer:
                              daemon=True, name="rpc-conn").start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
-        send_lock = threading.Lock()  # interleaved replies share the pipe
+        send_lock = lock_witness.Lock(
+            "rpc.RpcServer.conn_send")  # interleaved replies share the pipe
         try:
             while not self._shutdown.is_set():
                 try:
@@ -568,7 +571,7 @@ class RpcServer:
             try:
                 conn.close()
             except OSError:
-                pass
+                pass  # conn already torn down by the peer
             with self._conns_lock:
                 try:
                     self._conns.remove(conn)
@@ -615,7 +618,7 @@ class RpcServer:
             try:
                 conn.close()
             except OSError:
-                pass
+                pass  # close after send failure: already dead
             return False
 
     def _reply(self, conn, send_lock, reply) -> bool:
@@ -631,7 +634,7 @@ class RpcServer:
             try:
                 conn.close()
             except OSError:
-                pass
+                pass  # close after send failure: already dead
             return False
 
     def _handle_one(self, conn, send_lock, seq, method, args,
@@ -657,11 +660,11 @@ class RpcServer:
                             try:
                                 conn.shutdown(socket.SHUT_RDWR)
                             except OSError:
-                                pass
+                                pass  # chaos kill: socket may already be dead
                             try:
                                 conn.close()
                             except OSError:
-                                pass
+                                pass  # chaos kill: socket may already be dead
                             raise RpcError("chaos: stream killed "
                                            "mid-parts")
                         # A dead connection must abort the producer, not
@@ -699,7 +702,7 @@ class RpcServer:
                 try:
                     conn.close()  # wakes every mux slot with RpcError
                 except OSError:
-                    pass
+                    pass  # conn already dead: slots fail either way
                 return False
         try:
             with send_lock:
@@ -711,7 +714,7 @@ class RpcServer:
             try:
                 conn.close()
             except OSError:
-                pass
+                pass  # conn already dead: that IS the signal
             return False
 
     def stop(self) -> None:
@@ -723,18 +726,18 @@ class RpcServer:
         try:
             self._sock.close()
         except OSError:
-            pass
+            pass  # listener already closed
         with self._conns_lock:
             conns, self._conns = self._conns, []
         for conn in conns:
             try:
                 conn.shutdown(socket.SHUT_RDWR)
             except OSError:
-                pass
+                pass  # peer already FINed the conn
             try:
                 conn.close()
             except OSError:
-                pass
+                pass  # conn already closed
 
 
 class _MuxSlot:
@@ -839,8 +842,10 @@ class MuxRpcClient:
         self.address = f"{self._addr[0]}:{self._addr[1]}"
         self._timeout = timeout_s
         self._connect_timeout = connect_timeout_s
-        self._lock = threading.Lock()       # conn state + seq
-        self._send_lock = threading.Lock()  # frame writes
+        self._lock = lock_witness.Lock(
+            "rpc.MuxRpcClient.state")       # conn state + seq
+        self._send_lock = lock_witness.Lock(
+            "rpc.MuxRpcClient.send")  # frame writes
         self._conn: _MuxConn | None = None
         self._seq = 0
         self._closed = False
@@ -858,7 +863,7 @@ class MuxRpcClient:
         # see the bump no later than the call result.
         self.on_reply_meta: Callable[[dict], None] | None = None
 
-    def _ensure_conn(self) -> _MuxConn:
+    def _ensure_conn_locked(self) -> _MuxConn:
         # Caller holds self._lock.
         if self._conn is None:
             sock = socket.create_connection(
@@ -910,7 +915,7 @@ class MuxRpcClient:
             if self._closed:
                 raise RpcError(f"client to {self.address} is closed")
             try:
-                conn = self._ensure_conn()
+                conn = self._ensure_conn_locked()
             except OSError as exc:
                 raise RpcError(
                     f"cannot connect to {self.address}: {exc}") from exc
@@ -988,7 +993,7 @@ class MuxRpcClient:
                       and self._send_lock.acquire(blocking=False))
             if direct:
                 try:
-                    conn = self._ensure_conn()
+                    conn = self._ensure_conn_locked()
                     self._seq += 1
                     slot.seq = self._seq
                     slot.conn = conn
@@ -1056,7 +1061,7 @@ class MuxRpcClient:
                 conn = None
             else:
                 try:
-                    conn = self._ensure_conn()
+                    conn = self._ensure_conn_locked()
                 except OSError as exc:
                     conn = None
                     # Never sent: provably retryable.
@@ -1140,7 +1145,7 @@ class MuxRpcClient:
         try:
             conn.sock.close()
         except OSError:
-            pass
+            pass  # socket already dead: callers get RpcError
         if not (isinstance(exc, RpcError) and exc.maybe_executed):
             exc = RpcError(f"connection lost with the call in flight: "
                            f"{exc}", maybe_executed=True)
@@ -1173,7 +1178,7 @@ class MuxRpcClient:
             try:
                 conn.sock.close()
             except OSError:
-                pass
+                pass  # close on shutdown: already dead is fine
         for slot in pending + [s for s, _ in queued]:
             slot.error = RpcError("client closed")
             slot.event.set()
@@ -1195,7 +1200,7 @@ class RpcClient:
         self._connect_timeout = (connect_timeout_s
                                  if connect_timeout_s is not None
                                  else min(timeout_s, 10.0))
-        self._lock = threading.Lock()
+        self._lock = lock_witness.Lock("rpc.RpcClient")
         self._sock: socket.socket | None = None
         self._seq = 0
         # Same reply-metadata hook as MuxRpcClient (invoked on the
@@ -1239,7 +1244,7 @@ class RpcClient:
                         try:
                             self._sock.close()
                         except OSError:
-                            pass
+                            pass  # stale socket: replaced below either way
                         self._sock = None
                     if self._sock is None:
                         self._sock = self._connect()
@@ -1270,7 +1275,7 @@ class RpcClient:
                         try:
                             self._sock.close()
                         except OSError:
-                            pass
+                            pass  # failed socket: retry mints a new one
                         self._sock = None
                     if sent:
                         raise RpcError(
@@ -1298,5 +1303,5 @@ class RpcClient:
                 try:
                     self._sock.close()
                 except OSError:
-                    pass
+                    pass  # close(): already-closed is the goal state
                 self._sock = None

@@ -125,7 +125,7 @@ def _create_from_spec(exe: str, target: str, spec: dict) -> None:
         try:
             os.unlink(env_file)
         except OSError:
-            pass
+            pass  # env spec tmp already gone
 
 
 def _check_python_compat(info: dict, spec) -> dict:

@@ -149,7 +149,7 @@ def ensure_file_local(hash_hex: str, export_addr: str,
         try:
             os.unlink(tmp)
         except OSError:
-            pass
+            pass  # loser's tmp already swept
     return target
 
 

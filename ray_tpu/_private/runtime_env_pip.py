@@ -169,7 +169,7 @@ def ensure_env_single_flight(target: str, create_fn,
         try:
             os.rmdir(lock_dir)
         except OSError:
-            pass
+            pass  # lock dir already reclaimed
 
 
 def ensure_pip_env(spec) -> dict:

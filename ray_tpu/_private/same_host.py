@@ -35,11 +35,13 @@ from __future__ import annotations
 
 import os
 import threading
+
+from ray_tpu._private import lock_witness
 import time
 from typing import Callable
 
 _HOST_ID: str | None = None
-_HOST_ID_LOCK = threading.Lock()
+_HOST_ID_LOCK = lock_witness.Lock("same_host.HOST_ID")
 
 
 def host_identity() -> str:
@@ -57,7 +59,7 @@ def host_identity() -> str:
                 with open("/proc/sys/kernel/random/boot_id") as f:
                     boot_id = f.read().strip()
             except OSError:
-                pass
+                pass  # no /proc boot_id: fallback below
             if not boot_id:
                 import socket
                 import uuid
@@ -99,7 +101,7 @@ class LeaseTable:
     period."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lock_witness.Lock("same_host.LeaseTable")
         self._next = 0
         # token -> (id_bytes, holder_addr, granted_monotonic, on_release)
         self._leases: dict[str, tuple] = {}
@@ -317,7 +319,7 @@ def fetch_mapped_blob(call, id_bytes: bytes, my_addr: str,
                 try:
                     seg.close()
                 except (BufferError, OSError):
-                    pass
+                    pass  # borrowed map: owner/tracker reclaims
         if info.get("kind") == "arena":
             from ray_tpu._private.arena_store import ArenaStore
 
@@ -352,7 +354,7 @@ class PeerArenaRegistry:
     so a crashed puller cannot corrupt or wedge the owner's arena."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = lock_witness.Lock("same_host.PeerArenaRegistry")
         self._arenas: dict[str, object] = {}
 
     def get(self, name: str):

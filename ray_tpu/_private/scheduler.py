@@ -27,6 +27,8 @@ from __future__ import annotations
 import heapq
 import itertools
 import threading
+
+from ray_tpu._private import lock_witness
 import traceback
 import time
 from dataclasses import dataclass, field
@@ -165,7 +167,8 @@ class ClusterState:
     """Cluster-wide resource view + node selection policies."""
 
     def __init__(self, spread_threshold: float = 0.5):
-        self._lock = threading.Condition(threading.Lock())
+        self._lock = lock_witness.Condition(
+            "scheduler.ClusterState", plain_lock=True)
         self._nodes: dict[NodeID, NodeState] = {}
         self._spread_threshold = spread_threshold
         self._rr_counter = 0
@@ -489,7 +492,8 @@ class Dispatcher:
         self._collections = collections
         self._cluster = cluster
         self._store = store
-        self._lock = threading.Condition(threading.Lock())
+        self._lock = lock_witness.Condition(
+            "scheduler.Dispatcher", plain_lock=True)
         # Dep-gated tasks, indexed BY DEPENDENCY ID: a seal group
         # touches only its dependents (O(deps sealed)), never the whole
         # waiting population — with 100k buffered submits parked in
@@ -605,7 +609,7 @@ class Dispatcher:
         except Exception:  # noqa: BLE001 — never wedge dispatch
             return None
 
-    def _enqueue_ready(self, task: _QueuedTask) -> None:
+    def _enqueue_ready_locked(self, task: _QueuedTask) -> None:
         # Caller holds self._lock.
         self._num_ready_live += 1
         if getattr(task.spec, "_avoid_nodes", None):
@@ -693,7 +697,7 @@ class Dispatcher:
                     task.unresolved_deps = len(dep_ids)
                     if task.unresolved_deps == 0:
                         self._waiting.discard(task)
-                        self._enqueue_ready(task)
+                        self._enqueue_ready_locked(task)
                         woke = True
             if woke and self._parked:
                 self._lock.notify_all()
@@ -1012,7 +1016,7 @@ class Dispatcher:
         (no barrier on the slowest sibling)."""
         run_batch = self._run_batch
         by_spec = {id(t.spec): t for t in tasks}
-        done_lock = threading.Lock()
+        done_lock = lock_witness.Lock("scheduler.Dispatcher.launch_done")
         self.batches_launched += 1
         self.batch_tasks_launched += len(tasks)
 
@@ -1258,7 +1262,8 @@ class BlockedResourceContext:
         self._cpu_only = {k: v for k, v in resources.items() if k == "CPU"}
         self._depth = 0
         # Cross-process nested gets block/unblock from RPC threads.
-        self._depth_lock = threading.Lock()
+        self._depth_lock = lock_witness.Lock(
+            "scheduler.BlockedResourceContext.depth")
 
     def __enter__(self):
         self._tls.ctx = self

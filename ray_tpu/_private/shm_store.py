@@ -74,7 +74,7 @@ def untrack(seg: shared_memory.SharedMemory) -> None:
 
         resource_tracker.unregister(seg._name, "shared_memory")
     except Exception:
-        pass
+        pass  # private tracker API may change shape
 
 
 def _defuse(seg: shared_memory.SharedMemory) -> None:
@@ -89,7 +89,7 @@ def _defuse(seg: shared_memory.SharedMemory) -> None:
         seg._buf = None
         seg._mmap = None
     except Exception:
-        pass
+        pass  # private segment fields may change shape
 
 
 def track(seg: shared_memory.SharedMemory) -> None:
@@ -101,7 +101,7 @@ def track(seg: shared_memory.SharedMemory) -> None:
 
         resource_tracker.register(seg._name, "shared_memory")
     except Exception:
-        pass
+        pass  # private tracker API may change shape
 
 
 class ShmObjectWriter:

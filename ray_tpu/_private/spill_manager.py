@@ -58,6 +58,8 @@ import os
 import shutil
 import struct
 import threading
+
+from ray_tpu._private import lock_witness
 import time
 import zlib
 from typing import Callable
@@ -138,7 +140,7 @@ def write_spill_file(path: str, payload, fsync: bool = False) -> None:
         try:
             os.unlink(tmp)
         except OSError:
-            pass
+            pass  # tmp unlink is tidy-up; raising below
         raise SpillDiskFullError(
             f"spill write failed ({errno.errorcode.get(exc.errno, '?')}): "
             f"{exc}") from exc
@@ -215,7 +217,7 @@ class SpillManager:
         self._victims = victims_fn
         self._extract = extract_fn
         self._commit = commit_fn
-        self._lock = threading.Lock()
+        self._lock = lock_witness.Lock("spill_manager.SpillManager")
         self._backoff_until = 0.0
         self._forced = False
         # Counters (read under the lock via stats()).
@@ -327,7 +329,7 @@ class SpillManager:
             try:
                 os.unlink(path)
             except OSError:
-                pass
+                pass  # lost commit race: file already swept
             return True
         with self._lock:
             self.spills += 1
@@ -352,7 +354,7 @@ class SpillManager:
             try:
                 os.unlink(path)
             except OSError:
-                pass
+                pass  # torn file unlink; tear counted above
             flight_recorder.record("spill.torn", key.hex()[:16])
             raise
         wall = time.monotonic() - start
@@ -408,7 +410,7 @@ class SpillManager:
 # executors), so shutdown cleanup only removes it once the LAST
 # manager stopped.
 _LIVE: set = set()
-_LIVE_LOCK = threading.Lock()
+_LIVE_LOCK = lock_witness.Lock("spill_manager.LIVE")
 
 
 def live_manager_count() -> int:

@@ -889,7 +889,7 @@ class Runtime:
             try:
                 seg.unlink()
             except (OSError, FileNotFoundError):
-                pass
+                pass  # segment already unlinked by the tracker
             try:
                 seg.close()
             except (BufferError, OSError):
@@ -995,7 +995,7 @@ class Runtime:
                         last_sync = now
                 except (RpcError, RpcMethodError, OSError,
                         AttributeError):
-                    continue
+                    continue  # head down / client mid-teardown: next pass
                 except Exception:  # noqa: BLE001 — watcher must survive
                     logger.exception("remote node sync failed")
         finally:
@@ -4060,7 +4060,7 @@ class Runtime:
             try:
                 fut.set_exception(exc)
             except Exception:
-                pass
+                pass  # future already resolved by a racing seal
 
     # --------------------------------------------------------------- status
 
@@ -4159,7 +4159,7 @@ class Runtime:
             try:
                 seg.unlink()
             except (OSError, FileNotFoundError):
-                pass
+                pass  # segment already unlinked by the tracker
             try:
                 seg.close()
             except (BufferError, OSError):
@@ -4393,7 +4393,7 @@ def _atexit_shutdown():
             try:
                 _runtime.shutdown()
             except Exception:
-                pass
+                pass  # shutdown() is best-effort on interpreter exit
             _runtime = None
 
 

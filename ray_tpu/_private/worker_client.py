@@ -454,7 +454,7 @@ class WorkerModeRuntime:
                 try:
                     fut.set_exception(exc)
                 except Exception:
-                    pass
+                    pass  # future already cancelled by the caller
 
         threading.Thread(target=resolve, daemon=True).start()
 

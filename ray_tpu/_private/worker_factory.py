@@ -98,7 +98,7 @@ def _child_exec(req: dict, pipe_fd: int | None = None) -> None:
             try:
                 os.chdir(req["cwd"])
             except OSError:
-                pass
+                pass  # missing cwd: worker runs where it can
         log_path = req.get("log_path")
         if log_path:
             fd = os.open(log_path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
@@ -270,22 +270,22 @@ def factory_main(sock_path: str, parent_pid: int) -> None:
             try:
                 _send_msg(conn, {"ok": False, "error": repr(exc)})
             except OSError:
-                pass
+                pass  # requester hung up before the reply
         finally:
             if pipe_fd is not None:
                 try:
                     os.close(pipe_fd)  # the child inherited its copy
                 except OSError:
-                    pass
+                    pass  # child inherited the fd; ours may be gone
             try:
                 conn.close()
             except OSError:
-                pass
+                pass  # requester already hung up
     try:
         server.close()
         os.unlink(sock_path)
     except OSError:
-        pass
+        pass  # socket path already removed
 
 
 # --------------------------------------------------------------------------
@@ -324,7 +324,7 @@ class PidHandle:
             try:
                 os.close(self._pidfd)
             except OSError:
-                pass
+                pass  # pidfd already closed at GC
 
     def poll(self) -> int | None:
         if self._rc is not None:
@@ -392,7 +392,7 @@ class PidHandle:
             elif self._pidfd == -1:
                 os.kill(self.pid, sig)
         except OSError:
-            pass
+            pass  # process already reaped
 
     def terminate(self) -> None:
         import signal
@@ -470,14 +470,14 @@ class WorkerFactory:
             _send_msg(conn, {"op": "exit"})
             conn.close()
         except OSError:
-            pass
+            pass  # factory already exited
         try:
             self.proc.wait(timeout=2.0)
         except Exception:  # noqa: BLE001
             try:
                 self.proc.kill()
             except OSError:
-                pass
+                pass  # process exited between wait and kill
 
 
 def start_factory(timeout_s: float | None = None) -> WorkerFactory:

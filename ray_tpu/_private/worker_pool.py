@@ -222,7 +222,7 @@ class _runtime_env_ctx:
             try:
                 os.chdir(self._saved_cwd)
             except OSError:
-                pass
+                pass  # saved cwd may have been deleted
         if self._unload_prefixes:
             # Unload modules imported from the env's paths: pool
             # workers are shared across tasks, and a module cached in
@@ -428,7 +428,7 @@ def _mark_jax_if_imported() -> None:
         with open(path, "w"):
             pass
     except OSError:
-        pass
+        pass  # marker touch is advisory only
 
 
 def _serve(conn, client: ShmClient, arena=None,
@@ -924,7 +924,7 @@ class PoolWorker:
             with self._lock:
                 self.conn.send(("exit",))
         except (OSError, BrokenPipeError):
-            pass
+            pass  # worker already dropped the pipe
         try:
             self.proc.wait(timeout=1.0)
         except subprocess.TimeoutExpired:

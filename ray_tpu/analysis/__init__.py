@@ -1,0 +1,17 @@
+"""``python -m ray_tpu.analysis`` — AST invariant linter CLI.
+
+Thin public wrapper over :mod:`ray_tpu._private.analysis`; see that
+package's docstring for the pass catalog and the suppression-file
+format, and the README "Static analysis & concurrency tooling"
+section for the operator quickstart.
+"""
+
+from ray_tpu._private.analysis import (  # noqa: F401 — public re-export
+    MAX_SUPPRESSIONS,
+    PASS_IDS,
+    Finding,
+    apply_suppressions,
+    load_suppressions,
+    main,
+    run_passes,
+)

@@ -163,7 +163,7 @@ def _spawn_head(config: dict, session_dir: str) -> tuple[int, str]:
         try:
             os.unlink(stale)
         except OSError:
-            pass
+            pass  # stale state already absent
     head_type = config.get("head_node_type")
     resources = None
     if head_type:
@@ -187,7 +187,7 @@ def _spawn_head(config: dict, session_dir: str) -> tuple[int, str]:
             if address:
                 return proc.pid, address
         except OSError:
-            pass
+            pass  # address file not written yet: poll on
         time.sleep(0.25)
     _term(proc.pid)
     raise TimeoutError("head daemon never advertised its address")
@@ -355,7 +355,7 @@ def _worker_alive(state: dict, worker: dict) -> bool:
             if node.get("node_id") == node_hex:
                 return bool(node.get("alive"))
     except (RpcError, OSError):
-        pass
+        pass  # head unreachable: treated as not-alive
     finally:
         client.close()
     return False
@@ -379,7 +379,7 @@ def teardown_cluster(config_or_path) -> int:
     try:
         os.unlink(_state_path(config["cluster_name"]))
     except OSError:
-        pass
+        pass  # state file already removed
     return signaled
 
 
@@ -406,5 +406,5 @@ def _term(pid: int, timeout_s: float = 10.0) -> None:
     try:
         os.kill(int(pid), signal.SIGKILL)
     except OSError:
-        pass
+        pass  # process exited before the SIGKILL
     _reap_if_child(pid)

@@ -170,4 +170,4 @@ class LocalDaemonNodeProvider(NodeProvider):
             try:
                 self._reap(proc)
             except OSError:
-                pass
+                pass  # daemon already reaped

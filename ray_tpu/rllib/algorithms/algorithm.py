@@ -367,5 +367,5 @@ class Algorithm(Trainable):
                 try:
                     ray_tpu.kill(self.env_runner_group.actor(i))
                 except Exception:
-                    pass
+                    pass  # runner already dead at teardown
         self.learner_group.shutdown()

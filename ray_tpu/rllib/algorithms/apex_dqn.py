@@ -231,7 +231,7 @@ class ApexDQN(Algorithm):
             try:
                 ray_tpu.kill(shard)
             except Exception:
-                pass
+                pass  # shard already dead at teardown
         super().cleanup()
 
 

@@ -139,4 +139,4 @@ class LearnerGroup:
                 try:
                     ray_tpu.kill(a)
                 except Exception:
-                    pass
+                    pass  # learner already dead at teardown

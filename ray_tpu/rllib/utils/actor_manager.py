@@ -164,7 +164,7 @@ class FaultTolerantActorManager:
                 restored.append(i)
                 continue
             except Exception:
-                pass
+                pass  # probe failed: falls through to respawn
             if self._factory is not None:
                 self._actors[i] = self._factory(i)
                 self._in_flight[i] = []

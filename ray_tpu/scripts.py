@@ -86,7 +86,7 @@ def cmd_start(args) -> int:
                           f"submit --address {address} -- <cmd>")
                     return 0
             except (FileNotFoundError, OSError):
-                pass
+                pass  # head not up yet: keep polling
             time.sleep(0.2)
         print("head failed to start; see "
               f"{os.path.join(SESSION_DIR, 'head.log')}", file=sys.stderr)

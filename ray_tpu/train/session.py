@@ -120,7 +120,7 @@ def run_with_session(fn, config, state: _SessionState, emit) -> Any:
             exc.__ray_tpu_remote_tb__ = "".join(traceback.format_exception(
                 type(exc), exc, exc.__traceback__))
         except Exception:
-            pass
+            pass  # tb attach is best-effort on exotic excs
         emit({"done": True, "result": None, "error": exc})
         raise
     finally:

@@ -190,12 +190,12 @@ class WorkerGroup:
             try:
                 ray_tpu.kill(w)
             except Exception:
-                pass
+                pass  # worker already dead at teardown
         if self.channel is not None:
             try:
                 ray_tpu.kill(self.channel)
             except Exception:
-                pass
+                pass  # channel already dead at teardown
             self.channel = None
         if self.pg is not None:
             remove_placement_group(self.pg)

@@ -18,6 +18,16 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["RAY_TPU_SKIP_TPU_DETECTION"] = "1"
 
+# Tier-1 runs with the lock-order witness ARMED (ISSUE 13): every lock
+# the hot modules create — in this process AND in every daemon spawned
+# through daemon_child_env, which inherits the environment — records
+# acquisition order, and a cycle (potential deadlock) raises
+# LockOrderError at its acquire site instead of surfacing as a CI
+# timeout. Must be set before any ray_tpu import (the witness arms at
+# module import, and locks are created at object construction).
+# Export RAY_TPU_LOCK_WITNESS=0 to run tier-1 unwitnessed.
+os.environ.setdefault("RAY_TPU_LOCK_WITNESS", "1")
+
 # The sandbox sitecustomize may have already initialized JAX on a real
 # accelerator platform before this conftest ran. Force a clean re-init on
 # the virtual 8-device CPU platform.

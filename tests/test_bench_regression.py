@@ -198,6 +198,27 @@ def test_bench_envelope_tasks_row_recorded_tracing_disabled():
             "RAY_TPU_TRACING_ENABLED")
 
 
+def test_bench_envelope_tasks_row_recorded_witness_disarmed():
+    """ISSUE 13: the lock-order witness is a TEST-ONLY plane — armed,
+    every hot-module acquire pays held-set + order-graph bookkeeping.
+    bench_envelope.py records the witness state with the tasks row; a
+    refresh recorded with RAY_TPU_LOCK_WITNESS armed would quietly
+    lower the guarded exec/submit baselines, so the guard refuses it
+    outright (throughput itself is untouched by this check)."""
+    if not BENCH_ENVELOPE.exists():
+        pytest.skip("BENCH_ENVELOPE.json not present in the working "
+                    "tree")
+    doc = json.loads(BENCH_ENVELOPE.read_text())
+    tasks_rows = [r for r in doc.get("phases", [])
+                  if r.get("phase") == "tasks"]
+    assert tasks_rows, "envelope lost its tasks phase"
+    for row in tasks_rows:
+        assert row.get("lock_witness_armed") is False, (
+            "envelope tasks row was recorded with the lock-order "
+            "witness armed (or predates the flag): rerun "
+            "bench_envelope.py without RAY_TPU_LOCK_WITNESS")
+
+
 def test_bench_envelope_tasks_row_records_submit_stage_counters():
     """The guarded submit_per_s number is only interpretable next to
     its stage counters: the tasks row must carry the submit-ring

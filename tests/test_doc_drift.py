@@ -11,11 +11,18 @@ from pathlib import Path
 import pytest
 
 import ray_tpu
-from ray_tpu._private.node_executor import (
-    DATA_PLANE_STAT_KEYS,
-    FAULT_STAT_KEYS,
-    PIPELINE_STAT_KEYS,
-)
+# Counter registries come through the analyzer's AST parser (ISSUE 13)
+# — the same code path `python -m ray_tpu.analysis` lints with, so the
+# doc checks and the linter cannot drift from each other. The parsed
+# tuples are asserted identical to the importable ones in
+# tests/test_static_analysis.py.
+from ray_tpu._private.analysis.counter_keys import registry_keys
+
+PIPELINE_STAT_KEYS = registry_keys("node_executor",
+                                   "PIPELINE_STAT_KEYS")
+DATA_PLANE_STAT_KEYS = registry_keys("node_executor",
+                                     "DATA_PLANE_STAT_KEYS")
+FAULT_STAT_KEYS = registry_keys("node_executor", "FAULT_STAT_KEYS")
 
 README = Path(__file__).resolve().parent.parent / "README.md"
 
@@ -400,9 +407,10 @@ def test_spill_knobs_documented(spilling_text):
 
 def test_spill_counter_keys_documented(spilling_text):
     """Every executor_stats()["spill"] / runtime.spill_stats() key
-    (SPILL_STAT_KEYS is the canonical source) plus the derived fields
-    must keep README rows."""
-    from ray_tpu._private.spill_manager import SPILL_STAT_KEYS
+    (SPILL_STAT_KEYS is the canonical source, read through the
+    analyzer's AST parser) plus the derived fields must keep README
+    rows."""
+    SPILL_STAT_KEYS = registry_keys("spill_manager", "SPILL_STAT_KEYS")
 
     keys = list(SPILL_STAT_KEYS) + ["restore_p50_ms",
                                     "spilled_plan_hits"]
@@ -414,13 +422,16 @@ def test_spill_counter_keys_documented(spilling_text):
 
 def test_spill_chaos_sites_documented(spilling_text):
     """The three spill chaos sites are part of the chaos-spec contract
-    (chaos.py docstring) and the README spilling section."""
-    import ray_tpu._private.chaos as chaos_mod
+    — registered in chaos.SITES (the analyzer's chaos-sites pass
+    enforces registry ↔ docstring ↔ tests coherence) and documented in
+    the README spilling section."""
+    from ray_tpu._private.analysis.chaos_sites import registered_sites
 
+    registered = registered_sites()
     for site in ("spill.torn_write", "spill.disk_full",
                  "spill.restore_delay"):
-        assert site in (chaos_mod.__doc__ or ""), (
-            f"chaos site {site} missing from chaos.py docstring")
+        assert site in registered, (
+            f"chaos site {site} missing from chaos.SITES")
         assert f"`{site}`" in spilling_text, (
             f"chaos site {site} missing from the README spilling "
             f"section")
@@ -504,11 +515,12 @@ def test_gcs_persist_counter_keys_documented(fault_tolerance_text):
 
 
 def test_partition_and_gcs_chaos_sites_documented(fault_tolerance_text):
-    import ray_tpu._private.chaos as chaos_mod
+    from ray_tpu._private.analysis.chaos_sites import registered_sites
 
+    registered = registered_sites()
     for site in ("net.partition", "gcs.torn_snapshot", "gcs.torn_wal"):
-        assert site in (chaos_mod.__doc__ or ""), (
-            f"chaos site {site} missing from chaos.py docstring")
+        assert site in registered, (
+            f"chaos site {site} missing from chaos.SITES")
         assert f"`{site}`" in fault_tolerance_text, (
             f"chaos site {site} missing from the README fault-"
             f"tolerance section")
@@ -521,3 +533,51 @@ def test_recovery_envelope_row_documented(fault_tolerance_text):
     assert "ENVELOPE_RECOVERY_ONLY" in fault_tolerance_text
     assert "time_to_recovered_s" in fault_tolerance_text
     assert "wal_records_replayed > 0" in fault_tolerance_text
+
+
+# ---------------------------------------- static analysis tooling
+
+
+@pytest.fixture(scope="module")
+def static_analysis_text() -> str:
+    text = README.read_text()
+    start = text.find("## Static analysis & concurrency tooling")
+    assert start != -1, ("README lost its Static analysis & "
+                         "concurrency tooling section")
+    end = text.find("\n## ", start + 1)
+    return text[start:end if end != -1 else len(text)]
+
+
+def test_lock_witness_knob_documented(static_analysis_text):
+    """The lock_witness knob keeps its README row (and stays a real
+    config key)."""
+    from ray_tpu._private.config import _DEFAULTS
+
+    assert "lock_witness" in _DEFAULTS, (
+        "lock_witness knob vanished from config")
+    assert "`lock_witness`" in static_analysis_text
+    assert "RAY_TPU_LOCK_WITNESS" in static_analysis_text
+    assert "LockOrderError" in static_analysis_text
+
+
+def test_every_linter_pass_documented(static_analysis_text):
+    """Every analyzer pass id keeps a row in the README pass table —
+    sourced from the same PASS_IDS tuple the CLI serves."""
+    from ray_tpu.analysis import PASS_IDS
+
+    missing = [p for p in PASS_IDS
+               if f"`{p}`" not in static_analysis_text]
+    assert not missing, (
+        f"linter passes missing from the README pass table: {missing}")
+
+
+def test_linter_cli_and_suppression_format_documented(
+        static_analysis_text):
+    assert "python -m ray_tpu.analysis" in static_analysis_text
+    assert "suppressions.txt" in static_analysis_text
+    # The suppression grammar is operator-facing contract.
+    assert "::" in static_analysis_text
+    from ray_tpu.analysis import MAX_SUPPRESSIONS
+
+    assert str(MAX_SUPPRESSIONS) in static_analysis_text, (
+        "suppression budget number drifted out of the README")

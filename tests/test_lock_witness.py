@@ -1,0 +1,269 @@
+"""Lock-order witness unit tests (ISSUE 13).
+
+The witness itself must be provably correct before tier-1 trusts it:
+deterministic AB/BA cycle detection with both stacks attached, no
+false positives on RLock reentrancy or Condition wait/notify, and
+byte-identical plain ``threading`` objects when disarmed (the
+production path).
+"""
+
+import threading
+import time
+
+import pytest
+
+from ray_tpu._private import lock_witness as lw
+
+
+@pytest.fixture(autouse=True)
+def _witness_clean():
+    """Each test picks its own arm state and starts with an empty
+    order graph; tier-1's ambient arming (conftest env) is restored
+    afterwards."""
+    prior = lw.WITNESS_ON
+    lw.reset()
+    yield
+    lw.arm(prior)
+    lw.reset()
+
+
+# ------------------------------------------------------------ disarmed
+
+
+def test_disarmed_factories_return_plain_threading_objects():
+    lw.arm(False)
+    assert type(lw.Lock("x")) is type(threading.Lock())
+    assert type(lw.RLock("x")) is type(threading.RLock())
+    cond = lw.Condition("x")
+    assert type(cond) is threading.Condition
+    assert type(cond._lock) is type(threading.RLock())
+    plain = lw.Condition("x", plain_lock=True)
+    assert type(plain._lock) is type(threading.Lock())
+    # Disarmed use records nothing.
+    with lw.Lock("a"):
+        with lw.Lock("b"):
+            pass
+    assert lw.stats() == {"armed": False, "acquires": 0,
+                          "lock_classes": 0, "edges": 0, "cycles": 0}
+
+
+# ------------------------------------------------------- cycle detection
+
+
+def test_ab_ba_cycle_detected_with_both_stacks():
+    lw.arm(True)
+    lock_a = lw.Lock("test.A")
+    lock_b = lw.Lock("test.B")
+
+    with lock_a:
+        with lock_b:
+            pass  # establishes A -> B
+
+    caught = []
+
+    def reverse():
+        try:
+            with lock_b:
+                with lock_a:  # B -> A closes the cycle
+                    pass
+        except lw.LockOrderError as exc:
+            caught.append(exc)
+
+    thread = threading.Thread(target=reverse)
+    thread.start()
+    thread.join()
+    assert len(caught) == 1
+    err = caught[0]
+    assert err.cycle["cycle"] == ["test.A", "test.B", "test.A"]
+    # Both stacks flight-recorded on the error: the acquire that
+    # closed the cycle and the first reverse-order acquire.
+    assert "reverse()" in str(err) or "reverse" in err.cycle["stack"]
+    assert err.cycle["reverse_stack"], "first-edge stack missing"
+    assert lw.stats()["cycles"] == 1
+    assert lw.cycles()[0]["edge"] == ("test.B", "test.A")
+    # The same pair raises ONCE: the edge is on record, re-running the
+    # reverse order is a known finding, not an error storm.
+    thread = threading.Thread(target=reverse)
+    thread.start()
+    thread.join()
+    assert len(caught) == 1
+
+
+def test_cycle_lands_in_flight_recorder():
+    from ray_tpu._private import flight_recorder
+
+    flight_recorder.install("test-witness")
+    lw.arm(True)
+    lock_a = lw.Lock("fr.A")
+    lock_b = lw.Lock("fr.B")
+    with lock_a:
+        with lock_b:
+            pass
+    with lock_b:
+        with pytest.raises(lw.LockOrderError):
+            with lock_a:
+                pass
+    kinds = [kind for _, kind, args in
+             list(flight_recorder.get()._ring)
+             if kind == "lock.cycle"]
+    assert kinds, "lock.cycle event missing from the flight ring"
+
+
+def test_three_lock_cycle_detected():
+    lw.arm(True)
+    la, lb, lc = lw.Lock("t3.A"), lw.Lock("t3.B"), lw.Lock("t3.C")
+    with la:
+        with lb:
+            pass  # A -> B
+    with lb:
+        with lc:
+            pass  # B -> C
+    with lc:
+        with pytest.raises(lw.LockOrderError) as info:
+            with la:  # C -> A closes A -> B -> C -> A
+                pass
+    assert set(info.value.cycle["cycle"]) == {"t3.A", "t3.B", "t3.C"}
+
+
+def test_trylock_records_no_edge_but_held_set_tracks_it():
+    lw.arm(True)
+    la, lb = lw.Lock("try.A"), lw.Lock("try.B")
+    with la:
+        assert lb.acquire(blocking=False)  # no edge: trylock can't deadlock
+        lb.release()
+    assert lw.stats()["edges"] == 0
+    # But a blocking acquire while HOLDING a trylocked lock does edge.
+    assert lb.acquire(blocking=False)
+    with la:
+        pass  # B(try-held) -> A
+    lb.release()
+    assert lw.stats()["edges"] == 1
+
+
+# ------------------------------------------------------ non-findings
+
+
+def test_rlock_reentrancy_is_not_a_finding():
+    lw.arm(True)
+    rlock = lw.RLock("re.R")
+    other = lw.Lock("re.X")
+    with rlock:
+        with rlock:  # reentrant: no self-edge, no cycle
+            with other:
+                pass
+        with rlock:
+            pass
+    assert lw.stats()["cycles"] == 0
+    assert not lw._held()
+
+
+def test_same_class_instances_do_not_self_loop():
+    lw.arm(True)
+    inst1 = lw.Lock("same.class")
+    inst2 = lw.Lock("same.class")
+    with inst1:
+        with inst2:
+            pass
+    with inst2:
+        with inst1:
+            pass
+    assert lw.stats()["cycles"] == 0
+
+
+def test_condition_wait_notify_is_not_a_finding():
+    lw.arm(True)
+    cond = lw.Condition("cv.rlock")
+    hits = []
+
+    def waiter():
+        with cond:
+            while not hits:
+                cond.wait(timeout=5.0)
+            hits.append("woke")
+
+    thread = threading.Thread(target=waiter)
+    thread.start()
+    time.sleep(0.05)
+    with cond:
+        hits.append("set")
+        cond.notify_all()
+    thread.join(timeout=5.0)
+    assert not thread.is_alive() and "woke" in hits
+    assert lw.stats()["cycles"] == 0
+    assert not lw._held()
+
+
+def test_condition_plain_lock_wait_notify_is_not_a_finding():
+    lw.arm(True)
+    cond = lw.Condition("cv.plain", plain_lock=True)
+    hits = []
+
+    def waiter():
+        with cond:
+            while not hits:
+                cond.wait(timeout=5.0)
+            hits.append("woke")
+
+    thread = threading.Thread(target=waiter)
+    thread.start()
+    time.sleep(0.05)
+    with cond:
+        hits.append("set")
+        cond.notify_all()
+    thread.join(timeout=5.0)
+    assert not thread.is_alive() and "woke" in hits
+    assert lw.stats()["cycles"] == 0
+    assert not lw._held()
+
+
+def test_condition_wait_releases_reentrant_depth_and_restores():
+    """An RLock-backed Condition waited on at reentrant depth 2 must
+    fully release (the notifier gets in) and restore depth + held-set
+    afterwards."""
+    lw.arm(True)
+    cond = lw.Condition("cv.deep")
+    entered = []
+
+    def waiter():
+        with cond:
+            with cond._lock:  # depth 2
+                cond.wait(timeout=5.0)
+                entered.append("restored")
+        entered.append("exited")
+
+    thread = threading.Thread(target=waiter)
+    thread.start()
+    time.sleep(0.05)
+    with cond:  # acquirable only if wait released both levels
+        cond.notify_all()
+    thread.join(timeout=5.0)
+    assert entered == ["restored", "exited"]
+    assert not lw._held()
+
+
+# ----------------------------------------------- consistent ordering ok
+
+
+def test_consistent_order_many_threads_no_finding():
+    lw.arm(True)
+    la, lb = lw.Lock("mt.A"), lw.Lock("mt.B")
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(200):
+                with la:
+                    with lb:
+                        pass
+        except lw.LockOrderError as exc:  # pragma: no cover
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    stats = lw.stats()
+    assert stats["cycles"] == 0 and stats["edges"] == 1
+    assert stats["acquires"] >= 1600

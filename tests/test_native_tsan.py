@@ -1,15 +1,21 @@
-"""ThreadSanitizer harness for the native components.
+"""ThreadSanitizer harness for the native components (optional,
+``@slow``).
 
 Reference: the reference's C++ tests run under TSAN/ASAN bazel configs
 in CI (SURVEY §5 "race detection"). Here the native node store is
-compiled with -fsanitize=thread together with a multithreaded stress
-driver (native_tsan_stress.cpp); any data race in the store's locking
-fails the test through TSAN's report + nonzero exit.
+compiled with ``-fsanitize=thread`` together with a multithreaded
+stress driver (native_tsan_stress.cpp — colliding keys, reseals,
+chunked reads, frees, owner sweeps and stats from 8 threads); any data
+race in the store's locking fails the test through TSAN's report +
+nonzero exit. Runs outside the tier-1 gate (``slow``: a sanitizer
+build + 3200-op stress is minutes, not seconds, on a busy box) and
+skips cleanly when the box has no g++ or no TSan runtime.
 """
 
 import os
 import subprocess
 import sys
+import tempfile
 
 import pytest
 
@@ -28,8 +34,37 @@ def _toolchain_available() -> bool:
     return which("g++") is not None
 
 
+def _tsan_available() -> bool:
+    """Probe that -fsanitize=thread actually links AND runs on this
+    box (g++ may exist without libtsan, or the runtime may refuse the
+    kernel's ASLR config) — the skip must be clean, not a cryptic
+    build/exec failure."""
+    if not _toolchain_available():
+        return False
+    with tempfile.TemporaryDirectory() as tmp:
+        probe_src = os.path.join(tmp, "probe.cpp")
+        probe_bin = os.path.join(tmp, "probe")
+        with open(probe_src, "w") as f:
+            f.write("int main() { return 0; }\n")
+        try:
+            build = subprocess.run(
+                ["g++", "-fsanitize=thread", probe_src, "-o",
+                 probe_bin, "-lpthread"],
+                capture_output=True, timeout=60)
+            if build.returncode != 0:
+                return False
+            run = subprocess.run([probe_bin], capture_output=True,
+                                 timeout=60)
+            return run.returncode == 0
+        except (OSError, subprocess.TimeoutExpired):
+            return False
+
+
+@pytest.mark.slow
 @pytest.mark.skipif(not _toolchain_available(), reason="no g++")
 def test_node_store_is_race_free_under_tsan(tmp_path):
+    if not _tsan_available():
+        pytest.skip("no working ThreadSanitizer runtime on this box")
     if (not os.path.exists(_BIN)
             or os.path.getmtime(_BIN) < max(
                 os.path.getmtime(s) for s in _SOURCES)):

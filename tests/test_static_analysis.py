@@ -1,0 +1,207 @@
+"""Tier-1 gate for the AST invariant linter (ISSUE 13).
+
+``python -m ray_tpu.analysis`` must exit 0 on the tree: zero
+unsuppressed findings, a justified suppression file within its triage
+budget, and no stale entries. The planted-violation tests keep the
+passes themselves honest — a pass that silently stops finding
+anything would otherwise look like a clean tree forever.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ray_tpu._private import lock_witness
+from ray_tpu._private.analysis import (
+    MAX_SUPPRESSIONS,
+    PASS_IDS,
+    apply_suppressions,
+    load_suppressions,
+    run_passes,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------ the gate
+
+
+def test_tree_has_zero_unsuppressed_findings():
+    findings = run_passes()
+    entries, format_errors = load_suppressions()
+    assert not format_errors, format_errors
+    open_findings, stale = apply_suppressions(findings, entries)
+    rendered = "\n".join(f.render() for f in open_findings)
+    assert not open_findings, (
+        f"unsuppressed linter findings — fix them or triage each into "
+        f"suppressions.txt with its why:\n{rendered}")
+    assert not stale, (
+        f"stale suppression entries (match no current finding — "
+        f"delete them): {[e.key for e in stale]}")
+
+
+def test_suppression_file_within_budget_and_justified():
+    entries, format_errors = load_suppressions()
+    assert not format_errors, format_errors
+    assert len(entries) <= MAX_SUPPRESSIONS, (
+        f"{len(entries)} suppressions > {MAX_SUPPRESSIONS}-entry "
+        f"budget: the file is becoming a silence list, fix findings "
+        f"instead")
+    for entry in entries:
+        assert len(entry.why) >= 10, (
+            f"suppression {entry.key!r} has a throwaway why-comment: "
+            f"{entry.why!r}")
+
+
+def test_cli_exits_zero_on_the_tree():
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.analysis"],
+        cwd=REPO, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, (proc.stdout, proc.stderr)
+    assert "0 finding(s)" in proc.stderr
+
+
+def test_cli_lists_the_documented_passes():
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_tpu.analysis", "--list-passes"],
+        cwd=REPO, capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0
+    assert tuple(proc.stdout.split()) == PASS_IDS
+
+
+# ------------------------------------------- the passes stay sharp
+
+
+def _write_pkg(tmp_path, name, body) -> str:
+    root = tmp_path / "fakepkg"
+    root.mkdir(exist_ok=True)
+    (root / name).write_text(textwrap.dedent(body))
+    return str(root)
+
+
+def test_lock_discipline_pass_catches_planted_bare_write(tmp_path):
+    root = _write_pkg(tmp_path, "victim.py", """\
+        import threading
+
+        class Table:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def add(self):
+                with self._lock:
+                    self.count += 1
+
+            def reset(self):
+                self.count = 0
+        """)
+    findings = run_passes(root, ("lock-discipline",))
+    assert [f.ident for f in findings] == ["Table.count"], findings
+
+
+def test_lock_discipline_pass_accepts_locked_suffix_convention(
+        tmp_path):
+    root = _write_pkg(tmp_path, "ok.py", """\
+        import threading
+
+        class Table:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.count = 0
+
+            def add(self):
+                with self._lock:
+                    self._bump_locked()
+
+            def _bump_locked(self):
+                self.count += 1
+        """)
+    assert not run_passes(root, ("lock-discipline",))
+
+
+def test_swallows_pass_catches_planted_silent_swallow(tmp_path):
+    root = _write_pkg(tmp_path, "eater.py", """\
+        def eat():
+            try:
+                open("/nope")
+            except OSError:
+                pass
+
+        def justified():
+            try:
+                open("/nope")
+            except OSError:
+                pass  # probe file is optional
+        """)
+    findings = run_passes(root, ("swallows",))
+    assert len(findings) == 1 and findings[0].ident == "eat:OSError"
+
+
+def test_swallows_pass_always_flags_bare_except(tmp_path):
+    root = _write_pkg(tmp_path, "bare.py", """\
+        def eat():
+            try:
+                open("/nope")
+            except:  # even a comment does not excuse a bare except
+                pass
+        """)
+    findings = run_passes(root, ("swallows",))
+    assert len(findings) == 1 and "bare" in findings[0].ident
+
+
+def test_chaos_pass_catches_unregistered_site():
+    """An unregistered should() string in the REAL tree would be
+    flagged: simulate by checking the pass's used-site extraction sees
+    through both chaos.should(x) and controller.should(x) shapes."""
+    from ray_tpu._private.analysis import (
+        default_package_root,
+        iter_sources,
+    )
+    from ray_tpu._private.analysis.chaos_sites import (
+        registered_sites,
+        used_sites,
+    )
+
+    sources = iter_sources(default_package_root())
+    used = used_sites(sources)
+    registered = registered_sites(sources)
+    assert used, "chaos-sites pass no longer sees any should() calls"
+    assert set(used) <= registered, (
+        f"sites drawn but unregistered: {set(used) - registered}")
+    import ray_tpu._private.chaos as chaos_mod
+
+    assert registered == set(chaos_mod.SITES), (
+        "AST-parsed registry drifted from the importable one")
+
+
+def test_counter_keys_pass_reads_real_registries():
+    from ray_tpu._private.analysis.counter_keys import registry_keys
+    from ray_tpu._private.node_executor import (
+        FAULT_STAT_KEYS,
+        PIPELINE_STAT_KEYS,
+    )
+    from ray_tpu._private.spill_manager import SPILL_STAT_KEYS
+
+    assert registry_keys("node_executor", "PIPELINE_STAT_KEYS") \
+        == PIPELINE_STAT_KEYS
+    assert registry_keys("node_executor", "FAULT_STAT_KEYS") \
+        == FAULT_STAT_KEYS
+    assert registry_keys("spill_manager", "SPILL_STAT_KEYS") \
+        == SPILL_STAT_KEYS
+
+
+# --------------------------------------- tier-1 runs witnessed
+
+
+def test_lock_witness_armed_through_tier1_with_zero_cycles():
+    """conftest.py arms the witness for the whole tier-1 run (env
+    inherited by every spawned daemon); any lock-order cycle raises at
+    its acquire site, and this check proves the arming took + nothing
+    was recorded without raising."""
+    if os.environ.get("RAY_TPU_LOCK_WITNESS", "") not in ("1", "true"):
+        pytest.skip("witness not armed in this run")
+    assert lock_witness.WITNESS_ON
+    assert lock_witness.cycles() == [], lock_witness.cycles()
