@@ -209,6 +209,9 @@ class LocalActor:
         if expired is not None:
             self._fail_call(call, expired)
             return
+        from ray_tpu._private import request_context
+
+        ctx_token = request_context.set_deadline(call.deadline)
         try:
             method = getattr(self._instance, call.method_name)
             result = method(*call.args, **call.kwargs)
@@ -220,6 +223,8 @@ class LocalActor:
             self._fail_call(call, ActorError(
                 exc, format_traceback(exc),
                 f"{self._cls.__name__}.{call.method_name}"))
+        finally:
+            request_context.reset_deadline(ctx_token)
 
     async def _execute_async(self, call: _ActorCall) -> None:
         with self._lock:
@@ -231,6 +236,9 @@ class LocalActor:
         if expired is not None:
             self._fail_call(call, expired)
             return
+        from ray_tpu._private import request_context
+
+        ctx_token = request_context.set_deadline(call.deadline)
         try:
             method = getattr(self._instance, call.method_name)
             result = method(*call.args, **call.kwargs)
@@ -244,6 +252,8 @@ class LocalActor:
             self._fail_call(call, ActorError(
                 exc, format_traceback(exc),
                 f"{self._cls.__name__}.{call.method_name}"))
+        finally:
+            request_context.reset_deadline(ctx_token)
 
     def _store_result(self, call: _ActorCall, result: Any) -> None:
         store = self._runtime.store

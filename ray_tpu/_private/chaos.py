@@ -60,6 +60,12 @@ Injection sites (the site string is the contract; counters surface in
   store pressure to the typed shed instead of crashing the daemon
 - ``spill.restore_delay`` spill tier: sleep 50-500 ms before a
   restore read, racing restores against concurrent gets/frees
+- ``llm.slow_step``     LLM engine: wedge one batched decode step for
+  ``RAY_TPU_LLM_SLOW_S`` seconds (default 2.0) BEFORE the jitted step
+  runs — proves a wedged decode trips the request deadline typed
+  (TaskTimeoutError stage ``llm_decode`` sealed by the caller-side
+  wait, exactly once) instead of hanging the stream; the sleep aborts
+  early on engine shutdown so a wedged engine still tears down
 """
 
 from __future__ import annotations
@@ -91,6 +97,7 @@ SITES: "tuple[str, ...]" = (
     "spill.torn_write",
     "spill.disk_full",
     "spill.restore_delay",
+    "llm.slow_step",
 )
 
 

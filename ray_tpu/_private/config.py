@@ -260,6 +260,30 @@ _DEFAULTS: dict[str, Any] = {
     # daemon or lingering old head can never double-register a node,
     # resurrect a dead actor, or corrupt the object directory.
     "gcs_epoch_fencing": True,
+    # LLM inference engine (serve/llm_engine): paged KV-cache
+    # continuous batching with prefill/decode scheduling. Disarmed
+    # (llm_paged_engine=0), LLMEngineServer falls back to the legacy
+    # slot-per-request llm.LLMServer byte-identically; every gated
+    # site costs one module-attribute branch (llm_engine PAGED_ON).
+    "llm_paged_engine": True,
+    # Tokens per KV block (the page size of the paged cache): small
+    # blocks waste less memory on ragged tails, large blocks shrink
+    # the block tables. Must divide into max_seq_len cleanly for a
+    # full-length sequence.
+    "llm_block_size": 16,
+    # Prefill chunk length: a long prompt prefills in fixed chunks of
+    # this many tokens, interleaved with decode steps, so one long
+    # prompt cannot stall in-flight streams. Also the jit-cache bound:
+    # ONE prefill program total (every chunk pads to this shape).
+    "llm_prefill_chunk": 32,
+    # Bounded engine waiting queue: requests past this depth shed
+    # typed (CacheExhaustedError -> SystemOverloadedError path ->
+    # HTTP 503) instead of queueing unboundedly.
+    "llm_max_waiting": 64,
+    # Serve routers push their live latency_stats() (p50/p99) to the
+    # controller at most this often — the feed the latency-driven
+    # replica autoscaler consumes. 0 disables the push.
+    "serve_latency_report_s": 1.0,
     # Worker pipe transport.
     "worker_inline_result_kb": 64,     # pool results <= this inline
     # Distributed tracing plane (util/tracing.py). Disabled, every

@@ -92,6 +92,40 @@ def has_cpu_multiprocess(timeout_s: float = 120.0) -> bool:
     return ok
 
 
+def set_mesh(mesh):
+    """Context manager installing ``mesh`` as the ambient mesh for the
+    jitted calls inside — the TP path the LLM engine / llm.py docs
+    reference. ``mesh=None`` is a no-op (single-chip).
+
+    New jax: ``jax.set_mesh(mesh)`` (a context manager since 0.7; on
+    the in-between releases where it sets globally we fall back to the
+    ``jax.sharding.use_mesh`` spelling). Old jax (< 0.5, no ambient
+    API): the ``with mesh:`` physical-mesh context, which is exactly
+    what pjit-era code used — so engine code written against
+    ``jax_compat.set_mesh`` imports AND runs clean on jax 0.4.x.
+    """
+    import contextlib
+
+    if mesh is None:
+        return contextlib.nullcontext()
+    new = getattr(jax, "set_mesh", None)
+    if new is not None:
+        ctx = new(mesh)
+        if hasattr(ctx, "__enter__"):
+            return ctx
+        use_mesh = getattr(jax.sharding, "use_mesh", None)
+        if use_mesh is not None:
+            return use_mesh(mesh)
+        return contextlib.nullcontext()  # already installed globally
+
+    @contextlib.contextmanager
+    def _physical(mesh):
+        with mesh:
+            yield mesh
+
+    return _physical(mesh)
+
+
 def ambient_mesh():
     """The ambient mesh, or None when none is set (or unknowable).
 

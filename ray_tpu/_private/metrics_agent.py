@@ -170,6 +170,7 @@ def install_runtime_collectors(runtime):
 
         by_node = _node_stats_table(runtime)
         lines.extend(_node_stat_lines(by_node))
+        lines.extend(_engine_lines(by_node))
         lines.extend(_sched_node_lines(by_node))
         # Always-on performance plane: stage-latency histogram families
         # (driver's own registry + every node's heartbeat-shipped
@@ -233,6 +234,37 @@ def _node_stat_lines(by_node: dict) -> list[str]:
                     lines.append(
                         f'{metric}{{node="{node}",'
                         f'key="{_escape_label(key)}"}} {value}')
+    return lines
+
+
+def _engine_lines(by_node: dict) -> list[str]:
+    """LLM-engine counter family (``ray_tpu_node_engine``): engines
+    hosted in THIS process surface under node="driver"; daemon-hosted
+    engines arrive through the heartbeat-shipped ``engine`` stats
+    group. sys.modules probe — a scrape must not import the serve tier
+    into processes that never served an LLM."""
+    import sys
+
+    lines: list[str] = []
+    rows: "list[tuple[str, dict]]" = []
+    mod = sys.modules.get("ray_tpu.serve.llm_engine.engine")
+    if mod is not None:
+        merged = mod.merged_engine_stats()
+        if merged:
+            rows.append(("driver", merged))
+    for node_hex, stats in sorted(by_node.items()):
+        group = stats.get("engine") if isinstance(stats, dict) else None
+        if isinstance(group, dict):
+            rows.append((node_hex[:16], group))
+    if not rows:
+        return lines
+    lines.append("# TYPE ray_tpu_node_engine counter")
+    for node, group in rows:
+        for key, value in sorted(group.items()):
+            if isinstance(value, (int, float)):
+                lines.append(
+                    f'ray_tpu_node_engine{{node="{_escape_label(node)}",'
+                    f'key="{_escape_label(key)}"}} {int(value)}')
     return lines
 
 

@@ -2603,15 +2603,19 @@ class NodeExecutorService:
                 "partials": len(self._partials),
                 "relay_chunks_served": self.relay_chunks_served,
             }
-        return {"tasks_executed": self.tasks_executed,
-                "running": running, "store": self.store.stats(),
-                "num_actors": num_actors, "pid": os.getpid(),
-                "relay": relay,
-                "data_plane": self._data_plane_stats(),
-                "pipeline": self._pipeline_stats(),
-                "faults": self._fault_stats(),
-                "spill": self._spill_stats(),
-                "threads": threading.active_count()}
+        stats = {"tasks_executed": self.tasks_executed,
+                 "running": running, "store": self.store.stats(),
+                 "num_actors": num_actors, "pid": os.getpid(),
+                 "relay": relay,
+                 "data_plane": self._data_plane_stats(),
+                 "pipeline": self._pipeline_stats(),
+                 "faults": self._fault_stats(),
+                 "spill": self._spill_stats(),
+                 "threads": threading.active_count()}
+        engine = self._engine_stats()
+        if engine is not None:
+            stats["engine"] = engine
+        return stats
 
     def _spill_stats(self) -> dict:
         from ray_tpu._private.spill_manager import merged_stats
@@ -2619,6 +2623,19 @@ class NodeExecutorService:
         stats = merged_stats(self._spill_mgr)
         stats["spilled_plan_hits"] = self.spilled_plan_hits
         return stats
+
+    @staticmethod
+    def _engine_stats() -> "dict | None":
+        """LLM-engine counters for engines co-hosted in this process
+        (serve replicas run as thread actors here). sys.modules probe:
+        a daemon that never served an LLM must not import the serve
+        tier just to report stats."""
+        import sys
+
+        mod = sys.modules.get("ray_tpu.serve.llm_engine.engine")
+        if mod is None:
+            return None
+        return mod.merged_engine_stats()
 
     def stats_for_sync(self) -> dict:
         """Heartbeat-piggyback subset of ``executor_stats()``: the
@@ -2648,6 +2665,11 @@ class NodeExecutorService:
             events = self._drain_spill_events()
             if events:
                 stats["spill_events"] = events
+        engine = self._engine_stats()
+        if engine is not None:
+            # LLM-engine counters ride the same heartbeat piggyback
+            # into the cluster /metrics (ray_tpu_node_engine family).
+            stats["engine"] = engine
         if perf.PERF_ON:
             # Always-on plane piggyback: mergeable-by-addition stage
             # histograms + the per-function attribution table ride the

@@ -1,0 +1,38 @@
+"""Per-call request context: the in-flight call's end-to-end deadline.
+
+The PR-7 deadline machinery stamps an ABSOLUTE deadline onto every
+task/actor call and checks it at each pipeline stage — but until now
+the budget was invisible to the USER CODE the call finally runs. A
+serve replica hosting a long-lived engine (the LLM engine's internal
+waiting queue and decode loop) needs the remaining budget so ITS
+stages can refuse dead work too, instead of decoding tokens nobody is
+waiting for.
+
+The actor runtimes set the contextvar around each method invocation;
+``ray_tpu.runtime_context.get_runtime_context().get_task_deadline()``
+reads it from inside the method (None = no deadline armed).
+Contextvars propagate into coroutines and stay isolated per thread, so
+concurrent actor calls never see each other's budgets.
+"""
+
+from __future__ import annotations
+
+import contextvars
+
+_DEADLINE: "contextvars.ContextVar[float | None]" = contextvars.ContextVar(
+    "ray_tpu_call_deadline", default=None)
+
+
+def set_deadline(deadline: "float | None"):
+    """Install the current call's absolute deadline (time.time());
+    returns the token for :func:`reset_deadline`."""
+    return _DEADLINE.set(deadline)
+
+
+def reset_deadline(token) -> None:
+    _DEADLINE.reset(token)
+
+
+def current_deadline() -> "float | None":
+    """The in-flight call's absolute deadline, or None."""
+    return _DEADLINE.get()

@@ -109,6 +109,24 @@ class SystemOverloadedError(RayTpuError):
                  self.retry_after_s))
 
 
+class CacheExhaustedError(SystemOverloadedError):
+    """The LLM engine's paged KV-cache (or its bounded waiting queue)
+    cannot hold this request right now. Subclasses
+    ``SystemOverloadedError`` so it sheds through the existing typed
+    overload path (serve handle callers see it typed; the HTTP tier
+    maps it to 503 + Retry-After). RETRYABLE: nothing decoded — back
+    off and resubmit."""
+
+    def __init__(self, reason: str = "KV cache exhausted",
+                 retry_after_s: float = 0.5):
+        super().__init__(reason, retry_after_s)
+
+    def __reduce__(self):
+        return (CacheExhaustedError,
+                (self.args[0] if self.args else "KV cache exhausted",
+                 self.retry_after_s))
+
+
 class TaskCancelledError(RayTpuError):
     """The task was cancelled before or during execution."""
 

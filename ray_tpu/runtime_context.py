@@ -49,6 +49,18 @@ class RuntimeContextAPI:
     def get_assigned_resources(self) -> dict:
         return _Ctx.current().get("resources", {})
 
+    def get_task_deadline(self) -> float | None:
+        """The in-flight call's ABSOLUTE end-to-end deadline
+        (time.time() clock) inherited from the PR-7 overload-control
+        plane (``.options(_deadline_s=...)`` / serve
+        ``request_timeout_s``), or None when no budget is armed.
+        Long-lived engines (e.g. the LLM engine) read this so their
+        internal queues refuse dead work typed instead of serving
+        results nobody is waiting for."""
+        from ray_tpu._private import request_context
+
+        return request_context.current_deadline()
+
 
 def get_runtime_context() -> RuntimeContextAPI:
     return RuntimeContextAPI()

@@ -4,9 +4,12 @@ Reference: python/ray/serve — @serve.deployment (api.py:246), serve.run
 (:439), controller/replica/router/pow-2 scheduling, @serve.batch
 (batching.py:436), long-poll config fan-out (long_poll.py), autoscaling.
 
-TPU-native specifics live in ray_tpu.serve.llm: a replica hosting a
-jit/pjit'd generate function with continuous batching, so many HTTP
-requests share one MXU-friendly decode batch.
+TPU-native specifics live in ray_tpu.serve.llm_engine: a paged
+KV-cache continuous-batching inference engine (prefill/decode
+scheduling, gather-by-block-table attention, latency-driven replica
+autoscaling) so many HTTP requests share one MXU-friendly decode
+batch. ray_tpu.serve.llm keeps the legacy slot-per-request prototype
+as the llm_paged_engine=0 fallback.
 """
 
 from ray_tpu.serve.api import (

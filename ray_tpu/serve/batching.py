@@ -29,10 +29,15 @@ class _Batcher:
         self._lock = threading.Condition()
         self._queue: list[tuple[Any, concurrent.futures.Future]] = []
         self._thread: threading.Thread | None = None
+        self._stopped = False
 
     def submit(self, instance, item: Any) -> concurrent.futures.Future:
         fut: concurrent.futures.Future = concurrent.futures.Future()
         with self._lock:
+            if self._stopped:
+                raise RuntimeError(
+                    "@serve.batch batcher is shut down (deployment "
+                    "stopping)")
             self._queue.append((item, fut))
             # The loop only exits under this lock with an empty queue
             # (clearing self._thread), so a live self._thread is
@@ -45,10 +50,29 @@ class _Batcher:
             self._lock.notify_all()
         return fut
 
+    def shutdown(self, timeout_s: float = 5.0) -> None:
+        """Deployment shutdown: stop the batcher thread and FAIL every
+        still-queued caller (a future that would otherwise wait on a
+        thread that will never drain it). Idempotent."""
+        with self._lock:
+            self._stopped = True
+            pending, self._queue = self._queue, []
+            thread = self._thread
+            self._lock.notify_all()
+        for _, fut in pending:
+            if not fut.done():
+                fut.set_exception(RuntimeError(
+                    "@serve.batch batcher shut down before this "
+                    "request was batched"))
+        if thread is not None and thread is not threading.current_thread():
+            thread.join(timeout=timeout_s)
+
     def _take_batch(self) -> list[tuple[Any, concurrent.futures.Future]]:
         deadline = time.monotonic() + self._wait_s
         with self._lock:
             while True:
+                if self._stopped:
+                    return []
                 if len(self._queue) >= self._max_batch_size:
                     batch = self._queue[:self._max_batch_size]
                     del self._queue[:self._max_batch_size]
@@ -60,10 +84,30 @@ class _Batcher:
                 self._lock.wait(min(remaining, 0.05))
 
     def _loop(self, instance) -> None:
+        try:
+            self._loop_impl(instance)
+        finally:
+            # The loop NEVER exits with waiting callers attached —
+            # whatever killed it (shutdown, or an exotic BaseException
+            # escaping the per-batch handler), queued futures fail
+            # loudly instead of hanging their callers forever.
+            with self._lock:
+                pending, self._queue = self._queue, []
+                if self._thread is threading.current_thread():
+                    self._thread = None
+            for _, fut in pending:
+                if not fut.done():
+                    fut.set_exception(RuntimeError(
+                        "@serve.batch batcher thread exited with this "
+                        "request still queued"))
+
+    def _loop_impl(self, instance) -> None:
         idle_since = time.monotonic()
         while True:
             batch = self._take_batch()
             if not batch:
+                if self._stopped:
+                    return
                 if time.monotonic() - idle_since > 5.0:
                     with self._lock:
                         if self._queue:
@@ -85,10 +129,20 @@ class _Batcher:
                         f"{len(items)} results, got {type(results)}")
                 for (_, fut), result in zip(batch, results):
                     fut.set_result(result)
-            except Exception as exc:  # noqa: BLE001 — fan the error out
+            except BaseException as exc:  # noqa: BLE001 — fan the error out
+                # EVERY waiting caller of this batch gets the error —
+                # a KeyboardInterrupt/SystemExit-shaped failure must
+                # not strand half the batch on futures nobody will
+                # ever complete.
                 for _, fut in batch:
                     if not fut.done():
-                        fut.set_exception(exc)
+                        fut.set_exception(
+                            exc if isinstance(exc, Exception)
+                            else RuntimeError(
+                                f"@serve.batch function died with "
+                                f"{type(exc).__name__}: {exc}"))
+                if not isinstance(exc, Exception):
+                    raise  # fatal: let _loop's finally fail the queue
 
 
 def batch(_fn: Callable | None = None, *, max_batch_size: int = 10,
@@ -129,6 +183,18 @@ def batch(_fn: Callable | None = None, *, max_batch_size: int = 10,
                         id_fallback[id(instance)] = b
                     return b
 
+        def existing_batcher(instance) -> "_Batcher | None":
+            """The batcher already bound to ``instance`` (None when it
+            never submitted) — deployment shutdown looks its batchers
+            up WITHOUT creating new ones."""
+            if instance is None:
+                return free_batcher
+            with creation_lock:
+                try:
+                    return per_instance.get(instance)
+                except TypeError:  # no __weakref__ slot
+                    return id_fallback.get(id(instance))
+
         @functools.wraps(fn)
         def wrapper(*args):
             if len(args) == 2:  # bound method: (self, item)
@@ -140,8 +206,33 @@ def batch(_fn: Callable | None = None, *, max_batch_size: int = 10,
             return batcher_for(instance).submit(instance, item).result()
 
         wrapper._serve_batcher = free_batcher
+        wrapper._serve_batcher_for = existing_batcher
         return wrapper
 
     if _fn is not None:
         return decorator(_fn)
     return decorator
+
+
+def shutdown_batchers(instance) -> int:
+    """Stop every batcher thread bound to ``instance``'s @serve.batch
+    methods (the replica calls this from prepare_for_shutdown): each
+    thread exits and still-queued callers fail typed instead of
+    hanging on a future nobody will drain. Returns the number of
+    batchers stopped."""
+    if instance is None:
+        return 0
+    stopped = 0
+    for name in dir(type(instance)):
+        try:
+            attr = getattr(type(instance), name)
+        except AttributeError:
+            continue
+        lookup = getattr(attr, "_serve_batcher_for", None)
+        if lookup is None:
+            continue
+        batcher = lookup(instance)
+        if batcher is not None:
+            batcher.shutdown()
+            stopped += 1
+    return stopped

@@ -30,6 +30,16 @@ class AutoscalingConfig:
     upscale_smoothing_factor: float = 1.0
     downscale_smoothing_factor: float = 1.0
     initial_replicas: int | None = None
+    # Latency-driven closed loop (the LLM-engine autoscaler): > 0
+    # switches the policy to llm_engine.autoscale.LatencyPolicy —
+    # replicas scale up when the router-reported p99 exceeds this
+    # budget (seconds), down when p99 sits under half of it with
+    # per-replica depth below target_ongoing_requests, damped by the
+    # up/down delay cooldowns (a direction flip waits out BOTH). The
+    # feed is the live Router.latency_stats() p50/p99 pushed to the
+    # controller every serve_latency_report_s, plus the replicas'
+    # engine_depth gauge.
+    target_p99_s: float = 0.0
 
     def desired_replicas(self, total_ongoing: float, current: int) -> int:
         if current == 0:

@@ -45,6 +45,12 @@ class _DeploymentState:
     handle_args: dict = field(default_factory=dict)
     last_scale_change: float = 0.0
     deleting: bool = False
+    # Latency-driven autoscaling (AutoscalingConfig.target_p99_s > 0):
+    # the freshest router-pushed latency_stats() + receipt stamp, and
+    # the per-deployment LatencyPolicy instance (cooldown state).
+    latency_report: dict | None = None
+    latency_report_ts: float = 0.0
+    latency_policy: Any = None
 
 
 class ServeController:
@@ -100,6 +106,27 @@ class ServeController:
                 return -1
             return int(getattr(state.deployment_config,
                                "max_queued_requests", -1))
+
+    def report_latency(self, app_name: str, name: str,
+                       stats: dict) -> None:
+        """Router push: the live per-deployment latency summary
+        (count/mean/p50_s/p99_s) the latency autoscaler consumes.
+        Routers live in every handle-holding process; last writer wins
+        — the policy only needs A fresh view, not a merged one."""
+        with self._lock:
+            state = self._deployments.get((app_name, name))
+            if state is not None:
+                state.latency_report = dict(stats or {})
+                state.latency_report_ts = time.monotonic()
+
+    def get_latency_report(self, app_name: str, name: str) -> dict:
+        """The freshest pushed report + its age (tests/debugging)."""
+        with self._lock:
+            state = self._deployments.get((app_name, name))
+            if state is None or state.latency_report is None:
+                return {}
+            return {**state.latency_report,
+                    "age_s": time.monotonic() - state.latency_report_ts}
 
     def set_ingress(self, app_name: str, deployment_name: str) -> None:
         with self._lock:
@@ -233,21 +260,57 @@ class ServeController:
                 except Exception:  # noqa: BLE001
                     pass
             total_ongoing = 0.0
+            engine_depth = 0.0
             for ref in refs:
                 try:
-                    total_ongoing += ray_tpu.get(ref, timeout=1.0)[
-                        "num_ongoing_requests"]
+                    metrics = ray_tpu.get(ref, timeout=1.0)
+                    total_ongoing += metrics["num_ongoing_requests"]
+                    # Engine-hosting replicas (LLM) report their
+                    # INTERNAL queue too — requests parked in the
+                    # engine's waiting queue are invisible to the
+                    # replica's ongoing count but are exactly the load
+                    # the autoscaler must see.
+                    engine_depth += float(
+                        metrics.get("engine_depth", 0) or 0)
                 except Exception:  # noqa: BLE001 — dead replica
                     pass
             current = len(replicas)
-            desired = cfg.desired_replicas(total_ongoing, current)
             now = time.monotonic()
+            if getattr(cfg, "target_p99_s", 0.0) > 0:
+                desired = self._latency_desired(
+                    state, cfg, current, total_ongoing + engine_depth,
+                    now)
+                if desired is not None and desired != current:
+                    with self._lock:
+                        state.target_replicas = desired
+                continue
+            desired = cfg.desired_replicas(
+                total_ongoing + engine_depth, current)
             delay = (cfg.upscale_delay_s if desired > current
                      else cfg.downscale_delay_s)
             if desired != current and \
                     now - state.last_scale_change >= delay:
                 with self._lock:
                     state.target_replicas = desired
+
+    def _latency_desired(self, state: _DeploymentState, cfg,
+                         current: int, depth: float,
+                         now: float) -> "int | None":
+        """The latency-driven closed loop: LatencyPolicy over the
+        freshest router-pushed p99 plus engine/replica depth."""
+        from ray_tpu.serve.llm_engine.autoscale import LatencyPolicy
+
+        with self._lock:
+            if state.latency_policy is None:
+                state.latency_policy = LatencyPolicy(cfg)
+            policy = state.latency_policy
+            report = state.latency_report
+            age_s = (now - state.latency_report_ts
+                     if report is not None else float("inf"))
+        if report is None or current == 0:
+            return None
+        return policy.desired(current, float(report.get("p99_s", 0.0)),
+                              depth, now, feed_age_s=age_s)
 
     def _health_check_once(self) -> None:
         """Fully non-blocking probe cycle: each replica carries at most

@@ -13,10 +13,17 @@ first-class component here. TPU-first design:
 - prefill lengths are bucketed to powers of two so XLA compiles a
   handful of prefill programs, then every step hits the jit cache;
 - donate_argnums on the cache: decode updates in place in HBM;
-- under a TP mesh, wrap with ``with jax.set_mesh(...)`` and shard params
-  via ray_tpu.parallel.sharding — the same jitted fns become pjit.
+- under a TP mesh, wrap with ``with jax_compat.set_mesh(mesh):`` (the
+  version-portable spelling of ``jax.set_mesh`` — this box's jax 0.4.x
+  has only the ``with mesh:`` physical-mesh context, which the shim
+  selects) and shard params via ray_tpu.parallel.sharding — the same
+  jitted fns become pjit.
 
 Works headless (token-in/token-out) so no tokenizer dependency.
+
+NOTE: superseded by ``ray_tpu.serve.llm_engine`` (paged KV cache +
+prefill/decode scheduling); this class remains as the
+``llm_paged_engine=0`` fallback path and the A/B baseline.
 """
 
 from __future__ import annotations

@@ -1,0 +1,560 @@
+"""The continuous-batching engine loop over the paged KV cache.
+
+One thread per engine runs the scheduler's interleave: sweep expired
+budgets, claim/advance ONE prefill chunk, then ONE fixed-shape decode
+step for every active stream — tokens stream out per step, finished
+rows free their blocks between steps, and cache pressure preempts the
+lowest-progress stream (recompute-on-resume) instead of failing it.
+
+Disarm discipline: the ``llm_paged_engine`` knob arms the ONE module
+attribute ``PAGED_ON`` (the ``TRACE_ON``/``SPILL_ON`` idiom);
+``LLMEngineServer`` branches on it to fall back to the legacy
+slot-per-request ``serve.llm.LLMServer``. Counters ship as
+``ENGINE_STAT_KEYS`` through the node-stats heartbeat piggyback
+(``ray_tpu_node_engine`` /metrics family) via the process-local
+engine registry below.
+
+Chaos: ``llm.slow_step`` wedges one decode step for
+``RAY_TPU_LLM_SLOW_S`` seconds before the jitted call — the
+deterministic proof that a wedged decode trips the request deadline
+typed (caller-side seal, stage recorded) instead of hanging streams.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+import time
+import weakref
+
+import numpy as np
+
+from ray_tpu._private import chaos, lock_witness
+from ray_tpu.exceptions import CacheExhaustedError, GetTimeoutError
+from ray_tpu.serve.llm_engine import model as paged_model
+from ray_tpu.serve.llm_engine.kv_cache import PagedKVCache
+from ray_tpu.serve.llm_engine.scheduler import (
+    DECODE,
+    EngineRequest,
+    Scheduler,
+)
+
+__all__ = ["ENGINE_STAT_KEYS", "LLMEngine", "PAGED_ON",
+           "merged_engine_stats", "merged_engine_load"]
+
+# The ONE production branch: LLMEngineServer checks this module
+# attribute to pick the paged engine vs the legacy slot-per-request
+# path. Armed from the llm_paged_engine knob at import/init.
+PAGED_ON: bool = True
+
+# Counter contract: code increments exactly these keys, engine_stats()
+# serves them, the README "LLM serving" section documents them, and
+# metrics_agent exports them as the ray_tpu_node_engine family (the
+# counter-keys analysis pass enforces all three).
+ENGINE_STAT_KEYS = (
+    "admitted", "shed_queue_full", "shed_cache",
+    "prefill_chunks", "prefill_tokens",
+    "decode_steps", "batched_decode_steps", "decode_tokens",
+    "preemptions", "resumes", "finished", "deadline_expired",
+    "slow_steps", "blocks_allocated", "blocks_freed",
+)
+
+# Live engines in THIS process (serve replicas are co-hosted with the
+# node executor, so daemon heartbeats pick these up; driver-local
+# engines surface under node="driver" in the scrape).
+_LIVE: "weakref.WeakSet" = weakref.WeakSet()
+
+
+class LLMEngine:
+    """Paged-KV continuous-batching engine (token-in/token-out)."""
+
+    def __init__(self, config=None, params=None, *,
+                 max_batch_size: int = 8, max_seq_len: "int | None" = None,
+                 block_size: "int | None" = None,
+                 num_blocks: "int | None" = None,
+                 prefill_chunk: "int | None" = None,
+                 max_waiting: "int | None" = None,
+                 seed: int = 0, mesh=None):
+        import jax
+
+        from ray_tpu._private.config import GLOBAL_CONFIG
+        from ray_tpu.models import llama
+
+        self.config = config or llama.LlamaConfig.tiny()
+        self.params = params if params is not None else llama.init_params(
+            self.config, jax.random.PRNGKey(seed))
+        self.max_batch = int(max_batch_size)
+        self.max_len = int(max_seq_len or self.config.max_seq_len)
+        self.block_size = int(block_size or GLOBAL_CONFIG.llm_block_size)
+        self.prefill_chunk_len = int(
+            prefill_chunk or GLOBAL_CONFIG.llm_prefill_chunk)
+        # Table width: blocks covering max_len, rounded up — ONE decode
+        # program at [max_batch, M * block_size] attention width.
+        self.blocks_per_seq = -(-self.max_len // self.block_size)
+        self.max_tokens = self.blocks_per_seq * self.block_size
+        if num_blocks is None:
+            # Default pool: every row can hold a full-length sequence
+            # (+ scratch). Smaller pools oversubscribe and lean on
+            # preemption — the production configuration.
+            num_blocks = 1 + self.max_batch * self.blocks_per_seq
+        cache = PagedKVCache(int(num_blocks), self.block_size,
+                             self.blocks_per_seq)
+        self._sched = Scheduler(
+            cache, self.max_batch,
+            int(max_waiting or GLOBAL_CONFIG.llm_max_waiting),
+            self.max_tokens)
+        self._mesh = mesh
+        self._pool = PagedKVCache.init_pool(self.config, cache.num_blocks,
+                                            self.block_size)
+        self._key = jax.random.PRNGKey(seed + 1)
+        self._counters: "dict[str, int]" = {k: 0 for k in ENGINE_STAT_KEYS}
+        self._lock = lock_witness.Condition("llm_engine.LLMEngine.state")
+        self._shutdown = threading.Event()
+        _LIVE.add(self)
+        self._loop_thread = threading.Thread(
+            target=self._engine_loop, name="llm-paged-engine", daemon=True)
+        self._loop_thread.start()
+
+    # ----------------------------------------------------------- jitted fns
+
+    @functools.cached_property
+    def _decode_step(self):
+        return paged_model.make_decode_step(self.config, self.block_size)
+
+    @functools.cached_property
+    def _prefill_step(self):
+        return paged_model.make_prefill_chunk(self.config, self.block_size)
+
+    # ----------------------------------------------------------- public API
+
+    def submit(self, tokens, max_new_tokens: int = 16,
+               temperature: float = 0.0,
+               deadline: "float | None" = None, stream: bool = False,
+               name: str = "llm_generate") -> EngineRequest:
+        """Admit one request (bounded; full queue / never-fits sheds
+        typed through the SystemOverloadedError path). ``deadline`` is
+        ABSOLUTE (time.time()); inherit it from the serve call via
+        ``get_runtime_context().get_task_deadline()``."""
+        max_new = max(1, min(int(max_new_tokens), self.max_tokens - 2))
+        prompt = list(tokens) or [0]
+        keep = max(1, self.max_tokens - max_new - 1)
+        prompt = prompt[-keep:]
+        req = EngineRequest(prompt, max_new, temperature,
+                            deadline=deadline, name=name, stream=stream)
+        with self._lock:
+            if self._shutdown.is_set():
+                raise RuntimeError("LLM engine is shut down")
+            sched = self._sched
+            if len(sched.waiting) >= sched.max_waiting:
+                self._counters["shed_queue_full"] += 1
+                raise CacheExhaustedError(
+                    f"engine waiting queue full ({sched.max_waiting})")
+            if not sched.cache.fits_ever(
+                    min(len(prompt) + max_new, self.max_tokens)):
+                self._counters["shed_cache"] += 1
+                raise CacheExhaustedError(
+                    f"request needs more KV blocks than the pool holds "
+                    f"({sched.cache.usable_blocks})")
+            sched.try_enqueue(req)
+            self._counters["admitted"] += 1
+            self._lock.notify_all()
+        return req
+
+    def result(self, req: EngineRequest,
+               timeout_s: "float | None" = None) -> "list[int]":
+        """Block until the request seals; a dead inherited budget seals
+        it typed HERE (exactly once, even when the engine loop itself
+        is wedged — the chaos llm.slow_step contract)."""
+        wall_deadline = (time.monotonic() + timeout_s
+                         if timeout_s is not None else None)
+        while not req.done.wait(timeout=0.05):
+            self._check_caller_deadline(req)
+            if wall_deadline is not None \
+                    and time.monotonic() > wall_deadline:
+                raise GetTimeoutError(
+                    f"generation exceeded timeout_s={timeout_s}")
+        if req.error is not None:
+            raise req.error
+        return list(req.output)
+
+    def stream_tokens(self, req: EngineRequest):
+        """Yield tokens AS the engine emits them (consumption overlaps
+        decode). Terminates with the sealed result: StopIteration on
+        success, the typed error otherwise."""
+        import queue as queue_mod
+
+        assert req.stream is not None, "submit(stream=True) first"
+        while True:
+            try:
+                kind, payload = req.stream.get(timeout=0.05)
+            except queue_mod.Empty:
+                self._check_caller_deadline(req)
+                continue
+            if kind == "tok":
+                yield payload
+            elif kind == "end":
+                return
+            else:
+                raise payload
+
+    def _check_caller_deadline(self, req: EngineRequest) -> None:
+        if req.deadline is not None and time.time() > req.deadline \
+                and not req.sealed:
+            if self._seal(req, self._sched.expired_error(req)):
+                with self._lock:
+                    self._counters["deadline_expired"] += 1
+
+    # -------------------------------------------------------------- sealing
+
+    def _seal(self, req: EngineRequest,
+              error: "Exception | None" = None) -> bool:
+        """The ONE commit point: first sealer wins (engine finish,
+        engine/caller deadline sweep, shutdown) — completion is
+        exactly-once however the race lands, preempted or not."""
+        with self._lock:
+            if req.sealed:
+                return False
+            req.sealed = True
+            req.error = error
+        if req.stream is not None:
+            req.stream.put(("err", error) if error is not None
+                           else ("end", None))
+        req.done.set()
+        return True
+
+    def _emit(self, req: EngineRequest, token: int) -> None:
+        req.output.append(token)
+        if req.stream is not None:
+            req.stream.put(("tok", token))
+
+    # --------------------------------------------------------------- engine
+
+    def _engine_loop(self) -> None:
+        while not self._shutdown.is_set():
+            with self._lock:
+                newly_expired = self._sched.sweep_expired()
+                for req in newly_expired:
+                    self._counters["deadline_expired"] += 1
+            for req in newly_expired:
+                self._seal(req, self._sched.expired_error(req))
+            progressed = self._prefill_tick()
+            progressed = self._decode_tick() or progressed
+            if not progressed:
+                with self._lock:
+                    if self._sched.depth() == 0:
+                        self._lock.wait(0.002)
+
+    def _grow_or_preempt_locked(self, req: EngineRequest,
+                                n_tokens: int) -> str:
+        """Grow ``req``'s table to cover ``n_tokens``, preempting the
+        lowest-progress stream per retry (caller holds the lock).
+        Returns ``"ok"`` when the table covers the target,
+        ``"victim"`` when ``req`` itself was preempted, ``"shed"``
+        when nothing was left to preempt (the caller seals typed,
+        OUTSIDE the lock)."""
+        while True:
+            try:
+                self._sched.cache.grow(req.block_table, n_tokens)
+                return "ok"
+            except CacheExhaustedError:
+                victim = self._sched.pick_victim()
+                if victim is None and self._sched.prefilling is req:
+                    # No decode stream left to preempt and the pool
+                    # still can't take the prefill: shed typed (only
+                    # reachable while sealed-but-unswept holders pin
+                    # blocks — the next sweep frees them).
+                    self._sched.prefilling = None
+                    self._sched.cache.release(req.block_table)
+                    self._counters["shed_cache"] += 1
+                    return "shed"
+                if victim is None:
+                    victim = req
+                self._counters["preemptions"] += 1
+                self._sched.preempt(victim)
+                if victim is req:
+                    return "victim"
+
+    def _prefill_tick(self) -> bool:
+        """At most ONE chunk of ONE request per engine iteration —
+        the interleave that keeps long prompts from stalling decode."""
+        with self._lock:
+            if self._sched.prefilling is None:
+                claimed = self._sched.claim_prefill()
+                if claimed is not None and claimed.preempted > 0:
+                    self._counters["resumes"] += 1
+            req = self._sched.prefilling
+            if req is None:
+                return False
+            n = min(self.prefill_chunk_len,
+                    len(req.context) - req.prefilled)
+            status = self._grow_or_preempt_locked(req, req.prefilled + n)
+            if status == "ok":
+                start = req.prefilled
+                table = list(req.block_table)
+        if status == "shed":
+            self._seal(req, CacheExhaustedError(
+                "KV block pool exhausted mid-prefill"))
+            return True
+        if status == "victim":
+            return True  # re-queued; pressure eased — progress made
+
+        chunk = self.prefill_chunk_len
+        tokens = np.zeros((1, chunk), dtype=np.int32)
+        tokens[0, :n] = req.context[start:start + n]
+        positions = np.zeros((1, chunk), dtype=np.int32)
+        positions[0, :n] = np.arange(start, start + n)
+        bt = np.zeros((1, self.blocks_per_seq), dtype=np.int32)
+        bt[0, :len(table)] = table
+        import jax.numpy as jnp
+
+        from ray_tpu._private import jax_compat
+
+        try:
+            with jax_compat.set_mesh(self._mesh):
+                last_logits, self._pool = self._prefill_step(
+                    self.params, self._pool, jnp.asarray(tokens),
+                    jnp.asarray(positions), jnp.asarray(bt),
+                    np.int32(n), np.int32(n - 1))
+        except Exception as exc:  # noqa: BLE001 — donated pool is gone
+            self._reset_after_failure(exc)
+            return True
+        with self._lock:
+            self._counters["prefill_chunks"] += 1
+            self._counters["prefill_tokens"] += n
+            req.prefilled += n
+            if req.prefilled < len(req.context):
+                return True
+            # Prompt fully prefilled: enter the decode batch.
+            req.position = len(req.context)
+            first_token = None
+            if req.sample_first:
+                first_token = self._sample_first(req, last_logits)
+            else:
+                req.last_token = req.output[-1]
+            self._sched.prefilling = None
+            req.state = DECODE
+            req.remaining = req.max_new_tokens - len(req.output) \
+                - (1 if first_token is not None else 0)
+            if first_token is not None:
+                self._emit(req, first_token)
+                req.last_token = first_token
+            if req.remaining <= 0 or req.position >= self.max_tokens:
+                self._finish_locked(req)
+            else:
+                self._sched.active.append(req)
+        return True
+
+    def _sample_first(self, req: EngineRequest, last_logits) -> int:
+        import jax
+        import jax.numpy as jnp
+
+        if req.temperature > 0:
+            self._key, sub = jax.random.split(self._key)
+            return int(jax.random.categorical(
+                sub, last_logits / max(req.temperature, 1e-4)))
+        return int(jnp.argmax(last_logits))
+
+    def _finish_locked(self, req: EngineRequest) -> None:
+        self._sched.cache.release(req.block_table)
+        if req in self._sched.active:
+            self._sched.active.remove(req)
+        self._counters["finished"] += 1
+        # Seal outside the engine lock is the usual discipline, but
+        # _seal re-checks under the same reentrant-safe path; here we
+        # mark and set the event after releasing blocks.
+        req.sealed = True
+        if req.stream is not None:
+            req.stream.put(("end", None))
+        req.done.set()
+
+    def _decode_tick(self) -> bool:
+        with self._lock:
+            if not self._sched.active:
+                return False
+            # Grow every row's table for the token it is about to
+            # write; pressure preempts lowest-progress rows.
+            for req in list(self._sched.active):
+                if req not in self._sched.active:
+                    continue  # already preempted as a victim
+                self._grow_or_preempt_locked(req, req.position + 1)
+            active = list(self._sched.active)
+            if not active:
+                return True  # everything preempted: progress made
+            B = self.max_batch
+            tokens = np.zeros((B, 1), dtype=np.int32)
+            positions = np.zeros((B,), dtype=np.int32)
+            tables = np.zeros((B, self.blocks_per_seq), dtype=np.int32)
+            temps = np.zeros((B,), dtype=np.float32)
+            for i, req in enumerate(active):
+                tokens[i, 0] = req.last_token
+                positions[i] = req.position
+                tables[i, :len(req.block_table)] = req.block_table
+                temps[i] = req.temperature
+
+        self._maybe_chaos_slow_step()
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu._private import jax_compat
+
+        self._key, sub = jax.random.split(self._key)
+        try:
+            with jax_compat.set_mesh(self._mesh):
+                nxt, self._pool = self._decode_step(
+                    self.params, self._pool, jnp.asarray(tokens),
+                    jnp.asarray(positions), jnp.asarray(tables), sub,
+                    jnp.asarray(temps))
+            nxt = np.asarray(nxt)
+        except Exception as exc:  # noqa: BLE001 — donated pool is gone
+            self._reset_after_failure(exc)
+            return True
+        with self._lock:
+            self._counters["decode_steps"] += 1
+            if len(active) >= 2:
+                self._counters["batched_decode_steps"] += 1
+            self._counters["decode_tokens"] += len(active)
+            for i, req in enumerate(active):
+                if req.sealed or req not in self._sched.active:
+                    continue  # expired/externally sealed mid-step
+                self._emit(req, int(nxt[i]))
+                req.last_token = int(nxt[i])
+                req.position += 1
+                req.remaining -= 1
+                if req.remaining <= 0 or req.position >= self.max_tokens:
+                    self._finish_locked(req)
+        return True
+
+    def _maybe_chaos_slow_step(self) -> None:
+        if chaos.ACTIVE is not None and chaos.ACTIVE.should(
+                "llm.slow_step"):
+            with self._lock:
+                self._counters["slow_steps"] += 1
+            delay = float(os.environ.get("RAY_TPU_LLM_SLOW_S", "2.0"))
+            end = time.monotonic() + delay
+            # Sliced sleep: a wedged step must still honor shutdown.
+            while time.monotonic() < end \
+                    and not self._shutdown.is_set():
+                time.sleep(0.02)
+
+    def _reset_after_failure(self, exc: Exception) -> None:
+        """A failed jitted call invalidated the donated pool: fail
+        every in-flight request typed and rebuild (the legacy engine's
+        ADVICE-r1 discipline, kept)."""
+        with self._lock:
+            sched = self._sched
+            victims = list(sched.waiting) + list(sched.active)
+            if sched.prefilling is not None:
+                victims.append(sched.prefilling)
+            sched.waiting.clear()
+            sched.active.clear()
+            sched.prefilling = None
+            for req in victims:
+                sched.cache.release(req.block_table)
+        for req in victims:
+            self._seal(req, exc)
+        self._pool = PagedKVCache.init_pool(
+            self.config, self._sched.cache.num_blocks, self.block_size)
+
+    # ---------------------------------------------------------------- stats
+
+    def engine_stats(self) -> dict:
+        """Monotonic counters (ENGINE_STAT_KEYS — the heartbeat/
+        /metrics payload)."""
+        out = {key: int(self._counters.get(key, 0))
+               for key in ENGINE_STAT_KEYS}
+        out["blocks_allocated"] = int(self._sched.cache.blocks_allocated)
+        out["blocks_freed"] = int(self._sched.cache.blocks_freed)
+        return out
+
+    def engine_load(self) -> dict:
+        """Live gauges (autoscaler feed; NOT counters — served through
+        replica ``serve_metrics()``, not the counter family)."""
+        with self._lock:
+            return {
+                "depth": self._sched.depth(),
+                "waiting": len(self._sched.waiting),
+                "active": len(self._sched.active),
+                "free_blocks": self._sched.cache.free_blocks,
+            }
+
+    # ------------------------------------------------------------ lifecycle
+
+    def check_health(self) -> None:
+        if not self._loop_thread.is_alive() \
+                and not self._shutdown.is_set():
+            raise RuntimeError("LLM engine loop died")
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        with self._lock:
+            self._lock.notify_all()
+            sched = self._sched
+            victims = list(sched.waiting) + list(sched.active)
+            if sched.prefilling is not None:
+                victims.append(sched.prefilling)
+            sched.waiting.clear()
+            sched.active.clear()
+            sched.prefilling = None
+            for req in victims:
+                sched.cache.release(req.block_table)
+        for req in victims:
+            self._seal(req, RuntimeError("LLM engine shut down"))
+        self._loop_thread.join(timeout=5.0)
+
+    def __del__(self):
+        self._shutdown.set()
+
+
+# --------------------------------------------------------------------------
+# Process-local registry (stats plumbing)
+# --------------------------------------------------------------------------
+
+
+def merged_engine_stats() -> "dict | None":
+    """Summed ENGINE_STAT_KEYS across this process's live engines, or
+    None when the process hosts none (heartbeats skip the group)."""
+    engines = list(_LIVE)
+    if not engines:
+        return None
+    out = {key: 0 for key in ENGINE_STAT_KEYS}
+    for engine in engines:
+        for key, value in engine.engine_stats().items():
+            out[key] += int(value)
+    return out
+
+
+def merged_engine_load() -> dict:
+    totals = {"depth": 0, "waiting": 0, "active": 0, "free_blocks": 0}
+    for engine in list(_LIVE):
+        for key, value in engine.engine_load().items():
+            totals[key] += int(value)
+    return totals
+
+
+# --------------------------------------------------------------------------
+# Arm/disarm
+# --------------------------------------------------------------------------
+
+
+def enable() -> None:
+    global PAGED_ON
+    PAGED_ON = True
+
+
+def disable() -> None:
+    global PAGED_ON
+    PAGED_ON = False
+
+
+def init_from_config() -> None:
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    global PAGED_ON
+    PAGED_ON = bool(GLOBAL_CONFIG.llm_paged_engine)
+
+
+try:
+    init_from_config()
+except Exception:  # noqa: BLE001 — config unavailable mid-bootstrap
+    pass

@@ -165,12 +165,24 @@ class Replica:
 
     def get_metrics(self) -> dict:
         with self._lock:
-            return {
+            metrics = {
                 "replica_tag": self._replica_tag,
                 "num_ongoing_requests": self._num_ongoing,
                 "num_total_requests": self._num_total,
                 "timestamp": time.time(),
             }
+        # User-callable load gauges (the LLM engine's engine_depth):
+        # merged in for the controller's autoscale pass — a deployment
+        # whose queue lives INSIDE the callable reports it here.
+        hook = getattr(self._callable, "serve_metrics", None)
+        if hook is not None:
+            try:
+                extra = hook()
+                if isinstance(extra, dict):
+                    metrics.update(extra)
+            except Exception:  # noqa: BLE001 — metrics must not fail probes
+                pass
+        return metrics
 
     def prepare_for_shutdown(self) -> None:
         deadline = time.monotonic() + 5.0
@@ -179,6 +191,15 @@ class Replica:
                 if self._num_ongoing == 0:
                     break
             time.sleep(0.02)
+        # Stop this instance's @serve.batch batcher threads: queued
+        # callers fail typed instead of hanging, and no batcher thread
+        # outlives the deployment.
+        from ray_tpu.serve.batching import shutdown_batchers
+
+        try:
+            shutdown_batchers(self._callable)
+        except Exception:  # noqa: BLE001 — teardown is best-effort
+            pass
         hook = getattr(self._callable, "__del__", None)
         if hook is not None:
             try:

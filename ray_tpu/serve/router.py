@@ -227,6 +227,21 @@ class DeploymentResponse:
                 return value
             except Exception as exc:  # noqa: BLE001 — inspect for backpressure
                 cause = getattr(exc, "cause", exc)
+                # Typed overload/expiry raised INSIDE the replica (the
+                # LLM engine's CacheExhaustedError shed, a deadline
+                # dying in its internal queue) surfaces unwrapped so
+                # handle callers and the proxy's 503/504 mapping see
+                # the same types the router-level paths raise.
+                from ray_tpu.exceptions import (
+                    SystemOverloadedError,
+                    TaskTimeoutError,
+                )
+
+                if isinstance(cause, (SystemOverloadedError,
+                                      TaskTimeoutError)) \
+                        and not isinstance(cause, BackPressureError):
+                    self._release()
+                    raise cause from exc
                 retriable = (isinstance(cause, BackPressureError)
                              and self._router is not None
                              and self._request is not None)
@@ -297,9 +312,24 @@ class Router:
         self.shed_total = 0
         # Always-on per-deployment latency histogram (assign→release,
         # perf_plane log buckets): exported as ray_tpu_serve_latency_*
-        # and queryable live via latency_stats() — the p99 the serve
-        # autoscaler (ROADMAP item 2) reads without arming tracing.
+        # and queryable live via latency_stats() — the p99 feed the
+        # latency-driven replica autoscaler consumes.
         self._latency = perf_plane.StageHistogram()
+        # Latency push: routers report their live p50/p99 to the
+        # controller at most every serve_latency_report_s (0 disables)
+        # — the controller-side LatencyPolicy reads the freshest
+        # report per deployment. Fire-and-forget; a missed report just
+        # ages the feed (the policy freezes on stale feeds).
+        from ray_tpu._private.config import GLOBAL_CONFIG
+
+        self._report_interval_s = float(
+            GLOBAL_CONFIG.serve_latency_report_s)
+        self._last_report_ts = 0.0
+        # Previous cumulative snapshot: reports ship the WINDOW since
+        # the last push (bucket-wise subtraction), so the controller's
+        # policy sees the live p99, not an all-time aggregate a past
+        # overload skewed forever.
+        self._last_window_snap: dict | None = None
         self._replicas: list[Any] = []          # ActorHandles
         # In-flight counts keyed by replica IDENTITY (actor id), so
         # membership changes neither zero live load nor cross-release a
@@ -372,18 +402,54 @@ class Router:
 
     def observe_latency(self, dt_s: float) -> None:
         self._latency.observe(max(0.0, dt_s))
+        if self._report_interval_s <= 0:
+            return
+        now = time.monotonic()
+        with self._lock:
+            if now - self._last_report_ts < self._report_interval_s:
+                return
+            self._last_report_ts = now
+        try:
+            # Async fire-and-forget: the caller's request path must
+            # never block on the control plane.
+            self._controller.report_latency.remote(
+                self._app_name, self._deployment_name,
+                self.latency_window_stats())
+        except Exception:  # noqa: BLE001 — controller down mid-teardown
+            pass
 
-    def latency_stats(self) -> dict:
-        """Live latency summary for this deployment: count / mean /
-        p50 / p99 (bucket-interpolated upper bounds)."""
-        snap = self._latency.snapshot()
-        count = snap["count"]
+    @staticmethod
+    def _summarize(snap: dict) -> dict:
+        count = int(snap.get("count", 0))
         return {
             "count": count,
             "mean_s": (snap["sum"] / count) if count else 0.0,
             "p50_s": perf_plane.quantile(snap, 0.5),
             "p99_s": perf_plane.quantile(snap, 0.99),
         }
+
+    def latency_stats(self) -> dict:
+        """Live latency summary for this deployment: count / mean /
+        p50 / p99 (bucket-interpolated upper bounds; all-time)."""
+        return self._summarize(self._latency.snapshot())
+
+    def latency_window_stats(self) -> dict:
+        """Same summary over the window SINCE THE LAST CALL (bucket
+        subtraction of cumulative snapshots) — what the autoscale
+        report ships: a past overload must stop dominating p99 the
+        moment traffic recovers."""
+        snap = self._latency.snapshot()
+        with self._lock:
+            prev, self._last_window_snap = self._last_window_snap, snap
+        if prev is None:
+            return self._summarize(snap)
+        delta = {
+            "counts": [int(a) - int(b) for a, b in
+                       zip(snap["counts"], prev["counts"])],
+            "sum": float(snap["sum"]) - float(prev["sum"]),
+            "count": int(snap["count"]) - int(prev["count"]),
+        }
+        return self._summarize(delta)
 
     def _max_queued_limit(self) -> int:
         """DeploymentConfig.max_queued_requests, cached (-1 =

@@ -1668,3 +1668,130 @@ def test_owner_sigkill_mid_spill_survivor_sweeps_dir(tmp_path):
         if victim.poll() is None:
             victim.kill()
         victim.stdout.close()
+
+
+# ------------------------------------------------------------- LLM engine
+
+
+def test_llm_slow_step_trips_deadline_typed_not_hung(monkeypatch):
+    """ISSUE 14: a WEDGED decode step (chaos ``llm.slow_step`` holds
+    the engine loop for RAY_TPU_LLM_SLOW_S) must trip the request's
+    inherited deadline TYPED — TaskTimeoutError with stage
+    ``llm_decode`` recorded, sealed exactly once by the caller-side
+    wait — instead of hanging the stream, and the engine must serve
+    fresh requests after the wedge."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from ray_tpu.exceptions import TaskTimeoutError
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    monkeypatch.setenv("RAY_TPU_LLM_SLOW_S", "1.2")
+    cfg = dataclasses.replace(llama.LlamaConfig.tiny(),
+                              dtype=jnp.float32)
+    engine = LLMEngine(cfg, max_batch_size=2, max_seq_len=64,
+                       block_size=8, prefill_chunk=8, seed=0)
+    try:
+        # Warm the jit cache chaos-free so the wedge is the ONLY
+        # source of decode latency.
+        warm = engine.submit([9, 8], max_new_tokens=2)
+        assert len(engine.result(warm, timeout_s=120)) == 2
+
+        chaos.configure("seed=7,llm.slow_step=1.0x1")
+        wedged = engine.submit([1, 2, 3], max_new_tokens=30,
+                               deadline=time.time() + 0.4,
+                               stream=True)
+        t0 = time.monotonic()
+        with pytest.raises(TaskTimeoutError) as err:
+            for _ in engine.stream_tokens(wedged):
+                pass
+        waited = time.monotonic() - t0
+        assert err.value.stage == "llm_decode"
+        # The TYPED failure arrived from the caller-side wait while
+        # the loop was still wedged — well before the 1.2s sleep.
+        assert waited < 1.0, f"stream hung {waited:.2f}s"
+        assert wedged.sealed and wedged.done.is_set()
+        stats = engine.engine_stats()
+        assert stats["slow_steps"] == 1
+        assert stats["deadline_expired"] >= 1
+        assert chaos.ACTIVE.stats()["injected"]["llm.slow_step"] == 1
+
+        # Exactly once: the sealed request never un-seals, its output
+        # never grows post-seal, and the engine keeps serving.
+        sealed_len = len(wedged.output)
+        fresh = engine.submit([4, 5], max_new_tokens=3)
+        assert len(engine.result(fresh, timeout_s=120)) == 3
+        assert len(wedged.output) == sealed_len
+        assert engine.engine_stats()["finished"] >= 2
+    finally:
+        engine.shutdown()
+
+
+def test_llm_preempted_requests_complete_exactly_once_under_chaos():
+    """ISSUE 14: with ``llm.slow_step`` firing INTO a cache-pressured
+    engine (preemptions + resumes live), every request still completes
+    exactly once — each done-event seals once, each output is exactly
+    max_new_tokens, and the greedy streams match the pressure-free
+    reference (zero lost, zero doubled)."""
+    import dataclasses
+    import threading as threading_mod
+
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    os.environ["RAY_TPU_LLM_SLOW_S"] = "0.05"
+    try:
+        cfg = dataclasses.replace(llama.LlamaConfig.tiny(),
+                                  dtype=jnp.float32)
+        prompts = [[1, 2, 3], [4, 5, 6, 7, 8], [9, 10],
+                   [11, 12, 13, 14]]
+        reference = LLMEngine(cfg, max_batch_size=4, max_seq_len=64,
+                              block_size=8, prefill_chunk=8, seed=0)
+        try:
+            expected = {}
+            for i, prompt in enumerate(prompts):
+                req = reference.submit(prompt, max_new_tokens=10)
+                expected[i] = reference.result(req, timeout_s=120)
+            params = reference.params
+        finally:
+            reference.shutdown()
+
+        chaos.configure("seed=13,llm.slow_step=0.3x4")
+        engine = LLMEngine(cfg, params, max_batch_size=4,
+                           max_seq_len=64, block_size=8,
+                           prefill_chunk=8, num_blocks=6, seed=0)
+        try:
+            results = {}
+            seal_counts = {i: 0 for i in range(4)}
+            lock = threading_mod.Lock()
+
+            def gen(i):
+                req = engine.submit(prompts[i], max_new_tokens=10)
+                out = engine.result(req, timeout_s=120)
+                with lock:
+                    results[i] = out
+                    if req.done.is_set():
+                        seal_counts[i] += 1
+
+            threads = [threading_mod.Thread(target=gen, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=180)
+            assert not any(t.is_alive() for t in threads), "hung request"
+            stats = engine.engine_stats()
+            assert stats["preemptions"] > 0 and stats["resumes"] > 0, \
+                stats
+            assert stats["finished"] == 4, stats
+            for i in range(4):
+                assert seal_counts[i] == 1
+                assert results[i] == expected[i], (i, stats)
+        finally:
+            engine.shutdown()
+    finally:
+        os.environ.pop("RAY_TPU_LLM_SLOW_S", None)

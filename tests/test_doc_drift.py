@@ -75,6 +75,10 @@ EXPORTED_SERIES = (
     "ray_tpu_gcs_epoch",
     "ray_tpu_gcs_persist_total",
     "ray_tpu_gcs_snapshot_restore_ms",
+    # LLM inference engine (ISSUE 14): ENGINE_STAT_KEYS counters per
+    # hosting process — driver-local engines under node="driver",
+    # daemon-hosted ones via the heartbeat "engine" stats group.
+    "ray_tpu_node_engine",
 )
 
 
@@ -210,7 +214,8 @@ def test_deadline_stage_table_documented():
     stage the runtime actually seals (TaskTimeoutError.stage values)."""
     text = README.read_text()
     for stage in ("submit", "queued", "dispatch", "execute",
-                  "admitted", "worker", "actor_queue", "serve_queue"):
+                  "admitted", "worker", "actor_queue", "serve_queue",
+                  "llm_queue", "llm_decode"):
         assert f"`{stage}`" in text, (
             f"deadline stage {stage!r} missing from the README "
             f"semantics table")
@@ -581,3 +586,79 @@ def test_linter_cli_and_suppression_format_documented(
 
     assert str(MAX_SUPPRESSIONS) in static_analysis_text, (
         "suppression budget number drifted out of the README")
+
+
+# ------------------------------------------------------------- LLM serving
+
+
+@pytest.fixture(scope="module")
+def llm_text() -> str:
+    text = README.read_text()
+    start = text.find("## LLM serving")
+    assert start != -1, "README lost its LLM serving section"
+    end = text.find("\n## ", start + 1)
+    return text[start:end if end != -1 else len(text)]
+
+
+def test_llm_engine_knobs_documented(llm_text):
+    """Every llm_* knob plus the router latency-report cadence keeps a
+    README row in the LLM serving knob table."""
+    from ray_tpu._private.config import _DEFAULTS
+
+    knobs = [k for k in _DEFAULTS if k.startswith("llm_")]
+    knobs.append("serve_latency_report_s")
+    assert len(knobs) >= 5, f"llm knobs vanished from config: {knobs}"
+    missing = [k for k in knobs if f"`{k}`" not in llm_text]
+    assert not missing, (
+        f"LLM engine knobs missing from the README knob table: "
+        f"{missing}")
+
+
+def test_engine_stat_keys_documented(llm_text):
+    """Every ENGINE_STAT_KEYS counter (read through the analyzer's AST
+    parser, asserted identical to the importable tuple) keeps a README
+    row in the LLM serving section."""
+    parsed = registry_keys("llm_engine", "ENGINE_STAT_KEYS")
+    from ray_tpu.serve.llm_engine import ENGINE_STAT_KEYS
+
+    assert tuple(parsed) == tuple(ENGINE_STAT_KEYS)
+    assert len(parsed) >= 12
+    missing = [k for k in parsed if f"`{k}`" not in llm_text]
+    assert not missing, (
+        f"ENGINE_STAT_KEYS missing from the README LLM serving "
+        f"section: {missing}")
+
+
+def test_llm_chaos_site_documented(llm_text):
+    """llm.slow_step is part of the chaos-spec contract: registered,
+    documented in the LLM section, with its delay env knob."""
+    from ray_tpu._private.analysis.chaos_sites import registered_sites
+
+    assert "llm.slow_step" in registered_sites()
+    assert "`llm.slow_step`" in llm_text
+    assert "RAY_TPU_LLM_SLOW_S" in llm_text
+
+
+def test_llm_scheduler_and_paging_semantics_documented(llm_text):
+    """The operator contract: block/page semantics, the scheduler
+    policy, preemption, typed shedding and the autoscaler feed."""
+    flat = " ".join(llm_text.split())
+    for phrase in ("block table", "block 0", "chunked prefill",
+                   "lowest-progress", "recompute-on-resume",
+                   "`CacheExhaustedError`", "`target_p99_s`",
+                   "`engine_depth`", "latency_stats()",
+                   "ray_tpu_node_engine", "BENCH_SERVE_LLM.json"):
+        assert phrase in flat, (
+            f"LLM serving section lost {phrase!r}")
+
+
+def test_llm_engine_disarm_gate_registered():
+    """The llm_paged_engine knob rides the disarm-gate analysis pass
+    (one module attribute, PAGED_ON) like every other plane."""
+    from ray_tpu._private.analysis.disarm_gates import KNOB_GATES
+
+    assert KNOB_GATES.get("llm_paged_engine") == (
+        "ray_tpu/serve/llm_engine/engine.py", "PAGED_ON")
+    from ray_tpu._private.config import _DEFAULTS
+
+    assert "llm_paged_engine" in _DEFAULTS

@@ -1,0 +1,570 @@
+"""LLM inference engine: paged KV cache, prefill/decode scheduling,
+preemption, deadlines, autoscale policy, batcher hardening (ISSUE 14).
+
+The jax-heavy tests share one float32 tiny-config engine where
+possible (each engine compiles one prefill + one decode program).
+"""
+
+import dataclasses
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import (
+    CacheExhaustedError,
+    SystemOverloadedError,
+    TaskTimeoutError,
+)
+
+
+def _f32_tiny():
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+
+    return dataclasses.replace(llama.LlamaConfig.tiny(),
+                               dtype=jnp.float32)
+
+
+# ---------------------------------------------------------------- kv cache
+
+
+def test_paged_cache_alloc_free_exhaustion():
+    from ray_tpu.serve.llm_engine import PagedKVCache
+
+    cache = PagedKVCache(num_blocks=5, block_size=8, max_blocks_per_seq=4)
+    assert cache.free_blocks == 4  # block 0 is reserved scratch
+    table: list = []
+    assert cache.grow(table, 1) is True
+    assert cache.grow(table, 8) is False  # same block covers 8 tokens
+    assert cache.grow(table, 9) is True
+    assert len(table) == 2 and 0 not in table
+    other: list = []
+    cache.grow(other, 16)
+    assert cache.free_blocks == 0
+    with pytest.raises(CacheExhaustedError):
+        cache.grow(table, 17)
+    cache.release(other)
+    assert cache.free_blocks == 2 and other == []
+    cache.grow(table, 17)
+    assert cache.blocks_allocated == 5 and cache.blocks_freed == 2
+    # Per-sequence table cap raises even with free blocks around.
+    with pytest.raises(CacheExhaustedError):
+        cache.grow(table, 8 * 4 + 1)
+    assert cache.fits_ever(32) and not cache.fits_ever(33)
+
+
+def test_scheduler_preempts_lowest_progress():
+    from ray_tpu.serve.llm_engine import PagedKVCache
+    from ray_tpu.serve.llm_engine.scheduler import (
+        EngineRequest,
+        Scheduler,
+    )
+
+    cache = PagedKVCache(num_blocks=9, block_size=8, max_blocks_per_seq=8)
+    sched = Scheduler(cache, max_batch=4, max_waiting=4,
+                      max_tokens_per_seq=64)
+    reqs = []
+    for i, progress in enumerate([5, 2, 9]):
+        req = EngineRequest([1, 2, 3], 16, 0.0)
+        req.output = list(range(progress))
+        sched.active.append(req)
+        reqs.append(req)
+    assert sched.pick_victim() is reqs[1]  # fewest generated tokens
+    cache.grow(reqs[1].block_table, 16)
+    sched.preempt(reqs[1])
+    assert reqs[1] not in sched.active
+    assert sched.waiting[0] is reqs[1]  # front of the queue
+    assert reqs[1].block_table == [] and cache.free_blocks == 8
+    # Resume recomputes prompt + output[:-1] and skips first-sample.
+    claimed = sched.claim_prefill()
+    assert claimed is reqs[1]
+    assert claimed.context == reqs[1].tokens + reqs[1].output[:-1]
+    assert claimed.sample_first is False
+
+
+def test_scheduler_bounded_queue_and_never_fits():
+    from ray_tpu.serve.llm_engine import PagedKVCache
+    from ray_tpu.serve.llm_engine.scheduler import (
+        EngineRequest,
+        Scheduler,
+    )
+
+    cache = PagedKVCache(num_blocks=3, block_size=8, max_blocks_per_seq=8)
+    sched = Scheduler(cache, max_batch=2, max_waiting=1,
+                      max_tokens_per_seq=64)
+    sched.try_enqueue(EngineRequest([1], 4, 0.0))
+    with pytest.raises(CacheExhaustedError):
+        sched.try_enqueue(EngineRequest([1], 4, 0.0))  # queue full
+    sched.waiting.clear()
+    with pytest.raises(CacheExhaustedError):
+        # 2 usable blocks = 16 tokens; 20-token need can never fit.
+        sched.try_enqueue(EngineRequest(list(range(10)), 10, 0.0))
+
+
+def test_scheduler_deadline_sweep_stages():
+    from ray_tpu.serve.llm_engine import PagedKVCache
+    from ray_tpu.serve.llm_engine.scheduler import (
+        DECODE,
+        EngineRequest,
+        Scheduler,
+    )
+
+    cache = PagedKVCache(num_blocks=5, block_size=8, max_blocks_per_seq=4)
+    sched = Scheduler(cache, max_batch=2, max_waiting=4,
+                      max_tokens_per_seq=32)
+    waiting = EngineRequest([1], 4, 0.0, deadline=time.time() - 1)
+    decoding = EngineRequest([1], 4, 0.0, deadline=time.time() - 1)
+    decoding.state = DECODE
+    cache.grow(decoding.block_table, 8)
+    live = EngineRequest([1], 4, 0.0, deadline=time.time() + 60)
+    sched.waiting.extend([waiting, live])
+    sched.active.append(decoding)
+    expired = sched.sweep_expired()
+    assert set(expired) == {waiting, decoding}
+    assert live in sched.waiting and decoding not in sched.active
+    assert cache.free_blocks == 4  # expired blocks reclaimed
+    assert sched.expired_error(waiting).stage == "llm_queue"
+    assert sched.expired_error(decoding).stage == "llm_decode"
+
+
+# ------------------------------------------------------------- the engine
+
+
+@pytest.fixture(scope="module")
+def paged_engine():
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    engine = LLMEngine(_f32_tiny(), max_batch_size=4, max_seq_len=64,
+                       block_size=8, prefill_chunk=8, seed=0)
+    yield engine
+    engine.shutdown()
+
+
+def test_paged_decode_matches_full_forward(paged_engine):
+    """Greedy paged decode == full-context greedy decode (f32; the
+    gather-by-block-table step must be numerically the dense path)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+
+    cfg = paged_engine.config
+    prompt = [5, 9, 2, 7]
+    req = paged_engine.submit(prompt, max_new_tokens=6)
+    out = paged_engine.result(req, timeout_s=120)
+
+    toks = list(prompt)
+    expected = []
+    for _ in range(6):
+        logits = llama.forward(
+            paged_engine.params, jnp.asarray([toks], dtype=jnp.int32),
+            cfg)
+        nxt = int(jnp.argmax(logits[0, -1]))
+        expected.append(nxt)
+        toks.append(nxt)
+    assert out == expected
+
+
+def test_concurrent_ragged_requests_batch(paged_engine):
+    """Ragged concurrent requests share the fixed decode batch
+    (batched_decode_steps counts steps with >= 2 active rows)."""
+    before = paged_engine.engine_stats()["batched_decode_steps"]
+    results = {}
+    lock = threading.Lock()
+
+    def gen(i):
+        req = paged_engine.submit([1 + i] * (2 * i + 1),
+                                  max_new_tokens=8)
+        out = paged_engine.result(req, timeout_s=120)
+        with lock:
+            results[i] = out
+
+    threads = [threading.Thread(target=gen, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(results) == 6
+    assert all(len(v) == 8 for v in results.values())
+    assert paged_engine.engine_stats()["batched_decode_steps"] > before
+
+
+def test_streaming_tokens_overlap_decode(paged_engine):
+    """stream_tokens yields while the engine still decodes (the TTFT
+    surface): the first token arrives before the request seals."""
+    req = paged_engine.submit([3, 1, 4], max_new_tokens=12, stream=True)
+    got = []
+    for token in paged_engine.stream_tokens(req):
+        got.append(token)
+        if len(got) == 1:
+            assert not req.done.is_set() or len(req.output) < 12
+    assert got == req.output and len(got) == 12
+
+
+def test_chunked_prefill_interleaves_with_decode(paged_engine):
+    """A long prompt prefills in chunks BETWEEN decode steps: the
+    in-flight stream keeps emitting while the long prompt loads."""
+    a = paged_engine.submit([7, 7, 7], max_new_tokens=24, stream=True)
+    a_tokens_ts = []
+    collected = threading.Event()
+
+    def consume():
+        for _ in paged_engine.stream_tokens(a):
+            a_tokens_ts.append(time.monotonic())
+        collected.set()
+
+    thread = threading.Thread(target=consume)
+    thread.start()
+    while len(a_tokens_ts) < 2:  # A is decoding
+        time.sleep(0.005)
+    # 40-token prompt / chunk 8 => 5 prefill iterations for B.
+    submit_ts = time.monotonic()
+    b = paged_engine.submit(list(range(1, 41)), max_new_tokens=2)
+    b_out = paged_engine.result(b, timeout_s=120)
+    b_first_ts = time.monotonic()
+    collected.wait(timeout=120)
+    thread.join(timeout=10)
+    assert len(b_out) == 2
+    during = [ts for ts in a_tokens_ts if submit_ts < ts < b_first_ts]
+    assert during, (
+        "stream A stalled for the whole of B's chunked prefill — the "
+        "interleave is broken")
+
+
+def test_preemption_recompute_on_resume_exact(paged_engine):
+    """Cache pressure preempts the lowest-progress stream; on resume
+    it re-prefills prompt+generated and continues from the exact token
+    — greedy outputs byte-identical to the pressure-free run, each
+    request completing exactly once."""
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    prompts = [[1, 2, 3], [4, 5, 6, 7, 8], [9, 10], [11, 12, 13, 14]]
+    reference = {}
+    for i, prompt in enumerate(prompts):
+        req = paged_engine.submit(prompt, max_new_tokens=12)
+        reference[i] = paged_engine.result(req, timeout_s=120)
+
+    # 5 usable blocks of 8 across four 2-3 block sequences: pressure.
+    engine = LLMEngine(paged_engine.config, paged_engine.params,
+                       max_batch_size=4, max_seq_len=64, block_size=8,
+                       prefill_chunk=8, num_blocks=6, seed=0)
+    try:
+        results = {}
+        lock = threading.Lock()
+
+        def gen(i):
+            req = engine.submit(prompts[i], max_new_tokens=12)
+            out = engine.result(req, timeout_s=120)
+            with lock:
+                results[i] = out
+
+        threads = [threading.Thread(target=gen, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        stats = engine.engine_stats()
+        assert stats["preemptions"] > 0 and stats["resumes"] > 0, stats
+        assert stats["finished"] == 4
+        for i in range(4):
+            assert results[i] == reference[i], (i, stats)
+    finally:
+        engine.shutdown()
+
+
+def test_waiting_deadline_seals_typed_llm_queue(paged_engine):
+    """A budget dying in the bounded waiting queue seals
+    TaskTimeoutError stage llm_queue — typed, exactly once, without
+    the request ever reaching the decode batch."""
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    engine = LLMEngine(paged_engine.config, paged_engine.params,
+                       max_batch_size=1, max_seq_len=64, block_size=8,
+                       prefill_chunk=8, seed=0)
+    try:
+        hog = engine.submit([1, 2], max_new_tokens=40)
+        parked = engine.submit([3, 4], max_new_tokens=4,
+                               deadline=time.time() + 0.15)
+        with pytest.raises(TaskTimeoutError) as err:
+            engine.result(parked, timeout_s=30)
+        assert err.value.stage == "llm_queue"
+        assert engine.engine_stats()["deadline_expired"] >= 1
+        assert len(engine.result(hog, timeout_s=120)) == 40
+        assert parked.output == []  # never decoded
+    finally:
+        engine.shutdown()
+
+
+def test_queue_full_and_never_fits_shed_typed(paged_engine):
+    """Bounded admission sheds through the SystemOverloadedError path:
+    queue-full and never-fits both raise CacheExhaustedError (a
+    SystemOverloadedError subclass — the HTTP tier's 503 contract)."""
+    from ray_tpu.serve.llm_engine import LLMEngine
+
+    engine = LLMEngine(paged_engine.config, paged_engine.params,
+                       max_batch_size=1, max_seq_len=64, block_size=8,
+                       prefill_chunk=8, max_waiting=1, num_blocks=5,
+                       seed=0)
+    try:
+        hog = engine.submit([1, 2], max_new_tokens=30)
+        deadline = time.monotonic() + 30
+        while hog.state == "waiting" and time.monotonic() < deadline:
+            time.sleep(0.005)  # wait for the engine to claim it
+        engine.submit([3, 4], max_new_tokens=4)   # fills the queue
+        with pytest.raises(CacheExhaustedError) as err:
+            engine.submit([5, 6], max_new_tokens=4)
+        assert isinstance(err.value, SystemOverloadedError)
+        stats = engine.engine_stats()
+        assert stats["shed_queue_full"] >= 1
+    finally:
+        engine.shutdown()
+    # Never-fits: 2 usable blocks = 16 tokens, request needs 24.
+    engine = LLMEngine(paged_engine.config, paged_engine.params,
+                       max_batch_size=1, max_seq_len=64, block_size=8,
+                       prefill_chunk=8, num_blocks=3, seed=0)
+    try:
+        with pytest.raises(CacheExhaustedError):
+            engine.submit(list(range(12)), max_new_tokens=12)
+        assert engine.engine_stats()["shed_cache"] >= 1
+    finally:
+        engine.shutdown()
+
+
+def test_engine_stats_keys_contract(paged_engine):
+    from ray_tpu.serve.llm_engine import ENGINE_STAT_KEYS
+
+    stats = paged_engine.engine_stats()
+    assert set(stats) == set(ENGINE_STAT_KEYS)
+    load = paged_engine.engine_load()
+    assert set(load) == {"depth", "waiting", "active", "free_blocks"}
+
+
+def test_engine_stats_ride_executor_stats(paged_engine):
+    """Engines co-hosted with a node executor surface as the "engine"
+    stats group (the ray_tpu_node_engine heartbeat payload)."""
+    from ray_tpu._private.node_executor import NodeExecutorService
+    from ray_tpu.serve.llm_engine import ENGINE_STAT_KEYS
+
+    merged = NodeExecutorService._engine_stats()
+    assert merged is not None
+    assert set(merged) == set(ENGINE_STAT_KEYS)
+    assert merged["decode_steps"] >= \
+        paged_engine.engine_stats()["decode_steps"]
+
+
+def test_server_fallback_equivalence(paged_engine):
+    """llm_paged_engine=0 (PAGED_ON False) hosts the legacy
+    slot-per-request LLMServer — same contract, same greedy tokens."""
+    from ray_tpu.serve.llm_engine import LLMEngineServer
+    from ray_tpu.serve.llm_engine import engine as engine_mod
+
+    request = {"tokens": [5, 9, 2, 7], "max_new_tokens": 5}
+    armed = LLMEngineServer(paged_engine.config, paged_engine.params,
+                            max_batch_size=2, max_seq_len=64)
+    try:
+        armed_out = armed(request)
+        assert armed._engine is not None and armed._legacy is None
+    finally:
+        armed._engine.shutdown()
+    engine_mod.disable()
+    try:
+        legacy = LLMEngineServer(paged_engine.config,
+                                 paged_engine.params,
+                                 max_batch_size=2, max_seq_len=64)
+        assert legacy._engine is None and legacy._legacy is not None
+        legacy_out = legacy(request)
+        assert legacy.engine_stats() == {"paged_engine": False}
+        assert legacy.serve_metrics() == {}
+    finally:
+        engine_mod.enable()
+    assert armed_out == legacy_out
+
+
+def test_mesh_context_portable(paged_engine):
+    """jax_compat.set_mesh: the engine TP path's version-portable
+    ambient-mesh context — on jax 0.4.x it is the `with mesh:`
+    physical-mesh context, and None is a no-op."""
+    import numpy as np
+    from jax.sharding import Mesh
+
+    import jax
+    from ray_tpu._private import jax_compat
+
+    with jax_compat.set_mesh(None):
+        pass
+    devices = np.array(jax.devices("cpu")[:2])
+    mesh = Mesh(devices, ("tp",))
+    with jax_compat.set_mesh(mesh):
+        ambient = jax_compat.ambient_mesh()
+        assert ambient is not None
+    assert jax_compat.ambient_mesh() is None
+
+
+# --------------------------------------------------- deadline inheritance
+
+
+def test_actor_call_deadline_visible_in_context(ray_start_regular):
+    """The PR-7 deadline rides the actor call INTO user code via
+    get_runtime_context().get_task_deadline() — what the engine's
+    submit() inherits."""
+
+    class Probe:
+        def deadline(self):
+            from ray_tpu.runtime_context import get_runtime_context
+
+            return get_runtime_context().get_task_deadline()
+
+    actor = ray_tpu.remote(Probe).remote()
+    assert ray_tpu.get(actor.deadline.remote()) is None
+    armed = ray_tpu.get(
+        actor.deadline.options(_deadline_s=30.0).remote())
+    assert armed is not None and armed > time.time() + 10
+
+
+# -------------------------------------------------------- autoscale policy
+
+
+def _policy_cfg(**overrides):
+    from ray_tpu.serve.config import AutoscalingConfig
+
+    defaults = dict(min_replicas=1, max_replicas=8,
+                    target_ongoing_requests=2.0, metrics_interval_s=0.5,
+                    upscale_delay_s=1.0, downscale_delay_s=4.0,
+                    target_p99_s=0.1)
+    defaults.update(overrides)
+    return AutoscalingConfig(**defaults)
+
+
+def test_latency_policy_scales_up_on_p99_skew():
+    from ray_tpu.serve.llm_engine import LatencyPolicy
+
+    policy = LatencyPolicy(_policy_cfg())
+    # 4x p99 violation: multiplicative (capped 2x) within the window.
+    assert policy.desired(2, p99_s=0.4, depth=4.0, now=100.0) == 4
+    # Cooldown: an immediate second decision holds.
+    assert policy.desired(4, p99_s=0.4, depth=4.0, now=100.5) == 4
+    # After upscale_delay_s it keeps expanding toward max.
+    assert policy.desired(4, p99_s=0.4, depth=4.0, now=101.5) == 8
+    # Depth floor: modest violation still covers the standing queue.
+    fresh = LatencyPolicy(_policy_cfg())
+    assert fresh.desired(1, p99_s=0.12, depth=10.0, now=10.0) == 5
+
+
+def test_latency_policy_scales_down_to_min_when_idle():
+    from ray_tpu.serve.llm_engine import LatencyPolicy
+
+    policy = LatencyPolicy(_policy_cfg(downscale_delay_s=1.0))
+    now = 50.0
+    current = 4
+    for _ in range(8):
+        desired = policy.desired(current, p99_s=0.01, depth=0.0,
+                                 now=now)
+        assert desired in (current, current - 1)
+        current = desired
+        now += 1.5
+    assert current == 1  # min_replicas
+
+
+def test_latency_policy_damps_flapping_and_stale_feed():
+    from ray_tpu.serve.llm_engine import LatencyPolicy
+
+    policy = LatencyPolicy(_policy_cfg(upscale_delay_s=1.0,
+                                       downscale_delay_s=5.0))
+    assert policy.desired(2, p99_s=0.4, depth=4.0, now=10.0) == 4  # up
+    # Direction flip right after: held for the FULL downscale delay
+    # even though the up-cooldown elapsed.
+    assert policy.desired(4, p99_s=0.01, depth=0.0, now=12.0) == 4
+    assert policy.desired(4, p99_s=0.01, depth=0.0, now=14.9) == 4
+    assert policy.desired(4, p99_s=0.01, depth=0.0, now=15.5) == 3
+    # A stale feed freezes the policy entirely.
+    assert policy.desired(3, p99_s=9.9, depth=99.0, now=30.0,
+                          feed_age_s=60.0) == 3
+
+
+# ------------------------------------------------------ batcher hardening
+
+
+def test_batcher_exception_scatters_to_all_callers():
+    """An exception from the wrapped batch fn must reach EVERY waiting
+    caller's future — no caller may hang."""
+    from ray_tpu.serve.batching import batch
+
+    calls = []
+
+    @batch(max_batch_size=4, batch_wait_timeout_s=0.2)
+    def explode(items):
+        calls.append(len(items))
+        raise ValueError("batch blew up")
+
+    errors = []
+    lock = threading.Lock()
+
+    def call(i):
+        try:
+            explode(i)
+        except Exception as exc:  # noqa: BLE001 — collected
+            with lock:
+                errors.append(exc)
+
+    threads = [threading.Thread(target=call, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads), "a caller hung"
+    assert len(errors) == 4
+    assert all(isinstance(e, ValueError) for e in errors)
+    assert calls and calls[0] == 4  # one batched invocation
+
+
+def test_batcher_shutdown_exits_thread_and_fails_queued():
+    """Deployment shutdown stops the batcher thread; queued callers
+    fail typed and late submits are refused."""
+    from ray_tpu.serve.batching import _Batcher
+
+    release = threading.Event()
+
+    def slow_fn(items):
+        release.wait(10)
+        return list(items)
+
+    batcher = _Batcher(slow_fn, max_batch_size=1,
+                       batch_wait_timeout_s=0.0)
+    first = batcher.submit(None, "a")     # occupies the loop
+    time.sleep(0.1)
+    queued = batcher.submit(None, "b")    # waits behind it
+    thread = batcher._thread
+    assert thread is not None and thread.is_alive()
+    batcher.shutdown(timeout_s=0.5)
+    with pytest.raises(RuntimeError):
+        queued.result(timeout=5)
+    release.set()
+    assert first.result(timeout=5) == "a"  # in-flight batch completes
+    thread.join(timeout=5)
+    assert not thread.is_alive(), "batcher thread survived shutdown"
+    with pytest.raises(RuntimeError):
+        batcher.submit(None, "c")
+
+
+def test_replica_shutdown_stops_instance_batchers():
+    """Replica.prepare_for_shutdown finds the instance's @serve.batch
+    batchers and stops their threads."""
+    from ray_tpu.serve.batching import batch, shutdown_batchers
+
+    class Deployment:
+        @batch(max_batch_size=8, batch_wait_timeout_s=0.01)
+        def __call__(self, items):
+            return [x + 1 for x in items]
+
+    dep = Deployment()
+    assert dep(41) == 42  # spins the per-instance batcher up
+    batcher = type(dep).__call__._serve_batcher_for(dep)
+    assert batcher is not None
+    assert shutdown_batchers(dep) == 1
+    assert batcher._stopped
+    with pytest.raises(RuntimeError):
+        dep(1)

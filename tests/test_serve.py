@@ -486,3 +486,84 @@ def test_streaming_early_abandon_stops_production(serve_instance):
         handle.options(method_name="generate", stream=True).remote(), 2))
     assert out == [0, 1]
     serve.delete("abandon_app")
+
+
+def test_latency_autoscaling_up_then_down(serve_instance):
+    """ISSUE 14: the latency-driven closed loop — injected p99 skew
+    (a deliberately slow handler under concurrent load) scales
+    replicas UP within the policy window via the router-pushed
+    latency_stats() feed; idle load scales back DOWN to min after the
+    cooldown."""
+    from ray_tpu._private.config import GLOBAL_CONFIG
+
+    GLOBAL_CONFIG.update({"serve_latency_report_s": 0.1})
+    try:
+        @serve.deployment(autoscaling_config=serve.AutoscalingConfig(
+            min_replicas=1, max_replicas=3, target_ongoing_requests=1,
+            metrics_interval_s=0.1, upscale_delay_s=0.1,
+            downscale_delay_s=0.5, target_p99_s=0.02))
+        class SlowLLM:
+            def __call__(self, mode):
+                # "slow" = the injected p99 skew; "fast" = recovered.
+                time.sleep(0.2 if mode == "slow" else 0.001)
+                return "ok"
+
+        handle = serve.run(SlowLLM.bind(), name="lat_auto_app")
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    handle.remote("slow").result(timeout_s=40)
+                except Exception:
+                    pass
+
+        threads = [threading.Thread(target=hammer) for _ in range(6)]
+        for t in threads:
+            t.start()
+        deadline = time.time() + 20
+        scaled_up = False
+        while time.time() < deadline:
+            st = serve.status().get("lat_auto_app::SlowLLM", {})
+            if st.get("running_replicas", 0) >= 2:
+                scaled_up = True
+                break
+            time.sleep(0.2)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert scaled_up, f"p99 skew never scaled up: {serve.status()}"
+        # The controller really consumed a router-pushed report.
+        from ray_tpu.serve import api as serve_api
+
+        report = ray_tpu.get(
+            serve_api._get_controller().get_latency_report.remote(
+                "lat_auto_app", "SlowLLM"))
+        assert report and report.get("p99_s", 0) > 0.02, report
+
+        # Recovered load: a fast trickle keeps the WINDOWED feed fresh
+        # with low latencies while the downscale cooldown elapses.
+        def trickle():
+            while not stop2.is_set():
+                try:
+                    handle.remote("fast").result(timeout_s=40)
+                except Exception:
+                    pass
+                time.sleep(0.3)
+
+        stop2 = threading.Event()
+        t2 = threading.Thread(target=trickle)
+        t2.start()
+        deadline = time.time() + 30
+        scaled_down = False
+        while time.time() < deadline:
+            st = serve.status().get("lat_auto_app::SlowLLM", {})
+            if st.get("running_replicas", 9) <= 1:
+                scaled_down = True
+                break
+            time.sleep(0.2)
+        stop2.set()
+        t2.join()
+        assert scaled_down, f"idle never scaled down: {serve.status()}"
+    finally:
+        GLOBAL_CONFIG.reset()
