@@ -138,7 +138,8 @@ def main() -> None:
 
     def _executed_count() -> int:
         # Tasks that actually ran, from the dispatch-stage counters
-        # (claimed = launched on this driver's watch).
+        # (claimed = launched on this driver's watch; batch_tasks
+        # includes the sharded lanes' dispatches).
         from ray_tpu._private.worker import global_runtime
 
         d = global_runtime().execution_pipeline_stats()["dispatch"]
@@ -297,6 +298,37 @@ def main() -> None:
     print(json.dumps({"note": "perf_plane_calibration",
                       **perf_plane_row}), flush=True)
 
+    # Sharded-dispatch honesty A/B (ISSUE 15): the same alternating
+    # best-of-N burst with the columnar lanes armed vs disarmed — the
+    # disarmed arm really is the classic ring path (submit_columnar
+    # refuses when SHARD_ON is off; in-flight groups drain first).
+    from ray_tpu._private import dispatch_lanes as _lanes_mod
+    from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
+
+    shard_armed_rates, shard_disarmed_rates = [], []
+    for _ in range(max(1, calib_reps)):
+        _lanes_mod.SHARD_ON = True
+        shard_armed_rates.append(_calib_burst(calib_n))
+        _lanes_mod.SHARD_ON = False
+        shard_disarmed_rates.append(_calib_burst(calib_n))
+    _lanes_mod.SHARD_ON = True  # the lanes ship armed
+    _rt = _grt()
+    sharded_row = {
+        "armed": bool(_cfg.driver_sharded_dispatch)
+        and _rt._lanes is not None,
+        "lanes": int(_rt.execution_pipeline_stats()["dispatch"][
+            "lanes"]),
+        "calib_tasks": calib_n,
+        "calib_exec_per_s_armed": round(max(shard_armed_rates), 1),
+        "calib_exec_per_s_disarmed": round(
+            max(shard_disarmed_rates), 1),
+        "calib_reps_armed": [round(r, 1) for r in shard_armed_rates],
+        "calib_reps_disarmed": [round(r, 1)
+                                for r in shard_disarmed_rates],
+    }
+    print(json.dumps({"note": "sharded_dispatch_calibration",
+                      **sharded_row}), flush=True)
+
     from ray_tpu.util import tracing as _tracing
     from ray_tpu._private import lock_witness as _witness
     from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
@@ -319,6 +351,13 @@ def main() -> None:
            # firing) is refused by test_bench_regression.
            fused_execution=bool(_cfg.fused_execution),
            fused=dict(stages.get("fused", {})),
+           # Sharded dispatch lanes + columnar submit records (ISSUE
+           # 15): knob state, lane count and the same-day disarmed
+           # A/B, so a refresh with the lanes disarmed (or one where
+           # the columnar path silently stopped firing — zero
+           # col_submits) is refused by test_bench_regression.
+           driver_sharded_dispatch=bool(_cfg.driver_sharded_dispatch),
+           sharded_dispatch=sharded_row,
            drained=drain_n,
            drain_wall_s=t_drain,
            throughput_per_s=best["throughput_per_s"],

@@ -41,6 +41,8 @@ KNOB_GATES: "dict[str, tuple[str, str]]" = {
     "speculation_enabled": ("ray_tpu/_private/speculation.py",
                             "SPEC_ON"),
     "lock_witness": ("ray_tpu/_private/lock_witness.py", "WITNESS_ON"),
+    "driver_sharded_dispatch": ("ray_tpu/_private/dispatch_lanes.py",
+                                "SHARD_ON"),
     "llm_paged_engine": ("ray_tpu/serve/llm_engine/engine.py",
                          "PAGED_ON"),
     "chaos": ("ray_tpu/_private/chaos.py", "ACTIVE"),

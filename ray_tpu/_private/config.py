@@ -211,6 +211,25 @@ _DEFAULTS: dict[str, Any] = {
     "submit_pipeline": True,
     "submit_ring_size": 65536,         # ring capacity; full => backpressure
     "submit_flush_max": 1024,          # records drained per flush pass
+    # Sharded driver dispatch + columnar submit records
+    # (dispatch_lanes.py): in connected mode, DEFAULT fused-eligible
+    # submits (scalar args, one return, no deadline/PG/affinity) skip
+    # per-task _SubmitRecord/TaskSpec/TaskEvent/lineage objects — a
+    # flush builds ONE columnar group per RemoteFunction (parallel
+    # id/args columns off the frozen call template) and hands it to N
+    # dispatch lanes, each with its own lock domain and ready queue;
+    # the cluster ledger is the only shared structure, acquired once
+    # per flush (ClusterState.acquire_batch), and get-less completions
+    # seal through a counter-only fast path. Disarmed
+    # (driver_sharded_dispatch=0), every submit takes the classic ring
+    # path byte-identically; each site costs one module-attribute
+    # branch (dispatch_lanes.SHARD_ON).
+    "driver_sharded_dispatch": True,
+    # Dispatch lanes (threads) the columnar groups shard across, keyed
+    # by admission signature. More lanes overlap RPC waits to more
+    # nodes; on a single-core box 2 is enough to keep one lane filling
+    # while another drains replies.
+    "dispatch_lanes": 2,
     # P2P chunked broadcast (reference: the object manager's chunked
     # Push/Pull fans transfers out peer-to-peer via the directory).
     "broadcast_chunk_fanout": 4,       # peer sources used per pull
@@ -359,6 +378,14 @@ class Config:
     def get(self, key: str) -> Any:
         with self._lock:
             return self._values[key]
+
+    def peek(self, key: str) -> Any:
+        """Lock-free read for per-call hot paths (the columnar submit
+        eligibility check runs per ``.remote()``). Safe: ``_values``
+        maps a fixed key set and ``update``/``reset`` replace values
+        per key under the GIL — a peek sees either the old or the new
+        value, never a torn one."""
+        return self._values[key]
 
     def __getattr__(self, key: str) -> Any:
         if key.startswith("_"):

@@ -414,6 +414,29 @@ class TaskEvent:
     stage_ts: dict = field(default_factory=dict)
 
 
+class TaskEventGroup:
+    """Columnar TaskEvent record: ONE object for a whole submit
+    flush's PENDING entries (dense index -> id range), expanded into
+    per-task :class:`TaskEvent` views lazily — only when a state query
+    actually touches a member. Completions accumulate as a counter
+    (the completion fast path records one group-finished bump per
+    reply group); members that leave the happy path (cancel, failure,
+    retry) get a REAL per-task event which always wins over the
+    synthesized view."""
+
+    __slots__ = ("task_ids", "name", "finished")
+
+    def __init__(self, task_ids: list, name: str):
+        self.task_ids = task_ids
+        self.name = name
+        self.finished = 0
+
+    def synthesize(self, task_id: TaskID) -> TaskEvent:
+        state = "FINISHED" if self.finished >= len(self.task_ids) \
+            else "PENDING"
+        return TaskEvent(task_id, self.name, state)
+
+
 class GlobalControlService:
     """All control-plane tables in one place."""
 
@@ -439,6 +462,11 @@ class GlobalControlService:
         self.wal_emit = None
         self._task_events: dict[TaskID, TaskEvent] = {}
         self._task_event_limit = 100_000
+        # Columnar task-event groups (TaskEventGroup): task id -> its
+        # group, bulk-built per flush; members count toward the event
+        # cap like per-task records.
+        self._task_groups: dict[TaskID, TaskEventGroup] = {}
+        self._group_event_entries = 0
         # Events silently refused at the cap used to vanish untraceably;
         # the counter surfaces as ray_tpu_task_events_dropped_total in
         # /metrics (reference: gcs_task_manager's dropped-task-attempts
@@ -738,7 +766,8 @@ class GlobalControlService:
 
     def _record_one_locked(self, event: TaskEvent) -> None:
         # Caller holds self._lock.
-        if len(self._task_events) >= self._task_event_limit \
+        if len(self._task_events) + self._group_event_entries \
+                >= self._task_event_limit \
                 and event.task_id not in self._task_events:
             self.task_events_dropped += 1
             return
@@ -764,6 +793,29 @@ class GlobalControlService:
         with self._lock:
             for event in events:
                 self._record_one_locked(event)
+
+    def record_task_event_group(self, task_ids: list,
+                                name: str) -> "TaskEventGroup | None":
+        """Columnar PENDING recording: one lock pass, one group object
+        and one bulk rid->group insert for a whole flush — no per-task
+        TaskEvent allocation (ISSUE 15). Returns the group (None when
+        the cap refused it, counted like per-task drops)."""
+        with self._lock:
+            if len(self._task_events) + self._group_event_entries \
+                    + len(task_ids) > self._task_event_limit:
+                self.task_events_dropped += len(task_ids)
+                return None
+            group = TaskEventGroup(task_ids, name)
+            self._task_groups.update(dict.fromkeys(task_ids, group))
+            self._group_event_entries += len(task_ids)
+            return group
+
+    def record_task_group_finished(self, group: "TaskEventGroup",
+                                   n: int) -> None:
+        """Completion fast path: one counter bump per sealed reply
+        group instead of a FINISHED TaskEvent per task."""
+        with self._lock:
+            group.finished += n
 
     def merge_stage_ts(self, task_id: TaskID, stages: dict) -> None:
         """Fold late-arriving stage stamps (a reply's offset-corrected
@@ -825,8 +877,22 @@ class GlobalControlService:
 
     def get_task_event(self, task_id: TaskID) -> TaskEvent | None:
         with self._lock:
-            return self._task_events.get(task_id)
+            event = self._task_events.get(task_id)
+            if event is not None:
+                return event
+            group = self._task_groups.get(task_id)
+            if group is not None:
+                # Lazy expansion: a real per-task record (failure,
+                # cancel) would have been found above and wins.
+                return group.synthesize(task_id)
+            return None
 
     def list_task_events(self) -> list[TaskEvent]:
         with self._lock:
-            return list(self._task_events.values())
+            out = list(self._task_events.values())
+            if self._task_groups:
+                events = self._task_events
+                for task_id, group in self._task_groups.items():
+                    if task_id not in events:
+                        out.append(group.synthesize(task_id))
+            return out

@@ -124,14 +124,31 @@ def install_runtime_collectors(runtime):
         # spillbacks / stale-stats skips / speculation outcomes): the
         # observability loop's own observability.
         try:
-            sched = runtime.execution_pipeline_stats().get("sched", {})
+            pipeline_stats = runtime.execution_pipeline_stats()
         except Exception:  # noqa: BLE001 — partial runtime teardown
-            sched = {}
+            pipeline_stats = {}
+        sched = pipeline_stats.get("sched", {})
         lines.append("# TYPE ray_tpu_sched_decisions_total counter")
         for key, value in sorted(sched.items()):
             lines.append(
                 f'ray_tpu_sched_decisions_total'
                 f'{{kind="{_escape_label(key)}"}} {value}')
+
+        # Driver submit-ring / dispatch-lane counters (ISSUE 15):
+        # flush latency, columnar intake, lane occupancy — exported as
+        # the ray_tpu_node_submit / ray_tpu_node_dispatch families
+        # under node="driver" (the driver IS the node that submits),
+        # keyed by the SUBMIT_STAT_KEYS / DISPATCH_STAT_KEYS
+        # registries in worker.py.
+        for family, group in (("ray_tpu_node_submit", "submit"),
+                              ("ray_tpu_node_dispatch", "dispatch")):
+            rows = pipeline_stats.get(group, {})
+            lines.append(f"# TYPE {family} counter")
+            for key, value in sorted(rows.items()):
+                if isinstance(value, (int, float)):
+                    lines.append(
+                        f'{family}{{node="driver",'
+                        f'key="{_escape_label(key)}"}} {int(value)}')
 
         # Cluster-wide per-node series: each daemon pushes its
         # executor_stats subset (pipeline / data_plane / faults) on

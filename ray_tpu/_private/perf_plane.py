@@ -103,6 +103,27 @@ class StageHistogram:
             self._sum += dt_s
             self._count += 1
 
+    def observe_many(self, samples) -> None:
+        """One lock pass for a whole batch of durations (the columnar
+        completion path records a reply group's worth of stage hops at
+        once instead of a lock acquire per task)."""
+        indexed = [(_bucket_index(dt), dt) for dt in samples]
+        with self._lock:
+            for idx, dt in indexed:
+                self._counts[idx] += 1
+                self._sum += dt
+            self._count += len(indexed)
+
+    def observe_n(self, dt_s: float, n: int) -> None:
+        """``n`` identical samples in one pass (a streamed reply group
+        lands at one instant — every member shares the rpc_seal
+        duration)."""
+        idx = _bucket_index(dt_s)
+        with self._lock:
+            self._counts[idx] += n
+            self._sum += dt_s * n
+            self._count += n
+
     def snapshot(self) -> dict:
         with self._lock:
             return {"counts": list(self._counts), "sum": self._sum,
@@ -162,6 +183,29 @@ def record_stage(stage: str, dt_s: float) -> None:
         with _hist_lock:
             hist = _hists.setdefault(stage, StageHistogram())
     hist.observe(dt_s)
+
+
+def record_stage_many(stage: str, samples) -> None:
+    """Batched record_stage: one histogram-lock pass for a whole
+    group of durations."""
+    if not samples:
+        return
+    hist = _hists.get(stage)
+    if hist is None:
+        with _hist_lock:
+            hist = _hists.setdefault(stage, StageHistogram())
+    hist.observe_many(samples)
+
+
+def record_stage_n(stage: str, dt_s: float, n: int) -> None:
+    """``n`` identical observations in one pass."""
+    if n <= 0:
+        return
+    hist = _hists.get(stage)
+    if hist is None:
+        with _hist_lock:
+            hist = _hists.setdefault(stage, StageHistogram())
+    hist.observe_n(dt_s, n)
 
 
 def stage_snapshot() -> dict:

@@ -22,7 +22,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Callable
 
 from ray_tpu._private.ids import NodeID, ObjectID  # noqa: F401 (NodeID: from_hex)
@@ -39,6 +39,17 @@ class LineageTable:
         self._lock = threading.RLock()
         self._by_object: "OrderedDict[ObjectID, TaskSpec]" = OrderedDict()
         self._max_entries = max_entries
+        # Columnar lineage (dispatch_lanes.ColumnarGroup): one GROUP
+        # record per submit flush instead of a spec per task. The
+        # rid -> group map is bulk-built (dict.fromkeys — one C pass,
+        # O(1) Python objects per group) and lookup() expands the one
+        # touched record into a real TaskSpec lazily (spec_for).
+        # Groups evict FIFO wholesale once the combined entry count
+        # passes the cap (same reconstructability-loss semantics as
+        # the per-spec eviction above).
+        self._group_by_rid: dict = {}
+        self._groups: "deque" = deque()
+        self._group_entries = 0
 
     def record(self, spec: TaskSpec) -> None:
         with self._lock:
@@ -64,18 +75,45 @@ class LineageTable:
             while len(by_object) > self._max_entries:
                 by_object.popitem(last=False)
 
+    def record_group(self, group) -> None:
+        """One lock pass + O(1) allocations for a whole columnar
+        group: the per-task specs exist only virtually until a lookup
+        touches one (lazy expansion — ISSUE 15)."""
+        with self._lock:
+            self._group_by_rid.update(
+                dict.fromkeys(group.return_ids, group))
+            self._groups.append(group)
+            self._group_entries += len(group.return_ids)
+            while self._groups and len(self._by_object) \
+                    + self._group_entries > self._max_entries:
+                old = self._groups.popleft()
+                self._group_entries -= len(old.return_ids)
+                for rid in old.return_ids:
+                    if self._group_by_rid.get(rid) is old:
+                        del self._group_by_rid[rid]
+
     def lookup(self, object_id: ObjectID) -> TaskSpec | None:
         with self._lock:
-            return self._by_object.get(object_id)
+            spec = self._by_object.get(object_id)
+            if spec is not None:
+                return spec
+            group = self._group_by_rid.get(object_id)
+            if group is None:
+                return None
+            # Expand the touched record only (recovery is the rare
+            # path); the materialized spec is NOT cached — recovery
+            # re-records it through record() when it resubmits.
+            return group.spec_for(group.by_rid[object_id])
 
     def forget(self, object_ids) -> None:
         with self._lock:
             for oid in object_ids:
                 self._by_object.pop(oid, None)
+                self._group_by_rid.pop(oid, None)
 
     def __len__(self) -> int:
         with self._lock:
-            return len(self._by_object)
+            return len(self._by_object) + len(self._group_by_rid)
 
 
 class ObjectRecoveryManager:

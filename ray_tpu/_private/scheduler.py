@@ -440,6 +440,116 @@ class ClusterState:
                 node.reported_inflight = dict(node.inflight)
                 self._lock.notify_all()
 
+    def acquire_batch(self, demand: dict[str, float], count: int,
+                      per_node_cap: int,
+                      node_filter=None,
+                      backlog: "int | None" = None,
+                      fill_extra: "int | None" = None,
+                      max_nodes: "int | None" = None) -> list:
+        """ONE ledger lock pass allocates up to ``count`` same-demand
+        tasks across the alive nodes — the sharded dispatch lanes'
+        replacement for per-task ``try_acquire`` calls. Returns
+        ``[(node, k, k_overcommitted), ...]``.
+
+        Each node takes its free slots (bounded by ``per_node_cap``)
+        plus an over-subscribed fill of ``count // n_nodes`` more
+        (availability goes negative — the daemon parks the excess in
+        admission, exactly like the classic batch-fill path). A node
+        with ZERO free slots is never over-subscribed: tasks stay
+        queued driver-side (and cancellable) instead of parking behind
+        a saturated daemon."""
+        plan: list = []
+        with self._lock:
+            n_all = sum(1 for n in self._nodes.values() if n.alive)
+            nodes = [n for n in self._nodes.values()
+                     if n.alive and (node_filter is None
+                                     or node_filter(n))]
+            if not nodes:
+                return plan
+            # Same fill pacing as the classic batch path: the
+            # over-subscription budget divides the backlog across ALL
+            # alive nodes, so a deep queue ships full batches while a
+            # modest burst leaves a cancellable driver-side tail.
+            # ``backlog`` is the caller's WHOLE queued population (a
+            # lane's groups beyond this allocation's count); a caller
+            # that KNOWS it is in a sustained burst passes
+            # ``fill_extra`` outright (the lanes' accumulation linger
+            # quantizes bursts into full-depth allocations).
+            if fill_extra is None:
+                fill_extra = min(
+                    per_node_cap,
+                    max(count, backlog or 0) // max(1, n_all))
+            else:
+                fill_extra = min(per_node_cap, fill_extra)
+            nodes.sort(key=lambda n: (n.utilization(),
+                                      n.node_id.hex()))
+            if LOCALITY_ON and len(nodes) > 1:
+                # Load-aware refinement, same policy as _pick_scored:
+                # a fresh stats feed showing the classic first choice
+                # measurably more loaded (>= _SPILL_MARGIN) — or gone
+                # stale while an alternative reports fresh — promotes
+                # the idler node to the front of the fill order.
+                now = time.monotonic()
+                try:
+                    stale_s = float(GLOBAL_CONFIG.sched_stats_stale_s)
+                except Exception:  # noqa: BLE001 — config teardown
+                    stale_s = 6.0
+
+                def load(n: NodeState) -> "float | None":
+                    if n.stats_at <= 0.0 or now - n.stats_at > stale_s:
+                        return None
+                    return (n.stats_running + n.stats_depth
+                            + n.stats_wait_s)
+
+                loads = {id(n): load(n) for n in nodes}
+                if any(v is not None for v in loads.values()):
+                    default = nodes[0]
+                    chosen = min(nodes, key=lambda n: (
+                        loads[id(n)] if loads[id(n)] is not None
+                        else float("inf"),
+                        n.utilization(), n.node_id.hex()))
+                    if chosen is not default:
+                        d_load = loads[id(default)]
+                        c_load = loads[id(chosen)]
+                        if d_load is None:
+                            self.sched["stale_stats_skips"] += 1
+                            nodes.remove(chosen)
+                            nodes.insert(0, chosen)
+                        elif c_load is not None \
+                                and d_load - c_load >= _SPILL_MARGIN:
+                            self.sched["load_spillbacks"] += 1
+                            nodes.remove(chosen)
+                            nodes.insert(0, chosen)
+            remaining = count
+            for node in nodes:
+                if remaining <= 0:
+                    break
+                if max_nodes is not None and len(plan) >= max_nodes:
+                    break
+                if demand:
+                    if not node.feasible(demand):
+                        continue
+                    k_free = per_node_cap
+                    for key, value in demand.items():
+                        if value > 0:
+                            k_free = min(k_free, int(
+                                (node.effective_available(key) + 1e-9)
+                                / value))
+                else:
+                    k_free = per_node_cap
+                if k_free <= 0:
+                    continue
+                k = min(per_node_cap, k_free + fill_extra, remaining)
+                n_over = max(0, k - k_free)
+                for key, value in demand.items():
+                    node.available[key] = node.available.get(
+                        key, 0.0) - value * k
+                    node.inflight[key] = node.inflight.get(
+                        key, 0.0) + value * k
+                plan.append((node, k, n_over))
+                remaining -= k
+        return plan
+
     def force_acquire(self, node_id: NodeID, demand: dict[str, float]) -> None:
         """Unconditional acquire (availability may go transiently
         negative). Used when a blocked task resumes: stalling the
@@ -532,8 +642,18 @@ class Dispatcher:
         # free when no task carries a deadline) and hands them to the
         # owner's hook instead of scanning the whole queue.
         self._deadline_heap: list = []  # (deadline, order, task)
+        # LIVE (unclaimed, uncancelled) deadline-armed queued tasks.
+        # The heap itself only shrinks when expiry times arrive, so a
+        # burst of deadline-armed tasks that all COMPLETED would
+        # otherwise leave zombie entries making every later dispatch
+        # pass pay the sweep; at zero live entries the sweep is
+        # skipped outright and the zombie heap dropped wholesale.
+        self._deadline_armed = 0
         self._on_deadline = None
         self.deadline_expired = 0
+        # Sweep passes that actually ran (the zero-armed fast path
+        # skips them — unit-tested in test_sharded_dispatch.py).
+        self.deadline_sweeps = 0
         # Batched remote dispatch (set_batch_hooks): tasks claimed for
         # the same batch key within one pass coalesce into one runner.
         self._batch_key = None
@@ -676,6 +796,7 @@ class Dispatcher:
                 if getattr(spec, "deadline", None) is not None:
                     heapq.heappush(self._deadline_heap,
                                    (spec.deadline, task.order, task))
+                    self._deadline_armed += 1
             if self._parked:
                 self._lock.notify_all()
 
@@ -684,6 +805,8 @@ class Dispatcher:
 
     def _on_objects_sealed(self, object_ids) -> None:
         with self._lock:
+            if not self._dep_index:
+                return  # nothing dep-gated: seal groups cost O(1)
             woke = False
             for object_id in object_ids:
                 dependents = self._dep_index.pop(object_id, None)
@@ -713,12 +836,21 @@ class Dispatcher:
         now = time.time()
         expired: list = []
         with self._lock:
+            if self._deadline_armed <= 0:
+                # Zero live deadline-armed tasks: skip the sweep and
+                # drop the zombie entries (claimed/cancelled tasks
+                # whose expiry times haven't arrived) wholesale —
+                # deadline-free workloads pay nothing here.
+                self._deadline_heap.clear()
+                return
+            self.deadline_sweeps += 1
             while self._deadline_heap and self._deadline_heap[0][0] <= now:
                 _, _, task = heapq.heappop(self._deadline_heap)
                 if task.claimed or task.cancelled:
                     continue  # ran (or was cancelled) in time
                 task.cancelled = True
                 self.deadline_expired += 1
+                self._deadline_armed -= 1
                 for rid in task.spec.return_ids:
                     self._by_return_id.pop(rid, None)
                 if not task.unresolved_deps:
@@ -740,7 +872,7 @@ class Dispatcher:
                         self._lock.wait(timeout=0.2)
                     finally:
                         self._parked = False
-                    if self._deadline_heap:
+                    if self._deadline_armed:
                         break  # sweep expiries even while idle-parked
                 if self._shutdown:
                     return
@@ -785,12 +917,15 @@ class Dispatcher:
                 task.cancelled = True
                 expired = True
                 self.deadline_expired += 1
+                self._deadline_armed -= 1
                 self._num_ready_live -= 1
                 for rid in task.spec.return_ids:
                     self._by_return_id.pop(rid, None)
                 self._cluster.release(node.node_id, task.spec.resources)
             else:
                 task.claimed = True
+                if deadline is not None:
+                    self._deadline_armed -= 1
                 self._num_ready_live -= 1
                 self._num_running += 1
                 if tracing.TRACE_ON or perf.PERF_ON:
@@ -1180,6 +1315,8 @@ class Dispatcher:
             if task is None or task.claimed or task.cancelled:
                 return None
             task.cancelled = True
+            if getattr(task.spec, "deadline", None) is not None:
+                self._deadline_armed -= 1
             for rid in task.spec.return_ids:
                 self._by_return_id.pop(rid, None)
             if not task.unresolved_deps:
@@ -1201,6 +1338,22 @@ class Dispatcher:
                 dependents.discard(task)
                 if not dependents:
                     del self._dep_index[dep_id]
+
+    def reset_unsatisfiable_avoids(self, alive_ids: set) -> None:
+        """A node died: spillback avoid sets computed against the old
+        membership may now exclude every live candidate — clear those
+        so their tasks dispatch (running on a previously-avoided node
+        beats hanging; the next bounce rebuilds the set against the
+        new membership). O(spillback tasks), only on node death."""
+        with self._lock:
+            for task in self._ready_odd:
+                if task.claimed or task.cancelled:
+                    continue
+                avoid = getattr(task.spec, "_avoid_nodes", None)
+                if avoid and avoid >= alive_ids:
+                    task.spec._avoid_nodes = set()
+            if self._parked:
+                self._lock.notify_all()
 
     def fail_hard_affinity(self, node_id_hex: str) -> "list[TaskSpec]":
         """Pop every queued task HARD-pinned to a node that just died.
@@ -1226,6 +1379,8 @@ class Dispatcher:
                 victims += [t for t in dq if pinned(t)]
             for task in victims:
                 task.cancelled = True
+                if getattr(task.spec, "deadline", None) is not None:
+                    self._deadline_armed -= 1
                 for rid in task.spec.return_ids:
                     self._by_return_id.pop(rid, None)
                 if not task.unresolved_deps:

@@ -31,6 +31,7 @@ import time
 from typing import Any, Iterable, Sequence
 
 from ray_tpu._private import accelerators
+from ray_tpu._private import dispatch_lanes
 from ray_tpu._private import perf_plane as perf
 from ray_tpu._private import scheduler as scheduler_mod
 from ray_tpu._private import speculation as spec_mod
@@ -87,6 +88,28 @@ def _simple_arg(value, depth: int = 0) -> bool:
     if t is tuple and depth < 2 and len(value) <= 8:
         return all(_simple_arg(v, depth + 1) for v in value)
     return False
+
+
+# Columnar submit eligibility: exact scalar types only at the top
+# level (the raw codec's shape minus containers — container args keep
+# the classic ring path, whose pickle-time machinery they may need).
+_COL_ARG_TYPES = frozenset((int, float, bool, str, bytes, type(None)))
+
+# Counter-key registries for execution_pipeline_stats()'s driver-side
+# submit/dispatch groups (the analysis counter-keys pass matches them
+# against the builder and metrics_agent exports them as the
+# ray_tpu_node_submit / ray_tpu_node_dispatch families).
+SUBMIT_STAT_KEYS = (
+    "ring_submits", "flushes", "flush_tasks", "ring_full_waits",
+    "buffered_cancels", "arg_cache_hits", "col_submits",
+    "col_flush_tasks", "flush_wall_us",
+)
+DISPATCH_STAT_KEYS = (
+    "batches", "batch_tasks", "singles", "batch_overcommit",
+    "deadline_sweeps", "lanes", "lane_dispatches", "lane_tasks",
+    "lane_busy_us", "lane_overcommits", "col_groups",
+    "lane_outstanding",
+)
 
 
 def _warn_runtime_env_ignored(context: str) -> None:
@@ -260,16 +283,41 @@ class _SubmitRing:
             self._runtime._seal_cancelled_submit(rec)
         return rec
 
+    def _aux_depth(self) -> int:
+        """Columnar records buffered alongside the classic ring (the
+        submitter thread drains both)."""
+        return len(self._runtime._col_buf)
+
+    def kick(self) -> None:
+        """Wake a parked drain loop after a lock-free columnar push
+        (the parked-flag read costs nothing during a burst)."""
+        if self._parked:
+            with self._cond:
+                self._cond.notify_all()
+
+    def col_backpressure(self) -> None:
+        """Bounded blocking for a full columnar buffer — same
+        semantics as a full ring: the submitter waits, never drops."""
+        with self._cond:
+            if self._aux_depth() < self._capacity:
+                return
+            self.ring_full_waits += 1
+            while self._aux_depth() >= self._capacity \
+                    and not self._stop:
+                self._cond.wait(0.1)
+
     def _drain_loop(self) -> None:
         while True:
             with self._cond:
-                while not self._ring and not self._stop:
+                while not self._ring and not self._aux_depth() \
+                        and not self._stop:
                     self._parked = True
                     try:
                         self._cond.wait(timeout=0.2)
                     finally:
                         self._parked = False
-                if not self._ring and self._stop:
+                if not self._ring and not self._aux_depth() \
+                        and self._stop:
                     return
             # Test seam sits between wake and claim so a cleared gate
             # deterministically holds records in the BUFFERED state.
@@ -283,16 +331,35 @@ class _SubmitRing:
             # loop running hot. A lone interactive submit (small
             # depth) flushes immediately; the linger is bounded so a
             # stalling producer can never hold a batch hostage.
-            if len(self._ring) >= 64:
+            if len(self._ring) + self._aux_depth() >= 64:
                 deadline = time.monotonic() + 0.05
                 last_depth = -1
+                stalls = 0
                 while not self._stop:
-                    depth = len(self._ring)
-                    if depth >= self._flush_max or depth == last_depth \
+                    depth = len(self._ring) + self._aux_depth()
+                    if depth >= self._flush_max \
                             or time.monotonic() >= deadline:
                         break
+                    if depth == last_depth:
+                        # One stalled tick can just be the producer
+                        # losing the GIL to a runner/daemon burst;
+                        # only a SUSTAINED stall ends the linger —
+                        # bigger flushes mean deeper dispatch slices.
+                        stalls += 1
+                        if stalls >= 2:
+                            break
+                    else:
+                        stalls = 0
                     last_depth = depth
                     time.sleep(0.002)
+            # Columnar records flush first (their own groups, one lock
+            # pass); failures there seal errors per record, never kill
+            # the drain thread.
+            if self._aux_depth():
+                try:
+                    self._runtime._flush_columnar(self)
+                except BaseException:  # noqa: BLE001 — never die
+                    logger.exception("columnar flush failed")
             with self._cond:
                 n = min(len(self._ring), self._flush_max)
                 batch = [self._ring.popleft() for _ in range(n)]
@@ -319,7 +386,7 @@ class _SubmitRing:
 
     def depth(self) -> int:
         with self._cond:
-            return len(self._ring)
+            return len(self._ring) + self._aux_depth()
 
     def stop(self) -> None:
         """Flush whatever is buffered, then join the submitter."""
@@ -583,6 +650,23 @@ class Runtime:
             collections.OrderedDict()
         self._arg_blob_lock = threading.Lock()
         self.arg_cache_hits = 0
+        # Columnar submit records (dispatch_lanes.py, ISSUE 15):
+        # eligible .remote() calls append ONE tuple to this lock-free
+        # buffer; the ring's flush thread drains it into per-template
+        # ColumnarGroups for the dispatch lanes. _col_index maps every
+        # in-flight columnar return id to its state (the record's
+        # TaskID while buffered, then its group) for cancel /
+        # attach_future / lazy expansion; _col_lock serializes flush
+        # claims against cancels — the submit hot path never takes it.
+        dispatch_lanes.init_from_config()
+        self._col_buf: collections.deque = collections.deque()
+        self._col_index: dict = {}
+        self._col_lock = threading.Lock()
+        self._lanes = None
+        self._col_submits = 0
+        self._col_flush_tasks = 0
+        self._col_buffered_cancels = 0
+        self._flush_wall_us = 0
         # Pipelined submission: .remote() returns pre-allocated refs and
         # defers the per-task record-keeping to the ring's flush thread.
         self._submit_ring = None
@@ -768,6 +852,16 @@ class Runtime:
             # in a dispatch pass ride a single execute_task_batch RPC.
             self.dispatcher.set_batch_hooks(self._task_batch_key,
                                             self._run_task_batch)
+            # Sharded dispatch lanes (ISSUE 15): columnar groups of
+            # fused-eligible DEFAULT submits bypass the classic
+            # dispatcher entirely — N lanes acquire whole per-node
+            # allocation plans from the cluster ledger (one lock pass
+            # per flush) and ship compact columnar batch RPCs.
+            if dispatch_lanes.SHARD_ON:
+                self._lanes = dispatch_lanes.DispatchLanes(
+                    self.cluster, self._run_columnar_slice,
+                    fallback=self._columnar_starved,
+                    node_filter=self._columnar_node_filter)
 
     # ------------------------------------------------------ remote exec plane
 
@@ -1103,9 +1197,16 @@ class Runtime:
     def _drop_remote_node(self, node_id: NodeID) -> None:
         with self._remote_nodes_lock:
             handle = self._remote_nodes.pop(node_id, None)
+            alive = set(self._remote_nodes)
         if handle is None:
             return
         handle.close()
+        # Busy-spillback avoid sets were computed against the OLD
+        # membership: with this node gone they can exclude every
+        # surviving candidate, leaving their tasks queued forever (the
+        # spillback reset only re-evaluates on the NEXT bounce, which
+        # an un-dispatchable task never gets).
+        self.dispatcher.reset_unsatisfiable_avoids(alive)
         self._on_node_dead(node_id)
 
     def _flush_remote_frees(self) -> None:
@@ -1543,9 +1644,13 @@ class Runtime:
         cap on the dispatcher backlog + host-memory watermark (both
         off by default; the watermark read is memoized)."""
         cap = int(GLOBAL_CONFIG.admission_max_queue_depth or 0)
-        if cap > 0 and self.dispatcher.pending_count() > cap:
-            return (f"dispatcher backlog over admission_max_queue_depth"
-                    f"={cap}")
+        if cap > 0:
+            depth = self.dispatcher.pending_count()
+            if self._lanes is not None:
+                depth += self._lanes.outstanding()
+            if depth > cap:
+                return (f"dispatcher backlog over "
+                        f"admission_max_queue_depth={cap}")
         watermark = float(GLOBAL_CONFIG.admission_memory_watermark or 0)
         if watermark > 0:
             from ray_tpu._private import spill_manager as spill_mod
@@ -1754,6 +1859,7 @@ class Runtime:
         the per-task costs the inline path pays 100k times are paid
         once per flush here. ``ring`` is passed in (not read off self):
         shutdown detaches self._submit_ring before the final flush."""
+        t_flush0 = time.perf_counter()
         live: list[_SubmitRecord] = []
         with ring._cond:
             for rec in records:
@@ -1894,6 +2000,445 @@ class Runtime:
         for rec in post_cancel:
             if rec.return_ids:
                 self._cancel_registered(rec.return_ids[0])
+        self._flush_wall_us += int(
+            (time.perf_counter() - t_flush0) * 1e6)
+
+    # -------------------------------------------- columnar submit (ISSUE 15)
+
+    def submit_columnar(self, template, args) -> "ObjectRef | None":
+        """Columnar fast path for an eligible ``.remote()``: mint the
+        ids, seed the ref, append ONE tuple to the lock-free buffer —
+        no _SubmitRecord, no per-push lock, no notify during a burst.
+        Returns None to send the caller down the classic ring path
+        (ineligible args, lanes absent, tracing/speculation armed)."""
+        lanes = self._lanes
+        if lanes is None:
+            return None
+        ring = self._submit_ring
+        if ring is None or ring._stop:
+            return None
+        # Per-task trace contexts / speculation tracking need real
+        # TaskSpecs: the classic path owns those. (A disarmed watcher
+        # object sticks around after configure_speculation toggles
+        # off — SPEC_ON is the live gate.) One gate per branch.
+        if tracing.TRACE_ON:
+            return None
+        if spec_mod.SPEC_ON and self._spec_watcher is not None:
+            return None
+        for a in args:
+            if type(a) not in _COL_ARG_TYPES:
+                return None
+        buf = self._col_buf
+        if len(buf) >= ring._capacity:
+            ring.col_backpressure()
+        task_id = TaskID()
+        rid = ObjectID()
+        # Index BEFORE the buffer append: a record popped by the flush
+        # always finds its index entry (GIL program order).
+        self._col_index[rid] = task_id
+        buf.append((template, task_id, rid, args,
+                    time.time() if perf.PERF_ON else 0.0))
+        ref = ObjectRef(rid, _register=False)
+        self.reference_counter.seed_ref(rid)
+        ref._registered = True
+        if ring._parked:
+            ring.kick()
+        return ref
+
+    def _flush_columnar(self, ring: "_SubmitRing") -> None:
+        """Drain one columnar flush: group the claimed records by
+        template and do O(1) work per GROUP — one ColumnarGroup, one
+        bulk rid->group index update, one lineage group record, one
+        TaskEvent group record, one lane submission. The per-task
+        TaskSpec/TaskEvent/ObjectEntry objects the classic flush
+        builds are expanded lazily, only when something touches one."""
+        buf = self._col_buf
+        n = min(len(buf), ring._flush_max)
+        if n <= 0:
+            return
+        t0 = time.perf_counter()
+        records = []
+        pop = buf.popleft
+        for _ in range(n):
+            try:
+                records.append(pop())
+            except IndexError:
+                break
+        # Admission control at the flush boundary: columnar records
+        # are deadline-free by construction, so over the cap they WAIT
+        # (which backpressures the buffer and ultimately .remote()) —
+        # bounded blocking, never loss.
+        while self._admission_overload_reason() is not None:
+            if ring._stop:
+                break
+            time.sleep(0.02)
+        index = self._col_index
+        groups: list = []
+        with self._col_lock:
+            per: dict = {}
+            for template, task_id, rid, args, ts in records:
+                if index.get(rid) is not task_id:
+                    continue  # cancelled while buffered (sealed there)
+                cols = per.get(template)
+                if cols is None:
+                    cols = per[template] = ([], [], [], [])
+                cols[0].append(task_id)
+                cols[1].append(rid)
+                cols[2].append(args)
+                cols[3].append(ts)
+            for template, cols in per.items():
+                group = dispatch_lanes.ColumnarGroup(
+                    template, cols[0], cols[1], cols[2], cols[3])
+                index.update(dict.fromkeys(cols[1], group))
+                groups.append(group)
+        lanes = self._lanes
+        for group in groups:
+            # Lineage + PENDING events as per-flush group records,
+            # registered BEFORE the lanes can dispatch any member.
+            self.lineage.record_group(group)
+            group.event_group = self.gcs.record_task_event_group(
+                group.task_ids, group.template.name)
+            lanes.submit_group(group)
+        self._col_submits += len(records)
+        self._col_flush_tasks += sum(len(g) for g in groups)
+        self._flush_wall_us += int((time.perf_counter() - t0) * 1e6)
+        with ring._cond:
+            ring._cond.notify_all()  # unblock col_backpressure waiters
+
+    def _cancel_columnar(self, object_id) -> bool:
+        """Cancel routing for columnar ids. True => handled here (the
+        error was sealed, or a racing cancel/seal already resolved the
+        ref); False => not ours / already dispatched — the caller
+        falls through to the dispatcher."""
+        index = self._col_index
+        st = index.get(object_id)
+        if st is None:
+            return False
+        with self._col_lock:
+            st = index.get(object_id)
+            if st is None:
+                return True  # raced a cancel or a terminal seal
+            if st.__class__ is TaskID:
+                # Still BUFFERED: the flush will skip the record (its
+                # index entry no longer matches); seal here.
+                index.pop(object_id, None)
+                self._col_buffered_cancels += 1
+                task_id, name = st, ""
+            else:
+                group = st
+                if not self._lanes.cancel(object_id, group):
+                    return False  # dispatched: best-effort no-op
+                index.pop(object_id, None)
+                idx = group.by_rid[object_id]
+                task_id = group.task_ids[idx]
+                name = group.template.name
+        err = TaskCancelledError(task_id)
+        self.store.put_error(object_id, err)
+        self.gcs.record_task_event(TaskEvent(
+            task_id, name, "FAILED", error="cancelled"))
+        return True
+
+    def _columnar_node_filter(self, node: NodeState) -> bool:
+        # Dict membership under the GIL; lanes only dispatch to nodes
+        # with a live daemon handle.
+        return node.node_id in self._remote_nodes
+
+    def _columnar_indexes_to_classic(self, group, idxs) -> None:
+        """Hand columnar tasks to the classic dispatcher (starvation
+        fallback, invisible requeues): expand the touched records into
+        TaskSpecs, create their store pending entries (attach_future /
+        state queries now see them there) and submit_many in one
+        pass. The caller has already released any held claims."""
+        index = self._col_index
+        rids = [group.return_ids[gidx] for gidx in idxs]
+        self.store.create_pending_batch(rids)
+        items = []
+        for gidx in idxs:
+            index.pop(group.return_ids[gidx], None)
+            items.append((group.spec_for(gidx), self._execute_task, []))
+        if items:
+            self.dispatcher.submit_many(items)
+            self._lanes.task_done(len(items))
+
+    def _columnar_starved(self, group, idxs) -> None:
+        """Lane starvation fallback: no filtered (remote) node could
+        admit this group for a while — the classic dispatcher owns the
+        wait (it can also run the tasks locally)."""
+        self._columnar_indexes_to_classic(group, idxs)
+
+    def _columnar_local_fallback(self, group, sent, node) -> None:
+        """The function can't cross a process boundary (unpicklable):
+        run the slice in-thread via the classic single path, exactly
+        like the classic batch runner's fallback."""
+        resources = group.template.resources
+        index = self._col_index
+        for gidx in sent:
+            rid = group.return_ids[gidx]
+            index.pop(rid, None)
+            self.store.create_pending(rid)
+            spec = group.spec_for(gidx)
+            try:
+                self._execute_task(spec, node)
+            finally:
+                self.cluster.release(node.node_id, resources)
+                self._lanes.task_done()
+
+    def _run_columnar_slice(self, group, indexes, node,
+                            n_over: int) -> None:
+        """Runner-thread executor for one lane allocation: build the
+        compact columnar batch RPC, seal streamed reply groups through
+        the completion fast path, and route every non-happy reply
+        through a lazily materialized TaskSpec on the classic
+        machinery. Exactly-once discipline matches the classic batch
+        runner: entries the daemon never announced requeue invisibly
+        on a cut stream; announced ones fail as WorkerCrashedError
+        (retried under the system-failure budget)."""
+        from ray_tpu._private import serialization
+        from ray_tpu._private.rpc import RpcError, RpcMethodError
+        from ray_tpu.exceptions import WorkerCrashedError
+
+        template = group.template
+        resources = template.resources
+        sent = list(indexes)
+        with self._remote_nodes_lock:
+            handle = self._remote_nodes.get(node.node_id)
+        if handle is None:
+            # Node dropped between plan and launch.
+            self.cluster.release_many(node.node_id,
+                                      [resources] * len(sent))
+            self._columnar_indexes_to_classic(group, sent)
+            return
+        try:
+            digest, func_blob = self._function_blob(template.func)
+        except Exception:  # noqa: BLE001 — unpicklable: run locally
+            self._columnar_local_fallback(group, sent, node)
+            return
+        with handle._digest_lock:
+            known = digest in handle.known_digests
+            handle.known_digests.add(digest)
+        ser_raw = serialization.try_serialize_raw
+        ser_framed = serialization.serialize_framed
+        args_col = group.args_col
+        rids = group.return_ids
+        # Columnar wire: the blob encodes the ARGS TUPLE alone —
+        # kwargs are empty by eligibility, so both ends skip the
+        # (args, kwargs) nesting the classic frames carry.
+        args_blobs = []
+        return_keys = []
+        for idx in sent:
+            args = args_col[idx]
+            blob = ser_raw(args)
+            args_blobs.append(blob if blob is not None
+                              else ser_framed(args))
+            return_keys.append(rids[idx].binary())
+        descriptor = ("col1", digest, None if known else func_blob,
+                      args_blobs, return_keys, resources,
+                      group.task_ids[sent[0]].hex())
+        n = len(sent)
+        done = bytearray(n)
+        started: "set[int]" = set()
+        cpu_only = {k: v for k, v in resources.items() if k == "CPU"}
+        client_addr = self._client_server_addr() or None
+        t_send = time.time()
+        if perf.PERF_ON:
+            ts_col = group.submit_ts
+            if ts_col:
+                perf.record_stage_many("submit_dispatch", [
+                    max(0.0, t_send - ts_col[idx]) for idx in sent
+                    if ts_col[idx]])
+
+        def on_col(payload):
+            start_local, items = payload
+            self._seal_columnar_group(group, sent, done, start_local,
+                                      items, node, handle, t_send)
+
+        def on_results(pairs):
+            # Classic replies: budget-spilled entries riding the
+            # worker pipeline inside the columnar batch.
+            for local_idx, reply in pairs:
+                if done[local_idx]:
+                    continue
+                done[local_idx] = 1
+                self._finish_columnar_classic(
+                    group, sent[local_idx], node, handle, reply)
+
+        def on_parked(local_idx):
+            # Over-subscribed entry parked in daemon admission: give
+            # its CPU back on the driver ledger until it resumes.
+            if cpu_only:
+                self.cluster.release(node.node_id, cpu_only)
+
+        def on_resumed(local_idx):
+            if cpu_only:
+                self.cluster.force_acquire(node.node_id, cpu_only)
+
+        transport_exc = None
+        try:
+            _, fused_stats = handle.execute_batch(
+                descriptor, on_results, on_parked, on_resumed,
+                client_addr, on_started=started.add, on_col=on_col)
+            if fused_stats.get("fused") \
+                    or fused_stats.get("fused_fallbacks"):
+                with self._fault_lock:
+                    if fused_stats.get("fused"):
+                        self._fused_runs += 1
+                        self._fused_tasks += int(fused_stats["fused"])
+                    self._fused_fallbacks += int(
+                        fused_stats.get("fused_fallbacks", 0))
+        except (RpcError, RpcMethodError, OSError) as exc:
+            transport_exc = exc
+        except BaseException as exc:  # noqa: BLE001 — never strand
+            # A reply-handler failure mid-stream must not strand the
+            # slice's tasks (no seal = a get() hangs forever): treat
+            # it like a cut stream — unfinished entries requeue/retry.
+            logger.exception("columnar slice reply handling failed")
+            transport_exc = exc
+        missing = [i for i in range(n) if not done[i]]
+        if not missing:
+            return
+        if transport_exc is not None and not handle.ping():
+            self._drop_remote_node(node.node_id)
+        for local_idx in missing:
+            gidx = sent[local_idx]
+            self.cluster.release(node.node_id, resources)
+            requeues = group.requeues.get(gidx, 0)
+            if local_idx not in started and requeues < 3:
+                # Provably never ran (no started window covered it):
+                # invisible requeue, no retry budget consumed.
+                group.requeues[gidx] = requeues + 1
+                with self._fault_lock:
+                    self._fault_batch_requeues += 1
+                self._columnar_indexes_to_classic(group, [gidx])
+                continue
+            spec = group.spec_for(gidx)
+            self._col_index.pop(rids[gidx], None)
+            self._lanes.task_done()
+            self.store.create_pending(rids[gidx])
+            err = WorkerCrashedError(
+                f"node {node.node_id.hex()[:8]} lost task "
+                f"{template.name} mid-batch: {transport_exc}")
+            self._finish_task_failure(spec, err, t_send)
+
+    def _seal_columnar_group(self, group, sent, done, start_local,
+                             items, node, handle, t_send) -> None:
+        """Completion FAST path: one store lock pass seals the whole
+        reply group (batch listeners only — get-less tasks touch zero
+        future machinery), one group-finished counter bump replaces
+        per-task FINISHED events, one ledger pass releases the claims,
+        and futures resolve only when any are actually attached."""
+        from ray_tpu._private import serialization
+
+        deser = serialization.deserialize_from_buffer
+        rids = group.return_ids
+        pairs = []
+        classic = []
+        for i, payload in enumerate(items):
+            local_idx = start_local + i
+            if done[local_idx]:
+                continue
+            done[local_idx] = 1
+            if type(payload) is bytes:
+                pairs.append((rids[sent[local_idx]],
+                              deser(memoryview(payload))))
+            else:
+                classic.append((local_idx, payload))
+        if pairs:
+            self.store.put_group(pairs)
+            if self._futures:
+                for rid, _ in pairs:
+                    self._resolve_futures(rid)
+            event_group = group.event_group
+            if event_group is not None:
+                self.gcs.record_task_group_finished(event_group,
+                                                    len(pairs))
+            self.cluster.release_many(
+                node.node_id, [group.template.resources] * len(pairs))
+            self._lanes.task_done(len(pairs))
+            index = self._col_index
+            for rid, _ in pairs:
+                index.pop(rid, None)
+            if perf.PERF_ON:
+                perf.record_stage_n("rpc_seal",
+                                    max(0.0, time.time() - t_send),
+                                    len(pairs))
+        for local_idx, payload in classic:
+            self._finish_columnar_classic(group, sent[local_idx],
+                                          node, handle, payload)
+
+    def _finish_columnar_classic(self, group, gidx, node, handle,
+                                 reply) -> None:
+        """A columnar entry left the happy path ('stored' results,
+        errors, requeue shapes): expand the one touched record into a
+        TaskSpec and give it to the classic machinery — retries,
+        spillback, overload handling and events all behave exactly as
+        on the classic batch path."""
+        from ray_tpu._private import serialization
+
+        spec = group.spec_for(gidx)
+        rid = group.return_ids[gidx]
+        self._col_index.pop(rid, None)
+        self._lanes.task_done()
+        self.store.create_pending(rid)
+        resources = group.template.resources
+        kind = reply[0]
+        start = time.time()
+        if kind == "ok":
+            try:
+                pairs: list = []
+                self._collect_remote_results(
+                    spec.return_ids, reply[1], node.node_id,
+                    handle.address, pairs)
+                if pairs:
+                    self.store.put_batch(pairs)
+                event_group = group.event_group
+                if event_group is not None:
+                    self.gcs.record_task_group_finished(event_group, 1)
+            except BaseException as exc:  # noqa: BLE001
+                self._finish_task_failure(spec, exc, start)
+            self.cluster.release(node.node_id, resources)
+            return
+        if kind == "err":
+            exc, tb = serialization.deserialize_from_buffer(
+                memoryview(reply[1]))
+            exc.__ray_tpu_remote_tb__ = tb
+            self._finish_task_failure(spec, exc, start)
+            self.cluster.release(node.node_id, resources)
+            return
+        if kind == "need_func":
+            # Daemon restarted: re-ship via the single path (which
+            # sends the function blob) on its own thread; the claim is
+            # released when it completes.
+            def redo(spec=spec):
+                try:
+                    self._execute_task(spec, node)
+                finally:
+                    self.cluster.release(node.node_id, resources)
+
+            threading.Thread(target=redo, daemon=True,
+                             name="ray_tpu-task-refunc").start()
+            return
+        # Requeue/terminal shapes release the claim first — their next
+        # dispatch re-acquires through the classic admission.
+        self.cluster.release(node.node_id, resources)
+        if kind == "busy":
+            self._spillback_requeue(spec, node)
+        elif kind == "overloaded":
+            self._handle_overloaded_reply(spec, node,
+                                          "daemon admission shed")
+        elif kind == "timeout":
+            self._seal_deadline(
+                spec, reply[1] if len(reply) > 1 and reply[1]
+                else "admitted")
+        elif kind == "cancelled":
+            err = TaskCancelledError(spec.task_id)
+            for r in spec.return_ids:
+                self.store.put_error(r, err)
+            self.gcs.record_task_event(TaskEvent(
+                spec.task_id, spec.name, "FAILED", error="cancelled"))
+        else:
+            self._finish_task_failure(
+                spec, RuntimeError(f"unknown columnar reply {kind!r}"),
+                start)
 
     def _submit_pg_task(self, spec: TaskSpec, deps, strategy) -> None:
         """Route through the bundle ledger once the PG is committed."""
@@ -3658,22 +4203,9 @@ class Runtime:
         ``executor_stats()['pipeline']``): submit = the submit ring,
         dispatch = scheduler batch coalescing, seal = grouped result
         sealing."""
-        ring = self._submit_ring
         return {
-            "submit": {
-                "ring_submits": ring.submits if ring else 0,
-                "flushes": ring.flushes if ring else 0,
-                "flush_tasks": ring.flush_tasks if ring else 0,
-                "ring_full_waits": ring.ring_full_waits if ring else 0,
-                "buffered_cancels": ring.buffered_cancels if ring else 0,
-                "arg_cache_hits": self.arg_cache_hits,
-            },
-            "dispatch": {
-                "batches": self.dispatcher.batches_launched,
-                "batch_tasks": self.dispatcher.batch_tasks_launched,
-                "singles": self.dispatcher.singles_launched,
-                "batch_overcommit": self.dispatcher.batch_overcommit,
-            },
+            "submit": self._submit_stats(),
+            "dispatch": self._dispatch_stats(),
             "seal": {
                 "batch_seals": self.store.batch_seals,
                 "batch_sealed_objects": self.store.batch_sealed_objects,
@@ -3689,6 +4221,50 @@ class Runtime:
             # observability (also exported as the
             # ray_tpu_sched_decisions_total /metrics family).
             "sched": self._sched_stats(),
+        }
+
+    def _submit_stats(self) -> dict:
+        """Submit-stage counters (SUBMIT_STAT_KEYS): the classic ring,
+        the columnar intake (ISSUE 15) and the cumulative flush wall
+        — flush latency derives as flush_wall_us over flushes."""
+        ring = self._submit_ring
+        return {
+            "ring_submits": ring.submits if ring else 0,
+            "flushes": ring.flushes if ring else 0,
+            "flush_tasks": ring.flush_tasks if ring else 0,
+            "ring_full_waits": ring.ring_full_waits if ring else 0,
+            "buffered_cancels": (ring.buffered_cancels if ring else 0)
+            + self._col_buffered_cancels,
+            "arg_cache_hits": self.arg_cache_hits,
+            "col_submits": self._col_submits,
+            "col_flush_tasks": self._col_flush_tasks,
+            "flush_wall_us": self._flush_wall_us,
+        }
+
+    def _dispatch_stats(self) -> dict:
+        """Dispatch-stage counters (DISPATCH_STAT_KEYS): classic batch
+        coalescing plus the sharded lanes' occupancy/throughput.
+        batch_tasks and batch_overcommit span BOTH engines (the
+        >4-tasks/RPC invariant is engine-agnostic)."""
+        lanes = self._lanes
+        lane_stats = lanes.stats() if lanes is not None else {}
+        return {
+            "batches": self.dispatcher.batches_launched,
+            "batch_tasks": self.dispatcher.batch_tasks_launched
+            + lane_stats.get("lane_tasks", 0),
+            "singles": self.dispatcher.singles_launched,
+            "batch_overcommit": self.dispatcher.batch_overcommit
+            + lane_stats.get("lane_overcommits", 0),
+            # Deadline-heap sweeps that actually ran (the zero-armed
+            # fast path skips them outright).
+            "deadline_sweeps": self.dispatcher.deadline_sweeps,
+            "lanes": lane_stats.get("lanes", 0),
+            "lane_dispatches": lane_stats.get("lane_dispatches", 0),
+            "lane_tasks": lane_stats.get("lane_tasks", 0),
+            "lane_busy_us": lane_stats.get("lane_busy_us", 0),
+            "lane_overcommits": lane_stats.get("lane_overcommits", 0),
+            "col_groups": lane_stats.get("col_groups", 0),
+            "lane_outstanding": lane_stats.get("lane_outstanding", 0),
         }
 
     def _fused_stats(self) -> dict:
@@ -4012,6 +4588,8 @@ class Runtime:
             # buffered records seal TaskCancelledError immediately,
             # draining ones via the flush's post-pass.
             return
+        if self._lanes is not None and self._cancel_columnar(ref.id()):
+            return
         self._cancel_registered(ref.id())
 
     def free(self, refs: Sequence[ObjectRef]) -> None:
@@ -4036,7 +4614,8 @@ class Runtime:
         with self._futures_lock:
             if not self.store.contains(ref.id()) and (
                     self.store.is_pending(ref.id())
-                    or (ring is not None and ring.holds(ref.id()))):
+                    or (ring is not None and ring.holds(ref.id()))
+                    or ref.id() in self._col_index):
                 # A ring-buffered submit has no store entry yet but IS
                 # pending — its flush creates the entry and the seal
                 # listener resolves the future.
@@ -4078,6 +4657,8 @@ class Runtime:
             # and retire the submitter before the planes below close.
             ring, self._submit_ring = self._submit_ring, None
             ring.stop()
+        if self._lanes is not None:
+            self._lanes.shutdown()
         self._watcher_stop.set()
         with self._remote_nodes_lock:
             handles = list(self._remote_nodes.values())

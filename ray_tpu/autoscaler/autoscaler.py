@@ -123,6 +123,11 @@ class StandardAutoscaler:
 
     def _collect_demands(self) -> list[dict[str, float]]:
         demands = list(self._runtime.dispatcher.pending_demands())
+        lanes = getattr(self._runtime, "_lanes", None)
+        if lanes is not None:
+            # Columnar groups queued on the dispatch lanes are demand
+            # too (ISSUE 15) — the autoscaler must see them.
+            demands.extend(lanes.queued_demands())
         for pg in self._runtime.placement_groups.snapshot():
             if pg["state"] == "PENDING":
                 demands.extend(dict(b["resources"]) for b in pg["bundles"])

@@ -9,7 +9,9 @@ from __future__ import annotations
 import functools
 from typing import Any, Callable
 
+from ray_tpu._private import dispatch_lanes
 from ray_tpu._private import worker as worker_mod
+from ray_tpu._private.config import GLOBAL_CONFIG
 from ray_tpu._private.task import SchedulingStrategy, normalize_resources
 
 _VALID_OPTIONS = {
@@ -80,6 +82,23 @@ class RemoteFunction:
             runtime_env=opts.get("runtime_env"),
             deadline_s=opts.get("_deadline_s"),
         )
+        # Columnar submit template (ISSUE 15): frozen once per
+        # RemoteFunction for DEFAULT-strategy, single-return,
+        # env-free, deadline-free, non-TPU functions — the sharded
+        # dispatch fast path slices per-call columns off it instead of
+        # building a TaskSpec per submit. None = never eligible.
+        self._col_template = None
+        ck = self._call_kwargs
+        strategy = ck["scheduling_strategy"]
+        if (ck["num_returns"] == 1 and ck["runtime_env"] is None
+                and ck["deadline_s"] is None
+                and strategy.kind == "DEFAULT"
+                and getattr(strategy, "placement_group", None) is None
+                and not any(k.startswith("TPU")
+                            for k in ck["resources"])):
+            self._col_template = dispatch_lanes.ColumnarTemplate(
+                func, ck["name"], ck["resources"], ck["max_retries"],
+                ck["retry_exceptions"], strategy)
         functools.update_wrapper(self, func)
 
     def __call__(self, *args, **kwargs):
@@ -105,6 +124,17 @@ class RemoteFunction:
         result within the budget or its refs raise TaskTimeoutError —
         checked at every pipeline stage, never executed once dead."""
         runtime = worker_mod.auto_init()
+        template = self._col_template
+        if (template is not None and _deadline_s is None and not kwargs
+                and dispatch_lanes.SHARD_ON
+                and runtime.__class__ is worker_mod.Runtime
+                and not GLOBAL_CONFIG.peek("task_default_deadline_s")):
+            # Columnar fast path: one buffer append instead of a
+            # _SubmitRecord + ring push; falls through (None) for
+            # ineligible args or when the lanes aren't running.
+            ref = runtime.submit_columnar(template, args)
+            if ref is not None:
+                return ref
         call_kwargs = self._call_kwargs
         if _deadline_s is not None:
             call_kwargs = {**call_kwargs, "deadline_s": _deadline_s}

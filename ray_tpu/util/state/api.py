@@ -180,6 +180,7 @@ def summarize_tasks() -> dict:
             "p95_s": _percentile(vals, 0.95),
             "p99_s": _percentile(vals, 0.99),
         }
+    pipeline = runtime.execution_pipeline_stats()
     return {"node_count": len(list_nodes(limit=10**9)),
             "summary": summary,
             "latency": latency,
@@ -187,7 +188,13 @@ def summarize_tasks() -> dict:
             # Placement/load table + scheduler decision counters: the
             # default `ray_tpu summary` view shows WHERE work landed
             # and why (locality hits, load spillbacks, speculation).
-            "placement": summarize_placement()}
+            "placement": summarize_placement(),
+            # Driver submit/dispatch hot-path counters (ISSUE 15):
+            # ring + columnar intake, flush latency, lane occupancy —
+            # the same groups /metrics exports as ray_tpu_node_submit
+            # / ray_tpu_node_dispatch.
+            "pipeline": {"submit": pipeline.get("submit", {}),
+                         "dispatch": pipeline.get("dispatch", {})}}
 
 
 # ------------------------------------------------------------------ actors

@@ -440,23 +440,31 @@ def _wait_for(predicate, timeout_s: float, what: str) -> None:
     raise TimeoutError(f"timed out waiting for {what}")
 
 
-def test_daemon_sigkill_mid_batch_requeues_unstarted(tmp_path):
+def test_daemon_sigkill_mid_batch_requeues_unstarted(tmp_path,
+                                                     monkeypatch):
     """SIGKILL a daemon holding an in-flight execute_task_batch:
     entries whose frames never reached a worker requeue INVISIBLY (no
     retry budget consumed, batch_requeues counts them); the one
     maybe-started entry retries under the system-failure budget; every
     result arrives exactly once on the replacement node."""
+    from ray_tpu._private import dispatch_lanes
+    from ray_tpu._private.config import GLOBAL_CONFIG
     from ray_tpu.cluster_utils import Cluster
     from ray_tpu.util.scheduling_strategies import (
         NodeAffinitySchedulingStrategy,  # noqa: F401 — doc pointer
     )
 
+    # Fused AND sharded dispatch off: this test guards the CLASSIC
+    # batch path's WORKER-PIPE death accounting (per-frame started
+    # marks, invisible requeue of unsent frames, blocker-then-victims
+    # dispatch order within one flush); the fused/columnar paths have
+    # their own exactly-once tests (test_daemon_sigkill_mid_fused_...
+    # and tests/test_sharded_dispatch.py).
+    monkeypatch.setenv("RAY_TPU_DRIVER_SHARDED_DISPATCH", "0")
+    GLOBAL_CONFIG.reset()
+    dispatch_lanes.init_from_config()
     ray_tpu.shutdown()
     cluster = Cluster(log_dir=str(tmp_path / "cluster"))
-    # Fused off: this test guards the WORKER-PIPE death accounting
-    # (per-frame started marks, invisible requeue of unsent frames);
-    # the fused path announces in windows and has its own exactly-once
-    # test (test_daemon_sigkill_mid_fused_run_exactly_once).
     cluster.add_node(num_cpus=8, resources={"vic": 100.0}, pool_size=1,
                      heartbeat_period_s=0.5,
                      env={"RAY_TPU_WORKER_PIPELINE_DEPTH": "1",
@@ -527,9 +535,14 @@ def test_daemon_sigkill_mid_batch_requeues_unstarted(tmp_path):
         if runtime is not None:
             ray_tpu.shutdown()
         cluster.shutdown()
+        monkeypatch.delenv("RAY_TPU_DRIVER_SHARDED_DISPATCH",
+                           raising=False)
+        GLOBAL_CONFIG.reset()
+        dispatch_lanes.init_from_config()
 
 
-def test_daemon_sigkill_mid_fused_run_exactly_once(tmp_path):
+def test_daemon_sigkill_mid_fused_run_exactly_once(tmp_path,
+                                                   monkeypatch):
     """SIGKILL the daemon while a FUSED run is executing on its
     dispatch thread (ISSUE 11): entries the run never reached requeue
     invisibly and execute exactly once on the replacement node;
@@ -538,9 +551,19 @@ def test_daemon_sigkill_mid_fused_run_exactly_once(tmp_path):
     budget — at most one extra execution, never a lost or double-sealed
     result. Marker files carry the executing pid, which doubles as
     proof the run really was in-daemon (victim markers bear the daemon
-    pid)."""
+    pid).
+
+    Sharded dispatch is pinned OFF: this test guards the CLASSIC
+    batch path's per-8 started windows, which the columnar wire's
+    wider windows would cover entirely at this task count — the
+    columnar equivalent lives in tests/test_sharded_dispatch.py."""
+    from ray_tpu._private import dispatch_lanes
+    from ray_tpu._private.config import GLOBAL_CONFIG
     from ray_tpu.cluster_utils import Cluster
 
+    monkeypatch.setenv("RAY_TPU_DRIVER_SHARDED_DISPATCH", "0")
+    GLOBAL_CONFIG.reset()
+    dispatch_lanes.init_from_config()
     ray_tpu.shutdown()
     cluster = Cluster(log_dir=str(tmp_path / "cluster"))
     # A generous wall budget keeps the WHOLE run fused (no worker-path
@@ -615,6 +638,10 @@ def test_daemon_sigkill_mid_fused_run_exactly_once(tmp_path):
         if runtime is not None:
             ray_tpu.shutdown()
         cluster.shutdown()
+        monkeypatch.delenv("RAY_TPU_DRIVER_SHARDED_DISPATCH",
+                           raising=False)
+        GLOBAL_CONFIG.reset()
+        dispatch_lanes.init_from_config()
 
 
 # --------------------------------------------- overload-control under chaos
@@ -782,7 +809,9 @@ def test_daemon_sigkill_expired_in_queue_no_ghost_execution(tmp_path):
         marker_dir.mkdir()
 
         @ray_tpu.remote(num_cpus=8, resources={"vic": 1.0})
-        def blocker():
+        def blocker(mdir):
+            with open(f"{mdir}/blocker-started", "w"):
+                pass
             time.sleep(1.5)
             return "unblocked"
 
@@ -795,7 +824,14 @@ def test_daemon_sigkill_expired_in_queue_no_ghost_execution(tmp_path):
             time.sleep(5.0)
             return i
 
-        blocker_ref = blocker.remote()
+        blocker_ref = blocker.remote(str(marker_dir))
+        # The victims must queue BEHIND a running blocker: wait for it
+        # to actually start before submitting them (ISSUE 15: columnar
+        # and classic submits ride independent queues, so relative
+        # dispatch order across the two paths is not guaranteed —
+        # submission order alone no longer pins the blocker first).
+        _wait_for(lambda: os.path.exists(marker_dir / "blocker-started"),
+                  60, "blocker to start executing")
         refs = [victim.remote(i, str(marker_dir), _deadline_s=6.0)
                 for i in range(6)]
         assert ray_tpu.get(blocker_ref, timeout=60) == "unblocked"

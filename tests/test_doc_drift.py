@@ -79,6 +79,11 @@ EXPORTED_SERIES = (
     # hosting process — driver-local engines under node="driver",
     # daemon-hosted ones via the heartbeat "engine" stats group.
     "ray_tpu_node_engine",
+    # Sharded driver dispatch (ISSUE 15): submit-ring/columnar intake
+    # and lane-occupancy counters under node="driver"
+    # (SUBMIT_STAT_KEYS / DISPATCH_STAT_KEYS in worker.py).
+    "ray_tpu_node_submit",
+    "ray_tpu_node_dispatch",
 )
 
 
@@ -178,6 +183,51 @@ def test_submit_stage_counter_keys_documented(observability_text):
     assert not missing, (
         f"submit-stage counter keys missing from the README "
         f"Observability tables: {missing}")
+
+
+def test_sharded_dispatch_knobs_documented():
+    """ISSUE 15: the columnar/lane knobs must keep README rows in the
+    'Pipelined submission' knob table, and the decision table must
+    name the three submit paths."""
+    from ray_tpu._private.config import _DEFAULTS
+
+    assert "driver_sharded_dispatch" in _DEFAULTS
+    assert "dispatch_lanes" in _DEFAULTS
+    text = README.read_text()
+    for knob in ("driver_sharded_dispatch", "dispatch_lanes"):
+        assert f"`{knob}`" in text, (
+            f"sharded-dispatch knob {knob!r} missing from the README "
+            f"knob table")
+    # Decision-table / semantics phrases the section must keep.
+    for phrase in ("columnar records", "dispatch lanes",
+                   "classic submit ring", "acquire_batch",
+                   "started_many"):
+        assert phrase in text, (
+            f"'Pipelined submission' section lost the {phrase!r} "
+            f"semantics")
+
+
+def test_sharded_dispatch_counter_registries_documented():
+    """Every SUBMIT_STAT_KEYS / DISPATCH_STAT_KEYS registry key (read
+    through the analyzer's AST parser, like the other registries) must
+    keep a README row, and the registries must match what
+    execution_pipeline_stats() actually returns."""
+    SUBMIT_KEYS = registry_keys("worker", "SUBMIT_STAT_KEYS")
+    DISPATCH_KEYS = registry_keys("worker", "DISPATCH_STAT_KEYS")
+    assert SUBMIT_KEYS and DISPATCH_KEYS
+    text = README.read_text()
+    missing = [k for k in SUBMIT_KEYS + DISPATCH_KEYS
+               if f"`{k}`" not in text]
+    assert not missing, (
+        f"submit/dispatch counter keys missing from the README: "
+        f"{missing}")
+    from ray_tpu._private.worker import (
+        DISPATCH_STAT_KEYS,
+        SUBMIT_STAT_KEYS,
+    )
+
+    assert tuple(SUBMIT_KEYS) == SUBMIT_STAT_KEYS
+    assert tuple(DISPATCH_KEYS) == DISPATCH_STAT_KEYS
 
 
 def test_overload_knobs_documented():

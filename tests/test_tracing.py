@@ -34,8 +34,13 @@ def traced_cluster(traced):
     execute path with a trace context on the wire."""
     ray_tpu.shutdown()
     cluster = Cluster(log_dir="/tmp/ray_tpu_test_tracing")
+    # Fused off: these tests assert the FULL stage chain including the
+    # worker hop (worker_start + worker-lane spans), which in-daemon
+    # fused runs legitimately skip — whether a burst fuses entirely
+    # depends on flush/batch shapes, which made the assertions flaky.
     cluster.add_node(num_cpus=2,
-                     env={"RAY_TPU_TRACING_ENABLED": "1"})
+                     env={"RAY_TPU_TRACING_ENABLED": "1",
+                          "RAY_TPU_FUSED_EXECUTION": "0"})
     try:
         assert cluster.wait_for_nodes(1, timeout=60), \
             "worker daemon never registered"
