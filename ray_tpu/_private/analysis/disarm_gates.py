@@ -45,6 +45,7 @@ KNOB_GATES: "dict[str, tuple[str, str]]" = {
                                 "SHARD_ON"),
     "llm_paged_engine": ("ray_tpu/serve/llm_engine/engine.py",
                          "PAGED_ON"),
+    "gcs_shards": ("ray_tpu/_private/gcs_shard.py", "SHARDS_ON"),
     "chaos": ("ray_tpu/_private/chaos.py", "ACTIVE"),
 }
 

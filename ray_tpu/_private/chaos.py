@@ -39,6 +39,14 @@ Injection sites (the site string is the contract; counters surface in
 - ``gcs.torn_wal``      head persistence: write a WAL record's payload
   short under a full-length header (the SIGKILL-mid-append shape) —
   restart must truncate the torn tail and replay everything before it
+- ``gcs.shard_die``     head shard plane (gcs_shards>1): crash-restart
+  the shard owning the in-flight mutation mid-call — it replays only
+  ITS WAL, mints its next epoch (the advertised epoch bumps, so the
+  stale writer is fenced typed), and the other shards keep serving
+- ``gcs.shard_stall``   head shard plane: wedge the owning shard for
+  ``RAY_TPU_SHARD_STALL_S`` base seconds x a seeded 0.5-1.5 jitter —
+  reads serve its stale view (age_s exposed), writes queue WAL-first
+  and shed SystemOverloadedError typed past the bounded cap
 - ``heartbeat.skip``  node agent: skip one heartbeat period
 - ``daemon.die``      node agent: SIGKILL its own daemon process
 - ``lease.expire``    same-host LeaseTable: expire a lease early
@@ -89,6 +97,8 @@ SITES: "tuple[str, ...]" = (
     "net.partition",
     "gcs.torn_snapshot",
     "gcs.torn_wal",
+    "gcs.shard_die",
+    "gcs.shard_stall",
     "heartbeat.skip",
     "daemon.die",
     "lease.expire",

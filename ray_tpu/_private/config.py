@@ -279,6 +279,21 @@ _DEFAULTS: dict[str, Any] = {
     # daemon or lingering old head can never double-register a node,
     # resurrect a dead actor, or corrupt the object directory.
     "gcs_epoch_fencing": True,
+    # Sharded hot tables (gcs_shard.py): split the head's object
+    # directory, task events and node-stats/stage-latency aggregation
+    # across N in-head shard domains — each with its own lock domain,
+    # RGW1 WAL + snapshot segment and persisted incarnation epoch, so
+    # one shard crash-restarts (replaying only its WAL, fencing its
+    # stale writers typed) while the others keep serving. Default 1
+    # keeps the PR 12 single-WAL layout byte-identically; changing the
+    # count over an existing layout is refused typed (ReshardError),
+    # never silently misrouted.
+    "gcs_shards": 1,
+    # Degraded mode: writes to a stalled/partitioned shard are
+    # WAL-durable immediately and queue for in-memory apply until the
+    # shard heals; past this cap they shed typed
+    # (SystemOverloadedError) instead of queueing unboundedly.
+    "gcs_shard_max_queued_writes": 512,
     # LLM inference engine (serve/llm_engine): paged KV-cache
     # continuous batching with prefill/decode scheduling. Disarmed
     # (llm_paged_engine=0), LLMEngineServer falls back to the legacy

@@ -33,11 +33,13 @@ spill tier's lifecycle (``spill.spill`` / ``spill.restore`` /
 ``spill.evict`` / ``spill.torn`` / ``spill.disk_full`` /
 ``spill.orphan_sweep`` — spill_manager.py), and the durable control
 plane (``gcs.restore`` / ``gcs.torn_snapshot`` / ``gcs.persist_error``
-/ ``gcs.fenced_write`` head-side; ``epoch.bump`` /
-``heartbeat.stale_epoch`` / ``gcs.stale_epoch`` on daemons and
-drivers re-syncing across a head restart), so a post-mortem shows
-what the disk tier and the head's recovery were doing when the
-process died.
+/ ``gcs.fenced_write`` head-side; ``gcs.shard_restore`` /
+``gcs.shard_fenced_write`` / ``gcs.shard_backoff`` on a sharded
+head's failover/degraded paths; ``epoch.bump`` /
+``heartbeat.stale_epoch`` / ``gcs.stale_epoch`` / ``heartbeat.shed``
+on daemons and drivers re-syncing across a head or shard restart), so
+a post-mortem shows what the disk tier and the head's recovery were
+doing when the process died.
 """
 
 from __future__ import annotations

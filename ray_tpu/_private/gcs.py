@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import threading
 
-from ray_tpu._private import lock_witness
+from ray_tpu._private import gcs_shard, lock_witness
 import time
 from collections import defaultdict
 from dataclasses import dataclass, field
@@ -470,8 +470,9 @@ class GlobalControlService:
         # Events silently refused at the cap used to vanish untraceably;
         # the counter surfaces as ray_tpu_task_events_dropped_total in
         # /metrics (reference: gcs_task_manager's dropped-task-attempts
-        # accounting).
-        self.task_events_dropped = 0
+        # accounting). Sharded, each task-event domain keeps its own
+        # counter; the task_events_dropped property sums them.
+        self._events_dropped = 0
         # Per-node executor stats pushed on heartbeats (pipeline /
         # data_plane / faults), served to drivers as labeled /metrics
         # series — the GCS-side aggregation table. Values are
@@ -480,6 +481,21 @@ class GlobalControlService:
         self._node_stats: dict[str, tuple] = {}
         self._node_stats_lock = lock_witness.Lock(
             "gcs.GlobalControlService.node_stats")
+        # Sharded hot-table domains (gcs_shard.py): armed, the
+        # heartbeat-piggybacked node stats and the task-event tables
+        # split across per-shard lock domains — record_node_stats and
+        # event flushes land on the owning shard without a global-lock
+        # pass. Disarmed (gcs_shards=1) the single-lock tables above
+        # serve byte-identically and none of this is constructed.
+        self._stats_shards = None
+        self._task_shards = None
+        if gcs_shard.SHARDS_ON:
+            n = gcs_shard.shard_count()
+            self._stats_shards = [gcs_shard.NodeStatsShard(i)
+                                  for i in range(n)]
+            per_limit = max(1, self._task_event_limit // n)
+            self._task_shards = [gcs_shard.TaskEventShard(i, per_limit)
+                                 for i in range(n)]
 
     # ----------------------------------------------------------- persistence
 
@@ -764,12 +780,56 @@ class GlobalControlService:
 
     # ----------------------------------------------------------- task events
 
+    @property
+    def task_events_dropped(self) -> int:
+        """Events refused at the cap (sums the per-shard counters when
+        the task-event table is sharded)."""
+        if self._task_shards is None:
+            return self._events_dropped
+        return self._events_dropped + sum(
+            dom.dropped for dom in self._task_shards)
+
+    def _task_domain(self, task_id: TaskID):
+        shards = self._task_shards
+        return shards[gcs_shard.shard_of(task_id.hex(), len(shards))]
+
+    @staticmethod
+    def _record_one_shard(dom, event: TaskEvent) -> None:
+        # Caller holds dom.lock — per-shard mirror of
+        # _record_one_locked against the shard's slice of the cap.
+        if len(dom.events) + dom.group_entries >= dom.limit \
+                and event.task_id not in dom.events:
+            dom.dropped += 1
+            return
+        prior = dom.events.get(event.task_id)
+        if prior is not None and prior.stage_ts:
+            merged = dict(prior.stage_ts)
+            merged.update(event.stage_ts)
+            event.stage_ts = merged
+        dom.events[event.task_id] = event
+
+    def crash_shard(self, index: int) -> None:
+        """One shard domain crashed (gcs.shard_die): its volatile
+        slices — node stats and task events — die with it, exactly as
+        a real shard process loss would; heartbeats and the next event
+        flushes repopulate them."""
+        if self._stats_shards is not None:
+            dom = self._stats_shards[index]
+            with dom.lock:
+                dom.rows.clear()
+        if self._task_shards is not None:
+            dom = self._task_shards[index]
+            with dom.lock:
+                dom.events.clear()
+                dom.groups.clear()
+                dom.group_entries = 0
+
     def _record_one_locked(self, event: TaskEvent) -> None:
         # Caller holds self._lock.
         if len(self._task_events) + self._group_event_entries \
                 >= self._task_event_limit \
                 and event.task_id not in self._task_events:
-            self.task_events_dropped += 1
+            self._events_dropped += 1
             return
         prior = self._task_events.get(event.task_id)
         if prior is not None and prior.stage_ts:
@@ -782,6 +842,11 @@ class GlobalControlService:
         self._task_events[event.task_id] = event
 
     def record_task_event(self, event: TaskEvent) -> None:
+        if self._task_shards is not None:
+            dom = self._task_domain(event.task_id)
+            with dom.lock:
+                self._record_one_shard(dom, event)
+            return
         with self._lock:
             self._record_one_locked(event)
 
@@ -789,7 +854,18 @@ class GlobalControlService:
         """Coalesced state recording: one lock pass for a whole batch
         of task transitions (the pipelined execute path records a
         dispatch batch's RUNNING — and each completion group's
-        FINISHED — in a single call)."""
+        FINISHED — in a single call). Sharded: one lock pass per
+        OWNING shard instead."""
+        if self._task_shards is not None:
+            by: dict = {}
+            for event in events:
+                by.setdefault(self._task_domain(event.task_id),
+                              []).append(event)
+            for dom, batch in by.items():
+                with dom.lock:
+                    for event in batch:
+                        self._record_one_shard(dom, event)
+            return
         with self._lock:
             for event in events:
                 self._record_one_locked(event)
@@ -800,10 +876,29 @@ class GlobalControlService:
         and one bulk rid->group insert for a whole flush — no per-task
         TaskEvent allocation (ISSUE 15). Returns the group (None when
         the cap refused it, counted like per-task drops)."""
+        if self._task_shards is not None:
+            group = TaskEventGroup(task_ids, name)
+            by: dict = {}
+            for task_id in task_ids:
+                by.setdefault(self._task_domain(task_id),
+                              []).append(task_id)
+            refused = 0
+            for dom, ids in by.items():
+                with dom.lock:
+                    if len(dom.events) + dom.group_entries \
+                            + len(ids) > dom.limit:
+                        # This shard's slice of the cap refuses ITS
+                        # members; the rest of the flush still lands.
+                        dom.dropped += len(ids)
+                        refused += len(ids)
+                        continue
+                    dom.groups.update(dict.fromkeys(ids, group))
+                    dom.group_entries += len(ids)
+            return None if refused == len(task_ids) else group
         with self._lock:
             if len(self._task_events) + self._group_event_entries \
                     + len(task_ids) > self._task_event_limit:
-                self.task_events_dropped += len(task_ids)
+                self._events_dropped += len(task_ids)
                 return None
             group = TaskEventGroup(task_ids, name)
             self._task_groups.update(dict.fromkeys(task_ids, group))
@@ -813,7 +908,14 @@ class GlobalControlService:
     def record_task_group_finished(self, group: "TaskEventGroup",
                                    n: int) -> None:
         """Completion fast path: one counter bump per sealed reply
-        group instead of a FINISHED TaskEvent per task."""
+        group instead of a FINISHED TaskEvent per task. Sharded, the
+        bump lands under the group's HOME shard (its first member's
+        domain) — one stable lock, no cross-shard pass."""
+        if self._task_shards is not None:
+            dom = self._task_domain(group.task_ids[0])
+            with dom.lock:
+                group.finished += n
+            return
         with self._lock:
             group.finished += n
 
@@ -821,6 +923,13 @@ class GlobalControlService:
         """Fold late-arriving stage stamps (a reply's offset-corrected
         remote timestamps, the seal time) into an existing event."""
         if not stages:
+            return
+        if self._task_shards is not None:
+            dom = self._task_domain(task_id)
+            with dom.lock:
+                event = dom.events.get(task_id)
+                if event is not None:
+                    event.stage_ts.update(stages)
             return
         with self._lock:
             event = self._task_events.get(task_id)
@@ -836,18 +945,41 @@ class GlobalControlService:
         so ``node_stats()`` consumers (the load-aware scheduler above
         all) can decay its last report out of their scores instead of
         treating the frozen snapshot as a live idle signal."""
+        if self._stats_shards is not None:
+            dom = self._stats_domain(node_hex)
+            with dom.lock:
+                dom.rows[node_hex] = (stats, time.monotonic())
+            return
         with self._node_stats_lock:
             self._node_stats[node_hex] = (stats, time.monotonic())
 
+    def _stats_domain(self, node_hex: str):
+        shards = self._stats_shards
+        return shards[gcs_shard.shard_of(node_hex, len(shards))]
+
     def drop_node_stats(self, node_hex: str) -> None:
+        if self._stats_shards is not None:
+            dom = self._stats_domain(node_hex)
+            with dom.lock:
+                dom.rows.pop(node_hex, None)
+            return
         with self._node_stats_lock:
             self._node_stats.pop(node_hex, None)
 
     def node_stats(self) -> dict:
         """{node hex -> last pushed executor stats snapshot}, each
         carrying ``age_s`` — seconds since the snapshot's heartbeat
-        arrived (receipt clock, monotonic)."""
+        arrived (receipt clock, monotonic). Sharded: merged across
+        every stats domain."""
         now = time.monotonic()
+        if self._stats_shards is not None:
+            out: dict = {}
+            for dom in self._stats_shards:
+                with dom.lock:
+                    for node_hex, (stats, at) in dom.rows.items():
+                        out[node_hex] = {**stats,
+                                         "age_s": round(now - at, 3)}
+            return out
         with self._node_stats_lock:
             return {node_hex: {**stats, "age_s": round(now - at, 3)}
                     for node_hex, (stats, at)
@@ -862,10 +994,21 @@ class GlobalControlService:
         from ray_tpu._private import perf_plane
 
         merged: dict[str, dict] = {}
-        with self._node_stats_lock:
-            tables = [stats.get("stage_hist")
-                      for stats, _at in self._node_stats.values()
-                      if isinstance(stats, dict)]
+        if self._stats_shards is not None:
+            # Merge across shards: each domain contributes its slice
+            # under its own lock, the bucket addition runs lock-free.
+            tables = []
+            for dom in self._stats_shards:
+                with dom.lock:
+                    tables.extend(
+                        stats.get("stage_hist")
+                        for stats, _at in dom.rows.values()
+                        if isinstance(stats, dict))
+        else:
+            with self._node_stats_lock:
+                tables = [stats.get("stage_hist")
+                          for stats, _at in self._node_stats.values()
+                          if isinstance(stats, dict)]
         for table in tables:
             if not isinstance(table, dict):
                 continue
@@ -876,6 +1019,16 @@ class GlobalControlService:
         return merged
 
     def get_task_event(self, task_id: TaskID) -> TaskEvent | None:
+        if self._task_shards is not None:
+            dom = self._task_domain(task_id)
+            with dom.lock:
+                event = dom.events.get(task_id)
+                if event is not None:
+                    return event
+                group = dom.groups.get(task_id)
+                if group is not None:
+                    return group.synthesize(task_id)
+                return None
         with self._lock:
             event = self._task_events.get(task_id)
             if event is not None:
@@ -888,6 +1041,15 @@ class GlobalControlService:
             return None
 
     def list_task_events(self) -> list[TaskEvent]:
+        if self._task_shards is not None:
+            out: list[TaskEvent] = []
+            for dom in self._task_shards:
+                with dom.lock:
+                    out.extend(dom.events.values())
+                    for task_id, group in dom.groups.items():
+                        if task_id not in dom.events:
+                            out.append(group.synthesize(task_id))
+            return out
         with self._lock:
             out = list(self._task_events.values())
             if self._task_groups:

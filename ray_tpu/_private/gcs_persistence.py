@@ -64,6 +64,25 @@ class LegacySnapshotError(Exception):
     loader."""
 
 
+class ReshardError(Exception):
+    """The persisted GCS layout on disk was written under a different
+    ``gcs_shards`` count than the one configured now. The stable
+    router's ring changed, so loading these segments would silently
+    misroute restored entries — refuse typed at restore instead.
+    Recovery: restart with the recorded count (then drain), or point
+    the head at a fresh persist path."""
+
+    def __init__(self, recorded, configured):
+        super().__init__(
+            f"persisted GCS layout has gcs_shards={recorded} but "
+            f"gcs_shards={configured} is configured — resharding an "
+            f"existing layout is refused (would misroute restored "
+            f"entries); restart with gcs_shards={recorded} or use a "
+            f"fresh persist path")
+        self.recorded = recorded
+        self.configured = configured
+
+
 # ----------------------------------------------------------------- snapshots
 
 

@@ -17,7 +17,7 @@ import os
 import subprocess
 import threading
 
-from ray_tpu._private import lock_witness
+from ray_tpu._private import gcs_shard, lock_witness
 import time
 from typing import Any
 
@@ -196,6 +196,12 @@ class GcsServer:
             from ray_tpu._private.gcs_kv_native import make_kv_store
 
             kv = make_kv_store()
+        # Sharded hot tables (gcs_shard.py): arm the gate BEFORE the
+        # control service constructs its table domains — node stats
+        # and task events shard inside GlobalControlService, the
+        # object directory shards here behind _shards.
+        self._shard_count = gcs_shard.init_from_config()
+        self._shards = None
         self.gcs = GlobalControlService(kv=kv)
         self.jobs = JobManager(self.gcs, os.path.join(log_dir, "jobs"))
         self.heartbeat_timeout_s = heartbeat_timeout_s
@@ -216,6 +222,7 @@ class GcsServer:
         self._fencing = self._persist_armed and bool(
             GLOBAL_CONFIG.gcs_epoch_fencing)
         self.epoch = 0
+        self._base_epoch = 0
         self._wal = None
         self._wal_seq = 0
         self._persist_lock = lock_witness.Lock("gcs_server.GcsServer.persist")
@@ -241,11 +248,27 @@ class GcsServer:
         self._pg_version = 0
         self._pg_lock = lock_witness.Lock("gcs_server.GcsServer.pg")
         if persist_path and self._persist_armed:
+            import glob as glob_mod
+
             from ray_tpu._private import gcs_persistence as gp
 
+            if self._shard_count == 1 \
+                    and glob_mod.glob(persist_path + ".shard*"):
+                # Per-shard segments on disk but a single-shard config:
+                # their directory entries would be silently ignored.
+                raise gp.ReshardError("2+", self._shard_count)
             self.epoch = gp.mint_epoch(os.path.join(
                 os.path.dirname(persist_path) or ".", "gcs_epoch"))
+            self._base_epoch = self.epoch
             self._restore_full()
+            if self._shard_count > 1 and (
+                    self.object_directory.locations()
+                    or self.object_directory.spilled()):
+                # Directory entries came out of the single-WAL layout:
+                # it was written with gcs_shards=1 (a snapshot records
+                # the count explicitly; a WAL-only layout shows up
+                # here).
+                raise gp.ReshardError(1, self._shard_count)
             try:
                 self._wal = gp.WalWriter(
                     persist_path + ".wal",
@@ -257,6 +280,40 @@ class GcsServer:
             # order).
             self.gcs.wal_emit = self._wal_append
             self.object_directory.wal_emit = self._wal_append
+            if self._shard_count > 1:
+                # Tentpole: the object directory splits across N shard
+                # domains, each with its own lock domain, WAL+snapshot
+                # segment and persisted incarnation epoch, so one
+                # shard crash-restarts (replaying only ITS WAL) while
+                # the rest keep serving.
+                import re as re_mod
+
+                seen = set()
+                for seg in glob_mod.glob(persist_path + ".shard*"):
+                    m = re_mod.match(r".*\.shard(\d+)", seg)
+                    if m is not None:
+                        seen.add(int(m.group(1)))
+                if seen and seen != set(range(self._shard_count)):
+                    # Segment indices disagree with the configured
+                    # ring: a shrink would silently orphan entries, a
+                    # growth would misroute removes — refused even for
+                    # a WAL-only layout no snapshot stamped. max+1 is
+                    # exact: every shard of the old ring opened its
+                    # WAL at boot.
+                    raise gp.ReshardError(
+                        max(seen) + 1, self._shard_count)
+                queue_cap = int(
+                    GLOBAL_CONFIG.gcs_shard_max_queued_writes)
+                self._shards = [
+                    gcs_shard.ShardState(
+                        i, self._shard_count, persist_path,
+                        fsync=bool(GLOBAL_CONFIG.gcs_wal_fsync),
+                        queue_cap=queue_cap)
+                    for i in range(self._shard_count)]
+                for shard in self._shards:
+                    shard.on_persist_error = self._count_persist_error
+                    shard.boot()
+                self._refresh_epoch()
         elif persist_path:
             self._restore_snapshot()
         self._server = RpcServer(host, port)
@@ -338,6 +395,10 @@ class GcsServer:
         # Epoch fencing + persistence observability.
         s.register("gcs_epoch", lambda: self.epoch)
         s.register("gcs_persist_stats", self.persist_stats)
+        # Shard plane: per-shard stats rows for /metrics, plus the
+        # deterministic kill seam the soak/bench drive failover with.
+        s.register("gcs_shard_stats", self.shard_stats)
+        s.register("gcs_kill_shard", self._kill_shard)
         # Cluster-wide pub/sub channels (reference: the GCS pubsub
         # handler over src/ray/pubsub/publisher.h:307). Polls block, so
         # they dispatch concurrently like task execution does.
@@ -357,7 +418,19 @@ class GcsServer:
         node's locations)."""
         kind, node_id = event
         if kind == "DEAD":
-            orphaned = self.object_directory.prune_node(node_id.hex())
+            if self._shards is not None:
+                orphaned = []
+                for shard in self._shards:
+                    # Degraded shards queue the prune (their orphan
+                    # verdicts arrive at heal through lineage's normal
+                    # holder-miss path instead of this push).
+                    result = self._shard_apply(
+                        shard, ("dir_prune_node", node_id.hex()),
+                        None, "prune_node")
+                    orphaned.extend(result or [])
+            else:
+                orphaned = self.object_directory.prune_node(
+                    node_id.hex())
             if orphaned:
                 self.pubsub.publish("object_loss", orphaned)
         self.pubsub.publish("nodes", (kind, node_id.hex()))
@@ -410,13 +483,16 @@ class GcsServer:
             events = stats.pop("spill_events", None)
             if events:
                 node_hex = node_id_bytes.hex()
-                for owner, obj_hex, kind in events:
-                    if kind == "spilled":
-                        self.object_directory.mark_spilled(
-                            owner, obj_hex, node_hex)
-                    else:
-                        self.object_directory.clear_spilled(
-                            owner, obj_hex)
+                if self._shards is not None:
+                    self._route_spill_events(events, node_hex, epoch)
+                else:
+                    for owner, obj_hex, kind in events:
+                        if kind == "spilled":
+                            self.object_directory.mark_spilled(
+                                owner, obj_hex, node_hex)
+                        else:
+                            self.object_directory.clear_spilled(
+                                owner, obj_hex)
             # Executor-stats piggyback: the GCS-side aggregation table
             # drivers scrape into per-node /metrics series.
             self.gcs.record_node_stats(node_id_bytes.hex(), stats)
@@ -485,6 +561,9 @@ class GcsServer:
         rejected typed — it re-syncs and FULL-republishes, so an old
         incarnation's deltas can never interleave into (and corrupt)
         the restored directory."""
+        if self._shards is not None:
+            return self._sharded_locations_update(
+                owner, adds, removes, epoch)
         self._check_epoch(epoch, "object_locations_update")
         return self.object_directory.update(owner, adds, removes)
 
@@ -493,12 +572,32 @@ class GcsServer:
         """Holder table, optionally paired with the spilled-location
         view (``include_spilled``): consumers like the locality scorer
         discount holders whose only copy is on disk."""
+        if self._shards is not None:
+            # Reads never block on a wedged domain: a stalled shard's
+            # in-memory view IS the stale-marked snapshot (its queued
+            # writes are unapplied), served as-is with the staleness
+            # age exposed as age_s in its shard_stats row.
+            locations: dict = {}
+            spilled: dict = {}
+            for shard in self._shards:
+                locations.update(shard.directory.locations(owner))
+                if include_spilled:
+                    spilled.update(shard.directory.spilled(owner))
+            if not include_spilled:
+                return locations
+            return (locations, spilled)
         locations = self.object_directory.locations(owner)
         if not include_spilled:
             return locations
         return (locations, self.object_directory.spilled(owner))
 
     def _prune_object_locations(self, ttl_s: float = 60.0) -> None:
+        if self._shards is not None:
+            for shard in self._shards:
+                with shard.lock:
+                    if not shard._stall_active_locked():
+                        shard.directory.prune(ttl_s)
+            return
         self.object_directory.prune(ttl_s)
 
     # -- cluster actor / placement-group mirrors ----------------------
@@ -540,12 +639,15 @@ class GcsServer:
                     for owner, records in self._pg_table.items()}
 
     # -- epoch fencing ------------------------------------------------
-    def _check_epoch(self, epoch: int | None, site: str) -> None:
+    def _check_epoch(self, epoch: int | None, site: str,
+                     shard=None) -> None:
         """Reject a write stamped with a previous incarnation's epoch.
         ``epoch=None`` (a writer that has not yet learned any epoch —
         first contact, or a fencing-disarmed cluster) passes: fencing
         exists to catch writers that KNOW a stale incarnation, not to
-        lock out bootstrapping ones."""
+        lock out bootstrapping ones. ``shard``: the fence fired on a
+        shard-routed write (a shard restart bumped the advertised
+        epoch) — counted on that shard's row too."""
         if epoch is None or not self._fencing or epoch == self.epoch:
             return
         from ray_tpu._private import flight_recorder
@@ -553,8 +655,128 @@ class GcsServer:
 
         with self._persist_lock:
             self._persist_stats["fenced_writes"] += 1
+        if shard is not None:
+            with shard.lock:
+                shard.fenced_writes += 1
+            flight_recorder.record("gcs.shard_fenced_write",
+                                   shard.index, site, epoch)
         flight_recorder.record("gcs.fenced_write", site, epoch)
         raise StaleEpochError(self.epoch, epoch)
+
+    # -- shard routing ------------------------------------------------
+    def _refresh_epoch(self) -> None:
+        # Advertised epoch = persisted head base + sum of shard epochs:
+        # monotonic (every component is a persisted monotonic counter)
+        # and it bumps when the head OR any one shard restarts — so
+        # the existing StaleEpochError fencing and reply-meta re-sync
+        # machinery cover shard failover unchanged.
+        self.epoch = self._base_epoch + sum(
+            shard.epoch for shard in self._shards)
+
+    def _shard_apply(self, shard, op: tuple, epoch: int | None,
+                     site: str):
+        """Every shard-routed durable mutation funnels here: chaos
+        (gcs.shard_die / gcs.shard_stall) draws mid-mutation, the
+        epoch fence runs against the CURRENT advertised epoch (a shard
+        restart just bumped it, so the in-flight stale writer is
+        rejected typed), then the op applies under the shard's lock
+        domain — or queues WAL-first in degraded mode."""
+        from ray_tpu._private import chaos
+
+        ctl = chaos.ACTIVE
+        if ctl is not None:
+            if ctl.should("gcs.shard_die"):
+                shard.crash_restart("chaos")
+                self.gcs.crash_shard(shard.index)
+                self._refresh_epoch()
+            elif ctl.should("gcs.shard_stall"):
+                base = float(os.environ.get(
+                    "RAY_TPU_SHARD_STALL_S", "2.0"))
+                shard.stall(base * (0.5 + ctl.uniform()))
+        self._check_epoch(epoch, site, shard=shard)
+        with shard.lock:
+            if shard._stall_active_locked():
+                if op[0] == "dir_update" and not op[2] and not op[3]:
+                    return None  # keepalive: nothing durable to queue
+                shard.enqueue_locked(op)
+                return None
+            return gcs_shard.apply_dir_op(shard.directory, op)
+
+    def _sharded_locations_update(self, owner: str, adds: list,
+                                  removes: list,
+                                  epoch: int | None) -> int:
+        """Router: each object's delta lands on its owning domain
+        (object hex -> shard — owner strings differ between the
+        daemon's and the driver's view, object ids don't). An empty
+        update (the owner's keepalive) refreshes the lease on EVERY
+        domain; a non-empty one refreshes untouched domains' leases
+        for free so entries never age out shard-by-shard."""
+        shards = self._shards
+        n = len(shards)
+        per: list = [([], []) for _ in range(n)]
+        for add in adds:
+            per[gcs_shard.shard_of(add[0], n)][0].append(add)
+        for obj_hex in removes:
+            per[gcs_shard.shard_of(obj_hex, n)][1].append(obj_hex)
+        total = 0
+        for i, shard in enumerate(shards):
+            s_adds, s_removes = per[i]
+            if s_adds or s_removes or not (adds or removes):
+                total += self._shard_apply(
+                    shard, ("dir_update", owner, s_adds, s_removes),
+                    epoch, "object_locations_update") or 0
+            else:
+                # Untouched domain: bare lease refresh — no WAL
+                # record, skipped while wedged (lease TTL is far
+                # longer than any stall window).
+                with shard.lock:
+                    if not shard._stall_active_locked():
+                        shard.directory.update(owner, [], [])
+        return total
+
+    def _route_spill_events(self, events, node_hex: str,
+                            epoch: int | None) -> None:
+        """Heartbeat spill-mark piggybacks land on the OBJECT's owning
+        shard. A degraded shard sheds past its queue cap — the marks
+        are advisory locality hints, so the heartbeat (the liveness
+        plane) absorbs the typed overload instead of failing."""
+        from ray_tpu.exceptions import SystemOverloadedError
+
+        shards = self._shards
+        n = len(shards)
+        for owner, obj_hex, kind in events:
+            shard = shards[gcs_shard.shard_of(obj_hex, n)]
+            op = (("dir_spill", owner, obj_hex, node_hex)
+                  if kind == "spilled"
+                  else ("dir_unspill", owner, obj_hex))
+            try:
+                self._shard_apply(shard, op, epoch, "heartbeat_spill")
+            except SystemOverloadedError:
+                break
+
+    def shard_stats(self) -> list:
+        """Per-shard stats rows (GCS_SHARD_STAT_KEYS plus the shard
+        index), served over RPC and folded into /metrics as the
+        ray_tpu_gcs_shard{shard=,key=} family. Empty when sharding is
+        disarmed."""
+        if self._shards is None:
+            return []
+        return [{**shard.stats(), "shard": shard.index}
+                for shard in self._shards]
+
+    def _kill_shard(self, index: int | None = None) -> int:
+        """Deterministic shard-kill seam (the chaos soak and the
+        recovery bench drive failover without a probability draw):
+        crash-restart one shard domain exactly as gcs.shard_die
+        would — drop its volatile slices, mint its next epoch, replay
+        only its WAL. Returns records replayed; -1 when disarmed."""
+        if self._shards is None:
+            return -1
+        shard = self._shards[int(index or 0) % len(self._shards)]
+        replayed = shard.crash_restart("admin")
+        self.gcs.crash_shard(shard.index)
+        self._refresh_epoch()
+        return replayed
 
     # -- WAL ----------------------------------------------------------
     def _wal_append(self, op: tuple) -> None:
@@ -718,6 +940,16 @@ class GcsServer:
         with self._persist_lock:
             if now < self._persist_backoff_until:
                 return
+        if self._shards is not None:
+            # Per-shard snapshots+rotation (each domain decides its own
+            # dirtiness; a wedged one is skipped — it heals and drains
+            # inside the stall check, bounding post-stall staleness to
+            # one monitor tick).
+            for shard in self._shards:
+                shard.maybe_snapshot(
+                    float(GLOBAL_CONFIG.gcs_snapshot_interval_s),
+                    float(GLOBAL_CONFIG.gcs_wal_max_mb),
+                    bool(GLOBAL_CONFIG.gcs_wal_fsync), force=force)
         wal_over = (self._wal is not None and self._wal.size()
                     > float(GLOBAL_CONFIG.gcs_wal_max_mb) * 1024 * 1024)
         interval = float(GLOBAL_CONFIG.gcs_snapshot_interval_s)
@@ -748,9 +980,15 @@ class GcsServer:
             "format": 2, "wal_seq": wal_seq, "epoch": self.epoch,
             "kv": self.gcs.kv.snapshot(),
             **self.gcs.control_snapshot(),
-            "directory": self.object_directory.snapshot_state(),
+            "directory": (self.object_directory.snapshot_state()
+                          if self._shards is None else {}),
             "placement_groups": pgs,
         }
+        if self._shards is not None:
+            # The directory lives in the per-shard segments; recording
+            # the ring size here is what lets restore refuse a changed
+            # gcs_shards typed instead of misrouting.
+            state["gcs_shards"] = self._shard_count
         try:
             gp.write_snapshot(
                 self._persist_path,
@@ -806,6 +1044,11 @@ class GcsServer:
                 continue
         base_seq = 0
         if state is not None:
+            recorded = int(state.get("gcs_shards", 1))
+            if recorded != self._shard_count:
+                # The stable router's ring changed: loading this layout
+                # would misroute restored entries — refuse typed.
+                raise gp.ReshardError(recorded, self._shard_count)
             base_seq = int(state.get("wal_seq", 0))
             self.gcs.kv.restore(state.get("kv", {}))
             self.gcs.restore_control(state)
@@ -900,4 +1143,7 @@ class GcsServer:
             self._persist_tick(force=True)
         if self._wal is not None:
             self._wal.close()
+        if self._shards is not None:
+            for shard in self._shards:
+                shard.close()
         self._server.stop()

@@ -184,6 +184,27 @@ def install_runtime_collectors(runtime):
                     f'ray_tpu_gcs_persist_total'
                     f'{{kind="{_escape_label(key)}"}} '
                     f'{gcs_persist.get(key, 0)}')
+        # Sharded hot tables: one labeled gauge sample per shard per
+        # GCS_SHARD_STAT_KEYS row (epoch, wal_records_replayed,
+        # queued_writes, age_s, ...). Empty list when gcs_shards=1 —
+        # the family only appears on sharded heads.
+        gcs_shards = None
+        try:
+            gcs_shards = runtime.gcs_shard_stats()
+        except Exception:  # noqa: BLE001 — partial runtime teardown
+            gcs_shards = None
+        if gcs_shards:
+            from ray_tpu._private.gcs_shard import GCS_SHARD_STAT_KEYS
+
+            lines.append("# TYPE ray_tpu_gcs_shard gauge")
+            for row in gcs_shards:
+                shard = row.get("shard", 0)
+                for key in GCS_SHARD_STAT_KEYS:
+                    lines.append(
+                        f'ray_tpu_gcs_shard'
+                        f'{{shard="{shard}",'
+                        f'key="{_escape_label(key)}"}} '
+                        f'{row.get(key, 0)}')
 
         by_node = _node_stats_table(runtime)
         lines.extend(_node_stat_lines(by_node))

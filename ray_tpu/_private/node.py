@@ -246,6 +246,18 @@ class NodeAgent:
                         self.node_id = self._register()
                     except (RpcError, RpcMethodError, OSError):
                         pass  # head flapped again; next beat retries
+                else:
+                    from ray_tpu._private import flight_recorder
+                    from ray_tpu.exceptions import SystemOverloadedError
+
+                    if isinstance(exc.cause, SystemOverloadedError):
+                        # A degraded GCS shard shed this beat's
+                        # piggyback typed (queue at cap). Liveness is
+                        # unaffected — the next beat retries — but the
+                        # shed belongs in the post-mortem ring.
+                        flight_recorder.record(
+                            "heartbeat.shed",
+                            getattr(exc.cause, "retry_after_s", 0.0))
             except (RpcError, OSError):
                 pass  # head unreachable; keep trying (it may restart)
             # Coalescing floor: pokes landing during the sleep fold
@@ -399,9 +411,16 @@ def run_head(port: int, resources: dict | None = None,
         # file deliberately SURVIVES: incarnation numbers are monotonic
         # per session dir, so a daemon partitioned across sessions can
         # still never present a current-looking epoch.
-        for suffix in ("", ".prev", ".wal", ".wal.prev"):
+        import glob as glob_mod
+
+        # Per-shard segments (<snapshot>.shard<i>[.wal][.prev]) follow
+        # the same rule; the gcs_epoch_shard<i> files survive with the
+        # head's epoch file for the same fencing reason.
+        for path in [snapshot_path + suffix
+                     for suffix in ("", ".prev", ".wal", ".wal.prev")] \
+                + glob_mod.glob(snapshot_path + ".shard*"):
             try:
-                os.unlink(snapshot_path + suffix)
+                os.unlink(path)
             except OSError:
                 pass  # generation file already absent
 

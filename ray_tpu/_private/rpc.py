@@ -246,6 +246,21 @@ def rpc_retry_count() -> int:
         return _RPC_RETRIES
 
 
+def overload_retry_after(exc: BaseException) -> "float | None":
+    """The typed backpressure hint carried by a remote
+    ``SystemOverloadedError`` (clamped to the local backoff cap so a
+    long server-side stall never wedges the caller), or None when
+    ``exc`` is not an overload shed."""
+    cause = getattr(exc, "cause", None)
+    from ray_tpu.exceptions import SystemOverloadedError
+
+    if isinstance(cause, SystemOverloadedError):
+        return min(
+            max(float(getattr(cause, "retry_after_s", 0.1)), 0.05),
+            2.0)
+    return None
+
+
 def call_with_retry(call: Callable, method: str, *args,
                     attempts: int | None = None,
                     base_delay_s: float | None = None,
@@ -290,12 +305,23 @@ def call_with_retry(call: Callable, method: str, *args,
                 f"open (destination failing consecutively)")
         try:
             result = call(method, *args, **kwargs)
-        except RpcMethodError:
+        except RpcMethodError as exc:
             # "poisoned": the remote raised — the node is alive and
             # answering. Propagate (retrying re-raises) and close the
             # failure streak.
             if dest is not None:
                 breaker_record(dest, True)
+            retry_after = overload_retry_after(exc)
+            if retry_after is not None and attempt + 1 < attempts \
+                    and time.monotonic() + retry_after < deadline:
+                # Typed shed (SystemOverloadedError) from a degraded
+                # remote — e.g. a stalled GCS shard's write queue at
+                # cap: an idempotent call honors the server's bounded
+                # retry_after_s hint instead of failing a call the
+                # remote explicitly asked to see again.
+                _record_retry()
+                time.sleep(retry_after)
+                continue
             raise
         except (RpcError, OSError) as exc:
             if dest is not None and not counted \

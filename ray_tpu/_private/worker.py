@@ -698,6 +698,7 @@ class Runtime:
         self._actor_dirty: set = set()
         self._pg_published_version = -1
         self._gcs_persist_cache: tuple = (0.0, None)
+        self._gcs_shard_cache: tuple = (0.0, None)
         # Remote execution plane state (threads start at the end of
         # __init__, but callbacks may touch these during construction).
         self._remote_nodes: dict[NodeID, Any] = {}
@@ -3573,6 +3574,27 @@ class Runtime:
         if isinstance(stats, dict):
             self._gcs_persist_cache = (now, stats)
             return stats
+        return cached
+
+    def gcs_shard_stats(self) -> list | None:
+        """Per-shard stats rows from a sharded head (``/metrics``
+        ray_tpu_gcs_shard{shard=,key=} family), same short cache as
+        gcs_persist_stats. Empty list on an unsharded head; None when
+        there is no head (or it predates sharding)."""
+        if self.gcs_client is None:
+            return None
+        now = time.monotonic()
+        fetched_at, cached = self._gcs_shard_cache
+        if cached is not None and now - fetched_at < 5.0:
+            return cached
+        try:
+            rows = self.gcs_client.call("gcs_shard_stats",
+                                        timeout_s=2.0)
+        except Exception:  # noqa: BLE001 — old/unreachable head
+            return cached
+        if isinstance(rows, list):
+            self._gcs_shard_cache = (now, rows)
+            return rows
         return cached
 
     def configure_speculation(self, enabled: bool) -> None:

@@ -550,6 +550,46 @@ def test_bench_envelope_records_recovery_row():
         "token to reject the old incarnation with")
 
 
+def test_bench_envelope_records_recovery_shard_row():
+    """ISSUE 19 acceptance: the recovery_shard row proves killing 1 of
+    4 shard domains under live traffic recovers by replaying only the
+    victim's own WAL. A refresh is refused when sharding was disarmed
+    (gcs_shards < 2 measures the monolithic head, not failover), when
+    the victim recovered without replaying its shard WAL, or when any
+    acked directory entry was lost or doubled across the kill."""
+    if not BENCH_ENVELOPE.exists():
+        pytest.skip("BENCH_ENVELOPE.json not present")
+    doc = json.loads(BENCH_ENVELOPE.read_text())
+    rows = [r for r in doc.get("phases", [])
+            if r.get("phase") == "recovery_shard"]
+    assert rows, "envelope lost its recovery_shard row"
+    row = rows[-1]
+    for key in ("gcs_shards", "dir_entries", "victim_shard",
+                "victim_keys", "time_to_recovered_s",
+                "shard_wal_records_replayed", "fenced_writes",
+                "victim_restores", "epoch", "lost_entries",
+                "doubled_entries"):
+        assert key in row, f"recovery_shard row lost its {key!r} column"
+    assert row["gcs_shards"] >= 2, (
+        "recovery_shard row refreshed with sharding DISARMED — re-run "
+        "with gcs_shards=4")
+    assert row["shard_wal_records_replayed"] > 0, (
+        "zero shard-WAL replays: the kill never exercised the "
+        "per-shard durable path — refusing the refresh")
+    assert row["victim_restores"] >= 1, (
+        "the victim never recorded a restore — the kill seam did not "
+        "crash-restart a shard domain")
+    assert row["lost_entries"] == 0, (
+        f"{row['lost_entries']} acked directory entries LOST across "
+        f"the shard kill")
+    assert row["doubled_entries"] == 0, (
+        f"{row['doubled_entries']} directory entries DOUBLED across "
+        f"the shard kill")
+    assert row["dir_entries"] >= 1000 and row["victim_keys"] > 0, (
+        "recovery_shard row shrank below its committed scale")
+    assert row["time_to_recovered_s"] > 0
+
+
 def test_bench_envelope_spill_restore_overhead_bounded():
     """The restore path is LOWER-is-better (unlike the throughput
     guards): a refresh may not balloon restore_p50_ms past 5x the

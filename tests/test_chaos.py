@@ -52,6 +52,11 @@ def _chaos_clean():
     chaos.disable()
     GLOBAL_CONFIG.reset()
     reset_breakers()
+    # Re-latch the sharded-GCS gate to the (disarmed) default: it is a
+    # module global read at table construction, not per call.
+    from ray_tpu._private import gcs_shard
+
+    gcs_shard.init_from_config()
 
 
 # ---------------------------------------------------------------- controller
@@ -1333,6 +1338,138 @@ def test_partition_across_head_restart_fences_then_resyncs(tmp_path):
         cluster.shutdown()
 
 
+def test_shard_die_and_partition_across_shard_restart(tmp_path):
+    """ISSUE 19 acceptance: with 4 GCS shards armed, gcs.shard_die
+    fires MID-MUTATION on live directory traffic (the in-flight
+    publish is fenced typed, the victim shard replays only ITS WAL),
+    then a net.partition window severs the driver across a second
+    shard kill. Zero acked directory writes lost, nothing doubled
+    (per-pid marker proof), >=1 stale write fenced on a shard row,
+    and the non-victim shards keep serving throughout."""
+    from ray_tpu._private import gcs_shard
+    from ray_tpu._private.config import GLOBAL_CONFIG
+    from ray_tpu.cluster_utils import Cluster
+
+    GLOBAL_CONFIG.update({"gcs_shards": 4})
+    marker_dir = tmp_path / "markers"
+    marker_dir.mkdir()
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir=str(tmp_path / "cluster"),
+                      persist_path=str(tmp_path / "gcs_snapshot.pkl"))
+    head_port = cluster.gcs._server.port
+    runtime = None
+    try:
+        assert cluster.gcs._shards is not None
+        assert len(cluster.gcs._shards) == 4
+        for _ in range(2):
+            cluster.add_node(num_cpus=2, resources={"pool": 4.0},
+                             pool_size=0, heartbeat_period_s=0.5)
+        assert cluster.wait_for_nodes(2, timeout=60)
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        _wait_for(lambda: ray_tpu.cluster_resources().get("pool", 0)
+                  >= 8, 60, "cluster to assemble")
+        _wait_for(lambda: runtime._gcs_epoch == cluster.gcs.epoch, 30,
+                  "driver to learn the epoch")
+
+        @ray_tpu.remote(num_cpus=1, resources={"pool": 1.0},
+                        max_retries=3)
+        def big(path, i):
+            import os as _os
+            import time as _t
+
+            import numpy as _np
+
+            with open(_os.path.join(path, f"m-{i}-{_os.getpid()}-"
+                      f"{_t.monotonic_ns()}"), "w"):
+                pass
+            return _np.full(256 * 1024, i % 251, dtype=_np.uint8)
+
+        # Acked directory writes: big task results keep their primary
+        # copy on the executing node, so the owner publishes their
+        # locations into the sharded directory.
+        refs = [big.remote(str(marker_dir), i) for i in range(8)]
+        hexes = [ref.hex() for ref in refs]
+        _wait_for(lambda: all(
+            h in cluster.gcs._list_object_locations() for h in hexes),
+            90, "owner to publish the directory entries")
+
+        # --- phase A: gcs.shard_die mid-mutation -------------------
+        epoch_a = cluster.gcs.epoch
+        chaos.configure("seed=9,gcs.shard_die=1.0x1")
+        _wait_for(lambda: sum(r["restores"]
+                              for r in cluster.gcs.shard_stats()) >= 1,
+                  60, "a live mutation to draw gcs.shard_die")
+        chaos.disable()
+        assert cluster.gcs.epoch == epoch_a + 1
+        rows = cluster.gcs.shard_stats()
+        assert sum(r["restores"] for r in rows) == 1
+        # The in-flight mutation that drew the die carried the old
+        # epoch: fenced typed, counted on the victim's row.
+        _wait_for(lambda: sum(r["fenced_writes"]
+                              for r in cluster.gcs.shard_stats()) >= 1,
+                  30, "the in-flight stale write to be fenced")
+        # Zero acked writes lost: the victim replayed its own WAL.
+        view = cluster.gcs._list_object_locations()
+        assert all(h in view for h in hexes), \
+            [h for h in hexes if h not in view]
+
+        # --- phase B: net.partition across a second shard kill -----
+        _wait_for(lambda: runtime._gcs_epoch == cluster.gcs.epoch, 60,
+                  "driver to re-sync after the shard restart")
+        inflight = [big.remote(str(marker_dir), 100 + i)
+                    for i in range(4)]
+        time.sleep(0.3)  # dispatched
+        os.environ["RAY_TPU_PARTITION_S"] = "3.0"
+        os.environ["RAY_TPU_PARTITION_TARGET"] = f":{head_port}"
+        chaos.configure("seed=11,net.partition=1.0x1")
+        try:
+            runtime.gcs_client.call("ping", timeout_s=2.0)
+        except (RpcError, Exception):  # noqa: BLE001 — opens the window
+            pass
+        assert chaos.ACTIVE.partitioned(f"127.0.0.1:{head_port}")
+        replayed = cluster.gcs._kill_shard(1)
+        assert replayed >= 0
+        # Non-victim shards keep serving INSIDE the window: reads
+        # merge every domain, a current-epoch write lands.
+        view = cluster.gcs._list_object_locations()
+        assert all(h in view for h in hexes)
+        probe = next(f"{i:040x}" for i in range(64)
+                     if gcs_shard.shard_of(f"{i:040x}", 4) == 0)
+        cluster.gcs._object_locations_update(
+            "probe-owner", [(probe, ["nX"])], [],
+            epoch=cluster.gcs.epoch)
+        assert probe in cluster.gcs._list_object_locations()
+
+        # The execute plane is head-free: the in-flight work drains
+        # exactly once through the healed window.
+        for arr, i in zip(ray_tpu.get(inflight, timeout=120),
+                          range(4)):
+            assert arr[0] == (100 + i) % 251
+        _wait_for(lambda: runtime._gcs_epoch == cluster.gcs.epoch, 60,
+                  "driver to re-sync the post-kill epoch")
+        # Nothing doubled: exactly one marker per task index.
+        counts: dict = {}
+        for name in sorted(os.listdir(marker_dir)):
+            counts[name.split("-")[1]] = \
+                counts.get(name.split("-")[1], 0) + 1
+        expect = {str(i): 1 for i in range(8)}
+        expect.update({str(100 + i): 1 for i in range(4)})
+        assert counts == expect, counts
+        # Zero lost acked writes end-to-end: every published entry is
+        # still served and every blob fetches intact.
+        view = cluster.gcs._list_object_locations()
+        assert all(h in view for h in hexes)
+        for i, arr in enumerate(ray_tpu.get(refs, timeout=120)):
+            assert arr[0] == i % 251 and len(arr) == 256 * 1024
+    finally:
+        os.environ.pop("RAY_TPU_PARTITION_S", None)
+        os.environ.pop("RAY_TPU_PARTITION_TARGET", None)
+        chaos.disable()
+        if runtime is not None:
+            ray_tpu.shutdown()
+        cluster.shutdown()
+
+
 def _session_dumps(session_dir: str) -> list:
     import json
 
@@ -1375,14 +1512,17 @@ def test_chaos_soak_survives_kill_epochs(tmp_path):
     print(f"chaos soak seed={SEED}")
     # Deadlines armed, generously: every task carries a real budget
     # through the whole requeue/retry machinery (the _chaos_clean
-    # fixture resets the knob afterwards).
-    GLOBAL_CONFIG.update({"task_default_deadline_s": 120.0})
+    # fixture resets the knob afterwards). Sharded GCS armed: the soak
+    # kills individual shard domains alongside heads and nodes.
+    GLOBAL_CONFIG.update({"task_default_deadline_s": 120.0,
+                          "gcs_shards": 4})
 
     shm_before = _shm_names()
     ray_tpu.shutdown()
     cluster = Cluster(log_dir=str(tmp_path / "cluster"),
                       persist_path=str(tmp_path / "gcs_snapshot.pkl"))
     head_kills = 0
+    shard_kills = 0
     for _ in range(3):
         cluster.add_node(num_cpus=4, resources={"pool": 8.0},
                          pool_size=1, heartbeat_period_s=0.5)
@@ -1424,9 +1564,18 @@ def test_chaos_soak_survives_kill_epochs(tmp_path):
             # Kill one live worker daemon mid-workload, then replace
             # it. Every few epochs kill the HEAD instead: durable
             # recovery + fenced re-sync must hold under the same load.
+            # Every 7th epoch a random GCS SHARD dies instead
+            # (gcs.shard_die's deterministic seam): it replays only
+            # its own WAL while the other shards keep serving.
             if epoch % 5 == 2:
                 cluster.restart_head(graceful=False)
                 head_kills += 1
+            elif epoch % 7 == 3:
+                victim_shard = rng.randrange(4)
+                assert cluster.gcs._kill_shard(victim_shard) >= 0
+                shard_kills += 1
+                rows = cluster.gcs.shard_stats()
+                assert rows[victim_shard]["restores"] >= 1, rows
             else:
                 victims = [h for h in cluster._nodes if h.alive()]
                 victim = rng.choice(victims)
@@ -1456,9 +1605,18 @@ def test_chaos_soak_survives_kill_epochs(tmp_path):
         # incarnation restored from snapshot+WAL (its epoch counts
         # every restart) and replayed records on at least one pass.
         assert head_kills >= 3
+        assert shard_kills >= 2
         stats = cluster.gcs.persist_stats()
         assert stats["epoch"] >= head_kills + 1, stats
         assert stats["wal_records_replayed"] > 0, stats
+        # Sharded GCS rode the whole soak: 4 domains live, each with
+        # its own persisted epoch minted at every head boot + shard
+        # kill (the advertised epoch above sums them).
+        rows = cluster.gcs.shard_stats()
+        assert len(rows) == 4, rows
+        for row in rows:
+            assert row["epoch"] >= head_kills + 1, rows
+            assert row["queued_writes"] == 0, rows
         # Lock-order witness (ISSUE 13): the soak runs fully armed
         # (driver here, daemons via the inherited env) — any cycle
         # would have raised LockOrderError at its acquire site and

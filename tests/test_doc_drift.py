@@ -84,6 +84,9 @@ EXPORTED_SERIES = (
     # (SUBMIT_STAT_KEYS / DISPATCH_STAT_KEYS in worker.py).
     "ray_tpu_node_submit",
     "ray_tpu_node_dispatch",
+    # Sharded GCS hot tables (ISSUE 19): one labeled gauge sample per
+    # shard per GCS_SHARD_STAT_KEYS key — only on sharded heads.
+    "ray_tpu_gcs_shard",
 )
 
 
@@ -588,6 +591,77 @@ def test_recovery_envelope_row_documented(fault_tolerance_text):
     assert "ENVELOPE_RECOVERY_ONLY" in fault_tolerance_text
     assert "time_to_recovered_s" in fault_tolerance_text
     assert "wal_records_replayed > 0" in fault_tolerance_text
+
+
+# ----------------------------------------------- sharded GCS hot tables
+
+
+def test_gcs_shard_knobs_documented(fault_tolerance_text):
+    """The sharding knobs (ISSUE 19) keep README rows in the fault-
+    tolerance knob table."""
+    from ray_tpu._private.config import _DEFAULTS
+
+    knobs = [k for k in _DEFAULTS if k.startswith("gcs_shard")]
+    assert len(knobs) >= 2, f"gcs shard knobs vanished from config: {knobs}"
+    missing = [k for k in knobs
+               if f"`{k}`" not in fault_tolerance_text]
+    assert not missing, (
+        f"gcs shard knobs missing from the README fault-tolerance "
+        f"knob table: {missing}")
+
+
+def test_shard_failure_model_table_documented(fault_tolerance_text):
+    """The shard failure-model contract: shard-kill vs head-kill vs
+    partition semantics, degraded-read / queued-write rules, typed
+    refusals."""
+    flat = " ".join(fault_tolerance_text.split())
+    for phrase in ("shard-kill", "head-kill",
+                   "replaying only its own WAL",
+                   "`ReshardError`", "`SystemOverloadedError`",
+                   "stale-marked", "queue WAL-first", "`age_s`",
+                   "never lose an acked write",
+                   "`gcs.shard_restore`", "`gcs.shard_fenced_write`",
+                   "`gcs.shard_backoff`"):
+        assert phrase in flat, (
+            f"shard failure-model text lost {phrase!r}")
+
+
+def test_gcs_shard_chaos_sites_documented(fault_tolerance_text):
+    from ray_tpu._private.analysis.chaos_sites import registered_sites
+
+    registered = registered_sites()
+    for site in ("gcs.shard_die", "gcs.shard_stall"):
+        assert site in registered, (
+            f"chaos site {site} missing from chaos.SITES")
+        assert f"`{site}`" in fault_tolerance_text, (
+            f"chaos site {site} missing from the README fault-"
+            f"tolerance section")
+    assert "RAY_TPU_SHARD_STALL_S" in fault_tolerance_text
+
+
+def test_gcs_shard_metrics_family_documented(fault_tolerance_text):
+    """Every GCS_SHARD_STAT_KEYS key (read through the analyzer's AST
+    parser, asserted identical to the importable tuple) keeps a README
+    row, and the family itself is documented."""
+    parsed = registry_keys("gcs_shard", "GCS_SHARD_STAT_KEYS")
+    from ray_tpu._private.gcs_shard import GCS_SHARD_STAT_KEYS
+
+    assert tuple(parsed) == tuple(GCS_SHARD_STAT_KEYS)
+    assert len(parsed) >= 9
+    assert "`ray_tpu_gcs_shard`" in fault_tolerance_text
+    missing = [k for k in parsed
+               if f"`{k}`" not in fault_tolerance_text]
+    assert not missing, (
+        f"GCS_SHARD_STAT_KEYS missing from the README fault-"
+        f"tolerance section: {missing}")
+
+
+def test_recovery_shard_envelope_row_documented(fault_tolerance_text):
+    """The shard-kill recovery bench row is operator contract like the
+    head-kill one."""
+    flat = " ".join(fault_tolerance_text.split())
+    assert "`recovery_shard` row" in flat
+    assert "1 of 4 shards" in flat
 
 
 # ---------------------------------------- static analysis tooling

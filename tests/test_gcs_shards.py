@@ -1,0 +1,657 @@
+"""Sharded GCS hot tables (gcs_shard.py + gcs_server.py): stable
+CRC32 routing, per-shard WAL+epoch segments with independent shard
+failover, typed reshard refusal, partition-hardened degraded mode
+(stale-marked reads, WAL-first queued writes, typed shed past the
+cap), and the disarmed (``gcs_shards=1``) path staying byte-identical
+to the PR 12 single-snapshot+WAL layout.
+
+Reference: the paper's sharded GCS — control-plane tables partitioned
+by key so one table loss never takes the cluster down.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import pickle
+import time
+
+import pytest
+
+from ray_tpu._private import chaos, flight_recorder, gcs_shard
+from ray_tpu._private import gcs_persistence as gp
+from ray_tpu._private.config import GLOBAL_CONFIG
+from ray_tpu._private.gcs import (GlobalControlService, StaleEpochError,
+                                  TaskEvent)
+from ray_tpu._private.gcs_server import GcsServer
+from ray_tpu._private.ids import TaskID
+from ray_tpu._private.rpc import (MuxRpcClient, RpcMethodError,
+                                  overload_retry_after)
+from ray_tpu.exceptions import SystemOverloadedError
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    chaos.disable()
+    # Flusher-less recorder so the shard flight events are observable
+    # (idempotent: a pre-installed recorder is reused, ring cleared).
+    flight_recorder.install("test")._ring.clear()
+    yield
+    chaos.disable()
+    GLOBAL_CONFIG.reset()
+    # The gate is a latched module global: re-disarm it so later test
+    # files construct unsharded tables again.
+    gcs_shard.init_from_config()
+
+
+def _arm(n: int = 4, queue_cap: int | None = None) -> None:
+    overrides: dict = {"gcs_shards": n}
+    if queue_cap is not None:
+        overrides["gcs_shard_max_queued_writes"] = queue_cap
+    GLOBAL_CONFIG.update(overrides)
+    gcs_shard.init_from_config()
+
+
+def _crash(server: GcsServer) -> None:
+    """SIGKILL shape: no final snapshot, no WAL close."""
+    server._shutdown.set()
+    server._server.stop()
+
+
+def _head(tmp_path, port: int = 0) -> GcsServer:
+    if port == 0:
+        return GcsServer(host="127.0.0.1", port=port,
+                         log_dir=str(tmp_path / "log"),
+                         persist_path=str(tmp_path / "gcs_snapshot.pkl"))
+    deadline = time.monotonic() + 15
+    while True:
+        try:
+            return GcsServer(
+                host="127.0.0.1", port=port,
+                log_dir=str(tmp_path / "log"),
+                persist_path=str(tmp_path / "gcs_snapshot.pkl"))
+        except OSError:
+            if time.monotonic() >= deadline:
+                raise
+            time.sleep(0.2)
+
+
+def _objs_for_shard(target: int, n: int, count: int) -> list:
+    """``count`` DISTINCT 40-hex object ids routing to ``target``
+    under an ``n``-shard ring (deterministic scan — the router is
+    stable)."""
+    out, i = [], 0
+    while len(out) < count:
+        key = f"{i:040x}"
+        if gcs_shard.shard_of(key, n) == target:
+            out.append(key)
+        i += 1
+    return out
+
+
+def _obj_for_shard(target: int, n: int) -> str:
+    return _objs_for_shard(target, n, 1)[0]
+
+
+def _ring_events():
+    rec = flight_recorder.get()
+    return [] if rec is None else list(rec._ring)
+
+
+def _ring_kinds() -> set:
+    return {kind for _ts, kind, _args in _ring_events()}
+
+
+# ------------------------------------------------------------------ router
+
+
+def test_router_stable_across_processes_and_restarts():
+    """shard_of is CRC32 over the raw key bytes — NOT the salted
+    builtin hash — so the same id routes to the same shard in every
+    process and every incarnation. Frozen expectations: a router
+    change IS a reshard and must fail loudly here."""
+    assert gcs_shard.shard_of("aa" * 10, 4) == 2
+    assert gcs_shard.shard_of("bb" * 10, 4) == 0
+    assert gcs_shard.shard_of("0123456789abcdef0123", 4) == 2
+    assert gcs_shard.shard_of("node-hex-1", 4) == 3
+    assert gcs_shard.shard_of("aa" * 10, 2) == 0
+    for key in ("aa" * 10, "bb" * 10, "node-hex-1"):
+        assert gcs_shard.shard_of(key, 4) == gcs_shard.shard_of(key, 4)
+    # count<=1 short-circuits to shard 0 (the disarmed ring).
+    assert gcs_shard.shard_of("anything", 1) == 0
+    # A modest key population covers every shard: no dead domain.
+    hit = {gcs_shard.shard_of(f"{i:040x}", 4) for i in range(64)}
+    assert hit == {0, 1, 2, 3}
+
+
+def test_init_from_config_latches_gate():
+    assert gcs_shard.shard_count() == 1 and not gcs_shard.SHARDS_ON
+    _arm(4)
+    assert gcs_shard.shard_count() == 4 and gcs_shard.SHARDS_ON
+    GLOBAL_CONFIG.reset()
+    gcs_shard.init_from_config()
+    assert gcs_shard.shard_count() == 1 and not gcs_shard.SHARDS_ON
+
+
+# ------------------------------------------------- disarmed byte-identity
+
+
+def test_disarmed_layout_byte_identical_to_single_wal(tmp_path):
+    """gcs_shards=1 (default): no shard segments on disk, no
+    gcs_shards stamp in the snapshot, directory persisted in the main
+    snapshot — the PR 12 layout exactly."""
+    server = _head(tmp_path)
+    assert server._shards is None
+    assert server.shard_stats() == []
+    assert server._kill_shard() == -1
+    server._object_locations_update(
+        "owner-1", [("aa" * 10, ["n1"])], [], epoch=server.epoch)
+    server._kv_put(b"k", b"v")
+    server._persist_tick(force=True)
+    _crash(server)
+
+    assert glob.glob(str(tmp_path / "gcs_snapshot.pkl") + ".shard*") == []
+    state = pickle.loads(
+        gp.read_snapshot(str(tmp_path / "gcs_snapshot.pkl")))
+    assert "gcs_shards" not in state
+    assert state["directory"]["locations"], state["directory"]
+
+    restarted = _head(tmp_path)
+    try:
+        assert restarted._list_object_locations()["aa" * 10] == ["n1"]
+    finally:
+        _crash(restarted)
+
+
+def test_disarmed_legacy_raw_pickle_snapshot_still_loads(tmp_path):
+    """The pre-WAL {kv, jobs} raw-pickle file loads through the legacy
+    path with sharding disarmed — arming shards was not allowed to
+    regress the oldest on-disk format."""
+    path = tmp_path / "gcs_snapshot.pkl"
+    with open(path, "wb") as f:
+        pickle.dump({"kv": {"default": {b"legacy": b"1"}}, "jobs": []}, f)
+    server = _head(tmp_path)
+    try:
+        assert server.gcs.kv.get(b"legacy") == b"1"
+        assert server._shards is None
+    finally:
+        _crash(server)
+
+
+# ------------------------------------------------------- sharded layout
+
+
+def test_sharded_boot_segments_and_routing(tmp_path):
+    _arm(4)
+    server = _head(tmp_path)
+    try:
+        assert len(server._shards) == 4
+        keys = [f"{i:040x}" for i in range(16)]
+        server._object_locations_update(
+            "owner-1", [(k, ["n1"]) for k in keys], [],
+            epoch=server.epoch)
+        # Every shard's slice holds ONLY keys the router sends to it.
+        for shard in server._shards:
+            for key in shard.directory.locations():
+                assert gcs_shard.shard_of(key, 4) == shard.index
+        merged = server._list_object_locations()
+        assert set(merged) == set(keys)
+        # Per-shard WAL segments exist from boot; snapshots after the
+        # persist tick fans out.
+        base = str(tmp_path / "gcs_snapshot.pkl")
+        for i in range(4):
+            assert os.path.exists(f"{base}.shard{i}.wal")
+        server._persist_tick(force=True)
+        for i in range(4):
+            assert os.path.exists(f"{base}.shard{i}")
+            state = pickle.loads(gp.read_snapshot(f"{base}.shard{i}"))
+            assert state["gcs_shards"] == 4 and state["shard"] == i
+        # The MAIN snapshot carries the stamp and an EMPTY directory
+        # (the shards own it now).
+        main = pickle.loads(gp.read_snapshot(base))
+        assert main["gcs_shards"] == 4
+        assert not main["directory"].get("locations")
+    finally:
+        _crash(server)
+
+
+def test_sharded_full_restart_recovers_all_shards(tmp_path):
+    _arm(4)
+    server = _head(tmp_path)
+    keys = [f"{i:040x}" for i in range(12)]
+    server._object_locations_update(
+        "owner-1", [(k, ["n1", "n2"]) for k in keys], [],
+        epoch=server.epoch)
+    first_epoch = server.epoch
+    _crash(server)
+
+    restarted = _head(tmp_path)
+    try:
+        # Head base + every shard's minted epoch all bumped.
+        assert restarted.epoch > first_epoch
+        assert set(restarted._list_object_locations()) == set(keys)
+        replayed = sum(r["wal_records_replayed"]
+                       for r in restarted.shard_stats())
+        assert replayed > 0
+    finally:
+        _crash(restarted)
+
+
+# --------------------------------------------------------- shard failover
+
+
+def test_shard_kill_failover_is_independent(tmp_path):
+    """Kill ONE shard: it replays only its own WAL and minted the next
+    epoch; the other shards' domains never restart; every entry is
+    still served; a writer holding the pre-kill epoch is fenced typed
+    and counted on the victim's row."""
+    _arm(4)
+    server = _head(tmp_path)
+    try:
+        keys = [f"{i:040x}" for i in range(20)]
+        server._object_locations_update(
+            "owner-1", [(k, ["n1"]) for k in keys], [],
+            epoch=server.epoch)
+        victim = 2
+        owned = [k for k in keys if gcs_shard.shard_of(k, 4) == victim]
+        assert owned  # the scan population covers every shard
+        epoch_before = server.epoch
+
+        replayed = server._kill_shard(victim)
+        assert replayed >= 1  # the batched dir_update is ONE WAL record
+        assert server.epoch == epoch_before + 1
+        rows = {r["shard"]: r for r in server.shard_stats()}
+        assert rows[victim]["restores"] == 1
+        for i in (0, 1, 3):
+            assert rows[i]["restores"] == 0
+        assert "gcs.shard_restore" in _ring_kinds()
+        # Zero lost: the victim's slice replayed, the rest never moved.
+        assert set(server._list_object_locations()) == set(keys)
+
+        # The stale writer (still holding the pre-kill epoch) is
+        # rejected typed — the re-sync machinery's shape.
+        with pytest.raises(StaleEpochError):
+            server._object_locations_update(
+                "owner-1", [(owned[0], ["n9"])], [], epoch=epoch_before)
+        assert server.shard_stats()[victim]["fenced_writes"] >= 1
+        assert "gcs.shard_fenced_write" in _ring_kinds()
+        # Re-synced to the new epoch, the write lands.
+        server._object_locations_update(
+            "owner-1", [(owned[0], ["n9"])], [], epoch=server.epoch)
+        assert "n9" in server._list_object_locations()[owned[0]]
+    finally:
+        _crash(server)
+
+
+def test_shard_kill_drops_volatile_slices_only(tmp_path):
+    """The killed shard's node-stats and task-event slices die with it
+    (a real shard process loss); other shards' slices survive."""
+    _arm(4)
+    server = _head(tmp_path)
+    try:
+        nodes = {}
+        for i in range(16):
+            hexid = f"{i:032x}"
+            server.gcs.record_node_stats(hexid, {"cpu": i})
+            nodes[hexid] = gcs_shard.shard_of(hexid, 4)
+        victim = 1
+        assert victim in nodes.values()
+        server._kill_shard(victim)
+        stats = server.gcs.node_stats()
+        for hexid, shard in nodes.items():
+            assert (hexid in stats) == (shard != victim), hexid
+    finally:
+        _crash(server)
+
+
+# ------------------------------------------------------- reshard refusal
+
+
+def test_reshard_refused_snapshot_layout(tmp_path):
+    """Changing gcs_shards over a persisted layout is refused TYPED at
+    restore — never a silent misroute of the restored directory."""
+    _arm(4)
+    server = _head(tmp_path)
+    server._object_locations_update(
+        "owner-1", [("aa" * 10, ["n1"])], [], epoch=server.epoch)
+    server._persist_tick(force=True)
+    _crash(server)
+
+    _arm(2)
+    with pytest.raises(gp.ReshardError) as info:
+        _head(tmp_path)
+    assert info.value.recorded == 4 and info.value.configured == 2
+    assert "refused" in str(info.value)
+
+    # The recorded count still boots and serves.
+    _arm(4)
+    restarted = _head(tmp_path)
+    try:
+        assert restarted._list_object_locations()["aa" * 10] == ["n1"]
+    finally:
+        _crash(restarted)
+
+
+def test_reshard_refused_wal_only_layout(tmp_path):
+    """No shard snapshot ever written (WAL-only segments): shrink and
+    growth are still refused — segment indices disagree with the ring."""
+    _arm(4)
+    server = _head(tmp_path)
+    server._object_locations_update(
+        "owner-1", [("aa" * 10, ["n1"])], [], epoch=server.epoch)
+    _crash(server)
+
+    for configured in (2, 8):
+        _arm(configured)
+        with pytest.raises(gp.ReshardError) as info:
+            _head(tmp_path)
+        assert info.value.recorded == 4
+        assert info.value.configured == configured
+
+
+def test_reshard_refused_disarming_over_sharded_layout(tmp_path):
+    _arm(4)
+    server = _head(tmp_path)
+    server._object_locations_update(
+        "owner-1", [("aa" * 10, ["n1"])], [], epoch=server.epoch)
+    _crash(server)
+
+    GLOBAL_CONFIG.reset()
+    gcs_shard.init_from_config()
+    with pytest.raises(gp.ReshardError) as info:
+        _head(tmp_path)
+    assert info.value.configured == 1
+
+
+def test_reshard_refused_arming_over_single_wal_layout(tmp_path):
+    """An unsharded layout whose WAL carries directory entries refuses
+    arming: those entries were routed by a 1-ring."""
+    server = _head(tmp_path)
+    server._object_locations_update(
+        "owner-1", [("aa" * 10, ["n1"])], [], epoch=server.epoch)
+    _crash(server)
+
+    _arm(4)
+    with pytest.raises(gp.ReshardError) as info:
+        _head(tmp_path)
+    assert info.value.recorded == 1 and info.value.configured == 4
+
+
+# -------------------------------------------------------- degraded mode
+
+
+def test_stall_serves_stale_reads_and_queues_writes(tmp_path):
+    _arm(4, queue_cap=3)
+    server = _head(tmp_path)
+    try:
+        victim = server._shards[0]
+        k_live, *queued, k_shed = _objs_for_shard(0, 4, 5)
+        server._object_locations_update(
+            "owner-1", [(k_live, ["n1"])], [], epoch=server.epoch)
+
+        victim.stall(30.0)
+        for key in queued:
+            server._object_locations_update(
+                "owner-1", [(key, ["n2"])], [], epoch=server.epoch)
+        # Reads never block: the pre-stall view serves, stale-marked
+        # via the row's age_s; the queued writes are not yet visible.
+        view = server._list_object_locations()
+        assert view[k_live] == ["n1"]
+        for key in queued:
+            assert key not in view
+        row = server.shard_stats()[0]
+        assert row["queued_writes"] == 3
+        assert row["age_s"] > 0.0
+        assert "gcs.shard_backoff" in _ring_kinds()
+
+        # Past the cap the write sheds TYPED with a retry hint —
+        # never hangs, never queues unboundedly.
+        with pytest.raises(SystemOverloadedError) as info:
+            server._object_locations_update(
+                "owner-1", [(k_shed, ["n3"])], [], epoch=server.epoch)
+        assert info.value.retry_after_s > 0
+        assert server.shard_stats()[0]["shed_writes"] == 1
+
+        # Other shards keep serving writes while shard 0 is wedged.
+        k_other = _obj_for_shard(1, 4)
+        server._object_locations_update(
+            "owner-1", [(k_other, ["n1"])], [], epoch=server.epoch)
+        assert server._list_object_locations()[k_other] == ["n1"]
+
+        # Heal: the queue drains, every ACKED write is visible, the
+        # shed one never was acked and never appears.
+        victim.stalled_until = time.monotonic() - 0.01
+        victim.heal_tick()
+        view = server._list_object_locations()
+        for key in queued:
+            assert view[key] == ["n2"]
+        row = server.shard_stats()[0]
+        assert row["queued_writes"] == 0 and row["age_s"] == 0.0
+    finally:
+        _crash(server)
+
+
+def test_queued_write_is_wal_durable_across_shard_crash(tmp_path):
+    """An acked degraded-mode write is WAL'd at enqueue: even a shard
+    crash DURING the stall replays it — never lose an acked write."""
+    _arm(4)
+    server = _head(tmp_path)
+    try:
+        victim = server._shards[0]
+        victim.stall(30.0)
+        key = _obj_for_shard(0, 4)
+        server._object_locations_update(
+            "owner-1", [(key, ["n1"])], [], epoch=server.epoch)
+        assert victim.queue_len() == 1
+        server._kill_shard(0)
+        assert server._list_object_locations()[key] == ["n1"]
+        assert server.shard_stats()[0]["wal_records_replayed"] >= 1
+    finally:
+        _crash(server)
+
+
+def test_persist_tick_skips_stalled_shard(tmp_path):
+    _arm(4)
+    server = _head(tmp_path)
+    try:
+        server._object_locations_update(
+            "owner-1", [(_obj_for_shard(0, 4), ["n1"]),
+                        (_obj_for_shard(1, 4), ["n1"])], [],
+            epoch=server.epoch)
+        server._shards[0].stall(30.0)
+        server._persist_tick(force=True)
+        base = str(tmp_path / "gcs_snapshot.pkl")
+        assert not os.path.exists(f"{base}.shard0")
+        assert os.path.exists(f"{base}.shard1")
+    finally:
+        _crash(server)
+
+
+# ------------------------------------------------------------ chaos sites
+
+
+def test_chaos_shard_die_mid_mutation_fences_typed(tmp_path):
+    """gcs.shard_die fires MID-mutation: the shard crash-restarts,
+    the advertised epoch bumps, and the in-flight write (stamped with
+    the pre-death epoch) is rejected typed — the writer re-syncs and
+    republishes, exactly the head-restart discipline."""
+    _arm(4)
+    server = _head(tmp_path)
+    try:
+        key = _obj_for_shard(0, 4)
+        epoch = server.epoch
+        chaos.configure("seed=5,gcs.shard_die=1.0x1")
+        with pytest.raises(StaleEpochError):
+            server._object_locations_update(
+                "owner-1", [(key, ["n1"])], [], epoch=epoch)
+        chaos.disable()
+        assert server.epoch == epoch + 1
+        assert any(r["restores"] == 1 for r in server.shard_stats())
+        # Re-synced retry lands; nothing doubled, nothing lost.
+        server._object_locations_update(
+            "owner-1", [(key, ["n1"])], [], epoch=server.epoch)
+        assert server._list_object_locations()[key] == ["n1"]
+    finally:
+        _crash(server)
+
+
+def test_chaos_shard_stall_opens_degraded_window(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAY_TPU_SHARD_STALL_S", "0.2")
+    _arm(4)
+    server = _head(tmp_path)
+    try:
+        key = _obj_for_shard(0, 4)
+        chaos.configure("seed=7,gcs.shard_stall=1.0x1")
+        server._object_locations_update(
+            "owner-1", [(key, ["n1"])], [], epoch=server.epoch)
+        chaos.disable()
+        victim = server._shards[0]
+        assert victim.stall_active() or victim.queue_len() == 0
+        # The write was ACKED (queued WAL-first); after the window it
+        # is applied and visible.
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            victim.heal_tick()
+            if server._list_object_locations().get(key) == ["n1"]:
+                break
+            time.sleep(0.05)
+        assert server._list_object_locations()[key] == ["n1"]
+    finally:
+        _crash(server)
+
+
+# --------------------------------------- heartbeat plane + sharded tables
+
+
+def test_heartbeat_spill_events_route_per_shard(tmp_path):
+    _arm(4)
+    server = _head(tmp_path)
+    server.start()
+    client = MuxRpcClient(server.address)
+    try:
+        node_id = client.call("register_node", "10.0.0.1:42",
+                              {"CPU": 4.0}, {}, "", host_id="hostA")
+        objs = [f"{i:040x}" for i in range(8)]
+        client.call("object_locations_update", "owner-1",
+                    [(o, ["n1"]) for o in objs], [], epoch=server.epoch)
+        assert client.call(
+            "heartbeat", node_id, None,
+            {"spill_events": [("owner-1", o, "spilled") for o in objs]},
+            None, epoch=server.epoch) is True
+        _locs, spilled = server._list_object_locations(
+            None, include_spilled=True)
+        for o in objs:
+            assert spilled[o] == node_id.hex()
+        # Marks landed on the owning shards.
+        for shard in server._shards:
+            for o in shard.directory.spilled():
+                assert gcs_shard.shard_of(o, 4) == shard.index
+    finally:
+        client.close()
+        _crash(server)
+
+
+def test_heartbeat_absorbs_degraded_shard_overload(tmp_path):
+    """A wedged shard shedding spill marks must NOT fail the liveness
+    plane: the heartbeat still returns True (marks are advisory)."""
+    _arm(4)
+    server = _head(tmp_path)
+    server.start()
+    client = MuxRpcClient(server.address)
+    try:
+        node_id = client.call("register_node", "10.0.0.1:42",
+                              {"CPU": 4.0}, {}, "", host_id="hostA")
+        victim = server._shards[0]
+        victim.stall(30.0)
+        victim.queue_cap = 0  # every queued op sheds immediately
+        key = _obj_for_shard(0, 4)
+        assert client.call(
+            "heartbeat", node_id, None,
+            {"spill_events": [("owner-1", key, "spilled")]},
+            None, epoch=server.epoch) is True
+        assert server.shard_stats()[0]["shed_writes"] >= 1
+    finally:
+        client.close()
+        _crash(server)
+
+
+def test_sharded_node_stats_merge_and_stage_latency():
+    _arm(4)
+    gcs = GlobalControlService()
+    assert gcs._stats_shards is not None
+    snap = {"counts": [1, 2], "sum": 3.0, "count": 3}
+    for i in range(8):
+        gcs.record_node_stats(f"{i:032x}",
+                              {"cpu": i, "stage_hist": {"exec": snap}})
+    stats = gcs.node_stats()
+    assert len(stats) == 8
+    for row in stats.values():
+        assert row["age_s"] >= 0.0
+    merged = gcs.cluster_stage_latency()
+    assert merged["exec"]["count"] == 8 * 3
+    assert merged["exec"]["sum"] == 8 * 3.0
+    gcs.drop_node_stats(f"{0:032x}")
+    assert len(gcs.node_stats()) == 7
+
+
+def test_sharded_task_events_route_and_merge():
+    _arm(4)
+    gcs = GlobalControlService()
+    assert gcs._task_shards is not None
+    ids = [TaskID(bytes([i]) * 16) for i in range(12)]
+    gcs.record_task_events(
+        [TaskEvent(t, f"f{i}", "RUNNING") for i, t in enumerate(ids)])
+    assert {gcs.get_task_event(t).state for t in ids} == {"RUNNING"}
+    assert len(gcs.list_task_events()) == 12
+    # Stage stamps merge on the owning shard.
+    gcs.merge_stage_ts(ids[0], {"exec_end": 1.5})
+    assert gcs.get_task_event(ids[0]).stage_ts["exec_end"] == 1.5
+    # Columnar groups: home-shard finish counter, lazy synthesis.
+    group_ids = [TaskID(bytes([100 + i]) * 16) for i in range(4)]
+    group = gcs.record_task_event_group(group_ids, "g")
+    assert group is not None
+    assert gcs.get_task_event(group_ids[0]).state == "PENDING"
+    gcs.record_task_group_finished(group, 4)
+    assert gcs.get_task_event(group_ids[0]).state == "FINISHED"
+    # Per-shard cap slice: a NEW event on a full domain drops and
+    # COUNTS (an update to an existing entry still lands).
+    fresh = TaskID(bytes([200]) * 16)
+    gcs._task_domain(fresh).limit = 0
+    gcs.record_task_event(TaskEvent(fresh, "late", "FINISHED"))
+    assert gcs.task_events_dropped >= 1
+    assert gcs.get_task_event(fresh) is None
+
+
+# ------------------------------------------------------------- RPC plane
+
+
+def test_overload_retry_after_extracts_typed_hint():
+    shed = RpcMethodError(
+        SystemOverloadedError("gcs shard 0 degraded", retry_after_s=0.4),
+        "tb")
+    assert overload_retry_after(shed) == pytest.approx(0.4)
+    # Clamped to the local backoff cap; non-overload causes yield None.
+    long = RpcMethodError(
+        SystemOverloadedError("x", retry_after_s=60.0), "tb")
+    assert overload_retry_after(long) == 2.0
+    assert overload_retry_after(
+        RpcMethodError(ValueError("x"), "tb")) is None
+    assert overload_retry_after(ValueError("x")) is None
+
+
+def test_shard_stats_rpc_and_kill_seam(tmp_path):
+    _arm(4)
+    server = _head(tmp_path)
+    server.start()
+    client = MuxRpcClient(server.address)
+    try:
+        rows = client.call("gcs_shard_stats")
+        assert [r["shard"] for r in rows] == [0, 1, 2, 3]
+        for row in rows:
+            for key in gcs_shard.GCS_SHARD_STAT_KEYS:
+                assert key in row, key
+        assert client.call("gcs_kill_shard", 3) >= 0
+        assert client.call("gcs_shard_stats")[3]["restores"] == 1
+    finally:
+        client.close()
+        _crash(server)
