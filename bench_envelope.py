@@ -329,6 +329,18 @@ def main() -> None:
     print(json.dumps({"note": "sharded_dispatch_calibration",
                       **sharded_row}), flush=True)
 
+    # History-plane honesty A/B (ISSUE 20): the same alternating
+    # best-of-N burst with the head's ring-store sampling + watchdog
+    # sweep attached vs detached. test_bench_regression refuses a
+    # refresh recorded with the plane disarmed or with armed overhead
+    # past the same 15% budget as the perf plane.
+    from ray_tpu._private import metrics_history as _mh
+
+    history_row = _history_calibration(_calib_burst, cluster.gcs,
+                                       calib_n, calib_reps)
+    print(json.dumps({"note": "metrics_history_calibration",
+                      **history_row}), flush=True)
+
     from ray_tpu.util import tracing as _tracing
     from ray_tpu._private import lock_witness as _witness
     from ray_tpu._private.config import GLOBAL_CONFIG as _cfg
@@ -377,7 +389,11 @@ def main() -> None:
            # bookkeeping. test_bench_regression refuses a refresh
            # recorded with the witness armed.
            lock_witness_armed=bool(_witness.WITNESS_ON),
-           perf_plane=perf_plane_row)
+           perf_plane=perf_plane_row,
+           # Cluster history plane (ISSUE 20): ships armed like the
+           # perf plane; the A/B bounds its cost on the same budget.
+           metrics_history_armed=bool(_mh.HISTORY_ON),
+           metrics_history=history_row)
 
     # -- phase 3b: skewed-load placement + straggler speculation ----------
     # The observability loop closed (ISSUE 9): byte-weighted locality
@@ -676,6 +692,88 @@ def main() -> None:
                   indent=2)
 
 
+def _history_calibration(burst, head, calib_n: int,
+                         calib_reps: int) -> dict:
+    """Armed/disarmed exec_per_s A/B for the cluster history plane
+    (ISSUE 20), alternating best-of-N like the perf-plane calibration.
+    The disarmed arm detaches the head's ring store + watchdog from
+    the monitor tick (the real disarmed path: ``_history_tick``'s
+    None guard), so the armed number carries the full sampling +
+    rule-sweep cost."""
+    armed_rates, disarmed_rates = [], []
+    saved_history = head._history
+    saved_watchdog = head._watchdog
+    for _ in range(max(1, calib_reps)):
+        head._history = saved_history
+        head._watchdog = saved_watchdog
+        armed_rates.append(burst(calib_n))
+        head._history = None
+        head._watchdog = None
+        disarmed_rates.append(burst(calib_n))
+    head._history = saved_history  # the plane ships armed
+    head._watchdog = saved_watchdog
+    from ray_tpu._private import metrics_history as _mh
+
+    return {
+        "armed": bool(_mh.HISTORY_ON) and saved_history is not None,
+        "calib_tasks": calib_n,
+        "calib_exec_per_s_armed": round(max(armed_rates), 1),
+        "calib_exec_per_s_disarmed": round(max(disarmed_rates), 1),
+        "calib_reps_armed": [round(r, 1) for r in armed_rates],
+        "calib_reps_disarmed": [round(r, 1) for r in disarmed_rates],
+    }
+
+
+def _phase_history() -> dict:
+    """Standalone history-plane A/B on a small live cluster; the
+    returned annotation merges onto the committed tasks row
+    (ENVELOPE_HISTORY_ONLY=1) so the full envelope needn't rerun to
+    refresh just this honesty check."""
+    import shutil
+    import tempfile
+
+    os.environ.setdefault("RAY_TPU_SKIP_TPU_DETECTION", "1")
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    calib_n = int(os.environ.get("ENVELOPE_PERF_CALIB_TASKS", "5000"))
+    calib_reps = int(os.environ.get("ENVELOPE_PERF_CALIB_REPS", "3"))
+    root = tempfile.mkdtemp(prefix="rt_envelope_hist_")
+    cluster = Cluster(log_dir=root)
+    for _ in range(2):
+        cluster.add_node(num_cpus=4, pool_size=1,
+                         heartbeat_period_s=0.5)
+    try:
+        assert cluster.wait_for_nodes(2, timeout=120)
+        ray_tpu.init(num_cpus=0, address=cluster.address)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline and \
+                ray_tpu.cluster_resources().get("CPU", 0) < 8:
+            time.sleep(0.2)
+
+        @ray_tpu.remote(num_cpus=1)
+        def noop(i: int) -> int:
+            return i
+
+        def burst(m: int) -> float:
+            t0 = time.monotonic()
+            out = ray_tpu.get([noop.remote(i) for i in range(m)],
+                              timeout=1800.0)
+            assert len(out) == m
+            return m / max(time.monotonic() - t0, 1e-9)
+
+        burst(min(1000, calib_n))  # warm the pools either way
+        row = _history_calibration(burst, cluster.gcs, calib_n,
+                                   calib_reps)
+        print(json.dumps({"note": "metrics_history_calibration",
+                          **row}), flush=True)
+        return row
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _phase_recovery() -> None:
     """Populate a persistence-armed head with N nodes / M actors / K
     object-directory entries, crash it (no clean stop, no final
@@ -900,7 +998,26 @@ def _phase_recovery_shard() -> None:
 
 
 if __name__ == "__main__":
-    if os.environ.get("ENVELOPE_RECOVERY_ONLY") == "1":
+    if os.environ.get("ENVELOPE_HISTORY_ONLY") == "1":
+        # Standalone refresh of the tasks row's history-plane A/B
+        # annotation — merged in place; every measured column keeps
+        # its committed value.
+        history_row = _phase_history()
+        out_path = os.environ.get("ENVELOPE_OUT") or os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "BENCH_ENVELOPE.json")
+        try:
+            with open(out_path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = {"host_cpus": os.cpu_count(), "phases": []}
+        for row in doc.get("phases", []):
+            if row.get("phase") == "tasks":
+                row["metrics_history_armed"] = history_row["armed"]
+                row["metrics_history"] = history_row
+        with open(out_path, "w") as f:
+            json.dump(doc, f, indent=2)
+    elif os.environ.get("ENVELOPE_RECOVERY_ONLY") == "1":
         # Standalone refresh of just the recovery rows (head-kill +
         # shard-kill), merged into the committed envelope (the other
         # rows keep their measurements).

@@ -46,6 +46,8 @@ KNOB_GATES: "dict[str, tuple[str, str]]" = {
     "llm_paged_engine": ("ray_tpu/serve/llm_engine/engine.py",
                          "PAGED_ON"),
     "gcs_shards": ("ray_tpu/_private/gcs_shard.py", "SHARDS_ON"),
+    "metrics_history": ("ray_tpu/_private/metrics_history.py",
+                        "HISTORY_ON"),
     "chaos": ("ray_tpu/_private/chaos.py", "ACTIVE"),
 }
 

@@ -354,6 +354,40 @@ _DEFAULTS: dict[str, Any] = {
     # refreshes record the state and test_bench_regression refuses a
     # witness-armed refresh.
     "lock_witness": False,
+    # Cluster history plane (metrics_history.py): head-side
+    # fixed-interval ring-buffer store that delta-encodes the per-node
+    # cumulative heartbeat stats into per-interval samples, plus the
+    # rule-driven health watchdog sweeping it. Disarmed
+    # (metrics_history=0 / RAY_TPU_METRICS_HISTORY=0), the head's
+    # monitor tick pays one module-attribute branch
+    # (metrics_history.HISTORY_ON) and the metrics_history /
+    # cluster_health RPCs answer armed=False.
+    "metrics_history": True,
+    # Sampling cadence: one delta-encoded sample per node per interval.
+    "metrics_history_interval_s": 2.0,
+    # Bounded retention window; ring capacity = retention / interval.
+    # Node series idle past this are evicted.
+    "metrics_history_retention_s": 600.0,
+    # Health watchdog rule thresholds (metrics_history.HEALTH_RULES).
+    # Rates evaluate over this trailing window.
+    "health_window_s": 30.0,
+    # overload: admission-shed rate past this, sustained over >= 2
+    # intervals (one burst is backpressure, not a verdict).
+    "health_overload_shed_per_s": 0.5,
+    # breaker_storm: circuit-breaker opens inside one window.
+    "health_breaker_storm_opens": 3.0,
+    # spill_thrash: spill+restore churn rate past this WHILE restore
+    # p50 is past health_spill_restore_p50_ms.
+    "health_spill_churn_per_s": 2.0,
+    "health_spill_restore_p50_ms": 50.0,
+    # wedged_node: node-stats receipt age (age_s) past this — the
+    # daemon stopped heartbeating but is not yet declared dead.
+    "health_wedged_age_s": 10.0,
+    # stale_shard: a GCS shard's stall age past this serves stale
+    # reads and queued writes (history for its domain is degraded).
+    "health_stale_shard_age_s": 3.0,
+    # fused_fallback_spike: fused-run fallbacks-to-pipeline per second.
+    "health_fused_fallback_per_s": 1.0,
     # Native (C++) daemon blob store (node_store.cpp); falls back to
     # the Python store when the toolchain/library is unavailable.
     "node_store_native": True,

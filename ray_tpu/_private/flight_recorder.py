@@ -39,7 +39,11 @@ head's failover/degraded paths; ``epoch.bump`` /
 ``heartbeat.stale_epoch`` / ``gcs.stale_epoch`` / ``heartbeat.shed``
 on daemons and drivers re-syncing across a head or shard restart), so
 a post-mortem shows what the disk tier and the head's recovery were
-doing when the process died.
+doing when the process died. The head's health watchdog
+(metrics_history.py) records ``health.<rule>`` — one event per typed
+verdict ACTIVATION (rule, node, value), for each rule in
+``HEALTH_RULES`` — so a post-mortem shows which SLO verdicts fired
+and when, even if the head died before anyone ran ``doctor``.
 """
 
 from __future__ import annotations

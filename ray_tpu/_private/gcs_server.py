@@ -17,7 +17,7 @@ import os
 import subprocess
 import threading
 
-from ray_tpu._private import gcs_shard, lock_witness
+from ray_tpu._private import gcs_shard, lock_witness, metrics_history
 import time
 from typing import Any
 
@@ -340,6 +340,16 @@ class GcsServer:
         # without limit.
         self._trace_spans: list[dict] = []
         self._trace_lock = lock_witness.Lock("gcs_server.GcsServer.trace")
+        # Cluster history plane: fixed-interval ring store over the
+        # node-stats table, sharded along the same domains as the hot
+        # tables, plus the SLO watchdog sweeping it each interval.
+        self._history: metrics_history.HistoryStore | None = None
+        self._watchdog: metrics_history.HealthWatchdog | None = None
+        if metrics_history.HISTORY_ON:
+            self._history = metrics_history.HistoryStore.from_config(
+                domains=max(1, self._shard_count))
+            self._watchdog = metrics_history.HealthWatchdog(
+                self._history)
         self._register_methods()
         self._monitor = threading.Thread(
             target=self._monitor_loop, daemon=True, name="gcs-monitor")
@@ -399,6 +409,10 @@ class GcsServer:
         # deterministic kill seam the soak/bench drive failover with.
         s.register("gcs_shard_stats", self.shard_stats)
         s.register("gcs_kill_shard", self._kill_shard)
+        # History plane: windowed per-node rate/percentile queries over
+        # the head's ring store, and the watchdog's typed verdicts.
+        s.register("metrics_history", self.metrics_history)
+        s.register("cluster_health", self.cluster_health)
         # Cluster-wide pub/sub channels (reference: the GCS pubsub
         # handler over src/ray/pubsub/publisher.h:307). Polls block, so
         # they dispatch concurrently like task execution does.
@@ -778,6 +792,38 @@ class GcsServer:
         self._refresh_epoch()
         return replayed
 
+    # -- history plane ------------------------------------------------
+    def metrics_history(self, window_s: float | None = None,
+                        node: str | None = None) -> dict:
+        """Windowed per-node history query (cross-domain merge; stale
+        domains ride ``degraded``). Disarmed heads answer typed
+        armed=False instead of erroring, so CLIs degrade cleanly."""
+        if self._history is None:
+            return metrics_history.disarmed_history()
+        return self._history.query(window_s=window_s, node=node)
+
+    def cluster_health(self) -> dict:
+        """The watchdog's active verdicts + recent fired ring."""
+        if self._watchdog is None:
+            return metrics_history.disarmed_health()
+        return self._watchdog.report()
+
+    def _history_tick(self) -> None:
+        """One monitor-tick turn of the history plane: when an
+        interval elapsed, delta-encode the node-stats table into the
+        rings and sweep the watchdog rules over the fresh window."""
+        history = self._history
+        if history is None or not history.due():
+            return
+        try:
+            node_stats = self.gcs.node_stats()
+            shard_rows = self.shard_stats()
+            history.sample(node_stats, shard_rows)
+            if self._watchdog is not None:
+                self._watchdog.sweep(node_stats, shard_rows)
+        except Exception:  # noqa: BLE001 — observability must not
+            pass           # take down the head's monitor loop
+
     # -- WAL ----------------------------------------------------------
     def _wal_append(self, op: tuple) -> None:
         """Append one durable mutation (called from the table mutators
@@ -894,6 +940,7 @@ class GcsServer:
                     self.gcs.drop_node_stats(hex_id)
             self._prune_object_locations()
             self.pubsub.prune()
+            self._history_tick()
             if self._persist_path:
                 self._persist_tick()
 

@@ -206,6 +206,26 @@ def install_runtime_collectors(runtime):
                         f'key="{_escape_label(key)}"}} '
                         f'{row.get(key, 0)}')
 
+        # Cluster history plane: the head watchdog's typed verdicts
+        # (one gauge sample per active rule/node pair — a scrape of 0
+        # means the rule is known but quiet) and each node's latest
+        # per-interval history sample. Absent for local-only runtimes
+        # and heads predating the plane.
+        health = None
+        try:
+            health = runtime.cluster_health()
+        except Exception:  # noqa: BLE001 — partial runtime teardown
+            health = None
+        if health and health.get("armed"):
+            lines.extend(_health_lines(health))
+        history = None
+        try:
+            history = runtime.metrics_history(window_s=60.0)
+        except Exception:  # noqa: BLE001 — partial runtime teardown
+            history = None
+        if history and history.get("armed"):
+            lines.extend(_history_lines(history))
+
         by_node = _node_stats_table(runtime)
         lines.extend(_node_stat_lines(by_node))
         lines.extend(_engine_lines(by_node))
@@ -217,6 +237,47 @@ def install_runtime_collectors(runtime):
         return lines
 
     return REGISTRY.add_collector(collect)
+
+
+def _health_lines(health: dict) -> list[str]:
+    """``ray_tpu_health{rule=,node=}``: 1 per ACTIVE verdict, plus a
+    per-rule fired total — so a dashboard can alert on both "firing
+    now" and "has fired"."""
+    lines = ["# TYPE ray_tpu_health gauge"]
+    for verdict in health.get("verdicts") or []:
+        rule = _escape_label(str(verdict.get("rule", "")))
+        node = _escape_label(str(verdict.get("node", ""))[:16])
+        lines.append(
+            f'ray_tpu_health{{rule="{rule}",node="{node}"}} 1')
+    lines.append("# TYPE ray_tpu_health_fired_total counter")
+    for rule, total in sorted(
+            (health.get("fired_total") or {}).items()):
+        lines.append(
+            f'ray_tpu_health_fired_total'
+            f'{{rule="{_escape_label(str(rule))}"}} {int(total)}')
+    return lines
+
+
+def _history_lines(history: dict) -> list[str]:
+    """``ray_tpu_node_history{node=,key=}``: each node's latest
+    per-interval delta sample out of the head's ring store (the
+    windowed rates behind it ride the metrics_history RPC / ``top``;
+    the scrape exports the newest interval)."""
+    from ray_tpu._private.metrics_history import HISTORY_STAT_KEYS
+
+    lines = ["# TYPE ray_tpu_node_history gauge"]
+    for node_hex, row in sorted((history.get("nodes") or {}).items()):
+        samples = row.get("samples") or []
+        if not samples:
+            continue
+        latest = samples[-1]
+        node = _escape_label(node_hex[:16])
+        for key in HISTORY_STAT_KEYS:
+            lines.append(
+                f'ray_tpu_node_history{{node="{node}",'
+                f'key="{_escape_label(key)}"}} '
+                f'{float(latest.get(key, 0.0) or 0.0)}')
+    return lines
 
 
 def _node_stats_table(runtime) -> dict:

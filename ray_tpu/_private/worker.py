@@ -699,6 +699,8 @@ class Runtime:
         self._pg_published_version = -1
         self._gcs_persist_cache: tuple = (0.0, None)
         self._gcs_shard_cache: tuple = (0.0, None)
+        self._history_cache: tuple = (0.0, None, None)
+        self._health_cache: tuple = (0.0, None)
         # Remote execution plane state (threads start at the end of
         # __init__, but callbacks may touch these during construction).
         self._remote_nodes: dict[NodeID, Any] = {}
@@ -3595,6 +3597,53 @@ class Runtime:
         if isinstance(rows, list):
             self._gcs_shard_cache = (now, rows)
             return rows
+        return cached
+
+    def metrics_history(self, window_s: float | None = None,
+                        node: str | None = None) -> dict | None:
+        """Windowed per-node history from the head's ring store
+        (cluster history plane): per-interval delta samples +
+        rate-over-window per counter, ``degraded`` naming any stalled
+        shard domains. Cached ~1s — ``top`` refreshing every second
+        must not turn into a head RPC storm. None when there is no
+        head (or it predates the history plane); a disarmed head
+        answers ``armed=False``."""
+        if self.gcs_client is None:
+            return None
+        now = time.monotonic()
+        fetched_at, key, cached = self._history_cache
+        if cached is not None and key == (window_s, node) \
+                and now - fetched_at < 1.0:
+            return cached
+        try:
+            hist = self.gcs_client.call(
+                "metrics_history", window_s=window_s, node=node,
+                timeout_s=2.0)
+        except Exception:  # noqa: BLE001 — old/unreachable head
+            return cached if key == (window_s, node) else None
+        if isinstance(hist, dict):
+            self._history_cache = (now, (window_s, node), hist)
+            return hist
+        return cached if key == (window_s, node) else None
+
+    def cluster_health(self) -> dict | None:
+        """The head watchdog's typed verdicts (active + recent fired
+        ring with evidence windows). Same caching/None contract as
+        metrics_history."""
+        if self.gcs_client is None:
+            return None
+        now = time.monotonic()
+        fetched_at, cached = self._health_cache
+        if cached is not None and now - fetched_at < 1.0:
+            return cached
+        try:
+            health = self.gcs_client.call("cluster_health",
+                                          timeout_s=2.0)
+        except Exception:  # noqa: BLE001 — old/unreachable head
+            return cached
+        if isinstance(health, dict):
+            self._health_cache = (now, health)
+            return health
         return cached
 
     def configure_speculation(self, enabled: bool) -> None:

@@ -14,6 +14,8 @@ dashboard/modules/job/cli.py). Usage::
     python -m ray_tpu list {tasks,actors,objects,nodes,...}  # state CLI
     python -m ray_tpu summary [tasks|placement]  # per-function latency/
                                     # resources + per-node placement/load
+    python -m ray_tpu top              # live per-node rates + verdicts
+    python -m ray_tpu doctor           # one-shot health verdict report
     python -m ray_tpu up cluster.yaml                  # YAML launcher
     python -m ray_tpu down cluster.yaml
 """
@@ -285,9 +287,12 @@ def cmd_serve(args) -> int:
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     # `list ...` routes to the state CLI (ray_tpu/util/state);
-    # `summary` (per-function latency/resource percentiles) and
-    # `debug` (flight-recorder post-mortem bundle) live there too.
-    if argv and argv[0] in ("list", "summary", "timeline", "debug"):
+    # `summary` (per-function latency/resource percentiles), `debug`
+    # (flight-recorder post-mortem bundle), `top` (live per-node rate
+    # view over the history plane) and `doctor` (one-shot watchdog
+    # verdict report) live there too.
+    if argv and argv[0] in ("list", "summary", "timeline", "debug",
+                            "top", "doctor"):
         from ray_tpu.util.state.api import _cli
 
         return _cli(argv)

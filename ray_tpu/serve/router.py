@@ -15,7 +15,7 @@ import threading
 import time
 from typing import Any
 
-from ray_tpu._private import perf_plane
+from ray_tpu._private import metrics_history, perf_plane
 from ray_tpu.serve.long_poll import LongPollClient
 from ray_tpu.serve.replica import BackPressureError
 
@@ -52,7 +52,10 @@ class DeploymentStreamingResponse:
             self._router._release(self._replica_idx)
             self._replica_idx = None
             if self._started is not None:
-                self._router.observe_latency(time.time() - self._started)
+                # Monotonic stamp: a wall-clock jump mid-stream must
+                # not distort the autoscaler's p50/p99 feed.
+                self._router.observe_latency(
+                    time.monotonic() - self._started)
                 self._started = None
 
     def _close(self):
@@ -206,8 +209,10 @@ class DeploymentResponse:
             if self._started is not None:
                 # End-to-end router latency (assign → final release,
                 # backpressure retries included): the per-deployment
-                # p99 the autoscaler consumes.
-                self._router.observe_latency(time.time() - self._started)
+                # p99 the autoscaler consumes. Monotonic stamp — a
+                # wall-clock jump must not distort the feed.
+                self._router.observe_latency(
+                    time.monotonic() - self._started)
                 self._started = None
 
     def result(self, timeout_s: float | None = None):
@@ -418,15 +423,11 @@ class Router:
         except Exception:  # noqa: BLE001 — controller down mid-teardown
             pass
 
-    @staticmethod
-    def _summarize(snap: dict) -> dict:
-        count = int(snap.get("count", 0))
-        return {
-            "count": count,
-            "mean_s": (snap["sum"] / count) if count else 0.0,
-            "p50_s": perf_plane.quantile(snap, 0.5),
-            "p99_s": perf_plane.quantile(snap, 0.99),
-        }
+    # THE windowed-latency summary implementation lives in
+    # metrics_history (the history plane generalized this router's
+    # bucket-subtraction trick); kept as a method alias so call sites
+    # and tests read the same.
+    _summarize = staticmethod(metrics_history.summarize)
 
     def latency_stats(self) -> dict:
         """Live latency summary for this deployment: count / mean /
@@ -441,15 +442,8 @@ class Router:
         snap = self._latency.snapshot()
         with self._lock:
             prev, self._last_window_snap = self._last_window_snap, snap
-        if prev is None:
-            return self._summarize(snap)
-        delta = {
-            "counts": [int(a) - int(b) for a, b in
-                       zip(snap["counts"], prev["counts"])],
-            "sum": float(snap["sum"]) - float(prev["sum"]),
-            "count": int(snap["count"]) - int(prev["count"]),
-        }
-        return self._summarize(delta)
+        return metrics_history.summarize(
+            metrics_history.snapshot_delta(snap, prev))
 
     def _max_queued_limit(self) -> int:
         """DeploymentConfig.max_queued_requests, cached (-1 =
@@ -511,8 +505,11 @@ class Router:
                 f"Deployment {self._deployment_name}: no replicas came up "
                 f"within {timeout_s}s")
         self._check_shed()
-        started = time.time()
-        deadline = (started + deadline_s
+        # Latency stamps are monotonic; the request DEADLINE stays
+        # wall-clock absolute (_bind_deadline rebases it vs time.time()
+        # on every retry hop).
+        started = time.monotonic()
+        deadline = (time.time() + deadline_s
                     if deadline_s is not None else None)
         idx, handle = self._pick(model_id=model_id)
         if stream_queue is not None:

@@ -345,6 +345,10 @@ def _cli(argv: list[str]) -> int:
         return _cli_timeline(argv[1:])
     if argv and argv[0] == "debug":
         return _cli_debug(argv[1:])
+    if argv and argv[0] == "top":
+        return _cli_top(argv[1:])
+    if argv and argv[0] == "doctor":
+        return _cli_doctor(argv[1:])
     if argv and argv[0] == "summary" and len(argv) == 1:
         # `python -m ray_tpu summary` — the per-function latency/
         # resource summary is the flagship view; default to tasks.
@@ -408,6 +412,13 @@ def collect_debug_bundle(out_path: str) -> dict:
             "breaker": breaker_stats(),
             "stage_hist": perf_plane.stage_snapshot(),
         }
+        # Cluster history plane: the head's windowed per-node history
+        # and the watchdog's verdicts — what happened in the last two
+        # minutes, not just cumulative-since-boot state. None for
+        # local-only runtimes / pre-plane heads.
+        bundle["metrics_history"] = runtime.metrics_history(
+            window_s=120.0)
+        bundle["cluster_health"] = runtime.cluster_health()
         client = getattr(runtime, "gcs_client", None)
         if client is not None:
             try:
@@ -482,3 +493,168 @@ def _cli_timeline(argv: list[str]) -> int:
     print(f"wrote {n} events to {out} "
           f"(open in chrome://tracing or https://ui.perfetto.dev)")
     return 0
+
+
+# ------------------------------------------------------ history plane CLI
+
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values: list, width: int = 24) -> str:
+    """One unicode block per interval sample, scaled to the window's
+    peak (an all-zero window renders as a flat floor, not blanks)."""
+    vals = [max(0.0, float(v or 0.0)) for v in values][-width:]
+    if not vals:
+        return ""
+    peak = max(vals)
+    if peak <= 0.0:
+        return _SPARK_CHARS[0] * len(vals)
+    top = len(_SPARK_CHARS) - 1
+    return "".join(
+        _SPARK_CHARS[min(top, int(v / peak * top + 0.5))] for v in vals)
+
+
+def _fetch_history_health(window_s: float):
+    runtime = _runtime()
+    return (runtime.metrics_history(window_s=window_s),
+            runtime.cluster_health())
+
+
+def _render_top(hist: dict | None, health: dict | None) -> list[str]:
+    """The `top` frame: per-node windowed rates + task-rate sparkline
+    + active verdicts, rendered from one metrics_history/cluster_health
+    query pair."""
+    lines: list[str] = []
+    if not hist or not hist.get("armed"):
+        lines.append(
+            "history plane unavailable (no head reachable, a pre-plane "
+            "head, or metrics_history=0)")
+        return lines
+    nodes = hist.get("nodes") or {}
+    degraded = hist.get("degraded") or []
+    lines.append(
+        f"cluster history — {len(nodes)} node(s), "
+        f"interval {hist.get('interval_s', 0):g}s, "
+        f"window {hist.get('window_s', 0):g}s"
+        + (f", DEGRADED shard domains {degraded}" if degraded else ""))
+    lines.append(
+        f"{'NODE':<18}{'TASKS/S':>9}{'SHED/S':>8}{'RETRY/S':>9}"
+        f"{'SPILL/S':>9}{'RUN':>5}{'DEPTH':>7}  HISTORY(tasks/s)")
+    for node_hex, row in sorted(nodes.items()):
+        rates = row.get("rates") or {}
+        samples = row.get("samples") or []
+        latest = samples[-1] if samples else {}
+        spark = _sparkline(
+            [s.get("tasks_executed", 0.0) for s in samples])
+        mark = "*" if row.get("stale") else " "
+        lines.append(
+            f"{node_hex[:16]:<17}{mark}"
+            f"{rates.get('tasks_executed', 0.0):>9.2f}"
+            f"{rates.get('admission_shed', 0.0):>8.2f}"
+            f"{rates.get('rpc_retries', 0.0):>9.2f}"
+            f"{rates.get('spills', 0.0):>9.2f}"
+            f"{int(latest.get('running', 0) or 0):>5}"
+            f"{int(latest.get('depth', 0) or 0):>7}  {spark}")
+    if degraded:
+        lines.append("  * = stale samples (shard domain stalled)")
+    verdicts = (health or {}).get("verdicts") or []
+    if verdicts:
+        lines.append(f"active verdicts ({len(verdicts)}):")
+        for verdict in verdicts:
+            lines.append(
+                f"  [{verdict.get('rule')}] {verdict.get('node')}: "
+                f"{verdict.get('detail')}")
+    else:
+        lines.append("active verdicts: none")
+    return lines
+
+
+def _cli_top(argv: list[str]) -> int:
+    """``ray_tpu top`` — live per-node rate view over the head's
+    history plane, refreshing every --interval seconds (ctrl-c to
+    stop; --iterations N for a bounded run)."""
+    import argparse
+    import time as _time
+
+    parser = argparse.ArgumentParser(prog="ray_tpu top")
+    parser.add_argument("--window", type=float, default=60.0,
+                        help="rate window in seconds")
+    parser.add_argument("--interval", type=float, default=2.0,
+                        help="refresh period")
+    parser.add_argument("--iterations", type=int, default=0,
+                        help="frames to render (0 = until ctrl-c)")
+    parser.add_argument("--no-clear", action="store_true",
+                        help="append frames instead of redrawing")
+    args = parser.parse_args(argv)
+    _ensure_connected()
+    rendered = 0
+    try:
+        while True:
+            hist, health = _fetch_history_health(args.window)
+            frame = _render_top(hist, health)
+            if not args.no_clear and rendered:
+                print("\033[2J\033[H", end="")
+            print("\n".join(frame))
+            rendered += 1
+            if args.iterations and rendered >= args.iterations:
+                return 0
+            _time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def _cli_doctor(argv: list[str]) -> int:
+    """``ray_tpu doctor`` — one-shot health report: every active
+    verdict with the evidence window behind it, the recently-fired
+    ring, and any degraded shard domains. Exit 1 when verdicts are
+    active (scriptable health check), 0 on a clean cluster."""
+    import argparse
+    import json as _json
+
+    parser = argparse.ArgumentParser(prog="ray_tpu doctor")
+    parser.add_argument("--window", type=float, default=120.0,
+                        help="history window behind the report")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the raw report as JSON")
+    args = parser.parse_args(argv)
+    _ensure_connected()
+    hist, health = _fetch_history_health(args.window)
+    if not health or not health.get("armed"):
+        print("health watchdog unavailable (no head reachable, a "
+              "pre-plane head, or metrics_history=0)")
+        return 2
+    if args.json:
+        print(_json.dumps({"cluster_health": health,
+                           "metrics_history": hist},
+                          indent=2, default=str))
+        return 1 if health.get("verdicts") else 0
+    verdicts = health.get("verdicts") or []
+    fired_total = health.get("fired_total") or {}
+    nodes = (hist or {}).get("nodes") or {}
+    degraded = (health.get("degraded")
+                or (hist or {}).get("degraded") or [])
+    print(f"ray_tpu doctor — {len(verdicts)} active verdict(s), "
+          f"{sum(fired_total.values())} fired since head start")
+    for verdict in verdicts:
+        print(f"[{verdict.get('rule')}] {verdict.get('node')}: "
+              f"{verdict.get('detail')}  "
+              f"(value={verdict.get('value')}, "
+              f"threshold={verdict.get('threshold')}, "
+              f"window={verdict.get('window_s')}s)")
+        evidence = verdict.get("evidence")
+        if evidence:
+            print(f"    evidence: "
+                  f"{_json.dumps(evidence, default=str, sort_keys=True)}")
+    if degraded:
+        print(f"degraded shard domains: {degraded} — history for "
+              f"their nodes is stale-marked")
+    if fired_total:
+        per_rule = ", ".join(f"{rule}={n}" for rule, n
+                             in sorted(fired_total.items()))
+        print(f"fired totals by rule: {per_rule}")
+    print(f"history: {len(nodes)} node(s) over "
+          f"{(hist or {}).get('window_s', 0):g}s "
+          f"(interval {(hist or {}).get('interval_s', 0):g}s)")
+    if not verdicts:
+        print("no active verdicts — cluster healthy")
+    return 1 if verdicts else 0

@@ -390,6 +390,42 @@ def test_bench_envelope_tasks_row_records_perf_plane_budget():
             f"{disarmed:g}/s) — over the 15% observability budget")
 
 
+def test_bench_envelope_tasks_row_records_metrics_history_budget():
+    """The cluster history plane (ISSUE 20) must be ARMED in the
+    committed envelope row — the head-side ring-store sampling and
+    watchdog sweep are part of the product — and the row must carry
+    the armed/disarmed exec_per_s A/B proving the plane fits the same
+    15% observability budget as the perf plane. A refresh that drops
+    the annotation, records with metrics_history disarmed, or shows
+    the plane eating more than the budget is refused outright."""
+    if not BENCH_ENVELOPE.exists():
+        pytest.skip("BENCH_ENVELOPE.json not present in the working "
+                    "tree")
+    doc = json.loads(BENCH_ENVELOPE.read_text())
+    tasks_rows = [r for r in doc.get("phases", [])
+                  if r.get("phase") == "tasks"]
+    assert tasks_rows, "envelope lost its tasks phase"
+    for row in tasks_rows:
+        assert row.get("metrics_history_armed") is True, (
+            "envelope tasks row was recorded with the history plane "
+            "disarmed (or predates it): rerun with "
+            "ENVELOPE_HISTORY_ONLY=1 python bench_envelope.py and "
+            "metrics_history left at its default")
+        plane = row.get("metrics_history")
+        assert isinstance(plane, dict), (
+            "envelope tasks row lost its metrics_history annotation: "
+            "rerun ENVELOPE_HISTORY_ONLY=1 python bench_envelope.py")
+        assert plane.get("armed") is True, plane
+        armed = float(plane.get("calib_exec_per_s_armed", 0))
+        disarmed = float(plane.get("calib_exec_per_s_disarmed", 0))
+        assert armed > 0 and disarmed > 0, plane
+        overhead = (disarmed - armed) / disarmed
+        assert overhead <= 0.15, (
+            f"history plane costs {overhead:.1%} exec_per_s in the "
+            f"calibration (armed {armed:g}/s vs disarmed "
+            f"{disarmed:g}/s) — over the 15% observability budget")
+
+
 def test_bench_envelope_records_sched_row():
     """The skewed-load placement row (ISSUE 9) must keep its schema:
     locality-hit counters on the broadcast-arg workload, the

@@ -1513,9 +1513,14 @@ def test_chaos_soak_survives_kill_epochs(tmp_path):
     # Deadlines armed, generously: every task carries a real budget
     # through the whole requeue/retry machinery (the _chaos_clean
     # fixture resets the knob afterwards). Sharded GCS armed: the soak
-    # kills individual shard domains alongside heads and nodes.
+    # kills individual shard domains alongside heads and nodes. The
+    # health watchdog samples every second with the wedged bound
+    # lowered under the 10s death timeout, so a SIGKILLed daemon's
+    # silent window deterministically fires a typed verdict.
     GLOBAL_CONFIG.update({"task_default_deadline_s": 120.0,
-                          "gcs_shards": 4})
+                          "gcs_shards": 4,
+                          "metrics_history_interval_s": 1.0,
+                          "health_wedged_age_s": 3.0})
 
     shm_before = _shm_names()
     ray_tpu.shutdown()
@@ -1523,6 +1528,7 @@ def test_chaos_soak_survives_kill_epochs(tmp_path):
                       persist_path=str(tmp_path / "gcs_snapshot.pkl"))
     head_kills = 0
     shard_kills = 0
+    watchdog_fired = False
     for _ in range(3):
         cluster.add_node(num_cpus=4, resources={"pool": 8.0},
                          pool_size=1, heartbeat_period_s=0.5)
@@ -1600,7 +1606,30 @@ def test_chaos_soak_survives_kill_epochs(tmp_path):
                     if attempt == 4:
                         raise
                     time.sleep(1.0)
+            # Watchdog check: accumulate per epoch (a head kill resets
+            # the new incarnation's fired counters, so one end-of-soak
+            # read would under-count).
+            health = cluster.gcs.cluster_health()
+            if health.get("armed") \
+                    and sum(health["fired_total"].values()) > 0:
+                watchdog_fired = True
             del blob_ref
+        # The kill epochs must have tripped the health watchdog at
+        # least once (typically wedged_node on a SIGKILLed daemon's
+        # silent window before the 10s death verdict).
+        assert watchdog_fired, \
+            "health watchdog never fired across 20 kill epochs"
+        # Calm tail: once the cluster settles, every verdict clears
+        # itself and a quiet window records zero new activations.
+        _wait_for(lambda: cluster.gcs.cluster_health()["verdicts"]
+                  == [], 60, "active verdicts to clear post-soak")
+        fired_before = dict(
+            cluster.gcs.cluster_health()["fired_total"])
+        time.sleep(3.5)  # several sample intervals of calm
+        calm = cluster.gcs.cluster_health()
+        assert calm["verdicts"] == [], calm["verdicts"]
+        assert calm["fired_total"] == fired_before, \
+            (fired_before, calm["fired_total"])
         # The head died and recovered head_kills times: the last
         # incarnation restored from snapshot+WAL (its epoch counts
         # every restart) and replayed records on at least one pass.

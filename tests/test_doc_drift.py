@@ -87,6 +87,12 @@ EXPORTED_SERIES = (
     # Sharded GCS hot tables (ISSUE 19): one labeled gauge sample per
     # shard per GCS_SHARD_STAT_KEYS key — only on sharded heads.
     "ray_tpu_gcs_shard",
+    # Cluster history plane (ISSUE 20): active watchdog verdicts as a
+    # labeled gauge + per-rule fired counter, and the latest
+    # per-interval sample per (node, key) from the head's ring store.
+    "ray_tpu_health",
+    "ray_tpu_health_fired_total",
+    "ray_tpu_node_history",
 )
 
 
@@ -662,6 +668,103 @@ def test_recovery_shard_envelope_row_documented(fault_tolerance_text):
     flat = " ".join(fault_tolerance_text.split())
     assert "`recovery_shard` row" in flat
     assert "1 of 4 shards" in flat
+
+
+# -------------------------------------------- cluster history plane
+
+
+def test_history_plane_knobs_documented(observability_text):
+    """Every history-plane knob (store cadence/retention + the
+    watchdog's health_* thresholds) keeps a README row in the 'Cluster
+    history plane' knob table."""
+    from ray_tpu._private.config import _DEFAULTS
+
+    knobs = [k for k in _DEFAULTS
+             if k.startswith("metrics_history")
+             or (k.startswith("health_")
+                 and not k.startswith("health_check"))]
+    assert len(knobs) >= 11, (
+        f"history-plane knobs vanished from config: {knobs}")
+    missing = [k for k in knobs
+               if f"`{k}`" not in observability_text]
+    assert not missing, (
+        f"history-plane knobs missing from the README knob table: "
+        f"{missing}")
+
+
+def test_health_rules_parsed_match_importable(observability_text):
+    """Every watchdog rule name (AST-parsed from the module source,
+    asserted identical to the importable HEALTH_RULES tuple) keeps a
+    row in the README rule table."""
+    import ast
+    import inspect
+
+    from ray_tpu._private import metrics_history
+    from ray_tpu._private.metrics_history import HEALTH_RULES
+
+    parsed: tuple = ()
+    tree = ast.parse(inspect.getsource(metrics_history))
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "HEALTH_RULES"
+                for t in node.targets):
+            assert isinstance(node.value, ast.Tuple)
+            parsed = tuple(elt.value for elt in node.value.elts
+                           if isinstance(elt, ast.Constant))
+    assert tuple(parsed) == tuple(HEALTH_RULES)
+    assert len(parsed) == 6
+    missing = [r for r in parsed
+               if f"`{r}`" not in observability_text]
+    assert not missing, (
+        f"watchdog rules missing from the README rule table: "
+        f"{missing}")
+
+
+def test_history_stat_keys_parsed_match_importable(observability_text):
+    """Every HISTORY_STAT_KEYS sample key (the per-interval row the
+    ring store serves and ray_tpu_node_history labels) keeps a README
+    mention in the Observability section."""
+    parsed = registry_keys("metrics_history", "HISTORY_STAT_KEYS")
+    from ray_tpu._private.metrics_history import (
+        GAUGE_KEYS,
+        HISTORY_STAT_KEYS,
+    )
+
+    assert tuple(parsed) == tuple(HISTORY_STAT_KEYS)
+    assert len(parsed) >= 12
+    assert GAUGE_KEYS <= set(parsed)
+    missing = [k for k in parsed
+               if f"`{k}`" not in observability_text]
+    assert not missing, (
+        f"history sample keys missing from the README Observability "
+        f"section: {missing}")
+
+
+def test_history_clis_documented(observability_text):
+    """The top/doctor subcommands and the health series semantics keep
+    their README quickstarts."""
+    for cmd in ("python -m ray_tpu top", "python -m ray_tpu doctor"):
+        assert cmd in observability_text, (
+            f"CLI {cmd!r} missing from the README Observability "
+            f"section")
+    flat = " ".join(observability_text.split())
+    for phrase in ("`ray_tpu_health`", "`cluster_health`",
+                   "`metrics_history`", "sparkline",
+                   "ENVELOPE_HISTORY_ONLY"):
+        assert phrase in flat, (
+            f"'Cluster history plane' section lost {phrase!r}")
+
+
+def test_history_disarm_gate_registered():
+    """The metrics_history knob rides the disarm-gate analysis pass
+    (one module attribute, HISTORY_ON) like every other plane."""
+    from ray_tpu._private.analysis.disarm_gates import KNOB_GATES
+
+    assert KNOB_GATES.get("metrics_history") == (
+        "ray_tpu/_private/metrics_history.py", "HISTORY_ON")
+    from ray_tpu._private.config import _DEFAULTS
+
+    assert "metrics_history" in _DEFAULTS
 
 
 # ---------------------------------------- static analysis tooling

@@ -1,0 +1,649 @@
+"""Cluster history plane: ring-store determinism, delta encoding
+across counter resets, retention, degraded shard reads, the health
+watchdog's typed verdicts, the shared windowed-latency helpers the
+serve router now rides, and the `top`/`doctor` CLIs against a live
+cluster."""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import metrics_history as mh
+from ray_tpu._private.config import GLOBAL_CONFIG
+
+
+@pytest.fixture(autouse=True)
+def _history_clean():
+    yield
+    GLOBAL_CONFIG.reset()
+    mh.init_from_config()
+
+
+def _wait_for(predicate, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while not predicate():
+        assert time.monotonic() < deadline, f"timed out waiting for {what}"
+        time.sleep(0.2)
+
+
+class _FakeClock:
+    def __init__(self, start=0.0, wall0=1_000_000.0):
+        self.now = start
+        self.wall0 = wall0
+
+    def clock(self):
+        return self.now
+
+    def wall(self):
+        return self.wall0 + self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+def _store(interval=1.0, retention=10.0, domains=1, clk=None):
+    clk = clk or _FakeClock()
+    return clk, mh.HistoryStore(interval, retention, domains=domains,
+                                clock=clk.clock, wall=clk.wall)
+
+
+def _stats(tasks=0, shed=0, opens=0, timeouts=0, retries=0, spills=0,
+           restores=0, restore_p50=0.0, fused=0, running=0, depth=0,
+           age=0.1, hist=None):
+    row = {
+        "tasks_executed": tasks, "running": running, "depth": depth,
+        "age_s": age,
+        "faults": {"admission_shed": shed, "breaker_open": opens,
+                   "task_timeouts": timeouts, "rpc_retries": retries},
+        "pipeline": {"fused_fallbacks": fused},
+        "spill": {"spills": spills, "restores": restores,
+                  "restore_p50_ms": restore_p50},
+    }
+    if hist is not None:
+        row["stage_hist"] = hist
+    return row
+
+
+# --------------------------------------------------------------- ring store
+
+
+def test_ring_determinism_under_fixed_clock():
+    """Two stores fed the identical stat sequence under the same fake
+    clock produce byte-identical queries (sampling is pure in its
+    inputs — no wall-clock leaks into the samples)."""
+    runs = []
+    for _ in range(2):
+        clk, store = _store(interval=1.0, retention=10.0, domains=4)
+        for i in range(1, 8):
+            clk.advance(1.0)
+            store.sample({"aa01": _stats(tasks=10 * i, shed=i),
+                          "bb02": _stats(tasks=7 * i)}, [])
+        runs.append(store.query(window_s=5.0))
+    assert runs[0] == runs[1]
+    row = runs[0]["nodes"]["aa01"]
+    # Delta encoding: each interval saw +10 tasks, +1 shed.
+    assert [s["tasks_executed"] for s in row["samples"]] \
+        == [10.0] * len(row["samples"])
+    assert row["rates"]["tasks_executed"] == pytest.approx(10.0)
+    assert row["rates"]["admission_shed"] == pytest.approx(1.0)
+
+
+def test_first_sample_is_zero_delta_not_cumulative_total():
+    """A node's first sighting must not emit its since-boot cumulative
+    totals as one giant interval spike."""
+    clk, store = _store()
+    clk.advance(1.0)
+    store.sample({"aa01": _stats(tasks=50_000, shed=400)}, [])
+    sample = store.query()["nodes"]["aa01"]["samples"][0]
+    assert sample["tasks_executed"] == 0.0
+    assert sample["admission_shed"] == 0.0
+
+
+def test_counter_reset_across_daemon_restart_never_negative():
+    """A daemon restart resets its cumulative counters; the delta
+    encoder must clamp to zero and rebaseline, never emit a negative
+    rate."""
+    clk, store = _store()
+    for tasks in (100, 200, 300):
+        clk.advance(1.0)
+        store.sample({"aa01": _stats(tasks=tasks)}, [])
+    # Restart: cumulative drops 300 -> 5, then resumes 5 -> 30.
+    clk.advance(1.0)
+    store.sample({"aa01": _stats(tasks=5)}, [])
+    clk.advance(1.0)
+    store.sample({"aa01": _stats(tasks=30)}, [])
+    row = store.query()["nodes"]["aa01"]
+    deltas = [s["tasks_executed"] for s in row["samples"]]
+    assert deltas == [0.0, 100.0, 100.0, 0.0, 25.0]
+    assert all(d >= 0.0 for d in deltas)
+    assert row["rates"]["tasks_executed"] >= 0.0
+    # Histogram deltas clamp the same way (snapshot_delta on a reset
+    # histogram: counts can't go negative).
+    delta = mh.snapshot_delta({"counts": [1, 0], "sum": 0.1, "count": 1},
+                              {"counts": [5, 2], "sum": 0.9, "count": 7})
+    assert delta == {"counts": [0, 0], "sum": 0.0, "count": 0}
+
+
+def test_retention_bounds_ring_and_evicts_departed_nodes():
+    clk, store = _store(interval=1.0, retention=5.0)
+    assert store.capacity == 5
+    for i in range(1, 10):
+        clk.advance(1.0)
+        store.sample({"aa01": _stats(tasks=i)}, [])
+    assert len(store.query()["nodes"]["aa01"]["samples"]) <= 5
+    # aa01 departs; bb02 keeps the sampler ticking. Past retention,
+    # aa01's whole series is evicted.
+    for _ in range(7):
+        clk.advance(1.0)
+        store.sample({"bb02": _stats(tasks=1)}, [])
+    nodes = store.query()["nodes"]
+    assert "aa01" not in nodes
+    assert "bb02" in nodes
+
+
+def test_shard_stall_marks_domain_samples_stale_and_degraded():
+    clk, store = _store(domains=4)
+    node_by_domain = {}
+    for i in range(64):
+        hexid = f"{i:02x}ab"
+        node_by_domain.setdefault(store.domain_of(hexid), hexid)
+        if len(node_by_domain) == 4:
+            break
+    stalled_domain = 2
+    stats = {h: _stats(tasks=10) for h in node_by_domain.values()}
+    clk.advance(1.0)
+    store.sample(stats, [{"shard": stalled_domain, "age_s": 4.2}])
+    out = store.query()
+    assert out["degraded"] == [stalled_domain]
+    for domain, hexid in node_by_domain.items():
+        row = out["nodes"][hexid]
+        assert row["stale"] is (domain == stalled_domain)
+    # Heal: next interval reports age 0 — new samples are clean and
+    # the degraded list empties.
+    clk.advance(1.0)
+    store.sample(stats, [{"shard": stalled_domain, "age_s": 0.0}])
+    out = store.query(window_s=0.4)
+    assert out["degraded"] == []
+    assert not out["nodes"][node_by_domain[stalled_domain]]["stale"]
+
+
+def test_stage_hist_window_merge_percentiles():
+    """Stage-latency histograms delta-encode per interval; merging a
+    window of deltas reproduces the cumulative window histogram
+    exactly (the bucket-subtraction trick, generalized)."""
+    from ray_tpu._private import perf_plane
+
+    clk, store = _store()
+    hist = perf_plane.StageHistogram()
+    cumulative: dict = {}
+    for i in range(1, 6):
+        for _ in range(10):
+            hist.observe(0.001 * i)
+        snap = hist.snapshot()
+        clk.advance(1.0)
+        store.sample({"aa01": _stats(tasks=i, hist={"exec": snap})}, [])
+        cumulative = snap
+    samples = store.query()["nodes"]["aa01"]["samples"]
+    merged = mh.merge_window(samples, "exec")
+    assert merged["count"] == cumulative["count"]
+    assert merged["counts"] == list(cumulative["counts"])
+    assert mh.summarize(merged)["p50_s"] \
+        == pytest.approx(mh.summarize(cumulative)["p50_s"])
+
+
+# ----------------------------------------------- shared latency helpers
+
+
+def test_snapshot_delta_summarize_match_pr14_router_semantics():
+    """The shared helpers must reproduce the router's hand-rolled
+    window summary bit-for-bit (the PR 14 implementation, inlined here
+    as the oracle) on growing histograms."""
+    from ray_tpu._private import perf_plane
+
+    def oracle(snap, prev):  # the old Router.latency_window_stats math
+        if prev is None:
+            delta = snap
+        else:
+            delta = {
+                "counts": [int(a) - int(b) for a, b in
+                           zip(snap["counts"], prev["counts"])],
+                "sum": float(snap["sum"]) - float(prev["sum"]),
+                "count": int(snap["count"]) - int(prev["count"]),
+            }
+        count = int(delta.get("count", 0))
+        return {
+            "count": count,
+            "mean_s": (delta["sum"] / count) if count else 0.0,
+            "p50_s": perf_plane.quantile(delta, 0.5),
+            "p99_s": perf_plane.quantile(delta, 0.99),
+        }
+
+    hist = perf_plane.StageHistogram()
+    prev = None
+    import random
+
+    rng = random.Random(7)
+    for _ in range(6):
+        for _ in range(200):
+            hist.observe(rng.uniform(1e-4, 0.5))
+        snap = hist.snapshot()
+        expect = oracle(snap, prev)
+        got = mh.summarize(mh.snapshot_delta(snap, prev))
+        assert got == expect
+        prev = snap
+
+
+def test_router_summarize_is_the_shared_helper():
+    from ray_tpu.serve.router import Router
+
+    assert Router._summarize is mh.summarize
+
+
+def test_router_window_stats_ride_shared_helper():
+    from ray_tpu._private import perf_plane
+    from ray_tpu.serve.router import Router
+
+    router = Router.__new__(Router)
+    router._latency = perf_plane.StageHistogram()
+    router._last_window_snap = None
+    import threading
+
+    router._lock = threading.Lock()
+    for _ in range(100):
+        router._latency.observe(0.010)
+    first = router.latency_window_stats()
+    assert first["count"] == 100
+    for _ in range(50):
+        router._latency.observe(0.100)
+    window = router.latency_window_stats()
+    # Only the NEW 50 observations: the all-time p50 (0.01-dominated)
+    # must not leak into the window.
+    assert window["count"] == 50
+    assert window["p50_s"] > first["p50_s"]
+
+
+def test_router_latency_stamps_survive_wall_clock_jump(monkeypatch):
+    """Regression (the satellite fix): response release must stamp
+    monotonic latency — a wall-clock jump mid-request used to distort
+    p50/p99 and the autoscaler feed."""
+    from ray_tpu.serve import router as router_mod
+
+    class FakeRouter:
+        def __init__(self):
+            self.observed = []
+
+        def _release(self, idx):
+            pass
+
+        def observe_latency(self, dt_s):
+            self.observed.append(dt_s)
+
+    fake = FakeRouter()
+    resp = router_mod.DeploymentResponse(
+        None, router=fake, replica_idx=0,
+        started=time.monotonic())
+    # Jump the wall clock an hour forward.
+    real_time = time.time
+    monkeypatch.setattr(router_mod.time, "time",
+                        lambda: real_time() + 3600.0)
+    resp._release()
+    assert len(fake.observed) == 1
+    assert fake.observed[0] < 60.0
+
+    fake2 = FakeRouter()
+    stream = router_mod.DeploymentStreamingResponse(
+        None, None, router=fake2, replica_idx=0,
+        started=time.monotonic())
+    stream._release()
+    assert len(fake2.observed) == 1
+    assert fake2.observed[0] < 60.0
+
+
+# ------------------------------------------------------------- watchdog
+
+
+_THRESHOLDS = {
+    "window_s": 10.0,
+    "overload_shed_per_s": 0.5,
+    "breaker_storm_opens": 3.0,
+    "spill_churn_per_s": 2.0,
+    "spill_restore_p50_ms": 50.0,
+    "wedged_age_s": 5.0,
+    "stale_shard_age_s": 3.0,
+    "fused_fallback_per_s": 1.0,
+}
+
+
+def _watchdog(domains=1):
+    clk, store = _store(domains=domains)
+    return clk, store, mh.HealthWatchdog(store, thresholds=_THRESHOLDS)
+
+
+def _feed(clk, store, rows, shard_rows=None, n=1):
+    for _ in range(n):
+        clk.advance(1.0)
+        store.sample(rows, shard_rows or [])
+
+
+def test_watchdog_zero_verdicts_on_clean_run():
+    clk, store, wd = _watchdog()
+    cumulative = 0
+    for _ in range(8):
+        cumulative += 50
+        _feed(clk, store, {"aa01": _stats(tasks=cumulative)})
+        assert wd.sweep({"aa01": _stats(tasks=cumulative)}, []) == []
+    report = wd.report()
+    assert report["verdicts"] == []
+    assert report["fired"] == []
+    assert report["fired_total"] == {}
+    assert report["rules"] == list(mh.HEALTH_RULES)
+
+
+def test_overload_requires_sustained_sheds():
+    clk, store, wd = _watchdog()
+    # One burst interval (rate over window still past threshold) must
+    # NOT fire: sustained means >= 2 shedding intervals.
+    _feed(clk, store, {"aa01": _stats(shed=0)})
+    _feed(clk, store, {"aa01": _stats(shed=40)})
+    assert wd.sweep({}, []) == []
+    # A second shedding interval fires it.
+    _feed(clk, store, {"aa01": _stats(shed=80)})
+    new = wd.sweep({}, [])
+    assert [v["rule"] for v in new] == ["overload"]
+    verdict = new[0]
+    assert verdict["node"] == "aa01"
+    assert verdict["value"] >= _THRESHOLDS["overload_shed_per_s"]
+    assert verdict["evidence"]["intervals_shedding"] >= 2
+    assert verdict["window_s"] == 10.0
+
+
+def test_breaker_storm_fires_on_open_burst():
+    clk, store, wd = _watchdog()
+    _feed(clk, store, {"aa01": _stats(opens=0)})
+    _feed(clk, store, {"aa01": _stats(opens=4)})
+    new = wd.sweep({}, [])
+    assert [v["rule"] for v in new] == ["breaker_storm"]
+    assert new[0]["value"] == 4.0
+    assert sum(new[0]["evidence"]["breaker_open"]) == 4.0
+
+
+def test_spill_thrash_needs_churn_and_slow_restores():
+    clk, store, wd = _watchdog()
+    # High churn, fast restores: no verdict (healthy spill tier).
+    _feed(clk, store, {"aa01": _stats()})
+    _feed(clk, store, {"aa01": _stats(spills=30, restores=30,
+                                      restore_p50=1.0)})
+    assert wd.sweep({}, []) == []
+    # Churn with restore p50 past bound: verdict.
+    _feed(clk, store, {"aa01": _stats(spills=60, restores=60,
+                                      restore_p50=120.0)})
+    new = wd.sweep({}, [])
+    assert [v["rule"] for v in new] == ["spill_thrash"]
+    assert new[0]["evidence"]["restore_p50_ms"] == 120.0
+
+
+def test_stale_shard_verdict_names_the_shard():
+    clk, store, wd = _watchdog(domains=4)
+    _feed(clk, store, {"aa01": _stats()},
+          shard_rows=[{"shard": 3, "age_s": 7.5, "queued_writes": 9,
+                       "shed_writes": 0}])
+    new = wd.sweep({}, [{"shard": 3, "age_s": 7.5, "queued_writes": 9,
+                         "shed_writes": 0}])
+    assert [v["rule"] for v in new] == ["stale_shard"]
+    assert new[0]["node"] == "shard:3"
+    assert new[0]["evidence"]["queued_writes"] == 9
+
+
+def test_wedged_node_verdict_on_stats_age():
+    clk, store, wd = _watchdog()
+    _feed(clk, store, {"aa01": _stats()})
+    new = wd.sweep({"aa01": _stats(age=9.0), "bb02": _stats(age=0.2)},
+                   [])
+    assert [(v["rule"], v["node"]) for v in new] \
+        == [("wedged_node", "aa01")]
+
+
+def test_fused_fallback_spike_verdict():
+    clk, store, wd = _watchdog()
+    _feed(clk, store, {"aa01": _stats(fused=0)})
+    _feed(clk, store, {"aa01": _stats(fused=30)})
+    new = wd.sweep({}, [])
+    assert [v["rule"] for v in new] == ["fused_fallback_spike"]
+
+
+def test_verdict_lifecycle_flight_records_activations_only(monkeypatch):
+    """A (rule, node) pair flight-records once on ACTIVATION, stays
+    active without re-recording, clears when the condition stops
+    holding, and re-records on the next activation."""
+    from ray_tpu._private import flight_recorder
+
+    recorded = []
+    monkeypatch.setattr(flight_recorder, "record",
+                        lambda kind, *args: recorded.append(
+                            (kind, args)))
+    clk, store, wd = _watchdog()
+    shard_rows = [{"shard": 0, "age_s": 9.0, "queued_writes": 0,
+                   "shed_writes": 0}]
+    _feed(clk, store, {"aa01": _stats()})
+    assert len(wd.sweep({}, shard_rows)) == 1
+    assert recorded == [("health.stale_shard", ("shard:0", 9.0))]
+    # Still stalled: active, but no second flight record.
+    assert wd.sweep({}, shard_rows) == []
+    assert len(recorded) == 1
+    assert len(wd.report()["verdicts"]) == 1
+    # Healed: verdict clears.
+    assert wd.sweep({}, []) == []
+    assert wd.report()["verdicts"] == []
+    # Stalls again: re-fires, counted twice in fired_total.
+    assert len(wd.sweep({}, shard_rows)) == 1
+    assert len(recorded) == 2
+    assert wd.report()["fired_total"] == {"stale_shard": 2}
+
+
+def test_rule_registry_matches_dispatch_table():
+    assert tuple(mh._RULES) == mh.HEALTH_RULES
+    for rule in mh.HEALTH_RULES:
+        assert callable(mh._RULES[rule])
+
+
+# ------------------------------------------------------- live cluster
+
+
+def _run_cli(argv):
+    from ray_tpu import scripts
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = scripts.main(argv)
+    return rc, buf.getvalue()
+
+
+def test_top_doctor_smoke_against_live_two_node_cluster(tmp_path):
+    """Acceptance: `python -m ray_tpu top` renders >= 2 nodes of
+    rate-derived history from a live cluster; `doctor` reports a clean
+    bill (exit 0, zero verdicts); the debug bundle folds both in."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.util.state.api import collect_debug_bundle
+
+    GLOBAL_CONFIG.update({"metrics_history_interval_s": 0.3})
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir=str(tmp_path / "cluster"))
+    cluster.add_node(num_cpus=2, heartbeat_period_s=0.3)
+    cluster.add_node(num_cpus=2, heartbeat_period_s=0.3)
+    runtime = None
+    try:
+        assert cluster.wait_for_nodes(2, timeout=30)
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        _wait_for(lambda: ray_tpu.cluster_resources().get("CPU", 0) >= 4,
+                  30, "both nodes to join")
+
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        for _ in range(4):
+            assert sorted(ray_tpu.get([f.remote(i)
+                                       for i in range(40)])) \
+                == list(range(1, 41))
+            time.sleep(0.5)
+        # Both nodes sampled with nonzero task rates.
+        _wait_for(
+            lambda: (lambda h: h is not None and h.get("armed")
+                     and sum(1 for r in h["nodes"].values()
+                             if r["rates"]["tasks_executed"] > 0) >= 2)(
+                runtime.metrics_history(window_s=30.0)),
+            30, "two nodes of rate-derived history")
+
+        rc, out = _run_cli(["top", "--iterations", "1", "--no-clear",
+                            "--window", "30"])
+        assert rc == 0
+        hist = runtime.metrics_history(window_s=30.0)
+        node_rows = [line for line in out.splitlines()
+                     if any(h[:16] in line for h in hist["nodes"])]
+        assert len(node_rows) >= 2, out
+        assert "active verdicts: none" in out
+        assert "cluster history — " in out
+
+        rc, out = _run_cli(["doctor", "--window", "30"])
+        assert rc == 0, out
+        assert "0 active verdict(s)" in out
+        assert "no active verdicts — cluster healthy" in out
+
+        health = runtime.cluster_health()
+        assert health["armed"] and health["verdicts"] == []
+
+        bundle = collect_debug_bundle(str(tmp_path / "bundle.json"))
+        assert bundle["metrics_history"]["armed"]
+        assert bundle["cluster_health"]["armed"]
+    finally:
+        if runtime is not None:
+            ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_doctor_names_stalled_shard_and_degraded_history(tmp_path):
+    """Acceptance: after a gcs.shard_stall window, `doctor` names the
+    stalled shard (typed stale_shard verdict with its evidence) and
+    the history query stale-marks that domain."""
+    from ray_tpu.cluster_utils import Cluster
+
+    GLOBAL_CONFIG.update({"gcs_shards": 4,
+                          "metrics_history_interval_s": 0.3,
+                          "health_stale_shard_age_s": 1.0})
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir=str(tmp_path / "cluster"),
+                      persist_path=str(tmp_path / "gcs_snapshot.pkl"))
+    cluster.add_node(num_cpus=2, heartbeat_period_s=0.3)
+    runtime = None
+    try:
+        assert cluster.wait_for_nodes(1, timeout=30)
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        victim = 2
+        cluster.gcs._shards[victim].stall(8.0)
+        _wait_for(
+            lambda: any(v["rule"] == "stale_shard"
+                        for v in (runtime.cluster_health() or {})
+                        .get("verdicts", [])),
+            30, "stale_shard verdict")
+        rc, out = _run_cli(["doctor"])
+        assert rc == 1  # active verdicts -> nonzero (scriptable check)
+        assert "[stale_shard]" in out
+        assert f"shard:{victim}" in out
+        assert f"gcs shard {victim} stalled" in out
+        assert "evidence:" in out
+        # History marks the stalled domain degraded.
+        hist = runtime.metrics_history(window_s=10.0)
+        assert victim in hist["degraded"]
+        # The stall window lapses; the verdict clears on its own.
+        _wait_for(
+            lambda: not (runtime.cluster_health() or {}).get("verdicts"),
+            30, "verdict to clear after heal")
+    finally:
+        if runtime is not None:
+            ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_overload_chaos_fires_overload_verdict(tmp_path):
+    """Acceptance: under chaos overload.saturate the watchdog returns
+    the typed overload verdict (with its evidence window) via
+    cluster_health."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.exceptions import SystemOverloadedError
+
+    GLOBAL_CONFIG.update({"metrics_history_interval_s": 0.3,
+                          "health_window_s": 8.0,
+                          "health_overload_shed_per_s": 0.2})
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir=str(tmp_path / "cluster"))
+    cluster.add_node(
+        num_cpus=2, pool_size=1, heartbeat_period_s=0.3,
+        env={"RAY_TPU_CHAOS": "seed=7,overload.saturate=1.0x64"})
+    runtime = None
+    try:
+        assert cluster.wait_for_nodes(1, timeout=30)
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        _wait_for(lambda: ray_tpu.cluster_resources().get("CPU", 0) >= 2,
+                  30, "worker node to join")
+
+        @ray_tpu.remote(num_cpus=1)
+        def quick(x):
+            return x
+
+        # Sustained sheds: several waves spaced past the sampling
+        # interval, each burning chaos-shed admissions.
+        for _wave in range(4):
+            for i in range(3):
+                with pytest.raises(SystemOverloadedError):
+                    ray_tpu.get(quick.remote(i, _deadline_s=5),
+                                timeout=30)
+            time.sleep(1.0)
+        _wait_for(
+            lambda: any(v["rule"] == "overload"
+                        for v in (runtime.cluster_health() or {})
+                        .get("verdicts", [])),
+            30, "overload verdict")
+        verdict = next(v for v in runtime.cluster_health()["verdicts"]
+                       if v["rule"] == "overload")
+        assert verdict["value"] >= 0.2
+        assert verdict["evidence"]["intervals_shedding"] >= 2
+        assert verdict["window_s"] == 8.0
+    finally:
+        if runtime is not None:
+            ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_disarmed_head_answers_typed_unarmed(tmp_path):
+    """metrics_history=0 disarms the plane at head boot: both RPCs
+    answer armed=False (never an error), top degrades with a clear
+    message."""
+    from ray_tpu.cluster_utils import Cluster
+
+    GLOBAL_CONFIG.update({"metrics_history": False})
+    mh.init_from_config()
+    assert mh.HISTORY_ON is False
+    ray_tpu.shutdown()
+    cluster = Cluster(log_dir=str(tmp_path / "cluster"))
+    runtime = None
+    try:
+        runtime = ray_tpu.init(num_cpus=0, address=cluster.address)
+        hist = runtime.metrics_history()
+        assert hist is not None and hist["armed"] is False
+        health = runtime.cluster_health()
+        assert health is not None and health["armed"] is False
+        assert health["rules"] == list(mh.HEALTH_RULES)
+        rc, out = _run_cli(["top", "--iterations", "1", "--no-clear"])
+        assert rc == 0
+        assert "history plane unavailable" in out
+        rc, out = _run_cli(["doctor"])
+        assert rc == 2
+    finally:
+        if runtime is not None:
+            ray_tpu.shutdown()
+        cluster.shutdown()
